@@ -1,0 +1,70 @@
+"""ipc_proofs_tpu — TPU-native framework for IPC cross-chain proofs.
+
+A from-scratch re-design of the capabilities of
+consensus-shipyard/ipc-filecoin-proofs (Rust, single-threaded CPU) as a
+batch-first, TPU-native framework:
+
+- ``core``     — IPLD byte layer: canonical DAG-CBOR, CIDv1, varint,
+                 keccak256 / blake2b-256 (replaces the reference's external
+                 crates ``serde_ipld_dagcbor``/``cid``/``multihash``/``sha3``).
+- ``store``    — the Blockstore plugin boundary (memory / recording / cached /
+                 RPC), mirroring reference ``src/client/*blockstore.rs`` and
+                 ``src/proofs/common/blockstore.rs``.
+- ``ipld``     — AMT (v0 + v3) and HAMT readers *and writers* (the reference
+                 delegates to ``fvm_ipld_amt``/``fvm_ipld_hamt`` and has no
+                 writers; writers here enable hermetic fixtures).
+- ``state``    — Filecoin state schema decode (headers, actors, EVM state,
+                 events, receipts, addresses, storage-slot encodings).
+- ``proofs``   — storage/event proof engines, unified bundle API, trust
+                 policies (reference ``src/proofs/``).
+- ``backend``  — the BatchHashBackend seam: CPU (numpy + C++ ext) and TPU
+                 (JAX/Pallas) implementations of the batch inner loops.
+- ``ops``      — JAX / Pallas kernels (keccak-f[1600], blake2b, match masks).
+- ``parallel`` — device-mesh sharding helpers for the batch pipeline.
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "CID": ("ipc_proofs_tpu.core.cid", "CID"),
+    "ProofBlock": ("ipc_proofs_tpu.proofs.bundle", "ProofBlock"),
+    "UnifiedProofBundle": ("ipc_proofs_tpu.proofs.bundle", "UnifiedProofBundle"),
+    "UnifiedVerificationResult": (
+        "ipc_proofs_tpu.proofs.bundle",
+        "UnifiedVerificationResult",
+    ),
+    "StorageProofSpec": ("ipc_proofs_tpu.proofs.generator", "StorageProofSpec"),
+    "EventProofSpec": ("ipc_proofs_tpu.proofs.generator", "EventProofSpec"),
+    "generate_proof_bundle": ("ipc_proofs_tpu.proofs.generator", "generate_proof_bundle"),
+    "verify_proof_bundle": ("ipc_proofs_tpu.proofs.verifier", "verify_proof_bundle"),
+    "TrustPolicy": ("ipc_proofs_tpu.proofs.trust", "TrustPolicy"),
+    "TrustVerifier": ("ipc_proofs_tpu.proofs.trust", "TrustVerifier"),
+    "MockTrustVerifier": ("ipc_proofs_tpu.proofs.trust", "MockTrustVerifier"),
+}
+
+
+def __getattr__(name):
+    """Lazy re-exports so `import ipc_proofs_tpu.core` never pulls in JAX."""
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "CID",
+    "ProofBlock",
+    "UnifiedProofBundle",
+    "UnifiedVerificationResult",
+    "StorageProofSpec",
+    "EventProofSpec",
+    "generate_proof_bundle",
+    "verify_proof_bundle",
+    "TrustPolicy",
+    "TrustVerifier",
+    "MockTrustVerifier",
+]

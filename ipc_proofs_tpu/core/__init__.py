@@ -1,0 +1,26 @@
+"""Core IPLD byte layer: varint, CID, canonical DAG-CBOR, hashes.
+
+Replaces the reference's external crates (`cid`, `multihash-codetable`,
+`serde_ipld_dagcbor`, `fvm_ipld_encoding`, `sha3` — reference Cargo.toml:10-39)
+with a self-contained implementation. Byte-exactness here is load-bearing:
+every proof CID above this layer depends on it.
+"""
+
+from ipc_proofs_tpu.core.varint import encode_uvarint, decode_uvarint
+from ipc_proofs_tpu.core.cid import CID, DAG_CBOR, RAW, BLAKE2B_256, SHA2_256
+from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode, decode as cbor_decode
+from ipc_proofs_tpu.core.hashes import keccak256, blake2b_256
+
+__all__ = [
+    "encode_uvarint",
+    "decode_uvarint",
+    "CID",
+    "DAG_CBOR",
+    "RAW",
+    "BLAKE2B_256",
+    "SHA2_256",
+    "cbor_encode",
+    "cbor_decode",
+    "keccak256",
+    "blake2b_256",
+]

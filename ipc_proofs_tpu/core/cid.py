@@ -1,0 +1,117 @@
+"""CIDv1 with dag-cbor/raw codecs and blake2b-256/sha2-256 multihashes.
+
+Replaces the reference's ``cid`` + ``multihash-codetable`` crates. Filecoin
+chain CIDs are CIDv1 / dag-cbor / blake2b-256; strings are multibase
+base32-lower ("b" prefix), e.g. ``bafy2bza...``.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass
+from functools import total_ordering
+
+from ipc_proofs_tpu.core.hashes import blake2b_256
+from ipc_proofs_tpu.core.varint import decode_uvarint, encode_uvarint
+
+# codecs
+DAG_CBOR = 0x71
+RAW = 0x55
+
+# multihash codes
+BLAKE2B_256 = 0xB220
+SHA2_256 = 0x12
+IDENTITY = 0x00
+
+__all__ = ["CID", "DAG_CBOR", "RAW", "BLAKE2B_256", "SHA2_256", "IDENTITY"]
+
+
+def _b32_encode_lower(data: bytes) -> str:
+    return base64.b32encode(data).decode("ascii").rstrip("=").lower()
+
+
+def _b32_decode_lower(text: str) -> bytes:
+    pad = (-len(text)) % 8
+    return base64.b32decode(text.upper() + "=" * pad)
+
+
+@total_ordering
+@dataclass(frozen=True)
+class CID:
+    """An immutable CIDv1 (version, codec, multihash code, digest)."""
+
+    version: int
+    codec: int
+    mh_code: int
+    digest: bytes
+
+    # --- constructors ------------------------------------------------------
+
+    @classmethod
+    def hash_of(cls, data: bytes, codec: int = DAG_CBOR, mh_code: int = BLAKE2B_256) -> "CID":
+        """CID of raw block bytes (the Filecoin chain default: blake2b-256)."""
+        if mh_code == BLAKE2B_256:
+            digest = blake2b_256(data)
+        elif mh_code == SHA2_256:
+            import hashlib
+
+            digest = hashlib.sha256(data).digest()
+        elif mh_code == IDENTITY:
+            digest = data
+        else:
+            raise ValueError(f"unsupported multihash code {mh_code:#x}")
+        return cls(1, codec, mh_code, digest)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "CID":
+        version, off = decode_uvarint(raw)
+        if version != 1:
+            raise ValueError(f"unsupported CID version {version}")
+        codec, off = decode_uvarint(raw, off)
+        mh_code, off = decode_uvarint(raw, off)
+        mh_len, off = decode_uvarint(raw, off)
+        digest = raw[off : off + mh_len]
+        if len(digest) != mh_len:
+            raise ValueError("truncated CID multihash digest")
+        if off + mh_len != len(raw):
+            raise ValueError("trailing bytes after CID")
+        return cls(version, codec, mh_code, digest)
+
+    @classmethod
+    def from_string(cls, text: str) -> "CID":
+        if not text:
+            raise ValueError("empty CID string")
+        if text[0] != "b":
+            raise ValueError(f"unsupported multibase prefix {text[0]!r} (base32 only)")
+        return cls.from_bytes(_b32_decode_lower(text[1:]))
+
+    @classmethod
+    def parse(cls, value: "CID | str | bytes") -> "CID":
+        if isinstance(value, CID):
+            return value
+        if isinstance(value, bytes):
+            return cls.from_bytes(value)
+        return cls.from_string(value)
+
+    # --- serialization -----------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        return (
+            encode_uvarint(self.version)
+            + encode_uvarint(self.codec)
+            + encode_uvarint(self.mh_code)
+            + encode_uvarint(len(self.digest))
+            + self.digest
+        )
+
+    def __str__(self) -> str:
+        return "b" + _b32_encode_lower(self.to_bytes())
+
+    def __repr__(self) -> str:
+        return f"CID({str(self)})"
+
+    def __lt__(self, other: "CID") -> bool:
+        return self.to_bytes() < other.to_bytes()
+
+    def __hash__(self) -> int:  # dataclass frozen gives eq; keep hash cheap
+        return hash(self.digest)

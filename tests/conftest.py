@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Multi-chip TPU hardware is not available in CI; all `jax.sharding.Mesh` tests
+run against 8 virtual CPU devices. The driver separately dry-run-compiles the
+multi-chip path via `__graft_entry__.dryrun_multichip`.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

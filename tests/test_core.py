@@ -1,0 +1,194 @@
+"""Golden-vector and round-trip tests for the core IPLD byte layer."""
+
+import pytest
+
+from ipc_proofs_tpu.core.bigint import bigint_from_bytes, bigint_to_bytes
+from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, DAG_CBOR, RAW
+from ipc_proofs_tpu.core.dagcbor import decode, encode
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
+from ipc_proofs_tpu.core.varint import decode_uvarint, encode_uvarint
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "value,expected",
+        [
+            (0, b"\x00"),
+            (1, b"\x01"),
+            (127, b"\x7f"),
+            (128, b"\x80\x01"),
+            (300, b"\xac\x02"),
+            (0xB220, b"\xa0\xe4\x02"),  # blake2b-256 multihash code
+        ],
+    )
+    def test_roundtrip(self, value, expected):
+        assert encode_uvarint(value) == expected
+        decoded, offset = decode_uvarint(expected)
+        assert decoded == value
+        assert offset == len(expected)
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError):
+            encode_uvarint(-1)
+
+
+class TestKeccak256:
+    def test_empty(self):
+        # Universal Keccak-256 test vector
+        assert (
+            keccak256(b"").hex()
+            == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        )
+
+    def test_abc(self):
+        assert (
+            keccak256(b"abc").hex()
+            == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+        )
+
+    def test_transfer_topic(self):
+        # The canonical ERC-20 Transfer event topic0
+        assert (
+            keccak256(b"Transfer(address,address,uint256)").hex()
+            == "ddf252ad1be2c89b69c2b068fc378daa952ba7f163c4a11628f55a4df523b3ef"
+        )
+
+    def test_multiblock(self):
+        # > 136-byte (rate) input exercises the multi-block sponge path;
+        # check self-consistency against incremental property: determinism
+        data = bytes(range(256)) * 3
+        assert keccak256(data) == keccak256(bytes(data))
+        assert len(keccak256(data)) == 32
+
+    def test_rate_boundary(self):
+        for n in (135, 136, 137, 271, 272, 273):
+            assert len(keccak256(b"\xaa" * n)) == 32
+
+
+class TestBlake2b:
+    def test_known_vector(self):
+        # blake2b-256 of empty string (from the BLAKE2 reference implementation)
+        assert (
+            blake2b_256(b"").hex()
+            == "0e5751c026e543b2e8ab2eb06099daa1d1e5df47778f7787faab45cdf12fe3a8"
+        )
+
+
+class TestCID:
+    def test_hash_and_string_roundtrip(self):
+        c = CID.hash_of(b"hello world")
+        assert c.version == 1
+        assert c.codec == DAG_CBOR
+        assert c.mh_code == BLAKE2B_256
+        s = str(c)
+        assert s.startswith("b")
+        assert CID.from_string(s) == c
+
+    def test_bytes_roundtrip(self):
+        c = CID.hash_of(b"data", codec=RAW)
+        assert CID.from_bytes(c.to_bytes()) == c
+
+    def test_ordering_matches_byte_order(self):
+        a = CID.hash_of(b"a")
+        b = CID.hash_of(b"b")
+        assert (a < b) == (a.to_bytes() < b.to_bytes())
+
+    def test_known_filecoin_cid_parses(self):
+        # A real CIDv1/dag-cbor/blake2b-256 string shape from Filecoin
+        c = CID.hash_of(b"\x82\x00\x01")
+        s = str(c)
+        assert s.startswith("bafy2bza")  # v1 + dag-cbor + blake2b-256 prefix
+        parsed = CID.from_string(s)
+        assert parsed.digest == c.digest
+
+
+class TestDagCbor:
+    @pytest.mark.parametrize(
+        "value",
+        [
+            0,
+            1,
+            23,
+            24,
+            255,
+            256,
+            65535,
+            65536,
+            2**32 - 1,
+            2**32,
+            2**64 - 1,
+            -1,
+            -24,
+            -25,
+            -(2**63),
+            b"",
+            b"\x00\x01\x02",
+            "",
+            "hello",
+            "héllo ünïcode",
+            [],
+            [1, [2, [3]]],
+            {},
+            {"a": 1, "b": [2]},
+            True,
+            False,
+            None,
+        ],
+    )
+    def test_roundtrip(self, value):
+        assert decode(encode(value)) == value
+
+    def test_canonical_int_heads(self):
+        assert encode(0) == b"\x00"
+        assert encode(23) == b"\x17"
+        assert encode(24) == b"\x18\x18"
+        assert encode(255) == b"\x18\xff"
+        assert encode(256) == b"\x19\x01\x00"
+        assert encode(-1) == b"\x20"
+
+    def test_cid_tag42(self):
+        c = CID.hash_of(b"block")
+        raw = encode(c)
+        # tag 42 head
+        assert raw[0] == 0xD8 and raw[1] == 42
+        # bytestring head 0x58 0x25 (37 bytes), then identity multibase 0x00
+        # 39 = identity prefix + 38 CID bytes (1 ver + 1 codec + 3 mh-code + 1 len + 32 digest)
+        assert raw[2] == 0x58 and raw[3] == 39 and raw[4] == 0x00
+        assert decode(raw) == c
+
+    def test_tuple_encodes_as_array(self):
+        assert encode((1, 2)) == encode([1, 2])
+
+    def test_map_key_ordering_is_canonical(self):
+        # length-first, then bytewise
+        raw = encode({"bb": 1, "a": 2, "ab": 3})
+        assert decode(raw) == {"a": 2, "ab": 3, "bb": 1}
+        ordered = encode({"a": 2, "ab": 3, "bb": 1})
+        assert raw == ordered
+
+    def test_trailing_bytes_rejected(self):
+        with pytest.raises(ValueError):
+            decode(encode(1) + b"\x00")
+
+    def test_indefinite_rejected(self):
+        with pytest.raises(ValueError):
+            decode(b"\x9f\x01\xff")  # indefinite array
+
+    def test_nested_structure_with_cids(self):
+        c1 = CID.hash_of(b"one")
+        c2 = CID.hash_of(b"two", codec=RAW)
+        obj = [c1, {"link": c2, "n": 42}, [c1, c2]]
+        assert decode(encode(obj)) == obj
+
+
+class TestBigInt:
+    @pytest.mark.parametrize("value", [0, 1, -1, 255, 256, 10**30, -(10**30)])
+    def test_roundtrip(self, value):
+        assert bigint_from_bytes(bigint_to_bytes(value)) == value
+
+    def test_zero_is_empty(self):
+        assert bigint_to_bytes(0) == b""
+
+    def test_sign_bytes(self):
+        assert bigint_to_bytes(5) == b"\x00\x05"
+        assert bigint_to_bytes(-5) == b"\x01\x05"
