@@ -1,0 +1,26 @@
+"""Blockstore stack — the single plugin boundary of the framework.
+
+Every traversal algorithm (AMT walk, HAMT walk, header decode) is generic
+over the `Blockstore` protocol, so the same code runs online (RPC-backed,
+recording) during generation and offline (memory-backed) during verification.
+Mirrors the reference's `fvm_ipld_blockstore::Blockstore` seam
+(`src/client/blockstore.rs`, `src/client/cached_blockstore.rs`,
+`src/proofs/common/blockstore.rs`).
+"""
+
+from ipc_proofs_tpu.store.blockstore import (
+    Blockstore,
+    CachedBlockstore,
+    MemoryBlockstore,
+    RecordingBlockstore,
+)
+from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+
+__all__ = [
+    "Blockstore",
+    "MemoryBlockstore",
+    "RecordingBlockstore",
+    "CachedBlockstore",
+    "LotusClient",
+    "RpcBlockstore",
+]

@@ -1,0 +1,176 @@
+"""Blockstore protocol and the memory / recording / cached implementations.
+
+Reference parity:
+- `Blockstore` protocol ≈ `fvm_ipld_blockstore::Blockstore` (get/put_keyed/has).
+- `MemoryBlockstore` ≈ the external crate impl used as the isolated verifier
+  store (reference `storage/verifier.rs:68-78`, `events/verifier.rs:79-89`).
+  Unlike the reference (which documents that `put_keyed` does NOT verify the
+  hash), `put_keyed` here optionally recomputes the CID — verification batches
+  this on TPU instead of trusting the witness implicitly.
+- `RecordingBlockstore` ≈ `src/proofs/common/blockstore.rs:8-39` — the witness
+  mechanism: records every CID fetched through it into an ordered set.
+- `CachedBlockstore` ≈ `src/client/cached_blockstore.rs:12-85` — memoizing
+  wrapper with a cache shareable across instances; unlike the reference's
+  `Rc<RefCell<…>>` (single-threaded), the cache here is lock-protected so a
+  host-side prefetcher can fill it concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional, Protocol, runtime_checkable
+
+from ipc_proofs_tpu.core.cid import CID
+
+__all__ = [
+    "Blockstore",
+    "MemoryBlockstore",
+    "RecordingBlockstore",
+    "CachedBlockstore",
+    "put_cbor",
+]
+
+
+@runtime_checkable
+class Blockstore(Protocol):
+    """The plugin boundary: content-addressed block storage."""
+
+    def get(self, cid: CID) -> Optional[bytes]:
+        """Return the raw block bytes for ``cid``, or None if absent."""
+        ...
+
+    def put_keyed(self, cid: CID, data: bytes) -> None:
+        """Store ``data`` under an externally supplied ``cid``."""
+        ...
+
+    def has(self, cid: CID) -> bool:
+        return self.get(cid) is not None
+
+
+def put_cbor(store: Blockstore, obj, codec: int = 0x71, mh_code: int = 0xB220) -> CID:
+    """Encode ``obj`` as DAG-CBOR, store it, and return its CID.
+
+    Equivalent of `fvm_ipld_encoding::CborStore::put_cbor` with
+    `Code::Blake2b256` (the TxMeta recompute at reference
+    `events/utils.rs:65`).
+    """
+    from ipc_proofs_tpu.core.dagcbor import encode
+
+    data = encode(obj)
+    cid = CID.hash_of(data, codec=codec, mh_code=mh_code)
+    store.put_keyed(cid, data)
+    return cid
+
+
+class MemoryBlockstore:
+    """In-memory blockstore; the isolated store for offline verification."""
+
+    def __init__(self, verify_cids: bool = False):
+        self._blocks: dict[CID, bytes] = {}
+        self._verify = verify_cids
+
+    def get(self, cid: CID) -> Optional[bytes]:
+        return self._blocks.get(cid)
+
+    def put_keyed(self, cid: CID, data: bytes) -> None:
+        if self._verify:
+            recomputed = CID.hash_of(data, codec=cid.codec, mh_code=cid.mh_code)
+            if recomputed != cid:
+                raise ValueError(f"block bytes do not hash to claimed CID {cid}")
+        self._blocks[cid] = bytes(data)
+
+    def has(self, cid: CID) -> bool:
+        return cid in self._blocks
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def items(self) -> Iterable[tuple[CID, bytes]]:
+        return self._blocks.items()
+
+
+class RecordingBlockstore:
+    """Wraps any blockstore and records every CID fetched through it.
+
+    This is the witness mechanism (reference `common/blockstore.rs:8-39`):
+    the recorded set becomes the proof's witness after materialization.
+    Thread-safe, like the reference's `parking_lot::Mutex<BTreeSet<Cid>>`.
+    """
+
+    def __init__(self, inner: Blockstore):
+        self._inner = inner
+        self._seen: set[CID] = set()
+        self._lock = threading.Lock()
+
+    def get(self, cid: CID) -> Optional[bytes]:
+        with self._lock:
+            self._seen.add(cid)
+        return self._inner.get(cid)
+
+    def put_keyed(self, cid: CID, data: bytes) -> None:
+        self._inner.put_keyed(cid, data)
+
+    def has(self, cid: CID) -> bool:
+        return self._inner.has(cid)
+
+    def take_seen(self) -> set[CID]:
+        """Drain and return the set of recorded CIDs."""
+        with self._lock:
+            seen, self._seen = self._seen, set()
+        return seen
+
+    def peek_seen(self) -> frozenset[CID]:
+        with self._lock:
+            return frozenset(self._seen)
+
+
+class CachedBlockstore:
+    """Memoizing wrapper; the cache can be shared across instances.
+
+    Reference `cached_blockstore.rs` shares via `Rc<RefCell<HashMap>>` and is
+    explicitly single-threaded; here a `threading.Lock` protects the dict so
+    the async prefetcher can populate it from worker threads.
+    """
+
+    def __init__(self, inner: Blockstore, shared_cache: Optional[dict[CID, bytes]] = None):
+        self._inner = inner
+        self._cache = shared_cache if shared_cache is not None else {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def with_shared_cache(cls, inner: Blockstore, cache: dict[CID, bytes]) -> "CachedBlockstore":
+        return cls(inner, shared_cache=cache)
+
+    def shared_cache(self) -> dict[CID, bytes]:
+        return self._cache
+
+    def get(self, cid: CID) -> Optional[bytes]:
+        with self._lock:
+            cached = self._cache.get(cid)
+        if cached is not None:
+            self.hits += 1
+            return cached
+        self.misses += 1
+        data = self._inner.get(cid)
+        if data is not None:
+            with self._lock:
+                self._cache[cid] = data
+        return data
+
+    def put_keyed(self, cid: CID, data: bytes) -> None:
+        with self._lock:
+            self._cache[cid] = bytes(data)
+        self._inner.put_keyed(cid, data)
+
+    def has(self, cid: CID) -> bool:
+        with self._lock:
+            if cid in self._cache:
+                return True
+        return self._inner.has(cid)
+
+    def cache_stats(self) -> tuple[int, int]:
+        """(entries, total bytes) — reference `cached_blockstore.rs:40-45`."""
+        with self._lock:
+            return len(self._cache), sum(len(v) for v in self._cache.values())

@@ -1,0 +1,53 @@
+"""Hermetic test doubles for the RPC layer.
+
+The reference has no fake client (its only integration test is a live run
+against the public calibration net, `src/main.rs`). `FakeLotusClient` serves
+the same RPC surface from an in-memory blockstore + canned JSON responses,
+making the full online generation path testable offline — one of the
+capability gaps SURVEY.md §4 calls out.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Callable, Optional
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.store.blockstore import Blockstore
+
+__all__ = ["FakeLotusClient"]
+
+
+class FakeLotusClient:
+    """Duck-types `LotusClient.request`/`chain_read_obj` against local data.
+
+    - `Filecoin.ChainReadObj` is served from the backing blockstore.
+    - Any other method is looked up in `responses` (method -> value or
+      callable(params) -> value).
+    """
+
+    def __init__(
+        self,
+        store: Blockstore,
+        responses: Optional[dict[str, Any]] = None,
+    ):
+        self._store = store
+        self.responses: dict[str, Any | Callable[[Any], Any]] = responses or {}
+        self.calls: list[tuple[str, Any]] = []
+
+    def request(self, method: str, params: Any) -> Any:
+        self.calls.append((method, params))
+        if method == "Filecoin.ChainReadObj":
+            cid = CID.from_string(params[0]["/"])
+            data = self._store.get(cid)
+            if data is None:
+                raise RuntimeError(f"FakeLotus: block not found: {cid}")
+            return base64.b64encode(data).decode("ascii")
+        if method in self.responses:
+            handler = self.responses[method]
+            return handler(params) if callable(handler) else handler
+        raise RuntimeError(f"FakeLotus: no canned response for {method}")
+
+    def chain_read_obj(self, cid: CID) -> Optional[bytes]:
+        data = self._store.get(cid)
+        return data

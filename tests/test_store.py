@@ -1,0 +1,123 @@
+"""Blockstore stack tests: memory, recording, cached, fake-RPC."""
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.store.blockstore import (
+    CachedBlockstore,
+    MemoryBlockstore,
+    RecordingBlockstore,
+    put_cbor,
+)
+from ipc_proofs_tpu.store.rpc import RpcBlockstore
+from ipc_proofs_tpu.store.testing import FakeLotusClient
+
+
+def _put(store, data: bytes) -> CID:
+    cid = CID.hash_of(data, codec=RAW)
+    store.put_keyed(cid, data)
+    return cid
+
+
+class TestMemoryBlockstore:
+    def test_put_get_has(self):
+        bs = MemoryBlockstore()
+        cid = _put(bs, b"hello")
+        assert bs.get(cid) == b"hello"
+        assert bs.has(cid)
+        assert not bs.has(CID.hash_of(b"other"))
+        assert bs.get(CID.hash_of(b"other")) is None
+
+    def test_verify_cids_rejects_mismatch(self):
+        bs = MemoryBlockstore(verify_cids=True)
+        wrong_cid = CID.hash_of(b"not this data", codec=RAW)
+        with pytest.raises(ValueError):
+            bs.put_keyed(wrong_cid, b"actual data")
+
+    def test_verify_cids_accepts_match(self):
+        bs = MemoryBlockstore(verify_cids=True)
+        cid = _put(bs, b"ok")
+        assert bs.get(cid) == b"ok"
+
+
+class TestRecordingBlockstore:
+    def test_records_gets_only(self):
+        inner = MemoryBlockstore()
+        c1 = _put(inner, b"one")
+        c2 = _put(inner, b"two")
+        rec = RecordingBlockstore(inner)
+        rec.get(c1)
+        rec.get(c1)  # duplicate
+        missing = CID.hash_of(b"missing")
+        rec.get(missing)  # even misses are recorded (matches reference)
+        seen = rec.take_seen()
+        assert seen == {c1, missing}
+        assert c2 not in seen
+        # drained
+        assert rec.take_seen() == set()
+
+    def test_passthrough(self):
+        inner = MemoryBlockstore()
+        rec = RecordingBlockstore(inner)
+        cid = _put(rec, b"through")
+        assert inner.get(cid) == b"through"
+
+
+class TestCachedBlockstore:
+    def test_hit_miss_accounting(self):
+        inner = MemoryBlockstore()
+        cid = _put(inner, b"data")
+        cached = CachedBlockstore(inner)
+        assert cached.get(cid) == b"data"
+        assert cached.get(cid) == b"data"
+        assert cached.hits == 1 and cached.misses == 1
+
+    def test_shared_cache_across_instances(self):
+        inner1 = MemoryBlockstore()
+        cid = _put(inner1, b"payload")
+        c1 = CachedBlockstore(inner1)
+        c1.get(cid)
+        # second instance over an EMPTY inner store, sharing the cache
+        c2 = CachedBlockstore.with_shared_cache(MemoryBlockstore(), c1.shared_cache())
+        assert c2.get(cid) == b"payload"
+        assert c2.hits == 1 and c2.misses == 0
+
+    def test_cache_stats(self):
+        inner = MemoryBlockstore()
+        cid = _put(inner, b"12345")
+        cached = CachedBlockstore(inner)
+        cached.get(cid)
+        entries, total = cached.cache_stats()
+        assert entries == 1 and total == 5
+
+
+class TestFakeRpc:
+    def test_chain_read_obj_roundtrip(self):
+        backing = MemoryBlockstore()
+        cid = put_cbor(backing, [1, 2, 3])
+        client = FakeLotusClient(backing)
+        bs = RpcBlockstore(client)
+        data = bs.get(cid)
+        assert data is not None
+        assert CID.hash_of(data) == cid
+
+    def test_canned_responses(self):
+        client = FakeLotusClient(MemoryBlockstore(), responses={"Filecoin.StateLookupID": "f0123"})
+        assert client.request("Filecoin.StateLookupID", ["f410f...", None]) == "f0123"
+        assert client.calls[-1][0] == "Filecoin.StateLookupID"
+
+    def test_rpc_blockstore_readonly(self):
+        bs = RpcBlockstore(FakeLotusClient(MemoryBlockstore()))
+        with pytest.raises(NotImplementedError):
+            bs.put_keyed(CID.hash_of(b"x"), b"x")
+
+
+class TestPutCbor:
+    def test_txmeta_style_recompute(self):
+        bs = MemoryBlockstore()
+        c1 = CID.hash_of(b"bls")
+        c2 = CID.hash_of(b"secp")
+        txmeta_cid = put_cbor(bs, (c1, c2))
+        raw = bs.get(txmeta_cid)
+        assert raw is not None
+        assert CID.hash_of(raw) == txmeta_cid
