@@ -1,0 +1,13 @@
+"""IPLD collection types: AMT (v0 + v3) and HAMT, readers AND writers.
+
+Replaces the reference's external `fvm_ipld_amt` / `fvm_ipld_hamt` crates
+(reference Cargo.toml:10-13). The reference only ever *reads* these
+structures from the chain; writers here exist so the whole framework can be
+tested hermetically against synthetic chain state (SURVEY.md §4), and so the
+TPU backend has flattened node arrays to batch-verify.
+"""
+
+from ipc_proofs_tpu.ipld.amt import AMT, amt_build
+from ipc_proofs_tpu.ipld.hamt import HAMT, hamt_build
+
+__all__ = ["AMT", "amt_build", "HAMT", "hamt_build"]

@@ -1,0 +1,236 @@
+"""Array Mapped Trie (AMT) over a blockstore — both on-disk versions.
+
+Two wire versions exist on the Filecoin chain (reference
+`src/proofs/events/utils.rs:76-90`, `events/generator.rs:196-259`):
+
+- **v0** (`Amtv0`): root = ``[height, count, node]``, fixed bit width 3
+  (branching 8). Used for message-CID lists and the receipts array.
+- **v3** (`Amt`): root = ``[bit_width, height, count, node]``. Used for the
+  per-receipt events array.
+
+Node = ``[bmap(bytes), links([CID]), values([any])]`` where bit ``i`` of the
+bitmap is ``bmap[i // 8] & (1 << (i % 8))`` (LSB-first within each byte).
+Internal nodes carry ``links`` in set-bit order; leaves carry ``values``.
+Slot addressing at height ``h``: ``(index >> (bit_width * h)) & (width - 1)``.
+
+Blocks are DAG-CBOR / blake2b-256, like everything on the Filecoin chain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
+from ipc_proofs_tpu.store.blockstore import Blockstore, put_cbor
+
+__all__ = ["AMT", "amt_build", "amt_build_v0", "amt_count"]
+
+_V0_BIT_WIDTH = 3
+_MAX_HEIGHT = 64
+
+
+def _width(bit_width: int) -> int:
+    return 1 << bit_width
+
+
+def _bmap_get(bmap: bytes, i: int) -> bool:
+    byte = i // 8
+    return byte < len(bmap) and bool(bmap[byte] & (1 << (i % 8)))
+
+
+def _bmap_make(bits: list[int], bit_width: int) -> bytes:
+    out = bytearray((_width(bit_width) + 7) // 8)
+    for i in bits:
+        out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+class AMT:
+    """Reader for an AMT rooted at a CID; version auto-detected from the root.
+
+    ``get`` and ordered ``for_each`` mirror `fvm_ipld_amt`'s API surface the
+    proof engines rely on. All node fetches go through the supplied
+    blockstore, so wrapping it in a `RecordingBlockstore` records the touched
+    path exactly like the reference's witness mechanism.
+    """
+
+    def __init__(
+        self,
+        store: Blockstore,
+        root_cid: CID,
+        bit_width: int,
+        height: int,
+        count: int,
+        root_node: list,
+        version: int,
+    ):
+        self._store = store
+        self.root_cid = root_cid
+        self.bit_width = bit_width
+        self.height = height
+        self.count = count
+        self._root_node = root_node
+        self.version = version
+
+    @classmethod
+    def load(
+        cls, store: Blockstore, root_cid: CID, expected_version: Optional[int] = None
+    ) -> "AMT":
+        raw = store.get(root_cid)
+        if raw is None:
+            raise KeyError(f"missing AMT root {root_cid}")
+        root = cbor_decode(raw)
+        if not isinstance(root, list):
+            raise ValueError("AMT root must be a CBOR array")
+        if len(root) == 4:
+            version = 3
+            bit_width, height, count, node = root
+        elif len(root) == 3:
+            version = 0
+            bit_width = _V0_BIT_WIDTH
+            height, count, node = root
+        else:
+            raise ValueError(f"unrecognized AMT root arity {len(root)}")
+        if expected_version is not None and version != expected_version:
+            raise ValueError(f"expected AMT v{expected_version}, found v{version}")
+        if not 1 <= bit_width <= 8:
+            raise ValueError(f"invalid AMT bit width {bit_width}")
+        if not 0 <= height <= _MAX_HEIGHT:
+            raise ValueError(f"invalid AMT height {height}")
+        return cls(store, root_cid, bit_width, height, count, node, version)
+
+    # -- node access --------------------------------------------------------
+
+    def _load_node(self, cid: CID) -> list:
+        raw = self._store.get(cid)
+        if raw is None:
+            raise KeyError(f"missing AMT node {cid}")
+        node = cbor_decode(raw)
+        if not (isinstance(node, list) and len(node) == 3):
+            raise ValueError("malformed AMT node")
+        return node
+
+    @staticmethod
+    def _node_parts(node: list) -> tuple[bytes, list, list]:
+        bmap, links, values = node
+        if not isinstance(bmap, bytes):
+            raise ValueError("AMT node bitmap must be bytes")
+        return bmap, links, values
+
+    def get(self, index: int) -> Optional[Any]:
+        """Value at ``index`` or None; walks exactly one root-to-leaf path."""
+        if index < 0:
+            raise ValueError("negative AMT index")
+        width = _width(self.bit_width)
+        if index >= width ** (self.height + 1):
+            return None
+        node = self._root_node
+        for h in range(self.height, 0, -1):
+            bmap, links, _ = self._node_parts(node)
+            slot = (index >> (self.bit_width * h)) & (width - 1)
+            if not _bmap_get(bmap, slot):
+                return None
+            link_pos = sum(1 for i in range(slot) if _bmap_get(bmap, i))
+            node = self._load_node(links[link_pos])
+        bmap, _, values = self._node_parts(node)
+        slot = index & (width - 1)
+        if not _bmap_get(bmap, slot):
+            return None
+        value_pos = sum(1 for i in range(slot) if _bmap_get(bmap, i))
+        return values[value_pos]
+
+    def for_each(self, fn: Callable[[int, Any], None]) -> None:
+        """Call ``fn(index, value)`` for every element in ascending order."""
+        for index, value in self.items():
+            fn(index, value)
+
+    def items(self) -> Iterator[tuple[int, Any]]:
+        yield from self._walk(self._root_node, self.height, 0)
+
+    def _walk(self, node: list, height: int, base: int) -> Iterator[tuple[int, Any]]:
+        width = _width(self.bit_width)
+        bmap, links, values = self._node_parts(node)
+        pos = 0
+        for slot in range(width):
+            if not _bmap_get(bmap, slot):
+                continue
+            if height == 0:
+                yield base + slot, values[pos]
+            else:
+                child = self._load_node(links[pos])
+                yield from self._walk(child, height - 1, base + slot * width**height)
+            pos += 1
+
+
+def amt_count(values: dict[int, Any]) -> int:
+    return len(values)
+
+
+def _build_node(
+    store: Blockstore,
+    entries: list[tuple[int, Any]],
+    height: int,
+    bit_width: int,
+) -> list:
+    """Recursively build one node covering ``entries`` (relative indices)."""
+    width = _width(bit_width)
+    bits: list[int] = []
+    links: list[CID] = []
+    values: list[Any] = []
+    if height == 0:
+        for index, value in sorted(entries):
+            bits.append(index)
+            values.append(value)
+    else:
+        span = width**height
+        by_slot: dict[int, list[tuple[int, Any]]] = {}
+        for index, value in entries:
+            by_slot.setdefault(index // span, []).append((index % span, value))
+        for slot in sorted(by_slot):
+            child = _build_node(store, by_slot[slot], height - 1, bit_width)
+            bits.append(slot)
+            links.append(put_cbor(store, child))
+    return [_bmap_make(bits, bit_width), links, values]
+
+
+def amt_build(
+    store: Blockstore,
+    values: "dict[int, Any] | list[Any]",
+    bit_width: int = 5,
+    version: int = 3,
+) -> CID:
+    """Build an AMT over ``values`` and return its root CID.
+
+    ``values`` may be a dense list (indices 0..n-1) or a sparse dict.
+    ``version=0`` forces the legacy 3-tuple root with bit width 3.
+    """
+    if isinstance(values, list):
+        entries = {i: v for i, v in enumerate(values)}
+    else:
+        entries = dict(values)
+    if any(i < 0 for i in entries):
+        raise ValueError("negative AMT index")
+    if version == 0:
+        bit_width = _V0_BIT_WIDTH
+    elif version != 3:
+        raise ValueError(f"unsupported AMT version {version}")
+
+    width = _width(bit_width)
+    max_index = max(entries) if entries else 0
+    height = 0
+    while max_index >= width ** (height + 1):
+        height += 1
+
+    node = _build_node(store, list(entries.items()), height, bit_width)
+    count = len(entries)
+    if version == 0:
+        root = [height, count, node]
+    else:
+        root = [bit_width, height, count, node]
+    return put_cbor(store, root)
+
+
+def amt_build_v0(store: Blockstore, values: "dict[int, Any] | list[Any]") -> CID:
+    """Legacy AMT (message-CID lists, receipts arrays)."""
+    return amt_build(store, values, version=0)

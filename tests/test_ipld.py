@@ -1,0 +1,178 @@
+"""AMT / HAMT round-trip and structure tests."""
+
+import random
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.ipld.amt import AMT, amt_build, amt_build_v0
+from ipc_proofs_tpu.ipld.hamt import HAMT, hamt_build
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore, RecordingBlockstore
+
+
+class TestAmtV3:
+    def test_dense_roundtrip(self):
+        bs = MemoryBlockstore()
+        values = [f"value-{i}" for i in range(100)]
+        root = amt_build(bs, values, bit_width=5)
+        amt = AMT.load(bs, root)
+        assert amt.version == 3
+        assert amt.count == 100
+        for i, v in enumerate(values):
+            assert amt.get(i) == v
+        assert amt.get(100) is None
+        assert amt.get(10**9) is None
+
+    def test_sparse_roundtrip(self):
+        bs = MemoryBlockstore()
+        entries = {0: "a", 7: "b", 31: "c", 32: "d", 1024: "e", 123456: "f"}
+        root = amt_build(bs, entries, bit_width=5)
+        amt = AMT.load(bs, root)
+        assert amt.count == len(entries)
+        for i, v in entries.items():
+            assert amt.get(i) == v
+        assert amt.get(5) is None
+
+    def test_for_each_is_ordered(self):
+        bs = MemoryBlockstore()
+        entries = {i: i * 10 for i in random.Random(0).sample(range(10_000), 200)}
+        root = amt_build(bs, entries)
+        amt = AMT.load(bs, root)
+        seen = []
+        amt.for_each(lambda i, v: seen.append((i, v)))
+        assert seen == sorted(entries.items())
+
+    def test_empty(self):
+        bs = MemoryBlockstore()
+        root = amt_build(bs, [])
+        amt = AMT.load(bs, root)
+        assert amt.count == 0
+        assert amt.get(0) is None
+        assert list(amt.items()) == []
+
+    def test_heights(self):
+        bs = MemoryBlockstore()
+        # bit_width 5 → width 32; 33 elements forces height 1
+        root = amt_build(bs, list(range(33)), bit_width=5)
+        assert AMT.load(bs, root).height == 1
+        root2 = amt_build(bs, {32 * 32: "deep"}, bit_width=5)
+        assert AMT.load(bs, root2).height == 2
+
+
+class TestAmtV0:
+    def test_roundtrip_and_arity(self):
+        bs = MemoryBlockstore()
+        cids = [CID.hash_of(f"msg-{i}".encode()) for i in range(20)]
+        root = amt_build_v0(bs, cids)
+        amt = AMT.load(bs, root)
+        assert amt.version == 0
+        assert amt.bit_width == 3
+        for i, c in enumerate(cids):
+            assert amt.get(i) == c
+        # root block must be a 3-tuple (no bit_width field)
+        from ipc_proofs_tpu.core.dagcbor import decode
+
+        assert len(decode(bs.get(root))) == 3
+
+    def test_version_check(self):
+        bs = MemoryBlockstore()
+        root_v0 = amt_build_v0(bs, [1, 2, 3])
+        AMT.load(bs, root_v0, expected_version=0)
+        with pytest.raises(ValueError):
+            AMT.load(bs, root_v0, expected_version=3)
+
+    def test_width8_height(self):
+        bs = MemoryBlockstore()
+        root = amt_build_v0(bs, list(range(9)))  # 9 > 8 → height 1
+        assert AMT.load(bs, root).height == 1
+
+
+class TestAmtRecording:
+    def test_get_touches_single_path(self):
+        bs = MemoryBlockstore()
+        root = amt_build(bs, list(range(1000)), bit_width=3)
+        rec = RecordingBlockstore(bs)
+        amt = AMT.load(rec, root)
+        amt.get(999)
+        path_len = len(rec.take_seen())
+        # height = 3 for 1000 entries at width 8 (8^3=512 < 1000 <= 8^4)
+        assert amt.height == 3
+        # root + 3 internal/leaf nodes on the path
+        assert path_len == 1 + amt.height
+
+    def test_for_each_touches_all_nodes(self):
+        bs = MemoryBlockstore()
+        root = amt_build(bs, list(range(100)), bit_width=3)
+        rec = RecordingBlockstore(bs)
+        AMT.load(rec, root).for_each(lambda i, v: None)
+        assert len(rec.take_seen()) == len(bs)
+
+
+class TestHamt:
+    def test_small_roundtrip(self):
+        bs = MemoryBlockstore()
+        entries = {f"key-{i}".encode(): f"val-{i}" for i in range(10)}
+        root = hamt_build(bs, entries)
+        hamt = HAMT.load(bs, root)
+        for k, v in entries.items():
+            assert hamt.get(k) == v
+        assert hamt.get(b"absent") is None
+
+    def test_large_roundtrip_forces_splits(self):
+        bs = MemoryBlockstore()
+        entries = {f"key-{i}".encode(): i for i in range(2000)}
+        root = hamt_build(bs, entries)
+        hamt = HAMT.load(bs, root)
+        for k, v in entries.items():
+            assert hamt.get(k) == v
+        assert len(bs) > 1  # must have split into child nodes
+        assert dict(hamt.items()) == entries
+
+    def test_bitwidth_variants(self):
+        for bw in (2, 3, 5, 8):
+            bs = MemoryBlockstore()
+            entries = {bytes([i, i + 1]): i for i in range(50)}
+            root = hamt_build(bs, entries, bit_width=bw)
+            hamt = HAMT.load(bs, root, bit_width=bw)
+            for k, v in entries.items():
+                assert hamt.get(k) == v
+
+    def test_wrong_bitwidth_misses(self):
+        bs = MemoryBlockstore()
+        entries = {f"k{i}".encode(): i for i in range(500)}
+        root = hamt_build(bs, entries, bit_width=5)
+        bad = HAMT.load(bs, root, bit_width=3)
+        # With the wrong bitwidth most lookups miss or err — structure is
+        # hash-dependent, so just assert it does NOT behave like bw=5.
+        misses = 0
+        for k in list(entries)[:50]:
+            try:
+                if bad.get(k) != entries[k]:
+                    misses += 1
+            except (KeyError, ValueError):
+                misses += 1
+        assert misses > 0
+
+    def test_get_touches_single_path(self):
+        bs = MemoryBlockstore()
+        entries = {f"key-{i}".encode(): i for i in range(5000)}
+        root = hamt_build(bs, entries)
+        rec = RecordingBlockstore(bs)
+        hamt = HAMT.load(rec, root)
+        hamt.get(b"key-123")
+        touched = len(rec.take_seen())
+        assert 1 <= touched <= 4  # root + at most a few levels
+        assert touched < len(bs) / 10
+
+    def test_values_can_be_structured(self):
+        bs = MemoryBlockstore()
+        c = CID.hash_of(b"linked")
+        entries = {b"actor": [c, c, 5, b"\x00\x01"]}
+        root = hamt_build(bs, entries)
+        assert HAMT.load(bs, root).get(b"actor") == [c, c, 5, b"\x00\x01"]
+
+    def test_deterministic_roots(self):
+        bs1, bs2 = MemoryBlockstore(), MemoryBlockstore()
+        entries = {f"key-{i}".encode(): i for i in range(100)}
+        shuffled = dict(sorted(entries.items(), key=lambda kv: hash(kv[0])))
+        assert hamt_build(bs1, entries) == hamt_build(bs2, shuffled)
