@@ -1,0 +1,58 @@
+"""Filecoin state schema: addresses, headers, actors, events, storage slots.
+
+Replaces the reference's `fvm_shared` types and its decode helpers
+(`src/proofs/common/decode.rs`, `src/proofs/common/evm.rs`,
+`src/proofs/storage/decode.rs`, `src/client/types.rs`). Includes *builders*
+for every type so synthetic chains can be written for hermetic tests — a
+capability the reference lacks entirely.
+"""
+
+from ipc_proofs_tpu.state.address import Address, Protocol
+from ipc_proofs_tpu.state.header import BlockHeader, extract_parent_state_root
+from ipc_proofs_tpu.state.actors import (
+    ActorState,
+    EvmStateLite,
+    StateRoot,
+    get_actor_state,
+    parse_evm_state,
+)
+from ipc_proofs_tpu.state.events import (
+    ActorEvent,
+    EventEntry,
+    EvmLog,
+    Receipt,
+    StampedEvent,
+    ascii_to_bytes32,
+    extract_evm_log,
+    hash_event_signature,
+    left_pad_32,
+)
+from ipc_proofs_tpu.state.storage import (
+    calculate_storage_slot,
+    compute_mapping_slot,
+    read_storage_slot,
+)
+
+__all__ = [
+    "Address",
+    "Protocol",
+    "BlockHeader",
+    "extract_parent_state_root",
+    "StateRoot",
+    "ActorState",
+    "EvmStateLite",
+    "get_actor_state",
+    "parse_evm_state",
+    "EventEntry",
+    "ActorEvent",
+    "StampedEvent",
+    "Receipt",
+    "EvmLog",
+    "extract_evm_log",
+    "hash_event_signature",
+    "ascii_to_bytes32",
+    "left_pad_32",
+    "read_storage_slot",
+    "compute_mapping_slot",
+    "calculate_storage_slot",
+]

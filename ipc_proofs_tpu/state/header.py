@@ -1,0 +1,104 @@
+"""Filecoin block headers: the 16-field tuple, decode + fixture builder.
+
+Reference parity: `HeaderLite` (`src/proofs/common/decode.rs:100-118`) decodes
+fields 5 (parents), 7 (height), 8 (parent_state_root),
+9 (parent_message_receipts), 10 (messages), 12 (timestamp),
+14 (fork_signaling) and ignores the rest. The builder emits a full 16-field
+tuple so fixture headers round-trip through the same decoder the proof
+engines use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
+from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+
+__all__ = ["BlockHeader", "extract_parent_state_root"]
+
+
+@dataclass
+class BlockHeader:
+    """The fields the proof system reads, plus opaque padding for the rest."""
+
+    parents: list[CID]
+    height: int
+    parent_state_root: CID
+    parent_message_receipts: CID
+    messages: CID
+    timestamp: int = 0
+    fork_signaling: int = 0
+    miner: Any = None
+    parent_weight: bytes = b""
+    # Opaque fields kept only so decode(encode(h)) is byte-stable.
+    _ticket: Any = None
+    _election_proof: Any = None
+    _beacon_entries: Any = field(default_factory=list)
+    _winpost_proof: Any = field(default_factory=list)
+    _bls_aggregate: Any = None
+    _block_sig: Any = None
+    _parent_base_fee: bytes = b""
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "BlockHeader":
+        fields = cbor_decode(raw)
+        if not (isinstance(fields, list) and len(fields) == 16):
+            raise ValueError(f"block header must be a 16-tuple, got {type(fields)}")
+        parents = fields[5]
+        if not (isinstance(parents, list) and all(isinstance(c, CID) for c in parents)):
+            raise ValueError("header parents must be a CID list")
+        for idx, name in ((8, "parent_state_root"), (9, "parent_message_receipts"), (10, "messages")):
+            if not isinstance(fields[idx], CID):
+                raise ValueError(f"header field {name} must be a CID")
+        return cls(
+            miner=fields[0],
+            _ticket=fields[1],
+            _election_proof=fields[2],
+            _beacon_entries=fields[3],
+            _winpost_proof=fields[4],
+            parents=parents,
+            parent_weight=fields[6],
+            height=fields[7],
+            parent_state_root=fields[8],
+            parent_message_receipts=fields[9],
+            messages=fields[10],
+            _bls_aggregate=fields[11],
+            timestamp=fields[12],
+            _block_sig=fields[13],
+            fork_signaling=fields[14],
+            _parent_base_fee=fields[15],
+        )
+
+    def encode(self) -> bytes:
+        return cbor_encode(
+            [
+                self.miner,
+                self._ticket,
+                self._election_proof,
+                self._beacon_entries,
+                self._winpost_proof,
+                self.parents,
+                self.parent_weight,
+                self.height,
+                self.parent_state_root,
+                self.parent_message_receipts,
+                self.messages,
+                self._bls_aggregate,
+                self.timestamp,
+                self._block_sig,
+                self.fork_signaling,
+                self._parent_base_fee,
+            ]
+        )
+
+    def cid(self) -> CID:
+        return CID.hash_of(self.encode())
+
+
+def extract_parent_state_root(raw: bytes) -> CID:
+    """Parent state root straight from raw header CBOR
+    (reference `common/decode.rs:121-124`)."""
+    return BlockHeader.decode(raw).parent_state_root

@@ -1,0 +1,260 @@
+"""State schema tests: addresses, headers, actors, events, storage slots."""
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+from ipc_proofs_tpu.ipld.hamt import hamt_build
+from ipc_proofs_tpu.state.actors import (
+    ActorState,
+    EvmStateLite,
+    StateRoot,
+    get_actor_state,
+    parse_evm_state,
+)
+from ipc_proofs_tpu.state.address import Address, Protocol
+from ipc_proofs_tpu.state.events import (
+    ActorEvent,
+    EventEntry,
+    Receipt,
+    StampedEvent,
+    ascii_to_bytes32,
+    extract_evm_log,
+    hash_event_signature,
+    left_pad_32,
+)
+from ipc_proofs_tpu.state.header import BlockHeader, extract_parent_state_root
+from ipc_proofs_tpu.state.storage import (
+    calculate_storage_slot,
+    compute_mapping_slot,
+    read_storage_slot,
+)
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore, put_cbor
+
+
+class TestAddress:
+    def test_id_roundtrip(self):
+        a = Address.new_id(1234)
+        assert a.id() == 1234
+        assert str(a) == "f01234"
+        assert Address.from_string("f01234") == a
+        assert Address.from_string("t01234") == a  # testnet normalization
+        assert Address.from_bytes(a.to_bytes()) == a
+
+    def test_id_bytes_form(self):
+        # protocol byte 0x00 + uvarint payload — the state-tree HAMT key
+        assert Address.new_id(0).to_bytes() == b"\x00\x00"
+        assert Address.new_id(128).to_bytes() == b"\x00\x80\x01"
+
+    def test_delegated_f410(self):
+        eth = "52f864e96e8c85836c2df262ae34d2dc4df5953a"
+        a = Address.from_eth_address(eth)
+        assert a.protocol == Protocol.DELEGATED
+        ns, sub = a.delegated_parts()
+        assert ns == 10
+        assert sub.hex() == eth
+        s = str(a)
+        assert s.startswith("f410f")
+        assert Address.from_string(s) == a
+
+    def test_checksum_rejected(self):
+        a = Address.from_eth_address("52f864e96e8c85836c2df262ae34d2dc4df5953a")
+        s = str(a)
+        # corrupt a mid-payload character (the final char only holds base32
+        # padding bits, which decode ignores)
+        i = len(s) - 8
+        corrupted = s[:i] + ("a" if s[i] != "a" else "b") + s[i + 1 :]
+        with pytest.raises(ValueError):
+            Address.from_string(corrupted)
+
+    def test_eth_address_validation(self):
+        with pytest.raises(ValueError):
+            Address.from_eth_address("0x1234")
+
+
+class TestHeader:
+    def _header(self):
+        return BlockHeader(
+            parents=[CID.hash_of(b"p1"), CID.hash_of(b"p2")],
+            height=100,
+            parent_state_root=CID.hash_of(b"state"),
+            parent_message_receipts=CID.hash_of(b"receipts"),
+            messages=CID.hash_of(b"txmeta"),
+            timestamp=1700000000,
+        )
+
+    def test_roundtrip(self):
+        h = self._header()
+        decoded = BlockHeader.decode(h.encode())
+        assert decoded.parents == h.parents
+        assert decoded.height == 100
+        assert decoded.parent_state_root == h.parent_state_root
+        assert decoded.parent_message_receipts == h.parent_message_receipts
+        assert decoded.messages == h.messages
+        assert decoded.encode() == h.encode()
+
+    def test_is_16_tuple(self):
+        from ipc_proofs_tpu.core.dagcbor import decode
+
+        assert len(decode(self._header().encode())) == 16
+
+    def test_extract_parent_state_root(self):
+        h = self._header()
+        assert extract_parent_state_root(h.encode()) == h.parent_state_root
+
+    def test_cid_stable(self):
+        assert self._header().cid() == self._header().cid()
+
+
+class TestActors:
+    def test_state_root_roundtrip(self):
+        sr = StateRoot(version=5, actors=CID.hash_of(b"actors"), info=CID.hash_of(b"info"))
+        decoded = StateRoot.decode(cbor_encode(sr.to_tuple()))
+        assert decoded == sr
+
+    def test_actor_state_4_and_5_tuple(self):
+        code, state = CID.hash_of(b"code"), CID.hash_of(b"head")
+        a4 = ActorState.from_tuple([code, state, 7, b"\x00\x64"])
+        assert a4.balance == 100 and a4.delegated_address is None
+        a5 = ActorState.from_tuple([code, state, 7, b"\x00\x64", b"\x04\x0a" + b"\xaa" * 20])
+        assert a5.delegated_address is not None
+
+    def test_get_actor_state_walks_hamt(self):
+        bs = MemoryBlockstore()
+        addr = Address.new_id(1001)
+        actor = ActorState(
+            code=CID.hash_of(b"evmcode"),
+            state=CID.hash_of(b"evmstate"),
+            call_seq_num=1,
+            balance=0,
+        )
+        actors_root = hamt_build(bs, {addr.to_bytes(): actor.to_tuple()})
+        state_root_cid = put_cbor(
+            bs, StateRoot(version=5, actors=actors_root, info=CID.hash_of(b"info")).to_tuple()
+        )
+        loaded = get_actor_state(bs, state_root_cid, addr)
+        assert loaded.state == actor.state
+        with pytest.raises(KeyError):
+            get_actor_state(bs, state_root_cid, Address.new_id(9999))
+
+    def test_parse_evm_state_v6_and_v5(self):
+        bytecode, storage = CID.hash_of(b"bc", codec=RAW), CID.hash_of(b"storage")
+        bh = b"\xbb" * 32
+        v6 = cbor_encode([bytecode, bh, storage, None, 9, None])
+        parsed = parse_evm_state(v6)
+        assert parsed.contract_state == storage and parsed.nonce == 9
+        v5 = cbor_encode([bytecode, bh, storage, 3, None])
+        parsed5 = parse_evm_state(v5)
+        assert parsed5.contract_state == storage and parsed5.nonce == 3
+
+    def test_parse_evm_state_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_evm_state(cbor_encode([1, 2]))
+
+
+class TestEvents:
+    def _evm_event_compact(self, topic0, topic1, data=b"\x01" * 8):
+        return ActorEvent(
+            entries=[
+                EventEntry(0, "t1", 0x55, topic0),
+                EventEntry(0, "t2", 0x55, topic1),
+                EventEntry(0, "d", 0x55, data),
+            ]
+        )
+
+    def test_extract_compact_form(self):
+        t0 = hash_event_signature("NewTopDownMessage(bytes32,uint256)")
+        t1 = ascii_to_bytes32("subnet-1")
+        log = extract_evm_log(self._evm_event_compact(t0, t1))
+        assert log is not None
+        assert log.topics == [t0, t1]
+        assert log.data == b"\x01" * 8
+
+    def test_extract_concatenated_form(self):
+        t0, t1 = b"\xaa" * 32, b"\xbb" * 32
+        ev = ActorEvent(
+            entries=[
+                EventEntry(0, "topics", 0x55, t0 + t1),
+                EventEntry(0, "data", 0x55, b"\xfe"),
+            ]
+        )
+        log = extract_evm_log(ev)
+        assert log.topics == [t0, t1] and log.data == b"\xfe"
+
+    def test_extract_rejects_bad_shapes(self):
+        # misaligned concatenated topics
+        assert extract_evm_log(ActorEvent([EventEntry(0, "topics", 0x55, b"\x01" * 33)])) is None
+        # wrong-size compact topic
+        assert extract_evm_log(ActorEvent([EventEntry(0, "t1", 0x55, b"\x01" * 31)])) is None
+        # no topic entries at all
+        assert extract_evm_log(ActorEvent([EventEntry(0, "other", 0x55, b"")])) is None
+
+    def test_stamped_event_cbor_roundtrip(self):
+        se = StampedEvent(emitter=42, event=self._evm_event_compact(b"\x00" * 32, b"\x01" * 32))
+        assert StampedEvent.from_cbor(se.to_cbor()).emitter == 42
+
+    def test_receipt_cbor_roundtrip(self):
+        r = Receipt(exit_code=0, return_data=b"ok", gas_used=555, events_root=CID.hash_of(b"ev"))
+        rt = Receipt.from_cbor(r.to_cbor())
+        assert rt == r
+        r_no_events = Receipt(exit_code=1, return_data=b"", gas_used=0, events_root=None)
+        assert Receipt.from_cbor(r_no_events.to_cbor()).events_root is None
+
+    def test_helpers(self):
+        assert ascii_to_bytes32("abc")[:3] == b"abc"
+        assert len(ascii_to_bytes32("abc")) == 32
+        assert left_pad_32(b"\x01") == b"\x00" * 31 + b"\x01"
+        assert left_pad_32(b"\xff" * 40) == b"\xff" * 32
+
+
+class TestStorage:
+    SLOT = calculate_storage_slot("calib-subnet-1", 0)
+
+    def test_mapping_slot_math(self):
+        # keccak(key32 ++ be32(index)) — check against a manual computation
+        from ipc_proofs_tpu.core.hashes import keccak256
+
+        key = ascii_to_bytes32("calib-subnet-1")
+        assert self.SLOT == keccak256(key + b"\x00" * 31 + b"\x00")
+        assert compute_mapping_slot(key, 1) == keccak256(key + b"\x00" * 31 + b"\x01")
+
+    def test_direct_hamt_encoding_c(self):
+        bs = MemoryBlockstore()
+        value = (5).to_bytes(2, "big")
+        root = hamt_build(bs, {self.SLOT: value, b"\x01" * 32: b"\xff"})
+        assert read_storage_slot(bs, root, self.SLOT) == value
+        assert read_storage_slot(bs, root, b"\x02" * 32) is None
+
+    def test_inline_small_map_a3(self):
+        bs = MemoryBlockstore()
+        root = put_cbor(bs, {"v": [[self.SLOT, b"\x2a"]]})
+        assert read_storage_slot(bs, root, self.SLOT) == b"\x2a"
+        assert read_storage_slot(bs, root, b"\x03" * 32) is None
+
+    def test_inline_tuple_a2(self):
+        bs = MemoryBlockstore()
+        root = put_cbor(bs, [b"params", {"v": [[self.SLOT, b"\x07"]]}])
+        assert read_storage_slot(bs, root, self.SLOT) == b"\x07"
+
+    def test_inline_tuple_list_a1(self):
+        bs = MemoryBlockstore()
+        root = put_cbor(bs, [b"params", [{"v": [[self.SLOT, b"\x08"]]}]])
+        assert read_storage_slot(bs, root, self.SLOT) == b"\x08"
+
+    def test_wrapper_tuple_b1(self):
+        bs = MemoryBlockstore()
+        inner = hamt_build(bs, {self.SLOT: b"\x09"}, bit_width=5)
+        root = put_cbor(bs, [inner, 5])
+        assert read_storage_slot(bs, root, self.SLOT) == b"\x09"
+
+    def test_wrapper_map_b2(self):
+        bs = MemoryBlockstore()
+        inner = hamt_build(bs, {self.SLOT: b"\x0a"}, bit_width=4)
+        root = put_cbor(bs, {"root": inner, "bitwidth": 4})
+        assert read_storage_slot(bs, root, self.SLOT) == b"\x0a"
+
+    def test_slot_key_must_be_32(self):
+        bs = MemoryBlockstore()
+        root = hamt_build(bs, {})
+        with pytest.raises(ValueError):
+            read_storage_slot(bs, root, b"\x00")
