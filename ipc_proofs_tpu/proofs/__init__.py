@@ -1,0 +1,56 @@
+"""Proof engines: storage + event generators/verifiers, unified bundle API.
+
+Reference parity map (all under /root/reference/src/proofs/):
+- witness.py        ← common/witness.rs, common/blockstore.rs
+- bundle.py         ← common/bundle.rs, storage/bundle.rs, events/bundle.rs
+- chain.py          ← client/types.rs (ApiTipset et al.), re-designed as a
+                      blockstore-first Tipset type
+- exec_order.py     ← events/utils.rs
+- storage_generator ← storage/generator.rs   storage_verifier ← storage/verifier.rs
+- event_generator   ← events/generator.rs    event_verifier   ← events/verifier.rs
+- trust.py          ← trust/mod.rs           cert.py          ← cert.rs
+- generator.py      ← generator.rs           verifier.py      ← verifier.rs
+- address.py        ← common/address.rs
+"""
+
+from ipc_proofs_tpu.proofs.bundle import (
+    EventData,
+    EventProof,
+    EventProofBundle,
+    ProofBlock,
+    StorageProof,
+    UnifiedProofBundle,
+    UnifiedVerificationResult,
+)
+from ipc_proofs_tpu.proofs.chain import Tipset
+from ipc_proofs_tpu.proofs.generator import (
+    EventProofSpec,
+    StorageProofSpec,
+    generate_proof_bundle,
+)
+from ipc_proofs_tpu.proofs.trust import MockTrustVerifier, TrustPolicy, TrustVerifier
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
+from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+from ipc_proofs_tpu.state.storage import calculate_storage_slot
+
+__all__ = [
+    "ProofBlock",
+    "StorageProof",
+    "EventData",
+    "EventProof",
+    "EventProofBundle",
+    "UnifiedProofBundle",
+    "UnifiedVerificationResult",
+    "Tipset",
+    "StorageProofSpec",
+    "EventProofSpec",
+    "generate_proof_bundle",
+    "verify_proof_bundle",
+    "TrustPolicy",
+    "TrustVerifier",
+    "MockTrustVerifier",
+    "create_event_filter",
+    "resolve_eth_address_to_actor_id",
+    "calculate_storage_slot",
+]

@@ -1,0 +1,155 @@
+"""Proof claim types and the serializable bundle wire format.
+
+Reference parity: `ProofBlock`/`UnifiedProofBundle`/`UnifiedVerificationResult`
+(`src/proofs/common/bundle.rs`), `StorageProof` (`src/proofs/storage/bundle.rs`),
+`EventData`/`EventProof`/`EventProofBundle` (`src/proofs/events/bundle.rs`).
+
+Wire format: JSON with snake_case fields, hex strings 0x-prefixed, CIDs as
+base32 strings, witness block data base64-encoded — the bundle is the durable
+artifact (the reference's only "checkpoint" format, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from ipc_proofs_tpu.core.cid import CID
+
+__all__ = [
+    "ProofBlock",
+    "StorageProof",
+    "EventData",
+    "EventProof",
+    "EventProofBundle",
+    "UnifiedProofBundle",
+    "UnifiedVerificationResult",
+]
+
+
+@dataclass(frozen=True)
+class ProofBlock:
+    """One witness block: a CID and its raw DAG-CBOR bytes."""
+
+    cid: CID
+    data: bytes
+
+    def to_json_obj(self) -> dict:
+        return {"cid": str(self.cid), "data": base64.b64encode(self.data).decode("ascii")}
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "ProofBlock":
+        return cls(cid=CID.from_string(obj["cid"]), data=base64.b64decode(obj["data"]))
+
+
+@dataclass
+class StorageProof:
+    """Claim: actor ``actor_id`` had ``value`` at storage ``slot`` in the
+    state root committed by child block ``child_block_cid`` at ``child_epoch``."""
+
+    child_epoch: int
+    child_block_cid: str
+    parent_state_root: str
+    actor_id: int
+    actor_state_cid: str
+    storage_root: str
+    slot: str  # 0x-hex 32 bytes
+    value: str  # 0x-hex 32 bytes
+
+    def to_json_obj(self) -> dict:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "StorageProof":
+        return cls(**obj)
+
+
+@dataclass
+class EventData:
+    emitter: int
+    topics: list[str]  # 0x-hex, 32 bytes each
+    data: str  # 0x-hex
+
+    def to_json_obj(self) -> dict:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "EventData":
+        return cls(**obj)
+
+
+@dataclass
+class EventProof:
+    """Claim: message ``message_cid`` at execution index ``exec_index`` in the
+    parent tipset emitted ``event_data`` at ``event_index``."""
+
+    parent_epoch: int
+    child_epoch: int
+    parent_tipset_cids: list[str]
+    child_block_cid: str
+    message_cid: str
+    exec_index: int
+    event_index: int
+    event_data: EventData
+
+    def to_json_obj(self) -> dict:
+        obj = dict(self.__dict__)
+        obj["event_data"] = self.event_data.to_json_obj()
+        return obj
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "EventProof":
+        obj = dict(obj)
+        obj["event_data"] = EventData.from_json_obj(obj["event_data"])
+        return cls(**obj)
+
+
+@dataclass
+class EventProofBundle:
+    proofs: list[EventProof]
+    blocks: list[ProofBlock]
+
+
+@dataclass
+class UnifiedProofBundle:
+    storage_proofs: list[StorageProof]
+    event_proofs: list[EventProof]
+    blocks: list[ProofBlock]  # deduplicated, CID-sorted
+
+    # --- persistence -------------------------------------------------------
+
+    def to_json_obj(self) -> dict:
+        return {
+            "storage_proofs": [p.to_json_obj() for p in self.storage_proofs],
+            "event_proofs": [p.to_json_obj() for p in self.event_proofs],
+            "blocks": [b.to_json_obj() for b in self.blocks],
+        }
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.to_json_obj(), indent=indent)
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "UnifiedProofBundle":
+        return cls(
+            storage_proofs=[StorageProof.from_json_obj(p) for p in obj["storage_proofs"]],
+            event_proofs=[EventProof.from_json_obj(p) for p in obj["event_proofs"]],
+            blocks=[ProofBlock.from_json_obj(b) for b in obj["blocks"]],
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "UnifiedProofBundle":
+        return cls.from_json_obj(json.loads(text))
+
+    def witness_bytes(self) -> int:
+        return sum(len(b.data) for b in self.blocks)
+
+
+@dataclass
+class UnifiedVerificationResult:
+    storage_results: list[bool] = field(default_factory=list)
+    event_results: list[bool] = field(default_factory=list)
+
+    def all_valid(self) -> bool:
+        return all(self.storage_results) and all(self.event_results)

@@ -1,0 +1,96 @@
+"""F3 finality certificate types (Forest-aligned JSON shapes).
+
+Reference parity: `src/cert.rs`. `is_valid_for_epoch` preserves the
+reference's placeholder semantics: the epoch must fall within the EC chain's
+[first, last] range; BLS signature / power-table verification is a TODO in
+the reference too (`cert.rs:52-64`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = [
+    "FinalityCertificate",
+    "ECTipSet",
+    "SupplementalData",
+    "PowerTableDelta",
+]
+
+
+@dataclass
+class ECTipSet:
+    key: list[str]  # tipset CIDs as strings
+    epoch: int
+    power_table: str
+    commitments: bytes = b""
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "ECTipSet":
+        key = [c["/"] if isinstance(c, dict) else c for c in obj["Key"]]
+        pt = obj["PowerTable"]
+        return cls(
+            key=key,
+            epoch=obj["Epoch"],
+            power_table=pt["/"] if isinstance(pt, dict) else pt,
+            commitments=bytes(obj.get("Commitments", b"")),
+        )
+
+
+@dataclass
+class SupplementalData:
+    commitments: bytes = b""
+    power_table: str = ""
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "SupplementalData":
+        pt = obj.get("PowerTable", "")
+        return cls(
+            commitments=bytes(obj.get("Commitments", b"")),
+            power_table=pt["/"] if isinstance(pt, dict) else pt,
+        )
+
+
+@dataclass
+class PowerTableDelta:
+    participant_id: int
+    power_delta: str
+    signing_key: str
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "PowerTableDelta":
+        return cls(
+            participant_id=obj["ParticipantID"],
+            power_delta=obj["PowerDelta"],
+            signing_key=obj["SigningKey"],
+        )
+
+
+@dataclass
+class FinalityCertificate:
+    instance: int
+    ec_chain: list[ECTipSet] = field(default_factory=list)
+    supplemental_data: SupplementalData = field(default_factory=SupplementalData)
+    signers: bytes = b""
+    signature: bytes = b""
+    power_table_delta: list[PowerTableDelta] = field(default_factory=list)
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "FinalityCertificate":
+        return cls(
+            instance=obj["GPBFTInstance"],
+            ec_chain=[ECTipSet.from_json_obj(t) for t in obj["ECChain"]],
+            supplemental_data=SupplementalData.from_json_obj(obj.get("SupplementalData", {})),
+            signers=bytes(obj.get("Signers", b"")),
+            signature=bytes(obj.get("Signature", b"")),
+            power_table_delta=[
+                PowerTableDelta.from_json_obj(d) for d in obj.get("PowerTableDelta", [])
+            ],
+        )
+
+    def is_valid_for_epoch(self, epoch: int) -> bool:
+        """Placeholder check: epoch within the EC-chain range
+        (matches reference `cert.rs:52-64`, including empty-chain → False)."""
+        if not self.ec_chain:
+            return False
+        return self.ec_chain[0].epoch <= epoch <= self.ec_chain[-1].epoch

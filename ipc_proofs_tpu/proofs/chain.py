@@ -1,0 +1,72 @@
+"""Tipset: the chain-view type the generators take as input.
+
+Re-design of the reference's `ApiTipset`/`ApiBlockHeader` JSON mirror types
+(`src/client/types.rs:42-60`): instead of carrying a partial JSON projection,
+a `Tipset` holds the block CIDs plus fully decoded `BlockHeader`s, and can be
+built either from Lotus RPC JSON (online) or straight from a blockstore
+(fixtures / offline), which the reference cannot do.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.state.header import BlockHeader
+from ipc_proofs_tpu.store.blockstore import Blockstore
+
+__all__ = ["Tipset"]
+
+
+@dataclass
+class Tipset:
+    cids: list[CID]
+    blocks: list[BlockHeader]
+    height: int
+
+    def __post_init__(self):
+        if len(self.cids) != len(self.blocks):
+            raise ValueError("tipset cids/blocks length mismatch")
+        if not self.cids:
+            raise ValueError("empty tipset")
+
+    @classmethod
+    def from_blockstore(cls, store: Blockstore, cids: list[CID]) -> "Tipset":
+        blocks = []
+        for cid in cids:
+            raw = store.get(cid)
+            if raw is None:
+                raise KeyError(f"missing header {cid}")
+            blocks.append(BlockHeader.decode(raw))
+        return cls(cids=cids, blocks=blocks, height=blocks[0].height)
+
+    @classmethod
+    def from_api_json(cls, obj: dict) -> "Tipset":
+        """Build from a `Filecoin.ChainGetTipSetByHeight` response.
+
+        Note: unlike the reference we re-derive headers from their CBOR when
+        available; here we trust the JSON fields we need (the generators
+        cross-check against raw header CBOR anyway, mirroring
+        `storage/generator.rs:72-103`).
+        """
+        cids = [CID.from_string(c["/"]) for c in obj["Cids"]]
+        blocks = []
+        for header_json in obj["Blocks"]:
+            blocks.append(
+                BlockHeader(
+                    parents=[CID.from_string(c["/"]) for c in header_json["Parents"]],
+                    height=header_json["Height"],
+                    parent_state_root=CID.from_string(header_json["ParentStateRoot"]["/"]),
+                    parent_message_receipts=CID.from_string(
+                        header_json["ParentMessageReceipts"]["/"]
+                    ),
+                    messages=CID.from_string(header_json["Messages"]["/"]),
+                    timestamp=header_json.get("Timestamp", 0),
+                )
+            )
+        return cls(cids=cids, blocks=blocks, height=obj["Height"])
+
+    @classmethod
+    def fetch(cls, client, height: int) -> "Tipset":
+        """Fetch by height over RPC (`Filecoin.ChainGetTipSetByHeight`)."""
+        return cls.from_api_json(client.request("Filecoin.ChainGetTipSetByHeight", [height, None]))

@@ -1,0 +1,147 @@
+"""Event proof verification: fully offline 4-step replay per proof.
+
+Reference parity: `verify_event_proof` (`src/proofs/events/verifier.rs`):
+per proof — trust anchors; header consistency (child.parents == claimed
+tipset, heights match); execution order (reconstructed from witness with
+TxMeta CID recompute, claimed message at exec_index); receipt + event replay
+(receipts AMT → events AMT → emitter/topics/data compare, optional semantic
+predicate). Returns a vector of booleans, one per proof.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.ipld.amt import AMT
+from ipc_proofs_tpu.proofs.bundle import EventData, EventProof, EventProofBundle
+from ipc_proofs_tpu.proofs.exec_order import reconstruct_execution_order
+from ipc_proofs_tpu.proofs.witness import load_witness_store
+from ipc_proofs_tpu.state.events import (
+    ActorEvent,
+    Receipt,
+    StampedEvent,
+    ascii_to_bytes32,
+    extract_evm_log,
+    hash_event_signature,
+)
+from ipc_proofs_tpu.state.header import BlockHeader
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+__all__ = ["verify_event_proof", "create_event_filter"]
+
+
+def create_event_filter(event_sig: str, subnet_id: str) -> Callable[[ActorEvent], bool]:
+    """Semantic predicate factory (reference `events/verifier.rs:28-39`)."""
+    topic0 = hash_event_signature(event_sig)
+    topic1 = ascii_to_bytes32(subnet_id)
+
+    def predicate(event: ActorEvent) -> bool:
+        log = extract_evm_log(event)
+        return (
+            log is not None
+            and len(log.topics) >= 2
+            and log.topics[0] == topic0
+            and log.topics[1] == topic1
+        )
+
+    return predicate
+
+
+def verify_event_proof(
+    bundle: EventProofBundle,
+    is_trusted_parent_ts: Callable[[int, list[CID]], bool],
+    is_trusted_child_header: Callable[[int, CID], bool],
+    check_event: Optional[Callable[[ActorEvent], bool]] = None,
+    verify_witness_cids: bool = False,
+) -> list[bool]:
+    store = load_witness_store(bundle.blocks, verify_cids=verify_witness_cids)
+    return [
+        _verify_single_proof(store, proof, is_trusted_parent_ts, is_trusted_child_header, check_event)
+        for proof in bundle.proofs
+    ]
+
+
+def _verify_single_proof(
+    store: MemoryBlockstore,
+    proof: EventProof,
+    is_trusted_parent_ts: Callable[[int, list[CID]], bool],
+    is_trusted_child_header: Callable[[int, CID], bool],
+    check_event: Optional[Callable[[ActorEvent], bool]],
+) -> bool:
+    child_cid = CID.from_string(proof.child_block_cid)
+    parent_cids = [CID.from_string(c) for c in proof.parent_tipset_cids]
+
+    # Step 1: trust anchors.
+    if not is_trusted_parent_ts(proof.parent_epoch, parent_cids):
+        return False
+    if not is_trusted_child_header(proof.child_epoch, child_cid):
+        return False
+
+    # Step 2: header consistency.
+    child_raw = store.get(child_cid)
+    if child_raw is None:
+        raise KeyError("missing child header in witness")
+    child_header = BlockHeader.decode(child_raw)
+    if child_header.parents != parent_cids:
+        return False
+    if child_header.height != proof.child_epoch:
+        return False
+    parent_raw = store.get(parent_cids[0])
+    if parent_raw is None:
+        raise KeyError("missing parent header in witness")
+    if BlockHeader.decode(parent_raw).height != proof.parent_epoch:
+        return False
+
+    # Step 3: execution order (with TxMeta CID recompute).
+    try:
+        exec_order = reconstruct_execution_order(store, parent_cids)
+    except (KeyError, ValueError):
+        return False
+    msg_cid = CID.from_string(proof.message_cid)
+    try:
+        position = exec_order.index(msg_cid)
+    except ValueError:
+        return False
+    if position != proof.exec_index:
+        return False
+
+    # Step 4: receipt + event replay.
+    try:
+        receipts_amt = AMT.load(store, child_header.parent_message_receipts, expected_version=0)
+        receipt_cbor = receipts_amt.get(proof.exec_index)
+        if receipt_cbor is None:
+            return False
+        receipt = Receipt.from_cbor(receipt_cbor)
+        if receipt.events_root is None:
+            return False
+        events_amt = AMT.load(store, receipt.events_root, expected_version=3)
+        stamped_cbor = events_amt.get(proof.event_index)
+    except (KeyError, ValueError):
+        return False
+    if stamped_cbor is None:
+        return False
+    stamped = StampedEvent.from_cbor(stamped_cbor)
+
+    if not _event_data_matches(stamped, proof.event_data):
+        return False
+
+    if check_event is not None and not check_event(stamped.event):
+        return False
+    return True
+
+
+def _event_data_matches(stamped: StampedEvent, stored: EventData) -> bool:
+    """Compare the replayed event against the stored claim
+    (reference `events/verifier.rs:257-290`; hex case-insensitive)."""
+    if stamped.emitter != stored.emitter:
+        return False
+    log = extract_evm_log(stamped.event)
+    if log is None:
+        return False
+    if len(log.topics) != len(stored.topics):
+        return False
+    for actual, claimed in zip(log.topics, stored.topics):
+        if ("0x" + actual.hex()).lower() != claimed.lower():
+            return False
+    return ("0x" + log.data.hex()).lower() == stored.data.lower()

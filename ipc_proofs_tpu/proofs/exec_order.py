@@ -1,0 +1,80 @@
+"""Canonical message execution order for a tipset.
+
+Reference parity: `src/proofs/events/utils.rs`. Semantics preserved exactly:
+per block (in tipset order), BLS messages before secp messages, walking both
+AMT v0 message lists in index order; cross-block dedup keeps the FIRST
+occurrence. Offline reconstruction recomputes each TxMeta CID
+(DAG-CBOR + blake2b-256) and fails on mismatch — the trustless check at
+`events/utils.rs:63-73`.
+"""
+
+from __future__ import annotations
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
+from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+from ipc_proofs_tpu.ipld.amt import AMT
+from ipc_proofs_tpu.state.header import BlockHeader
+from ipc_proofs_tpu.store.blockstore import Blockstore
+
+__all__ = ["build_execution_order", "reconstruct_execution_order", "decode_txmeta"]
+
+
+def decode_txmeta(raw: bytes) -> tuple[CID, CID]:
+    """TxMeta is the DAG-CBOR 2-tuple ``(bls_root, secp_root)``."""
+    obj = cbor_decode(raw)
+    if not (
+        isinstance(obj, list)
+        and len(obj) == 2
+        and isinstance(obj[0], CID)
+        and isinstance(obj[1], CID)
+    ):
+        raise ValueError("malformed TxMeta (expected 2-tuple of CIDs)")
+    return obj[0], obj[1]
+
+
+def _collect_exec_list(
+    store: Blockstore, txmeta_cids: list[CID], verify_txmeta: bool
+) -> list[CID]:
+    out: list[CID] = []
+    seen: set[CID] = set()
+
+    for tx_cid in txmeta_cids:
+        raw = store.get(tx_cid)
+        if raw is None:
+            raise KeyError(f"missing TxMeta {tx_cid}")
+        bls_root, secp_root = decode_txmeta(raw)
+
+        if verify_txmeta:
+            recomputed = CID.hash_of(cbor_encode([bls_root, secp_root]))
+            if recomputed != tx_cid:
+                raise ValueError(f"TxMeta mismatch: header {tx_cid} vs recomputed {recomputed}")
+
+        for root in (bls_root, secp_root):
+            amt = AMT.load(store, root, expected_version=0)
+            for _, msg_cid in amt.items():
+                if not isinstance(msg_cid, CID):
+                    raise ValueError("message list AMT must hold CIDs")
+                if msg_cid not in seen:
+                    seen.add(msg_cid)
+                    out.append(msg_cid)
+    return out
+
+
+def build_execution_order(store: Blockstore, parent: "object") -> list[CID]:
+    """Online variant: TxMeta CIDs straight from the tipset's headers
+    (reference `events/utils.rs:33-45`)."""
+    txmeta_cids = [header.messages for header in parent.blocks]
+    return _collect_exec_list(store, txmeta_cids, verify_txmeta=False)
+
+
+def reconstruct_execution_order(store: Blockstore, parent_header_cids: list[CID]) -> list[CID]:
+    """Offline variant: decode parent headers from the witness, then verify
+    each TxMeta CID by recomputation (reference `events/utils.rs:16-30`)."""
+    txmeta_cids = []
+    for cid in parent_header_cids:
+        raw = store.get(cid)
+        if raw is None:
+            raise KeyError(f"missing parent header {cid}")
+        txmeta_cids.append(BlockHeader.decode(raw).messages)
+    return _collect_exec_list(store, txmeta_cids, verify_txmeta=True)

@@ -1,0 +1,75 @@
+"""Storage proof verification: fully offline 6-step replay.
+
+Reference parity: `verify_storage_proof` (`src/proofs/storage/verifier.rs`):
+load witness → trust anchor → parent-state-root check → actor-state check →
+storage-root check → re-read slot and compare (hex, case-insensitive).
+Returns False on any mismatch; raises only on malformed inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.proofs.bundle import ProofBlock, StorageProof
+from ipc_proofs_tpu.proofs.witness import load_witness_store
+from ipc_proofs_tpu.state.actors import get_actor_state, parse_evm_state
+from ipc_proofs_tpu.state.address import Address
+from ipc_proofs_tpu.state.events import left_pad_32
+from ipc_proofs_tpu.state.header import extract_parent_state_root
+from ipc_proofs_tpu.state.storage import read_storage_slot
+
+__all__ = ["verify_storage_proof"]
+
+
+def verify_storage_proof(
+    proof: StorageProof,
+    blocks: Iterable[ProofBlock],
+    is_trusted_child_header: Callable[[int, CID], bool],
+    verify_witness_cids: bool = False,
+) -> bool:
+    # Step 1: isolated witness store.
+    store = load_witness_store(blocks, verify_cids=verify_witness_cids)
+
+    # Step 2: trust anchor on (child_epoch, child CID).
+    child_cid = CID.from_string(proof.child_block_cid)
+    if not is_trusted_child_header(proof.child_epoch, child_cid):
+        return False
+
+    # Step 3: parent state root matches the child header in the witness.
+    child_header_raw = store.get(child_cid)
+    if child_header_raw is None:
+        raise KeyError(f"missing child header {child_cid} in witness")
+    if str(extract_parent_state_root(child_header_raw)) != proof.parent_state_root:
+        return False
+
+    # Step 4: actor state CID matches the state-tree lookup.
+    parent_state_root = CID.from_string(proof.parent_state_root)
+    try:
+        actor = get_actor_state(store, parent_state_root, Address.new_id(proof.actor_id))
+    except KeyError:
+        return False
+    if str(actor.state) != proof.actor_state_cid:
+        return False
+
+    # Step 5: storage root matches the EVM state.
+    actor_state_cid = CID.from_string(proof.actor_state_cid)
+    evm_state_raw = store.get(actor_state_cid)
+    if evm_state_raw is None:
+        raise KeyError(f"missing EVM state {actor_state_cid} in witness")
+    evm_state = parse_evm_state(evm_state_raw)
+    if str(evm_state.contract_state) != proof.storage_root:
+        return False
+
+    # Step 6: re-read the slot from the witness and compare values.
+    storage_root = CID.from_string(proof.storage_root)
+    slot_hex = proof.slot.removeprefix("0x")
+    if len(slot_hex) != 64:
+        raise ValueError("slot must be 32 bytes of hex")
+    slot = bytes.fromhex(slot_hex)
+    try:
+        raw_value = read_storage_slot(store, storage_root, slot) or b""
+    except KeyError:
+        return False
+    actual = "0x" + left_pad_32(raw_value).hex()
+    return actual.lower() == proof.value.lower()

@@ -1,0 +1,88 @@
+"""Trust / finality policies gating proof verification.
+
+Reference parity: `TrustPolicy::{AcceptAll, F3Certificate}` and the
+`TrustVerifier` trait (`src/proofs/trust/mod.rs`). The F3 branch preserves
+the reference's *stub* semantics (epoch-range check only; signature
+verification is an acknowledged TODO in the reference at
+`trust/mod.rs:58,72`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.proofs.cert import FinalityCertificate
+
+__all__ = ["TrustPolicy", "TrustVerifier", "MockTrustVerifier", "CustomVerifier"]
+
+
+class TrustVerifier(Protocol):
+    """Custom trust verification logic (reference `trust/mod.rs:31-37`)."""
+
+    def verify_parent_tipset(self, epoch: int, cids: list[CID]) -> bool: ...
+
+    def verify_child_header(self, epoch: int, cid: CID) -> bool: ...
+
+
+@dataclass
+class MockTrustVerifier:
+    """Canned-answer fixture (reference `trust/mod.rs:82-95`)."""
+
+    parent_result: bool = True
+    child_result: bool = True
+
+    def verify_parent_tipset(self, epoch: int, cids: list[CID]) -> bool:
+        return self.parent_result
+
+    def verify_child_header(self, epoch: int, cid: CID) -> bool:
+        return self.child_result
+
+
+@dataclass
+class CustomVerifier:
+    verifier: TrustVerifier
+
+
+class TrustPolicy:
+    """AcceptAll (testing only) | F3 certificate | custom verifier."""
+
+    def __init__(
+        self,
+        accept_all: bool = False,
+        certificate: Optional[FinalityCertificate] = None,
+        custom: Optional[TrustVerifier] = None,
+    ):
+        if sum(x is not None and x is not False for x in (accept_all, certificate, custom)) != 1:
+            raise ValueError("exactly one of accept_all/certificate/custom required")
+        self._accept_all = accept_all
+        self._certificate = certificate
+        self._custom = custom
+
+    @classmethod
+    def accept_all(cls) -> "TrustPolicy":
+        """WARNING: development/testing only."""
+        return cls(accept_all=True)
+
+    @classmethod
+    def with_f3_certificate(cls, cert: FinalityCertificate) -> "TrustPolicy":
+        return cls(certificate=cert)
+
+    @classmethod
+    def with_custom_verifier(cls, verifier: TrustVerifier) -> "TrustPolicy":
+        return cls(custom=verifier)
+
+    def verify_parent_tipset(self, epoch: int, cids: list[CID]) -> bool:
+        if self._accept_all:
+            return True
+        if self._certificate is not None:
+            return self._certificate.is_valid_for_epoch(epoch)
+        return self._custom.verify_parent_tipset(epoch, cids)
+
+    def verify_child_header(self, epoch: int, cid: CID) -> bool:
+        if self._accept_all:
+            return True
+        if self._certificate is not None:
+            return self._certificate.is_valid_for_epoch(epoch)
+        return self._custom.verify_child_header(epoch, cid)

@@ -1,0 +1,61 @@
+"""Unified proof verification under a trust policy.
+
+Reference parity: `verify_proof_bundle` (`src/proofs/verifier.rs`): adapts
+the `TrustPolicy` into closures, verifies all storage proofs, then all event
+proofs against the shared witness.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ipc_proofs_tpu.proofs.bundle import (
+    EventProofBundle,
+    UnifiedProofBundle,
+    UnifiedVerificationResult,
+)
+from ipc_proofs_tpu.proofs.event_verifier import verify_event_proof
+from ipc_proofs_tpu.proofs.storage_verifier import verify_storage_proof
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.state.events import ActorEvent
+
+__all__ = ["verify_proof_bundle"]
+
+
+def verify_proof_bundle(
+    bundle: UnifiedProofBundle,
+    trust_policy: TrustPolicy,
+    event_filter: Optional[Callable[[ActorEvent], bool]] = None,
+    verify_witness_cids: bool = False,
+) -> UnifiedVerificationResult:
+    def child_verifier(epoch, cid):
+        try:
+            return trust_policy.verify_child_header(epoch, cid)
+        except Exception:
+            return False
+
+    def parent_verifier(epoch, cids):
+        try:
+            return trust_policy.verify_parent_tipset(epoch, cids)
+        except Exception:
+            return False
+
+    storage_results = [
+        verify_storage_proof(
+            proof, bundle.blocks, child_verifier, verify_witness_cids=verify_witness_cids
+        )
+        for proof in bundle.storage_proofs
+    ]
+
+    event_bundle = EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks)
+    event_results = verify_event_proof(
+        event_bundle,
+        parent_verifier,
+        child_verifier,
+        check_event=event_filter,
+        verify_witness_cids=verify_witness_cids,
+    )
+
+    return UnifiedVerificationResult(
+        storage_results=storage_results, event_results=event_results
+    )

@@ -1,0 +1,322 @@
+"""End-to-end proof round-trip tests over synthetic chains, plus tamper tests.
+
+This is the correctness anchor: generate → serialize → verify offline, then
+every tamper case must fail verification (SURVEY.md §4's capability gap).
+"""
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
+from ipc_proofs_tpu.proofs.generator import (
+    EventProofSpec,
+    StorageProofSpec,
+    generate_proof_bundle,
+)
+from ipc_proofs_tpu.proofs.trust import MockTrustVerifier, TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.state.storage import calculate_storage_slot
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+SLOT = calculate_storage_slot(SUBNET, 0)
+
+
+def make_world(**kwargs):
+    contracts = [ContractFixture(actor_id=ACTOR, storage={SLOT: (42).to_bytes(2, "big")})]
+    events = [
+        [],  # msg 0: no events
+        [EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET, data=b"\x01" * 32)],
+        [EventFixture(emitter=999, signature=SIG, topic1=SUBNET)],  # wrong emitter
+        [EventFixture(emitter=ACTOR, signature="Other(uint256)", topic1=SUBNET)],
+        [
+            EventFixture(emitter=ACTOR, signature=SIG, topic1="other-subnet"),
+            EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET, data=b"\x02" * 32),
+        ],
+    ]
+    return build_chain(contracts, events, **kwargs)
+
+
+def generate(world, match_backend=None):
+    return generate_proof_bundle(
+        world.store,
+        world.parent,
+        world.child,
+        [StorageProofSpec(actor_id=ACTOR, slot=SLOT)],
+        [EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)],
+        match_backend=match_backend,
+    )
+
+
+class TestRoundTrip:
+    def test_generate_and_verify(self):
+        world = make_world()
+        bundle = generate(world)
+        assert len(bundle.storage_proofs) == 1
+        # two matching events: msg 1, and the second event of msg 4
+        assert len(bundle.event_proofs) == 2
+        assert bundle.storage_proofs[0].value == "0x" + (42).to_bytes(32, "big").hex()
+        assert {p.exec_index for p in bundle.event_proofs} == {1, 4}
+        assert bundle.event_proofs[1].event_index == 1  # second event in msg 4's AMT
+
+        result = verify_proof_bundle(
+            bundle,
+            TrustPolicy.accept_all(),
+            event_filter=create_event_filter(SIG, SUBNET),
+        )
+        assert result.storage_results == [True]
+        assert result.event_results == [True, True]
+        assert result.all_valid()
+
+    def test_verify_with_cid_recompute(self):
+        world = make_world()
+        bundle = generate(world)
+        result = verify_proof_bundle(
+            bundle, TrustPolicy.accept_all(), verify_witness_cids=True
+        )
+        assert result.all_valid()
+
+    def test_json_wire_roundtrip(self):
+        world = make_world()
+        bundle = generate(world)
+        restored = UnifiedProofBundle.from_json(bundle.to_json())
+        assert restored.to_json() == bundle.to_json()
+        result = verify_proof_bundle(restored, TrustPolicy.accept_all())
+        assert result.all_valid()
+
+    def test_multi_block_parent(self):
+        world = make_world(n_parent_blocks=3)
+        bundle = generate(world)
+        assert len(bundle.event_proofs) == 2
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert result.all_valid()
+
+    def test_zero_slot_for_absent_key(self):
+        world = make_world()
+        absent = calculate_storage_slot("no-such-subnet", 7)
+        bundle = generate_proof_bundle(
+            world.store,
+            world.parent,
+            world.child,
+            [StorageProofSpec(actor_id=ACTOR, slot=absent)],
+            [],
+        )
+        assert bundle.storage_proofs[0].value == "0x" + "00" * 32
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).all_valid()
+
+    def test_storage_encodings(self):
+        for encoding in ("direct", "wrapper_tuple", "wrapper_map", "inline"):
+            contracts = [
+                ContractFixture(
+                    actor_id=ACTOR,
+                    storage={SLOT: b"\x07"},
+                    storage_encoding=encoding,
+                )
+            ]
+            world = build_chain(contracts, [[]])
+            bundle = generate_proof_bundle(
+                world.store,
+                world.parent,
+                world.child,
+                [StorageProofSpec(actor_id=ACTOR, slot=SLOT)],
+                [],
+            )
+            assert bundle.storage_proofs[0].value.endswith("07"), encoding
+            assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).all_valid(), encoding
+
+    def test_concat_event_encoding(self):
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET, encoding="concat")]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events)
+        bundle = generate_proof_bundle(
+            world.store,
+            world.parent,
+            world.child,
+            [],
+            [EventProofSpec(event_signature=SIG, topic_1=SUBNET)],
+        )
+        assert len(bundle.event_proofs) == 1
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).all_valid()
+
+    def test_failed_message_has_no_events(self):
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET)]]
+        world = build_chain(
+            [ContractFixture(actor_id=ACTOR)], events, failed_message_indices={0}
+        )
+        bundle = generate_proof_bundle(
+            world.store,
+            world.parent,
+            world.child,
+            [],
+            [EventProofSpec(event_signature=SIG, topic_1=SUBNET)],
+        )
+        assert bundle.event_proofs == []
+
+    def test_witness_is_deduplicated_and_sorted(self):
+        world = make_world()
+        bundle = generate(world)
+        cids = [b.cid for b in bundle.blocks]
+        assert cids == sorted(cids)
+        assert len(cids) == len(set(cids))
+
+    def test_witness_smaller_than_world(self):
+        # Two-pass filtering: witness must exclude untouched event AMTs
+        world = make_world()
+        bundle = generate(world)
+        total_world = sum(len(d) for _, d in world.store.items())
+        assert bundle.witness_bytes() < total_world
+
+
+class TestTrustPolicies:
+    def test_mock_verifier_gates(self):
+        world = make_world()
+        bundle = generate(world)
+        ok = verify_proof_bundle(
+            bundle, TrustPolicy.with_custom_verifier(MockTrustVerifier(True, True))
+        )
+        assert ok.all_valid()
+        bad_child = verify_proof_bundle(
+            bundle, TrustPolicy.with_custom_verifier(MockTrustVerifier(True, False))
+        )
+        assert not any(bad_child.storage_results) and not any(bad_child.event_results)
+        bad_parent = verify_proof_bundle(
+            bundle, TrustPolicy.with_custom_verifier(MockTrustVerifier(False, True))
+        )
+        assert all(bad_parent.storage_results)  # storage only anchors the child
+        assert not any(bad_parent.event_results)
+
+    def test_f3_certificate_epoch_range(self):
+        from ipc_proofs_tpu.proofs.cert import ECTipSet, FinalityCertificate
+
+        world = make_world()
+        bundle = generate(world)
+        covering = FinalityCertificate(
+            instance=1,
+            ec_chain=[
+                ECTipSet(key=[], epoch=world.parent.height, power_table=""),
+                ECTipSet(key=[], epoch=world.child.height, power_table=""),
+            ],
+        )
+        assert verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(covering)).all_valid()
+        not_covering = FinalityCertificate(
+            instance=1, ec_chain=[ECTipSet(key=[], epoch=5, power_table="")]
+        )
+        result = verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(not_covering))
+        assert not result.all_valid()
+        empty = FinalityCertificate(instance=1, ec_chain=[])
+        assert not verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(empty)).all_valid()
+
+    def test_event_filter_rejects_other_events(self):
+        world = make_world()
+        bundle = generate(world)
+        wrong_filter = create_event_filter(SIG, "totally-other-subnet")
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all(), event_filter=wrong_filter)
+        assert result.event_results == [False, False]
+
+
+class TestTamper:
+    def _bundle(self):
+        world = make_world()
+        return generate(world)
+
+    def test_flipped_storage_value(self):
+        bundle = self._bundle()
+        bundle.storage_proofs[0].value = "0x" + "99" * 32
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).storage_results == [False]
+
+    def test_wrong_actor_state_cid(self):
+        bundle = self._bundle()
+        bundle.storage_proofs[0].actor_state_cid = str(CID.hash_of(b"forged"))
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).storage_results == [False]
+
+    def test_wrong_exec_index(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].exec_index += 1
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert result.event_results[0] is False
+
+    def test_wrong_message_cid(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].message_cid = str(CID.hash_of(b"not-a-real-msg", codec=RAW))
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results[0] is False
+
+    def test_tampered_event_data(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].event_data.data = "0x" + "ff" * 32
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results[0] is False
+
+    def test_tampered_topics(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].event_data.topics[1] = "0x" + "aa" * 32
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results[0] is False
+
+    def test_wrong_emitter(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].event_data.emitter = 4242
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results[0] is False
+
+    def test_truncated_witness_fails_closed(self):
+        bundle = self._bundle()
+        # Drop the largest witness block (some structural node)
+        biggest = max(range(len(bundle.blocks)), key=lambda i: len(bundle.blocks[i].data))
+        del bundle.blocks[biggest]
+        try:
+            result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+            assert not result.all_valid()
+        except KeyError:
+            pass  # missing-witness error is also acceptable fail-closed behavior
+
+    def test_swapped_witness_bytes_detected_with_cid_verify(self):
+        bundle = self._bundle()
+        from ipc_proofs_tpu.proofs.bundle import ProofBlock
+
+        victim = 0
+        tampered = ProofBlock(cid=bundle.blocks[victim].cid, data=b"\x82\x00\x01")
+        bundle.blocks[victim] = tampered
+        with pytest.raises(ValueError):
+            verify_proof_bundle(bundle, TrustPolicy.accept_all(), verify_witness_cids=True)
+
+    def test_wrong_child_epoch(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].child_epoch += 5
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results[0] is False
+
+    def test_wrong_parent_tipset_cids(self):
+        bundle = self._bundle()
+        bundle.event_proofs[0].parent_tipset_cids = [str(CID.hash_of(b"fake-parent"))]
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert result.event_results[0] is False
+
+
+class TestEthResolution:
+    def test_resolve_via_fake_rpc(self):
+        from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+        from ipc_proofs_tpu.state.address import Address
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+        from ipc_proofs_tpu.store.testing import FakeLotusClient
+
+        eth = "0x52f864e96e8c85836c2df262ae34d2dc4df5953a"
+        f410 = str(Address.from_eth_address(eth))
+        client = FakeLotusClient(
+            MemoryBlockstore(),
+            responses={
+                "Filecoin.EthAddressToFilecoinAddress": f410,
+                "Filecoin.StateLookupID": "f01001",
+            },
+        )
+        assert resolve_eth_address_to_actor_id(client, eth) == 1001
+
+    def test_resolve_id_address_directly(self):
+        from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+        from ipc_proofs_tpu.store.testing import FakeLotusClient
+
+        client = FakeLotusClient(
+            MemoryBlockstore(),
+            responses={"Filecoin.EthAddressToFilecoinAddress": "t0777"},
+        )
+        assert (
+            resolve_eth_address_to_actor_id(client, "0x" + "ab" * 20) == 777
+        )
