@@ -1,0 +1,9 @@
+"""JAX/Pallas kernels for the batch inner loops.
+
+TPUs have no native u64 integer lanes, so keccak-f[1600] and blake2b-256 are
+implemented over u32 pairs (`u64.py`) with all rotation amounts static —
+the whole permutation unrolls at trace time into [N]-wide elementwise vector
+ops, i.e. the classic bitslice-over-batch layout. `vmap` adds the batch
+dimension; multi-block messages absorb via `lax.scan` with per-message
+block-count masking (`pack.py` does the host-side padding).
+"""

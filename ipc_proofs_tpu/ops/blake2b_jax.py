@@ -1,0 +1,178 @@
+"""blake2b-256 as a batch JAX kernel (u32-pair lanes, array form).
+
+Filecoin's chain CID hash. The TPU witness verifier recomputes the CID of
+every witness block with this kernel (BASELINE.json config 4: 1M-block CID
+recompute) — the integrity check the reference leaves implicit.
+
+Layout: the 16-word working vector lives in uint32 [N, 16] pairs; the 12
+rounds run under `lax.fori_loop` with the sigma schedule as a constant
+gather, and each round does the 4 column G-mixes and 4 diagonal G-mixes as
+[N, 4]-vectorized ops — compact graph, fully batched.
+
+Golden model: `hashlib.blake2b(digest_size=32)` via
+:func:`ipc_proofs_tpu.core.hashes.blake2b_256` (tested equal).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["blake2b256_blocks", "BLOCK_BYTES"]
+
+BLOCK_BYTES = 128
+WORDS_PER_BLOCK_U32 = 32  # 16 u64 message words
+
+_IV = (
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+)
+
+# digest_length=32, key=0, fanout=1, depth=1
+_PARAM_WORD0 = 0x01010020
+
+_SIGMA = np.array(
+    [
+        [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+        [14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3],
+        [11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4],
+        [7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8],
+        [9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13],
+        [2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9],
+        [12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11],
+        [13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10],
+        [6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5],
+        [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
+    ],
+    dtype=np.int32,
+)
+
+_IV_LO = np.array([x & 0xFFFFFFFF for x in _IV], dtype=np.uint32)
+_IV_HI = np.array([x >> 32 for x in _IV], dtype=np.uint32)
+
+
+def _add64(alo, ahi, blo, bhi):
+    sum_lo = alo + blo
+    carry = (sum_lo < alo).astype(jnp.uint32)
+    return sum_lo, ahi + bhi + carry
+
+
+def _rotr64(lo, hi, n: int):
+    """Rotate right by static n — specialized for blake2b's 32/24/16/63."""
+    if n == 32:
+        return hi, lo
+    if n == 63:  # rotr 63 == rotl 1
+        return (lo << 1) | (hi >> 31), (hi << 1) | (lo >> 31)
+    # 0 < n < 32
+    return (lo >> n) | (hi << (32 - n)), (hi >> n) | (lo << (32 - n))
+
+
+def _g(a, b, c, d, mx, my):
+    """Vectorized G over [N, 4] u64 pairs."""
+    a = _add64(*_add64(*a, *b), *mx)
+    d = _rotr64(d[0] ^ a[0], d[1] ^ a[1], 32)
+    c = _add64(*c, *d)
+    b = _rotr64(b[0] ^ c[0], b[1] ^ c[1], 24)
+    a = _add64(*_add64(*a, *b), *my)
+    d = _rotr64(d[0] ^ a[0], d[1] ^ a[1], 16)
+    c = _add64(*c, *d)
+    b = _rotr64(b[0] ^ c[0], b[1] ^ c[1], 63)
+    return a, b, c, d
+
+
+def _compress(h_lo, h_hi, block, t_lo, f_word):
+    """One compression for the whole batch.
+
+    h: [N, 8] pairs; block: [N, 32] u32; t_lo: [N] byte counters
+    (messages < 4 GiB, so the u64 counter's high word is 0);
+    f_word: [N] all-ones where final block.
+    """
+    m_lo = block[:, 0::2]  # [N, 16]
+    m_hi = block[:, 1::2]
+    batch = h_lo.shape[0]
+    v_lo = jnp.concatenate([h_lo, jnp.broadcast_to(jnp.asarray(_IV_LO), (batch, 8))], axis=1)
+    v_hi = jnp.concatenate([h_hi, jnp.broadcast_to(jnp.asarray(_IV_HI), (batch, 8))], axis=1)
+    v_lo = v_lo.at[:, 12].set(v_lo[:, 12] ^ t_lo)
+    v_lo = v_lo.at[:, 14].set(v_lo[:, 14] ^ f_word)
+    v_hi = v_hi.at[:, 14].set(v_hi[:, 14] ^ f_word)
+
+    sigma = jnp.asarray(_SIGMA)
+
+    def round_fn(r, v):
+        v_lo, v_hi = v
+        s = sigma[r % 10]
+        mx_lo = jnp.take(m_lo, s[0::2], axis=1)  # [N, 8]
+        mx_hi = jnp.take(m_hi, s[0::2], axis=1)
+        my_lo = jnp.take(m_lo, s[1::2], axis=1)
+        my_hi = jnp.take(m_hi, s[1::2], axis=1)
+
+        # columns: (0,4,8,12) (1,5,9,13) (2,6,10,14) (3,7,11,15)
+        a = (v_lo[:, 0:4], v_hi[:, 0:4])
+        b = (v_lo[:, 4:8], v_hi[:, 4:8])
+        c = (v_lo[:, 8:12], v_hi[:, 8:12])
+        d = (v_lo[:, 12:16], v_hi[:, 12:16])
+        a, b, c, d = _g(a, b, c, d, (mx_lo[:, 0:4], mx_hi[:, 0:4]), (my_lo[:, 0:4], my_hi[:, 0:4]))
+
+        # diagonals: (0,5,10,15) (1,6,11,12) (2,7,8,13) (3,4,9,14)
+        b = (jnp.roll(b[0], -1, axis=1), jnp.roll(b[1], -1, axis=1))
+        c = (jnp.roll(c[0], -2, axis=1), jnp.roll(c[1], -2, axis=1))
+        d = (jnp.roll(d[0], -3, axis=1), jnp.roll(d[1], -3, axis=1))
+        a, b, c, d = _g(a, b, c, d, (mx_lo[:, 4:8], mx_hi[:, 4:8]), (my_lo[:, 4:8], my_hi[:, 4:8]))
+        b = (jnp.roll(b[0], 1, axis=1), jnp.roll(b[1], 1, axis=1))
+        c = (jnp.roll(c[0], 2, axis=1), jnp.roll(c[1], 2, axis=1))
+        d = (jnp.roll(d[0], 3, axis=1), jnp.roll(d[1], 3, axis=1))
+
+        v_lo = jnp.concatenate([a[0], b[0], c[0], d[0]], axis=1)
+        v_hi = jnp.concatenate([a[1], b[1], c[1], d[1]], axis=1)
+        return v_lo, v_hi
+
+    v_lo, v_hi = lax.fori_loop(0, 12, round_fn, (v_lo, v_hi))
+    new_h_lo = h_lo ^ v_lo[:, :8] ^ v_lo[:, 8:]
+    new_h_hi = h_hi ^ v_hi[:, :8] ^ v_hi[:, 8:]
+    return new_h_lo, new_h_hi
+
+
+@jax.jit
+def blake2b256_blocks(blocks, n_blocks, lengths):
+    """Batch blake2b-256 over pre-padded blocks (jitted).
+
+    Args:
+      blocks: uint32 [N, B, 32] zero-padded 128-byte blocks
+        (see `pack.pad_blake2b`).
+      n_blocks: int32 [N] block count per message (≥ 1, even for empty).
+      lengths: int32 [N] true byte lengths.
+
+    Returns:
+      uint32 [N, 8] digests (little-endian u32 words).
+    """
+    n = blocks.shape[0]
+    h0_lo = _IV_LO.copy()
+    h0_hi = _IV_HI.copy()
+    h0_lo[0] ^= _PARAM_WORD0 & 0xFFFFFFFF
+    h_lo = jnp.broadcast_to(jnp.asarray(h0_lo), (n, 8))
+    h_hi = jnp.broadcast_to(jnp.asarray(h0_hi), (n, 8))
+
+    def step(carry, inp):
+        lo, hi = carry
+        block, idx = inp  # [N, 32], scalar
+        active = idx < n_blocks  # [N]
+        is_last = idx == n_blocks - 1
+        t_lo = jnp.where(is_last, lengths, (idx + 1) * BLOCK_BYTES).astype(jnp.uint32)
+        f_word = jnp.where(is_last, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        new_lo, new_hi = _compress(lo, hi, block, t_lo, f_word)
+        mask = active[:, None]
+        return (jnp.where(mask, new_lo, lo), jnp.where(mask, new_hi, hi)), None
+
+    num_blocks = blocks.shape[1]
+    (h_lo, h_hi), _ = lax.scan(
+        step,
+        (h_lo, h_hi),
+        (jnp.moveaxis(blocks, 1, 0), jnp.arange(num_blocks, dtype=jnp.int32)),
+    )
+    return jnp.stack(
+        [h_lo[:, 0], h_hi[:, 0], h_lo[:, 1], h_hi[:, 1],
+         h_lo[:, 2], h_hi[:, 2], h_lo[:, 3], h_hi[:, 3]],
+        axis=1,
+    )

@@ -1,0 +1,43 @@
+"""Event predicate mask: the pjit'd boolean filter over padded event tensors.
+
+This is the TPU replacement for the reference's hottest loop — the per-event
+topic0/topic1/emitter check inside pass 1 of the event generator
+(`src/proofs/events/generator.rs:217-233`): a pure elementwise mask over a
+padded ``[events, ...]`` tensor plus a segment any-reduce per receipt.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["event_match_mask", "receipts_with_match"]
+
+
+def event_match_mask(
+    topics,  # uint32 [N, 2, 8]: first two topics as u32 words
+    n_topics,  # int32 [N]
+    emitters,  # int32/uint32 [N]
+    valid,  # bool [N] (padding rows are False)
+    topic0,  # uint32 [8]
+    topic1,  # uint32 [8]
+    actor_id_filter=None,  # optional scalar
+):
+    """Boolean [N] mask: event matches (sig, topic1[, emitter]) exactly like
+    `EventMatcher.matches_log` + the actor filter."""
+    t0_eq = jnp.all(topics[:, 0, :] == topic0[None, :], axis=-1)
+    t1_eq = jnp.all(topics[:, 1, :] == topic1[None, :], axis=-1)
+    mask = valid & (n_topics >= 2) & t0_eq & t1_eq
+    if actor_id_filter is not None:
+        mask = mask & (emitters == actor_id_filter)
+    return mask
+
+
+def receipts_with_match(mask, receipt_ids, num_receipts: int):
+    """Per-receipt any-reduce: bool [N] event mask + int32 [N] receipt ids →
+    bool [num_receipts] (which receipts contain ≥1 matching event).
+
+    The segment reduction is the only cross-event communication in pass 1 —
+    under `shard_map` it lowers to a psum over the event axis.
+    """
+    hits = jnp.zeros(num_receipts, dtype=jnp.int32).at[receipt_ids].add(mask.astype(jnp.int32))
+    return hits > 0

@@ -1,0 +1,64 @@
+"""Host-side packing: variable-length byte messages → padded u32 tensors.
+
+The bridge between the pointer-chasing host world (IPLD blocks, event
+entries) and fixed-shape device tensors. Length-dependent padding (keccak's
+0x01…0x80 domain bits, blake2b's zero fill + byte counters) happens here so
+the device kernels see only dense arrays + per-message counts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ipc_proofs_tpu.ops.blake2b_jax import BLOCK_BYTES as B2B_BLOCK
+from ipc_proofs_tpu.ops.keccak_jax import RATE_BYTES
+
+__all__ = ["pad_keccak", "pad_blake2b", "digests_to_bytes"]
+
+
+def pad_keccak(messages: "list[bytes]", max_blocks: "int | None" = None):
+    """Pack messages into keccak rate blocks with multi-rate padding applied.
+
+    Returns (blocks u32[N, B, 34], n_blocks i32[N]).
+    """
+    n = len(messages)
+    counts = np.array([len(m) // RATE_BYTES + 1 for m in messages], dtype=np.int32)
+    b = int(counts.max()) if n else 1
+    if max_blocks is not None:
+        if counts.size and counts.max() > max_blocks:
+            raise ValueError(f"message needs {counts.max()} blocks > cap {max_blocks}")
+        b = max_blocks
+    raw = np.zeros((n, b * RATE_BYTES), dtype=np.uint8)
+    for i, msg in enumerate(messages):
+        raw[i, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+        raw[i, len(msg)] ^= 0x01
+        raw[i, counts[i] * RATE_BYTES - 1] ^= 0x80
+    blocks = raw.reshape(n, b, RATE_BYTES).view(np.uint32).reshape(n, b, RATE_BYTES // 4)
+    # u32 words are already (lo, hi) interleaved little-endian: word 2i = lane i lo
+    return np.ascontiguousarray(blocks), counts
+
+
+def pad_blake2b(messages: "list[bytes]", max_blocks: "int | None" = None):
+    """Pack messages into zero-padded 128-byte blake2b blocks.
+
+    Returns (blocks u32[N, B, 32], n_blocks i32[N], lengths i32[N]).
+    """
+    n = len(messages)
+    lengths = np.array([len(m) for m in messages], dtype=np.int32)
+    counts = np.maximum((lengths + B2B_BLOCK - 1) // B2B_BLOCK, 1).astype(np.int32)
+    b = int(counts.max()) if n else 1
+    if max_blocks is not None:
+        if counts.size and counts.max() > max_blocks:
+            raise ValueError(f"message needs {counts.max()} blocks > cap {max_blocks}")
+        b = max_blocks
+    raw = np.zeros((n, b * B2B_BLOCK), dtype=np.uint8)
+    for i, msg in enumerate(messages):
+        raw[i, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+    blocks = raw.reshape(n, b, B2B_BLOCK).view(np.uint32).reshape(n, b, B2B_BLOCK // 4)
+    return np.ascontiguousarray(blocks), counts, lengths
+
+
+def digests_to_bytes(digests) -> "list[bytes]":
+    """uint32 [N, 8] little-endian words → 32-byte digests."""
+    arr = np.asarray(digests, dtype=np.uint32)
+    return [arr[i].astype("<u4").tobytes() for i in range(arr.shape[0])]

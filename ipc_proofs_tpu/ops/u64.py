@@ -1,0 +1,52 @@
+"""u64 arithmetic emulated on uint32 pairs for TPU lanes.
+
+A u64 lane is carried as ``(lo, hi)`` uint32 arrays. All shift amounts are
+Python ints (static), so every case below resolves at trace time — no
+dynamic shifts reach XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["rotl64", "rotr64", "add64", "xor64", "split_u64", "join_u64"]
+
+
+def rotl64(lo, hi, n: int):
+    """Rotate the u64 (lo, hi) left by static ``n``."""
+    n %= 64
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n > 32:
+        return rotl64(hi, lo, n - 32)
+    # 0 < n < 32
+    new_lo = (lo << n) | (hi >> (32 - n))
+    new_hi = (hi << n) | (lo >> (32 - n))
+    return new_lo, new_hi
+
+
+def rotr64(lo, hi, n: int):
+    return rotl64(lo, hi, 64 - (n % 64))
+
+
+def add64(alo, ahi, blo, bhi):
+    """u64 addition with carry on u32 pairs (wrapping)."""
+    sum_lo = alo + blo
+    carry = (sum_lo < alo).astype(jnp.uint32)
+    sum_hi = ahi + bhi + carry
+    return sum_lo, sum_hi
+
+
+def xor64(alo, ahi, blo, bhi):
+    return alo ^ blo, ahi ^ bhi
+
+
+def split_u64(value: int) -> tuple[int, int]:
+    """Static u64 constant → (lo, hi) u32 ints."""
+    return value & 0xFFFFFFFF, (value >> 32) & 0xFFFFFFFF
+
+
+def join_u64(lo: int, hi: int) -> int:
+    return (int(hi) << 32) | int(lo)

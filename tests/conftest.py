@@ -7,10 +7,21 @@ multi-chip path via `__graft_entry__.dryrun_multichip`.
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests: the environment's axon TPU plugin registers at
+# interpreter startup and sets jax.config jax_platforms="axon,cpu", which
+# would make the first jnp op claim the single real TPU chip through the
+# relay (slow, serialized across processes). Overriding the env var is not
+# enough — the config must be updated after the sitecustomize registration.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
