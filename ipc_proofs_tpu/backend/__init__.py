@@ -1,0 +1,92 @@
+"""The BatchHashBackend seam: pluggable batch inner loops.
+
+This is the architectural move BASELINE.json's north star prescribes: the
+reference's hottest loops — per-event topic matching
+(`src/proofs/events/generator.rs:217-233`), signature/slot keccak hashing
+(`common/evm.rs`, `storage/utils.rs`) and witness-CID recomputation (implicit
+in the reference; explicit here) — become calls into a backend interface.
+`RecordingBlockstore` stays the plugin boundary; `--backend=tpu` swaps only
+the hasher/matcher.
+
+Backends:
+- ``cpu``   — numpy + optional C++ native extension (ctypes), default.
+- ``tpu``   — JAX kernels (Pallas-ready), padded tensors, jit/pjit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence
+
+from ipc_proofs_tpu.state.events import StampedEvent
+
+__all__ = ["BatchHashBackend", "get_backend", "available_backends"]
+
+
+class BatchHashBackend(Protocol):
+    """Batch primitives the proof engines can offload."""
+
+    name: str
+
+    def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
+        """keccak256 of each message."""
+        ...
+
+    def blake2b256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
+        """blake2b-256 of each message (CID digests)."""
+        ...
+
+    def verify_block_cids(self, cids_digests: Sequence[bytes], blocks: Sequence[bytes]) -> bool:
+        """True iff every block hashes (blake2b-256) to its claimed digest."""
+        ...
+
+    def event_match_mask(
+        self,
+        events: Sequence[StampedEvent],
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> list[bool]:
+        """Per-event predicate: EVM-log shaped, topics[0:2] equal, emitter ok."""
+        ...
+
+    def any_event_matches(
+        self,
+        events: Sequence[StampedEvent],
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> bool:
+        """Existence form used by pass 1 of the event generator."""
+        ...
+
+
+_BACKENDS: dict[str, BatchHashBackend] = {}
+
+
+def get_backend(name: str = "cpu") -> BatchHashBackend:
+    """Backend registry; instances are cached (kernels stay jitted)."""
+    if name in _BACKENDS:
+        return _BACKENDS[name]
+    if name == "cpu":
+        from ipc_proofs_tpu.backend.cpu import CpuBackend
+
+        backend: BatchHashBackend = CpuBackend()
+    elif name == "tpu":
+        from ipc_proofs_tpu.backend.tpu import TpuBackend
+
+        backend = TpuBackend()
+    else:
+        raise ValueError(f"unknown backend {name!r} (expected cpu|tpu)")
+    _BACKENDS[name] = backend
+    return backend
+
+
+def available_backends() -> list[str]:
+    names = ["cpu"]
+    try:
+        import jax  # noqa: F401
+
+        names.append("tpu")
+    except ImportError:  # pragma: no cover
+        pass
+    return names

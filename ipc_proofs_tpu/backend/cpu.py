@@ -1,0 +1,76 @@
+"""CPU batch backend: C++ native extension with pure-Python fallback.
+
+The default backend (the reference's role is played by Rust crates; here a
+C++ .so built on first use). Matching is plain Python — on CPU the per-event
+predicate is cheap relative to decode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
+from ipc_proofs_tpu.backend.native import load_native
+from ipc_proofs_tpu.state.events import StampedEvent, extract_evm_log
+
+__all__ = ["CpuBackend"]
+
+
+class CpuBackend:
+    name = "cpu"
+
+    def __init__(self, use_native: bool = True):
+        self._native = load_native() if use_native else None
+
+    @property
+    def has_native(self) -> bool:
+        return self._native is not None
+
+    def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
+        if self._native is not None:
+            return self._native.keccak256_batch(list(messages))
+        return [keccak256(m) for m in messages]
+
+    def blake2b256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
+        if self._native is not None:
+            return self._native.blake2b256_batch(list(messages))
+        return [blake2b_256(m) for m in messages]
+
+    def verify_block_cids(
+        self, cids_digests: Sequence[bytes], blocks: Sequence[bytes]
+    ) -> bool:
+        if self._native is not None:
+            return self._native.verify_blake2b_batch(list(cids_digests), list(blocks))
+        return all(
+            blake2b_256(block) == digest for digest, block in zip(cids_digests, blocks)
+        )
+
+    def event_match_mask(
+        self,
+        events: Sequence[StampedEvent],
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> list[bool]:
+        mask = []
+        for stamped in events:
+            if actor_id_filter is not None and stamped.emitter != actor_id_filter:
+                mask.append(False)
+                continue
+            log = extract_evm_log(stamped.event)
+            mask.append(
+                log is not None
+                and len(log.topics) >= 2
+                and log.topics[0] == topic0
+                and log.topics[1] == topic1
+            )
+        return mask
+
+    def any_event_matches(
+        self,
+        events: Sequence[StampedEvent],
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> bool:
+        return any(self.event_match_mask(events, topic0, topic1, actor_id_filter))

@@ -1,0 +1,108 @@
+"""Build-and-load for the native C++ batch hash library (ctypes).
+
+Compiled on first use with g++ into ``build/libipchashes.so`` (cached by
+source mtime). Falls back cleanly: callers check ``load_native() is None``
+and use the pure-Python scalar path instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+__all__ = ["load_native", "NativeHashes"]
+
+_SRC = Path(__file__).parent / "hashes.cpp"
+_BUILD_DIR = Path(__file__).parent / "build"
+_SO_PATH = _BUILD_DIR / "libipchashes.so"
+
+_lock = threading.Lock()
+_cached: "NativeHashes | None | bool" = False  # False = not attempted yet
+
+
+class NativeHashes:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        for name in ("batch_keccak256", "batch_blake2b256"):
+            fn = getattr(lib, name)
+            fn.argtypes = [u8p, u64p, u64p, ctypes.c_uint64, u8p]
+            fn.restype = None
+        lib.batch_verify_blake2b.argtypes = [u8p, u64p, u64p, u8p, ctypes.c_uint64]
+        lib.batch_verify_blake2b.restype = ctypes.c_uint64
+
+    @staticmethod
+    def _pack(messages) -> tuple[bytes, "ctypes.Array", "ctypes.Array", int]:
+        n = len(messages)
+        offsets = (ctypes.c_uint64 * n)()
+        lengths = (ctypes.c_uint64 * n)()
+        position = 0
+        for i, message in enumerate(messages):
+            offsets[i] = position
+            lengths[i] = len(message)
+            position += len(message)
+        return b"".join(messages), offsets, lengths, n
+
+    def _batch(self, fn_name: str, messages) -> list[bytes]:
+        data, offsets, lengths, n = self._pack(messages)
+        out = (ctypes.c_uint8 * (32 * n))()
+        data_buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
+        getattr(self._lib, fn_name)(data_buf, offsets, lengths, n, out)
+        raw = bytes(out)
+        return [raw[32 * i : 32 * i + 32] for i in range(n)]
+
+    def keccak256_batch(self, messages) -> list[bytes]:
+        return self._batch("batch_keccak256", messages)
+
+    def blake2b256_batch(self, messages) -> list[bytes]:
+        return self._batch("batch_blake2b256", messages)
+
+    def verify_blake2b_batch(self, digests, blocks) -> bool:
+        data, offsets, lengths, n = self._pack(blocks)
+        expected = b"".join(digests)
+        if len(expected) != 32 * n:
+            raise ValueError("each expected digest must be 32 bytes")
+        data_buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
+        exp_buf = (ctypes.c_uint8 * len(expected)).from_buffer_copy(expected)
+        bad = self._lib.batch_verify_blake2b(data_buf, offsets, lengths, exp_buf, n)
+        return bad == 0
+
+
+def _build() -> Optional[Path]:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    if _SO_PATH.exists() and _SO_PATH.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO_PATH
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        str(_SRC), "-o", str(_SO_PATH),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO_PATH
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
+
+
+def load_native() -> Optional[NativeHashes]:
+    """Compile (if needed) and load the native library; None on failure."""
+    global _cached
+    with _lock:
+        if _cached is not False:
+            return _cached  # type: ignore[return-value]
+        if os.environ.get("IPC_PROOFS_NO_NATIVE"):
+            _cached = None
+            return None
+        so = _build()
+        if so is None:
+            _cached = None
+            return None
+        try:
+            _cached = NativeHashes(ctypes.CDLL(str(so)))
+        except OSError:
+            _cached = None
+        return _cached

@@ -1,0 +1,183 @@
+// Native batch hash primitives for the CPU backend.
+//
+// The reference gets these from Rust crates (sha3, multihash); here they are
+// C++ (Rust is unavailable in this environment) exposed through a plain C ABI
+// consumed via ctypes. Batch layout: one flat byte buffer + offsets/lengths,
+// so Python hands over a single contiguous allocation per call.
+//
+// Build: g++ -O3 -march=native -shared -fPIC hashes.cpp -o libipchashes.so
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------- keccak256
+constexpr uint64_t kRoundConstants[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+constexpr int kRotation[5][5] = {{0, 36, 3, 41, 18},
+                                 {1, 44, 10, 45, 2},
+                                 {62, 6, 43, 15, 61},
+                                 {28, 55, 25, 21, 56},
+                                 {27, 20, 39, 8, 14}};
+
+inline uint64_t rotl64(uint64_t v, int n) {
+  return n == 0 ? v : (v << n) | (v >> (64 - n));
+}
+
+void keccak_f1600(uint64_t a[25]) {
+  uint64_t b[25], c[5], d[5];
+  for (int round = 0; round < 24; ++round) {
+    for (int x = 0; x < 5; ++x)
+      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rotl64(c[(x + 1) % 5], 1);
+    for (int i = 0; i < 25; ++i) a[i] ^= d[i % 5];
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl64(a[x + 5 * y], kRotation[x][y]);
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        a[x + 5 * y] =
+            b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y]);
+    a[0] ^= kRoundConstants[round];
+  }
+}
+
+void keccak256_one(const uint8_t* data, uint64_t len, uint8_t* out) {
+  constexpr uint64_t kRate = 136;
+  uint64_t state[25] = {0};
+  uint64_t offset = 0;
+  // full blocks
+  while (len - offset >= kRate) {
+    for (int i = 0; i < 17; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, data + offset + 8 * i, 8);
+      state[i] ^= lane;
+    }
+    keccak_f1600(state);
+    offset += kRate;
+  }
+  // final (padded) block
+  uint8_t block[kRate] = {0};
+  std::memcpy(block, data + offset, len - offset);
+  block[len - offset] ^= 0x01;
+  block[kRate - 1] ^= 0x80;
+  for (int i = 0; i < 17; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    state[i] ^= lane;
+  }
+  keccak_f1600(state);
+  std::memcpy(out, state, 32);
+}
+
+// --------------------------------------------------------------- blake2b-256
+constexpr uint64_t kBlakeIV[8] = {
+    0x6A09E667F3BCC908ULL, 0xBB67AE8584CAA73BULL, 0x3C6EF372FE94F82BULL,
+    0xA54FF53A5F1D36F1ULL, 0x510E527FADE682D1ULL, 0x9B05688C2B3E6C1FULL,
+    0x1F83D9ABFB41BD6BULL, 0x5BE0CD19137E2179ULL};
+
+constexpr uint8_t kSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t rotr64(uint64_t v, int n) { return (v >> n) | (v << (64 - n)); }
+
+#define B2B_G(a, b, c, d, x, y)       \
+  v[a] += v[b] + (x);                 \
+  v[d] = rotr64(v[d] ^ v[a], 32);     \
+  v[c] += v[d];                       \
+  v[b] = rotr64(v[b] ^ v[c], 24);     \
+  v[a] += v[b] + (y);                 \
+  v[d] = rotr64(v[d] ^ v[a], 16);     \
+  v[c] += v[d];                       \
+  v[b] = rotr64(v[b] ^ v[c], 63);
+
+void blake2b_compress(uint64_t h[8], const uint8_t* block, uint64_t t,
+                      bool last) {
+  uint64_t v[16], m[16];
+  for (int i = 0; i < 8; ++i) v[i] = h[i];
+  for (int i = 0; i < 8; ++i) v[i + 8] = kBlakeIV[i];
+  v[12] ^= t;
+  if (last) v[14] = ~v[14];
+  for (int i = 0; i < 16; ++i) std::memcpy(&m[i], block + 8 * i, 8);
+  for (int r = 0; r < 12; ++r) {
+    const uint8_t* s = kSigma[r];
+    B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+  for (int i = 0; i < 8; ++i) h[i] ^= v[i] ^ v[i + 8];
+}
+
+void blake2b256_one(const uint8_t* data, uint64_t len, uint8_t* out) {
+  uint64_t h[8];
+  for (int i = 0; i < 8; ++i) h[i] = kBlakeIV[i];
+  h[0] ^= 0x01010020ULL;  // digest 32, key 0, fanout 1, depth 1
+  uint64_t offset = 0;
+  while (len > 128 && len - offset > 128) {
+    blake2b_compress(h, data + offset, offset + 128, false);
+    offset += 128;
+  }
+  uint8_t block[128] = {0};
+  std::memcpy(block, data + offset, len - offset);
+  blake2b_compress(h, block, len, true);
+  std::memcpy(out, h, 32);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batch APIs: data = concatenated messages; offsets[i]/lengths[i] describe
+// message i; out = n * 32 bytes.
+void batch_keccak256(const uint8_t* data, const uint64_t* offsets,
+                     const uint64_t* lengths, uint64_t n, uint8_t* out) {
+  for (uint64_t i = 0; i < n; ++i)
+    keccak256_one(data + offsets[i], lengths[i], out + 32 * i);
+}
+
+void batch_blake2b256(const uint8_t* data, const uint64_t* offsets,
+                      const uint64_t* lengths, uint64_t n, uint8_t* out) {
+  for (uint64_t i = 0; i < n; ++i)
+    blake2b256_one(data + offsets[i], lengths[i], out + 32 * i);
+}
+
+// Returns the number of mismatching blocks (0 == all CIDs verify).
+uint64_t batch_verify_blake2b(const uint8_t* data, const uint64_t* offsets,
+                              const uint64_t* lengths,
+                              const uint8_t* expected_digests, uint64_t n) {
+  uint64_t bad = 0;
+  uint8_t digest[32];
+  for (uint64_t i = 0; i < n; ++i) {
+    blake2b256_one(data + offsets[i], lengths[i], digest);
+    if (std::memcmp(digest, expected_digests + 32 * i, 32) != 0) ++bad;
+  }
+  return bad;
+}
+
+}  // extern "C"

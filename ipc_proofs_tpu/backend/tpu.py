@@ -1,0 +1,116 @@
+"""TPU batch backend: JAX kernels over padded tensors.
+
+Flattens host-side objects (events, witness blocks) into dense arrays, then
+runs the jitted batch kernels from :mod:`ipc_proofs_tpu.ops`. On a CPU-only
+host the same code runs on the XLA CPU backend (used by the equivalence
+tests); on TPU the kernels execute on the chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ipc_proofs_tpu.state.events import StampedEvent, extract_evm_log
+
+__all__ = ["TpuBackend", "flatten_events"]
+
+
+def flatten_events(events: Sequence[StampedEvent]):
+    """Host-side flattener: events → (topics u32[N,2,8], n_topics i32[N],
+    emitters i64[N], valid bool[N]).
+
+    ``valid`` is False for events that are not EVM-log shaped (no topics /
+    malformed sizes), mirroring `extract_evm_log`'s rejections.
+    """
+    n = len(events)
+    topics = np.zeros((n, 2, 8), dtype=np.uint32)
+    n_topics = np.zeros(n, dtype=np.int32)
+    emitters = np.zeros(n, dtype=np.int64)
+    valid = np.zeros(n, dtype=bool)
+    for i, stamped in enumerate(events):
+        emitters[i] = stamped.emitter
+        log = extract_evm_log(stamped.event)
+        if log is None:
+            continue
+        valid[i] = True
+        n_topics[i] = len(log.topics)
+        for j, topic in enumerate(log.topics[:2]):
+            topics[i, j] = np.frombuffer(topic, dtype="<u4")
+    return topics, n_topics, emitters, valid
+
+
+class TpuBackend:
+    name = "tpu"
+
+    def __init__(self):
+        import jax  # noqa: F401 — fail fast if jax is unavailable
+
+        from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
+        from ipc_proofs_tpu.ops.keccak_jax import keccak256_blocks
+        from ipc_proofs_tpu.ops.match_jax import event_match_mask
+
+        self._keccak = keccak256_blocks
+        self._blake2b = blake2b256_blocks
+        self._match = event_match_mask
+
+    def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
+        import jax.numpy as jnp
+
+        from ipc_proofs_tpu.ops.pack import digests_to_bytes, pad_keccak
+
+        if not messages:
+            return []
+        blocks, counts = pad_keccak(list(messages))
+        return digests_to_bytes(self._keccak(jnp.asarray(blocks), jnp.asarray(counts)))
+
+    def blake2b256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
+        import jax.numpy as jnp
+
+        from ipc_proofs_tpu.ops.pack import digests_to_bytes, pad_blake2b
+
+        if not messages:
+            return []
+        blocks, counts, lengths = pad_blake2b(list(messages))
+        return digests_to_bytes(
+            self._blake2b(jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))
+        )
+
+    def verify_block_cids(
+        self, cids_digests: Sequence[bytes], blocks: Sequence[bytes]
+    ) -> bool:
+        digests = self.blake2b256_batch(blocks)
+        return all(d == e for d, e in zip(digests, cids_digests))
+
+    def event_match_mask(
+        self,
+        events: Sequence[StampedEvent],
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> list[bool]:
+        import jax.numpy as jnp
+
+        if not events:
+            return []
+        topics, n_topics, emitters, valid = flatten_events(events)
+        mask = self._match(
+            jnp.asarray(topics),
+            jnp.asarray(n_topics),
+            jnp.asarray(emitters),
+            jnp.asarray(valid),
+            jnp.asarray(np.frombuffer(topic0, dtype="<u4")),
+            jnp.asarray(np.frombuffer(topic1, dtype="<u4")),
+            actor_id_filter=actor_id_filter,
+        )
+        return [bool(x) for x in np.asarray(mask)]
+
+    def any_event_matches(
+        self,
+        events: Sequence[StampedEvent],
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> bool:
+        return any(self.event_match_mask(events, topic0, topic1, actor_id_filter))

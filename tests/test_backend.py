@@ -1,0 +1,116 @@
+"""Backend seam tests: CPU (native + fallback) vs TPU(JAX) equivalence."""
+
+import pytest
+
+from ipc_proofs_tpu.backend import get_backend
+from ipc_proofs_tpu.backend.cpu import CpuBackend
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
+from ipc_proofs_tpu.fixtures import EventFixture
+from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+MESSAGES = [b"", b"abc", b"x" * 135, b"y" * 136, b"z" * 1000, bytes(range(256))]
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+T0 = hash_event_signature(SIG)
+T1 = ascii_to_bytes32("subnet-a")
+
+
+def _events():
+    return [
+        EventFixture(emitter=7, signature=SIG, topic1="subnet-a").to_stamped(),
+        EventFixture(emitter=7, signature=SIG, topic1="subnet-b").to_stamped(),
+        EventFixture(emitter=9, signature=SIG, topic1="subnet-a").to_stamped(),
+        EventFixture(emitter=7, signature="Other()", topic1="subnet-a").to_stamped(),
+        EventFixture(emitter=7, signature=SIG, topic1="subnet-a", encoding="concat").to_stamped(),
+    ]
+
+
+class TestCpuBackend:
+    def test_hashes_match_reference(self):
+        backend = get_backend("cpu")
+        assert backend.keccak256_batch(MESSAGES) == [keccak256(m) for m in MESSAGES]
+        assert backend.blake2b256_batch(MESSAGES) == [blake2b_256(m) for m in MESSAGES]
+
+    def test_python_fallback_matches_native(self):
+        native = CpuBackend(use_native=True)
+        fallback = CpuBackend(use_native=False)
+        assert native.keccak256_batch(MESSAGES) == fallback.keccak256_batch(MESSAGES)
+        assert native.blake2b256_batch(MESSAGES) == fallback.blake2b256_batch(MESSAGES)
+
+    def test_native_available(self):
+        # g++ is baked into the image; the native path should build.
+        assert CpuBackend().has_native
+
+    def test_verify_block_cids(self):
+        backend = get_backend("cpu")
+        blocks = [b"block-a", b"block-b"]
+        digests = [blake2b_256(b) for b in blocks]
+        assert backend.verify_block_cids(digests, blocks)
+        assert not backend.verify_block_cids(digests, [b"block-a", b"tampered"])
+
+    def test_event_mask(self):
+        backend = get_backend("cpu")
+        mask = backend.event_match_mask(_events(), T0, T1, actor_id_filter=7)
+        assert mask == [True, False, False, False, True]
+        assert backend.any_event_matches(_events(), T0, T1, 7)
+        assert not backend.any_event_matches(_events()[1:4], T0, T1, 7)
+
+
+class TestTpuBackendEquivalence:
+    @pytest.fixture(scope="class")
+    def tpu(self):
+        pytest.importorskip("jax")
+        return get_backend("tpu")
+
+    def test_hashes_match_cpu(self, tpu):
+        cpu = get_backend("cpu")
+        assert tpu.keccak256_batch(MESSAGES) == cpu.keccak256_batch(MESSAGES)
+        assert tpu.blake2b256_batch(MESSAGES) == cpu.blake2b256_batch(MESSAGES)
+
+    def test_event_mask_matches_cpu(self, tpu):
+        cpu = get_backend("cpu")
+        events = _events()
+        for actor_filter in (None, 7, 9, 12345):
+            assert tpu.event_match_mask(events, T0, T1, actor_filter) == cpu.event_match_mask(
+                events, T0, T1, actor_filter
+            ), f"filter={actor_filter}"
+
+    def test_verify_block_cids(self, tpu):
+        blocks = [b"block-%d" % i * (i + 1) for i in range(20)]
+        digests = [blake2b_256(b) for b in blocks]
+        assert tpu.verify_block_cids(digests, blocks)
+        bad = list(blocks)
+        bad[7] = b"evil"
+        assert not tpu.verify_block_cids(digests, bad)
+
+    def test_empty_batches(self, tpu):
+        assert tpu.keccak256_batch([]) == []
+        assert tpu.event_match_mask([], T0, T1, None) == []
+
+
+class TestBackendInProofGeneration:
+    def test_event_generation_same_proofs_cpu_vs_tpu(self):
+        pytest.importorskip("jax")
+        from ipc_proofs_tpu.fixtures import ContractFixture, build_chain
+        from ipc_proofs_tpu.proofs.generator import EventProofSpec, generate_proof_bundle
+
+        events = [
+            [EventFixture(emitter=500, signature=SIG, topic1="subnet-a")],
+            [EventFixture(emitter=500, signature=SIG, topic1="other")],
+            [],
+            [EventFixture(emitter=501, signature=SIG, topic1="subnet-a")],
+        ]
+        world = build_chain([ContractFixture(actor_id=500)], events)
+        spec = [EventProofSpec(event_signature=SIG, topic_1="subnet-a", actor_id_filter=500)]
+
+        bundle_cpu = generate_proof_bundle(
+            world.store, world.parent, world.child, [], spec, match_backend=get_backend("cpu")
+        )
+        bundle_tpu = generate_proof_bundle(
+            world.store, world.parent, world.child, [], spec, match_backend=get_backend("tpu")
+        )
+        bundle_scalar = generate_proof_bundle(
+            world.store, world.parent, world.child, [], spec, match_backend=None
+        )
+        assert bundle_cpu.to_json() == bundle_tpu.to_json() == bundle_scalar.to_json()
+        assert len(bundle_cpu.event_proofs) == 1
