@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Headline benchmark: event-proofs/sec over a 4096-tipset batch.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The measured quantity is BASELINE.json config 2: batch event-proof
+generation (sparse filter, ~1% receipt match rate) — the padded
+[tipset, receipt, event] match pipeline plus the per-receipt reduce, on the
+best available platform (TPU chip if the axon backend initializes, else XLA
+CPU). ``vs_baseline`` compares against the reference's architecture: a
+single-threaded scalar decode+match loop over the same events, measured
+in-process (the reference publishes no numbers — BASELINE.md).
+
+Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def _pick_platform(requested: str, probe_timeout: float) -> str:
+    """'auto' probes the default (axon TPU) backend in a subprocess so a
+    hung chip claim cannot hang the bench."""
+    if requested != "auto":
+        return requested
+    if os.environ.get("IPC_BENCH_PLATFORM"):
+        return os.environ["IPC_BENCH_PLATFORM"]
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=probe_timeout,
+            text=True,
+        )
+        if probe.returncode == 0 and probe.stdout.strip():
+            platform = probe.stdout.strip().splitlines()[-1]
+            _log(f"bench: default backend probe OK → platform {platform!r}")
+            return "default"
+    except subprocess.TimeoutExpired:
+        _log("bench: default backend probe timed out — falling back to CPU")
+    except Exception as exc:  # pragma: no cover
+        _log(f"bench: probe failed ({exc}) — falling back to CPU")
+    return "cpu"
+
+
+def _scalar_baseline_proofs_per_sec(
+    topic0: bytes, topic1: bytes, total_events: int, proofs_per_pass: int, sample: int = 20000
+) -> float:
+    """The reference-architecture baseline: one thread, one Python object per
+    event, decode + match per event (events/generator.rs:217-233 shape)."""
+    from ipc_proofs_tpu.backend.cpu import CpuBackend
+    from ipc_proofs_tpu.fixtures import EventFixture
+
+    events = []
+    for i in range(sample // 2):
+        events.append(
+            EventFixture(emitter=1001, signature="NewTopDownMessage(bytes32,uint256)",
+                         topic1="calib-subnet-1").to_stamped()
+        )
+        events.append(
+            EventFixture(emitter=1001, signature="Other(uint256)", topic1="nope").to_stamped()
+        )
+    backend = CpuBackend(use_native=False)
+    start = time.perf_counter()
+    backend.event_match_mask(events, topic0, topic1, 1001)
+    elapsed = time.perf_counter() - start
+    per_event = elapsed / len(events)
+    pass_time = per_event * total_events
+    return proofs_per_pass / pass_time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", default="auto", help="auto|default|cpu")
+    parser.add_argument("--tipsets", type=int, default=4096)
+    parser.add_argument("--receipts", type=int, default=16)
+    parser.add_argument("--events", type=int, default=4)
+    parser.add_argument("--match-rate", type=float, default=0.01)
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--probe-timeout", type=float, default=240.0)
+    parser.add_argument("--quick", action="store_true", help="small shapes for smoke runs")
+    args = parser.parse_args()
+
+    if args.quick:
+        args.tipsets, args.iters = min(args.tipsets, 256), min(args.iters, 5)
+
+    platform = _pick_platform(args.platform, args.probe_timeout)
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    devices = jax.devices()
+    _log(f"bench: devices = {devices}")
+
+    from ipc_proofs_tpu.parallel.mesh import make_mesh
+    from ipc_proofs_tpu.parallel.pipeline import sharded_match_pipeline, synthetic_event_batch
+    from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+    topic0 = hash_event_signature("NewTopDownMessage(bytes32,uint256)")
+    topic1 = ascii_to_bytes32("calib-subnet-1")
+
+    t_build = time.perf_counter()
+    batch = synthetic_event_batch(
+        args.tipsets, args.receipts, args.events,
+        topic0, topic1, emitter=1001, match_rate=args.match_rate, seed=42,
+    )
+    total_events = args.tipsets * args.receipts * args.events
+    _log(
+        f"bench: batch [{args.tipsets}×{args.receipts}×{args.events}] = "
+        f"{total_events} events built in {time.perf_counter() - t_build:.2f}s"
+    )
+
+    n_dev = len(devices)
+    sp = 2 if (n_dev % 2 == 0 and n_dev > 1) else 1
+    mesh = make_mesh(n_dev, sp=sp)
+    jitted, shard_batch = sharded_match_pipeline(mesh)
+    sharded_args = shard_batch(batch, topic0, topic1, 1001)
+
+    # warmup / compile
+    t_compile = time.perf_counter()
+    hits, mask, count = jitted(*sharded_args)
+    hits.block_until_ready()
+    proofs_per_pass = int(count)
+    _log(
+        f"bench: compile+first pass {time.perf_counter() - t_compile:.2f}s, "
+        f"{proofs_per_pass} matching proofs per pass"
+    )
+
+    start = time.perf_counter()
+    for _ in range(args.iters):
+        hits, mask, count = jitted(*sharded_args)
+    hits.block_until_ready()
+    elapsed = time.perf_counter() - start
+    pass_time = elapsed / args.iters
+    proofs_per_sec = proofs_per_pass / pass_time
+    events_per_sec = total_events / pass_time
+    _log(
+        f"bench: {args.iters} passes in {elapsed:.3f}s → {pass_time*1e3:.2f} ms/pass, "
+        f"{events_per_sec:,.0f} events/s scanned, {proofs_per_sec:,.0f} proofs/s"
+    )
+
+    baseline = _scalar_baseline_proofs_per_sec(topic0, topic1, total_events, proofs_per_pass)
+    _log(f"bench: scalar single-thread baseline ≈ {baseline:,.0f} proofs/s")
+
+    print(
+        json.dumps(
+            {
+                "metric": "event_proofs_per_sec_4k_tipset_batch",
+                "value": round(proofs_per_sec, 1),
+                "unit": "proofs/s",
+                "vs_baseline": round(proofs_per_sec / baseline, 2) if baseline > 0 else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Device-mesh parallelism for the batch proof pipeline.
+
+The proof workload is data-parallel over independent items (SURVEY.md §2c):
+(tipset × receipt × event) for event proofs, (block) for witness CID
+recomputation. The mesh maps those axes onto devices:
+
+- ``dp`` (data)     — tipsets / witness blocks shard here;
+- ``sp`` (sequence) — the flattened event axis shards here; the per-receipt
+  any-reduce is the only cross-device communication (a psum over ``sp``).
+
+There is deliberately no tp/pp: there are no weight matrices to shard and no
+layered model to pipeline — the reference's workload is a filter/hash
+pipeline, and inventing tensor/pipeline parallelism for it would be
+structure for structure's sake (SURVEY.md §5 says the same about ring
+attention).
+"""
+
+from ipc_proofs_tpu.parallel.mesh import make_mesh
+from ipc_proofs_tpu.parallel.pipeline import (
+    EventBatch,
+    match_pipeline,
+    sharded_match_pipeline,
+    synthetic_event_batch,
+)
+
+__all__ = [
+    "make_mesh",
+    "EventBatch",
+    "match_pipeline",
+    "sharded_match_pipeline",
+    "synthetic_event_batch",
+]

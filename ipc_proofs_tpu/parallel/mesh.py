@@ -1,0 +1,29 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["make_mesh"]
+
+
+def make_mesh(n_devices: Optional[int] = None, sp: int = 1):
+    """Build a 2D ``(dp, sp)`` mesh over the first ``n_devices`` devices.
+
+    ``sp`` devices shard the event axis (sequence parallelism for the
+    match-reduce); the rest shard the tipset/block axis (data parallelism).
+    ``n_devices=None`` uses all available devices.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+    if n_devices % sp != 0:
+        raise ValueError(f"n_devices {n_devices} not divisible by sp {sp}")
+    grid = np.array(devices[:n_devices]).reshape(n_devices // sp, sp)
+    return Mesh(grid, axis_names=("dp", "sp"))
