@@ -1,0 +1,224 @@
+"""Command-line interface: generate / verify / demo.
+
+The reference has no CLI at all — `main.rs` is a hardcoded end-to-end run
+against calibration net (endpoint, height, contract, event all constants,
+`src/main.rs:21-64`; SURVEY.md §5 lists "no config/flag system" as a gap).
+This CLI exposes the same flow with real flags plus offline verification of
+saved bundles.
+
+    python -m ipc_proofs_tpu.cli generate --endpoint URL --height H \
+        --contract 0x... --slot-subnet calib-subnet-1 --slot-index 0 \
+        --event-sig "NewTopDownMessage(bytes32,uint256)" \
+        --topic1 calib-subnet-1 --backend cpu -o bundle.json
+    python -m ipc_proofs_tpu.cli verify bundle.json [--f3-cert cert.json] \
+        [--event-sig ... --topic1 ...] [--check-cids]
+    python -m ipc_proofs_tpu.cli demo          # hermetic synthetic-chain run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cmd_generate(args) -> int:
+    from ipc_proofs_tpu.backend import get_backend
+    from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+    from ipc_proofs_tpu.proofs.chain import Tipset
+    from ipc_proofs_tpu.proofs.generator import (
+        EventProofSpec,
+        StorageProofSpec,
+        generate_proof_bundle,
+    )
+    from ipc_proofs_tpu.state.storage import calculate_storage_slot
+    from ipc_proofs_tpu.store.blockstore import CachedBlockstore
+    from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+    from ipc_proofs_tpu.utils.metrics import get_metrics
+
+    metrics = get_metrics()
+    client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
+
+    with metrics.stage("fetch_tipsets"):
+        parent = Tipset.fetch(client, args.height)
+        child = Tipset.fetch(client, args.height + 1)
+    print(f"parent tipset @{parent.height}: {len(parent.cids)} blocks", file=sys.stderr)
+
+    with metrics.stage("resolve_address"):
+        actor_id = (
+            args.actor_id
+            if args.actor_id is not None
+            else resolve_eth_address_to_actor_id(client, args.contract)
+        )
+    print(f"actor id: {actor_id}", file=sys.stderr)
+
+    storage_specs = []
+    if args.slot_subnet is not None:
+        slot = calculate_storage_slot(args.slot_subnet, args.slot_index)
+        storage_specs.append(StorageProofSpec(actor_id=actor_id, slot=slot))
+    event_specs = []
+    if args.event_sig:
+        event_specs.append(
+            EventProofSpec(
+                event_signature=args.event_sig,
+                topic_1=args.topic1,
+                actor_id_filter=None if args.no_actor_filter else actor_id,
+            )
+        )
+
+    store = RpcBlockstore(client)
+    backend = get_backend(args.backend) if args.backend != "none" else None
+    with metrics.stage("generate"):
+        bundle = generate_proof_bundle(
+            store, parent, child, storage_specs, event_specs, match_backend=backend
+        )
+
+    output = args.output or "bundle.json"
+    with open(output, "w") as fh:
+        fh.write(bundle.to_json(indent=2))
+    print(
+        f"bundle: {len(bundle.storage_proofs)} storage + {len(bundle.event_proofs)} "
+        f"event proofs, {len(bundle.blocks)} witness blocks "
+        f"({bundle.witness_bytes()} bytes) → {output}",
+        file=sys.stderr,
+    )
+    if args.metrics:
+        print(metrics.to_json(), file=sys.stderr)
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+    from ipc_proofs_tpu.proofs.cert import FinalityCertificate
+    from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+
+    with open(args.bundle) as fh:
+        bundle = UnifiedProofBundle.from_json(fh.read())
+
+    if args.f3_cert:
+        with open(args.f3_cert) as fh:
+            cert = FinalityCertificate.from_json_obj(json.load(fh))
+        policy = TrustPolicy.with_f3_certificate(cert)
+    else:
+        print("WARNING: no F3 certificate — accept-all trust (testing only)", file=sys.stderr)
+        policy = TrustPolicy.accept_all()
+
+    event_filter = (
+        create_event_filter(args.event_sig, args.topic1) if args.event_sig else None
+    )
+
+    start = time.perf_counter()
+    result = verify_proof_bundle(
+        bundle, policy, event_filter=event_filter, verify_witness_cids=args.check_cids
+    )
+    elapsed = time.perf_counter() - start
+
+    print(
+        json.dumps(
+            {
+                "storage_results": result.storage_results,
+                "event_results": result.event_results,
+                "all_valid": result.all_valid(),
+                "verify_seconds": round(elapsed, 4),
+            }
+        )
+    )
+    return 0 if result.all_valid() else 1
+
+
+def _cmd_demo(args) -> int:
+    """The reference `main.rs` flow, hermetic: synthesize a chain, generate
+    one storage + one event proof, verify offline, print results."""
+    from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+    from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
+    from ipc_proofs_tpu.proofs.generator import (
+        EventProofSpec,
+        StorageProofSpec,
+        generate_proof_bundle,
+    )
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+    from ipc_proofs_tpu.state.storage import calculate_storage_slot
+
+    sig = "NewTopDownMessage(bytes32,uint256)"
+    subnet = "calib-subnet-1"
+    actor = 1001
+    slot = calculate_storage_slot(subnet, 0)
+
+    world = build_chain(
+        [ContractFixture(actor_id=actor, storage={slot: (15).to_bytes(1, "big")})],
+        [
+            [EventFixture(emitter=actor, signature=sig, topic1=subnet, data=b"\x0f".rjust(32, b"\x00"))],
+            [],
+            [EventFixture(emitter=actor, signature=sig, topic1=subnet, data=b"\x10".rjust(32, b"\x00"))],
+        ],
+        parent_height=2_992_953,
+    )
+    bundle = generate_proof_bundle(
+        world.store,
+        world.parent,
+        world.child,
+        [StorageProofSpec(actor_id=actor, slot=slot)],
+        [EventProofSpec(event_signature=sig, topic_1=subnet, actor_id_filter=actor)],
+    )
+    print("Unified Proof Bundle generated:")
+    print(f"  Storage proofs: {len(bundle.storage_proofs)}")
+    print(f"  Event proofs: {len(bundle.event_proofs)}")
+    print(f"  Total witness blocks: {len(bundle.blocks)}")
+
+    result = verify_proof_bundle(
+        bundle,
+        TrustPolicy.accept_all(),
+        event_filter=create_event_filter(sig, subnet),
+        verify_witness_cids=True,
+    )
+    print("Verification Results:")
+    print(f"  Storage proofs valid: {result.storage_results}")
+    print(f"  Event proofs valid: {result.event_results}")
+    print(f"  All valid: {result.all_valid()}")
+    return 0 if result.all_valid() else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ipc-proofs-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")
+    gen.add_argument("--endpoint", required=True, help="Lotus JSON-RPC endpoint URL")
+    gen.add_argument("--token", default=None, help="bearer token")
+    gen.add_argument("--timeout", type=float, default=250.0)
+    gen.add_argument("--height", type=int, required=True, help="parent epoch H (child is H+1)")
+    gen.add_argument("--contract", help="EVM contract address 0x…")
+    gen.add_argument("--actor-id", type=int, default=None, help="skip address resolution")
+    gen.add_argument("--slot-subnet", default=None, help="subnet id for mapping-slot proof")
+    gen.add_argument("--slot-index", type=int, default=0)
+    gen.add_argument("--event-sig", default=None, help='e.g. "NewTopDownMessage(bytes32,uint256)"')
+    gen.add_argument("--topic1", default=None)
+    gen.add_argument("--no-actor-filter", action="store_true")
+    gen.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
+    gen.add_argument("-o", "--output", default=None)
+    gen.add_argument("--metrics", action="store_true")
+    gen.set_defaults(fn=_cmd_generate)
+
+    ver = sub.add_parser("verify", help="verify a saved bundle offline")
+    ver.add_argument("bundle")
+    ver.add_argument("--f3-cert", default=None, help="F3 finality certificate JSON")
+    ver.add_argument("--event-sig", default=None)
+    ver.add_argument("--topic1", default=None)
+    ver.add_argument("--check-cids", action="store_true", help="recompute every witness CID")
+    ver.set_defaults(fn=_cmd_verify)
+
+    demo = sub.add_parser("demo", help="hermetic end-to-end demo on a synthetic chain")
+    demo.set_defaults(fn=_cmd_demo)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "event_sig", None) and not getattr(args, "topic1", None):
+        parser.error("--event-sig requires --topic1")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Observability: structured logging and per-stage timers.
+
+The reference depends on `tracing` but never initializes a subscriber, so
+all its logs are dropped (SURVEY.md §5); its only metric is one cache-stats
+eprintln. Here: real stage timers (fetch/decode/hash/match) and a metrics
+registry the CLI and benchmarks print.
+"""
+
+from ipc_proofs_tpu.utils.metrics import Metrics, StageTimer, get_metrics
+
+__all__ = ["Metrics", "StageTimer", "get_metrics"]

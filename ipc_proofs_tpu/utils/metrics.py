@@ -1,0 +1,64 @@
+"""Per-stage timers and counters."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+__all__ = ["StageTimer", "Metrics", "get_metrics"]
+
+
+@dataclass
+class StageTimer:
+    total_s: float = 0.0
+    calls: int = 0
+
+    def add(self, seconds: float) -> None:
+        self.total_s += seconds
+        self.calls += 1
+
+
+@dataclass
+class Metrics:
+    """Thread-safe stage timers + counters; one instance per pipeline run."""
+
+    timers: dict[str, StageTimer] = field(default_factory=dict)
+    counters: dict[str, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    @contextmanager
+    def stage(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self.timers.setdefault(name, StageTimer()).add(elapsed)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "timers": {
+                    k: {"total_s": round(v.total_s, 6), "calls": v.calls}
+                    for k, v in self.timers.items()
+                },
+                "counters": dict(self.counters),
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), indent=2)
+
+
+_global = Metrics()
+
+
+def get_metrics() -> Metrics:
+    return _global
