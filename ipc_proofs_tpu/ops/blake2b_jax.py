@@ -82,23 +82,25 @@ def _g(a, b, c, d, mx, my):
     return a, b, c, d
 
 
-def _compress(h_lo, h_hi, block, t_lo, f_word):
+def _compress(h_lo, h_hi, m_lo, m_hi, t_lo, f_word, tables=None):
     """One compression for the whole batch.
 
-    h: [N, 8] pairs; block: [N, 32] u32; t_lo: [N] byte counters
+    h: [N, 8] pairs; m: [N, 16] message-word pairs; t_lo: [N] byte counters
     (messages < 4 GiB, so the u64 counter's high word is 0);
-    f_word: [N] all-ones where final block.
+    f_word: [N] all-ones where final block. ``tables`` optionally supplies
+    ``(iv_lo, iv_hi, sigma)`` as traced arrays (Pallas kernels cannot close
+    over array constants).
     """
-    m_lo = block[:, 0::2]  # [N, 16]
-    m_hi = block[:, 1::2]
+    if tables is None:
+        iv_lo, iv_hi, sigma = jnp.asarray(_IV_LO), jnp.asarray(_IV_HI), jnp.asarray(_SIGMA)
+    else:
+        iv_lo, iv_hi, sigma = tables
     batch = h_lo.shape[0]
-    v_lo = jnp.concatenate([h_lo, jnp.broadcast_to(jnp.asarray(_IV_LO), (batch, 8))], axis=1)
-    v_hi = jnp.concatenate([h_hi, jnp.broadcast_to(jnp.asarray(_IV_HI), (batch, 8))], axis=1)
+    v_lo = jnp.concatenate([h_lo, jnp.broadcast_to(iv_lo, (batch, 8))], axis=1)
+    v_hi = jnp.concatenate([h_hi, jnp.broadcast_to(iv_hi, (batch, 8))], axis=1)
     v_lo = v_lo.at[:, 12].set(v_lo[:, 12] ^ t_lo)
     v_lo = v_lo.at[:, 14].set(v_lo[:, 14] ^ f_word)
     v_hi = v_hi.at[:, 14].set(v_hi[:, 14] ^ f_word)
-
-    sigma = jnp.asarray(_SIGMA)
 
     def round_fn(r, v):
         v_lo, v_hi = v
@@ -161,7 +163,7 @@ def blake2b256_blocks(blocks, n_blocks, lengths):
         is_last = idx == n_blocks - 1
         t_lo = jnp.where(is_last, lengths, (idx + 1) * BLOCK_BYTES).astype(jnp.uint32)
         f_word = jnp.where(is_last, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-        new_lo, new_hi = _compress(lo, hi, block, t_lo, f_word)
+        new_lo, new_hi = _compress(lo, hi, block[:, 0::2], block[:, 1::2], t_lo, f_word)
         mask = active[:, None]
         return (jnp.where(mask, new_lo, lo), jnp.where(mask, new_hi, hi)), None
 

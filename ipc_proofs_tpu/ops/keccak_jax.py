@@ -70,8 +70,19 @@ def _rotl64_const(lo, hi, rot: np.ndarray):
     return (low << m) | carry_h, (high << m) | carry_l
 
 
-def keccak_f1600_batch(lo, hi):
-    """keccak-f[1600] over a batch: ``lo``/``hi`` are uint32 [N, 25]."""
+def keccak_f1600_batch(lo, hi, tables=None):
+    """keccak-f[1600] over a batch: ``lo``/``hi`` are uint32 [N, 25].
+
+    ``tables`` optionally supplies ``(idx_x, perm_src, perm_rot, rc_lo,
+    rc_hi)`` as traced arrays — Pallas kernels may not close over array
+    constants, so they thread the tables through as kernel inputs. The
+    default (None) uses the module's numpy constants (XLA folds them).
+    """
+    if tables is None:
+        idx_x, perm_src, perm_rot = _IDX_X, _PERM_SRC, _PERM_ROT
+        rc_lo, rc_hi = jnp.asarray(_RC_LO), jnp.asarray(_RC_HI)
+    else:
+        idx_x, perm_src, perm_rot, rc_lo, rc_hi = tables
 
     def round_fn(r, state):
         a_lo, a_hi = state
@@ -80,15 +91,17 @@ def keccak_f1600_batch(lo, hi):
         a_hi5 = a_hi.reshape(-1, 5, 5)
         c_lo = a_lo5[:, 0] ^ a_lo5[:, 1] ^ a_lo5[:, 2] ^ a_lo5[:, 3] ^ a_lo5[:, 4]
         c_hi = a_hi5[:, 0] ^ a_hi5[:, 1] ^ a_hi5[:, 2] ^ a_hi5[:, 3] ^ a_hi5[:, 4]
-        rot1_lo, rot1_hi = _rotl64_const(
-            jnp.roll(c_lo, -1, axis=-1), jnp.roll(c_hi, -1, axis=-1), np.ones(5, np.int32)
-        )
+        # rotl by 1 (static, uniform across lanes)
+        cr_lo = jnp.roll(c_lo, -1, axis=-1)
+        cr_hi = jnp.roll(c_hi, -1, axis=-1)
+        rot1_lo = (cr_lo << 1) | (cr_hi >> 31)
+        rot1_hi = (cr_hi << 1) | (cr_lo >> 31)
         d_lo = jnp.roll(c_lo, 1, axis=-1) ^ rot1_lo
         d_hi = jnp.roll(c_hi, 1, axis=-1) ^ rot1_hi
-        a_lo = a_lo ^ d_lo[:, _IDX_X]
-        a_hi = a_hi ^ d_hi[:, _IDX_X]
-        # rho + pi: one gather + constant-rotation
-        b_lo, b_hi = _rotl64_const(a_lo[:, _PERM_SRC], a_hi[:, _PERM_SRC], _PERM_ROT)
+        a_lo = a_lo ^ d_lo[:, idx_x]
+        a_hi = a_hi ^ d_hi[:, idx_x]
+        # rho + pi: one gather + per-lane rotation
+        b_lo, b_hi = _rotl64_const(a_lo[:, perm_src], a_hi[:, perm_src], perm_rot)
         # chi over rows: a[x] = b[x] ^ (~b[x+1] & b[x+2])
         b_lo5 = b_lo.reshape(-1, 5, 5)
         b_hi5 = b_hi.reshape(-1, 5, 5)
@@ -99,8 +112,8 @@ def keccak_f1600_batch(lo, hi):
             b_hi5 ^ (~jnp.roll(b_hi5, -1, axis=2) & jnp.roll(b_hi5, -2, axis=2))
         ).reshape(-1, 25)
         # iota
-        a_lo = a_lo.at[:, 0].set(a_lo[:, 0] ^ jnp.asarray(_RC_LO)[r])
-        a_hi = a_hi.at[:, 0].set(a_hi[:, 0] ^ jnp.asarray(_RC_HI)[r])
+        a_lo = a_lo.at[:, 0].set(a_lo[:, 0] ^ rc_lo[r])
+        a_hi = a_hi.at[:, 0].set(a_hi[:, 0] ^ rc_hi[r])
         return a_lo, a_hi
 
     return lax.fori_loop(0, 24, round_fn, (lo, hi))

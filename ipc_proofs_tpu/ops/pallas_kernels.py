@@ -1,0 +1,190 @@
+"""Pallas TPU kernels for the single-block hash fast paths.
+
+The overwhelmingly common shapes in this workload are single-block:
+- keccak256 preimages are 64-byte mapping-slot keys and short event
+  signatures (≤ 135 bytes ⇒ one rate block);
+- most IPLD witness nodes are ≤ 128 bytes ⇒ one blake2b block (larger
+  blocks use the XLA `lax.scan` kernels in `keccak_jax`/`blake2b_jax`).
+
+Each kernel tiles the batch over a 1-D grid ([TILE, lanes] blocks resident
+in VMEM) and reuses the exact round logic of the XLA kernels — so the
+Pallas and XLA paths cannot drift. On non-TPU hosts the kernels run in
+interpreter mode (CI equivalence tests); callers should fall back to the
+XLA kernels if Mosaic rejects a shape at runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipc_proofs_tpu.ops.blake2b_jax import _IV_HI, _IV_LO, _PARAM_WORD0, _SIGMA, _compress
+from ipc_proofs_tpu.ops.keccak_jax import (
+    _IDX_X,
+    _PERM_ROT,
+    _PERM_SRC,
+    _RC_HI,
+    _RC_LO,
+    keccak_f1600_batch,
+)
+
+__all__ = [
+    "keccak256_single_block_pallas",
+    "blake2b256_single_block_pallas",
+    "pack_single_block_keccak",
+    "pack_single_block_blake2b",
+]
+
+TILE = 256
+
+
+def _digest_columns(lo, hi):
+    return jnp.stack(
+        [lo[:, 0], hi[:, 0], lo[:, 1], hi[:, 1], lo[:, 2], hi[:, 2], lo[:, 3], hi[:, 3]],
+        axis=1,
+    )
+
+
+def _keccak_kernel(blo_ref, bhi_ref, idx_x_ref, perm_ref, rot_ref, rclo_ref, rchi_ref, out_ref):
+    tile = blo_ref.shape[0]
+    lo = jnp.zeros((tile, 25), dtype=jnp.uint32).at[:, :17].set(blo_ref[:])
+    hi = jnp.zeros((tile, 25), dtype=jnp.uint32).at[:, :17].set(bhi_ref[:])
+    tables = (idx_x_ref[:], perm_ref[:], rot_ref[:], rclo_ref[:], rchi_ref[:])
+    lo, hi = keccak_f1600_batch(lo, hi, tables=tables)
+    out_ref[:] = _digest_columns(lo, hi)
+
+
+def _blake2b_kernel(mlo_ref, mhi_ref, len_ref, ivlo_ref, ivhi_ref, sigma_ref, out_ref):
+    tile = mlo_ref.shape[0]
+    iv_lo = ivlo_ref[:]
+    iv_hi = ivhi_ref[:]
+    h_lo = jnp.broadcast_to(iv_lo, (tile, 8)).astype(jnp.uint32)
+    h_lo = h_lo.at[:, 0].set(h_lo[:, 0] ^ jnp.uint32(_PARAM_WORD0))
+    h_hi = jnp.broadcast_to(iv_hi, (tile, 8)).astype(jnp.uint32)
+    t_lo = len_ref[:, 0].astype(jnp.uint32)
+    f_word = jnp.full((tile,), 0xFFFFFFFF, dtype=jnp.uint32)
+    h_lo, h_hi = _compress(
+        h_lo, h_hi, mlo_ref[:], mhi_ref[:], t_lo, f_word,
+        tables=(iv_lo, iv_hi, sigma_ref[:]),
+    )
+    out_ref[:] = _digest_columns(h_lo, h_hi)
+
+
+def _interpret_default() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def keccak256_single_block_pallas(blocks_lo, blocks_hi, interpret: bool = False):
+    """Batch keccak256 for one-rate-block messages.
+
+    Args: blocks_lo/blocks_hi uint32 [N, 17] (padded rate block, N % TILE == 0).
+    Returns uint32 [N, 8] digests.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = blocks_lo.shape[0]
+    table_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _keccak_kernel,
+        grid=(n // TILE,),
+        in_specs=[
+            pl.BlockSpec((TILE, 17), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, 17), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            table_spec, table_spec, table_spec, table_spec, table_spec,
+        ],
+        out_specs=pl.BlockSpec((TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 8), jnp.uint32),
+        interpret=interpret,
+    )(
+        blocks_lo,
+        blocks_hi,
+        jnp.asarray(_IDX_X),
+        jnp.asarray(_PERM_SRC),
+        jnp.asarray(_PERM_ROT),
+        jnp.asarray(_RC_LO),
+        jnp.asarray(_RC_HI),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def blake2b256_single_block_pallas(m_lo, m_hi, lengths, interpret: bool = False):
+    """Batch blake2b-256 for single-block (≤ 128 byte) messages.
+
+    Args: m_lo/m_hi uint32 [N, 16]; lengths int32 [N, 1]. N % TILE == 0.
+    Returns uint32 [N, 8] digests.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = m_lo.shape[0]
+    table_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _blake2b_kernel,
+        grid=(n // TILE,),
+        in_specs=[
+            pl.BlockSpec((TILE, 16), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, 16), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            table_spec, table_spec, table_spec,
+        ],
+        out_specs=pl.BlockSpec((TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 8), jnp.uint32),
+        interpret=interpret,
+    )(
+        m_lo,
+        m_hi,
+        lengths,
+        jnp.asarray(_IV_LO),
+        jnp.asarray(_IV_HI),
+        jnp.asarray(_SIGMA),
+    )
+
+
+# --- host-side packing (single-block, de-interleaved, TILE-padded) ----------
+
+
+def pack_single_block_keccak(messages: "list[bytes]"):
+    """Pad ≤135-byte messages into de-interleaved keccak rate blocks.
+
+    Returns (blocks_lo u32[Np, 17], blocks_hi u32[Np, 17], n) where
+    Np is n rounded up to TILE.
+    """
+    n = len(messages)
+    n_pad = ((n + TILE - 1) // TILE) * TILE
+    raw = np.zeros((n_pad, 136), dtype=np.uint8)
+    for i, msg in enumerate(messages):
+        if len(msg) >= 136:
+            raise ValueError("single-block keccak kernel requires len < 136")
+        raw[i, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+        raw[i, len(msg)] ^= 0x01
+        raw[i, 135] ^= 0x80
+    words = raw.view(np.uint32).reshape(n_pad, 34)
+    return np.ascontiguousarray(words[:, 0::2]), np.ascontiguousarray(words[:, 1::2]), n
+
+
+def pack_single_block_blake2b(messages: "list[bytes]"):
+    """Pad ≤128-byte messages into de-interleaved blake2b blocks.
+
+    Returns (m_lo u32[Np, 16], m_hi u32[Np, 16], lengths i32[Np, 1], n).
+    """
+    n = len(messages)
+    n_pad = ((n + TILE - 1) // TILE) * TILE
+    raw = np.zeros((n_pad, 128), dtype=np.uint8)
+    lengths = np.zeros((n_pad, 1), dtype=np.int32)
+    for i, msg in enumerate(messages):
+        if len(msg) > 128:
+            raise ValueError("single-block blake2b kernel requires len <= 128")
+        raw[i, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+        lengths[i, 0] = len(msg)
+    words = raw.view(np.uint32).reshape(n_pad, 32)
+    return (
+        np.ascontiguousarray(words[:, 0::2]),
+        np.ascontiguousarray(words[:, 1::2]),
+        lengths,
+        n,
+    )

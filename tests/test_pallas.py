@@ -1,0 +1,81 @@
+"""Pallas kernel equivalence tests (interpreter mode on CPU hosts)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256  # noqa: E402
+from ipc_proofs_tpu.ops.pack import digests_to_bytes  # noqa: E402
+from ipc_proofs_tpu.ops.pallas_kernels import (  # noqa: E402
+    blake2b256_single_block_pallas,
+    keccak256_single_block_pallas,
+    pack_single_block_blake2b,
+    pack_single_block_keccak,
+)
+
+INTERPRET = jax.devices()[0].platform != "tpu"
+
+KECCAK_MSGS = [
+    b"",
+    b"abc",
+    b"Transfer(address,address,uint256)",
+    b"\xaa" * 64,  # mapping-slot preimage shape
+    b"\x42" * 135,  # max single-block
+]
+
+BLAKE_MSGS = [b"", b"abc", b"\x11" * 64, b"\x22" * 127, b"\x33" * 128]
+
+
+class TestPallasKeccak:
+    def test_matches_golden(self):
+        blo, bhi, n = pack_single_block_keccak(KECCAK_MSGS)
+        out = keccak256_single_block_pallas(
+            jnp.asarray(blo), jnp.asarray(bhi), interpret=INTERPRET
+        )
+        digests = digests_to_bytes(out[:n])
+        for msg, digest in zip(KECCAK_MSGS, digests):
+            assert digest == keccak256(msg), f"len={len(msg)}"
+
+    def test_rejects_multiblock(self):
+        with pytest.raises(ValueError):
+            pack_single_block_keccak([b"\x00" * 136])
+
+    def test_full_tile_batch(self):
+        msgs = [f"slot-{i}".encode() * 3 for i in range(300)]
+        blo, bhi, n = pack_single_block_keccak(msgs)
+        assert blo.shape[0] == 512  # padded to TILE multiple
+        out = keccak256_single_block_pallas(
+            jnp.asarray(blo), jnp.asarray(bhi), interpret=INTERPRET
+        )
+        digests = digests_to_bytes(out[:n])
+        for msg, digest in zip(msgs, digests):
+            assert digest == keccak256(msg)
+
+
+class TestPallasBlake2b:
+    def test_matches_golden(self):
+        mlo, mhi, lengths, n = pack_single_block_blake2b(BLAKE_MSGS)
+        out = blake2b256_single_block_pallas(
+            jnp.asarray(mlo), jnp.asarray(mhi), jnp.asarray(lengths), interpret=INTERPRET
+        )
+        digests = digests_to_bytes(out[:n])
+        for msg, digest in zip(BLAKE_MSGS, digests):
+            assert digest == blake2b_256(msg), f"len={len(msg)}"
+
+    def test_rejects_multiblock(self):
+        with pytest.raises(ValueError):
+            pack_single_block_blake2b([b"\x00" * 129])
+
+    def test_cid_digest_batch(self):
+        from ipc_proofs_tpu.core.cid import CID
+
+        payloads = [f"ipld-node-{i}".encode() * 2 for i in range(64)]
+        mlo, mhi, lengths, n = pack_single_block_blake2b(payloads)
+        out = blake2b256_single_block_pallas(
+            jnp.asarray(mlo), jnp.asarray(mhi), jnp.asarray(lengths), interpret=INTERPRET
+        )
+        digests = digests_to_bytes(out[:n])
+        for payload, digest in zip(payloads, digests):
+            assert CID.hash_of(payload).digest == digest
