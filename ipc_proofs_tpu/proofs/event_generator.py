@@ -7,8 +7,8 @@ Reference parity: `generate_event_proof` / `find_matching_events`
 2. base witness: parent header CIDs + child header + receipts root + TxMeta
    CIDs; full TxMeta AMT walks recorded (execution-order witness);
 3. canonical execution order (BLS-before-secp, first-seen dedup);
-4. PASS 1: scan every receipt's events AMT under a throwaway recorder,
-   applying the actor filter then the topic match — only *indices* survive;
+4. PASS 1: scan every receipt's events AMT without recording, applying the
+   actor filter then the topic match — only *indices* survive;
 5. PASS 2: re-touch only matching receipts and their event AMTs under
    recording stores, emitting claims;
 6. materialize the deduplicated witness.
@@ -20,10 +20,11 @@ Redesign notes (TPU-first):
 - receipts come from the receipts AMT itself rather than a
   `ChainGetParentReceipts` JSON side-channel, so generation is
   blockstore-pure and hermetically testable;
-- pass 1's decode loop batches all (receipt, event) pairs and hands the
-  topic/emitter predicate to a pluggable `BatchHashBackend`
-  (CPU scalar default; TPU mask kernel), the seam BASELINE.json's
-  north star prescribes.
+- the phases are exposed as composable functions (`collect_base_witness`,
+  `scan_receipt_events`, `match_receipt_indices`, `record_matching_receipts`)
+  so the multi-tipset range driver (`proofs/range.py`) can batch pass 1 of
+  MANY tipset pairs into one device call — the seam BASELINE.json's north
+  star prescribes.
 """
 
 from __future__ import annotations
@@ -34,7 +35,7 @@ from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.ipld.amt import AMT
 from ipc_proofs_tpu.proofs.bundle import EventData, EventProof, EventProofBundle
 from ipc_proofs_tpu.proofs.chain import Tipset
-from ipc_proofs_tpu.proofs.exec_order import build_execution_order
+from ipc_proofs_tpu.proofs.exec_order import build_execution_order, decode_txmeta
 from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.events import (
     Receipt,
@@ -45,7 +46,14 @@ from ipc_proofs_tpu.state.events import (
 )
 from ipc_proofs_tpu.store.blockstore import Blockstore, RecordingBlockstore
 
-__all__ = ["EventMatcher", "generate_event_proof"]
+__all__ = [
+    "EventMatcher",
+    "generate_event_proof",
+    "collect_base_witness",
+    "scan_receipt_events",
+    "match_receipt_indices",
+    "record_matching_receipts",
+]
 
 
 class EventMatcher:
@@ -63,26 +71,13 @@ class EventMatcher:
         )
 
 
-def generate_event_proof(
-    store: Blockstore,
-    parent: Tipset,
-    child: Tipset,
-    event_signature: str,
-    topic_1: str,
-    actor_id_filter: Optional[int] = None,
-    match_backend=None,
-) -> EventProofBundle:
-    """Generate proofs for every event matching (signature, topic_1, emitter).
-
-    ``match_backend``: optional `BatchHashBackend` used to evaluate the
-    predicate over all decoded events at once (pass 1); None = scalar path.
-    """
-    matcher = EventMatcher(event_signature, topic_1)
+def collect_base_witness(
+    collector: WitnessCollector, store: Blockstore, parent: Tipset, child: Tipset
+) -> None:
+    """Seed the witness: headers, receipts root, TxMeta CIDs, and the full
+    TxMeta AMT walks needed to reconstruct execution order offline."""
     child_cid = child.cids[0]
     receipts_root = child.blocks[0].parent_message_receipts
-
-    # Step 2: base witness (headers + TxMeta CIDs + full TxMeta AMT walks).
-    collector = WitnessCollector(store)
     for parent_cid in parent.cids:
         collector.add_cid(parent_cid)
     collector.add_cid(child_cid)
@@ -95,90 +90,85 @@ def generate_event_proof(
         tx_raw = tx_recorder.get(header.messages)
         if tx_raw is None:
             raise KeyError(f"missing TxMeta {header.messages}")
-        from ipc_proofs_tpu.proofs.exec_order import decode_txmeta
-
         bls_root, secp_root = decode_txmeta(tx_raw)
         AMT.load(tx_recorder, bls_root, expected_version=0).for_each(lambda i, v: None)
         AMT.load(tx_recorder, secp_root, expected_version=0).for_each(lambda i, v: None)
     collector.collect_from_recording(tx_recorder)
 
-    # Step 3: canonical execution order.
-    exec_order = build_execution_order(store, parent)
 
-    # Steps 4-5: two-pass filter.
-    proofs, event_recordings = _find_matching_events(
-        store,
-        parent,
-        child,
-        child_cid,
-        receipts_root,
-        exec_order,
-        matcher,
-        actor_id_filter,
-        match_backend,
-    )
-    collector.collect_from_recordings(event_recordings)
-
-    # Step 6: materialize.
-    blocks = collector.materialize()
-    return EventProofBundle(proofs=proofs, blocks=blocks)
-
-
-def _decode_stamped(value) -> StampedEvent:
-    return StampedEvent.from_cbor(value)
-
-
-def _find_matching_events(
-    store: Blockstore,
-    parent: Tipset,
-    child: Tipset,
-    child_cid: CID,
-    receipts_root: CID,
-    exec_order: list[CID],
-    matcher: EventMatcher,
-    actor_id_filter: Optional[int],
-    match_backend,
-) -> tuple[list[EventProof], list[RecordingBlockstore]]:
-    proofs: list[EventProof] = []
-    event_recordings: list[RecordingBlockstore] = []
-
-    # Receipts AMT under a recorder — paths are only recorded when pass 2
-    # touches them via get() (reference events/generator.rs:195-196,249).
-    receipts_recorder = RecordingBlockstore(store)
-    receipts_amt = AMT.load(receipts_recorder, receipts_root, expected_version=0)
-
-    # PASS 1: find matching receipt indices without recording anything.
-    # Enumerate receipts from a NON-recording view of the same AMT.
-    plain_receipts = AMT.load(store, receipts_root, expected_version=0)
-    matching_indices: list[int] = []
-    for i, receipt_cbor in plain_receipts.items():
+def scan_receipt_events(
+    store: Blockstore, receipts_root: CID
+) -> list[tuple[int, Receipt, list[StampedEvent]]]:
+    """PASS 1 decode leg: enumerate (exec_index, receipt, events) without
+    recording anything. Receipts without an events root are skipped."""
+    scanned = []
+    receipts_amt = AMT.load(store, receipts_root, expected_version=0)
+    for i, receipt_cbor in receipts_amt.items():
         receipt = Receipt.from_cbor(receipt_cbor)
         if receipt.events_root is None:
             continue
-        throwaway = RecordingBlockstore(store)
-        events_amt = AMT.load(throwaway, receipt.events_root, expected_version=3)
+        events_amt = AMT.load(store, receipt.events_root, expected_version=3)
+        events = [StampedEvent.from_cbor(v) for _, v in events_amt.items()]
+        scanned.append((i, receipt, events))
+    return scanned
 
-        if match_backend is not None:
-            stamped = [(_decode_stamped(v)) for _, v in events_amt.items()]
-            if match_backend.any_event_matches(
-                stamped, matcher.topic0, matcher.topic1, actor_id_filter
-            ):
-                matching_indices.append(i)
-            continue
 
-        has_matching = False
-        for _, stamped_cbor in events_amt.items():
-            stamped = _decode_stamped(stamped_cbor)
+def match_receipt_indices(
+    scanned: list[tuple[int, Receipt, list[StampedEvent]]],
+    matcher: EventMatcher,
+    actor_id_filter: Optional[int],
+    match_backend=None,
+) -> list[int]:
+    """PASS 1 predicate leg: which receipt indices contain ≥1 matching event.
+
+    With a backend, ALL events are evaluated in one batched mask call; the
+    scalar path short-circuits per receipt like the reference."""
+    if match_backend is not None:
+        flat: list[StampedEvent] = []
+        owners: list[int] = []
+        for pos, (_, _, events) in enumerate(scanned):
+            flat.extend(events)
+            owners.extend([pos] * len(events))
+        if not flat:
+            return []
+        mask = match_backend.event_match_mask(
+            flat, matcher.topic0, matcher.topic1, actor_id_filter
+        )
+        hit_positions = {owners[k] for k, hit in enumerate(mask) if hit}
+        return [scanned[pos][0] for pos in sorted(hit_positions)]
+
+    matching = []
+    for i, _, events in scanned:
+        for stamped in events:
             if actor_id_filter is not None and stamped.emitter != actor_id_filter:
                 continue
             log = extract_evm_log(stamped.event)
             if log is not None and matcher.matches_log(log):
-                has_matching = True
-                break  # pass 1 only needs existence (reference sets a flag)
-        if has_matching:
-            matching_indices.append(i)
+                matching.append(i)
+                break
+    return matching
 
-    # PASS 2: touch only matching receipts; record their paths + event AMTs.
+
+def record_matching_receipts(
+    store: Blockstore,
+    parent: Tipset,
+    child: Tipset,
+    exec_order: list[CID],
+    matching_indices: list[int],
+    matcher: EventMatcher,
+    actor_id_filter: Optional[int],
+) -> tuple[list[EventProof], list[RecordingBlockstore]]:
+    """PASS 2: touch only matching receipts under recording stores; emit
+    claims for each matching event."""
+    child_cid = child.cids[0]
+    receipts_root = child.blocks[0].parent_message_receipts
+
+    proofs: list[EventProof] = []
+    recordings: list[RecordingBlockstore] = []
+
+    receipts_recorder = RecordingBlockstore(store)
+    receipts_amt = AMT.load(receipts_recorder, receipts_root, expected_version=0)
+
     for i in matching_indices:
         if i >= len(exec_order):
             raise KeyError(f"missing message at execution index {i}")
@@ -193,7 +183,7 @@ def _find_matching_events(
         events_recorder = RecordingBlockstore(store)
         events_amt = AMT.load(events_recorder, receipt.events_root, expected_version=3)
         for j, stamped_cbor in events_amt.items():
-            stamped = _decode_stamped(stamped_cbor)
+            stamped = StampedEvent.from_cbor(stamped_cbor)
             if actor_id_filter is not None and stamped.emitter != actor_id_filter:
                 continue
             log = extract_evm_log(stamped.event)
@@ -215,7 +205,40 @@ def _find_matching_events(
                     ),
                 )
             )
-        event_recordings.append(events_recorder)
+        recordings.append(events_recorder)
 
-    event_recordings.append(receipts_recorder)
-    return proofs, event_recordings
+    recordings.append(receipts_recorder)
+    return proofs, recordings
+
+
+def generate_event_proof(
+    store: Blockstore,
+    parent: Tipset,
+    child: Tipset,
+    event_signature: str,
+    topic_1: str,
+    actor_id_filter: Optional[int] = None,
+    match_backend=None,
+) -> EventProofBundle:
+    """Generate proofs for every event matching (signature, topic_1, emitter).
+
+    ``match_backend``: optional `BatchHashBackend` used to evaluate the
+    predicate over all decoded events at once (pass 1); None = scalar path.
+    """
+    matcher = EventMatcher(event_signature, topic_1)
+    receipts_root = child.blocks[0].parent_message_receipts
+
+    collector = WitnessCollector(store)
+    collect_base_witness(collector, store, parent, child)
+
+    exec_order = build_execution_order(store, parent)
+
+    scanned = scan_receipt_events(store, receipts_root)
+    matching_indices = match_receipt_indices(scanned, matcher, actor_id_filter, match_backend)
+    proofs, recordings = record_matching_receipts(
+        store, parent, child, exec_order, matching_indices, matcher, actor_id_filter
+    )
+    collector.collect_from_recordings(recordings)
+
+    blocks = collector.materialize()
+    return EventProofBundle(proofs=proofs, blocks=blocks)

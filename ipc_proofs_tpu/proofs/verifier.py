@@ -27,7 +27,26 @@ def verify_proof_bundle(
     trust_policy: TrustPolicy,
     event_filter: Optional[Callable[[ActorEvent], bool]] = None,
     verify_witness_cids: bool = False,
+    cid_backend=None,
 ) -> UnifiedVerificationResult:
+    """Verify all proofs in ``bundle`` under ``trust_policy``.
+
+    ``verify_witness_cids`` recomputes every witness block's CID — the
+    explicit integrity check the reference skips. With ``cid_backend`` (a
+    `BatchHashBackend`) the recomputation runs as ONE batch (C++ or TPU,
+    BASELINE.json config 4); otherwise it happens scalar on load. Raises
+    ValueError on any mismatching block.
+    """
+    if verify_witness_cids and cid_backend is not None:
+        from ipc_proofs_tpu.core.cid import BLAKE2B_256
+
+        batch = [b for b in bundle.blocks if b.cid.mh_code == BLAKE2B_256]
+        if batch and not cid_backend.verify_block_cids(
+            [b.cid.digest for b in batch], [b.data for b in batch]
+        ):
+            raise ValueError("witness block bytes do not hash to their claimed CIDs")
+        # non-blake2b blocks (rare) still verify scalar below
+        verify_witness_cids = any(b.cid.mh_code != BLAKE2B_256 for b in bundle.blocks)
     def child_verifier(epoch, cid):
         try:
             return trust_policy.verify_child_header(epoch, cid)

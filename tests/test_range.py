@@ -1,0 +1,114 @@
+"""Multi-tipset range driver tests: batched pass 1, merged witness, and
+backend-accelerated witness CID verification."""
+
+import pytest
+
+from ipc_proofs_tpu.backend import get_backend
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.bundle import ProofBlock
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import TipsetPair, generate_event_proofs_for_range
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "range-subnet"
+ACTOR = 777
+
+
+def _make_range(n_pairs=4, store=None):
+    """n_pairs independent synthetic worlds sharing one blockstore."""
+    bs = store or MemoryBlockstore()
+    pairs = []
+    expected = 0
+    for p in range(n_pairs):
+        events = [
+            [EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET,
+                          data=p.to_bytes(32, "big"))] if p % 2 == 0 else [],
+            [EventFixture(emitter=ACTOR, signature="Noise()", topic1=SUBNET)],
+        ]
+        if p % 2 == 0:
+            expected += 1
+        world = build_chain(
+            [ContractFixture(actor_id=ACTOR)],
+            events,
+            parent_height=100 + 2 * p,
+            store=bs,
+        )
+        pairs.append(TipsetPair(parent=world.parent, child=world.child))
+    return bs, pairs, expected
+
+
+class TestRangeDriver:
+    def test_scalar_and_backend_agree(self):
+        bs, pairs, expected = _make_range(6)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        scalar = generate_event_proofs_for_range(bs, pairs, spec, match_backend=None)
+        cpu = generate_event_proofs_for_range(bs, pairs, spec, match_backend=get_backend("cpu"))
+        assert scalar.to_json() == cpu.to_json()
+        assert len(scalar.event_proofs) == expected
+
+    def test_backend_tpu_agrees(self):
+        pytest.importorskip("jax")
+        bs, pairs, _ = _make_range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        scalar = generate_event_proofs_for_range(bs, pairs, spec)
+        tpu = generate_event_proofs_for_range(bs, pairs, spec, match_backend=get_backend("tpu"))
+        assert scalar.to_json() == tpu.to_json()
+
+    def test_range_bundle_verifies(self):
+        bs, pairs, expected = _make_range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        bundle = generate_event_proofs_for_range(bs, pairs, spec)
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert result.event_results == [True] * expected
+        assert result.all_valid()
+
+    def test_witness_merged_and_deduped(self):
+        bs, pairs, _ = _make_range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        bundle = generate_event_proofs_for_range(bs, pairs, spec)
+        cids = [b.cid for b in bundle.blocks]
+        assert cids == sorted(cids)
+        assert len(cids) == len(set(cids))
+
+    def test_metrics_populated(self):
+        bs, pairs, expected = _make_range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        metrics = Metrics()
+        generate_event_proofs_for_range(
+            bs, pairs, spec, match_backend=get_backend("cpu"), metrics=metrics
+        )
+        snap = metrics.snapshot()
+        assert snap["counters"]["range_proofs"] == expected
+        assert snap["counters"]["range_events"] > 0
+        assert {"range_scan", "range_match", "range_record"} <= set(snap["timers"])
+
+
+class TestBatchCidVerification:
+    def test_batch_backend_accepts_valid(self):
+        bs, pairs, _ = _make_range(2)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        bundle = generate_event_proofs_for_range(bs, pairs, spec)
+        result = verify_proof_bundle(
+            bundle,
+            TrustPolicy.accept_all(),
+            verify_witness_cids=True,
+            cid_backend=get_backend("cpu"),
+        )
+        assert result.all_valid()
+
+    def test_batch_backend_rejects_tampered(self):
+        bs, pairs, _ = _make_range(2)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        bundle = generate_event_proofs_for_range(bs, pairs, spec)
+        bundle.blocks[0] = ProofBlock(cid=bundle.blocks[0].cid, data=b"\x82\x00\x01")
+        with pytest.raises(ValueError):
+            verify_proof_bundle(
+                bundle,
+                TrustPolicy.accept_all(),
+                verify_witness_cids=True,
+                cid_backend=get_backend("cpu"),
+            )
