@@ -1,0 +1,106 @@
+"""Batch storage-proof driver tests."""
+
+import pytest
+
+from ipc_proofs_tpu.backend import get_backend
+from ipc_proofs_tpu.fixtures import ContractFixture, build_chain
+from ipc_proofs_tpu.proofs.generator import StorageProofSpec, generate_proof_bundle
+from ipc_proofs_tpu.proofs.storage_batch import (
+    MappingSlotSpec,
+    generate_storage_proofs_batch,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.state.storage import calculate_storage_slot
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+def _world(n_contracts=3, n_slots=5):
+    contracts = []
+    for c in range(n_contracts):
+        actor_id = 1000 + c
+        storage = {
+            calculate_storage_slot(f"subnet-{c}-{s}", 0): (c * 16 + s + 1).to_bytes(1, "big")
+            for s in range(n_slots)
+        }
+        contracts.append(ContractFixture(actor_id=actor_id, storage=storage))
+    return build_chain(contracts, [[]]), n_contracts, n_slots
+
+
+class TestStorageBatch:
+    def _specs(self, n_contracts, n_slots):
+        return [
+            MappingSlotSpec(actor_id=1000 + c, key=f"subnet-{c}-{s}", slot_index=0)
+            for c in range(n_contracts)
+            for s in range(n_slots)
+        ]
+
+    def test_batch_matches_per_spec_generator(self):
+        world, nc, ns = _world()
+        specs = self._specs(nc, ns)
+        batch = generate_storage_proofs_batch(world.store, world.parent, world.child, specs)
+        # the one-at-a-time path (reference architecture)
+        singles = generate_proof_bundle(
+            world.store,
+            world.parent,
+            world.child,
+            [
+                StorageProofSpec(
+                    actor_id=s.actor_id, slot=calculate_storage_slot(s.key, s.slot_index)
+                )
+                for s in specs
+            ],
+            [],
+        )
+        assert [p.to_json_obj() for p in batch.storage_proofs] == [
+            p.to_json_obj() for p in singles.storage_proofs
+        ]
+        # merged witness must be identical too (same traversals, same dedup)
+        assert [str(b.cid) for b in batch.blocks] == [str(b.cid) for b in singles.blocks]
+
+    def test_batch_verifies(self):
+        world, nc, ns = _world()
+        specs = self._specs(nc, ns)
+        for backend in (None, get_backend("cpu")):
+            bundle = generate_storage_proofs_batch(
+                world.store, world.parent, world.child, specs, hash_backend=backend
+            )
+            result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+            assert result.storage_results == [True] * (nc * ns)
+
+    def test_tpu_backend_same_slots(self):
+        pytest.importorskip("jax")
+        world, nc, ns = _world(2, 3)
+        specs = self._specs(2, 3)
+        cpu = generate_storage_proofs_batch(
+            world.store, world.parent, world.child, specs, hash_backend=get_backend("cpu")
+        )
+        tpu = generate_storage_proofs_batch(
+            world.store, world.parent, world.child, specs, hash_backend=get_backend("tpu")
+        )
+        assert cpu.to_json() == tpu.to_json()
+
+    def test_absent_slots_prove_zero(self):
+        world, _, _ = _world(1, 1)
+        specs = [MappingSlotSpec(actor_id=1000, key="no-such-key", slot_index=9)]
+        bundle = generate_storage_proofs_batch(world.store, world.parent, world.child, specs)
+        assert bundle.storage_proofs[0].value == "0x" + "00" * 32
+        assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).all_valid()
+
+    def test_metrics(self):
+        world, nc, ns = _world()
+        metrics = Metrics()
+        generate_storage_proofs_batch(
+            world.store, world.parent, world.child, self._specs(nc, ns), metrics=metrics
+        )
+        snap = metrics.snapshot()
+        assert snap["counters"]["batch_slots"] == nc * ns
+        assert snap["counters"]["batch_contracts"] == nc
+
+    def test_raw_bytes_key(self):
+        world, _, _ = _world(1, 2)
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32
+
+        specs = [MappingSlotSpec(actor_id=1000, key=ascii_to_bytes32("subnet-0-0"))]
+        bundle = generate_storage_proofs_batch(world.store, world.parent, world.child, specs)
+        assert bundle.storage_proofs[0].value.endswith("01")
