@@ -14,14 +14,18 @@ import threading
 from pathlib import Path
 from typing import Optional
 
-__all__ = ["load_native", "NativeHashes"]
+__all__ = ["load_native", "NativeHashes", "load_dagcbor_ext"]
 
 _SRC = Path(__file__).parent / "hashes.cpp"
 _BUILD_DIR = Path(__file__).parent / "build"
 _SO_PATH = _BUILD_DIR / "libipchashes.so"
 
+_DAGCBOR_SRC = Path(__file__).parent / "dagcbor_ext.c"
+_DAGCBOR_SO = _BUILD_DIR / "ipc_dagcbor_ext.so"
+
 _lock = threading.Lock()
 _cached: "NativeHashes | None | bool" = False  # False = not attempted yet
+_dagcbor_cached: "object | None | bool" = False
 
 
 class NativeHashes:
@@ -86,6 +90,53 @@ def _build() -> Optional[Path]:
         return _SO_PATH
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return None
+
+
+def load_dagcbor_ext():
+    """Compile (if needed) and import the C DAG-CBOR decoder module.
+
+    Returns the extension module with ``decode``/``decode_many``/
+    ``set_cid_factory``, or None on any failure (callers fall back to the
+    pure-Python decoder).
+    """
+    global _dagcbor_cached
+    with _lock:
+        if _dagcbor_cached is not False:
+            return _dagcbor_cached
+        if os.environ.get("IPC_PROOFS_NO_NATIVE"):
+            _dagcbor_cached = None
+            return None
+        try:
+            import sysconfig
+
+            _BUILD_DIR.mkdir(exist_ok=True)
+            if not (
+                _DAGCBOR_SO.exists()
+                and _DAGCBOR_SO.stat().st_mtime >= _DAGCBOR_SRC.stat().st_mtime
+            ):
+                include = sysconfig.get_paths()["include"]
+                subprocess.run(
+                    [
+                        "gcc", "-O2", "-shared", "-fPIC",
+                        f"-I{include}",
+                        str(_DAGCBOR_SRC), "-o", str(_DAGCBOR_SO),
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("ipc_dagcbor_ext", _DAGCBOR_SO)
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+            from ipc_proofs_tpu.core.cid import CID  # deferred: avoids import cycle
+
+            module.set_cid_factory(CID.from_bytes)
+            _dagcbor_cached = module
+        except Exception:
+            _dagcbor_cached = None
+        return _dagcbor_cached
 
 
 def load_native() -> Optional[NativeHashes]:

@@ -1,0 +1,261 @@
+/* Fast DAG-CBOR decoder as a CPython extension.
+ *
+ * The pure-Python decoder (core/dagcbor.py) is the correctness reference;
+ * this module accelerates the bulk decode paths (witness loading, receipt/
+ * event scanning — the host Phase A of the range driver). pybind11 is not
+ * available in this environment, so it uses the raw CPython C API.
+ *
+ * CIDs (tag 42) are produced through a factory callable registered from
+ * Python (set_cid_factory), so the extension does not need to know the CID
+ * class layout.
+ *
+ * Build: g++/gcc -O2 -shared -fPIC -I<python-include> dagcbor_ext.c \
+ *        -o ipc_dagcbor_ext.so
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static PyObject *cid_factory = NULL; /* callable(bytes) -> CID */
+
+typedef struct {
+  const uint8_t *data;
+  Py_ssize_t len;
+  Py_ssize_t pos;
+} Parser;
+
+static PyObject *parse_item(Parser *p);
+
+static int parse_head(Parser *p, int *major, uint64_t *value) {
+  if (p->pos >= p->len) {
+    PyErr_SetString(PyExc_ValueError, "truncated CBOR head");
+    return -1;
+  }
+  uint8_t byte = p->data[p->pos++];
+  *major = byte >> 5;
+  uint8_t info = byte & 0x1f;
+  if (info < 24) {
+    *value = info;
+    return 0;
+  }
+  int extra;
+  switch (info) {
+    case 24: extra = 1; break;
+    case 25: extra = 2; break;
+    case 26: extra = 4; break;
+    case 27: extra = 8; break;
+    default:
+      PyErr_SetString(PyExc_ValueError,
+                      "indefinite/reserved CBOR length not allowed in DAG-CBOR");
+      return -1;
+  }
+  if (p->pos + extra > p->len) {
+    PyErr_SetString(PyExc_ValueError, "truncated CBOR head");
+    return -1;
+  }
+  uint64_t v = 0;
+  for (int i = 0; i < extra; i++) v = (v << 8) | p->data[p->pos++];
+  *value = v;
+  /* return the info bits so float64 can be distinguished */
+  return info;
+}
+
+static PyObject *parse_item(Parser *p) {
+  int major;
+  uint64_t value;
+  int info = parse_head(p, &major, &value);
+  if (info < 0) return NULL;
+
+  switch (major) {
+    case 0: /* uint */
+      return PyLong_FromUnsignedLongLong(value);
+    case 1: /* negint: -1 - value */
+      if (value <= (uint64_t)INT64_MAX) {
+        return PyLong_FromLongLong(-1 - (int64_t)value);
+      } else {
+        PyObject *v = PyLong_FromUnsignedLongLong(value);
+        if (!v) return NULL;
+        PyObject *minus_one = PyLong_FromLong(-1);
+        PyObject *result = PyNumber_Subtract(minus_one, v);
+        Py_DECREF(minus_one);
+        Py_DECREF(v);
+        return result;
+      }
+    case 2: { /* bytes */
+      if (p->pos + (Py_ssize_t)value > p->len) {
+        PyErr_SetString(PyExc_ValueError, "truncated CBOR bytes");
+        return NULL;
+      }
+      PyObject *b = PyBytes_FromStringAndSize((const char *)p->data + p->pos,
+                                              (Py_ssize_t)value);
+      p->pos += (Py_ssize_t)value;
+      return b;
+    }
+    case 3: { /* text */
+      if (p->pos + (Py_ssize_t)value > p->len) {
+        PyErr_SetString(PyExc_ValueError, "truncated CBOR text");
+        return NULL;
+      }
+      PyObject *s = PyUnicode_DecodeUTF8((const char *)p->data + p->pos,
+                                         (Py_ssize_t)value, NULL);
+      p->pos += (Py_ssize_t)value;
+      return s;
+    }
+    case 4: { /* array */
+      if ((uint64_t)p->len - p->pos < value) { /* cheap DoS guard */
+        PyErr_SetString(PyExc_ValueError, "CBOR array length exceeds input");
+        return NULL;
+      }
+      PyObject *list = PyList_New((Py_ssize_t)value);
+      if (!list) return NULL;
+      for (Py_ssize_t i = 0; i < (Py_ssize_t)value; i++) {
+        PyObject *item = parse_item(p);
+        if (!item) {
+          Py_DECREF(list);
+          return NULL;
+        }
+        PyList_SET_ITEM(list, i, item);
+      }
+      return list;
+    }
+    case 5: { /* map */
+      PyObject *dict = PyDict_New();
+      if (!dict) return NULL;
+      for (uint64_t i = 0; i < value; i++) {
+        PyObject *key = parse_item(p);
+        if (!key) {
+          Py_DECREF(dict);
+          return NULL;
+        }
+        if (!PyUnicode_Check(key)) {
+          Py_DECREF(key);
+          Py_DECREF(dict);
+          PyErr_SetString(PyExc_ValueError, "DAG-CBOR map keys must be strings");
+          return NULL;
+        }
+        PyObject *val = parse_item(p);
+        if (!val) {
+          Py_DECREF(key);
+          Py_DECREF(dict);
+          return NULL;
+        }
+        int rc = PyDict_SetItem(dict, key, val);
+        Py_DECREF(key);
+        Py_DECREF(val);
+        if (rc < 0) {
+          Py_DECREF(dict);
+          return NULL;
+        }
+      }
+      return dict;
+    }
+    case 6: { /* tag — only 42 (CID) */
+      if (value != 42) {
+        PyErr_Format(PyExc_ValueError, "unsupported CBOR tag %llu",
+                     (unsigned long long)value);
+        return NULL;
+      }
+      PyObject *inner = parse_item(p);
+      if (!inner) return NULL;
+      if (!PyBytes_Check(inner) || PyBytes_GET_SIZE(inner) < 1 ||
+          PyBytes_AS_STRING(inner)[0] != 0) {
+        Py_DECREF(inner);
+        PyErr_SetString(PyExc_ValueError,
+                        "tag-42 content must be identity-multibase CID bytes");
+        return NULL;
+      }
+      if (!cid_factory) {
+        Py_DECREF(inner);
+        PyErr_SetString(PyExc_RuntimeError, "CID factory not registered");
+        return NULL;
+      }
+      PyObject *cid_bytes = PyBytes_FromStringAndSize(
+          PyBytes_AS_STRING(inner) + 1, PyBytes_GET_SIZE(inner) - 1);
+      Py_DECREF(inner);
+      if (!cid_bytes) return NULL;
+      PyObject *cid = PyObject_CallOneArg(cid_factory, cid_bytes);
+      Py_DECREF(cid_bytes);
+      return cid;
+    }
+    case 7: /* simple / float */
+      if (info == 27) { /* f64: value holds the raw payload */
+        double d;
+        uint64_t bits = value;
+        memcpy(&d, &bits, 8);
+        return PyFloat_FromDouble(d);
+      }
+      if (value == 20) Py_RETURN_FALSE;
+      if (value == 21) Py_RETURN_TRUE;
+      if (value == 22) Py_RETURN_NONE;
+      PyErr_Format(PyExc_ValueError, "unsupported CBOR simple value %llu",
+                   (unsigned long long)value);
+      return NULL;
+  }
+  PyErr_SetString(PyExc_ValueError, "unreachable CBOR major type");
+  return NULL;
+}
+
+static PyObject *py_decode(PyObject *self, PyObject *arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  Parser p = {(const uint8_t *)view.buf, view.len, 0};
+  PyObject *result = parse_item(&p);
+  if (result && p.pos != p.len) {
+    Py_DECREF(result);
+    result = NULL;
+    PyErr_Format(PyExc_ValueError, "trailing bytes after CBOR item (%zd bytes)",
+                 (Py_ssize_t)(p.len - p.pos));
+  }
+  PyBuffer_Release(&view);
+  return result;
+}
+
+static PyObject *py_decode_many(PyObject *self, PyObject *arg) {
+  PyObject *seq = PySequence_Fast(arg, "decode_many expects a sequence");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return NULL;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = py_decode(self, PySequence_Fast_GET_ITEM(seq, i));
+    if (!item) {
+      Py_DECREF(out);
+      Py_DECREF(seq);
+      return NULL;
+    }
+    PyList_SET_ITEM(out, i, item);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
+static PyObject *py_set_cid_factory(PyObject *self, PyObject *arg) {
+  if (!PyCallable_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "CID factory must be callable");
+    return NULL;
+  }
+  Py_XDECREF(cid_factory);
+  Py_INCREF(arg);
+  cid_factory = arg;
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"decode", py_decode, METH_O, "Decode one DAG-CBOR item from bytes."},
+    {"decode_many", py_decode_many, METH_O,
+     "Decode a sequence of DAG-CBOR byte strings."},
+    {"set_cid_factory", py_set_cid_factory, METH_O,
+     "Register callable(bytes)->CID used for tag-42 links."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_dagcbor_ext",
+                                       "Fast DAG-CBOR decoder", -1, methods};
+
+PyMODINIT_FUNC PyInit_ipc_dagcbor_ext(void) {
+  return PyModule_Create(&moduledef);
+}

@@ -118,15 +118,14 @@ def _decode_head(data: bytes, pos: int) -> tuple[int, int, int]:
     pos += 1
     if info < 24:
         return major, info, pos
-    if info == 24:
-        return major, data[pos], pos + 1
-    if info == 25:
-        return major, int.from_bytes(data[pos : pos + 2], "big"), pos + 2
-    if info == 26:
-        return major, int.from_bytes(data[pos : pos + 4], "big"), pos + 4
-    if info == 27:
-        return major, int.from_bytes(data[pos : pos + 8], "big"), pos + 8
-    raise ValueError(f"indefinite/reserved CBOR length (info={info}) not allowed in DAG-CBOR")
+    if info > 27:
+        raise ValueError(
+            f"indefinite/reserved CBOR length (info={info}) not allowed in DAG-CBOR"
+        )
+    extra = 1 << (info - 24)
+    if pos + extra > len(data):
+        raise ValueError("truncated CBOR head")
+    return major, int.from_bytes(data[pos : pos + extra], "big"), pos + extra
 
 
 def _decode_item(data: bytes, pos: int) -> tuple[Any, int]:
@@ -181,11 +180,38 @@ def _decode_item(data: bytes, pos: int) -> tuple[Any, int]:
     raise ValueError(f"unsupported CBOR simple value {value}")
 
 
-def decode(data: bytes) -> Any:
+def decode_py(data: bytes) -> Any:
+    """The pure-Python decoder (correctness reference for the C extension)."""
     obj, pos = _decode_item(bytes(data), 0)
     if pos != len(data):
         raise ValueError(f"trailing bytes after CBOR item ({len(data) - pos} bytes)")
     return obj
+
+
+_native = False  # False = not resolved yet; None = unavailable
+
+
+def _resolve_native():
+    global _native
+    try:
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
+        module = load_dagcbor_ext()
+        if module is not None:
+            module.set_cid_factory(CID.from_bytes)
+        _native = module
+    except Exception:
+        _native = None
+    return _native
+
+
+def decode(data: bytes) -> Any:
+    """Decode one DAG-CBOR item; uses the C extension when available
+    (bulk witness/receipt decode is the host-side hot loop)."""
+    native = _native if _native is not False else _resolve_native()
+    if native is not None:
+        return native.decode(bytes(data))
+    return decode_py(data)
 
 
 def decode_prefix(data: bytes) -> tuple[Any, int]:

@@ -1,0 +1,88 @@
+"""C-extension DAG-CBOR decoder: equivalence fuzzing against pure Python."""
+
+import random
+
+import pytest
+
+from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.core.dagcbor import decode, decode_py, encode
+
+ext = load_dagcbor_ext()
+pytestmark = pytest.mark.skipif(ext is None, reason="native decoder unavailable")
+
+
+def _random_value(rng: random.Random, depth: int = 0):
+    choices = ["int", "bytes", "str", "bool", "none", "cid"]
+    if depth < 3:
+        choices += ["list", "dict", "list", "dict"]
+    kind = rng.choice(choices)
+    if kind == "int":
+        return rng.choice(
+            [0, 1, -1, 23, 24, -24, -25, 255, 65536, 2**32, 2**63 - 1, -(2**63)]
+        )
+    if kind == "bytes":
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(40)))
+    if kind == "str":
+        return "".join(rng.choice("abcdefémoji🎈xyz ") for _ in range(rng.randrange(20)))
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "none":
+        return None
+    if kind == "cid":
+        return CID.hash_of(bytes(rng.randrange(256) for _ in range(8)), codec=RAW)
+    if kind == "list":
+        return [_random_value(rng, depth + 1) for _ in range(rng.randrange(5))]
+    return {
+        f"k{i}-{rng.randrange(100)}": _random_value(rng, depth + 1)
+        for i in range(rng.randrange(5))
+    }
+
+
+class TestNativeDecoder:
+    def test_fuzz_equivalence(self):
+        rng = random.Random(1234)
+        for _ in range(300):
+            value = _random_value(rng)
+            raw = encode(value)
+            assert ext.decode(raw) == decode_py(raw) == value
+
+    def test_decode_many(self):
+        values = [[1, "two", b"three", CID.hash_of(b"x")], {"a": None}, 42]
+        raws = [encode(v) for v in values]
+        assert ext.decode_many(raws) == values
+
+    def test_module_decode_dispatches_to_native(self):
+        # decode() and decode_py() must agree on real chain structures
+        from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+
+        world = build_chain(
+            [ContractFixture(actor_id=9, storage={b"\x01" * 32: b"\x02"})],
+            [[EventFixture(emitter=9, signature="E(uint256)", topic1="s")]],
+        )
+        for _, data in world.store.items():
+            assert decode(data) == decode_py(data)
+
+    def test_errors_match_python(self):
+        bad_inputs = [
+            b"",  # empty
+            b"\x9f\x01\xff",  # indefinite array
+            b"\x18",  # truncated head
+            b"\x58\x05ab",  # truncated bytes
+            encode(1) + b"\x00",  # trailing
+            b"\xd8\x2b\x41\x00",  # wrong tag (43)
+        ]
+        for raw in bad_inputs:
+            with pytest.raises(ValueError):
+                ext.decode(raw)
+            with pytest.raises(ValueError):
+                decode_py(raw)
+
+    def test_big_negative_int(self):
+        # -1 - 2**64-1 exercises the PyNumber_Subtract path
+        raw = b"\x3b" + (2**64 - 1).to_bytes(8, "big")
+        assert ext.decode(raw) == decode_py(raw) == -(2**64)
+
+    def test_float64(self):
+        raw = encode(3.5)
+        assert ext.decode(raw) == 3.5
