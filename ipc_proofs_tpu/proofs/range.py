@@ -37,13 +37,73 @@ from ipc_proofs_tpu.state.events import StampedEvent
 from ipc_proofs_tpu.store.blockstore import Blockstore, CachedBlockstore
 from ipc_proofs_tpu.utils.metrics import Metrics
 
-__all__ = ["TipsetPair", "generate_event_proofs_for_range"]
+__all__ = [
+    "TipsetPair",
+    "generate_event_proofs_for_range",
+    "generate_event_proofs_for_range_chunked",
+]
 
 
 @dataclass
 class TipsetPair:
     parent: Tipset
     child: Tipset
+
+
+def generate_event_proofs_for_range_chunked(
+    store: Blockstore,
+    pairs: Sequence[TipsetPair],
+    spec: EventProofSpec,
+    chunk_size: int,
+    checkpoint_dir: "str | None" = None,
+    match_backend=None,
+    metrics: Optional[Metrics] = None,
+) -> UnifiedProofBundle:
+    """Chunked, resumable range generation.
+
+    Splits ``pairs`` into chunks of ``chunk_size``; each finished chunk's
+    bundle is written to ``checkpoint_dir/chunk_NNNN.json`` and skipped on
+    re-run (crash recovery for long ranges — the reference aborts the whole
+    run on any error and restarts from zero, SURVEY.md §5). The merged
+    bundle deduplicates witness blocks across chunks.
+    """
+    import os
+
+    metrics = metrics or Metrics()
+    if checkpoint_dir is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    event_proofs = []
+    all_blocks: set[ProofBlock] = set()
+    for chunk_index, start in enumerate(range(0, len(pairs), chunk_size)):
+        chunk = pairs[start : start + chunk_size]
+        path = (
+            os.path.join(checkpoint_dir, f"chunk_{chunk_index:04d}.json")
+            if checkpoint_dir is not None
+            else None
+        )
+        if path is not None and os.path.exists(path):
+            with open(path) as fh:
+                bundle = UnifiedProofBundle.from_json(fh.read())
+            metrics.count("range_chunks_resumed")
+        else:
+            bundle = generate_event_proofs_for_range(
+                store, chunk, spec, match_backend=match_backend, metrics=metrics
+            )
+            if path is not None:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    fh.write(bundle.to_json())
+                os.replace(tmp, path)  # atomic: partial writes never count
+            metrics.count("range_chunks_generated")
+        event_proofs.extend(bundle.event_proofs)
+        all_blocks.update(bundle.blocks)
+
+    return UnifiedProofBundle(
+        storage_proofs=[],
+        event_proofs=event_proofs,
+        blocks=sorted(all_blocks, key=lambda b: b.cid),
+    )
 
 
 def generate_event_proofs_for_range(

@@ -1,0 +1,184 @@
+"""Failure-detection / recovery tests: RPC retries, chunked resume.
+
+The reference aborts on the first error with no retries and no partial
+recovery (SURVEY.md §5); these tests pin the framework's improvements.
+"""
+
+import json
+
+import pytest
+
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import (
+    TipsetPair,
+    generate_event_proofs_for_range,
+    generate_event_proofs_for_range_chunked,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+
+
+def _range(n_pairs, store=None):
+    bs = store or MemoryBlockstore()
+    pairs = []
+    for p in range(n_pairs):
+        events = [[EventFixture(emitter=5, signature=SIG, topic1="s")]]
+        world = build_chain(
+            [ContractFixture(actor_id=5)], events, parent_height=50 + 2 * p, store=bs
+        )
+        pairs.append(TipsetPair(world.parent, world.child))
+    return bs, pairs
+
+
+class TestChunkedResume:
+    def test_chunked_equals_unchunked(self, tmp_path):
+        bs, pairs = _range(7)
+        spec = EventProofSpec(event_signature=SIG, topic_1="s", actor_id_filter=5)
+        whole = generate_event_proofs_for_range(bs, pairs, spec)
+        chunked = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=3, checkpoint_dir=str(tmp_path / "ckpt")
+        )
+        assert {p.message_cid for p in whole.event_proofs} == {
+            p.message_cid for p in chunked.event_proofs
+        }
+        assert [str(b.cid) for b in whole.blocks] == [str(b.cid) for b in chunked.blocks]
+        assert verify_proof_bundle(chunked, TrustPolicy.accept_all()).all_valid()
+
+    def test_resume_skips_finished_chunks(self, tmp_path):
+        bs, pairs = _range(6)
+        spec = EventProofSpec(event_signature=SIG, topic_1="s", actor_id_filter=5)
+        ckpt = str(tmp_path / "ckpt")
+        m1 = Metrics()
+        first = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=ckpt, metrics=m1
+        )
+        assert m1.snapshot()["counters"]["range_chunks_generated"] == 3
+
+        # second run must come entirely from checkpoints — even with an
+        # EMPTY blockstore (nothing left to fetch)
+        m2 = Metrics()
+        resumed = generate_event_proofs_for_range_chunked(
+            MemoryBlockstore(), pairs, spec, chunk_size=2, checkpoint_dir=ckpt, metrics=m2
+        )
+        counters = m2.snapshot()["counters"]
+        assert counters["range_chunks_resumed"] == 3
+        assert "range_chunks_generated" not in counters
+        assert resumed.to_json() == first.to_json()
+
+    def test_partial_checkpoint_recovers_rest(self, tmp_path):
+        bs, pairs = _range(6)
+        spec = EventProofSpec(event_signature=SIG, topic_1="s", actor_id_filter=5)
+        ckpt = tmp_path / "ckpt"
+        # simulate a crash after one finished chunk
+        generate_event_proofs_for_range_chunked(
+            bs, pairs[:2], spec, chunk_size=2, checkpoint_dir=str(ckpt)
+        )
+        assert (ckpt / "chunk_0000.json").exists()
+        m = Metrics()
+        full = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=str(ckpt), metrics=m
+        )
+        counters = m.snapshot()["counters"]
+        assert counters["range_chunks_resumed"] == 1
+        assert counters["range_chunks_generated"] == 2
+        assert len(full.event_proofs) == 6
+
+    def test_checkpoint_files_are_valid_bundles(self, tmp_path):
+        bs, pairs = _range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1="s", actor_id_filter=5)
+        ckpt = tmp_path / "ckpt"
+        generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=str(ckpt)
+        )
+        for path in sorted(ckpt.glob("chunk_*.json")):
+            bundle = UnifiedProofBundle.from_json(path.read_text())
+            assert verify_proof_bundle(bundle, TrustPolicy.accept_all()).all_valid()
+
+
+class FlakyClient:
+    """requests-free stand-in that fails N times then succeeds."""
+
+    def __init__(self, fail_times: int):
+        self.fail_times = fail_times
+        self.calls = 0
+
+    def post(self, url, data=None, headers=None, timeout=None):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise ConnectionError("flaky network")
+
+        class Resp:
+            @staticmethod
+            def raise_for_status():
+                pass
+
+            @staticmethod
+            def json():
+                return {"jsonrpc": "2.0", "result": "ok", "id": 1}
+
+        return Resp()
+
+
+class TestRpcRetries:
+    def _client(self, fail_times):
+        from ipc_proofs_tpu.store.rpc import LotusClient
+
+        client = LotusClient.__new__(LotusClient)
+        client.endpoint = "http://fake"
+        client.timeout_s = 1.0
+        client.max_retries = 3
+        client._headers = {}
+        import threading
+
+        client._id_lock = threading.Lock()
+        client._next_id = 1
+        client._session = FlakyClient(fail_times)
+        return client
+
+    def test_retries_then_succeeds(self, monkeypatch):
+        import time as time_module
+
+        monkeypatch.setattr(time_module, "sleep", lambda s: None)
+        client = self._client(fail_times=2)
+        assert client.request("Filecoin.ChainHead", []) == "ok"
+        assert client._session.calls == 3
+
+    def test_exhausted_retries_raise(self, monkeypatch):
+        import time as time_module
+
+        monkeypatch.setattr(time_module, "sleep", lambda s: None)
+        client = self._client(fail_times=10)
+        with pytest.raises(RuntimeError, match="failed after 3 attempts"):
+            client.request("Filecoin.ChainHead", [])
+
+    def test_protocol_errors_not_retried(self):
+        from ipc_proofs_tpu.store.rpc import RpcError
+
+        client = self._client(fail_times=0)
+
+        class ErrResp:
+            @staticmethod
+            def raise_for_status():
+                pass
+
+            @staticmethod
+            def json():
+                return {"error": {"code": -32601, "message": "method not found"}, "id": 1}
+
+        class ErrSession:
+            calls = 0
+
+            def post(self, *a, **k):
+                ErrSession.calls += 1
+                return ErrResp()
+
+        client._session = ErrSession()
+        with pytest.raises(RpcError):
+            client.request("Filecoin.Nope", [])
+        assert ErrSession.calls == 1
