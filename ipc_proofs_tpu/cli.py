@@ -129,6 +129,60 @@ def _cmd_verify(args) -> int:
     return 0 if result.all_valid() else 1
 
 
+def _cmd_range(args) -> int:
+    """Event proofs across a whole epoch range, chunked + resumable."""
+    from ipc_proofs_tpu.backend import get_backend
+    from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+    from ipc_proofs_tpu.proofs.chain import Tipset
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import (
+        TipsetPair,
+        generate_event_proofs_for_range_chunked,
+    )
+    from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+    from ipc_proofs_tpu.utils.metrics import get_metrics
+
+    metrics = get_metrics()
+    client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
+
+    actor_id = None
+    if args.contract:
+        actor_id = resolve_eth_address_to_actor_id(client, args.contract)
+        print(f"actor id: {actor_id}", file=sys.stderr)
+
+    with metrics.stage("fetch_tipsets"):
+        tipsets = [Tipset.fetch(client, h) for h in range(args.from_height, args.to_height + 2)]
+    pairs = [
+        TipsetPair(parent=tipsets[i], child=tipsets[i + 1]) for i in range(len(tipsets) - 1)
+    ]
+    print(f"range: {len(pairs)} tipset pairs", file=sys.stderr)
+
+    spec = EventProofSpec(
+        event_signature=args.event_sig, topic_1=args.topic1, actor_id_filter=actor_id
+    )
+    backend = get_backend(args.backend) if args.backend != "none" else None
+    bundle = generate_event_proofs_for_range_chunked(
+        RpcBlockstore(client),
+        pairs,
+        spec,
+        chunk_size=args.chunk_size,
+        checkpoint_dir=args.checkpoint_dir,
+        match_backend=backend,
+        metrics=metrics,
+    )
+    output = args.output or "range_bundle.json"
+    with open(output, "w") as fh:
+        fh.write(bundle.to_json())
+    print(
+        f"range bundle: {len(bundle.event_proofs)} proofs, "
+        f"{len(bundle.blocks)} witness blocks → {output}",
+        file=sys.stderr,
+    )
+    if args.metrics:
+        print(metrics.to_json(), file=sys.stderr)
+    return 0
+
+
 def _cmd_demo(args) -> int:
     """The reference `main.rs` flow, hermetic: synthesize a chain, generate
     one storage + one event proof, verify offline, print results."""
@@ -210,6 +264,22 @@ def main(argv=None) -> int:
     ver.add_argument("--topic1", default=None)
     ver.add_argument("--check-cids", action="store_true", help="recompute every witness CID")
     ver.set_defaults(fn=_cmd_verify)
+
+    rng = sub.add_parser("range", help="event proofs over an epoch range (chunked, resumable)")
+    rng.add_argument("--endpoint", required=True)
+    rng.add_argument("--token", default=None)
+    rng.add_argument("--timeout", type=float, default=250.0)
+    rng.add_argument("--from-height", type=int, required=True)
+    rng.add_argument("--to-height", type=int, required=True)
+    rng.add_argument("--contract", default=None)
+    rng.add_argument("--event-sig", required=True)
+    rng.add_argument("--topic1", required=True)
+    rng.add_argument("--chunk-size", type=int, default=64)
+    rng.add_argument("--checkpoint-dir", default=None)
+    rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
+    rng.add_argument("-o", "--output", default=None)
+    rng.add_argument("--metrics", action="store_true")
+    rng.set_defaults(fn=_cmd_range)
 
     demo = sub.add_parser("demo", help="hermetic end-to-end demo on a synthetic chain")
     demo.set_defaults(fn=_cmd_demo)

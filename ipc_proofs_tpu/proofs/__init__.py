@@ -32,6 +32,15 @@ from ipc_proofs_tpu.proofs.trust import MockTrustVerifier, TrustPolicy, TrustVer
 from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
 from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
 from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+from ipc_proofs_tpu.proofs.range import (
+    TipsetPair,
+    generate_event_proofs_for_range,
+    generate_event_proofs_for_range_chunked,
+)
+from ipc_proofs_tpu.proofs.storage_batch import (
+    MappingSlotSpec,
+    generate_storage_proofs_batch,
+)
 from ipc_proofs_tpu.state.storage import calculate_storage_slot
 
 __all__ = [
@@ -52,5 +61,10 @@ __all__ = [
     "MockTrustVerifier",
     "create_event_filter",
     "resolve_eth_address_to_actor_id",
+    "TipsetPair",
+    "generate_event_proofs_for_range",
+    "generate_event_proofs_for_range_chunked",
+    "MappingSlotSpec",
+    "generate_storage_proofs_batch",
     "calculate_storage_slot",
 ]

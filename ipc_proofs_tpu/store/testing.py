@@ -49,5 +49,5 @@ class FakeLotusClient:
         raise RuntimeError(f"FakeLotus: no canned response for {method}")
 
     def chain_read_obj(self, cid: CID) -> Optional[bytes]:
-        data = self._store.get(cid)
-        return data
+        self.calls.append(("Filecoin.ChainReadObj", [{"/": str(cid)}]))
+        return self._store.get(cid)

@@ -1,0 +1,135 @@
+"""Online-path tests: the full RPC pipeline against a fake Lotus node.
+
+The reference can only exercise this path against the live calibration net
+(its `main.rs` smoke test); here the identical flow runs hermetically:
+ChainGetTipSetByHeight JSON → Tipset → RpcBlockstore(ChainReadObj) →
+generate → verify, plus CLI verify on the saved bundle.
+"""
+
+import json
+
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.chain import Tipset
+from ipc_proofs_tpu.proofs.generator import (
+    EventProofSpec,
+    StorageProofSpec,
+    generate_proof_bundle,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.state.storage import calculate_storage_slot
+from ipc_proofs_tpu.store.rpc import RpcBlockstore
+from ipc_proofs_tpu.store.testing import FakeLotusClient
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+SLOT = calculate_storage_slot(SUBNET, 0)
+
+
+def _tipset_json(tipset: Tipset) -> dict:
+    return {
+        "Cids": [{"/": str(c)} for c in tipset.cids],
+        "Blocks": [
+            {
+                "Parents": [{"/": str(p)} for p in h.parents],
+                "Height": h.height,
+                "ParentStateRoot": {"/": str(h.parent_state_root)},
+                "ParentMessageReceipts": {"/": str(h.parent_message_receipts)},
+                "Messages": {"/": str(h.messages)},
+                "Timestamp": h.timestamp,
+            }
+            for h in tipset.blocks
+        ],
+        "Height": tipset.height,
+    }
+
+
+def _world_and_client():
+    world = build_chain(
+        [ContractFixture(actor_id=ACTOR, storage={SLOT: b"\x2a"})],
+        [[EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET)], []],
+        parent_height=500,
+    )
+    by_height = {world.parent.height: world.parent, world.child.height: world.child}
+    client = FakeLotusClient(
+        world.store,
+        responses={
+            "Filecoin.ChainGetTipSetByHeight": lambda params: _tipset_json(
+                by_height[params[0]]
+            ),
+            "Filecoin.EthAddressToFilecoinAddress": "f410f" + "a" * 39,  # unused here
+            "Filecoin.StateLookupID": f"f0{ACTOR}",
+        },
+    )
+    return world, client
+
+
+class TestOnlinePipeline:
+    def test_fetch_generate_verify_over_rpc(self):
+        world, client = _world_and_client()
+        parent = Tipset.fetch(client, 500)
+        child = Tipset.fetch(client, 501)
+        assert parent.cids == world.parent.cids
+        assert child.blocks[0].parent_message_receipts == world.receipts_root
+
+        store = RpcBlockstore(client)
+        bundle = generate_proof_bundle(
+            store,
+            parent,
+            child,
+            [StorageProofSpec(actor_id=ACTOR, slot=SLOT)],
+            [EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)],
+        )
+        assert len(bundle.storage_proofs) == 1 and len(bundle.event_proofs) == 1
+        # every witness byte came over the (fake) wire
+        read_calls = [c for c in client.calls if c[0] == "Filecoin.ChainReadObj"]
+        assert len(read_calls) > 0
+
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert result.all_valid()
+
+    def test_shared_cache_dedupes_rpc_traffic(self):
+        world, client = _world_and_client()
+        parent = Tipset.fetch(client, 500)
+        child = Tipset.fetch(client, 501)
+        store = RpcBlockstore(client)
+        client.calls.clear()
+        generate_proof_bundle(
+            store,
+            parent,
+            child,
+            [StorageProofSpec(actor_id=ACTOR, slot=SLOT)] * 3,  # same spec 3x
+            [],
+        )
+        reads = [json.dumps(c[1]) for c in client.calls if c[0] == "Filecoin.ChainReadObj"]
+        # the shared cache must make repeated specs nearly free: every block
+        # fetched at most once (the reference claims ~80% reduction)
+        assert len(reads) == len(set(reads))
+
+    def test_cli_verify_on_saved_bundle(self, tmp_path, capsys):
+        world, client = _world_and_client()
+        parent = Tipset.fetch(client, 500)
+        child = Tipset.fetch(client, 501)
+        bundle = generate_proof_bundle(
+            RpcBlockstore(client),
+            parent,
+            child,
+            [StorageProofSpec(actor_id=ACTOR, slot=SLOT)],
+            [EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)],
+        )
+        path = tmp_path / "bundle.json"
+        path.write_text(bundle.to_json())
+
+        from ipc_proofs_tpu.cli import main
+
+        rc = main(["verify", str(path), "--check-cids", "--event-sig", SIG, "--topic1", SUBNET])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["all_valid"] is True
+
+    def test_cli_demo_exit_code(self, capsys):
+        from ipc_proofs_tpu.cli import main
+
+        assert main(["demo"]) == 0
+        assert "All valid: True" in capsys.readouterr().out
