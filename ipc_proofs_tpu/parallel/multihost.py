@@ -1,0 +1,78 @@
+"""Multi-host (DCN) scale-out for the batch proof pipeline.
+
+The workload is embarrassingly parallel across tipset ranges (SURVEY.md
+§2c): multi-host scaling = shard the epoch range across processes (``dp``
+over DCN), keep the event axis (``sp``) inside each host's ICI domain, and
+reduce only tiny aggregates (proof counts, witness-CID set sizes). There is
+deliberately no parameter state to synchronize — no NCCL/MPI analog is
+required beyond XLA's own collectives.
+
+Usage on a multi-host slice (e.g. v5e pods):
+
+    initialize_distributed()          # env-driven jax.distributed init
+    mesh = global_mesh(sp=2)          # dp spans hosts, sp stays intra-host
+    jitted, shard = sharded_match_pipeline(mesh)
+
+Single-process fallback is automatic, so the same driver script runs
+everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["initialize_distributed", "global_mesh", "host_local_pairs"]
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize `jax.distributed` from args or standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+    Returns True if a multi-process runtime was initialized, False when
+    running single-process (no coordinator configured).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(os.environ.get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh(sp: int = 1):
+    """A ``(dp, sp)`` mesh over ALL global devices, laid out so ``sp`` (the
+    axis with the per-receipt reduce collective) stays within a host's ICI
+    domain and only ``dp`` crosses DCN."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    local = jax.local_device_count()
+    if sp > local or local % sp != 0:
+        raise ValueError(f"sp={sp} must divide local device count {local}")
+    grid = np.array(devices).reshape(len(devices) // sp, sp)
+    return Mesh(grid, axis_names=("dp", "sp"))
+
+
+def host_local_pairs(pairs, process_id: Optional[int] = None, num_processes: Optional[int] = None):
+    """Partition an epoch range across processes (contiguous slices — keeps
+    adjacent pairs, and so their shared witness blocks, on one host)."""
+    import jax
+
+    process_id = jax.process_index() if process_id is None else process_id
+    num_processes = jax.process_count() if num_processes is None else num_processes
+    chunk = (len(pairs) + num_processes - 1) // num_processes
+    return pairs[process_id * chunk : (process_id + 1) * chunk]

@@ -1,0 +1,37 @@
+"""Multi-host helpers (single-process + virtual-device behavior)."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from ipc_proofs_tpu.parallel.multihost import (  # noqa: E402
+    global_mesh,
+    host_local_pairs,
+    initialize_distributed,
+)
+
+
+class TestMultihost:
+    def test_initialize_noop_without_coordinator(self, monkeypatch):
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert initialize_distributed() is False
+
+    def test_global_mesh_shapes(self):
+        mesh = global_mesh(sp=2)
+        assert mesh.axis_names == ("dp", "sp")
+        assert mesh.shape["sp"] == 2
+        assert mesh.shape["dp"] * 2 == len(jax.devices())
+        with pytest.raises(ValueError):
+            global_mesh(sp=3)
+
+    def test_host_local_pairs_partitioning(self):
+        pairs = list(range(10))
+        shard0 = host_local_pairs(pairs, process_id=0, num_processes=3)
+        shard1 = host_local_pairs(pairs, process_id=1, num_processes=3)
+        shard2 = host_local_pairs(pairs, process_id=2, num_processes=3)
+        assert shard0 + shard1 + shard2 == pairs
+        assert max(len(shard0), len(shard1), len(shard2)) <= 4
+
+    def test_host_local_pairs_defaults_to_jax_process(self):
+        pairs = list(range(4))
+        assert host_local_pairs(pairs) == pairs  # single process owns all
