@@ -96,13 +96,17 @@ class CID:
     # --- serialization -----------------------------------------------------
 
     def to_bytes(self) -> bytes:
-        return (
-            encode_uvarint(self.version)
-            + encode_uvarint(self.codec)
-            + encode_uvarint(self.mh_code)
-            + encode_uvarint(len(self.digest))
-            + self.digest
-        )
+        cached = self.__dict__.get("_bytes")
+        if cached is None:
+            cached = (
+                encode_uvarint(self.version)
+                + encode_uvarint(self.codec)
+                + encode_uvarint(self.mh_code)
+                + encode_uvarint(len(self.digest))
+                + self.digest
+            )
+            object.__setattr__(self, "_bytes", cached)  # frozen-safe memo
+        return cached
 
     def __str__(self) -> str:
         return "b" + _b32_encode_lower(self.to_bytes())

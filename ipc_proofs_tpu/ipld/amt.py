@@ -34,9 +34,9 @@ def _width(bit_width: int) -> int:
     return 1 << bit_width
 
 
-def _bmap_get(bmap: bytes, i: int) -> bool:
-    byte = i // 8
-    return byte < len(bmap) and bool(bmap[byte] & (1 << (i % 8)))
+def _bmap_int(bmap: bytes) -> int:
+    """Bitmap bytes → int with bit i == slot i (LSB-first byte layout)."""
+    return int.from_bytes(bmap, "little")
 
 
 def _bmap_make(bits: list[int], bit_width: int) -> bytes:
@@ -128,16 +128,18 @@ class AMT:
         node = self._root_node
         for h in range(self.height, 0, -1):
             bmap, links, _ = self._node_parts(node)
+            bits = _bmap_int(bmap)
             slot = (index >> (self.bit_width * h)) & (width - 1)
-            if not _bmap_get(bmap, slot):
+            if not (bits >> slot) & 1:
                 return None
-            link_pos = sum(1 for i in range(slot) if _bmap_get(bmap, i))
+            link_pos = (bits & ((1 << slot) - 1)).bit_count()
             node = self._load_node(links[link_pos])
         bmap, _, values = self._node_parts(node)
+        bits = _bmap_int(bmap)
         slot = index & (width - 1)
-        if not _bmap_get(bmap, slot):
+        if not (bits >> slot) & 1:
             return None
-        value_pos = sum(1 for i in range(slot) if _bmap_get(bmap, i))
+        value_pos = (bits & ((1 << slot) - 1)).bit_count()
         return values[value_pos]
 
     def for_each(self, fn: Callable[[int, Any], None]) -> None:
@@ -151,15 +153,17 @@ class AMT:
     def _walk(self, node: list, height: int, base: int) -> Iterator[tuple[int, Any]]:
         width = _width(self.bit_width)
         bmap, links, values = self._node_parts(node)
+        bits = _bmap_int(bmap)
         pos = 0
+        span = width**height
         for slot in range(width):
-            if not _bmap_get(bmap, slot):
+            if not (bits >> slot) & 1:
                 continue
             if height == 0:
                 yield base + slot, values[pos]
             else:
                 child = self._load_node(links[pos])
-                yield from self._walk(child, height - 1, base + slot * width**height)
+                yield from self._walk(child, height - 1, base + slot * span)
             pos += 1
 
 

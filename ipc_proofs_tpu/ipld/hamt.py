@@ -87,7 +87,7 @@ class HAMT:
             idx = _hash_bits(key, depth, self.bit_width)
             if not (bitfield >> idx) & 1:
                 return None
-            pos = bin(bitfield & ((1 << idx) - 1)).count("1")
+            pos = (bitfield & ((1 << idx) - 1)).bit_count()
             ptr = pointers[pos]
             if isinstance(ptr, CID):
                 node = self._load_node(ptr)

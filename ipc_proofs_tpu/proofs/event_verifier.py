@@ -56,8 +56,14 @@ def verify_event_proof(
     verify_witness_cids: bool = False,
 ) -> list[bool]:
     store = load_witness_store(bundle.blocks, verify_cids=verify_witness_cids)
+    # The reference reconstructs the execution order from scratch for EVERY
+    # proof (SURVEY.md §3.2 flags this as an obvious win); proofs of the same
+    # parent tipset share one reconstruction here.
+    exec_cache: dict[tuple[str, ...], list[CID]] = {}
     return [
-        _verify_single_proof(store, proof, is_trusted_parent_ts, is_trusted_child_header, check_event)
+        _verify_single_proof(
+            store, proof, is_trusted_parent_ts, is_trusted_child_header, check_event, exec_cache
+        )
         for proof in bundle.proofs
     ]
 
@@ -68,6 +74,7 @@ def _verify_single_proof(
     is_trusted_parent_ts: Callable[[int, list[CID]], bool],
     is_trusted_child_header: Callable[[int, CID], bool],
     check_event: Optional[Callable[[ActorEvent], bool]],
+    exec_cache: Optional[dict] = None,
 ) -> bool:
     child_cid = CID.from_string(proof.child_block_cid)
     parent_cids = [CID.from_string(c) for c in proof.parent_tipset_cids]
@@ -93,11 +100,17 @@ def _verify_single_proof(
     if BlockHeader.decode(parent_raw).height != proof.parent_epoch:
         return False
 
-    # Step 3: execution order (with TxMeta CID recompute).
-    try:
-        exec_order = reconstruct_execution_order(store, parent_cids)
-    except (KeyError, ValueError):
-        return False
+    # Step 3: execution order (with TxMeta CID recompute), memoized per
+    # parent tipset across the bundle's proofs.
+    cache_key = tuple(proof.parent_tipset_cids)
+    exec_order = exec_cache.get(cache_key) if exec_cache is not None else None
+    if exec_order is None:
+        try:
+            exec_order = reconstruct_execution_order(store, parent_cids)
+        except (KeyError, ValueError):
+            return False
+        if exec_cache is not None:
+            exec_cache[cache_key] = exec_order
     msg_cid = CID.from_string(proof.message_cid)
     try:
         position = exec_order.index(msg_cid)
