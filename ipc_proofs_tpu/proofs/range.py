@@ -112,19 +112,29 @@ def generate_event_proofs_for_range(
     spec: EventProofSpec,
     match_backend=None,
     metrics: Optional[Metrics] = None,
+    scan_workers: int = 0,
 ) -> UnifiedProofBundle:
     """Generate event proofs for ``spec`` across a whole range of tipset
-    pairs, with one device mask call for the entire range."""
+    pairs, with one device mask call for the entire range.
+
+    ``scan_workers > 0`` runs Phase A over a thread pool — for RPC-backed
+    stores this overlaps block fetches across pairs (the reference fetches
+    strictly one block at a time, `client/blockstore.rs:21-28`).
+    """
     metrics = metrics or Metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
 
     # Phase A: host decode of every pair's receipts + events.
     with metrics.stage("range_scan"):
-        scans = []  # per pair: list[(exec_index, receipt, events)]
-        for pair in pairs:
-            receipts_root = pair.child.blocks[0].parent_message_receipts
-            scans.append(scan_receipt_events(cached, receipts_root))
+        roots = [pair.child.blocks[0].parent_message_receipts for pair in pairs]
+        if scan_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=scan_workers) as pool:
+                scans = list(pool.map(lambda r: scan_receipt_events(cached, r), roots))
+        else:
+            scans = [scan_receipt_events(cached, root) for root in roots]
 
     # Phase B: one batched predicate over all events in the range.
     with metrics.stage("range_match"):

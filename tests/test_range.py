@@ -112,3 +112,23 @@ class TestBatchCidVerification:
                 verify_witness_cids=True,
                 cid_backend=get_backend("cpu"),
             )
+
+
+class TestConcurrentScan:
+    def test_scan_workers_same_result(self):
+        bs, pairs, expected = _make_range(6)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        serial = generate_event_proofs_for_range(bs, pairs, spec)
+        threaded = generate_event_proofs_for_range(bs, pairs, spec, scan_workers=4)
+        assert serial.to_json() == threaded.to_json()
+        assert len(threaded.event_proofs) == expected
+
+    def test_scan_workers_over_rpc_store(self):
+        from ipc_proofs_tpu.store.rpc import RpcBlockstore
+        from ipc_proofs_tpu.store.testing import FakeLotusClient
+
+        bs, pairs, expected = _make_range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        rpc_store = RpcBlockstore(FakeLotusClient(bs))
+        bundle = generate_event_proofs_for_range(rpc_store, pairs, spec, scan_workers=8)
+        assert len(bundle.event_proofs) == expected
