@@ -77,7 +77,8 @@ def config2_batch_events(quick: bool):
     """Delegates to the headline bench (same measurement)."""
     import subprocess
 
-    cmd = [sys.executable, "bench.py", "--platform", "cpu"]
+    cmd = [sys.executable, "bench.py",
+           "--platform", os.environ.get("IPC_BENCH_PLATFORM", "cpu")]
     if quick:
         cmd.append("--quick")
     out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
@@ -107,10 +108,25 @@ def config3_storage_slots(quick: bool):
         for c in range(n_contracts)
         for i in range(slots_per_contract)
     ]
-    backend.keccak256_batch(preimages[:64])  # warm compile
     start = time.perf_counter()
-    slot_keys = backend.keccak256_batch(preimages)
-    t_hash = time.perf_counter() - start
+    slot_keys = backend.keccak256_batch(preimages)  # compile + E2E (incl. host pack/transfer)
+    t_hash_e2e = time.perf_counter() - start
+
+    # device kernel rate, slope-timed (tunnel RTT cancelled)
+    import jax.numpy as jnp
+
+    from ipc_proofs_tpu.ops.keccak_jax import keccak256_blocks
+    from ipc_proofs_tpu.ops.pack import pad_keccak
+    from ipc_proofs_tpu.utils.timing import measure_pass_seconds
+
+    kb, kc = pad_keccak(preimages)
+    kb_j, kc_j = jnp.asarray(kb), jnp.asarray(kc)
+
+    def one_pass(i, b, c):
+        return keccak256_blocks(b ^ i.astype(jnp.uint32), c).sum(dtype=jnp.uint32).astype(jnp.int32)
+
+    pt = measure_pass_seconds(one_pass, (kb_j, kc_j), k_small=3, k_large=43)
+    t_hash = pt.seconds
 
     # host leg: build one storage HAMT per contract, then look up every slot
     build_start = time.perf_counter()
@@ -143,8 +159,8 @@ def config3_storage_slots(quick: bool):
 
     rate = n_slots / (t_hash + t_lookup)
     _log(
-        f"config3: {n_slots} slots / {n_contracts} roots — hash {t_hash:.3f}s, "
-        f"build {t_build:.1f}s, lookup {t_lookup:.2f}s"
+        f"config3: {n_slots} slots / {n_contracts} roots — device hash {t_hash*1e3:.2f}ms "
+        f"(e2e incl. transfer {t_hash_e2e:.2f}s), build {t_build:.1f}s, lookup {t_lookup:.2f}s"
     )
     _emit("storage_slot_lookups_per_sec", rate, "slots/s",
           vs_baseline=round((n_slots / t_hash) / scalar_rate, 2))
@@ -173,13 +189,18 @@ def config4_witness_cids(quick: bool):
     blocks_j = jnp.asarray(blocks)
     counts_j = jnp.asarray(counts)
     lengths_j = jnp.asarray(lengths)
-    blake2b256_blocks(blocks_j[:64], counts_j[:64], lengths_j[:64])  # warm compile
 
-    start = time.perf_counter()
-    digests = blake2b256_blocks(blocks_j, counts_j, lengths_j)
-    digests.block_until_ready()
-    elapsed = time.perf_counter() - start
-    rate = n_blocks / elapsed
+    digests = blake2b256_blocks(blocks_j, counts_j, lengths_j)  # compile + correctness pass
+
+    from ipc_proofs_tpu.utils.timing import measure_pass_seconds
+
+    def one_pass(i, b, c, l):
+        d = blake2b256_blocks(b ^ i.astype(jnp.uint32), c, l)
+        return d.sum(dtype=jnp.uint32).astype(jnp.int32)
+
+    pt = measure_pass_seconds(one_pass, (blocks_j, counts_j, lengths_j), k_small=3, k_large=23)
+    _log(f"config4: slope timing k={pt.k_small}/{pt.k_large} → {pt.per_pass_ms:.2f} ms/pass")
+    rate = n_blocks / pt.seconds
 
     out = digests_to_bytes(digests[:4])
     for i in range(4):
@@ -276,14 +297,28 @@ CONFIGS = {
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=None, help="1-5; default all")
-    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("--platform", default="auto", help="auto|default|cpu")
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
 
+    if args.platform == "auto":
+        import subprocess
+
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, timeout=240, text=True,
+            )
+            ok = probe.returncode == 0 and probe.stdout.strip()
+            args.platform = "default" if ok else "cpu"
+        except Exception:
+            args.platform = "cpu"
+        _log(f"platform probe → {args.platform}")
     if args.platform == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("IPC_BENCH_PLATFORM", args.platform)
 
     targets = [args.config] if args.config else sorted(CONFIGS)
     for n in targets:

@@ -1,0 +1,100 @@
+"""Honest device-throughput timing over high-latency dispatch links.
+
+On this environment the TPU chip sits behind a network tunnel: every
+dispatch + scalar readback costs ~60-70 ms round-trip regardless of the work
+submitted, and ``block_until_ready`` returns before execution completes. Any
+per-call wall-clock timing therefore measures the link, not the kernel.
+
+The fix is standard: run K passes of the kernel *inside one jit* (a
+``lax.fori_loop`` whose body depends on the induction variable and whose
+result is carried, so XLA can neither hoist nor dead-code the passes), read
+back a single scalar, and time two different K values. The slope
+``(t_large - t_small) / (k_large - k_small)`` is the per-pass device time
+with every constant cost (tunnel RTT, dispatch, readback) cancelled.
+
+The reference (consensus-shipyard/ipc-filecoin-proofs) publishes no measured
+numbers at all (SURVEY.md §6); this module is how every number we publish is
+obtained.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, NamedTuple, Sequence
+
+__all__ = ["PassTime", "measure_pass_seconds"]
+
+
+class PassTime(NamedTuple):
+    seconds: float  # per-pass device seconds (slope)
+    k_small: int
+    k_large: int
+    t_small: float  # best-of wall time for the k_small loop
+    t_large: float  # best-of wall time for the k_large loop
+
+    @property
+    def per_pass_ms(self) -> float:
+        return self.seconds * 1e3
+
+
+def measure_pass_seconds(
+    body: Callable,
+    args: Sequence,
+    *,
+    k_small: int = 5,
+    k_large: int = 105,
+    repeats: int = 3,
+    max_k: int = 8005,
+    min_delta_s: float = 0.010,
+) -> PassTime:
+    """Measure per-pass device seconds of ``body`` via the slope method.
+
+    Args:
+      body: ``body(i, *args) -> scalar array`` — one pass of the kernel.
+        ``i`` is the traced ``int32`` loop index; the body MUST mix it into
+        the computation (e.g. XOR it into an input) so the loop cannot be
+        hoisted, and the returned scalar must depend on the pass's real
+        output so it cannot be dead-coded.
+      args: device arrays passed through unchanged each pass.
+      k_small/k_large: initial loop lengths. If the timing difference is
+        below ``min_delta_s`` (pass too cheap to resolve), ``k_large``
+        escalates geometrically up to ``max_k``.
+      repeats: best-of-N wall timings per loop length (first call compiles
+        and is discarded).
+
+    Returns:
+      PassTime with the per-pass seconds (clamped to >= 1 ns).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make_loop(k: int):
+        @jax.jit
+        def run(*a):
+            def step(i, acc):
+                out = body(i, *a)
+                return acc + out.astype(jnp.int64 if acc.dtype == jnp.int64 else jnp.int32)
+
+            return lax.fori_loop(0, k, step, jnp.int32(0))
+
+        return run
+
+    def best_of(run) -> float:
+        int(run(*args))  # compile + warm (forces completion via scalar readback)
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            int(run(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_small = best_of(make_loop(k_small))
+    while True:
+        t_large = best_of(make_loop(k_large))
+        delta = t_large - t_small
+        if delta >= max(min_delta_s, 0.05 * t_small) or k_large >= max_k:
+            break
+        k_large = min(max_k, (k_large - k_small) * 4 + k_small)
+    per_pass = max((t_large - t_small) / (k_large - k_small), 1e-9)
+    return PassTime(per_pass, k_small, k_large, t_small, t_large)

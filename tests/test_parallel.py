@@ -89,3 +89,21 @@ class TestShardedPipeline:
             & (batch.emitters == 1001)
         )
         np.testing.assert_array_equal(np.asarray(mask_s), expected)
+
+
+def test_measure_pass_seconds_slope():
+    """Slope timing resolves a real per-pass cost and cancels constants."""
+    import jax.numpy as jnp
+
+    from ipc_proofs_tpu.utils.timing import measure_pass_seconds
+
+    x = jnp.arange(4096, dtype=jnp.uint32)
+
+    def body(i, v):
+        acc = v ^ i.astype(jnp.uint32)
+        return acc.sum(dtype=jnp.uint32).astype(jnp.int32)
+
+    pt = measure_pass_seconds(body, (x,), k_small=2, k_large=42, repeats=2, max_k=202)
+    assert pt.seconds > 0
+    assert pt.k_large > pt.k_small
+    assert pt.per_pass_ms == pt.seconds * 1e3
