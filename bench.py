@@ -20,7 +20,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -29,29 +28,6 @@ def _log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def _pick_platform(requested: str, probe_timeout: float) -> str:
-    """'auto' probes the default (axon TPU) backend in a subprocess so a
-    hung chip claim cannot hang the bench."""
-    if requested != "auto":
-        return requested
-    if os.environ.get("IPC_BENCH_PLATFORM"):
-        return os.environ["IPC_BENCH_PLATFORM"]
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            timeout=probe_timeout,
-            text=True,
-        )
-        if probe.returncode == 0 and probe.stdout.strip():
-            platform = probe.stdout.strip().splitlines()[-1]
-            _log(f"bench: default backend probe OK → platform {platform!r}")
-            return "default"
-    except subprocess.TimeoutExpired:
-        _log("bench: default backend probe timed out — falling back to CPU")
-    except Exception as exc:  # pragma: no cover
-        _log(f"bench: probe failed ({exc}) — falling back to CPU")
-    return "cpu"
 
 
 def _scalar_baseline_proofs_per_sec(
@@ -87,7 +63,11 @@ def main() -> None:
     parser.add_argument("--receipts", type=int, default=16)
     parser.add_argument("--events", type=int, default=4)
     parser.add_argument("--match-rate", type=float, default=0.01)
-    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument(
+        "--iters", type=int, default=20,
+        help="lower bound for the slope-timing k_large loop length "
+        "(full runs floor it at 105 passes for resolution; --quick floors at 13)",
+    )
     parser.add_argument("--probe-timeout", type=float, default=240.0)
     parser.add_argument("--quick", action="store_true", help="small shapes for smoke runs")
     args = parser.parse_args()
@@ -95,7 +75,9 @@ def main() -> None:
     if args.quick:
         args.tipsets, args.iters = min(args.tipsets, 256), min(args.iters, 5)
 
-    platform = _pick_platform(args.platform, args.probe_timeout)
+    from ipc_proofs_tpu.utils.platform import pick_platform
+
+    platform = pick_platform(args.platform, args.probe_timeout, log=_log)
     if platform == "cpu":
         import jax
 
@@ -152,7 +134,11 @@ def main() -> None:
         _, _, c = jitted(topics ^ i.astype(topics.dtype), n_topics, emitters, valid, s0, s1, actor)
         return c.astype(jnp.int32)
 
-    pt = measure_pass_seconds(one_pass, sharded_args, k_small=5, k_large=max(args.iters, 105))
+    if args.quick:
+        k_small, k_large = 3, max(args.iters, 13)
+    else:
+        k_small, k_large = 5, max(args.iters, 105)
+    pt = measure_pass_seconds(one_pass, sharded_args, k_small=k_small, k_large=k_large)
     pass_time = pt.seconds
     proofs_per_sec = proofs_per_pass / pass_time
     events_per_sec = total_events / pass_time

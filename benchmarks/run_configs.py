@@ -108,8 +108,9 @@ def config3_storage_slots(quick: bool):
         for c in range(n_contracts)
         for i in range(slots_per_contract)
     ]
+    backend.keccak256_batch(preimages)  # discard: compile + first transfer
     start = time.perf_counter()
-    slot_keys = backend.keccak256_batch(preimages)  # compile + E2E (incl. host pack/transfer)
+    slot_keys = backend.keccak256_batch(preimages)  # warmed E2E (host pack + transfer + kernel)
     t_hash_e2e = time.perf_counter() - start
 
     # device kernel rate, slope-timed (tunnel RTT cancelled)
@@ -157,13 +158,20 @@ def config3_storage_slots(quick: bool):
         keccak256(p)
     scalar_rate = sample / (time.perf_counter() - scalar_start)
 
-    rate = n_slots / (t_hash + t_lookup)
+    # Two honest numbers: device kernel slope (tunnel cancelled) and the warmed
+    # end-to-end batch call (host pack + transfer + kernel) that a user actually
+    # pays. vs_baseline compares e2e-to-e2e so the ratio is apples-to-apples.
+    device_rate = n_slots / t_hash
+    e2e_rate = n_slots / t_hash_e2e
+    rate = n_slots / (t_hash_e2e + t_lookup)
     _log(
         f"config3: {n_slots} slots / {n_contracts} roots — device hash {t_hash*1e3:.2f}ms "
-        f"(e2e incl. transfer {t_hash_e2e:.2f}s), build {t_build:.1f}s, lookup {t_lookup:.2f}s"
+        f"(warmed e2e incl. transfer {t_hash_e2e:.2f}s), build {t_build:.1f}s, "
+        f"lookup {t_lookup:.2f}s"
     )
     _emit("storage_slot_lookups_per_sec", rate, "slots/s",
-          vs_baseline=round((n_slots / t_hash) / scalar_rate, 2))
+          vs_baseline=round(e2e_rate / scalar_rate, 2),
+          device_hash_rate=round(device_rate, 1), e2e_hash_rate=round(e2e_rate, 1))
 
 
 def config4_witness_cids(quick: bool):
@@ -302,17 +310,9 @@ def main():
     args = parser.parse_args()
 
     if args.platform == "auto":
-        import subprocess
+        from ipc_proofs_tpu.utils.platform import pick_platform
 
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, timeout=240, text=True,
-            )
-            ok = probe.returncode == 0 and probe.stdout.strip()
-            args.platform = "default" if ok else "cpu"
-        except Exception:
-            args.platform = "cpu"
+        args.platform = pick_platform("auto", log=_log)
         _log(f"platform probe → {args.platform}")
     if args.platform == "cpu":
         import jax

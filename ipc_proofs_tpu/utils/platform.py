@@ -1,0 +1,52 @@
+"""Benchmark platform selection shared by bench.py and benchmarks/.
+
+On this environment the default JAX backend may be a TPU chip behind a
+network tunnel whose initialization can hang; 'auto' therefore probes it in
+a subprocess with a timeout so a hung chip claim cannot hang the caller.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Callable
+
+__all__ = ["pick_platform"]
+
+
+def _default_log(*args) -> None:
+    print(*args, file=sys.stderr, flush=True)
+
+
+def pick_platform(
+    requested: str,
+    probe_timeout: float = 240.0,
+    log: Callable[..., None] = _default_log,
+) -> str:
+    """Resolve 'auto' to 'default' (probe succeeded) or 'cpu'.
+
+    Any explicit request ('cpu', 'default', ...) passes through untouched.
+    The IPC_BENCH_PLATFORM env var short-circuits the probe.
+    """
+    if requested != "auto":
+        return requested
+    if os.environ.get("IPC_BENCH_PLATFORM"):
+        return os.environ["IPC_BENCH_PLATFORM"]
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=probe_timeout,
+            text=True,
+        )
+        if probe.returncode == 0 and probe.stdout.strip():
+            platform = probe.stdout.strip().splitlines()[-1]
+            log(f"bench: default backend probe OK → platform {platform!r}")
+            return "default"
+        log(f"bench: probe exited rc={probe.returncode} — falling back to CPU")
+    except subprocess.TimeoutExpired:
+        log("bench: default backend probe timed out — falling back to CPU")
+    except Exception as exc:  # pragma: no cover
+        log(f"bench: probe failed ({exc}) — falling back to CPU")
+    return "cpu"

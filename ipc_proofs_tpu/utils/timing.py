@@ -65,6 +65,11 @@ def measure_pass_seconds(
     Returns:
       PassTime with the per-pass seconds (clamped to >= 1 ns).
     """
+    if not (k_large > k_small >= 1):
+        raise ValueError(
+            f"need k_large > k_small >= 1, got k_small={k_small} k_large={k_large}"
+        )
+
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -73,8 +78,8 @@ def measure_pass_seconds(
         @jax.jit
         def run(*a):
             def step(i, acc):
-                out = body(i, *a)
-                return acc + out.astype(jnp.int64 if acc.dtype == jnp.int64 else jnp.int32)
+                # int32 carry; wraparound is harmless — only timing matters.
+                return acc + body(i, *a).astype(jnp.int32)
 
             return lax.fori_loop(0, k, step, jnp.int32(0))
 
