@@ -14,7 +14,7 @@ import threading
 from pathlib import Path
 from typing import Optional
 
-__all__ = ["load_native", "NativeHashes", "load_dagcbor_ext"]
+__all__ = ["load_native", "NativeHashes", "load_dagcbor_ext", "load_scan_ext"]
 
 _SRC = Path(__file__).parent / "hashes.cpp"
 _BUILD_DIR = Path(__file__).parent / "build"
@@ -23,9 +23,13 @@ _SO_PATH = _BUILD_DIR / "libipchashes.so"
 _DAGCBOR_SRC = Path(__file__).parent / "dagcbor_ext.c"
 _DAGCBOR_SO = _BUILD_DIR / "ipc_dagcbor_ext.so"
 
+_SCAN_SRC = Path(__file__).parent / "scan_ext.c"
+_SCAN_SO = _BUILD_DIR / "ipc_scan_ext.so"
+
 _lock = threading.Lock()
 _cached: "NativeHashes | None | bool" = False  # False = not attempted yet
 _dagcbor_cached: "object | None | bool" = False
+_scan_cached: "object | None | bool" = False
 
 
 class NativeHashes:
@@ -107,29 +111,7 @@ def load_dagcbor_ext():
             _dagcbor_cached = None
             return None
         try:
-            import sysconfig
-
-            _BUILD_DIR.mkdir(exist_ok=True)
-            if not (
-                _DAGCBOR_SO.exists()
-                and _DAGCBOR_SO.stat().st_mtime >= _DAGCBOR_SRC.stat().st_mtime
-            ):
-                include = sysconfig.get_paths()["include"]
-                subprocess.run(
-                    [
-                        "gcc", "-O2", "-shared", "-fPIC",
-                        f"-I{include}",
-                        str(_DAGCBOR_SRC), "-o", str(_DAGCBOR_SO),
-                    ],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location("ipc_dagcbor_ext", _DAGCBOR_SO)
-            module = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(module)
+            module = _build_cpython_ext(_DAGCBOR_SRC, _DAGCBOR_SO, "ipc_dagcbor_ext")
             from ipc_proofs_tpu.core.cid import CID  # deferred: avoids import cycle
 
             module.set_cid_factory(CID.from_bytes)
@@ -137,6 +119,46 @@ def load_dagcbor_ext():
         except Exception:
             _dagcbor_cached = None
         return _dagcbor_cached
+
+
+def _build_cpython_ext(src: Path, so: Path, mod_name: str):
+    """Compile (mtime-cached) and import a raw-CPython-API extension."""
+    import importlib.util
+    import sysconfig
+
+    _BUILD_DIR.mkdir(exist_ok=True)
+    if not (so.exists() and so.stat().st_mtime >= src.stat().st_mtime):
+        include = sysconfig.get_paths()["include"]
+        subprocess.run(
+            ["gcc", "-O2", "-shared", "-fPIC", f"-I{include}", str(src), "-o", str(so)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    spec = importlib.util.spec_from_file_location(mod_name, so)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def load_scan_ext():
+    """Compile (if needed) and import the native Phase-A scanner module.
+
+    Returns the extension module with ``scan_events_batch``, or None on any
+    failure (callers fall back to the pure-Python scan path).
+    """
+    global _scan_cached
+    with _lock:
+        if _scan_cached is not False:
+            return _scan_cached
+        if os.environ.get("IPC_PROOFS_NO_NATIVE"):
+            _scan_cached = None
+            return None
+        try:
+            _scan_cached = _build_cpython_ext(_SCAN_SRC, _SCAN_SO, "ipc_scan_ext")
+        except Exception:
+            _scan_cached = None
+        return _scan_cached
 
 
 def load_native() -> Optional[NativeHashes]:

@@ -1,0 +1,662 @@
+/* Native Phase-A scanner: receipts AMT -> events AMTs -> flat event tensors.
+ *
+ * The host side of pass 1 of the event-proof generator (the reference's
+ * hottest loop, src/proofs/events/generator.rs:206-239) decodes every event
+ * of every receipt.  The pure-Python path materializes Receipt/StampedEvent/
+ * EventEntry objects per event; this extension walks the raw IPLD blocks
+ * directly and emits the padded arrays the device match kernel consumes
+ * (topics u32[N,2,8], n_topics, emitters, valid, pair/receipt/event ids) —
+ * no per-event Python objects anywhere.
+ *
+ * Block access: a dict {cid_bytes: block_bytes} (fast path, C dict lookup)
+ * plus an optional fallback callable(cid_bytes)->bytes|None for stores that
+ * cannot expose a raw map (RPC-backed).  The scanner never records — pass 1
+ * is deliberately witness-free, matching the reference's throwaway recorder.
+ *
+ * Build: gcc -O2 -shared -fPIC -I<python-include> scan_ext.c -o ipc_scan_ext.so
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ---------------- CBOR primitives (DAG-CBOR subset) ---------------- */
+
+typedef struct {
+  const uint8_t *data;
+  Py_ssize_t len;
+  Py_ssize_t pos;
+} Parser;
+
+static int rd_head(Parser *p, int *major, uint64_t *value) {
+  if (p->pos >= p->len) {
+    PyErr_SetString(PyExc_ValueError, "truncated CBOR head");
+    return -1;
+  }
+  uint8_t byte = p->data[p->pos++];
+  *major = byte >> 5;
+  uint8_t info = byte & 0x1f;
+  if (info < 24) {
+    *value = info;
+    return 0;
+  }
+  int extra;
+  switch (info) {
+    case 24: extra = 1; break;
+    case 25: extra = 2; break;
+    case 26: extra = 4; break;
+    case 27: extra = 8; break;
+    default:
+      PyErr_SetString(PyExc_ValueError, "indefinite CBOR length in DAG-CBOR");
+      return -1;
+  }
+  if (p->pos + extra > p->len) {
+    PyErr_SetString(PyExc_ValueError, "truncated CBOR head");
+    return -1;
+  }
+  uint64_t v = 0;
+  for (int i = 0; i < extra; i++) v = (v << 8) | p->data[p->pos++];
+  *value = v;
+  return info;
+}
+
+static int skip_item(Parser *p) {
+  int major;
+  uint64_t value;
+  int info = rd_head(p, &major, &value);
+  if (info < 0) return -1;
+  switch (major) {
+    case 0:
+    case 1:
+      return 0;
+    case 2:
+    case 3:
+      if (p->pos + (Py_ssize_t)value > p->len) {
+        PyErr_SetString(PyExc_ValueError, "truncated CBOR bytes/text");
+        return -1;
+      }
+      p->pos += (Py_ssize_t)value;
+      return 0;
+    case 4:
+      for (uint64_t i = 0; i < value; i++)
+        if (skip_item(p) < 0) return -1;
+      return 0;
+    case 5:
+      for (uint64_t i = 0; i < value; i++) {
+        if (skip_item(p) < 0) return -1;
+        if (skip_item(p) < 0) return -1;
+      }
+      return 0;
+    case 6:
+      return skip_item(p);
+    case 7:
+      return 0;
+  }
+  PyErr_SetString(PyExc_ValueError, "unreachable CBOR major");
+  return -1;
+}
+
+/* expect an array head, return its length */
+static int rd_array(Parser *p, uint64_t *n) {
+  int major;
+  if (rd_head(p, &major, n) < 0) return -1;
+  if (major != 4) {
+    PyErr_SetString(PyExc_ValueError, "expected CBOR array");
+    return -1;
+  }
+  return 0;
+}
+
+/* expect bytes, return span */
+static int rd_bytes(Parser *p, const uint8_t **ptr, Py_ssize_t *blen) {
+  int major;
+  uint64_t value;
+  if (rd_head(p, &major, &value) < 0) return -1;
+  if (major != 2 || p->pos + (Py_ssize_t)value > p->len) {
+    PyErr_SetString(PyExc_ValueError, "expected CBOR bytes");
+    return -1;
+  }
+  *ptr = p->data + p->pos;
+  *blen = (Py_ssize_t)value;
+  p->pos += (Py_ssize_t)value;
+  return 0;
+}
+
+/* expect uint, return value */
+static int rd_uint(Parser *p, uint64_t *value) {
+  int major;
+  if (rd_head(p, &major, value) < 0) return -1;
+  if (major != 0) {
+    PyErr_SetString(PyExc_ValueError, "expected CBOR uint");
+    return -1;
+  }
+  return 0;
+}
+
+/* tag-42 CID: returns span of cid bytes (multibase 0x00 stripped), or
+ * no-CID (ok=0) when the item is null.  Errors set an exception. */
+static int rd_cid_or_null(Parser *p, const uint8_t **ptr, Py_ssize_t *clen, int *ok) {
+  int major;
+  uint64_t value;
+  int info = rd_head(p, &major, &value);
+  if (info < 0) return -1;
+  if (major == 7 && value == 22) { /* null */
+    *ok = 0;
+    return 0;
+  }
+  if (major != 6 || value != 42) {
+    PyErr_SetString(PyExc_ValueError, "expected CID or null");
+    return -1;
+  }
+  const uint8_t *raw;
+  Py_ssize_t rlen;
+  if (rd_bytes(p, &raw, &rlen) < 0) return -1;
+  if (rlen < 2 || raw[0] != 0) {
+    PyErr_SetString(PyExc_ValueError, "tag-42 must hold identity-multibase CID");
+    return -1;
+  }
+  *ptr = raw + 1;
+  *clen = rlen - 1;
+  *ok = 1;
+  return 0;
+}
+
+/* ---------------- growable output buffers ---------------- */
+
+typedef struct {
+  uint8_t *buf;
+  size_t len, cap;
+} Vec;
+
+static int vec_push(Vec *v, const void *src, size_t n) {
+  if (v->len + n > v->cap) {
+    size_t cap = v->cap ? v->cap * 2 : 4096;
+    while (cap < v->len + n) cap *= 2;
+    uint8_t *nb = PyMem_Realloc(v->buf, cap);
+    if (!nb) {
+      PyErr_NoMemory();
+      return -1;
+    }
+    v->buf = nb;
+    v->cap = cap;
+  }
+  memcpy(v->buf + v->len, src, n);
+  v->len += n;
+  return 0;
+}
+
+static void vec_free(Vec *v) {
+  PyMem_Free(v->buf);
+  v->buf = NULL;
+}
+
+typedef struct {
+  Vec topics;   /* u32[2][8] per event (64 B) */
+  Vec n_topics; /* i32 */
+  Vec emitters; /* u64 */
+  Vec valid;    /* u8 */
+  Vec pair_ids; /* i32 */
+  Vec exec_idx; /* i32 */
+  Vec event_idx;/* i32 */
+  /* payload mode (verification): full topics / data bytes, pooled */
+  Vec topics_pool;
+  Vec data_pool;
+  Vec topics_off; /* u32 per event: start offset into topics_pool */
+  Vec data_off;   /* u32 per event: start offset into data_pool */
+  Vec data_len;   /* u32 per event */
+  int64_t n_events;
+  int64_t n_receipts; /* receipts with an events root, across all pairs */
+  PyObject *blocks;   /* borrowed: dict {cid_bytes: block_bytes} */
+  PyObject *fallback; /* borrowed: callable(cid_bytes)->bytes|None, or NULL */
+  int skip_missing;   /* 1 = prune subtrees whose blocks are absent */
+  int want_payload;   /* 1 = fill the payload pools */
+} Scan;
+
+/* fetch a block: 1 = ok (*out new ref), 0 = missing + skip_missing (prune),
+ * -1 = error (exception set). */
+static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
+                     PyObject **out) {
+  PyObject *key = PyBytes_FromStringAndSize((const char *)cid, clen);
+  if (!key) return -1;
+  PyObject *hit = PyDict_GetItemWithError(s->blocks, key);
+  if (hit) {
+    Py_INCREF(hit);
+    Py_DECREF(key);
+    if (!PyBytes_Check(hit)) {
+      Py_DECREF(hit);
+      PyErr_SetString(PyExc_TypeError, "block map values must be bytes");
+      return -1;
+    }
+    *out = hit;
+    return 1;
+  }
+  if (PyErr_Occurred()) {
+    Py_DECREF(key);
+    return -1;
+  }
+  if (s->fallback && s->fallback != Py_None) {
+    PyObject *res = PyObject_CallOneArg(s->fallback, key);
+    Py_DECREF(key);
+    if (!res) return -1;
+    if (res == Py_None) {
+      Py_DECREF(res);
+      if (s->skip_missing) return 0;
+      PyErr_SetString(PyExc_KeyError, "missing block");
+      return -1;
+    }
+    if (!PyBytes_Check(res)) {
+      Py_DECREF(res);
+      PyErr_SetString(PyExc_TypeError, "fallback get must return bytes");
+      return -1;
+    }
+    *out = res;
+    return 1;
+  }
+  Py_DECREF(key);
+  if (s->skip_missing) return 0;
+  PyErr_SetString(PyExc_KeyError, "missing block");
+  return -1;
+}
+
+/* ---------------- EVM log extraction (state/events.py parity) -------- */
+
+/* one stamped event value: [emitter, [[flags,key,codec,value],...]] */
+static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
+                      int32_t ev_idx) {
+  uint64_t n_fields;
+  if (rd_array(p, &n_fields) < 0) return -1;
+  if (n_fields != 2) {
+    PyErr_SetString(PyExc_ValueError, "StampedEvent must be a 2-tuple");
+    return -1;
+  }
+  uint64_t emitter;
+  if (rd_uint(p, &emitter) < 0) return -1;
+
+  uint64_t n_entries;
+  if (rd_array(p, &n_entries) < 0) return -1;
+
+  /* spans; last occurrence wins (dict-comprehension parity) */
+  const uint8_t *topics_ptr = NULL; Py_ssize_t topics_len = -1;
+  const uint8_t *t_ptr[4] = {0}; Py_ssize_t t_len[4] = {-1, -1, -1, -1};
+  const uint8_t *dataA_ptr = NULL; Py_ssize_t dataA_len = -1; /* "data" */
+  const uint8_t *dataB_ptr = NULL; Py_ssize_t dataB_len = -1; /* "d" */
+
+  for (uint64_t e = 0; e < n_entries; e++) {
+    uint64_t entry_fields;
+    if (rd_array(p, &entry_fields) < 0) return -1;
+    if (entry_fields != 4) {
+      PyErr_SetString(PyExc_ValueError, "event entry must be a 4-tuple");
+      return -1;
+    }
+    if (skip_item(p) < 0) return -1; /* flags */
+    int major;
+    uint64_t klen;
+    if (rd_head(p, &major, &klen) < 0) return -1;
+    if (major != 3 || p->pos + (Py_ssize_t)klen > p->len) {
+      PyErr_SetString(PyExc_ValueError, "event entry key must be text");
+      return -1;
+    }
+    const uint8_t *key = p->data + p->pos;
+    p->pos += (Py_ssize_t)klen;
+    if (skip_item(p) < 0) return -1; /* codec */
+    const uint8_t *vptr;
+    Py_ssize_t vlen;
+    if (rd_bytes(p, &vptr, &vlen) < 0) return -1; /* value (always bytes) */
+
+    if (klen == 6 && memcmp(key, "topics", 6) == 0) {
+      topics_ptr = vptr;
+      topics_len = vlen;
+    } else if (klen == 2 && key[0] == 't' && key[1] >= '1' && key[1] <= '4') {
+      int k = key[1] - '1';
+      t_ptr[k] = vptr;
+      t_len[k] = vlen;
+    } else if (klen == 4 && memcmp(key, "data", 4) == 0) {
+      dataA_ptr = vptr;
+      dataA_len = vlen;
+    } else if (klen == 1 && key[0] == 'd') {
+      dataB_ptr = vptr;
+      dataB_len = vlen;
+    }
+  }
+
+  uint8_t topic_words[64]; /* 2 topics x 32 B */
+  memset(topic_words, 0, sizeof(topic_words));
+  int32_t n_topics = 0;
+  uint8_t valid = 0;
+  int case_a = topics_len >= 0;
+
+  if (case_a) { /* Case A: concatenated 32-byte chunks */
+    if (topics_len % 32 == 0) {
+      valid = 1;
+      n_topics = (int32_t)(topics_len / 32);
+      Py_ssize_t take = topics_len < 64 ? topics_len : 64;
+      memcpy(topic_words, topics_ptr, take);
+    }
+  } else { /* Case B: compact t1..t4, stop at first missing */
+    for (int k = 0; k < 4; k++) {
+      if (t_len[k] < 0) break;
+      if (t_len[k] != 32) {
+        n_topics = 0; /* malformed -> not EVM-shaped (extract returns None) */
+        valid = 0;
+        goto done;
+      }
+      if (k < 2) memcpy(topic_words + 32 * k, t_ptr[k], 32);
+      n_topics++;
+    }
+    valid = n_topics > 0;
+  }
+
+done:;
+  if (s->want_payload) {
+    uint32_t toff = (uint32_t)s->topics_pool.len;
+    uint32_t doff = (uint32_t)s->data_pool.len;
+    uint32_t dlen = 0;
+    if (valid) {
+      if (case_a) {
+        if (vec_push(&s->topics_pool, topics_ptr, (size_t)topics_len) < 0)
+          return -1;
+        if (dataA_len > 0) {
+          if (vec_push(&s->data_pool, dataA_ptr, (size_t)dataA_len) < 0)
+            return -1;
+          dlen = (uint32_t)dataA_len;
+        }
+      } else {
+        for (int k = 0; k < n_topics; k++)
+          if (vec_push(&s->topics_pool, t_ptr[k], 32) < 0) return -1;
+        if (dataB_len > 0) {
+          if (vec_push(&s->data_pool, dataB_ptr, (size_t)dataB_len) < 0)
+            return -1;
+          dlen = (uint32_t)dataB_len;
+        }
+      }
+    }
+    if (vec_push(&s->topics_off, &toff, 4) < 0) return -1;
+    if (vec_push(&s->data_off, &doff, 4) < 0) return -1;
+    if (vec_push(&s->data_len, &dlen, 4) < 0) return -1;
+  }
+  int32_t ids[3] = {pair_id, rcpt_idx, ev_idx};
+  if (vec_push(&s->topics, topic_words, 64) < 0) return -1;
+  if (vec_push(&s->n_topics, &n_topics, 4) < 0) return -1;
+  if (vec_push(&s->emitters, &emitter, 8) < 0) return -1;
+  if (vec_push(&s->valid, &valid, 1) < 0) return -1;
+  if (vec_push(&s->pair_ids, &ids[0], 4) < 0) return -1;
+  if (vec_push(&s->exec_idx, &ids[1], 4) < 0) return -1;
+  if (vec_push(&s->event_idx, &ids[2], 4) < 0) return -1;
+  s->n_events++;
+  return 0;
+}
+
+/* ---------------- AMT walk (ipld/amt.py parity) ---------------- */
+
+typedef int (*leaf_fn)(Scan *s, Parser *p, int64_t index, void *ctx);
+
+static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
+                     Parser *inline_node, int bit_width, int height,
+                     int64_t base, leaf_fn fn, void *ctx) {
+  PyObject *block = NULL;
+  Parser local;
+  Parser *p;
+  if (inline_node) {
+    p = inline_node;
+  } else {
+    int st = get_block(s, cid, clen, &block);
+    if (st < 0) return -1;
+    if (st == 0) return 0; /* pruned: block absent under skip_missing */
+    local.data = (const uint8_t *)PyBytes_AS_STRING(block);
+    local.len = PyBytes_GET_SIZE(block);
+    local.pos = 0;
+    p = &local;
+  }
+
+  int rc = -1;
+  uint64_t parts;
+  if (rd_array(p, &parts) < 0 || parts != 3) {
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_ValueError, "malformed AMT node");
+    goto out;
+  }
+  const uint8_t *bmap;
+  Py_ssize_t bmap_len;
+  if (rd_bytes(p, &bmap, &bmap_len) < 0) goto out;
+
+  int width = 1 << bit_width;
+  if (bmap_len * 8 < width) {
+    PyErr_SetString(PyExc_ValueError, "AMT bitmap too short");
+    goto out;
+  }
+
+  /* links array: collect spans */
+  uint64_t n_links;
+  if (rd_array(p, &n_links) < 0) goto out;
+  if (n_links > (uint64_t)width) {
+    PyErr_SetString(PyExc_ValueError, "too many AMT links");
+    goto out;
+  }
+  const uint8_t *link_ptr[256];
+  Py_ssize_t link_len[256];
+  for (uint64_t i = 0; i < n_links; i++) {
+    int ok;
+    if (rd_cid_or_null(p, &link_ptr[i], &link_len[i], &ok) < 0) goto out;
+    if (!ok) {
+      PyErr_SetString(PyExc_ValueError, "null AMT link");
+      goto out;
+    }
+  }
+
+  uint64_t n_values;
+  if (rd_array(p, &n_values) < 0) goto out;
+
+  /* pop-count ascending slots; links/values appear in set-bit order */
+  int64_t span = 1;
+  for (int h = 0; h < height; h++) span *= width;
+
+  int pos = 0;
+  uint64_t used_values = 0;
+  for (int slot = 0; slot < width; slot++) {
+    if (!((bmap[slot >> 3] >> (slot & 7)) & 1)) continue;
+    if (height == 0) {
+      if ((uint64_t)pos >= n_values) {
+        PyErr_SetString(PyExc_ValueError, "AMT leaf bitmap/values mismatch");
+        goto out;
+      }
+      if (fn(s, p, base + slot, ctx) < 0) goto out;
+      used_values++;
+    } else {
+      if ((uint64_t)pos >= n_links) {
+        PyErr_SetString(PyExc_ValueError, "AMT node bitmap/links mismatch");
+        goto out;
+      }
+      if (walk_node(s, link_ptr[pos], link_len[pos], NULL, bit_width,
+                    height - 1, base + slot * span, fn, ctx) < 0)
+        goto out;
+    }
+    pos++;
+  }
+  if (height == 0 && used_values != n_values) {
+    PyErr_SetString(PyExc_ValueError, "AMT leaf value count mismatch");
+    goto out;
+  }
+  rc = 0;
+out:
+  Py_XDECREF(block);
+  return rc;
+}
+
+/* Walk an AMT root block.  expected_version: 0 (root [h,c,node], bw=3) or
+ * 3 (root [bw,h,c,node]). */
+static int walk_amt_root(Scan *s, const uint8_t *cid, Py_ssize_t clen,
+                         int expected_version, leaf_fn fn, void *ctx) {
+  PyObject *block = NULL;
+  int st = get_block(s, cid, clen, &block);
+  if (st < 0) return -1;
+  if (st == 0) return 0; /* pruned root */
+  Parser p = {(const uint8_t *)PyBytes_AS_STRING(block),
+              PyBytes_GET_SIZE(block), 0};
+  int rc = -1;
+  uint64_t arity;
+  if (rd_array(&p, &arity) < 0) goto out;
+  int bit_width, height;
+  uint64_t tmp;
+  if (arity == 4) {
+    if (expected_version != 3) {
+      PyErr_SetString(PyExc_ValueError, "expected AMT v0, found v3");
+      goto out;
+    }
+    if (rd_uint(&p, &tmp) < 0) goto out;
+    bit_width = (int)tmp;
+  } else if (arity == 3) {
+    if (expected_version != 0) {
+      PyErr_SetString(PyExc_ValueError, "expected AMT v3, found v0");
+      goto out;
+    }
+    bit_width = 3;
+  } else {
+    PyErr_SetString(PyExc_ValueError, "unrecognized AMT root arity");
+    goto out;
+  }
+  if (bit_width < 1 || bit_width > 8) {
+    PyErr_SetString(PyExc_ValueError, "invalid AMT bit width");
+    goto out;
+  }
+  if (rd_uint(&p, &tmp) < 0) goto out; /* height */
+  height = (int)tmp;
+  if (height < 0 || height > 64) {
+    PyErr_SetString(PyExc_ValueError, "invalid AMT height");
+    goto out;
+  }
+  if (rd_uint(&p, &tmp) < 0) goto out; /* count (unused) */
+  rc = walk_node(s, NULL, 0, &p, bit_width, height, 0, fn, ctx);
+out:
+  Py_DECREF(block);
+  return rc;
+}
+
+/* ---------------- receipts -> events plumbing ---------------- */
+
+typedef struct {
+  int32_t pair_id;
+  int32_t rcpt_idx;
+  int32_t next_event_pos; /* running event index within one events AMT */
+} EvCtx;
+
+static int event_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
+  EvCtx *c = (EvCtx *)ctx;
+  return emit_event(s, p, c->pair_id, c->rcpt_idx, (int32_t)index);
+}
+
+typedef struct {
+  int32_t pair_id;
+} RcptCtx;
+
+static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
+  RcptCtx *c = (RcptCtx *)ctx;
+  uint64_t arity;
+  if (rd_array(p, &arity) < 0) return -1;
+  if (arity != 3 && arity != 4) {
+    PyErr_SetString(PyExc_ValueError, "receipt must be a 3/4-tuple");
+    return -1;
+  }
+  if (skip_item(p) < 0) return -1; /* exit_code */
+  if (skip_item(p) < 0) return -1; /* return_data */
+  if (skip_item(p) < 0) return -1; /* gas_used */
+  if (arity == 3) return 0;        /* no events root */
+  const uint8_t *ev_cid;
+  Py_ssize_t ev_len;
+  int ok;
+  if (rd_cid_or_null(p, &ev_cid, &ev_len, &ok) < 0) return -1;
+  if (!ok) return 0; /* null events root: skip (scan_receipt_events parity) */
+
+  s->n_receipts++;
+  EvCtx ec = {c->pair_id, (int32_t)index, 0};
+  return walk_amt_root(s, ev_cid, ev_len, 3, event_leaf, &ec);
+}
+
+/* ---------------- module entry ---------------- */
+
+static PyObject *make_array_bytes(Vec *v) {
+  return PyBytes_FromStringAndSize((const char *)(v->buf ? v->buf : (uint8_t *)""),
+                                   (Py_ssize_t)v->len);
+}
+
+static void scan_free(Scan *s) {
+  vec_free(&s->topics); vec_free(&s->n_topics); vec_free(&s->emitters);
+  vec_free(&s->valid); vec_free(&s->pair_ids); vec_free(&s->exec_idx);
+  vec_free(&s->event_idx); vec_free(&s->topics_pool); vec_free(&s->data_pool);
+  vec_free(&s->topics_off); vec_free(&s->data_off); vec_free(&s->data_len);
+}
+
+static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
+                                      PyObject *kwargs) {
+  PyObject *blocks, *roots, *fallback = Py_None;
+  int skip_missing = 0, want_payload = 0;
+  static char *kwlist[] = {"blocks", "roots", "fallback", "skip_missing",
+                           "want_payload", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Opp", kwlist,
+                                   &PyDict_Type, &blocks, &roots, &fallback,
+                                   &skip_missing, &want_payload))
+    return NULL;
+  PyObject *seq = PySequence_Fast(roots, "roots must be a sequence of cid bytes");
+  if (!seq) return NULL;
+
+  Scan s;
+  memset(&s, 0, sizeof(s));
+  s.blocks = blocks;
+  s.fallback = fallback;
+  s.skip_missing = skip_missing;
+  s.want_payload = want_payload;
+
+  Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n_roots; i++) {
+    PyObject *root = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyBytes_Check(root)) {
+      PyErr_SetString(PyExc_TypeError, "roots must be bytes (raw CID bytes)");
+      goto fail;
+    }
+    RcptCtx rc = {(int32_t)i};
+    if (walk_amt_root(&s, (const uint8_t *)PyBytes_AS_STRING(root),
+                      PyBytes_GET_SIZE(root), 0, receipt_leaf, &rc) < 0)
+      goto fail;
+  }
+
+  {
+    PyObject *result = Py_BuildValue(
+        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L}",
+        "topics", make_array_bytes(&s.topics),
+        "n_topics", make_array_bytes(&s.n_topics),
+        "emitters", make_array_bytes(&s.emitters),
+        "valid", make_array_bytes(&s.valid),
+        "pair_ids", make_array_bytes(&s.pair_ids),
+        "exec_idx", make_array_bytes(&s.exec_idx),
+        "event_idx", make_array_bytes(&s.event_idx),
+        "topics_pool", make_array_bytes(&s.topics_pool),
+        "data_pool", make_array_bytes(&s.data_pool),
+        "topics_off", make_array_bytes(&s.topics_off),
+        "data_off", make_array_bytes(&s.data_off),
+        "data_len", make_array_bytes(&s.data_len),
+        "n_events", (long long)s.n_events,
+        "n_receipts", (long long)s.n_receipts);
+    Py_DECREF(seq);
+    scan_free(&s);
+    return result;
+  }
+
+fail:
+  Py_DECREF(seq);
+  scan_free(&s);
+  return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"scan_events_batch", (PyCFunction)(void (*)(void))py_scan_events_batch,
+     METH_VARARGS | METH_KEYWORDS,
+     "scan_events_batch(blocks_dict, roots, fallback=None, skip_missing=False,"
+     " want_payload=False) -> dict of flat array buffers over every event of "
+     "every receipt of every root."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_scan_ext",
+                                       "Native receipts/events AMT scanner",
+                                       -1, methods};
+
+PyMODINIT_FUNC PyInit_ipc_scan_ext(void) { return PyModule_Create(&moduledef); }

@@ -49,11 +49,9 @@ class TpuBackend:
 
         from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
         from ipc_proofs_tpu.ops.keccak_jax import keccak256_blocks
-        from ipc_proofs_tpu.ops.match_jax import event_match_mask
 
         self._keccak = keccak256_blocks
         self._blake2b = blake2b256_blocks
-        self._match = event_match_mask
 
     def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
         import jax.numpy as jnp
@@ -90,21 +88,38 @@ class TpuBackend:
         topic1: bytes,
         actor_id_filter: Optional[int],
     ) -> list[bool]:
-        import jax.numpy as jnp
-
         if not events:
             return []
         topics, n_topics, emitters, valid = flatten_events(events)
-        mask = self._match(
-            jnp.asarray(topics),
-            jnp.asarray(n_topics),
-            jnp.asarray(emitters),
-            jnp.asarray(valid),
-            jnp.asarray(np.frombuffer(topic0, dtype="<u4")),
-            jnp.asarray(np.frombuffer(topic1, dtype="<u4")),
-            actor_id_filter=actor_id_filter,
+        return self.event_match_mask_flat(
+            topics, n_topics, emitters, valid, topic0, topic1, actor_id_filter
+        )[: len(events)].tolist()
+
+    def event_match_mask_flat(
+        self,
+        topics: np.ndarray,
+        n_topics: np.ndarray,
+        emitters: np.ndarray,
+        valid: np.ndarray,
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> np.ndarray:
+        """Mask over pre-flattened arrays (the no-Python-objects fast path the
+        C scanner feeds). One jitted dispatch, bucket-padded shapes, single
+        readback; returns the padded bool array (slice to true length)."""
+        from ipc_proofs_tpu.ops.match_jax import event_match_mask_jit
+
+        mask = event_match_mask_jit(
+            topics,
+            n_topics,
+            emitters,
+            valid,
+            np.frombuffer(topic0, dtype="<u4"),
+            np.frombuffer(topic1, dtype="<u4"),
+            actor_id_filter,
         )
-        return [bool(x) for x in np.asarray(mask)]
+        return np.asarray(mask)
 
     def any_event_matches(
         self,

@@ -118,4 +118,8 @@ class CID:
         return self.to_bytes() < other.to_bytes()
 
     def __hash__(self) -> int:  # dataclass frozen gives eq; keep hash cheap
-        return hash(self.digest)
+        cached = self.__dict__.get("_hash")
+        if cached is None:
+            cached = hash(self.digest)
+            object.__setattr__(self, "_hash", cached)  # frozen-safe memo
+        return cached

@@ -8,9 +8,10 @@ padded ``[events, ...]`` tensor plus a segment any-reduce per receipt.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-__all__ = ["event_match_mask", "receipts_with_match"]
+__all__ = ["event_match_mask", "event_match_mask_jit", "receipts_with_match", "pad_to_bucket"]
 
 
 def event_match_mask(
@@ -30,6 +31,46 @@ def event_match_mask(
     if actor_id_filter is not None:
         mask = mask & (emitters == actor_id_filter)
     return mask
+
+
+@jax.jit
+def _match_mask_topics(topics, n_topics, valid, topic0, topic1):
+    t0_eq = jnp.all(topics[:, 0, :] == topic0[None, :], axis=-1)
+    t1_eq = jnp.all(topics[:, 1, :] == topic1[None, :], axis=-1)
+    return valid & (n_topics >= 2) & t0_eq & t1_eq
+
+
+def pad_to_bucket(n: int, minimum: int = 256) -> int:
+    """Round an event count up to a power-of-two bucket so jit traces a small
+    fixed set of shapes instead of recompiling per range size."""
+    bucket = minimum
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def event_match_mask_jit(topics, n_topics, emitters, valid, topic0, topic1, actor_id_filter=None):
+    """Jitted, shape-bucketed wrapper: one fused kernel, one dispatch.
+
+    Inputs are host numpy arrays of true length N; they are zero-padded to a
+    power-of-two bucket (padding rows have valid=False) so repeated calls at
+    nearby sizes hit the jit cache. The emitter filter is applied host-side
+    in numpy (actor IDs are u64 — exact regardless of jax x64 mode); the
+    device kernel checks only topic equality. Returns a device bool array of
+    the padded length — slice ``[:N]`` after readback.
+    """
+    import numpy as np
+
+    if actor_id_filter is not None:
+        valid = valid & (np.asarray(emitters) == actor_id_filter)
+    n = topics.shape[0]
+    bucket = pad_to_bucket(n)
+    if bucket != n:
+        pad = bucket - n
+        topics = np.concatenate([topics, np.zeros((pad, 2, 8), topics.dtype)])
+        n_topics = np.concatenate([n_topics, np.zeros(pad, n_topics.dtype)])
+        valid = np.concatenate([valid, np.zeros(pad, valid.dtype)])
+    return _match_mask_topics(topics, n_topics, valid, topic0, topic1)
 
 
 def receipts_with_match(mask, receipt_ids, num_receipts: int):

@@ -125,20 +125,60 @@ def generate_event_proofs_for_range(
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
 
-    # Phase A: host decode of every pair's receipts + events.
+    # Phase A: host decode of every pair's receipts + events. With a match
+    # backend the native scanner emits flat tensors directly (no per-event
+    # Python objects); otherwise (or if the C extension is unavailable) the
+    # Python scan materializes StampedEvents.
+    scan_batch = None
+    scans = None
     with metrics.stage("range_scan"):
         roots = [pair.child.blocks[0].parent_message_receipts for pair in pairs]
-        if scan_workers > 0:
-            from concurrent.futures import ThreadPoolExecutor
+        if match_backend is not None and hasattr(match_backend, "event_match_mask_flat"):
+            from ipc_proofs_tpu.proofs.scan_native import has_raw_map, scan_events_flat
 
-            with ThreadPoolExecutor(max_workers=scan_workers) as pool:
-                scans = list(pool.map(lambda r: scan_receipt_events(cached, r), roots))
-        else:
-            scans = [scan_receipt_events(cached, root) for root in roots]
+            # Memory-backed stores only: an RPC-backed store would serialize
+            # every fetch through the C fallback callable, losing the
+            # scan_workers thread-pool overlap that hides network latency.
+            if has_raw_map(cached):
+                scan_batch = scan_events_flat(cached, roots)
+        if scan_batch is None:
+            if scan_workers > 0:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=scan_workers) as pool:
+                    scans = list(pool.map(lambda r: scan_receipt_events(cached, r), roots))
+            else:
+                scans = [scan_receipt_events(cached, root) for root in roots]
 
     # Phase B: one batched predicate over all events in the range.
     with metrics.stage("range_match"):
-        if match_backend is not None:
+        if scan_batch is not None:
+            import numpy as np
+
+            metrics.count("range_events", scan_batch.n_events)
+            matching_per_pair: list[list[int]] = [[] for _ in pairs]
+            if scan_batch.n_events:
+                mask = match_backend.event_match_mask_flat(
+                    scan_batch.topics,
+                    scan_batch.n_topics,
+                    scan_batch.emitters,
+                    scan_batch.valid,
+                    matcher.topic0,
+                    matcher.topic1,
+                    spec.actor_id_filter,
+                )[: scan_batch.n_events]
+                sel = np.nonzero(mask)[0]
+                hits = sorted(
+                    set(
+                        zip(
+                            scan_batch.pair_ids[sel].tolist(),
+                            scan_batch.exec_idx[sel].tolist(),
+                        )
+                    )
+                )
+                for pair_pos, exec_index in hits:
+                    matching_per_pair[pair_pos].append(exec_index)
+        elif match_backend is not None:
             flat: list[StampedEvent] = []
             owners: list[tuple[int, int]] = []  # (pair_pos, scan_pos)
             for pair_pos, scanned in enumerate(scans):
@@ -168,11 +208,18 @@ def generate_event_proofs_for_range(
                 for scanned in scans
             ]
 
-    # Phase C+D: per-pair pass 2 + merged witness.
+    # Phase C+D: per-pair pass 2 + merged witness. Pairs with no matching
+    # receipts contribute no proofs, so their base witness (headers, TxMeta
+    # walks, exec-order blocks) is dead weight for the verifier — skip them
+    # entirely. (The reference always collects the base witness because it
+    # runs one pair per invocation, `events/generator.rs:122-145`; a range
+    # bundle's witness only needs to cover the proofs it carries.)
     event_proofs = []
     all_blocks: set[ProofBlock] = set()
     with metrics.stage("range_record"):
         for pair, matching in zip(pairs, matching_per_pair):
+            if not matching:
+                continue
             collector = WitnessCollector(cached)
             collect_base_witness(collector, cached, pair.parent, pair.child)
             exec_order = build_execution_order(cached, pair.parent)

@@ -1,0 +1,139 @@
+"""Native Phase-A scan: receipts+events decoded straight into flat tensors.
+
+The pure-Python pass 1 (`event_generator.scan_receipt_events` +
+`backend.tpu.flatten_events`) materializes a Python object per receipt,
+event, and entry; at north-star scale (BASELINE.json config 2: 4096 tipsets,
+~262k events) host prep dwarfs the device mask. This wrapper drives the C
+scanner (`backend/native/scan_ext.c`) which walks the raw IPLD blocks and
+fills the padded arrays the match kernel consumes directly.
+
+Parity anchor: same traversal as reference pass 1
+(`src/proofs/events/generator.rs:206-239`) minus recording — pass 1 is
+deliberately witness-free in both designs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.store.blockstore import (
+    Blockstore,
+    CachedBlockstore,
+    MemoryBlockstore,
+)
+
+__all__ = ["ScanBatch", "scan_events_flat", "native_scan_available"]
+
+
+@dataclass
+class ScanBatch:
+    """Flat arrays over every event of every receipt of every scanned root."""
+
+    topics: np.ndarray  # uint32 [N, 2, 8] — first two topics as LE u32 words
+    n_topics: np.ndarray  # int32 [N] — total topic count (may exceed 2)
+    emitters: np.ndarray  # uint64 [N]
+    valid: np.ndarray  # bool [N] — EVM-log shaped (extract_evm_log parity)
+    pair_ids: np.ndarray  # int32 [N] — which root (position in `roots`)
+    exec_idx: np.ndarray  # int32 [N] — receipt index == execution index
+    event_idx: np.ndarray  # int32 [N] — index within the receipt's events AMT
+    n_receipts: int  # receipts with an events root, across all roots
+    # payload mode (verification): full topics/data bytes, pooled
+    topics_pool: bytes = b""
+    data_pool: bytes = b""
+    topics_off: Optional[np.ndarray] = None  # uint32 [N]
+    data_off: Optional[np.ndarray] = None  # uint32 [N]
+    data_len: Optional[np.ndarray] = None  # uint32 [N]
+
+    @property
+    def n_events(self) -> int:
+        return len(self.n_topics)
+
+    def event_topics(self, row: int) -> bytes:
+        """Full concatenated topics bytes of event ``row`` (payload mode)."""
+        start = int(self.topics_off[row])
+        return self.topics_pool[start : start + 32 * int(self.n_topics[row])]
+
+    def event_data(self, row: int) -> bytes:
+        start = int(self.data_off[row])
+        return self.data_pool[start : start + int(self.data_len[row])]
+
+
+def native_scan_available() -> bool:
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    return load_scan_ext() is not None
+
+
+def has_raw_map(store: Blockstore) -> bool:
+    """True when the store can expose a raw dict for C-side lookups — i.e.
+    the native scan runs without per-block Python fallback calls."""
+    if isinstance(store, MemoryBlockstore):
+        return True
+    if isinstance(store, CachedBlockstore):
+        return has_raw_map(store._inner)
+    return False
+
+
+def _raw_view(store: Blockstore):
+    """(raw_dict, fallback_callable) for the C scanner's block access."""
+    if isinstance(store, MemoryBlockstore):
+        return store.raw_map(), None
+    if isinstance(store, CachedBlockstore):
+        inner_raw, inner_fallback = _raw_view(store._inner)
+        if inner_fallback is None:
+            return inner_raw, None
+
+    def fallback(cid_bytes: bytes):
+        return store.get(CID.from_bytes(cid_bytes))
+
+    return {}, fallback
+
+
+def scan_events_flat(
+    store: Blockstore,
+    receipts_roots: Sequence[CID],
+    skip_missing: bool = False,
+    want_payload: bool = False,
+) -> Optional[ScanBatch]:
+    """Scan every receipts AMT in ``receipts_roots``; None if the native
+    extension is unavailable (callers use the Python scan path).
+
+    ``skip_missing`` prunes subtrees whose blocks are absent instead of
+    raising — the tolerant mode the batch verifier uses over pruned witness
+    stores (a proof whose path is missing simply finds no row → False).
+    ``want_payload`` additionally pools the full topics/data bytes per event
+    for claim comparison.
+    """
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    ext = load_scan_ext()
+    if ext is None:
+        return None
+    raw, fallback = _raw_view(store)
+    out = ext.scan_events_batch(
+        raw,
+        [c.to_bytes() for c in receipts_roots],
+        fallback,
+        skip_missing=skip_missing,
+        want_payload=want_payload,
+    )
+    n = out["n_events"]
+    return ScanBatch(
+        topics=np.frombuffer(out["topics"], dtype="<u4").reshape(n, 2, 8),
+        n_topics=np.frombuffer(out["n_topics"], dtype="<i4"),
+        emitters=np.frombuffer(out["emitters"], dtype="<u8"),
+        valid=np.frombuffer(out["valid"], dtype=np.uint8).astype(bool),
+        pair_ids=np.frombuffer(out["pair_ids"], dtype="<i4"),
+        exec_idx=np.frombuffer(out["exec_idx"], dtype="<i4"),
+        event_idx=np.frombuffer(out["event_idx"], dtype="<i4"),
+        n_receipts=out["n_receipts"],
+        topics_pool=out["topics_pool"],
+        data_pool=out["data_pool"],
+        topics_off=np.frombuffer(out["topics_off"], dtype="<u4") if want_payload else None,
+        data_off=np.frombuffer(out["data_off"], dtype="<u4") if want_payload else None,
+        data_len=np.frombuffer(out["data_len"], dtype="<u4") if want_payload else None,
+    )

@@ -27,9 +27,14 @@ def verify_storage_proof(
     blocks: Iterable[ProofBlock],
     is_trusted_child_header: Callable[[int, CID], bool],
     verify_witness_cids: bool = False,
+    store=None,
 ) -> bool:
-    # Step 1: isolated witness store.
-    store = load_witness_store(blocks, verify_cids=verify_witness_cids)
+    # Step 1: isolated witness store. A caller verifying many proofs of one
+    # bundle passes a pre-loaded ``store`` so the witness is loaded (and its
+    # CIDs verified) once per bundle, not once per proof — the reference
+    # reloads per proof (`storage/verifier.rs:68-78`).
+    if store is None:
+        store = load_witness_store(blocks, verify_cids=verify_witness_cids)
 
     # Step 2: trust anchor on (child_epoch, child CID).
     child_cid = CID.from_string(proof.child_block_cid)

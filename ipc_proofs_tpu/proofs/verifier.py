@@ -47,6 +47,14 @@ def verify_proof_bundle(
             raise ValueError("witness block bytes do not hash to their claimed CIDs")
         # non-blake2b blocks (rare) still verify scalar below
         verify_witness_cids = any(b.cid.mh_code != BLAKE2B_256 for b in bundle.blocks)
+
+    # One witness store for the whole bundle: loaded (and, when requested,
+    # CID-verified) exactly once, shared by every storage and event proof.
+    # The reference rebuilds it per storage proof (`storage/verifier.rs:68-78`).
+    from ipc_proofs_tpu.proofs.witness import load_witness_store
+
+    shared_store = load_witness_store(bundle.blocks, verify_cids=verify_witness_cids)
+
     def child_verifier(epoch, cid):
         try:
             return trust_policy.verify_child_header(epoch, cid)
@@ -60,9 +68,7 @@ def verify_proof_bundle(
             return False
 
     storage_results = [
-        verify_storage_proof(
-            proof, bundle.blocks, child_verifier, verify_witness_cids=verify_witness_cids
-        )
+        verify_storage_proof(proof, bundle.blocks, child_verifier, store=shared_store)
         for proof in bundle.storage_proofs
     ]
 
@@ -72,7 +78,7 @@ def verify_proof_bundle(
         parent_verifier,
         child_verifier,
         check_event=event_filter,
-        verify_witness_cids=verify_witness_cids,
+        store=shared_store,
     )
 
     return UnifiedVerificationResult(

@@ -67,6 +67,7 @@ class MemoryBlockstore:
 
     def __init__(self, verify_cids: bool = False):
         self._blocks: dict[CID, bytes] = {}
+        self._raw: dict[bytes, bytes] = {}  # cid.to_bytes() -> data
         self._verify = verify_cids
 
     def get(self, cid: CID) -> Optional[bytes]:
@@ -77,7 +78,9 @@ class MemoryBlockstore:
             recomputed = CID.hash_of(data, codec=cid.codec, mh_code=cid.mh_code)
             if recomputed != cid:
                 raise ValueError(f"block bytes do not hash to claimed CID {cid}")
-        self._blocks[cid] = bytes(data)
+        data = bytes(data)
+        self._blocks[cid] = data
+        self._raw[cid.to_bytes()] = data
 
     def has(self, cid: CID) -> bool:
         return cid in self._blocks
@@ -87,6 +90,11 @@ class MemoryBlockstore:
 
     def items(self) -> Iterable[tuple[CID, bytes]]:
         return self._blocks.items()
+
+    def raw_map(self) -> dict[bytes, bytes]:
+        """Live view keyed by raw CID bytes — the native scanner's fast path
+        (C-side dict lookups, no CID object construction per block)."""
+        return self._raw
 
 
 class RecordingBlockstore:

@@ -1,0 +1,230 @@
+"""Batch event verifier ↔ scalar verifier equivalence.
+
+The grouped batch replay (native scan + pooled compares) must return exactly
+the scalar loop's verdicts — on valid bundles, on every tamper case, and on
+pruned/garbled witnesses. Each case asserts both paths agree AND the
+expected verdict.
+"""
+
+import dataclasses
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.bundle import EventProofBundle
+from ipc_proofs_tpu.proofs.event_generator import generate_event_proof
+from ipc_proofs_tpu.proofs.event_verifier import create_event_filter, verify_event_proof
+from ipc_proofs_tpu.proofs.scan_native import native_scan_available
+
+pytestmark = pytest.mark.skipif(
+    not native_scan_available(), reason="native scan extension unavailable"
+)
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "batch-subnet"
+ACTOR = 321
+
+
+def make_bundle(n_pairs=3, encoding="compact"):
+    from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+    bs = MemoryBlockstore()
+    proofs, blocks = [], {}
+    for p in range(n_pairs):
+        events = [
+            [EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET,
+                          data=p.to_bytes(32, "big"), encoding=encoding)],
+            [EventFixture(emitter=ACTOR, signature="Noise()", topic1="x")],
+            [
+                EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET,
+                             extra_topics=[b"\x05" * 32], encoding=encoding),
+                EventFixture(emitter=999, signature=SIG, topic1=SUBNET),
+            ],
+        ]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events,
+                            parent_height=10 + 2 * p, store=bs)
+        bundle = generate_event_proof(
+            world.store, world.parent, world.child, SIG, SUBNET, actor_id_filter=ACTOR
+        )
+        proofs.extend(bundle.proofs)
+        for b in bundle.blocks:
+            blocks[b.cid] = b
+    return EventProofBundle(proofs=proofs, blocks=list(blocks.values()))
+
+
+def both_paths(bundle, check_event=None):
+    accept = lambda *_: True
+    scalar = verify_event_proof(bundle, accept, accept, check_event=check_event,
+                                batch=False)
+    batch = verify_event_proof(bundle, accept, accept, check_event=check_event,
+                               batch=True)
+    assert scalar == batch, f"scalar={scalar} batch={batch}"
+    return batch
+
+
+class TestBatchScalarEquivalence:
+    def test_valid_bundle_all_true(self):
+        bundle = make_bundle()
+        assert all(both_paths(bundle))
+        assert len(bundle.proofs) == 6  # 2 matching events x 3 pairs
+
+    def test_concat_encoding_bundle(self):
+        bundle = make_bundle(encoding="concat")
+        assert all(both_paths(bundle))
+
+    def test_event_filter_paths_agree(self):
+        bundle = make_bundle()
+        res = both_paths(bundle, check_event=create_event_filter(SIG, SUBNET))
+        assert all(res)
+        res = both_paths(bundle, check_event=create_event_filter(SIG, "other"))
+        assert not any(res)
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda p: dataclasses.replace(p, exec_index=p.exec_index + 1),
+            lambda p: dataclasses.replace(p, event_index=p.event_index + 7),
+            lambda p: dataclasses.replace(p, child_epoch=p.child_epoch + 1),
+            lambda p: dataclasses.replace(p, parent_epoch=p.parent_epoch + 1),
+            lambda p: dataclasses.replace(
+                p, message_cid=str(CID.hash_of(b"bogus", codec=RAW))
+            ),
+            lambda p: dataclasses.replace(
+                p,
+                event_data=dataclasses.replace(p.event_data, emitter=1),
+            ),
+            lambda p: dataclasses.replace(
+                p,
+                event_data=dataclasses.replace(
+                    p.event_data, data="0x" + "ff" * 32
+                ),
+            ),
+            lambda p: dataclasses.replace(
+                p,
+                event_data=dataclasses.replace(
+                    p.event_data, topics=p.event_data.topics[:1]
+                ),
+            ),
+            lambda p: dataclasses.replace(
+                p,
+                event_data=dataclasses.replace(
+                    p.event_data,
+                    topics=[p.event_data.topics[0], "0x" + "ab" * 32],
+                ),
+            ),
+            # malformed hex / missing prefix claims
+            lambda p: dataclasses.replace(
+                p,
+                event_data=dataclasses.replace(
+                    p.event_data, topics=[p.event_data.topics[0], "zz" * 32]
+                ),
+            ),
+            lambda p: dataclasses.replace(
+                p,
+                event_data=dataclasses.replace(
+                    p.event_data, data=p.event_data.data.removeprefix("0x")
+                ),
+            ),
+        ],
+    )
+    def test_tampered_proof_fails_both_paths(self, mutate):
+        bundle = make_bundle(n_pairs=1)
+        tampered = EventProofBundle(
+            proofs=[mutate(bundle.proofs[0]), *bundle.proofs[1:]],
+            blocks=bundle.blocks,
+        )
+        res = both_paths(tampered)
+        assert res[0] is False
+        assert all(res[1:])  # untouched proofs still verify
+
+    def test_uppercase_hex_claims_accepted(self):
+        """Scalar compare is case-insensitive; batch must match."""
+        bundle = make_bundle(n_pairs=1)
+        p = bundle.proofs[0]
+        shouty = dataclasses.replace(
+            p,
+            event_data=dataclasses.replace(
+                p.event_data,
+                topics=[t.upper().replace("0X", "0x") for t in p.event_data.topics],
+                data=p.event_data.data.upper().replace("0X", "0x"),
+            ),
+        )
+        res = both_paths(
+            EventProofBundle(proofs=[shouty, *bundle.proofs[1:]], blocks=bundle.blocks)
+        )
+        assert res[0] is True
+
+    def test_untrusted_proof_with_missing_child_header_no_raise(self):
+        """A proof the trust policy rejects must be False (not a bundle-wide
+        KeyError) even when its child header is absent from the witness —
+        the scalar path never touches the witness for untrusted proofs."""
+        bundle = make_bundle(n_pairs=1)
+        bogus = dataclasses.replace(
+            bundle.proofs[0],
+            child_block_cid=str(CID.hash_of(b"not-in-witness")),
+        )
+        tampered = EventProofBundle(
+            proofs=[bogus, *bundle.proofs[1:]], blocks=bundle.blocks
+        )
+        reject_child = lambda *_: False
+        accept = lambda *_: True
+        scalar = verify_event_proof(tampered, accept, reject_child, batch=False)
+        batch = verify_event_proof(tampered, accept, reject_child, batch=True)
+        assert scalar == batch == [False] * len(tampered.proofs)
+
+    def test_whitespace_hex_claim_rejected_both_paths(self):
+        """bytes.fromhex tolerates whitespace; the scalar string compare does
+        not — the batch path must reject identically."""
+        bundle = make_bundle(n_pairs=1)
+        p = bundle.proofs[0]
+        topic = p.event_data.topics[1]
+        spaced = dataclasses.replace(
+            p,
+            event_data=dataclasses.replace(
+                p.event_data,
+                topics=[p.event_data.topics[0], topic[:6] + " " + topic[6:]],
+            ),
+        )
+        res = both_paths(
+            EventProofBundle(proofs=[spaced, *bundle.proofs[1:]], blocks=bundle.blocks)
+        )
+        assert res[0] is False
+
+        spaced_data = dataclasses.replace(
+            p,
+            event_data=dataclasses.replace(
+                p.event_data, data=p.event_data.data[:6] + " " + p.event_data.data[6:]
+            ),
+        )
+        res = both_paths(
+            EventProofBundle(
+                proofs=[spaced_data, *bundle.proofs[1:]], blocks=bundle.blocks
+            )
+        )
+        assert res[0] is False
+
+    def test_truncated_witness_fails_closed(self):
+        bundle = make_bundle(n_pairs=1)
+        # remove one block at a time and check both paths agree
+        for drop in range(len(bundle.blocks)):
+            pruned = [b for i, b in enumerate(bundle.blocks) if i != drop]
+            try:
+                scalar = verify_event_proof(
+                    EventProofBundle(proofs=bundle.proofs, blocks=pruned),
+                    lambda *_: True, lambda *_: True, batch=False,
+                )
+                scalar_raised = None
+            except KeyError as exc:
+                scalar_raised = type(exc)
+            try:
+                batch = verify_event_proof(
+                    EventProofBundle(proofs=bundle.proofs, blocks=pruned),
+                    lambda *_: True, lambda *_: True, batch=True,
+                )
+                batch_raised = None
+            except KeyError as exc:
+                batch_raised = type(exc)
+            assert scalar_raised == batch_raised
+            if scalar_raised is None:
+                assert scalar == batch

@@ -79,6 +79,42 @@ class TestRoundTrip:
         )
         assert result.all_valid()
 
+    def test_bundle_verify_loads_witness_store_once(self, monkeypatch):
+        """Perf regression: an N-proof bundle must load (and CID-verify) the
+        witness exactly once, not once per proof (the reference reloads per
+        storage proof, `storage/verifier.rs:68-78`)."""
+        import ipc_proofs_tpu.proofs.verifier as verifier_mod
+        from ipc_proofs_tpu.proofs import witness as witness_mod
+
+        world = make_world()
+        bundle = generate_proof_bundle(
+            world.store,
+            world.parent,
+            world.child,
+            [StorageProofSpec(actor_id=ACTOR, slot=SLOT)] * 4,
+            [EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)],
+        )
+        assert len(bundle.storage_proofs) == 4
+
+        calls = {"n": 0}
+        real_load = witness_mod.load_witness_store
+
+        def counting_load(blocks, verify_cids=False):
+            calls["n"] += 1
+            return real_load(blocks, verify_cids=verify_cids)
+
+        import ipc_proofs_tpu.proofs.event_verifier as ev_mod
+        import ipc_proofs_tpu.proofs.storage_verifier as sv_mod
+
+        monkeypatch.setattr(witness_mod, "load_witness_store", counting_load)
+        monkeypatch.setattr(sv_mod, "load_witness_store", counting_load)
+        monkeypatch.setattr(ev_mod, "load_witness_store", counting_load)
+        result = verify_proof_bundle(
+            bundle, TrustPolicy.accept_all(), verify_witness_cids=True
+        )
+        assert result.all_valid()
+        assert calls["n"] == 1
+
     def test_json_wire_roundtrip(self):
         world = make_world()
         bundle = generate(world)

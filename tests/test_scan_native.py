@@ -1,0 +1,166 @@
+"""Native Phase-A scanner ↔ pure-Python scan equivalence.
+
+The C scanner (backend/native/scan_ext.c) must produce exactly the arrays
+that scan_receipt_events + flatten_events produce, over every event-encoding
+case and AMT shape, so the device mask sees identical inputs either way.
+"""
+
+import numpy as np
+import pytest
+
+from ipc_proofs_tpu.backend.tpu import flatten_events
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.event_generator import scan_receipt_events
+from ipc_proofs_tpu.proofs.scan_native import native_scan_available, scan_events_flat
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+pytestmark = pytest.mark.skipif(
+    not native_scan_available(), reason="native scan extension unavailable"
+)
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+ACTOR = 4242
+
+
+def _python_reference(store, roots):
+    """The existing Python path, flattened the same way."""
+    topics, n_topics, emitters, valid = [], [], [], []
+    pair_ids, exec_idx, event_idx = [], [], []
+    n_receipts = 0
+    for pair_pos, root in enumerate(roots):
+        for i, _receipt, events in scan_receipt_events(store, root):
+            n_receipts += 1
+            t, nt, em, va = flatten_events(events)
+            topics.append(t)
+            n_topics.append(nt)
+            emitters.append(em)
+            valid.append(va)
+            pair_ids.extend([pair_pos] * len(events))
+            exec_idx.extend([i] * len(events))
+            event_idx.extend(range(len(events)))
+    if topics:
+        return (
+            np.concatenate(topics),
+            np.concatenate(n_topics),
+            np.concatenate(emitters).astype(np.uint64),
+            np.concatenate(valid),
+            np.array(pair_ids, np.int32),
+            np.array(exec_idx, np.int32),
+            np.array(event_idx, np.int32),
+            n_receipts,
+        )
+    return (
+        np.zeros((0, 2, 8), np.uint32), np.zeros(0, np.int32),
+        np.zeros(0, np.uint64), np.zeros(0, bool),
+        np.zeros(0, np.int32), np.zeros(0, np.int32), np.zeros(0, np.int32), 0,
+    )
+
+
+def assert_scan_matches(store, roots):
+    batch = scan_events_flat(store, roots)
+    assert batch is not None
+    t, nt, em, va, pi, xi, ei, nr = _python_reference(store, roots)
+    np.testing.assert_array_equal(batch.topics, t)
+    np.testing.assert_array_equal(batch.n_topics, nt)
+    np.testing.assert_array_equal(batch.emitters, em)
+    np.testing.assert_array_equal(batch.valid, va)
+    np.testing.assert_array_equal(batch.pair_ids, pi)
+    np.testing.assert_array_equal(batch.exec_idx, xi)
+    np.testing.assert_array_equal(batch.event_idx, ei)
+    assert batch.n_receipts == nr
+
+
+class TestNativeScan:
+    def test_mixed_events_multi_pair(self):
+        bs = MemoryBlockstore()
+        roots = []
+        for p in range(5):
+            events = [
+                [EventFixture(emitter=ACTOR, signature=SIG, topic1=f"net-{p}")],
+                [],  # receipt without events
+                [
+                    EventFixture(emitter=9, signature="Noise()", topic1="x"),
+                    EventFixture(emitter=ACTOR, signature=SIG, topic1="other",
+                                 data=b"\x07" * 32),
+                ],
+            ]
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)], events,
+                parent_height=50 + p, store=bs,
+            )
+            roots.append(world.child.blocks[0].parent_message_receipts)
+        assert_scan_matches(bs, roots)
+
+    def test_concat_topics_encoding(self):
+        """Case A: explicit concatenated topics entry (>2 topics too)."""
+        bs = MemoryBlockstore()
+        events = [[
+            EventFixture(emitter=1, signature=SIG, topic1="s",
+                         extra_topics=[b"\x01" * 32, b"\x02" * 32]),
+            EventFixture(emitter=2, signature=SIG, topic1="s", encoding="concat"),
+            EventFixture(emitter=3, signature=SIG, topic1="s", encoding="concat",
+                         extra_topics=[b"\x03" * 32]),
+        ]]
+        world = build_chain([ContractFixture(actor_id=1)], events, store=bs)
+        assert_scan_matches(bs, [world.child.blocks[0].parent_message_receipts])
+
+    def test_large_receipt_count_multilevel_amt(self):
+        """>8 receipts forces a multi-level v0 AMT; >8 events a v3 one."""
+        bs = MemoryBlockstore()
+        events = [
+            [EventFixture(emitter=ACTOR, signature=SIG, topic1=f"m{m}")
+             for _ in range(m % 3)]
+            for m in range(30)
+        ]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        assert_scan_matches(bs, [world.child.blocks[0].parent_message_receipts])
+
+    def test_many_events_one_receipt(self):
+        bs = MemoryBlockstore()
+        events = [[
+            EventFixture(emitter=ACTOR, signature=SIG, topic1=f"t{i}")
+            for i in range(20)
+        ]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        assert_scan_matches(bs, [world.child.blocks[0].parent_message_receipts])
+
+    def test_empty_root_list(self):
+        bs = MemoryBlockstore()
+        batch = scan_events_flat(bs, [])
+        assert batch is not None and batch.n_events == 0
+
+    def test_missing_block_raises(self):
+        from ipc_proofs_tpu.core.cid import CID
+
+        bs = MemoryBlockstore()
+        bogus = CID.hash_of(b"nope")
+        with pytest.raises(KeyError):
+            scan_events_flat(bs, [bogus])
+
+    def test_fallback_get_path(self):
+        """Stores without a raw map go through the callable fallback."""
+
+        class OpaqueStore:
+            def __init__(self, inner):
+                self._inner = inner
+                self.gets = 0
+
+            def get(self, cid):
+                self.gets += 1
+                return self._inner.get(cid)
+
+            def put_keyed(self, cid, data):
+                self._inner.put_keyed(cid, data)
+
+            def has(self, cid):
+                return self._inner.has(cid)
+
+        bs = MemoryBlockstore()
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="f")]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        opaque = OpaqueStore(bs)
+        root = world.child.blocks[0].parent_message_receipts
+        batch = scan_events_flat(opaque, [root])
+        assert batch is not None and batch.n_events == 1
+        assert opaque.gets > 0
+        assert_scan_matches(bs, [root])  # same answer as the raw-map path
