@@ -1,0 +1,50 @@
+// SPDX-License-Identifier: MIT
+pragma solidity ^0.8.24;
+
+/// @title TopdownMessenger — on-chain fixture for IPC top-down proofs
+///
+/// The deployable counterpart of the Python fixture world
+/// (`ipc_proofs_tpu/fixtures.py`) and of benchmark config 5
+/// (`benchmarks/run_configs.py`): a minimal FEVM contract whose storage and
+/// event shapes are exactly what the proof engines target.
+///
+/// Proof-relevant invariants (checked by the framework's tests/benchmarks):
+///
+/// 1. `subnets` occupies storage slot 0, so the nonce for a subnet lives at
+///    `keccak256(abi.encode(subnetId, uint256(0)))` — the slot the framework
+///    computes with `compute_mapping_slot` (`ipc_proofs_tpu/state/storage.py`).
+/// 2. The nonce is incremented BEFORE each emission, so after `trigger(id, n)`
+///    the stored nonce equals the `nonce` field of the last emitted event —
+///    a storage proof and an event proof over the same checkpoint must agree.
+/// 3. `subnetId` is an indexed bytes32, so it lands in topic1 uninterpreted;
+///    event proofs match on `keccak256("NewTopDownMessage(bytes32,uint256)")`
+///    as topic0 and the raw subnet id as topic1.
+///
+/// Reference parity: topdown-messenger/src/TopdownMessenger.sol:1-33 (same
+/// ABI, storage layout, and emission order; independent implementation).
+contract TopdownMessenger {
+    /// Slot 0: per-subnet top-down message nonce. A bare uint256 mapping has
+    /// the same storage layout as a single-field struct mapping: the value
+    /// sits directly at the mapping slot hash.
+    mapping(bytes32 => uint256) public subnets;
+
+    event NewTopDownMessage(bytes32 indexed subnetId, uint256 nonce);
+
+    /// Emit `count` top-down messages for `subnetId`, bumping the nonce
+    /// before each emission (invariant 2 above).
+    function trigger(bytes32 subnetId, uint256 count) external {
+        uint256 nonce = subnets[subnetId];
+        for (uint256 i = 0; i < count; i++) {
+            unchecked {
+                nonce += 1;
+            }
+            emit NewTopDownMessage(subnetId, nonce);
+        }
+        subnets[subnetId] = nonce;
+    }
+
+    /// Convenience read: current nonce for a subnet.
+    function topDownNonce(bytes32 subnetId) external view returns (uint256) {
+        return subnets[subnetId];
+    }
+}

@@ -9,6 +9,12 @@ a complete parent→child tipset pair in a MemoryBlockstore:
 
 so both proof engines can run end-to-end offline — and so benchmarks can
 scale the world (tipsets × receipts × events) arbitrarily.
+
+The contract/event semantics modeled here (slot-0 mapping keyed by subnet id,
+pre-incremented nonce, ``NewTopDownMessage(bytes32,uint256)`` with the subnet
+id as indexed topic1) are those of the deployable fixture at
+``contracts/TopdownMessenger.sol`` (reference parity:
+``topdown-messenger/src/TopdownMessenger.sol:1-33``).
 """
 
 from __future__ import annotations
