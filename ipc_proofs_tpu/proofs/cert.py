@@ -1,20 +1,46 @@
 """F3 finality certificate types (Forest-aligned JSON shapes).
 
 Reference parity: `src/cert.rs`. `is_valid_for_epoch` preserves the
-reference's placeholder semantics: the epoch must fall within the EC chain's
-[first, last] range; BLS signature / power-table verification is a TODO in
-the reference too (`cert.rs:52-64`).
+reference's placeholder semantics (epoch within the EC chain's [first, last]
+range, `cert.rs:52-64`); on top of that this module implements the two
+structural checks the reference leaves as TODOs (`trust/mod.rs:58,72`):
+
+* **tipset binding** — `validates_parent_tipset` / `validates_child_header`
+  require the *claimed CIDs*, not just the epoch, to appear in the cert's EC
+  chain (exact key match for the parent tipset; member-block match for a
+  single child header). A forged proof carrying real epochs but fabricated
+  tipsets now fails the trust anchor.
+* **power-table chaining** — `apply_power_table_delta` +
+  `FinalityCertificateChain.validate` replay each certificate's
+  `PowerTableDelta` onto the previous table and check instance continuity,
+  so a certificate sequence must be self-consistent before it is trusted.
+
+What full verification would additionally require (out of scope without a
+BLS library and the genesis power table, documented here so the gap is
+explicit):
+
+1. the initial power table fetched from the f3 genesis (its CID is chain
+   metadata), hashed and compared against each cert's
+   `supplemental_data.power_table` after applying the deltas;
+2. aggregate-BLS verification of `signature` over the certificate's gpbft
+   payload (instance ‖ ECChain merkle root ‖ supplemental data) against the
+   public keys of the `signers` bitfield resolved through the power table;
+3. a >2/3 quorum check of the signers' power against the table total.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional, Sequence
 
 __all__ = [
     "FinalityCertificate",
+    "FinalityCertificateChain",
     "ECTipSet",
     "SupplementalData",
     "PowerTableDelta",
+    "PowerTableEntry",
+    "apply_power_table_delta",
 ]
 
 
@@ -94,3 +120,136 @@ class FinalityCertificate:
         if not self.ec_chain:
             return False
         return self.ec_chain[0].epoch <= epoch <= self.ec_chain[-1].epoch
+
+    def tipset_at_epoch(self, epoch: int) -> Optional[ECTipSet]:
+        for ts in self.ec_chain:
+            if ts.epoch == epoch:
+                return ts
+        return None
+
+    def validates_parent_tipset(self, epoch: int, cids: Sequence[str]) -> bool:
+        """True iff the EC chain finalizes exactly ``cids`` at ``epoch``.
+
+        The tipset key is order-sensitive (Filecoin orders blocks by ticket),
+        so this is an exact-sequence comparison — the strictest reading, and
+        the one a forged-tipset proof cannot satisfy. Beats the reference's
+        epoch-only stub (`trust/mod.rs:53-64`).
+        """
+        ts = self.tipset_at_epoch(epoch)
+        return ts is not None and list(ts.key) == list(cids)
+
+    def validates_child_header(self, epoch: int, cid: str) -> bool:
+        """True iff block ``cid`` is a member of the finalized tipset at
+        ``epoch``. A child *header* is one block of the child tipset, so
+        membership (not whole-key equality) is the correct predicate.
+        Beats the reference's epoch-only stub (`trust/mod.rs:67-78`).
+        """
+        ts = self.tipset_at_epoch(epoch)
+        return ts is not None and cid in ts.key
+
+
+@dataclass
+class PowerTableEntry:
+    """One row of an F3 power table: participant id → (power, BLS key)."""
+
+    participant_id: int
+    power: int
+    signing_key: str
+
+
+def apply_power_table_delta(
+    table: Sequence[PowerTableEntry], deltas: Sequence[PowerTableDelta]
+) -> list[PowerTableEntry]:
+    """Replay a certificate's ``PowerTableDelta`` onto ``table``.
+
+    Semantics (go-f3 `certs.ApplyPowerTableDiffs`): a delta adds the signed
+    ``power_delta`` to the participant's power, creating the entry if new
+    (its ``signing_key`` must then be non-empty) and removing it when power
+    reaches zero; negative resulting power is invalid. A non-empty
+    ``signing_key`` on an existing participant replaces the key. Output is
+    sorted by participant id (the canonical table order).
+
+    Raises ValueError on any inconsistency — a certificate whose delta does
+    not apply cleanly must not be trusted. Like go-f3, the delta list must be
+    strictly sorted by participant id (which also forbids duplicates).
+    """
+    ids = [d.participant_id for d in deltas]
+    if ids != sorted(set(ids)):
+        raise ValueError("power table delta not strictly sorted by participant id")
+    rows = {e.participant_id: PowerTableEntry(e.participant_id, e.power, e.signing_key) for e in table}
+    for d in deltas:
+        delta = int(d.power_delta)
+        row = rows.get(d.participant_id)
+        if row is None:
+            if delta <= 0:
+                raise ValueError(
+                    f"delta for unknown participant {d.participant_id} must be positive"
+                )
+            if not d.signing_key:
+                raise ValueError(
+                    f"new participant {d.participant_id} is missing a signing key"
+                )
+            rows[d.participant_id] = PowerTableEntry(d.participant_id, delta, d.signing_key)
+            continue
+        if delta == 0 and not d.signing_key:
+            raise ValueError(f"no-op delta for participant {d.participant_id}")
+        new_power = row.power + delta
+        if new_power < 0:
+            raise ValueError(f"participant {d.participant_id} power would go negative")
+        if new_power == 0:
+            del rows[d.participant_id]
+        else:
+            row.power = new_power
+            if d.signing_key:
+                row.signing_key = d.signing_key
+    return [rows[pid] for pid in sorted(rows)]
+
+
+@dataclass
+class FinalityCertificateChain:
+    """A consecutive run of finality certificates, validated as a unit.
+
+    ``validate`` checks what can be checked without BLS (see module
+    docstring for the remaining gap): instances strictly consecutive, every
+    cert's EC chain non-empty, epochs strictly increasing across certs, and
+    — when ``initial_power_table`` is given — each cert's delta applies
+    cleanly in sequence. Returns the final power table (or None when no
+    initial table was provided).
+    """
+
+    certificates: list[FinalityCertificate] = field(default_factory=list)
+
+    def validate(
+        self, initial_power_table: Optional[Sequence[PowerTableEntry]] = None
+    ) -> Optional[list[PowerTableEntry]]:
+        table = list(initial_power_table) if initial_power_table is not None else None
+        prev_instance: Optional[int] = None
+        prev_epoch: Optional[int] = None
+        for cert in self.certificates:
+            if not cert.ec_chain:
+                raise ValueError(f"certificate {cert.instance} has an empty EC chain")
+            if prev_instance is not None and cert.instance != prev_instance + 1:
+                raise ValueError(
+                    f"instance gap: {prev_instance} followed by {cert.instance}"
+                )
+            epochs = [ts.epoch for ts in cert.ec_chain]
+            if epochs != sorted(epochs) or len(set(epochs)) != len(epochs):
+                raise ValueError(
+                    f"certificate {cert.instance} EC chain epochs not strictly increasing"
+                )
+            if prev_epoch is not None and epochs[0] <= prev_epoch:
+                raise ValueError(
+                    f"certificate {cert.instance} starts at epoch {epochs[0]} "
+                    f"<= previous cert's head {prev_epoch}"
+                )
+            if table is not None:
+                table = apply_power_table_delta(table, cert.power_table_delta)
+            prev_instance, prev_epoch = cert.instance, epochs[-1]
+        return table
+
+    def tipset_at_epoch(self, epoch: int) -> Optional[ECTipSet]:
+        for cert in self.certificates:
+            ts = cert.tipset_at_epoch(epoch)
+            if ts is not None:
+                return ts
+        return None

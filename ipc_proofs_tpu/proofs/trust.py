@@ -1,10 +1,15 @@
 """Trust / finality policies gating proof verification.
 
 Reference parity: `TrustPolicy::{AcceptAll, F3Certificate}` and the
-`TrustVerifier` trait (`src/proofs/trust/mod.rs`). The F3 branch preserves
-the reference's *stub* semantics (epoch-range check only; signature
-verification is an acknowledged TODO in the reference at
-`trust/mod.rs:58,72`).
+`TrustVerifier` trait (`src/proofs/trust/mod.rs`). The F3 branch goes beyond
+the reference's stub (epoch-range only, acknowledged TODOs at
+`trust/mod.rs:58,72`): by default the *claimed CIDs* must appear in the
+certificate's EC chain (exact tipset-key match for the parent, member-block
+match for the child header) — see `cert.validates_parent_tipset` /
+`validates_child_header`. Pass ``bind_tipsets=False`` to
+`with_f3_certificate` for the reference's epoch-only semantics. BLS
+signature / quorum verification remains out of scope; the exact gap is
+documented in `cert.py`'s module docstring.
 """
 
 from __future__ import annotations
@@ -53,12 +58,14 @@ class TrustPolicy:
         accept_all: bool = False,
         certificate: Optional[FinalityCertificate] = None,
         custom: Optional[TrustVerifier] = None,
+        bind_tipsets: bool = True,
     ):
         if sum(x is not None and x is not False for x in (accept_all, certificate, custom)) != 1:
             raise ValueError("exactly one of accept_all/certificate/custom required")
         self._accept_all = accept_all
         self._certificate = certificate
         self._custom = custom
+        self._bind_tipsets = bind_tipsets
 
     @classmethod
     def accept_all(cls) -> "TrustPolicy":
@@ -66,8 +73,17 @@ class TrustPolicy:
         return cls(accept_all=True)
 
     @classmethod
-    def with_f3_certificate(cls, cert: FinalityCertificate) -> "TrustPolicy":
-        return cls(certificate=cert)
+    def with_f3_certificate(
+        cls, cert: FinalityCertificate, bind_tipsets: bool = True
+    ) -> "TrustPolicy":
+        """Trust proofs anchored by an F3 finality certificate.
+
+        With ``bind_tipsets`` (the default) the claimed parent tipset key /
+        child block CID must appear in the cert's EC chain at the claimed
+        epoch; ``bind_tipsets=False`` reproduces the reference's epoch-range
+        stub (`trust/mod.rs:53-78`).
+        """
+        return cls(certificate=cert, bind_tipsets=bind_tipsets)
 
     @classmethod
     def with_custom_verifier(cls, verifier: TrustVerifier) -> "TrustPolicy":
@@ -77,6 +93,10 @@ class TrustPolicy:
         if self._accept_all:
             return True
         if self._certificate is not None:
+            if self._bind_tipsets:
+                return self._certificate.validates_parent_tipset(
+                    epoch, [str(c) for c in cids]
+                )
             return self._certificate.is_valid_for_epoch(epoch)
         return self._custom.verify_parent_tipset(epoch, cids)
 
@@ -84,5 +104,7 @@ class TrustPolicy:
         if self._accept_all:
             return True
         if self._certificate is not None:
+            if self._bind_tipsets:
+                return self._certificate.validates_child_header(epoch, str(cid))
             return self._certificate.is_valid_for_epoch(epoch)
         return self._custom.verify_child_header(epoch, cid)

@@ -190,6 +190,28 @@ class TestRoundTrip:
         )
         assert bundle.event_proofs == []
 
+    def test_preloaded_store_rejects_verify_witness_cids_flag(self):
+        # the flag would be silently dropped with a pre-loaded store — must raise
+        from ipc_proofs_tpu.proofs.event_verifier import verify_event_proof
+        from ipc_proofs_tpu.proofs.storage_verifier import verify_storage_proof
+        from ipc_proofs_tpu.proofs.bundle import EventProofBundle
+        from ipc_proofs_tpu.proofs.witness import load_witness_store
+
+        world = make_world()
+        bundle = generate(world)
+        store = load_witness_store(bundle.blocks)
+        with pytest.raises(ValueError, match="pre-loaded store"):
+            verify_storage_proof(
+                bundle.storage_proofs[0], bundle.blocks, lambda e, c: True,
+                verify_witness_cids=True, store=store,
+            )
+        with pytest.raises(ValueError, match="pre-loaded store"):
+            verify_event_proof(
+                EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks),
+                lambda e, c: True, lambda e, c: True,
+                verify_witness_cids=True, store=store,
+            )
+
     def test_witness_is_deduplicated_and_sorted(self):
         world = make_world()
         bundle = generate(world)
@@ -224,6 +246,8 @@ class TestTrustPolicies:
         assert not any(bad_parent.event_results)
 
     def test_f3_certificate_epoch_range(self):
+        # bind_tipsets=False — the reference's epoch-only stub semantics
+        # (`trust/mod.rs:53-78`).
         from ipc_proofs_tpu.proofs.cert import ECTipSet, FinalityCertificate
 
         world = make_world()
@@ -235,14 +259,132 @@ class TestTrustPolicies:
                 ECTipSet(key=[], epoch=world.child.height, power_table=""),
             ],
         )
-        assert verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(covering)).all_valid()
+        assert verify_proof_bundle(
+            bundle, TrustPolicy.with_f3_certificate(covering, bind_tipsets=False)
+        ).all_valid()
         not_covering = FinalityCertificate(
             instance=1, ec_chain=[ECTipSet(key=[], epoch=5, power_table="")]
         )
-        result = verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(not_covering))
+        result = verify_proof_bundle(
+            bundle, TrustPolicy.with_f3_certificate(not_covering, bind_tipsets=False)
+        )
         assert not result.all_valid()
         empty = FinalityCertificate(instance=1, ec_chain=[])
-        assert not verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(empty)).all_valid()
+        assert not verify_proof_bundle(
+            bundle, TrustPolicy.with_f3_certificate(empty, bind_tipsets=False)
+        ).all_valid()
+
+    def _cert_for_world(self, world, parent_key=None, child_key=None):
+        from ipc_proofs_tpu.proofs.cert import ECTipSet, FinalityCertificate
+
+        return FinalityCertificate(
+            instance=1,
+            ec_chain=[
+                ECTipSet(
+                    key=parent_key if parent_key is not None
+                    else [str(c) for c in world.parent.cids],
+                    epoch=world.parent.height,
+                    power_table="",
+                ),
+                ECTipSet(
+                    key=child_key if child_key is not None
+                    else [str(c) for c in world.child.cids],
+                    epoch=world.child.height,
+                    power_table="",
+                ),
+            ],
+        )
+
+    def test_f3_tipset_binding_accepts_real_tipsets(self):
+        world = make_world()
+        bundle = generate(world)
+        cert = self._cert_for_world(world)
+        assert verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(cert)).all_valid()
+
+    def test_f3_tipset_binding_rejects_forged_tipsets(self):
+        # The VERDICT tamper case: right epochs, wrong tipset CIDs. The
+        # epoch-only stub would accept this; the bound policy must not.
+        from ipc_proofs_tpu.core.cid import CID, RAW
+
+        world = make_world()
+        bundle = generate(world)
+        forged = str(CID.hash_of(b"forged-block", codec=RAW))
+        wrong_parent = self._cert_for_world(world, parent_key=[forged])
+        result = verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(wrong_parent))
+        assert not any(result.event_results)  # events anchor the parent tipset
+        wrong_child = self._cert_for_world(world, child_key=[forged])
+        result = verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(wrong_child))
+        assert not result.all_valid()
+        assert not any(result.storage_results) and not any(result.event_results)
+
+    def test_f3_tipset_binding_is_order_sensitive_for_parent(self):
+        world = make_world(n_parent_blocks=2)
+        bundle = generate(world)
+        real_key = [str(c) for c in world.parent.cids]
+        assert len(real_key) == 2
+        cert = self._cert_for_world(world, parent_key=list(reversed(real_key)))
+        result = verify_proof_bundle(bundle, TrustPolicy.with_f3_certificate(cert))
+        assert not any(result.event_results)
+
+    def test_f3_power_table_delta_chain(self):
+        from ipc_proofs_tpu.proofs.cert import (
+            ECTipSet,
+            FinalityCertificate,
+            FinalityCertificateChain,
+            PowerTableDelta,
+            PowerTableEntry,
+            apply_power_table_delta,
+        )
+
+        table = [
+            PowerTableEntry(1, 100, "k1"),
+            PowerTableEntry(2, 50, "k2"),
+        ]
+        # add participant 3, remove participant 2, bump participant 1
+        deltas = [
+            PowerTableDelta(1, "25", ""),
+            PowerTableDelta(2, "-50", ""),
+            PowerTableDelta(3, "10", "k3"),
+        ]
+        out = apply_power_table_delta(table, deltas)
+        assert [(e.participant_id, e.power) for e in out] == [(1, 125), (3, 10)]
+
+        import pytest
+
+        with pytest.raises(ValueError):  # new participant needs a key
+            apply_power_table_delta(table, [PowerTableDelta(9, "5", "")])
+        with pytest.raises(ValueError):  # power can't go negative
+            apply_power_table_delta(table, [PowerTableDelta(2, "-60", "")])
+        with pytest.raises(ValueError):  # deltas must be sorted by id (go-f3)
+            apply_power_table_delta(
+                table, [PowerTableDelta(2, "1", ""), PowerTableDelta(1, "1", "")]
+            )
+        with pytest.raises(ValueError):  # duplicate participant forbidden
+            apply_power_table_delta(
+                table, [PowerTableDelta(3, "10", "k3"), PowerTableDelta(3, "-10", "")]
+            )
+
+        def cert(instance, epoch, delta=()):
+            return FinalityCertificate(
+                instance=instance,
+                ec_chain=[ECTipSet(key=["c"], epoch=epoch, power_table="")],
+                power_table_delta=list(delta),
+            )
+
+        chain = FinalityCertificateChain(
+            [cert(1, 10, [PowerTableDelta(3, "10", "k3")]), cert(2, 11)]
+        )
+        final = chain.validate(table)
+        assert [e.participant_id for e in final] == [1, 2, 3]
+
+        with pytest.raises(ValueError):  # instance gap
+            FinalityCertificateChain([cert(1, 10), cert(3, 11)]).validate()
+        with pytest.raises(ValueError):  # epoch regression across certs
+            FinalityCertificateChain([cert(1, 10), cert(2, 10)]).validate()
+        with pytest.raises(ValueError):  # empty EC chain
+            FinalityCertificateChain(
+                [FinalityCertificate(instance=1, ec_chain=[])]
+            ).validate()
 
     def test_event_filter_rejects_other_events(self):
         world = make_world()
