@@ -71,7 +71,8 @@ def _cmd_generate(args) -> int:
     backend = get_backend(args.backend) if args.backend != "none" else None
     with metrics.stage("generate"):
         bundle = generate_proof_bundle(
-            store, parent, child, storage_specs, event_specs, match_backend=backend
+            store, parent, child, storage_specs, event_specs, match_backend=backend,
+            receipts_client=client if args.receipts_api else None,
         )
 
     output = args.output or "bundle.json"
@@ -253,6 +254,13 @@ def main(argv=None) -> int:
     gen.add_argument("--topic1", default=None)
     gen.add_argument("--no-actor-filter", action="store_true")
     gen.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
+    gen.add_argument(
+        "--receipts-api",
+        action="store_true",
+        help="enumerate pass-1 receipts via Filecoin.ChainGetParentReceipts "
+        "(the reference's pathway) instead of walking the receipts AMT; "
+        "needed for nodes that serve receipts only through the JSON API",
+    )
     gen.add_argument("-o", "--output", default=None)
     gen.add_argument("--metrics", action="store_true")
     gen.set_defaults(fn=_cmd_generate)

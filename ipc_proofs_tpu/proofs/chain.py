@@ -9,13 +9,32 @@ built either from Lotus RPC JSON (online) or straight from a blockstore
 
 from __future__ import annotations
 
+import base64
 from dataclasses import dataclass
 
 from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.state.events import Receipt
 from ipc_proofs_tpu.state.header import BlockHeader
 from ipc_proofs_tpu.store.blockstore import Blockstore
 
-__all__ = ["Tipset"]
+__all__ = ["Tipset", "receipt_from_api_json"]
+
+
+def receipt_from_api_json(obj: dict) -> Receipt:
+    """`ApiReceipt` JSON → `Receipt` (reference `client/types.rs:22-37`):
+    ``Return`` is base64 (null/empty → b""), ``EventsRoot`` a CIDMap or null.
+
+    This is the wire conversion for the `Filecoin.ChainGetParentReceipts`
+    fallback pathway — see `event_generator.scan_receipts_from_api`.
+    """
+    ret = obj.get("Return")
+    events_root = obj.get("EventsRoot")
+    return Receipt(
+        exit_code=obj["ExitCode"],
+        return_data=base64.b64decode(ret) if ret else b"",
+        gas_used=obj.get("GasUsed", 0),
+        events_root=CID.from_string(events_root["/"]) if events_root else None,
+    )
 
 
 @dataclass

@@ -51,6 +51,7 @@ __all__ = [
     "generate_event_proof",
     "collect_base_witness",
     "scan_receipt_events",
+    "scan_receipts_from_api",
     "match_receipt_indices",
     "record_matching_receipts",
 ]
@@ -105,6 +106,35 @@ def scan_receipt_events(
     receipts_amt = AMT.load(store, receipts_root, expected_version=0)
     for i, receipt_cbor in receipts_amt.items():
         receipt = Receipt.from_cbor(receipt_cbor)
+        if receipt.events_root is None:
+            continue
+        events_amt = AMT.load(store, receipt.events_root, expected_version=3)
+        events = [StampedEvent.from_cbor(v) for _, v in events_amt.items()]
+        scanned.append((i, receipt, events))
+    return scanned
+
+
+def scan_receipts_from_api(
+    store: Blockstore, client, child: Tipset
+) -> list[tuple[int, Receipt, list[StampedEvent]]]:
+    """PASS 1 decode leg via the `Filecoin.ChainGetParentReceipts` JSON API
+    (the reference's pathway, `events/generator.rs:199-204`): the receipt
+    list arrives in execution order as JSON, so pass 1 never walks the
+    receipts AMT — useful against nodes that serve receipts only through the
+    JSON API. Events AMTs are still read from ``store``; pass 2 also still
+    walks the receipts AMT (the witness must contain it for offline replay),
+    so a node pruning receipt *blocks* can scan but not produce a witness.
+    """
+    from ipc_proofs_tpu.proofs.chain import receipt_from_api_json
+
+    api_receipts = client.chain_get_parent_receipts(child.cids[0])
+    if api_receipts is None:
+        # null result = node doesn't know the block; the AMT path raises in
+        # the same situation, so don't silently emit an empty bundle
+        raise KeyError(f"ChainGetParentReceipts returned null for {child.cids[0]}")
+    scanned = []
+    for i, obj in enumerate(api_receipts):
+        receipt = receipt_from_api_json(obj)
         if receipt.events_root is None:
             continue
         events_amt = AMT.load(store, receipt.events_root, expected_version=3)
@@ -219,11 +249,17 @@ def generate_event_proof(
     topic_1: str,
     actor_id_filter: Optional[int] = None,
     match_backend=None,
+    receipts_client=None,
 ) -> EventProofBundle:
     """Generate proofs for every event matching (signature, topic_1, emitter).
 
     ``match_backend``: optional `BatchHashBackend` used to evaluate the
     predicate over all decoded events at once (pass 1); None = scalar path.
+
+    ``receipts_client``: optional `LotusClient`; when given, pass 1
+    enumerates receipts via `Filecoin.ChainGetParentReceipts` (the
+    reference's pathway) instead of walking the receipts AMT — see
+    `scan_receipts_from_api` for the trade-off.
     """
     matcher = EventMatcher(event_signature, topic_1)
     receipts_root = child.blocks[0].parent_message_receipts
@@ -233,7 +269,10 @@ def generate_event_proof(
 
     exec_order = build_execution_order(store, parent)
 
-    scanned = scan_receipt_events(store, receipts_root)
+    if receipts_client is not None:
+        scanned = scan_receipts_from_api(store, receipts_client, child)
+    else:
+        scanned = scan_receipt_events(store, receipts_root)
     matching_indices = match_receipt_indices(scanned, matcher, actor_id_filter, match_backend)
     proofs, recordings = record_matching_receipts(
         store, parent, child, exec_order, matching_indices, matcher, actor_id_filter

@@ -66,6 +66,11 @@ def verify_event_proof(
     scan errors, and for the semantic ``check_event`` predicate (which needs
     the real decoded event).
     """
+    if store is not None and verify_witness_cids:
+        raise ValueError(
+            "verify_witness_cids=True has no effect with a pre-loaded store; "
+            "verify CIDs when loading it (load_witness_store(verify_cids=True))"
+        )
     if store is None:
         store = load_witness_store(bundle.blocks, verify_cids=verify_witness_cids)
     if batch == "auto":

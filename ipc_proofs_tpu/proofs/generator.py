@@ -43,12 +43,17 @@ def generate_proof_bundle(
     storage_specs: list[StorageProofSpec],
     event_specs: list[EventProofSpec],
     match_backend=None,
+    receipts_client=None,
 ) -> UnifiedProofBundle:
     """Generate all requested proofs; witness deduplicated across proofs.
 
     ``store`` is any blockstore (RPC-backed online, memory-backed in tests);
     it is wrapped in a single `CachedBlockstore` shared by every generator,
     the reference's ~80 % RPC-reduction optimization.
+
+    ``receipts_client``: optional `LotusClient` enabling the
+    `ChainGetParentReceipts` pass-1 pathway (see
+    `event_generator.scan_receipts_from_api`).
     """
     cached = CachedBlockstore(store)
     shared = cached.shared_cache()
@@ -75,6 +80,7 @@ def generate_proof_bundle(
             event_spec.topic_1,
             event_spec.actor_id_filter,
             match_backend=match_backend,
+            receipts_client=receipts_client,
         )
         event_proofs.extend(bundle.proofs)
         all_blocks.update(bundle.blocks)

@@ -33,6 +33,11 @@ def verify_storage_proof(
     # bundle passes a pre-loaded ``store`` so the witness is loaded (and its
     # CIDs verified) once per bundle, not once per proof — the reference
     # reloads per proof (`storage/verifier.rs:68-78`).
+    if store is not None and verify_witness_cids:
+        raise ValueError(
+            "verify_witness_cids=True has no effect with a pre-loaded store; "
+            "verify CIDs when loading it (load_witness_store(verify_cids=True))"
+        )
     if store is None:
         store = load_witness_store(blocks, verify_cids=verify_witness_cids)
 

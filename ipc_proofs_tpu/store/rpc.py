@@ -98,6 +98,14 @@ class LotusClient:
             return None
         return base64.b64decode(result)
 
+    def chain_get_parent_receipts(self, block_cid: CID) -> Optional[list[dict]]:
+        """Fetch a block's parent receipts as API JSON
+        (`Filecoin.ChainGetParentReceipts`, reference
+        `events/generator.rs:199-204`). Returns the raw JSON objects; convert
+        with `proofs.chain.receipt_from_api_json`.
+        """
+        return self.request("Filecoin.ChainGetParentReceipts", [{"/": str(block_cid)}])
+
 
 class RpcBlockstore:
     """Read-only blockstore over `Filecoin.ChainReadObj`.

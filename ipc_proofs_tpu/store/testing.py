@@ -51,3 +51,39 @@ class FakeLotusClient:
     def chain_read_obj(self, cid: CID) -> Optional[bytes]:
         self.calls.append(("Filecoin.ChainReadObj", [{"/": str(cid)}]))
         return self._store.get(cid)
+
+    def chain_get_parent_receipts(self, block_cid: CID) -> Optional[list[dict]]:
+        """Serve `Filecoin.ChainGetParentReceipts` by synthesizing the API
+        JSON from the block's receipts AMT in the backing store (the dense
+        AMT order IS the execution order, which is what the real API
+        returns). A canned response, if present, takes precedence."""
+        self.calls.append(("Filecoin.ChainGetParentReceipts", [{"/": str(block_cid)}]))
+        if "Filecoin.ChainGetParentReceipts" in self.responses:
+            handler = self.responses["Filecoin.ChainGetParentReceipts"]
+            return handler(block_cid) if callable(handler) else handler
+
+        from ipc_proofs_tpu.ipld.amt import AMT
+        from ipc_proofs_tpu.state.events import Receipt
+        from ipc_proofs_tpu.state.header import BlockHeader
+
+        raw = self._store.get(block_cid)
+        if raw is None:
+            return None
+        header = BlockHeader.decode(raw)
+        amt = AMT.load(self._store, header.parent_message_receipts, expected_version=0)
+        out = []
+        for _, receipt_cbor in amt.items():
+            r = Receipt.from_cbor(receipt_cbor)
+            out.append(
+                {
+                    "ExitCode": r.exit_code,
+                    "Return": (
+                        base64.b64encode(r.return_data).decode("ascii")
+                        if r.return_data
+                        else None
+                    ),
+                    "GasUsed": r.gas_used,
+                    "EventsRoot": {"/": str(r.events_root)} if r.events_root else None,
+                }
+            )
+        return out

@@ -133,3 +133,61 @@ class TestOnlinePipeline:
 
         assert main(["demo"]) == 0
         assert "All valid: True" in capsys.readouterr().out
+
+
+class TestApiReceiptsPathway:
+    """The `ChainGetParentReceipts` fallback (reference
+    `events/generator.rs:199-204`, `client/types.rs:22-37`)."""
+
+    def test_receipt_from_api_json(self):
+        import base64
+
+        from ipc_proofs_tpu.core.cid import CID, RAW
+        from ipc_proofs_tpu.proofs.chain import receipt_from_api_json
+
+        root = CID.hash_of(b"events", codec=RAW)
+        r = receipt_from_api_json(
+            {
+                "ExitCode": 0,
+                "Return": base64.b64encode(b"\x01\x02").decode(),
+                "GasUsed": 77,
+                "EventsRoot": {"/": str(root)},
+            }
+        )
+        assert (r.exit_code, r.return_data, r.gas_used, r.events_root) == (0, b"\x01\x02", 77, root)
+        # null Return / EventsRoot (the common case)
+        r = receipt_from_api_json({"ExitCode": 1, "Return": None, "GasUsed": 0, "EventsRoot": None})
+        assert r.return_data == b"" and r.events_root is None
+
+    def test_api_pathway_produces_identical_proofs(self):
+        world, client = _world_and_client()
+        parent = Tipset.fetch(client, 500)
+        child = Tipset.fetch(client, 501)
+        store = RpcBlockstore(client)
+        specs = [EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)]
+
+        via_amt = generate_proof_bundle(store, parent, child, [], specs)
+        via_api = generate_proof_bundle(
+            store, parent, child, [], specs, receipts_client=client
+        )
+        assert [p.to_json_obj() for p in via_api.event_proofs] == [
+            p.to_json_obj() for p in via_amt.event_proofs
+        ]
+        # pass 2 still records the receipts AMT, so the witnesses agree too
+        assert [b.cid for b in via_api.blocks] == [b.cid for b in via_amt.blocks]
+        assert any(c[0] == "Filecoin.ChainGetParentReceipts" for c in client.calls)
+        assert verify_proof_bundle(via_api, TrustPolicy.accept_all()).all_valid()
+
+    def test_null_api_receipts_raises_not_empty_bundle(self):
+        import pytest
+
+        world, client = _world_and_client()
+        client.responses["Filecoin.ChainGetParentReceipts"] = lambda _cid: None
+        parent = Tipset.fetch(client, 500)
+        child = Tipset.fetch(client, 501)
+        with pytest.raises(KeyError, match="ChainGetParentReceipts"):
+            generate_proof_bundle(
+                RpcBlockstore(client), parent, child, [],
+                [EventProofSpec(event_signature=SIG, topic_1=SUBNET)],
+                receipts_client=client,
+            )
