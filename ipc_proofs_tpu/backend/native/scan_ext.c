@@ -525,6 +525,12 @@ static int walk_amt_root(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     PyErr_SetString(PyExc_ValueError, "invalid AMT height");
     goto out;
   }
+  /* span = width^height and every index stay below 2^62: forged roots with
+   * huge heights must fail cleanly, not overflow int64 (UB). */
+  if ((int64_t)bit_width * (height + 1) > 62) {
+    PyErr_SetString(PyExc_ValueError, "AMT too deep for native scanner");
+    goto out;
+  }
   if (rd_uint(&p, &tmp) < 0) goto out; /* count (unused) */
   rc = walk_node(s, NULL, 0, &p, bit_width, height, 0, fn, ctx);
 out:
@@ -542,6 +548,10 @@ typedef struct {
 
 static int event_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   EvCtx *c = (EvCtx *)ctx;
+  if (index > INT32_MAX) {
+    PyErr_SetString(PyExc_ValueError, "event index exceeds int32 range");
+    return -1;
+  }
   return emit_event(s, p, c->pair_id, c->rcpt_idx, (int32_t)index);
 }
 
@@ -567,6 +577,10 @@ static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   if (rd_cid_or_null(p, &ev_cid, &ev_len, &ok) < 0) return -1;
   if (!ok) return 0; /* null events root: skip (scan_receipt_events parity) */
 
+  if (index > INT32_MAX) {
+    PyErr_SetString(PyExc_ValueError, "receipt index exceeds int32 range");
+    return -1;
+  }
   s->n_receipts++;
   EvCtx ec = {c->pair_id, (int32_t)index, 0};
   return walk_amt_root(s, ev_cid, ev_len, 3, event_leaf, &ec);

@@ -1,0 +1,480 @@
+"""Independent byte-compatibility anchors.
+
+Round-trip tests (encoder ↔ decoder of this repo) cannot catch a
+*systematic* divergence from the real Filecoin wire formats — both sides
+would share the bug. Every vector in this file is therefore derived
+INDEPENDENTLY of the code under test:
+
+- **published digests**: Keccak-256 / SHA-256 / BLAKE2b-256 values published
+  in specs and ecosystem test suites (cited inline), plus the canonical
+  empty-raw-sha256 IPFS CID;
+- **hashlib**: Python's independent BLAKE2b/SHA-256 implementations anchor
+  every CID in this file (never this repo's C/JAX/Pallas kernels);
+- **hand-derived bytes**: raw CBOR assembled byte-by-byte in this file from
+  RFC 8949 and the published DAG-CBOR / go-amt-ipld / go-hamt-ipld /
+  fvm_shared wire formats — never produced by calling the encoder under
+  test.
+
+What still cannot be anchored in this sandbox (zero network egress): a raw
+block header + CID fetched from the live chain, and the go-hamt-ipld /
+go-amt-ipld fixture root CIDs (not reproducible from memory with
+confidence). The structures those would cover are pinned here instead via
+hand-derived node encodings at every layer (empty + populated, v0 + v3).
+"""
+
+import hashlib
+
+import pytest
+
+from ipc_proofs_tpu.core.bigint import bigint_from_bytes, bigint_to_bytes
+from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, DAG_CBOR, RAW, SHA2_256
+from ipc_proofs_tpu.core.dagcbor import decode_py, encode
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
+from ipc_proofs_tpu.core.varint import decode_uvarint, encode_uvarint
+from ipc_proofs_tpu.ipld.amt import AMT, amt_build, amt_build_v0
+from ipc_proofs_tpu.ipld.hamt import HAMT, hamt_build
+from ipc_proofs_tpu.state.address import Address
+from ipc_proofs_tpu.state.events import Receipt
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+
+def b2b(data: bytes) -> bytes:
+    """Independent blake2b-256 (hashlib, not this repo's kernels)."""
+    return hashlib.blake2b(data, digest_size=32).digest()
+
+
+def cid_of(block: bytes, codec: int = DAG_CBOR) -> CID:
+    """Independently-computed Filecoin chain CID for raw block bytes."""
+    return CID(1, codec, BLAKE2B_256, b2b(block))
+
+
+class TestPublishedDigests:
+    """Digest values published outside this repo."""
+
+    def test_keccak256(self):
+        # Keccak team test vectors (pre-NIST padding), as used by Ethereum
+        assert keccak256(b"").hex() == (
+            "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        )
+        assert keccak256(b"abc").hex() == (
+            "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+        )
+
+    def test_keccak256_erc20_event_topics(self):
+        # The universally-published ERC-20 log topic0 values — any Ethereum
+        # explorer shows these for every Transfer/Approval event.
+        assert keccak256(b"Transfer(address,address,uint256)").hex() == (
+            "ddf252ad1be2c89b69c2b068fc378daa952ba7f163c4a11628f55a4df523b3ef"
+        )
+        assert keccak256(b"Approval(address,address,uint256)").hex() == (
+            "8c5be1e5ebec7d5bd14f71427d1e84f3dd0314c0f7b2291e5b200ac8c7c3b925"
+        )
+
+    def test_blake2b_256(self):
+        # Published BLAKE2b-256 vectors (RFC 7693 parameterization); also
+        # cross-checked against hashlib, an implementation this repo doesn't own.
+        assert blake2b_256(b"").hex() == (
+            "0e5751c026e543b2e8ab2eb06099daa1d1e5df47778f7787faab45cdf12fe3a8"
+        )
+        assert blake2b_256(b"abc").hex() == (
+            "bddd813c634239723171ef3fee98579b94964e3bb1cb3e427262c8c068d52319"
+        )
+
+    def test_blake2b_256_matches_hashlib_on_varied_lengths(self):
+        import random
+
+        rng = random.Random(0xF17)
+        for n in (0, 1, 31, 32, 33, 63, 64, 65, 127, 128, 129, 1000, 4096):
+            data = bytes(rng.getrandbits(8) for _ in range(n))
+            assert blake2b_256(data) == b2b(data), f"len={n}"
+
+    def test_famous_empty_raw_cid(self):
+        # The canonical CIDv1(raw, sha2-256) of zero bytes — appears across
+        # IPFS documentation and test suites.
+        assert str(CID.hash_of(b"", codec=RAW, mh_code=SHA2_256)) == (
+            "bafkreihdwdcefgh4dqkjv67uzcmw7ojee6xedzdetojuzjevtenxquvyku"
+        )
+
+    def test_sha256_nist(self):
+        # FIPS 180 "abc" vector through the CID path
+        assert CID.hash_of(b"abc", codec=RAW, mh_code=SHA2_256).digest.hex() == (
+            "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+        )
+
+
+class TestVarint:
+    """Unsigned LEB128 (multiformats uvarint), hand-derived."""
+
+    @pytest.mark.parametrize(
+        "value,expected",
+        [
+            (0, "00"),
+            (1, "01"),
+            (127, "7f"),
+            (128, "8001"),
+            (255, "ff01"),
+            (300, "ac02"),
+            (16384, "808001"),
+            (0x71, "71"),  # dag-cbor codec
+            (0x55, "55"),  # raw codec
+            (0xB220, "a0e402"),  # blake2b-256 multihash code
+        ],
+    )
+    def test_encode(self, value, expected):
+        assert encode_uvarint(value).hex() == expected
+        decoded, off = decode_uvarint(bytes.fromhex(expected))
+        assert decoded == value and off == len(expected) // 2
+
+
+class TestDagCborRfc8949:
+    """RFC 8949 appendix-A style vectors, hand-encoded (deterministic form)."""
+
+    @pytest.mark.parametrize(
+        "obj,expected",
+        [
+            (0, "00"),
+            (1, "01"),
+            (10, "0a"),
+            (23, "17"),
+            (24, "1818"),
+            (25, "1819"),
+            (100, "1864"),
+            (255, "18ff"),
+            (256, "190100"),
+            (1000, "1903e8"),
+            (65535, "19ffff"),
+            (65536, "1a00010000"),
+            (1000000, "1a000f4240"),
+            (4294967295, "1affffffff"),
+            (4294967296, "1b0000000100000000"),
+            (18446744073709551615, "1bffffffffffffffff"),
+            (-1, "20"),
+            (-10, "29"),
+            (-24, "37"),
+            (-25, "3818"),
+            (-100, "3863"),
+            (-1000, "3903e7"),
+            (b"", "40"),
+            (b"\x01\x02\x03\x04", "4401020304"),
+            ("", "60"),
+            ("a", "6161"),
+            ("IETF", "6449455446"),
+            ("ü", "62c3bc"),
+            ([], "80"),
+            ([1, 2, 3], "83010203"),
+            ([1, [2, 3], [4, 5]], "8301820203820405"),
+            (list(range(1, 26)),
+             "98190102030405060708090a0b0c0d0e0f101112131415161718181819"),
+            ({}, "a0"),
+            ({"a": 1, "b": [2, 3]}, "a26161016162820203"),
+            (False, "f4"),
+            (True, "f5"),
+            (None, "f6"),
+            # DAG-CBOR floats are always 64-bit
+            (1.1, "fb3ff199999999999a"),
+            (1.0e300, "fb7e37e43c8800759c"),
+            (-4.1, "fbc010666666666666"),
+        ],
+    )
+    def test_scalar_vectors(self, obj, expected):
+        assert encode(obj).hex() == expected
+        decoded = decode_py(bytes.fromhex(expected))
+        assert decoded == obj and type(decoded) is type(obj)
+
+    def test_canonical_map_ordering_length_first(self):
+        # RFC 7049 §3.9 canonical order (length-first, then bytewise) — the
+        # ordering DAG-CBOR inherited and go-ipld-cbor ships. "b" < "aa".
+        assert encode({"aa": 1, "b": 2}).hex() == "a2616202626161 01".replace(" ", "")
+        assert encode({"b": 2, "aa": 1}).hex() == "a2616202626161 01".replace(" ", "")
+
+    def test_cid_tag_42(self):
+        # tag(42) wrapping bytes(0x00 ++ cid): D8 2A head, 58 25 byte head
+        # (37 = 1 identity prefix + 36 cid bytes), hand-assembled.
+        cid = CID.hash_of(b"", codec=RAW, mh_code=SHA2_256)
+        cid_bytes = bytes.fromhex("015512 20".replace(" ", "")) + hashlib.sha256(b"").digest()
+        assert cid.to_bytes() == cid_bytes
+        expected = bytes.fromhex("d82a5825") + b"\x00" + cid_bytes
+        assert encode(cid) == expected
+        assert decode_py(expected) == cid
+
+    def test_filecoin_chain_cid_shape(self):
+        # CIDv1 dag-cbor blake2b-256: 01 71 a0e402 20 ++ digest (hand bytes)
+        block = encode([1, 2, 3])
+        cid = cid_of(block)
+        assert cid.to_bytes() == bytes.fromhex("0171a0e40220") + b2b(block)
+
+
+class TestBigIntVectors:
+    """fvm_shared BigInt byte form: empty=0, else sign byte ++ BE magnitude."""
+
+    @pytest.mark.parametrize(
+        "value,expected",
+        [
+            (0, ""),
+            (1, "0001"),
+            (255, "00ff"),
+            (256, "000100"),
+            (10**18, "000de0b6b3a7640000"),  # 1 FIL in attoFIL
+            (-1, "0101"),
+            (-255, "01ff"),
+        ],
+    )
+    def test_vectors(self, value, expected):
+        assert bigint_to_bytes(value).hex() == expected
+        assert bigint_from_bytes(bytes.fromhex(expected)) == value
+
+
+class TestAddressVectors:
+    """fvm_shared Address byte form: protocol byte ++ payload (uvarint for ID)."""
+
+    @pytest.mark.parametrize(
+        "actor_id,expected",
+        [
+            (0, "0000"),
+            (1, "0001"),
+            (100, "0064"),
+            (1024, "008008"),
+            (18446744073709551615, "00ffffffffffffffffff01"),  # max u64
+        ],
+    )
+    def test_id_address_bytes(self, actor_id, expected):
+        assert Address.new_id(actor_id).to_bytes().hex() == expected
+        assert Address.from_bytes(bytes.fromhex(expected)).id() == actor_id
+
+
+class TestAmtNodeLayout:
+    """go-amt-ipld wire format, hand-assembled.
+
+    v0 root = [height, count, node]; v3 root = [bitWidth, height, count, node].
+    node = [bmap(bytes, LSB-first bits, width/8 bytes), [links], [values]].
+    """
+
+    def test_empty_v0(self):
+        store = MemoryBlockstore()
+        root = amt_build_v0(store, [])
+        # [0, 0, [h'00', [], []]] — width 8 ⇒ 1 bitmap byte
+        expected = bytes.fromhex("8300008341008080")
+        assert store.get(root) == expected
+        assert root == cid_of(expected)
+
+    def test_empty_v3_bitwidth5(self):
+        store = MemoryBlockstore()
+        root = amt_build(store, [], bit_width=5, version=3)
+        # [5, 0, 0, [h'00000000', [], []]] — width 32 ⇒ 4 bitmap bytes
+        expected = bytes.fromhex("840500008344000000008080")
+        assert store.get(root) == expected
+        assert root == cid_of(expected)
+
+    def test_two_values_v3(self):
+        store = MemoryBlockstore()
+        root = amt_build(store, [b"a", b"b"], bit_width=5, version=3)
+        # height 0, count 2, bitmap bits {0,1} ⇒ 03 00 00 00 (LSB-first)
+        expected = bytes.fromhex("84050002834403000000") + bytes.fromhex("8082416141 62".replace(" ", ""))
+        assert store.get(root) == expected
+        assert root == cid_of(expected)
+
+    def test_sparse_two_level_v0(self):
+        # Index 9 with bit_width 3: height 1; root node links slot 1
+        # (9 >> 3 = 1), leaf holds slot 1 (9 & 7 = 1).
+        store = MemoryBlockstore()
+        root = amt_build_v0(store, {9: 7})
+        leaf = bytes.fromhex("8341028081 07".replace(" ", ""))  # [h'02', [], [7]]
+        leaf_cid = cid_of(leaf)
+        # root node: [h'02', [leaf_cid], []]
+        root_node = (
+            bytes.fromhex("834102 81".replace(" ", ""))
+            + bytes.fromhex("d82a5827") + b"\x00" + leaf_cid.to_bytes()
+            + bytes.fromhex("80")
+        )
+        expected_root = bytes.fromhex("830101") + root_node  # [1, 1, node]
+        assert store.get(root) == expected_root
+        assert root == cid_of(expected_root)
+        # and the reader agrees with the hand layout
+        assert AMT.load(store, root).get(9) == 7
+
+    def test_amt_cid_link_head_is_58_27(self):
+        # every AMT link encodes as d8 2a 58 27 00 ++ 36 cid bytes: the byte
+        # string is 39 = 0x27 long (1 + 36), needing the one-byte length head
+        store = MemoryBlockstore()
+        inner = amt_build_v0(store, {100: 1})
+        raw = store.get(inner)
+        assert bytes.fromhex("d82a582700") in raw
+
+
+class TestHamtNodeLayout:
+    """go-hamt-ipld / fvm_ipld_hamt wire format, hand-assembled.
+
+    node = [bitfield (minimal big-endian bytes, b"" for 0), [pointers]];
+    pointer = tag-42 link | bucket [[key, value], ...]; key hash = sha256,
+    bits MSB-first, 5 at a time.
+    """
+
+    def test_empty(self):
+        store = MemoryBlockstore()
+        root = hamt_build(store, {})
+        expected = bytes.fromhex("824080")  # [h'', []]
+        assert store.get(root) == expected
+        assert root == cid_of(expected)
+
+    def test_single_entry(self):
+        store = MemoryBlockstore()
+        key = b"k"
+        root = hamt_build(store, {key: 42})
+        # slot = top 5 bits of sha256("k") — computed via hashlib, not the
+        # repo's _hash_bits
+        slot = hashlib.sha256(key).digest()[0] >> 3
+        bitfield = 1 << slot
+        bf_bytes = bitfield.to_bytes((bitfield.bit_length() + 7) // 8, "big")
+        expected = (
+            bytes([0x82])
+            + bytes([0x40 + len(bf_bytes)]) + bf_bytes
+            + bytes.fromhex("81")  # one pointer
+            + bytes.fromhex("81")  # bucket of one KV
+            + bytes.fromhex("82416b182a")  # [h'6b', 42]
+        )
+        assert store.get(root) == expected
+        assert root == cid_of(expected)
+        assert HAMT.load(store, root).get(key) == 42
+
+    def test_bucket_order_is_key_bytes(self):
+        # two keys that share a top-5-bits slot must sit in one bucket sorted
+        # by key bytes; search for such a pair deterministically
+        import itertools
+
+        pairs = {}
+        collision = None
+        for i in itertools.count():
+            k = b"g-%d" % i
+            slot = hashlib.sha256(k).digest()[0] >> 3
+            if slot in pairs:
+                collision = (pairs[slot], k)
+                break
+            pairs[slot] = k
+        a, b = sorted(collision)
+        store = MemoryBlockstore()
+        root = hamt_build(store, {b: 2, a: 1})
+        node = decode_py(store.get(root))
+        bucket = next(p for p in node[1] if isinstance(p, list))
+        assert bucket == [[a, 1], [b, 2]]
+
+
+class TestFilecoinTupleLayouts:
+    """fvm_shared struct tuple layouts, hand-assembled CBOR."""
+
+    def test_receipt_tuple(self):
+        store = MemoryBlockstore()
+        events_root = cid_of(encode([5, 0, 0, [b"\x00" * 4, [], []]]))
+        r = Receipt(exit_code=0, return_data=b"", gas_used=100, events_root=events_root)
+        expected = (
+            bytes.fromhex("8400401864")  # [0, h'', 100, …
+            + bytes.fromhex("d82a5827") + b"\x00" + events_root.to_bytes()
+        )
+        assert encode(r.to_cbor()) == expected
+        back = Receipt.from_cbor(decode_py(expected))
+        assert back == r
+
+    def test_actor_state_tuple(self):
+        from ipc_proofs_tpu.state.actors import ActorState
+
+        code = cid_of(b"fil/evm-code-block")
+        head = cid_of(b"evm-state-block")
+        actor = ActorState(code=code, state=head, call_seq_num=7, balance=255)
+        link = bytes.fromhex("d82a5827")
+        # v10+ 5-field layout: [code, head, call_seq, balance, delegated(null)]
+        expected = (
+            b"\x85"
+            + link + b"\x00" + code.to_bytes()
+            + link + b"\x00" + head.to_bytes()
+            + b"\x07"
+            + bytes.fromhex("4200ff")  # bigint bytes h'00ff'
+            + b"\xf6"
+        )
+        assert encode(actor.to_tuple()) == expected
+
+    def test_state_root_tuple(self):
+        from ipc_proofs_tpu.state.actors import StateRoot
+
+        actors = cid_of(encode([b"", []]))
+        info = cid_of(encode("state-info"))
+        sr = StateRoot(version=5, actors=actors, info=info)
+        link = bytes.fromhex("d82a5827")
+        expected = (
+            b"\x83\x05"
+            + link + b"\x00" + actors.to_bytes()
+            + link + b"\x00" + info.to_bytes()
+        )
+        assert encode(sr.to_tuple()) == expected
+
+    def test_stamped_event_tuple(self):
+        """[emitter, [[flags, key, codec, value], …]] — the hottest decode
+        on the event-scan path (reference `events/generator.rs:215-233`)."""
+        from ipc_proofs_tpu.state.events import (
+            ActorEvent,
+            EventEntry,
+            IPLD_RAW,
+            StampedEvent,
+        )
+
+        t1 = bytes(range(32))
+        stamped = StampedEvent(
+            emitter=1001,
+            event=ActorEvent(entries=[EventEntry(0, "t1", IPLD_RAW, t1)]),
+        )
+        expected = (
+            b"\x82"  # [emitter, event]
+            + bytes.fromhex("1903e9")  # 1001
+            + b"\x81"  # one entry
+            + b"\x84\x00"  # [flags=0,
+            + bytes.fromhex("627431")  # "t1"
+            + bytes.fromhex("1855")  # codec 0x55
+            + bytes.fromhex("5820") + t1  # value bytes(32)
+        )
+        assert encode(stamped.to_cbor()) == expected
+        assert StampedEvent.from_cbor(decode_py(expected)) == stamped
+
+    def test_header_16_tuple_field_positions(self):
+        """A minimal header, hand-assembled: parents at index 5, weight 6,
+        height 7, state root 8, receipts 9, messages 10, timestamp 12,
+        fork_signaling 14 (reference `common/decode.rs:101-118`)."""
+        from ipc_proofs_tpu.state.header import BlockHeader, extract_parent_state_root
+
+        p1 = cid_of(b"parent-block")
+        state = cid_of(b"state-block")
+        rcpts = cid_of(b"receipts-block")
+        msgs = cid_of(b"txmeta-block")
+        header = BlockHeader(
+            parents=[p1],
+            height=100,
+            parent_state_root=state,
+            parent_message_receipts=rcpts,
+            messages=msgs,
+            timestamp=1700003000,
+            miner="f01000",
+        )
+        link = bytes.fromhex("d82a5827") + b"\x00"
+        # assemble explicitly, field by field
+        expected = b"".join(
+            [
+                b"\x90",
+                bytes.fromhex("66") + b"f01000",  # 0 miner text(6)
+                b"\xf6",  # 1 ticket
+                b"\xf6",  # 2 election proof
+                b"\x80",  # 3 beacon entries
+                b"\x80",  # 4 winpost proofs
+                b"\x81" + link + p1.to_bytes(),  # 5 parents
+                b"\x40",  # 6 parent weight h''
+                b"\x18\x64",  # 7 height 100
+                link + state.to_bytes(),  # 8
+                link + rcpts.to_bytes(),  # 9
+                link + msgs.to_bytes(),  # 10
+                b"\xf6",  # 11 bls aggregate
+                bytes.fromhex("1a6553fcb8"),  # 12 timestamp 1700003000
+                b"\xf6",  # 13 block sig
+                b"\x00",  # 14 fork signaling
+                b"\x40",  # 15 parent base fee h''
+            ]
+        )
+        raw = header.encode()
+        assert raw == expected
+        assert header.cid() == cid_of(expected)
+        assert str(extract_parent_state_root(raw)) == str(state)

@@ -164,3 +164,26 @@ class TestNativeScan:
         assert batch is not None and batch.n_events == 1
         assert opaque.gets > 0
         assert_scan_matches(bs, [root])  # same answer as the raw-map path
+
+
+class TestForgedInputs:
+    """Adversarial witness blocks must fail cleanly, never overflow."""
+
+    def test_forged_deep_amt_root_rejected(self):
+        # v0 root [21, 1, node]: passes the height<=64 check but
+        # 8^21 = 2^63 would overflow the int64 span — must raise cleanly
+        from ipc_proofs_tpu.store.blockstore import put_cbor
+
+        bs = MemoryBlockstore()
+        node = [b"\x01", [], [1]]
+        root = put_cbor(bs, [21, 1, node])
+        with pytest.raises(ValueError, match="too deep"):
+            scan_events_flat(bs, [root])
+
+    def test_deep_but_valid_python_amt_still_errors_consistently(self):
+        # the Python reader tolerates any height; the native scanner bounds
+        # it — build a legitimate shallow AMT and confirm both agree first
+        bs = MemoryBlockstore()
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="x")]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        assert_scan_matches(bs, [world.child.blocks[0].parent_message_receipts])
