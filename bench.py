@@ -1,16 +1,30 @@
 #!/usr/bin/env python
-"""Headline benchmark: event-proofs/sec over a 4096-tipset batch.
+"""Headline benchmark: END-TO-END event proofs over a 4096-tipset-pair range.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-The measured quantity is BASELINE.json config 2: batch event-proof
-generation (sparse filter, ~1% receipt match rate) — the padded
-[tipset, receipt, event] match pipeline plus the per-receipt reduce, on the
-best available platform (TPU chip if the axon backend initializes, else XLA
-CPU). ``vs_baseline`` compares against the reference's architecture: a
-single-threaded scalar decode+match loop over the same events, measured
-in-process (the reference publishes no numbers — BASELINE.md).
+The measured quantity is the BASELINE.json north star, measured honestly:
+the FULL pipeline over a 4096-pair synthetic range (~1 % receipt match rate)
+on the best available platform —
+
+  generate:  Phase A host scan (native C walker over receipts/events AMTs)
+             → Phase B device match mask (one jitted dispatch)
+             → Phase C pass-2 witness recording (host)
+             → Phase D merged witness materialization
+  verify:    batched witness-CID recompute (device or scalar, whichever the
+             backend picks for the batch size) → offline replay of every
+             proof (grouped batch verifier)
+
+The e2e number includes every host decode, device transfer, and readback a
+real user pays (warmed jit caches; compile excluded by a warmup pass at the
+same shapes). ``vs_baseline`` compares against the reference architecture —
+a single-thread scalar decode+match+record+verify over the same world,
+measured in-process on a subrange and scaled (the reference publishes no
+numbers — BASELINE.md).
+
+Two secondary stderr lines report the device-kernel slope rate (mask-only,
+tunnel RTT cancelled — the round-1 headline) and the per-stage breakdown.
 
 Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
 """
@@ -28,52 +42,90 @@ def _log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+SIG = "NewTopDownMessage(bytes32,uint256)"
+TOPIC1 = "calib-subnet-1"
+ACTOR = 1001
 
 
-def _scalar_baseline_proofs_per_sec(
-    topic0: bytes, topic1: bytes, total_events: int, proofs_per_pass: int, sample: int = 20000
-) -> float:
-    """The reference-architecture baseline: one thread, one Python object per
-    event, decode + match per event (events/generator.rs:217-233 shape)."""
-    from ipc_proofs_tpu.backend.cpu import CpuBackend
-    from ipc_proofs_tpu.fixtures import EventFixture
+def _staged_verify(bundle, backend):
+    """Offline verification with per-stage timers; returns (results, stages)."""
+    from ipc_proofs_tpu.core.cid import BLAKE2B_256
+    from ipc_proofs_tpu.proofs.bundle import EventProofBundle
+    from ipc_proofs_tpu.proofs.event_verifier import verify_event_proof
+    from ipc_proofs_tpu.proofs.witness import load_witness_store
 
-    events = []
-    for i in range(sample // 2):
-        events.append(
-            EventFixture(emitter=1001, signature="NewTopDownMessage(bytes32,uint256)",
-                         topic1="calib-subnet-1").to_stamped()
-        )
-        events.append(
-            EventFixture(emitter=1001, signature="Other(uint256)", topic1="nope").to_stamped()
-        )
-    backend = CpuBackend(use_native=False)
+    stages = {}
+    t0 = time.perf_counter()
+    batch = [b for b in bundle.blocks if b.cid.mh_code == BLAKE2B_256]
+    if batch and not backend.verify_block_cids(
+        [b.cid.digest for b in batch], [b.data for b in batch]
+    ):
+        raise ValueError("witness CID mismatch")
+    stages["verify_cids"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    store = load_witness_store(bundle.blocks, verify_cids=False)
+    stages["load_witness"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    results = verify_event_proof(
+        EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks),
+        lambda e, c: True,
+        lambda e, c: True,
+        store=store,
+    )
+    stages["verify_replay"] = time.perf_counter() - t0
+    return results, stages
+
+
+def _scalar_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
+    """Reference-architecture e2e rate (proofs/s): single thread, per-event
+    Python decode + match (events/generator.rs:217-239 shape), scalar
+    verify with per-proof witness stores, scalar CID recompute. Measured on
+    a small subrange; rates are per-pair-linear so the rate transfers."""
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+
+    bs, pairs, _ = build_range_world(
+        n_pairs_sample, receipts, events, base_height=10_000_000
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
     start = time.perf_counter()
-    backend.event_match_mask(events, topic0, topic1, 1001)
+    bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=None)
+    result = verify_proof_bundle(
+        bundle, TrustPolicy.accept_all(), verify_witness_cids=True
+    )
     elapsed = time.perf_counter() - start
-    per_event = elapsed / len(events)
-    pass_time = per_event * total_events
-    return proofs_per_pass / pass_time
+    assert result.all_valid()
+    n = len(bundle.event_proofs)
+    return n / elapsed if elapsed > 0 else 0.0
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--platform", default="auto", help="auto|default|cpu")
-    parser.add_argument("--tipsets", type=int, default=4096)
+    parser.add_argument("--tipsets", type=int, default=4096, help="tipset pairs in the range")
     parser.add_argument("--receipts", type=int, default=16)
     parser.add_argument("--events", type=int, default=4)
     parser.add_argument("--match-rate", type=float, default=0.01)
     parser.add_argument(
-        "--iters", type=int, default=20,
-        help="lower bound for the slope-timing k_large loop length "
-        "(full runs floor it at 105 passes for resolution; --quick floors at 13)",
+        "--kernel-iters", type=int, default=20,
+        help="lower bound for the secondary kernel-slope loop (full runs "
+        "floor it at 105 passes; --quick floors at 13)",
     )
+    parser.add_argument("--baseline-pairs", type=int, default=128,
+                        help="subrange size for the scalar baseline measurement")
     parser.add_argument("--probe-timeout", type=float, default=240.0)
     parser.add_argument("--quick", action="store_true", help="small shapes for smoke runs")
     args = parser.parse_args()
 
     if args.quick:
-        args.tipsets, args.iters = min(args.tipsets, 256), min(args.iters, 5)
+        args.tipsets = min(args.tipsets, 256)
+        args.baseline_pairs = min(args.baseline_pairs, 32)
+        args.kernel_iters = min(args.kernel_iters, 5)
 
     from ipc_proofs_tpu.utils.platform import pick_platform
 
@@ -84,84 +136,140 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax
 
-    devices = jax.devices()
-    _log(f"bench: devices = {devices}")
+    _log(f"bench: devices = {jax.devices()}")
 
-    from ipc_proofs_tpu.parallel.mesh import make_mesh
-    from ipc_proofs_tpu.parallel.pipeline import sharded_match_pipeline, synthetic_event_batch
-    from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+    from ipc_proofs_tpu.backend import get_backend
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+    from ipc_proofs_tpu.utils.metrics import Metrics
 
-    topic0 = hash_event_signature("NewTopDownMessage(bytes32,uint256)")
-    topic1 = ascii_to_bytes32("calib-subnet-1")
-
-    t_build = time.perf_counter()
-    batch = synthetic_event_batch(
-        args.tipsets, args.receipts, args.events,
-        topic0, topic1, emitter=1001, match_rate=args.match_rate, seed=42,
+    # --- build the range world (setup, not measured) ------------------------
+    t0 = time.perf_counter()
+    bs, pairs, n_matching = build_range_world(
+        args.tipsets, args.receipts, args.events, args.match_rate
     )
     total_events = args.tipsets * args.receipts * args.events
     _log(
-        f"bench: batch [{args.tipsets}×{args.receipts}×{args.events}] = "
-        f"{total_events} events built in {time.perf_counter() - t_build:.2f}s"
+        f"bench: world [{args.tipsets} pairs × {args.receipts} rcpt × "
+        f"{args.events} ev] = {total_events} events, {n_matching} matching "
+        f"receipts, built in {time.perf_counter() - t0:.1f}s"
     )
 
-    n_dev = len(devices)
-    sp = 2 if (n_dev % 2 == 0 and n_dev > 1) else 1
-    mesh = make_mesh(n_dev, sp=sp)
-    jitted, shard_batch = sharded_match_pipeline(mesh)
-    sharded_args = shard_batch(batch, topic0, topic1, 1001)
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
+    backend = get_backend("tpu")
 
-    # warmup / compile; the true per-pass count for reporting
-    t_compile = time.perf_counter()
-    hits, mask, count = jitted(*sharded_args)
-    proofs_per_pass = int(count)
+    # --- warmup: compile every jit kernel at the measurement shapes ---------
+    t0 = time.perf_counter()
+    bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
+    results, _ = _staged_verify(bundle, backend)
+    assert all(results) and len(results) == len(bundle.event_proofs)
+    _log(f"bench: warmup (incl. jit compile) {time.perf_counter() - t0:.1f}s")
+
+    # --- measured end-to-end pass ------------------------------------------
+    metrics = Metrics()
+    t_gen0 = time.perf_counter()
+    bundle = generate_event_proofs_for_range(
+        bs, pairs, spec, match_backend=backend, metrics=metrics
+    )
+    t_gen = time.perf_counter() - t_gen0
+    results, vstages = _staged_verify(bundle, backend)
+    assert all(results)
+    n_proofs = len(bundle.event_proofs)
+    t_verify = sum(vstages.values())
+    t_e2e = t_gen + t_verify
+
+    gtimers = json.loads(metrics.to_json())["timers"]
+    stages = {
+        "scan": gtimers.get("range_scan", {}).get("total_s", 0.0),
+        "match": gtimers.get("range_match", {}).get("total_s", 0.0),
+        "record": gtimers.get("range_record", {}).get("total_s", 0.0),
+        **vstages,
+    }
+    stage_str = " ".join(f"{k}={v * 1000:.0f}ms" for k, v in stages.items())
+    proofs_per_sec = n_proofs / t_e2e
+    events_per_sec = total_events / t_e2e
     _log(
-        f"bench: compile+first pass {time.perf_counter() - t_compile:.2f}s, "
-        f"{proofs_per_pass} matching proofs per pass"
+        f"bench: e2e gen {t_gen * 1e3:.0f}ms + verify {t_verify * 1e3:.0f}ms → "
+        f"{n_proofs} proofs, {len(bundle.blocks)} witness blocks "
+        f"({bundle.witness_bytes()} B)"
     )
-
-    # Slope-timed in-jit loop: the chip sits behind a high-latency tunnel
-    # (~60 ms/dispatch) and block_until_ready is unreliable on the axon
-    # platform, so per-call timing measures the link, not the kernel.
-    # See ipc_proofs_tpu/utils/timing.py.
-    import jax.numpy as jnp
-
-    from ipc_proofs_tpu.utils.timing import measure_pass_seconds
-
-    def one_pass(i, topics, n_topics, emitters, valid, s0, s1, actor):
-        # XOR the loop index into the topic words: iteration-dependent input
-        # (no hoisting), and the count depends on the real match output.
-        _, _, c = jitted(topics ^ i.astype(topics.dtype), n_topics, emitters, valid, s0, s1, actor)
-        return c.astype(jnp.int32)
-
-    if args.quick:
-        k_small, k_large = 3, max(args.iters, 13)
-    else:
-        k_small, k_large = 5, max(args.iters, 105)
-    pt = measure_pass_seconds(one_pass, sharded_args, k_small=k_small, k_large=k_large)
-    pass_time = pt.seconds
-    proofs_per_sec = proofs_per_pass / pass_time
-    events_per_sec = total_events / pass_time
+    _log(f"bench: stages {stage_str}")
     _log(
-        f"bench: slope timing k={pt.k_small}/{pt.k_large} "
-        f"(t={pt.t_small*1e3:.1f}/{pt.t_large*1e3:.1f} ms) → "
-        f"{pass_time*1e6:.1f} us/pass, "
-        f"{events_per_sec:,.0f} events/s scanned, {proofs_per_sec:,.0f} proofs/s"
+        f"bench: {proofs_per_sec:,.0f} proofs/s e2e, "
+        f"{events_per_sec:,.0f} events/s scanned e2e"
     )
 
-    baseline = _scalar_baseline_proofs_per_sec(topic0, topic1, total_events, proofs_per_pass)
-    _log(f"bench: scalar single-thread baseline ≈ {baseline:,.0f} proofs/s")
+    # --- secondary: device kernel slope (the round-1 mask-only number) ------
+    kernel_rate = _kernel_slope_rate(args, _log)
+
+    # --- scalar reference-architecture baseline -----------------------------
+    t0 = time.perf_counter()
+    baseline = _scalar_baseline(
+        min(args.baseline_pairs, args.tipsets), args.receipts, args.events
+    )
+    _log(
+        f"bench: scalar reference-architecture baseline ≈ {baseline:,.1f} "
+        f"proofs/s e2e (measured in {time.perf_counter() - t0:.1f}s)"
+    )
 
     print(
         json.dumps(
             {
-                "metric": "event_proofs_per_sec_4k_tipset_batch",
+                "metric": "event_proofs_per_sec_4k_range_e2e",
                 "value": round(proofs_per_sec, 1),
                 "unit": "proofs/s",
                 "vs_baseline": round(proofs_per_sec / baseline, 2) if baseline > 0 else None,
+                "events_per_sec_e2e": round(events_per_sec, 1),
+                "proofs": n_proofs,
+                "stages_ms": {k: round(v * 1000, 1) for k, v in stages.items()},
+                "device_mask_kernel_events_per_sec": kernel_rate,
             }
         )
     )
+
+
+def _kernel_slope_rate(args, log) -> float:
+    """The round-1 headline, kept as a secondary line: the jitted mask
+    kernel's slope-timed throughput (tunnel RTT cancelled)."""
+    import jax.numpy as jnp
+
+    from ipc_proofs_tpu.parallel.mesh import make_mesh
+    from ipc_proofs_tpu.parallel.pipeline import sharded_match_pipeline, synthetic_event_batch
+    from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+    from ipc_proofs_tpu.utils.timing import measure_pass_seconds
+    import jax
+
+    topic0 = hash_event_signature(SIG)
+    topic1 = ascii_to_bytes32(TOPIC1)
+    batch = synthetic_event_batch(
+        args.tipsets, args.receipts, args.events,
+        topic0, topic1, emitter=ACTOR, match_rate=args.match_rate, seed=42,
+    )
+    n_dev = len(jax.devices())
+    sp = 2 if (n_dev % 2 == 0 and n_dev > 1) else 1
+    mesh = make_mesh(n_dev, sp=sp)
+    jitted, shard_batch = sharded_match_pipeline(mesh)
+    sharded_args = shard_batch(batch, topic0, topic1, ACTOR)
+    _hits, _mask, count = jitted(*sharded_args)  # compile + warm
+
+    def one_pass(i, topics, n_topics, emitters, valid, s0, s1, actor):
+        _, _, c = jitted(topics ^ i.astype(topics.dtype), n_topics, emitters, valid, s0, s1, actor)
+        return c.astype(jnp.int32)
+
+    if args.quick:
+        k_small, k_large = 3, max(args.kernel_iters, 13)
+    else:
+        k_small, k_large = 5, max(args.kernel_iters, 105)
+    pt = measure_pass_seconds(one_pass, sharded_args, k_small=k_small, k_large=k_large)
+    total_events = args.tipsets * args.receipts * args.events
+    rate = total_events / pt.seconds
+    log(
+        f"bench: device mask kernel (slope k={pt.k_small}/{pt.k_large}): "
+        f"{pt.seconds * 1e6:.1f} us/pass, {rate:,.0f} events/s "
+        f"({int(count)} matches/pass)"
+    )
+    return round(rate, 1)
 
 
 if __name__ == "__main__":

@@ -193,6 +193,8 @@ static void vec_free(Vec *v) {
 
 typedef struct {
   Vec topics;   /* u32[2][8] per event (64 B) */
+  Vec fp;       /* u64 per event: FNV-1a over the 64 topic bytes (the
+                 * transfer-light device-match input; see scan_native.py) */
   Vec n_topics; /* i32 */
   Vec emitters; /* u64 */
   Vec valid;    /* u8 */
@@ -375,8 +377,16 @@ done:;
     if (vec_push(&s->data_off, &doff, 4) < 0) return -1;
     if (vec_push(&s->data_len, &dlen, 4) < 0) return -1;
   }
+  /* FNV-1a of the zero-padded 2x32B topic words — must match
+   * scan_native.topic_fingerprint exactly */
+  uint64_t fp = 1469598103934665603ULL;
+  for (int k = 0; k < 64; k++) {
+    fp ^= topic_words[k];
+    fp *= 1099511628211ULL;
+  }
   int32_t ids[3] = {pair_id, rcpt_idx, ev_idx};
   if (vec_push(&s->topics, topic_words, 64) < 0) return -1;
+  if (vec_push(&s->fp, &fp, 8) < 0) return -1;
   if (vec_push(&s->n_topics, &n_topics, 4) < 0) return -1;
   if (vec_push(&s->emitters, &emitter, 8) < 0) return -1;
   if (vec_push(&s->valid, &valid, 1) < 0) return -1;
@@ -594,7 +604,8 @@ static PyObject *make_array_bytes(Vec *v) {
 }
 
 static void scan_free(Scan *s) {
-  vec_free(&s->topics); vec_free(&s->n_topics); vec_free(&s->emitters);
+  vec_free(&s->topics); vec_free(&s->fp); vec_free(&s->n_topics);
+  vec_free(&s->emitters);
   vec_free(&s->valid); vec_free(&s->pair_ids); vec_free(&s->exec_idx);
   vec_free(&s->event_idx); vec_free(&s->topics_pool); vec_free(&s->data_pool);
   vec_free(&s->topics_off); vec_free(&s->data_off); vec_free(&s->data_len);
@@ -635,8 +646,9 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
 
   {
     PyObject *result = Py_BuildValue(
-        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L}",
+        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L}",
         "topics", make_array_bytes(&s.topics),
+        "fp", make_array_bytes(&s.fp),
         "n_topics", make_array_bytes(&s.n_topics),
         "emitters", make_array_bytes(&s.emitters),
         "valid", make_array_bytes(&s.valid),

@@ -75,9 +75,22 @@ class TpuBackend:
             self._blake2b(jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))
         )
 
+    # Below this many payload bytes the device batch loses to fixed dispatch
+    # cost (one round trip to the chip + the host→device copy); the native
+    # C++ batch hash wins there. The crossover is transfer-bandwidth bound,
+    # so it is deliberately conservative; override with IPC_TPU_CID_MIN_BYTES.
+    _CID_BATCH_MIN_BYTES = 4 << 20
+
     def verify_block_cids(
         self, cids_digests: Sequence[bytes], blocks: Sequence[bytes]
     ) -> bool:
+        import os
+
+        min_bytes = int(os.environ.get("IPC_TPU_CID_MIN_BYTES", self._CID_BATCH_MIN_BYTES))
+        if sum(len(b) for b in blocks) < min_bytes:
+            from ipc_proofs_tpu.backend.cpu import CpuBackend
+
+            return CpuBackend().verify_block_cids(cids_digests, blocks)
         digests = self.blake2b256_batch(blocks)
         return all(d == e for d, e in zip(digests, cids_digests))
 
@@ -118,6 +131,29 @@ class TpuBackend:
             np.frombuffer(topic0, dtype="<u4"),
             np.frombuffer(topic1, dtype="<u4"),
             actor_id_filter,
+        )
+        return np.asarray(mask)
+
+    def event_match_mask_fp(
+        self,
+        fp: np.ndarray,
+        n_topics: np.ndarray,
+        emitters: np.ndarray,
+        valid: np.ndarray,
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ) -> np.ndarray:
+        """Fingerprint match over pre-flattened arrays: one u64 per event
+        crosses to the device instead of 64 topic bytes (see
+        `ops.match_jax.event_match_mask_fp_jit`). Semantics identical to
+        `event_match_mask_flat` — pass 2 confirms every hit exactly."""
+        from ipc_proofs_tpu.ops.match_jax import event_match_mask_fp_jit
+        from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+
+        mask = event_match_mask_fp_jit(
+            fp, n_topics, emitters, valid,
+            topic_fingerprint(topic0, topic1), actor_id_filter,
         )
         return np.asarray(mask)
 

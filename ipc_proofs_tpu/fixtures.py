@@ -40,7 +40,13 @@ from ipc_proofs_tpu.state.events import (
 from ipc_proofs_tpu.state.header import BlockHeader
 from ipc_proofs_tpu.store.blockstore import Blockstore, MemoryBlockstore, put_cbor
 
-__all__ = ["ContractFixture", "EventFixture", "ChainFixture", "build_chain"]
+__all__ = [
+    "ContractFixture",
+    "EventFixture",
+    "ChainFixture",
+    "build_chain",
+    "build_range_world",
+]
 
 
 @dataclass
@@ -268,3 +274,108 @@ def build_chain(
         message_cids=exec_order,
         contracts={c.actor_id: c for c in contracts},
     )
+
+
+def build_range_world(
+    n_pairs: int,
+    receipts_per_pair: int = 16,
+    events_per_receipt: int = 4,
+    match_rate: float = 0.01,
+    signature: str = "NewTopDownMessage(bytes32,uint256)",
+    topic1: str = "calib-subnet-1",
+    actor_id: int = 1001,
+    base_height: int = 1000,
+    store: Optional[MemoryBlockstore] = None,
+):
+    """A benchmark-scale range of parent→child pairs sharing one state tree.
+
+    ``build_chain`` rebuilds the full state tree per call (fine for tests,
+    ~ms each); a 4096-pair north-star range needs the cheap path: the state
+    tree is written once, and each pair gets only its own messages, receipts,
+    events AMTs, and headers. Event payloads embed (pair, receipt, event)
+    indices so blocks are unique across the range — no artificial CID dedup
+    shrinking the scan or witness workload.
+
+    A fraction ``match_rate`` of receipts (evenly spread) contain exactly one
+    event matching ``(signature, topic1, actor_id)``; all other events are
+    noise with a different signature. Returns ``(store, pairs,
+    n_matching_receipts)`` where ``pairs`` is a list of objects with
+    ``parent`` / ``child`` attributes (duck-compatible with
+    `proofs.range.TipsetPair`).
+    """
+    from ipc_proofs_tpu.proofs.range import TipsetPair
+
+    bs = store if store is not None else MemoryBlockstore()
+
+    # --- shared state tree (one contract actor, written once) ---------------
+    storage_root = hamt_build(bs, {})
+    bytecode_cid = CID.hash_of(b"range-bytecode", codec=RAW)
+    evm_state_cid = put_cbor(bs, [bytecode_cid, b"\xbc" * 32, storage_root, None, 1, None])
+    actor = ActorState(
+        code=CID.hash_of(b"fil/evm", codec=RAW), state=evm_state_cid,
+        call_seq_num=1, balance=0,
+    )
+    actors_root = hamt_build(bs, {Address.new_id(actor_id).to_bytes(): actor.to_tuple()})
+    info_cid = put_cbor(bs, "state-info")
+    state_root_cid = put_cbor(bs, StateRoot(version=5, actors=actors_root, info=info_cid).to_tuple())
+    grandparent_cids = [CID.hash_of(b"range-grandparent", codec=RAW)]
+    old_receipts = amt_build_v0(bs, [])
+    empty_amt = amt_build_v0(bs, [])
+    child_txmeta = put_cbor(bs, [empty_amt, empty_amt])
+
+    # pre-encoded topics shared by every event
+    topic0 = hash_event_signature(signature)
+    t1 = ascii_to_bytes32(topic1)
+    noise_topic0 = hash_event_signature("Noise(uint256)")
+
+    every = max(int(round(1.0 / match_rate)), 1) if match_rate > 0 else 0
+    n_matching = 0
+    pairs = []
+    for p in range(n_pairs):
+        receipts = []
+        msg_cids = []
+        for r in range(receipts_per_pair):
+            gid = p * receipts_per_pair + r
+            msg_cids.append(CID.hash_of(b"msg-%d" % gid, codec=RAW))
+            stamped = []
+            for e in range(events_per_receipt):
+                uniq = (gid * events_per_receipt + e).to_bytes(32, "big")
+                if every and gid % every == 0 and e == 0:
+                    entries = [[0, "t1", IPLD_RAW, topic0], [0, "t2", IPLD_RAW, t1],
+                               [0, "d", IPLD_RAW, uniq]]
+                else:
+                    entries = [[0, "t1", IPLD_RAW, noise_topic0], [0, "t2", IPLD_RAW, uniq],
+                               [0, "d", IPLD_RAW, uniq]]
+                stamped.append([actor_id, entries])
+            if every and gid % every == 0:
+                n_matching += 1
+            events_root = amt_build(bs, stamped, bit_width=5, version=3)
+            receipts.append([0, b"", 1_000_000 + gid, events_root])
+        receipts_root = amt_build_v0(bs, receipts)
+        bls_root = amt_build_v0(bs, {i: c for i, c in enumerate(msg_cids)})
+        txmeta = put_cbor(bs, [bls_root, empty_amt])
+
+        height = base_height + 2 * p
+        parent_header = BlockHeader(
+            parents=grandparent_cids, height=height,
+            parent_state_root=state_root_cid, parent_message_receipts=old_receipts,
+            messages=txmeta, timestamp=1_700_000_000 + height * 30, miner="f01000",
+        )
+        parent_raw = parent_header.encode()
+        parent_cid = CID.hash_of(parent_raw)
+        bs.put_keyed(parent_cid, parent_raw)
+        child_header = BlockHeader(
+            parents=[parent_cid], height=height + 1,
+            parent_state_root=state_root_cid, parent_message_receipts=receipts_root,
+            messages=child_txmeta, timestamp=1_700_000_000 + (height + 1) * 30, miner="f02000",
+        )
+        child_raw = child_header.encode()
+        child_cid = CID.hash_of(child_raw)
+        bs.put_keyed(child_cid, child_raw)
+        pairs.append(
+            TipsetPair(
+                parent=Tipset(cids=[parent_cid], blocks=[parent_header], height=height),
+                child=Tipset(cids=[child_cid], blocks=[child_header], height=height + 1),
+            )
+        )
+    return bs, pairs, n_matching

@@ -73,6 +73,38 @@ def event_match_mask_jit(topics, n_topics, emitters, valid, topic0, topic1, acto
     return _match_mask_topics(topics, n_topics, valid, topic0, topic1)
 
 
+@jax.jit
+def _match_mask_fp(fp2, valid, target2):
+    # u64 fingerprints as [N, 2] u32 words (jax x64 stays off)
+    return valid & (fp2[:, 0] == target2[0]) & (fp2[:, 1] == target2[1])
+
+
+def event_match_mask_fp_jit(fp, n_topics, emitters, valid, target_fp: int, actor_id_filter=None):
+    """Transfer-light device match: ships ONE u64 fingerprint + one valid bit
+    per event instead of the 64-byte topic words (~8× less host→device
+    traffic — the tunnel/PCIe-bound leg of the range pipeline).
+
+    The n_topics≥2 and emitter predicates fold into the host-side valid mask
+    (u64 actor IDs stay exact); the device compares fingerprints. Pass 2
+    re-applies the full matcher per event, so claims are identical to the
+    full-width kernel's even in the 2^-64 collision case.
+    """
+    import numpy as np
+
+    valid = valid & (np.asarray(n_topics) >= 2)
+    if actor_id_filter is not None:
+        valid = valid & (np.asarray(emitters) == actor_id_filter)
+    n = fp.shape[0]
+    bucket = pad_to_bucket(n)
+    fp2 = np.ascontiguousarray(fp).view("<u4").reshape(n, 2)
+    if bucket != n:
+        pad = bucket - n
+        fp2 = np.concatenate([fp2, np.zeros((pad, 2), fp2.dtype)])
+        valid = np.concatenate([valid, np.zeros(pad, valid.dtype)])
+    target2 = np.frombuffer(int(target_fp).to_bytes(8, "little"), dtype="<u4")
+    return _match_mask_fp(fp2, valid, target2)
+
+
 def receipts_with_match(mask, receipt_ids, num_receipts: int):
     """Per-receipt any-reduce: bool [N] event mask + int32 [N] receipt ids →
     bool [num_receipts] (which receipts contain ≥1 matching event).

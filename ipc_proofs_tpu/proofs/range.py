@@ -158,15 +158,28 @@ def generate_event_proofs_for_range(
             metrics.count("range_events", scan_batch.n_events)
             matching_per_pair: list[list[int]] = [[] for _ in pairs]
             if scan_batch.n_events:
-                mask = match_backend.event_match_mask_flat(
-                    scan_batch.topics,
-                    scan_batch.n_topics,
-                    scan_batch.emitters,
-                    scan_batch.valid,
-                    matcher.topic0,
-                    matcher.topic1,
-                    spec.actor_id_filter,
-                )[: scan_batch.n_events]
+                # fingerprint path when the backend offers it: 8× less
+                # host→device transfer; pass 2 confirms hits exactly either way
+                if hasattr(match_backend, "event_match_mask_fp"):
+                    mask = match_backend.event_match_mask_fp(
+                        scan_batch.fp,
+                        scan_batch.n_topics,
+                        scan_batch.emitters,
+                        scan_batch.valid,
+                        matcher.topic0,
+                        matcher.topic1,
+                        spec.actor_id_filter,
+                    )[: scan_batch.n_events]
+                else:
+                    mask = match_backend.event_match_mask_flat(
+                        scan_batch.topics,
+                        scan_batch.n_topics,
+                        scan_batch.emitters,
+                        scan_batch.valid,
+                        matcher.topic0,
+                        matcher.topic1,
+                        spec.actor_id_filter,
+                    )[: scan_batch.n_events]
                 sel = np.nonzero(mask)[0]
                 hits = sorted(
                     set(

@@ -26,7 +26,29 @@ from ipc_proofs_tpu.store.blockstore import (
     MemoryBlockstore,
 )
 
-__all__ = ["ScanBatch", "scan_events_flat", "native_scan_available"]
+__all__ = [
+    "ScanBatch",
+    "scan_events_flat",
+    "native_scan_available",
+    "topic_fingerprint",
+]
+
+_FNV_OFFSET = 1469598103934665603
+_FNV_PRIME = 1099511628211
+_U64 = (1 << 64) - 1
+
+
+def topic_fingerprint(topic0: bytes, topic1: bytes) -> int:
+    """FNV-1a over the zero-padded 2×32-byte topic words — the target value
+    for the transfer-light device match (must equal the C scanner's per-event
+    ``fp``). A fingerprint equality is confirmed exactly in pass 2, which
+    re-applies the full matcher per event, so a (2^-64-rare) collision can
+    only add an unused witness path, never a wrong claim."""
+    buf = (topic0 + b"\x00" * 32)[:32] + (topic1 + b"\x00" * 32)[:32]
+    fp = _FNV_OFFSET
+    for b in buf:
+        fp = ((fp ^ b) * _FNV_PRIME) & _U64
+    return fp
 
 
 @dataclass
@@ -34,6 +56,7 @@ class ScanBatch:
     """Flat arrays over every event of every receipt of every scanned root."""
 
     topics: np.ndarray  # uint32 [N, 2, 8] — first two topics as LE u32 words
+    fp: np.ndarray  # uint64 [N] — FNV-1a fingerprint of the topic words
     n_topics: np.ndarray  # int32 [N] — total topic count (may exceed 2)
     emitters: np.ndarray  # uint64 [N]
     valid: np.ndarray  # bool [N] — EVM-log shaped (extract_evm_log parity)
@@ -124,6 +147,7 @@ def scan_events_flat(
     n = out["n_events"]
     return ScanBatch(
         topics=np.frombuffer(out["topics"], dtype="<u4").reshape(n, 2, 8),
+        fp=np.frombuffer(out["fp"], dtype="<u8"),
         n_topics=np.frombuffer(out["n_topics"], dtype="<i4"),
         emitters=np.frombuffer(out["emitters"], dtype="<u8"),
         valid=np.frombuffer(out["valid"], dtype=np.uint8).astype(bool),

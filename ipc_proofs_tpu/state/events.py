@@ -13,6 +13,7 @@ Reference parity:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Optional
 
 from ipc_proofs_tpu.core.cid import CID
@@ -147,8 +148,11 @@ def extract_evm_log(event: ActorEvent) -> Optional[EvmLog]:
     return EvmLog(topics=topics, data=entries.get("d", b""))
 
 
+@lru_cache(maxsize=4096)
 def hash_event_signature(signature: str) -> bytes:
-    """keccak256 of the Solidity event signature → topic0."""
+    """keccak256 of the Solidity event signature → topic0 (memoized —
+    fixture builders and matchers hash the same few signatures millions of
+    times; the scalar keccak is ~40 µs)."""
     return keccak256(signature.encode("utf-8"))
 
 

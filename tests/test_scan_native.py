@@ -187,3 +187,54 @@ class TestForgedInputs:
         events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="x")]]
         world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
         assert_scan_matches(bs, [world.child.blocks[0].parent_message_receipts])
+
+
+class TestFingerprint:
+    def test_c_fingerprint_matches_python_target(self):
+        from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+        bs = MemoryBlockstore()
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="fp-sub")]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        batch = scan_events_flat(bs, [world.child.blocks[0].parent_message_receipts])
+        assert batch.n_events == 1
+        expected = topic_fingerprint(hash_event_signature(SIG), ascii_to_bytes32("fp-sub"))
+        assert int(batch.fp[0]) == expected
+
+    def test_fp_mask_equals_full_width_mask(self):
+        import numpy as np
+
+        from ipc_proofs_tpu.ops.match_jax import (
+            event_match_mask_fp_jit,
+            event_match_mask_jit,
+        )
+        from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+        bs = MemoryBlockstore()
+        events = [
+            [
+                EventFixture(emitter=ACTOR, signature=SIG, topic1="match-me"),
+                EventFixture(emitter=ACTOR, signature=SIG, topic1="not-me"),
+                EventFixture(emitter=99, signature=SIG, topic1="match-me"),
+                EventFixture(emitter=ACTOR, signature="Noise()", topic1="match-me"),
+            ]
+        ]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        batch = scan_events_flat(bs, [world.child.blocks[0].parent_message_receipts])
+        t0, t1 = hash_event_signature(SIG), ascii_to_bytes32("match-me")
+        full = np.asarray(
+            event_match_mask_jit(
+                batch.topics, batch.n_topics, batch.emitters, batch.valid,
+                np.frombuffer(t0, "<u4"), np.frombuffer(t1, "<u4"), ACTOR,
+            )
+        )[: batch.n_events]
+        fp = np.asarray(
+            event_match_mask_fp_jit(
+                batch.fp, batch.n_topics, batch.emitters, batch.valid,
+                topic_fingerprint(t0, t1), ACTOR,
+            )
+        )[: batch.n_events]
+        assert (full == fp).all()
+        assert fp.tolist() == [True, False, False, False]
