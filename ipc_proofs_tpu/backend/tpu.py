@@ -44,7 +44,11 @@ def flatten_events(events: Sequence[StampedEvent]):
 class TpuBackend:
     name = "tpu"
 
-    def __init__(self):
+    def __init__(self, mesh=None):
+        """``mesh``: optional `jax.sharding.Mesh` — when given, the flat
+        match kernels run sharded over every mesh device (events split
+        across the flattened axes, spec words replicated), so the same
+        range driver scales from one chip to a pod slice unchanged."""
         import jax  # noqa: F401 — fail fast if jax is unavailable
 
         from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
@@ -52,6 +56,7 @@ class TpuBackend:
 
         self._keccak = keccak256_blocks
         self._blake2b = blake2b256_blocks
+        self.mesh = mesh
 
     def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
         import jax.numpy as jnp
@@ -153,7 +158,7 @@ class TpuBackend:
 
         mask = event_match_mask_fp_jit(
             fp, n_topics, emitters, valid,
-            topic_fingerprint(topic0, topic1), actor_id_filter,
+            topic_fingerprint(topic0, topic1), actor_id_filter, mesh=self.mesh,
         )
         return np.asarray(mask)
 

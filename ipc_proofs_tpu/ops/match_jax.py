@@ -73,13 +73,37 @@ def event_match_mask_jit(topics, n_topics, emitters, valid, topic0, topic1, acto
     return _match_mask_topics(topics, n_topics, valid, topic0, topic1)
 
 
-@jax.jit
-def _match_mask_fp(fp2, valid, target2):
+def _match_mask_fp_impl(fp2, valid, target2):
     # u64 fingerprints as [N, 2] u32 words (jax x64 stays off)
     return valid & (fp2[:, 0] == target2[0]) & (fp2[:, 1] == target2[1])
 
 
-def event_match_mask_fp_jit(fp, n_topics, emitters, valid, target_fp: int, actor_id_filter=None):
+_match_mask_fp = jax.jit(_match_mask_fp_impl)
+_sharded_fp_cache: dict = {}
+
+
+def sharded_fp_mask_fn(mesh):
+    """The fp mask jitted over a device mesh: event rows split across ALL
+    mesh axes (dp × sp — the match is embarrassingly parallel over events),
+    spec words replicated. Cached per mesh."""
+    fn = _sharded_fp_cache.get(mesh)
+    if fn is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axes = tuple(mesh.axis_names)
+        rows = NamedSharding(mesh, P(axes))
+        mat = NamedSharding(mesh, P(axes, None))
+        rep = NamedSharding(mesh, P())
+        fn = jax.jit(
+            _match_mask_fp_impl, in_shardings=(mat, rows, rep), out_shardings=rows
+        )
+        _sharded_fp_cache[mesh] = fn
+    return fn
+
+
+def event_match_mask_fp_jit(
+    fp, n_topics, emitters, valid, target_fp: int, actor_id_filter=None, mesh=None
+):
     """Transfer-light device match: ships ONE u64 fingerprint + one valid bit
     per event instead of the 64-byte topic words (~8× less host→device
     traffic — the tunnel/PCIe-bound leg of the range pipeline).
@@ -96,12 +120,17 @@ def event_match_mask_fp_jit(fp, n_topics, emitters, valid, target_fp: int, actor
         valid = valid & (np.asarray(emitters) == actor_id_filter)
     n = fp.shape[0]
     bucket = pad_to_bucket(n)
+    if mesh is not None:  # rows must split evenly across every device
+        n_dev = mesh.size
+        bucket += (-bucket) % n_dev
     fp2 = np.ascontiguousarray(fp).view("<u4").reshape(n, 2)
     if bucket != n:
         pad = bucket - n
         fp2 = np.concatenate([fp2, np.zeros((pad, 2), fp2.dtype)])
         valid = np.concatenate([valid, np.zeros(pad, valid.dtype)])
     target2 = np.frombuffer(int(target_fp).to_bytes(8, "little"), dtype="<u4")
+    if mesh is not None:
+        return sharded_fp_mask_fn(mesh)(fp2, valid, target2)
     return _match_mask_fp(fp2, valid, target2)
 
 
