@@ -203,6 +203,9 @@ def main() -> None:
     # --- secondary: device kernel slope (the round-1 mask-only number) ------
     kernel_rate = _kernel_slope_rate(args, _log)
 
+    # --- secondary: witness-CID recompute rate (BASELINE config 4 on-chip) --
+    cid_rate = _cid_kernel_rate(quick=args.quick, log=_log)
+
     # --- scalar reference-architecture baseline -----------------------------
     t0 = time.perf_counter()
     baseline = _scalar_baseline(
@@ -224,6 +227,7 @@ def main() -> None:
                 "proofs": n_proofs,
                 "stages_ms": {k: round(v * 1000, 1) for k, v in stages.items()},
                 "device_mask_kernel_events_per_sec": kernel_rate,
+                "witness_cid_kernel_per_sec": cid_rate,
             }
         )
     )
@@ -268,6 +272,62 @@ def _kernel_slope_rate(args, log) -> float:
         f"bench: device mask kernel (slope k={pt.k_small}/{pt.k_large}): "
         f"{pt.seconds * 1e6:.1f} us/pass, {rate:,.0f} events/s "
         f"({int(count)} matches/pass)"
+    )
+    return round(rate, 1)
+
+
+def _cid_kernel_rate(quick: bool, log) -> float:
+    """Witness-verify CIDs/sec (BASELINE config 4's kernel, slope-timed):
+    blake2b-256 over typical ~100-byte IPLD nodes via the single-block
+    Pallas kernel when the chip accepts it, else the XLA scan kernel."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ipc_proofs_tpu.backend import get_backend
+    from ipc_proofs_tpu.core.hashes import blake2b_256
+    from ipc_proofs_tpu.utils.timing import measure_pass_seconds
+
+    n = 20_000 if quick else 200_000
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, size=(n, 100), dtype=np.uint8)
+    messages = [payload[i].tobytes() for i in range(n)]
+    backend = get_backend("tpu")
+
+    if backend._pallas_usable():
+        from ipc_proofs_tpu.ops.pallas_kernels import (
+            blake2b256_single_block_pallas,
+            pack_single_block_blake2b,
+        )
+
+        m_lo, m_hi, lengths, _ = pack_single_block_blake2b(messages)
+        args = (jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths))
+        first = np.asarray(blake2b256_single_block_pallas(*args))
+
+        def one_pass(i, a, b, l):
+            d = blake2b256_single_block_pallas(a ^ i.astype(jnp.uint32), b, l)
+            return d.sum(dtype=jnp.uint32).astype(jnp.int32)
+
+        kernel = "pallas"
+    else:
+        from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
+        from ipc_proofs_tpu.ops.pack import pad_blake2b
+
+        blocks, counts, lengths = pad_blake2b(messages)
+        args = (jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))
+        first = np.asarray(blake2b256_blocks(*args))
+
+        def one_pass(i, a, b, l):
+            d = blake2b256_blocks(a ^ i.astype(jnp.uint32), b, l)
+            return d.sum(dtype=jnp.uint32).astype(jnp.int32)
+
+        kernel = "xla"
+
+    assert first[0].tobytes() == blake2b_256(messages[0])
+    pt = measure_pass_seconds(one_pass, args, k_small=3, k_large=13 if quick else 23)
+    rate = n / pt.seconds
+    log(
+        f"bench: witness-CID recompute ({kernel} kernel, slope "
+        f"k={pt.k_small}/{pt.k_large}): {rate:,.0f} CIDs/s"
     )
     return round(rate, 1)
 

@@ -57,6 +57,34 @@ class TpuBackend:
         self._keccak = keccak256_blocks
         self._blake2b = blake2b256_blocks
         self.mesh = mesh
+        # None = untried; True/False after the first on-chip attempt
+        self._pallas_ok: Optional[bool] = None
+
+    def _pallas_usable(self) -> bool:
+        """Single-block Pallas fast path: TPU platform only (interpret mode
+        on CPU is orders of magnitude slower than the XLA kernels), with a
+        one-time runtime probe so a Mosaic rejection falls back to XLA for
+        the rest of the process."""
+        if self._pallas_ok is None:
+            import jax
+
+            if jax.devices()[0].platform != "tpu":
+                self._pallas_ok = False
+            else:
+                try:
+                    import jax.numpy as jnp
+
+                    from ipc_proofs_tpu.ops.pallas_kernels import (
+                        TILE,
+                        keccak256_single_block_pallas,
+                    )
+
+                    probe = jnp.zeros((TILE, 17), jnp.uint32)
+                    np.asarray(keccak256_single_block_pallas(probe, probe))
+                    self._pallas_ok = True
+                except Exception:  # Mosaic rejection / unsupported runtime
+                    self._pallas_ok = False
+        return self._pallas_ok
 
     def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
         import jax.numpy as jnp
@@ -65,6 +93,17 @@ class TpuBackend:
 
         if not messages:
             return []
+        # single-block fast path: 3.3× the XLA kernel on v5e (measured;
+        # see ops/pallas_kernels.py docstring)
+        if max(len(m) for m in messages) < 136 and self._pallas_usable():
+            from ipc_proofs_tpu.ops.pallas_kernels import (
+                keccak256_single_block_pallas,
+                pack_single_block_keccak,
+            )
+
+            lo, hi, n = pack_single_block_keccak(list(messages))
+            digests = keccak256_single_block_pallas(jnp.asarray(lo), jnp.asarray(hi))
+            return digests_to_bytes(digests[:n])
         blocks, counts = pad_keccak(list(messages))
         return digests_to_bytes(self._keccak(jnp.asarray(blocks), jnp.asarray(counts)))
 
@@ -75,6 +114,18 @@ class TpuBackend:
 
         if not messages:
             return []
+        # single-block fast path: 4.1× the XLA kernel on v5e (measured)
+        if max(len(m) for m in messages) <= 128 and self._pallas_usable():
+            from ipc_proofs_tpu.ops.pallas_kernels import (
+                blake2b256_single_block_pallas,
+                pack_single_block_blake2b,
+            )
+
+            m_lo, m_hi, lengths, n = pack_single_block_blake2b(list(messages))
+            digests = blake2b256_single_block_pallas(
+                jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths)
+            )
+            return digests_to_bytes(digests[:n])
         blocks, counts, lengths = pad_blake2b(list(messages))
         return digests_to_bytes(
             self._blake2b(jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))

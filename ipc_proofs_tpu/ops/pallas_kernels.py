@@ -6,11 +6,28 @@ The overwhelmingly common shapes in this workload are single-block:
 - most IPLD witness nodes are ≤ 128 bytes ⇒ one blake2b block (larger
   blocks use the XLA `lax.scan` kernels in `keccak_jax`/`blake2b_jax`).
 
-Each kernel tiles the batch over a 1-D grid ([TILE, lanes] blocks resident
-in VMEM) and reuses the exact round logic of the XLA kernels — so the
-Pallas and XLA paths cannot drift. On non-TPU hosts the kernels run in
-interpreter mode (CI equivalence tests); callers should fall back to the
-XLA kernels if Mosaic rejects a shape at runtime.
+Kernel structure (what Mosaic can actually lower, and fast): the state is
+LANE-MAJOR — each u64 lane is a [1, TILE] u32-pair row vector, so every
+elementwise op fills whole (8, 128) vregs (the batch-major [TILE, 1] layout
+ran 15× slower: 1/128 vreg utilization). ALL schedule indices — the keccak
+rho/pi permutation, per-lane rotation amounts, the blake2b sigma schedule —
+are Python compile-time constants; keccak's 24 rounds run under an in-kernel
+`fori_loop` whose only dynamic access is a scalar round-constant load from
+SMEM (a fully unrolled 24-round graph took Mosaic >9 min to compile; the
+loop form compiles in ~2 s). The earlier table-driven form (shared with the
+XLA kernels) needed gather/scatter, which the TPU Pallas lowering rejects
+(`Unimplemented ... scatter`).
+
+Measured on TPU v5e (65k-message batch, slope-timed): keccak 44.8M hashes/s
+vs 13.5M XLA (3.3×); blake2b 252M hashes/s vs 61.5M XLA (4.1×).
+
+Digest-word layout matches the XLA kernels: [lo0, hi0, lo1, hi1, ...] — the
+little-endian u32 view of the 32-byte digest. Golden models:
+`core.hashes.keccak256` / `hashlib.blake2b(digest_size=32)`, tested equal.
+
+On non-TPU hosts the kernels run in interpreter mode (CI equivalence
+tests); callers fall back to the XLA kernels if Mosaic rejects at runtime
+(`backend.tpu.TpuBackend._pallas_single_block`).
 """
 
 from __future__ import annotations
@@ -21,15 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ipc_proofs_tpu.ops.blake2b_jax import _IV_HI, _IV_LO, _PARAM_WORD0, _SIGMA, _compress
-from ipc_proofs_tpu.ops.keccak_jax import (
-    _IDX_X,
-    _PERM_ROT,
-    _PERM_SRC,
-    _RC_HI,
-    _RC_LO,
-    keccak_f1600_batch,
-)
+from ipc_proofs_tpu.ops.blake2b_jax import _IV, _PARAM_WORD0, _SIGMA
+from ipc_proofs_tpu.ops.keccak_jax import _PERM_ROT, _PERM_SRC, _ROUND_CONSTANTS
 
 __all__ = [
     "keccak256_single_block_pallas",
@@ -39,42 +49,168 @@ __all__ = [
 ]
 
 TILE = 256
+_U32 = 0xFFFFFFFF
 
 
-def _digest_columns(lo, hi):
-    return jnp.stack(
-        [lo[:, 0], hi[:, 0], lo[:, 1], hi[:, 1], lo[:, 2], hi[:, 2], lo[:, 3], hi[:, 3]],
-        axis=1,
+def _rotl64_static(lo, hi, r: int):
+    """Rotate a u64 (as a [1, TILE] u32-pair row) left by the constant r."""
+    r %= 64
+    if r >= 32:
+        lo, hi = hi, lo
+        r -= 32
+    if r == 0:
+        return lo, hi
+    return (lo << r) | (hi >> (32 - r)), (hi << r) | (lo >> (32 - r))
+
+
+_RC_LO_COL = np.array([[rc & _U32] for rc in _ROUND_CONSTANTS], dtype=np.uint32)
+_RC_HI_COL = np.array([[rc >> 32] for rc in _ROUND_CONSTANTS], dtype=np.uint32)
+
+
+def _keccak_round(lo, hi, rc_lo, rc_hi):
+    """One keccak-f round over 25 [1, TILE] u32-pair lanes — static
+    permutation/rotations (Python constants), rc_* traced scalars."""
+    c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+    c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
+    d_lo, d_hi = [], []
+    for x in range(5):
+        r1_lo, r1_hi = _rotl64_static(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+        d_lo.append(c_lo[(x - 1) % 5] ^ r1_lo)
+        d_hi.append(c_hi[(x - 1) % 5] ^ r1_hi)
+    lo = [lo[i] ^ d_lo[i % 5] for i in range(25)]
+    hi = [hi[i] ^ d_hi[i % 5] for i in range(25)]
+    b_lo, b_hi = [None] * 25, [None] * 25
+    for dest in range(25):
+        src = int(_PERM_SRC[dest])
+        b_lo[dest], b_hi[dest] = _rotl64_static(lo[src], hi[src], int(_PERM_ROT[dest]))
+    for y in range(0, 25, 5):
+        row_lo = b_lo[y : y + 5]
+        row_hi = b_hi[y : y + 5]
+        for x in range(5):
+            lo[y + x] = row_lo[x] ^ (~row_lo[(x + 1) % 5] & row_lo[(x + 2) % 5])
+            hi[y + x] = row_hi[x] ^ (~row_hi[(x + 1) % 5] & row_hi[(x + 2) % 5])
+    lo[0] = lo[0] ^ rc_lo
+    hi[0] = hi[0] ^ rc_hi
+    return lo, hi
+
+
+def _keccak_kernel(blo_ref, bhi_ref, rclo_ref, rchi_ref, out_ref):
+    # lane-major layout: refs are [17|8, TILE_N] — each lane is a [1, TILE_N]
+    # row vector, so every elementwise op fills whole (8,128) vregs
+    tile_n = blo_ref.shape[1]
+    zero = jnp.zeros((1, tile_n), dtype=jnp.uint32)
+    lo = [blo_ref[i : i + 1, :] for i in range(17)] + [zero] * 8
+    hi = [bhi_ref[i : i + 1, :] for i in range(17)] + [zero] * 8
+
+    def round_body(r, state):
+        lo25, hi25 = state
+        lo_l = [lo25[i : i + 1, :] for i in range(25)]
+        hi_l = [hi25[i : i + 1, :] for i in range(25)]
+        # round constant: dynamic scalar load from the SMEM table (Mosaic
+        # lowers ref indexing by a loop counter; value-level dynamic_slice
+        # and gathers it does not)
+        rc_lo = rclo_ref[r]
+        rc_hi = rchi_ref[r]
+        lo_l, hi_l = _keccak_round(lo_l, hi_l, rc_lo, rc_hi)
+        return jnp.concatenate(lo_l, axis=0), jnp.concatenate(hi_l, axis=0)
+
+    lo25, hi25 = jax.lax.fori_loop(
+        0, 24, round_body, (jnp.concatenate(lo, axis=0), jnp.concatenate(hi, axis=0))
+    )
+    out_ref[:] = jnp.concatenate(
+        [lo25[0:1], hi25[0:1], lo25[1:2], hi25[1:2],
+         lo25[2:3], hi25[2:3], lo25[3:4], hi25[3:4]], axis=0
     )
 
 
-def _keccak_kernel(blo_ref, bhi_ref, idx_x_ref, perm_ref, rot_ref, rclo_ref, rchi_ref, out_ref):
-    tile = blo_ref.shape[0]
-    lo = jnp.zeros((tile, 25), dtype=jnp.uint32).at[:, :17].set(blo_ref[:])
-    hi = jnp.zeros((tile, 25), dtype=jnp.uint32).at[:, :17].set(bhi_ref[:])
-    tables = (idx_x_ref[:], perm_ref[:], rot_ref[:], rclo_ref[:], rchi_ref[:])
-    lo, hi = keccak_f1600_batch(lo, hi, tables=tables)
-    out_ref[:] = _digest_columns(lo, hi)
+def _add64_s(alo, ahi, blo, bhi):
+    sum_lo = alo + blo
+    carry = (sum_lo < alo).astype(jnp.uint32)
+    return sum_lo, ahi + bhi + carry
 
 
-def _blake2b_kernel(mlo_ref, mhi_ref, len_ref, ivlo_ref, ivhi_ref, sigma_ref, out_ref):
-    tile = mlo_ref.shape[0]
-    iv_lo = ivlo_ref[:]
-    iv_hi = ivhi_ref[:]
-    h_lo = jnp.broadcast_to(iv_lo, (tile, 8)).astype(jnp.uint32)
-    h_lo = h_lo.at[:, 0].set(h_lo[:, 0] ^ jnp.uint32(_PARAM_WORD0))
-    h_hi = jnp.broadcast_to(iv_hi, (tile, 8)).astype(jnp.uint32)
-    t_lo = len_ref[:, 0].astype(jnp.uint32)
-    f_word = jnp.full((tile,), 0xFFFFFFFF, dtype=jnp.uint32)
-    h_lo, h_hi = _compress(
-        h_lo, h_hi, mlo_ref[:], mhi_ref[:], t_lo, f_word,
-        tables=(iv_lo, iv_hi, sigma_ref[:]),
+def _rotr64_s(lo, hi, n: int):
+    if n == 32:
+        return hi, lo
+    if n == 63:
+        return (lo << 1) | (hi >> 31), (hi << 1) | (lo >> 31)
+    return (lo >> n) | (hi << (32 - n)), (hi >> n) | (lo << (32 - n))
+
+
+def _g_vec(a, b, c, d, mx, my):
+    """One blake2b G mix over [4, TILE] u64-pair row groups (the four
+    column — or diagonal, after row rotation — mixes at once)."""
+    a = _add64_s(*_add64_s(*a, *b), *mx)
+    d = _rotr64_s(d[0] ^ a[0], d[1] ^ a[1], 32)
+    c = _add64_s(*c, *d)
+    b = _rotr64_s(b[0] ^ c[0], b[1] ^ c[1], 24)
+    a = _add64_s(*_add64_s(*a, *b), *my)
+    d = _rotr64_s(d[0] ^ a[0], d[1] ^ a[1], 16)
+    c = _add64_s(*c, *d)
+    b = _rotr64_s(b[0] ^ c[0], b[1] ^ c[1], 63)
+    return a, b, c, d
+
+
+def _rot_rows(pair, k: int):
+    """Rotate a [4, TILE] pair's rows up by the static k (diagonalization)."""
+    lo, hi = pair
+    return (
+        jnp.concatenate([lo[k:], lo[:k]], axis=0),
+        jnp.concatenate([hi[k:], hi[:k]], axis=0),
     )
-    out_ref[:] = _digest_columns(h_lo, h_hi)
 
 
-def _interpret_default() -> bool:
-    return jax.devices()[0].platform != "tpu"
+def _blake2b_kernel(mlo_ref, mhi_ref, len_ref, out_ref):
+    # lane-major: refs [16|1|8, TILE_N]; state kept as four [4, TILE_N]
+    # row groups so each G mixes all four columns in one vector op chain
+    tile_n = mlo_ref.shape[1]
+
+    def sel(ref, rows):
+        return jnp.concatenate([ref[i : i + 1, :] for i in rows], axis=0)
+
+    def const_rows(words):
+        # built from Python scalars — Pallas kernels may not capture arrays
+        return jnp.concatenate(
+            [jnp.full((1, tile_n), w, dtype=jnp.uint32) for w in words], axis=0
+        )
+
+    t_lo = len_ref[0:1, :].astype(jnp.uint32)
+    h0 = _IV[0] ^ _PARAM_WORD0
+    hw = [h0 if i == 0 else _IV[i] for i in range(8)]
+    h_lo = (const_rows([w & _U32 for w in hw[:4]]), const_rows([w & _U32 for w in hw[4:]]))
+    h_hi = (const_rows([w >> 32 for w in hw[:4]]), const_rows([w >> 32 for w in hw[4:]]))
+
+    a = (h_lo[0], h_hi[0])  # v0..3
+    b = (h_lo[1], h_hi[1])  # v4..7
+    c = (const_rows([w & _U32 for w in _IV[:4]]), const_rows([w >> 32 for w in _IV[:4]]))
+    # v12..15: v12 ^= t_lo; v14 = ~IV[6] (single final block, f0 = ~0)
+    inv6 = _IV[6] ^ ((1 << 64) - 1)
+    d_lo = const_rows([_IV[4] & _U32, _IV[5] & _U32, inv6 & _U32, _IV[7] & _U32])
+    d_hi = const_rows([_IV[4] >> 32, _IV[5] >> 32, inv6 >> 32, _IV[7] >> 32])
+    # xor t_lo into row 0 without a slice-update (Mosaic: concat only)
+    d_lo = jnp.concatenate([d_lo[0:1, :] ^ t_lo, d_lo[1:4, :]], axis=0)
+    d = (d_lo, d_hi)
+
+    for r in range(12):
+        s = [int(x) for x in _SIGMA[r % 10]]
+        mx = (sel(mlo_ref, s[0:8:2]), sel(mhi_ref, s[0:8:2]))
+        my = (sel(mlo_ref, s[1:8:2]), sel(mhi_ref, s[1:8:2]))
+        a, b, c, d = _g_vec(a, b, c, d, mx, my)
+        # diagonalize, mix, un-diagonalize
+        b, c, d = _rot_rows(b, 1), _rot_rows(c, 2), _rot_rows(d, 3)
+        mx = (sel(mlo_ref, s[8:16:2]), sel(mhi_ref, s[8:16:2]))
+        my = (sel(mlo_ref, s[9:16:2]), sel(mhi_ref, s[9:16:2]))
+        a, b, c, d = _g_vec(a, b, c, d, mx, my)
+        b, c, d = _rot_rows(b, 3), _rot_rows(c, 2), _rot_rows(d, 1)
+
+    out_lo = h_lo[0] ^ a[0] ^ c[0]  # h0..3 ^ v0..3 ^ v8..11
+    out_hi = h_hi[0] ^ a[1] ^ c[1]
+    # interleave [lo0, hi0, lo1, hi1, ...] rows
+    rows = []
+    for i in range(4):
+        rows.append(out_lo[i : i + 1, :])
+        rows.append(out_hi[i : i + 1, :])
+    out_ref[:] = jnp.concatenate(rows, axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -88,27 +224,26 @@ def keccak256_single_block_pallas(blocks_lo, blocks_hi, interpret: bool = False)
     from jax.experimental.pallas import tpu as pltpu
 
     n = blocks_lo.shape[0]
-    table_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
-    return pl.pallas_call(
+    table_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    digests_t = pl.pallas_call(
         _keccak_kernel,
         grid=(n // TILE,),
         in_specs=[
-            pl.BlockSpec((TILE, 17), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((TILE, 17), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            table_spec, table_spec, table_spec, table_spec, table_spec,
+            pl.BlockSpec((17, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((17, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            table_spec,
+            table_spec,
         ],
-        out_specs=pl.BlockSpec((TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, 8), jnp.uint32),
+        out_specs=pl.BlockSpec((8, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
         interpret=interpret,
     )(
-        blocks_lo,
-        blocks_hi,
-        jnp.asarray(_IDX_X),
-        jnp.asarray(_PERM_SRC),
-        jnp.asarray(_PERM_ROT),
-        jnp.asarray(_RC_LO),
-        jnp.asarray(_RC_HI),
+        blocks_lo.T,  # lane-major [17, N]; transpose fuses into the same jit
+        blocks_hi.T,
+        jnp.asarray(_RC_LO_COL[:, 0]),
+        jnp.asarray(_RC_HI_COL[:, 0]),
     )
+    return digests_t.T
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -122,27 +257,19 @@ def blake2b256_single_block_pallas(m_lo, m_hi, lengths, interpret: bool = False)
     from jax.experimental.pallas import tpu as pltpu
 
     n = m_lo.shape[0]
-    table_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
-    return pl.pallas_call(
+    digests_t = pl.pallas_call(
         _blake2b_kernel,
         grid=(n // TILE,),
         in_specs=[
-            pl.BlockSpec((TILE, 16), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((TILE, 16), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            table_spec, table_spec, table_spec,
+            pl.BlockSpec((16, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((16, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, 8), jnp.uint32),
+        out_specs=pl.BlockSpec((8, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
         interpret=interpret,
-    )(
-        m_lo,
-        m_hi,
-        lengths,
-        jnp.asarray(_IV_LO),
-        jnp.asarray(_IV_HI),
-        jnp.asarray(_SIGMA),
-    )
+    )(m_lo.T, m_hi.T, lengths.T)
+    return digests_t.T
 
 
 # --- host-side packing (single-block, de-interleaved, TILE-padded) ----------
