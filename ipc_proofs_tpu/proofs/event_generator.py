@@ -35,7 +35,7 @@ from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.ipld.amt import AMT
 from ipc_proofs_tpu.proofs.bundle import EventData, EventProof, EventProofBundle
 from ipc_proofs_tpu.proofs.chain import Tipset
-from ipc_proofs_tpu.proofs.exec_order import build_execution_order, decode_txmeta
+from ipc_proofs_tpu.proofs.exec_order import decode_txmeta
 from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.events import (
     Receipt,
@@ -50,6 +50,7 @@ __all__ = [
     "EventMatcher",
     "generate_event_proof",
     "collect_base_witness",
+    "collect_base_witness_and_exec_order",
     "scan_receipt_events",
     "scan_receipts_from_api",
     "match_receipt_indices",
@@ -77,6 +78,17 @@ def collect_base_witness(
 ) -> None:
     """Seed the witness: headers, receipts root, TxMeta CIDs, and the full
     TxMeta AMT walks needed to reconstruct execution order offline."""
+    collect_base_witness_and_exec_order(collector, store, parent, child)
+
+
+def collect_base_witness_and_exec_order(
+    collector: WitnessCollector, store: Blockstore, parent: Tipset, child: Tipset
+) -> list[CID]:
+    """`collect_base_witness` + `build_execution_order` in ONE set of TxMeta
+    AMT walks (they traverse exactly the same blocks; the range driver runs
+    both per matching pair, so walking once halves that leg). Returns the
+    canonical execution order: per block, BLS before secp, first-seen dedup
+    (`events/utils.rs:48-94` semantics)."""
     child_cid = child.cids[0]
     receipts_root = child.blocks[0].parent_message_receipts
     for parent_cid in parent.cids:
@@ -86,15 +98,23 @@ def collect_base_witness(
     for header in parent.blocks:
         collector.add_cid(header.messages)
 
+    exec_order: list[CID] = []
+    seen: set[CID] = set()
     tx_recorder = RecordingBlockstore(store)
     for header in parent.blocks:
         tx_raw = tx_recorder.get(header.messages)
         if tx_raw is None:
             raise KeyError(f"missing TxMeta {header.messages}")
         bls_root, secp_root = decode_txmeta(tx_raw)
-        AMT.load(tx_recorder, bls_root, expected_version=0).for_each(lambda i, v: None)
-        AMT.load(tx_recorder, secp_root, expected_version=0).for_each(lambda i, v: None)
+        for root in (bls_root, secp_root):
+            for _, msg_cid in AMT.load(tx_recorder, root, expected_version=0).items():
+                if not isinstance(msg_cid, CID):
+                    raise ValueError("message list AMT must hold CIDs")
+                if msg_cid not in seen:
+                    seen.add(msg_cid)
+                    exec_order.append(msg_cid)
     collector.collect_from_recording(tx_recorder)
+    return exec_order
 
 
 def scan_receipt_events(
@@ -265,9 +285,7 @@ def generate_event_proof(
     receipts_root = child.blocks[0].parent_message_receipts
 
     collector = WitnessCollector(store)
-    collect_base_witness(collector, store, parent, child)
-
-    exec_order = build_execution_order(store, parent)
+    exec_order = collect_base_witness_and_exec_order(collector, store, parent, child)
 
     if receipts_client is not None:
         scanned = scan_receipts_from_api(store, receipts_client, child)

@@ -114,20 +114,24 @@ def _verify_proofs_batch(
         groups.setdefault(key, []).append(k)
 
     _UNSET = object()
+    # Phase 1: steps 1-3 per group (shared pieces computed lazily, at the
+    # FIRST proof whose earlier steps pass — so raise/False behavior is
+    # exactly the scalar path's: a proof rejected by the trust policy never
+    # touches the witness; a missing child header raises only after trust
+    # passes, as in `_verify_single_proof`). Proofs that clear step 3 are
+    # parked as (proof index, receipts root) for the batched step 4.
+    pending: list[tuple[int, "BlockHeader"]] = []
+    pending_roots: list[CID] = []  # one receipts root per group with survivors
+    root_pos: dict[str, int] = {}  # receipts-root cid str → position in ^
+    pending_pair: list[int] = []  # pending[i] → its root position
+
     for (parent_strs, child_str), idxs in groups.items():
         parent_cids = [CID.from_string(c) for c in parent_strs]
         child_cid = CID.from_string(child_str)
-
-        # Every group-shared piece is computed lazily, at the FIRST proof
-        # whose earlier steps pass — so raise/False behavior is exactly the
-        # scalar path's (e.g. a proof rejected by the trust policy never
-        # touches the witness; a missing child header raises only after
-        # trust passes, as in `_verify_single_proof`).
         child_header: Optional[BlockHeader] = None
         parents_match = False
         parent_height: Optional[int] = None
         exec_pos = _UNSET  # dict[CID, int] | None (None = reconstruct failed)
-        scan_state = _UNSET  # (ScanBatch, rows dict) | None (None = scan error)
 
         for k in idxs:
             proof = proofs[k]
@@ -166,54 +170,59 @@ def _verify_proofs_batch(
             position = exec_pos.get(CID.from_string(proof.message_cid))
             if position is None or position != proof.exec_index:
                 continue
-            # Step 4: receipt + event replay. The tolerant scan visits every
-            # receipts/events path present in the (pruned) witness once; a
-            # proof whose path is missing finds no row → False, matching the
-            # scalar KeyError → False. A scan *error* (malformed block) falls
-            # back to scalar replay so per-proof error semantics hold.
-            if scan_state is _UNSET:
-                try:
-                    scan = scan_events_flat(
-                        store,
-                        [child_header.parent_message_receipts],
-                        skip_missing=True,
-                        want_payload=True,
-                    )
-                except (KeyError, ValueError):
-                    scan = None
-                if scan is None:
-                    scan_state = None
-                else:
-                    scan_state = (
-                        scan,
-                        {
-                            (int(scan.exec_idx[r]), int(scan.event_idx[r])): r
-                            for r in range(scan.n_events)
-                        },
-                    )
-            if scan_state is None:
-                results[k] = _verify_receipt_and_event(
-                    store, child_header, proof, check_event
-                )
+            root = child_header.parent_message_receipts
+            pos = root_pos.setdefault(str(root), len(pending_roots))
+            if pos == len(pending_roots):
+                pending_roots.append(root)
+            pending.append((k, child_header))
+            pending_pair.append(pos)
+
+    if not pending:
+        return results
+
+    # Phase 2: ONE tolerant scan over every pending group's receipts AMT —
+    # the walk visits each receipts/events path present in the (pruned)
+    # witness once; a proof whose path is missing finds no row → False,
+    # matching the scalar KeyError → False. A scan *error* (malformed block)
+    # falls back to scalar replay so per-proof error semantics hold.
+    try:
+        scan = scan_events_flat(
+            store, pending_roots, skip_missing=True, want_payload=True
+        )
+    except (KeyError, ValueError):
+        scan = None
+    rows: Optional[dict] = None
+    if scan is not None:
+        rows = {
+            (int(scan.pair_ids[r]), int(scan.exec_idx[r]), int(scan.event_idx[r])): r
+            for r in range(scan.n_events)
+        }
+
+    # Phase 3: step 4 per pending proof.
+    for (k, child_header), pair in zip(pending, pending_pair):
+        proof = proofs[k]
+        if rows is None:
+            results[k] = _verify_receipt_and_event(
+                store, child_header, proof, check_event
+            )
+            continue
+        row = rows.get((pair, proof.exec_index, proof.event_index))
+        if row is None:
+            continue
+        if not _row_matches_claim(scan, row, proof.event_data):
+            continue
+        if check_event is not None:
+            # Semantic predicates inspect the decoded ActorEvent — replay
+            # just this proof's event scalar (sparse path).
+            stamped = _replay_stamped_event(
+                store,
+                child_header.parent_message_receipts,
+                proof.exec_index,
+                proof.event_index,
+            )
+            if stamped is None or not check_event(stamped.event):
                 continue
-            scan, rows = scan_state
-            row = rows.get((proof.exec_index, proof.event_index))
-            if row is None:
-                continue
-            if not _row_matches_claim(scan, row, proof.event_data):
-                continue
-            if check_event is not None:
-                # Semantic predicates inspect the decoded ActorEvent — replay
-                # just this proof's event scalar (sparse path).
-                stamped = _replay_stamped_event(
-                    store,
-                    child_header.parent_message_receipts,
-                    proof.exec_index,
-                    proof.event_index,
-                )
-                if stamped is None or not check_event(stamped.event):
-                    continue
-            results[k] = True
+        results[k] = True
     return results
 
 

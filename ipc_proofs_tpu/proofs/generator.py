@@ -88,5 +88,5 @@ def generate_proof_bundle(
     return UnifiedProofBundle(
         storage_proofs=storage_proofs,
         event_proofs=event_proofs,
-        blocks=sorted(all_blocks, key=lambda b: b.cid),
+        blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
     )

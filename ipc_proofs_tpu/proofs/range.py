@@ -25,12 +25,11 @@ from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
 from ipc_proofs_tpu.proofs.chain import Tipset
 from ipc_proofs_tpu.proofs.event_generator import (
     EventMatcher,
-    collect_base_witness,
+    collect_base_witness_and_exec_order,
     match_receipt_indices,
     record_matching_receipts,
     scan_receipt_events,
 )
-from ipc_proofs_tpu.proofs.exec_order import build_execution_order
 from ipc_proofs_tpu.proofs.generator import EventProofSpec
 from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.events import StampedEvent
@@ -102,7 +101,7 @@ def generate_event_proofs_for_range_chunked(
     return UnifiedProofBundle(
         storage_proofs=[],
         event_proofs=event_proofs,
-        blocks=sorted(all_blocks, key=lambda b: b.cid),
+        blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
     )
 
 
@@ -234,8 +233,11 @@ def generate_event_proofs_for_range(
             if not matching:
                 continue
             collector = WitnessCollector(cached)
-            collect_base_witness(collector, cached, pair.parent, pair.child)
-            exec_order = build_execution_order(cached, pair.parent)
+            # one set of TxMeta walks yields both the recorded base witness
+            # and the execution order (they touch the same blocks)
+            exec_order = collect_base_witness_and_exec_order(
+                collector, cached, pair.parent, pair.child
+            )
             proofs, recordings = record_matching_receipts(
                 cached,
                 pair.parent,
@@ -253,5 +255,5 @@ def generate_event_proofs_for_range(
     return UnifiedProofBundle(
         storage_proofs=[],
         event_proofs=event_proofs,
-        blocks=sorted(all_blocks, key=lambda b: b.cid),
+        blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
     )
