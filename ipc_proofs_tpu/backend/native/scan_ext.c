@@ -213,12 +213,27 @@ typedef struct {
   PyObject *fallback; /* borrowed: callable(cid_bytes)->bytes|None, or NULL */
   int skip_missing;   /* 1 = prune subtrees whose blocks are absent */
   int want_payload;   /* 1 = fill the payload pools */
+  /* optional touched-block recording (the exec-order walker's witness leg):
+   * every successful get_block appends (offset, len) + cid bytes */
+  Vec *touch_pool;
+  Vec *touch_off;
+  Vec *touch_len;
 } Scan;
 
 /* fetch a block: 1 = ok (*out new ref), 0 = missing + skip_missing (prune),
  * -1 = error (exception set). */
+static int record_touch(Scan *s, const uint8_t *cid, Py_ssize_t clen) {
+  if (!s->touch_pool) return 0;
+  int32_t off = (int32_t)s->touch_pool->len;
+  int32_t len = (int32_t)clen;
+  if (vec_push(s->touch_off, &off, 4) < 0) return -1;
+  if (vec_push(s->touch_len, &len, 4) < 0) return -1;
+  return vec_push(s->touch_pool, cid, (size_t)clen);
+}
+
 static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
                      PyObject **out) {
+  if (record_touch(s, cid, clen) < 0) return -1;
   PyObject *key = PyBytes_FromStringAndSize((const char *)cid, clen);
   if (!key) return -1;
   PyObject *hit = PyDict_GetItemWithError(s->blocks, key);
@@ -673,12 +688,252 @@ fail:
   return NULL;
 }
 
+/* ---------------- batched execution-order walker ----------------
+ *
+ * The other Phase-C / verify hot loop: per tipset pair, TxMeta (bls_root,
+ * secp_root) -> both v0 message-CID AMTs in index order.  One call walks
+ * MANY groups; per-group errors set a failed flag instead of raising, so a
+ * malformed group degrades exactly like the scalar path's caught
+ * KeyError/ValueError (proofs of that group -> False) without aborting the
+ * batch.  Python-side glue: proofs/exec_order.py.
+ */
+
+typedef struct {
+  Vec *pool;
+  Vec *off;
+  Vec *len;
+} CidSink;
+
+static int msg_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
+  (void)index;
+  CidSink *sink = (CidSink *)ctx;
+  const uint8_t *cid;
+  Py_ssize_t clen;
+  int ok;
+  if (rd_cid_or_null(p, &cid, &clen, &ok) < 0) return -1;
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, "message list AMT must hold CIDs");
+    return -1;
+  }
+  int32_t off = (int32_t)sink->pool->len;
+  int32_t len = (int32_t)clen;
+  if (vec_push(sink->off, &off, 4) < 0) return -1;
+  if (vec_push(sink->len, &len, 4) < 0) return -1;
+  return vec_push(sink->pool, cid, (size_t)clen);
+}
+
+/* canonical re-encoding of TxMeta [bls, secp]: 0x82 ++ tag42(cid) x2 */
+static int txmeta_is_canonical(const uint8_t *raw, Py_ssize_t rlen,
+                               const uint8_t *bls, Py_ssize_t bls_len,
+                               const uint8_t *secp, Py_ssize_t secp_len) {
+  uint8_t buf[512];
+  size_t n = 0;
+  if ((size_t)(bls_len + secp_len) + 16 > sizeof(buf)) return 0;
+  buf[n++] = 0x82;
+  const uint8_t *cids[2] = {bls, secp};
+  Py_ssize_t lens[2] = {bls_len, secp_len};
+  for (int i = 0; i < 2; i++) {
+    buf[n++] = 0xd8;
+    buf[n++] = 0x2a;
+    Py_ssize_t blen = lens[i] + 1; /* identity multibase prefix */
+    if (blen < 24) {
+      buf[n++] = 0x40 | (uint8_t)blen;
+    } else if (blen < 256) {
+      buf[n++] = 0x58;
+      buf[n++] = (uint8_t)blen;
+    } else {
+      buf[n++] = 0x59;
+      buf[n++] = (uint8_t)(blen >> 8);
+      buf[n++] = (uint8_t)blen;
+    }
+    buf[n++] = 0x00;
+    memcpy(buf + n, cids[i], (size_t)lens[i]);
+    n += (size_t)lens[i];
+  }
+  return (Py_ssize_t)n == rlen && memcmp(buf, raw, n) == 0;
+}
+
+static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
+                                        PyObject *kwargs) {
+  PyObject *blocks, *groups, *fallback = Py_None;
+  int headers = 1;
+  static char *kwlist[] = {"blocks", "groups", "fallback", "headers", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Op", kwlist,
+                                   &PyDict_Type, &blocks, &groups, &fallback,
+                                   &headers))
+    return NULL;
+  PyObject *gseq = PySequence_Fast(groups, "groups must be a sequence");
+  if (!gseq) return NULL;
+  Py_ssize_t n_groups = PySequence_Fast_GET_SIZE(gseq);
+
+  Scan s;
+  memset(&s, 0, sizeof(s));
+  s.blocks = blocks;
+  s.fallback = fallback;
+
+  Vec msg_pool = {0}, msg_off = {0}, msg_len = {0}, msg_goff = {0};
+  Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
+  Vec tx_pool = {0}, tx_off = {0}, tx_len = {0}, tx_goff = {0}, tx_canon = {0};
+  Vec failed = {0};
+  s.touch_pool = &touch_pool;
+  s.touch_off = &touch_off;
+  s.touch_len = &touch_len;
+  CidSink sink = {&msg_pool, &msg_off, &msg_len};
+
+  int rc = -1;
+  for (Py_ssize_t g = 0; g < n_groups; g++) {
+    /* group starts (for truncation on per-group failure) */
+    size_t m_pool0 = msg_pool.len, m_off0 = msg_off.len, m_len0 = msg_len.len;
+    size_t t_pool0 = touch_pool.len, t_off0 = touch_off.len, t_len0 = touch_len.len;
+    size_t x_pool0 = tx_pool.len, x_off0 = tx_off.len, x_len0 = tx_len.len,
+           x_canon0 = tx_canon.len;
+    int32_t mcount = (int32_t)(msg_off.len / 4);
+    int32_t tcount = (int32_t)(touch_off.len / 4);
+    int32_t xcount = (int32_t)(tx_off.len / 4);
+    if (vec_push(&msg_goff, &mcount, 4) < 0) goto out;
+    if (vec_push(&touch_goff, &tcount, 4) < 0) goto out;
+    if (vec_push(&tx_goff, &xcount, 4) < 0) goto out;
+
+    PyObject *grp = PySequence_Fast(PySequence_Fast_GET_ITEM(gseq, g),
+                                    "group must be a sequence of cid bytes");
+    if (!grp) goto out;
+    int ok = 1;
+    Py_ssize_t n_cids = PySequence_Fast_GET_SIZE(grp);
+    for (Py_ssize_t i = 0; ok && i < n_cids; i++) {
+      PyObject *cid_obj = PySequence_Fast_GET_ITEM(grp, i);
+      if (!PyBytes_Check(cid_obj)) {
+        Py_DECREF(grp);
+        PyErr_SetString(PyExc_TypeError, "group entries must be cid bytes");
+        goto out;
+      }
+      const uint8_t *in_cid = (const uint8_t *)PyBytes_AS_STRING(cid_obj);
+      Py_ssize_t in_len = PyBytes_GET_SIZE(cid_obj);
+      const uint8_t *tx_cid = in_cid;
+      Py_ssize_t tx_clen = in_len;
+      PyObject *header_block = NULL;
+      Parser hp;
+      if (headers) {
+        /* header fetches are NOT part of the touched set (the scalar path
+         * adds headers to the witness explicitly, outside the recorder) */
+        Vec *save = s.touch_pool;
+        s.touch_pool = NULL;
+        int st = get_block(&s, in_cid, in_len, &header_block);
+        s.touch_pool = save;
+        if (st <= 0) { ok = 0; break; }
+        hp.data = (const uint8_t *)PyBytes_AS_STRING(header_block);
+        hp.len = PyBytes_GET_SIZE(header_block);
+        hp.pos = 0;
+        uint64_t arity;
+        if (rd_array(&hp, &arity) < 0 || arity != 16) { ok = 0; }
+        for (int f = 0; ok && f < 10; f++)
+          if (skip_item(&hp) < 0) ok = 0; /* fields 0..9 */
+        int have = 0;
+        if (ok && rd_cid_or_null(&hp, &tx_cid, &tx_clen, &have) < 0) ok = 0;
+        if (ok && !have) ok = 0; /* messages field must be a CID */
+        if (!ok) { Py_XDECREF(header_block); break; }
+      }
+      int32_t xoff = (int32_t)tx_pool.len, xlen = (int32_t)tx_clen;
+      if (vec_push(&tx_off, &xoff, 4) < 0 || vec_push(&tx_len, &xlen, 4) < 0 ||
+          vec_push(&tx_pool, tx_cid, (size_t)tx_clen) < 0) {
+        Py_XDECREF(header_block);
+        goto out;
+      }
+      PyObject *tx_block = NULL;
+      int st = get_block(&s, tx_cid, tx_clen, &tx_block);
+      Py_XDECREF(header_block); /* tx_cid may point into it — done with it */
+      if (st <= 0) { ok = 0; break; }
+      Parser tp = {(const uint8_t *)PyBytes_AS_STRING(tx_block),
+                   PyBytes_GET_SIZE(tx_block), 0};
+      uint64_t two;
+      const uint8_t *bls, *secp;
+      Py_ssize_t bls_len, secp_len;
+      int have_b = 0, have_s = 0;
+      if (rd_array(&tp, &two) < 0 || two != 2 ||
+          rd_cid_or_null(&tp, &bls, &bls_len, &have_b) < 0 || !have_b ||
+          rd_cid_or_null(&tp, &secp, &secp_len, &have_s) < 0 || !have_s ||
+          tp.pos != tp.len /* trailing bytes: decode_txmeta rejects these */) {
+        Py_DECREF(tx_block);
+        ok = 0;
+        break;
+      }
+      uint8_t canon = (uint8_t)txmeta_is_canonical(
+          (const uint8_t *)PyBytes_AS_STRING(tx_block),
+          PyBytes_GET_SIZE(tx_block), bls, bls_len, secp, secp_len);
+      if (vec_push(&tx_canon, &canon, 1) < 0) {
+        Py_DECREF(tx_block);
+        goto out;
+      }
+      if (walk_amt_root(&s, bls, bls_len, 0, msg_leaf, &sink) < 0 ||
+          walk_amt_root(&s, secp, secp_len, 0, msg_leaf, &sink) < 0)
+        ok = 0;
+      Py_DECREF(tx_block);
+    }
+    Py_DECREF(grp);
+    uint8_t fail = !ok;
+    if (!ok) {
+      if (PyErr_ExceptionMatches(PyExc_KeyError) ||
+          PyErr_ExceptionMatches(PyExc_ValueError) || !PyErr_Occurred()) {
+        PyErr_Clear(); /* per-group degradation, like the scalar caught errors */
+        msg_pool.len = m_pool0; msg_off.len = m_off0; msg_len.len = m_len0;
+        touch_pool.len = t_pool0; touch_off.len = t_off0; touch_len.len = t_len0;
+        tx_pool.len = x_pool0; tx_off.len = x_off0; tx_len.len = x_len0;
+        tx_canon.len = x_canon0;
+      } else {
+        goto out; /* real errors (TypeError, MemoryError) propagate */
+      }
+    }
+    if (vec_push(&failed, &fail, 1) < 0) goto out;
+  }
+  {
+    int32_t mcount = (int32_t)(msg_off.len / 4);
+    int32_t tcount = (int32_t)(touch_off.len / 4);
+    int32_t xcount = (int32_t)(tx_off.len / 4);
+    if (vec_push(&msg_goff, &mcount, 4) < 0) goto out;
+    if (vec_push(&touch_goff, &tcount, 4) < 0) goto out;
+    if (vec_push(&tx_goff, &xcount, 4) < 0) goto out;
+  }
+  rc = 0;
+out:;
+  PyObject *result = NULL;
+  if (rc == 0) {
+    result = Py_BuildValue(
+        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N}",
+        "msg_pool", make_array_bytes(&msg_pool),
+        "msg_off", make_array_bytes(&msg_off),
+        "msg_len", make_array_bytes(&msg_len),
+        "msg_goff", make_array_bytes(&msg_goff),
+        "touch_pool", make_array_bytes(&touch_pool),
+        "touch_off", make_array_bytes(&touch_off),
+        "touch_len", make_array_bytes(&touch_len),
+        "touch_goff", make_array_bytes(&touch_goff),
+        "tx_pool", make_array_bytes(&tx_pool),
+        "tx_off", make_array_bytes(&tx_off),
+        "tx_len", make_array_bytes(&tx_len),
+        "tx_goff", make_array_bytes(&tx_goff),
+        "tx_canon", make_array_bytes(&tx_canon),
+        "failed", make_array_bytes(&failed));
+  }
+  Py_DECREF(gseq);
+  vec_free(&msg_pool); vec_free(&msg_off); vec_free(&msg_len); vec_free(&msg_goff);
+  vec_free(&touch_pool); vec_free(&touch_off); vec_free(&touch_len);
+  vec_free(&touch_goff);
+  vec_free(&tx_pool); vec_free(&tx_off); vec_free(&tx_len); vec_free(&tx_goff);
+  vec_free(&tx_canon); vec_free(&failed);
+  return result;
+}
+
 static PyMethodDef methods[] = {
     {"scan_events_batch", (PyCFunction)(void (*)(void))py_scan_events_batch,
      METH_VARARGS | METH_KEYWORDS,
      "scan_events_batch(blocks_dict, roots, fallback=None, skip_missing=False,"
      " want_payload=False) -> dict of flat array buffers over every event of "
      "every receipt of every root."},
+    {"collect_exec_orders",
+     (PyCFunction)(void (*)(void))py_collect_exec_orders,
+     METH_VARARGS | METH_KEYWORDS,
+     "collect_exec_orders(blocks_dict, groups, fallback=None, headers=True) ->"
+     " per-group message-CID lists (execution order, pre-dedup), touched block"
+     " CIDs, TxMeta CIDs + canonical flags, and failed flags."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_scan_ext",

@@ -67,8 +67,10 @@ class CID:
         # fast paths: the two canonical chain forms — CIDv1 dag-cbor
         # blake2b-256 (every Filecoin chain block) and CIDv1 raw sha2-256.
         # Decode paths parse these tens of thousands of times per range.
-        if len(raw) == 38 and raw[:6] == b"\x01\x71\xa0\xe4\x02\x20":
+        if len(raw) == 38 and raw[1] == 0x71 and raw[:6] == b"\x01\x71\xa0\xe4\x02\x20":
             return cls(1, DAG_CBOR, BLAKE2B_256, raw[6:])
+        if len(raw) == 38 and raw[:6] == b"\x01\x55\xa0\xe4\x02\x20":
+            return cls(1, RAW, BLAKE2B_256, raw[6:])
         if len(raw) == 36 and raw[:4] == b"\x01\x55\x12\x20":
             return cls(1, RAW, SHA2_256, raw[4:])
         version, off = decode_uvarint(raw)

@@ -105,6 +105,7 @@ def _verify_proofs_batch(
     walk ONCE; per-proof work shrinks to integer checks and pooled byte
     compares. The reference redoes all of it per proof
     (`events/verifier.rs:92-121`)."""
+    from ipc_proofs_tpu.proofs.exec_order import reconstruct_execution_orders_batch
     from ipc_proofs_tpu.proofs.scan_native import scan_events_flat
 
     results = [False] * len(proofs)
@@ -113,25 +114,22 @@ def _verify_proofs_batch(
         key = (tuple(proof.parent_tipset_cids), proof.child_block_cid)
         groups.setdefault(key, []).append(k)
 
-    _UNSET = object()
-    # Phase 1: steps 1-3 per group (shared pieces computed lazily, at the
+    # Phase 1: steps 1-2 per group (shared pieces computed lazily, at the
     # FIRST proof whose earlier steps pass — so raise/False behavior is
     # exactly the scalar path's: a proof rejected by the trust policy never
-    # touches the witness; a missing child header raises only after trust
-    # passes, as in `_verify_single_proof`). Proofs that clear step 3 are
-    # parked as (proof index, receipts root) for the batched step 4.
-    pending: list[tuple[int, "BlockHeader"]] = []
-    pending_roots: list[CID] = []  # one receipts root per group with survivors
-    root_pos: dict[str, int] = {}  # receipts-root cid str → position in ^
-    pending_pair: list[int] = []  # pending[i] → its root position
-
+    # touches the witness beyond the headers step 2 itself reads; a missing
+    # child header raises only after trust passes, as in
+    # `_verify_single_proof`). Groups with survivors proceed to the batched
+    # step 3 — reconstruction runs ONLY for groups some proof actually
+    # reached, preserving the lazy cost model against adversarial bundles.
+    step3: list[tuple[list[int], list[CID], "BlockHeader"]] = []
     for (parent_strs, child_str), idxs in groups.items():
         parent_cids = [CID.from_string(c) for c in parent_strs]
         child_cid = CID.from_string(child_str)
         child_header: Optional[BlockHeader] = None
         parents_match = False
         parent_height: Optional[int] = None
-        exec_pos = _UNSET  # dict[CID, int] | None (None = reconstruct failed)
+        survivors: list[int] = []
 
         for k in idxs:
             proof = proofs[k]
@@ -158,16 +156,38 @@ def _verify_proofs_batch(
                 parent_height = BlockHeader.decode(parent_raw).height
             if parent_height != proof.parent_epoch:
                 continue
-            # Step 3: execution order (reconstructed once per group).
-            if exec_pos is _UNSET:
-                try:
-                    exec_order = reconstruct_execution_order(store, parent_cids)
-                    exec_pos = {cid: i for i, cid in enumerate(exec_order)}
-                except (KeyError, ValueError):
-                    exec_pos = None
-            if exec_pos is None:
-                continue
-            position = exec_pos.get(CID.from_string(proof.message_cid))
+            survivors.append(k)
+        if survivors:
+            step3.append((survivors, parent_cids, child_header))
+
+    if not step3:
+        return results
+
+    # Step 3, batched: ONE native walk reconstructs the surviving groups'
+    # execution orders (scalar per group when the extension is absent).
+    batch_exec = reconstruct_execution_orders_batch(
+        store, [parent_cids for _, parent_cids, _ in step3]
+    )
+
+    pending: list[tuple[int, "BlockHeader"]] = []
+    pending_roots: list[CID] = []  # one receipts root per group with survivors
+    root_pos: dict[str, int] = {}  # receipts-root cid str → position in ^
+    pending_pair: list[int] = []  # pending[i] → its root position
+
+    for gi, (survivors, parent_cids, child_header) in enumerate(step3):
+        if batch_exec is not None:
+            exec_pos = batch_exec[gi]
+        else:
+            try:
+                exec_order = reconstruct_execution_order(store, parent_cids)
+                exec_pos = {c.to_bytes(): i for i, c in enumerate(exec_order)}
+            except (KeyError, ValueError):
+                exec_pos = None
+        if exec_pos is None:
+            continue
+        for k in survivors:
+            proof = proofs[k]
+            position = exec_pos.get(CID.from_string(proof.message_cid).to_bytes())
             if position is None or position != proof.exec_index:
                 continue
             root = child_header.parent_message_receipts

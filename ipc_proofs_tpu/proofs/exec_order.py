@@ -10,6 +10,8 @@ occurrence. Offline reconstruction recomputes each TxMeta CID
 
 from __future__ import annotations
 
+from typing import Optional
+
 from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
 from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
@@ -17,7 +19,13 @@ from ipc_proofs_tpu.ipld.amt import AMT
 from ipc_proofs_tpu.state.header import BlockHeader
 from ipc_proofs_tpu.store.blockstore import Blockstore
 
-__all__ = ["build_execution_order", "reconstruct_execution_order", "decode_txmeta"]
+__all__ = [
+    "build_execution_order",
+    "reconstruct_execution_order",
+    "reconstruct_execution_orders_batch",
+    "collect_exec_orders_for_pairs",
+    "decode_txmeta",
+]
 
 
 def decode_txmeta(raw: bytes) -> tuple[CID, CID]:
@@ -78,3 +86,176 @@ def reconstruct_execution_order(store: Blockstore, parent_header_cids: list[CID]
             raise KeyError(f"missing parent header {cid}")
         txmeta_cids.append(BlockHeader.decode(raw).messages)
     return _collect_exec_list(store, txmeta_cids, verify_txmeta=True)
+
+
+def _native_exec_orders(store: Blockstore, groups: list[list[CID]], headers: bool):
+    """Raw C-walker call; None when the extension is unavailable or errors."""
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+    from ipc_proofs_tpu.proofs.scan_native import _raw_view
+
+    ext = load_scan_ext()
+    if ext is None:
+        return None
+    raw, fallback = _raw_view(store)
+    try:
+        return ext.collect_exec_orders(
+            raw, [[c.to_bytes() for c in g] for g in groups], fallback, headers=headers
+        )
+    except Exception:
+        return None
+
+
+class _GroupView:
+    """Per-group slices of the C walker's pooled output."""
+
+    __slots__ = ("msgs", "touched", "txmetas", "canon", "failed")
+
+    def __init__(self, msgs, touched, txmetas, canon, failed):
+        self.msgs = msgs  # list[bytes] — message CIDs, pre-dedup, in order
+        self.touched = touched  # list[bytes] — fetched block CIDs
+        self.txmetas = txmetas  # list[bytes] — TxMeta CIDs
+        self.canon = canon  # list[bool] — raw block == canonical encoding
+        self.failed = failed
+
+
+def _unpack_groups(out: dict, n_groups: int) -> list[_GroupView]:
+    """Decode the C result dict (pools + offset/length/group-offset arrays)
+    into per-group byte-slice lists — the single place that knows the
+    layout."""
+    import numpy as np
+
+    def slices(prefix):
+        off = np.frombuffer(out[f"{prefix}_off"], "<i4")
+        ln = np.frombuffer(out[f"{prefix}_len"], "<i4")
+        goff = np.frombuffer(out[f"{prefix}_goff"], "<i4")
+        pool = out[f"{prefix}_pool"]
+        return [
+            [pool[off[t] : off[t] + ln[t]] for t in range(goff[g], goff[g + 1])]
+            for g in range(n_groups)
+        ], goff
+
+    msgs, _ = slices("msg")
+    touched, _ = slices("touch")
+    txmetas, tx_goff = slices("tx")
+    canon = out["tx_canon"]
+    failed = out["failed"]
+    return [
+        _GroupView(
+            msgs[g],
+            touched[g],
+            txmetas[g],
+            [bool(canon[t]) for t in range(tx_goff[g], tx_goff[g + 1])],
+            bool(failed[g]),
+        )
+        for g in range(n_groups)
+    ]
+
+
+def _first_seen_positions(msg_bytes: list[bytes]) -> dict[bytes, int]:
+    pos: dict[bytes, int] = {}
+    for b in msg_bytes:
+        if b not in pos:
+            pos[b] = len(pos)
+    return pos
+
+
+def reconstruct_execution_orders_batch(
+    store: Blockstore, groups: list[list[CID]]
+) -> "Optional[list[Optional[dict[bytes, int]]]]":
+    """Batched `reconstruct_execution_order` over many parent-header groups
+    via the native walker: ONE C call walks every group's TxMeta/message
+    AMTs. Returns per group a first-seen position map keyed by message-CID
+    BYTES (no per-CID Python objects), or None for a group whose
+    reconstruction fails — exactly the caught-KeyError/ValueError degradation
+    of the scalar path. Returns None overall when the extension is absent
+    (callers use the scalar path).
+
+    Parity with the scalar path is enforced in Python on top of the C walk:
+
+    - every parent header is re-decoded with `BlockHeader.decode` (the C
+      parser only extracts the messages field; the scalar path's strict
+      16-tuple/CID/trailing-byte validation must still reject what it
+      rejects), and its ``messages`` must equal the C-reported TxMeta CID;
+    - TxMeta CID recomputation: the scalar path recomputes
+      ``CID.hash_of(encode([bls, secp]))`` and compares. The C walker
+      reports whether the raw block IS the canonical encoding; if so the
+      recomputed CID is blake2b-256(raw) (checked with hashlib).
+      Non-canonical raws (adversarial corner) fall back to the scalar
+      reconstruction for that group so semantics match bit-for-bit.
+    """
+    import hashlib
+
+    out = _native_exec_orders(store, groups, headers=True)
+    if out is None:
+        return None
+    views = _unpack_groups(out, len(groups))
+
+    _CHAIN_PREFIX = b"\x01\x71\xa0\xe4\x02\x20"  # CIDv1 dag-cbor blake2b-256
+    results: list[Optional[dict[bytes, int]]] = []
+    for g, view in enumerate(views):
+        if view.failed:
+            results.append(None)
+            continue
+        ok = True
+        # strict header validation (scalar parity — see docstring)
+        expected_txmetas = []
+        try:
+            for cid in groups[g]:
+                raw = store.get(cid)
+                if raw is None:
+                    ok = False
+                    break
+                expected_txmetas.append(BlockHeader.decode(raw).messages.to_bytes())
+        except ValueError:
+            ok = False
+        if ok and expected_txmetas != view.txmetas:
+            ok = False
+        scalar_fallback = False
+        if ok:
+            for cid_b, canon in zip(view.txmetas, view.canon):
+                if canon and cid_b[:6] == _CHAIN_PREFIX:
+                    raw_block = store.get(CID.from_bytes(cid_b))
+                    if (
+                        raw_block is None
+                        or hashlib.blake2b(raw_block, digest_size=32).digest() != cid_b[6:]
+                    ):
+                        ok = False
+                        break
+                else:
+                    scalar_fallback = True
+                    break
+        if scalar_fallback:
+            try:
+                order = reconstruct_execution_order(store, groups[g])
+                results.append({c.to_bytes(): i for i, c in enumerate(order)})
+            except (KeyError, ValueError):
+                results.append(None)
+            continue
+        results.append(_first_seen_positions(view.msgs) if ok else None)
+    return results
+
+
+def collect_exec_orders_for_pairs(
+    store: Blockstore, txmeta_groups: list[list[CID]]
+) -> "Optional[list[Optional[tuple[list[CID], list[CID]]]]]":
+    """Generation-side batched walker: per group of TxMeta CIDs, returns
+    ``(exec_order, touched_block_cids)`` — the execution order AND the block
+    CIDs the walk touched (the recorded base-witness leg of
+    `collect_base_witness_and_exec_order`), in one C call for all matching
+    pairs. A failed group yields None (callers redo it scalar so errors
+    surface with the scalar path's exact exceptions). None overall when the
+    extension is absent."""
+    out = _native_exec_orders(store, txmeta_groups, headers=False)
+    if out is None:
+        return None
+    views = _unpack_groups(out, len(txmeta_groups))
+
+    results = []
+    for view in views:
+        if view.failed:
+            results.append(None)
+            continue
+        order = [CID.from_bytes(b) for b in _first_seen_positions(view.msgs)]
+        touched = [CID.from_bytes(b) for b in view.touched]
+        results.append((order, touched))
+    return results

@@ -229,15 +229,44 @@ def generate_event_proofs_for_range(
     event_proofs = []
     all_blocks: set[ProofBlock] = set()
     with metrics.stage("range_record"):
-        for pair, matching in zip(pairs, matching_per_pair):
-            if not matching:
-                continue
-            collector = WitnessCollector(cached)
-            # one set of TxMeta walks yields both the recorded base witness
-            # and the execution order (they touch the same blocks)
-            exec_order = collect_base_witness_and_exec_order(
-                collector, cached, pair.parent, pair.child
+        # Batched exec-order + base-witness walks: one native call covers
+        # every matching pair's TxMeta/message AMTs; a failed group (or no
+        # extension) redoes that pair scalar so errors surface identically.
+        from ipc_proofs_tpu.proofs.exec_order import collect_exec_orders_for_pairs
+
+        matching_pairs = [
+            (pair, matching)
+            for pair, matching in zip(pairs, matching_per_pair)
+            if matching
+        ]
+        native_walks = None
+        # scan_batch non-None ⇒ the native extension loaded and the store
+        # exposes a raw map, so the walker uses the same fast block access
+        if matching_pairs and scan_batch is not None:
+            native_walks = collect_exec_orders_for_pairs(
+                cached,
+                [[h.messages for h in pair.parent.blocks] for pair, _ in matching_pairs],
             )
+
+        for pos, (pair, matching) in enumerate(matching_pairs):
+            collector = WitnessCollector(cached)
+            walk = native_walks[pos] if native_walks is not None else None
+            if walk is not None:
+                exec_order, touched = walk
+                for parent_cid in pair.parent.cids:
+                    collector.add_cid(parent_cid)
+                collector.add_cid(pair.child.cids[0])
+                collector.add_cid(pair.child.blocks[0].parent_message_receipts)
+                for header in pair.parent.blocks:
+                    collector.add_cid(header.messages)
+                for cid in touched:
+                    collector.add_cid(cid)
+            else:
+                # one set of TxMeta walks yields both the recorded base
+                # witness and the execution order (they touch the same blocks)
+                exec_order = collect_base_witness_and_exec_order(
+                    collector, cached, pair.parent, pair.child
+                )
             proofs, recordings = record_matching_receipts(
                 cached,
                 pair.parent,

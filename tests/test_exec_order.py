@@ -110,3 +110,149 @@ class TestTwoPassWitnessSavings:
         witness_bytes = bundle.witness_bytes()
         # sparse match (1 of 21 receipts) ⇒ witness ≪ full chain state
         assert witness_bytes < world_bytes * 0.5, (witness_bytes, world_bytes)
+
+
+class TestNativeExecOrderBatch:
+    """The C walker (scan_ext.collect_exec_orders) must agree with the
+    scalar reconstruction, including its caught-error degradation."""
+
+    def _world(self):
+        bs = MemoryBlockstore()
+        h1, _ = _header(bs, [_msg(1), _msg(2)], [_msg(3)])
+        h2, _ = _header(bs, [_msg(3), _msg(4)], [])  # dedup: m3 already seen
+        h3, _ = _header(bs, [], [_msg(5)], height=11)
+        return bs, [[h1, h2], [h3]]
+
+    def test_matches_scalar(self):
+        from ipc_proofs_tpu.proofs.exec_order import (
+            reconstruct_execution_order,
+            reconstruct_execution_orders_batch,
+        )
+
+        bs, groups = self._world()
+        batch = reconstruct_execution_orders_batch(bs, groups)
+        if batch is None:
+            pytest.skip("native extension unavailable")
+        for g, group in enumerate(groups):
+            scalar = reconstruct_execution_order(bs, group)
+            assert batch[g] == {c.to_bytes(): i for i, c in enumerate(scalar)}
+
+    def test_missing_txmeta_degrades_to_none(self):
+        from ipc_proofs_tpu.proofs.exec_order import (
+            reconstruct_execution_orders_batch,
+        )
+
+        bs, groups = self._world()
+        # a header whose TxMeta block is absent from the store
+        orphan = BlockHeader(
+            parents=[CID.hash_of(b"gp")], height=12,
+            parent_state_root=CID.hash_of(b"sr"),
+            parent_message_receipts=CID.hash_of(b"rc"),
+            messages=CID.hash_of(b"missing-txmeta"),
+        )
+        raw = orphan.encode()
+        cid = CID.hash_of(raw)
+        bs.put_keyed(cid, raw)
+        batch = reconstruct_execution_orders_batch(bs, groups + [[cid]])
+        if batch is None:
+            pytest.skip("native extension unavailable")
+        assert batch[0] is not None and batch[1] is not None
+        assert batch[2] is None  # scalar raises KeyError → caught → None
+
+    def test_non_canonical_txmeta_falls_back_scalar(self):
+        from ipc_proofs_tpu.core.dagcbor import encode
+        from ipc_proofs_tpu.proofs.exec_order import (
+            reconstruct_execution_orders_batch,
+        )
+
+        bs = MemoryBlockstore()
+        bls_root = amt_build_v0(bs, [_msg(7)])
+        secp_root = amt_build_v0(bs, [])
+        canonical = encode([bls_root, secp_root])
+        # non-minimal byte-string head for the first tag-42 payload:
+        # 0x58 len → 0x59 0x00 len (same value, longer head)
+        idx = canonical.index(b"\x58")
+        tampered = canonical[:idx] + b"\x59\x00" + canonical[idx + 1 :]
+        tx_cid = CID.hash_of(tampered)
+        bs.put_keyed(tx_cid, tampered)
+        header = BlockHeader(
+            parents=[CID.hash_of(b"gp")], height=13,
+            parent_state_root=CID.hash_of(b"sr"),
+            parent_message_receipts=CID.hash_of(b"rc"),
+            messages=tx_cid,
+        )
+        raw = header.encode()
+        hcid = CID.hash_of(raw)
+        bs.put_keyed(hcid, raw)
+        batch = reconstruct_execution_orders_batch(bs, [[hcid]])
+        if batch is None:
+            pytest.skip("native extension unavailable")
+        # scalar recomputes the CANONICAL encoding → CID mismatch → ValueError
+        # → None; the batch path must agree (via its scalar fallback)
+        assert batch[0] is None
+
+    def test_generation_walker_matches_python(self):
+        from ipc_proofs_tpu.proofs.exec_order import (
+            build_execution_order,
+            collect_exec_orders_for_pairs,
+        )
+
+        bs, groups = self._world()
+        txmeta_groups = []
+        for group in groups:
+            metas = []
+            for hcid in group:
+                metas.append(BlockHeader.decode(bs.get(hcid)).messages)
+            txmeta_groups.append(metas)
+        walks = collect_exec_orders_for_pairs(bs, txmeta_groups)
+        if walks is None:
+            pytest.skip("native extension unavailable")
+        for g, group in enumerate(groups):
+            headers = [BlockHeader.decode(bs.get(h)) for h in group]
+
+            class FakeTipset:
+                blocks = headers
+
+            scalar = build_execution_order(bs, FakeTipset)
+            order, touched = walks[g]
+            assert order == scalar
+            assert len(touched) >= 2  # at least the TxMeta + AMT root blocks
+
+    def test_malformed_parent_header_rejected_like_scalar(self):
+        """The C walker only extracts the messages field; a header that
+        BlockHeader.decode rejects (parents not CIDs here) must still
+        degrade the group to None, exactly like the scalar ValueError."""
+        import pytest as _pytest
+
+        from ipc_proofs_tpu.core.dagcbor import encode
+        from ipc_proofs_tpu.proofs.exec_order import (
+            reconstruct_execution_order,
+            reconstruct_execution_orders_batch,
+        )
+
+        bs = MemoryBlockstore()
+        good, _ = _header(bs, [_msg(1)], [])
+        # 16-tuple with a valid messages CID at index 10 but malformed
+        # parents (index 5 not a CID list)
+        txmeta = BlockHeader.decode(bs.get(good)).messages
+        forged_fields = [None] * 16
+        forged_fields[5] = ["not-a-cid"]
+        forged_fields[6] = b""
+        forged_fields[7] = 10
+        forged_fields[8] = CID.hash_of(b"sr")
+        forged_fields[9] = CID.hash_of(b"rc")
+        forged_fields[10] = txmeta
+        forged_fields[12] = 0
+        forged_fields[14] = 0
+        forged_fields[15] = b""
+        raw = encode(forged_fields)
+        forged = CID.hash_of(raw)
+        bs.put_keyed(forged, raw)
+
+        with _pytest.raises(ValueError):
+            reconstruct_execution_order(bs, [good, forged])
+        batch = reconstruct_execution_orders_batch(bs, [[good, forged], [good]])
+        if batch is None:
+            _pytest.skip("native extension unavailable")
+        assert batch[0] is None  # scalar ValueError → caught → None
+        assert batch[1] is not None
