@@ -220,10 +220,22 @@ typedef struct {
   Vec *touch_len;
 } Scan;
 
+/* offset vectors are int32/uint32; reject pools that would wrap rather than
+ * silently corrupting slices (plausible at pod-scale ranges). */
+static int pool_off_ok(size_t len, size_t max) {
+  if (len > max) {
+    PyErr_SetString(PyExc_OverflowError,
+                    "pooled bytes exceed offset range (>2 GiB pool)");
+    return -1;
+  }
+  return 0;
+}
+
 /* fetch a block: 1 = ok (*out new ref), 0 = missing + skip_missing (prune),
  * -1 = error (exception set). */
 static int record_touch(Scan *s, const uint8_t *cid, Py_ssize_t clen) {
   if (!s->touch_pool) return 0;
+  if (pool_off_ok(s->touch_pool->len, INT32_MAX) < 0) return -1;
   int32_t off = (int32_t)s->touch_pool->len;
   int32_t len = (int32_t)clen;
   if (vec_push(s->touch_off, &off, 4) < 0) return -1;
@@ -366,6 +378,9 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
 
 done:;
   if (s->want_payload) {
+    if (pool_off_ok(s->topics_pool.len, UINT32_MAX) < 0 ||
+        pool_off_ok(s->data_pool.len, UINT32_MAX) < 0)
+      return -1;
     uint32_t toff = (uint32_t)s->topics_pool.len;
     uint32_t doff = (uint32_t)s->data_pool.len;
     uint32_t dlen = 0;
@@ -529,6 +544,12 @@ static int walk_amt_root(Scan *s, const uint8_t *cid, Py_ssize_t clen,
       goto out;
     }
     if (rd_uint(&p, &tmp) < 0) goto out;
+    /* range-check the raw u64 BEFORE narrowing: a forged bit-width of
+     * e.g. 2^32+3 must not wrap into the valid range. */
+    if (tmp < 1 || tmp > 8) {
+      PyErr_SetString(PyExc_ValueError, "invalid AMT bit width");
+      goto out;
+    }
     bit_width = (int)tmp;
   } else if (arity == 3) {
     if (expected_version != 0) {
@@ -540,16 +561,14 @@ static int walk_amt_root(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     PyErr_SetString(PyExc_ValueError, "unrecognized AMT root arity");
     goto out;
   }
-  if (bit_width < 1 || bit_width > 8) {
-    PyErr_SetString(PyExc_ValueError, "invalid AMT bit width");
-    goto out;
-  }
   if (rd_uint(&p, &tmp) < 0) goto out; /* height */
-  height = (int)tmp;
-  if (height < 0 || height > 64) {
+  /* range-check the raw u64 BEFORE narrowing: a forged height of 2^32
+   * would truncate to 0 and walk as a leaf (amt.py raises here too). */
+  if (tmp > 64) {
     PyErr_SetString(PyExc_ValueError, "invalid AMT height");
     goto out;
   }
+  height = (int)tmp;
   /* span = width^height and every index stay below 2^62: forged roots with
    * huge heights must fail cleanly, not overflow int64 (UB). */
   if ((int64_t)bit_width * (height + 1) > 62) {
@@ -715,6 +734,7 @@ static int msg_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
     PyErr_SetString(PyExc_ValueError, "message list AMT must hold CIDs");
     return -1;
   }
+  if (pool_off_ok(sink->pool->len, INT32_MAX) < 0) return -1;
   int32_t off = (int32_t)sink->pool->len;
   int32_t len = (int32_t)clen;
   if (vec_push(sink->off, &off, 4) < 0) return -1;
@@ -832,10 +852,16 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
         if (ok && !have) ok = 0; /* messages field must be a CID */
         if (!ok) { Py_XDECREF(header_block); break; }
       }
+      if (pool_off_ok(tx_pool.len, INT32_MAX) < 0) {
+        Py_XDECREF(header_block);
+        Py_DECREF(grp);
+        goto out;
+      }
       int32_t xoff = (int32_t)tx_pool.len, xlen = (int32_t)tx_clen;
       if (vec_push(&tx_off, &xoff, 4) < 0 || vec_push(&tx_len, &xlen, 4) < 0 ||
           vec_push(&tx_pool, tx_cid, (size_t)tx_clen) < 0) {
         Py_XDECREF(header_block);
+        Py_DECREF(grp);
         goto out;
       }
       PyObject *tx_block = NULL;
@@ -861,6 +887,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
           PyBytes_GET_SIZE(tx_block), bls, bls_len, secp, secp_len);
       if (vec_push(&tx_canon, &canon, 1) < 0) {
         Py_DECREF(tx_block);
+        Py_DECREF(grp);
         goto out;
       }
       if (walk_amt_root(&s, bls, bls_len, 0, msg_leaf, &sink) < 0 ||

@@ -211,10 +211,20 @@ class FinalityCertificateChain:
 
     ``validate`` checks what can be checked without BLS (see module
     docstring for the remaining gap): instances strictly consecutive, every
-    cert's EC chain non-empty, epochs strictly increasing across certs, and
-    — when ``initial_power_table`` is given — each cert's delta applies
+    cert's EC chain non-empty, base continuity across certs (below), and —
+    when ``initial_power_table`` is given — each cert's delta applies
     cleanly in sequence. Returns the final power table (or None when no
     initial table was provided).
+
+    **Base continuity (go-f3 ``certs.ValidateFinalityCertificates``):**
+    every certificate's EC chain starts with a *base* tipset — the head of
+    the previous instance's chain — and only the suffix is newly finalized.
+    For each cert after the first, the base must BE the previous head:
+    same epoch, same key, same power table. Any deviation (different key or
+    power table at the same epoch = fork; different epoch = a chain that
+    does not descend from the finalized head) is rejected. A chain of just
+    the repeated base is a valid *stall* certificate — an instance that
+    decided the base with no EC progress — and finalizes nothing new.
     """
 
     certificates: list[FinalityCertificate] = field(default_factory=list)
@@ -224,7 +234,7 @@ class FinalityCertificateChain:
     ) -> Optional[list[PowerTableEntry]]:
         table = list(initial_power_table) if initial_power_table is not None else None
         prev_instance: Optional[int] = None
-        prev_epoch: Optional[int] = None
+        prev_head: Optional[ECTipSet] = None
         for cert in self.certificates:
             if not cert.ec_chain:
                 raise ValueError(f"certificate {cert.instance} has an empty EC chain")
@@ -237,14 +247,21 @@ class FinalityCertificateChain:
                 raise ValueError(
                     f"certificate {cert.instance} EC chain epochs not strictly increasing"
                 )
-            if prev_epoch is not None and epochs[0] <= prev_epoch:
-                raise ValueError(
-                    f"certificate {cert.instance} starts at epoch {epochs[0]} "
-                    f"<= previous cert's head {prev_epoch}"
-                )
+            if prev_head is not None:
+                base = cert.ec_chain[0]
+                if (
+                    base.epoch != prev_head.epoch
+                    or list(base.key) != list(prev_head.key)
+                    or base.power_table != prev_head.power_table
+                ):
+                    raise ValueError(
+                        f"certificate {cert.instance} base tipset (epoch "
+                        f"{base.epoch}) must equal the previous cert's head "
+                        f"(epoch {prev_head.epoch}) — forked or gapped chain"
+                    )
             if table is not None:
                 table = apply_power_table_delta(table, cert.power_table_delta)
-            prev_instance, prev_epoch = cert.instance, epochs[-1]
+            prev_instance, prev_head = cert.instance, cert.ec_chain[-1]
         return table
 
     def tipset_at_epoch(self, epoch: int) -> Optional[ECTipSet]:

@@ -364,26 +364,94 @@ class TestTrustPolicies:
                 table, [PowerTableDelta(3, "10", "k3"), PowerTableDelta(3, "-10", "")]
             )
 
-        def cert(instance, epoch, delta=()):
+        def ects(epoch):
+            return ECTipSet(key=[f"c{epoch}"], epoch=epoch, power_table="")
+
+        def cert(instance, epochs, delta=()):
             return FinalityCertificate(
                 instance=instance,
-                ec_chain=[ECTipSet(key=["c"], epoch=epoch, power_table="")],
+                ec_chain=[ects(e) for e in epochs],
                 power_table_delta=list(delta),
             )
 
+        # go-f3 form: cert 2's base repeats cert 1's head (epoch 10)
         chain = FinalityCertificateChain(
-            [cert(1, 10, [PowerTableDelta(3, "10", "k3")]), cert(2, 11)]
+            [cert(1, [10], [PowerTableDelta(3, "10", "k3")]), cert(2, [10, 11])]
         )
         final = chain.validate(table)
         assert [e.participant_id for e in final] == [1, 2, 3]
 
         with pytest.raises(ValueError):  # instance gap
-            FinalityCertificateChain([cert(1, 10), cert(3, 11)]).validate()
-        with pytest.raises(ValueError):  # epoch regression across certs
-            FinalityCertificateChain([cert(1, 10), cert(2, 10)]).validate()
+            FinalityCertificateChain([cert(1, [10]), cert(3, [10, 11])]).validate()
+        with pytest.raises(ValueError):  # missing base: chain gap
+            FinalityCertificateChain([cert(1, [10]), cert(2, [11])]).validate()
         with pytest.raises(ValueError):  # empty EC chain
             FinalityCertificateChain(
                 [FinalityCertificate(instance=1, ec_chain=[])]
+            ).validate()
+
+    def test_f3_chain_repeated_base_continuity(self):
+        # real go-f3/Forest certificates repeat the previous instance's head
+        # tipset as the next certificate's BASE; only the suffix is new
+        from ipc_proofs_tpu.proofs.cert import (
+            ECTipSet,
+            FinalityCertificate,
+            FinalityCertificateChain,
+        )
+
+        def ts(epoch, key, pt="pt"):
+            return ECTipSet(key=list(key), epoch=epoch, power_table=pt)
+
+        def cert(instance, chain):
+            return FinalityCertificate(instance=instance, ec_chain=chain)
+
+        head1 = ts(12, ["b12"])
+        good = FinalityCertificateChain(
+            [
+                cert(1, [ts(10, ["b10"]), ts(11, ["b11"]), head1]),
+                cert(2, [ts(12, ["b12"]), ts(13, ["b13"])]),  # base == head1
+            ]
+        )
+        assert good.validate() is None  # no power table: structural only
+
+        # a stall certificate (instance decided the base, no EC progress)
+        # is valid and carries the head forward
+        stall = FinalityCertificateChain(
+            [
+                cert(1, [head1]),
+                cert(2, [ts(12, ["b12"])]),  # ECChain == [base] only
+                cert(3, [ts(12, ["b12"]), ts(13, ["b13"])]),
+            ]
+        )
+        assert stall.validate() is None
+
+        import pytest
+
+        # same-epoch base with a DIFFERENT key is a fork, not a base
+        with pytest.raises(ValueError, match="must equal the previous"):
+            FinalityCertificateChain(
+                [
+                    cert(1, [head1]),
+                    cert(2, [ts(12, ["forked"]), ts(13, ["b13"])]),
+                ]
+            ).validate()
+        # same-epoch base with a different power table likewise
+        with pytest.raises(ValueError, match="must equal the previous"):
+            FinalityCertificateChain(
+                [
+                    cert(1, [head1]),
+                    cert(2, [ts(12, ["b12"], pt="other"), ts(13, ["b13"])]),
+                ]
+            ).validate()
+        # skipping the base entirely (epoch gap) cannot descend from the head
+        with pytest.raises(ValueError, match="must equal the previous"):
+            FinalityCertificateChain(
+                [cert(1, [head1]), cert(2, [ts(13, ["b13"]), ts(14, ["b14"])])]
+            ).validate()
+        # starting BEFORE the previous head is always a regression
+        with pytest.raises(ValueError, match="must equal the previous"):
+            FinalityCertificateChain(
+                [cert(1, [head1]), cert(2, [ts(11, ["b11"]), ts(13, ["b13"])])]
             ).validate()
 
     def test_event_filter_rejects_other_events(self):

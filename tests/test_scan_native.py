@@ -180,6 +180,32 @@ class TestForgedInputs:
         with pytest.raises(ValueError, match="too deep"):
             scan_events_flat(bs, [root])
 
+    def test_forged_u64_height_must_not_wrap(self):
+        # height 2^32 would truncate to 0 through a naive (int) cast and
+        # walk the node as a leaf; the raw u64 must be range-checked first
+        from ipc_proofs_tpu.store.blockstore import put_cbor
+
+        bs = MemoryBlockstore()
+        node = [b"\x01", [], [1]]
+        root = put_cbor(bs, [2**32, 1, node])
+        with pytest.raises(ValueError, match="invalid AMT height"):
+            scan_events_flat(bs, [root])
+
+    def test_forged_u64_bit_width_must_not_wrap(self):
+        # v3 events root with bit_width 2^32+3: wraps to 3 through a naive
+        # (int) cast; must be rejected on the raw u64 instead. Reached via a
+        # valid v0 receipts AMT whose single receipt links the forged root.
+        from ipc_proofs_tpu.store.blockstore import put_cbor
+
+        bs = MemoryBlockstore()
+        ev_node = [b"\x01", [], [[1, []]]]
+        forged_events = put_cbor(bs, [2**32 + 3, 0, 1, ev_node])
+        receipt = [0, b"", 0, forged_events]
+        rcpt_node = [b"\x01", [], [receipt]]
+        receipts_root = put_cbor(bs, [0, 1, rcpt_node])
+        with pytest.raises(ValueError, match="invalid AMT bit width"):
+            scan_events_flat(bs, [receipts_root])
+
     def test_deep_but_valid_python_amt_still_errors_consistently(self):
         # the Python reader tolerates any height; the native scanner bounds
         # it — build a legitimate shallow AMT and confirm both agree first
