@@ -130,7 +130,10 @@ def _build_cpython_ext(src: Path, so: Path, mod_name: str):
     if not (so.exists() and so.stat().st_mtime >= src.stat().st_mtime):
         include = sysconfig.get_paths()["include"]
         subprocess.run(
-            ["gcc", "-O2", "-shared", "-fPIC", f"-I{include}", str(src), "-o", str(so)],
+            [
+                "gcc", "-O3", "-shared", "-fPIC", "-pthread",
+                f"-I{include}", str(src), "-o", str(so),
+            ],
             check=True,
             capture_output=True,
             timeout=120,

@@ -18,8 +18,11 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <pthread.h>
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 /* ---------------- CBOR primitives (DAG-CBOR subset) ---------------- */
 
@@ -29,9 +32,63 @@ typedef struct {
   Py_ssize_t pos;
 } Parser;
 
+/* ---------------- error channel ----------------
+ *
+ * The walk path must be callable WITHOUT the GIL (the parallel scan fans
+ * roots out over pthreads), so parse/walk errors are recorded in a
+ * thread-local slot instead of the Python error indicator; API boundaries
+ * convert via raise_walk_err() with the GIL held.  A live Python exception
+ * (e.g. raised by a fallback callable) always takes precedence. */
+
+enum { E_NONE = 0, E_VALUE, E_KEY, E_TYPE, E_OVERFLOW, E_MEM };
+
+typedef struct {
+  int kind;
+  char msg[120];
+} WalkErr;
+
+static _Thread_local WalkErr t_err;
+
+static int walk_err(int kind, const char *msg) {
+  if (t_err.kind == E_NONE) {
+    t_err.kind = kind;
+    strncpy(t_err.msg, msg, sizeof(t_err.msg) - 1);
+    t_err.msg[sizeof(t_err.msg) - 1] = 0;
+  }
+  return -1;
+}
+
+static void raise_err(const WalkErr *err) {
+  if (PyErr_Occurred()) return;
+  switch (err->kind) {
+    case E_KEY: PyErr_SetString(PyExc_KeyError, err->msg); return;
+    case E_TYPE: PyErr_SetString(PyExc_TypeError, err->msg); return;
+    case E_OVERFLOW: PyErr_SetString(PyExc_OverflowError, err->msg); return;
+    case E_MEM: PyErr_NoMemory(); return;
+    case E_VALUE: PyErr_SetString(PyExc_ValueError, err->msg); return;
+    default: PyErr_SetString(PyExc_RuntimeError, "native scan failed"); return;
+  }
+}
+
+static void raise_walk_err(void) { raise_err(&t_err); }
+
+/* is the pending failure the per-group-degradable kind (scalar parity:
+ * caught KeyError/ValueError)?  Checks the real indicator first. */
+static int walk_err_degradable(void) {
+  if (PyErr_Occurred())
+    return PyErr_ExceptionMatches(PyExc_KeyError) ||
+           PyErr_ExceptionMatches(PyExc_ValueError);
+  return t_err.kind == E_VALUE || t_err.kind == E_KEY || t_err.kind == E_NONE;
+}
+
+static void walk_err_clear(void) {
+  t_err.kind = E_NONE;
+  if (PyErr_Occurred()) PyErr_Clear();
+}
+
 static int rd_head(Parser *p, int *major, uint64_t *value) {
   if (p->pos >= p->len) {
-    PyErr_SetString(PyExc_ValueError, "truncated CBOR head");
+    walk_err(E_VALUE, "truncated CBOR head");
     return -1;
   }
   uint8_t byte = p->data[p->pos++];
@@ -48,11 +105,11 @@ static int rd_head(Parser *p, int *major, uint64_t *value) {
     case 26: extra = 4; break;
     case 27: extra = 8; break;
     default:
-      PyErr_SetString(PyExc_ValueError, "indefinite CBOR length in DAG-CBOR");
+      walk_err(E_VALUE, "indefinite CBOR length in DAG-CBOR");
       return -1;
   }
   if (p->pos + extra > p->len) {
-    PyErr_SetString(PyExc_ValueError, "truncated CBOR head");
+    walk_err(E_VALUE, "truncated CBOR head");
     return -1;
   }
   uint64_t v = 0;
@@ -73,7 +130,7 @@ static int skip_item(Parser *p) {
     case 2:
     case 3:
       if (p->pos + (Py_ssize_t)value > p->len) {
-        PyErr_SetString(PyExc_ValueError, "truncated CBOR bytes/text");
+        walk_err(E_VALUE, "truncated CBOR bytes/text");
         return -1;
       }
       p->pos += (Py_ssize_t)value;
@@ -93,7 +150,7 @@ static int skip_item(Parser *p) {
     case 7:
       return 0;
   }
-  PyErr_SetString(PyExc_ValueError, "unreachable CBOR major");
+  walk_err(E_VALUE, "unreachable CBOR major");
   return -1;
 }
 
@@ -102,7 +159,7 @@ static int rd_array(Parser *p, uint64_t *n) {
   int major;
   if (rd_head(p, &major, n) < 0) return -1;
   if (major != 4) {
-    PyErr_SetString(PyExc_ValueError, "expected CBOR array");
+    walk_err(E_VALUE, "expected CBOR array");
     return -1;
   }
   return 0;
@@ -114,7 +171,7 @@ static int rd_bytes(Parser *p, const uint8_t **ptr, Py_ssize_t *blen) {
   uint64_t value;
   if (rd_head(p, &major, &value) < 0) return -1;
   if (major != 2 || p->pos + (Py_ssize_t)value > p->len) {
-    PyErr_SetString(PyExc_ValueError, "expected CBOR bytes");
+    walk_err(E_VALUE, "expected CBOR bytes");
     return -1;
   }
   *ptr = p->data + p->pos;
@@ -128,7 +185,7 @@ static int rd_uint(Parser *p, uint64_t *value) {
   int major;
   if (rd_head(p, &major, value) < 0) return -1;
   if (major != 0) {
-    PyErr_SetString(PyExc_ValueError, "expected CBOR uint");
+    walk_err(E_VALUE, "expected CBOR uint");
     return -1;
   }
   return 0;
@@ -146,14 +203,14 @@ static int rd_cid_or_null(Parser *p, const uint8_t **ptr, Py_ssize_t *clen, int 
     return 0;
   }
   if (major != 6 || value != 42) {
-    PyErr_SetString(PyExc_ValueError, "expected CID or null");
+    walk_err(E_VALUE, "expected CID or null");
     return -1;
   }
   const uint8_t *raw;
   Py_ssize_t rlen;
   if (rd_bytes(p, &raw, &rlen) < 0) return -1;
   if (rlen < 2 || raw[0] != 0) {
-    PyErr_SetString(PyExc_ValueError, "tag-42 must hold identity-multibase CID");
+    walk_err(E_VALUE, "tag-42 must hold identity-multibase CID");
     return -1;
   }
   *ptr = raw + 1;
@@ -169,25 +226,27 @@ typedef struct {
   size_t len, cap;
 } Vec;
 
+/* plain malloc/realloc: vec operations must be legal without the GIL */
+static int vec_reserve(Vec *v, size_t need) {
+  if (need <= v->cap) return 0;
+  size_t cap = v->cap ? v->cap * 2 : 4096;
+  while (cap < need) cap *= 2;
+  uint8_t *nb = realloc(v->buf, cap);
+  if (!nb) return walk_err(E_MEM, "out of memory");
+  v->buf = nb;
+  v->cap = cap;
+  return 0;
+}
+
 static int vec_push(Vec *v, const void *src, size_t n) {
-  if (v->len + n > v->cap) {
-    size_t cap = v->cap ? v->cap * 2 : 4096;
-    while (cap < v->len + n) cap *= 2;
-    uint8_t *nb = PyMem_Realloc(v->buf, cap);
-    if (!nb) {
-      PyErr_NoMemory();
-      return -1;
-    }
-    v->buf = nb;
-    v->cap = cap;
-  }
+  if (vec_reserve(v, v->len + n) < 0) return -1;
   memcpy(v->buf + v->len, src, n);
   v->len += n;
   return 0;
 }
 
 static void vec_free(Vec *v) {
-  PyMem_Free(v->buf);
+  free(v->buf);
   v->buf = NULL;
 }
 
@@ -208,9 +267,11 @@ typedef struct {
   Vec data_off;   /* u32 per event: start offset into data_pool */
   Vec data_len;   /* u32 per event */
   int64_t n_events;
+  int64_t ev_cap;     /* row capacity of the fixed-width event columns */
   int64_t n_receipts; /* receipts with an events root, across all pairs */
   PyObject *blocks;   /* borrowed: dict {cid_bytes: block_bytes} */
   PyObject *fallback; /* borrowed: callable(cid_bytes)->bytes|None, or NULL */
+  const struct CMap *cmap; /* optional GIL-free snapshot of `blocks` */
   int skip_missing;   /* 1 = prune subtrees whose blocks are absent */
   int want_payload;   /* 1 = fill the payload pools */
   /* optional touched-block recording (the exec-order walker's witness leg):
@@ -223,16 +284,104 @@ typedef struct {
 /* offset vectors are int32/uint32; reject pools that would wrap rather than
  * silently corrupting slices (plausible at pod-scale ranges). */
 static int pool_off_ok(size_t len, size_t max) {
-  if (len > max) {
-    PyErr_SetString(PyExc_OverflowError,
-                    "pooled bytes exceed offset range (>2 GiB pool)");
-    return -1;
+  if (len > max)
+    return walk_err(E_OVERFLOW, "pooled bytes exceed offset range (>2 GiB pool)");
+  return 0;
+}
+
+/* ---------------- GIL-free block map snapshot ----------------
+ *
+ * The parallel scan threads cannot touch the Python dict; cmap_build
+ * snapshots it (borrowed pointers into live bytes objects — the caller
+ * keeps the dict alive for the call's duration) into an open-addressing
+ * table that cmap_get probes without the GIL. */
+
+typedef struct {
+  const uint8_t *key;
+  Py_ssize_t klen;
+  const uint8_t *val;
+  Py_ssize_t vlen; /* -2 = value is not bytes (lazily errors, dict parity) */
+} CEntry;
+
+typedef struct CMap {
+  CEntry *slots;
+  size_t mask; /* capacity - 1, capacity a power of two */
+} CMap;
+
+static uint64_t cmap_hash(const uint8_t *d, Py_ssize_t n) {
+  /* CID keys END in a cryptographic digest — the last 8 bytes are already
+   * uniformly distributed, so one unaligned load beats hashing all 38 */
+  if (n >= 8) {
+    uint64_t h;
+    memcpy(&h, d + n - 8, 8);
+    return h * 0x9E3779B97F4A7C15ULL;
+  }
+  uint64_t h = 1469598103934665603ULL;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    h ^= d[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+static int cmap_build(CMap *m, PyObject *dict) {
+  Py_ssize_t n = PyDict_Size(dict);
+  size_t cap = 16;
+  while (cap < (size_t)n * 2 + 1) cap <<= 1;
+  m->slots = calloc(cap, sizeof(CEntry));
+  if (!m->slots) return walk_err(E_MEM, "out of memory");
+  m->mask = cap - 1;
+  PyObject *k, *v;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(dict, &pos, &k, &v)) {
+    /* non-bytes keys can never equal a CID-bytes lookup — skip */
+    if (!PyBytes_Check(k)) continue;
+    CEntry e;
+    e.key = (const uint8_t *)PyBytes_AS_STRING(k);
+    e.klen = PyBytes_GET_SIZE(k);
+    if (PyBytes_Check(v)) {
+      e.val = (const uint8_t *)PyBytes_AS_STRING(v);
+      e.vlen = PyBytes_GET_SIZE(v);
+    } else {
+      e.val = NULL;
+      e.vlen = -2;
+    }
+    size_t i = cmap_hash(e.key, e.klen) & m->mask;
+    while (m->slots[i].key) i = (i + 1) & m->mask;
+    m->slots[i] = e;
   }
   return 0;
 }
 
-/* fetch a block: 1 = ok (*out new ref), 0 = missing + skip_missing (prune),
- * -1 = error (exception set). */
+static void cmap_free(CMap *m) {
+  free(m->slots);
+  m->slots = NULL;
+}
+
+static const CEntry *cmap_get(const CMap *m, const uint8_t *key,
+                              Py_ssize_t klen) {
+  size_t i = cmap_hash(key, klen) & m->mask;
+  while (m->slots[i].key) {
+    if (m->slots[i].klen == klen && memcmp(m->slots[i].key, key, klen) == 0)
+      return &m->slots[i];
+    i = (i + 1) & m->mask;
+  }
+  return NULL;
+}
+
+/* a fetched block: data/len always valid on success; obj non-NULL iff a
+ * reference is held (dict/fallback path) and must be block_release()d */
+typedef struct {
+  PyObject *obj;
+  const uint8_t *data;
+  Py_ssize_t len;
+} BlockRef;
+
+static void block_release(BlockRef *b) {
+  Py_XDECREF(b->obj);
+  b->obj = NULL;
+}
+
 static int record_touch(Scan *s, const uint8_t *cid, Py_ssize_t clen) {
   if (!s->touch_pool) return 0;
   if (pool_off_ok(s->touch_pool->len, INT32_MAX) < 0) return -1;
@@ -243,9 +392,23 @@ static int record_touch(Scan *s, const uint8_t *cid, Py_ssize_t clen) {
   return vec_push(s->touch_pool, cid, (size_t)clen);
 }
 
+/* fetch a block: 1 = ok, 0 = missing + skip_missing (prune), -1 = error. */
 static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
-                     PyObject **out) {
+                     BlockRef *out) {
+  out->obj = NULL;
   if (record_touch(s, cid, clen) < 0) return -1;
+  if (s->cmap) { /* GIL-free path */
+    const CEntry *e = cmap_get(s->cmap, cid, clen);
+    if (!e) {
+      if (s->skip_missing) return 0;
+      return walk_err(E_KEY, "missing block");
+    }
+    if (e->vlen == -2)
+      return walk_err(E_TYPE, "block map values must be bytes");
+    out->data = e->val;
+    out->len = e->vlen;
+    return 1;
+  }
   PyObject *key = PyBytes_FromStringAndSize((const char *)cid, clen);
   if (!key) return -1;
   PyObject *hit = PyDict_GetItemWithError(s->blocks, key);
@@ -254,10 +417,11 @@ static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     Py_DECREF(key);
     if (!PyBytes_Check(hit)) {
       Py_DECREF(hit);
-      PyErr_SetString(PyExc_TypeError, "block map values must be bytes");
-      return -1;
+      return walk_err(E_TYPE, "block map values must be bytes");
     }
-    *out = hit;
+    out->obj = hit;
+    out->data = (const uint8_t *)PyBytes_AS_STRING(hit);
+    out->len = PyBytes_GET_SIZE(hit);
     return 1;
   }
   if (PyErr_Occurred()) {
@@ -271,21 +435,20 @@ static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     if (res == Py_None) {
       Py_DECREF(res);
       if (s->skip_missing) return 0;
-      PyErr_SetString(PyExc_KeyError, "missing block");
-      return -1;
+      return walk_err(E_KEY, "missing block");
     }
     if (!PyBytes_Check(res)) {
       Py_DECREF(res);
-      PyErr_SetString(PyExc_TypeError, "fallback get must return bytes");
-      return -1;
+      return walk_err(E_TYPE, "fallback get must return bytes");
     }
-    *out = res;
+    out->obj = res;
+    out->data = (const uint8_t *)PyBytes_AS_STRING(res);
+    out->len = PyBytes_GET_SIZE(res);
     return 1;
   }
   Py_DECREF(key);
   if (s->skip_missing) return 0;
-  PyErr_SetString(PyExc_KeyError, "missing block");
-  return -1;
+  return walk_err(E_KEY, "missing block");
 }
 
 /* ---------------- EVM log extraction (state/events.py parity) -------- */
@@ -296,7 +459,7 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
   uint64_t n_fields;
   if (rd_array(p, &n_fields) < 0) return -1;
   if (n_fields != 2) {
-    PyErr_SetString(PyExc_ValueError, "StampedEvent must be a 2-tuple");
+    walk_err(E_VALUE, "StampedEvent must be a 2-tuple");
     return -1;
   }
   uint64_t emitter;
@@ -315,7 +478,7 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
     uint64_t entry_fields;
     if (rd_array(p, &entry_fields) < 0) return -1;
     if (entry_fields != 4) {
-      PyErr_SetString(PyExc_ValueError, "event entry must be a 4-tuple");
+      walk_err(E_VALUE, "event entry must be a 4-tuple");
       return -1;
     }
     if (skip_item(p) < 0) return -1; /* flags */
@@ -323,7 +486,7 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
     uint64_t klen;
     if (rd_head(p, &major, &klen) < 0) return -1;
     if (major != 3 || p->pos + (Py_ssize_t)klen > p->len) {
-      PyErr_SetString(PyExc_ValueError, "event entry key must be text");
+      walk_err(E_VALUE, "event entry key must be text");
       return -1;
     }
     const uint8_t *key = p->data + p->pos;
@@ -377,13 +540,13 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
   }
 
 done:;
+  uint32_t toff = 0, doff = 0, dlen = 0;
   if (s->want_payload) {
     if (pool_off_ok(s->topics_pool.len, UINT32_MAX) < 0 ||
         pool_off_ok(s->data_pool.len, UINT32_MAX) < 0)
       return -1;
-    uint32_t toff = (uint32_t)s->topics_pool.len;
-    uint32_t doff = (uint32_t)s->data_pool.len;
-    uint32_t dlen = 0;
+    toff = (uint32_t)s->topics_pool.len;
+    doff = (uint32_t)s->data_pool.len;
     if (valid) {
       if (case_a) {
         if (vec_push(&s->topics_pool, topics_ptr, (size_t)topics_len) < 0)
@@ -403,26 +566,62 @@ done:;
         }
       }
     }
-    if (vec_push(&s->topics_off, &toff, 4) < 0) return -1;
-    if (vec_push(&s->data_off, &doff, 4) < 0) return -1;
-    if (vec_push(&s->data_len, &dlen, 4) < 0) return -1;
   }
-  /* FNV-1a of the zero-padded 2x32B topic words — must match
-   * scan_native.topic_fingerprint exactly */
-  uint64_t fp = 1469598103934665603ULL;
-  for (int k = 0; k < 64; k++) {
-    fp ^= topic_words[k];
-    fp *= 1099511628211ULL;
+  /* word-wise 64-bit mix of the zero-padded 2x32B topic words — must match
+   * scan_native.topic_fingerprint exactly (8 u64 LE rounds; a byte-serial
+   * FNV's multiply chain dominated the per-event cost) */
+  uint64_t fp = 0x9E3779B97F4A7C15ULL;
+  for (int k = 0; k < 8; k++) {
+    uint64_t w;
+    memcpy(&w, topic_words + 8 * k, 8);
+    fp = (fp ^ w) * 0xFF51AFD7ED558CCDULL;
+    fp ^= fp >> 29;
   }
-  int32_t ids[3] = {pair_id, rcpt_idx, ev_idx};
-  if (vec_push(&s->topics, topic_words, 64) < 0) return -1;
-  if (vec_push(&s->fp, &fp, 8) < 0) return -1;
-  if (vec_push(&s->n_topics, &n_topics, 4) < 0) return -1;
-  if (vec_push(&s->emitters, &emitter, 8) < 0) return -1;
-  if (vec_push(&s->valid, &valid, 1) < 0) return -1;
-  if (vec_push(&s->pair_ids, &ids[0], 4) < 0) return -1;
-  if (vec_push(&s->exec_idx, &ids[1], 4) < 0) return -1;
-  if (vec_push(&s->event_idx, &ids[2], 4) < 0) return -1;
+  /* fused row write: ONE capacity check per event instead of 8-11 pushes
+   * (the scan emits hundreds of thousands of rows per range) */
+  if (s->n_events == s->ev_cap) {
+    size_t rows = s->ev_cap ? (size_t)s->ev_cap * 2 : 1024;
+    if (vec_reserve(&s->topics, rows * 64) < 0 ||
+        vec_reserve(&s->fp, rows * 8) < 0 ||
+        vec_reserve(&s->n_topics, rows * 4) < 0 ||
+        vec_reserve(&s->emitters, rows * 8) < 0 ||
+        vec_reserve(&s->valid, rows) < 0 ||
+        vec_reserve(&s->pair_ids, rows * 4) < 0 ||
+        vec_reserve(&s->exec_idx, rows * 4) < 0 ||
+        vec_reserve(&s->event_idx, rows * 4) < 0)
+      return -1;
+    if (s->want_payload &&
+        (vec_reserve(&s->topics_off, rows * 4) < 0 ||
+         vec_reserve(&s->data_off, rows * 4) < 0 ||
+         vec_reserve(&s->data_len, rows * 4) < 0))
+      return -1;
+    s->ev_cap = (int64_t)rows;
+  }
+  size_t n = (size_t)s->n_events;
+  memcpy(s->topics.buf + n * 64, topic_words, 64);
+  ((uint64_t *)s->fp.buf)[n] = fp;
+  ((int32_t *)s->n_topics.buf)[n] = n_topics;
+  ((uint64_t *)s->emitters.buf)[n] = emitter;
+  s->valid.buf[n] = valid;
+  ((int32_t *)s->pair_ids.buf)[n] = pair_id;
+  ((int32_t *)s->exec_idx.buf)[n] = rcpt_idx;
+  ((int32_t *)s->event_idx.buf)[n] = ev_idx;
+  s->topics.len = (n + 1) * 64;
+  s->fp.len = (n + 1) * 8;
+  s->n_topics.len = (n + 1) * 4;
+  s->emitters.len = (n + 1) * 8;
+  s->valid.len = n + 1;
+  s->pair_ids.len = (n + 1) * 4;
+  s->exec_idx.len = (n + 1) * 4;
+  s->event_idx.len = (n + 1) * 4;
+  if (s->want_payload) {
+    ((uint32_t *)s->topics_off.buf)[n] = toff;
+    ((uint32_t *)s->data_off.buf)[n] = doff;
+    ((uint32_t *)s->data_len.buf)[n] = dlen;
+    s->topics_off.len = (n + 1) * 4;
+    s->data_off.len = (n + 1) * 4;
+    s->data_len.len = (n + 1) * 4;
+  }
   s->n_events++;
   return 0;
 }
@@ -434,7 +633,7 @@ typedef int (*leaf_fn)(Scan *s, Parser *p, int64_t index, void *ctx);
 static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
                      Parser *inline_node, int bit_width, int height,
                      int64_t base, leaf_fn fn, void *ctx) {
-  PyObject *block = NULL;
+  BlockRef block = {0};
   Parser local;
   Parser *p;
   if (inline_node) {
@@ -443,8 +642,8 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     int st = get_block(s, cid, clen, &block);
     if (st < 0) return -1;
     if (st == 0) return 0; /* pruned: block absent under skip_missing */
-    local.data = (const uint8_t *)PyBytes_AS_STRING(block);
-    local.len = PyBytes_GET_SIZE(block);
+    local.data = block.data;
+    local.len = block.len;
     local.pos = 0;
     p = &local;
   }
@@ -452,8 +651,9 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
   int rc = -1;
   uint64_t parts;
   if (rd_array(p, &parts) < 0 || parts != 3) {
-    if (!PyErr_Occurred())
-      PyErr_SetString(PyExc_ValueError, "malformed AMT node");
+    /* walk_err keeps the first error; NEVER touch PyErr here — this runs
+     * on GIL-free worker threads with no Python thread state */
+    walk_err(E_VALUE, "malformed AMT node");
     goto out;
   }
   const uint8_t *bmap;
@@ -462,7 +662,7 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
 
   int width = 1 << bit_width;
   if (bmap_len * 8 < width) {
-    PyErr_SetString(PyExc_ValueError, "AMT bitmap too short");
+    walk_err(E_VALUE, "AMT bitmap too short");
     goto out;
   }
 
@@ -470,7 +670,7 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
   uint64_t n_links;
   if (rd_array(p, &n_links) < 0) goto out;
   if (n_links > (uint64_t)width) {
-    PyErr_SetString(PyExc_ValueError, "too many AMT links");
+    walk_err(E_VALUE, "too many AMT links");
     goto out;
   }
   const uint8_t *link_ptr[256];
@@ -479,7 +679,7 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     int ok;
     if (rd_cid_or_null(p, &link_ptr[i], &link_len[i], &ok) < 0) goto out;
     if (!ok) {
-      PyErr_SetString(PyExc_ValueError, "null AMT link");
+      walk_err(E_VALUE, "null AMT link");
       goto out;
     }
   }
@@ -497,14 +697,14 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     if (!((bmap[slot >> 3] >> (slot & 7)) & 1)) continue;
     if (height == 0) {
       if ((uint64_t)pos >= n_values) {
-        PyErr_SetString(PyExc_ValueError, "AMT leaf bitmap/values mismatch");
+        walk_err(E_VALUE, "AMT leaf bitmap/values mismatch");
         goto out;
       }
       if (fn(s, p, base + slot, ctx) < 0) goto out;
       used_values++;
     } else {
       if ((uint64_t)pos >= n_links) {
-        PyErr_SetString(PyExc_ValueError, "AMT node bitmap/links mismatch");
+        walk_err(E_VALUE, "AMT node bitmap/links mismatch");
         goto out;
       }
       if (walk_node(s, link_ptr[pos], link_len[pos], NULL, bit_width,
@@ -514,71 +714,164 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     pos++;
   }
   if (height == 0 && used_values != n_values) {
-    PyErr_SetString(PyExc_ValueError, "AMT leaf value count mismatch");
+    walk_err(E_VALUE, "AMT leaf value count mismatch");
     goto out;
   }
   rc = 0;
 out:
-  Py_XDECREF(block);
+  block_release(&block);
   return rc;
+}
+
+/* Parse an AMT root body: [h,c,node] (v0, bw=3) or [bw,h,c,node] (v3).
+ * Leaves the parser positioned at the inline node. */
+static int parse_amt_root(Parser *p, int expected_version, int *bit_width_out,
+                          int *height_out) {
+  uint64_t arity;
+  if (rd_array(p, &arity) < 0) return -1;
+  int bit_width, height;
+  uint64_t tmp;
+  if (arity == 4) {
+    if (expected_version != 3) {
+      walk_err(E_VALUE, "expected AMT v0, found v3");
+      return -1;
+    }
+    if (rd_uint(p, &tmp) < 0) return -1;
+    /* range-check the raw u64 BEFORE narrowing: a forged bit-width of
+     * e.g. 2^32+3 must not wrap into the valid range. */
+    if (tmp < 1 || tmp > 8) {
+      walk_err(E_VALUE, "invalid AMT bit width");
+      return -1;
+    }
+    bit_width = (int)tmp;
+  } else if (arity == 3) {
+    if (expected_version != 0) {
+      walk_err(E_VALUE, "expected AMT v3, found v0");
+      return -1;
+    }
+    bit_width = 3;
+  } else {
+    walk_err(E_VALUE, "unrecognized AMT root arity");
+    return -1;
+  }
+  if (rd_uint(p, &tmp) < 0) return -1; /* height */
+  /* range-check the raw u64 BEFORE narrowing: a forged height of 2^32
+   * would truncate to 0 and walk as a leaf (amt.py raises here too). */
+  if (tmp > 64) {
+    walk_err(E_VALUE, "invalid AMT height");
+    return -1;
+  }
+  height = (int)tmp;
+  /* span = width^height and every index stay below 2^62: forged roots with
+   * huge heights must fail cleanly, not overflow int64 (UB). */
+  if ((int64_t)bit_width * (height + 1) > 62) {
+    walk_err(E_VALUE, "AMT too deep for native scanner");
+    return -1;
+  }
+  if (rd_uint(p, &tmp) < 0) return -1; /* count (unused) */
+  *bit_width_out = bit_width;
+  *height_out = height;
+  return 0;
 }
 
 /* Walk an AMT root block.  expected_version: 0 (root [h,c,node], bw=3) or
  * 3 (root [bw,h,c,node]). */
 static int walk_amt_root(Scan *s, const uint8_t *cid, Py_ssize_t clen,
                          int expected_version, leaf_fn fn, void *ctx) {
-  PyObject *block = NULL;
+  BlockRef block = {0};
   int st = get_block(s, cid, clen, &block);
   if (st < 0) return -1;
   if (st == 0) return 0; /* pruned root */
-  Parser p = {(const uint8_t *)PyBytes_AS_STRING(block),
-              PyBytes_GET_SIZE(block), 0};
+  Parser p = {block.data, block.len, 0};
   int rc = -1;
-  uint64_t arity;
-  if (rd_array(&p, &arity) < 0) goto out;
   int bit_width, height;
-  uint64_t tmp;
-  if (arity == 4) {
-    if (expected_version != 3) {
-      PyErr_SetString(PyExc_ValueError, "expected AMT v0, found v3");
-      goto out;
-    }
-    if (rd_uint(&p, &tmp) < 0) goto out;
-    /* range-check the raw u64 BEFORE narrowing: a forged bit-width of
-     * e.g. 2^32+3 must not wrap into the valid range. */
-    if (tmp < 1 || tmp > 8) {
-      PyErr_SetString(PyExc_ValueError, "invalid AMT bit width");
-      goto out;
-    }
-    bit_width = (int)tmp;
-  } else if (arity == 3) {
-    if (expected_version != 0) {
-      PyErr_SetString(PyExc_ValueError, "expected AMT v3, found v0");
-      goto out;
-    }
-    bit_width = 3;
-  } else {
-    PyErr_SetString(PyExc_ValueError, "unrecognized AMT root arity");
-    goto out;
-  }
-  if (rd_uint(&p, &tmp) < 0) goto out; /* height */
-  /* range-check the raw u64 BEFORE narrowing: a forged height of 2^32
-   * would truncate to 0 and walk as a leaf (amt.py raises here too). */
-  if (tmp > 64) {
-    PyErr_SetString(PyExc_ValueError, "invalid AMT height");
-    goto out;
-  }
-  height = (int)tmp;
-  /* span = width^height and every index stay below 2^62: forged roots with
-   * huge heights must fail cleanly, not overflow int64 (UB). */
-  if ((int64_t)bit_width * (height + 1) > 62) {
-    PyErr_SetString(PyExc_ValueError, "AMT too deep for native scanner");
-    goto out;
-  }
-  if (rd_uint(&p, &tmp) < 0) goto out; /* count (unused) */
+  if (parse_amt_root(&p, expected_version, &bit_width, &height) < 0) goto out;
   rc = walk_node(s, NULL, 0, &p, bit_width, height, 0, fn, ctx);
 out:
-  Py_DECREF(block);
+  block_release(&block);
+  return rc;
+}
+
+/* Targeted AMT get: walk exactly one root-to-leaf path for ``index``
+ * (ipld/amt.py AMT.get parity, incl. partial-path touches when the index
+ * turns out absent).  ``node`` must be positioned at the root's inline
+ * node.  Invokes fn at the value when present. */
+static int amt_get_path(Scan *s, Parser node, int bit_width, int height,
+                        int64_t index, leaf_fn fn, void *ctx) {
+  int width = 1 << bit_width;
+  if (index < 0) {
+    walk_err(E_VALUE, "negative AMT index");
+    return -1;
+  }
+  /* index >= width^(height+1) -> absent (parse_amt_root bounded the span) */
+  if (index >> ((int64_t)bit_width * (height + 1)) != 0) return 0;
+
+  BlockRef block = {0}; /* current non-root node's block, if any */
+  int rc = -1;
+  for (int h = height; h >= 0; h--) {
+    uint64_t parts;
+    if (rd_array(&node, &parts) < 0 || parts != 3) {
+      walk_err(E_VALUE, "malformed AMT node");
+      goto out;
+    }
+    const uint8_t *bmap;
+    Py_ssize_t bmap_len;
+    if (rd_bytes(&node, &bmap, &bmap_len) < 0) goto out;
+    if (bmap_len * 8 < width) {
+      walk_err(E_VALUE, "AMT bitmap too short");
+      goto out;
+    }
+    int slot = (int)((index >> ((int64_t)bit_width * h)) & (width - 1));
+    if (!((bmap[slot >> 3] >> (slot & 7)) & 1)) {
+      rc = 0; /* absent */
+      goto out;
+    }
+    int pos = 0; /* popcount of set bits below slot */
+    for (int i = 0; i < slot; i++) pos += (bmap[i >> 3] >> (i & 7)) & 1;
+
+    uint64_t n_links;
+    if (rd_array(&node, &n_links) < 0) goto out;
+    if (h > 0) {
+      if ((uint64_t)pos >= n_links) {
+        walk_err(E_VALUE, "AMT node bitmap/links mismatch");
+        goto out;
+      }
+      const uint8_t *child_cid = NULL;
+      Py_ssize_t child_len = 0;
+      for (int i = 0; i <= pos; i++) {
+        int ok;
+        if (rd_cid_or_null(&node, &child_cid, &child_len, &ok) < 0) goto out;
+        if (!ok) {
+          walk_err(E_VALUE, "null AMT link");
+          goto out;
+        }
+      }
+      BlockRef next = {0};
+      int st = get_block(s, child_cid, child_len, &next);
+      if (st < 0) goto out;
+      if (st == 0) { rc = 0; goto out; } /* pruned under skip_missing */
+      block_release(&block);
+      block = next;
+      node.data = block.data;
+      node.len = block.len;
+      node.pos = 0;
+    } else {
+      for (uint64_t i = 0; i < n_links; i++)
+        if (skip_item(&node) < 0) goto out;
+      uint64_t n_values;
+      if (rd_array(&node, &n_values) < 0) goto out;
+      if ((uint64_t)pos >= n_values) {
+        walk_err(E_VALUE, "AMT leaf bitmap/values mismatch");
+        goto out;
+      }
+      for (int i = 0; i < pos; i++)
+        if (skip_item(&node) < 0) goto out;
+      if (fn(s, &node, index, ctx) < 0) goto out;
+      rc = 0;
+    }
+  }
+out:
+  block_release(&block);
   return rc;
 }
 
@@ -593,7 +886,7 @@ typedef struct {
 static int event_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   EvCtx *c = (EvCtx *)ctx;
   if (index > INT32_MAX) {
-    PyErr_SetString(PyExc_ValueError, "event index exceeds int32 range");
+    walk_err(E_VALUE, "event index exceeds int32 range");
     return -1;
   }
   return emit_event(s, p, c->pair_id, c->rcpt_idx, (int32_t)index);
@@ -608,7 +901,7 @@ static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   uint64_t arity;
   if (rd_array(p, &arity) < 0) return -1;
   if (arity != 3 && arity != 4) {
-    PyErr_SetString(PyExc_ValueError, "receipt must be a 3/4-tuple");
+    walk_err(E_VALUE, "receipt must be a 3/4-tuple");
     return -1;
   }
   if (skip_item(p) < 0) return -1; /* exit_code */
@@ -622,7 +915,7 @@ static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   if (!ok) return 0; /* null events root: skip (scan_receipt_events parity) */
 
   if (index > INT32_MAX) {
-    PyErr_SetString(PyExc_ValueError, "receipt index exceeds int32 range");
+    walk_err(E_VALUE, "receipt index exceeds int32 range");
     return -1;
   }
   s->n_receipts++;
@@ -645,6 +938,101 @@ static void scan_free(Scan *s) {
   vec_free(&s->topics_off); vec_free(&s->data_off); vec_free(&s->data_len);
 }
 
+/* scan a contiguous range of roots into one Scan; roots are pre-extracted
+ * (ptr, len) pairs so the worker never touches Python objects */
+typedef struct {
+  Scan s;                 /* thread-private outputs */
+  const uint8_t **cids;   /* all root cid pointers */
+  const Py_ssize_t *lens; /* all root cid lengths */
+  Py_ssize_t lo, hi;      /* this worker's root range */
+  WalkErr err;            /* copied from t_err at thread exit */
+} ScanJob;
+
+static int scan_roots_range(Scan *s, const uint8_t **cids,
+                            const Py_ssize_t *lens, Py_ssize_t lo,
+                            Py_ssize_t hi) {
+  for (Py_ssize_t i = lo; i < hi; i++) {
+    RcptCtx rc = {(int32_t)i};
+    if (walk_amt_root(s, cids[i], lens[i], 0, receipt_leaf, &rc) < 0)
+      return -1;
+  }
+  return 0;
+}
+
+static void *scan_job_run(void *arg) {
+  ScanJob *job = (ScanJob *)arg;
+  t_err.kind = E_NONE;
+  if (scan_roots_range(&job->s, job->cids, job->lens, job->lo, job->hi) < 0)
+    job->err = t_err;
+  return NULL;
+}
+
+/* merge `src` onto the tail of `dst`, rebasing the payload-offset columns
+ * by dst's pool sizes (all other columns are position-independent) */
+static int scan_merge(Scan *dst, Scan *src) {
+  if (src->want_payload && src->n_events) {
+    if (pool_off_ok(dst->topics_pool.len + src->topics_pool.len, UINT32_MAX) < 0 ||
+        pool_off_ok(dst->data_pool.len + src->data_pool.len, UINT32_MAX) < 0)
+      return -1;
+    uint32_t tbase = (uint32_t)dst->topics_pool.len;
+    uint32_t dbase = (uint32_t)dst->data_pool.len;
+    uint32_t *toff = (uint32_t *)src->topics_off.buf;
+    uint32_t *doff = (uint32_t *)src->data_off.buf;
+    for (int64_t i = 0; i < src->n_events; i++) {
+      toff[i] += tbase;
+      doff[i] += dbase;
+    }
+  }
+  if (vec_push(&dst->topics, src->topics.buf, src->topics.len) < 0 ||
+      vec_push(&dst->fp, src->fp.buf, src->fp.len) < 0 ||
+      vec_push(&dst->n_topics, src->n_topics.buf, src->n_topics.len) < 0 ||
+      vec_push(&dst->emitters, src->emitters.buf, src->emitters.len) < 0 ||
+      vec_push(&dst->valid, src->valid.buf, src->valid.len) < 0 ||
+      vec_push(&dst->pair_ids, src->pair_ids.buf, src->pair_ids.len) < 0 ||
+      vec_push(&dst->exec_idx, src->exec_idx.buf, src->exec_idx.len) < 0 ||
+      vec_push(&dst->event_idx, src->event_idx.buf, src->event_idx.len) < 0 ||
+      vec_push(&dst->topics_pool, src->topics_pool.buf, src->topics_pool.len) < 0 ||
+      vec_push(&dst->data_pool, src->data_pool.buf, src->data_pool.len) < 0 ||
+      vec_push(&dst->topics_off, src->topics_off.buf, src->topics_off.len) < 0 ||
+      vec_push(&dst->data_off, src->data_off.buf, src->data_off.len) < 0 ||
+      vec_push(&dst->data_len, src->data_len.buf, src->data_len.len) < 0)
+    return -1;
+  dst->n_events += src->n_events;
+  dst->n_receipts += src->n_receipts;
+  return 0;
+}
+
+static int scan_threads_default(void) {
+  const char *env = getenv("IPC_SCAN_THREADS");
+  if (env && env[0]) {
+    int v = atoi(env);
+    return v < 1 ? 1 : (v > 64 ? 64 : v);
+  }
+  long cores = sysconf(_SC_NPROCESSORS_ONLN);
+  int t = (int)(cores > 0 ? cores : 1);
+  return t > 8 ? 8 : t;
+}
+
+static PyObject *scan_result_dict(Scan *s) {
+  return Py_BuildValue(
+      "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L}",
+      "topics", make_array_bytes(&s->topics),
+      "fp", make_array_bytes(&s->fp),
+      "n_topics", make_array_bytes(&s->n_topics),
+      "emitters", make_array_bytes(&s->emitters),
+      "valid", make_array_bytes(&s->valid),
+      "pair_ids", make_array_bytes(&s->pair_ids),
+      "exec_idx", make_array_bytes(&s->exec_idx),
+      "event_idx", make_array_bytes(&s->event_idx),
+      "topics_pool", make_array_bytes(&s->topics_pool),
+      "data_pool", make_array_bytes(&s->data_pool),
+      "topics_off", make_array_bytes(&s->topics_off),
+      "data_off", make_array_bytes(&s->data_off),
+      "data_len", make_array_bytes(&s->data_len),
+      "n_events", (long long)s->n_events,
+      "n_receipts", (long long)s->n_receipts);
+}
+
 static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *fallback = Py_None;
@@ -658,6 +1046,7 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
   PyObject *seq = PySequence_Fast(roots, "roots must be a sequence of cid bytes");
   if (!seq) return NULL;
 
+  t_err.kind = E_NONE;
   Scan s;
   memset(&s, 0, sizeof(s));
   s.blocks = blocks;
@@ -666,42 +1055,118 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
   s.want_payload = want_payload;
 
   Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(seq);
+  /* pre-extract root cid spans; validates types up front (same TypeError) */
+  const uint8_t **cids = malloc(sizeof(*cids) * (n_roots ? n_roots : 1));
+  Py_ssize_t *lens = malloc(sizeof(*lens) * (n_roots ? n_roots : 1));
+  if (!cids || !lens) {
+    PyErr_NoMemory();
+    goto fail;
+  }
   for (Py_ssize_t i = 0; i < n_roots; i++) {
     PyObject *root = PySequence_Fast_GET_ITEM(seq, i);
     if (!PyBytes_Check(root)) {
       PyErr_SetString(PyExc_TypeError, "roots must be bytes (raw CID bytes)");
       goto fail;
     }
-    RcptCtx rc = {(int32_t)i};
-    if (walk_amt_root(&s, (const uint8_t *)PyBytes_AS_STRING(root),
-                      PyBytes_GET_SIZE(root), 0, receipt_leaf, &rc) < 0)
+    cids[i] = (const uint8_t *)PyBytes_AS_STRING(root);
+    lens[i] = PyBytes_GET_SIZE(root);
+  }
+
+  /* Parallel path: GIL-free walk over a snapshot of the dict, fanned out
+   * over pthreads in contiguous root chunks (chunk concatenation preserves
+   * the sequential emission order exactly).  Only when every block can come
+   * from the dict (no fallback callable). */
+  int threads = scan_threads_default();
+  if ((fallback == NULL || fallback == Py_None) && threads > 1 &&
+      n_roots >= 2 * threads && n_roots >= 64) {
+    CMap cmap = {0};
+    if (cmap_build(&cmap, blocks) < 0) {
+      raise_walk_err();
       goto fail;
+    }
+    if (threads > (int)(n_roots / 32) && n_roots / 32 >= 2)
+      threads = (int)(n_roots / 32);
+    ScanJob *jobs = calloc(threads, sizeof(ScanJob));
+    pthread_t *tids = malloc(sizeof(pthread_t) * threads);
+    if (!jobs || !tids) {
+      free(jobs);
+      free(tids);
+      cmap_free(&cmap);
+      PyErr_NoMemory();
+      goto fail;
+    }
+    Py_ssize_t chunk = (n_roots + threads - 1) / threads;
+    int started = 0;
+    for (int t = 0; t < threads; t++) {
+      /* s's output vecs are still empty here, so a struct copy hands each
+       * worker the config (skip_missing/want_payload) with zeroed outputs */
+      jobs[t].s = s;
+      jobs[t].s.blocks = NULL;
+      jobs[t].s.fallback = NULL;
+      jobs[t].s.cmap = &cmap;
+      jobs[t].cids = cids;
+      jobs[t].lens = lens;
+      jobs[t].lo = t * chunk;
+      jobs[t].hi = (t + 1) * chunk < n_roots ? (t + 1) * chunk : n_roots;
+      if (jobs[t].lo >= jobs[t].hi) break;
+      started++;
+    }
+    int spawn_failed = 0;
+    Py_BEGIN_ALLOW_THREADS;
+    for (int t = 0; t < started; t++)
+      if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
+        /* run inline if a thread can't spawn — correctness over speed */
+        scan_job_run(&jobs[t]);
+        tids[t] = 0;
+        spawn_failed++;
+      }
+    for (int t = 0; t < started; t++)
+      if (tids[t]) pthread_join(tids[t], NULL);
+    Py_END_ALLOW_THREADS;
+    (void)spawn_failed;
+    cmap_free(&cmap);
+
+    /* first error in root order wins (identical to the sequential walk:
+     * earlier roots' output exists, later error aborts everything) */
+    int err_at = -1;
+    for (int t = 0; t < started; t++)
+      if (jobs[t].err.kind != E_NONE && err_at < 0) err_at = t;
+    if (err_at >= 0) {
+      raise_err(&jobs[err_at].err);
+      for (int t = 0; t < started; t++) scan_free(&jobs[t].s);
+      free(jobs);
+      free(tids);
+      goto fail;
+    }
+    int merge_rc = 0;
+    for (int t = 0; t < started && merge_rc == 0; t++)
+      merge_rc = scan_merge(&s, &jobs[t].s);
+    for (int t = 0; t < started; t++) scan_free(&jobs[t].s);
+    free(jobs);
+    free(tids);
+    if (merge_rc < 0) {
+      raise_walk_err();
+      goto fail;
+    }
+  } else {
+    if (scan_roots_range(&s, cids, lens, 0, n_roots) < 0) {
+      raise_walk_err();
+      goto fail;
+    }
   }
 
   {
-    PyObject *result = Py_BuildValue(
-        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L}",
-        "topics", make_array_bytes(&s.topics),
-        "fp", make_array_bytes(&s.fp),
-        "n_topics", make_array_bytes(&s.n_topics),
-        "emitters", make_array_bytes(&s.emitters),
-        "valid", make_array_bytes(&s.valid),
-        "pair_ids", make_array_bytes(&s.pair_ids),
-        "exec_idx", make_array_bytes(&s.exec_idx),
-        "event_idx", make_array_bytes(&s.event_idx),
-        "topics_pool", make_array_bytes(&s.topics_pool),
-        "data_pool", make_array_bytes(&s.data_pool),
-        "topics_off", make_array_bytes(&s.topics_off),
-        "data_off", make_array_bytes(&s.data_off),
-        "data_len", make_array_bytes(&s.data_len),
-        "n_events", (long long)s.n_events,
-        "n_receipts", (long long)s.n_receipts);
+    PyObject *result = scan_result_dict(&s);
+    free(cids);
+    free(lens);
     Py_DECREF(seq);
     scan_free(&s);
     return result;
   }
 
 fail:
+  free(cids);
+  free(lens);
   Py_DECREF(seq);
   scan_free(&s);
   return NULL;
@@ -731,7 +1196,7 @@ static int msg_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   int ok;
   if (rd_cid_or_null(p, &cid, &clen, &ok) < 0) return -1;
   if (!ok) {
-    PyErr_SetString(PyExc_ValueError, "message list AMT must hold CIDs");
+    walk_err(E_VALUE, "message list AMT must hold CIDs");
     return -1;
   }
   if (pool_off_ok(sink->pool->len, INT32_MAX) < 0) return -1;
@@ -786,6 +1251,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
   if (!gseq) return NULL;
   Py_ssize_t n_groups = PySequence_Fast_GET_SIZE(gseq);
 
+  t_err.kind = E_NONE;
   Scan s;
   memset(&s, 0, sizeof(s));
   s.blocks = blocks;
@@ -830,7 +1296,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
       Py_ssize_t in_len = PyBytes_GET_SIZE(cid_obj);
       const uint8_t *tx_cid = in_cid;
       Py_ssize_t tx_clen = in_len;
-      PyObject *header_block = NULL;
+      BlockRef header_block = {0};
       Parser hp;
       if (headers) {
         /* header fetches are NOT part of the touched set (the scalar path
@@ -840,8 +1306,8 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
         int st = get_block(&s, in_cid, in_len, &header_block);
         s.touch_pool = save;
         if (st <= 0) { ok = 0; break; }
-        hp.data = (const uint8_t *)PyBytes_AS_STRING(header_block);
-        hp.len = PyBytes_GET_SIZE(header_block);
+        hp.data = header_block.data;
+        hp.len = header_block.len;
         hp.pos = 0;
         uint64_t arity;
         if (rd_array(&hp, &arity) < 0 || arity != 16) { ok = 0; }
@@ -850,26 +1316,25 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
         int have = 0;
         if (ok && rd_cid_or_null(&hp, &tx_cid, &tx_clen, &have) < 0) ok = 0;
         if (ok && !have) ok = 0; /* messages field must be a CID */
-        if (!ok) { Py_XDECREF(header_block); break; }
+        if (!ok) { block_release(&header_block); break; }
       }
       if (pool_off_ok(tx_pool.len, INT32_MAX) < 0) {
-        Py_XDECREF(header_block);
+        block_release(&header_block);
         Py_DECREF(grp);
         goto out;
       }
       int32_t xoff = (int32_t)tx_pool.len, xlen = (int32_t)tx_clen;
       if (vec_push(&tx_off, &xoff, 4) < 0 || vec_push(&tx_len, &xlen, 4) < 0 ||
           vec_push(&tx_pool, tx_cid, (size_t)tx_clen) < 0) {
-        Py_XDECREF(header_block);
+        block_release(&header_block);
         Py_DECREF(grp);
         goto out;
       }
-      PyObject *tx_block = NULL;
+      BlockRef tx_block = {0};
       int st = get_block(&s, tx_cid, tx_clen, &tx_block);
-      Py_XDECREF(header_block); /* tx_cid may point into it — done with it */
+      block_release(&header_block); /* tx_cid may point into it — done */
       if (st <= 0) { ok = 0; break; }
-      Parser tp = {(const uint8_t *)PyBytes_AS_STRING(tx_block),
-                   PyBytes_GET_SIZE(tx_block), 0};
+      Parser tp = {tx_block.data, tx_block.len, 0};
       uint64_t two;
       const uint8_t *bls, *secp;
       Py_ssize_t bls_len, secp_len;
@@ -878,29 +1343,27 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
           rd_cid_or_null(&tp, &bls, &bls_len, &have_b) < 0 || !have_b ||
           rd_cid_or_null(&tp, &secp, &secp_len, &have_s) < 0 || !have_s ||
           tp.pos != tp.len /* trailing bytes: decode_txmeta rejects these */) {
-        Py_DECREF(tx_block);
+        block_release(&tx_block);
         ok = 0;
         break;
       }
       uint8_t canon = (uint8_t)txmeta_is_canonical(
-          (const uint8_t *)PyBytes_AS_STRING(tx_block),
-          PyBytes_GET_SIZE(tx_block), bls, bls_len, secp, secp_len);
+          tx_block.data, tx_block.len, bls, bls_len, secp, secp_len);
       if (vec_push(&tx_canon, &canon, 1) < 0) {
-        Py_DECREF(tx_block);
+        block_release(&tx_block);
         Py_DECREF(grp);
         goto out;
       }
       if (walk_amt_root(&s, bls, bls_len, 0, msg_leaf, &sink) < 0 ||
           walk_amt_root(&s, secp, secp_len, 0, msg_leaf, &sink) < 0)
         ok = 0;
-      Py_DECREF(tx_block);
+      block_release(&tx_block);
     }
     Py_DECREF(grp);
     uint8_t fail = !ok;
     if (!ok) {
-      if (PyErr_ExceptionMatches(PyExc_KeyError) ||
-          PyErr_ExceptionMatches(PyExc_ValueError) || !PyErr_Occurred()) {
-        PyErr_Clear(); /* per-group degradation, like the scalar caught errors */
+      if (walk_err_degradable()) {
+        walk_err_clear(); /* per-group degradation, like the scalar caught errors */
         msg_pool.len = m_pool0; msg_off.len = m_off0; msg_len.len = m_len0;
         touch_pool.len = t_pool0; touch_off.len = t_off0; touch_len.len = t_len0;
         tx_pool.len = x_pool0; tx_off.len = x_off0; tx_len.len = x_len0;
@@ -921,6 +1384,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
   }
   rc = 0;
 out:;
+  if (rc != 0) raise_walk_err();
   PyObject *result = NULL;
   if (rc == 0) {
     result = Py_BuildValue(
@@ -949,6 +1413,188 @@ out:;
   return result;
 }
 
+/* ---------------- batched pass-2 recorder ----------------
+ *
+ * The remaining Phase-C hot leg: for each matching pair, walk the receipts
+ * AMT path to each matching receipt index and the FULL events AMT beneath
+ * it, recording every touched block CID (the witness) and emitting every
+ * event in payload mode (claim construction becomes a numpy mask + array
+ * slicing in Python — zero Python AMT walks).  Python-side glue:
+ * proofs/scan_native.py record_receipt_paths.  Scalar-parity anchor:
+ * proofs/event_generator.py record_matching_receipts (reference
+ * src/proofs/events/generator.rs:241-301). */
+
+typedef struct {
+  size_t topics, fp, n_topics, emitters, valid, pair_ids, exec_idx, event_idx;
+  size_t topics_pool, data_pool, topics_off, data_off, data_len;
+  int64_t n_events, n_receipts;
+} ScanMark;
+
+static ScanMark scan_mark(const Scan *s) {
+  ScanMark m = {s->topics.len, s->fp.len, s->n_topics.len, s->emitters.len,
+                s->valid.len, s->pair_ids.len, s->exec_idx.len,
+                s->event_idx.len, s->topics_pool.len, s->data_pool.len,
+                s->topics_off.len, s->data_off.len, s->data_len.len,
+                s->n_events, s->n_receipts};
+  return m;
+}
+
+static void scan_rewind(Scan *s, const ScanMark *m) {
+  s->topics.len = m->topics; s->fp.len = m->fp;
+  s->n_topics.len = m->n_topics; s->emitters.len = m->emitters;
+  s->valid.len = m->valid; s->pair_ids.len = m->pair_ids;
+  s->exec_idx.len = m->exec_idx; s->event_idx.len = m->event_idx;
+  s->topics_pool.len = m->topics_pool; s->data_pool.len = m->data_pool;
+  s->topics_off.len = m->topics_off; s->data_off.len = m->data_off;
+  s->data_len.len = m->data_len;
+  s->n_events = m->n_events; s->n_receipts = m->n_receipts;
+}
+
+static PyObject *py_record_receipt_paths(PyObject *self, PyObject *args,
+                                         PyObject *kwargs) {
+  PyObject *blocks, *roots, *wanted, *fallback = Py_None;
+  static char *kwlist[] = {"blocks", "roots", "wanted", "fallback", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OO|O", kwlist,
+                                   &PyDict_Type, &blocks, &roots, &wanted,
+                                   &fallback))
+    return NULL;
+  PyObject *rseq = PySequence_Fast(roots, "roots must be a sequence");
+  if (!rseq) return NULL;
+  PyObject *wseq = PySequence_Fast(wanted, "wanted must be a sequence");
+  if (!wseq) {
+    Py_DECREF(rseq);
+    return NULL;
+  }
+  Py_ssize_t n_groups = PySequence_Fast_GET_SIZE(rseq);
+  if (PySequence_Fast_GET_SIZE(wseq) != n_groups) {
+    Py_DECREF(rseq);
+    Py_DECREF(wseq);
+    PyErr_SetString(PyExc_ValueError, "roots/wanted length mismatch");
+    return NULL;
+  }
+
+  t_err.kind = E_NONE;
+  Scan s;
+  memset(&s, 0, sizeof(s));
+  s.blocks = blocks;
+  s.fallback = (fallback == Py_None) ? NULL : fallback;
+  s.want_payload = 1;
+  Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
+  Vec failed = {0};
+  s.touch_pool = &touch_pool;
+  s.touch_off = &touch_off;
+  s.touch_len = &touch_len;
+
+  int rc = -1;
+  for (Py_ssize_t g = 0; g < n_groups; g++) {
+    ScanMark mark = scan_mark(&s);
+    size_t t_pool0 = touch_pool.len, t_off0 = touch_off.len,
+           t_len0 = touch_len.len;
+    int32_t tcount = (int32_t)(touch_off.len / 4);
+    if (vec_push(&touch_goff, &tcount, 4) < 0) goto out;
+
+    PyObject *root = PySequence_Fast_GET_ITEM(rseq, g);
+    if (!PyBytes_Check(root)) {
+      PyErr_SetString(PyExc_TypeError, "roots must be bytes (raw CID bytes)");
+      goto out;
+    }
+    int ok = 1;
+    BlockRef root_block = {0};
+    /* receipts-AMT root fetched ONCE per group (AMT.load parity) */
+    int st = get_block(&s, (const uint8_t *)PyBytes_AS_STRING(root),
+                       PyBytes_GET_SIZE(root), &root_block);
+    if (st < 0) ok = 0;
+    if (st == 0) { /* only reachable under skip_missing (not used here) */
+      walk_err(E_KEY, "missing receipts root");
+      ok = 0;
+    }
+    Parser rp = {0};
+    int bit_width = 0, height = 0;
+    if (ok) {
+      rp.data = root_block.data;
+      rp.len = root_block.len;
+      rp.pos = 0;
+      if (parse_amt_root(&rp, 0, &bit_width, &height) < 0) ok = 0;
+    }
+    if (ok) {
+      PyObject *wl = PySequence_Fast(PySequence_Fast_GET_ITEM(wseq, g),
+                                     "wanted group must be a sequence");
+      if (!wl) {
+        block_release(&root_block);
+        goto out;
+      }
+      Py_ssize_t n_idx = PySequence_Fast_GET_SIZE(wl);
+      RcptCtx rctx = {(int32_t)g};
+      for (Py_ssize_t k = 0; ok && k < n_idx; k++) {
+        long long idx = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(wl, k));
+        if (idx == -1 && PyErr_Occurred()) {
+          Py_DECREF(wl);
+          block_release(&root_block);
+          goto out; /* non-int wanted index: programming error, propagate */
+        }
+        Parser np = rp; /* re-walk from the root's inline node per index */
+        if (amt_get_path(&s, np, bit_width, height, (int64_t)idx, receipt_leaf,
+                         &rctx) < 0)
+          ok = 0;
+      }
+      Py_DECREF(wl);
+    }
+    block_release(&root_block);
+    uint8_t fail = !ok;
+    if (!ok) {
+      if (walk_err_degradable() && (PyErr_Occurred() || t_err.kind != E_NONE)) {
+        walk_err_clear(); /* per-group degradation: caller redoes it scalar */
+        scan_rewind(&s, &mark);
+        touch_pool.len = t_pool0;
+        touch_off.len = t_off0;
+        touch_len.len = t_len0;
+      } else {
+        goto out; /* TypeError / MemoryError / OverflowError propagate */
+      }
+    }
+    if (vec_push(&failed, &fail, 1) < 0) goto out;
+  }
+  {
+    int32_t tcount = (int32_t)(touch_off.len / 4);
+    if (vec_push(&touch_goff, &tcount, 4) < 0) goto out;
+  }
+  rc = 0;
+out:;
+  if (rc != 0) raise_walk_err();
+  PyObject *result = NULL;
+  if (rc == 0) {
+    result = Py_BuildValue(
+        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L,"
+        "s:N,s:N,s:N,s:N,s:N}",
+        "topics", make_array_bytes(&s.topics),
+        "fp", make_array_bytes(&s.fp),
+        "n_topics", make_array_bytes(&s.n_topics),
+        "emitters", make_array_bytes(&s.emitters),
+        "valid", make_array_bytes(&s.valid),
+        "pair_ids", make_array_bytes(&s.pair_ids),
+        "exec_idx", make_array_bytes(&s.exec_idx),
+        "event_idx", make_array_bytes(&s.event_idx),
+        "topics_pool", make_array_bytes(&s.topics_pool),
+        "data_pool", make_array_bytes(&s.data_pool),
+        "topics_off", make_array_bytes(&s.topics_off),
+        "data_off", make_array_bytes(&s.data_off),
+        "data_len", make_array_bytes(&s.data_len),
+        "n_events", (long long)s.n_events,
+        "n_receipts", (long long)s.n_receipts,
+        "touch_pool", make_array_bytes(&touch_pool),
+        "touch_off", make_array_bytes(&touch_off),
+        "touch_len", make_array_bytes(&touch_len),
+        "touch_goff", make_array_bytes(&touch_goff),
+        "failed", make_array_bytes(&failed));
+  }
+  Py_DECREF(rseq);
+  Py_DECREF(wseq);
+  scan_free(&s);
+  vec_free(&touch_pool); vec_free(&touch_off); vec_free(&touch_len);
+  vec_free(&touch_goff); vec_free(&failed);
+  return result;
+}
+
 static PyMethodDef methods[] = {
     {"scan_events_batch", (PyCFunction)(void (*)(void))py_scan_events_batch,
      METH_VARARGS | METH_KEYWORDS,
@@ -961,6 +1607,14 @@ static PyMethodDef methods[] = {
      "collect_exec_orders(blocks_dict, groups, fallback=None, headers=True) ->"
      " per-group message-CID lists (execution order, pre-dedup), touched block"
      " CIDs, TxMeta CIDs + canonical flags, and failed flags."},
+    {"record_receipt_paths",
+     (PyCFunction)(void (*)(void))py_record_receipt_paths,
+     METH_VARARGS | METH_KEYWORDS,
+     "record_receipt_paths(blocks_dict, roots, wanted, fallback=None) -> "
+     "pass 2 of the event generator batched: per group, targeted receipts-AMT"
+     " path walks to each wanted index plus full events-AMT walks beneath,"
+     " returning flat payload-mode event arrays, touched block CIDs (grouped),"
+     " and per-group failed flags."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_scan_ext",

@@ -122,6 +122,20 @@ def _verify_proofs_batch(
     # `_verify_single_proof`). Groups with survivors proceed to the batched
     # step 3 — reconstruction runs ONLY for groups some proof actually
     # reached, preserving the lazy cost model against adversarial bundles.
+    # headers decoded once per CID across ALL phases (phase 1 shares its
+    # decodes with step 3's strict re-validation leg)
+    header_cache: dict[CID, BlockHeader] = {}
+
+    def _decoded_header(cid: CID, kind: str) -> BlockHeader:
+        header = header_cache.get(cid)
+        if header is None:
+            raw = store.get(cid)
+            if raw is None:
+                raise KeyError(f"missing {kind} header in witness")
+            header = BlockHeader.decode(raw)
+            header_cache[cid] = header
+        return header
+
     step3: list[tuple[list[int], list[CID], "BlockHeader"]] = []
     for (parent_strs, child_str), idxs in groups.items():
         parent_cids = [CID.from_string(c) for c in parent_strs]
@@ -140,20 +154,14 @@ def _verify_proofs_batch(
                 continue
             # Step 2: header consistency (decode once per group).
             if child_header is None:
-                child_raw = store.get(child_cid)
-                if child_raw is None:
-                    raise KeyError("missing child header in witness")
-                child_header = BlockHeader.decode(child_raw)
+                child_header = _decoded_header(child_cid, "child")
                 parents_match = child_header.parents == parent_cids
             if not parents_match:
                 continue
             if child_header.height != proof.child_epoch:
                 continue
             if parent_height is None:
-                parent_raw = store.get(parent_cids[0])
-                if parent_raw is None:
-                    raise KeyError("missing parent header in witness")
-                parent_height = BlockHeader.decode(parent_raw).height
+                parent_height = _decoded_header(parent_cids[0], "parent").height
             if parent_height != proof.parent_epoch:
                 continue
             survivors.append(k)
@@ -166,7 +174,9 @@ def _verify_proofs_batch(
     # Step 3, batched: ONE native walk reconstructs the surviving groups'
     # execution orders (scalar per group when the extension is absent).
     batch_exec = reconstruct_execution_orders_batch(
-        store, [parent_cids for _, parent_cids, _ in step3]
+        store,
+        [parent_cids for _, parent_cids, _ in step3],
+        header_cache=header_cache,
     )
 
     pending: list[tuple[int, "BlockHeader"]] = []
@@ -211,22 +221,44 @@ def _verify_proofs_batch(
         )
     except (KeyError, ValueError):
         scan = None
-    rows: Optional[dict] = None
+    row_for: Optional[list] = None
     if scan is not None:
-        rows = {
-            (int(scan.pair_ids[r]), int(scan.exec_idx[r]), int(scan.event_idx[r])): r
-            for r in range(scan.n_events)
-        }
+        # Rows are emitted in (pair, exec, event) walk order, i.e. sorted —
+        # vectorized searchsorted over 12-byte big-endian keys replaces a
+        # Python dict over every scanned event.
+        import numpy as np
+
+        scan_keys = np.empty((scan.n_events, 3), dtype=">i4")
+        scan_keys[:, 0] = scan.pair_ids
+        scan_keys[:, 1] = scan.exec_idx
+        scan_keys[:, 2] = scan.event_idx
+        flat_keys = np.ascontiguousarray(scan_keys).view("S12").ravel()
+        def _q(v: int) -> int:
+            # forged claims can carry indices outside int32; those matched
+            # nothing in the dict formulation and must match nothing here
+            # (-1 is unreachable: scanned indices are non-negative)
+            return v if 0 <= v <= 0x7FFFFFFF else -1
+
+        query = np.empty((len(pending), 3), dtype=">i4")
+        query[:, 0] = pending_pair
+        query[:, 1] = [_q(proofs[k].exec_index) for k, _ in pending]
+        query[:, 2] = [_q(proofs[k].event_index) for k, _ in pending]
+        flat_query = np.ascontiguousarray(query).view("S12").ravel()
+        pos = np.searchsorted(flat_keys, flat_query)
+        in_range = pos < scan.n_events
+        found = np.zeros(len(pending), dtype=bool)
+        found[in_range] = flat_keys[pos[in_range]] == flat_query[in_range]
+        row_for = [int(p) if f else None for p, f in zip(pos, found)]
 
     # Phase 3: step 4 per pending proof.
-    for (k, child_header), pair in zip(pending, pending_pair):
+    for j, ((k, child_header), pair) in enumerate(zip(pending, pending_pair)):
         proof = proofs[k]
-        if rows is None:
+        if row_for is None:
             results[k] = _verify_receipt_and_event(
                 store, child_header, proof, check_event
             )
             continue
-        row = rows.get((pair, proof.exec_index, proof.event_index))
+        row = row_for[j]
         if row is None:
             continue
         if not _row_matches_claim(scan, row, proof.event_data):

@@ -160,7 +160,9 @@ def _first_seen_positions(msg_bytes: list[bytes]) -> dict[bytes, int]:
 
 
 def reconstruct_execution_orders_batch(
-    store: Blockstore, groups: list[list[CID]]
+    store: Blockstore,
+    groups: list[list[CID]],
+    header_cache: "Optional[dict[CID, BlockHeader]]" = None,
 ) -> "Optional[list[Optional[dict[bytes, int]]]]":
     """Batched `reconstruct_execution_order` over many parent-header groups
     via the native walker: ONE C call walks every group's TxMeta/message
@@ -197,15 +199,21 @@ def reconstruct_execution_orders_batch(
             results.append(None)
             continue
         ok = True
-        # strict header validation (scalar parity — see docstring)
+        # strict header validation (scalar parity — see docstring);
+        # header_cache lets the batch verifier share its phase-1 decodes
         expected_txmetas = []
         try:
             for cid in groups[g]:
-                raw = store.get(cid)
-                if raw is None:
-                    ok = False
-                    break
-                expected_txmetas.append(BlockHeader.decode(raw).messages.to_bytes())
+                header = header_cache.get(cid) if header_cache is not None else None
+                if header is None:
+                    raw = store.get(cid)
+                    if raw is None:
+                        ok = False
+                        break
+                    header = BlockHeader.decode(raw)
+                    if header_cache is not None:
+                        header_cache[cid] = header
+                expected_txmetas.append(header.messages.to_bytes())
         except ValueError:
             ok = False
         if ok and expected_txmetas != view.txmetas:
@@ -237,14 +245,16 @@ def reconstruct_execution_orders_batch(
 
 def collect_exec_orders_for_pairs(
     store: Blockstore, txmeta_groups: list[list[CID]]
-) -> "Optional[list[Optional[tuple[list[CID], list[CID]]]]]":
+) -> "Optional[list[Optional[tuple[list[bytes], list[bytes]]]]]":
     """Generation-side batched walker: per group of TxMeta CIDs, returns
     ``(exec_order, touched_block_cids)`` — the execution order AND the block
     CIDs the walk touched (the recorded base-witness leg of
     `collect_base_witness_and_exec_order`), in one C call for all matching
-    pairs. A failed group yields None (callers redo it scalar so errors
-    surface with the scalar path's exact exceptions). None overall when the
-    extension is absent."""
+    pairs. Both are RAW CID BYTES in order — callers build `CID` objects
+    only for the few they actually surface (claims), keeping Phase C free
+    of per-CID Python object churn. A failed group yields None (callers
+    redo it scalar so errors surface with the scalar path's exact
+    exceptions). None overall when the extension is absent."""
     out = _native_exec_orders(store, txmeta_groups, headers=False)
     if out is None:
         return None
@@ -255,7 +265,5 @@ def collect_exec_orders_for_pairs(
         if view.failed:
             results.append(None)
             continue
-        order = [CID.from_bytes(b) for b in _first_seen_positions(view.msgs)]
-        touched = [CID.from_bytes(b) for b in view.touched]
-        results.append((order, touched))
+        results.append((list(_first_seen_positions(view.msgs)), view.touched))
     return results

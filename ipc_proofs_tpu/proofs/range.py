@@ -220,69 +220,186 @@ def generate_event_proofs_for_range(
                 for scanned in scans
             ]
 
-    # Phase C+D: per-pair pass 2 + merged witness. Pairs with no matching
-    # receipts contribute no proofs, so their base witness (headers, TxMeta
-    # walks, exec-order blocks) is dead weight for the verifier — skip them
+    # Phase C+D: pass 2 + merged witness. Pairs with no matching receipts
+    # contribute no proofs, so their base witness (headers, TxMeta walks,
+    # exec-order blocks) is dead weight for the verifier — skip them
     # entirely. (The reference always collects the base witness because it
     # runs one pair per invocation, `events/generator.rs:122-145`; a range
     # bundle's witness only needs to cover the proofs it carries.)
-    event_proofs = []
-    all_blocks: set[ProofBlock] = set()
+    #
+    # Native path: TWO C calls cover every matching pair — the batched
+    # TxMeta/message-AMT walker (exec order + base witness) and the batched
+    # pass-2 recorder (receipts paths + events AMTs + payload-mode event
+    # arrays). Claims become a numpy mask + array slicing; the witness is a
+    # set of raw CID bytes materialized ONCE. Any failed group (or a store
+    # without a raw map, or no extension) falls back to the scalar pass 2
+    # so errors surface identically.
     with metrics.stage("range_record"):
-        # Batched exec-order + base-witness walks: one native call covers
-        # every matching pair's TxMeta/message AMTs; a failed group (or no
-        # extension) redoes that pair scalar so errors surface identically.
-        from ipc_proofs_tpu.proofs.exec_order import collect_exec_orders_for_pairs
-
         matching_pairs = [
             (pair, matching)
             for pair, matching in zip(pairs, matching_per_pair)
             if matching
         ]
-        native_walks = None
+        native = None
         # scan_batch non-None ⇒ the native extension loaded and the store
-        # exposes a raw map, so the walker uses the same fast block access
+        # exposes a raw map, so the walkers use the same fast block access
         if matching_pairs and scan_batch is not None:
-            native_walks = collect_exec_orders_for_pairs(
-                cached,
-                [[h.messages for h in pair.parent.blocks] for pair, _ in matching_pairs],
+            native = _record_pass2_native(
+                cached, matching_pairs, matcher, spec.actor_id_filter
             )
+        if native is not None:
+            event_proofs, witness_bytes = native
+            from ipc_proofs_tpu.core.cid import CID
 
-        for pos, (pair, matching) in enumerate(matching_pairs):
-            collector = WitnessCollector(cached)
-            walk = native_walks[pos] if native_walks is not None else None
-            if walk is not None:
-                exec_order, touched = walk
-                for parent_cid in pair.parent.cids:
-                    collector.add_cid(parent_cid)
-                collector.add_cid(pair.child.cids[0])
-                collector.add_cid(pair.child.blocks[0].parent_message_receipts)
-                for header in pair.parent.blocks:
-                    collector.add_cid(header.messages)
-                for cid in touched:
-                    collector.add_cid(cid)
-            else:
+            blocks = []
+            for cid_bytes in sorted(witness_bytes):
+                cid = CID.from_bytes(cid_bytes)
+                raw = cached.get(cid)
+                if raw is None:
+                    raise KeyError(f"missing witness block {cid}")
+                blocks.append(ProofBlock(cid=cid, data=raw))
+        else:
+            event_proofs = []
+            all_blocks: set[ProofBlock] = set()
+            for pair, matching in matching_pairs:
+                collector = WitnessCollector(cached)
                 # one set of TxMeta walks yields both the recorded base
                 # witness and the execution order (they touch the same blocks)
                 exec_order = collect_base_witness_and_exec_order(
                     collector, cached, pair.parent, pair.child
                 )
-            proofs, recordings = record_matching_receipts(
+                proofs, recordings = record_matching_receipts(
+                    cached,
+                    pair.parent,
+                    pair.child,
+                    exec_order,
+                    matching,
+                    matcher,
+                    spec.actor_id_filter,
+                )
+                collector.collect_from_recordings(recordings)
+                event_proofs.extend(proofs)
+                all_blocks.update(collector.materialize())
+            blocks = sorted(all_blocks, key=lambda b: b.cid.to_bytes())
+    metrics.count("range_proofs", len(event_proofs))
+
+    return UnifiedProofBundle(
+        storage_proofs=[],
+        event_proofs=event_proofs,
+        blocks=blocks,
+    )
+
+
+def _record_pass2_native(
+    cached: Blockstore,
+    matching_pairs: "list[tuple[TipsetPair, list[int]]]",
+    matcher: EventMatcher,
+    actor_id_filter: Optional[int],
+) -> "Optional[tuple[list, set[bytes]]]":
+    """Phase C over the native walkers: returns (event_proofs,
+    witness_cid_bytes) or None when either extension pathway is
+    unavailable. Verdict- and byte-identical to the scalar pass 2 (tested
+    differentially); groups the C side fails on are redone scalar."""
+    import numpy as np
+
+    from ipc_proofs_tpu.core.cid import CID
+    from ipc_proofs_tpu.proofs.bundle import EventData, EventProof
+    from ipc_proofs_tpu.proofs.exec_order import collect_exec_orders_for_pairs
+    from ipc_proofs_tpu.proofs.scan_native import record_receipt_paths
+
+    walks = collect_exec_orders_for_pairs(
+        cached,
+        [[h.messages for h in pair.parent.blocks] for pair, _ in matching_pairs],
+    )
+    if walks is None:
+        return None
+    rec = record_receipt_paths(
+        cached,
+        [pair.child.blocks[0].parent_message_receipts for pair, _ in matching_pairs],
+        [matching for _, matching in matching_pairs],
+    )
+    if rec is None:
+        return None
+
+    sb = rec.batch
+    # claim mask over ALL emitted events at once — exactly the scalar
+    # per-event predicate (extract_evm_log validity + matches_log + actor
+    # filter), evaluated on the C-parsed arrays
+    if sb.n_events:
+        mask = sb.valid & (sb.n_topics >= 2)
+        t0_words = np.frombuffer(matcher.topic0, dtype="<u4")
+        t1_words = np.frombuffer(matcher.topic1, dtype="<u4")
+        mask &= (sb.topics[:, 0, :] == t0_words).all(axis=1)
+        mask &= (sb.topics[:, 1, :] == t1_words).all(axis=1)
+        if actor_id_filter is not None:
+            mask &= sb.emitters == np.uint64(actor_id_filter)
+    else:
+        mask = np.zeros(0, dtype=bool)
+
+    proofs: list = []
+    witness: set[bytes] = set()
+    for g, (pair, matching) in enumerate(matching_pairs):
+        walk = walks[g]
+        if walk is None or rec.failed[g]:
+            collector = WitnessCollector(cached)
+            exec_order = collect_base_witness_and_exec_order(
+                collector, cached, pair.parent, pair.child
+            )
+            redo_proofs, recordings = record_matching_receipts(
                 cached,
                 pair.parent,
                 pair.child,
                 exec_order,
                 matching,
                 matcher,
-                spec.actor_id_filter,
+                actor_id_filter,
             )
             collector.collect_from_recordings(recordings)
-            event_proofs.extend(proofs)
-            all_blocks.update(collector.materialize())
-    metrics.count("range_proofs", len(event_proofs))
+            proofs.extend(redo_proofs)
+            witness.update(c.to_bytes() for c in collector.needed_cids())
+            continue
 
-    return UnifiedProofBundle(
-        storage_proofs=[],
-        event_proofs=event_proofs,
-        blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
-    )
+        exec_msgs, exec_touched = walk
+        for i in matching:
+            if i >= len(exec_msgs):
+                raise KeyError(f"missing message at execution index {i}")
+
+        for parent_cid in pair.parent.cids:
+            witness.add(parent_cid.to_bytes())
+        witness.add(pair.child.cids[0].to_bytes())
+        witness.add(pair.child.blocks[0].parent_message_receipts.to_bytes())
+        for header in pair.parent.blocks:
+            witness.add(header.messages.to_bytes())
+        witness.update(exec_touched)
+        witness.update(rec.touched(g))
+
+        lo, hi = rec.rows(g)
+        if lo == hi:
+            continue
+        parent_cid_strs = [str(c) for c in pair.parent.cids]
+        child_cid_str = str(pair.child.cids[0])
+        for rel in np.nonzero(mask[lo:hi])[0]:
+            row = int(rel) + lo
+            exec_index = int(sb.exec_idx[row])
+            topics_bytes = sb.event_topics(row)
+            n_topics = int(sb.n_topics[row])
+            proofs.append(
+                EventProof(
+                    parent_epoch=pair.parent.height,
+                    child_epoch=pair.child.height,
+                    parent_tipset_cids=list(parent_cid_strs),
+                    child_block_cid=child_cid_str,
+                    message_cid=str(CID.from_bytes(exec_msgs[exec_index])),
+                    exec_index=exec_index,
+                    event_index=int(sb.event_idx[row]),
+                    event_data=EventData(
+                        emitter=int(sb.emitters[row]),
+                        topics=[
+                            "0x" + topics_bytes[32 * k : 32 * (k + 1)].hex()
+                            for k in range(n_topics)
+                        ],
+                        data="0x" + sb.event_data(row).hex(),
+                    ),
+                )
+            )
+    return proofs, witness

@@ -28,26 +28,33 @@ from ipc_proofs_tpu.store.blockstore import (
 
 __all__ = [
     "ScanBatch",
+    "RecordBatch",
     "scan_events_flat",
+    "record_receipt_paths",
     "native_scan_available",
     "topic_fingerprint",
 ]
 
-_FNV_OFFSET = 1469598103934665603
-_FNV_PRIME = 1099511628211
+_FP_SEED = 0x9E3779B97F4A7C15
+_FP_MULT = 0xFF51AFD7ED558CCD
 _U64 = (1 << 64) - 1
 
 
 def topic_fingerprint(topic0: bytes, topic1: bytes) -> int:
-    """FNV-1a over the zero-padded 2×32-byte topic words — the target value
-    for the transfer-light device match (must equal the C scanner's per-event
-    ``fp``). A fingerprint equality is confirmed exactly in pass 2, which
-    re-applies the full matcher per event, so a (2^-64-rare) collision can
-    only add an unused witness path, never a wrong claim."""
+    """64-bit mix over the zero-padded 2×32-byte topic words — the target
+    value for the transfer-light device match (must equal the C scanner's
+    per-event ``fp``). Word-wise (8×u64 LE) rather than byte-wise: the C
+    side computes this once per scanned event, and a byte-serial FNV's
+    64-multiply dependency chain was the scan's hottest instruction path.
+    A fingerprint equality is confirmed exactly in pass 2, which re-applies
+    the full matcher per event, so a (2^-64-rare) collision can only add an
+    unused witness path, never a wrong claim."""
     buf = (topic0 + b"\x00" * 32)[:32] + (topic1 + b"\x00" * 32)[:32]
-    fp = _FNV_OFFSET
-    for b in buf:
-        fp = ((fp ^ b) * _FNV_PRIME) & _U64
+    fp = _FP_SEED
+    for k in range(8):
+        word = int.from_bytes(buf[8 * k : 8 * k + 8], "little")
+        fp = ((fp ^ word) * _FP_MULT) & _U64
+        fp ^= fp >> 29
     return fp
 
 
@@ -56,7 +63,7 @@ class ScanBatch:
     """Flat arrays over every event of every receipt of every scanned root."""
 
     topics: np.ndarray  # uint32 [N, 2, 8] — first two topics as LE u32 words
-    fp: np.ndarray  # uint64 [N] — FNV-1a fingerprint of the topic words
+    fp: np.ndarray  # uint64 [N] — topic_fingerprint (word-wise u64 mix)
     n_topics: np.ndarray  # int32 [N] — total topic count (may exceed 2)
     emitters: np.ndarray  # uint64 [N]
     valid: np.ndarray  # bool [N] — EVM-log shaped (extract_evm_log parity)
@@ -114,6 +121,89 @@ def _raw_view(store: Blockstore):
         return store.get(CID.from_bytes(cid_bytes))
 
     return {}, fallback
+
+
+@dataclass
+class RecordBatch:
+    """Native pass-2 output: payload-mode event arrays over every event of
+    every WANTED receipt, plus the touched-block witness per group."""
+
+    batch: ScanBatch
+    failed: np.ndarray  # bool [n_groups]
+    _touch_pool: bytes
+    _touch_off: np.ndarray
+    _touch_len: np.ndarray
+    _touch_goff: np.ndarray
+
+    def touched(self, group: int) -> list[bytes]:
+        """Raw CID bytes of every block pass 2 fetched for ``group``
+        (receipts-AMT root + targeted paths + full events-AMT walks)."""
+        lo, hi = int(self._touch_goff[group]), int(self._touch_goff[group + 1])
+        return [
+            bytes(self._touch_pool[self._touch_off[t] : self._touch_off[t] + self._touch_len[t]])
+            for t in range(lo, hi)
+        ]
+
+    def rows(self, group: int) -> tuple[int, int]:
+        """Half-open row range of ``group``'s events in ``batch`` (rows are
+        emitted in ascending group order)."""
+        lo = int(np.searchsorted(self.batch.pair_ids, group, side="left"))
+        hi = int(np.searchsorted(self.batch.pair_ids, group, side="right"))
+        return lo, hi
+
+
+def record_receipt_paths(
+    store: Blockstore,
+    receipts_roots: Sequence[CID],
+    wanted: Sequence[Sequence[int]],
+) -> Optional[RecordBatch]:
+    """Batched PASS 2 (native): for each (receipts root, wanted receipt
+    indices) group, walk the receipts-AMT path to each wanted index and the
+    full events AMT beneath it, recording every touched block. Returns None
+    when the extension is unavailable (callers use the scalar pass 2).
+    Per-group failures (missing/malformed blocks) set ``failed[g]``; callers
+    redo those groups scalar so errors surface identically.
+
+    Scalar parity anchor: `event_generator.record_matching_receipts`
+    (reference `src/proofs/events/generator.rs:241-301`).
+    """
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    ext = load_scan_ext()
+    if ext is None or not hasattr(ext, "record_receipt_paths"):
+        return None
+    raw, fallback = _raw_view(store)
+    out = ext.record_receipt_paths(
+        raw,
+        [c.to_bytes() for c in receipts_roots],
+        [list(map(int, w)) for w in wanted],
+        fallback,
+    )
+    n = out["n_events"]
+    batch = ScanBatch(
+        topics=np.frombuffer(out["topics"], dtype="<u4").reshape(n, 2, 8),
+        fp=np.frombuffer(out["fp"], dtype="<u8"),
+        n_topics=np.frombuffer(out["n_topics"], dtype="<i4"),
+        emitters=np.frombuffer(out["emitters"], dtype="<u8"),
+        valid=np.frombuffer(out["valid"], dtype=np.uint8).astype(bool),
+        pair_ids=np.frombuffer(out["pair_ids"], dtype="<i4"),
+        exec_idx=np.frombuffer(out["exec_idx"], dtype="<i4"),
+        event_idx=np.frombuffer(out["event_idx"], dtype="<i4"),
+        n_receipts=out["n_receipts"],
+        topics_pool=out["topics_pool"],
+        data_pool=out["data_pool"],
+        topics_off=np.frombuffer(out["topics_off"], dtype="<u4"),
+        data_off=np.frombuffer(out["data_off"], dtype="<u4"),
+        data_len=np.frombuffer(out["data_len"], dtype="<u4"),
+    )
+    return RecordBatch(
+        batch=batch,
+        failed=np.frombuffer(out["failed"], dtype=np.uint8).astype(bool),
+        _touch_pool=out["touch_pool"],
+        _touch_off=np.frombuffer(out["touch_off"], dtype="<i4"),
+        _touch_len=np.frombuffer(out["touch_len"], dtype="<i4"),
+        _touch_goff=np.frombuffer(out["touch_goff"], dtype="<i4"),
+    )
 
 
 def scan_events_flat(

@@ -34,6 +34,11 @@ class WitnessCollector:
         for recorder in recorders:
             self.collect_from_recording(recorder)
 
+    def needed_cids(self) -> set[CID]:
+        """The accumulated CID set (callers merging several collectors'
+        witness sets without materializing each separately)."""
+        return set(self._needed)
+
     def materialize(self) -> list[ProofBlock]:
         """Fetch every needed CID's bytes; CID-sorted like the reference's
         BTreeSet iteration order."""

@@ -1,8 +1,19 @@
 """Benchmark platform selection shared by bench.py and benchmarks/.
 
 On this environment the default JAX backend may be a TPU chip behind a
-network tunnel whose initialization can hang; 'auto' therefore probes it in
-a subprocess with a timeout so a hung chip claim cannot hang the caller.
+network tunnel whose initialization can hang *transiently* (observed: a
+``jax.devices()`` call hanging >280 s, with a later probe succeeding).
+'auto' therefore probes in a subprocess with a timeout — so a hung chip
+claim cannot hang the caller — and RETRIES the probe several times with
+spacing before giving up, so one transient hang does not cost a benchmark
+run its hardware platform. A success is cached for the process.
+
+Environment overrides:
+
+* ``IPC_BENCH_PLATFORM=cpu|default|tpu`` — skip the probe entirely and use
+  this platform ('tpu' is treated as 'default': let JAX pick the chip).
+* ``IPC_BENCH_PROBE_ATTEMPTS`` / ``IPC_BENCH_PROBE_SPACING`` — override the
+  retry count / sleep between attempts (seconds).
 """
 
 from __future__ import annotations
@@ -10,29 +21,81 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
-from typing import Callable
+import time
+from typing import Callable, Optional
 
-__all__ = ["pick_platform"]
+__all__ = ["pick_platform", "probed_platform_name"]
 
 
 def _default_log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
 
+# process-level cache: (resolved, platform_name) after a successful probe or
+# an exhausted retry budget. A cached SUCCESS is always honored; a cached
+# failure is kept too (the retry budget was already spent once).
+_cache: Optional[tuple[str, Optional[str]]] = None
+
+
+def probed_platform_name() -> Optional[str]:
+    """The backend platform name ('tpu', 'cpu', …) the last successful
+    'auto' probe reported, or None if no probe has succeeded."""
+    return _cache[1] if _cache else None
+
+
 def pick_platform(
     requested: str,
     probe_timeout: float = 240.0,
     log: Callable[..., None] = _default_log,
+    attempts: Optional[int] = None,
+    spacing: Optional[float] = None,
 ) -> str:
     """Resolve 'auto' to 'default' (probe succeeded) or 'cpu'.
 
-    Any explicit request ('cpu', 'default', ...) passes through untouched.
-    The IPC_BENCH_PLATFORM env var short-circuits the probe.
+    Any explicit request ('cpu', 'default', ...) passes through untouched
+    ('tpu' maps to 'default'). The IPC_BENCH_PLATFORM env var short-circuits
+    the probe. An 'auto' probe runs up to ``attempts`` times (default 3,
+    env-overridable), sleeping ``spacing`` seconds between failures
+    (default 20), so one transient tunnel hang doesn't forfeit the chip.
     """
+    global _cache
     if requested != "auto":
-        return requested
+        return "default" if requested == "tpu" else requested
     if os.environ.get("IPC_BENCH_PLATFORM"):
-        return os.environ["IPC_BENCH_PLATFORM"]
+        env = os.environ["IPC_BENCH_PLATFORM"]
+        return "default" if env == "tpu" else env
+    if _cache is not None:
+        return _cache[0]
+
+    if attempts is None:
+        attempts = int(os.environ.get("IPC_BENCH_PROBE_ATTEMPTS", "3"))
+    if spacing is None:
+        spacing = float(os.environ.get("IPC_BENCH_PROBE_SPACING", "20"))
+
+    for attempt in range(1, max(attempts, 1) + 1):
+        t0 = time.monotonic()
+        name = _probe_once(probe_timeout, log, attempt, attempts)
+        if name is not None:
+            log(f"bench: default backend probe OK → platform {name!r}")
+            _cache = ("default", name)
+            return "default"
+        if attempt < attempts:
+            # a probe that failed FAST (plugin error, not a hang) won't be
+            # fixed by waiting; still space retries out a little
+            elapsed = time.monotonic() - t0
+            delay = spacing if elapsed >= probe_timeout * 0.5 else min(spacing, 5.0)
+            log(f"bench: retrying default backend probe in {delay:.0f}s "
+                f"(attempt {attempt}/{attempts} failed)")
+            time.sleep(delay)
+    log("bench: default backend probe exhausted retries — falling back to CPU")
+    _cache = ("cpu", None)
+    return "cpu"
+
+
+def _probe_once(
+    probe_timeout: float, log: Callable[..., None], attempt: int, attempts: int
+) -> Optional[str]:
+    """One subprocess probe; returns the platform name or None."""
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
@@ -41,12 +104,10 @@ def pick_platform(
             text=True,
         )
         if probe.returncode == 0 and probe.stdout.strip():
-            platform = probe.stdout.strip().splitlines()[-1]
-            log(f"bench: default backend probe OK → platform {platform!r}")
-            return "default"
-        log(f"bench: probe exited rc={probe.returncode} — falling back to CPU")
+            return probe.stdout.strip().splitlines()[-1]
+        log(f"bench: probe {attempt}/{attempts} exited rc={probe.returncode}")
     except subprocess.TimeoutExpired:
-        log("bench: default backend probe timed out — falling back to CPU")
+        log(f"bench: probe {attempt}/{attempts} timed out after {probe_timeout:.0f}s")
     except Exception as exc:  # pragma: no cover
-        log(f"bench: probe failed ({exc}) — falling back to CPU")
-    return "cpu"
+        log(f"bench: probe {attempt}/{attempts} failed ({exc})")
+    return None

@@ -215,7 +215,7 @@ class TestNativeExecOrderBatch:
 
             scalar = build_execution_order(bs, FakeTipset)
             order, touched = walks[g]
-            assert order == scalar
+            assert order == [c.to_bytes() for c in scalar]
             assert len(touched) >= 2  # at least the TxMeta + AMT root blocks
 
     def test_malformed_parent_header_rejected_like_scalar(self):

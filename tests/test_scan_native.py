@@ -166,6 +166,90 @@ class TestNativeScan:
         assert_scan_matches(bs, [root])  # same answer as the raw-map path
 
 
+class TestParallelScan:
+    """The pthread fan-out must be byte-identical to the sequential walk
+    (contiguous chunk concatenation preserves emission order) and must
+    surface the same exception for a bad root."""
+
+    def _big_world(self, n_roots=96):
+        bs = MemoryBlockstore()
+        roots = []
+        for p in range(n_roots):
+            events = [
+                [
+                    EventFixture(emitter=ACTOR, signature=SIG, topic1=f"n{p}"),
+                    EventFixture(emitter=9, signature="Other()", topic1="x"),
+                ],
+                [],
+                [EventFixture(emitter=ACTOR, signature=SIG, topic1=f"m{p}")],
+            ]
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)],
+                events,
+                parent_height=1000 + 2 * p,
+                store=bs,
+            )
+            roots.append(world.child.blocks[0].parent_message_receipts)
+        return bs, roots
+
+    def test_parallel_matches_sequential(self, monkeypatch):
+        import os
+
+        bs, roots = self._big_world()
+        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        seq = scan_events_flat(bs, roots, want_payload=True)
+        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
+        par = scan_events_flat(bs, roots, want_payload=True)
+        assert par.n_events == seq.n_events and par.n_receipts == seq.n_receipts
+        np.testing.assert_array_equal(par.topics, seq.topics)
+        np.testing.assert_array_equal(par.fp, seq.fp)
+        np.testing.assert_array_equal(par.n_topics, seq.n_topics)
+        np.testing.assert_array_equal(par.emitters, seq.emitters)
+        np.testing.assert_array_equal(par.valid, seq.valid)
+        np.testing.assert_array_equal(par.pair_ids, seq.pair_ids)
+        np.testing.assert_array_equal(par.exec_idx, seq.exec_idx)
+        np.testing.assert_array_equal(par.event_idx, seq.event_idx)
+        # pools are chunk-rebased; per-event payload slices must agree
+        for r in range(seq.n_events):
+            assert par.event_topics(r) == seq.event_topics(r)
+            assert par.event_data(r) == seq.event_data(r)
+
+    def test_parallel_missing_block_raises_keyerror(self, monkeypatch):
+        bs, roots = self._big_world()
+        raw = bs.raw_map()
+        # drop one late root so a non-first chunk hits the error
+        del raw[roots[-3].to_bytes()]
+        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
+        with pytest.raises(KeyError):
+            scan_events_flat(bs, roots)
+        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        with pytest.raises(KeyError):
+            scan_events_flat(bs, roots)
+
+    def test_parallel_malformed_block_raises_valueerror(self, monkeypatch):
+        # a corrupted AMT block on a worker thread must surface as the same
+        # ValueError as the sequential walk (never touch PyErr off-GIL)
+        bs, roots = self._big_world()
+        raw = bs.raw_map()
+        raw[roots[-5].to_bytes()] = b"\x83\x00\x01"  # not an AMT root
+        for threads in ("8", "1"):
+            monkeypatch.setenv("IPC_SCAN_THREADS", threads)
+            with pytest.raises(ValueError):
+                scan_events_flat(bs, roots)
+
+    def test_parallel_skip_missing_prunes_identically(self, monkeypatch):
+        bs, roots = self._big_world()
+        raw = bs.raw_map()
+        del raw[roots[10].to_bytes()]
+        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        seq = scan_events_flat(bs, roots, skip_missing=True)
+        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
+        par = scan_events_flat(bs, roots, skip_missing=True)
+        np.testing.assert_array_equal(par.pair_ids, seq.pair_ids)
+        np.testing.assert_array_equal(par.fp, seq.fp)
+        assert par.n_receipts == seq.n_receipts
+
+
 class TestForgedInputs:
     """Adversarial witness blocks must fail cleanly, never overflow."""
 
