@@ -150,6 +150,27 @@ class TpuBackend:
         digests = self.blake2b256_batch(blocks)
         return all(d == e for d, e in zip(digests, cids_digests))
 
+    # Below this many events the device mask loses to fixed dispatch cost:
+    # one round trip (tunnel RTT on a proxied chip) + the host→device copy
+    # of the fp/valid rows costs more than evaluating the identical
+    # predicate over the already-resident host numpy arrays (a few hundred
+    # µs at 262k events — memory-bound, ~9 B/event). Mirrors the
+    # `_CID_BATCH_MIN_BYTES` crossover above; override with
+    # IPC_TPU_MATCH_MIN_EVENTS. A mesh forces the device path regardless —
+    # sharded multichip batches amortize the dispatch and keep the mask
+    # where the rest of the sharded pipeline runs.
+    _MATCH_BATCH_MIN_EVENTS = 4 << 20
+
+    def _match_on_device(self, n_events: int) -> bool:
+        import os
+
+        if self.mesh is not None:
+            return True
+        min_events = int(
+            os.environ.get("IPC_TPU_MATCH_MIN_EVENTS", self._MATCH_BATCH_MIN_EVENTS)
+        )
+        return n_events >= min_events
+
     def event_match_mask(
         self,
         events: Sequence[StampedEvent],
@@ -176,7 +197,23 @@ class TpuBackend:
     ) -> np.ndarray:
         """Mask over pre-flattened arrays (the no-Python-objects fast path the
         C scanner feeds). One jitted dispatch, bucket-padded shapes, single
-        readback; returns the padded bool array (slice to true length)."""
+        readback; returns the padded bool array (slice to true length).
+
+        Small batches stay on host (see `_match_on_device`): the predicate
+        is evaluated with the same numpy expressions the device kernel
+        traces, so the mask is bit-identical either way."""
+        if not self._match_on_device(topics.shape[0]):
+            t0 = np.frombuffer(topic0, dtype="<u4")
+            t1 = np.frombuffer(topic1, dtype="<u4")
+            mask = (
+                valid
+                & (n_topics >= 2)
+                & (topics[:, 0, :] == t0).all(axis=1)
+                & (topics[:, 1, :] == t1).all(axis=1)
+            )
+            if actor_id_filter is not None:
+                mask = mask & (emitters == actor_id_filter)
+            return mask
         from ipc_proofs_tpu.ops.match_jax import event_match_mask_jit
 
         mask = event_match_mask_jit(
@@ -203,9 +240,22 @@ class TpuBackend:
         """Fingerprint match over pre-flattened arrays: one u64 per event
         crosses to the device instead of 64 topic bytes (see
         `ops.match_jax.event_match_mask_fp_jit`). Semantics identical to
-        `event_match_mask_flat` — pass 2 confirms every hit exactly."""
-        from ipc_proofs_tpu.ops.match_jax import event_match_mask_fp_jit
+        `event_match_mask_flat` — pass 2 confirms every hit exactly.
+
+        Small batches stay on host (see `_match_on_device`): one vectorized
+        u64 compare over the scanner's resident fp array — the same
+        predicate the device kernel evaluates, minus the dispatch and
+        transfer that made a single proxied-chip round trip cost more than
+        the entire host-side match."""
         from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+
+        if not self._match_on_device(fp.shape[0]):
+            target = topic_fingerprint(topic0, topic1)
+            mask = valid & (np.asarray(n_topics) >= 2) & (fp == target)
+            if actor_id_filter is not None:
+                mask = mask & (np.asarray(emitters) == actor_id_filter)
+            return mask
+        from ipc_proofs_tpu.ops.match_jax import event_match_mask_fp_jit
 
         mask = event_match_mask_fp_jit(
             fp, n_topics, emitters, valid,

@@ -87,6 +87,52 @@ class TestTpuBackendEquivalence:
         assert tpu.keccak256_batch([]) == []
         assert tpu.event_match_mask([], T0, T1, None) == []
 
+    def test_match_crossover_host_vs_device_identical(self, tpu, monkeypatch):
+        """The small-batch host crossover must produce bit-identical masks to
+        the device kernels (both the full-width and fingerprint paths)."""
+        import numpy as np
+
+        from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+
+        rng = np.random.default_rng(7)
+        n = 503  # odd, off-bucket size
+        topics = rng.integers(0, 2**32, size=(n, 2, 8), dtype=np.uint32)
+        # plant exact spec-topic hits in a random subset
+        t0 = np.frombuffer(T0, dtype="<u4")
+        t1 = np.frombuffer(T1, dtype="<u4")
+        hit_rows = rng.choice(n, size=40, replace=False)
+        topics[hit_rows, 0] = t0
+        topics[hit_rows, 1] = t1
+        n_topics = rng.integers(0, 4, size=n).astype(np.int32)
+        emitters = rng.integers(0, 10, size=n).astype(np.uint64)
+        valid = rng.random(n) < 0.9
+        fp = np.array(
+            [
+                topic_fingerprint(topics[i, 0].tobytes(), topics[i, 1].tobytes())
+                for i in range(n)
+            ],
+            dtype=np.uint64,
+        )
+
+        for actor in (None, 7):
+            monkeypatch.setenv("IPC_TPU_MATCH_MIN_EVENTS", "1")
+            dev_flat = np.asarray(
+                tpu.event_match_mask_flat(topics, n_topics, emitters, valid, T0, T1, actor)
+            )[:n]
+            dev_fp = np.asarray(
+                tpu.event_match_mask_fp(fp, n_topics, emitters, valid, T0, T1, actor)
+            )[:n]
+            monkeypatch.setenv("IPC_TPU_MATCH_MIN_EVENTS", str(1 << 40))
+            host_flat = np.asarray(
+                tpu.event_match_mask_flat(topics, n_topics, emitters, valid, T0, T1, actor)
+            )[:n]
+            host_fp = np.asarray(
+                tpu.event_match_mask_fp(fp, n_topics, emitters, valid, T0, T1, actor)
+            )[:n]
+            assert (host_flat == dev_flat).all()
+            assert (host_fp == dev_fp).all()
+            assert (host_flat == host_fp).all()  # fp is injective over these rows
+
 
 class TestBackendInProofGeneration:
     def test_event_generation_same_proofs_cpu_vs_tpu(self):
