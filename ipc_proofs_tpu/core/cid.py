@@ -100,24 +100,34 @@ class CID:
         # fast paths: the two canonical chain forms — CIDv1 dag-cbor
         # blake2b-256 (every Filecoin chain block) and CIDv1 raw sha2-256.
         # Decode paths parse these tens of thousands of times per range.
+        # On the fast paths ``raw`` is the canonical encoding by
+        # construction (fixed minimal-varint prefixes), so it is stashed as
+        # the to_bytes memo — witness loading and claim construction
+        # re-encode every CID they touch. The generic path does NOT stash:
+        # decode_uvarint accepts non-minimal varints, and memoizing a
+        # non-canonical input would make to_bytes malleable (two byte forms
+        # for one logical CID diverging across byte-keyed maps and claims).
         if len(raw) == 38 and raw[1] == 0x71 and raw[:6] == b"\x01\x71\xa0\xe4\x02\x20":
-            return cls(1, DAG_CBOR, BLAKE2B_256, raw[6:])
-        if len(raw) == 38 and raw[:6] == b"\x01\x55\xa0\xe4\x02\x20":
-            return cls(1, RAW, BLAKE2B_256, raw[6:])
-        if len(raw) == 36 and raw[:4] == b"\x01\x55\x12\x20":
-            return cls(1, RAW, SHA2_256, raw[4:])
-        version, off = decode_uvarint(raw)
-        if version != 1:
-            raise ValueError(f"unsupported CID version {version}")
-        codec, off = decode_uvarint(raw, off)
-        mh_code, off = decode_uvarint(raw, off)
-        mh_len, off = decode_uvarint(raw, off)
-        digest = raw[off : off + mh_len]
-        if len(digest) != mh_len:
-            raise ValueError("truncated CID multihash digest")
-        if off + mh_len != len(raw):
-            raise ValueError("trailing bytes after CID")
-        return cls(version, codec, mh_code, digest)
+            out = cls(1, DAG_CBOR, BLAKE2B_256, raw[6:])
+        elif len(raw) == 38 and raw[:6] == b"\x01\x55\xa0\xe4\x02\x20":
+            out = cls(1, RAW, BLAKE2B_256, raw[6:])
+        elif len(raw) == 36 and raw[:4] == b"\x01\x55\x12\x20":
+            out = cls(1, RAW, SHA2_256, raw[4:])
+        else:
+            version, off = decode_uvarint(raw)
+            if version != 1:
+                raise ValueError(f"unsupported CID version {version}")
+            codec, off = decode_uvarint(raw, off)
+            mh_code, off = decode_uvarint(raw, off)
+            mh_len, off = decode_uvarint(raw, off)
+            digest = raw[off : off + mh_len]
+            if len(digest) != mh_len:
+                raise ValueError("truncated CID multihash digest")
+            if off + mh_len != len(raw):
+                raise ValueError("trailing bytes after CID")
+            return cls(version, codec, mh_code, digest)
+        object.__setattr__(out, "_bytes", bytes(raw))
+        return out
 
     @classmethod
     def from_string(cls, text: str) -> "CID":
@@ -137,16 +147,32 @@ class CID:
 
     # --- serialization -----------------------------------------------------
 
+    # precomputed varint prefixes for the canonical 32-byte-digest forms
+    _PREFIXES = {
+        (1, DAG_CBOR, BLAKE2B_256): b"\x01\x71\xa0\xe4\x02\x20",
+        (1, RAW, BLAKE2B_256): b"\x01\x55\xa0\xe4\x02\x20",
+        (1, RAW, SHA2_256): b"\x01\x55\x12\x20",
+        (1, DAG_CBOR, SHA2_256): b"\x01\x71\x12\x20",
+    }
+
     def to_bytes(self) -> bytes:
         cached = self.__dict__.get("_bytes")
         if cached is None:
-            cached = (
-                encode_uvarint(self.version)
-                + encode_uvarint(self.codec)
-                + encode_uvarint(self.mh_code)
-                + encode_uvarint(len(self.digest))
-                + self.digest
+            prefix = (
+                self._PREFIXES.get((self.version, self.codec, self.mh_code))
+                if len(self.digest) == 32
+                else None
             )
+            if prefix is not None:
+                cached = prefix + self.digest
+            else:
+                cached = (
+                    encode_uvarint(self.version)
+                    + encode_uvarint(self.codec)
+                    + encode_uvarint(self.mh_code)
+                    + encode_uvarint(len(self.digest))
+                    + self.digest
+                )
             object.__setattr__(self, "_bytes", cached)  # frozen-safe memo
         return cached
 

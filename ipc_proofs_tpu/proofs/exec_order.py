@@ -124,15 +124,14 @@ def _unpack_groups(out: dict, n_groups: int) -> list[_GroupView]:
     layout."""
     import numpy as np
 
+    from ipc_proofs_tpu.proofs.scan_native import split_pooled
+
     def slices(prefix):
-        off = np.frombuffer(out[f"{prefix}_off"], "<i4")
-        ln = np.frombuffer(out[f"{prefix}_len"], "<i4")
         goff = np.frombuffer(out[f"{prefix}_goff"], "<i4")
-        pool = out[f"{prefix}_pool"]
-        return [
-            [pool[off[t] : off[t] + ln[t]] for t in range(goff[g], goff[g + 1])]
-            for g in range(n_groups)
-        ], goff
+        flat = split_pooled(
+            out[f"{prefix}_pool"], out[f"{prefix}_off"], out[f"{prefix}_len"]
+        )
+        return [flat[goff[g] : goff[g + 1]] for g in range(n_groups)], goff
 
     msgs, _ = slices("msg")
     touched, _ = slices("touch")

@@ -250,11 +250,18 @@ def generate_event_proofs_for_range(
         if native is not None:
             event_proofs, witness_bytes = native
             from ipc_proofs_tpu.core.cid import CID
+            from ipc_proofs_tpu.proofs.scan_native import _raw_view
 
+            # materialize through the raw byte-keyed map (one dict probe per
+            # block) — the CID-keyed store path costs a hash+eq per block on
+            # freshly parsed CID objects
+            raw_map, _ = _raw_view(cached)
             blocks = []
             for cid_bytes in sorted(witness_bytes):
+                raw = raw_map.get(cid_bytes)
                 cid = CID.from_bytes(cid_bytes)
-                raw = cached.get(cid)
+                if raw is None:
+                    raw = cached.get(cid)
                 if raw is None:
                     raise KeyError(f"missing witness block {cid}")
                 blocks.append(ProofBlock(cid=cid, data=raw))

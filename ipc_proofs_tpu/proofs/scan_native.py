@@ -33,7 +33,25 @@ __all__ = [
     "record_receipt_paths",
     "native_scan_available",
     "topic_fingerprint",
+    "split_pooled",
 ]
+
+
+def split_pooled(pool: bytes, off, ln) -> list[bytes]:
+    """Materialize every item of a pooled (pool, i32 offsets, i32 lengths)
+    walker output as bytes — one C call when the extension provides
+    ``split_pool``, else a Python slice loop. ``off``/``ln`` may be byte
+    buffers or little-endian i32 numpy arrays."""
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    off_b = off.tobytes() if isinstance(off, np.ndarray) else off
+    ln_b = ln.tobytes() if isinstance(ln, np.ndarray) else ln
+    ext = load_scan_ext()
+    if ext is not None and hasattr(ext, "split_pool"):
+        return ext.split_pool(pool, off_b, ln_b)
+    off_a = np.frombuffer(off_b, "<i4")
+    ln_a = np.frombuffer(ln_b, "<i4")
+    return [bytes(pool[o : o + n]) for o, n in zip(off_a, ln_a)]
 
 _FP_SEED = 0x9E3779B97F4A7C15
 _FP_MULT = 0xFF51AFD7ED558CCD
@@ -134,15 +152,17 @@ class RecordBatch:
     _touch_off: np.ndarray
     _touch_len: np.ndarray
     _touch_goff: np.ndarray
+    _touch_items: "Optional[list[bytes]]" = None  # lazy one-call split
 
     def touched(self, group: int) -> list[bytes]:
         """Raw CID bytes of every block pass 2 fetched for ``group``
         (receipts-AMT root + targeted paths + full events-AMT walks)."""
+        if self._touch_items is None:
+            self._touch_items = split_pooled(
+                self._touch_pool, self._touch_off, self._touch_len
+            )
         lo, hi = int(self._touch_goff[group]), int(self._touch_goff[group + 1])
-        return [
-            bytes(self._touch_pool[self._touch_off[t] : self._touch_off[t] + self._touch_len[t]])
-            for t in range(lo, hi)
-        ]
+        return self._touch_items[lo:hi]
 
     def rows(self, group: int) -> tuple[int, int]:
         """Half-open row range of ``group``'s events in ``batch`` (rows are

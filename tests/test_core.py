@@ -101,6 +101,18 @@ class TestCID:
         parsed = CID.from_string(s)
         assert parsed.digest == c.digest
 
+    def test_to_bytes_canonicalizes_nonminimal_varint_input(self):
+        # decode_uvarint accepts non-minimal varints; to_bytes must re-encode
+        # canonically rather than echo the malleable input back (two byte
+        # forms for one logical CID would diverge across byte-keyed maps)
+        canonical = CID.hash_of(b"payload")
+        raw = canonical.to_bytes()
+        assert raw[:2] == b"\x01\x71"
+        nonminimal = b"\x01\xf1\x00" + raw[2:]  # codec 0x71 as two bytes
+        parsed = CID.from_bytes(nonminimal)
+        assert parsed == canonical
+        assert parsed.to_bytes() == raw  # canonical, NOT the 39-byte input
+
 
 class TestDagCbor:
     @pytest.mark.parametrize(

@@ -348,3 +348,35 @@ class TestFingerprint:
         )[: batch.n_events]
         assert (full == fp).all()
         assert fp.tolist() == [True, False, False, False]
+
+
+class TestSplitPooled:
+    def test_matches_python_slicing(self):
+        import numpy as np
+
+        from ipc_proofs_tpu.proofs.scan_native import split_pooled
+
+        items = [b"", b"a", b"hello", b"x" * 100]
+        pool = b"".join(items)
+        off, pos = [], 0
+        for it in items:
+            off.append(pos)
+            pos += len(it)
+        off_a = np.asarray(off, dtype="<i4")
+        len_a = np.asarray([len(it) for it in items], dtype="<i4")
+        assert split_pooled(pool, off_a, len_a) == items
+        assert split_pooled(pool, off_a.tobytes(), len_a.tobytes()) == items
+
+    def test_native_rejects_misaligned_buffers(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if ext is None or not hasattr(ext, "split_pool"):
+            pytest.skip("native split_pool unavailable")
+        with pytest.raises(ValueError):
+            ext.split_pool(b"abc", b"\x00" * 7, b"\x00" * 5)  # not i32-aligned
+        with pytest.raises(ValueError):
+            ext.split_pool(b"abc", b"\x00" * 8, b"\x00" * 4)  # length mismatch
+        with pytest.raises(ValueError):
+            # out-of-bounds slice must raise, not read past the pool
+            ext.split_pool(b"abc", (0).to_bytes(4, "little"), (9).to_bytes(4, "little"))
