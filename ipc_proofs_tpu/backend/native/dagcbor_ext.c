@@ -84,7 +84,7 @@ static PyObject *parse_item(Parser *p) {
         return result;
       }
     case 2: { /* bytes */
-      if (p->pos + (Py_ssize_t)value > p->len) {
+      if ((uint64_t)(p->len - p->pos) < value) {
         PyErr_SetString(PyExc_ValueError, "truncated CBOR bytes");
         return NULL;
       }
@@ -94,7 +94,7 @@ static PyObject *parse_item(Parser *p) {
       return b;
     }
     case 3: { /* text */
-      if (p->pos + (Py_ssize_t)value > p->len) {
+      if ((uint64_t)(p->len - p->pos) < value) {
         PyErr_SetString(PyExc_ValueError, "truncated CBOR text");
         return NULL;
       }
@@ -197,6 +197,232 @@ static PyObject *parse_item(Parser *p) {
   return NULL;
 }
 
+/* ---------------- validating skip (no object materialization) ----------
+ *
+ * skip_item walks exactly the grammar parse_item accepts — including
+ * strict UTF-8 text validation, string-keyed maps, tag-42 CID byte
+ * validation (mirroring CID.from_bytes), and the same error ordering —
+ * without building Python objects. Used by decode_header to skip the
+ * block-header fields verification never reads. */
+
+static int utf8_valid(const uint8_t *s, Py_ssize_t n) {
+  Py_ssize_t i = 0;
+  while (i < n) {
+    uint8_t c = s[i];
+    if (c < 0x80) {
+      i++;
+    } else if (c < 0xC2) { /* bare continuation / overlong C0-C1 */
+      return 0;
+    } else if (c < 0xE0) { /* 2-byte */
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return 0;
+      i += 2;
+    } else if (c < 0xF0) { /* 3-byte */
+      if (i + 2 >= n || (s[i + 1] & 0xC0) != 0x80 || (s[i + 2] & 0xC0) != 0x80)
+        return 0;
+      if (c == 0xE0 && s[i + 1] < 0xA0) return 0; /* overlong */
+      if (c == 0xED && s[i + 1] >= 0xA0) return 0; /* surrogate */
+      i += 3;
+    } else if (c < 0xF5) { /* 4-byte */
+      if (i + 3 >= n || (s[i + 1] & 0xC0) != 0x80 ||
+          (s[i + 2] & 0xC0) != 0x80 || (s[i + 3] & 0xC0) != 0x80)
+        return 0;
+      if (c == 0xF0 && s[i + 1] < 0x90) return 0; /* overlong */
+      if (c == 0xF4 && s[i + 1] >= 0x90) return 0; /* > U+10FFFF */
+      i += 4;
+    } else {
+      return 0;
+    }
+  }
+  return 1;
+}
+
+/* unsigned LEB128, mirroring core/varint.py decode_uvarint exactly:
+ * at most 10 bytes (shift > 63 after a continuation byte errors), 128-bit
+ * accumulation so oversized values compare/fail like Python's bignums. */
+static int cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
+                       unsigned __int128 *out) {
+  unsigned __int128 value = 0;
+  int shift = 0;
+  for (;;) {
+    if (*pos >= n) return -1; /* truncated uvarint */
+    uint8_t b = d[(*pos)++];
+    value |= (unsigned __int128)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = value;
+      return 0;
+    }
+    shift += 7;
+    if (shift > 63) return -1; /* uvarint too long */
+  }
+}
+
+/* CID byte validation with CID.from_bytes acceptance: CIDv1 only, varint
+ * (codec, mh_code, mh_len) prefix, digest exactly mh_len bytes, nothing
+ * trailing. */
+static int cid_bytes_valid(const uint8_t *d, Py_ssize_t n) {
+  Py_ssize_t pos = 0;
+  unsigned __int128 version, codec, mh_code, mh_len;
+  if (cid_uvarint(d, n, &pos, &version) < 0 || version != 1) return 0;
+  if (cid_uvarint(d, n, &pos, &codec) < 0) return 0;
+  if (cid_uvarint(d, n, &pos, &mh_code) < 0) return 0;
+  if (cid_uvarint(d, n, &pos, &mh_len) < 0) return 0;
+  return (unsigned __int128)(n - pos) == mh_len;
+}
+
+static int skip_item(Parser *p) {
+  int major;
+  uint64_t value;
+  int info = parse_head(p, &major, &value);
+  if (info < 0) return -1;
+  switch (major) {
+    case 0:
+    case 1:
+      return 0;
+    case 2:
+      if ((uint64_t)(p->len - p->pos) < value) {
+        PyErr_SetString(PyExc_ValueError, "truncated CBOR bytes");
+        return -1;
+      }
+      p->pos += (Py_ssize_t)value;
+      return 0;
+    case 3:
+      if ((uint64_t)(p->len - p->pos) < value) {
+        PyErr_SetString(PyExc_ValueError, "truncated CBOR text");
+        return -1;
+      }
+      if (!utf8_valid(p->data + p->pos, (Py_ssize_t)value)) {
+        PyErr_SetString(PyExc_ValueError, "invalid UTF-8 in CBOR text");
+        return -1;
+      }
+      p->pos += (Py_ssize_t)value;
+      return 0;
+    case 4:
+      if ((uint64_t)p->len - p->pos < value) {
+        PyErr_SetString(PyExc_ValueError, "CBOR array length exceeds input");
+        return -1;
+      }
+      for (uint64_t i = 0; i < value; i++)
+        if (skip_item(p) < 0) return -1;
+      return 0;
+    case 5:
+      for (uint64_t i = 0; i < value; i++) {
+        /* key: inner grammar errors surface first (parse_item parses the
+         * key before its string-ness check), then the type check */
+        Py_ssize_t key_at = p->pos;
+        if (skip_item(p) < 0) return -1;
+        if ((p->data[key_at] >> 5) != 3) {
+          PyErr_SetString(PyExc_ValueError, "DAG-CBOR map keys must be strings");
+          return -1;
+        }
+        if (skip_item(p) < 0) return -1;
+      }
+      return 0;
+    case 6: {
+      if (value != 42) {
+        PyErr_Format(PyExc_ValueError, "unsupported CBOR tag %llu",
+                     (unsigned long long)value);
+        return -1;
+      }
+      Py_ssize_t inner_at = p->pos;
+      int imajor;
+      uint64_t ival;
+      if (parse_head(p, &imajor, &ival) < 0) return -1;
+      if (imajor != 2) {
+        /* parse the non-bytes item for error ordering, then reject */
+        p->pos = inner_at;
+        if (skip_item(p) < 0) return -1;
+        PyErr_SetString(PyExc_ValueError,
+                        "tag-42 content must be identity-multibase CID bytes");
+        return -1;
+      }
+      if ((uint64_t)(p->len - p->pos) < ival) {
+        PyErr_SetString(PyExc_ValueError, "truncated CBOR bytes");
+        return -1;
+      }
+      const uint8_t *content = p->data + p->pos;
+      p->pos += (Py_ssize_t)ival;
+      if (ival < 1 || content[0] != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "tag-42 content must be identity-multibase CID bytes");
+        return -1;
+      }
+      if (!cid_bytes_valid(content + 1, (Py_ssize_t)ival - 1)) {
+        PyErr_SetString(PyExc_ValueError, "malformed CID bytes in tag 42");
+        return -1;
+      }
+      return 0;
+    }
+    case 7:
+      if (info == 27 || value == 20 || value == 21 || value == 22) return 0;
+      PyErr_Format(PyExc_ValueError, "unsupported CBOR simple value %llu",
+                   (unsigned long long)value);
+      return -1;
+  }
+  PyErr_SetString(PyExc_ValueError, "unreachable CBOR major type");
+  return -1;
+}
+
+/* Header fields verification reads (BlockHeader.decode's named fields):
+ * 5 parents, 6 parent_weight, 7 height, 8 parent_state_root,
+ * 9 parent_message_receipts, 10 messages, 12 timestamp, 14 fork_signaling.
+ * The rest are validated (skip_item) but returned as None. */
+static const char header_keep[16] = {0, 0, 0, 0, 0, 1, 1, 1,
+                                     1, 1, 1, 0, 1, 0, 1, 0};
+
+static PyObject *py_decode_header(PyObject *self, PyObject *arg) {
+  (void)self;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  Parser p = {(const uint8_t *)view.buf, view.len, 0};
+  PyObject *result = NULL;
+  int major;
+  uint64_t value;
+  int info = parse_head(&p, &major, &value);
+  if (info < 0) goto done;
+  if (major != 4 || value != 16) {
+    /* match BlockHeader.decode over the full decoder: grammar errors (and
+     * trailing-bytes errors) surface first, then the shape rejection */
+    Parser q = {(const uint8_t *)view.buf, view.len, 0};
+    if (skip_item(&q) < 0) goto done;
+    if (q.pos != q.len) {
+      PyErr_Format(PyExc_ValueError, "trailing bytes after CBOR item (%zd bytes)",
+                   (Py_ssize_t)(q.len - q.pos));
+      goto done;
+    }
+    PyErr_SetString(PyExc_ValueError, "block header is not a 16-tuple");
+    goto done;
+  }
+  if ((uint64_t)view.len - p.pos < value) {
+    PyErr_SetString(PyExc_ValueError, "CBOR array length exceeds input");
+    goto done;
+  }
+  result = PyList_New(16);
+  if (!result) goto done;
+  for (int i = 0; i < 16; i++) {
+    PyObject *item;
+    if (header_keep[i]) {
+      item = parse_item(&p);
+    } else {
+      item = skip_item(&p) < 0 ? NULL : Py_NewRef(Py_None);
+    }
+    if (!item) {
+      Py_DECREF(result);
+      result = NULL;
+      goto done;
+    }
+    PyList_SET_ITEM(result, i, item);
+  }
+  if (p.pos != p.len) {
+    Py_DECREF(result);
+    result = NULL;
+    PyErr_Format(PyExc_ValueError, "trailing bytes after CBOR item (%zd bytes)",
+                 (Py_ssize_t)(p.len - p.pos));
+  }
+done:
+  PyBuffer_Release(&view);
+  return result;
+}
+
 static PyObject *py_decode(PyObject *self, PyObject *arg) {
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
@@ -249,6 +475,9 @@ static PyMethodDef methods[] = {
     {"decode", py_decode, METH_O, "Decode one DAG-CBOR item from bytes."},
     {"decode_many", py_decode_many, METH_O,
      "Decode a sequence of DAG-CBOR byte strings."},
+    {"decode_header", py_decode_header, METH_O,
+     "Decode a 16-field block header, materializing only the fields "
+     "verification reads (others validated and returned as None)."},
     {"set_cid_factory", py_set_cid_factory, METH_O,
      "Register callable(bytes)->CID used for tag-42 links."},
     {NULL, NULL, 0, NULL}};

@@ -81,6 +81,17 @@ class CID:
     # --- constructors ------------------------------------------------------
 
     @classmethod
+    def _make(cls, version: int, codec: int, mh_code: int, digest: bytes) -> "CID":
+        """Internal fast constructor: a frozen dataclass pays four
+        ``object.__setattr__`` calls per init, which dominates bulk decode
+        paths creating tens of thousands of CIDs per range."""
+        out = object.__new__(cls)
+        out.__dict__.update(
+            version=version, codec=codec, mh_code=mh_code, digest=digest
+        )
+        return out
+
+    @classmethod
     def hash_of(cls, data: bytes, codec: int = DAG_CBOR, mh_code: int = BLAKE2B_256) -> "CID":
         """CID of raw block bytes (the Filecoin chain default: blake2b-256)."""
         if mh_code == BLAKE2B_256:
@@ -108,11 +119,11 @@ class CID:
         # non-canonical input would make to_bytes malleable (two byte forms
         # for one logical CID diverging across byte-keyed maps and claims).
         if len(raw) == 38 and raw[1] == 0x71 and raw[:6] == b"\x01\x71\xa0\xe4\x02\x20":
-            out = cls(1, DAG_CBOR, BLAKE2B_256, raw[6:])
+            out = cls._make(1, DAG_CBOR, BLAKE2B_256, raw[6:])
         elif len(raw) == 38 and raw[:6] == b"\x01\x55\xa0\xe4\x02\x20":
-            out = cls(1, RAW, BLAKE2B_256, raw[6:])
+            out = cls._make(1, RAW, BLAKE2B_256, raw[6:])
         elif len(raw) == 36 and raw[:4] == b"\x01\x55\x12\x20":
-            out = cls(1, RAW, SHA2_256, raw[4:])
+            out = cls._make(1, RAW, SHA2_256, raw[4:])
         else:
             version, off = decode_uvarint(raw)
             if version != 1:
@@ -125,8 +136,8 @@ class CID:
                 raise ValueError("truncated CID multihash digest")
             if off + mh_len != len(raw):
                 raise ValueError("trailing bytes after CID")
-            return cls(version, codec, mh_code, digest)
-        object.__setattr__(out, "_bytes", bytes(raw))
+            return cls._make(version, codec, mh_code, digest)
+        out.__dict__["_bytes"] = bytes(raw)
         return out
 
     @classmethod

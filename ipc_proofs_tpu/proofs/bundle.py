@@ -36,6 +36,15 @@ class ProofBlock:
     cid: CID
     data: bytes
 
+    @classmethod
+    def _make(cls, cid: CID, data: bytes) -> "ProofBlock":
+        """Fast constructor: the frozen-dataclass init pays one
+        ``object.__setattr__`` per field, which adds up across the thousands
+        of blocks a range witness materializes."""
+        out = object.__new__(cls)
+        out.__dict__.update(cid=cid, data=data)
+        return out
+
     def to_json_obj(self) -> dict:
         return {"cid": str(self.cid), "data": base64.b64encode(self.data).decode("ascii")}
 

@@ -132,7 +132,9 @@ def _verify_proofs_batch(
             raw = store.get(cid)
             if raw is None:
                 raise KeyError(f"missing {kind} header in witness")
-            header = BlockHeader.decode(raw)
+            # verification never re-encodes headers; the lite decode skips
+            # materializing the opaque fields with identical acceptance
+            header = BlockHeader.decode_lite(raw)
             header_cache[cid] = header
         return header
 

@@ -173,8 +173,9 @@ def reconstruct_execution_orders_batch(
 
     Parity with the scalar path is enforced in Python on top of the C walk:
 
-    - every parent header is re-decoded with `BlockHeader.decode` (the C
-      parser only extracts the messages field; the scalar path's strict
+    - every parent header is re-decoded with `BlockHeader.decode_lite`
+      (acceptance-identical to the full decode — the C walker here only
+      extracts the messages field; the scalar path's strict
       16-tuple/CID/trailing-byte validation must still reject what it
       rejects), and its ``messages`` must equal the C-reported TxMeta CID;
     - TxMeta CID recomputation: the scalar path recomputes
@@ -209,7 +210,7 @@ def reconstruct_execution_orders_batch(
                     if raw is None:
                         ok = False
                         break
-                    header = BlockHeader.decode(raw)
+                    header = BlockHeader.decode_lite(raw)
                     if header_cache is not None:
                         header_cache[cid] = header
                 expected_txmetas.append(header.messages.to_bytes())

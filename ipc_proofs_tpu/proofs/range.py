@@ -256,15 +256,17 @@ def generate_event_proofs_for_range(
             # block) — the CID-keyed store path costs a hash+eq per block on
             # freshly parsed CID objects
             raw_map, _ = _raw_view(cached)
+            from_bytes = CID.from_bytes
+            make_block = ProofBlock._make
             blocks = []
             for cid_bytes in sorted(witness_bytes):
                 raw = raw_map.get(cid_bytes)
-                cid = CID.from_bytes(cid_bytes)
+                cid = from_bytes(cid_bytes)
                 if raw is None:
                     raw = cached.get(cid)
                 if raw is None:
                     raise KeyError(f"missing witness block {cid}")
-                blocks.append(ProofBlock(cid=cid, data=raw))
+                blocks.append(make_block(cid, raw))
         else:
             event_proofs = []
             all_blocks: set[ProofBlock] = set()

@@ -19,6 +19,9 @@ from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
 
 __all__ = ["BlockHeader", "extract_parent_state_root"]
 
+# memoized native decode_header entry (None = untried, False = unavailable)
+_decode_header = None
+
 
 @dataclass
 class BlockHeader:
@@ -47,9 +50,41 @@ class BlockHeader:
         fields = cbor_decode(raw)
         if not (isinstance(fields, list) and len(fields) == 16):
             raise ValueError(f"block header must be a 16-tuple, got {type(fields)}")
+        return cls._from_fields(fields)
+
+    @classmethod
+    def decode_lite(cls, raw: bytes) -> "BlockHeader":
+        """Verification-only decode: identical acceptance to :meth:`decode`
+        (the C ``decode_header`` walks the full grammar in validating-skip
+        mode, including strict UTF-8, map-key, and tag-42 CID byte checks),
+        but the opaque fields (ticket, election proof, beacon entries,
+        signatures, …) come back as ``None`` instead of being materialized.
+        The returned header must NOT be re-encoded — ``encode()`` would emit
+        nulls where the opaque payloads were. Falls back to the full decode
+        when the extension is unavailable. Differential acceptance is
+        covered by tests/test_state.py."""
+        global _decode_header
+        if _decode_header is None:
+            from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
+            ext = load_dagcbor_ext()
+            _decode_header = (
+                ext.decode_header
+                if ext is not None and hasattr(ext, "decode_header")
+                else False
+            )
+        if _decode_header is False:
+            return cls.decode(raw)
+        return cls._from_fields(_decode_header(raw))
+
+    @classmethod
+    def _from_fields(cls, fields: list) -> "BlockHeader":
         parents = fields[5]
-        if not (isinstance(parents, list) and all(isinstance(c, CID) for c in parents)):
+        if not isinstance(parents, list):
             raise ValueError("header parents must be a CID list")
+        for c in parents:
+            if not isinstance(c, CID):
+                raise ValueError("header parents must be a CID list")
         for idx, name in ((8, "parent_state_root"), (9, "parent_message_receipts"), (10, "messages")):
             if not isinstance(fields[idx], CID):
                 raise ValueError(f"header field {name} must be a CID")

@@ -105,6 +105,91 @@ class TestHeader:
     def test_cid_stable(self):
         assert self._header().cid() == self._header().cid()
 
+    def test_decode_lite_matches_decode_on_valid_headers(self):
+        h = self._header()
+        raw = h.encode()
+        lite = BlockHeader.decode_lite(raw)
+        full = BlockHeader.decode(raw)
+        for name in (
+            "parents",
+            "height",
+            "parent_state_root",
+            "parent_message_receipts",
+            "messages",
+            "timestamp",
+            "fork_signaling",
+            "parent_weight",
+        ):
+            assert getattr(lite, name) == getattr(full, name), name
+
+    def test_decode_lite_acceptance_differential(self):
+        """decode_lite must accept/reject EXACTLY what decode does — checked
+        over the valid header, every 1-byte truncation, several hundred
+        random byte flips, and structurally interesting corruptions."""
+        import random
+
+        import pytest
+
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "decode_header"):
+            # without the native path decode_lite IS decode and the
+            # differential would compare decode against itself
+            pytest.skip("native decode_header unavailable")
+
+        raw = self._header().encode()
+        cases = [raw, raw + b"\x00"]  # valid + trailing byte
+        cases += [raw[:k] for k in range(len(raw))]  # every truncation
+        rng = random.Random(12345)
+        for _ in range(400):
+            mutated = bytearray(raw)
+            for _ in range(rng.randint(1, 3)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            cases.append(bytes(mutated))
+        # structurally interesting: non-list, short list, bad utf-8 text,
+        # non-string map key, f16, bad CID bytes in a tag
+        cases.append(cbor_encode({"a": 1}))
+        cases.append(cbor_encode([1, 2, 3]))
+        cases.append(b"\x81\x63\xed\xa0\x80")  # [text(3) = lone surrogate]
+        cases.append(b"\xa1\x01\x02")  # {1: 2} — int map key
+        cases.append(b"\x81\xf9\x00\x14")  # [f16] — the decoder's quirk path
+        cases.append(b"\x81\xd8\x2a\x44\x00\x01\x02\x03")  # bad CID bytes
+        cases.append(b"\x81\xd8\x2b\x41\x00")  # tag 43
+        cases.append(b"\x81\xd8\x2a\x81\x01")  # tag-42 over non-bytes
+        # u64-length overflow probes (must error, never crash): a 16-array
+        # whose first skipped field declares bytes/text of length 2^63+
+        for head in (b"\x5b", b"\x7b", b"\xd8\x2a\x5b"):
+            cases.append(
+                b"\x90" + head + b"\x80" + b"\x00" * 7 + b"\x00" * 15
+            )
+
+        agree = 0
+        for case in cases:
+            try:
+                full = BlockHeader.decode(case)
+                full_err = None
+            except (ValueError, KeyError) as e:
+                full, full_err = None, type(e)
+            try:
+                lite = BlockHeader.decode_lite(case)
+                lite_err = None
+            except (ValueError, KeyError) as e:
+                lite, lite_err = None, type(e)
+            if full_err is not None:
+                assert lite_err is not None, (
+                    f"decode rejected but decode_lite accepted: {case.hex()}"
+                )
+            else:
+                assert lite_err is None, (
+                    f"decode accepted but decode_lite rejected ({lite_err}): {case.hex()}"
+                )
+                assert lite.parents == full.parents
+                assert lite.height == full.height
+                agree += 1
+        assert agree >= 1  # the valid header at minimum
+
 
 class TestActors:
     def test_state_root_roundtrip(self):
