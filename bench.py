@@ -104,6 +104,44 @@ def _scalar_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
     return n / elapsed if elapsed > 0 else 0.0
 
 
+def _native_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
+    """Language-fair baseline (proofs/s): the REFERENCE ARCHITECTURE — one
+    (parent, child) pair per invocation, sequential over pairs
+    (`src/proofs/generator.rs:43-78` runs specs in a plain loop) — but with
+    every hot primitive on the same compiled C paths this framework uses
+    (native scanner, native pass-2 walkers, C++ batch hashes, C dag-cbor).
+    What it deliberately lacks is the range-level design: cross-pair
+    batching, one fused match over the whole range, range-wide witness
+    dedup, and phase overlap. ``vs_native_baseline`` therefore isolates the
+    architectural win from the Python-vs-compiled language gap that
+    ``vs_baseline`` (scalar Python reference loop) folds in."""
+    from ipc_proofs_tpu.backend import get_backend
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+
+    bs, pairs, _ = build_range_world(
+        n_pairs_sample, receipts, events, base_height=20_000_000
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
+    cpu = get_backend("cpu")
+    # warm the native extensions (build/load outside the measured region)
+    generate_event_proofs_for_range(bs, [pairs[0]], spec, match_backend=cpu)
+    start = time.perf_counter()
+    n = 0
+    for pair in pairs:  # one pair per invocation, like the reference binary
+        bundle = generate_event_proofs_for_range(bs, [pair], spec, match_backend=cpu)
+        result = verify_proof_bundle(
+            bundle, TrustPolicy.accept_all(), verify_witness_cids=True
+        )
+        assert result.all_valid()
+        n += len(bundle.event_proofs)
+    elapsed = time.perf_counter() - start
+    return n / elapsed if elapsed > 0 else 0.0
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--platform", default="auto", help="auto|default|cpu")
@@ -144,7 +182,7 @@ def main() -> None:
     from ipc_proofs_tpu.backend import get_backend
     from ipc_proofs_tpu.fixtures import build_range_world
     from ipc_proofs_tpu.proofs.generator import EventProofSpec
-    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
     from ipc_proofs_tpu.utils.metrics import Metrics
 
     # --- build the range world (setup, not measured) ------------------------
@@ -163,8 +201,15 @@ def main() -> None:
     backend = get_backend("tpu")
 
     # --- warmup: compile every jit kernel at the measurement shapes ---------
+    # generation runs the phase-overlapped chunked driver (scan chunk k+1 on
+    # a worker thread while chunk k records) — measured faster than the flat
+    # driver even on a single-core host (smaller per-chunk working sets),
+    # and bit-identical (tests/test_range.py)
+    chunk_size = 1024
     t0 = time.perf_counter()
-    bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
+    bundle = generate_event_proofs_for_range_pipelined(
+        bs, pairs, spec, chunk_size=chunk_size, match_backend=backend
+    )
     results, _ = _staged_verify(bundle, backend)
     assert all(results) and len(results) == len(bundle.event_proofs)
     _log(f"bench: warmup (incl. jit compile) {time.perf_counter() - t0:.1f}s")
@@ -178,8 +223,8 @@ def main() -> None:
         gc.collect()
         metrics = Metrics()
         t_gen0 = time.perf_counter()
-        bundle = generate_event_proofs_for_range(
-            bs, pairs, spec, match_backend=backend, metrics=metrics
+        bundle = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=chunk_size, match_backend=backend, metrics=metrics
         )
         t_gen = time.perf_counter() - t_gen0
         results, vstages = _staged_verify(bundle, backend)
@@ -191,6 +236,9 @@ def main() -> None:
     n_proofs = len(bundle.event_proofs)
     t_e2e = t_gen + t_verify
 
+    # NOTE: generation stages overlap under the pipelined driver (chunk k+1
+    # scans on a worker thread while chunk k records), so scan+match+record
+    # can exceed the generation wall time; e2e/proofs_per_sec are wall.
     gtimers = json.loads(metrics.to_json())["timers"]
     stages = {
         "scan": gtimers.get("range_scan", {}).get("total_s", 0.0),
@@ -228,6 +276,30 @@ def main() -> None:
         f"proofs/s e2e (measured in {time.perf_counter() - t0:.1f}s)"
     )
 
+    # --- language-fair native baseline (reference architecture at C speed) --
+    t0 = time.perf_counter()
+    native_baseline = _native_baseline(
+        min(args.baseline_pairs, args.tipsets), args.receipts, args.events
+    )
+    _log(
+        f"bench: native (C-primitive, per-pair) reference-architecture "
+        f"baseline ≈ {native_baseline:,.1f} proofs/s e2e "
+        f"(measured in {time.perf_counter() - t0:.1f}s)"
+    )
+
+    import os as _os
+
+    host_cores = len(_os.sched_getaffinity(0)) if hasattr(_os, "sched_getaffinity") else _os.cpu_count()
+    # ask the scanner itself (C scan_threads_default) rather than re-deriving
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    _scan_ext = load_scan_ext()
+    scan_threads = (
+        int(_scan_ext.scan_threads())
+        if _scan_ext is not None and hasattr(_scan_ext, "scan_threads")
+        else None
+    )
+
     print(
         json.dumps(
             {
@@ -237,9 +309,18 @@ def main() -> None:
                 "platform": jax_platform,
                 "devices": len(jax.devices()),
                 "vs_baseline": round(proofs_per_sec / baseline, 2) if baseline > 0 else None,
+                "vs_native_baseline": round(proofs_per_sec / native_baseline, 2)
+                if native_baseline > 0
+                else None,
+                "host_cores": host_cores,
+                "scan_threads": scan_threads,
+                "pipeline_chunk": chunk_size,
                 "events_per_sec_e2e": round(events_per_sec, 1),
                 "proofs": n_proofs,
+                # generation stages overlap across pipeline threads; their
+                # sum may exceed the e2e wall the headline rate is based on
                 "stages_ms": {k: round(v * 1000, 1) for k, v in stages.items()},
+                "stages_overlap": True,
                 "device_mask_kernel_events_per_sec": kernel_rate,
                 "witness_cid_kernel_per_sec": cid_rate,
             }

@@ -20,11 +20,25 @@
 
 static PyObject *cid_factory = NULL; /* callable(bytes) -> CID */
 
+/* Nesting cap for the recursive walkers: attacker-controlled witness
+ * bytes must exhaust a counter, not the C stack. Real chain objects nest
+ * < 20 deep; the pure-Python decoder enforces the same bound. */
+#define MAX_CBOR_DEPTH 512
+
 typedef struct {
   const uint8_t *data;
   Py_ssize_t len;
   Py_ssize_t pos;
+  int depth;
 } Parser;
+
+static int depth_enter(Parser *p) {
+  if (++p->depth > MAX_CBOR_DEPTH) {
+    PyErr_SetString(PyExc_ValueError, "CBOR nesting too deep");
+    return -1;
+  }
+  return 0;
+}
 
 static PyObject *parse_item(Parser *p);
 
@@ -62,7 +76,16 @@ static int parse_head(Parser *p, int *major, uint64_t *value) {
   return info;
 }
 
+static PyObject *parse_item_inner(Parser *p);
+
 static PyObject *parse_item(Parser *p) {
+  if (depth_enter(p) < 0) return NULL;
+  PyObject *out = parse_item_inner(p);
+  p->depth--;
+  return out;
+}
+
+static PyObject *parse_item_inner(Parser *p) {
   int major;
   uint64_t value;
   int info = parse_head(p, &major, &value);
@@ -269,7 +292,16 @@ static int cid_bytes_valid(const uint8_t *d, Py_ssize_t n) {
   return (unsigned __int128)(n - pos) == mh_len;
 }
 
+static int skip_item_inner(Parser *p);
+
 static int skip_item(Parser *p) {
+  if (depth_enter(p) < 0) return -1;
+  int rc = skip_item_inner(p);
+  p->depth--;
+  return rc;
+}
+
+static int skip_item_inner(Parser *p) {
   int major;
   uint64_t value;
   int info = parse_head(p, &major, &value);
@@ -373,7 +405,7 @@ static PyObject *py_decode_header(PyObject *self, PyObject *arg) {
   (void)self;
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
-  Parser p = {(const uint8_t *)view.buf, view.len, 0};
+  Parser p = {(const uint8_t *)view.buf, view.len, 0, 0};
   PyObject *result = NULL;
   int major;
   uint64_t value;
@@ -382,7 +414,7 @@ static PyObject *py_decode_header(PyObject *self, PyObject *arg) {
   if (major != 4 || value != 16) {
     /* match BlockHeader.decode over the full decoder: grammar errors (and
      * trailing-bytes errors) surface first, then the shape rejection */
-    Parser q = {(const uint8_t *)view.buf, view.len, 0};
+    Parser q = {(const uint8_t *)view.buf, view.len, 0, 0};
     if (skip_item(&q) < 0) goto done;
     if (q.pos != q.len) {
       PyErr_Format(PyExc_ValueError, "trailing bytes after CBOR item (%zd bytes)",
@@ -426,7 +458,7 @@ done:
 static PyObject *py_decode(PyObject *self, PyObject *arg) {
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
-  Parser p = {(const uint8_t *)view.buf, view.len, 0};
+  Parser p = {(const uint8_t *)view.buf, view.len, 0, 0};
   PyObject *result = parse_item(&p);
   if (result && p.pos != p.len) {
     Py_DECREF(result);

@@ -1639,7 +1639,19 @@ done:
   return out;
 }
 
+/* the effective scan fan-out (IPC_SCAN_THREADS env or core count, capped)
+ * — exposed so observability (bench JSON) reports exactly what the
+ * scanner uses instead of re-deriving it with divergent logic */
+static PyObject *py_scan_threads(PyObject *self, PyObject *noarg) {
+  (void)self;
+  (void)noarg;
+  return PyLong_FromLong(scan_threads_default());
+}
+
 static PyMethodDef methods[] = {
+    {"scan_threads", py_scan_threads, METH_NOARGS,
+     "Effective scan thread count (IPC_SCAN_THREADS env or capped core "
+     "count) — the value scan_events_batch fans out to."},
     {"split_pool", py_split_pool, METH_VARARGS,
      "split_pool(pool, off_i32, len_i32) -> list[bytes]: materialize every "
      "pooled item in one call."},

@@ -128,7 +128,16 @@ def _decode_head(data: bytes, pos: int) -> tuple[int, int, int]:
     return major, int.from_bytes(data[pos : pos + extra], "big"), pos + extra
 
 
-def _decode_item(data: bytes, pos: int) -> tuple[Any, int]:
+# Nesting cap shared with the C extension (MAX_CBOR_DEPTH): malicious
+# deeply nested input must raise ValueError, not exhaust the stack.
+_MAX_DEPTH = 512
+
+
+def _decode_item(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
+    # 0-based depth here vs the C extension's 1-based counter: >= aligns
+    # both to error at exactly the same nesting level
+    if depth >= _MAX_DEPTH:
+        raise ValueError("CBOR nesting too deep")
     head_start = pos
     major, value, pos = _decode_head(data, pos)
     if major == _MAJOR_UINT:
@@ -148,22 +157,22 @@ def _decode_item(data: bytes, pos: int) -> tuple[Any, int]:
     if major == _MAJOR_ARRAY:
         items = []
         for _ in range(value):
-            item, pos = _decode_item(data, pos)
+            item, pos = _decode_item(data, pos, depth + 1)
             items.append(item)
         return items, pos
     if major == _MAJOR_MAP:
         result: dict[str, Any] = {}
         for _ in range(value):
-            key, pos = _decode_item(data, pos)
+            key, pos = _decode_item(data, pos, depth + 1)
             if not isinstance(key, str):
                 raise ValueError("DAG-CBOR map keys must be strings")
-            val, pos = _decode_item(data, pos)
+            val, pos = _decode_item(data, pos, depth + 1)
             result[key] = val
         return result, pos
     if major == _MAJOR_TAG:
         if value != _CID_TAG:
             raise ValueError(f"unsupported CBOR tag {value} (DAG-CBOR allows only 42)")
-        inner, pos = _decode_item(data, pos)
+        inner, pos = _decode_item(data, pos, depth + 1)
         if not isinstance(inner, bytes) or not inner.startswith(b"\x00"):
             raise ValueError("tag-42 content must be identity-multibase CID bytes")
         return CID.from_bytes(inner[1:]), pos

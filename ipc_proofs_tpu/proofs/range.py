@@ -40,6 +40,7 @@ __all__ = [
     "TipsetPair",
     "generate_event_proofs_for_range",
     "generate_event_proofs_for_range_chunked",
+    "generate_event_proofs_for_range_pipelined",
 ]
 
 
@@ -123,7 +124,32 @@ def generate_event_proofs_for_range(
     metrics = metrics or Metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
+    matching_per_pair, native_ok = _scan_and_match(
+        cached, pairs, spec, matcher, match_backend, metrics, scan_workers
+    )
+    with metrics.stage("range_record"):
+        event_proofs, blocks = _record_chunk(
+            cached, pairs, matching_per_pair, matcher, spec, native_ok
+        )
+    metrics.count("range_proofs", len(event_proofs))
+    return UnifiedProofBundle(
+        storage_proofs=[], event_proofs=event_proofs, blocks=blocks
+    )
 
+
+def _scan_and_match(
+    cached: Blockstore,
+    pairs: Sequence[TipsetPair],
+    spec: EventProofSpec,
+    matcher: EventMatcher,
+    match_backend,
+    metrics: Metrics,
+    scan_workers: int = 0,
+) -> "tuple[list[list[int]], bool]":
+    """Phases A+B: scan every pair's receipts/events, run the match
+    predicate, return (matching receipt indices per pair, whether the
+    native scan pathway ran — the record phase reuses the same fast block
+    access when it did)."""
     # Phase A: host decode of every pair's receipts + events. With a match
     # backend the native scanner emits flat tensors directly (no per-event
     # Python objects); otherwise (or if the C extension is unavailable) the
@@ -219,83 +245,151 @@ def generate_event_proofs_for_range(
                 match_receipt_indices(scanned, matcher, spec.actor_id_filter)
                 for scanned in scans
             ]
+    return matching_per_pair, scan_batch is not None
 
-    # Phase C+D: pass 2 + merged witness. Pairs with no matching receipts
-    # contribute no proofs, so their base witness (headers, TxMeta walks,
-    # exec-order blocks) is dead weight for the verifier — skip them
-    # entirely. (The reference always collects the base witness because it
-    # runs one pair per invocation, `events/generator.rs:122-145`; a range
-    # bundle's witness only needs to cover the proofs it carries.)
-    #
-    # Native path: TWO C calls cover every matching pair — the batched
-    # TxMeta/message-AMT walker (exec order + base witness) and the batched
-    # pass-2 recorder (receipts paths + events AMTs + payload-mode event
-    # arrays). Claims become a numpy mask + array slicing; the witness is a
-    # set of raw CID bytes materialized ONCE. Any failed group (or a store
-    # without a raw map, or no extension) falls back to the scalar pass 2
-    # so errors surface identically.
-    with metrics.stage("range_record"):
-        matching_pairs = [
-            (pair, matching)
-            for pair, matching in zip(pairs, matching_per_pair)
-            if matching
-        ]
-        native = None
-        # scan_batch non-None ⇒ the native extension loaded and the store
-        # exposes a raw map, so the walkers use the same fast block access
-        if matching_pairs and scan_batch is not None:
-            native = _record_pass2_native(
-                cached, matching_pairs, matcher, spec.actor_id_filter
+
+def _record_chunk(
+    cached: Blockstore,
+    pairs: Sequence[TipsetPair],
+    matching_per_pair: "list[list[int]]",
+    matcher: EventMatcher,
+    spec: EventProofSpec,
+    native_ok: bool,
+) -> "tuple[list, list[ProofBlock]]":
+    """Phase C+D: pass 2 + merged witness. Pairs with no matching receipts
+    contribute no proofs, so their base witness (headers, TxMeta walks,
+    exec-order blocks) is dead weight for the verifier — skip them
+    entirely. (The reference always collects the base witness because it
+    runs one pair per invocation, `events/generator.rs:122-145`; a range
+    bundle's witness only needs to cover the proofs it carries.)
+
+    Native path: TWO C calls cover every matching pair — the batched
+    TxMeta/message-AMT walker (exec order + base witness) and the batched
+    pass-2 recorder (receipts paths + events AMTs + payload-mode event
+    arrays). Claims become a numpy mask + array slicing; the witness is a
+    set of raw CID bytes materialized ONCE. Any failed group (or a store
+    without a raw map, or no extension) falls back to the scalar pass 2
+    so errors surface identically.
+    """
+    matching_pairs = [
+        (pair, matching)
+        for pair, matching in zip(pairs, matching_per_pair)
+        if matching
+    ]
+    native = None
+    # native_ok ⇒ the native extension loaded and the store exposes a raw
+    # map (the scan used it), so the walkers use the same fast block access
+    if matching_pairs and native_ok:
+        native = _record_pass2_native(
+            cached, matching_pairs, matcher, spec.actor_id_filter
+        )
+    if native is not None:
+        event_proofs, witness_bytes = native
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.proofs.scan_native import _raw_view
+
+        # materialize through the raw byte-keyed map (one dict probe per
+        # block) — the CID-keyed store path costs a hash+eq per block on
+        # freshly parsed CID objects
+        raw_map, _ = _raw_view(cached)
+        from_bytes = CID.from_bytes
+        make_block = ProofBlock._make
+        blocks = []
+        for cid_bytes in sorted(witness_bytes):
+            raw = raw_map.get(cid_bytes)
+            cid = from_bytes(cid_bytes)
+            if raw is None:
+                raw = cached.get(cid)
+            if raw is None:
+                raise KeyError(f"missing witness block {cid}")
+            blocks.append(make_block(cid, raw))
+    else:
+        event_proofs = []
+        all_blocks: set[ProofBlock] = set()
+        for pair, matching in matching_pairs:
+            collector = WitnessCollector(cached)
+            # one set of TxMeta walks yields both the recorded base
+            # witness and the execution order (they touch the same blocks)
+            exec_order = collect_base_witness_and_exec_order(
+                collector, cached, pair.parent, pair.child
             )
-        if native is not None:
-            event_proofs, witness_bytes = native
-            from ipc_proofs_tpu.core.cid import CID
-            from ipc_proofs_tpu.proofs.scan_native import _raw_view
+            proofs, recordings = record_matching_receipts(
+                cached,
+                pair.parent,
+                pair.child,
+                exec_order,
+                matching,
+                matcher,
+                spec.actor_id_filter,
+            )
+            collector.collect_from_recordings(recordings)
+            event_proofs.extend(proofs)
+            all_blocks.update(collector.materialize())
+        blocks = sorted(all_blocks, key=lambda b: b.cid.to_bytes())
+    return event_proofs, blocks
 
-            # materialize through the raw byte-keyed map (one dict probe per
-            # block) — the CID-keyed store path costs a hash+eq per block on
-            # freshly parsed CID objects
-            raw_map, _ = _raw_view(cached)
-            from_bytes = CID.from_bytes
-            make_block = ProofBlock._make
-            blocks = []
-            for cid_bytes in sorted(witness_bytes):
-                raw = raw_map.get(cid_bytes)
-                cid = from_bytes(cid_bytes)
-                if raw is None:
-                    raw = cached.get(cid)
-                if raw is None:
-                    raise KeyError(f"missing witness block {cid}")
-                blocks.append(make_block(cid, raw))
-        else:
-            event_proofs = []
-            all_blocks: set[ProofBlock] = set()
-            for pair, matching in matching_pairs:
-                collector = WitnessCollector(cached)
-                # one set of TxMeta walks yields both the recorded base
-                # witness and the execution order (they touch the same blocks)
-                exec_order = collect_base_witness_and_exec_order(
-                    collector, cached, pair.parent, pair.child
-                )
-                proofs, recordings = record_matching_receipts(
+
+def generate_event_proofs_for_range_pipelined(
+    store: Blockstore,
+    pairs: Sequence[TipsetPair],
+    spec: EventProofSpec,
+    chunk_size: int = 512,
+    match_backend=None,
+    metrics: Optional[Metrics] = None,
+) -> UnifiedProofBundle:
+    """Phase-overlapped range generation: the range is split into chunks
+    and chunk k+1's scan+match runs on a worker thread while chunk k
+    records on the calling thread, so the scan leg and any in-flight
+    device mask dispatch stop serializing with pass-2 recording.
+
+    Bundle output is bit-identical to the unpipelined driver over the same
+    chunking (chunks are merged in order; the witness union is CID-sorted,
+    and per-chunk claim emission order is deterministic) — enforced by
+    tests/test_range.py. Overlap pays on multi-core hosts and on hosts
+    where the device dispatch has real latency (tunneled chips); on a
+    single-core host it degrades gracefully to roughly the chunked
+    driver's cost. No checkpointing — use
+    `generate_event_proofs_for_range_chunked` for resumable runs.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    metrics = metrics or Metrics()
+    matcher = EventMatcher(spec.event_signature, spec.topic_1)
+    cached = CachedBlockstore(store)
+    chunks = [pairs[k : k + chunk_size] for k in range(0, len(pairs), chunk_size)]
+
+    event_proofs: list = []
+    all_blocks: set[ProofBlock] = set()
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = None
+        if chunks:
+            pending = pool.submit(
+                _scan_and_match, cached, chunks[0], spec, matcher, match_backend, metrics
+            )
+        for k, chunk in enumerate(chunks):
+            matching_per_pair, native_ok = pending.result()
+            if k + 1 < len(chunks):
+                pending = pool.submit(
+                    _scan_and_match,
                     cached,
-                    pair.parent,
-                    pair.child,
-                    exec_order,
-                    matching,
+                    chunks[k + 1],
+                    spec,
                     matcher,
-                    spec.actor_id_filter,
+                    match_backend,
+                    metrics,
                 )
-                collector.collect_from_recordings(recordings)
-                event_proofs.extend(proofs)
-                all_blocks.update(collector.materialize())
-            blocks = sorted(all_blocks, key=lambda b: b.cid.to_bytes())
+            with metrics.stage("range_record"):
+                proofs, blocks = _record_chunk(
+                    cached, chunk, matching_per_pair, matcher, spec, native_ok
+                )
+            event_proofs.extend(proofs)
+            all_blocks.update(blocks)
     metrics.count("range_proofs", len(event_proofs))
 
     return UnifiedProofBundle(
         storage_proofs=[],
         event_proofs=event_proofs,
-        blocks=blocks,
+        blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
     )
 
 

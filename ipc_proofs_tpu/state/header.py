@@ -75,7 +75,9 @@ class BlockHeader:
             )
         if _decode_header is False:
             return cls.decode(raw)
-        return cls._from_fields(_decode_header(raw))
+        header = cls._from_fields(_decode_header(raw))
+        header._lite = True  # encode() raises instead of emitting nulls
+        return header
 
     @classmethod
     def _from_fields(cls, fields: list) -> "BlockHeader":
@@ -107,7 +109,16 @@ class BlockHeader:
             _parent_base_fee=fields[15],
         )
 
+    # set on decode_lite results: opaque fields were validated but not
+    # materialized, so re-encoding would silently emit nulls in their place
+    _lite: bool = field(default=False, compare=False, repr=False)
+
     def encode(self) -> bytes:
+        if self._lite:
+            raise ValueError(
+                "cannot re-encode a decode_lite header: opaque fields were "
+                "not materialized (use BlockHeader.decode for round-trips)"
+            )
         return cbor_encode(
             [
                 self.miner,

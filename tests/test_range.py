@@ -74,6 +74,32 @@ class TestRangeDriver:
         assert cids == sorted(cids)
         assert len(cids) == len(set(cids))
 
+    def test_pipelined_bit_identical(self):
+        """The phase-overlapped driver must emit exactly the unpipelined
+        bundle: same proofs in the same order, same CID-sorted witness —
+        across chunk sizes that split pairs unevenly, with and without a
+        match backend."""
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+        bs, pairs, expected = _make_range(7)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        reference = generate_event_proofs_for_range(bs, pairs, spec).to_json()
+        for backend in (None, get_backend("cpu")):
+            for chunk_size in (1, 2, 3, 7, 100):
+                piped = generate_event_proofs_for_range_pipelined(
+                    bs, pairs, spec, chunk_size=chunk_size, match_backend=backend
+                )
+                assert piped.to_json() == reference, (backend, chunk_size)
+        assert len(piped.event_proofs) == expected
+
+    def test_pipelined_empty_range(self):
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+        bs, _, _ = _make_range(1)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        bundle = generate_event_proofs_for_range_pipelined(bs, [], spec)
+        assert bundle.event_proofs == [] and bundle.blocks == []
+
     def test_metrics_populated(self):
         bs, pairs, expected = _make_range(4)
         spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)

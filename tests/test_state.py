@@ -122,6 +122,20 @@ class TestHeader:
         ):
             assert getattr(lite, name) == getattr(full, name), name
 
+    def test_decode_lite_refuses_reencode(self):
+        import pytest
+
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "decode_header"):
+            pytest.skip("native decode_header unavailable")
+        lite = BlockHeader.decode_lite(self._header().encode())
+        with pytest.raises(ValueError, match="decode_lite"):
+            lite.encode()
+        with pytest.raises(ValueError, match="decode_lite"):
+            lite.cid()
+
     def test_decode_lite_acceptance_differential(self):
         """decode_lite must accept/reject EXACTLY what decode does — checked
         over the valid header, every 1-byte truncation, several hundred
@@ -164,6 +178,8 @@ class TestHeader:
             cases.append(
                 b"\x90" + head + b"\x80" + b"\x00" * 7 + b"\x00" * 15
             )
+        # deep-nesting DoS probe (must raise, never exhaust the C stack)
+        cases.append(b"\x90" + b"\x81" * 200_000 + b"\x01" + b"\x00" * 15)
 
         agree = 0
         for case in cases:
