@@ -373,50 +373,21 @@ def _kernel_slope_rate(args, log) -> float:
 
 def _cid_kernel_rate(quick: bool, log) -> float:
     """Witness-verify CIDs/sec (BASELINE config 4's kernel, slope-timed):
-    blake2b-256 over typical ~100-byte IPLD nodes via the single-block
-    Pallas kernel when the chip accepts it, else the XLA scan kernel."""
+    blake2b-256 over 200-byte IPLD nodes — config 4's OWN block size
+    (`benchmarks/run_configs.py` config 4) — via the two-block Pallas
+    kernel when the chip accepts it, else the XLA scan kernel."""
     import numpy as np
-    import jax.numpy as jnp
 
-    from ipc_proofs_tpu.backend import get_backend
     from ipc_proofs_tpu.core.hashes import blake2b_256
+    from ipc_proofs_tpu.ops.cid_bench import blake2b_cid_bench_setup
     from ipc_proofs_tpu.utils.timing import measure_pass_seconds
 
     n = 20_000 if quick else 200_000
     rng = np.random.default_rng(1)
-    payload = rng.integers(0, 256, size=(n, 100), dtype=np.uint8)
+    payload = rng.integers(0, 256, size=(n, 200), dtype=np.uint8)
     messages = [payload[i].tobytes() for i in range(n)]
-    backend = get_backend("tpu")
 
-    if backend._pallas_usable():
-        from ipc_proofs_tpu.ops.pallas_kernels import (
-            blake2b256_single_block_pallas,
-            pack_single_block_blake2b,
-        )
-
-        m_lo, m_hi, lengths, _ = pack_single_block_blake2b(messages)
-        args = (jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths))
-        first = np.asarray(blake2b256_single_block_pallas(*args))
-
-        def one_pass(i, a, b, l):
-            d = blake2b256_single_block_pallas(a ^ i.astype(jnp.uint32), b, l)
-            return d.sum(dtype=jnp.uint32).astype(jnp.int32)
-
-        kernel = "pallas"
-    else:
-        from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
-        from ipc_proofs_tpu.ops.pack import pad_blake2b
-
-        blocks, counts, lengths = pad_blake2b(messages)
-        args = (jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))
-        first = np.asarray(blake2b256_blocks(*args))
-
-        def one_pass(i, a, b, l):
-            d = blake2b256_blocks(a ^ i.astype(jnp.uint32), b, l)
-            return d.sum(dtype=jnp.uint32).astype(jnp.int32)
-
-        kernel = "xla"
-
+    one_pass, args, first, kernel = blake2b_cid_bench_setup(messages)
     assert first[0].tobytes() == blake2b_256(messages[0])
     pt = measure_pass_seconds(one_pass, args, k_small=3, k_large=13 if quick else 23)
     rate = n / pt.seconds

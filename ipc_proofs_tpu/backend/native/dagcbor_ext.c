@@ -428,6 +428,10 @@ static PyObject *py_decode_header(PyObject *self, PyObject *arg) {
     PyErr_SetString(PyExc_ValueError, "CBOR array length exceeds input");
     goto done;
   }
+  /* the outer 16-array was consumed via parse_head above, bypassing
+   * depth_enter — account for it so fields nest at the same depth they
+   * would under parse_item (acceptance parity with the full decode) */
+  p.depth = 1;
   result = PyList_New(16);
   if (!result) goto done;
   for (int i = 0; i < 16; i++) {

@@ -59,6 +59,9 @@ class TpuBackend:
         self.mesh = mesh
         # None = untried; True/False after the first on-chip attempt
         self._pallas_ok: Optional[bool] = None
+        # separate memo: the single-block probe passing does not guarantee
+        # Mosaic accepts the larger two-block blake2b kernel
+        self._pallas_two_block_ok: Optional[bool] = None
 
     def _pallas_usable(self) -> bool:
         """Single-block Pallas fast path: TPU platform only (interpret mode
@@ -114,8 +117,9 @@ class TpuBackend:
 
         if not messages:
             return []
+        longest = max(len(m) for m in messages)
         # single-block fast path: 4.1× the XLA kernel on v5e (measured)
-        if max(len(m) for m in messages) <= 128 and self._pallas_usable():
+        if longest <= 128 and self._pallas_usable():
             from ipc_proofs_tpu.ops.pallas_kernels import (
                 blake2b256_single_block_pallas,
                 pack_single_block_blake2b,
@@ -126,6 +130,31 @@ class TpuBackend:
                 jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths)
             )
             return digests_to_bytes(digests[:n])
+        # two-block fast path (≤ 256 B): covers the ~200-byte IPLD node
+        # shape of BASELINE config 4, which previously fell through to the
+        # XLA scan kernel. Runtime fallback: a Mosaic rejection of this
+        # kernel drops to XLA (memoized so later calls skip the doomed
+        # pack + compile attempt) without poisoning the single-block probe.
+        if (
+            128 < longest <= 256
+            and self._pallas_two_block_ok is not False
+            and self._pallas_usable()
+        ):
+            from ipc_proofs_tpu.ops.pallas_kernels import (
+                blake2b256_two_block_pallas,
+                pack_two_block_blake2b,
+            )
+
+            try:
+                m_lo, m_hi, lengths, n = pack_two_block_blake2b(list(messages))
+                digests = blake2b256_two_block_pallas(
+                    jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths)
+                )
+            except Exception:  # Mosaic rejection — use the XLA kernel
+                self._pallas_two_block_ok = False
+            else:
+                self._pallas_two_block_ok = True
+                return digests_to_bytes(digests[:n])
         blocks, counts, lengths = pad_blake2b(list(messages))
         return digests_to_bytes(
             self._blake2b(jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))

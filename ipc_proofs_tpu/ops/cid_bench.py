@@ -1,0 +1,61 @@
+"""Shared kernel-selection + slope-timing harness for the witness-CID
+recompute benchmarks (BASELINE config 4 and bench.py's secondary line).
+
+Both benchmarks measure the same thing — blake2b-256 CID recompute over
+~200-byte IPLD nodes — so the kernel choice (two-block Pallas on a chip
+that accepts it, XLA scan otherwise, including a runtime Mosaic-rejection
+fallback) lives here exactly once.
+"""
+
+from __future__ import annotations
+
+__all__ = ["blake2b_cid_bench_setup"]
+
+
+def blake2b_cid_bench_setup(messages: "list[bytes]"):
+    """Build the timing closure for a blake2b CID-recompute benchmark.
+
+    Returns ``(one_pass, args_j, first_digests, kernel_name)`` where
+    ``one_pass(i, *args_j)`` is slope-timeable (perturbs the input with
+    ``^ i`` so passes cannot be CSE'd), ``first_digests`` is the
+    correctness-check array for the unperturbed input, and ``kernel_name``
+    names the kernel that will actually run.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ipc_proofs_tpu.backend import get_backend
+
+    if get_backend("tpu")._pallas_usable():
+        # the single-block probe passing does not guarantee Mosaic accepts
+        # the larger two-block kernel — compile it here and fall back
+        try:
+            from ipc_proofs_tpu.ops.pallas_kernels import (
+                blake2b256_two_block_pallas,
+                pack_two_block_blake2b,
+            )
+
+            m_lo, m_hi, lengths, _ = pack_two_block_blake2b(messages)
+            args_j = (jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths))
+            first = np.asarray(blake2b256_two_block_pallas(*args_j))
+
+            def one_pass(i, a, b, l):
+                d = blake2b256_two_block_pallas(a ^ i.astype(jnp.uint32), b, l)
+                return d.sum(dtype=jnp.uint32).astype(jnp.int32)
+
+            return one_pass, args_j, first, "pallas-2blk"
+        except Exception:  # Mosaic rejection — measure the XLA kernel
+            pass
+
+    from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
+    from ipc_proofs_tpu.ops.pack import pad_blake2b
+
+    blocks, counts, lengths = pad_blake2b(messages)
+    args_j = (jnp.asarray(blocks), jnp.asarray(counts), jnp.asarray(lengths))
+    first = np.asarray(blake2b256_blocks(*args_j))
+
+    def one_pass(i, b, c, l):
+        d = blake2b256_blocks(b ^ i.astype(jnp.uint32), c, l)
+        return d.sum(dtype=jnp.uint32).astype(jnp.int32)
+
+    return one_pass, args_j, first, "xla"

@@ -44,8 +44,10 @@ from ipc_proofs_tpu.ops.keccak_jax import _PERM_ROT, _PERM_SRC, _ROUND_CONSTANTS
 __all__ = [
     "keccak256_single_block_pallas",
     "blake2b256_single_block_pallas",
+    "blake2b256_two_block_pallas",
     "pack_single_block_keccak",
     "pack_single_block_blake2b",
+    "pack_two_block_blake2b",
 ]
 
 TILE = 256
@@ -160,6 +162,92 @@ def _rot_rows(pair, k: int):
     )
 
 
+def _blake2b_compress(const_rows, h03, h47, m_rows, t_row, f_row):
+    """One blake2b compression over lane-major [4, TILE] u64-pair groups.
+
+    ``h03``/``h47`` are (lo, hi) pairs for h0..3 / h4..7; ``m_rows`` is a
+    (mlo_sel, mhi_sel) pair of row-selector callables for this block's 16
+    message lanes; ``t_row`` is the u32 byte-counter row (t < 2^32 for the
+    ≤2-block shapes these kernels serve); ``f_row`` is 0xFFFFFFFF where the
+    block is final, else 0 (applied to both u32 halves of v14)."""
+    mlo_sel, mhi_sel = m_rows
+    a = (h03[0], h03[1])
+    b = (h47[0], h47[1])
+    c = (const_rows([w & _U32 for w in _IV[:4]]), const_rows([w >> 32 for w in _IV[:4]]))
+    d_lo = const_rows([_IV[4] & _U32, _IV[5] & _U32, _IV[6] & _U32, _IV[7] & _U32])
+    d_hi = const_rows([_IV[4] >> 32, _IV[5] >> 32, _IV[6] >> 32, _IV[7] >> 32])
+    zero = t_row ^ t_row  # [1, T] zeros without capturing an array
+    # v12 ^= t (lo half only); v14 ^= f (both halves)
+    d_lo = jnp.concatenate(
+        [d_lo[0:1, :] ^ t_row, d_lo[1:2, :], d_lo[2:3, :] ^ f_row, d_lo[3:4, :]], axis=0
+    )
+    d_hi = jnp.concatenate(
+        [d_hi[0:1, :] ^ zero, d_hi[1:2, :], d_hi[2:3, :] ^ f_row, d_hi[3:4, :]], axis=0
+    )
+    d = (d_lo, d_hi)
+
+    for r in range(12):
+        s = [int(x) for x in _SIGMA[r % 10]]
+        mx = (mlo_sel(s[0:8:2]), mhi_sel(s[0:8:2]))
+        my = (mlo_sel(s[1:8:2]), mhi_sel(s[1:8:2]))
+        a, b, c, d = _g_vec(a, b, c, d, mx, my)
+        b, c, d = _rot_rows(b, 1), _rot_rows(c, 2), _rot_rows(d, 3)
+        mx = (mlo_sel(s[8:16:2]), mhi_sel(s[8:16:2]))
+        my = (mlo_sel(s[9:16:2]), mhi_sel(s[9:16:2]))
+        a, b, c, d = _g_vec(a, b, c, d, mx, my)
+        b, c, d = _rot_rows(b, 3), _rot_rows(c, 2), _rot_rows(d, 1)
+
+    new_h03 = (h03[0] ^ a[0] ^ c[0], h03[1] ^ a[1] ^ c[1])
+    new_h47 = (h47[0] ^ b[0] ^ d[0], h47[1] ^ b[1] ^ d[1])
+    return new_h03, new_h47
+
+
+def _blake2b2_kernel(mlo_ref, mhi_ref, len_ref, out_ref):
+    """Two-block blake2b-256: messages up to 256 bytes (the ~200-byte IPLD
+    node shape of BASELINE config 4). Both compressions run for every
+    message; single-block messages take the first compression's digest via
+    a final masked select, so no divergent control flow reaches Mosaic."""
+    tile_n = mlo_ref.shape[1]
+
+    def const_rows(words):
+        return jnp.concatenate(
+            [jnp.full((1, tile_n), w, dtype=jnp.uint32) for w in words], axis=0
+        )
+
+    def block_sel(ref, base):
+        def sel(rows):
+            return jnp.concatenate([ref[base + i : base + i + 1, :] for i in rows], axis=0)
+
+        return sel
+
+    length = len_ref[0:1, :].astype(jnp.uint32)
+    ones = jnp.full((1, tile_n), _U32, dtype=jnp.uint32)
+    zero = jnp.zeros((1, tile_n), dtype=jnp.uint32)
+    two = length > 128
+    t1 = jnp.where(two, jnp.full((1, tile_n), 128, dtype=jnp.uint32), length)
+    f1 = jnp.where(two, zero, ones)
+
+    h0 = _IV[0] ^ _PARAM_WORD0
+    hw = [h0 if i == 0 else _IV[i] for i in range(8)]
+    h03 = (const_rows([w & _U32 for w in hw[:4]]), const_rows([w >> 32 for w in hw[:4]]))
+    h47 = (const_rows([w & _U32 for w in hw[4:]]), const_rows([w >> 32 for w in hw[4:]]))
+
+    h03_1, h47_1 = _blake2b_compress(
+        const_rows, h03, h47,
+        (block_sel(mlo_ref, 0), block_sel(mhi_ref, 0)), t1, f1,
+    )
+    h03_2, _ = _blake2b_compress(
+        const_rows, h03_1, h47_1,
+        (block_sel(mlo_ref, 16), block_sel(mhi_ref, 16)), length, ones,
+    )
+
+    rows = []
+    for i in range(4):
+        rows.append(jnp.where(two, h03_2[0][i : i + 1, :], h03_1[0][i : i + 1, :]))
+        rows.append(jnp.where(two, h03_2[1][i : i + 1, :], h03_1[1][i : i + 1, :]))
+    out_ref[:] = jnp.concatenate(rows, axis=0)
+
+
 def _blake2b_kernel(mlo_ref, mhi_ref, len_ref, out_ref):
     # lane-major: refs [16|1|8, TILE_N]; state kept as four [4, TILE_N]
     # row groups so each G mixes all four columns in one vector op chain
@@ -272,6 +360,34 @@ def blake2b256_single_block_pallas(m_lo, m_hi, lengths, interpret: bool = False)
     return digests_t.T
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def blake2b256_two_block_pallas(m_lo, m_hi, lengths, interpret: bool = False):
+    """Batch blake2b-256 for messages up to 256 bytes (two compression
+    blocks). Single-block rows are computed in the same pass and selected
+    by mask, so mixed batches stay correct.
+
+    Args: m_lo/m_hi uint32 [N, 32] (block0 words 0..15, block1 16..31);
+    lengths int32 [N, 1]. N % TILE == 0. Returns uint32 [N, 8] digests.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = m_lo.shape[0]
+    digests_t = pl.pallas_call(
+        _blake2b2_kernel,
+        grid=(n // TILE,),
+        in_specs=[
+            pl.BlockSpec((32, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
+        interpret=interpret,
+    )(m_lo.T, m_hi.T, lengths.T)
+    return digests_t.T
+
+
 # --- host-side packing (single-block, de-interleaved, TILE-padded) ----------
 
 
@@ -309,6 +425,29 @@ def pack_single_block_blake2b(messages: "list[bytes]"):
         raw[i, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
         lengths[i, 0] = len(msg)
     words = raw.view(np.uint32).reshape(n_pad, 32)
+    return (
+        np.ascontiguousarray(words[:, 0::2]),
+        np.ascontiguousarray(words[:, 1::2]),
+        lengths,
+        n,
+    )
+
+
+def pack_two_block_blake2b(messages: "list[bytes]"):
+    """Pad ≤256-byte messages into de-interleaved 2×128-byte blake2b blocks.
+
+    Returns (m_lo u32[Np, 32], m_hi u32[Np, 32], lengths i32[Np, 1], n).
+    """
+    n = len(messages)
+    n_pad = ((n + TILE - 1) // TILE) * TILE
+    raw = np.zeros((n_pad, 256), dtype=np.uint8)
+    lengths = np.zeros((n_pad, 1), dtype=np.int32)
+    for i, msg in enumerate(messages):
+        if len(msg) > 256:
+            raise ValueError("two-block blake2b kernel requires len <= 256")
+        raw[i, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+        lengths[i, 0] = len(msg)
+    words = raw.view(np.uint32).reshape(n_pad, 64)
     return (
         np.ascontiguousarray(words[:, 0::2]),
         np.ascontiguousarray(words[:, 1::2]),

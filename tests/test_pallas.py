@@ -79,3 +79,57 @@ class TestPallasBlake2b:
         digests = digests_to_bytes(out[:n])
         for payload, digest in zip(payloads, digests):
             assert CID.hash_of(payload).digest == digest
+
+
+class TestPallasBlake2bTwoBlock:
+    MSGS = [
+        b"",
+        b"abc",
+        b"\x22" * 127,
+        b"\x33" * 128,  # exactly one block — single-compression select path
+        b"\x44" * 129,  # first two-block length
+        b"\x55" * 200,  # BASELINE config 4's IPLD node size
+        b"\x66" * 255,
+        b"\x77" * 256,  # max
+    ]
+
+    def test_matches_golden(self):
+        from ipc_proofs_tpu.ops.pallas_kernels import (
+            blake2b256_two_block_pallas,
+            pack_two_block_blake2b,
+        )
+
+        mlo, mhi, lengths, n = pack_two_block_blake2b(self.MSGS)
+        out = blake2b256_two_block_pallas(
+            jnp.asarray(mlo), jnp.asarray(mhi), jnp.asarray(lengths), interpret=INTERPRET
+        )
+        digests = digests_to_bytes(out[:n])
+        for msg, digest in zip(self.MSGS, digests):
+            assert digest == blake2b_256(msg), f"len={len(msg)}"
+
+    def test_random_mixed_lengths(self):
+        import random
+
+        from ipc_proofs_tpu.ops.pallas_kernels import (
+            blake2b256_two_block_pallas,
+            pack_two_block_blake2b,
+        )
+
+        rng = random.Random(99)
+        msgs = [
+            bytes(rng.randrange(256) for _ in range(rng.randrange(257)))
+            for _ in range(40)
+        ]
+        mlo, mhi, lengths, n = pack_two_block_blake2b(msgs)
+        out = blake2b256_two_block_pallas(
+            jnp.asarray(mlo), jnp.asarray(mhi), jnp.asarray(lengths), interpret=INTERPRET
+        )
+        digests = digests_to_bytes(out[:n])
+        for msg, digest in zip(msgs, digests):
+            assert digest == blake2b_256(msg), f"len={len(msg)}"
+
+    def test_rejects_over_256(self):
+        from ipc_proofs_tpu.ops.pallas_kernels import pack_two_block_blake2b
+
+        with pytest.raises(ValueError):
+            pack_two_block_blake2b([b"\x00" * 257])

@@ -180,6 +180,23 @@ class TestHeader:
             )
         # deep-nesting DoS probe (must raise, never exhaust the C stack)
         cases.append(b"\x90" + b"\x81" * 200_000 + b"\x01" + b"\x00" * 15)
+        # depth-cap BOUNDARY: an otherwise-valid header whose opaque
+        # _ticket field nests to exactly the limit — decode_header consumes
+        # the outer array outside parse_item and must account for that
+        # level, or it accepts one level more than decode
+        for k in (509, 510, 511, 512):
+            ticket = 1
+            for _ in range(k):
+                ticket = [ticket]
+            deep = BlockHeader(
+                parents=[CID.hash_of(b"p")],
+                height=1,
+                parent_state_root=CID.hash_of(b"s"),
+                parent_message_receipts=CID.hash_of(b"r"),
+                messages=CID.hash_of(b"m"),
+                _ticket=ticket,
+            )
+            cases.append(deep.encode())
 
         agree = 0
         for case in cases:
