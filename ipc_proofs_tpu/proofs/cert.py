@@ -15,17 +15,29 @@ structural checks the reference leaves as TODOs (`trust/mod.rs:58,72`):
   `PowerTableDelta` onto the previous table and check instance continuity,
   so a certificate sequence must be self-consistent before it is trusted.
 
-What full verification would additionally require (out of scope without a
-BLS library and the genesis power table, documented here so the gap is
-explicit):
+Round 4 closes the remaining trust boundary with the in-repo BLS12-381
+implementation (`ipc_proofs_tpu.crypto.bls`):
 
-1. the initial power table fetched from the f3 genesis (its CID is chain
-   metadata), hashed and compared against each cert's
-   `supplemental_data.power_table` after applying the deltas;
-2. aggregate-BLS verification of `signature` over the certificate's gpbft
-   payload (instance ‖ ECChain merkle root ‖ supplemental data) against the
-   public keys of the `signers` bitfield resolved through the power table;
-3. a >2/3 quorum check of the signers' power against the table total.
+* **aggregate-signature verification** — `verify_signature` resolves the
+  ``signers`` (bitmap bytes or index list) through the power table,
+  aggregates their G1 public keys, and checks the 96-byte G2 ``signature``
+  over the certificate's decide payload with two pairings;
+* **>2/3 power quorum** — signers' summed power must strictly exceed 2/3 of
+  the table total (gpbft strong quorum);
+* **power-table commitment** — `power_table_cid` canonically encodes the
+  table (dag-cbor ``[[id, power, key], …]``, Filecoin positive-BigInt byte
+  form) and `FinalityCertificateChain.validate(verify_table_cids=True)`
+  compares the post-delta table's CID against each cert's
+  ``supplemental_data.power_table``.
+
+Interop caveats (documented divergences pending real-chain vectors, which a
+zero-egress environment cannot fetch): the signing payload is a canonical
+dag-cbor encoding of the same fields go-f3's ``MarshalPayloadForSigning``
+covers (not byte-identical to go-f3's marshaling), hash-to-G2 uses
+deterministic try-and-increment rather than RFC 9380 SSWU (see
+`crypto/bls.py`), and ``signers`` bitmaps are plain LSB-first bitmaps, not
+Filecoin RLE+. Each is a swap-in point; the trust semantics — forged,
+under-quorum, or wrong-table certificates are rejected — hold regardless.
 """
 
 from __future__ import annotations
@@ -41,7 +53,48 @@ __all__ = [
     "PowerTableDelta",
     "PowerTableEntry",
     "apply_power_table_delta",
+    "power_table_cid",
+    "decode_signing_key",
 ]
+
+
+def decode_signing_key(key: str) -> bytes:
+    """Decode a power-table signing key string (base64, Forest JSON's byte
+    encoding, or 0x-hex) to the 48-byte compressed G1 form."""
+    import base64
+
+    if key.startswith("0x"):
+        raw = bytes.fromhex(key[2:])
+    else:
+        raw = base64.b64decode(key, validate=True)
+    if len(raw) != 48:
+        raise ValueError(f"signing key must be 48 bytes, got {len(raw)}")
+    return raw
+
+
+def power_table_cid(table: "Sequence[PowerTableEntry]"):
+    """Canonical CID of a power table: dag-cbor ``[[id, power, key], …]``
+    rows in participant-id order, power in Filecoin's positive-BigInt byte
+    form (empty for zero, 0x00 sign prefix + big-endian magnitude), key as
+    the raw 48-byte compressed G1 bytes; blake2b-256 dag-cbor CIDv1.
+
+    This is the table commitment `FinalityCertificateChain.validate`
+    compares against ``supplemental_data.power_table`` (go-f3 hashes the
+    next instance's table the same way structurally; byte-level parity
+    pending vectors — module docstring).
+    """
+    from ipc_proofs_tpu.core.cid import CID
+    from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+
+    rows = []
+    for entry in sorted(table, key=lambda e: e.participant_id):
+        if entry.power < 0:
+            raise ValueError("power table entries cannot be negative")
+        power = b"" if entry.power == 0 else b"\x00" + entry.power.to_bytes(
+            (entry.power.bit_length() + 7) // 8, "big"
+        )
+        rows.append([entry.participant_id, power, decode_signing_key(entry.signing_key)])
+    return CID.hash_of(cbor_encode(rows))
 
 
 @dataclass
@@ -97,22 +150,123 @@ class FinalityCertificate:
     instance: int
     ec_chain: list[ECTipSet] = field(default_factory=list)
     supplemental_data: SupplementalData = field(default_factory=SupplementalData)
-    signers: bytes = b""
+    # signers: LSB-first bitmap bytes over power-table rows (sorted by
+    # participant id), or an explicit list of row indices
+    signers: "bytes | list[int]" = b""
     signature: bytes = b""
     power_table_delta: list[PowerTableDelta] = field(default_factory=list)
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "FinalityCertificate":
+        import base64
+
+        raw_signers = obj.get("Signers", b"")
+        if isinstance(raw_signers, str):  # Forest JSON byte encoding
+            signers: "bytes | list[int]" = base64.b64decode(raw_signers)
+        elif isinstance(raw_signers, list):  # explicit row indices
+            signers = [int(i) for i in raw_signers]
+        else:
+            signers = bytes(raw_signers)
+        raw_sig = obj.get("Signature", b"")
+        signature = base64.b64decode(raw_sig) if isinstance(raw_sig, str) else bytes(raw_sig)
         return cls(
             instance=obj["GPBFTInstance"],
             ec_chain=[ECTipSet.from_json_obj(t) for t in obj["ECChain"]],
             supplemental_data=SupplementalData.from_json_obj(obj.get("SupplementalData", {})),
-            signers=bytes(obj.get("Signers", b"")),
-            signature=bytes(obj.get("Signature", b"")),
+            signers=signers,
+            signature=signature,
             power_table_delta=[
                 PowerTableDelta.from_json_obj(d) for d in obj.get("PowerTableDelta", [])
             ],
         )
+
+    def signer_indices(self) -> list[int]:
+        """Power-table row indices of the signers: the explicit list form,
+        or set bits of the LSB-first bitmap. Sorted, duplicates rejected."""
+        if isinstance(self.signers, list):
+            idxs = list(self.signers)
+            if len(set(idxs)) != len(idxs):
+                raise ValueError("duplicate signer indices")
+            if any(i < 0 for i in idxs):
+                raise ValueError("negative signer index")
+            return sorted(idxs)
+        idxs = []
+        for byte_pos, byte in enumerate(self.signers):
+            for bit in range(8):
+                if byte >> bit & 1:
+                    idxs.append(byte_pos * 8 + bit)
+        return idxs
+
+    def signing_payload(self) -> bytes:
+        """Canonical decide-payload bytes the aggregate signature covers:
+        dag-cbor over (instance, supplemental data, EC chain) — the same
+        field set go-f3's ``MarshalPayloadForSigning`` commits to (byte
+        parity pending vectors; module docstring)."""
+        from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+
+        return cbor_encode(
+            [
+                "F3-DECIDE",
+                self.instance,
+                self.supplemental_data.commitments,
+                self.supplemental_data.power_table,
+                [
+                    [list(ts.key), ts.epoch, ts.power_table, ts.commitments]
+                    for ts in self.ec_chain
+                ],
+            ]
+        )
+
+    def verify_signature(self, table: "Sequence[PowerTableEntry]") -> None:
+        """Verify the aggregate BLS signature and the >2/3 power quorum
+        against ``table`` (the committee for this instance — the power
+        table BEFORE this certificate's delta is applied).
+
+        Raises ValueError describing the first failure; returns None on
+        success. Checks, in order: signers resolve to table rows; strong
+        quorum (3·signer_power > 2·total_power); signature bytes decode to
+        a G2 subgroup point; the aggregate verifies over
+        `signing_payload`.
+        """
+        from ipc_proofs_tpu.crypto import bls
+
+        rows = sorted(table, key=lambda e: e.participant_id)
+        if not rows:
+            raise ValueError("empty power table")
+        idxs = self.signer_indices()
+        if not idxs:
+            raise ValueError(f"certificate {self.instance} has no signers")
+        if idxs[-1] >= len(rows):
+            raise ValueError(
+                f"signer index {idxs[-1]} out of range for {len(rows)}-row table"
+            )
+        signer_rows = [rows[i] for i in idxs]
+        signer_power = sum(e.power for e in signer_rows)
+        total_power = sum(e.power for e in rows)
+        if total_power <= 0:
+            raise ValueError("power table has no power")
+        if 3 * signer_power <= 2 * total_power:
+            raise ValueError(
+                f"certificate {self.instance} signers hold {signer_power} of "
+                f"{total_power} power — not a strong (>2/3) quorum"
+            )
+        try:
+            pks = [bls.g1_decompress(decode_signing_key(e.signing_key)) for e in signer_rows]
+            sig = bls.g2_decompress(bytes(self.signature))
+        except ValueError as exc:
+            raise ValueError(f"certificate {self.instance}: {exc}") from exc
+        if any(pk is None for pk in pks):
+            # BLS KeyValidate: an identity pubkey contributes nothing to the
+            # aggregate — accepting it would count its power toward quorum
+            # without any signature behind it
+            raise ValueError(
+                f"certificate {self.instance} has a signer with an identity "
+                f"public key"
+            )
+        if not bls.verify_aggregate_same_message(pks, self.signing_payload(), sig):
+            raise ValueError(
+                f"certificate {self.instance} aggregate BLS signature is invalid"
+            )
 
     def is_valid_for_epoch(self, epoch: int) -> bool:
         """Placeholder check: epoch within the EC-chain range
@@ -230,8 +384,30 @@ class FinalityCertificateChain:
     certificates: list[FinalityCertificate] = field(default_factory=list)
 
     def validate(
-        self, initial_power_table: Optional[Sequence[PowerTableEntry]] = None
+        self,
+        initial_power_table: Optional[Sequence[PowerTableEntry]] = None,
+        verify_signatures: bool = False,
+        verify_table_cids: bool = False,
     ) -> Optional[list[PowerTableEntry]]:
+        """Validate the chain; returns the final power table (None when no
+        initial table was given).
+
+        ``verify_signatures`` additionally checks each certificate's
+        aggregate BLS signature and >2/3 quorum against the table in force
+        for its instance (the table BEFORE its delta — requires
+        ``initial_power_table``), AND the post-delta table commitment: the
+        signature payload covers ``supplemental_data.power_table`` but not
+        the delta itself, so the delta is only authenticated through the
+        commitment — it is therefore mandatory here (an empty commitment is
+        rejected), mirroring go-f3's ValidateFinalityCertificates.
+        ``verify_table_cids`` runs the same commitment comparison without
+        signatures (structural-only validation; certs without a commitment
+        are skipped).
+        """
+        if (verify_signatures or verify_table_cids) and initial_power_table is None:
+            raise ValueError(
+                "signature/table-CID verification requires initial_power_table"
+            )
         table = list(initial_power_table) if initial_power_table is not None else None
         prev_instance: Optional[int] = None
         prev_head: Optional[ECTipSet] = None
@@ -259,8 +435,23 @@ class FinalityCertificateChain:
                         f"{base.epoch}) must equal the previous cert's head "
                         f"(epoch {prev_head.epoch}) — forked or gapped chain"
                     )
+            if verify_signatures:
+                cert.verify_signature(table)
+                if not cert.supplemental_data.power_table:
+                    raise ValueError(
+                        f"certificate {cert.instance} carries no power-table "
+                        f"commitment — its delta would be unauthenticated"
+                    )
             if table is not None:
                 table = apply_power_table_delta(table, cert.power_table_delta)
+                if (verify_signatures or verify_table_cids) and cert.supplemental_data.power_table:
+                    computed = str(power_table_cid(table))
+                    if computed != cert.supplemental_data.power_table:
+                        raise ValueError(
+                            f"certificate {cert.instance} power table commitment "
+                            f"mismatch: replayed deltas give {computed}, cert "
+                            f"claims {cert.supplemental_data.power_table}"
+                        )
             prev_instance, prev_head = cert.instance, cert.ec_chain[-1]
         return table
 

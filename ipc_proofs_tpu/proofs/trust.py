@@ -8,8 +8,10 @@ certificate's EC chain (exact tipset-key match for the parent, member-block
 match for the child header) — see `cert.validates_parent_tipset` /
 `validates_child_header`. Pass ``bind_tipsets=False`` to
 `with_f3_certificate` for the reference's epoch-only semantics. BLS
-signature / quorum verification remains out of scope; the exact gap is
-documented in `cert.py`'s module docstring.
+aggregate-signature + quorum verification is available via
+``with_f3_certificate(verify_signature=True, power_table=…)`` (see
+`cert.FinalityCertificate.verify_signature` and `crypto/bls.py`), closing
+the reference's TODOs.
 """
 
 from __future__ import annotations
@@ -74,7 +76,11 @@ class TrustPolicy:
 
     @classmethod
     def with_f3_certificate(
-        cls, cert: FinalityCertificate, bind_tipsets: bool = True
+        cls,
+        cert: FinalityCertificate,
+        bind_tipsets: bool = True,
+        verify_signature: bool = False,
+        power_table=None,
     ) -> "TrustPolicy":
         """Trust proofs anchored by an F3 finality certificate.
 
@@ -82,7 +88,18 @@ class TrustPolicy:
         child block CID must appear in the cert's EC chain at the claimed
         epoch; ``bind_tipsets=False`` reproduces the reference's epoch-range
         stub (`trust/mod.rs:53-78`).
+
+        ``verify_signature=True`` verifies the certificate's aggregate BLS
+        signature and >2/3 power quorum against ``power_table`` (the
+        committee for the cert's instance) AT CONSTRUCTION, raising
+        ValueError for a forged/under-quorum certificate — closing the
+        reference's TODO at `trust/mod.rs:58,72`. Requires ``power_table``
+        (a sequence of `cert.PowerTableEntry`).
         """
+        if verify_signature:
+            if power_table is None:
+                raise ValueError("verify_signature=True requires power_table")
+            cert.verify_signature(power_table)
         return cls(certificate=cert, bind_tipsets=bind_tipsets)
 
     @classmethod

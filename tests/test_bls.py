@@ -1,0 +1,269 @@
+"""F3 BLS trust boundary: aggregate signatures, quorum, table commitments.
+
+Covers the round-4 closure of the reference's open TODOs
+(`src/proofs/trust/mod.rs:58,72`): bad-signature / short-quorum /
+wrong-table certificates rejected, well-formed certificates accepted.
+Pairing-level math (bilinearity) is asserted once — it underwrites
+everything above it.
+"""
+
+import base64
+
+import pytest
+
+from ipc_proofs_tpu.crypto import bls
+from ipc_proofs_tpu.proofs.cert import (
+    ECTipSet,
+    FinalityCertificate,
+    FinalityCertificateChain,
+    PowerTableDelta,
+    PowerTableEntry,
+    SupplementalData,
+    power_table_cid,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+
+SKS = [11111, 22222, 33333, 44444]
+PKS = [bls.sk_to_pk(sk) for sk in SKS]
+KEY_STRS = [base64.b64encode(bls.g1_compress(pk)).decode() for pk in PKS]
+POWERS = [30, 30, 30, 10]
+
+
+def _table():
+    return [
+        PowerTableEntry(participant_id=i, power=POWERS[i], signing_key=KEY_STRS[i])
+        for i in range(4)
+    ]
+
+
+def _cert(signer_ids, instance=0, tamper_sig=False, signers_as_bitmap=False):
+    cert = FinalityCertificate(
+        instance=instance,
+        ec_chain=[
+            ECTipSet(key=["bafy-parent"], epoch=100, power_table="pt-cid"),
+            ECTipSet(key=["bafy-head"], epoch=101, power_table="pt-cid"),
+        ],
+        supplemental_data=SupplementalData(power_table="bafy-next-table"),
+    )
+    payload = cert.signing_payload()
+    sig = bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in signer_ids])
+    if tamper_sig:
+        sig = bls.aggregate_signatures([sig, bls.g2_generator()])
+    cert.signature = bls.g2_compress(sig)
+    if signers_as_bitmap:
+        bitmap = bytearray(1)
+        for i in signer_ids:
+            bitmap[0] |= 1 << i
+        cert.signers = bytes(bitmap)
+    else:
+        cert.signers = list(signer_ids)
+    return cert
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        from ipc_proofs_tpu.crypto.bls import (
+            _G1,
+            _G2,
+            _OPS1,
+            _OPS2,
+            _f12_pow,
+            _F12_ONE,
+            _pt_mul,
+            pairing,
+        )
+
+        e = pairing(_G1, _G2)
+        assert e != _F12_ONE  # non-degenerate
+        assert pairing(_pt_mul(_OPS1, _G1, 5), _G2) == _f12_pow(e, 5)
+        assert pairing(_G1, _pt_mul(_OPS2, _G2, 7)) == _f12_pow(e, 7)
+
+    def test_compression_roundtrip_and_subgroup_rejection(self):
+        pk = PKS[0]
+        assert bls.g1_decompress(bls.g1_compress(pk)) == pk
+        sig = bls.sign(SKS[0], b"m")
+        assert bls.g2_decompress(bls.g2_compress(sig)) == sig
+        assert bls.g1_decompress(bls.g1_compress(None)) is None
+        with pytest.raises(ValueError):
+            bls.g1_decompress(b"\x00" * 48)  # no compression flag
+        with pytest.raises(ValueError):
+            bls.g2_decompress(b"\xc0" + b"\x01" * 95)  # malformed infinity
+
+
+class TestCertificateSignature:
+    def test_well_formed_passes(self):
+        _cert([0, 1, 2]).verify_signature(_table())  # no raise
+
+    def test_bitmap_signers_equivalent(self):
+        _cert([0, 1, 2], signers_as_bitmap=True).verify_signature(_table())
+
+    def test_bad_signature_rejected(self):
+        with pytest.raises(ValueError, match="signature is invalid"):
+            _cert([0, 1, 2], tamper_sig=True).verify_signature(_table())
+
+    def test_missing_signer_key_rejected(self):
+        # signature claims signers {0,1,2} but only {0,1} actually signed
+        cert = _cert([0, 1])
+        cert.signers = [0, 1, 2]
+        with pytest.raises(ValueError, match="signature is invalid"):
+            cert.verify_signature(_table())
+
+    def test_short_quorum_rejected(self):
+        # 60 of 100 power — above half, below the 2/3 strong quorum
+        with pytest.raises(ValueError, match="strong"):
+            _cert([0, 1]).verify_signature(_table())
+
+    def test_exact_two_thirds_rejected(self):
+        # quorum must be STRICTLY greater than 2/3: 60 of 90
+        table = _table()[:3]  # powers 30/30/30
+        with pytest.raises(ValueError, match="strong"):
+            _cert([0, 1]).verify_signature(table)
+
+    def test_out_of_range_signer_rejected(self):
+        cert = _cert([0, 1, 2])
+        cert.signers = [0, 1, 5]
+        with pytest.raises(ValueError, match="out of range"):
+            cert.verify_signature(_table())
+
+    def test_duplicate_signers_rejected(self):
+        cert = _cert([0, 1, 2])
+        cert.signers = [0, 0, 1, 2]
+        with pytest.raises(ValueError, match="duplicate"):
+            cert.verify_signature(_table())
+
+    def test_identity_pubkey_signer_rejected(self):
+        """Quorum-bypass regression: an identity (infinity) G1 key in the
+        table must not let its power count toward quorum. Here signers
+        {0, 1, identity-row} would reach 70/110 > 2/3 with only rows 0+1
+        actually signing — the identity key must be rejected outright."""
+        table = _table()
+        table.append(
+            PowerTableEntry(
+                participant_id=4,
+                power=40,  # signers {0,1,4} = 100 of 140 > 2/3 — quorum met
+                signing_key=base64.b64encode(bls.g1_compress(None)).decode(),
+            )
+        )
+        cert = _cert([0, 1])  # only 0 and 1 really sign
+        cert.signers = [0, 1, 4]
+        with pytest.raises(ValueError, match="identity"):
+            cert.verify_signature(table)
+
+    def test_payload_binds_instance_and_chain(self):
+        # a signature over instance 0's payload must not validate a cert
+        # re-labeled as instance 1 (payload includes the instance)
+        cert = _cert([0, 1, 2])
+        cert.instance = 1
+        with pytest.raises(ValueError, match="signature is invalid"):
+            cert.verify_signature(_table())
+
+
+class TestTrustPolicyPlumbing:
+    def test_verify_signature_at_construction(self):
+        cert = _cert([0, 1, 2])
+        TrustPolicy.with_f3_certificate(
+            cert, verify_signature=True, power_table=_table()
+        )  # no raise
+
+    def test_forged_cert_rejected_at_construction(self):
+        cert = _cert([0, 1, 2], tamper_sig=True)
+        with pytest.raises(ValueError, match="signature is invalid"):
+            TrustPolicy.with_f3_certificate(
+                cert, verify_signature=True, power_table=_table()
+            )
+
+    def test_requires_power_table(self):
+        with pytest.raises(ValueError, match="power_table"):
+            TrustPolicy.with_f3_certificate(_cert([0, 1, 2]), verify_signature=True)
+
+
+class TestChainWithSignaturesAndTableCids:
+    def test_chain_validates_and_checks_table_commitments(self):
+        table0 = _table()
+        # cert 0: no delta; commits to the (unchanged) table CID
+        cert0 = _cert([0, 1, 2], instance=0)
+        cert0.supplemental_data = SupplementalData(
+            power_table=str(power_table_cid(table0))
+        )
+        # re-sign: supplemental data is part of the payload
+        payload = cert0.signing_payload()
+        cert0.signature = bls.g2_compress(
+            bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in (0, 1, 2)])
+        )
+        # cert 1: participant 3 gains 20 power; base = cert 0's head
+        delta = [PowerTableDelta(participant_id=3, power_delta="20", signing_key="")]
+        table1 = [
+            PowerTableEntry(e.participant_id, e.power + (20 if e.participant_id == 3 else 0), e.signing_key)
+            for e in table0
+        ]
+        cert1 = FinalityCertificate(
+            instance=1,
+            ec_chain=[
+                ECTipSet(key=["bafy-head"], epoch=101, power_table="pt-cid"),
+                ECTipSet(key=["bafy-next"], epoch=102, power_table="pt-cid"),
+            ],
+            supplemental_data=SupplementalData(power_table=str(power_table_cid(table1))),
+            power_table_delta=delta,
+        )
+        payload1 = cert1.signing_payload()
+        cert1.signers = [0, 1, 2]
+        cert1.signature = bls.g2_compress(
+            bls.aggregate_signatures([bls.sign(SKS[i], payload1) for i in (0, 1, 2)])
+        )
+        chain = FinalityCertificateChain([cert0, cert1])
+        final = chain.validate(
+            table0, verify_signatures=True, verify_table_cids=True
+        )
+        assert [e.power for e in final] == [30, 30, 30, 30]
+
+    def test_wrong_table_commitment_rejected(self):
+        table0 = _table()
+        cert0 = _cert([0, 1, 2], instance=0)
+        cert0.supplemental_data = SupplementalData(power_table="bafy-wrong")
+        payload = cert0.signing_payload()
+        cert0.signature = bls.g2_compress(
+            bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in (0, 1, 2)])
+        )
+        chain = FinalityCertificateChain([cert0])
+        with pytest.raises(ValueError, match="commitment mismatch"):
+            chain.validate(table0, verify_signatures=True, verify_table_cids=True)
+
+    def test_requires_initial_table(self):
+        with pytest.raises(ValueError, match="initial_power_table"):
+            FinalityCertificateChain([_cert([0, 1, 2])]).validate(
+                verify_signatures=True
+            )
+
+    def test_forged_delta_rejected_under_signatures_alone(self):
+        """The signature payload does not cover the delta; the table
+        commitment is the delta's only authentication, so
+        verify_signatures=True must enforce it without a separate flag."""
+        table0 = _table()
+        cert = _cert([0, 1, 2], instance=0)
+        cert.supplemental_data = SupplementalData(
+            power_table=str(power_table_cid(table0))
+        )
+        payload = cert.signing_payload()
+        cert.signature = bls.g2_compress(
+            bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in (0, 1, 2)])
+        )
+        # attacker splices in a power grab after signing
+        cert.power_table_delta = [
+            PowerTableDelta(participant_id=3, power_delta="1000", signing_key="")
+        ]
+        with pytest.raises(ValueError, match="commitment mismatch"):
+            FinalityCertificateChain([cert]).validate(
+                table0, verify_signatures=True
+            )
+
+    def test_missing_commitment_rejected_under_signatures(self):
+        cert = _cert([0, 1, 2], instance=0)
+        cert.supplemental_data = SupplementalData(power_table="")
+        payload = cert.signing_payload()
+        cert.signature = bls.g2_compress(
+            bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in (0, 1, 2)])
+        )
+        with pytest.raises(ValueError, match="no power-table commitment"):
+            FinalityCertificateChain([cert]).validate(
+                _table(), verify_signatures=True
+            )
