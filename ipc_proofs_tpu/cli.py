@@ -161,6 +161,17 @@ def _cmd_range(args) -> int:
     spec = EventProofSpec(
         event_signature=args.event_sig, topic_1=args.topic1, actor_id_filter=actor_id
     )
+    storage_specs = None
+    if args.storage_slot:
+        from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
+
+        if actor_id is None:
+            print("--storage-slot requires --contract", file=sys.stderr)
+            return 2
+        storage_specs = [
+            MappingSlotSpec(actor_id=actor_id, key=key, slot_index=args.slot_index)
+            for key in args.storage_slot
+        ]
     backend = get_backend(args.backend) if args.backend != "none" else None
     bundle = generate_event_proofs_for_range_chunked(
         RpcBlockstore(client),
@@ -170,12 +181,14 @@ def _cmd_range(args) -> int:
         checkpoint_dir=args.checkpoint_dir,
         match_backend=backend,
         metrics=metrics,
+        storage_specs=storage_specs,
     )
     output = args.output or "range_bundle.json"
     with open(output, "w") as fh:
         fh.write(bundle.to_json())
     print(
-        f"range bundle: {len(bundle.event_proofs)} proofs, "
+        f"range bundle: {len(bundle.event_proofs)} event + "
+        f"{len(bundle.storage_proofs)} storage proofs, "
         f"{len(bundle.blocks)} witness blocks → {output}",
         file=sys.stderr,
     )
@@ -273,7 +286,9 @@ def main(argv=None) -> int:
     ver.add_argument("--check-cids", action="store_true", help="recompute every witness CID")
     ver.set_defaults(fn=_cmd_verify)
 
-    rng = sub.add_parser("range", help="event proofs over an epoch range (chunked, resumable)")
+    rng = sub.add_parser(
+        "range", help="event (+ storage) proofs over an epoch range (chunked, resumable)"
+    )
     rng.add_argument("--endpoint", required=True)
     rng.add_argument("--token", default=None)
     rng.add_argument("--timeout", type=float, default=250.0)
@@ -282,6 +297,15 @@ def main(argv=None) -> int:
     rng.add_argument("--contract", default=None)
     rng.add_argument("--event-sig", required=True)
     rng.add_argument("--topic1", required=True)
+    rng.add_argument(
+        "--storage-slot",
+        action="append",
+        default=None,
+        metavar="KEY",
+        help="also prove this mapping key's slot (of --contract) at every "
+        "pair; repeatable — both proof kinds share the bundle witness",
+    )
+    rng.add_argument("--slot-index", type=int, default=0)
     rng.add_argument("--chunk-size", type=int, default=64)
     rng.add_argument("--checkpoint-dir", default=None)
     rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])

@@ -49,6 +49,8 @@ __all__ = [
     "aggregate_signatures",
     "aggregate_pubkeys",
     "verify_aggregate_same_message",
+    "pop_prove",
+    "pop_verify",
     "g1_compress",
     "g1_decompress",
     "g2_compress",
@@ -643,7 +645,13 @@ def verify_aggregate_same_message(
 
     Identity (infinity) public keys are REJECTED, per BLS KeyValidate: an
     identity key contributes nothing to the aggregate, so accepting one
-    would let its table power count toward quorum without a signature."""
+    would let its table power count toward quorum without a signature.
+
+    SECURITY: same-message aggregation is sound ONLY against keys with a
+    verified proof of possession (`pop_verify`) — without PoP, a rogue key
+    pk_evil = t·G1 − Σ pk_honest lets one participant forge the whole
+    aggregate. Callers at a trust boundary must validate PoPs (the F3
+    certificate path does, mirroring the POP ciphersuite go-f3 uses)."""
     if not pks or agg_sig is None:
         return False
     if any(pk is None for pk in pks):
@@ -652,3 +660,22 @@ def verify_aggregate_same_message(
     if agg_pk is None:
         return False
     return pairing(agg_pk, hash_to_g2(msg, dst)) == pairing(_G1, agg_sig)
+
+
+POP_DST = b"IPC_PROOFS_F3_BLS_POP_V1"
+
+
+def pop_prove(sk: int) -> "tuple":
+    """Proof of possession: sign one's own compressed public key under the
+    dedicated PoP domain tag. Registering a PoP is what makes
+    same-message aggregation rogue-key safe (an attacker cannot produce a
+    PoP for pk_evil = t·G1 − Σ pk_honest without its discrete log)."""
+    pk = sk_to_pk(sk)
+    return sign(sk, g1_compress(pk), POP_DST)
+
+
+def pop_verify(pk, pop) -> bool:
+    """Check a proof of possession for ``pk``."""
+    if pk is None or pop is None:
+        return False
+    return verify(pk, g1_compress(pk), pop, POP_DST)

@@ -58,18 +58,60 @@ __all__ = [
 ]
 
 
-def decode_signing_key(key: str) -> bytes:
-    """Decode a power-table signing key string (base64, Forest JSON's byte
-    encoding, or 0x-hex) to the 48-byte compressed G1 form."""
+def _decode_point_str(value: str, n_bytes: int, what: str) -> bytes:
+    """Decode a compressed-point string (base64 — Forest JSON's byte
+    encoding — or 0x-hex) to exactly ``n_bytes``. The two forms are
+    disambiguated by LENGTH, not prefix: a base64 encoding can legitimately
+    begin with the characters "0x"."""
     import base64
 
-    if key.startswith("0x"):
-        raw = bytes.fromhex(key[2:])
+    hex_len = 2 + 2 * n_bytes
+    if len(value) == hex_len and value.startswith("0x"):
+        raw = bytes.fromhex(value[2:])
     else:
-        raw = base64.b64decode(key, validate=True)
-    if len(raw) != 48:
-        raise ValueError(f"signing key must be 48 bytes, got {len(raw)}")
+        raw = base64.b64decode(value, validate=True)
+    if len(raw) != n_bytes:
+        raise ValueError(f"{what} must be {n_bytes} bytes, got {len(raw)}")
     return raw
+
+
+def decode_signing_key(key: str) -> bytes:
+    """Decode a power-table signing key string to the 48-byte compressed
+    G1 form."""
+    return _decode_point_str(key, 48, "signing key")
+
+
+# (signing_key bytes, pop bytes) pairs that verified — PoP validity is a
+# pure function of the two byte strings, so caching process-wide is sound
+_POP_OK: "set[tuple[bytes, bytes]]" = set()
+
+
+def _check_pop(instance: int, entry: "PowerTableEntry", pk) -> None:
+    """Require a valid proof of possession for a signer's key (rogue-key
+    defense — see `PowerTableEntry.pop`). Raises ValueError otherwise."""
+    from ipc_proofs_tpu.crypto import bls
+
+    if not entry.pop:
+        raise ValueError(
+            f"certificate {instance}: signer {entry.participant_id} has no "
+            f"proof of possession for its key"
+        )
+    key_raw = decode_signing_key(entry.signing_key)
+    pop_raw = _decode_point_str(entry.pop, 96, "proof of possession")
+    if (key_raw, pop_raw) in _POP_OK:
+        return
+    try:
+        pop_point = bls.g2_decompress(pop_raw)
+    except ValueError as exc:
+        raise ValueError(
+            f"certificate {instance}: signer {entry.participant_id}: {exc}"
+        ) from exc
+    if not bls.pop_verify(pk, pop_point):
+        raise ValueError(
+            f"certificate {instance}: signer {entry.participant_id} proof of "
+            f"possession is invalid"
+        )
+    _POP_OK.add((key_raw, pop_raw))
 
 
 def power_table_cid(table: "Sequence[PowerTableEntry]"):
@@ -224,9 +266,14 @@ class FinalityCertificate:
 
         Raises ValueError describing the first failure; returns None on
         success. Checks, in order: signers resolve to table rows; strong
-        quorum (3·signer_power > 2·total_power); signature bytes decode to
-        a G2 subgroup point; the aggregate verifies over
-        `signing_payload`.
+        quorum (3·signer_power > 2·total_power); every signer's key carries
+        a valid proof of possession (same-message aggregation is rogue-key
+        forgeable without PoP — a participant registering
+        pk = t·G1 − Σ pk_others could otherwise forge the aggregate alone);
+        signature bytes decode to a G2 subgroup point; the aggregate
+        verifies over `signing_payload`. PoP results are cached per
+        (key, pop) process-wide — re-verifying a certificate chain does not
+        re-pair every signer.
         """
         from ipc_proofs_tpu.crypto import bls
 
@@ -263,6 +310,8 @@ class FinalityCertificate:
                 f"certificate {self.instance} has a signer with an identity "
                 f"public key"
             )
+        for entry, pk in zip(signer_rows, pks):
+            _check_pop(self.instance, entry, pk)
         if not bls.verify_aggregate_same_message(pks, self.signing_payload(), sig):
             raise ValueError(
                 f"certificate {self.instance} aggregate BLS signature is invalid"
@@ -304,11 +353,18 @@ class FinalityCertificate:
 
 @dataclass
 class PowerTableEntry:
-    """One row of an F3 power table: participant id → (power, BLS key)."""
+    """One row of an F3 power table: participant id → (power, BLS key).
+
+    ``pop`` is the key's proof of possession (96-byte compressed G2,
+    base64 or 0x-hex) — REQUIRED for signature verification: same-message
+    BLS aggregation is rogue-key forgeable against keys without a verified
+    PoP (go-f3 uses the POP ciphersuite for exactly this reason). Not part
+    of the table's CID commitment (go-f3 commits (id, power, key))."""
 
     participant_id: int
     power: int
     signing_key: str
+    pop: str = ""
 
 
 def apply_power_table_delta(
@@ -330,7 +386,10 @@ def apply_power_table_delta(
     ids = [d.participant_id for d in deltas]
     if ids != sorted(set(ids)):
         raise ValueError("power table delta not strictly sorted by participant id")
-    rows = {e.participant_id: PowerTableEntry(e.participant_id, e.power, e.signing_key) for e in table}
+    rows = {
+        e.participant_id: PowerTableEntry(e.participant_id, e.power, e.signing_key, e.pop)
+        for e in table
+    }
     for d in deltas:
         delta = int(d.power_delta)
         row = rows.get(d.participant_id)
@@ -355,6 +414,11 @@ def apply_power_table_delta(
         else:
             row.power = new_power
             if d.signing_key:
+                # a replaced key invalidates the old proof of possession;
+                # the participant must re-register one (out of band, like
+                # the delta's key itself) before signing again
+                if d.signing_key != row.signing_key:
+                    row.pop = ""
                 row.signing_key = d.signing_key
     return [rows[pid] for pid in sorted(rows)]
 

@@ -14,6 +14,12 @@ re-shapes the work TPU-first:
                     share headers/TxMeta/receipt paths, so the range-level
                     dedup is strictly stronger than the reference's
                     per-bundle dedup.
+
+Mixed bundles: every driver accepts ``storage_specs`` (a
+`storage_batch.MappingSlotSpec` grid proved at every pair, slot keccaks
+hashed once range-wide) and merges both proof kinds into the one
+deduplicated, checkpoint-resumable witness — the range generalization of
+the reference's unified bundle (`src/proofs/generator.rs:25-95`).
 """
 
 from __future__ import annotations
@@ -58,6 +64,7 @@ def generate_event_proofs_for_range_chunked(
     checkpoint_dir: "str | None" = None,
     match_backend=None,
     metrics: Optional[Metrics] = None,
+    storage_specs=None,
 ) -> UnifiedProofBundle:
     """Chunked, resumable range generation.
 
@@ -65,20 +72,45 @@ def generate_event_proofs_for_range_chunked(
     bundle is written to ``checkpoint_dir/chunk_NNNN.json`` and skipped on
     re-run (crash recovery for long ranges — the reference aborts the whole
     run on any error and restarts from zero, SURVEY.md §5). The merged
-    bundle deduplicates witness blocks across chunks.
+    bundle deduplicates witness blocks across chunks. ``storage_specs``
+    prove at every pair of every chunk and ride the same resumable
+    checkpoints (both proof kinds serialize in the chunk bundles).
     """
+    import hashlib
     import os
 
     metrics = metrics or Metrics()
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
 
+    # checkpoints are only valid for the exact request that wrote them —
+    # the filename carries a digest of (event spec, storage specs,
+    # chunk size), so a re-run with different specs regenerates instead of
+    # silently resuming stale bundles missing (or carrying extra) proofs
+    spec_digest = hashlib.sha256(
+        repr(
+            (
+                spec.event_signature,
+                spec.topic_1,
+                spec.actor_id_filter,
+                chunk_size,
+                [
+                    (s.actor_id, s.key32().hex(), s.slot_index)
+                    for s in (storage_specs or [])
+                ],
+            )
+        ).encode()
+    ).hexdigest()[:12]
+
+    storage_proofs = []
     event_proofs = []
     all_blocks: set[ProofBlock] = set()
     for chunk_index, start in enumerate(range(0, len(pairs), chunk_size)):
         chunk = pairs[start : start + chunk_size]
         path = (
-            os.path.join(checkpoint_dir, f"chunk_{chunk_index:04d}.json")
+            os.path.join(
+                checkpoint_dir, f"chunk_{spec_digest}_{chunk_index:04d}.json"
+            )
             if checkpoint_dir is not None
             else None
         )
@@ -88,7 +120,12 @@ def generate_event_proofs_for_range_chunked(
             metrics.count("range_chunks_resumed")
         else:
             bundle = generate_event_proofs_for_range(
-                store, chunk, spec, match_backend=match_backend, metrics=metrics
+                store,
+                chunk,
+                spec,
+                match_backend=match_backend,
+                metrics=metrics,
+                storage_specs=storage_specs,
             )
             if path is not None:
                 tmp = path + ".tmp"
@@ -96,11 +133,12 @@ def generate_event_proofs_for_range_chunked(
                     fh.write(bundle.to_json())
                 os.replace(tmp, path)  # atomic: partial writes never count
             metrics.count("range_chunks_generated")
+        storage_proofs.extend(bundle.storage_proofs)
         event_proofs.extend(bundle.event_proofs)
         all_blocks.update(bundle.blocks)
 
     return UnifiedProofBundle(
-        storage_proofs=[],
+        storage_proofs=storage_proofs,
         event_proofs=event_proofs,
         blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
     )
@@ -113,6 +151,7 @@ def generate_event_proofs_for_range(
     match_backend=None,
     metrics: Optional[Metrics] = None,
     scan_workers: int = 0,
+    storage_specs=None,
 ) -> UnifiedProofBundle:
     """Generate event proofs for ``spec`` across a whole range of tipset
     pairs, with one device mask call for the entire range.
@@ -120,6 +159,13 @@ def generate_event_proofs_for_range(
     ``scan_workers > 0`` runs Phase A over a thread pool — for RPC-backed
     stores this overlaps block fetches across pairs (the reference fetches
     strictly one block at a time, `client/blockstore.rs:21-28`).
+
+    ``storage_specs``: optional `storage_batch.MappingSlotSpec` grid proved
+    against EVERY pair in the range (the reference's unified bundle mixes
+    N storage + M event specs for one pair, `src/proofs/generator.rs:25-95`;
+    this is its range generalization — e.g. tracking a subnet's nonce slot
+    across the whole range). Slot-preimage keccaks hash ONCE range-wide;
+    both proof kinds share one deduplicated witness.
     """
     metrics = metrics or Metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
@@ -132,9 +178,48 @@ def generate_event_proofs_for_range(
             cached, pairs, matching_per_pair, matcher, spec, native_ok
         )
     metrics.count("range_proofs", len(event_proofs))
+
+    storage_proofs: list = []
+    if storage_specs:
+        with metrics.stage("range_storage"):
+            storage_proofs, storage_blocks = _storage_for_pairs(
+                cached, pairs, storage_specs, match_backend
+            )
+        metrics.count("range_storage_proofs", len(storage_proofs))
+        merged = set(blocks)
+        merged.update(storage_blocks)
+        blocks = sorted(merged, key=lambda b: b.cid.to_bytes())
+
     return UnifiedProofBundle(
-        storage_proofs=[], event_proofs=event_proofs, blocks=blocks
+        storage_proofs=storage_proofs, event_proofs=event_proofs, blocks=blocks
     )
+
+
+def _storage_for_pairs(
+    cached: Blockstore, pairs: Sequence[TipsetPair], storage_specs, hash_backend
+) -> "tuple[list, set[ProofBlock]]":
+    """Prove every storage spec at every pair: slot digests hashed once
+    for the whole range, per-pair walks share the range cache, witness
+    blocks returned as a set for cross-kind dedup."""
+    from ipc_proofs_tpu.proofs.storage_batch import (
+        generate_storage_proofs_batch,
+        hash_slot_specs,
+    )
+
+    slots = hash_slot_specs(storage_specs, hash_backend)
+    proofs: list = []
+    blocks: set[ProofBlock] = set()
+    for pair in pairs:
+        bundle = generate_storage_proofs_batch(
+            cached,
+            pair.parent,
+            pair.child,
+            storage_specs,
+            precomputed_slots=slots,
+        )
+        proofs.extend(bundle.storage_proofs)
+        blocks.update(bundle.blocks)
+    return proofs, blocks
 
 
 def _scan_and_match(
@@ -336,6 +421,7 @@ def generate_event_proofs_for_range_pipelined(
     chunk_size: int = 512,
     match_backend=None,
     metrics: Optional[Metrics] = None,
+    storage_specs=None,
 ) -> UnifiedProofBundle:
     """Phase-overlapped range generation: the range is split into chunks
     and chunk k+1's scan+match runs on a worker thread while chunk k
@@ -386,8 +472,17 @@ def generate_event_proofs_for_range_pipelined(
             all_blocks.update(blocks)
     metrics.count("range_proofs", len(event_proofs))
 
+    storage_proofs: list = []
+    if storage_specs:
+        with metrics.stage("range_storage"):
+            storage_proofs, storage_blocks = _storage_for_pairs(
+                cached, pairs, storage_specs, match_backend
+            )
+        metrics.count("range_storage_proofs", len(storage_proofs))
+        all_blocks.update(storage_blocks)
+
     return UnifiedProofBundle(
-        storage_proofs=[],
+        storage_proofs=storage_proofs,
         event_proofs=event_proofs,
         blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
     )

@@ -30,7 +30,7 @@ from ipc_proofs_tpu.state.storage import read_storage_slot
 from ipc_proofs_tpu.store.blockstore import Blockstore, CachedBlockstore, RecordingBlockstore
 from ipc_proofs_tpu.utils.metrics import Metrics
 
-__all__ = ["MappingSlotSpec", "generate_storage_proofs_batch"]
+__all__ = ["MappingSlotSpec", "generate_storage_proofs_batch", "hash_slot_specs"]
 
 
 @dataclass
@@ -49,6 +49,20 @@ class MappingSlotSpec:
         return self.key
 
 
+def hash_slot_specs(
+    specs: Sequence[MappingSlotSpec], hash_backend=None
+) -> "list[bytes]":
+    """Derive every spec's storage-slot digest in one batch keccak call
+    (device or C++ via ``hash_backend``; scalar otherwise). Range drivers
+    hash once and reuse the digests across every pair."""
+    preimages = [s.key32() + s.slot_index.to_bytes(32, "big") for s in specs]
+    if hash_backend is not None:
+        return hash_backend.keccak256_batch(preimages)
+    from ipc_proofs_tpu.core.hashes import keccak256
+
+    return [keccak256(p) for p in preimages]
+
+
 def generate_storage_proofs_batch(
     store: Blockstore,
     parent: Tipset,
@@ -56,24 +70,26 @@ def generate_storage_proofs_batch(
     specs: Sequence[MappingSlotSpec],
     hash_backend=None,
     metrics: Optional[Metrics] = None,
+    precomputed_slots: "Optional[Sequence[bytes]]" = None,
 ) -> UnifiedProofBundle:
     """Generate storage proofs for a grid of mapping slots.
 
     ``hash_backend``: optional `BatchHashBackend`; all slot preimages hash in
-    one batch call. None = scalar keccak per slot.
+    one batch call. None = scalar keccak per slot. ``precomputed_slots``
+    skips the hashing phase entirely (range drivers hash the grid once for
+    all pairs via `hash_slot_specs`).
     """
     metrics = metrics or Metrics()
     cached = CachedBlockstore(store)
 
     # Phase 1: derive all slot digests in one batch.
     with metrics.stage("slot_hash"):
-        preimages = [s.key32() + s.slot_index.to_bytes(32, "big") for s in specs]
-        if hash_backend is not None:
-            slots = hash_backend.keccak256_batch(preimages)
+        if precomputed_slots is not None:
+            if len(precomputed_slots) != len(specs):
+                raise ValueError("precomputed_slots length must match specs")
+            slots = list(precomputed_slots)
         else:
-            from ipc_proofs_tpu.core.hashes import keccak256
-
-            slots = [keccak256(p) for p in preimages]
+            slots = hash_slot_specs(specs, hash_backend)
     metrics.count("batch_slots", len(slots))
 
     # Phase 2: child header extraction + cross-check (once for the batch).

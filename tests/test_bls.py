@@ -26,12 +26,15 @@ from ipc_proofs_tpu.proofs.trust import TrustPolicy
 SKS = [11111, 22222, 33333, 44444]
 PKS = [bls.sk_to_pk(sk) for sk in SKS]
 KEY_STRS = [base64.b64encode(bls.g1_compress(pk)).decode() for pk in PKS]
+POPS = [base64.b64encode(bls.g2_compress(bls.pop_prove(sk))).decode() for sk in SKS]
 POWERS = [30, 30, 30, 10]
 
 
 def _table():
     return [
-        PowerTableEntry(participant_id=i, power=POWERS[i], signing_key=KEY_STRS[i])
+        PowerTableEntry(
+            participant_id=i, power=POWERS[i], signing_key=KEY_STRS[i], pop=POPS[i]
+        )
         for i in range(4)
     ]
 
@@ -130,6 +133,60 @@ class TestCertificateSignature:
         cert.signers = [0, 0, 1, 2]
         with pytest.raises(ValueError, match="duplicate"):
             cert.verify_signature(_table())
+
+    def test_rogue_key_attack_rejected(self):
+        """Same-message aggregation is forgeable WITHOUT proof of
+        possession: pk_evil = t·G1 − Σ pk_honest makes the aggregate key
+        t·G1, so sig = t·H(payload) verifies over ALL signers. The PoP
+        requirement must stop it (the attacker cannot produce a PoP for
+        pk_evil without its discrete log)."""
+        from ipc_proofs_tpu.crypto.bls import (
+            _G1,
+            _OPS1,
+            _OPS2,
+            _pt_add,
+            _pt_mul,
+            _pt_neg,
+        )
+
+        t = 987654321
+        evil_pk = _pt_add(
+            _OPS1,
+            _pt_mul(_OPS1, _G1, t),
+            _pt_neg(_OPS1, bls.aggregate_pubkeys(PKS[:3])),
+        )
+        table = _table()[:3]
+        table.append(
+            PowerTableEntry(
+                participant_id=3,
+                power=10,
+                signing_key=base64.b64encode(bls.g1_compress(evil_pk)).decode(),
+                pop=POPS[0],  # forged: someone else's PoP — must not validate
+            )
+        )
+        cert = FinalityCertificate(
+            instance=0,
+            ec_chain=[ECTipSet(key=["bafy-a"], epoch=100, power_table="pt")],
+        )
+        cert.signers = [0, 1, 2, 3]
+        cert.signature = bls.g2_compress(
+            _pt_mul(_OPS2, bls.hash_to_g2(cert.signing_payload()), t)
+        )
+        # the forged aggregate WOULD pass the raw pairing check:
+        assert bls.verify_aggregate_same_message(
+            PKS[:3] + [evil_pk],
+            cert.signing_payload(),
+            bls.g2_decompress(cert.signature),
+        )
+        # ...but PoP enforcement rejects it
+        with pytest.raises(ValueError, match="possession"):
+            cert.verify_signature(table)
+
+    def test_missing_pop_rejected(self):
+        table = _table()
+        table[1].pop = ""
+        with pytest.raises(ValueError, match="no proof of possession"):
+            _cert([0, 1, 2]).verify_signature(table)
 
     def test_identity_pubkey_signer_rejected(self):
         """Quorum-bypass regression: an identity (infinity) G1 key in the

@@ -100,6 +100,96 @@ class TestRangeDriver:
         bundle = generate_event_proofs_for_range_pipelined(bs, [], spec)
         assert bundle.event_proofs == [] and bundle.blocks == []
 
+    def test_mixed_storage_and_event_range(self):
+        """A range run carrying storage specs emits BOTH proof kinds in one
+        deduplicated witness and round-trips verify_proof_bundle
+        (reference unified-bundle semantics, `generator.rs:25-95`,
+        generalized over the range)."""
+        from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+        from ipc_proofs_tpu.proofs.range import (
+            generate_event_proofs_for_range_pipelined,
+        )
+        from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
+        from ipc_proofs_tpu.state.storage import calculate_storage_slot
+
+        bs = MemoryBlockstore()
+        pairs = []
+        for p in range(4):
+            world = build_chain(
+                [
+                    ContractFixture(
+                        actor_id=ACTOR,
+                        storage={
+                            calculate_storage_slot("subnet-x", 0): bytes([p + 1])
+                        },
+                    )
+                ],
+                [[EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET)]],
+                parent_height=100 + 2 * p,
+                store=bs,
+            )
+            pairs.append(TipsetPair(parent=world.parent, child=world.child))
+
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        storage_specs = [MappingSlotSpec(actor_id=ACTOR, key="subnet-x", slot_index=0)]
+        bundle = generate_event_proofs_for_range(
+            bs, pairs, spec, match_backend=get_backend("cpu"), storage_specs=storage_specs
+        )
+        assert len(bundle.event_proofs) == 4
+        assert len(bundle.storage_proofs) == 4
+        # per-pair slot values surfaced correctly
+        values = sorted(p.value for p in bundle.storage_proofs)
+        assert values == sorted(
+            "0x" + bytes([v + 1]).rjust(32, b"\x00").hex() for v in range(4)
+        )
+        # one deduplicated CID-sorted witness covering both kinds
+        cids = [b.cid for b in bundle.blocks]
+        assert cids == sorted(cids) and len(cids) == len(set(cids))
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert result.all_valid()
+        assert len(result.storage_results) == 4 and len(result.event_results) == 4
+
+        # pipelined and chunked drivers emit the same mixed bundle
+        piped = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2,
+            match_backend=get_backend("cpu"), storage_specs=storage_specs,
+        )
+        assert piped.to_json() == bundle.to_json()
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+
+        chunked = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2,
+            match_backend=get_backend("cpu"), storage_specs=storage_specs,
+        )
+        assert sorted(p.to_json_obj().items().__str__() for p in chunked.storage_proofs) == sorted(
+            p.to_json_obj().items().__str__() for p in bundle.storage_proofs
+        )
+        assert [str(b.cid) for b in chunked.blocks] == [str(b.cid) for b in bundle.blocks]
+
+    def test_mixed_range_checkpoint_resume(self, tmp_path):
+        """Storage proofs ride the chunk checkpoints: a resumed run loads
+        them from disk instead of regenerating."""
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+        from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
+
+        bs, pairs, _ = _make_range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        storage_specs = [MappingSlotSpec(actor_id=ACTOR, key="missing-key", slot_index=0)]
+        m1 = Metrics()
+        first = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=str(tmp_path),
+            storage_specs=storage_specs, metrics=m1,
+        )
+        # missing key ⇒ zero value, matching the reference's semantics
+        assert all(p.value == "0x" + "00" * 32 for p in first.storage_proofs)
+        m2 = Metrics()
+        resumed = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=str(tmp_path),
+            storage_specs=storage_specs, metrics=m2,
+        )
+        assert resumed.to_json() == first.to_json()
+        assert m2.snapshot()["counters"].get("range_chunks_resumed") == 2
+
     def test_metrics_populated(self):
         bs, pairs, expected = _make_range(4)
         spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)

@@ -79,7 +79,7 @@ class TestChunkedResume:
         generate_event_proofs_for_range_chunked(
             bs, pairs[:2], spec, chunk_size=2, checkpoint_dir=str(ckpt)
         )
-        assert (ckpt / "chunk_0000.json").exists()
+        assert list(ckpt.glob("chunk_*_0000.json"))
         m = Metrics()
         full = generate_event_proofs_for_range_chunked(
             bs, pairs, spec, chunk_size=2, checkpoint_dir=str(ckpt), metrics=m
@@ -88,6 +88,28 @@ class TestChunkedResume:
         assert counters["range_chunks_resumed"] == 1
         assert counters["range_chunks_generated"] == 2
         assert len(full.event_proofs) == 6
+
+    def test_checkpoints_keyed_by_request(self, tmp_path):
+        """Checkpoints written for one request must NOT be resumed by a
+        different one: adding storage specs to a re-run regenerates instead
+        of silently reusing event-only chunk bundles."""
+        from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
+
+        bs, pairs = _range(4)
+        spec = EventProofSpec(event_signature=SIG, topic_1="s", actor_id_filter=5)
+        ckpt = tmp_path / "ckpt"
+        generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=str(ckpt)
+        )
+        m = Metrics()
+        mixed = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=str(ckpt), metrics=m,
+            storage_specs=[MappingSlotSpec(actor_id=5, key="k", slot_index=0)],
+        )
+        counters = m.snapshot()["counters"]
+        assert "range_chunks_resumed" not in counters
+        assert counters["range_chunks_generated"] == 2
+        assert len(mixed.storage_proofs) == len(pairs)
 
     def test_checkpoint_files_are_valid_bundles(self, tmp_path):
         bs, pairs = _range(4)
