@@ -158,6 +158,10 @@ def main() -> None:
                         help="subrange size for the scalar baseline measurement")
     parser.add_argument("--probe-timeout", type=float, default=240.0)
     parser.add_argument("--quick", action="store_true", help="small shapes for smoke runs")
+    parser.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="emit a jax.profiler trace of one measured e2e pass into DIR",
+    )
     args = parser.parse_args()
 
     if args.quick:
@@ -213,6 +217,17 @@ def main() -> None:
     results, _ = _staged_verify(bundle, backend)
     assert all(results) and len(results) == len(bundle.event_proofs)
     _log(f"bench: warmup (incl. jit compile) {time.perf_counter() - t0:.1f}s")
+
+    # optional profiler trace of one representative pass (not measured)
+    if args.profile:
+        from ipc_proofs_tpu.utils.profiling import maybe_profile
+
+        with maybe_profile(args.profile):
+            profiled = generate_event_proofs_for_range_pipelined(
+                bs, pairs, spec, chunk_size=chunk_size, match_backend=backend
+            )
+            _staged_verify(profiled, backend)
+        del profiled
 
     # --- measured end-to-end passes (best of 2 — steady state, GC settled) --
     import gc

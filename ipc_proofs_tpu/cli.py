@@ -22,6 +22,10 @@ import json
 import sys
 import time
 
+from ipc_proofs_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
 
 def _cmd_generate(args) -> int:
     from ipc_proofs_tpu.backend import get_backend
@@ -43,7 +47,7 @@ def _cmd_generate(args) -> int:
     with metrics.stage("fetch_tipsets"):
         parent = Tipset.fetch(client, args.height)
         child = Tipset.fetch(client, args.height + 1)
-    print(f"parent tipset @{parent.height}: {len(parent.cids)} blocks", file=sys.stderr)
+    log.info("parent tipset @%d: %d blocks", parent.height, len(parent.cids))
 
     with metrics.stage("resolve_address"):
         actor_id = (
@@ -51,7 +55,7 @@ def _cmd_generate(args) -> int:
             if args.actor_id is not None
             else resolve_eth_address_to_actor_id(client, args.contract)
         )
-    print(f"actor id: {actor_id}", file=sys.stderr)
+    log.info("actor id: %d", actor_id)
 
     storage_specs = []
     if args.slot_subnet is not None:
@@ -78,11 +82,10 @@ def _cmd_generate(args) -> int:
     output = args.output or "bundle.json"
     with open(output, "w") as fh:
         fh.write(bundle.to_json(indent=2))
-    print(
-        f"bundle: {len(bundle.storage_proofs)} storage + {len(bundle.event_proofs)} "
-        f"event proofs, {len(bundle.blocks)} witness blocks "
-        f"({bundle.witness_bytes()} bytes) → {output}",
-        file=sys.stderr,
+    log.info(
+        "bundle: %d storage + %d event proofs, %d witness blocks (%d bytes) → %s",
+        len(bundle.storage_proofs), len(bundle.event_proofs),
+        len(bundle.blocks), bundle.witness_bytes(), output,
     )
     if args.metrics:
         print(metrics.to_json(), file=sys.stderr)
@@ -104,7 +107,7 @@ def _cmd_verify(args) -> int:
             cert = FinalityCertificate.from_json_obj(json.load(fh))
         policy = TrustPolicy.with_f3_certificate(cert)
     else:
-        print("WARNING: no F3 certificate — accept-all trust (testing only)", file=sys.stderr)
+        log.warning("no F3 certificate — accept-all trust (testing only)")
         policy = TrustPolicy.accept_all()
 
     event_filter = (
@@ -143,20 +146,26 @@ def _cmd_range(args) -> int:
     from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
     from ipc_proofs_tpu.utils.metrics import get_metrics
 
+    if args.storage_slot and not args.contract:
+        # validate before any network work — the tipset fetch below can be
+        # tens of thousands of RPC calls
+        log.error("--storage-slot requires --contract")
+        return 2
+
     metrics = get_metrics()
     client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
 
     actor_id = None
     if args.contract:
         actor_id = resolve_eth_address_to_actor_id(client, args.contract)
-        print(f"actor id: {actor_id}", file=sys.stderr)
+        log.info("actor id: %d", actor_id)
 
     with metrics.stage("fetch_tipsets"):
         tipsets = [Tipset.fetch(client, h) for h in range(args.from_height, args.to_height + 2)]
     pairs = [
         TipsetPair(parent=tipsets[i], child=tipsets[i + 1]) for i in range(len(tipsets) - 1)
     ]
-    print(f"range: {len(pairs)} tipset pairs", file=sys.stderr)
+    log.info("range: %d tipset pairs", len(pairs))
 
     spec = EventProofSpec(
         event_signature=args.event_sig, topic_1=args.topic1, actor_id_filter=actor_id
@@ -165,35 +174,51 @@ def _cmd_range(args) -> int:
     if args.storage_slot:
         from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
 
-        if actor_id is None:
-            print("--storage-slot requires --contract", file=sys.stderr)
-            return 2
         storage_specs = [
             MappingSlotSpec(actor_id=actor_id, key=key, slot_index=args.slot_index)
             for key in args.storage_slot
         ]
     backend = get_backend(args.backend) if args.backend != "none" else None
-    bundle = generate_event_proofs_for_range_chunked(
-        RpcBlockstore(client),
-        pairs,
-        spec,
-        chunk_size=args.chunk_size,
-        checkpoint_dir=args.checkpoint_dir,
-        match_backend=backend,
-        metrics=metrics,
-        storage_specs=storage_specs,
-    )
+    from ipc_proofs_tpu.utils.profiling import maybe_profile
+
+    with maybe_profile(args.profile):
+        bundle = generate_event_proofs_for_range_chunked(
+            RpcBlockstore(client),
+            pairs,
+            spec,
+            chunk_size=args.chunk_size,
+            checkpoint_dir=args.checkpoint_dir,
+            match_backend=backend,
+            metrics=metrics,
+            storage_specs=storage_specs,
+        )
     output = args.output or "range_bundle.json"
     with open(output, "w") as fh:
         fh.write(bundle.to_json())
-    print(
-        f"range bundle: {len(bundle.event_proofs)} event + "
-        f"{len(bundle.storage_proofs)} storage proofs, "
-        f"{len(bundle.blocks)} witness blocks → {output}",
-        file=sys.stderr,
+    log.info(
+        "range bundle: %d event + %d storage proofs, %d witness blocks → %s",
+        len(bundle.event_proofs), len(bundle.storage_proofs), len(bundle.blocks), output,
     )
     if args.metrics:
         print(metrics.to_json(), file=sys.stderr)
+    return 0
+
+
+def _cmd_vectors(args) -> int:
+    """Capture live-chain byte-compat vectors (headers, TxMeta,
+    receipts-AMT root) into a fixtures JSON the test suite consumes —
+    grounds the codecs against real chain bytes the way the reference's
+    live run does implicitly (`src/main.rs:19-101`)."""
+    from ipc_proofs_tpu.proofs.vectors import capture_vectors, check_vectors, write_vectors
+    from ipc_proofs_tpu.store.rpc import LotusClient
+
+    client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
+    doc = capture_vectors(client, args.height)
+    n = check_vectors(doc)  # never write vectors we cannot re-verify
+    output = args.output or "vectors.json"
+    write_vectors(doc, output)
+    log.info("captured %d vectors at height %d → %s", n, args.height, output)
+    log.info("re-run the byte-compat suite with IPC_VECTORS_FILE=%s", output)
     return 0
 
 
@@ -311,7 +336,24 @@ def main(argv=None) -> int:
     rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
     rng.add_argument("-o", "--output", default=None)
     rng.add_argument("--metrics", action="store_true")
+    rng.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="emit a jax.profiler trace of generation into DIR "
+        "(TensorBoard/Perfetto format)",
+    )
     rng.set_defaults(fn=_cmd_range)
+
+    vec = sub.add_parser(
+        "vectors", help="capture live-chain byte-compat vectors to a fixtures JSON"
+    )
+    vec.add_argument("--endpoint", required=True)
+    vec.add_argument("--token", default=None)
+    vec.add_argument("--timeout", type=float, default=250.0)
+    vec.add_argument("--height", type=int, required=True)
+    vec.add_argument("-o", "--output", default=None)
+    vec.set_defaults(fn=_cmd_vectors)
 
     demo = sub.add_parser("demo", help="hermetic end-to-end demo on a synthetic chain")
     demo.set_defaults(fn=_cmd_demo)

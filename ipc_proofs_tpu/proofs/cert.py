@@ -177,6 +177,10 @@ class PowerTableDelta:
     participant_id: int
     power_delta: str
     signing_key: str
+    # proof of possession accompanying a new or rotated key — without it the
+    # (new) key can never satisfy the signer PoP requirement, so committee
+    # churn would make later certificates unverifiable
+    pop: str = ""
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "PowerTableDelta":
@@ -184,6 +188,7 @@ class PowerTableDelta:
             participant_id=obj["ParticipantID"],
             power_delta=obj["PowerDelta"],
             signing_key=obj["SigningKey"],
+            pop=obj.get("Pop", ""),
         )
 
 
@@ -402,9 +407,11 @@ def apply_power_table_delta(
                 raise ValueError(
                     f"new participant {d.participant_id} is missing a signing key"
                 )
-            rows[d.participant_id] = PowerTableEntry(d.participant_id, delta, d.signing_key)
+            rows[d.participant_id] = PowerTableEntry(
+                d.participant_id, delta, d.signing_key, d.pop
+            )
             continue
-        if delta == 0 and not d.signing_key:
+        if delta == 0 and not d.signing_key and not d.pop:
             raise ValueError(f"no-op delta for participant {d.participant_id}")
         new_power = row.power + delta
         if new_power < 0:
@@ -414,12 +421,16 @@ def apply_power_table_delta(
         else:
             row.power = new_power
             if d.signing_key:
-                # a replaced key invalidates the old proof of possession;
-                # the participant must re-register one (out of band, like
-                # the delta's key itself) before signing again
+                # a replaced key invalidates the old proof of possession:
+                # take the delta's accompanying PoP (empty until the
+                # participant registers one for the new key)
                 if d.signing_key != row.signing_key:
-                    row.pop = ""
+                    row.pop = d.pop
+                elif d.pop:
+                    row.pop = d.pop
                 row.signing_key = d.signing_key
+            elif d.pop:
+                row.pop = d.pop  # PoP (re-)registration without a key change
     return [rows[pid] for pid in sorted(rows)]
 
 

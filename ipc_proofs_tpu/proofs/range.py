@@ -84,23 +84,31 @@ def generate_event_proofs_for_range_chunked(
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # checkpoints are only valid for the exact request that wrote them —
-    # the filename carries a digest of (event spec, storage specs,
-    # chunk size), so a re-run with different specs regenerates instead of
-    # silently resuming stale bundles missing (or carrying extra) proofs
-    spec_digest = hashlib.sha256(
-        repr(
-            (
-                spec.event_signature,
-                spec.topic_1,
-                spec.actor_id_filter,
-                chunk_size,
-                [
-                    (s.actor_id, s.key32().hex(), s.slot_index)
-                    for s in (storage_specs or [])
-                ],
-            )
-        ).encode()
-    ).hexdigest()[:12]
+    # each chunk's filename carries a digest of (event spec, storage specs,
+    # chunk size, AND the chunk's own tipset identity), so a re-run with
+    # different specs OR over a different epoch range regenerates instead
+    # of silently resuming stale bundles
+    spec_repr = repr(
+        (
+            spec.event_signature,
+            spec.topic_1,
+            spec.actor_id_filter,
+            chunk_size,
+            [
+                (s.actor_id, s.key32().hex(), s.slot_index)
+                for s in (storage_specs or [])
+            ],
+        )
+    ).encode()
+
+    def _chunk_digest(chunk) -> str:
+        h = hashlib.sha256(spec_repr)
+        for pair in chunk:
+            for cid in pair.parent.cids:
+                h.update(cid.to_bytes())
+            for cid in pair.child.cids:
+                h.update(cid.to_bytes())
+        return h.hexdigest()[:12]
 
     storage_proofs = []
     event_proofs = []
@@ -109,7 +117,7 @@ def generate_event_proofs_for_range_chunked(
         chunk = pairs[start : start + chunk_size]
         path = (
             os.path.join(
-                checkpoint_dir, f"chunk_{spec_digest}_{chunk_index:04d}.json"
+                checkpoint_dir, f"chunk_{_chunk_digest(chunk)}_{chunk_index:04d}.json"
             )
             if checkpoint_dir is not None
             else None

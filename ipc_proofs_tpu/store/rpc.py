@@ -88,6 +88,12 @@ class LotusClient:
             except Exception as exc:  # transport errors: retry with backoff
                 last_err = exc
                 if attempt + 1 < self.max_retries:
+                    from ipc_proofs_tpu.utils.log import get_logger
+
+                    get_logger(__name__).warning(
+                        "RPC %s attempt %d/%d failed (%s) — retrying",
+                        method, attempt + 1, self.max_retries, exc,
+                    )
                     time.sleep(min(2.0**attempt, 10.0))
         raise RuntimeError(f"RPC {method} failed after {self.max_retries} attempts") from last_err
 

@@ -1,0 +1,64 @@
+"""Leveled, structured logging for the framework.
+
+SURVEY.md §5: the reference declares `tracing` but never initializes a
+subscriber, so its logs are dropped, and everything user-visible is ad-hoc
+`eprintln!`. Here every module logs through one `ipc_proofs` logger tree:
+
+    from ipc_proofs_tpu.utils.log import get_logger
+    log = get_logger(__name__)
+    log.info("range: %d pairs", n)
+
+Level comes from ``IPC_LOG_LEVEL`` (DEBUG/INFO/WARNING/ERROR, default
+INFO); output is one stderr line per record with timestamp, level and
+logger name. The handler attaches once to the `ipc_proofs` root, so
+applications embedding the library can replace it with their own handlers
+via standard `logging` configuration.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["get_logger"]
+
+_ROOT = "ipc_proofs"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    root = logging.getLogger(_ROOT)
+    # Respect an embedding application's config: if the app configured
+    # either the `ipc_proofs` logger or the process root logger (e.g.
+    # logging.basicConfig), attach nothing and let records propagate
+    # through its handlers. Only a genuinely unconfigured process gets the
+    # library's own stderr handler + level default.
+    if root.handlers or logging.getLogger().handlers:
+        if "IPC_LOG_LEVEL" in os.environ:
+            level = os.environ["IPC_LOG_LEVEL"].upper()
+            root.setLevel(getattr(logging, level, logging.INFO))
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    root.addHandler(handler)
+    level = os.environ.get("IPC_LOG_LEVEL", "INFO").upper()
+    root.setLevel(getattr(logging, level, logging.INFO))
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    """A logger under the `ipc_proofs` tree; ``name`` is typically
+    ``__name__`` (the package prefix is normalized away)."""
+    _configure()
+    short = name.removeprefix("ipc_proofs_tpu.")
+    return logging.getLogger(f"{_ROOT}.{short}")

@@ -28,7 +28,9 @@ __all__ = ["pick_platform", "probed_platform_name"]
 
 
 def _default_log(*args) -> None:
-    print(*args, file=sys.stderr, flush=True)
+    from ipc_proofs_tpu.utils.log import get_logger
+
+    get_logger(__name__).info(" ".join(str(a) for a in args))
 
 
 # process-level cache: (resolved, platform_name) after a successful probe or

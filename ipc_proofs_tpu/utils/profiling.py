@@ -1,0 +1,25 @@
+"""Optional JAX profiler tracing (SURVEY.md §5: the reference has no
+profiler hooks at all)."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["maybe_profile"]
+
+
+@contextmanager
+def maybe_profile(trace_dir: "str | None"):
+    """Emit a `jax.profiler` trace into ``trace_dir`` for the enclosed
+    block when a directory is given (view with TensorBoard or Perfetto);
+    no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    from ipc_proofs_tpu.utils.log import get_logger
+
+    with jax.profiler.trace(trace_dir):
+        yield
+    get_logger(__name__).info("profiler trace written to %s", trace_dir)

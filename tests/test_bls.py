@@ -273,6 +273,57 @@ class TestChainWithSignaturesAndTableCids:
         )
         assert [e.power for e in final] == [30, 30, 30, 30]
 
+    def test_committee_churn_with_delta_pops(self):
+        """A delta-added participant carries its PoP in the delta, so a
+        later certificate signed by the new committee member verifies —
+        committee churn must not brick chain verification."""
+        table0 = _table()[:3]  # powers 30/30/30
+        new_sk = 55555
+        new_key = base64.b64encode(bls.g1_compress(bls.sk_to_pk(new_sk))).decode()
+        new_pop = base64.b64encode(bls.g2_compress(bls.pop_prove(new_sk))).decode()
+        table1 = table0 + [PowerTableEntry(9, 30, new_key, new_pop)]
+
+        cert0 = FinalityCertificate(
+            instance=0,
+            ec_chain=[
+                ECTipSet(key=["bafy-a"], epoch=100, power_table="pt"),
+                ECTipSet(key=["bafy-b"], epoch=101, power_table="pt"),
+            ],
+            supplemental_data=SupplementalData(power_table=str(power_table_cid(table1))),
+            power_table_delta=[
+                PowerTableDelta(
+                    participant_id=9, power_delta="30",
+                    signing_key=new_key, pop=new_pop,
+                )
+            ],
+        )
+        cert0.signers = [0, 1, 2]
+        payload0 = cert0.signing_payload()
+        cert0.signature = bls.g2_compress(
+            bls.aggregate_signatures([bls.sign(SKS[i], payload0) for i in (0, 1, 2)])
+        )
+
+        cert1 = FinalityCertificate(
+            instance=1,
+            ec_chain=[
+                ECTipSet(key=["bafy-b"], epoch=101, power_table="pt"),
+                ECTipSet(key=["bafy-c"], epoch=102, power_table="pt"),
+            ],
+            supplemental_data=SupplementalData(power_table=str(power_table_cid(table1))),
+        )
+        # rows sorted by id: 0,1,2,9 → the new member is row 3
+        cert1.signers = [0, 1, 3]
+        payload1 = cert1.signing_payload()
+        cert1.signature = bls.g2_compress(
+            bls.aggregate_signatures(
+                [bls.sign(SKS[0], payload1), bls.sign(SKS[1], payload1), bls.sign(new_sk, payload1)]
+            )
+        )
+        final = FinalityCertificateChain([cert0, cert1]).validate(
+            table0, verify_signatures=True
+        )
+        assert [e.participant_id for e in final] == [0, 1, 2, 9]
+
     def test_wrong_table_commitment_rejected(self):
         table0 = _table()
         cert0 = _cert([0, 1, 2], instance=0)

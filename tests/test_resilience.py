@@ -24,13 +24,13 @@ from ipc_proofs_tpu.utils.metrics import Metrics
 SIG = "NewTopDownMessage(bytes32,uint256)"
 
 
-def _range(n_pairs, store=None):
+def _range(n_pairs, store=None, base=50):
     bs = store or MemoryBlockstore()
     pairs = []
     for p in range(n_pairs):
         events = [[EventFixture(emitter=5, signature=SIG, topic1="s")]]
         world = build_chain(
-            [ContractFixture(actor_id=5)], events, parent_height=50 + 2 * p, store=bs
+            [ContractFixture(actor_id=5)], events, parent_height=base + 2 * p, store=bs
         )
         pairs.append(TipsetPair(world.parent, world.child))
     return bs, pairs
@@ -110,6 +110,27 @@ class TestChunkedResume:
         assert "range_chunks_resumed" not in counters
         assert counters["range_chunks_generated"] == 2
         assert len(mixed.storage_proofs) == len(pairs)
+
+    def test_checkpoints_keyed_by_range_identity(self, tmp_path):
+        """Chunks of a DIFFERENT epoch range must not be resumed from a
+        shared checkpoint dir even with identical specs."""
+        bs, pairs_a = _range(2)
+        spec = EventProofSpec(event_signature=SIG, topic_1="s", actor_id_filter=5)
+        ckpt = tmp_path / "ckpt"
+        generate_event_proofs_for_range_chunked(
+            bs, pairs_a, spec, chunk_size=2, checkpoint_dir=str(ckpt)
+        )
+        bs2, pairs_b = _range(2, base=400)  # different heights/tipsets
+        m = Metrics()
+        out = generate_event_proofs_for_range_chunked(
+            bs2, pairs_b, spec, chunk_size=2, checkpoint_dir=str(ckpt), metrics=m
+        )
+        counters = m.snapshot()["counters"]
+        assert "range_chunks_resumed" not in counters
+        assert counters["range_chunks_generated"] == 1
+        assert {p.parent_epoch for p in out.event_proofs} == {
+            pair.parent.height for pair in pairs_b
+        }
 
     def test_checkpoint_files_are_valid_bundles(self, tmp_path):
         bs, pairs = _range(4)

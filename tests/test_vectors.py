@@ -1,0 +1,131 @@
+"""Byte-compat vectors harness: capture with a canned fake RPC, verify;
+consume a live-captured fixtures file when one is present.
+
+Set ``IPC_VECTORS_FILE=/path/to/vectors.json`` (written by
+``ipc-proofs vectors --endpoint … --height …``) to run the byte-compat
+checks against real chain bytes; without it the live test skips.
+"""
+
+import json
+import os
+
+import pytest
+
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.vectors import (
+    FORMAT,
+    capture_vectors,
+    check_vectors,
+    load_vectors,
+    write_vectors,
+)
+from ipc_proofs_tpu.store.testing import FakeLotusClient
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+
+
+def _tipset_json(ts):
+    return {
+        "Cids": [{"/": str(c)} for c in ts.cids],
+        "Blocks": [
+            {
+                "Parents": [{"/": str(c)} for c in h.parents],
+                "Height": h.height,
+                "ParentStateRoot": {"/": str(h.parent_state_root)},
+                "ParentMessageReceipts": {"/": str(h.parent_message_receipts)},
+                "Messages": {"/": str(h.messages)},
+                "Timestamp": h.timestamp,
+            }
+            for h in ts.blocks
+        ],
+        "Height": ts.height,
+    }
+
+
+def _fake_client():
+    world = build_chain(
+        [ContractFixture(actor_id=900)],
+        [[EventFixture(emitter=900, signature=SIG, topic1="vec-subnet")]],
+        parent_height=500,
+    )
+    client = FakeLotusClient(
+        world.store,
+        responses={
+            "Filecoin.ChainGetTipSetByHeight": lambda params: _tipset_json(
+                world.parent if params[0] == world.parent.height else world.child
+            ),
+        },
+    )
+    return client, world
+
+
+class TestVectorsHarness:
+    def test_capture_and_check_roundtrip(self, tmp_path):
+        client, world = _fake_client()
+        doc = capture_vectors(client, world.parent.height)
+        assert doc["format"] == FORMAT
+        kinds = [v["kind"] for v in doc["vectors"]]
+        assert kinds.count("header") == len(world.parent.cids) + 1
+        assert "txmeta" in kinds and "amt_node" in kinds
+        n = check_vectors(doc)
+        assert n == len(doc["vectors"]) >= 4
+        path = tmp_path / "vectors.json"
+        write_vectors(doc, str(path))
+        assert check_vectors(load_vectors(str(path))) == n
+
+    def test_cli_vectors_command(self, tmp_path, monkeypatch):
+        """The `vectors` subcommand end-to-end against the fake RPC."""
+        from ipc_proofs_tpu import cli
+
+        client, world = _fake_client()
+        monkeypatch.setattr(
+            "ipc_proofs_tpu.store.rpc.LotusClient",
+            lambda *a, **kw: client,
+        )
+        out = tmp_path / "v.json"
+        rc = cli.main(
+            [
+                "vectors",
+                "--endpoint",
+                "http://fake",
+                "--height",
+                str(world.parent.height),
+                "-o",
+                str(out),
+            ]
+        )
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert check_vectors(doc) >= 4
+
+    def test_check_rejects_tampered_bytes(self):
+        client, world = _fake_client()
+        doc = capture_vectors(client, world.parent.height)
+        import base64
+
+        bad = json.loads(json.dumps(doc))
+        raw = bytearray(base64.b64decode(bad["vectors"][0]["data"]))
+        raw[-1] ^= 1
+        bad["vectors"][0]["data"] = base64.b64encode(bytes(raw)).decode()
+        with pytest.raises(ValueError, match="diverges from the chain"):
+            check_vectors(bad)
+
+    def test_check_rejects_tampered_expectations(self):
+        client, world = _fake_client()
+        doc = capture_vectors(client, world.parent.height)
+        bad = json.loads(json.dumps(doc))
+        header_vec = next(v for v in bad["vectors"] if v["kind"] == "header")
+        header_vec["expect"]["height"] += 1
+        with pytest.raises(ValueError, match="header fields diverge"):
+            check_vectors(bad)
+
+
+class TestLiveVectors:
+    def test_live_captured_vectors_if_present(self):
+        """Byte-compat against REAL chain bytes — runs only when a captured
+        fixtures file is provided (zero-egress CI skips)."""
+        path = os.environ.get("IPC_VECTORS_FILE", "tests/vectors/live_vectors.json")
+        if not os.path.exists(path):
+            pytest.skip(f"no captured vectors at {path} (run `ipc-proofs vectors`)")
+        n = check_vectors(load_vectors(path))
+        assert n >= 4
