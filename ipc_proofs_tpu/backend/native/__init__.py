@@ -115,6 +115,8 @@ def load_dagcbor_ext():
             from ipc_proofs_tpu.core.cid import CID  # deferred: avoids import cycle
 
             module.set_cid_factory(CID.from_bytes)
+            if hasattr(module, "set_cid_class"):
+                module.set_cid_class(CID)  # direct C-side link construction
             _dagcbor_cached = module
         except Exception:
             _dagcbor_cached = None

@@ -19,6 +19,8 @@
 #include <string.h>
 
 static PyObject *cid_factory = NULL; /* callable(bytes) -> CID */
+static PyObject *cid_class = NULL;   /* the CID class for direct C construction */
+static PyObject *s_version, *s_codec, *s_mh_code, *s_digest, *s_bytes;
 
 /* Nesting cap for the recursive walkers: attacker-controlled witness
  * bytes must exhaust a counter, not the C stack. Real chain objects nest
@@ -41,6 +43,7 @@ static int depth_enter(Parser *p) {
 }
 
 static PyObject *parse_item(Parser *p);
+static PyObject *make_cid(const uint8_t *raw, Py_ssize_t n);
 
 static int parse_head(Parser *p, int *major, uint64_t *value) {
   if (p->pos >= p->len) {
@@ -189,6 +192,13 @@ static PyObject *parse_item_inner(Parser *p) {
                         "tag-42 content must be identity-multibase CID bytes");
         return NULL;
       }
+      if (cid_class) { /* direct C construction — no Python call per link */
+        PyObject *cid = make_cid(
+            (const uint8_t *)PyBytes_AS_STRING(inner) + 1,
+            PyBytes_GET_SIZE(inner) - 1);
+        Py_DECREF(inner);
+        return cid;
+      }
       if (!cid_factory) {
         Py_DECREF(inner);
         PyErr_SetString(PyExc_RuntimeError, "CID factory not registered");
@@ -290,6 +300,80 @@ static int cid_bytes_valid(const uint8_t *d, Py_ssize_t n) {
   if (cid_uvarint(d, n, &pos, &mh_code) < 0) return 0;
   if (cid_uvarint(d, n, &pos, &mh_len) < 0) return 0;
   return (unsigned __int128)(n - pos) == mh_len;
+}
+
+/* like cid_uvarint but flags non-minimal encodings (a multi-byte varint
+ * whose most significant group is zero) — only canonical encodings may be
+ * memoized as a CID's to_bytes value */
+static int cid_uvarint_min(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
+                           unsigned __int128 *out, int *minimal) {
+  Py_ssize_t start = *pos;
+  if (cid_uvarint(d, n, pos, out) < 0) return -1;
+  *minimal &= (*pos - start) == 1 || d[*pos - 1] != 0;
+  return 0;
+}
+
+/* uvarint values can exceed u64 (shift cap 63 admits up to ~2^70); Python
+ * stores bignums, so mirror that exactly */
+static PyObject *u128_to_pylong(unsigned __int128 v) {
+  if (v <= (unsigned __int128)UINT64_MAX)
+    return PyLong_FromUnsignedLongLong((unsigned long long)v);
+  unsigned char le[16];
+  for (int i = 0; i < 16; i++) le[i] = (unsigned char)(v >> (8 * i));
+#if PY_VERSION_HEX >= 0x030D0000 /* 3.13+: public API */
+  return PyLong_FromNativeBytes(le, 16,
+                                Py_ASNATIVEBYTES_LITTLE_ENDIAN |
+                                    Py_ASNATIVEBYTES_UNSIGNED_BUFFER);
+#else
+  return _PyLong_FromByteArray(le, 16, 1 /* little-endian */, 0 /* unsigned */);
+#endif
+}
+
+/* Construct a CID instance directly (the Python-call-per-link factory was
+ * ~80% of header decode cost). Mirrors CID.from_bytes acceptance exactly;
+ * stashes the raw bytes as the to_bytes memo ONLY when every varint is
+ * minimal (i.e. raw IS the canonical encoding — same no-malleability rule
+ * as the Python fast paths). */
+static PyObject *make_cid(const uint8_t *raw, Py_ssize_t n) {
+  Py_ssize_t pos = 0;
+  unsigned __int128 version, codec, mh_code, mh_len;
+  int minimal = 1;
+  if (cid_uvarint_min(raw, n, &pos, &version, &minimal) < 0 || version != 1 ||
+      cid_uvarint_min(raw, n, &pos, &codec, &minimal) < 0 ||
+      cid_uvarint_min(raw, n, &pos, &mh_code, &minimal) < 0 ||
+      cid_uvarint_min(raw, n, &pos, &mh_len, &minimal) < 0 ||
+      (unsigned __int128)(n - pos) != mh_len) {
+    PyErr_SetString(PyExc_ValueError, "malformed CID bytes in tag 42");
+    return NULL;
+  }
+  PyTypeObject *tp = (PyTypeObject *)cid_class;
+  PyObject *obj = tp->tp_alloc(tp, 0);
+  if (!obj) return NULL;
+  PyObject *v_version = PyLong_FromUnsignedLongLong((unsigned long long)version);
+  PyObject *v_codec = u128_to_pylong(codec);
+  PyObject *v_mh = u128_to_pylong(mh_code);
+  PyObject *v_digest = PyBytes_FromStringAndSize((const char *)raw + pos, n - pos);
+  PyObject *v_raw = minimal ? PyBytes_FromStringAndSize((const char *)raw, n) : NULL;
+  int rc = 0;
+  if (!v_version || !v_codec || !v_mh || !v_digest || (minimal && !v_raw)) {
+    rc = -1;
+  } else {
+    rc |= PyObject_GenericSetAttr(obj, s_version, v_version);
+    rc |= PyObject_GenericSetAttr(obj, s_codec, v_codec);
+    rc |= PyObject_GenericSetAttr(obj, s_mh_code, v_mh);
+    rc |= PyObject_GenericSetAttr(obj, s_digest, v_digest);
+    if (minimal) rc |= PyObject_GenericSetAttr(obj, s_bytes, v_raw);
+  }
+  Py_XDECREF(v_version);
+  Py_XDECREF(v_codec);
+  Py_XDECREF(v_mh);
+  Py_XDECREF(v_digest);
+  Py_XDECREF(v_raw);
+  if (rc) {
+    Py_DECREF(obj);
+    return NULL;
+  }
+  return obj;
 }
 
 static int skip_item_inner(Parser *p);
@@ -497,6 +581,7 @@ static PyObject *py_decode_many(PyObject *self, PyObject *arg) {
 }
 
 static PyObject *py_set_cid_factory(PyObject *self, PyObject *arg) {
+  (void)self;
   if (!PyCallable_Check(arg)) {
     PyErr_SetString(PyExc_TypeError, "CID factory must be callable");
     return NULL;
@@ -504,6 +589,55 @@ static PyObject *py_set_cid_factory(PyObject *self, PyObject *arg) {
   Py_XDECREF(cid_factory);
   Py_INCREF(arg);
   cid_factory = arg;
+  Py_RETURN_NONE;
+}
+
+/* make_cids(list[bytes]) -> list[CID]: batch C-side construction for the
+ * witness-materialization paths (thousands of CIDs per range bundle). */
+static PyObject *py_make_cids(PyObject *self, PyObject *arg) {
+  (void)self;
+  if (!cid_class) {
+    PyErr_SetString(PyExc_RuntimeError, "CID class not registered");
+    return NULL;
+  }
+  PyObject *seq = PySequence_Fast(arg, "make_cids expects a sequence of bytes");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return NULL;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyBytes_Check(item)) {
+      Py_DECREF(out);
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_TypeError, "make_cids expects bytes items");
+      return NULL;
+    }
+    PyObject *cid = make_cid((const uint8_t *)PyBytes_AS_STRING(item),
+                             PyBytes_GET_SIZE(item));
+    if (!cid) {
+      Py_DECREF(out);
+      Py_DECREF(seq);
+      return NULL;
+    }
+    PyList_SET_ITEM(out, i, cid);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
+static PyObject *py_set_cid_class(PyObject *self, PyObject *arg) {
+  (void)self;
+  if (!PyType_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "CID class must be a type");
+    return NULL;
+  }
+  Py_XDECREF(cid_class);
+  Py_INCREF(arg);
+  cid_class = arg;
   Py_RETURN_NONE;
 }
 
@@ -515,12 +649,25 @@ static PyMethodDef methods[] = {
      "Decode a 16-field block header, materializing only the fields "
      "verification reads (others validated and returned as None)."},
     {"set_cid_factory", py_set_cid_factory, METH_O,
-     "Register callable(bytes)->CID used for tag-42 links."},
+     "Register callable(bytes)->CID used for tag-42 links when no CID "
+     "class is registered (set_cid_class takes precedence)."},
+    {"set_cid_class", py_set_cid_class, METH_O,
+     "Register the CID class for direct C-side construction of tag-42 "
+     "links (bypasses the per-link Python factory call)."},
+    {"make_cids", py_make_cids, METH_O,
+     "Construct a list of CID objects from raw CID byte strings in one "
+     "call (from_bytes semantics)."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_dagcbor_ext",
                                        "Fast DAG-CBOR decoder", -1, methods};
 
 PyMODINIT_FUNC PyInit_ipc_dagcbor_ext(void) {
+  s_version = PyUnicode_InternFromString("version");
+  s_codec = PyUnicode_InternFromString("codec");
+  s_mh_code = PyUnicode_InternFromString("mh_code");
+  s_digest = PyUnicode_InternFromString("digest");
+  s_bytes = PyUnicode_InternFromString("_bytes");
+  if (!s_version || !s_codec || !s_mh_code || !s_digest || !s_bytes) return NULL;
   return PyModule_Create(&moduledef);
 }

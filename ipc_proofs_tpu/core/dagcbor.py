@@ -205,10 +205,9 @@ def _resolve_native():
     try:
         from ipc_proofs_tpu.backend.native import load_dagcbor_ext
 
-        module = load_dagcbor_ext()
-        if module is not None:
-            module.set_cid_factory(CID.from_bytes)
-        _native = module
+        # load_dagcbor_ext registers the CID factory/class hooks itself —
+        # that loader is the single registration site
+        _native = load_dagcbor_ext()
     except Exception:
         _native = None
     return _native

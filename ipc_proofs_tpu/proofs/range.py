@@ -378,19 +378,25 @@ def _record_chunk(
         )
     if native is not None:
         event_proofs, witness_bytes = native
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
         from ipc_proofs_tpu.core.cid import CID
         from ipc_proofs_tpu.proofs.scan_native import _raw_view
 
         # materialize through the raw byte-keyed map (one dict probe per
         # block) — the CID-keyed store path costs a hash+eq per block on
-        # freshly parsed CID objects
+        # freshly parsed CID objects; CID objects come from one batched C
+        # call when the extension provides it
         raw_map, _ = _raw_view(cached)
-        from_bytes = CID.from_bytes
+        ordered = sorted(witness_bytes)
+        ext = load_dagcbor_ext()
+        if ext is not None and hasattr(ext, "make_cids"):
+            cids = ext.make_cids(ordered)
+        else:
+            cids = [CID.from_bytes(b) for b in ordered]
         make_block = ProofBlock._make
         blocks = []
-        for cid_bytes in sorted(witness_bytes):
+        for cid_bytes, cid in zip(ordered, cids):
             raw = raw_map.get(cid_bytes)
-            cid = from_bytes(cid_bytes)
             if raw is None:
                 raw = cached.get(cid)
             if raw is None:

@@ -86,3 +86,63 @@ class TestNativeDecoder:
     def test_float64(self):
         raw = encode(3.5)
         assert ext.decode(raw) == 3.5
+
+
+class TestCSideCidConstruction:
+    def test_c_built_cids_match_python(self):
+        """Tag-42 links built directly in C (set_cid_class) must be
+        indistinguishable from CID.from_bytes results: eq, hash, to_bytes,
+        str, and type."""
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core.cid import CID, RAW
+        from ipc_proofs_tpu.core.dagcbor import decode_py, encode
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "set_cid_class"):
+            pytest.skip("native set_cid_class unavailable")
+        cids = [CID.hash_of(b"x"), CID.hash_of(b"y", codec=RAW)]
+        raw = encode([cids[0], {"k": cids[1]}, [cids[0]] * 3])
+        c_obj = ext.decode(raw)
+        py_obj = decode_py(raw)
+        assert c_obj == py_obj
+        c_cid = c_obj[1]["k"]
+        assert type(c_cid) is CID
+        assert hash(c_cid) == hash(cids[1])
+        assert c_cid.to_bytes() == cids[1].to_bytes()
+        assert str(c_cid) == str(cids[1])
+
+    def test_nonminimal_varint_cid_not_memoized(self):
+        """A tag-42 CID with a non-minimal varint must decode equal to the
+        canonical CID and re-encode CANONICALLY from to_bytes (the C
+        constructor must not stash malleable input bytes)."""
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core.cid import CID
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "set_cid_class"):
+            pytest.skip("native set_cid_class unavailable")
+        canonical = CID.hash_of(b"payload")
+        raw = canonical.to_bytes()
+        nonminimal = b"\x01\xf1\x00" + raw[2:]  # codec 0x71 as two bytes
+        # wrap in tag 42 with identity multibase prefix
+        cbor = b"\xd8\x2a\x58" + bytes([len(nonminimal) + 1]) + b"\x00" + nonminimal
+        parsed = ext.decode(cbor)
+        assert parsed == canonical
+        assert parsed.to_bytes() == raw  # canonical, NOT the 39-byte input
+
+    def test_make_cids_batch(self):
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core.cid import CID, RAW
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "make_cids"):
+            pytest.skip("native make_cids unavailable")
+        cids = [CID.hash_of(b"\x01"), CID.hash_of(b"\x02", codec=RAW)]
+        raws = [c.to_bytes() for c in cids]
+        built = ext.make_cids(raws)
+        assert built == cids
+        assert [b.to_bytes() for b in built] == raws
+        with pytest.raises(ValueError):
+            ext.make_cids([b"\x00\x01"])  # CIDv0 / malformed
+        with pytest.raises(TypeError):
+            ext.make_cids([42])
