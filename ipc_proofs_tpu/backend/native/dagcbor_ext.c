@@ -343,7 +343,7 @@ static PyObject *make_cid(const uint8_t *raw, Py_ssize_t n) {
       cid_uvarint_min(raw, n, &pos, &mh_code, &minimal) < 0 ||
       cid_uvarint_min(raw, n, &pos, &mh_len, &minimal) < 0 ||
       (unsigned __int128)(n - pos) != mh_len) {
-    PyErr_SetString(PyExc_ValueError, "malformed CID bytes in tag 42");
+    PyErr_SetString(PyExc_ValueError, "malformed CID bytes");
     return NULL;
   }
   PyTypeObject *tp = (PyTypeObject *)cid_class;

@@ -1072,13 +1072,28 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
     lens[i] = PyBytes_GET_SIZE(root);
   }
 
-  /* Parallel path: GIL-free walk over a snapshot of the dict, fanned out
-   * over pthreads in contiguous root chunks (chunk concatenation preserves
-   * the sequential emission order exactly).  Only when every block can come
-   * from the dict (no fallback callable). */
+  /* Snapshot path: GIL-free walk over an open-addressing snapshot of the
+   * dict, fanned out over pthreads in contiguous root chunks (chunk
+   * concatenation preserves the sequential emission order exactly). Only
+   * when every block can come from the dict (no fallback callable).
+   *
+   * Taken even at ONE thread: profiling showed the dict-backed sequential
+   * walk spends ~85% of its time in CPython (a PyBytes key allocation +
+   * PyDict probe per block fetch); the cmap probe is a plain memcmp hash
+   * table, ~25% faster end-to-end on a single core before any
+   * parallelism. */
   int threads = scan_threads_default();
-  if ((fallback == NULL || fallback == Py_None) && threads > 1 &&
-      n_roots >= 2 * threads && n_roots >= 64) {
+  const char *no_snap = getenv("IPC_SCAN_NO_SNAPSHOT"); /* test/debug knob:
+      force the Python-dict sequential walk to keep a true differential
+      reference for the snapshot path */
+  /* cmap_build is O(|dict|); only worth it when the scan will touch a
+   * meaningful fraction of the store (a range scan touches ~25 blocks per
+   * root). A huge dict with a tiny scan keeps the per-probe dict walk. */
+  int snapshot_pays =
+      n_roots >= 64 && PyDict_Size(blocks) / n_roots <= 256;
+  if ((fallback == NULL || fallback == Py_None) &&
+      (snapshot_pays || (threads > 1 && n_roots >= 2 * threads && n_roots >= 64)) &&
+      !(no_snap && no_snap[0] == '1')) {
     CMap cmap = {0};
     if (cmap_build(&cmap, blocks) < 0) {
       raise_walk_err();
@@ -1113,15 +1128,21 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
     }
     int spawn_failed = 0;
     Py_BEGIN_ALLOW_THREADS;
-    for (int t = 0; t < started; t++)
-      if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
-        /* run inline if a thread can't spawn — correctness over speed */
-        scan_job_run(&jobs[t]);
-        tids[t] = 0;
-        spawn_failed++;
-      }
-    for (int t = 0; t < started; t++)
-      if (tids[t]) pthread_join(tids[t], NULL);
+    if (started == 1) {
+      /* single chunk: run inline, no thread spawn */
+      scan_job_run(&jobs[0]);
+      tids[0] = 0;
+    } else {
+      for (int t = 0; t < started; t++)
+        if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
+          /* run inline if a thread can't spawn — correctness over speed */
+          scan_job_run(&jobs[t]);
+          tids[t] = 0;
+          spawn_failed++;
+        }
+      for (int t = 0; t < started; t++)
+        if (tids[t]) pthread_join(tids[t], NULL);
+    }
     Py_END_ALLOW_THREADS;
     (void)spawn_failed;
     cmap_free(&cmap);

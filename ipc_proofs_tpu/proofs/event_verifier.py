@@ -183,7 +183,7 @@ def _verify_proofs_batch(
 
     pending: list[tuple[int, "BlockHeader"]] = []
     pending_roots: list[CID] = []  # one receipts root per group with survivors
-    root_pos: dict[str, int] = {}  # receipts-root cid str → position in ^
+    root_pos: dict[CID, int] = {}  # receipts-root cid → position in ^
     pending_pair: list[int] = []  # pending[i] → its root position
 
     for gi, (survivors, parent_cids, child_header) in enumerate(step3):
@@ -203,7 +203,7 @@ def _verify_proofs_batch(
             if position is None or position != proof.exec_index:
                 continue
             root = child_header.parent_message_receipts
-            pos = root_pos.setdefault(str(root), len(pending_roots))
+            pos = root_pos.setdefault(root, len(pending_roots))
             if pos == len(pending_roots):
                 pending_roots.append(root)
             pending.append((k, child_header))

@@ -196,8 +196,11 @@ class TestParallelScan:
         import os
 
         bs, roots = self._big_world()
-        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        # true sequential (Python-dict walk) as the reference side — the
+        # snapshot path is otherwise taken even at one thread
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
         seq = scan_events_flat(bs, roots, want_payload=True)
+        monkeypatch.delenv("IPC_SCAN_NO_SNAPSHOT")
         monkeypatch.setenv("IPC_SCAN_THREADS", "8")
         par = scan_events_flat(bs, roots, want_payload=True)
         assert par.n_events == seq.n_events and par.n_receipts == seq.n_receipts
@@ -222,7 +225,7 @@ class TestParallelScan:
         monkeypatch.setenv("IPC_SCAN_THREADS", "8")
         with pytest.raises(KeyError):
             scan_events_flat(bs, roots)
-        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
         with pytest.raises(KeyError):
             scan_events_flat(bs, roots)
 
@@ -232,17 +235,20 @@ class TestParallelScan:
         bs, roots = self._big_world()
         raw = bs.raw_map()
         raw[roots[-5].to_bytes()] = b"\x83\x00\x01"  # not an AMT root
-        for threads in ("8", "1"):
-            monkeypatch.setenv("IPC_SCAN_THREADS", threads)
-            with pytest.raises(ValueError):
-                scan_events_flat(bs, roots)
+        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
+        with pytest.raises(ValueError):
+            scan_events_flat(bs, roots)
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
+        with pytest.raises(ValueError):
+            scan_events_flat(bs, roots)
 
     def test_parallel_skip_missing_prunes_identically(self, monkeypatch):
         bs, roots = self._big_world()
         raw = bs.raw_map()
         del raw[roots[10].to_bytes()]
-        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
         seq = scan_events_flat(bs, roots, skip_missing=True)
+        monkeypatch.delenv("IPC_SCAN_NO_SNAPSHOT")
         monkeypatch.setenv("IPC_SCAN_THREADS", "8")
         par = scan_events_flat(bs, roots, skip_missing=True)
         np.testing.assert_array_equal(par.pair_ids, seq.pair_ids)
