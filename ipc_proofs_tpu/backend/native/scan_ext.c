@@ -293,8 +293,11 @@ static int pool_off_ok(size_t len, size_t max) {
  *
  * The parallel scan threads cannot touch the Python dict; cmap_build
  * snapshots it (borrowed pointers into live bytes objects — the caller
- * keeps the dict alive for the call's duration) into an open-addressing
- * table that cmap_get probes without the GIL. */
+ * keeps the dict alive AND unmutated for the call's duration; the
+ * multi-thread fan-out runs without the GIL, so a concurrent `del
+ * blocks[k]` from another Python thread would free a borrowed buffer.
+ * The single-chunk path holds the GIL throughout, closing that window)
+ * into an open-addressing table that cmap_get probes without the GIL. */
 
 typedef struct {
   const uint8_t *key;
@@ -1086,9 +1089,11 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
   const char *no_snap = getenv("IPC_SCAN_NO_SNAPSHOT"); /* test/debug knob:
       force the Python-dict sequential walk to keep a true differential
       reference for the snapshot path */
-  /* cmap_build is O(|dict|); only worth it when the scan will touch a
-   * meaningful fraction of the store (a range scan touches ~25 blocks per
-   * root). A huge dict with a tiny scan keeps the per-probe dict walk. */
+  /* cmap_build is O(|dict|); without parallelism it only pays when the
+   * scan touches a meaningful fraction of the store (a range scan touches
+   * ~25 blocks per root), so the SINGLE-THREAD arm keeps the per-probe
+   * dict walk for a huge dict with a tiny scan. The multi-thread arm
+   * always snapshots — it needs the GIL-free table regardless of ratio. */
   int snapshot_pays =
       n_roots >= 64 && PyDict_Size(blocks) / n_roots <= 256;
   if ((fallback == NULL || fallback == Py_None) &&
@@ -1101,6 +1106,23 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
     }
     if (threads > (int)(n_roots / 32) && n_roots / 32 >= 2)
       threads = (int)(n_roots / 32);
+    if (threads <= 1) {
+      /* single chunk: scan straight into `s` over the snapshot with the
+       * GIL HELD — the snapshot's borrowed dict-internals pointers stay
+       * safe against other Python threads mutating the store mid-scan,
+       * and no job struct / merge copy is needed. The speedup on this
+       * path is the memcmp cmap probe replacing a PyBytes-alloc +
+       * PyDict probe per block fetch, not parallelism. */
+      s.cmap = &cmap;
+      int rc_scan = scan_roots_range(&s, cids, lens, 0, n_roots);
+      s.cmap = NULL;
+      cmap_free(&cmap);
+      if (rc_scan < 0) {
+        raise_walk_err();
+        goto fail;
+      }
+      goto done_scan;
+    }
     ScanJob *jobs = calloc(threads, sizeof(ScanJob));
     pthread_t *tids = malloc(sizeof(pthread_t) * threads);
     if (!jobs || !tids) {
@@ -1128,21 +1150,15 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
     }
     int spawn_failed = 0;
     Py_BEGIN_ALLOW_THREADS;
-    if (started == 1) {
-      /* single chunk: run inline, no thread spawn */
-      scan_job_run(&jobs[0]);
-      tids[0] = 0;
-    } else {
-      for (int t = 0; t < started; t++)
-        if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
-          /* run inline if a thread can't spawn — correctness over speed */
-          scan_job_run(&jobs[t]);
-          tids[t] = 0;
-          spawn_failed++;
-        }
-      for (int t = 0; t < started; t++)
-        if (tids[t]) pthread_join(tids[t], NULL);
-    }
+    for (int t = 0; t < started; t++)
+      if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
+        /* run inline if a thread can't spawn — correctness over speed */
+        scan_job_run(&jobs[t]);
+        tids[t] = 0;
+        spawn_failed++;
+      }
+    for (int t = 0; t < started; t++)
+      if (tids[t]) pthread_join(tids[t], NULL);
     Py_END_ALLOW_THREADS;
     (void)spawn_failed;
     cmap_free(&cmap);
@@ -1176,6 +1192,7 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
     }
   }
 
+done_scan:;
   {
     PyObject *result = scan_result_dict(&s);
     free(cids);

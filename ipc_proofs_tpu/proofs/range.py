@@ -550,6 +550,7 @@ def _record_pass2_native(
 
     proofs: list = []
     witness: set[bytes] = set()
+    goff = rec.row_offsets(len(matching_pairs))
     for g, (pair, matching) in enumerate(matching_pairs):
         walk = walks[g]
         if walk is None or rec.failed[g]:
@@ -585,7 +586,7 @@ def _record_pass2_native(
         witness.update(exec_touched)
         witness.update(rec.touched(g))
 
-        lo, hi = rec.rows(g)
+        lo, hi = int(goff[g]), int(goff[g + 1])
         if lo == hi:
             continue
         parent_cid_strs = [str(c) for c in pair.parent.cids]

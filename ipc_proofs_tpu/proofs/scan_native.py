@@ -164,12 +164,11 @@ class RecordBatch:
         lo, hi = int(self._touch_goff[group]), int(self._touch_goff[group + 1])
         return self._touch_items[lo:hi]
 
-    def rows(self, group: int) -> tuple[int, int]:
-        """Half-open row range of ``group``'s events in ``batch`` (rows are
-        emitted in ascending group order)."""
-        lo = int(np.searchsorted(self.batch.pair_ids, group, side="left"))
-        hi = int(np.searchsorted(self.batch.pair_ids, group, side="right"))
-        return lo, hi
+    def row_offsets(self, n_groups: int) -> np.ndarray:
+        """Group row boundaries into ``batch`` as one [n_groups+1] array
+        (rows are emitted in ascending group order): group g's events are
+        rows [out[g], out[g+1])."""
+        return np.searchsorted(self.batch.pair_ids, np.arange(n_groups + 1))
 
 
 def record_receipt_paths(

@@ -201,33 +201,35 @@ class TestParallelScan:
         monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
         seq = scan_events_flat(bs, roots, want_payload=True)
         monkeypatch.delenv("IPC_SCAN_NO_SNAPSHOT")
-        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
-        par = scan_events_flat(bs, roots, want_payload=True)
-        assert par.n_events == seq.n_events and par.n_receipts == seq.n_receipts
-        np.testing.assert_array_equal(par.topics, seq.topics)
-        np.testing.assert_array_equal(par.fp, seq.fp)
-        np.testing.assert_array_equal(par.n_topics, seq.n_topics)
-        np.testing.assert_array_equal(par.emitters, seq.emitters)
-        np.testing.assert_array_equal(par.valid, seq.valid)
-        np.testing.assert_array_equal(par.pair_ids, seq.pair_ids)
-        np.testing.assert_array_equal(par.exec_idx, seq.exec_idx)
-        np.testing.assert_array_equal(par.event_idx, seq.event_idx)
-        # pools are chunk-rebased; per-event payload slices must agree
-        for r in range(seq.n_events):
-            assert par.event_topics(r) == seq.event_topics(r)
-            assert par.event_data(r) == seq.event_data(r)
+        # BOTH snapshot variants against the dict-walk reference: the
+        # single-chunk GIL-held inline path AND the pthread fan-out
+        for threads in ("1", "8"):
+            monkeypatch.setenv("IPC_SCAN_THREADS", threads)
+            par = scan_events_flat(bs, roots, want_payload=True)
+            assert par.n_events == seq.n_events and par.n_receipts == seq.n_receipts
+            np.testing.assert_array_equal(par.topics, seq.topics)
+            np.testing.assert_array_equal(par.fp, seq.fp)
+            np.testing.assert_array_equal(par.n_topics, seq.n_topics)
+            np.testing.assert_array_equal(par.emitters, seq.emitters)
+            np.testing.assert_array_equal(par.valid, seq.valid)
+            np.testing.assert_array_equal(par.pair_ids, seq.pair_ids)
+            np.testing.assert_array_equal(par.exec_idx, seq.exec_idx)
+            np.testing.assert_array_equal(par.event_idx, seq.event_idx)
+            # pools are chunk-rebased; per-event payload slices must agree
+            for r in range(seq.n_events):
+                assert par.event_topics(r) == seq.event_topics(r)
+                assert par.event_data(r) == seq.event_data(r)
 
     def test_parallel_missing_block_raises_keyerror(self, monkeypatch):
         bs, roots = self._big_world()
         raw = bs.raw_map()
         # drop one late root so a non-first chunk hits the error
         del raw[roots[-3].to_bytes()]
-        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
-        with pytest.raises(KeyError):
-            scan_events_flat(bs, roots)
-        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
-        with pytest.raises(KeyError):
-            scan_events_flat(bs, roots)
+        for env in (("IPC_SCAN_THREADS", "8"), ("IPC_SCAN_THREADS", "1"),
+                    ("IPC_SCAN_NO_SNAPSHOT", "1")):
+            monkeypatch.setenv(*env)
+            with pytest.raises(KeyError):
+                scan_events_flat(bs, roots)
 
     def test_parallel_malformed_block_raises_valueerror(self, monkeypatch):
         # a corrupted AMT block on a worker thread must surface as the same
@@ -235,12 +237,11 @@ class TestParallelScan:
         bs, roots = self._big_world()
         raw = bs.raw_map()
         raw[roots[-5].to_bytes()] = b"\x83\x00\x01"  # not an AMT root
-        monkeypatch.setenv("IPC_SCAN_THREADS", "8")
-        with pytest.raises(ValueError):
-            scan_events_flat(bs, roots)
-        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
-        with pytest.raises(ValueError):
-            scan_events_flat(bs, roots)
+        for env in (("IPC_SCAN_THREADS", "8"), ("IPC_SCAN_THREADS", "1"),
+                    ("IPC_SCAN_NO_SNAPSHOT", "1")):
+            monkeypatch.setenv(*env)
+            with pytest.raises(ValueError):
+                scan_events_flat(bs, roots)
 
     def test_parallel_skip_missing_prunes_identically(self, monkeypatch):
         bs, roots = self._big_world()
