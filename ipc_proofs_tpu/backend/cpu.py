@@ -1,8 +1,9 @@
 """CPU batch backend: C++ native extension with pure-Python fallback.
 
 The default backend (the reference's role is played by Rust crates; here a
-C++ .so built on first use). Matching is plain Python — on CPU the per-event
-predicate is cheap relative to decode.
+C++ .so built on first use). Flat-tensor matching runs the same vectorized
+numpy predicate the TPU backend's host crossover uses, so the range drivers
+take the native C scan paths (fused fp match included) on CPU too.
 """
 
 from __future__ import annotations
@@ -18,6 +19,7 @@ __all__ = ["CpuBackend"]
 
 class CpuBackend:
     name = "cpu"
+    mesh = None  # single-host: range drivers may fuse the match into the scan
 
     def __init__(self, use_native: bool = True):
         self._native = load_native() if use_native else None
@@ -65,6 +67,43 @@ class CpuBackend:
                 and log.topics[1] == topic1
             )
         return mask
+
+    def event_match_mask_flat(
+        self,
+        topics,
+        n_topics,
+        emitters,
+        valid,
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ):
+        """Vectorized mask over the C scanner's flat arrays — the shared
+        host predicate (`scan_native.match_mask_flat_np`), bit-identical to
+        the TPU backend's host-crossover branch."""
+        from ipc_proofs_tpu.proofs.scan_native import match_mask_flat_np
+
+        return match_mask_flat_np(
+            topics, n_topics, emitters, valid, topic0, topic1, actor_id_filter
+        )
+
+    def event_match_mask_fp(
+        self,
+        fp,
+        n_topics,
+        emitters,
+        valid,
+        topic0: bytes,
+        topic1: bytes,
+        actor_id_filter: Optional[int],
+    ):
+        """Fingerprint mask (one u64 compare per event); pass 2 confirms
+        every hit exactly — same contract as the TPU backend's fp path."""
+        from ipc_proofs_tpu.proofs.scan_native import match_mask_fp_np
+
+        return match_mask_fp_np(
+            fp, n_topics, emitters, valid, topic0, topic1, actor_id_filter
+        )
 
     def any_event_matches(
         self,

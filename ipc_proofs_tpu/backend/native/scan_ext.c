@@ -266,6 +266,18 @@ typedef struct {
   Vec topics_off; /* u32 per event: start offset into topics_pool */
   Vec data_off;   /* u32 per event: start offset into data_pool */
   Vec data_len;   /* u32 per event */
+  /* fused-match mode: evaluate the fp predicate per event IN the walk and
+   * emit only the matching (pair_id, receipt_idx) rows — no per-event
+   * columns at all. Same predicate as the host/device fp mask
+   * (backend/tpu.py event_match_mask_fp): valid && n_topics >= 2 &&
+   * fp == match_fp && (no actor filter || emitter == match_actor). Pass 2
+   * confirms every hit exactly, so fp collisions stay harmless. */
+  int match_mode;
+  uint64_t match_fp;
+  int match_has_actor;
+  uint64_t match_actor;
+  Vec hit_pairs; /* i32 per hit */
+  Vec hit_exec;  /* i32 per hit */
   int64_t n_events;
   int64_t ev_cap;     /* row capacity of the fixed-width event columns */
   int64_t n_receipts; /* receipts with an events root, across all pairs */
@@ -543,6 +555,29 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
   }
 
 done:;
+  /* word-wise 64-bit mix of the zero-padded 2x32B topic words — must match
+   * scan_native.topic_fingerprint exactly (8 u64 LE rounds; a byte-serial
+   * FNV's multiply chain dominated the per-event cost). ONE copy serves
+   * both the fused-match predicate and the emitted fp column. */
+  uint64_t fp = 0x9E3779B97F4A7C15ULL;
+  for (int k = 0; k < 8; k++) {
+    uint64_t w;
+    memcpy(&w, topic_words + 8 * k, 8);
+    fp = (fp ^ w) * 0xFF51AFD7ED558CCDULL;
+    fp ^= fp >> 29;
+  }
+  if (s->match_mode) {
+    /* fused match: no per-event output — one register compare per event,
+     * hits are rare (north-star range: ~0.25 % of events) */
+    if (valid && n_topics >= 2 && fp == s->match_fp &&
+        (!s->match_has_actor || emitter == s->match_actor)) {
+      if (vec_push(&s->hit_pairs, &pair_id, 4) < 0 ||
+          vec_push(&s->hit_exec, &rcpt_idx, 4) < 0)
+        return -1;
+    }
+    s->n_events++;
+    return 0;
+  }
   uint32_t toff = 0, doff = 0, dlen = 0;
   if (s->want_payload) {
     if (pool_off_ok(s->topics_pool.len, UINT32_MAX) < 0 ||
@@ -569,16 +604,6 @@ done:;
         }
       }
     }
-  }
-  /* word-wise 64-bit mix of the zero-padded 2x32B topic words — must match
-   * scan_native.topic_fingerprint exactly (8 u64 LE rounds; a byte-serial
-   * FNV's multiply chain dominated the per-event cost) */
-  uint64_t fp = 0x9E3779B97F4A7C15ULL;
-  for (int k = 0; k < 8; k++) {
-    uint64_t w;
-    memcpy(&w, topic_words + 8 * k, 8);
-    fp = (fp ^ w) * 0xFF51AFD7ED558CCDULL;
-    fp ^= fp >> 29;
   }
   /* fused row write: ONE capacity check per event instead of 8-11 pushes
    * (the scan emits hundreds of thousands of rows per range) */
@@ -939,6 +964,7 @@ static void scan_free(Scan *s) {
   vec_free(&s->valid); vec_free(&s->pair_ids); vec_free(&s->exec_idx);
   vec_free(&s->event_idx); vec_free(&s->topics_pool); vec_free(&s->data_pool);
   vec_free(&s->topics_off); vec_free(&s->data_off); vec_free(&s->data_len);
+  vec_free(&s->hit_pairs); vec_free(&s->hit_exec);
 }
 
 /* scan a contiguous range of roots into one Scan; roots are pre-extracted
@@ -1000,6 +1026,11 @@ static int scan_merge(Scan *dst, Scan *src) {
       vec_push(&dst->data_off, src->data_off.buf, src->data_off.len) < 0 ||
       vec_push(&dst->data_len, src->data_len.buf, src->data_len.len) < 0)
     return -1;
+  /* fused-match hits: pair ids are global root positions, so chunk
+   * concatenation in job order preserves the sequential emission order */
+  if (vec_push(&dst->hit_pairs, src->hit_pairs.buf, src->hit_pairs.len) < 0 ||
+      vec_push(&dst->hit_exec, src->hit_exec.buf, src->hit_exec.len) < 0)
+    return -1;
   dst->n_events += src->n_events;
   dst->n_receipts += src->n_receipts;
   return 0;
@@ -1017,6 +1048,13 @@ static int scan_threads_default(void) {
 }
 
 static PyObject *scan_result_dict(Scan *s) {
+  if (s->match_mode)
+    return Py_BuildValue(
+        "{s:N,s:N,s:L,s:L}",
+        "hit_pairs", make_array_bytes(&s->hit_pairs),
+        "hit_exec", make_array_bytes(&s->hit_exec),
+        "n_events", (long long)s->n_events,
+        "n_receipts", (long long)s->n_receipts);
   return Py_BuildValue(
       "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:L,s:L}",
       "topics", make_array_bytes(&s->topics),
@@ -1039,12 +1077,14 @@ static PyObject *scan_result_dict(Scan *s) {
 static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *fallback = Py_None;
+  PyObject *match_fp_obj = Py_None, *match_actor_obj = Py_None;
   int skip_missing = 0, want_payload = 0;
   static char *kwlist[] = {"blocks", "roots", "fallback", "skip_missing",
-                           "want_payload", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Opp", kwlist,
+                           "want_payload", "match_fp", "match_actor", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|OppOO", kwlist,
                                    &PyDict_Type, &blocks, &roots, &fallback,
-                                   &skip_missing, &want_payload))
+                                   &skip_missing, &want_payload,
+                                   &match_fp_obj, &match_actor_obj))
     return NULL;
   PyObject *seq = PySequence_Fast(roots, "roots must be a sequence of cid bytes");
   if (!seq) return NULL;
@@ -1056,6 +1096,36 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
   s.fallback = fallback;
   s.skip_missing = skip_missing;
   s.want_payload = want_payload;
+  if (match_fp_obj != Py_None) {
+    if (want_payload) {
+      PyErr_SetString(PyExc_ValueError,
+                      "match_fp excludes want_payload (fused match emits no "
+                      "per-event columns)");
+      Py_DECREF(seq);
+      return NULL;
+    }
+    s.match_mode = 1;
+    s.match_fp = PyLong_AsUnsignedLongLong(match_fp_obj);
+    if (PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return NULL;
+    }
+  }
+  if (match_actor_obj != Py_None) {
+    if (!s.match_mode) {
+      PyErr_SetString(PyExc_ValueError,
+                      "match_actor requires match_fp (the actor filter is "
+                      "part of the fused match predicate)");
+      Py_DECREF(seq);
+      return NULL;
+    }
+    s.match_has_actor = 1;
+    s.match_actor = PyLong_AsUnsignedLongLong(match_actor_obj);
+    if (PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return NULL;
+    }
+  }
 
   Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(seq);
   /* pre-extract root cid spans; validates types up front (same TypeError) */

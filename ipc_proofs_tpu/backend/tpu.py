@@ -232,17 +232,11 @@ class TpuBackend:
         is evaluated with the same numpy expressions the device kernel
         traces, so the mask is bit-identical either way."""
         if not self._match_on_device(topics.shape[0]):
-            t0 = np.frombuffer(topic0, dtype="<u4")
-            t1 = np.frombuffer(topic1, dtype="<u4")
-            mask = (
-                valid
-                & (n_topics >= 2)
-                & (topics[:, 0, :] == t0).all(axis=1)
-                & (topics[:, 1, :] == t1).all(axis=1)
+            from ipc_proofs_tpu.proofs.scan_native import match_mask_flat_np
+
+            return match_mask_flat_np(
+                topics, n_topics, emitters, valid, topic0, topic1, actor_id_filter
             )
-            if actor_id_filter is not None:
-                mask = mask & (emitters == actor_id_filter)
-            return mask
         from ipc_proofs_tpu.ops.match_jax import event_match_mask_jit
 
         mask = event_match_mask_jit(
@@ -276,14 +270,15 @@ class TpuBackend:
         predicate the device kernel evaluates, minus the dispatch and
         transfer that made a single proxied-chip round trip cost more than
         the entire host-side match."""
-        from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+        from ipc_proofs_tpu.proofs.scan_native import (
+            match_mask_fp_np,
+            topic_fingerprint,
+        )
 
         if not self._match_on_device(fp.shape[0]):
-            target = topic_fingerprint(topic0, topic1)
-            mask = valid & (np.asarray(n_topics) >= 2) & (fp == target)
-            if actor_id_filter is not None:
-                mask = mask & (np.asarray(emitters) == actor_id_filter)
-            return mask
+            return match_mask_fp_np(
+                fp, n_topics, emitters, valid, topic0, topic1, actor_id_filter
+            )
         from ipc_proofs_tpu.ops.match_jax import event_match_mask_fp_jit
 
         mask = event_match_mask_fp_jit(

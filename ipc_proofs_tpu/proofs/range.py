@@ -250,7 +250,41 @@ def _scan_and_match(
     scan_batch = None
     scans = None
     with metrics.stage("range_scan"):
+        import os
+
         roots = [pair.child.blocks[0].parent_message_receipts for pair in pairs]
+        # Fused scan+match: single-chip, fp-capable backends fold the match
+        # predicate into the C walk itself (scan_match_hits) — the match
+        # leg disappears and no per-event arrays are materialized. A mesh
+        # keeps the unfused flat-tensor path: sharded multichip batches
+        # want the mask where the rest of the sharded pipeline runs.
+        # IPC_SCAN_FUSED_MATCH=0 forces the unfused path (differential knob).
+        if (
+            match_backend is not None
+            and hasattr(match_backend, "event_match_mask_fp")
+            and getattr(match_backend, "mesh", None) is None
+            and os.environ.get("IPC_SCAN_FUSED_MATCH", "1") != "0"
+        ):
+            from ipc_proofs_tpu.proofs.scan_native import has_raw_map, scan_match_hits
+
+            if has_raw_map(cached):
+                hits = scan_match_hits(
+                    cached, roots, matcher.topic0, matcher.topic1, spec.actor_id_filter
+                )
+                if hits is not None:
+                    n_events, hit_pairs, hit_exec = hits
+                    metrics.count("range_events", n_events)
+                    # the match leg collapsed into the scan: record it as a
+                    # (near-)zero stage so per-stage accounting stays complete
+                    with metrics.stage("range_match"):
+                        matching_per_pair = [[] for _ in pairs]
+                        prev = None
+                        # walk order ⇒ (pair, exec) ascending, dups adjacent
+                        for p, e in zip(hit_pairs.tolist(), hit_exec.tolist()):
+                            if (p, e) != prev:
+                                matching_per_pair[p].append(e)
+                                prev = (p, e)
+                    return matching_per_pair, True
         if match_backend is not None and hasattr(match_backend, "event_match_mask_flat"):
             from ipc_proofs_tpu.proofs.scan_native import has_raw_map, scan_events_flat
 

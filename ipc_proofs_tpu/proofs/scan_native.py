@@ -30,9 +30,12 @@ __all__ = [
     "ScanBatch",
     "RecordBatch",
     "scan_events_flat",
+    "scan_match_hits",
     "record_receipt_paths",
     "native_scan_available",
     "topic_fingerprint",
+    "match_mask_flat_np",
+    "match_mask_fp_np",
     "split_pooled",
 ]
 
@@ -74,6 +77,50 @@ def topic_fingerprint(topic0: bytes, topic1: bytes) -> int:
         fp = ((fp ^ word) * _FP_MULT) & _U64
         fp ^= fp >> 29
     return fp
+
+
+def match_mask_flat_np(
+    topics: np.ndarray,
+    n_topics: np.ndarray,
+    emitters: np.ndarray,
+    valid: np.ndarray,
+    topic0: bytes,
+    topic1: bytes,
+    actor_id_filter: "Optional[int]",
+) -> np.ndarray:
+    """THE host match predicate over flat scanner arrays — the single
+    source of truth the CPU backend, the TPU backend's host crossover, and
+    the device kernels' differential tests all share (the C fused-match
+    walk mirrors it via the fp formulation; pass 2 confirms hits exactly)."""
+    t0 = np.frombuffer(topic0, dtype="<u4")
+    t1 = np.frombuffer(topic1, dtype="<u4")
+    mask = (
+        valid
+        & (n_topics >= 2)
+        & (topics[:, 0, :] == t0).all(axis=1)
+        & (topics[:, 1, :] == t1).all(axis=1)
+    )
+    if actor_id_filter is not None:
+        mask = mask & (emitters == actor_id_filter)
+    return mask
+
+
+def match_mask_fp_np(
+    fp: np.ndarray,
+    n_topics: np.ndarray,
+    emitters: np.ndarray,
+    valid: np.ndarray,
+    topic0: bytes,
+    topic1: bytes,
+    actor_id_filter: "Optional[int]",
+) -> np.ndarray:
+    """Fingerprint formulation of :func:`match_mask_flat_np` (one u64
+    compare per event; pass 2 confirms every hit exactly)."""
+    target = topic_fingerprint(topic0, topic1)
+    mask = valid & (np.asarray(n_topics) >= 2) & (fp == target)
+    if actor_id_filter is not None:
+        mask = mask & (np.asarray(emitters) == actor_id_filter)
+    return mask
 
 
 @dataclass
@@ -222,6 +269,46 @@ def record_receipt_paths(
         _touch_off=np.frombuffer(out["touch_off"], dtype="<i4"),
         _touch_len=np.frombuffer(out["touch_len"], dtype="<i4"),
         _touch_goff=np.frombuffer(out["touch_goff"], dtype="<i4"),
+    )
+
+
+def scan_match_hits(
+    store: Blockstore,
+    receipts_roots: Sequence[CID],
+    topic0: bytes,
+    topic1: bytes,
+    actor_id_filter: "Optional[int]",
+) -> "Optional[tuple[int, np.ndarray, np.ndarray]]":
+    """Fused Phase A+B: ONE C walk scans every receipts AMT AND evaluates
+    the fp match predicate per event in-register, returning
+    ``(n_events, hit_pair_ids, hit_exec_idx)`` — no per-event columns cross
+    the C boundary at all (the unfused path materializes ~100 B/event; the
+    north-star range is ~25 MB of arrays whose only consumer is one
+    vectorized compare). Predicate is exactly
+    ``BatchHashBackend.event_match_mask_fp``'s; pass 2 confirms every hit,
+    so fp collisions can only add an unused witness path, never a claim.
+
+    Hits are emitted in walk order — (pair, exec, event) ascending — so
+    duplicate (pair, exec) rows from multiple matching events in one
+    receipt are adjacent. Returns None when the extension is unavailable.
+    """
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    ext = load_scan_ext()
+    if ext is None:
+        return None
+    raw, fallback = _raw_view(store)
+    out = ext.scan_events_batch(
+        raw,
+        [c.to_bytes() for c in receipts_roots],
+        fallback,
+        match_fp=topic_fingerprint(topic0, topic1),
+        match_actor=actor_id_filter,
+    )
+    return (
+        out["n_events"],
+        np.frombuffer(out["hit_pairs"], dtype="<i4"),
+        np.frombuffer(out["hit_exec"], dtype="<i4"),
     )
 
 

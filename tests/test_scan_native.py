@@ -387,3 +387,103 @@ class TestSplitPooled:
         with pytest.raises(ValueError):
             # out-of-bounds slice must raise, not read past the pool
             ext.split_pool(b"abc", (0).to_bytes(4, "little"), (9).to_bytes(4, "little"))
+
+
+class TestFusedMatchHits:
+    """scan_match_hits: the fused scan+match walk must agree with the
+    unfused scan→fp-mask pipeline exactly, including error behavior."""
+
+    def _world(self):
+        from ipc_proofs_tpu.fixtures import build_range_world
+
+        return build_range_world(24, 4, 3, 0.25, base_height=777_000)
+
+    def test_hits_match_unfused_mask(self):
+        if not native_scan_available():
+            pytest.skip("native scan unavailable")
+        from ipc_proofs_tpu.proofs.scan_native import scan_match_hits, topic_fingerprint
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+        bs, pairs, _ = self._world()
+        roots = [p.child.blocks[0].parent_message_receipts for p in pairs]
+        t0 = hash_event_signature("NewTopDownMessage(bytes32,uint256)")
+        t1 = ascii_to_bytes32("calib-subnet-1")
+        for actor in (1001, None):
+            n_events, hp, he = scan_match_hits(bs, roots, t0, t1, actor)
+            batch = scan_events_flat(bs, roots)
+            assert n_events == batch.n_events
+            mask = batch.valid & (batch.n_topics >= 2)
+            mask &= batch.fp == np.uint64(topic_fingerprint(t0, t1))
+            if actor is not None:
+                mask &= batch.emitters == np.uint64(actor)
+            sel = np.nonzero(mask)[0]
+            expected = list(zip(batch.pair_ids[sel].tolist(), batch.exec_idx[sel].tolist()))
+            assert list(zip(hp.tolist(), he.tolist())) == expected
+            assert len(expected) > 0  # the fixture world has matches
+
+    def test_hits_walk_order_adjacent_duplicates(self):
+        if not native_scan_available():
+            pytest.skip("native scan unavailable")
+        from ipc_proofs_tpu.proofs.scan_native import scan_match_hits
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+        bs = MemoryBlockstore()
+        # one receipt emitting THREE matching events -> three adjacent hits
+        events = [[
+            EventFixture(emitter=ACTOR, signature=SIG, topic1="dup"),
+            EventFixture(emitter=ACTOR, signature=SIG, topic1="dup"),
+            EventFixture(emitter=ACTOR, signature=SIG, topic1="dup"),
+        ]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        t0, t1 = hash_event_signature(SIG), ascii_to_bytes32("dup")
+        n_events, hp, he = scan_match_hits(
+            bs, [world.child.blocks[0].parent_message_receipts], t0, t1, ACTOR
+        )
+        assert n_events == 3
+        assert hp.tolist() == [0, 0, 0] and he.tolist() == [0, 0, 0]
+
+    def test_missing_block_raises_like_unfused(self):
+        if not native_scan_available():
+            pytest.skip("native scan unavailable")
+        from ipc_proofs_tpu.proofs.scan_native import scan_match_hits
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+        bs = MemoryBlockstore()
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="x")]]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        root = world.child.blocks[0].parent_message_receipts
+        bs.raw_map().pop(root.to_bytes())
+        t0, t1 = hash_event_signature(SIG), ascii_to_bytes32("x")
+        with pytest.raises(KeyError):
+            scan_match_hits(bs, [root], t0, t1, ACTOR)
+        with pytest.raises(KeyError):
+            scan_events_flat(bs, [root])
+
+    def test_match_mode_rejects_want_payload(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if ext is None:
+            pytest.skip("native scan unavailable")
+        with pytest.raises(ValueError):
+            ext.scan_events_batch({}, [], None, want_payload=True, match_fp=7)
+
+    def test_range_driver_fused_vs_forced_unfused(self, monkeypatch):
+        if not native_scan_available():
+            pytest.skip("native scan unavailable")
+        from ipc_proofs_tpu.backend import get_backend
+        from ipc_proofs_tpu.proofs.generator import EventProofSpec
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+
+        bs, pairs, _ = self._world()
+        spec = EventProofSpec(
+            event_signature="NewTopDownMessage(bytes32,uint256)",
+            topic_1="calib-subnet-1",
+            actor_id_filter=1001,
+        )
+        backend = get_backend("cpu")
+        fused = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
+        monkeypatch.setenv("IPC_SCAN_FUSED_MATCH", "0")
+        unfused = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
+        assert fused.to_json() == unfused.to_json()
+        assert len(fused.event_proofs) > 0
