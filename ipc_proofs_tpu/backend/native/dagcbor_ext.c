@@ -629,6 +629,160 @@ static PyObject *py_make_cids(PyObject *self, PyObject *arg) {
   return out;
 }
 
+/* cid_strs(list[bytes]) -> list[str]: batch multibase base32-lower
+ * rendering ("b" prefix, RFC 4648 lower alphabet, no padding) — exactly
+ * CID.__str__'s output for raw CID bytes. Claim construction renders one
+ * string per proof plus two per pair; the Python int-codec costs ~6 µs
+ * per CID where this is ~100 ns. */
+static const char b32_alpha[32] = "abcdefghijklmnopqrstuvwxyz234567";
+
+static PyObject *py_cid_strs(PyObject *self, PyObject *arg) {
+  (void)self;
+  PyObject *seq = PySequence_Fast(arg, "cid_strs expects a sequence of bytes");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return NULL;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyBytes_Check(item)) {
+      Py_DECREF(out);
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_TypeError, "cid_strs expects bytes items");
+      return NULL;
+    }
+    const uint8_t *d = (const uint8_t *)PyBytes_AS_STRING(item);
+    Py_ssize_t blen = PyBytes_GET_SIZE(item);
+    Py_ssize_t nchars = (blen * 8 + 4) / 5;
+    PyObject *str = PyUnicode_New(1 + nchars, 127);
+    if (!str) {
+      Py_DECREF(out);
+      Py_DECREF(seq);
+      return NULL;
+    }
+    Py_UCS1 *w = PyUnicode_1BYTE_DATA(str);
+    *w++ = 'b';
+    uint32_t acc = 0;
+    int bits = 0;
+    for (Py_ssize_t k = 0; k < blen; k++) {
+      acc = (acc << 8) | d[k];
+      bits += 8;
+      while (bits >= 5) {
+        bits -= 5;
+        *w++ = (Py_UCS1)b32_alpha[(acc >> bits) & 31];
+      }
+    }
+    if (bits) *w++ = (Py_UCS1)b32_alpha[(acc << (5 - bits)) & 31];
+    PyList_SET_ITEM(out, i, str);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
+/* cids_from_strs(list[str]) -> list[CID]: batch multibase base32 parse +
+ * CID construction — CID.from_string semantics exactly: 'b' prefix
+ * required, both alphabet cases accepted, unpadded length classes
+ * {1,3,6} (mod 8) rejected, trailing sub-byte bits DROPPED (the Python
+ * int codec discards them), then CID.from_bytes validation via make_cid. */
+static int8_t b32_val[256];
+static int b32_val_ready = 0;
+
+static void b32_val_init(void) {
+  memset(b32_val, -1, sizeof(b32_val));
+  for (int i = 0; i < 32; i++) {
+    uint8_t c = (uint8_t)b32_alpha[i];
+    b32_val[c] = (int8_t)i;
+    if (c >= 'a' && c <= 'z') b32_val[c - 32] = (int8_t)i; /* both cases */
+  }
+  b32_val_ready = 1;
+}
+
+static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
+  (void)self;
+  if (!cid_class) {
+    PyErr_SetString(PyExc_RuntimeError, "CID class not registered");
+    return NULL;
+  }
+  if (!b32_val_ready) b32_val_init();
+  PyObject *seq = PySequence_Fast(arg, "cids_from_strs expects a sequence of str");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return NULL;
+  }
+  uint8_t buf[256];
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    Py_ssize_t slen;
+    const char *s =
+        PyUnicode_Check(item) ? PyUnicode_AsUTF8AndSize(item, &slen) : NULL;
+    if (!s) {
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_TypeError, "cids_from_strs expects str items");
+      goto fail;
+    }
+    if (slen == 0) {
+      PyErr_SetString(PyExc_ValueError, "empty CID string");
+      goto fail;
+    }
+    if (s[0] != 'b') {
+      PyErr_Format(PyExc_ValueError,
+                   "unsupported multibase prefix '%c' (base32 only)", s[0]);
+      goto fail;
+    }
+    Py_ssize_t tlen = slen - 1;
+    Py_ssize_t rem = tlen % 8;
+    if (rem == 1 || rem == 3 || rem == 6) {
+      PyErr_Format(PyExc_ValueError, "invalid base32 length %zd", tlen);
+      goto fail;
+    }
+    Py_ssize_t nbytes = tlen * 5 / 8;
+    /* oversized CIDs (e.g. long identity-multihash digests) are valid to
+     * CID.from_string — heap-allocate past the stack buffer, never reject */
+    uint8_t *dec = buf;
+    if ((size_t)nbytes > sizeof(buf)) {
+      dec = malloc((size_t)nbytes);
+      if (!dec) {
+        PyErr_NoMemory();
+        goto fail;
+      }
+    }
+    uint32_t acc = 0;
+    int bits = 0;
+    uint8_t *w = dec;
+    for (Py_ssize_t k = 1; k < slen; k++) {
+      int8_t v = b32_val[(uint8_t)s[k]];
+      if (v < 0) {
+        PyErr_Format(PyExc_ValueError, "non-base32 character in %R", item);
+        if (dec != buf) free(dec);
+        goto fail;
+      }
+      acc = (acc << 5) | (uint32_t)v;
+      bits += 5;
+      if (bits >= 8) {
+        bits -= 8;
+        *w++ = (uint8_t)(acc >> bits);
+      }
+    }
+    /* trailing <8 bits dropped — Python int-codec parity */
+    PyObject *cid = make_cid(dec, nbytes);
+    if (dec != buf) free(dec);
+    if (!cid) goto fail;
+    PyList_SET_ITEM(out, i, cid);
+  }
+  Py_DECREF(seq);
+  return out;
+fail:
+  Py_DECREF(out);
+  Py_DECREF(seq);
+  return NULL;
+}
+
 static PyObject *py_set_cid_class(PyObject *self, PyObject *arg) {
   (void)self;
   if (!PyType_Check(arg)) {
@@ -657,6 +811,12 @@ static PyMethodDef methods[] = {
     {"make_cids", py_make_cids, METH_O,
      "Construct a list of CID objects from raw CID byte strings in one "
      "call (from_bytes semantics)."},
+    {"cid_strs", py_cid_strs, METH_O,
+     "Render raw CID bytes as multibase base32-lower strings ('b' prefix, "
+     "no padding) in one call (CID.__str__ semantics)."},
+    {"cids_from_strs", py_cids_from_strs, METH_O,
+     "Parse multibase base32 CID strings into CID objects in one call "
+     "(CID.from_string semantics)."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_dagcbor_ext",

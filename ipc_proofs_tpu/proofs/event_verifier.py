@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.cid import CID, cids_from_strings
 from ipc_proofs_tpu.ipld.amt import AMT
 from ipc_proofs_tpu.proofs.bundle import EventData, EventProof, EventProofBundle
 from ipc_proofs_tpu.proofs.exec_order import reconstruct_execution_order
@@ -25,7 +25,7 @@ from ipc_proofs_tpu.state.events import (
     extract_evm_log,
     hash_event_signature,
 )
-from ipc_proofs_tpu.state.header import BlockHeader
+from ipc_proofs_tpu.state.header import BlockHeader, LiteHeader, decode_header_lite
 from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
 
 __all__ = ["verify_event_proof", "create_event_filter"]
@@ -123,26 +123,39 @@ def _verify_proofs_batch(
     # step 3 — reconstruction runs ONLY for groups some proof actually
     # reached, preserving the lazy cost model against adversarial bundles.
     # headers decoded once per CID across ALL phases (phase 1 shares its
-    # decodes with step 3's strict re-validation leg)
-    header_cache: dict[CID, BlockHeader] = {}
+    # decodes with step 3's strict re-validation leg); LiteHeader carries
+    # exactly the fields any phase reads, with full-decode acceptance
+    header_cache: dict[CID, LiteHeader] = {}
 
-    def _decoded_header(cid: CID, kind: str) -> BlockHeader:
+    def _decoded_header(cid: CID, kind: str) -> LiteHeader:
         header = header_cache.get(cid)
         if header is None:
             raw = store.get(cid)
             if raw is None:
                 raise KeyError(f"missing {kind} header in witness")
-            # verification never re-encodes headers; the lite decode skips
-            # materializing the opaque fields with identical acceptance
-            header = BlockHeader.decode_lite(raw)
+            header = decode_header_lite(raw)
             header_cache[cid] = header
         return header
 
-    step3: list[tuple[list[int], list[CID], "BlockHeader"]] = []
-    for (parent_strs, child_str), idxs in groups.items():
-        parent_cids = [CID.from_string(c) for c in parent_strs]
-        child_cid = CID.from_string(child_str)
-        child_header: Optional[BlockHeader] = None
+    # every group's (parents..., child) CID strings parse in ONE batched C
+    # call — same per-string acceptance as the scalar CID.from_string loop,
+    # and a malformed string aborts the whole verify in both formulations
+    group_items = list(groups.items())
+    flat_strs: list[str] = []
+    spans: list[tuple[int, int]] = []
+    for (parent_strs, _child_str), _idxs in group_items:
+        spans.append((len(flat_strs), len(parent_strs)))
+        flat_strs.extend(parent_strs)
+        flat_strs.append(_child_str)
+    flat_cids = cids_from_strings(flat_strs)
+
+    step3: list[tuple[list[int], list[CID], "LiteHeader"]] = []
+    for ((parent_strs, child_str), idxs), (base, n_parents) in zip(
+        group_items, spans
+    ):
+        parent_cids = flat_cids[base : base + n_parents]
+        child_cid = flat_cids[base + n_parents]
+        child_header: Optional[LiteHeader] = None
         parents_match = False
         parent_height: Optional[int] = None
         survivors: list[int] = []
@@ -181,11 +194,17 @@ def _verify_proofs_batch(
         header_cache=header_cache,
     )
 
-    pending: list[tuple[int, "BlockHeader"]] = []
+    pending: list[tuple[int, "LiteHeader"]] = []
     pending_roots: list[CID] = []  # one receipts root per group with survivors
     root_pos: dict[CID, int] = {}  # receipts-root cid → position in ^
     pending_pair: list[int] = []  # pending[i] → its root position
 
+    # resolve each group's exec mapping first, then batch-parse the live
+    # groups' claimed message CIDs in one C call (a malformed message_cid
+    # string raises only if its group's reconstruction succeeded — the
+    # scalar path's step-3 ordering)
+    group_exec: list = []
+    msg_strs: list[str] = []
     for gi, (survivors, parent_cids, child_header) in enumerate(step3):
         if batch_exec is not None:
             exec_pos = batch_exec[gi]
@@ -195,11 +214,18 @@ def _verify_proofs_batch(
                 exec_pos = {c.to_bytes(): i for i, c in enumerate(exec_order)}
             except (KeyError, ValueError):
                 exec_pos = None
+        group_exec.append(exec_pos)
+        if exec_pos is not None:
+            msg_strs.extend(proofs[k].message_cid for k in survivors)
+    msg_cids = iter(cids_from_strings(msg_strs))
+
+    for gi, (survivors, parent_cids, child_header) in enumerate(step3):
+        exec_pos = group_exec[gi]
         if exec_pos is None:
             continue
         for k in survivors:
             proof = proofs[k]
-            position = exec_pos.get(CID.from_string(proof.message_cid).to_bytes())
+            position = exec_pos.get(next(msg_cids).to_bytes())
             if position is None or position != proof.exec_index:
                 continue
             root = child_header.parent_message_receipts

@@ -16,7 +16,7 @@ from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
 from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
 from ipc_proofs_tpu.ipld.amt import AMT
-from ipc_proofs_tpu.state.header import BlockHeader
+from ipc_proofs_tpu.state.header import BlockHeader, decode_header_lite
 from ipc_proofs_tpu.store.blockstore import Blockstore
 
 __all__ = [
@@ -173,7 +173,7 @@ def reconstruct_execution_orders_batch(
 
     Parity with the scalar path is enforced in Python on top of the C walk:
 
-    - every parent header is re-decoded with `BlockHeader.decode_lite`
+    - every parent header is re-decoded with `decode_header_lite`
       (acceptance-identical to the full decode — the C walker here only
       extracts the messages field; the scalar path's strict
       16-tuple/CID/trailing-byte validation must still reject what it
@@ -210,7 +210,7 @@ def reconstruct_execution_orders_batch(
                     if raw is None:
                         ok = False
                         break
-                    header = BlockHeader.decode_lite(raw)
+                    header = decode_header_lite(raw)
                     if header_cache is not None:
                         header_cache[cid] = header
                 expected_txmetas.append(header.messages.to_bytes())

@@ -182,7 +182,7 @@ def generate_event_proofs_for_range(
         cached, pairs, spec, matcher, match_backend, metrics, scan_workers
     )
     with metrics.stage("range_record"):
-        event_proofs, blocks = _record_chunk(
+        event_proofs, witness_bytes, fallback_blocks = _record_chunk(
             cached, pairs, matching_per_pair, matcher, spec, native_ok
         )
     metrics.count("range_proofs", len(event_proofs))
@@ -194,10 +194,10 @@ def generate_event_proofs_for_range(
                 cached, pairs, storage_specs, match_backend
             )
         metrics.count("range_storage_proofs", len(storage_proofs))
-        merged = set(blocks)
-        merged.update(storage_blocks)
-        blocks = sorted(merged, key=lambda b: b.cid.to_bytes())
+        fallback_blocks = list(fallback_blocks) + list(storage_blocks)
 
+    with metrics.stage("range_record"):
+        blocks = _materialize_witness(cached, witness_bytes, fallback_blocks)
     return UnifiedProofBundle(
         storage_proofs=storage_proofs, event_proofs=event_proofs, blocks=blocks
     )
@@ -382,21 +382,26 @@ def _record_chunk(
     matcher: EventMatcher,
     spec: EventProofSpec,
     native_ok: bool,
-) -> "tuple[list, list[ProofBlock]]":
-    """Phase C+D: pass 2 + merged witness. Pairs with no matching receipts
-    contribute no proofs, so their base witness (headers, TxMeta walks,
-    exec-order blocks) is dead weight for the verifier — skip them
-    entirely. (The reference always collects the base witness because it
-    runs one pair per invocation, `events/generator.rs:122-145`; a range
-    bundle's witness only needs to cover the proofs it carries.)
+) -> "tuple[list, set[bytes], list[ProofBlock]]":
+    """Phase C: pass 2. Pairs with no matching receipts contribute no
+    proofs, so their base witness (headers, TxMeta walks, exec-order
+    blocks) is dead weight for the verifier — skip them entirely. (The
+    reference always collects the base witness because it runs one pair
+    per invocation, `events/generator.rs:122-145`; a range bundle's
+    witness only needs to cover the proofs it carries.)
+
+    Returns ``(event_proofs, witness_cid_bytes, fallback_blocks)`` — the
+    witness stays a set of raw CID bytes until the whole bundle
+    materializes ONCE (`_materialize_witness`); cross-chunk union on bytes
+    avoids hashing materialized ProofBlocks per chunk.
 
     Native path: TWO C calls cover every matching pair — the batched
     TxMeta/message-AMT walker (exec order + base witness) and the batched
     pass-2 recorder (receipts paths + events AMTs + payload-mode event
-    arrays). Claims become a numpy mask + array slicing; the witness is a
-    set of raw CID bytes materialized ONCE. Any failed group (or a store
-    without a raw map, or no extension) falls back to the scalar pass 2
-    so errors surface identically.
+    arrays); claims become a numpy mask + array slicing. Any failed group
+    (or a store without a raw map, or no extension) falls back to the
+    scalar pass 2 — whose already-materialized blocks ride along in
+    ``fallback_blocks`` — so errors surface identically.
     """
     matching_pairs = [
         (pair, matching)
@@ -412,54 +417,65 @@ def _record_chunk(
         )
     if native is not None:
         event_proofs, witness_bytes = native
-        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
-        from ipc_proofs_tpu.core.cid import CID
-        from ipc_proofs_tpu.proofs.scan_native import _raw_view
+        return event_proofs, witness_bytes, []
+    event_proofs = []
+    all_blocks: set[ProofBlock] = set()
+    for pair, matching in matching_pairs:
+        collector = WitnessCollector(cached)
+        # one set of TxMeta walks yields both the recorded base
+        # witness and the execution order (they touch the same blocks)
+        exec_order = collect_base_witness_and_exec_order(
+            collector, cached, pair.parent, pair.child
+        )
+        proofs, recordings = record_matching_receipts(
+            cached,
+            pair.parent,
+            pair.child,
+            exec_order,
+            matching,
+            matcher,
+            spec.actor_id_filter,
+        )
+        collector.collect_from_recordings(recordings)
+        event_proofs.extend(proofs)
+        all_blocks.update(collector.materialize())
+    return event_proofs, set(), sorted(all_blocks, key=lambda b: b.cid.to_bytes())
 
-        # materialize through the raw byte-keyed map (one dict probe per
-        # block) — the CID-keyed store path costs a hash+eq per block on
-        # freshly parsed CID objects; CID objects come from one batched C
-        # call when the extension provides it
-        raw_map, _ = _raw_view(cached)
-        ordered = sorted(witness_bytes)
-        ext = load_dagcbor_ext()
-        if ext is not None and hasattr(ext, "make_cids"):
-            cids = ext.make_cids(ordered)
-        else:
-            cids = [CID.from_bytes(b) for b in ordered]
-        make_block = ProofBlock._make
-        blocks = []
-        for cid_bytes, cid in zip(ordered, cids):
-            raw = raw_map.get(cid_bytes)
-            if raw is None:
-                raw = cached.get(cid)
-            if raw is None:
-                raise KeyError(f"missing witness block {cid}")
-            blocks.append(make_block(cid, raw))
+
+def _materialize_witness(
+    cached: Blockstore,
+    witness_bytes: "set[bytes]",
+    extra_blocks: "Sequence[ProofBlock]" = (),
+) -> "list[ProofBlock]":
+    """Phase D: ONE materialization for the whole bundle — CID objects come
+    from one batched C call, block bytes from the raw byte-keyed map (one
+    dict probe each; the CID-keyed store path would pay a hash+eq on every
+    freshly parsed CID). ``extra_blocks`` (scalar-fallback and storage
+    blocks, already materialized) dedup against the byte set by CID bytes.
+    Output is CID-byte-sorted — the bundle's canonical witness order."""
+    from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+    from ipc_proofs_tpu.core.cid import CID
+    from ipc_proofs_tpu.proofs.scan_native import _raw_view
+
+    by_cid: "dict[bytes, ProofBlock]" = {}
+    for block in extra_blocks:
+        by_cid[block.cid.to_bytes()] = block
+    todo = sorted(witness_bytes - by_cid.keys() if by_cid else witness_bytes)
+    raw_map, _ = _raw_view(cached)
+    ext = load_dagcbor_ext()
+    if ext is not None and hasattr(ext, "make_cids"):
+        cids = ext.make_cids(todo)
     else:
-        event_proofs = []
-        all_blocks: set[ProofBlock] = set()
-        for pair, matching in matching_pairs:
-            collector = WitnessCollector(cached)
-            # one set of TxMeta walks yields both the recorded base
-            # witness and the execution order (they touch the same blocks)
-            exec_order = collect_base_witness_and_exec_order(
-                collector, cached, pair.parent, pair.child
-            )
-            proofs, recordings = record_matching_receipts(
-                cached,
-                pair.parent,
-                pair.child,
-                exec_order,
-                matching,
-                matcher,
-                spec.actor_id_filter,
-            )
-            collector.collect_from_recordings(recordings)
-            event_proofs.extend(proofs)
-            all_blocks.update(collector.materialize())
-        blocks = sorted(all_blocks, key=lambda b: b.cid.to_bytes())
-    return event_proofs, blocks
+        cids = [CID.from_bytes(b) for b in todo]
+    make_block = ProofBlock._make
+    for cid_bytes, cid in zip(todo, cids):
+        raw = raw_map.get(cid_bytes)
+        if raw is None:
+            raw = cached.get(cid)
+        if raw is None:
+            raise KeyError(f"missing witness block {cid}")
+        by_cid[cid_bytes] = make_block(cid, raw)
+    return [by_cid[k] for k in sorted(by_cid)]
 
 
 def generate_event_proofs_for_range_pipelined(
@@ -493,7 +509,8 @@ def generate_event_proofs_for_range_pipelined(
     chunks = [pairs[k : k + chunk_size] for k in range(0, len(pairs), chunk_size)]
 
     event_proofs: list = []
-    all_blocks: set[ProofBlock] = set()
+    witness_bytes: set[bytes] = set()
+    fallback_blocks: list[ProofBlock] = []
     with ThreadPoolExecutor(max_workers=1) as pool:
         pending = None
         if chunks:
@@ -513,11 +530,12 @@ def generate_event_proofs_for_range_pipelined(
                     metrics,
                 )
             with metrics.stage("range_record"):
-                proofs, blocks = _record_chunk(
+                proofs, chunk_witness, chunk_fallback = _record_chunk(
                     cached, chunk, matching_per_pair, matcher, spec, native_ok
                 )
             event_proofs.extend(proofs)
-            all_blocks.update(blocks)
+            witness_bytes |= chunk_witness
+            fallback_blocks.extend(chunk_fallback)
     metrics.count("range_proofs", len(event_proofs))
 
     storage_proofs: list = []
@@ -527,12 +545,14 @@ def generate_event_proofs_for_range_pipelined(
                 cached, pairs, storage_specs, match_backend
             )
         metrics.count("range_storage_proofs", len(storage_proofs))
-        all_blocks.update(storage_blocks)
+        fallback_blocks.extend(storage_blocks)
 
+    with metrics.stage("range_record"):
+        blocks = _materialize_witness(cached, witness_bytes, fallback_blocks)
     return UnifiedProofBundle(
         storage_proofs=storage_proofs,
         event_proofs=event_proofs,
-        blocks=sorted(all_blocks, key=lambda b: b.cid.to_bytes()),
+        blocks=blocks,
     )
 
 
@@ -568,23 +588,33 @@ def _record_pass2_native(
         return None
 
     sb = rec.batch
-    # claim mask over ALL emitted events at once — exactly the scalar
-    # per-event predicate (extract_evm_log validity + matches_log + actor
-    # filter), evaluated on the C-parsed arrays
+    # claim mask over ALL emitted events at once — THE shared host
+    # predicate (extract_evm_log validity + matches_log + actor filter),
+    # evaluated on the C-parsed arrays
     if sb.n_events:
-        mask = sb.valid & (sb.n_topics >= 2)
-        t0_words = np.frombuffer(matcher.topic0, dtype="<u4")
-        t1_words = np.frombuffer(matcher.topic1, dtype="<u4")
-        mask &= (sb.topics[:, 0, :] == t0_words).all(axis=1)
-        mask &= (sb.topics[:, 1, :] == t1_words).all(axis=1)
-        if actor_id_filter is not None:
-            mask &= sb.emitters == np.uint64(actor_id_filter)
+        from ipc_proofs_tpu.proofs.scan_native import match_mask_flat_np
+
+        mask = match_mask_flat_np(
+            sb.topics, sb.n_topics, sb.emitters, sb.valid,
+            matcher.topic0, matcher.topic1, actor_id_filter,
+        )
     else:
         mask = np.zeros(0, dtype=bool)
 
-    proofs: list = []
+    # Two passes over the groups so every CID string in every claim renders
+    # in ONE batched C call (cid_strs): pass A collects witness bytes, runs
+    # scalar redo for failed groups, and gathers the raw CID bytes each
+    # native claim needs; pass B builds the EventProof objects from the
+    # pre-rendered strings. Per-group proof lists keep the emission order
+    # identical to the single-pass formulation (group order, row order).
+    from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
     witness: set[bytes] = set()
     goff = rec.row_offsets(len(matching_pairs))
+    per_group_proofs: "list[list]" = [[] for _ in matching_pairs]
+    claim_rows: "list[tuple[int, int]]" = []  # (group, row)
+    str_bytes: "list[bytes]" = []  # cid bytes to render, in claim order
+    group_str_base: "dict[int, int]" = {}  # group → offset of its parents+child
     for g, (pair, matching) in enumerate(matching_pairs):
         walk = walks[g]
         if walk is None or rec.failed[g]:
@@ -602,7 +632,7 @@ def _record_pass2_native(
                 actor_id_filter,
             )
             collector.collect_from_recordings(recordings)
-            proofs.extend(redo_proofs)
+            per_group_proofs[g] = redo_proofs
             witness.update(c.to_bytes() for c in collector.needed_cids())
             continue
 
@@ -623,30 +653,57 @@ def _record_pass2_native(
         lo, hi = int(goff[g]), int(goff[g + 1])
         if lo == hi:
             continue
-        parent_cid_strs = [str(c) for c in pair.parent.cids]
-        child_cid_str = str(pair.child.cids[0])
-        for rel in np.nonzero(mask[lo:hi])[0]:
+        rows = np.nonzero(mask[lo:hi])[0]
+        if not len(rows):
+            continue
+        group_str_base[g] = len(str_bytes)
+        str_bytes.extend(c.to_bytes() for c in pair.parent.cids)
+        str_bytes.append(pair.child.cids[0].to_bytes())
+        for rel in rows:
             row = int(rel) + lo
-            exec_index = int(sb.exec_idx[row])
-            topics_bytes = sb.event_topics(row)
-            n_topics = int(sb.n_topics[row])
-            proofs.append(
-                EventProof(
-                    parent_epoch=pair.parent.height,
-                    child_epoch=pair.child.height,
-                    parent_tipset_cids=list(parent_cid_strs),
-                    child_block_cid=child_cid_str,
-                    message_cid=str(CID.from_bytes(exec_msgs[exec_index])),
-                    exec_index=exec_index,
-                    event_index=int(sb.event_idx[row]),
-                    event_data=EventData(
-                        emitter=int(sb.emitters[row]),
-                        topics=[
-                            "0x" + topics_bytes[32 * k : 32 * (k + 1)].hex()
-                            for k in range(n_topics)
-                        ],
-                        data="0x" + sb.event_data(row).hex(),
-                    ),
-                )
+            claim_rows.append((g, row))
+            str_bytes.append(exec_msgs[int(sb.exec_idx[row])])
+
+    ext = load_dagcbor_ext()
+    if ext is not None and hasattr(ext, "cid_strs"):
+        strs = ext.cid_strs(str_bytes)
+    else:
+        strs = [str(CID.from_bytes(b)) for b in str_bytes]
+
+    pos = 0
+    for g, row in claim_rows:
+        pair = matching_pairs[g][0]
+        base = group_str_base[g]
+        n_parents = len(pair.parent.cids)
+        # claims of one group are contiguous in claim_rows; `pos` walks the
+        # message-cid slots laid out after the group's parents+child block
+        if pos < base + n_parents + 1:
+            pos = base + n_parents + 1
+        exec_index = int(sb.exec_idx[row])
+        topics_bytes = sb.event_topics(row)
+        n_topics = int(sb.n_topics[row])
+        per_group_proofs[g].append(
+            EventProof(
+                parent_epoch=pair.parent.height,
+                child_epoch=pair.child.height,
+                parent_tipset_cids=strs[base : base + n_parents],
+                child_block_cid=strs[base + n_parents],
+                message_cid=strs[pos],
+                exec_index=exec_index,
+                event_index=int(sb.event_idx[row]),
+                event_data=EventData(
+                    emitter=int(sb.emitters[row]),
+                    topics=[
+                        "0x" + topics_bytes[32 * k : 32 * (k + 1)].hex()
+                        for k in range(n_topics)
+                    ],
+                    data="0x" + sb.event_data(row).hex(),
+                ),
             )
+        )
+        pos += 1
+
+    proofs: list = []
+    for group_proofs in per_group_proofs:
+        proofs.extend(group_proofs)
     return proofs, witness

@@ -11,16 +11,80 @@ engines use.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
 from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
 
-__all__ = ["BlockHeader", "extract_parent_state_root"]
+__all__ = [
+    "BlockHeader",
+    "LiteHeader",
+    "decode_header_lite",
+    "extract_parent_state_root",
+]
 
 # memoized native decode_header entry (None = untried, False = unavailable)
 _decode_header = None
+
+
+def _validate_core_fields(fields: list) -> None:
+    """Type checks on the fields verification reads — shared by the full
+    and lite decoders so their acceptance can never diverge."""
+    parents = fields[5]
+    if not isinstance(parents, list):
+        raise ValueError("header parents must be a CID list")
+    for c in parents:
+        if not isinstance(c, CID):
+            raise ValueError("header parents must be a CID list")
+    for idx, name in (
+        (8, "parent_state_root"),
+        (9, "parent_message_receipts"),
+        (10, "messages"),
+    ):
+        if not isinstance(fields[idx], CID):
+            raise ValueError(f"header field {name} must be a CID")
+
+
+class LiteHeader(NamedTuple):
+    """The five header fields verification reads, and nothing else — the
+    batch verifier decodes two headers per proof group, and a 17-field
+    dataclass construction per decode was its hottest Python line. Shares
+    attribute names with :class:`BlockHeader`, so the verifier and the
+    batched exec-order walker accept either."""
+
+    parents: "list[CID]"
+    height: int
+    parent_state_root: CID
+    parent_message_receipts: CID
+    messages: CID
+
+
+def decode_header_lite(raw: bytes) -> "LiteHeader":
+    """Verification-only header decode with :meth:`BlockHeader.decode`'s
+    exact acceptance (the C ``decode_header`` walks the full grammar in
+    validating-skip mode — strict UTF-8, map keys, tag-42 CID bytes), but
+    returns the 5-field :class:`LiteHeader`. Falls back to the full Python
+    decode when the extension is unavailable."""
+    global _decode_header
+    if _decode_header is None:
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
+        ext = load_dagcbor_ext()
+        _decode_header = (
+            ext.decode_header
+            if ext is not None and hasattr(ext, "decode_header")
+            else False
+        )
+    if _decode_header is False:
+        h = BlockHeader.decode(raw)
+        return LiteHeader(
+            h.parents, h.height, h.parent_state_root,
+            h.parent_message_receipts, h.messages,
+        )
+    fields = _decode_header(raw)
+    _validate_core_fields(fields)
+    return LiteHeader(fields[5], fields[7], fields[8], fields[9], fields[10])
 
 
 @dataclass
@@ -81,22 +145,14 @@ class BlockHeader:
 
     @classmethod
     def _from_fields(cls, fields: list) -> "BlockHeader":
-        parents = fields[5]
-        if not isinstance(parents, list):
-            raise ValueError("header parents must be a CID list")
-        for c in parents:
-            if not isinstance(c, CID):
-                raise ValueError("header parents must be a CID list")
-        for idx, name in ((8, "parent_state_root"), (9, "parent_message_receipts"), (10, "messages")):
-            if not isinstance(fields[idx], CID):
-                raise ValueError(f"header field {name} must be a CID")
+        _validate_core_fields(fields)
         return cls(
             miner=fields[0],
             _ticket=fields[1],
             _election_proof=fields[2],
             _beacon_entries=fields[3],
             _winpost_proof=fields[4],
-            parents=parents,
+            parents=fields[5],
             parent_weight=fields[6],
             height=fields[7],
             parent_state_root=fields[8],

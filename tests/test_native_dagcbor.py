@@ -146,3 +146,109 @@ class TestCSideCidConstruction:
             ext.make_cids([b"\x00\x01"])  # CIDv0 / malformed
         with pytest.raises(TypeError):
             ext.make_cids([42])
+
+
+class TestBatchCidCodecs:
+    """cid_strs / cids_from_strs: C batch codecs must match the Python
+    int-codec bit-for-bit, including every rejection."""
+
+    def _ext(self):
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "cid_strs"):
+            pytest.skip("native cid codecs unavailable")
+        return ext
+
+    def _sample_cids(self):
+        from ipc_proofs_tpu.core.cid import CID, DAG_CBOR, RAW, SHA2_256
+
+        cids = [CID.hash_of(bytes([i]) * 3) for i in range(40)]
+        cids.append(CID.hash_of(b"raw", codec=RAW))
+        cids.append(CID.hash_of(b"sha", codec=DAG_CBOR, mh_code=SHA2_256))
+        return cids
+
+    def test_cid_strs_matches_python_str(self):
+        ext = self._ext()
+        cids = self._sample_cids()
+        assert ext.cid_strs([c.to_bytes() for c in cids]) == [str(c) for c in cids]
+
+    def test_cids_from_strs_round_trip(self):
+        from ipc_proofs_tpu.core.cid import CID
+
+        ext = self._ext()
+        cids = self._sample_cids()
+        strs = [str(c) for c in cids]
+        parsed = ext.cids_from_strs(strs)
+        assert parsed == cids
+        # uppercase accepted, like CID.from_string
+        up = "b" + strs[0][1:].upper()
+        assert ext.cids_from_strs([up]) == [CID.from_string(up)]
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["", "zabc", "b" + "a" * 9, "babc!aaaaa", "b"],
+    )
+    def test_cids_from_strs_rejections_match_python(self, bad):
+        from ipc_proofs_tpu.core.cid import CID
+
+        ext = self._ext()
+        with pytest.raises((ValueError, TypeError)):
+            CID.from_string(bad)
+        with pytest.raises((ValueError, TypeError)):
+            ext.cids_from_strs([bad])
+
+    def test_helpers_fall_back_identically(self):
+        from ipc_proofs_tpu.core.cid import CID, cid_strings, cids_from_strings
+
+        cids = self._sample_cids()
+        strs = cid_strings(cids)
+        assert strs == [str(c) for c in cids]
+        assert cids_from_strings(strs) == cids
+
+
+class TestDecodeHeaderLite:
+    def test_matches_blockheader_decode(self):
+        from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+        from ipc_proofs_tpu.state.header import BlockHeader, decode_header_lite
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        bs = MemoryBlockstore()
+        world = build_chain(
+            [ContractFixture(actor_id=7)],
+            [[EventFixture(emitter=7, signature="E()", topic1="t")]],
+            store=bs,
+        )
+        for header in (*world.parent.blocks, *world.child.blocks):
+            raw = bs.get(header.cid())
+            full = BlockHeader.decode(raw)
+            lite = decode_header_lite(raw)
+            assert lite.parents == full.parents
+            assert lite.height == full.height
+            assert lite.parent_state_root == full.parent_state_root
+            assert lite.parent_message_receipts == full.parent_message_receipts
+            assert lite.messages == full.messages
+
+    def test_rejects_malformed_like_decode(self):
+        from ipc_proofs_tpu.core.dagcbor import encode
+        from ipc_proofs_tpu.state.header import BlockHeader, decode_header_lite
+
+        bad = encode([1, 2, 3])  # not a 16-tuple
+        with pytest.raises(ValueError):
+            BlockHeader.decode(bad)
+        with pytest.raises(ValueError):
+            decode_header_lite(bad)
+
+    def test_oversized_identity_cid_parity(self):
+        # >256-byte decoded CIDs (long identity digests) must parse in C
+        # exactly as CID.from_string does — never rejected on size
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core.cid import CID, DAG_CBOR, IDENTITY
+
+        ext = load_dagcbor_ext()
+        if ext is None or not hasattr(ext, "cids_from_strs"):
+            pytest.skip("native cid codecs unavailable")
+        big = CID(1, DAG_CBOR, IDENTITY, bytes(range(256)) + b"x" * 100)
+        s = str(big)
+        assert ext.cids_from_strs([s]) == [CID.from_string(s)]
+        assert ext.cid_strs([big.to_bytes()]) == [s]
