@@ -90,27 +90,36 @@ def _scalar_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
     from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
     from ipc_proofs_tpu.proofs.witness import load_witness_store
 
+    import gc
+
     bs, pairs, _ = build_range_world(
         n_pairs_sample, receipts, events, base_height=10_000_000
     )
     spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
-    start = time.perf_counter()
-    bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=None)
-    # scalar verify, explicitly: per-block CID recompute on load and the
-    # per-proof replay loop (batch=False) — the batch verifier is this
-    # framework's own machinery, not the reference architecture's
-    store = load_witness_store(bundle.blocks, verify_cids=True)
-    results = verify_event_proof(
-        EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks),
-        lambda e, c: True,
-        lambda e, c: True,
-        store=store,
-        batch=False,
-    )
-    elapsed = time.perf_counter() - start
-    assert all(results) and len(results) == len(bundle.event_proofs)
-    n = len(bundle.event_proofs)
-    return n / elapsed if elapsed > 0 else 0.0
+    # best-of-2 with GC settled — the same steady-state methodology the
+    # headline number uses, so the ratio doesn't swing with one-off GC
+    # pauses on small hosts
+    best = 0.0
+    for _ in range(2):
+        gc.collect()
+        start = time.perf_counter()
+        bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=None)
+        # scalar verify, explicitly: per-block CID recompute on load and the
+        # per-proof replay loop (batch=False) — the batch verifier is this
+        # framework's own machinery, not the reference architecture's
+        store = load_witness_store(bundle.blocks, verify_cids=True)
+        results = verify_event_proof(
+            EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks),
+            lambda e, c: True,
+            lambda e, c: True,
+            store=store,
+            batch=False,
+        )
+        elapsed = time.perf_counter() - start
+        assert all(results) and len(results) == len(bundle.event_proofs)
+        if elapsed > 0:
+            best = max(best, len(bundle.event_proofs) / elapsed)
+    return best
 
 
 def _native_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
@@ -135,20 +144,27 @@ def _native_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
         n_pairs_sample, receipts, events, base_height=20_000_000
     )
     spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
+    import gc
+
     cpu = get_backend("cpu")
     # warm the native extensions (build/load outside the measured region)
     generate_event_proofs_for_range(bs, [pairs[0]], spec, match_backend=cpu)
-    start = time.perf_counter()
-    n = 0
-    for pair in pairs:  # one pair per invocation, like the reference binary
-        bundle = generate_event_proofs_for_range(bs, [pair], spec, match_backend=cpu)
-        result = verify_proof_bundle(
-            bundle, TrustPolicy.accept_all(), verify_witness_cids=True
-        )
-        assert result.all_valid()
-        n += len(bundle.event_proofs)
-    elapsed = time.perf_counter() - start
-    return n / elapsed if elapsed > 0 else 0.0
+    best = 0.0
+    for _ in range(2):  # best-of-2, GC settled (headline methodology)
+        gc.collect()
+        start = time.perf_counter()
+        n = 0
+        for pair in pairs:  # one pair per invocation, like the reference binary
+            bundle = generate_event_proofs_for_range(bs, [pair], spec, match_backend=cpu)
+            result = verify_proof_bundle(
+                bundle, TrustPolicy.accept_all(), verify_witness_cids=True
+            )
+            assert result.all_valid()
+            n += len(bundle.event_proofs)
+        elapsed = time.perf_counter() - start
+        if elapsed > 0:
+            best = max(best, n / elapsed)
+    return best
 
 
 def main() -> None:

@@ -123,23 +123,56 @@ def load_dagcbor_ext():
         return _dagcbor_cached
 
 
+def _host_build_id() -> str:
+    """Identity of the CPU the cached .so was tuned for — a checkout (or
+    container image) moved to a different host must rebuild rather than
+    run a stale -march=native binary into SIGILL."""
+    import hashlib
+    import platform
+
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    if not model:
+        model = platform.processor() or "unknown"
+    return hashlib.sha256(f"{platform.machine()}|{model}".encode()).hexdigest()[:16]
+
+
 def _build_cpython_ext(src: Path, so: Path, mod_name: str):
-    """Compile (mtime-cached) and import a raw-CPython-API extension."""
+    """Compile (mtime- AND host-stamp-cached) and import a raw-CPython-API
+    extension."""
     import importlib.util
     import sysconfig
 
     _BUILD_DIR.mkdir(exist_ok=True)
-    if not (so.exists() and so.stat().st_mtime >= src.stat().st_mtime):
+    stamp = so.with_suffix(so.suffix + ".host")
+    host_id = _host_build_id()
+    cached = (
+        so.exists()
+        and so.stat().st_mtime >= src.stat().st_mtime
+        and stamp.exists()
+        and stamp.read_text() == host_id
+    )
+    if not cached:
         include = sysconfig.get_paths()["include"]
-        subprocess.run(
-            [
-                "gcc", "-O3", "-shared", "-fPIC", "-pthread",
-                f"-I{include}", str(src), "-o", str(so),
-            ],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        base = ["gcc", "-O3", "-shared", "-fPIC", "-pthread", f"-I{include}",
+                str(src), "-o", str(so)]
+        try:
+            # host-tuned codegen measurably helps the scan parse loop;
+            # retry portable if the toolchain rejects -march=native
+            subprocess.run(
+                base[:2] + ["-march=native"] + base[2:],
+                check=True, capture_output=True, timeout=120,
+            )
+        except subprocess.SubprocessError:
+            subprocess.run(base, check=True, capture_output=True, timeout=120)
+        stamp.write_text(host_id)
     spec = importlib.util.spec_from_file_location(mod_name, so)
     module = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(module)

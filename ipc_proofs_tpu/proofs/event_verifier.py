@@ -202,8 +202,10 @@ def _verify_proofs_batch(
     # resolve each group's exec mapping first, then batch-parse the live
     # groups' claimed message CIDs in one C call (a malformed message_cid
     # string raises only if its group's reconstruction succeeded — the
-    # scalar path's step-3 ordering)
+    # scalar path's step-3 ordering); each group records its explicit
+    # (start, count) span into the parsed list
     group_exec: list = []
+    msg_spans: list[tuple[int, int]] = []
     msg_strs: list[str] = []
     for gi, (survivors, parent_cids, child_header) in enumerate(step3):
         if batch_exec is not None:
@@ -215,17 +217,19 @@ def _verify_proofs_batch(
             except (KeyError, ValueError):
                 exec_pos = None
         group_exec.append(exec_pos)
+        msg_spans.append((len(msg_strs), len(survivors)))
         if exec_pos is not None:
             msg_strs.extend(proofs[k].message_cid for k in survivors)
-    msg_cids = iter(cids_from_strings(msg_strs))
+    msg_cids = cids_from_strings(msg_strs)
 
     for gi, (survivors, parent_cids, child_header) in enumerate(step3):
         exec_pos = group_exec[gi]
         if exec_pos is None:
             continue
-        for k in survivors:
+        msg_base = msg_spans[gi][0]
+        for j, k in enumerate(survivors):
             proof = proofs[k]
-            position = exec_pos.get(next(msg_cids).to_bytes())
+            position = exec_pos.get(msg_cids[msg_base + j].to_bytes())
             if position is None or position != proof.exec_index:
                 continue
             root = child_header.parent_message_receipts

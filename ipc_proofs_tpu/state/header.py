@@ -28,6 +28,22 @@ __all__ = [
 _decode_header = None
 
 
+def _native_decode_header():
+    """Resolve (once) the C validating-skip header decoder, or False when
+    the extension is unavailable — shared by both lite decode paths."""
+    global _decode_header
+    if _decode_header is None:
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+
+        ext = load_dagcbor_ext()
+        _decode_header = (
+            ext.decode_header
+            if ext is not None and hasattr(ext, "decode_header")
+            else False
+        )
+    return _decode_header
+
+
 def _validate_core_fields(fields: list) -> None:
     """Type checks on the fields verification reads — shared by the full
     and lite decoders so their acceptance can never diverge."""
@@ -66,23 +82,14 @@ def decode_header_lite(raw: bytes) -> "LiteHeader":
     validating-skip mode — strict UTF-8, map keys, tag-42 CID bytes), but
     returns the 5-field :class:`LiteHeader`. Falls back to the full Python
     decode when the extension is unavailable."""
-    global _decode_header
-    if _decode_header is None:
-        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
-
-        ext = load_dagcbor_ext()
-        _decode_header = (
-            ext.decode_header
-            if ext is not None and hasattr(ext, "decode_header")
-            else False
-        )
-    if _decode_header is False:
+    native = _native_decode_header()
+    if native is False:
         h = BlockHeader.decode(raw)
         return LiteHeader(
             h.parents, h.height, h.parent_state_root,
             h.parent_message_receipts, h.messages,
         )
-    fields = _decode_header(raw)
+    fields = native(raw)
     _validate_core_fields(fields)
     return LiteHeader(fields[5], fields[7], fields[8], fields[9], fields[10])
 
@@ -127,19 +134,10 @@ class BlockHeader:
         nulls where the opaque payloads were. Falls back to the full decode
         when the extension is unavailable. Differential acceptance is
         covered by tests/test_state.py."""
-        global _decode_header
-        if _decode_header is None:
-            from ipc_proofs_tpu.backend.native import load_dagcbor_ext
-
-            ext = load_dagcbor_ext()
-            _decode_header = (
-                ext.decode_header
-                if ext is not None and hasattr(ext, "decode_header")
-                else False
-            )
-        if _decode_header is False:
+        native = _native_decode_header()
+        if native is False:
             return cls.decode(raw)
-        header = cls._from_fields(_decode_header(raw))
+        header = cls._from_fields(native(raw))
         header._lite = True  # encode() raises instead of emitting nulls
         return header
 
