@@ -81,8 +81,14 @@ def _staged_verify(bundle, backend):
 def _scalar_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
     """Reference-architecture e2e rate (proofs/s): single thread, per-event
     Python decode + match (events/generator.rs:217-239 shape), scalar
-    verify with per-proof witness stores, scalar CID recompute. Measured on
-    a small subrange; rates are per-pair-linear so the rate transfers."""
+    verify with per-proof replay, scalar CID recompute. Measured on a small
+    subrange; rates are per-pair-linear so the rate transfers.
+
+    Runs under `force_python_decoder` so the baseline is genuinely the
+    Python scalar loop — without it the C DAG-CBOR extension accelerates
+    the baseline too, and the reported multiple tracks the extension's
+    build flags rather than the batch/fusion design. The compiled-language
+    comparison lives in ``vs_native_baseline``."""
     from ipc_proofs_tpu.fixtures import build_range_world
     from ipc_proofs_tpu.proofs.bundle import EventProofBundle
     from ipc_proofs_tpu.proofs.event_verifier import verify_event_proof
@@ -91,6 +97,8 @@ def _scalar_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
     from ipc_proofs_tpu.proofs.witness import load_witness_store
 
     import gc
+
+    from ipc_proofs_tpu.core.dagcbor import force_python_decoder
 
     bs, pairs, _ = build_range_world(
         n_pairs_sample, receipts, events, base_height=10_000_000
@@ -103,18 +111,19 @@ def _scalar_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
     for _ in range(2):
         gc.collect()
         start = time.perf_counter()
-        bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=None)
-        # scalar verify, explicitly: per-block CID recompute on load and the
-        # per-proof replay loop (batch=False) — the batch verifier is this
-        # framework's own machinery, not the reference architecture's
-        store = load_witness_store(bundle.blocks, verify_cids=True)
-        results = verify_event_proof(
-            EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks),
-            lambda e, c: True,
-            lambda e, c: True,
-            store=store,
-            batch=False,
-        )
+        with force_python_decoder():
+            bundle = generate_event_proofs_for_range(bs, pairs, spec, match_backend=None)
+            # scalar verify, explicitly: per-block CID recompute on load and
+            # the per-proof replay loop (batch=False) — the batch verifier is
+            # this framework's own machinery, not the reference architecture's
+            store = load_witness_store(bundle.blocks, verify_cids=True)
+            results = verify_event_proof(
+                EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks),
+                lambda e, c: True,
+                lambda e, c: True,
+                store=store,
+                batch=False,
+            )
         elapsed = time.perf_counter() - start
         assert all(results) and len(results) == len(bundle.event_proofs)
         if elapsed > 0:

@@ -1294,7 +1294,33 @@ typedef struct {
   Vec *pool;
   Vec *off;
   Vec *len;
+  /* first-seen dedup across the group's blocks/AMTs (scalar parity:
+   * events/utils.rs:76-90 keeps the first occurrence) — open-addressing
+   * table of (off/len-array index + 1) slots, reset per group */
+  uint32_t *seen;
+  size_t seen_cap; /* power of two; 0 = dedup disabled */
+  size_t seen_n;
+  size_t group_first; /* index of this group's first entry in off/len */
 } CidSink;
+
+static int sink_seen_grow(CidSink *sink) {
+  size_t cap = sink->seen_cap ? sink->seen_cap * 2 : 128;
+  uint32_t *tbl = calloc(cap, sizeof(uint32_t));
+  if (!tbl) return walk_err(E_MEM, "out of memory");
+  const int32_t *offs = (const int32_t *)sink->off->buf;
+  const int32_t *lens = (const int32_t *)sink->len->buf;
+  size_t total = sink->len->len / 4;
+  for (size_t k = sink->group_first; k < total; k++) {
+    const uint8_t *d = sink->pool->buf + offs[k];
+    size_t i = cmap_hash(d, lens[k]) & (cap - 1);
+    while (tbl[i]) i = (i + 1) & (cap - 1);
+    tbl[i] = (uint32_t)(k + 1);
+  }
+  free(sink->seen);
+  sink->seen = tbl;
+  sink->seen_cap = cap;
+  return 0;
+}
 
 static int msg_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   (void)index;
@@ -1307,12 +1333,29 @@ static int msg_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
     walk_err(E_VALUE, "message list AMT must hold CIDs");
     return -1;
   }
+  /* first-seen dedup: probe the group's seen set; duplicates emit nothing */
+  if (sink->seen_n * 2 >= sink->seen_cap && sink_seen_grow(sink) < 0)
+    return -1;
+  const int32_t *offs = (const int32_t *)sink->off->buf;
+  const int32_t *lens = (const int32_t *)sink->len->buf;
+  size_t mask = sink->seen_cap - 1;
+  size_t i = cmap_hash(cid, clen) & mask;
+  while (sink->seen[i]) {
+    size_t k = sink->seen[i] - 1;
+    if (lens[k] == (int32_t)clen &&
+        memcmp(sink->pool->buf + offs[k], cid, (size_t)clen) == 0)
+      return 0; /* duplicate: first occurrence wins */
+    i = (i + 1) & mask;
+  }
   if (pool_off_ok(sink->pool->len, INT32_MAX) < 0) return -1;
   int32_t off = (int32_t)sink->pool->len;
   int32_t len = (int32_t)clen;
   if (vec_push(sink->off, &off, 4) < 0) return -1;
   if (vec_push(sink->len, &len, 4) < 0) return -1;
-  return vec_push(sink->pool, cid, (size_t)clen);
+  if (vec_push(sink->pool, cid, (size_t)clen) < 0) return -1;
+  sink->seen[i] = (uint32_t)(sink->len->len / 4); /* new index + 1 */
+  sink->seen_n++;
+  return 0;
 }
 
 /* canonical re-encoding of TxMeta [bls, secp]: 0x82 ++ tag42(cid) x2 */
@@ -1376,6 +1419,10 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
 
   int rc = -1;
   for (Py_ssize_t g = 0; g < n_groups; g++) {
+    /* fresh first-seen set per group */
+    sink.seen_n = 0;
+    sink.group_first = msg_off.len / 4;
+    if (sink.seen) memset(sink.seen, 0, sink.seen_cap * sizeof(uint32_t));
     /* group starts (for truncation on per-group failure) */
     size_t m_pool0 = msg_pool.len, m_off0 = msg_off.len, m_len0 = msg_len.len;
     size_t t_pool0 = touch_pool.len, t_off0 = touch_off.len, t_len0 = touch_len.len;
@@ -1513,6 +1560,7 @@ out:;
         "failed", make_array_bytes(&failed));
   }
   Py_DECREF(gseq);
+  free(sink.seen);
   vec_free(&msg_pool); vec_free(&msg_off); vec_free(&msg_len); vec_free(&msg_goff);
   vec_free(&touch_pool); vec_free(&touch_off); vec_free(&touch_len);
   vec_free(&touch_goff);
@@ -1772,7 +1820,7 @@ static PyMethodDef methods[] = {
      (PyCFunction)(void (*)(void))py_collect_exec_orders,
      METH_VARARGS | METH_KEYWORDS,
      "collect_exec_orders(blocks_dict, groups, fallback=None, headers=True) ->"
-     " per-group message-CID lists (execution order, pre-dedup), touched block"
+     " per-group message-CID lists (execution order, first-seen deduped), touched block"
      " CIDs, TxMeta CIDs + canonical flags, and failed flags."},
     {"record_receipt_paths",
      (PyCFunction)(void (*)(void))py_record_receipt_paths,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import math
 import struct
+from contextlib import contextmanager as _contextmanager
 from typing import Any
 
 from ipc_proofs_tpu.core.cid import CID
@@ -220,6 +221,22 @@ def decode(data: bytes) -> Any:
     if native is not None:
         return native.decode(bytes(data))
     return decode_py(data)
+
+
+@_contextmanager
+def force_python_decoder():
+    """Context manager routing :func:`decode` through the pure-Python
+    decoder for its duration. Benchmarks measuring the scalar reference
+    architecture use this so "per-event Python decode" means what it says —
+    otherwise the C extension silently accelerates the baseline and the
+    reported speedup tracks the extension's build flags, not the design."""
+    global _native
+    saved = _native
+    _native = None
+    try:
+        yield
+    finally:
+        _native = saved
 
 
 def decode_prefix(data: bytes) -> tuple[Any, int]:

@@ -42,7 +42,9 @@ class ProofBlock:
         ``object.__setattr__`` per field, which adds up across the thousands
         of blocks a range witness materializes."""
         out = object.__new__(cls)
-        out.__dict__.update(cid=cid, data=data)
+        d = out.__dict__
+        d["cid"] = cid
+        d["data"] = data
         return out
 
     def to_json_obj(self) -> dict:

@@ -209,28 +209,40 @@ def _verify_proofs_batch(
     msg_strs: list[str] = []
     for gi, (survivors, parent_cids, child_header) in enumerate(step3):
         if batch_exec is not None:
-            exec_pos = batch_exec[gi]
+            exec_list = batch_exec[gi]
         else:
             try:
                 exec_order = reconstruct_execution_order(store, parent_cids)
-                exec_pos = {c.to_bytes(): i for i, c in enumerate(exec_order)}
+                exec_list = [c.to_bytes() for c in exec_order]
             except (KeyError, ValueError):
-                exec_pos = None
-        group_exec.append(exec_pos)
+                exec_list = None
+        group_exec.append(exec_list)
         msg_spans.append((len(msg_strs), len(survivors)))
-        if exec_pos is not None:
+        if exec_list is not None:
             msg_strs.extend(proofs[k].message_cid for k in survivors)
     msg_cids = cids_from_strings(msg_strs)
 
     for gi, (survivors, parent_cids, child_header) in enumerate(step3):
-        exec_pos = group_exec[gi]
-        if exec_pos is None:
+        exec_list = group_exec[gi]
+        if exec_list is None:
             continue
         msg_base = msg_spans[gi][0]
         for j, k in enumerate(survivors):
             proof = proofs[k]
-            position = exec_pos.get(msg_cids[msg_base + j].to_bytes())
-            if position is None or position != proof.exec_index:
+            # exec_list entries are unique (first-seen deduped), so "the
+            # claimed message sits at the claimed index" is one indexing.
+            # Non-int indices (float 3.0 from a JSON bundle) are rejected
+            # up front in BOTH paths — serde parity: the reference's u64
+            # claim fields reject non-integers at deserialization
+            # (`events/bundle.rs:14-23`) — so claims that could never
+            # deserialize there verify False here, identically.
+            ei = proof.exec_index
+            if (
+                not _claim_index_ok(ei)
+                or not _claim_index_ok(proof.event_index)
+                or not 0 <= ei < len(exec_list)
+                or exec_list[ei] != msg_cids[msg_base + j].to_bytes()
+            ):
                 continue
             root = child_header.parent_message_receipts
             pos = root_pos.setdefault(root, len(pending_roots))
@@ -310,6 +322,14 @@ def _verify_proofs_batch(
     return results
 
 
+def _claim_index_ok(v) -> bool:
+    """Claim indices must be ints — serde parity: the reference's u64
+    fields (`events/bundle.rs:14-23`) reject non-integers at
+    deserialization, so a float/str index could never reach its verifier.
+    Both verify paths reject them identically (False, not a raise)."""
+    return isinstance(v, int)
+
+
 def _row_matches_claim(scan, row: int, stored: EventData) -> bool:
     """Pooled-bytes equivalent of `_event_data_matches`, using the SAME
     string comparison as the scalar path (``("0x" + actual.hex()).lower() ==
@@ -359,6 +379,11 @@ def _verify_single_proof(
     if parent_raw is None:
         raise KeyError("missing parent header in witness")
     if BlockHeader.decode(parent_raw).height != proof.parent_epoch:
+        return False
+
+    # Non-int claim indices reject before any walk (serde parity — see
+    # `_claim_index_ok`; an AMT walk on a float would raise, not verify).
+    if not _claim_index_ok(proof.exec_index) or not _claim_index_ok(proof.event_index):
         return False
 
     # Step 3: execution order (with TxMeta CID recompute), memoized per

@@ -111,7 +111,9 @@ class _GroupView:
     __slots__ = ("msgs", "touched", "txmetas", "canon", "failed")
 
     def __init__(self, msgs, touched, txmetas, canon, failed):
-        self.msgs = msgs  # list[bytes] — message CIDs, pre-dedup, in order
+        # list[bytes] — message CIDs in execution order, first-seen deduped
+        # IN the C walker (scalar parity: events/utils.rs:76-90)
+        self.msgs = msgs
         self.touched = touched  # list[bytes] — fetched block CIDs
         self.txmetas = txmetas  # list[bytes] — TxMeta CIDs
         self.canon = canon  # list[bool] — raw block == canonical encoding
@@ -150,23 +152,17 @@ def _unpack_groups(out: dict, n_groups: int) -> list[_GroupView]:
     ]
 
 
-def _first_seen_positions(msg_bytes: list[bytes]) -> dict[bytes, int]:
-    pos: dict[bytes, int] = {}
-    for b in msg_bytes:
-        if b not in pos:
-            pos[b] = len(pos)
-    return pos
-
-
 def reconstruct_execution_orders_batch(
     store: Blockstore,
     groups: list[list[CID]],
     header_cache: "Optional[dict[CID, BlockHeader]]" = None,
-) -> "Optional[list[Optional[dict[bytes, int]]]]":
+) -> "Optional[list[Optional[list[bytes]]]]":
     """Batched `reconstruct_execution_order` over many parent-header groups
     via the native walker: ONE C call walks every group's TxMeta/message
-    AMTs. Returns per group a first-seen position map keyed by message-CID
-    BYTES (no per-CID Python objects), or None for a group whose
+    AMTs. Returns per group the execution order as a first-seen-deduped
+    list of message-CID BYTES (deduped in C; entries are unique, so
+    "claimed message at claimed index" is one list indexing — no per-CID
+    Python objects, no per-group dict), or None for a group whose
     reconstruction fails — exactly the caught-KeyError/ValueError degradation
     of the scalar path. Returns None overall when the extension is absent
     (callers use the scalar path).
@@ -193,7 +189,7 @@ def reconstruct_execution_orders_batch(
     views = _unpack_groups(out, len(groups))
 
     _CHAIN_PREFIX = b"\x01\x71\xa0\xe4\x02\x20"  # CIDv1 dag-cbor blake2b-256
-    results: list[Optional[dict[bytes, int]]] = []
+    results: list[Optional[list[bytes]]] = []
     for g, view in enumerate(views):
         if view.failed:
             results.append(None)
@@ -235,11 +231,11 @@ def reconstruct_execution_orders_batch(
         if scalar_fallback:
             try:
                 order = reconstruct_execution_order(store, groups[g])
-                results.append({c.to_bytes(): i for i, c in enumerate(order)})
+                results.append([c.to_bytes() for c in order])
             except (KeyError, ValueError):
                 results.append(None)
             continue
-        results.append(_first_seen_positions(view.msgs) if ok else None)
+        results.append(view.msgs if ok else None)
     return results
 
 
@@ -265,5 +261,5 @@ def collect_exec_orders_for_pairs(
         if view.failed:
             results.append(None)
             continue
-        results.append((list(_first_seen_positions(view.msgs)), view.touched))
+        results.append((view.msgs, view.touched))
     return results

@@ -62,6 +62,11 @@ def load_witness_store(blocks: Iterable[ProofBlock], verify_cids: bool = False):
     from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
 
     store = MemoryBlockstore(verify_cids=verify_cids)
+    if not verify_cids:
+        # bulk path: one call, no per-block method dispatch (a range
+        # witness is thousands of blocks)
+        store.put_many_trusted(blocks)
+        return store
     for block in blocks:
         store.put_keyed(block.cid, block.data)
     return store

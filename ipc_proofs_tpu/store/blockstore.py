@@ -91,6 +91,17 @@ class MemoryBlockstore:
     def items(self) -> Iterable[tuple[CID, bytes]]:
         return self._blocks.items()
 
+    def put_many_trusted(self, blocks: "Iterable") -> None:
+        """Bulk load of ``ProofBlock``-shaped items (``.cid``/``.data``)
+        WITHOUT per-block CID verification — the witness loader's fast path
+        when verification happens elsewhere (or is explicitly skipped).
+        Keeps both internal maps in sync in the one place that owns them."""
+        cid_map, raw_map = self._blocks, self._raw
+        for block in blocks:
+            data = bytes(block.data)
+            cid_map[block.cid] = data
+            raw_map[block.cid.to_bytes()] = data
+
     def raw_map(self) -> dict[bytes, bytes]:
         """Live view keyed by raw CID bytes — the native scanner's fast path
         (C-side dict lookups, no CID object construction per block)."""

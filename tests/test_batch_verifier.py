@@ -228,3 +228,47 @@ class TestBatchScalarEquivalence:
             assert scalar_raised == batch_raised
             if scalar_raised is None:
                 assert scalar == batch
+
+
+def test_non_int_claim_indices_rejected_identically():
+    """Non-int exec_index / event_index (float 3.0 via json.loads, nan,
+    strings) must verify False in BOTH paths — serde parity with the
+    reference's u64 claim fields, which reject them at deserialization —
+    and never raise (the AMT walk on a float would TypeError)."""
+    from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+    from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+    bs = MemoryBlockstore()
+    world = build_chain(
+        [ContractFixture(actor_id=77)],
+        [[EventFixture(emitter=77, signature="Evt(bytes32)", topic1="s")]],
+        store=bs,
+    )
+    bundle = generate_event_proof(
+        bs, world.parent, world.child, "Evt(bytes32)", "s", actor_id_filter=77
+    )
+    ok = lambda *a: True
+
+    for field in ("exec_index", "event_index"):
+        good = getattr(bundle.proofs[0], field)
+        for forged, expect in [
+            (good, True),
+            (float(good), False),  # would never deserialize into a u64
+            (float(good) + 0.5, False),
+            (float("nan"), False),
+            (float("inf"), False),
+            (str(good), False),
+            (good + 10_000, False),  # out of range, still int
+        ]:
+            setattr(bundle.proofs[0], field, forged)
+            got_batch = verify_event_proof(
+                EventProofBundle(proofs=bundle.proofs, blocks=bundle.blocks), ok, ok
+            )
+            got_scalar = verify_event_proof(
+                EventProofBundle(proofs=bundle.proofs, blocks=bundle.blocks), ok, ok,
+                batch=False,
+            )
+            assert got_batch == got_scalar == [expect], (
+                field, forged, got_batch, got_scalar,
+            )
+        setattr(bundle.proofs[0], field, good)
