@@ -190,6 +190,8 @@ def reconstruct_execution_orders_batch(
 
     _CHAIN_PREFIX = b"\x01\x71\xa0\xe4\x02\x20"  # CIDv1 dag-cbor blake2b-256
     results: list[Optional[list[bytes]]] = []
+    recompute_group: list[int] = []  # deferred TxMeta CID recomputes
+    recompute_cids: list[bytes] = []
     for g, view in enumerate(views):
         if view.failed:
             results.append(None)
@@ -216,17 +218,20 @@ def reconstruct_execution_orders_batch(
             ok = False
         scalar_fallback = False
         if ok:
+            mark = len(recompute_cids)
             for cid_b, canon in zip(view.txmetas, view.canon):
                 if canon and cid_b[:6] == _CHAIN_PREFIX:
-                    raw_block = store.get(CID.from_bytes(cid_b))
-                    if (
-                        raw_block is None
-                        or hashlib.blake2b(raw_block, digest_size=32).digest() != cid_b[6:]
-                    ):
-                        ok = False
-                        break
+                    # recompute deferred: collected range-wide below and
+                    # verified in ONE C++ blake2b batch (localized scalar
+                    # only if the batch reports any mismatch)
+                    recompute_group.append(g)
+                    recompute_cids.append(cid_b)
                 else:
                     scalar_fallback = True
+                    # the scalar redo settles this whole group — drop its
+                    # deferred entries so the batch only carries live work
+                    del recompute_group[mark:]
+                    del recompute_cids[mark:]
                     break
         if scalar_fallback:
             try:
@@ -236,6 +241,41 @@ def reconstruct_execution_orders_batch(
                 results.append(None)
             continue
         results.append(view.msgs if ok else None)
+
+    # TxMeta CID recompute, batched: one C++ blake2b pass over every
+    # canonical TxMeta in the range (the scalar path recomputes per proof).
+    # A clean batch (the overwhelmingly common case) settles all groups in
+    # one call; any mismatch localizes scalar so per-group failure
+    # semantics stay exactly the scalar path's.
+    if recompute_cids:
+        raw_map = store.raw_map() if hasattr(store, "raw_map") else None
+        raws = []
+        for cid_b in recompute_cids:
+            raw_block = (
+                raw_map.get(cid_b)
+                if raw_map is not None
+                else store.get(CID.from_bytes(cid_b))
+            )
+            raws.append(raw_block)
+        all_present = all(r is not None for r in raws)
+        batch_clean = False
+        if all_present:
+            from ipc_proofs_tpu.backend.native import load_native
+
+            native = load_native()  # memoized by the loader itself
+            if native is not None:
+                batch_clean = native.verify_blake2b_batch(
+                    [c[6:] for c in recompute_cids], raws
+                )
+        if not batch_clean:
+            for g, cid_b, raw_block in zip(recompute_group, recompute_cids, raws):
+                if results[g] is None:
+                    continue
+                if (
+                    raw_block is None
+                    or hashlib.blake2b(raw_block, digest_size=32).digest() != cid_b[6:]
+                ):
+                    results[g] = None
     return results
 
 

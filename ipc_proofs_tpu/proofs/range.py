@@ -670,8 +670,26 @@ def _record_pass2_native(
     else:
         strs = [str(CID.from_bytes(b)) for b in str_bytes]
 
+    # gather every claim's columns in one numpy fancy-index per column —
+    # per-claim np-scalar int() conversions were the loop's hottest ops
+    if claim_rows:
+        rows_arr = np.fromiter(
+            (row for _, row in claim_rows), dtype=np.int64, count=len(claim_rows)
+        )
+        exec_idx_l = sb.exec_idx[rows_arr].tolist()
+        event_idx_l = sb.event_idx[rows_arr].tolist()
+        emitters_l = sb.emitters[rows_arr].tolist()
+        n_topics_l = sb.n_topics[rows_arr].tolist()
+        toff_l = sb.topics_off[rows_arr].tolist()
+        doff_l = sb.data_off[rows_arr].tolist()
+        dlen_l = sb.data_len[rows_arr].tolist()
+    topics_pool = sb.topics_pool
+    data_pool = sb.data_pool
+    make_proof = EventProof._make
+    make_data = EventData._make
+
     pos = 0
-    for g, row in claim_rows:
+    for j, (g, row) in enumerate(claim_rows):
         pair = matching_pairs[g][0]
         base = group_str_base[g]
         n_parents = len(pair.parent.cids)
@@ -679,25 +697,25 @@ def _record_pass2_native(
         # message-cid slots laid out after the group's parents+child block
         if pos < base + n_parents + 1:
             pos = base + n_parents + 1
-        exec_index = int(sb.exec_idx[row])
-        topics_bytes = sb.event_topics(row)
-        n_topics = int(sb.n_topics[row])
+        nt = n_topics_l[j]
+        toff = toff_l[j]
+        doff = doff_l[j]
         per_group_proofs[g].append(
-            EventProof(
+            make_proof(
                 parent_epoch=pair.parent.height,
                 child_epoch=pair.child.height,
                 parent_tipset_cids=strs[base : base + n_parents],
                 child_block_cid=strs[base + n_parents],
                 message_cid=strs[pos],
-                exec_index=exec_index,
-                event_index=int(sb.event_idx[row]),
-                event_data=EventData(
-                    emitter=int(sb.emitters[row]),
+                exec_index=exec_idx_l[j],
+                event_index=event_idx_l[j],
+                event_data=make_data(
+                    emitter=emitters_l[j],
                     topics=[
-                        "0x" + topics_bytes[32 * k : 32 * (k + 1)].hex()
-                        for k in range(n_topics)
+                        "0x" + topics_pool[toff + 32 * k : toff + 32 * (k + 1)].hex()
+                        for k in range(nt)
                     ],
-                    data="0x" + sb.event_data(row).hex(),
+                    data="0x" + data_pool[doff : doff + dlen_l[j]].hex(),
                 ),
             )
         )

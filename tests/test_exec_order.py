@@ -258,3 +258,42 @@ class TestNativeExecOrderBatch:
             _pytest.skip("native extension unavailable")
         assert batch[0] is None  # scalar ValueError → caught → None
         assert batch[1] is not None
+
+
+class TestBatchedTxmetaRecompute:
+    def test_corrupt_txmeta_localizes_to_its_group(self):
+        """A corrupted TxMeta block (bytes don't hash to the header's CID)
+        must fail ONLY its group — the range-wide blake2b batch reports
+        unclean and the scalar localization nulls exactly that group."""
+        from ipc_proofs_tpu.proofs.exec_order import (
+            reconstruct_execution_order,
+            reconstruct_execution_orders_batch,
+        )
+
+        bs = MemoryBlockstore()
+        h1, hdr1 = _header(bs, [_msg(21)], [_msg(22)])
+        h2, _hdr2 = _header(bs, [_msg(23)], [])
+        tx1 = hdr1.messages
+        groups = [[h1], [h2]]
+        clean = reconstruct_execution_orders_batch(bs, groups)
+        if clean is None:
+            pytest.skip("native extension unavailable")
+        assert clean[0] is not None and clean[1] is not None
+
+        # corrupt group 0's TxMeta bytes in place (same CID key)
+        raw = bs.get(tx1)
+        import ipc_proofs_tpu.core.dagcbor as dagcbor
+        from ipc_proofs_tpu.core.cid import CID
+
+        bls, secp = dagcbor.decode(raw)
+        forged = dagcbor.encode([secp, bls])  # valid shape, wrong bytes
+        bs.raw_map()[tx1.to_bytes()] = forged
+        bs._blocks[tx1] = forged
+
+        batch = reconstruct_execution_orders_batch(bs, groups)
+        assert batch[0] is None  # corrupted group fails
+        assert batch[1] is not None  # untouched group still verifies
+        # scalar parity: the scalar reconstruction rejects the same group
+        with pytest.raises(ValueError):
+            reconstruct_execution_order(bs, [h1])
+        assert [c.to_bytes() for c in reconstruct_execution_order(bs, [h2])] == batch[1]

@@ -487,3 +487,89 @@ class TestFusedMatchHits:
         unfused = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
         assert fused.to_json() == unfused.to_json()
         assert len(fused.event_proofs) > 0
+
+
+class TestFusedMatchRandomizedDifferential:
+    """Seeded random worlds — varied encodings, topic counts, emitters,
+    multi-block parents, failed messages — where the fused C scan+match,
+    the unfused scan→mask pipeline, and the full generate→verify round
+    trip must all agree exactly."""
+
+    SIG = "Rand(bytes32,uint256)"
+    TOPIC = "rand-subnet"
+
+    def _random_world(self, rng, bs):
+        sigs = [self.SIG, "Other(bytes32)", "Noise()"]
+        topics = [self.TOPIC, "other", "x"]
+        n_msgs = rng.integers(1, 9)
+        events = []
+        for _ in range(n_msgs):
+            row = []
+            for _ in range(int(rng.integers(0, 5))):
+                row.append(
+                    EventFixture(
+                        emitter=int(rng.choice([ACTOR, 7, 99])),
+                        signature=str(rng.choice(sigs)),
+                        topic1=str(rng.choice(topics)),
+                        extra_topics=[bytes([int(rng.integers(0, 256))]) * 32]
+                        * int(rng.integers(0, 3)),
+                        data=bytes(rng.integers(0, 256, size=int(rng.integers(0, 80)), dtype="uint8")),
+                        encoding=str(rng.choice(["compact", "concat"])),
+                    )
+                )
+            events.append(row)
+        failed = set()
+        for m in range(n_msgs):
+            if rng.random() < 0.15:
+                failed.add(m)
+        return build_chain(
+            [ContractFixture(actor_id=ACTOR)],
+            events,
+            parent_height=int(rng.integers(10, 10_000)),
+            n_parent_blocks=int(rng.integers(1, 4)),
+            store=bs,
+            failed_message_indices=failed or None,
+        )
+
+    def test_fused_matches_mask_and_round_trips(self):
+        if not native_scan_available():
+            pytest.skip("native scan unavailable")
+        from ipc_proofs_tpu.backend import get_backend
+        from ipc_proofs_tpu.proofs.event_generator import generate_event_proof
+        from ipc_proofs_tpu.proofs.event_verifier import verify_event_proof
+        from ipc_proofs_tpu.proofs.scan_native import scan_match_hits, topic_fingerprint
+        from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+
+        rng = np.random.default_rng(20260730)
+        t0 = hash_event_signature(self.SIG)
+        t1 = ascii_to_bytes32(self.TOPIC)
+        backend = get_backend("cpu")
+        n_bundles = 0
+        for trial in range(25):
+            bs = MemoryBlockstore()
+            world = self._random_world(rng, bs)
+            roots = [world.child.blocks[0].parent_message_receipts]
+            actor = ACTOR if rng.random() < 0.5 else None
+            n_events, hp, he = scan_match_hits(bs, roots, t0, t1, actor)
+            batch = scan_events_flat(bs, roots)
+            assert n_events == batch.n_events, trial
+            mask = batch.valid & (batch.n_topics >= 2)
+            mask &= batch.fp == np.uint64(topic_fingerprint(t0, t1))
+            if actor is not None:
+                mask &= batch.emitters == np.uint64(actor)
+            sel = np.nonzero(mask)[0]
+            assert list(zip(hp.tolist(), he.tolist())) == list(
+                zip(batch.pair_ids[sel].tolist(), batch.exec_idx[sel].tolist())
+            ), trial
+            # full round trip: generate (uses the fused path via the range
+            # driver machinery or scalar here) and verify on both paths
+            bundle = generate_event_proof(
+                bs, world.parent, world.child, self.SIG, self.TOPIC,
+                actor_id_filter=actor, match_backend=backend,
+            )
+            ok = lambda *a: True
+            scalar = verify_event_proof(bundle, ok, ok, batch=False)
+            fast = verify_event_proof(bundle, ok, ok, batch=True)
+            assert scalar == fast == [True] * len(bundle.proofs), trial
+            n_bundles += len(bundle.proofs)
+        assert n_bundles > 0  # the sweep actually exercised matches
