@@ -101,16 +101,19 @@ def config3_storage_slots(quick: bool):
     n_contracts = 32 if quick else 256
     slots_per_contract = n_slots // n_contracts
 
-    # device/batch leg: derive all mapping slots (keccak over 64-byte preimages)
+    # batch leg: derive all mapping slots (keccak over 64-byte preimages).
+    # The backend picks the path — below IPC_TPU_KECCAK_MIN_BYTES the C++
+    # host batch wins the dispatch+transfer economics; the device-kernel
+    # slope line below reports the chip's own rate either way.
     backend = get_backend("tpu")
     preimages = [
         ascii_to_bytes32(f"subnet-{c}") + int(i).to_bytes(32, "big")
         for c in range(n_contracts)
         for i in range(slots_per_contract)
     ]
-    backend.keccak256_batch(preimages)  # discard: compile + first transfer
+    backend.keccak256_batch(preimages)  # discard: compile/warm either path
     start = time.perf_counter()
-    slot_keys = backend.keccak256_batch(preimages)  # warmed E2E (host pack + transfer + kernel)
+    slot_keys = backend.keccak256_batch(preimages)  # warmed, backend-chosen path
     t_hash_e2e = time.perf_counter() - start
 
     # device kernel rate, slope-timed (tunnel RTT cancelled)
@@ -129,26 +132,37 @@ def config3_storage_slots(quick: bool):
     pt = measure_pass_seconds(one_pass, (kb_j, kc_j), k_small=3, k_large=43)
     t_hash = pt.seconds
 
-    # host leg: build one storage HAMT per contract, then look up every slot
+    # host leg: build one storage HAMT per contract (shared store), then
+    # look up every slot — ONE batched C walk over all (root, key) pairs
+    # (`hamt_get_batch`), scalar loop when the extension is absent
     build_start = time.perf_counter()
-    stores, roots = [], []
+    bs = MemoryBlockstore()
+    roots = []
     for c in range(n_contracts):
-        bs = MemoryBlockstore()
         entries = {
             slot_keys[c * slots_per_contract + i]: (i % 251).to_bytes(2, "big")
             for i in range(slots_per_contract)
         }
         roots.append(hamt_build(bs, entries))
-        stores.append(bs)
     t_build = time.perf_counter() - build_start
 
+    from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+
+    owners = [c for c in range(n_contracts) for _ in range(slots_per_contract)]
+    hamt_get_batch(bs, roots, owners[:8], slot_keys[:8])  # warm/load the ext
     start = time.perf_counter()
-    hits = 0
-    for c in range(n_contracts):
-        hamt = HAMT.load(stores[c], roots[c])
-        for i in range(slots_per_contract):
-            if hamt.get(slot_keys[c * slots_per_contract + i]) is not None:
-                hits += 1
+    values = hamt_get_batch(bs, roots, owners, slot_keys)
+    if values is not None:
+        hits = sum(v is not None for v in values)
+        lookup_path = "batched-C"
+    else:
+        hits = 0
+        for c in range(n_contracts):
+            hamt = HAMT.load(bs, roots[c])
+            for i in range(slots_per_contract):
+                if hamt.get(slot_keys[c * slots_per_contract + i]) is not None:
+                    hits += 1
+        lookup_path = "scalar"
     t_lookup = time.perf_counter() - start
     assert hits == n_slots
 
@@ -166,8 +180,8 @@ def config3_storage_slots(quick: bool):
     rate = n_slots / (t_hash_e2e + t_lookup)
     _log(
         f"config3: {n_slots} slots / {n_contracts} roots — device hash {t_hash*1e3:.2f}ms "
-        f"(warmed e2e incl. transfer {t_hash_e2e:.2f}s), build {t_build:.1f}s, "
-        f"lookup {t_lookup:.2f}s"
+        f"(warmed backend-chosen hash leg {t_hash_e2e:.2f}s), build {t_build:.1f}s, "
+        f"lookup {t_lookup:.2f}s ({lookup_path})"
     )
     _emit("storage_slot_lookups_per_sec", rate, "slots/s",
           vs_baseline=round(e2e_rate / scalar_rate, 2),

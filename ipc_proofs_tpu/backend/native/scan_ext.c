@@ -1757,6 +1757,349 @@ out:;
  * walker in this module emits). Materializes every pooled item as a bytes
  * object in one C call — the Python-level per-item slicing loop this
  * replaces was the dominant cost of unpacking large walks. */
+/* ---------------- batched HAMT slot lookup ----------------
+ *
+ * The storage-side analog of the receipts scanner: one C call walks a
+ * root→bucket HAMT path per (root, key) pair — the BASELINE config-3
+ * shape (65k slots × 256 contract roots) and the range driver's
+ * storage legs. Wire format per ipld/hamt.py: node = [bitfield(bytes),
+ * [pointer, ...]]; pointer = tag-42 link | inline bucket [[k, v], ...];
+ * key hash = sha256(key), bits consumed MSB-first, bit_width at a time.
+ */
+
+static const uint32_t sha_k[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+#define ROR32(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void sha256_compress(uint32_t h[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((uint32_t)block[4 * i] << 24) | ((uint32_t)block[4 * i + 1] << 16) |
+           ((uint32_t)block[4 * i + 2] << 8) | block[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = ROR32(w[i - 15], 7) ^ ROR32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = ROR32(w[i - 2], 17) ^ ROR32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+           g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t s1 = ROR32(e, 6) ^ ROR32(e, 11) ^ ROR32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + s1 + ch + sha_k[i] + w[i];
+    uint32_t s0 = ROR32(a, 2) ^ ROR32(a, 13) ^ ROR32(a, 22);
+    uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + mj;
+    hh = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static void sha256_digest(const uint8_t *data, Py_ssize_t len, uint8_t out[32]) {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  Py_ssize_t off = 0;
+  for (; off + 64 <= len; off += 64) sha256_compress(h, data + off);
+  uint8_t block[64];
+  Py_ssize_t rem = len - off;
+  memcpy(block, data + off, (size_t)rem);
+  block[rem++] = 0x80;
+  if (rem > 56) {
+    memset(block + rem, 0, (size_t)(64 - rem));
+    sha256_compress(h, block);
+    rem = 0;
+  }
+  memset(block + rem, 0, (size_t)(56 - rem));
+  uint64_t bits = (uint64_t)len * 8;
+  for (int i = 0; i < 8; i++) block[56 + i] = (uint8_t)(bits >> (56 - 8 * i));
+  sha256_compress(h, block);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (uint8_t)(h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)h[i];
+  }
+}
+
+/* bw bits of hash32 starting at bit position bw*depth, MSB-first */
+static int hamt_hash_bits(const uint8_t hash[32], int depth, int bw,
+                          uint32_t *out) {
+  int start = bw * depth;
+  if (start + bw > 256)
+    return walk_err(E_VALUE, "HAMT max depth exceeded (hash bits exhausted)");
+  uint32_t v = 0;
+  for (int b = 0; b < bw; b++) {
+    int bit = start + b;
+    v = (v << 1) | (uint32_t)((hash[bit >> 3] >> (7 - (bit & 7))) & 1);
+  }
+  *out = v;
+  return 0;
+}
+
+/* bit `i` (LSB order) of the big-endian minimal bitfield bytes */
+static int bitfield_bit(const uint8_t *bf, Py_ssize_t bflen, uint32_t i) {
+  Py_ssize_t byte = (Py_ssize_t)(i >> 3);
+  if (byte >= bflen) return 0;
+  return (bf[bflen - 1 - byte] >> (i & 7)) & 1;
+}
+
+/* walk one root→bucket path; on a hit pushes the VALUE's raw CBOR span
+ * into val_pool (copied out before the node block is released). Returns
+ * -1 error, 0 done (found flag set). */
+static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
+                        const uint8_t *key, Py_ssize_t klen, int bw,
+                        Vec *val_pool, int32_t *voff, int32_t *vlen,
+                        uint8_t *found) {
+  uint8_t hash[32];
+  sha256_digest(key, klen, hash);
+  uint8_t cid_buf[72];
+  const uint8_t *cid = root;
+  Py_ssize_t clen = rlen;
+  int depth = 0;
+  *found = 0;
+  *voff = 0;
+  *vlen = 0;
+  for (;;) {
+    BlockRef node = {0};
+    int st = get_block(s, cid, clen, &node);
+    if (st < 0) return -1;
+    if (st == 0) return 0; /* pruned under skip_missing */
+    Parser p = {node.data, node.len, 0};
+    uint64_t parts;
+    if (rd_array(&p, &parts) < 0 || parts != 2) {
+      block_release(&node);
+      return walk_err(E_VALUE, "malformed HAMT node");
+    }
+    const uint8_t *bf;
+    Py_ssize_t bflen;
+    if (rd_bytes(&p, &bf, &bflen) < 0) {
+      block_release(&node);
+      return walk_err(E_VALUE, "malformed HAMT node");
+    }
+    uint32_t idx;
+    if (hamt_hash_bits(hash, depth, bw, &idx) < 0) {
+      block_release(&node);
+      return -1;
+    }
+    if (!bitfield_bit(bf, bflen, idx)) {
+      block_release(&node);
+      return 0; /* absent */
+    }
+    uint32_t pos = 0;
+    for (uint32_t j = 0; j < idx; j++) pos += (uint32_t)bitfield_bit(bf, bflen, j);
+    uint64_t n_ptrs;
+    if (rd_array(&p, &n_ptrs) < 0 || pos >= n_ptrs) {
+      block_release(&node);
+      return walk_err(E_VALUE, "malformed HAMT node");
+    }
+    for (uint32_t j = 0; j < pos; j++)
+      if (skip_item(&p) < 0) {
+        block_release(&node);
+        return -1;
+      }
+    /* the selected pointer: link or bucket */
+    const uint8_t *child;
+    Py_ssize_t child_len;
+    int is_cid;
+    Parser peek = p;
+    int pm;
+    uint64_t pv;
+    if (rd_head(&peek, &pm, &pv) < 0) {
+      block_release(&node);
+      return -1;
+    }
+    if (pm == 6) { /* tag (42) — a link */
+      Parser q = p;
+      if (rd_cid_or_null(&q, &child, &child_len, &is_cid) < 0 || !is_cid) {
+        block_release(&node);
+        return walk_err(E_VALUE, "malformed HAMT pointer");
+      }
+      if ((size_t)child_len > sizeof(cid_buf)) {
+        block_release(&node);
+        return walk_err(E_VALUE, "malformed HAMT pointer");
+      }
+      memcpy(cid_buf, child, (size_t)child_len);
+      block_release(&node);
+      cid = cid_buf;
+      clen = child_len;
+      depth++;
+      continue;
+    }
+    if (pm != 4) {
+      block_release(&node);
+      return walk_err(E_VALUE, "malformed HAMT pointer");
+    }
+    /* bucket: [[key, value], ...] */
+    uint64_t n_kv;
+    if (rd_array(&p, &n_kv) < 0) {
+      block_release(&node);
+      return -1;
+    }
+    for (uint64_t k = 0; k < n_kv; k++) {
+      uint64_t kv_fields;
+      if (rd_array(&p, &kv_fields) < 0 || kv_fields < 2) {
+        block_release(&node);
+        return walk_err(E_VALUE, "malformed HAMT bucket");
+      }
+      /* key item: bytes compare when bytes, else skip (no match) */
+      Parser kp = p;
+      int km;
+      uint64_t kv_len;
+      int match = 0;
+      if (rd_head(&kp, &km, &kv_len) < 0) {
+        block_release(&node);
+        return -1;
+      }
+      if (km == 2) {
+        const uint8_t *kptr;
+        Py_ssize_t kblen;
+        if (rd_bytes(&p, &kptr, &kblen) < 0) {
+          block_release(&node);
+          return -1;
+        }
+        match = (kblen == klen && memcmp(kptr, key, (size_t)klen) == 0);
+      } else {
+        if (skip_item(&p) < 0) {
+          block_release(&node);
+          return -1;
+        }
+      }
+      /* value item: span */
+      Py_ssize_t vstart = p.pos;
+      if (skip_item(&p) < 0) {
+        block_release(&node);
+        return -1;
+      }
+      if (match) {
+        if (pool_off_ok(val_pool->len, INT32_MAX) < 0) {
+          block_release(&node);
+          return -1;
+        }
+        *voff = (int32_t)val_pool->len;
+        *vlen = (int32_t)(p.pos - vstart);
+        if (vec_push(val_pool, node.data + vstart, (size_t)(p.pos - vstart)) < 0) {
+          block_release(&node);
+          return -1;
+        }
+        *found = 1;
+        block_release(&node);
+        return 0;
+      }
+      for (uint64_t f = 2; f < kv_fields; f++)
+        if (skip_item(&p) < 0) {
+          block_release(&node);
+          return -1;
+        }
+    }
+    block_release(&node);
+    return 0; /* bucket exhausted: absent */
+  }
+}
+
+static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
+                                      PyObject *kwargs) {
+  PyObject *blocks, *roots, *owners, *keys, *fallback = Py_None;
+  int bit_width = 5, skip_missing = 0;
+  static char *kwlist[] = {"blocks", "roots", "owners", "keys", "bit_width",
+                           "fallback", "skip_missing", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOp", kwlist,
+                                   &PyDict_Type, &blocks, &roots, &owners,
+                                   &keys, &bit_width, &fallback, &skip_missing))
+    return NULL;
+  if (bit_width < 1 || bit_width > 8) {
+    PyErr_SetString(PyExc_ValueError, "bit_width must be in [1, 8]");
+    return NULL;
+  }
+  PyObject *rseq = PySequence_Fast(roots, "roots must be a sequence of cid bytes");
+  if (!rseq) return NULL;
+  PyObject *oseq = PySequence_Fast(owners, "owners must be a sequence of ints");
+  if (!oseq) {
+    Py_DECREF(rseq);
+    return NULL;
+  }
+  PyObject *kseq = PySequence_Fast(keys, "keys must be a sequence of bytes");
+  if (!kseq) {
+    Py_DECREF(rseq);
+    Py_DECREF(oseq);
+    return NULL;
+  }
+
+  t_err.kind = E_NONE;
+  Scan s;
+  memset(&s, 0, sizeof(s));
+  s.blocks = blocks;
+  s.fallback = fallback;
+  s.skip_missing = skip_missing;
+
+  Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(rseq);
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(kseq);
+  Vec found = {0}, val_pool = {0}, val_off = {0}, val_len = {0};
+  PyObject *result = NULL;
+  if (PySequence_Fast_GET_SIZE(oseq) != n) {
+    PyErr_SetString(PyExc_ValueError, "owners and keys must align");
+    goto out;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *key_obj = PySequence_Fast_GET_ITEM(kseq, i);
+    PyObject *own_obj = PySequence_Fast_GET_ITEM(oseq, i);
+    if (!PyBytes_Check(key_obj)) {
+      PyErr_SetString(PyExc_TypeError, "keys must be bytes");
+      goto out;
+    }
+    Py_ssize_t owner = PyLong_AsSsize_t(own_obj);
+    if (owner == -1 && PyErr_Occurred()) goto out;
+    if (owner < 0 || owner >= n_roots) {
+      PyErr_SetString(PyExc_ValueError, "owner index out of range");
+      goto out;
+    }
+    PyObject *root_obj = PySequence_Fast_GET_ITEM(rseq, owner);
+    if (!PyBytes_Check(root_obj)) {
+      PyErr_SetString(PyExc_TypeError, "roots must be bytes (raw CID bytes)");
+      goto out;
+    }
+    uint8_t f = 0;
+    int32_t voff = 0, vlen = 0;
+    if (hamt_get_one(&s, (const uint8_t *)PyBytes_AS_STRING(root_obj),
+                     PyBytes_GET_SIZE(root_obj),
+                     (const uint8_t *)PyBytes_AS_STRING(key_obj),
+                     PyBytes_GET_SIZE(key_obj), bit_width, &val_pool, &voff,
+                     &vlen, &f) < 0) {
+      if (!PyErr_Occurred()) raise_walk_err();
+      goto out;
+    }
+    if (vec_push(&found, &f, 1) < 0 || vec_push(&val_off, &voff, 4) < 0 ||
+        vec_push(&val_len, &vlen, 4) < 0) {
+      raise_walk_err();
+      goto out;
+    }
+  }
+  result = Py_BuildValue(
+      "{s:N,s:N,s:N,s:N}", "found", make_array_bytes(&found), "val_pool",
+      make_array_bytes(&val_pool), "val_off", make_array_bytes(&val_off),
+      "val_len", make_array_bytes(&val_len));
+out:
+  Py_DECREF(rseq);
+  Py_DECREF(oseq);
+  Py_DECREF(kseq);
+  vec_free(&found);
+  vec_free(&val_pool);
+  vec_free(&val_off);
+  vec_free(&val_len);
+  return result;
+}
+
 static PyObject *py_split_pool(PyObject *self, PyObject *args) {
   (void)self;
   Py_buffer pool, off, len;
@@ -1822,6 +2165,13 @@ static PyMethodDef methods[] = {
      "collect_exec_orders(blocks_dict, groups, fallback=None, headers=True) ->"
      " per-group message-CID lists (execution order, first-seen deduped), touched block"
      " CIDs, TxMeta CIDs + canonical flags, and failed flags."},
+    {"hamt_lookup_batch",
+     (PyCFunction)(void (*)(void))py_hamt_lookup_batch,
+     METH_VARARGS | METH_KEYWORDS,
+     "hamt_lookup_batch(blocks_dict, roots, owners, keys, bit_width=5, "
+     "fallback=None, skip_missing=False) -> one root→bucket HAMT walk per "
+     "(owner root, key), returning found flags and raw value-CBOR spans "
+     "(pooled) — the batched storage-slot lookup path."},
     {"record_receipt_paths",
      (PyCFunction)(void (*)(void))py_record_receipt_paths,
      METH_VARARGS | METH_KEYWORDS,

@@ -62,6 +62,7 @@ class TpuBackend:
         # separate memo: the single-block probe passing does not guarantee
         # Mosaic accepts the larger two-block blake2b kernel
         self._pallas_two_block_ok: Optional[bool] = None
+        self._cpu_backend = None  # lazy crossover fallback
 
     def _pallas_usable(self) -> bool:
         """Single-block Pallas fast path: TPU platform only (interpret mode
@@ -89,6 +90,14 @@ class TpuBackend:
                     self._pallas_ok = False
         return self._pallas_ok
 
+    # Below this many payload bytes a keccak batch stays on the host C++
+    # path: the dispatch + host→device copy dominates (same economics as
+    # `_CID_BATCH_MIN_BYTES`, but keccak preimages are small — config 3's
+    # 65k slot preimages are 4 MB — so the device only pays at larger
+    # batches or when a mesh shards the hash). Override with
+    # IPC_TPU_KECCAK_MIN_BYTES.
+    _KECCAK_BATCH_MIN_BYTES = 8 << 20
+
     def keccak256_batch(self, messages: Sequence[bytes]) -> list[bytes]:
         import jax.numpy as jnp
 
@@ -96,6 +105,20 @@ class TpuBackend:
 
         if not messages:
             return []
+        if self.mesh is None:
+            import os
+
+            min_bytes = int(
+                os.environ.get("IPC_TPU_KECCAK_MIN_BYTES", self._KECCAK_BATCH_MIN_BYTES)
+            )
+            # the crossover premise is "host C++ batch beats the dispatch";
+            # without the native lib the host path is pure-Python keccak —
+            # keep the device kernel in that case
+            if (
+                sum(len(m) for m in messages) < min_bytes
+                and self._cpu_fallback().has_native
+            ):
+                return self._cpu_fallback().keccak256_batch(messages)
         # single-block fast path: 3.3× the XLA kernel on v5e (measured;
         # see ops/pallas_kernels.py docstring)
         if max(len(m) for m in messages) < 136 and self._pallas_usable():
@@ -166,6 +189,14 @@ class TpuBackend:
     # so it is deliberately conservative; override with IPC_TPU_CID_MIN_BYTES.
     _CID_BATCH_MIN_BYTES = 4 << 20
 
+    def _cpu_fallback(self):
+        """Memoized CpuBackend for the host-side crossover branches."""
+        if self._cpu_backend is None:
+            from ipc_proofs_tpu.backend.cpu import CpuBackend
+
+            self._cpu_backend = CpuBackend()
+        return self._cpu_backend
+
     def verify_block_cids(
         self, cids_digests: Sequence[bytes], blocks: Sequence[bytes]
     ) -> bool:
@@ -173,9 +204,7 @@ class TpuBackend:
 
         min_bytes = int(os.environ.get("IPC_TPU_CID_MIN_BYTES", self._CID_BATCH_MIN_BYTES))
         if sum(len(b) for b in blocks) < min_bytes:
-            from ipc_proofs_tpu.backend.cpu import CpuBackend
-
-            return CpuBackend().verify_block_cids(cids_digests, blocks)
+            return self._cpu_fallback().verify_block_cids(cids_digests, blocks)
         digests = self.blake2b256_batch(blocks)
         return all(d == e for d, e in zip(digests, cids_digests))
 

@@ -25,7 +25,7 @@ from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
 from ipc_proofs_tpu.store.blockstore import Blockstore, put_cbor
 
-__all__ = ["HAMT", "hamt_build", "HAMT_BIT_WIDTH", "MAX_BUCKET"]
+__all__ = ["HAMT", "hamt_build", "hamt_get_batch", "HAMT_BIT_WIDTH", "MAX_BUCKET"]
 
 HAMT_BIT_WIDTH = 5  # fvm_shared::HAMT_BIT_WIDTH
 MAX_BUCKET = 3  # fvm_ipld_hamt MAX_ARRAY_WIDTH
@@ -51,6 +51,43 @@ def _bitfield_encode(bits: int) -> bytes:
     if bits == 0:
         return b""
     return bits.to_bytes((bits.bit_length() + 7) // 8, "big")
+
+
+def hamt_get_batch(
+    store: Blockstore,
+    roots: "list[CID]",
+    owners: "list[int]",
+    keys: "list[bytes]",
+    bit_width: int = HAMT_BIT_WIDTH,
+) -> "Optional[list[Optional[Any]]]":
+    """Batched ``HAMT.get``: ONE C call walks a root→bucket path per
+    (owner root, key) — the storage-side analog of the native receipts
+    scanner, sized for BASELINE config 3 (65k slots × 256 contract roots)
+    and the range driver's storage legs. ``owners[i]`` selects the root for
+    ``keys[i]``. Returns decoded values (None for absent keys), or None
+    overall when the extension is unavailable (callers loop scalar).
+    Missing node blocks raise KeyError, malformed nodes ValueError — the
+    scalar reader's behavior; value decoding is the shared DAG-CBOR path."""
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+    from ipc_proofs_tpu.proofs.scan_native import _raw_view, split_pooled
+
+    ext = load_scan_ext()
+    if ext is None or not hasattr(ext, "hamt_lookup_batch"):
+        return None
+    raw, fallback = _raw_view(store)
+    out = ext.hamt_lookup_batch(
+        raw,
+        [c.to_bytes() for c in roots],
+        owners,
+        keys,
+        bit_width=bit_width,
+        fallback=fallback,
+    )
+    found = out["found"]
+    spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])
+    return [
+        cbor_decode(spans[i]) if found[i] else None for i in range(len(keys))
+    ]
 
 
 class HAMT:

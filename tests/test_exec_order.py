@@ -283,7 +283,6 @@ class TestBatchedTxmetaRecompute:
         # corrupt group 0's TxMeta bytes in place (same CID key)
         raw = bs.get(tx1)
         import ipc_proofs_tpu.core.dagcbor as dagcbor
-        from ipc_proofs_tpu.core.cid import CID
 
         bls, secp = dagcbor.decode(raw)
         forged = dagcbor.encode([secp, bls])  # valid shape, wrong bytes

@@ -176,3 +176,92 @@ class TestHamt:
         entries = {f"key-{i}".encode(): i for i in range(100)}
         shuffled = dict(sorted(entries.items(), key=lambda kv: hash(kv[0])))
         assert hamt_build(bs1, entries) == hamt_build(bs2, shuffled)
+
+
+class TestHamtBatchLookup:
+    """hamt_get_batch (C walker) ↔ scalar HAMT.get equivalence."""
+
+    def _ext_or_skip(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if ext is None or not hasattr(ext, "hamt_lookup_batch"):
+            pytest.skip("native hamt_lookup_batch unavailable")
+
+    def test_matches_scalar_across_roots_and_absent_keys(self):
+        self._ext_or_skip()
+        import hashlib
+
+        from ipc_proofs_tpu.ipld.hamt import HAMT, hamt_build, hamt_get_batch
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        bs = MemoryBlockstore()
+        roots, keysets = [], []
+        for c in range(5):
+            # enough keys to force multi-level nodes and full buckets
+            entries = {
+                hashlib.sha256(f"{c}:{i}".encode()).digest(): f"v{c}:{i}".encode()
+                for i in range(120)
+            }
+            # one structured value too (values are arbitrary CBOR)
+            entries[hashlib.sha256(f"{c}:struct".encode()).digest()] = [1, b"x", {"k": 2}]
+            roots.append(hamt_build(bs, entries))
+            keysets.append(list(entries))
+        owners, keys = [], []
+        for c, ks in enumerate(keysets):
+            for k in ks:
+                owners.append(c)
+                keys.append(k)
+            owners.append(c)
+            keys.append(hashlib.sha256(f"{c}:absent".encode()).digest())
+        got = hamt_get_batch(bs, roots, owners, keys)
+        assert got is not None
+        hamts = [HAMT.load(bs, r) for r in roots]
+        expected = [hamts[o].get(k) for o, k in zip(owners, keys)]
+        assert got == expected
+        assert sum(v is None for v in got) == 5  # exactly the absent probes
+
+    def test_bitwidth_variants_match(self):
+        self._ext_or_skip()
+        from ipc_proofs_tpu.ipld.hamt import HAMT, hamt_build, hamt_get_batch
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        for bw in (3, 5, 8):
+            bs = MemoryBlockstore()
+            entries = {f"key-{i}".encode(): i.to_bytes(2, "big") for i in range(40)}
+            root = hamt_build(bs, entries, bit_width=bw)
+            keys = list(entries) + [b"nope"]
+            got = hamt_get_batch(bs, [root], [0] * len(keys), keys, bit_width=bw)
+            hamt = HAMT.load(bs, root, bit_width=bw)
+            assert got == [hamt.get(k) for k in keys]
+
+    def test_missing_node_raises_keyerror(self):
+        self._ext_or_skip()
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        bs = MemoryBlockstore()
+        bogus = CID.hash_of(b"missing-hamt-root")
+        with pytest.raises(KeyError):
+            hamt_get_batch(bs, [bogus], [0], [b"k"])
+
+    def test_malformed_node_raises_valueerror(self):
+        self._ext_or_skip()
+        from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore, put_cbor
+
+        bs = MemoryBlockstore()
+        bad = put_cbor(bs, [1, 2, 3])  # not a [bitfield, pointers] node
+        with pytest.raises(ValueError):
+            hamt_get_batch(bs, [bad], [0], [b"k"])
+
+    def test_owner_index_validation(self):
+        self._ext_or_skip()
+        from ipc_proofs_tpu.ipld.hamt import hamt_build, hamt_get_batch
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        bs = MemoryBlockstore()
+        root = hamt_build(bs, {b"a": b"1"})
+        with pytest.raises(ValueError):
+            hamt_get_batch(bs, [root], [3], [b"a"])
