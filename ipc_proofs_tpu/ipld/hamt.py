@@ -59,6 +59,7 @@ def hamt_get_batch(
     owners: "list[int]",
     keys: "list[bytes]",
     bit_width: int = HAMT_BIT_WIDTH,
+    skip_missing: bool = False,
 ) -> "Optional[list[Optional[Any]]]":
     """Batched ``HAMT.get``: ONE C call walks a root→bucket path per
     (owner root, key) — the storage-side analog of the native receipts
@@ -67,7 +68,10 @@ def hamt_get_batch(
     ``keys[i]``. Returns decoded values (None for absent keys), or None
     overall when the extension is unavailable (callers loop scalar).
     Missing node blocks raise KeyError, malformed nodes ValueError — the
-    scalar reader's behavior; value decoding is the shared DAG-CBOR path."""
+    scalar reader's behavior; ``skip_missing=True`` instead treats a
+    missing node as an absent key (the batch verifiers' tolerant mode,
+    mirroring the scalar caller's caught-KeyError → unverified). Value
+    decoding is the shared DAG-CBOR path."""
     from ipc_proofs_tpu.backend.native import load_scan_ext
     from ipc_proofs_tpu.proofs.scan_native import _raw_view, split_pooled
 
@@ -82,6 +86,7 @@ def hamt_get_batch(
         keys,
         bit_width=bit_width,
         fallback=fallback,
+        skip_missing=skip_missing,
     )
     found = out["found"]
     spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])

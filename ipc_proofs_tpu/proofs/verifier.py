@@ -67,10 +67,21 @@ def verify_proof_bundle(
         except Exception:
             return False
 
-    storage_results = [
-        verify_storage_proof(proof, bundle.blocks, child_verifier, store=shared_store)
-        for proof in bundle.storage_proofs
-    ]
+    # Storage proofs: batched replay when the native HAMT walker is
+    # available (shared header decodes + one actors-tree walk for the
+    # bundle; verdict-identical to the scalar loop), scalar otherwise.
+    storage_results = None
+    if bundle.storage_proofs:
+        from ipc_proofs_tpu.proofs.storage_verifier import verify_storage_proofs_batch
+
+        storage_results = verify_storage_proofs_batch(
+            shared_store, bundle.storage_proofs, child_verifier
+        )
+    if storage_results is None:
+        storage_results = [
+            verify_storage_proof(proof, bundle.blocks, child_verifier, store=shared_store)
+            for proof in bundle.storage_proofs
+        ]
 
     event_bundle = EventProofBundle(proofs=bundle.event_proofs, blocks=bundle.blocks)
     event_results = verify_event_proof(

@@ -1,0 +1,196 @@
+"""Batched storage verifier ↔ scalar verifier equivalence.
+
+`verify_storage_proofs_batch` must return exactly the scalar loop's
+verdicts — on valid bundles across every storage encoding, on every tamper
+case, and on pruned witnesses — and raise where the scalar path raises.
+"""
+
+import dataclasses
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+from ipc_proofs_tpu.proofs.generator import StorageProofSpec, generate_proof_bundle
+from ipc_proofs_tpu.proofs.storage_verifier import (
+    verify_storage_proof,
+    verify_storage_proofs_batch,
+)
+from ipc_proofs_tpu.proofs.witness import load_witness_store
+from ipc_proofs_tpu.state.storage import calculate_storage_slot
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+ACCEPT = lambda *_: True
+
+
+def _native_or_skip():
+    if hamt_get_batch(MemoryBlockstore(), [], [], []) is None:
+        pytest.skip("native hamt_lookup_batch unavailable")
+
+
+def make_storage_bundle(encodings=("direct",), n_slots=3):
+    bs = MemoryBlockstore()
+    contracts = []
+    specs = []
+    for c, enc in enumerate(encodings):
+        storage = {}
+        for i in range(n_slots):
+            slot = calculate_storage_slot(f"sub-{c}-{i}", 0)
+            storage[slot] = (c * 10 + i + 1).to_bytes(2, "big")
+        contracts.append(
+            ContractFixture(actor_id=100 + c, storage=storage, storage_encoding=enc)
+        )
+        for i in range(n_slots):
+            specs.append(
+                StorageProofSpec(
+                    actor_id=100 + c, slot=calculate_storage_slot(f"sub-{c}-{i}", 0)
+                )
+            )
+        # an absent slot too — proves the zero-value path
+        specs.append(
+            StorageProofSpec(
+                actor_id=100 + c, slot=calculate_storage_slot(f"sub-{c}-absent", 7)
+            )
+        )
+    world = build_chain(
+        contracts, [[EventFixture(emitter=100, signature="E()", topic1="x")]], store=bs
+    )
+    bundle = generate_proof_bundle(bs, world.parent, world.child, specs, [])
+    assert len(bundle.storage_proofs) == len(specs)
+    return bundle
+
+
+def both_paths(bundle, trust=ACCEPT):
+    store = load_witness_store(bundle.blocks, verify_cids=False)
+    scalar = [
+        verify_storage_proof(p, bundle.blocks, trust, store=store)
+        for p in bundle.storage_proofs
+    ]
+    batch = verify_storage_proofs_batch(store, bundle.storage_proofs, trust)
+    assert batch is not None
+    assert scalar == batch, f"scalar={scalar} batch={batch}"
+    return batch
+
+
+class TestStorageBatchEquivalence:
+    def test_valid_bundle_all_encodings(self):
+        _native_or_skip()
+        bundle = make_storage_bundle(
+            encodings=("direct", "wrapper_tuple", "wrapper_map", "inline")
+        )
+        assert all(both_paths(bundle))
+
+    def test_trust_rejection_per_proof(self):
+        _native_or_skip()
+        bundle = make_storage_bundle()
+        reject = lambda *_: False
+        assert not any(both_paths(bundle, trust=reject))
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda p: dataclasses.replace(p, value="0x" + "ab" * 32),
+            lambda p: dataclasses.replace(p, actor_id=p.actor_id + 1),
+            lambda p: dataclasses.replace(
+                p, parent_state_root=str(CID.hash_of(b"wrong-root"))
+            ),
+            lambda p: dataclasses.replace(
+                p, actor_state_cid=str(CID.hash_of(b"wrong-actor-state"))
+            ),
+            lambda p: dataclasses.replace(
+                p, storage_root=str(CID.hash_of(b"wrong-storage-root"))
+            ),
+            # NOTE: a child_epoch tamper alone is accepted under accept-all
+            # trust in BOTH paths — epoch binding is the trust policy's job
+            # (reference storage/verifier.rs anchors (epoch, cid) via the
+            # policy closure only); covered by the epoch-binding case below.
+        ],
+    )
+    def test_tampered_proof_fails_both_paths(self, mutate):
+        _native_or_skip()
+        bundle = make_storage_bundle()
+        proofs = [mutate(bundle.storage_proofs[0]), *bundle.storage_proofs[1:]]
+        patched = dataclasses.replace(bundle, storage_proofs=proofs)
+        res = both_paths(patched)
+        assert res[0] is False
+        assert all(res[1:])
+
+    def test_case_insensitive_value_compare(self):
+        _native_or_skip()
+        bundle = make_storage_bundle()
+        p = bundle.storage_proofs[0]
+        shouty = dataclasses.replace(p, value=p.value.upper().replace("0X", "0x"))
+        patched = dataclasses.replace(
+            bundle, storage_proofs=[shouty, *bundle.storage_proofs[1:]]
+        )
+        assert both_paths(patched)[0] is True
+
+    def test_missing_state_root_block_false_both_paths(self):
+        _native_or_skip()
+        bundle = make_storage_bundle()
+        pruned_blocks = [
+            b
+            for b in bundle.blocks
+            if str(b.cid) != bundle.storage_proofs[0].parent_state_root
+        ]
+        assert len(pruned_blocks) == len(bundle.blocks) - 1
+        store = load_witness_store(pruned_blocks, verify_cids=False)
+        scalar = [
+            verify_storage_proof(p, pruned_blocks, ACCEPT, store=store)
+            for p in bundle.storage_proofs
+        ]
+        batch = verify_storage_proofs_batch(store, bundle.storage_proofs, ACCEPT)
+        assert scalar == batch == [False] * len(bundle.storage_proofs)
+
+    def test_missing_child_header_raises_both_paths(self):
+        _native_or_skip()
+        bundle = make_storage_bundle()
+        child_str = bundle.storage_proofs[0].child_block_cid
+        pruned = [b for b in bundle.blocks if str(b.cid) != child_str]
+        store = load_witness_store(pruned, verify_cids=False)
+        with pytest.raises(KeyError):
+            for p in bundle.storage_proofs:
+                verify_storage_proof(p, pruned, ACCEPT, store=store)
+        with pytest.raises(KeyError):
+            verify_storage_proofs_batch(store, bundle.storage_proofs, ACCEPT)
+
+    def test_malformed_slot_hex_raises_both_paths(self):
+        _native_or_skip()
+        bundle = make_storage_bundle()
+        bad = dataclasses.replace(bundle.storage_proofs[0], slot="0x1234")
+        store = load_witness_store(bundle.blocks, verify_cids=False)
+        with pytest.raises(ValueError):
+            verify_storage_proof(bad, bundle.blocks, ACCEPT, store=store)
+        with pytest.raises(ValueError):
+            verify_storage_proofs_batch(store, [bad], ACCEPT)
+
+    def test_unified_bundle_routes_through_batch(self):
+        _native_or_skip()
+        from ipc_proofs_tpu.proofs.trust import TrustPolicy
+        from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+
+        bundle = make_storage_bundle(encodings=("direct", "inline"))
+        result = verify_proof_bundle(bundle, TrustPolicy.accept_all())
+        assert all(result.storage_results)
+        assert len(result.storage_results) == len(bundle.storage_proofs)
+
+
+def test_epoch_binding_enforced_by_trust_policy_identically():
+    """child_epoch tampering is caught by an epoch-binding trust policy,
+    not by the replay — and identically on both paths."""
+    _native_or_skip()
+    bundle = make_storage_bundle()
+    true_epoch = bundle.storage_proofs[0].child_epoch
+    bound = lambda epoch, cid: epoch == true_epoch
+    import dataclasses as dc
+
+    tampered = dc.replace(
+        bundle,
+        storage_proofs=[
+            dc.replace(bundle.storage_proofs[0], child_epoch=true_epoch + 5),
+            *bundle.storage_proofs[1:],
+        ],
+    )
+    res = both_paths(tampered, trust=bound)
+    assert res[0] is False and all(res[1:])
