@@ -2011,12 +2011,14 @@ static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
 static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *owners, *keys, *fallback = Py_None;
-  int bit_width = 5, skip_missing = 0;
-  static char *kwlist[] = {"blocks", "roots", "owners", "keys", "bit_width",
-                           "fallback", "skip_missing", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOp", kwlist,
+  int bit_width = 5, skip_missing = 0, want_touched = 0;
+  static char *kwlist[] = {"blocks",      "roots",        "owners",
+                           "keys",        "bit_width",    "fallback",
+                           "skip_missing", "want_touched", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOpp", kwlist,
                                    &PyDict_Type, &blocks, &roots, &owners,
-                                   &keys, &bit_width, &fallback, &skip_missing))
+                                   &keys, &bit_width, &fallback, &skip_missing,
+                                   &want_touched))
     return NULL;
   if (bit_width < 1 || bit_width > 8) {
     PyErr_SetString(PyExc_ValueError, "bit_width must be in [1, 8]");
@@ -2046,12 +2048,27 @@ static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
   Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(rseq);
   Py_ssize_t n = PySequence_Fast_GET_SIZE(kseq);
   Vec found = {0}, val_pool = {0}, val_off = {0}, val_len = {0};
+  Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
+  if (want_touched) {
+    /* per-item witness recording: every block the walk fetches, grouped
+     * by item — the generation-side analog of the RecordingBlockstore */
+    s.touch_pool = &touch_pool;
+    s.touch_off = &touch_off;
+    s.touch_len = &touch_len;
+  }
   PyObject *result = NULL;
   if (PySequence_Fast_GET_SIZE(oseq) != n) {
     PyErr_SetString(PyExc_ValueError, "owners and keys must align");
     goto out;
   }
   for (Py_ssize_t i = 0; i < n; i++) {
+    if (want_touched) {
+      int32_t tcount = (int32_t)(touch_off.len / 4);
+      if (vec_push(&touch_goff, &tcount, 4) < 0) {
+        raise_walk_err();
+        goto out;
+      }
+    }
     PyObject *key_obj = PySequence_Fast_GET_ITEM(kseq, i);
     PyObject *own_obj = PySequence_Fast_GET_ITEM(oseq, i);
     if (!PyBytes_Check(key_obj)) {
@@ -2085,10 +2102,25 @@ static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
       goto out;
     }
   }
-  result = Py_BuildValue(
-      "{s:N,s:N,s:N,s:N}", "found", make_array_bytes(&found), "val_pool",
-      make_array_bytes(&val_pool), "val_off", make_array_bytes(&val_off),
-      "val_len", make_array_bytes(&val_len));
+  if (want_touched) {
+    int32_t tcount = (int32_t)(touch_off.len / 4);
+    if (vec_push(&touch_goff, &tcount, 4) < 0) {
+      raise_walk_err();
+      goto out;
+    }
+    result = Py_BuildValue(
+        "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N}", "found", make_array_bytes(&found),
+        "val_pool", make_array_bytes(&val_pool), "val_off",
+        make_array_bytes(&val_off), "val_len", make_array_bytes(&val_len),
+        "touch_pool", make_array_bytes(&touch_pool), "touch_off",
+        make_array_bytes(&touch_off), "touch_len", make_array_bytes(&touch_len),
+        "touch_goff", make_array_bytes(&touch_goff));
+  } else {
+    result = Py_BuildValue(
+        "{s:N,s:N,s:N,s:N}", "found", make_array_bytes(&found), "val_pool",
+        make_array_bytes(&val_pool), "val_off", make_array_bytes(&val_off),
+        "val_len", make_array_bytes(&val_len));
+  }
 out:
   Py_DECREF(rseq);
   Py_DECREF(oseq);
@@ -2097,6 +2129,10 @@ out:
   vec_free(&val_pool);
   vec_free(&val_off);
   vec_free(&val_len);
+  vec_free(&touch_pool);
+  vec_free(&touch_off);
+  vec_free(&touch_len);
+  vec_free(&touch_goff);
   return result;
 }
 

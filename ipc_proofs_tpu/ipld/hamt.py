@@ -25,7 +25,14 @@ from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.core.dagcbor import decode as cbor_decode
 from ipc_proofs_tpu.store.blockstore import Blockstore, put_cbor
 
-__all__ = ["HAMT", "hamt_build", "hamt_get_batch", "HAMT_BIT_WIDTH", "MAX_BUCKET"]
+__all__ = [
+    "HAMT",
+    "hamt_build",
+    "hamt_get_batch",
+    "hamt_get_batch_touched",
+    "HAMT_BIT_WIDTH",
+    "MAX_BUCKET",
+]
 
 HAMT_BIT_WIDTH = 5  # fvm_shared::HAMT_BIT_WIDTH
 MAX_BUCKET = 3  # fvm_ipld_hamt MAX_ARRAY_WIDTH
@@ -93,6 +100,44 @@ def hamt_get_batch(
     return [
         cbor_decode(spans[i]) if found[i] else None for i in range(len(keys))
     ]
+
+
+def hamt_get_batch_touched(
+    store: Blockstore,
+    roots: "list[CID]",
+    owners: "list[int]",
+    keys: "list[bytes]",
+    bit_width: int = HAMT_BIT_WIDTH,
+) -> "Optional[tuple[list[Optional[Any]], list[list[bytes]]]]":
+    """:func:`hamt_get_batch` with per-item witness recording: also returns,
+    per (root, key), the raw CID bytes of every node the walk fetched —
+    the generation-side analog of walking under a RecordingBlockstore.
+    Returns None when the extension is unavailable."""
+    import numpy as np
+
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+    from ipc_proofs_tpu.proofs.scan_native import _raw_view, split_pooled
+
+    ext = load_scan_ext()
+    if ext is None or not hasattr(ext, "hamt_lookup_batch"):
+        return None
+    raw, fallback = _raw_view(store)
+    out = ext.hamt_lookup_batch(
+        raw,
+        [c.to_bytes() for c in roots],
+        owners,
+        keys,
+        bit_width=bit_width,
+        fallback=fallback,
+        want_touched=True,
+    )
+    found = out["found"]
+    spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])
+    values = [cbor_decode(spans[i]) if found[i] else None for i in range(len(keys))]
+    titems = split_pooled(out["touch_pool"], out["touch_off"], out["touch_len"])
+    goff = np.frombuffer(out["touch_goff"], "<i4")
+    touched = [titems[goff[i] : goff[i + 1]] for i in range(len(keys))]
+    return values, touched
 
 
 class HAMT:

@@ -190,10 +190,11 @@ def generate_event_proofs_for_range(
     storage_proofs: list = []
     if storage_specs:
         with metrics.stage("range_storage"):
-            storage_proofs, storage_blocks = _storage_for_pairs(
+            storage_proofs, storage_witness, storage_blocks = _storage_for_pairs(
                 cached, pairs, storage_specs, match_backend
             )
         metrics.count("range_storage_proofs", len(storage_proofs))
+        witness_bytes = witness_bytes | storage_witness
         fallback_blocks = list(fallback_blocks) + list(storage_blocks)
 
     with metrics.stage("range_record"):
@@ -205,17 +206,24 @@ def generate_event_proofs_for_range(
 
 def _storage_for_pairs(
     cached: Blockstore, pairs: Sequence[TipsetPair], storage_specs, hash_backend
-) -> "tuple[list, set[ProofBlock]]":
-    """Prove every storage spec at every pair: slot digests hashed once
-    for the whole range, per-pair walks share the range cache, witness
-    blocks returned as a set for cross-kind dedup."""
+) -> "tuple[list, set[bytes], list[ProofBlock]]":
+    """Prove every storage spec at every pair: slot digests hashed once for
+    the whole range. Returns ``(proofs, witness_cid_bytes,
+    fallback_blocks)`` — the range-batched generator contributes raw CID
+    bytes for the shared end-of-bundle materialization; the per-pair scalar
+    fallback (no native walker) contributes materialized blocks."""
     from ipc_proofs_tpu.proofs.storage_batch import (
         generate_storage_proofs_batch,
+        generate_storage_proofs_for_pairs,
         hash_slot_specs,
     )
 
     slots = hash_slot_specs(storage_specs, hash_backend)
-    proofs: list = []
+    batched = generate_storage_proofs_for_pairs(cached, pairs, storage_specs, slots)
+    if batched is not None:
+        proofs, witness_bytes = batched
+        return proofs, witness_bytes, []
+    proofs = []
     blocks: set[ProofBlock] = set()
     for pair in pairs:
         bundle = generate_storage_proofs_batch(
@@ -227,7 +235,7 @@ def _storage_for_pairs(
         )
         proofs.extend(bundle.storage_proofs)
         blocks.update(bundle.blocks)
-    return proofs, blocks
+    return proofs, set(), sorted(blocks, key=lambda b: b.cid.to_bytes())
 
 
 def _scan_and_match(
@@ -541,10 +549,11 @@ def generate_event_proofs_for_range_pipelined(
     storage_proofs: list = []
     if storage_specs:
         with metrics.stage("range_storage"):
-            storage_proofs, storage_blocks = _storage_for_pairs(
+            storage_proofs, storage_witness, storage_blocks = _storage_for_pairs(
                 cached, pairs, storage_specs, match_backend
             )
         metrics.count("range_storage_proofs", len(storage_proofs))
+        witness_bytes |= storage_witness
         fallback_blocks.extend(storage_blocks)
 
     with metrics.stage("range_record"):

@@ -25,7 +25,12 @@ from ipc_proofs_tpu.ipld.hamt import HAMT, HAMT_BIT_WIDTH
 from ipc_proofs_tpu.state.events import ascii_to_bytes32
 from ipc_proofs_tpu.store.blockstore import Blockstore
 
-__all__ = ["read_storage_slot", "compute_mapping_slot", "calculate_storage_slot"]
+__all__ = [
+    "read_storage_slot",
+    "classify_storage_root",
+    "compute_mapping_slot",
+    "calculate_storage_slot",
+]
 
 
 def _small_map_lookup(obj, slot_key: bytes) -> "tuple[bool, Optional[bytes]]":
@@ -33,11 +38,8 @@ def _small_map_lookup(obj, slot_key: bytes) -> "tuple[bool, Optional[bytes]]":
 
     Returns (matched_shape, value_or_None).
     """
-    if not (isinstance(obj, dict) and set(obj) == {"v"} and isinstance(obj["v"], list)):
+    if not _small_map_shape(obj):
         return False, None
-    for pair in obj["v"]:
-        if not (isinstance(pair, list) and len(pair) == 2 and isinstance(pair[0], bytes)):
-            return False, None
     for key, value in obj["v"]:
         if key == slot_key:
             return True, value
@@ -97,6 +99,55 @@ def read_storage_slot(
     # C) direct HAMT at the root, protocol default bit width
     hamt = HAMT.load(store, contract_state_root, bit_width=HAMT_BIT_WIDTH)
     return hamt.get(slot_key)
+
+
+def classify_storage_root(obj) -> "tuple[str, object, int]":
+    """Resolve which arm of :func:`read_storage_slot`'s five-encoding
+    cascade a DECODED storage-root object takes — the arms are purely
+    type-directed (a SmallMap is a ``{"v": [...]}`` dict, so HAMT nodes
+    ``[bytes, list]`` can never shape-match an A-case), which lets batch
+    drivers resolve the encoding ONCE per root and route the HAMT arms
+    through the C batched walker. Returns:
+
+    - ``("smallmap", map_obj, 0)`` — A1/A2/A3: every key resolves against
+      ``map_obj`` (value or None), nothing beyond the root is touched;
+    - ``("hamt", root_or_cid, bit_width)`` — B1/B2/C: walk a HAMT.
+    """
+    if (
+        isinstance(obj, list)
+        and len(obj) == 2
+        and isinstance(obj[0], bytes)
+        and isinstance(obj[1], list)
+        and obj[1]
+        and _small_map_shape(obj[1][0])
+    ):
+        return ("smallmap", obj[1][0], 0)
+    if isinstance(obj, list) and len(obj) == 2 and isinstance(obj[0], bytes):
+        if _small_map_shape(obj[1]):
+            return ("smallmap", obj[1], 0)
+    if _small_map_shape(obj):
+        return ("smallmap", obj, 0)
+    if (
+        isinstance(obj, list)
+        and len(obj) == 2
+        and isinstance(obj[0], CID)
+        and isinstance(obj[1], int)
+    ):
+        return ("hamt", obj[0], obj[1])
+    if isinstance(obj, dict) and isinstance(obj.get("root"), CID) and "bitwidth" in obj:
+        return ("hamt", obj["root"], obj["bitwidth"])
+    return ("hamt", None, HAMT_BIT_WIDTH)  # C: direct HAMT at the root itself
+
+
+def _small_map_shape(obj) -> bool:
+    """SmallMap *shape* check — exactly `_small_map_lookup`'s acceptance,
+    key-independent (the cascade's matched/fall-through is type-driven)."""
+    if not (isinstance(obj, dict) and set(obj) == {"v"} and isinstance(obj["v"], list)):
+        return False
+    for pair in obj["v"]:
+        if not (isinstance(pair, list) and len(pair) == 2 and isinstance(pair[0], bytes)):
+            return False
+    return True
 
 
 def compute_mapping_slot(key32: bytes, slot_index: int) -> bytes:
