@@ -354,9 +354,7 @@ def main() -> None:
         f"(measured in {time.perf_counter() - t0:.1f}s)"
     )
 
-    import os as _os
-
-    host_cores = len(_os.sched_getaffinity(0)) if hasattr(_os, "sched_getaffinity") else _os.cpu_count()
+    host_cores = n_cores  # computed once above for the driver choice
     # ask the scanner itself (C scan_threads_default) rather than re-deriving
     from ipc_proofs_tpu.backend.native import load_scan_ext
 

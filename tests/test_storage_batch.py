@@ -201,3 +201,112 @@ class TestRangeBatchedStorageGeneration:
         assert sorted(witness_bytes) == sorted(
             b.cid.to_bytes() for b in scalar_bundle.blocks
         )
+
+
+class TestRandomizedStorageDifferential:
+    """Seeded random storage worlds — random encodings, value sizes, absent
+    slots, multiple contracts — where the range-batched generator must emit
+    bit-identical bundles to the scalar loop and the batched verifier must
+    agree with the scalar verifier (including under random tampering)."""
+
+    def test_random_worlds_round_trip(self):
+        import numpy as np
+
+        from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+        from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+        from ipc_proofs_tpu.proofs.range import TipsetPair, _storage_for_pairs
+        from ipc_proofs_tpu.proofs.storage_batch import (
+            MappingSlotSpec,
+            generate_storage_proofs_batch,
+            hash_slot_specs,
+        )
+        from ipc_proofs_tpu.proofs.storage_verifier import (
+            verify_storage_proof,
+            verify_storage_proofs_batch,
+        )
+        from ipc_proofs_tpu.proofs.witness import load_witness_store
+        from ipc_proofs_tpu.state.storage import calculate_storage_slot
+        from ipc_proofs_tpu.store.blockstore import CachedBlockstore, MemoryBlockstore
+
+        from ipc_proofs_tpu.core.cid import CID
+
+        if hamt_get_batch(MemoryBlockstore(), [], [], []) is None:
+            pytest.skip("native hamt_lookup_batch unavailable")
+        rng = np.random.default_rng(422)
+        encodings = ["direct", "wrapper_tuple", "wrapper_map", "inline"]
+        accept = lambda *_: True
+        for trial in range(12):
+            bs = MemoryBlockstore()
+            contracts, specs = [], []
+            n_contracts = int(rng.integers(1, 4))
+            for c in range(n_contracts):
+                n_slots = int(rng.integers(0, 8))
+                storage = {}
+                slot_indices = []
+                for i in range(n_slots):
+                    idx = int(rng.integers(0, 3))
+                    slot_indices.append(idx)
+                    slot = calculate_storage_slot(f"t{trial}-c{c}-s{i}", idx)
+                    storage[slot] = bytes(
+                        rng.integers(0, 256, size=int(rng.integers(1, 40)), dtype="uint8")
+                    )
+                contracts.append(
+                    ContractFixture(
+                        actor_id=200 + c,
+                        storage=storage,
+                        storage_encoding=str(rng.choice(encodings)),
+                    )
+                )
+                for i in range(n_slots):
+                    specs.append(  # same index the value was stored under
+                        MappingSlotSpec(
+                            actor_id=200 + c,
+                            key=f"t{trial}-c{c}-s{i}",
+                            slot_index=slot_indices[i],
+                        )
+                    )
+                specs.append(  # an absent probe per contract
+                    MappingSlotSpec(actor_id=200 + c, key=f"t{trial}-c{c}-nope")
+                )
+            world = build_chain(
+                contracts,
+                [[EventFixture(emitter=200, signature="E()", topic1="x")]],
+                store=bs,
+            )
+            pairs = [TipsetPair(parent=world.parent, child=world.child)]
+            cached = CachedBlockstore(bs)
+            proofs, wbytes, fb = _storage_for_pairs(cached, pairs, specs, None)
+            assert fb == []
+            slots = hash_slot_specs(specs)
+            scalar_bundle = generate_storage_proofs_batch(
+                bs, world.parent, world.child, specs, precomputed_slots=slots
+            )
+            assert [p.__dict__ for p in proofs] == [
+                p.__dict__ for p in scalar_bundle.storage_proofs
+            ], trial
+            assert sorted(wbytes) == sorted(
+                b.cid.to_bytes() for b in scalar_bundle.blocks
+            ), trial
+
+            # verify: batch vs scalar, valid + randomly tampered claims
+            store = load_witness_store(scalar_bundle.blocks, verify_cids=False)
+            tampered = list(scalar_bundle.storage_proofs)
+            if tampered and rng.random() < 0.7:
+                import dataclasses as dc
+
+                j = int(rng.integers(0, len(tampered)))
+                field = str(rng.choice(["value", "actor_id", "storage_root"]))
+                if field == "value":
+                    tampered[j] = dc.replace(tampered[j], value="0x" + "fe" * 32)
+                elif field == "actor_id":
+                    tampered[j] = dc.replace(tampered[j], actor_id=999999)
+                else:
+                    tampered[j] = dc.replace(
+                        tampered[j], storage_root=str(CID.hash_of(b"zz"))
+                    )
+            scalar_v = [
+                verify_storage_proof(p, scalar_bundle.blocks, accept, store=store)
+                for p in tampered
+            ]
+            batch_v = verify_storage_proofs_batch(store, tampered, accept)
+            assert scalar_v == batch_v, trial
