@@ -191,3 +191,78 @@ class TestApiReceiptsPathway:
                 [EventProofSpec(event_signature=SIG, topic_1=SUBNET)],
                 receipts_client=client,
             )
+
+
+class TestCliRangeHermetic:
+    """The `range` CLI subcommand end-to-end against the fake Lotus node:
+    mixed storage+event proofs over an epoch range, checkpoint resume, and
+    offline verify of the emitted bundle — the north-star user journey at
+    the CLI layer, fully offline."""
+
+    def _fake_range_client(self, n_pairs=6):
+        from ipc_proofs_tpu.fixtures import build_range_world
+
+        bs, pairs, n_matching = build_range_world(
+            n_pairs, 4, 2, 0.5, base_height=7000
+        )
+        by_height = {}
+        for pair in pairs:
+            by_height[pair.parent.height] = pair.parent
+            by_height[pair.child.height] = pair.child
+        client = FakeLotusClient(
+            bs,
+            responses={
+                "Filecoin.ChainGetTipSetByHeight": lambda params: _tipset_json(
+                    by_height[params[0]]
+                ),
+                # ID-form address: resolution short-circuits StateLookupID
+                "Filecoin.EthAddressToFilecoinAddress": "f01001",
+            },
+        )
+        lo = min(by_height)
+        hi = max(by_height)
+        return client, lo, hi, n_matching
+
+    def test_range_cli_mixed_bundle_and_resume(self, tmp_path, monkeypatch):
+        from ipc_proofs_tpu import cli
+        from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+
+        client, lo, hi, n_matching = self._fake_range_client()
+        import ipc_proofs_tpu.store.rpc as rpc_mod
+
+        monkeypatch.setattr(rpc_mod, "LotusClient", lambda *a, **k: client)
+        out = tmp_path / "range_bundle.json"
+        ckpt = tmp_path / "ckpt"
+        args = [
+            "range",
+            "--endpoint", "http://fake.invalid/rpc/v1",
+            "--from-height", str(lo),
+            "--to-height", str(hi - 1),
+            "--contract", "0x" + "52" * 20,
+            "--event-sig", SIG,
+            "--topic1", SUBNET,
+            "--storage-slot", SUBNET,
+            "--chunk-size", "2",
+            "--checkpoint-dir", str(ckpt),
+            "--backend", "cpu",
+            "-o", str(out),
+        ]
+        assert cli.main(args) == 0
+        bundle = UnifiedProofBundle.from_json(out.read_text())
+        assert len(bundle.event_proofs) == n_matching
+        assert len(bundle.storage_proofs) > 0  # one per pair for the slot
+        assert len(list(ckpt.glob("chunk_*.json"))) >= 2
+
+        # verify the emitted bundle offline through the CLI
+        assert cli.main(["verify", str(out), "--check-cids"]) == 0
+
+        # resume: a second run consumes the checkpoints, identical output
+        calls_before = len(client.calls)
+        out2 = tmp_path / "range_bundle_2.json"
+        assert cli.main(args[:-1] + [str(out2)]) == 0
+        assert out2.read_text() == out.read_text()
+        # resumed chunks skip generation-side block reads
+        resumed_reads = sum(
+            1 for m, _ in client.calls[calls_before:] if m == "Filecoin.ChainReadObj"
+        )
+        assert resumed_reads == 0
