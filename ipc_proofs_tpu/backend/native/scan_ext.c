@@ -1392,11 +1392,12 @@ static int txmeta_is_canonical(const uint8_t *raw, Py_ssize_t rlen,
 static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
                                         PyObject *kwargs) {
   PyObject *blocks, *groups, *fallback = Py_None;
-  int headers = 1;
-  static char *kwlist[] = {"blocks", "groups", "fallback", "headers", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Op", kwlist,
+  int headers = 1, want_touched = 1;
+  static char *kwlist[] = {"blocks", "groups", "fallback", "headers",
+                           "want_touched", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Opp", kwlist,
                                    &PyDict_Type, &blocks, &groups, &fallback,
-                                   &headers))
+                                   &headers, &want_touched))
     return NULL;
   PyObject *gseq = PySequence_Fast(groups, "groups must be a sequence");
   if (!gseq) return NULL;
@@ -1412,9 +1413,11 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
   Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
   Vec tx_pool = {0}, tx_off = {0}, tx_len = {0}, tx_goff = {0}, tx_canon = {0};
   Vec failed = {0};
-  s.touch_pool = &touch_pool;
-  s.touch_off = &touch_off;
-  s.touch_len = &touch_len;
+  if (want_touched) { /* verify-side callers skip witness recording */
+    s.touch_pool = &touch_pool;
+    s.touch_off = &touch_off;
+    s.touch_len = &touch_len;
+  }
   CidSink sink = {&msg_pool, &msg_off, &msg_len};
 
   int rc = -1;

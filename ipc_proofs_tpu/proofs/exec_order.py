@@ -88,7 +88,12 @@ def reconstruct_execution_order(store: Blockstore, parent_header_cids: list[CID]
     return _collect_exec_list(store, txmeta_cids, verify_txmeta=True)
 
 
-def _native_exec_orders(store: Blockstore, groups: list[list[CID]], headers: bool):
+def _native_exec_orders(
+    store: Blockstore,
+    groups: list[list[CID]],
+    headers: bool,
+    want_touched: bool = True,
+):
     """Raw C-walker call; None when the extension is unavailable or errors."""
     from ipc_proofs_tpu.backend.native import load_scan_ext
     from ipc_proofs_tpu.proofs.scan_native import _raw_view
@@ -99,7 +104,11 @@ def _native_exec_orders(store: Blockstore, groups: list[list[CID]], headers: boo
     raw, fallback = _raw_view(store)
     try:
         return ext.collect_exec_orders(
-            raw, [[c.to_bytes() for c in g] for g in groups], fallback, headers=headers
+            raw,
+            [[c.to_bytes() for c in g] for g in groups],
+            fallback,
+            headers=headers,
+            want_touched=want_touched,
         )
     except Exception:
         return None
@@ -120,10 +129,14 @@ class _GroupView:
         self.failed = failed
 
 
-def _unpack_groups(out: dict, n_groups: int) -> list[_GroupView]:
+def _unpack_groups(
+    out: dict, n_groups: int, want_touched: bool = True
+) -> list[_GroupView]:
     """Decode the C result dict (pools + offset/length/group-offset arrays)
     into per-group byte-slice lists — the single place that knows the
-    layout."""
+    layout. ``want_touched=False`` skips materializing the touched-block
+    lists (the verify-side caller never reads them; only generation's
+    witness assembly does)."""
     import numpy as np
 
     from ipc_proofs_tpu.proofs.scan_native import split_pooled
@@ -136,7 +149,8 @@ def _unpack_groups(out: dict, n_groups: int) -> list[_GroupView]:
         return [flat[goff[g] : goff[g + 1]] for g in range(n_groups)], goff
 
     msgs, _ = slices("msg")
-    touched, _ = slices("touch")
+    # None (not a shared []) so an accidental verify-side read fails loudly
+    touched = [None] * n_groups if not want_touched else slices("touch")[0]
     txmetas, tx_goff = slices("tx")
     canon = out["tx_canon"]
     failed = out["failed"]
@@ -183,10 +197,10 @@ def reconstruct_execution_orders_batch(
     """
     import hashlib
 
-    out = _native_exec_orders(store, groups, headers=True)
+    out = _native_exec_orders(store, groups, headers=True, want_touched=False)
     if out is None:
         return None
-    views = _unpack_groups(out, len(groups))
+    views = _unpack_groups(out, len(groups), want_touched=False)
 
     _CHAIN_PREFIX = b"\x01\x71\xa0\xe4\x02\x20"  # CIDv1 dag-cbor blake2b-256
     results: list[Optional[list[bytes]]] = []
