@@ -47,7 +47,7 @@ def probed_platform_name() -> Optional[str]:
 
 def pick_platform(
     requested: str,
-    probe_timeout: float = 240.0,
+    probe_timeout: float = 150.0,
     log: Callable[..., None] = _default_log,
     attempts: Optional[int] = None,
     spacing: Optional[float] = None,
