@@ -37,7 +37,6 @@ def _cmd_generate(args) -> int:
         generate_proof_bundle,
     )
     from ipc_proofs_tpu.state.storage import calculate_storage_slot
-    from ipc_proofs_tpu.store.blockstore import CachedBlockstore
     from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
     from ipc_proofs_tpu.utils.metrics import get_metrics
 

@@ -36,7 +36,7 @@ Implementation notes / divergences (documented, all testable in-repo):
 from __future__ import annotations
 
 import hashlib
-from typing import Optional, Sequence
+from typing import Sequence
 
 __all__ = [
     "PRIME",

@@ -14,7 +14,6 @@ from __future__ import annotations
 import base64
 import json
 from dataclasses import dataclass, field
-from typing import Any
 
 from ipc_proofs_tpu.core.cid import CID
 

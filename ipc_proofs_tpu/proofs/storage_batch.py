@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
-from ipc_proofs_tpu.proofs.bundle import ProofBlock, StorageProof, UnifiedProofBundle
+from ipc_proofs_tpu.proofs.bundle import StorageProof, UnifiedProofBundle
 from ipc_proofs_tpu.proofs.chain import Tipset
 from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.actors import get_actor_state, parse_evm_state
