@@ -38,6 +38,10 @@ class TestCpuBackend:
         assert native.blake2b256_batch(MESSAGES) == fallback.blake2b256_batch(MESSAGES)
 
     def test_native_available(self):
+        import os
+
+        if os.environ.get("IPC_PROOFS_NO_NATIVE"):
+            pytest.skip("native paths disabled by IPC_PROOFS_NO_NATIVE")
         # g++ is baked into the image; the native path should build.
         assert CpuBackend().has_native
 
