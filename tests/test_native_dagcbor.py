@@ -252,3 +252,60 @@ class TestDecodeHeaderLite:
         s = str(big)
         assert ext.cids_from_strs([s]) == [CID.from_string(s)]
         assert ext.cid_strs([big.to_bytes()]) == [s]
+
+
+class TestMutationFuzzEquivalence:
+    """Witness blocks are attacker-controlled: the C and Python decoders
+    must agree byte-for-byte on ACCEPTANCE over corrupted inputs — same
+    value when both accept, both rejecting otherwise — or a crafted block
+    could verify on one install and not another."""
+
+    def test_truncations_and_flips_agree(self):
+        import random
+
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core.dagcbor import decode_py, encode
+
+        ext = load_dagcbor_ext()
+        if ext is None:
+            pytest.skip("native decoder unavailable")
+        rng = random.Random(99)
+        seeds = []
+        for trial in range(30):
+            seeds.append(encode(_random_value(rng)))
+        from ipc_proofs_tpu.core.cid import CID
+
+        seeds.append(encode([CID.hash_of(b"link"), {"k": [1, b"\x00" * 40]}]))
+
+        checked = agreed_rejects = 0
+        for raw in seeds:
+            mutations = [raw[:k] for k in range(len(raw))]  # every truncation
+            for _ in range(40):  # random byte flips / inserts
+                m = bytearray(raw)
+                op = rng.randrange(3)
+                pos = rng.randrange(len(m)) if m else 0
+                if op == 0 and m:
+                    m[pos] ^= 1 << rng.randrange(8)
+                elif op == 1 and m:
+                    del m[pos]
+                else:
+                    m.insert(pos, rng.randrange(256))
+                mutations.append(bytes(m))
+            for mut in mutations:
+                try:
+                    py = ("ok", decode_py(mut))
+                except ValueError:
+                    py = ("err", None)
+                except RecursionError:
+                    continue  # depth guard differences are not reachable here
+                try:
+                    c = ("ok", ext.decode(mut))
+                except ValueError:
+                    c = ("err", None)
+                assert py[0] == c[0], (mut.hex(), py, c)
+                if py[0] == "ok":
+                    assert py[1] == c[1], mut.hex()
+                else:
+                    agreed_rejects += 1
+                checked += 1
+        assert checked > 1000 and agreed_rejects > 100
