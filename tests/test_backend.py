@@ -164,3 +164,21 @@ class TestBackendInProofGeneration:
         )
         assert bundle_cpu.to_json() == bundle_tpu.to_json() == bundle_scalar.to_json()
         assert len(bundle_cpu.event_proofs) == 1
+
+
+def test_keccak_crossover_paths_agree(monkeypatch):
+    """TpuBackend.keccak256_batch must return identical digests whether the
+    batch crosses over to the host C++ path (default for small batches) or
+    is forced onto the device/XLA kernel (IPC_TPU_KECCAK_MIN_BYTES=0)."""
+    from ipc_proofs_tpu.backend.cpu import CpuBackend
+    from ipc_proofs_tpu.backend.tpu import TpuBackend
+    from ipc_proofs_tpu.core.hashes import keccak256
+
+    msgs = [bytes([i]) * (7 + i) for i in range(20)] + [b"", b"x" * 200]
+    expected = [keccak256(m) for m in msgs]
+    tpu = TpuBackend()
+    monkeypatch.delenv("IPC_TPU_KECCAK_MIN_BYTES", raising=False)
+    assert tpu.keccak256_batch(msgs) == expected  # host-crossover side
+    monkeypatch.setenv("IPC_TPU_KECCAK_MIN_BYTES", "0")
+    assert tpu.keccak256_batch(msgs) == expected  # device/XLA side
+    assert CpuBackend().keccak256_batch(msgs) == expected
