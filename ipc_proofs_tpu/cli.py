@@ -190,6 +190,7 @@ def _cmd_range(args) -> int:
             match_backend=backend,
             metrics=metrics,
             storage_specs=storage_specs,
+            scan_workers=args.scan_workers,
         )
     output = args.output or "range_bundle.json"
     with open(output, "w") as fh:
@@ -330,6 +331,12 @@ def main(argv=None) -> int:
         "pair; repeatable — both proof kinds share the bundle witness",
     )
     rng.add_argument("--slot-index", type=int, default=0)
+    rng.add_argument(
+        "--scan-workers", type=int, default=8,
+        help="thread-pool width for Phase-A scans over the RPC store "
+        "(overlapping block fetches hides network latency; the reference "
+        "fetches strictly one block at a time)",
+    )
     rng.add_argument("--chunk-size", type=int, default=64)
     rng.add_argument("--checkpoint-dir", default=None)
     rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])

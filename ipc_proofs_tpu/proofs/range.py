@@ -65,6 +65,7 @@ def generate_event_proofs_for_range_chunked(
     match_backend=None,
     metrics: Optional[Metrics] = None,
     storage_specs=None,
+    scan_workers: int = 0,
 ) -> UnifiedProofBundle:
     """Chunked, resumable range generation.
 
@@ -134,6 +135,7 @@ def generate_event_proofs_for_range_chunked(
                 match_backend=match_backend,
                 metrics=metrics,
                 storage_specs=storage_specs,
+                scan_workers=scan_workers,
             )
             if path is not None:
                 tmp = path + ".tmp"
