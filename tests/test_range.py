@@ -248,3 +248,40 @@ class TestConcurrentScan:
         rpc_store = RpcBlockstore(FakeLotusClient(bs))
         bundle = generate_event_proofs_for_range(rpc_store, pairs, spec, scan_workers=8)
         assert len(bundle.event_proofs) == expected
+
+
+class TestDriverEquivalenceAcrossAmtShapes:
+    """All three range drivers (flat, fused/unfused, pipelined) must emit
+    byte-identical bundles on worlds whose receipt/event counts force
+    multi-level v0 and v3 AMTs — heights the bench shape never reaches."""
+
+    @pytest.mark.parametrize(
+        "n_pairs,receipts,events,rate",
+        [(5, 33, 9, 0.3), (3, 65, 17, 0.9), (7, 9, 1, 0.0)],
+    )
+    def test_all_drivers_bit_identical(self, n_pairs, receipts, events, rate, monkeypatch):
+        from ipc_proofs_tpu.fixtures import build_range_world
+        from ipc_proofs_tpu.proofs.range import (
+            generate_event_proofs_for_range_pipelined,
+        )
+
+        bs, pairs, n_match = build_range_world(
+            n_pairs, receipts, events, rate,
+            signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+            base_height=90_000,
+        )
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        backend = get_backend("cpu")
+        fused = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
+        monkeypatch.setenv("IPC_SCAN_FUSED_MATCH", "0")
+        unfused = generate_event_proofs_for_range(bs, pairs, spec, match_backend=backend)
+        monkeypatch.delenv("IPC_SCAN_FUSED_MATCH")
+        piped = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=max(1, n_pairs // 3), match_backend=backend
+        )
+        assert fused.to_json() == unfused.to_json() == piped.to_json()
+        assert len(fused.event_proofs) == n_match
+        result = verify_proof_bundle(
+            fused, TrustPolicy.accept_all(), verify_witness_cids=True
+        )
+        assert result.all_valid()
