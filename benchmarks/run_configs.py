@@ -81,7 +81,22 @@ def config2_batch_events(quick: bool):
            "--platform", os.environ.get("IPC_BENCH_PLATFORM", "cpu")]
     if quick:
         cmd.append("--quick")
-    out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    # the bench is a per-leg watchdogged orchestrator; bound config2 above
+    # its own worst case (bench.worst_case_seconds keeps the bound next to
+    # the retry policy it bounds), scaled by the same mult the child will
+    # read from the env, plus probe/assembly slack — and survive the bound
+    # so the remaining configs still run and emit their lines
+    import bench
+
+    mult = float(os.environ.get("IPC_BENCH_LEG_TIMEOUT_MULT", "1.0"))
+    ceiling = bench.worst_case_seconds(quick, mult) + 600.0
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=ceiling)
+    except subprocess.TimeoutExpired as exc:
+        sys.stderr.write((exc.stderr or b"").decode(errors="replace")
+                         if isinstance(exc.stderr, bytes) else (exc.stderr or ""))
+        _log(f"config2: headline bench exceeded its {ceiling:.0f}s ceiling — skipped")
+        return
     sys.stderr.write(out.stderr)
     print(out.stdout.strip())
 

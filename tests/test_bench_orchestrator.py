@@ -1,0 +1,233 @@
+"""Hermetic tests for bench.py's per-leg watchdog orchestrator.
+
+The orchestrator exists because the tunneled chip can stall MID-RUN (a
+dispatch that never returns), which used to hang the whole benchmark so no
+JSON artifact was ever printed. These tests drive the assembly logic with
+faked legs — no jax, no subprocesses beyond a stub — and pin the contract:
+one stalled leg costs that leg, never the artifact; device legs downgrade
+to CPU after a stall; every leg's numbers are labeled with the platform it
+actually ran on; and a total failure still emits the full headline schema
+with nulls rather than a shrunken dict.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402
+
+
+def _args(**overrides):
+    argv = []
+    for k, v in overrides.items():
+        argv += [f"--{k.replace('_', '-')}", str(v)]
+    return bench._parse_args(argv)
+
+
+class TestParseArgs:
+    def test_quick_clamps_shapes(self):
+        args = bench._parse_args(["--quick", "--tipsets", "4096"])
+        assert args.tipsets == 256
+        assert args.baseline_pairs == 32
+        assert args.kernel_iters == 5
+
+    def test_leg_choices(self):
+        for leg in bench.LEGS:
+            assert bench._parse_args(["--leg", leg]).leg == leg
+        with pytest.raises(SystemExit):
+            bench._parse_args(["--leg", "nonsense"])
+
+
+class _FakeProc:
+    def __init__(self, returncode=0, stdout=""):
+        self.returncode = returncode
+        self.stdout = stdout
+
+
+class TestRunLeg:
+    """_run_leg parses the child's last stdout line and labels status with
+    the platform the leg REPORTS (not the one requested)."""
+
+    def test_ok_pops_reported_platform(self, monkeypatch):
+        payload = {"device_mask_kernel_events_per_sec": 5.0, "_platform": "tpu"}
+        monkeypatch.setattr(
+            bench.subprocess, "run",
+            lambda *a, **k: _FakeProc(0, "jax noise line\n" + json.dumps(payload)),
+        )
+        out, status = bench._run_leg("kernel", _args(), "default")
+        assert status == "ok:tpu"
+        assert out == {"device_mask_kernel_events_per_sec": 5.0}
+
+    def test_ok_without_platform_falls_back_to_requested(self, monkeypatch):
+        monkeypatch.setattr(
+            bench.subprocess, "run", lambda *a, **k: _FakeProc(0, json.dumps({"x": 1}))
+        )
+        _out, status = bench._run_leg("kernel", _args(), "cpu")
+        assert status == "ok:cpu"
+
+    def test_timeout_and_error_statuses(self, monkeypatch):
+        def _raise(*a, **k):
+            raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+        monkeypatch.setattr(bench.subprocess, "run", _raise)
+        out, status = bench._run_leg("e2e", _args(), "default")
+        assert out is None and status == "timeout:default"
+
+        monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: _FakeProc(3, ""))
+        out, status = bench._run_leg("e2e", _args(), "default")
+        assert out is None and status == "error:default"
+
+        monkeypatch.setattr(
+            bench.subprocess, "run", lambda *a, **k: _FakeProc(0, "not json at all")
+        )
+        out, status = bench._run_leg("e2e", _args(), "cpu")
+        assert out is None and status == "error:cpu"
+
+    def test_timeout_scaling(self):
+        args = _args(leg_timeout_mult=2.0)
+        assert bench._leg_timeout("e2e", args) == pytest.approx(
+            bench._LEG_TIMEOUTS["e2e"][0] * 2.0
+        )
+        args_quick = bench._parse_args(["--quick"])
+        assert bench._leg_timeout("cid", args_quick) == pytest.approx(
+            bench._LEG_TIMEOUTS["cid"][1]
+        )
+
+
+def _orchestrate_with(monkeypatch, capsys, leg_results, requested=None):
+    """Run _orchestrate with faked pick_platform + _run_leg; returns the
+    printed JSON artifact. ``leg_results`` maps leg name → list of
+    (dict|None, status) consumed per call. ``requested``, if given, collects
+    every (leg, platform) the orchestrator asked for — the downgrade
+    contract is about REQUESTS, not canned results."""
+    calls = {}
+
+    def fake_run_leg(name, args, platform):
+        if requested is not None:
+            requested.append((name, platform))
+        seq = leg_results[name]
+        result = seq[min(calls.get(name, 0), len(seq) - 1)]
+        calls[name] = calls.get(name, 0) + 1
+        return result
+
+    monkeypatch.setattr(bench, "_run_leg", fake_run_leg)
+    import ipc_proofs_tpu.utils.platform as plat
+
+    monkeypatch.setattr(plat, "pick_platform", lambda *a, **k: "default")
+    bench._orchestrate(_args())
+    return json.loads(capsys.readouterr().out.strip())
+
+
+_E2E_OK = {
+    "metric": "event_proofs_per_sec_4k_range_e2e",
+    "value": 5000.0,
+    "unit": "proofs/s",
+    "platform": "cpu",
+    "devices": 1,
+    "host_cores": 1,
+    "scan_threads": 1,
+    "pipeline_chunk": 4096,
+    "events_per_sec_e2e": 2e6,
+    "proofs": 656,
+    "stages_ms": {"scan": 50.0},
+    "stages_overlap": False,
+}
+
+
+class TestOrchestrate:
+    def test_happy_path_ratios(self, monkeypatch, capsys):
+        out = _orchestrate_with(monkeypatch, capsys, {
+            "e2e": [(dict(_E2E_OK, platform="tpu"), "ok:tpu")],
+            "kernel": [({"device_mask_kernel_events_per_sec": 6e9}, "ok:tpu")],
+            "cid": [({"witness_cid_kernel_per_sec": 1e8}, "ok:tpu")],
+            "baseline": [({"scalar_baseline_proofs_per_sec": 125.0}, "ok:cpu")],
+            "native_baseline": [({"native_baseline_proofs_per_sec": 1000.0}, "ok:cpu")],
+        })
+        assert out["value"] == 5000.0
+        assert out["vs_baseline"] == 40.0
+        assert out["vs_native_baseline"] == 5.0
+        assert out["watchdog_fallback"] is False
+        assert out["legs"]["e2e"] == "ok:tpu"
+
+    def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
+        requested = []
+        out = _orchestrate_with(monkeypatch, capsys, {
+            "e2e": [(None, "timeout:default"), (dict(_E2E_OK), "ok:cpu")],
+            "kernel": [({"device_mask_kernel_events_per_sec": 1e8}, "ok:cpu")],
+            "cid": [({"witness_cid_kernel_per_sec": 1e4}, "ok:cpu")],
+            "baseline": [({"scalar_baseline_proofs_per_sec": 100.0}, "ok:cpu")],
+            "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
+        }, requested=requested)
+        assert out["watchdog_fallback"] is True
+        assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
+        assert out["value"] == 5000.0
+        assert out["vs_baseline"] == 50.0
+        # after the e2e STALL the device legs must actually be REQUESTED on
+        # cpu (not just reported as cpu by the canned results)
+        assert requested == [
+            ("e2e", "default"), ("e2e", "cpu"), ("kernel", "cpu"),
+            ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
+        ]
+
+    def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
+        out = _orchestrate_with(monkeypatch, capsys, {
+            "e2e": [(dict(_E2E_OK, platform="tpu"), "ok:tpu")],
+            "kernel": [(None, "timeout:default")],
+            "cid": [({"witness_cid_kernel_per_sec": 1e4}, "ok:cpu")],
+            "baseline": [({"scalar_baseline_proofs_per_sec": 100.0}, "ok:cpu")],
+            "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
+        })
+        assert out["value"] == 5000.0  # headline survives
+        assert out["device_mask_kernel_events_per_sec"] is None
+        assert out["witness_cid_kernel_per_sec"] == 1e4
+        assert out["watchdog_fallback"] is True
+
+    def test_fast_crash_keeps_the_chip(self, monkeypatch, capsys):
+        """A leg that CRASHES quickly (rc!=0) is not a tunnel stall: later
+        device legs must still be requested on the chip platform and
+        watchdog_fallback must stay False."""
+        requested = []
+
+        def fake_run_leg(name, args, platform):
+            requested.append((name, platform))
+            if name == "kernel":
+                return None, f"error:{platform}"
+            if name == "e2e":
+                return dict(_E2E_OK, platform="tpu"), "ok:tpu"
+            if name == "cid":
+                return {"witness_cid_kernel_per_sec": 1e8}, "ok:tpu"
+            return {f"{'scalar' if name == 'baseline' else 'native'}_baseline_proofs_per_sec": 100.0}, "ok:cpu"
+
+        monkeypatch.setattr(bench, "_run_leg", fake_run_leg)
+        import ipc_proofs_tpu.utils.platform as plat
+
+        monkeypatch.setattr(plat, "pick_platform", lambda *a, **k: "default")
+        bench._orchestrate(_args())
+        out = json.loads(capsys.readouterr().out.strip())
+        assert ("cid", "default") in requested  # chip NOT forfeited
+        assert out["watchdog_fallback"] is False
+        assert out["device_mask_kernel_events_per_sec"] is None
+        assert out["legs"]["kernel"] == "error:default"
+
+    def test_total_failure_emits_full_null_schema(self, monkeypatch, capsys):
+        out = _orchestrate_with(monkeypatch, capsys, {
+            "e2e": [(None, "timeout:default"), (None, "timeout:cpu")],
+            "kernel": [(None, "timeout:cpu")],
+            "cid": [(None, "timeout:cpu")],
+            "baseline": [(None, "error:cpu")],
+            "native_baseline": [(None, "error:cpu")],
+        })
+        # the artifact still prints, with every headline key present + null
+        for key in (
+            "value", "platform", "devices", "host_cores", "scan_threads",
+            "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
+            "stages_overlap", "vs_baseline", "vs_native_baseline",
+            "device_mask_kernel_events_per_sec", "witness_cid_kernel_per_sec",
+        ):
+            assert key in out and out[key] is None, key
+        assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"
+        assert out["watchdog_fallback"] is True
