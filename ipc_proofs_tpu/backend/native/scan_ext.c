@@ -118,7 +118,55 @@ static int rd_head(Parser *p, int *major, uint64_t *value) {
   return info;
 }
 
-static int skip_item(Parser *p) {
+/* strict UTF-8 (same table as the decoders: no overlongs, no surrogates,
+ * max U+10FFFF) — validating skip must reject exactly what they reject */
+static int scan_utf8_valid(const uint8_t *s, Py_ssize_t n) {
+  Py_ssize_t i = 0;
+  while (i < n) {
+    uint8_t c = s[i];
+    if (c < 0x80) {
+      i++;
+    } else if (c < 0xC2) {
+      return 0;
+    } else if (c < 0xE0) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return 0;
+      i += 2;
+    } else if (c < 0xF0) {
+      if (i + 2 >= n || (s[i + 1] & 0xC0) != 0x80 || (s[i + 2] & 0xC0) != 0x80)
+        return 0;
+      if (c == 0xE0 && s[i + 1] < 0xA0) return 0;
+      if (c == 0xED && s[i + 1] >= 0xA0) return 0;
+      i += 3;
+    } else if (c < 0xF5) {
+      if (i + 3 >= n || (s[i + 1] & 0xC0) != 0x80 ||
+          (s[i + 2] & 0xC0) != 0x80 || (s[i + 3] & 0xC0) != 0x80)
+        return 0;
+      if (c == 0xF0 && s[i + 1] < 0x90) return 0;
+      if (c == 0xF4 && s[i + 1] >= 0x90) return 0;
+      i += 4;
+    } else {
+      return 0;
+    }
+  }
+  return 1;
+}
+
+/* VALIDATING skip, mirroring the decoders' DAG-CBOR acceptance (strict
+ * UTF-8 text, string map keys, only tag 42 with structurally valid CID
+ * bytes, only simple values false/true/null and f64). The lax skip this
+ * replaced let a witness block hide garbage in positions the targeted
+ * walk skips (receipt return_data, sibling entries) that the scalar
+ * replay's full decode rejects — a batch-accepts/scalar-rejects verdict
+ * divergence. The explicit depth budget also bounds recursion: the old
+ * skip recursed per nesting level with no cap, so a crafted block of tens
+ * of thousands of nested arrays could overflow the C stack. */
+#define SCAN_MAX_CBOR_DEPTH 512
+
+static int scan_cid_valid(const uint8_t *d, Py_ssize_t n);
+
+static int skip_item_d(Parser *p, int depth) {
+  if (depth >= SCAN_MAX_CBOR_DEPTH)
+    return walk_err(E_VALUE, "CBOR nesting too deep");
   int major;
   uint64_t value;
   int info = rd_head(p, &major, &value);
@@ -128,30 +176,78 @@ static int skip_item(Parser *p) {
     case 1:
       return 0;
     case 2:
+      /* unsigned compare: a crafted length >= 2^63 must fail here, not
+       * wrap the signed cast and drive pos negative (OOB read) */
+      if ((uint64_t)(p->len - p->pos) < value)
+        return walk_err(E_VALUE, "truncated CBOR bytes/text");
+      p->pos += (Py_ssize_t)value;
+      return 0;
     case 3:
-      if (p->pos + (Py_ssize_t)value > p->len) {
-        walk_err(E_VALUE, "truncated CBOR bytes/text");
-        return -1;
-      }
+      if ((uint64_t)(p->len - p->pos) < value)
+        return walk_err(E_VALUE, "truncated CBOR bytes/text");
+      if (!scan_utf8_valid(p->data + p->pos, (Py_ssize_t)value))
+        return walk_err(E_VALUE, "invalid UTF-8 in CBOR text");
       p->pos += (Py_ssize_t)value;
       return 0;
     case 4:
+      if ((uint64_t)(p->len - p->pos) < value)
+        return walk_err(E_VALUE, "CBOR array length exceeds input");
       for (uint64_t i = 0; i < value; i++)
-        if (skip_item(p) < 0) return -1;
+        if (skip_item_d(p, depth + 1) < 0) return -1;
       return 0;
     case 5:
       for (uint64_t i = 0; i < value; i++) {
-        if (skip_item(p) < 0) return -1;
-        if (skip_item(p) < 0) return -1;
+        Py_ssize_t key_at = p->pos;
+        if (skip_item_d(p, depth + 1) < 0) return -1;
+        if ((p->data[key_at] >> 5) != 3)
+          return walk_err(E_VALUE, "DAG-CBOR map keys must be strings");
+        if (skip_item_d(p, depth + 1) < 0) return -1;
       }
       return 0;
-    case 6:
-      return skip_item(p);
-    case 7:
+    case 6: {
+      if (value != 42) return walk_err(E_VALUE, "unsupported CBOR tag");
+      /* tag content consumes a nesting level in BOTH decoders (native
+       * depth_enter, Python depth + 1) — budget it here too, or blocks
+       * at the 512-depth boundary validate clean while the scalar decode
+       * rejects them */
+      if (depth + 1 >= SCAN_MAX_CBOR_DEPTH)
+        return walk_err(E_VALUE, "CBOR nesting too deep");
+      int imajor;
+      uint64_t ival;
+      if (rd_head(p, &imajor, &ival) < 0) return -1;
+      if (imajor != 2)
+        return walk_err(E_VALUE,
+                        "tag-42 content must be identity-multibase CID bytes");
+      if ((uint64_t)(p->len - p->pos) < ival)
+        return walk_err(E_VALUE, "truncated CBOR bytes/text");
+      const uint8_t *content = p->data + p->pos;
+      p->pos += (Py_ssize_t)ival;
+      if (ival < 1 || content[0] != 0)
+        return walk_err(E_VALUE,
+                        "tag-42 content must be identity-multibase CID bytes");
+      if (!scan_cid_valid(content + 1, (Py_ssize_t)ival - 1))
+        return walk_err(E_VALUE, "malformed CID bytes in tag 42");
       return 0;
+    }
+    case 7:
+      if (info == 27 || value == 20 || value == 21 || value == 22) return 0;
+      return walk_err(E_VALUE, "unsupported CBOR simple value");
   }
-  walk_err(E_VALUE, "unreachable CBOR major");
-  return -1;
+  return walk_err(E_VALUE, "unreachable CBOR major");
+}
+
+static int skip_item(Parser *p) { return skip_item_d(p, 0); }
+
+/* full-block validation: the whole block must be ONE well-formed DAG-CBOR
+ * item with nothing trailing — exactly what the scalar paths establish by
+ * cbor_decode()ing every block they load. Applied per fetched block on
+ * the verify-side walkers (Scan.validate). */
+static int validate_block(const uint8_t *data, Py_ssize_t len) {
+  Parser q = {data, len, 0};
+  if (skip_item_d(&q, 0) < 0) return -1;
+  if (q.pos != q.len)
+    return walk_err(E_VALUE, "trailing bytes after CBOR item");
+  return 0;
 }
 
 /* expect an array head, return its length */
@@ -170,7 +266,8 @@ static int rd_bytes(Parser *p, const uint8_t **ptr, Py_ssize_t *blen) {
   int major;
   uint64_t value;
   if (rd_head(p, &major, &value) < 0) return -1;
-  if (major != 2 || p->pos + (Py_ssize_t)value > p->len) {
+  /* unsigned compare — a length >= 2^63 must fail, not wrap the cast */
+  if (major != 2 || (uint64_t)(p->len - p->pos) < value) {
     walk_err(E_VALUE, "expected CBOR bytes");
     return -1;
   }
@@ -189,6 +286,42 @@ static int rd_uint(Parser *p, uint64_t *value) {
     return -1;
   }
   return 0;
+}
+
+/* uvarint with the same acceptance as core/varint.decode_uvarint (shift
+ * capped so values stay under 2^70; non-minimal encodings accepted) */
+static int scan_cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
+                            unsigned __int128 *out) {
+  unsigned __int128 value = 0;
+  int shift = 0;
+  for (;;) {
+    if (*pos >= n) return -1; /* truncated uvarint */
+    uint8_t b = d[(*pos)++];
+    value |= (unsigned __int128)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = value;
+      return 0;
+    }
+    shift += 7;
+    if (shift > 63) return -1; /* uvarint too long */
+  }
+}
+
+/* structural CID validation, mirroring CID.from_bytes acceptance (version
+ * must be 1; digest length must equal the mh_len varint; no trailing
+ * bytes). The Python decoders validate EVERY CID in a node they decode, so
+ * the scanner must reject the same bytes — otherwise a witness node whose
+ * unrelated sibling entry carries a corrupt CID scans clean here while the
+ * scalar replay rejects it, and the two verify paths diverge (found by
+ * tests/test_batch_verifier_fuzz.py). */
+static int scan_cid_valid(const uint8_t *d, Py_ssize_t n) {
+  Py_ssize_t pos = 0;
+  unsigned __int128 version, codec, mh_code, mh_len;
+  if (scan_cid_uvarint(d, n, &pos, &version) < 0 || version != 1) return 0;
+  if (scan_cid_uvarint(d, n, &pos, &codec) < 0) return 0;
+  if (scan_cid_uvarint(d, n, &pos, &mh_code) < 0) return 0;
+  if (scan_cid_uvarint(d, n, &pos, &mh_len) < 0) return 0;
+  return (unsigned __int128)(n - pos) == mh_len;
 }
 
 /* tag-42 CID: returns span of cid bytes (multibase 0x00 stripped), or
@@ -211,6 +344,10 @@ static int rd_cid_or_null(Parser *p, const uint8_t **ptr, Py_ssize_t *clen, int 
   if (rd_bytes(p, &raw, &rlen) < 0) return -1;
   if (rlen < 2 || raw[0] != 0) {
     walk_err(E_VALUE, "tag-42 must hold identity-multibase CID");
+    return -1;
+  }
+  if (!scan_cid_valid(raw + 1, rlen - 1)) {
+    walk_err(E_VALUE, "malformed CID bytes in tag 42");
     return -1;
   }
   *ptr = raw + 1;
@@ -286,6 +423,15 @@ typedef struct {
   const struct CMap *cmap; /* optional GIL-free snapshot of `blocks` */
   int skip_missing;   /* 1 = prune subtrees whose blocks are absent */
   int want_payload;   /* 1 = fill the payload pools */
+  int validate;       /* 1 = full-block DAG-CBOR validation per fetch
+                       * (verify-side callers: adversarial witness bytes
+                       * must not scan clean where the scalar replay's
+                       * full decode rejects them). Validation re-runs on
+                       * re-fetches of the same block; today's verify-side
+                       * callers walk <= 1 key/path per root, so the
+                       * redundancy is bounded — add a per-Scan seen-memo
+                       * before pointing a many-keys-per-root caller at
+                       * this flag. */
   /* optional touched-block recording (the exec-order walker's witness leg):
    * every successful get_block appends (offset, len) + cid bytes */
   Vec *touch_pool;
@@ -422,6 +568,7 @@ static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
       return walk_err(E_TYPE, "block map values must be bytes");
     out->data = e->val;
     out->len = e->vlen;
+    if (s->validate && validate_block(out->data, out->len) < 0) return -1;
     return 1;
   }
   PyObject *key = PyBytes_FromStringAndSize((const char *)cid, clen);
@@ -437,6 +584,10 @@ static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     out->obj = hit;
     out->data = (const uint8_t *)PyBytes_AS_STRING(hit);
     out->len = PyBytes_GET_SIZE(hit);
+    if (s->validate && validate_block(out->data, out->len) < 0) {
+      block_release(out);
+      return -1;
+    }
     return 1;
   }
   if (PyErr_Occurred()) {
@@ -459,6 +610,10 @@ static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
     out->obj = res;
     out->data = (const uint8_t *)PyBytes_AS_STRING(res);
     out->len = PyBytes_GET_SIZE(res);
+    if (s->validate && validate_block(out->data, out->len) < 0) {
+      block_release(out);
+      return -1;
+    }
     return 1;
   }
   Py_DECREF(key);
@@ -1078,13 +1233,15 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *fallback = Py_None;
   PyObject *match_fp_obj = Py_None, *match_actor_obj = Py_None;
-  int skip_missing = 0, want_payload = 0;
+  int skip_missing = 0, want_payload = 0, validate_blocks = 0;
   static char *kwlist[] = {"blocks", "roots", "fallback", "skip_missing",
-                           "want_payload", "match_fp", "match_actor", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|OppOO", kwlist,
+                           "want_payload", "match_fp", "match_actor",
+                           "validate_blocks", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|OppOOp", kwlist,
                                    &PyDict_Type, &blocks, &roots, &fallback,
                                    &skip_missing, &want_payload,
-                                   &match_fp_obj, &match_actor_obj))
+                                   &match_fp_obj, &match_actor_obj,
+                                   &validate_blocks))
     return NULL;
   PyObject *seq = PySequence_Fast(roots, "roots must be a sequence of cid bytes");
   if (!seq) return NULL;
@@ -1096,6 +1253,7 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
   s.fallback = fallback;
   s.skip_missing = skip_missing;
   s.want_payload = want_payload;
+  s.validate = validate_blocks;
   if (match_fp_obj != Py_None) {
     if (want_payload) {
       PyErr_SetString(PyExc_ValueError,
@@ -1392,12 +1550,12 @@ static int txmeta_is_canonical(const uint8_t *raw, Py_ssize_t rlen,
 static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
                                         PyObject *kwargs) {
   PyObject *blocks, *groups, *fallback = Py_None;
-  int headers = 1, want_touched = 1;
+  int headers = 1, want_touched = 1, validate_blocks = 0;
   static char *kwlist[] = {"blocks", "groups", "fallback", "headers",
-                           "want_touched", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Opp", kwlist,
+                           "want_touched", "validate_blocks", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Oppp", kwlist,
                                    &PyDict_Type, &blocks, &groups, &fallback,
-                                   &headers, &want_touched))
+                                   &headers, &want_touched, &validate_blocks))
     return NULL;
   PyObject *gseq = PySequence_Fast(groups, "groups must be a sequence");
   if (!gseq) return NULL;
@@ -1408,6 +1566,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
   memset(&s, 0, sizeof(s));
   s.blocks = blocks;
   s.fallback = fallback;
+  s.validate = validate_blocks;
 
   Vec msg_pool = {0}, msg_off = {0}, msg_len = {0}, msg_goff = {0};
   Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
@@ -2014,14 +2173,15 @@ static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
 static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *owners, *keys, *fallback = Py_None;
-  int bit_width = 5, skip_missing = 0, want_touched = 0;
+  int bit_width = 5, skip_missing = 0, want_touched = 0, validate_blocks = 0;
   static char *kwlist[] = {"blocks",      "roots",        "owners",
                            "keys",        "bit_width",    "fallback",
-                           "skip_missing", "want_touched", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOpp", kwlist,
+                           "skip_missing", "want_touched", "validate_blocks",
+                           NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOppp", kwlist,
                                    &PyDict_Type, &blocks, &roots, &owners,
                                    &keys, &bit_width, &fallback, &skip_missing,
-                                   &want_touched))
+                                   &want_touched, &validate_blocks))
     return NULL;
   if (bit_width < 1 || bit_width > 8) {
     PyErr_SetString(PyExc_ValueError, "bit_width must be in [1, 8]");
@@ -2047,6 +2207,7 @@ static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
   s.blocks = blocks;
   s.fallback = fallback;
   s.skip_missing = skip_missing;
+  s.validate = validate_blocks;
 
   Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(rseq);
   Py_ssize_t n = PySequence_Fast_GET_SIZE(kseq);

@@ -94,10 +94,23 @@ class AMT:
             raise ValueError(f"unrecognized AMT root arity {len(root)}")
         if expected_version is not None and version != expected_version:
             raise ValueError(f"expected AMT v{expected_version}, found v{version}")
+        # u64-serde parity: the reference's root fields deserialize as
+        # unsigned integers (fvm_ipld_amt), so a CBOR negint / bool / bytes
+        # in any of them must fail the load — the native walker already
+        # rejects these (rd_uint), and accepting them here made the scalar
+        # path verify roots the reference (and the batch path) reject
+        # (found by tests/test_batch_verifier_fuzz.py: count = -3)
+        for field_name, value in (
+            ("bit width", bit_width), ("height", height), ("count", count)
+        ):
+            if not isinstance(value, int) or isinstance(value, bool):
+                raise ValueError(f"AMT root {field_name} must be an unsigned int")
         if not 1 <= bit_width <= 8:
             raise ValueError(f"invalid AMT bit width {bit_width}")
         if not 0 <= height <= _MAX_HEIGHT:
             raise ValueError(f"invalid AMT height {height}")
+        if not 0 <= count < 1 << 64:
+            raise ValueError(f"invalid AMT count {count}")
         return cls(store, root_cid, bit_width, height, count, node, version)
 
     # -- node access --------------------------------------------------------

@@ -67,6 +67,7 @@ def hamt_get_batch(
     keys: "list[bytes]",
     bit_width: int = HAMT_BIT_WIDTH,
     skip_missing: bool = False,
+    validate_blocks: bool = False,
 ) -> "Optional[list[Optional[Any]]]":
     """Batched ``HAMT.get``: ONE C call walks a root→bucket path per
     (owner root, key) — the storage-side analog of the native receipts
@@ -78,7 +79,10 @@ def hamt_get_batch(
     scalar reader's behavior; ``skip_missing=True`` instead treats a
     missing node as an absent key (the batch verifiers' tolerant mode,
     mirroring the scalar caller's caught-KeyError → unverified). Value
-    decoding is the shared DAG-CBOR path."""
+    decoding is the shared DAG-CBOR path. ``validate_blocks`` full-validates
+    every fetched node block (verify-side callers — adversarial witness
+    bytes in positions the targeted walk skips must fail like the scalar
+    reader's full decode)."""
     from ipc_proofs_tpu.backend.native import load_scan_ext
     from ipc_proofs_tpu.proofs.scan_native import _raw_view, split_pooled
 
@@ -94,6 +98,7 @@ def hamt_get_batch(
         bit_width=bit_width,
         fallback=fallback,
         skip_missing=skip_missing,
+        validate_blocks=validate_blocks,
     )
     found = out["found"]
     spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])

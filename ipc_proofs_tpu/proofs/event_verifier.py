@@ -261,7 +261,8 @@ def _verify_proofs_batch(
     # falls back to scalar replay so per-proof error semantics hold.
     try:
         scan = scan_events_flat(
-            store, pending_roots, skip_missing=True, want_payload=True
+            store, pending_roots, skip_missing=True, want_payload=True,
+            validate_blocks=True,
         )
     except (KeyError, ValueError):
         scan = None

@@ -93,8 +93,12 @@ def _native_exec_orders(
     groups: list[list[CID]],
     headers: bool,
     want_touched: bool = True,
+    validate_blocks: bool = False,
 ):
-    """Raw C-walker call; None when the extension is unavailable or errors."""
+    """Raw C-walker call; None when the extension is unavailable or errors.
+
+    ``validate_blocks`` full-validates every fetched block (verify-side
+    callers only — the store holds adversarial witness bytes there)."""
     from ipc_proofs_tpu.backend.native import load_scan_ext
     from ipc_proofs_tpu.proofs.scan_native import _raw_view
 
@@ -109,6 +113,7 @@ def _native_exec_orders(
             fallback,
             headers=headers,
             want_touched=want_touched,
+            validate_blocks=validate_blocks,
         )
     except Exception:
         return None
@@ -197,18 +202,35 @@ def reconstruct_execution_orders_batch(
     """
     import hashlib
 
-    out = _native_exec_orders(store, groups, headers=True, want_touched=False)
+    out = _native_exec_orders(
+        store, groups, headers=True, want_touched=False, validate_blocks=True
+    )
     if out is None:
         return None
     views = _unpack_groups(out, len(groups), want_touched=False)
 
     _CHAIN_PREFIX = b"\x01\x71\xa0\xe4\x02\x20"  # CIDv1 dag-cbor blake2b-256
+
+    def _scalar_redo(g: int) -> "Optional[list[bytes]]":
+        """Settle one group with the scalar reconstruction — the verdict
+        authority. Used both when the C walk rejects something (any
+        residual acceptance gap between the walkers, either direction,
+        must not become a verdict divergence — the fuzz sweep found
+        exactly that with a root count the C walker rejects (u64) and the
+        Python reader of the time accepted) and for non-canonical TxMeta
+        raws."""
+        try:
+            order = reconstruct_execution_order(store, groups[g])
+            return [c.to_bytes() for c in order]
+        except (KeyError, ValueError):
+            return None
+
     results: list[Optional[list[bytes]]] = []
     recompute_group: list[int] = []  # deferred TxMeta CID recomputes
     recompute_cids: list[bytes] = []
     for g, view in enumerate(views):
         if view.failed:
-            results.append(None)
+            results.append(_scalar_redo(g))
             continue
         ok = True
         # strict header validation (scalar parity — see docstring);
@@ -248,11 +270,7 @@ def reconstruct_execution_orders_batch(
                     del recompute_cids[mark:]
                     break
         if scalar_fallback:
-            try:
-                order = reconstruct_execution_order(store, groups[g])
-                results.append([c.to_bytes() for c in order])
-            except (KeyError, ValueError):
-                results.append(None)
+            results.append(_scalar_redo(g))
             continue
         results.append(view.msgs if ok else None)
 

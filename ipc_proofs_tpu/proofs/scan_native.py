@@ -317,6 +317,7 @@ def scan_events_flat(
     receipts_roots: Sequence[CID],
     skip_missing: bool = False,
     want_payload: bool = False,
+    validate_blocks: bool = False,
 ) -> Optional[ScanBatch]:
     """Scan every receipts AMT in ``receipts_roots``; None if the native
     extension is unavailable (callers use the Python scan path).
@@ -325,7 +326,11 @@ def scan_events_flat(
     raising — the tolerant mode the batch verifier uses over pruned witness
     stores (a proof whose path is missing simply finds no row → False).
     ``want_payload`` additionally pools the full topics/data bytes per event
-    for claim comparison.
+    for claim comparison. ``validate_blocks`` full-validates every fetched
+    block as one trailing-free DAG-CBOR item — REQUIRED when the store
+    holds adversarial witness bytes (the batch verifier), so garbage in
+    positions the targeted walk skips cannot scan clean where the scalar
+    replay's full decode rejects it.
     """
     from ipc_proofs_tpu.backend.native import load_scan_ext
 
@@ -339,6 +344,7 @@ def scan_events_flat(
         fallback,
         skip_missing=skip_missing,
         want_payload=want_payload,
+        validate_blocks=validate_blocks,
     )
     n = out["n_events"]
     return ScanBatch(

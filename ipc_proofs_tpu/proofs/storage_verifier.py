@@ -187,9 +187,13 @@ def verify_storage_proofs_batch(
         keys.append(Address.new_id(actor_id).to_bytes())
         live_pairs.append(pos)
     # tolerant mode: a missing actors-tree node makes the dependent proofs
-    # False (the scalar path's caught KeyError), never aborts the batch
+    # False (the scalar path's caught KeyError), never aborts the batch.
+    # validate_blocks: witness bytes are adversarial here — any fetched
+    # node must be a fully well-formed DAG-CBOR item, as the scalar
+    # reader's cbor_decode of the same node establishes.
     values = hamt_get_batch(
-        store, walk_roots, owners, keys, bit_width=HAMT_BIT_WIDTH, skip_missing=True
+        store, walk_roots, owners, keys, bit_width=HAMT_BIT_WIDTH,
+        skip_missing=True, validate_blocks=True,
     )
     assert values is not None  # availability probed above
     pair_actor: list[Optional[ActorState]] = [None] * len(pair_order)

@@ -1,0 +1,310 @@
+"""Seeded randomized differential fuzz: batch ↔ scalar event verification.
+
+The parametrized tamper cases in test_batch_verifier.py pin known attack
+shapes; this sweep drives BOTH verify paths through hundreds of randomly
+mutated bundles — claim-field garbage (wrong/huge/negative/float indices,
+malformed hex, swapped CIDs, shuffled proofs) and witness damage (dropped
+and bit-flipped blocks) — asserting the grouped batch replay agrees with
+the scalar loop on every verdict vector AND on every raised exception
+(type and message). Any divergence is a parity bug by the module's own
+contract (`event_verifier.verify_event_proof` docstring).
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.proofs.bundle import EventProofBundle, ProofBlock
+from ipc_proofs_tpu.proofs.event_verifier import verify_event_proof
+from ipc_proofs_tpu.proofs.scan_native import native_scan_available
+
+from tests.test_batch_verifier import make_bundle
+
+pytestmark = pytest.mark.skipif(
+    not native_scan_available(), reason="native scan extension unavailable"
+)
+
+
+def _outcome(bundle, batch):
+    """Run one path; capture ("ok", verdicts) or ("raise", type, message).
+
+    Agreement is asserted on the outcome kind, the verdict vector, and the
+    exception FAMILY (KeyError vs the ValueError family — the only classes
+    the verifier's own error handling distinguishes). Exact types and
+    messages are carried for debugging but not compared: the two paths
+    parse malformed inputs through different implementations of the same
+    acceptance set, which reject with different wordings ('truncated CID
+    multihash digest' vs 'malformed CID bytes') and occasionally different
+    ValueError subclasses (the decoders surface invalid CBOR text as
+    UnicodeDecodeError, the scanner's validating skip as plain
+    ValueError)."""
+    accept = lambda *_: True
+    try:
+        return ("ok", verify_event_proof(bundle, accept, accept, batch=batch))
+    except Exception as exc:  # noqa: BLE001 — parity includes the exception
+        family = (
+            "KeyError"
+            if isinstance(exc, KeyError)
+            else "ValueError"
+            if isinstance(exc, ValueError)
+            else type(exc).__name__
+        )
+        return ("raise", family, type(exc).__name__, str(exc))
+
+
+def _comparable(outcome):
+    """Collapse an outcome to what the parity contract actually promises.
+
+    - ("ok", verdicts): verdict vectors must be identical.
+    - both raise: the verifier aborts through exactly two families —
+      KeyError (missing witness blocks) and ValueError (malformed bytes /
+      claims). When a bundle carries SEVERAL independent fatal conditions,
+      the two paths may surface different ones first (the batch path
+      batch-parses every group's CID strings before any witness access;
+      the scalar loop hits whatever its proof order reaches first), so
+      both-raise-within-the-abort-family counts as agreement. Anything
+      outside that family (TypeError, etc.) keeps its name — a path
+      crashing in an unplanned way must never be masked.
+    - one raises while the other returns verdicts: always a failure.
+    """
+    if outcome[0] == "ok":
+        return outcome[:2]
+    family = outcome[1]
+    return ("raise", "abort" if family in ("KeyError", "ValueError") else family)
+
+
+def _mutate_proof(rng: random.Random, proof):
+    """One random claim-field mutation (returns a new EventProof)."""
+    ed = proof.event_data
+    choice = rng.randrange(12)
+    if choice == 0:
+        return dataclasses.replace(
+            proof, exec_index=rng.choice([-1, 0, 3, 2**31, 2**63, 10**20])
+        )
+    if choice == 1:
+        return dataclasses.replace(
+            proof, event_index=rng.choice([-5, 1, 2**31 - 1, 2**40])
+        )
+    if choice == 2:  # JSON-plausible non-int indices
+        as_float = (
+            float(proof.exec_index)
+            if isinstance(proof.exec_index, int)
+            else 1.5  # proof already mutated to a non-number
+        )
+        return dataclasses.replace(
+            proof, exec_index=rng.choice([as_float, "0", None])
+        )
+    if choice == 3:
+        return dataclasses.replace(
+            proof, child_epoch=proof.child_epoch + rng.choice([-1, 1, 1000])
+        )
+    if choice == 4:
+        return dataclasses.replace(
+            proof, parent_epoch=proof.parent_epoch + rng.choice([-1, 1])
+        )
+    if choice == 5:
+        return dataclasses.replace(
+            proof,
+            message_cid=str(CID.hash_of(rng.randbytes(8), codec=RAW)),
+        )
+    if choice == 6:  # malformed CID strings
+        return dataclasses.replace(
+            proof,
+            child_block_cid=rng.choice(
+                ["", "b", "not-a-cid", proof.child_block_cid[:-1]]
+            ),
+        )
+    if choice == 7:
+        return dataclasses.replace(
+            proof,
+            parent_tipset_cids=rng.choice(
+                [
+                    [],
+                    list(reversed(proof.parent_tipset_cids)) * 2,
+                    [str(CID.hash_of(rng.randbytes(4)))],
+                ]
+            ),
+        )
+    if choice == 8:
+        return dataclasses.replace(
+            proof, event_data=dataclasses.replace(ed, emitter=rng.randrange(5000))
+        )
+    if choice == 9:
+        topics = list(ed.topics)
+        if topics:
+            i = rng.randrange(len(topics))
+            t = topics[i]
+            topics[i] = rng.choice(
+                [
+                    t.upper().replace("0X", "0x"),
+                    t[:-1],
+                    t + "0",
+                    t.removeprefix("0x"),
+                    t[:6] + " " + t[6:],
+                    "0x" + "cd" * 32,
+                ]
+            )
+        return dataclasses.replace(
+            proof, event_data=dataclasses.replace(ed, topics=topics)
+        )
+    if choice == 10:
+        return dataclasses.replace(
+            proof,
+            event_data=dataclasses.replace(
+                ed,
+                data=rng.choice(
+                    [ed.data + "ff", ed.data[:-1], "0x" + "0" * 63, ""]
+                ),
+            ),
+        )
+    return dataclasses.replace(
+        proof, event_data=dataclasses.replace(ed, topics=ed.topics + [ed.data])
+    )
+
+
+def _mutate_bundle(rng: random.Random, proofs, blocks):
+    """Apply one structural mutation; returns (proofs, blocks)."""
+    kind = rng.randrange(10)
+    if kind == 0 and blocks:  # drop a witness block
+        drop = rng.randrange(len(blocks))
+        return proofs, [b for i, b in enumerate(blocks) if i != drop]
+    if kind == 1 and blocks:  # bit-flip inside a witness block (CID kept)
+        i = rng.randrange(len(blocks))
+        data = bytearray(blocks[i].data)
+        if data:
+            data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+        blocks = list(blocks)
+        blocks[i] = ProofBlock(cid=blocks[i].cid, data=bytes(data))
+        return proofs, blocks
+    if kind == 2 and len(proofs) >= 2:  # cross-wire two proofs' claims
+        i, j = rng.sample(range(len(proofs)), 2)
+        proofs = list(proofs)
+        proofs[i] = dataclasses.replace(
+            proofs[i],
+            message_cid=proofs[j].message_cid,
+            exec_index=proofs[j].exec_index,
+        )
+        return proofs, blocks
+    if kind == 3:  # duplicate a proof
+        proofs = list(proofs) + [rng.choice(proofs)]
+        return proofs, blocks
+    if kind == 4:  # shuffle proof order (groups re-form differently)
+        proofs = list(proofs)
+        rng.shuffle(proofs)
+        return proofs, blocks
+    # default: mutate 1-3 random proofs' claim fields
+    proofs = list(proofs)
+    for _ in range(rng.randrange(1, 4)):
+        i = rng.randrange(len(proofs))
+        proofs[i] = _mutate_proof(rng, proofs[i])
+    return proofs, blocks
+
+
+class TestAdversarialWitnessBytes:
+    """Crafted (not random) witness corruption in positions the C walker's
+    TARGETED parse skips but the scalar replay's full decode reads. Before
+    verify-side full-block validation (scan_ext Scan.validate), each of
+    these scanned clean in the batch path while the scalar path rejected
+    it — the exact batch-accepts/scalar-rejects soundness divergences from
+    the round-4 review."""
+
+    def _assert_agree(self, proofs, blocks):
+        mutated = EventProofBundle(proofs=proofs, blocks=blocks)
+        scalar = _outcome(mutated, batch=False)
+        batch = _outcome(mutated, batch=True)
+        assert _comparable(scalar) == _comparable(batch), (
+            f"scalar={scalar!r} batch={batch!r}"
+        )
+        return scalar
+
+    def test_unsupported_tag_in_skipped_receipt_field(self):
+        """Tag 43 spliced into a receipt's return_data — a field the
+        scanner skips; the scalar decode of the same node rejects it."""
+        bundle = make_bundle(n_pairs=1)
+        # receipt tuples in the fixture encode as [0, b'', gas, CID]:
+        # 0x84 0x00 0x40 0x1a... — replace the empty return_data (0x40)
+        # with tag 43 over a uint (0xd8 0x2b 0x00); arrays count items,
+        # not bytes, so the node stays structurally parseable
+        pattern = b"\x84\x00\x40\x1a"
+        hit = next(
+            (i for i, b in enumerate(bundle.blocks) if pattern in b.data), None
+        )
+        assert hit is not None, "fixture receipt-node shape changed"
+        data = bundle.blocks[hit].data
+        at = data.index(pattern)
+        garbled = data[: at + 2] + b"\xd8\x2b\x00" + data[at + 3 :]
+        blocks = list(bundle.blocks)
+        blocks[hit] = ProofBlock(cid=blocks[hit].cid, data=garbled)
+        outcome = self._assert_agree(bundle.proofs, blocks)
+        # and the corruption must actually bite: not all-True anymore
+        assert outcome[0] != "ok" or not all(outcome[1])
+
+    def test_trailing_bytes_after_any_block(self):
+        """A validly-framed block with garbage appended: cbor_decode
+        rejects trailing bytes, so the batch walk must too."""
+        bundle = make_bundle(n_pairs=1)
+        for i in range(len(bundle.blocks)):
+            blocks = list(bundle.blocks)
+            blocks[i] = ProofBlock(cid=blocks[i].cid, data=blocks[i].data + b"\x00")
+            self._assert_agree(bundle.proofs, blocks)
+
+    def test_deep_nesting_bomb_does_not_crash(self):
+        """A block of 100k nested arrays: the decoders cap nesting depth;
+        the scanner's skip must consume a depth budget rather than the C
+        stack (the pre-fix skip recursed uncapped — a segfault vector)."""
+        bundle = make_bundle(n_pairs=1)
+        bomb = b"\x81" * 100_000 + b"\x80"
+        for i in range(len(bundle.blocks)):
+            blocks = list(bundle.blocks)
+            blocks[i] = ProofBlock(cid=blocks[i].cid, data=bomb)
+            self._assert_agree(bundle.proofs, blocks)
+
+    def test_huge_length_header_no_oob(self):
+        """A bytes head claiming length 2^63: the bounds check must compare
+        unsigned — a signed cast wraps negative, passes the check, and
+        drives the parser out of bounds (crash) instead of rejecting."""
+        bundle = make_bundle(n_pairs=1)
+        huge = b"\x5b" + (1 << 63).to_bytes(8, "big")
+        for i in range(len(bundle.blocks)):
+            blocks = list(bundle.blocks)
+            blocks[i] = ProofBlock(cid=blocks[i].cid, data=huge)
+            self._assert_agree(bundle.proofs, blocks)
+
+    def test_depth_boundary_with_tag_content_agrees(self):
+        """Tag-42 content consumes a nesting level in the decoders; blocks
+        with a tag at the 512-depth boundary must validate (or fail)
+        identically in the scanner's skip."""
+        from ipc_proofs_tpu.core.cid import CID as _CID
+
+        cid_bytes = _CID.hash_of(b"x").to_bytes()
+        tag42 = b"\xd8\x2a" + bytes([0x58, len(cid_bytes) + 1]) + b"\x00" + cid_bytes
+        bundle = make_bundle(n_pairs=1)
+        for n_arrays in (510, 511, 512):
+            payload = b"\x81" * n_arrays + tag42
+            blocks = list(bundle.blocks)
+            blocks[0] = ProofBlock(cid=blocks[0].cid, data=payload)
+            self._assert_agree(bundle.proofs, blocks)
+
+
+@pytest.mark.parametrize("seed", [0xF3, 0xBEEF, 2026])
+def test_randomized_mutation_differential(seed):
+    rng = random.Random(seed)
+    base = make_bundle(n_pairs=2)
+    agree_raise = 0
+    for _ in range(150):
+        proofs, blocks = _mutate_bundle(rng, base.proofs, base.blocks)
+        # occasionally stack a second structural mutation
+        if rng.random() < 0.3:
+            proofs, blocks = _mutate_bundle(rng, proofs, blocks)
+        mutated = EventProofBundle(proofs=proofs, blocks=blocks)
+        scalar = _outcome(mutated, batch=False)
+        batch = _outcome(mutated, batch=True)
+        assert _comparable(scalar) == _comparable(batch), (
+            f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
+        )
+        if scalar[0] == "raise":
+            agree_raise += 1
+    # sanity: the sweep actually exercised both regimes
+    assert 0 < agree_raise < 150
