@@ -2111,7 +2111,9 @@ static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
     }
     for (uint64_t k = 0; k < n_kv; k++) {
       uint64_t kv_fields;
-      if (rd_array(&p, &kv_fields) < 0 || kv_fields < 2) {
+      /* exactly 2 — the reference's KeyValuePair is a serde 2-tuple, and
+       * the Python reader rejects != 2 identically */
+      if (rd_array(&p, &kv_fields) < 0 || kv_fields != 2) {
         block_release(&node);
         return walk_err(E_VALUE, "malformed HAMT bucket");
       }

@@ -111,6 +111,12 @@ class AMT:
             raise ValueError(f"invalid AMT height {height}")
         if not 0 <= count < 1 << 64:
             raise ValueError(f"invalid AMT count {count}")
+        # the INLINE root node must pass the same shape check _load_node
+        # applies to fetched nodes, or _node_parts leaks TypeError on a
+        # non-list node — outside the (KeyError, ValueError) family the
+        # verify paths map to verdicts
+        if not (isinstance(node, list) and len(node) == 3):
+            raise ValueError("malformed AMT node")
         return cls(store, root_cid, bit_width, height, count, node, version)
 
     # -- node access --------------------------------------------------------
@@ -124,11 +130,20 @@ class AMT:
             raise ValueError("malformed AMT node")
         return node
 
-    @staticmethod
-    def _node_parts(node: list) -> tuple[bytes, list, list]:
+    def _node_parts(self, node: list) -> tuple[bytes, list, list]:
         bmap, links, values = node
         if not isinstance(bmap, bytes):
             raise ValueError("AMT node bitmap must be bytes")
+        # malformed witness nodes must fail as ValueError, never leak
+        # IndexError/TypeError from downstream indexing — the verify paths
+        # map the (KeyError, ValueError) family to verdicts uniformly
+        if not isinstance(links, list) or not isinstance(values, list):
+            raise ValueError("AMT node links/values must be lists")
+        # the native walker requires at least `width` bitmap bits; reading
+        # absent bytes as zero here would verify nodes the batch path
+        # rejects
+        if len(bmap) * 8 < _width(self.bit_width):
+            raise ValueError("AMT bitmap too short")
         return bmap, links, values
 
     def get(self, index: int) -> Optional[Any]:
@@ -146,6 +161,8 @@ class AMT:
             if not (bits >> slot) & 1:
                 return None
             link_pos = (bits & ((1 << slot) - 1)).bit_count()
+            if link_pos >= len(links):
+                raise ValueError("malformed AMT node: bitmap exceeds links")
             node = self._load_node(links[link_pos])
         bmap, _, values = self._node_parts(node)
         bits = _bmap_int(bmap)
@@ -153,6 +170,8 @@ class AMT:
         if not (bits >> slot) & 1:
             return None
         value_pos = (bits & ((1 << slot) - 1)).bit_count()
+        if value_pos >= len(values):
+            raise ValueError("malformed AMT node: bitmap exceeds values")
         return values[value_pos]
 
     def for_each(self, fn: Callable[[int, Any], None]) -> None:
@@ -173,8 +192,12 @@ class AMT:
             if not (bits >> slot) & 1:
                 continue
             if height == 0:
+                if pos >= len(values):
+                    raise ValueError("malformed AMT node: bitmap exceeds values")
                 yield base + slot, values[pos]
             else:
+                if pos >= len(links):
+                    raise ValueError("malformed AMT node: bitmap exceeds links")
                 child = self._load_node(links[pos])
                 yield from self._walk(child, height - 1, base + slot * span)
             pos += 1

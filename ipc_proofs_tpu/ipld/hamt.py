@@ -165,12 +165,24 @@ class HAMT:
         if raw is None:
             raise KeyError(f"missing HAMT node {cid}")
         node = cbor_decode(raw)
-        if not (isinstance(node, list) and len(node) == 2 and isinstance(node[0], bytes)):
+        if not (
+            isinstance(node, list)
+            and len(node) == 2
+            and isinstance(node[0], bytes)
+            and isinstance(node[1], list)
+        ):
             raise ValueError("malformed HAMT node")
         return node
 
     def get(self, key: bytes) -> Optional[Any]:
-        """Value for ``key`` or None; walks one root-to-bucket path."""
+        """Value for ``key`` or None; walks one root-to-bucket path.
+
+        Malformed witness nodes raise ValueError — never IndexError or
+        TypeError: every caller on both verify paths maps the
+        (KeyError, ValueError) family to a verdict, so a leaked exception
+        class would turn the same corrupt node into an abort on one path
+        and a False on the other (found by the storage fuzz: a bitmap
+        claiming more entries than the pointer list holds)."""
         node = self._root
         depth = 0
         while True:
@@ -180,6 +192,8 @@ class HAMT:
             if not (bitfield >> idx) & 1:
                 return None
             pos = (bitfield & ((1 << idx) - 1)).bit_count()
+            if pos >= len(pointers):
+                raise ValueError("malformed HAMT node: bitmap exceeds pointers")
             ptr = pointers[pos]
             if isinstance(ptr, CID):
                 node = self._load_node(ptr)
@@ -187,6 +201,8 @@ class HAMT:
                 continue
             if isinstance(ptr, list):
                 for kv in ptr:
+                    if not (isinstance(kv, list) and len(kv) == 2):
+                        raise ValueError("malformed HAMT bucket entry")
                     if kv[0] == key:
                         return kv[1]
                 return None
@@ -203,9 +219,13 @@ class HAMT:
         for ptr in node[1]:
             if isinstance(ptr, CID):
                 yield from self._walk(self._load_node(ptr))
+            elif isinstance(ptr, list):
+                for kv in ptr:
+                    if not (isinstance(kv, list) and len(kv) == 2):
+                        raise ValueError("malformed HAMT bucket entry")
+                    yield kv[0], kv[1]
             else:
-                for key, value in ptr:
-                    yield key, value
+                raise ValueError(f"malformed HAMT pointer {type(ptr)}")
 
 
 def _build_node(

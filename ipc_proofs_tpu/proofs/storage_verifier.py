@@ -177,6 +177,11 @@ def verify_storage_proofs_batch(
     keys: list[bytes] = []
     live_pairs: list[int] = []  # positions in pair_order that reach the walk
     for pos, (root_str, actor_id) in enumerate(pair_order):
+        # address builds FIRST — the scalar step 4 evaluates
+        # Address.new_id(actor_id) as an argument before get_actor_state
+        # can hit (and catch) a missing StateRoot, so an invalid actor id
+        # must raise here even when the pair's walk would be skipped
+        key = Address.new_id(actor_id).to_bytes()
         actors_root = actors_roots[root_str]
         if actors_root is None:
             continue
@@ -184,7 +189,7 @@ def verify_storage_proofs_batch(
         if rpos == len(walk_roots):
             walk_roots.append(actors_root)
         owners.append(rpos)
-        keys.append(Address.new_id(actor_id).to_bytes())
+        keys.append(key)
         live_pairs.append(pos)
     # tolerant mode: a missing actors-tree node makes the dependent proofs
     # False (the scalar path's caught KeyError), never aborts the batch.

@@ -1,0 +1,238 @@
+"""Seeded randomized differential fuzz: batch ↔ scalar STORAGE verification.
+
+The event-side fuzz (test_batch_verifier_fuzz.py) found two real soundness
+divergences between the native batch walkers and the scalar replay; this
+sweep applies the same method to the storage pair — random claim-field
+garbage and witness damage, asserting `verify_storage_proofs_batch` agrees
+with the scalar `verify_storage_proof` loop on every verdict vector and on
+the abort family when both raise.
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID, RAW
+from ipc_proofs_tpu.proofs.bundle import ProofBlock
+from ipc_proofs_tpu.proofs.storage_verifier import (
+    verify_storage_proof,
+    verify_storage_proofs_batch,
+)
+from ipc_proofs_tpu.proofs.witness import load_witness_store
+
+from tests.test_storage_batch_verifier import _native_or_skip, make_storage_bundle
+
+ACCEPT = lambda *_: True
+
+
+def _outcome(proofs, blocks, batch):
+    """("ok", verdicts) or ("raise", family, type, message) — same contract
+    as the event fuzz's `_outcome` (see its docstring for why messages and
+    exact ValueError subclasses are not compared)."""
+    try:
+        store = load_witness_store(blocks, verify_cids=False)
+        if batch:
+            out = verify_storage_proofs_batch(store, proofs, ACCEPT)
+            assert out is not None  # native availability gated by the skip
+        else:
+            out = [verify_storage_proof(p, blocks, ACCEPT, store=store) for p in proofs]
+        return ("ok", out)
+    except Exception as exc:  # noqa: BLE001 — parity includes the exception
+        family = (
+            "KeyError"
+            if isinstance(exc, KeyError)
+            else "ValueError"
+            if isinstance(exc, ValueError)
+            else type(exc).__name__
+        )
+        return ("raise", family, type(exc).__name__, str(exc))
+
+
+def _comparable(outcome):
+    if outcome[0] == "ok":
+        return outcome[:2]
+    family = outcome[1]
+    return ("raise", "abort" if family in ("KeyError", "ValueError") else family)
+
+
+def _mutate_proof(rng: random.Random, proof):
+    choice = rng.randrange(9)
+    if choice == 0:
+        return dataclasses.replace(
+            proof, child_epoch=proof.child_epoch + rng.choice([-1, 1, 999])
+        )
+    if choice == 1:
+        return dataclasses.replace(
+            proof,
+            child_block_cid=rng.choice(
+                ["", "b", "junk", str(CID.hash_of(rng.randbytes(4)))]
+            ),
+        )
+    if choice == 2:
+        return dataclasses.replace(
+            proof,
+            parent_state_root=rng.choice(
+                [str(CID.hash_of(rng.randbytes(4))), proof.parent_state_root.upper()]
+            ),
+        )
+    if choice == 3:
+        return dataclasses.replace(
+            proof, actor_id=rng.choice([-1, 0, proof.actor_id + 1, 2**63])
+        )
+    if choice == 4:
+        return dataclasses.replace(
+            proof, actor_state_cid=str(CID.hash_of(rng.randbytes(4), codec=RAW))
+        )
+    if choice == 5:
+        return dataclasses.replace(
+            proof, storage_root=rng.choice(["", str(CID.hash_of(rng.randbytes(4)))])
+        )
+    if choice == 6:
+        slot = proof.slot
+        return dataclasses.replace(
+            proof,
+            slot=rng.choice(
+                [slot[:-1], slot + "0", slot.removeprefix("0x"), "0x" + "zz" * 32,
+                 slot.upper().replace("0X", "0x")]
+            ),
+        )
+    if choice == 7:
+        value = proof.value
+        return dataclasses.replace(
+            proof,
+            value=rng.choice(
+                ["0x" + "ff" * 32, value.upper().replace("0X", "0x"),
+                 value[:-2], value[2:], value[:6] + " " + value[6:]]
+            ),
+        )
+    return dataclasses.replace(
+        proof, slot=proof.value, value=proof.slot  # cross-wire the hex fields
+    )
+
+
+def _mutate(rng: random.Random, proofs, blocks):
+    kind = rng.randrange(8)
+    if kind == 0 and blocks:
+        drop = rng.randrange(len(blocks))
+        return proofs, [b for i, b in enumerate(blocks) if i != drop]
+    if kind == 1 and blocks:
+        i = rng.randrange(len(blocks))
+        data = bytearray(blocks[i].data)
+        if data:
+            data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+        blocks = list(blocks)
+        blocks[i] = ProofBlock(cid=blocks[i].cid, data=bytes(data))
+        return proofs, blocks
+    if kind == 2 and blocks:  # trailing garbage after a block
+        i = rng.randrange(len(blocks))
+        blocks = list(blocks)
+        blocks[i] = ProofBlock(cid=blocks[i].cid, data=blocks[i].data + b"\x00")
+        return proofs, blocks
+    if kind == 3 and len(proofs) >= 2:  # cross-wire two proofs' roots
+        i, j = rng.sample(range(len(proofs)), 2)
+        proofs = list(proofs)
+        proofs[i] = dataclasses.replace(
+            proofs[i],
+            actor_state_cid=proofs[j].actor_state_cid,
+            storage_root=proofs[j].storage_root,
+        )
+        return proofs, blocks
+    if kind == 4:
+        proofs = list(proofs)
+        rng.shuffle(proofs)
+        return proofs, blocks
+    proofs = list(proofs)
+    for _ in range(rng.randrange(1, 4)):
+        i = rng.randrange(len(proofs))
+        proofs[i] = _mutate_proof(rng, proofs[i])
+    return proofs, blocks
+
+
+class TestMalformedTreeNodes:
+    """Crafted tree-node corruption pinning Python↔C reader acceptance
+    parity (each was a real divergence found by review/fuzz: IndexError
+    leaks, a lax C bucket rule, an unvalidated inline root, and
+    bitmap-length rules differing between the readers)."""
+
+    def _store_with(self, obj):
+        from ipc_proofs_tpu.core.cid import CID as _CID
+        from ipc_proofs_tpu.core.dagcbor import encode
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        bs = MemoryBlockstore()
+        raw = encode(obj)
+        cid = _CID.hash_of(raw)
+        bs.put_keyed(cid, raw)
+        return bs, cid
+
+    def test_hamt_bucket_arity_rejected_both_readers(self):
+        from ipc_proofs_tpu.ipld.hamt import HAMT, _bitfield_encode, _hash_bits
+        from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+
+        _native_or_skip()
+        # the ONE set bit sits on the lookup key's hash path, so both
+        # walks reach the bucket — whose entry has THREE fields. The
+        # reference's KeyValuePair is a serde 2-tuple, so both readers
+        # must reject (the C walker used to accept >= 2)
+        idx = _hash_bits(b"k", 0, 5)
+        bs, cid = self._store_with(
+            [_bitfield_encode(1 << idx), [[[b"k", b"VAL", b"x"]]]]
+        )
+        with pytest.raises(ValueError):
+            HAMT(bs, cid, 5).get(b"k")
+        with pytest.raises(ValueError):
+            hamt_get_batch(bs, [cid], [0], [b"k"], validate_blocks=True)
+
+    def test_hamt_bitmap_exceeding_pointers_rejected(self):
+        from ipc_proofs_tpu.ipld.hamt import HAMT
+
+        bs, cid = self._store_with([b"\xff\xff\xff\xff", [[[b"k", b"VAL"]]]])
+        with pytest.raises(ValueError):
+            HAMT(bs, cid, 5).get(b"zz")  # pos beyond the pointer list
+
+    def test_amt_non_list_root_node_rejected(self):
+        from ipc_proofs_tpu.ipld.amt import AMT
+
+        bs, cid = self._store_with([5, 0, 0, 7])
+        with pytest.raises(ValueError):
+            AMT.load(bs, cid)
+
+    def test_amt_short_bitmap_rejected(self):
+        from ipc_proofs_tpu.ipld.amt import AMT
+
+        # bit_width 5 ⇒ 32 slots ⇒ 4 bitmap bytes required; 1 supplied.
+        # The native walker has always rejected this shape; the Python
+        # reader used to read the missing bytes as zero and verify it.
+        bs, cid = self._store_with([5, 0, 1, [b"\x01", [], [b"hello"]]])
+        with pytest.raises(ValueError):
+            AMT.load(bs, cid).get(0)
+
+    def test_amt_bitmap_exceeding_values_rejected(self):
+        from ipc_proofs_tpu.ipld.amt import AMT
+
+        # v0 root (bit_width 3 ⇒ 1 bitmap byte): two bits set, one value
+        bs, cid = self._store_with([0, 2, [b"\x03", [], [b"only-one"]]])
+        with pytest.raises(ValueError):
+            AMT.load(bs, cid, expected_version=0).get(1)
+
+
+@pytest.mark.parametrize("seed", [7, 0xA17, 424242])
+def test_randomized_storage_mutation_differential(seed):
+    _native_or_skip()
+    rng = random.Random(seed)
+    base = make_storage_bundle(encodings=("direct", "inline", "wrapper_tuple"))
+    base_proofs, base_blocks = base.storage_proofs, base.blocks
+    disagree_free_raises = 0
+    for _ in range(120):
+        proofs, blocks = _mutate(rng, base_proofs, base_blocks)
+        if rng.random() < 0.3:
+            proofs, blocks = _mutate(rng, proofs, blocks)
+        scalar = _outcome(proofs, blocks, batch=False)
+        batch = _outcome(proofs, blocks, batch=True)
+        assert _comparable(scalar) == _comparable(batch), (
+            f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
+        )
+        if scalar[0] == "raise":
+            disagree_free_raises += 1
+    assert 0 < disagree_free_raises < 120  # both regimes exercised
