@@ -684,18 +684,19 @@ static PyObject *py_cid_strs(PyObject *self, PyObject *arg) {
 
 /* cids_from_strs(list[str]) -> list[CID]: batch multibase base32 parse +
  * CID construction — CID.from_string semantics exactly: 'b' prefix
- * required, both alphabet cases accepted, unpadded length classes
- * {1,3,6} (mod 8) rejected, trailing sub-byte bits DROPPED (the Python
- * int codec discards them), then CID.from_bytes validation via make_cid. */
+ * required, unpadded length classes {1,3,6} (mod 8) rejected, and STRICT
+ * canonical decoding — lowercase only (multibase 'b' is base32-lower)
+ * and non-zero trailing sub-byte bits rejected, matching the reference
+ * multibase stack and the Python codec: every accepted string is the
+ * unique canonical form of its bytes, so no two strings alias one CID.
+ * Then CID.from_bytes validation via make_cid. */
 static int8_t b32_val[256];
 static int b32_val_ready = 0;
 
 static void b32_val_init(void) {
   memset(b32_val, -1, sizeof(b32_val));
   for (int i = 0; i < 32; i++) {
-    uint8_t c = (uint8_t)b32_alpha[i];
-    b32_val[c] = (int8_t)i;
-    if (c >= 'a' && c <= 'z') b32_val[c - 32] = (int8_t)i; /* both cases */
+    b32_val[(uint8_t)b32_alpha[i]] = (int8_t)i; /* lowercase only */
   }
   b32_val_ready = 1;
 }
@@ -769,7 +770,14 @@ static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
         *w++ = (uint8_t)(acc >> bits);
       }
     }
-    /* trailing <8 bits dropped — Python int-codec parity */
+    /* canonical check: the trailing <8 bits must be zero, or two strings
+     * differing only there would decode to one CID */
+    if (bits > 0 && (acc & ((1u << bits) - 1)) != 0) {
+      PyErr_Format(PyExc_ValueError, "non-zero trailing bits in base32 %R",
+                   item);
+      if (dec != buf) free(dec);
+      goto fail;
+    }
     PyObject *cid = make_cid(dec, nbytes);
     if (dec != buf) free(dec);
     if (!cid) goto fail;

@@ -37,11 +37,9 @@ __all__ = [
 # than base64.b32encode/b32decode, which matters because the verifier parses
 # two CID strings per proof group and the generator renders one per claim.
 _B32_ALPHABET = "abcdefghijklmnopqrstuvwxyz234567"
-# int(x, 32) uses digits 0-9a-v; translate RFC4648 (both cases) onto them
-_B32_TO_INT32 = str.maketrans(
-    _B32_ALPHABET + _B32_ALPHABET.upper(),
-    "0123456789abcdefghijklmnopqrstuv" * 2,
-)
+# int(x, 32) uses digits 0-9a-v; translate the (lowercase-only — multibase
+# 'b' is base32-lower) RFC4648 alphabet onto them
+_B32_TO_INT32 = str.maketrans(_B32_ALPHABET, "0123456789abcdefghijklmnopqrstuv")
 
 
 # 10-bit → 2-char lookup halves the per-call loop length vs per-char
@@ -60,20 +58,34 @@ def _b32_encode_lower(data: bytes) -> str:
     return out[:n_chars]
 
 
+_B32_CHARSET = frozenset(_B32_ALPHABET)
+
+
 def _b32_decode_lower(text: str) -> bytes:
+    """STRICT base32-lower decode: every accepted string is the unique
+    canonical encoding of its bytes. Multibase prefix 'b' means
+    base32-LOWER, and the reference stack (Rust multibase/data-encoding)
+    rejects mixed case, non-canonical lengths, and non-zero trailing bits
+    — each a way for distinct strings to decode to one CID (string→CID
+    malleability). The C batch parser enforces the same three rules."""
     if not text:
         return b""
     # RFC 4648 unpadded lengths are ≡ {0,2,4,5,7} (mod 8); the others cannot
-    # arise from encoding and would make distinct strings decode to the same
-    # bytes (string→CID malleability) — b32decode rejected them, so do we
+    # arise from encoding
     if len(text) % 8 in (1, 3, 6):
         raise ValueError(f"invalid base32 length {len(text)}")
-    try:
-        value = int(text.translate(_B32_TO_INT32), 32)
-    except ValueError:
-        raise ValueError(f"non-base32 character in {text!r}") from None
+    # membership check BEFORE the int parse: characters outside the
+    # lowercase RFC alphabet that happen to be base-32 int digits
+    # ('0','1','8','9', uppercase) pass through translate untouched and
+    # int() accepts them — '0' aliasing 'a', '8' aliasing 'i', etc.
+    # (found by tests/test_codec_exec_fuzz.py)
+    if not _B32_CHARSET.issuperset(text):
+        raise ValueError(f"non-base32 character in {text!r}")
+    value = int(text.translate(_B32_TO_INT32), 32)
     nbits = len(text) * 5
     nbytes = nbits // 8
+    if value & ((1 << (nbits - nbytes * 8)) - 1):
+        raise ValueError(f"non-zero trailing bits in base32 {text!r}")
     return (value >> (nbits - nbytes * 8)).to_bytes(nbytes, "big")
 
 

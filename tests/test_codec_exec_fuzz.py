@@ -1,0 +1,180 @@
+"""Randomized acceptance-parity fuzz: CID codecs and the exec-order walker.
+
+Same method as the verifier fuzzes (which found real divergences): drive
+the scalar/Python implementation and its batched/C twin through the same
+randomly mutated inputs and assert they accept and reject identically.
+
+- CID strings: `CID.from_string` vs the C `cids_from_strs` batch parser.
+- CID bytes: `CID.from_bytes` vs the C `make_cids` batch constructor.
+- Execution orders: scalar `reconstruct_execution_order` per group vs the
+  batched `reconstruct_execution_orders_batch` (whose contract maps a
+  scalar raise to a per-group None) over corrupted witness stores.
+"""
+
+import random
+
+import pytest
+
+from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.proofs.exec_order import (
+    reconstruct_execution_order,
+    reconstruct_execution_orders_batch,
+)
+from ipc_proofs_tpu.proofs.scan_native import native_scan_available
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+from tests.test_batch_verifier import make_bundle
+
+
+def _ext_or_skip(attr):
+    ext = load_dagcbor_ext()
+    if ext is None or not hasattr(ext, attr):
+        pytest.skip(f"native {attr} unavailable")
+    return ext
+
+
+_B32 = "abcdefghijklmnopqrstuvwxyz234567"
+
+
+def _mutate_str(rng: random.Random, s: str) -> str:
+    kind = rng.randrange(7)
+    if kind == 0 and s:  # substitute with base32 / invalid / uppercase char
+        i = rng.randrange(len(s))
+        ch = rng.choice(_B32 + _B32.upper() + "018!=. é")
+        return s[:i] + ch + s[i + 1 :]
+    if kind == 1 and s:
+        return s[: rng.randrange(len(s))]  # truncate
+    if kind == 2:
+        return s + rng.choice(_B32)  # extend
+    if kind == 3 and s:
+        return rng.choice(["z", "f", "B", ""]) + s[1:]  # multibase prefix
+    if kind == 4:
+        return s.upper()
+    if kind == 5:
+        return s + "="  # base32 padding is not accepted unpadded-only
+    return s  # unmutated valid string (keeps the accept regime exercised)
+
+
+@pytest.mark.parametrize("seed", [11, 0xC1D])
+def test_cid_string_codec_acceptance_parity(seed):
+    ext = _ext_or_skip("cids_from_strs")
+    rng = random.Random(seed)
+    bases = [str(CID.hash_of(bytes([i]))) for i in range(8)]
+    bases.append(str(CID.hash_of(b"raw", codec=0x55)))
+    accepted = rejected = 0
+    for _ in range(600):
+        s = _mutate_str(rng, rng.choice(bases))
+        if rng.random() < 0.3:
+            s = _mutate_str(rng, s)
+        try:
+            scalar = ("ok", CID.from_string(s))
+        except ValueError:
+            scalar = ("reject",)
+        try:
+            batch = ("ok", ext.cids_from_strs([s])[0])
+        except ValueError:
+            batch = ("reject",)
+        assert scalar == batch, f"CID string {s!r}: scalar={scalar} batch={batch}"
+        if scalar[0] == "ok":
+            # canonical-form invariant: an accepted string IS its CID's
+            # unique string form — the parity assert alone is blind to
+            # malleability both implementations share (case aliasing,
+            # non-zero trailing bits — both previously accepted)
+            assert str(scalar[1]) == s, f"non-canonical string accepted: {s!r}"
+            accepted += 1
+        else:
+            rejected += 1
+    assert accepted and rejected  # both regimes exercised
+
+
+@pytest.mark.parametrize("seed", [5, 0xB17E5])
+def test_cid_bytes_codec_acceptance_parity(seed):
+    ext = _ext_or_skip("make_cids")
+    rng = random.Random(seed)
+    bases = [CID.hash_of(bytes([i])).to_bytes() for i in range(8)]
+    accepted = rejected = 0
+    for _ in range(600):
+        raw = bytearray(rng.choice(bases))
+        for _ in range(rng.randrange(1, 3)):
+            kind = rng.randrange(4)
+            if kind == 0 and raw:
+                raw[rng.randrange(len(raw))] ^= 1 << rng.randrange(8)
+            elif kind == 1 and raw:
+                del raw[rng.randrange(len(raw))]
+            elif kind == 2:
+                raw.insert(rng.randrange(len(raw) + 1), rng.randrange(256))
+        raw = bytes(raw)
+        try:
+            scalar = ("ok", CID.from_bytes(raw))
+        except ValueError:
+            scalar = ("reject",)
+        try:
+            batch = ("ok", ext.make_cids([raw])[0])
+        except ValueError:
+            batch = ("reject",)
+        assert scalar == batch, f"CID bytes {raw.hex()}: scalar={scalar} batch={batch}"
+        if scalar[0] == "ok":
+            accepted += 1
+        else:
+            rejected += 1
+    assert accepted and rejected
+
+
+def _exec_groups_and_store():
+    """Real witness store + per-proof parent-header groups from the event
+    fixture world (one-block tipsets; TxMeta + both message AMTs present)."""
+    bundle = make_bundle(n_pairs=3)
+    store = MemoryBlockstore()
+    for b in bundle.blocks:
+        store.put_keyed(b.cid, b.data)
+    seen = set()
+    groups = []
+    for p in bundle.proofs:
+        key = tuple(p.parent_tipset_cids)
+        if key not in seen:
+            seen.add(key)
+            groups.append([CID.from_string(c) for c in key])
+    return store, groups, {b.cid: b.data for b in bundle.blocks}
+
+
+@pytest.mark.parametrize("seed", [3, 0xE0])
+def test_exec_order_batch_scalar_parity_under_corruption(seed):
+    if not native_scan_available():
+        pytest.skip("native scan extension unavailable")
+    rng = random.Random(seed)
+    store, groups, raw_map = _exec_groups_and_store()
+    cids = list(raw_map)
+    none_groups = 0
+    for _ in range(120):
+        # corrupt a copy of the store: flip/truncate/extend/drop blocks
+        mutated = MemoryBlockstore()
+        drop = rng.choice(cids) if rng.random() < 0.3 else None
+        for cid, raw in raw_map.items():
+            if cid == drop:
+                continue
+            if rng.random() < 0.25:
+                data = bytearray(raw)
+                kind = rng.randrange(3)
+                if kind == 0 and data:
+                    data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+                elif kind == 1 and data:
+                    del data[rng.randrange(len(data)) :]
+                else:
+                    data += b"\x00"
+                raw = bytes(data)
+            mutated.put_keyed(cid, raw)
+        batch = reconstruct_execution_orders_batch(mutated, groups)
+        assert batch is not None
+        for g, group in enumerate(groups):
+            try:
+                scalar = [c.to_bytes() for c in reconstruct_execution_order(mutated, group)]
+            except (KeyError, ValueError):
+                scalar = None
+            assert batch[g] == scalar, (
+                f"group {g} diverged under seed={seed}: "
+                f"batch={batch[g]!r} scalar={scalar!r}"
+            )
+            if scalar is None:
+                none_groups += 1
+    assert none_groups  # the corruption actually bit

@@ -181,9 +181,14 @@ class TestBatchCidCodecs:
         strs = [str(c) for c in cids]
         parsed = ext.cids_from_strs(strs)
         assert parsed == cids
-        # uppercase accepted, like CID.from_string
+        # uppercase payload REJECTED, like CID.from_string — multibase 'b'
+        # means base32-lower, and accepting both cases would let distinct
+        # strings alias one CID
         up = "b" + strs[0][1:].upper()
-        assert ext.cids_from_strs([up]) == [CID.from_string(up)]
+        with pytest.raises(ValueError):
+            ext.cids_from_strs([up])
+        with pytest.raises(ValueError):
+            CID.from_string(up)
 
     @pytest.mark.parametrize(
         "bad",
