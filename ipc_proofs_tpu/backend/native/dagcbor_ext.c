@@ -779,6 +779,23 @@ static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
       goto fail;
     }
     PyObject *cid = make_cid(dec, nbytes);
+    if (cid) {
+      /* canonical varints only at the STRING boundary (CID.from_string
+       * parity): a non-minimal varint prefix would be a second string
+       * for the same CID. make_cid already accepted the structure, so
+       * only minimality can fail here. */
+      Py_ssize_t pos = 0;
+      int minimal = 1;
+      unsigned __int128 v;
+      for (int f = 0; f < 4 && minimal; f++)
+        if (cid_uvarint_min(dec, nbytes, &pos, &v, &minimal) < 0) break;
+      if (!minimal) {
+        Py_DECREF(cid);
+        cid = NULL;
+        PyErr_Format(PyExc_ValueError,
+                     "non-canonical CID byte encoding in %R", item);
+      }
+    }
     if (dec != buf) free(dec);
     if (!cid) goto fail;
     PyList_SET_ITEM(out, i, cid);

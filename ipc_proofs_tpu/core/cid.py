@@ -191,7 +191,17 @@ class CID:
             raise ValueError("empty CID string")
         if text[0] != "b":
             raise ValueError(f"unsupported multibase prefix {text[0]!r} (base32 only)")
-        return cls.from_bytes(_b32_decode_lower(text[1:]))
+        raw = _b32_decode_lower(text[1:])
+        out = cls.from_bytes(raw)
+        # canonical-bytes check: from_bytes tolerates non-minimal varint
+        # prefixes (in-block tag-42 acceptance is governed by chain
+        # compatibility), but at the STRING boundary — where claims live —
+        # a non-minimal encoding would be a second string for the same CID.
+        # to_bytes() is the canonical re-encode (memoized from `raw` itself
+        # on the canonical fast paths, so this compare is cheap there).
+        if out.to_bytes() != raw:
+            raise ValueError(f"non-canonical CID byte encoding in {text!r}")
+        return out
 
     @classmethod
     def parse(cls, value: "CID | str | bytes") -> "CID":

@@ -58,6 +58,80 @@ __all__ = [
 ]
 
 
+# --- strict JSON field accessors -------------------------------------------
+# Certificates arrive from untrusted sources (CLI files, RPC). Forest
+# deserializes these shapes with typed serde, so ANY structural garbage is
+# a deserialization error there; mirror that by rejecting every malformed
+# field as ValueError — never leaking KeyError/TypeError/AttributeError
+# from shape assumptions (a trust boundary must fail closed, uniformly).
+
+
+def _as_map(v, what: str) -> dict:
+    if not isinstance(v, dict):
+        raise ValueError(f"malformed F3 certificate: {what} must be a JSON object")
+    return v
+
+
+def _get(obj: dict, key: str, what: str):
+    if key not in obj:
+        raise ValueError(f"malformed F3 certificate: {what} missing field {key!r}")
+    return obj[key]
+
+
+def _as_int(v, what: str) -> int:
+    if not isinstance(v, int) or isinstance(v, bool):
+        raise ValueError(f"malformed F3 certificate: {what} must be an integer")
+    return v
+
+
+def _as_str(v, what: str) -> str:
+    if not isinstance(v, str):
+        raise ValueError(f"malformed F3 certificate: {what} must be a string")
+    return v
+
+
+def _as_list(v, what: str) -> list:
+    if not isinstance(v, list):
+        raise ValueError(f"malformed F3 certificate: {what} must be a list")
+    return v
+
+
+def _as_bytes(v, what: str) -> bytes:
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, str):  # Forest JSON byte encoding — STRICT base64
+        return _b64_strict(v, what)
+    if isinstance(v, list) and all(
+        isinstance(b, int) and not isinstance(b, bool) and 0 <= b < 256 for b in v
+    ):
+        return bytes(v)
+    raise ValueError(f"malformed F3 certificate: {what} must be bytes")
+
+
+def _b64_strict(v: str, what: str) -> bytes:
+    """Strict base64 (validate=True): lax decoding silently DISCARDS
+    characters outside the alphabet, so distinct JSON documents would
+    decode to one certificate — the same aliasing the CID string codec
+    rejects."""
+    import base64
+    import binascii
+
+    try:
+        return base64.b64decode(v, validate=True)
+    except binascii.Error as exc:
+        raise ValueError(
+            f"malformed F3 certificate: {what} bad base64 ({exc})"
+        ) from None
+
+
+def _as_cid_str(v, what: str) -> str:
+    if isinstance(v, dict):  # Lotus/Forest {"/": "<cid>"} form
+        v = v.get("/")
+    if not isinstance(v, str):
+        raise ValueError(f"malformed F3 certificate: {what} must be a CID string")
+    return v
+
+
 def _decode_point_str(value: str, n_bytes: int, what: str) -> bytes:
     """Decode a compressed-point string (base64 — Forest JSON's byte
     encoding — or 0x-hex) to exactly ``n_bytes``. The two forms are
@@ -148,13 +222,18 @@ class ECTipSet:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "ECTipSet":
-        key = [c["/"] if isinstance(c, dict) else c for c in obj["Key"]]
-        pt = obj["PowerTable"]
+        obj = _as_map(obj, "ECTipSet")
+        key = [
+            _as_cid_str(c, "ECTipSet.Key entry")
+            for c in _as_list(_get(obj, "Key", "ECTipSet"), "ECTipSet.Key")
+        ]
         return cls(
             key=key,
-            epoch=obj["Epoch"],
-            power_table=pt["/"] if isinstance(pt, dict) else pt,
-            commitments=bytes(obj.get("Commitments", b"")),
+            epoch=_as_int(_get(obj, "Epoch", "ECTipSet"), "ECTipSet.Epoch"),
+            power_table=_as_cid_str(
+                _get(obj, "PowerTable", "ECTipSet"), "ECTipSet.PowerTable"
+            ),
+            commitments=_as_bytes(obj.get("Commitments", b""), "ECTipSet.Commitments"),
         )
 
 
@@ -165,10 +244,13 @@ class SupplementalData:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "SupplementalData":
+        obj = _as_map(obj, "SupplementalData")
         pt = obj.get("PowerTable", "")
         return cls(
-            commitments=bytes(obj.get("Commitments", b"")),
-            power_table=pt["/"] if isinstance(pt, dict) else pt,
+            commitments=_as_bytes(
+                obj.get("Commitments", b""), "SupplementalData.Commitments"
+            ),
+            power_table=_as_cid_str(pt, "SupplementalData.PowerTable"),
         )
 
 
@@ -184,11 +266,21 @@ class PowerTableDelta:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "PowerTableDelta":
+        obj = _as_map(obj, "PowerTableDelta")
         return cls(
-            participant_id=obj["ParticipantID"],
-            power_delta=obj["PowerDelta"],
-            signing_key=obj["SigningKey"],
-            pop=obj.get("Pop", ""),
+            participant_id=_as_int(
+                _get(obj, "ParticipantID", "PowerTableDelta"),
+                "PowerTableDelta.ParticipantID",
+            ),
+            power_delta=_as_str(
+                _get(obj, "PowerDelta", "PowerTableDelta"),
+                "PowerTableDelta.PowerDelta",
+            ),
+            signing_key=_as_str(
+                _get(obj, "SigningKey", "PowerTableDelta"),
+                "PowerTableDelta.SigningKey",
+            ),
+            pop=_as_str(obj.get("Pop", ""), "PowerTableDelta.Pop"),
         )
 
 
@@ -205,25 +297,35 @@ class FinalityCertificate:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "FinalityCertificate":
-        import base64
-
+        obj = _as_map(obj, "FinalityCertificate")
         raw_signers = obj.get("Signers", b"")
-        if isinstance(raw_signers, str):  # Forest JSON byte encoding
-            signers: "bytes | list[int]" = base64.b64decode(raw_signers)
-        elif isinstance(raw_signers, list):  # explicit row indices
-            signers = [int(i) for i in raw_signers]
-        else:
-            signers = bytes(raw_signers)
-        raw_sig = obj.get("Signature", b"")
-        signature = base64.b64decode(raw_sig) if isinstance(raw_sig, str) else bytes(raw_sig)
+        if isinstance(raw_signers, list):  # explicit row indices
+            signers: "bytes | list[int]" = [
+                _as_int(i, "Signers entry") for i in raw_signers
+            ]
+        else:  # bytes / Forest base64 string (strict)
+            signers = _as_bytes(raw_signers, "Signers")
+        signature = _as_bytes(obj.get("Signature", b""), "Signature")
         return cls(
-            instance=obj["GPBFTInstance"],
-            ec_chain=[ECTipSet.from_json_obj(t) for t in obj["ECChain"]],
-            supplemental_data=SupplementalData.from_json_obj(obj.get("SupplementalData", {})),
+            instance=_as_int(
+                _get(obj, "GPBFTInstance", "FinalityCertificate"), "GPBFTInstance"
+            ),
+            ec_chain=[
+                ECTipSet.from_json_obj(t)
+                for t in _as_list(
+                    _get(obj, "ECChain", "FinalityCertificate"), "ECChain"
+                )
+            ],
+            supplemental_data=SupplementalData.from_json_obj(
+                obj.get("SupplementalData", {})
+            ),
             signers=signers,
             signature=signature,
             power_table_delta=[
-                PowerTableDelta.from_json_obj(d) for d in obj.get("PowerTableDelta", [])
+                PowerTableDelta.from_json_obj(d)
+                for d in _as_list(
+                    obj.get("PowerTableDelta", []), "PowerTableDelta"
+                )
             ],
         )
 

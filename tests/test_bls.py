@@ -375,3 +375,87 @@ class TestChainWithSignaturesAndTableCids:
             FinalityCertificateChain([cert]).validate(
                 _table(), verify_signatures=True
             )
+
+
+class TestCertificateJsonParsing:
+    """`FinalityCertificate.from_json_obj` consumes UNTRUSTED JSON (CLI
+    cert files, RPC). It must reject every malformed shape as ValueError —
+    a trust boundary failing with KeyError/TypeError/AttributeError leaks
+    shape assumptions and previously did exactly that (pre-hardening:
+    `from_json_obj([1,2])` raised AttributeError)."""
+
+    VALID = {
+        "GPBFTInstance": 7,
+        "ECChain": [
+            {"Epoch": 10, "Key": [{"/": "bafyaa"}], "PowerTable": {"/": "bafypt"}},
+            {"Epoch": 11, "Key": ["bafybb"], "PowerTable": "bafypt"},
+        ],
+        "SupplementalData": {"PowerTable": {"/": "bafypt2"}, "Commitments": [0] * 4},
+        "Signers": "AAE=",
+        "Signature": "",
+        "PowerTableDelta": [
+            {"ParticipantID": 3, "PowerDelta": "100", "SigningKey": "", "Pop": ""}
+        ],
+    }
+
+    def test_valid_shapes_parse(self):
+        cert = FinalityCertificate.from_json_obj(self.VALID)
+        assert cert.instance == 7
+        assert cert.ec_chain[0].key == ["bafyaa"]
+        assert cert.power_table_delta[0].participant_id == 3
+
+    def test_non_object_roots_rejected(self):
+        for garbage in ([1, 2], "str", None, 42, 3.5, True):
+            with pytest.raises(ValueError, match="malformed F3 certificate"):
+                FinalityCertificate.from_json_obj(garbage)
+
+    @pytest.mark.parametrize("seed", [1, 99])
+    def test_randomized_structural_garbage_never_leaks(self, seed):
+        import copy
+        import random
+
+        rng = random.Random(seed)
+        garbage_values = [
+            None, True, False, 0, -1, 3.5, "x", "", [], {}, [None], {"/": 5},
+            {"/": None}, [["nested"]], "not-base64!!", {"Epoch": None}, 2**70,
+        ]
+
+        def mutate(obj):
+            """Replace one random node of a deep-copied VALID cert obj."""
+            doc = copy.deepcopy(obj)
+            # collect (container, key) sites
+            sites = []
+
+            def walk(node):
+                if isinstance(node, dict):
+                    for k in node:
+                        sites.append((node, k))
+                        walk(node[k])
+                elif isinstance(node, list):
+                    for i in range(len(node)):
+                        sites.append((node, i))
+                        walk(node[i])
+
+            walk(doc)
+            container, key = rng.choice(sites)
+            action = rng.randrange(3)
+            if action == 0:
+                container[key] = rng.choice(garbage_values)
+            elif action == 1 and isinstance(container, dict):
+                del container[key]
+            else:
+                container[key] = rng.choice(garbage_values)
+            return doc
+
+        parsed = rejected = 0
+        for _ in range(300):
+            doc = mutate(self.VALID)
+            if rng.random() < 0.3:
+                doc = mutate(doc)
+            try:
+                FinalityCertificate.from_json_obj(doc)
+                parsed += 1
+            except ValueError:
+                rejected += 1
+            # any other exception type propagates and fails the test
+        assert parsed and rejected  # both regimes exercised

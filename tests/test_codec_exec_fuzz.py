@@ -88,6 +88,24 @@ def test_cid_string_codec_acceptance_parity(seed):
     assert accepted and rejected  # both regimes exercised
 
 
+def test_non_minimal_varint_string_rejected_both_parsers():
+    """A CID string whose bytes encode the codec as a non-minimal varint
+    (0xf1 0x00 instead of 0x71) would be a SECOND string for the same CID
+    — both string parsers must reject it, even though the bytes-level
+    tag-42 acceptance (governed by chain compatibility) tolerates it."""
+    from ipc_proofs_tpu.core.cid import _b32_encode_lower
+
+    c = CID.hash_of(b"payload")
+    noncanon = b"\x01\xf1\x00\xa0\xe4\x02\x20" + c.digest
+    assert CID.from_bytes(noncanon) == c  # bytes level: accepted, equal CID
+    s = "b" + _b32_encode_lower(noncanon)
+    with pytest.raises(ValueError, match="non-canonical"):
+        CID.from_string(s)
+    ext = _ext_or_skip("cids_from_strs")
+    with pytest.raises(ValueError, match="non-canonical"):
+        ext.cids_from_strs([s])
+
+
 @pytest.mark.parametrize("seed", [5, 0xB17E5])
 def test_cid_bytes_codec_acceptance_parity(seed):
     ext = _ext_or_skip("make_cids")
