@@ -782,14 +782,14 @@ static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
     if (cid) {
       /* canonical varints only at the STRING boundary (CID.from_string
        * parity): a non-minimal varint prefix would be a second string
-       * for the same CID. make_cid already accepted the structure, so
-       * only minimality can fail here. */
-      Py_ssize_t pos = 0;
-      int minimal = 1;
-      unsigned __int128 v;
-      for (int f = 0; f < 4 && minimal; f++)
-        if (cid_uvarint_min(dec, nbytes, &pos, &v, &minimal) < 0) break;
-      if (!minimal) {
+       * for the same CID. make_cid stashes the to_bytes memo (s_bytes)
+       * IFF every varint was minimal — that flag is the single source of
+       * truth, so test for the memo instead of re-parsing the varints. */
+      PyObject *memo = PyObject_GetAttr(cid, s_bytes);
+      if (memo) {
+        Py_DECREF(memo);
+      } else {
+        PyErr_Clear();
         Py_DECREF(cid);
         cid = NULL;
         PyErr_Format(PyExc_ValueError,

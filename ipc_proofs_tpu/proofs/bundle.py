@@ -16,6 +16,7 @@ import json
 from dataclasses import dataclass, field
 
 from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.utils.jsonstrict import strict_fields
 
 __all__ = [
     "ProofBlock",
@@ -26,6 +27,14 @@ __all__ = [
     "UnifiedProofBundle",
     "UnifiedVerificationResult",
 ]
+
+
+# strict JSON field accessors for this trust boundary — bundles are THE
+# untrusted input (a verifier's whole job is checking one); see
+# utils/jsonstrict.py for the threat model the shared helpers encode
+_S = strict_fields("malformed proof bundle")
+_as_map, _get, _as_int, _as_str = _S.as_map, _S.get, _S.as_int, _S.as_str
+_as_list, _as_str_list, _b64_strict = _S.as_list, _S.as_str_list, _S.b64_strict
 
 
 @dataclass(frozen=True)
@@ -51,7 +60,13 @@ class ProofBlock:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "ProofBlock":
-        return cls(cid=CID.from_string(obj["cid"]), data=base64.b64decode(obj["data"]))
+        obj = _as_map(obj, "block")
+        return cls(
+            cid=CID.from_string(_as_str(_get(obj, "cid", "block"), "block cid")),
+            data=_b64_strict(
+                _as_str(_get(obj, "data", "block"), "block data"), "block data"
+            ),
+        )
 
 
 @dataclass
@@ -73,7 +88,20 @@ class StorageProof:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "StorageProof":
-        return cls(**obj)
+        obj = _as_map(obj, "storage proof")
+        w = "storage proof"
+        return cls(
+            child_epoch=_as_int(_get(obj, "child_epoch", w), "child_epoch"),
+            child_block_cid=_as_str(_get(obj, "child_block_cid", w), "child_block_cid"),
+            parent_state_root=_as_str(
+                _get(obj, "parent_state_root", w), "parent_state_root"
+            ),
+            actor_id=_as_int(_get(obj, "actor_id", w), "actor_id"),
+            actor_state_cid=_as_str(_get(obj, "actor_state_cid", w), "actor_state_cid"),
+            storage_root=_as_str(_get(obj, "storage_root", w), "storage_root"),
+            slot=_as_str(_get(obj, "slot", w), "slot"),
+            value=_as_str(_get(obj, "value", w), "value"),
+        )
 
 
 @dataclass
@@ -95,7 +123,12 @@ class EventData:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "EventData":
-        return cls(**obj)
+        obj = _as_map(obj, "event data")
+        return cls(
+            emitter=_as_int(_get(obj, "emitter", "event data"), "emitter"),
+            topics=_as_str_list(_get(obj, "topics", "event data"), "topics"),
+            data=_as_str(_get(obj, "data", "event data"), "data"),
+        )
 
 
 @dataclass
@@ -126,9 +159,20 @@ class EventProof:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "EventProof":
-        obj = dict(obj)
-        obj["event_data"] = EventData.from_json_obj(obj["event_data"])
-        return cls(**obj)
+        obj = _as_map(obj, "event proof")
+        w = "event proof"
+        return cls(
+            parent_epoch=_as_int(_get(obj, "parent_epoch", w), "parent_epoch"),
+            child_epoch=_as_int(_get(obj, "child_epoch", w), "child_epoch"),
+            parent_tipset_cids=_as_str_list(
+                _get(obj, "parent_tipset_cids", w), "parent_tipset_cids"
+            ),
+            child_block_cid=_as_str(_get(obj, "child_block_cid", w), "child_block_cid"),
+            message_cid=_as_str(_get(obj, "message_cid", w), "message_cid"),
+            exec_index=_as_int(_get(obj, "exec_index", w), "exec_index"),
+            event_index=_as_int(_get(obj, "event_index", w), "event_index"),
+            event_data=EventData.from_json_obj(_get(obj, "event_data", w)),
+        )
 
 
 @dataclass
@@ -157,10 +201,20 @@ class UnifiedProofBundle:
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "UnifiedProofBundle":
+        obj = _as_map(obj, "bundle")
         return cls(
-            storage_proofs=[StorageProof.from_json_obj(p) for p in obj["storage_proofs"]],
-            event_proofs=[EventProof.from_json_obj(p) for p in obj["event_proofs"]],
-            blocks=[ProofBlock.from_json_obj(b) for b in obj["blocks"]],
+            storage_proofs=[
+                StorageProof.from_json_obj(p)
+                for p in _as_list(_get(obj, "storage_proofs", "bundle"), "storage_proofs")
+            ],
+            event_proofs=[
+                EventProof.from_json_obj(p)
+                for p in _as_list(_get(obj, "event_proofs", "bundle"), "event_proofs")
+            ],
+            blocks=[
+                ProofBlock.from_json_obj(b)
+                for b in _as_list(_get(obj, "blocks", "bundle"), "blocks")
+            ],
         )
 
     @classmethod

@@ -45,6 +45,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from ipc_proofs_tpu.utils.jsonstrict import strict_fields
+
 __all__ = [
     "FinalityCertificate",
     "FinalityCertificateChain",
@@ -58,78 +60,13 @@ __all__ = [
 ]
 
 
-# --- strict JSON field accessors -------------------------------------------
-# Certificates arrive from untrusted sources (CLI files, RPC). Forest
-# deserializes these shapes with typed serde, so ANY structural garbage is
-# a deserialization error there; mirror that by rejecting every malformed
-# field as ValueError — never leaking KeyError/TypeError/AttributeError
-# from shape assumptions (a trust boundary must fail closed, uniformly).
-
-
-def _as_map(v, what: str) -> dict:
-    if not isinstance(v, dict):
-        raise ValueError(f"malformed F3 certificate: {what} must be a JSON object")
-    return v
-
-
-def _get(obj: dict, key: str, what: str):
-    if key not in obj:
-        raise ValueError(f"malformed F3 certificate: {what} missing field {key!r}")
-    return obj[key]
-
-
-def _as_int(v, what: str) -> int:
-    if not isinstance(v, int) or isinstance(v, bool):
-        raise ValueError(f"malformed F3 certificate: {what} must be an integer")
-    return v
-
-
-def _as_str(v, what: str) -> str:
-    if not isinstance(v, str):
-        raise ValueError(f"malformed F3 certificate: {what} must be a string")
-    return v
-
-
-def _as_list(v, what: str) -> list:
-    if not isinstance(v, list):
-        raise ValueError(f"malformed F3 certificate: {what} must be a list")
-    return v
-
-
-def _as_bytes(v, what: str) -> bytes:
-    if isinstance(v, (bytes, bytearray)):
-        return bytes(v)
-    if isinstance(v, str):  # Forest JSON byte encoding — STRICT base64
-        return _b64_strict(v, what)
-    if isinstance(v, list) and all(
-        isinstance(b, int) and not isinstance(b, bool) and 0 <= b < 256 for b in v
-    ):
-        return bytes(v)
-    raise ValueError(f"malformed F3 certificate: {what} must be bytes")
-
-
-def _b64_strict(v: str, what: str) -> bytes:
-    """Strict base64 (validate=True): lax decoding silently DISCARDS
-    characters outside the alphabet, so distinct JSON documents would
-    decode to one certificate — the same aliasing the CID string codec
-    rejects."""
-    import base64
-    import binascii
-
-    try:
-        return base64.b64decode(v, validate=True)
-    except binascii.Error as exc:
-        raise ValueError(
-            f"malformed F3 certificate: {what} bad base64 ({exc})"
-        ) from None
-
-
-def _as_cid_str(v, what: str) -> str:
-    if isinstance(v, dict):  # Lotus/Forest {"/": "<cid>"} form
-        v = v.get("/")
-    if not isinstance(v, str):
-        raise ValueError(f"malformed F3 certificate: {what} must be a CID string")
-    return v
+# strict JSON field accessors for this trust boundary (shared helpers —
+# see utils/jsonstrict.py for the threat model they encode)
+_S = strict_fields("malformed F3 certificate")
+_as_map, _get, _as_int = _S.as_map, _S.get, _S.as_int
+_as_str, _as_list, _as_bytes, _as_cid_str = (
+    _S.as_str, _S.as_list, _S.as_bytes, _S.as_cid_str
+)
 
 
 def _decode_point_str(value: str, n_bytes: int, what: str) -> bytes:

@@ -196,3 +196,107 @@ def test_exec_order_batch_scalar_parity_under_corruption(seed):
             if scalar is None:
                 none_groups += 1
     assert none_groups  # the corruption actually bit
+
+
+class TestBundleJsonParsing:
+    """`UnifiedProofBundle.from_json` consumes THE untrusted input (the
+    bundle a verifier is asked to check). It must reject every malformed
+    shape as ValueError — pre-hardening it leaked KeyError/TypeError from
+    shape assumptions and performed no field type validation at all."""
+
+    def _valid_obj(self):
+        import json
+
+        from tests.test_storage_batch_verifier import make_storage_bundle
+
+        return json.loads(make_storage_bundle().to_json())
+
+    def test_round_trip(self):
+        import json
+
+        from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+
+        obj = self._valid_obj()
+        bundle = UnifiedProofBundle.from_json_obj(obj)
+        assert json.loads(bundle.to_json()) == obj
+
+    def test_non_object_roots_rejected(self):
+        from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+
+        for garbage in ("[]", '"str"', "42", "null", "{}"):
+            with pytest.raises(ValueError):
+                UnifiedProofBundle.from_json(garbage)
+
+    @pytest.mark.parametrize("seed", [2, 0xB0B])
+    def test_randomized_structural_garbage_never_leaks(self, seed):
+        import copy
+
+        from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+
+        rng = random.Random(seed)
+        base = self._valid_obj()
+        garbage_values = [
+            None, True, False, -1, 3.5, "x", "", [], {}, [None], {"k": 1},
+            "AAA!", 2**70, [2**70],
+        ]
+
+        def mutate(obj):
+            doc = copy.deepcopy(obj)
+            sites = []
+
+            def walk(node):
+                if isinstance(node, dict):
+                    for k in node:
+                        sites.append((node, k))
+                        walk(node[k])
+                elif isinstance(node, list):
+                    for i in range(len(node)):
+                        sites.append((node, i))
+                        walk(node[i])
+
+            walk(doc)
+            container, key = rng.choice(sites)
+            if rng.randrange(3) == 1 and isinstance(container, dict):
+                del container[key]
+            else:
+                container[key] = rng.choice(garbage_values)
+            return doc
+
+        parsed = rejected = 0
+        for _ in range(250):
+            doc = mutate(base)
+            if rng.random() < 0.3:
+                doc = mutate(doc)
+            try:
+                UnifiedProofBundle.from_json_obj(doc)
+                parsed += 1
+            except ValueError:
+                rejected += 1
+            # anything else propagates and fails the test
+        assert parsed and rejected
+
+
+def test_base64_trailing_bits_rejected_at_trust_boundaries():
+    """'AB==' and 'AA==' decode to the same byte under validate=True —
+    non-canonical base64 would let distinct JSON documents carry one
+    object. Both untrusted-input boundaries must reject it."""
+    from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+    from ipc_proofs_tpu.proofs.cert import FinalityCertificate
+
+    with pytest.raises(ValueError, match="non-canonical base64"):
+        FinalityCertificate.from_json_obj(
+            {"GPBFTInstance": 1, "ECChain": [], "Signers": "AB=="}
+        )
+    with pytest.raises(ValueError, match="non-canonical base64"):
+        UnifiedProofBundle.from_json_obj(
+            {
+                "storage_proofs": [],
+                "event_proofs": [],
+                "blocks": [{"cid": str(CID.hash_of(b"x")), "data": "AB=="}],
+            }
+        )
+    # the canonical sibling passes
+    cert = FinalityCertificate.from_json_obj(
+        {"GPBFTInstance": 1, "ECChain": [], "Signers": "AA=="}
+    )
+    assert cert.signers == b"\x00"
