@@ -732,8 +732,12 @@ static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
       goto fail;
     }
     if (s[0] != 'b') {
+      /* NOTE: no %c here — s is UTF-8 and a non-ASCII first byte is
+       * NEGATIVE as a signed char, which makes PyErr_Format itself raise
+       * OverflowError instead of the intended ValueError (found by the
+       * codec fuzz soak) */
       PyErr_Format(PyExc_ValueError,
-                   "unsupported multibase prefix '%c' (base32 only)", s[0]);
+                   "unsupported multibase prefix in %R (base32 only)", item);
       goto fail;
     }
     Py_ssize_t tlen = slen - 1;

@@ -88,6 +88,19 @@ def test_cid_string_codec_acceptance_parity(seed):
     assert accepted and rejected  # both regimes exercised
 
 
+def test_non_ascii_prefix_rejects_as_value_error():
+    """A non-ASCII first character is NEGATIVE as a C signed char; the C
+    parser's error path used to feed it to PyErr_Format's %c, which raises
+    OverflowError itself — an exception-type leak at the boundary (found
+    by the codec fuzz soak). Both parsers must reject with ValueError."""
+    ext = _ext_or_skip("cids_from_strs")
+    s = "é" + str(CID.hash_of(b"x"))[1:]
+    with pytest.raises(ValueError):
+        CID.from_string(s)
+    with pytest.raises(ValueError):
+        ext.cids_from_strs([s])
+
+
 def test_non_minimal_varint_string_rejected_both_parsers():
     """A CID string whose bytes encode the codec as a non-minimal varint
     (0xf1 0x00 instead of 0x71) would be a SECOND string for the same CID
