@@ -156,6 +156,8 @@ class AMT:
         node = self._root_node
         for h in range(self.height, 0, -1):
             bmap, links, _ = self._node_parts(node)
+            if len(links) > width:
+                raise ValueError("too many AMT links")
             bits = _bmap_int(bmap)
             slot = (index >> (self.bit_width * h)) & (width - 1)
             if not (bits >> slot) & 1:
@@ -167,12 +169,16 @@ class AMT:
         bmap, _, values = self._node_parts(node)
         bits = _bmap_int(bmap)
         slot = index & (width - 1)
+        # EXACT leaf count, like the native full walk ('AMT leaf value count
+        # mismatch'): a leaf padded with extra values is non-canonical and
+        # must fail here too, or the scalar path verifies nodes the batch
+        # walk (and the reference's serde) rejects. Masked to width bits —
+        # the native walk only reads slots below width
+        if (bits & ((1 << width) - 1)).bit_count() != len(values):
+            raise ValueError("malformed AMT node: bitmap/values mismatch")
         if not (bits >> slot) & 1:
             return None
-        value_pos = (bits & ((1 << slot) - 1)).bit_count()
-        if value_pos >= len(values):
-            raise ValueError("malformed AMT node: bitmap exceeds values")
-        return values[value_pos]
+        return values[(bits & ((1 << slot) - 1)).bit_count()]
 
     def for_each(self, fn: Callable[[int, Any], None]) -> None:
         """Call ``fn(index, value)`` for every element in ascending order."""
@@ -185,15 +191,18 @@ class AMT:
     def _walk(self, node: list, height: int, base: int) -> Iterator[tuple[int, Any]]:
         width = _width(self.bit_width)
         bmap, links, values = self._node_parts(node)
+        if len(links) > width:
+            raise ValueError("too many AMT links")
         bits = _bmap_int(bmap)
+        # EXACT leaf count, mirroring the native full walk (see get())
+        if height == 0 and (bits & ((1 << width) - 1)).bit_count() != len(values):
+            raise ValueError("malformed AMT node: bitmap/values mismatch")
         pos = 0
         span = width**height
         for slot in range(width):
             if not (bits >> slot) & 1:
                 continue
             if height == 0:
-                if pos >= len(values):
-                    raise ValueError("malformed AMT node: bitmap exceeds values")
                 yield base + slot, values[pos]
             else:
                 if pos >= len(links):

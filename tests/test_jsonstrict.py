@@ -1,0 +1,83 @@
+"""Direct accept/reject matrix for the shared strict-JSON accessors.
+
+utils/jsonstrict.py guards both untrusted-input boundaries (proof bundles,
+F3 certificates); the boundary fuzzes cover it transitively, but each
+accessor's exact acceptance deserves direct pinning — especially the
+canonical-base64 rule, which exists because even validate=True accepts
+non-zero trailing padding bits.
+"""
+
+import pytest
+
+from ipc_proofs_tpu.utils.jsonstrict import strict_fields
+
+_S = strict_fields("boundary")
+
+
+class TestAccessors:
+    def test_as_map(self):
+        assert _S.as_map({"a": 1}, "x") == {"a": 1}
+        for bad in ([], "s", 1, None, True):
+            with pytest.raises(ValueError, match="boundary: x must be a JSON"):
+                _S.as_map(bad, "x")
+
+    def test_get(self):
+        assert _S.get({"k": 0}, "k", "x") == 0
+        with pytest.raises(ValueError, match="missing field 'k'"):
+            _S.get({}, "k", "x")
+
+    def test_as_int_excludes_bool(self):
+        assert _S.as_int(-5, "x") == -5
+        assert _S.as_int(2**70, "x") == 2**70
+        for bad in (True, False, 1.0, "1", None, []):
+            with pytest.raises(ValueError, match="must be an integer"):
+                _S.as_int(bad, "x")
+
+    def test_as_str(self):
+        assert _S.as_str("", "x") == ""
+        for bad in (b"s", 1, None, ["s"]):
+            with pytest.raises(ValueError, match="must be a string"):
+                _S.as_str(bad, "x")
+
+    def test_as_list_and_str_list(self):
+        assert _S.as_list([1], "x") == [1]
+        with pytest.raises(ValueError, match="must be a list"):
+            _S.as_list((1,), "x")
+        assert _S.as_str_list(["a"], "x") == ["a"]
+        for bad in ([1], ["a", None], "abc"):
+            with pytest.raises(ValueError, match="list of strings"):
+                _S.as_str_list(bad, "x")
+
+    def test_as_bytes_forms(self):
+        assert _S.as_bytes(b"\x01", "x") == b"\x01"
+        assert _S.as_bytes(bytearray(b"\x02"), "x") == b"\x02"
+        assert _S.as_bytes([0, 255], "x") == b"\x00\xff"
+        assert _S.as_bytes("AA==", "x") == b"\x00"
+        for bad in ([256], [-1], [True], 1, None, {"b": 1}):
+            with pytest.raises(ValueError, match="must be bytes"):
+                _S.as_bytes(bad, "x")
+
+    def test_b64_canonicality(self):
+        # garbage characters: lax decode would silently DISCARD them
+        with pytest.raises(ValueError, match="bad base64"):
+            _S.b64_strict("A!A!E!==", "x")
+        # non-zero trailing padding bits: validate=True alone accepts this
+        with pytest.raises(ValueError, match="non-canonical base64"):
+            _S.b64_strict("AB==", "x")
+        # whitespace: discarded by lax decoding, rejected here
+        with pytest.raises(ValueError, match="bad base64"):
+            _S.b64_strict("AA E=", "x")
+        assert _S.b64_strict("AAE=", "x") == b"\x00\x01"
+        assert _S.b64_strict("", "x") == b""
+
+    def test_as_cid_str(self):
+        assert _S.as_cid_str("bafy", "x") == "bafy"
+        assert _S.as_cid_str({"/": "bafy"}, "x") == "bafy"
+        for bad in ({"/": 5}, {}, 5, None, ["bafy"]):
+            with pytest.raises(ValueError, match="must be a CID string"):
+                _S.as_cid_str(bad, "x")
+
+    def test_prefix_appears_in_every_message(self):
+        other = strict_fields("malformed widget")
+        with pytest.raises(ValueError, match="^malformed widget:"):
+            other.as_int("x", "f")

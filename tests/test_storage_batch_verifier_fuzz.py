@@ -216,6 +216,20 @@ class TestMalformedTreeNodes:
         with pytest.raises(ValueError):
             AMT.load(bs, cid, expected_version=0).get(1)
 
+    def test_amt_padded_leaf_values_rejected(self):
+        from ipc_proofs_tpu.ipld.amt import AMT
+
+        # one bit set, TWO values: the native full walk requires the leaf
+        # value count to EQUAL the bitmap popcount ('AMT leaf value count
+        # mismatch'); the Python reader must reject identically — it used
+        # to accept the padded node, verifying what the batch walk rejects
+        bs, cid = self._store_with([0, 1, [b"\x01", [], [b"v", b"extra"]]])
+        amt = AMT.load(bs, cid, expected_version=0)
+        with pytest.raises(ValueError):
+            amt.get(0)
+        with pytest.raises(ValueError):
+            list(amt.items())
+
 
 @pytest.mark.parametrize("seed", [7, 0xA17, 424242])
 def test_randomized_storage_mutation_differential(seed):
