@@ -20,9 +20,6 @@ _SRC = Path(__file__).parent / "hashes.cpp"
 _BUILD_DIR = Path(__file__).parent / "build"
 _SO_PATH = _BUILD_DIR / "libipchashes.so"
 
-_DAGCBOR_SRC = Path(__file__).parent / "dagcbor_ext.c"
-_DAGCBOR_SO = _BUILD_DIR / "ipc_dagcbor_ext.so"
-
 _SCAN_SRC = Path(__file__).parent / "scan_ext.c"
 _SCAN_SO = _BUILD_DIR / "ipc_scan_ext.so"
 
@@ -97,86 +94,41 @@ def _build() -> Optional[Path]:
 
 
 def load_dagcbor_ext():
-    """Compile (if needed) and import the C DAG-CBOR decoder module.
+    """Compile (if needed) and import the C DAG-CBOR/CID module.
 
-    Returns the extension module with ``decode``/``decode_many``/
-    ``set_cid_factory``, or None on any failure (callers fall back to the
-    pure-Python decoder).
+    Delegates to :mod:`ipc_proofs_tpu.core._cid_native` (the single build
+    cache — core.cid binds its native CID type from the same loaded
+    module). Returns the extension module, or None on any failure (callers
+    fall back to the pure-Python decoder).
     """
     global _dagcbor_cached
     with _lock:
         if _dagcbor_cached is not False:
             return _dagcbor_cached
-        if os.environ.get("IPC_PROOFS_NO_NATIVE"):
-            _dagcbor_cached = None
-            return None
         try:
-            module = _build_cpython_ext(_DAGCBOR_SRC, _DAGCBOR_SO, "ipc_dagcbor_ext")
-            from ipc_proofs_tpu.core.cid import CID  # deferred: avoids import cycle
+            from ipc_proofs_tpu.core import _cid_native
 
-            module.set_cid_factory(CID.from_bytes)
-            if hasattr(module, "set_cid_class"):
-                module.set_cid_class(CID)  # direct C-side link construction
+            module = _cid_native.load()
+            if module is not None and not hasattr(module, "CID"):
+                # legacy extension builds without the native CID type need a
+                # factory/class registered for tag-42 links
+                from ipc_proofs_tpu.core.cid import CID  # deferred: avoids cycle
+
+                module.set_cid_factory(CID.from_bytes)
+                if hasattr(module, "set_cid_class"):
+                    module.set_cid_class(CID)
             _dagcbor_cached = module
         except Exception:
             _dagcbor_cached = None
         return _dagcbor_cached
 
 
-def _host_build_id() -> str:
-    """Identity of the CPU the cached .so was tuned for — a checkout (or
-    container image) moved to a different host must rebuild rather than
-    run a stale -march=native binary into SIGILL."""
-    import hashlib
-    import platform
+def _build_cpython_ext(src, so, mod_name):
+    """Compile-and-import via the shared builder in core._cid_native (one
+    build cache, one host stamp scheme for every raw-CPython extension)."""
+    from ipc_proofs_tpu.core import _cid_native
 
-    model = ""
-    try:
-        with open("/proc/cpuinfo") as fh:
-            for line in fh:
-                if line.startswith("model name"):
-                    model = line.split(":", 1)[1].strip()
-                    break
-    except OSError:
-        pass
-    if not model:
-        model = platform.processor() or "unknown"
-    return hashlib.sha256(f"{platform.machine()}|{model}".encode()).hexdigest()[:16]
-
-
-def _build_cpython_ext(src: Path, so: Path, mod_name: str):
-    """Compile (mtime- AND host-stamp-cached) and import a raw-CPython-API
-    extension."""
-    import importlib.util
-    import sysconfig
-
-    _BUILD_DIR.mkdir(exist_ok=True)
-    stamp = so.with_suffix(so.suffix + ".host")
-    host_id = _host_build_id()
-    cached = (
-        so.exists()
-        and so.stat().st_mtime >= src.stat().st_mtime
-        and stamp.exists()
-        and stamp.read_text() == host_id
-    )
-    if not cached:
-        include = sysconfig.get_paths()["include"]
-        base = ["gcc", "-O3", "-shared", "-fPIC", "-pthread", f"-I{include}",
-                str(src), "-o", str(so)]
-        try:
-            # host-tuned codegen measurably helps the scan parse loop;
-            # retry portable if the toolchain rejects -march=native
-            subprocess.run(
-                base[:2] + ["-march=native"] + base[2:],
-                check=True, capture_output=True, timeout=120,
-            )
-        except subprocess.SubprocessError:
-            subprocess.run(base, check=True, capture_output=True, timeout=120)
-        stamp.write_text(host_id)
-    spec = importlib.util.spec_from_file_location(mod_name, so)
-    module = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(module)
-    return module
+    return _cid_native.build_cpython_ext(src, so, mod_name)
 
 
 def load_scan_ext():

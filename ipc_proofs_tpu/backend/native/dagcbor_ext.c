@@ -192,24 +192,12 @@ static PyObject *parse_item_inner(Parser *p) {
                         "tag-42 content must be identity-multibase CID bytes");
         return NULL;
       }
-      if (cid_class) { /* direct C construction — no Python call per link */
-        PyObject *cid = make_cid(
-            (const uint8_t *)PyBytes_AS_STRING(inner) + 1,
-            PyBytes_GET_SIZE(inner) - 1);
-        Py_DECREF(inner);
-        return cid;
-      }
-      if (!cid_factory) {
-        Py_DECREF(inner);
-        PyErr_SetString(PyExc_RuntimeError, "CID factory not registered");
-        return NULL;
-      }
-      PyObject *cid_bytes = PyBytes_FromStringAndSize(
-          PyBytes_AS_STRING(inner) + 1, PyBytes_GET_SIZE(inner) - 1);
+      /* direct construction of the native CID type — no Python call and
+       * no per-field attribute write per link */
+      PyObject *cid =
+          make_cid((const uint8_t *)PyBytes_AS_STRING(inner) + 1,
+                   PyBytes_GET_SIZE(inner) - 1);
       Py_DECREF(inner);
-      if (!cid_bytes) return NULL;
-      PyObject *cid = PyObject_CallOneArg(cid_factory, cid_bytes);
-      Py_DECREF(cid_bytes);
       return cid;
     }
     case 7: /* simple / float */
@@ -272,12 +260,17 @@ static int utf8_valid(const uint8_t *s, Py_ssize_t n) {
 /* unsigned LEB128, mirroring core/varint.py decode_uvarint exactly:
  * at most 10 bytes (shift > 63 after a continuation byte errors), 128-bit
  * accumulation so oversized values compare/fail like Python's bignums. */
+static int cid_uvarint_errkind; /* 1 = truncated, 2 = too long (last failure) */
+
 static int cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
                        unsigned __int128 *out) {
   unsigned __int128 value = 0;
   int shift = 0;
   for (;;) {
-    if (*pos >= n) return -1; /* truncated uvarint */
+    if (*pos >= n) {
+      cid_uvarint_errkind = 1; /* truncated uvarint */
+      return -1;
+    }
     uint8_t b = d[(*pos)++];
     value |= (unsigned __int128)(b & 0x7F) << shift;
     if (!(b & 0x80)) {
@@ -285,7 +278,10 @@ static int cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
       return 0;
     }
     shift += 7;
-    if (shift > 63) return -1; /* uvarint too long */
+    if (shift > 63) {
+      cid_uvarint_errkind = 2; /* uvarint too long */
+      return -1;
+    }
   }
 }
 
@@ -329,52 +325,612 @@ static PyObject *u128_to_pylong(unsigned __int128 v) {
 #endif
 }
 
-/* Construct a CID instance directly (the Python-call-per-link factory was
- * ~80% of header decode cost). Mirrors CID.from_bytes acceptance exactly;
- * stashes the raw bytes as the to_bytes memo ONLY when every varint is
- * minimal (i.e. raw IS the canonical encoding — same no-malleability rule
- * as the Python fast paths). */
-static PyObject *make_cid(const uint8_t *raw, Py_ssize_t n) {
-  Py_ssize_t pos = 0;
-  unsigned __int128 version, codec, mh_code, mh_len;
-  int minimal = 1;
-  if (cid_uvarint_min(raw, n, &pos, &version, &minimal) < 0 || version != 1 ||
-      cid_uvarint_min(raw, n, &pos, &codec, &minimal) < 0 ||
-      cid_uvarint_min(raw, n, &pos, &mh_code, &minimal) < 0 ||
-      cid_uvarint_min(raw, n, &pos, &mh_len, &minimal) < 0 ||
-      (unsigned __int128)(n - pos) != mh_len) {
-    PyErr_SetString(PyExc_ValueError, "malformed CID bytes");
-    return NULL;
-  }
-  PyTypeObject *tp = (PyTypeObject *)cid_class;
-  PyObject *obj = tp->tp_alloc(tp, 0);
-  if (!obj) return NULL;
-  PyObject *v_version = PyLong_FromUnsignedLongLong((unsigned long long)version);
-  PyObject *v_codec = u128_to_pylong(codec);
-  PyObject *v_mh = u128_to_pylong(mh_code);
-  PyObject *v_digest = PyBytes_FromStringAndSize((const char *)raw + pos, n - pos);
-  PyObject *v_raw = minimal ? PyBytes_FromStringAndSize((const char *)raw, n) : NULL;
-  int rc = 0;
-  if (!v_version || !v_codec || !v_mh || !v_digest || (minimal && !v_raw)) {
-    rc = -1;
-  } else {
-    rc |= PyObject_GenericSetAttr(obj, s_version, v_version);
-    rc |= PyObject_GenericSetAttr(obj, s_codec, v_codec);
-    rc |= PyObject_GenericSetAttr(obj, s_mh_code, v_mh);
-    rc |= PyObject_GenericSetAttr(obj, s_digest, v_digest);
-    if (minimal) rc |= PyObject_GenericSetAttr(obj, s_bytes, v_raw);
-  }
-  Py_XDECREF(v_version);
-  Py_XDECREF(v_codec);
-  Py_XDECREF(v_mh);
-  Py_XDECREF(v_digest);
-  Py_XDECREF(v_raw);
-  if (rc) {
-    Py_DECREF(obj);
-    return NULL;
-  }
-  return obj;
+/* ====================== native CID extension type ======================
+ *
+ * A C-slot CID (the round-5 unlock named in NOTES_r04): the Python
+ * dataclass pays a per-instance __dict__ plus one dict insert per field
+ * and per memo — measured at ~2.9 µs/header for the 4-5 link CIDs each
+ * block header carries, the floor under the verify_replay/record stages.
+ * This type stores (version, codec, mh_code) as C uint128 fields, the
+ * digest as a bytes object, and memoizes to_bytes/str/hash in C slots.
+ * Interface parity with ipc_proofs_tpu.core.cid.CID (the pure-Python
+ * fallback, which stays the correctness reference): same constructor
+ * signature, classmethods, comparison/hash semantics, and the same
+ * strict-canonical acceptance at the bytes and string boundaries
+ * (reference stack: the Rust `cid` + `multibase` crates, SURVEY §2b). */
+
+static PyTypeObject CID_Type; /* forward */
+
+/* base32 tables are defined with the batched string codecs below */
+static const char b32_alpha[32];
+static int8_t b32_val[256];
+static int b32_val_ready;
+static void b32_val_init(void);
+
+typedef struct {
+  PyObject_HEAD
+  unsigned __int128 version;
+  unsigned __int128 codec;
+  unsigned __int128 mh_code;
+  PyObject *digest;     /* bytes (any object tolerated, like the dataclass) */
+  PyObject *bytes_memo; /* canonical encoding, NULL until computed */
+  PyObject *str_memo;   /* multibase base32-lower string, NULL until computed */
+  PyObject *field_memo[3]; /* lazily-built PyLongs for version/codec/mh_code */
+  Py_hash_t hash_memo;  /* -1 until computed (PyObject_Hash never returns -1) */
+} CIDObject;
+
+static void cid_dealloc(CIDObject *o) {
+  Py_XDECREF(o->digest);
+  Py_XDECREF(o->bytes_memo);
+  Py_XDECREF(o->str_memo);
+  for (int i = 0; i < 3; i++) Py_XDECREF(o->field_memo[i]);
+  PyObject_Free(o);
 }
+
+/* core allocator: borrows digest (increfs internally) */
+static PyObject *cid_new_parts(unsigned __int128 version, unsigned __int128 codec,
+                               unsigned __int128 mh_code, PyObject *digest) {
+  CIDObject *o = PyObject_New(CIDObject, &CID_Type);
+  if (!o) return NULL;
+  o->version = version;
+  o->codec = codec;
+  o->mh_code = mh_code;
+  Py_INCREF(digest);
+  o->digest = digest;
+  o->bytes_memo = NULL;
+  o->str_memo = NULL;
+  o->field_memo[0] = o->field_memo[1] = o->field_memo[2] = NULL;
+  o->hash_memo = -1;
+  return (PyObject *)o;
+}
+
+/* exact PyLong -> u128; negative -> ValueError (encode_uvarint parity),
+ * > u128 -> OverflowError (the dataclass tolerates arbitrary bignums but
+ * nothing real exceeds the varint decoder's ~2^70 cap) */
+static int pylong_to_u128(PyObject *v, unsigned __int128 *out) {
+  if (!PyLong_Check(v)) {
+    PyErr_Format(PyExc_TypeError, "CID field must be int, not %.80s",
+                 Py_TYPE(v)->tp_name);
+    return -1;
+  }
+  int overflow;
+  long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+  if (!overflow) {
+    if (ll < 0) {
+      PyErr_SetString(PyExc_ValueError, "uvarint cannot encode negative values");
+      return -1;
+    }
+    *out = (unsigned __int128)ll;
+    return 0;
+  }
+  if (overflow < 0) {
+    PyErr_SetString(PyExc_ValueError, "uvarint cannot encode negative values");
+    return -1;
+  }
+  unsigned char le[16];
+#if PY_VERSION_HEX >= 0x030D0000
+  /* AsNativeBytes does NOT raise on overflow — it returns the number of
+   * bytes the value actually needs; > 16 means truncation happened */
+  Py_ssize_t needed = PyLong_AsNativeBytes(
+      v, le, 16,
+      Py_ASNATIVEBYTES_LITTLE_ENDIAN | Py_ASNATIVEBYTES_UNSIGNED_BUFFER |
+          Py_ASNATIVEBYTES_REJECT_NEGATIVE);
+  if (needed < 0 || PyErr_Occurred()) return -1;
+  if (needed > 16) {
+    PyErr_SetString(PyExc_OverflowError, "CID field exceeds 128 bits");
+    return -1;
+  }
+#else
+  if (_PyLong_AsByteArray((PyLongObject *)v, le, 16, 1 /* little */,
+                          0 /* unsigned: raises on negative/overflow */) < 0)
+    return -1;
+#endif
+  unsigned __int128 acc = 0;
+  for (int i = 15; i >= 0; i--) acc = (acc << 8) | le[i];
+  *out = acc;
+  return 0;
+}
+
+static size_t uvarint_put(uint8_t *out, unsigned __int128 v) {
+  size_t n = 0;
+  do {
+    uint8_t b = (uint8_t)(v & 0x7F);
+    v >>= 7;
+    out[n++] = (uint8_t)(b | (v ? 0x80 : 0));
+  } while (v);
+  return n;
+}
+
+/* to_bytes with C-slot memoization (CID.to_bytes parity: varint header +
+ * digest; memo holds the canonical encoding) */
+static PyObject *cid_to_bytes_obj(CIDObject *o) {
+  if (o->bytes_memo) return Py_NewRef(o->bytes_memo);
+  if (!PyBytes_Check(o->digest)) {
+    PyErr_SetString(PyExc_TypeError, "CID digest must be bytes to serialize");
+    return NULL;
+  }
+  uint8_t head[4 * 19];
+  size_t hn = 0;
+  hn += uvarint_put(head + hn, o->version);
+  hn += uvarint_put(head + hn, o->codec);
+  hn += uvarint_put(head + hn, o->mh_code);
+  Py_ssize_t dn = PyBytes_GET_SIZE(o->digest);
+  hn += uvarint_put(head + hn, (unsigned __int128)dn);
+  PyObject *b = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)hn + dn);
+  if (!b) return NULL;
+  char *w = PyBytes_AS_STRING(b);
+  memcpy(w, head, hn);
+  memcpy(w + hn, PyBytes_AS_STRING(o->digest), (size_t)dn);
+  o->bytes_memo = b;
+  return Py_NewRef(b);
+}
+
+static PyObject *cid_to_bytes_meth(CIDObject *o, PyObject *ignored) {
+  (void)ignored;
+  return cid_to_bytes_obj(o);
+}
+
+/* multibase base32-lower render of raw CID bytes ("b" prefix, RFC 4648
+ * lower alphabet, no padding) — the single encoder behind CID.__str__ and
+ * the batched cid_strs */
+static PyObject *b32_render(const uint8_t *d, Py_ssize_t blen) {
+  Py_ssize_t nchars = (blen * 8 + 4) / 5;
+  PyObject *str = PyUnicode_New(1 + nchars, 127);
+  if (!str) return NULL;
+  Py_UCS1 *w = PyUnicode_1BYTE_DATA(str);
+  *w++ = 'b';
+  uint32_t acc = 0;
+  int bits = 0;
+  for (Py_ssize_t k = 0; k < blen; k++) {
+    acc = (acc << 8) | d[k];
+    bits += 8;
+    while (bits >= 5) {
+      bits -= 5;
+      *w++ = (Py_UCS1)b32_alpha[(acc >> bits) & 31];
+    }
+  }
+  if (bits) *w++ = (Py_UCS1)b32_alpha[(acc << (5 - bits)) & 31];
+  return str;
+}
+
+/* memoized CID.__str__ */
+static PyObject *cid_str_meth(CIDObject *o) {
+  if (o->str_memo) return Py_NewRef(o->str_memo);
+  PyObject *raw = cid_to_bytes_obj(o);
+  if (!raw) return NULL;
+  PyObject *str = b32_render((const uint8_t *)PyBytes_AS_STRING(raw),
+                             PyBytes_GET_SIZE(raw));
+  Py_DECREF(raw);
+  if (!str) return NULL;
+  o->str_memo = str;
+  return Py_NewRef(str);
+}
+
+static PyObject *cid_repr(CIDObject *o) {
+  PyObject *s = cid_str_meth(o);
+  if (!s) return NULL;
+  PyObject *r = PyUnicode_FromFormat("CID(%U)", s);
+  Py_DECREF(s);
+  return r;
+}
+
+static Py_hash_t cid_hash(CIDObject *o) {
+  if (o->hash_memo != -1) return o->hash_memo;
+  Py_hash_t h = PyObject_Hash(o->digest); /* dataclass parity: hash(digest) */
+  if (h == -1) return -1;
+  o->hash_memo = h;
+  return h;
+}
+
+static PyObject *cid_field_pylong(CIDObject *o, int idx) {
+  if (!o->field_memo[idx]) {
+    unsigned __int128 v = idx == 0 ? o->version : idx == 1 ? o->codec
+                                                           : o->mh_code;
+    o->field_memo[idx] = u128_to_pylong(v);
+    if (!o->field_memo[idx]) return NULL;
+  }
+  return Py_NewRef(o->field_memo[idx]);
+}
+
+static PyObject *cid_get_version(CIDObject *o, void *c) {
+  (void)c;
+  return cid_field_pylong(o, 0);
+}
+static PyObject *cid_get_codec(CIDObject *o, void *c) {
+  (void)c;
+  return cid_field_pylong(o, 1);
+}
+static PyObject *cid_get_mh_code(CIDObject *o, void *c) {
+  (void)c;
+  return cid_field_pylong(o, 2);
+}
+static PyObject *cid_get_digest(CIDObject *o, void *c) {
+  (void)c;
+  return Py_NewRef(o->digest);
+}
+
+static PyGetSetDef cid_getset[] = {
+    {"version", (getter)cid_get_version, NULL, "CID version (1)", NULL},
+    {"codec", (getter)cid_get_codec, NULL, "content codec (0x71 dag-cbor)", NULL},
+    {"mh_code", (getter)cid_get_mh_code, NULL, "multihash code", NULL},
+    {"digest", (getter)cid_get_digest, NULL, "multihash digest bytes", NULL},
+    {NULL, NULL, NULL, NULL, NULL}};
+
+/* comparisons: EQ/NE by (version, codec, mh_code, digest) like the frozen
+ * dataclass; ordering by to_bytes() like CID.__lt__/total_ordering. The
+ * duck-typed branch keeps mixed comparison with the pure-Python fallback
+ * class working (equivalence tests compare across implementations). */
+static PyObject *cid_richcompare(PyObject *a, PyObject *b, int op) {
+  CIDObject *x = (CIDObject *)a; /* tp_richcompare: a is always our type */
+  if (PyObject_TypeCheck(b, &CID_Type)) {
+    CIDObject *y = (CIDObject *)b;
+    if (op == Py_EQ || op == Py_NE) {
+      int eq = x->version == y->version && x->codec == y->codec &&
+               x->mh_code == y->mh_code;
+      if (eq) {
+        if (PyBytes_CheckExact(x->digest) && PyBytes_CheckExact(y->digest)) {
+          Py_ssize_t nx = PyBytes_GET_SIZE(x->digest);
+          eq = nx == PyBytes_GET_SIZE(y->digest) &&
+               memcmp(PyBytes_AS_STRING(x->digest), PyBytes_AS_STRING(y->digest),
+                      (size_t)nx) == 0;
+        } else {
+          eq = PyObject_RichCompareBool(x->digest, y->digest, Py_EQ);
+          if (eq < 0) return NULL;
+        }
+      }
+      return PyBool_FromLong(op == Py_EQ ? eq : !eq);
+    }
+    PyObject *xb = cid_to_bytes_obj(x);
+    if (!xb) return NULL;
+    PyObject *yb = cid_to_bytes_obj(y);
+    if (!yb) {
+      Py_DECREF(xb);
+      return NULL;
+    }
+    PyObject *r = PyObject_RichCompare(xb, yb, op);
+    Py_DECREF(xb);
+    Py_DECREF(yb);
+    return r;
+  }
+  if (op == Py_EQ || op == Py_NE) {
+    static const char *names[] = {"version", "codec", "mh_code", "digest"};
+    int eq = 1;
+    for (int i = 0; i < 4 && eq; i++) {
+      PyObject *theirs = PyObject_GetAttrString(b, names[i]);
+      if (!theirs) {
+        PyErr_Clear();
+        Py_RETURN_NOTIMPLEMENTED;
+      }
+      PyObject *ours = i == 3 ? Py_NewRef(x->digest) : cid_field_pylong(x, i);
+      if (!ours) {
+        Py_DECREF(theirs);
+        return NULL;
+      }
+      eq = PyObject_RichCompareBool(ours, theirs, Py_EQ);
+      Py_DECREF(ours);
+      Py_DECREF(theirs);
+      if (eq < 0) return NULL;
+    }
+    return PyBool_FromLong(op == Py_EQ ? eq : !eq);
+  }
+  PyObject *their_to_bytes = PyObject_GetAttrString(b, "to_bytes");
+  if (!their_to_bytes) {
+    PyErr_Clear();
+    Py_RETURN_NOTIMPLEMENTED;
+  }
+  PyObject *yb = PyObject_CallNoArgs(their_to_bytes);
+  Py_DECREF(their_to_bytes);
+  if (!yb) return NULL;
+  PyObject *xb = cid_to_bytes_obj(x);
+  if (!xb) {
+    Py_DECREF(yb);
+    return NULL;
+  }
+  PyObject *r = PyObject_RichCompare(xb, yb, op);
+  Py_DECREF(xb);
+  Py_DECREF(yb);
+  return r;
+}
+
+static PyObject *cid_tp_new(PyTypeObject *type, PyObject *args, PyObject *kwds) {
+  (void)type; /* no subclassing (tp_flags has no BASETYPE) */
+  static char *kwlist[] = {"version", "codec", "mh_code", "digest", NULL};
+  PyObject *pv, *pc, *pm, *pd;
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "OOOO", kwlist, &pv, &pc, &pm,
+                                   &pd))
+    return NULL;
+  unsigned __int128 v, c, m;
+  if (pylong_to_u128(pv, &v) < 0 || pylong_to_u128(pc, &c) < 0 ||
+      pylong_to_u128(pm, &m) < 0)
+    return NULL;
+  return cid_new_parts(v, c, m, pd);
+}
+
+static PyObject *cid_cls_make(PyObject *cls, PyObject *args, PyObject *kwds) {
+  return cid_tp_new((PyTypeObject *)cls, args, kwds);
+}
+
+/* CID.from_bytes parity, including the error messages of the pure-Python
+ * generic path. detailed=0 gives make_cid's single "malformed CID bytes"
+ * (the tolerant tag-42 / make_cids boundary). Memoizes raw as to_bytes
+ * IFF every varint is minimal — the no-malleability rule shared with the
+ * Python fast paths (only canonical encodings may be memoized). */
+static PyObject *cid_from_raw(const uint8_t *raw, Py_ssize_t n, int detailed) {
+  Py_ssize_t pos = 0;
+  unsigned __int128 version = 0, codec = 0, mh_code = 0, mh_len = 0;
+  int minimal = 1;
+  if (cid_uvarint_min(raw, n, &pos, &version, &minimal) < 0) goto uverr;
+  if (version != 1) {
+    if (!detailed) goto generic;
+    PyObject *v = u128_to_pylong(version);
+    if (v) {
+      PyErr_Format(PyExc_ValueError, "unsupported CID version %S", v);
+      Py_DECREF(v);
+    }
+    return NULL;
+  }
+  if (cid_uvarint_min(raw, n, &pos, &codec, &minimal) < 0 ||
+      cid_uvarint_min(raw, n, &pos, &mh_code, &minimal) < 0 ||
+      cid_uvarint_min(raw, n, &pos, &mh_len, &minimal) < 0)
+    goto uverr;
+  if ((unsigned __int128)(n - pos) < mh_len) {
+    if (!detailed) goto generic;
+    PyErr_SetString(PyExc_ValueError, "truncated CID multihash digest");
+    return NULL;
+  }
+  if ((unsigned __int128)(n - pos) > mh_len) {
+    if (!detailed) goto generic;
+    PyErr_SetString(PyExc_ValueError, "trailing bytes after CID");
+    return NULL;
+  }
+  {
+    PyObject *digest =
+        PyBytes_FromStringAndSize((const char *)raw + pos, n - pos);
+    if (!digest) return NULL;
+    CIDObject *o = (CIDObject *)cid_new_parts(version, codec, mh_code, digest);
+    Py_DECREF(digest);
+    if (!o) return NULL;
+    if (minimal) {
+      o->bytes_memo = PyBytes_FromStringAndSize((const char *)raw, n);
+      if (!o->bytes_memo) {
+        Py_DECREF(o);
+        return NULL;
+      }
+    }
+    return (PyObject *)o;
+  }
+uverr:
+  if (detailed) {
+    /* decode_uvarint parity: truncation vs the 10-byte length cap */
+    PyErr_SetString(PyExc_ValueError, cid_uvarint_errkind == 2
+                                          ? "uvarint too long"
+                                          : "truncated uvarint");
+    return NULL;
+  }
+generic:
+  PyErr_SetString(PyExc_ValueError, "malformed CID bytes");
+  return NULL;
+}
+
+/* tolerant construction from raw CID bytes (tag-42 links, make_cids) */
+static PyObject *make_cid(const uint8_t *raw, Py_ssize_t n) {
+  return cid_from_raw(raw, n, 0);
+}
+
+static PyObject *cid_cls_from_bytes(PyObject *cls, PyObject *arg) {
+  (void)cls;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  PyObject *out = cid_from_raw((const uint8_t *)view.buf, view.len, 1);
+  PyBuffer_Release(&view);
+  return out;
+}
+
+/* single-string CID.from_string core (strict canonical multibase base32:
+ * 'b' prefix, lowercase alphabet, valid unpadded length class, zero
+ * trailing bits, minimal varints) — shared by the classmethod and the
+ * batched cids_from_strs loop. */
+static PyObject *cid_from_str_item(PyObject *item) {
+  if (!b32_val_ready) b32_val_init();
+  Py_ssize_t slen;
+  const char *s =
+      PyUnicode_Check(item) ? PyUnicode_AsUTF8AndSize(item, &slen) : NULL;
+  if (!s) {
+    if (!PyErr_Occurred())
+      PyErr_Format(PyExc_TypeError, "CID string must be str, not %.80s",
+                   Py_TYPE(item)->tp_name);
+    return NULL;
+  }
+  if (slen == 0) {
+    PyErr_SetString(PyExc_ValueError, "empty CID string");
+    return NULL;
+  }
+  if (s[0] != 'b') {
+    /* NOTE: no %c here — s is UTF-8 and a non-ASCII first byte is
+     * NEGATIVE as a signed char, which makes PyErr_Format itself raise
+     * OverflowError instead of the intended ValueError (found by the
+     * codec fuzz soak) */
+    PyErr_Format(PyExc_ValueError,
+                 "unsupported multibase prefix in %R (base32 only)", item);
+    return NULL;
+  }
+  Py_ssize_t tlen = slen - 1;
+  Py_ssize_t rem = tlen % 8;
+  if (rem == 1 || rem == 3 || rem == 6) {
+    PyErr_Format(PyExc_ValueError, "invalid base32 length %zd", tlen);
+    return NULL;
+  }
+  Py_ssize_t nbytes = tlen * 5 / 8;
+  uint8_t buf[256];
+  /* oversized CIDs (e.g. long identity-multihash digests) are valid to
+   * CID.from_string — heap-allocate past the stack buffer, never reject */
+  uint8_t *dec = buf;
+  if ((size_t)nbytes > sizeof(buf)) {
+    dec = malloc((size_t)nbytes);
+    if (!dec) return PyErr_NoMemory();
+  }
+  uint32_t acc = 0;
+  int bits = 0;
+  uint8_t *w = dec;
+  PyObject *cid = NULL;
+  for (Py_ssize_t k = 1; k < slen; k++) {
+    int8_t v = b32_val[(uint8_t)s[k]];
+    if (v < 0) {
+      PyErr_Format(PyExc_ValueError, "non-base32 character in %R", item);
+      goto done;
+    }
+    acc = (acc << 5) | (uint32_t)v;
+    bits += 5;
+    if (bits >= 8) {
+      bits -= 8;
+      *w++ = (uint8_t)(acc >> bits);
+    }
+  }
+  /* canonical check: the trailing <8 bits must be zero, or two strings
+   * differing only there would decode to one CID */
+  if (bits > 0 && (acc & ((1u << bits) - 1)) != 0) {
+    PyErr_Format(PyExc_ValueError, "non-zero trailing bits in base32 %R", item);
+    goto done;
+  }
+  /* detailed=1: CID.from_string surfaces from_bytes' specific messages
+   * (unsupported version / truncated digest / trailing bytes), not the
+   * tolerant tag-42 boundary's generic one */
+  cid = cid_from_raw(dec, nbytes, 1);
+  if (cid) {
+    /* canonical varints only at the STRING boundary (CID.from_string
+     * parity): a non-minimal varint prefix would be a second string for
+     * the same CID. cid_from_raw sets the to_bytes memo IFF every varint
+     * was minimal — that flag is the single source of truth. */
+    if (!((CIDObject *)cid)->bytes_memo) {
+      Py_DECREF(cid);
+      cid = NULL;
+      PyErr_Format(PyExc_ValueError, "non-canonical CID byte encoding in %R",
+                   item);
+    }
+  }
+done:
+  if (dec != buf) free(dec);
+  return cid;
+}
+
+static PyObject *cid_cls_from_string(PyObject *cls, PyObject *arg) {
+  (void)cls;
+  return cid_from_str_item(arg);
+}
+
+static PyObject *cid_cls_parse(PyObject *cls, PyObject *arg) {
+  if (PyObject_TypeCheck(arg, &CID_Type)) return Py_NewRef(arg);
+  if (PyBytes_Check(arg)) return cid_cls_from_bytes(cls, arg);
+  if (!PyUnicode_Check(arg)) {
+    /* duck-typed CID (the pure-Python fallback class in differential
+     * tests) passes through unchanged, like PurePythonCID.parse */
+    int has = PyObject_HasAttr(arg, s_mh_code) && PyObject_HasAttr(arg, s_digest);
+    if (has) return Py_NewRef(arg);
+  }
+  return cid_from_str_item(arg);
+}
+
+/* hash_of(data, codec=DAG_CBOR, mh_code=BLAKE2B_256): digest via the
+ * cached hashlib constructors (scalar reference path — batch hashing
+ * lives in the C++/XLA/Pallas backends) */
+static PyObject *hashlib_blake2b_fn = NULL, *hashlib_sha256_fn = NULL,
+                *blake2b_kwargs = NULL, *s_digest_meth = NULL;
+
+static PyObject *cid_cls_hash_of(PyObject *cls, PyObject *args, PyObject *kwds) {
+  (void)cls;
+  static char *kwlist[] = {"data", "codec", "mh_code", NULL};
+  Py_buffer data;
+  PyObject *pcodec = NULL, *pmh = NULL;
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "y*|OO", kwlist, &data, &pcodec,
+                                   &pmh))
+    return NULL;
+  unsigned __int128 codec = 0x71, mh = 0xB220;
+  if ((pcodec && pylong_to_u128(pcodec, &codec) < 0) ||
+      (pmh && pylong_to_u128(pmh, &mh) < 0)) {
+    PyBuffer_Release(&data);
+    return NULL;
+  }
+  PyObject *data_bytes = PyBytes_FromStringAndSize(data.buf, data.len);
+  PyBuffer_Release(&data);
+  if (!data_bytes) return NULL;
+  PyObject *digest = NULL;
+  if (mh == 0xB220 || mh == 0x12) {
+    PyObject *one = PyTuple_Pack(1, data_bytes);
+    PyObject *h =
+        one ? PyObject_Call(mh == 0xB220 ? hashlib_blake2b_fn : hashlib_sha256_fn,
+                            one, mh == 0xB220 ? blake2b_kwargs : NULL)
+            : NULL;
+    Py_XDECREF(one);
+    Py_DECREF(data_bytes);
+    if (!h) return NULL;
+    digest = PyObject_CallMethodNoArgs(h, s_digest_meth);
+    Py_DECREF(h);
+    if (!digest) return NULL;
+  } else if (mh == 0) { /* identity */
+    digest = data_bytes;
+  } else {
+    Py_DECREF(data_bytes);
+    PyObject *v = u128_to_pylong(mh);
+    if (v) {
+      PyObject *hex = PyNumber_ToBase(v, 16);
+      if (hex)
+        PyErr_Format(PyExc_ValueError, "unsupported multihash code %S", hex);
+      Py_XDECREF(hex);
+      Py_DECREF(v);
+    }
+    return NULL;
+  }
+  PyObject *out = cid_new_parts(1, codec, mh, digest);
+  Py_DECREF(digest);
+  return out;
+}
+
+static PyObject *cid_reduce(CIDObject *o, PyObject *ignored) {
+  (void)ignored;
+  PyObject *v = cid_field_pylong(o, 0);
+  PyObject *c = cid_field_pylong(o, 1);
+  PyObject *m = cid_field_pylong(o, 2);
+  if (!v || !c || !m) {
+    Py_XDECREF(v);
+    Py_XDECREF(c);
+    Py_XDECREF(m);
+    return NULL;
+  }
+  return Py_BuildValue("(O(NNNO))", (PyObject *)&CID_Type, v, c, m, o->digest);
+}
+
+static PyMethodDef cid_methods_def[] = {
+    {"to_bytes", (PyCFunction)cid_to_bytes_meth, METH_NOARGS,
+     "Canonical binary CID encoding (varint header + digest), memoized."},
+    {"from_bytes", (PyCFunction)cid_cls_from_bytes, METH_CLASS | METH_O,
+     "Parse a binary CID (CIDv1 only; pure-Python CID.from_bytes parity)."},
+    {"from_string", (PyCFunction)cid_cls_from_string, METH_CLASS | METH_O,
+     "Parse a multibase base32-lower CID string, strictly canonical."},
+    {"parse", (PyCFunction)cid_cls_parse, METH_CLASS | METH_O,
+     "Coerce a CID | bytes | str into a CID."},
+    {"hash_of", (PyCFunction)(void (*)(void))cid_cls_hash_of,
+     METH_CLASS | METH_VARARGS | METH_KEYWORDS,
+     "CID of raw block bytes (default dag-cbor / blake2b-256)."},
+    {"_make", (PyCFunction)(void (*)(void))cid_cls_make,
+     METH_CLASS | METH_VARARGS | METH_KEYWORDS,
+     "Fast constructor alias (dataclass CID._make parity)."},
+    {"__reduce__", (PyCFunction)cid_reduce, METH_NOARGS, "Pickle support."},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject CID_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "ipc_dagcbor_ext.CID",
+    .tp_basicsize = sizeof(CIDObject),
+    .tp_dealloc = (destructor)cid_dealloc,
+    .tp_repr = (reprfunc)cid_repr,
+    .tp_str = (reprfunc)cid_str_meth,
+    .tp_hash = (hashfunc)cid_hash,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "Immutable CIDv1 (C-native): version, codec, mh_code, digest.",
+    .tp_richcompare = cid_richcompare,
+    .tp_methods = cid_methods_def,
+    .tp_getset = cid_getset,
+    .tp_new = cid_tp_new,
+};
 
 static int skip_item_inner(Parser *p);
 
@@ -596,10 +1152,6 @@ static PyObject *py_set_cid_factory(PyObject *self, PyObject *arg) {
  * witness-materialization paths (thousands of CIDs per range bundle). */
 static PyObject *py_make_cids(PyObject *self, PyObject *arg) {
   (void)self;
-  if (!cid_class) {
-    PyErr_SetString(PyExc_RuntimeError, "CID class not registered");
-    return NULL;
-  }
   PyObject *seq = PySequence_Fast(arg, "make_cids expects a sequence of bytes");
   if (!seq) return NULL;
   Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
@@ -654,28 +1206,13 @@ static PyObject *py_cid_strs(PyObject *self, PyObject *arg) {
       PyErr_SetString(PyExc_TypeError, "cid_strs expects bytes items");
       return NULL;
     }
-    const uint8_t *d = (const uint8_t *)PyBytes_AS_STRING(item);
-    Py_ssize_t blen = PyBytes_GET_SIZE(item);
-    Py_ssize_t nchars = (blen * 8 + 4) / 5;
-    PyObject *str = PyUnicode_New(1 + nchars, 127);
+    PyObject *str = b32_render((const uint8_t *)PyBytes_AS_STRING(item),
+                               PyBytes_GET_SIZE(item));
     if (!str) {
       Py_DECREF(out);
       Py_DECREF(seq);
       return NULL;
     }
-    Py_UCS1 *w = PyUnicode_1BYTE_DATA(str);
-    *w++ = 'b';
-    uint32_t acc = 0;
-    int bits = 0;
-    for (Py_ssize_t k = 0; k < blen; k++) {
-      acc = (acc << 8) | d[k];
-      bits += 8;
-      while (bits >= 5) {
-        bits -= 5;
-        *w++ = (Py_UCS1)b32_alpha[(acc >> bits) & 31];
-      }
-    }
-    if (bits) *w++ = (Py_UCS1)b32_alpha[(acc << (5 - bits)) & 31];
     PyList_SET_ITEM(out, i, str);
   }
   Py_DECREF(seq);
@@ -703,11 +1240,6 @@ static void b32_val_init(void) {
 
 static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
   (void)self;
-  if (!cid_class) {
-    PyErr_SetString(PyExc_RuntimeError, "CID class not registered");
-    return NULL;
-  }
-  if (!b32_val_ready) b32_val_init();
   PyObject *seq = PySequence_Fast(arg, "cids_from_strs expects a sequence of str");
   if (!seq) return NULL;
   Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
@@ -716,100 +1248,17 @@ static PyObject *py_cids_from_strs(PyObject *self, PyObject *arg) {
     Py_DECREF(seq);
     return NULL;
   }
-  uint8_t buf[256];
   for (Py_ssize_t i = 0; i < n; i++) {
-    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
-    Py_ssize_t slen;
-    const char *s =
-        PyUnicode_Check(item) ? PyUnicode_AsUTF8AndSize(item, &slen) : NULL;
-    if (!s) {
-      if (!PyErr_Occurred())
-        PyErr_SetString(PyExc_TypeError, "cids_from_strs expects str items");
-      goto fail;
+    PyObject *cid = cid_from_str_item(PySequence_Fast_GET_ITEM(seq, i));
+    if (!cid) {
+      Py_DECREF(out);
+      Py_DECREF(seq);
+      return NULL;
     }
-    if (slen == 0) {
-      PyErr_SetString(PyExc_ValueError, "empty CID string");
-      goto fail;
-    }
-    if (s[0] != 'b') {
-      /* NOTE: no %c here — s is UTF-8 and a non-ASCII first byte is
-       * NEGATIVE as a signed char, which makes PyErr_Format itself raise
-       * OverflowError instead of the intended ValueError (found by the
-       * codec fuzz soak) */
-      PyErr_Format(PyExc_ValueError,
-                   "unsupported multibase prefix in %R (base32 only)", item);
-      goto fail;
-    }
-    Py_ssize_t tlen = slen - 1;
-    Py_ssize_t rem = tlen % 8;
-    if (rem == 1 || rem == 3 || rem == 6) {
-      PyErr_Format(PyExc_ValueError, "invalid base32 length %zd", tlen);
-      goto fail;
-    }
-    Py_ssize_t nbytes = tlen * 5 / 8;
-    /* oversized CIDs (e.g. long identity-multihash digests) are valid to
-     * CID.from_string — heap-allocate past the stack buffer, never reject */
-    uint8_t *dec = buf;
-    if ((size_t)nbytes > sizeof(buf)) {
-      dec = malloc((size_t)nbytes);
-      if (!dec) {
-        PyErr_NoMemory();
-        goto fail;
-      }
-    }
-    uint32_t acc = 0;
-    int bits = 0;
-    uint8_t *w = dec;
-    for (Py_ssize_t k = 1; k < slen; k++) {
-      int8_t v = b32_val[(uint8_t)s[k]];
-      if (v < 0) {
-        PyErr_Format(PyExc_ValueError, "non-base32 character in %R", item);
-        if (dec != buf) free(dec);
-        goto fail;
-      }
-      acc = (acc << 5) | (uint32_t)v;
-      bits += 5;
-      if (bits >= 8) {
-        bits -= 8;
-        *w++ = (uint8_t)(acc >> bits);
-      }
-    }
-    /* canonical check: the trailing <8 bits must be zero, or two strings
-     * differing only there would decode to one CID */
-    if (bits > 0 && (acc & ((1u << bits) - 1)) != 0) {
-      PyErr_Format(PyExc_ValueError, "non-zero trailing bits in base32 %R",
-                   item);
-      if (dec != buf) free(dec);
-      goto fail;
-    }
-    PyObject *cid = make_cid(dec, nbytes);
-    if (cid) {
-      /* canonical varints only at the STRING boundary (CID.from_string
-       * parity): a non-minimal varint prefix would be a second string
-       * for the same CID. make_cid stashes the to_bytes memo (s_bytes)
-       * IFF every varint was minimal — that flag is the single source of
-       * truth, so test for the memo instead of re-parsing the varints. */
-      PyObject *memo = PyObject_GetAttr(cid, s_bytes);
-      if (memo) {
-        Py_DECREF(memo);
-      } else {
-        PyErr_Clear();
-        Py_DECREF(cid);
-        cid = NULL;
-        PyErr_Format(PyExc_ValueError,
-                     "non-canonical CID byte encoding in %R", item);
-      }
-    }
-    if (dec != buf) free(dec);
-    if (!cid) goto fail;
     PyList_SET_ITEM(out, i, cid);
   }
   Py_DECREF(seq);
   return out;
-fail:
-  Py_DECREF(out);
-  Py_DECREF(seq);
-  return NULL;
 }
 
 static PyObject *py_set_cid_class(PyObject *self, PyObject *arg) {
@@ -857,6 +1306,26 @@ PyMODINIT_FUNC PyInit_ipc_dagcbor_ext(void) {
   s_mh_code = PyUnicode_InternFromString("mh_code");
   s_digest = PyUnicode_InternFromString("digest");
   s_bytes = PyUnicode_InternFromString("_bytes");
-  if (!s_version || !s_codec || !s_mh_code || !s_digest || !s_bytes) return NULL;
-  return PyModule_Create(&moduledef);
+  s_digest_meth = PyUnicode_InternFromString("digest");
+  if (!s_version || !s_codec || !s_mh_code || !s_digest || !s_bytes ||
+      !s_digest_meth)
+    return NULL;
+  /* hash_of digest backends: cached hashlib constructors */
+  PyObject *hashlib = PyImport_ImportModule("hashlib");
+  if (!hashlib) return NULL;
+  hashlib_blake2b_fn = PyObject_GetAttrString(hashlib, "blake2b");
+  hashlib_sha256_fn = PyObject_GetAttrString(hashlib, "sha256");
+  Py_DECREF(hashlib);
+  blake2b_kwargs = Py_BuildValue("{s:i}", "digest_size", 32);
+  if (!hashlib_blake2b_fn || !hashlib_sha256_fn || !blake2b_kwargs) return NULL;
+  if (PyType_Ready(&CID_Type) < 0) return NULL;
+  PyObject *m = PyModule_Create(&moduledef);
+  if (!m) return NULL;
+  Py_INCREF(&CID_Type);
+  if (PyModule_AddObject(m, "CID", (PyObject *)&CID_Type) < 0) {
+    Py_DECREF(&CID_Type);
+    Py_DECREF(m);
+    return NULL;
+  }
+  return m;
 }

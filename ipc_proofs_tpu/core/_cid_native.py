@@ -1,0 +1,106 @@
+"""Build-and-load for the C DAG-CBOR/CID extension, import-cycle-free.
+
+This lives in ``core`` (stdlib-only imports) so :mod:`core.cid` can bind
+the native CID type at module import without pulling in the backend
+package — whose ``__init__`` transitively imports half the tree and would
+capture the pure-Python CID mid-rebind (modules imported during the load
+would hold a stale class). :mod:`ipc_proofs_tpu.backend.native` delegates
+here so there is exactly one build cache and one loaded module.
+
+Deliberate tradeoff: binding at import means a COLD checkout pays the gcc
+compile (~2-5 s, once per host) on the first ``import ipc_proofs_tpu``
+even for commands that never decode. The alternative — deferring the
+build to first decode — reintroduces the stale-class hazard this module
+exists to kill (every module imported before the rebind would hold the
+pure-Python CID). Warm checkouts load the cached .so instantly.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+__all__ = ["load", "build_cpython_ext", "host_build_id", "BUILD_DIR"]
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "backend" / "native"
+BUILD_DIR = _NATIVE_DIR / "build"
+_DAGCBOR_SRC = _NATIVE_DIR / "dagcbor_ext.c"
+_DAGCBOR_SO = BUILD_DIR / "ipc_dagcbor_ext.so"
+
+_lock = threading.Lock()
+_cached: "object | None | bool" = False  # False = not attempted yet
+
+
+def host_build_id() -> str:
+    """Identity of the CPU the cached .so was tuned for — a checkout (or
+    container image) moved to a different host must rebuild rather than
+    run a stale -march=native binary into SIGILL."""
+    import hashlib
+    import platform
+
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    if not model:
+        model = platform.processor() or "unknown"
+    return hashlib.sha256(f"{platform.machine()}|{model}".encode()).hexdigest()[:16]
+
+
+def build_cpython_ext(src: Path, so: Path, mod_name: str):
+    """Compile (mtime- AND host-stamp-cached) and import a raw-CPython-API
+    extension."""
+    import importlib.util
+    import sysconfig
+
+    BUILD_DIR.mkdir(exist_ok=True)
+    stamp = so.with_suffix(so.suffix + ".host")
+    host_id = host_build_id()
+    cached = (
+        so.exists()
+        and so.stat().st_mtime >= src.stat().st_mtime
+        and stamp.exists()
+        and stamp.read_text() == host_id
+    )
+    if not cached:
+        include = sysconfig.get_paths()["include"]
+        base = ["gcc", "-O3", "-shared", "-fPIC", "-pthread", f"-I{include}",
+                str(src), "-o", str(so)]
+        try:
+            # host-tuned codegen measurably helps the scan parse loop;
+            # retry portable if the toolchain rejects -march=native
+            subprocess.run(
+                base[:2] + ["-march=native"] + base[2:],
+                check=True, capture_output=True, timeout=120,
+            )
+        except subprocess.SubprocessError:
+            subprocess.run(base, check=True, capture_output=True, timeout=120)
+        stamp.write_text(host_id)
+    spec = importlib.util.spec_from_file_location(mod_name, so)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def load():
+    """Compile (if needed) and import the C DAG-CBOR/CID module, or None on
+    any failure. Honors ``IPC_PROOFS_NO_NATIVE``."""
+    global _cached
+    with _lock:
+        if _cached is not False:
+            return _cached
+        if os.environ.get("IPC_PROOFS_NO_NATIVE"):
+            _cached = None
+            return None
+        try:
+            _cached = build_cpython_ext(_DAGCBOR_SRC, _DAGCBOR_SO, "ipc_dagcbor_ext")
+        except Exception:
+            _cached = None
+        return _cached

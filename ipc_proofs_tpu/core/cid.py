@@ -205,7 +205,10 @@ class CID:
 
     @classmethod
     def parse(cls, value: "CID | str | bytes") -> "CID":
-        if isinstance(value, CID):
+        # CID_TYPES (module-bottom) covers BOTH implementations, so a
+        # native CID handed to PurePythonCID.parse passes through unchanged
+        # just like the native parse accepts a pure instance
+        if isinstance(value, CID_TYPES):
             return value
         if isinstance(value, bytes):
             return cls.from_bytes(value)
@@ -261,3 +264,47 @@ class CID:
             cached = hash(self.digest)
             object.__setattr__(self, "_hash", cached)  # frozen-safe memo
         return cached
+
+
+# --- native CID binding ----------------------------------------------------
+# The C extension ships a C-slot CID type (ipc_dagcbor_ext.CID) with this
+# exact interface: same constructor signature, classmethods, comparison /
+# hash semantics, and the same strict-canonical acceptance at the bytes and
+# string boundaries. The dataclass above stays the correctness reference
+# (exported as PurePythonCID; the full suite runs against it under
+# IPC_PROOFS_NO_NATIVE) — but per-instance it pays a __dict__ plus a dict
+# insert per field and per memo, which dominated bulk decode paths at
+# ~2.9 µs/header (NOTES_r04 "verify_replay stage floor"). When the
+# extension is importable, CID *is* the native type, so every constructor
+# in the tree (header links, witness materialization, claim parsing) gets
+# C-slot construction without call sites changing.
+
+PurePythonCID = CID
+
+__all__.append("PurePythonCID")
+
+
+def _bind_native_cid():
+    # via core._cid_native (stdlib-only), NOT the backend package: importing
+    # backend here would transitively import modules that capture the
+    # pure-Python CID before the rebind below lands
+    try:
+        import ipc_proofs_tpu.core._cid_native as _cid_native
+
+        ext = _cid_native.load()  # honors IPC_PROOFS_NO_NATIVE itself
+    except Exception:
+        return None
+    return getattr(ext, "CID", None) if ext is not None else None
+
+
+_native_cid = _bind_native_cid()
+if _native_cid is not None:
+    CID = _native_cid  # type: ignore[misc]
+
+# Every type that IS a CID in this process — both implementations coexist
+# in differential tests and fixture builders, and boundaries that accept
+# user-held CIDs (dagcbor.encode, parse) must recognize either.
+CID_TYPES: "tuple[type, ...]" = (
+    (CID, PurePythonCID) if CID is not PurePythonCID else (CID,)
+)
+__all__.append("CID_TYPES")

@@ -24,7 +24,7 @@ import struct
 from contextlib import contextmanager as _contextmanager
 from typing import Any
 
-from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.cid import CID, CID_TYPES
 
 __all__ = ["encode", "decode"]
 
@@ -61,7 +61,7 @@ def _encode_item(obj: Any, out: bytearray) -> None:
         out.append(0xF5)
     elif obj is False:
         out.append(0xF4)
-    elif isinstance(obj, CID):
+    elif isinstance(obj, CID_TYPES):  # either CID implementation
         out += _encode_head(_MAJOR_TAG, _CID_TAG)
         inner = b"\x00" + obj.to_bytes()
         out += _encode_head(_MAJOR_BYTES, len(inner))

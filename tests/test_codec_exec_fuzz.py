@@ -4,8 +4,12 @@ Same method as the verifier fuzzes (which found real divergences): drive
 the scalar/Python implementation and its batched/C twin through the same
 randomly mutated inputs and assert they accept and reject identically.
 
-- CID strings: `CID.from_string` vs the C `cids_from_strs` batch parser.
-- CID bytes: `CID.from_bytes` vs the C `make_cids` batch constructor.
+- CID strings: `PurePythonCID.from_string` vs the C `cids_from_strs`
+  batch parser AND the native CID type's `from_string` (since round 5,
+  `CID` *is* the C extension type when available — the pure-Python
+  dataclass stays the scalar authority so the differential is real).
+- CID bytes: `PurePythonCID.from_bytes` vs the C `make_cids` batch
+  constructor and native `CID.from_bytes`.
 - Execution orders: scalar `reconstruct_execution_order` per group vs the
   batched `reconstruct_execution_orders_batch` (whose contract maps a
   scalar raise to a per-group None) over corrupted witness stores.
@@ -16,7 +20,7 @@ import random
 import pytest
 
 from ipc_proofs_tpu.backend.native import load_dagcbor_ext
-from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.cid import CID, PurePythonCID
 from ipc_proofs_tpu.proofs.exec_order import (
     reconstruct_execution_order,
     reconstruct_execution_orders_batch,
@@ -68,14 +72,20 @@ def test_cid_string_codec_acceptance_parity(seed):
         if rng.random() < 0.3:
             s = _mutate_str(rng, s)
         try:
-            scalar = ("ok", CID.from_string(s))
+            scalar = ("ok", PurePythonCID.from_string(s))
         except ValueError:
             scalar = ("reject",)
+        try:
+            native = ("ok", CID.from_string(s))
+        except ValueError:
+            native = ("reject",)
         try:
             batch = ("ok", ext.cids_from_strs([s])[0])
         except ValueError:
             batch = ("reject",)
-        assert scalar == batch, f"CID string {s!r}: scalar={scalar} batch={batch}"
+        assert scalar == native == batch, (
+            f"CID string {s!r}: scalar={scalar} native={native} batch={batch}"
+        )
         if scalar[0] == "ok":
             # canonical-form invariant: an accepted string IS its CID's
             # unique string form — the parity assert alone is blind to
@@ -137,14 +147,20 @@ def test_cid_bytes_codec_acceptance_parity(seed):
                 raw.insert(rng.randrange(len(raw) + 1), rng.randrange(256))
         raw = bytes(raw)
         try:
-            scalar = ("ok", CID.from_bytes(raw))
+            scalar = ("ok", PurePythonCID.from_bytes(raw))
         except ValueError:
             scalar = ("reject",)
+        try:
+            native = ("ok", CID.from_bytes(raw))
+        except ValueError:
+            native = ("reject",)
         try:
             batch = ("ok", ext.make_cids([raw])[0])
         except ValueError:
             batch = ("reject",)
-        assert scalar == batch, f"CID bytes {raw.hex()}: scalar={scalar} batch={batch}"
+        assert scalar == native == batch, (
+            f"CID bytes {raw.hex()}: scalar={scalar} native={native} batch={batch}"
+        )
         if scalar[0] == "ok":
             accepted += 1
         else:

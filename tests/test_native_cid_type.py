@@ -1,0 +1,226 @@
+"""The native (C extension) CID type: interface parity with PurePythonCID.
+
+Since round 5, ``ipc_proofs_tpu.core.cid.CID`` binds to the C-slot type
+``ipc_dagcbor_ext.CID`` when the extension builds (the dataclass stays the
+correctness reference as ``PurePythonCID``; the full suite runs against it
+under ``IPC_PROOFS_NO_NATIVE=1``). This file pins the contract both
+implementations must share: constructors, classmethods, comparisons, hash,
+string/bytes codecs (strict-canonical, reference ``cid``/``multibase``
+crate semantics — SURVEY §2b), pickling, and immutability.
+"""
+
+import pickle
+import random
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import (
+    BLAKE2B_256,
+    CID,
+    DAG_CBOR,
+    IDENTITY,
+    PurePythonCID,
+    RAW,
+    SHA2_256,
+)
+
+native_active = CID is not PurePythonCID
+
+pytestmark = pytest.mark.skipif(
+    not native_active, reason="native CID type not bound (extension unavailable)"
+)
+
+
+class TestConstructionParity:
+    def test_binding_active(self):
+        assert CID.__name__ == "CID"
+        assert type(CID.hash_of(b"x")) is CID
+
+    def test_positional_and_keyword_construction(self):
+        a = CID(1, DAG_CBOR, BLAKE2B_256, b"\x01" * 32)
+        b = CID(version=1, codec=DAG_CBOR, mh_code=BLAKE2B_256, digest=b"\x01" * 32)
+        p = PurePythonCID(1, DAG_CBOR, BLAKE2B_256, b"\x01" * 32)
+        assert a == b == p
+        assert a.to_bytes() == p.to_bytes()
+        assert str(a) == str(p)
+
+    def test_make_alias(self):
+        m = CID._make(1, RAW, SHA2_256, b"\x02" * 32)
+        assert m == CID(1, RAW, SHA2_256, b"\x02" * 32)
+
+    def test_field_values(self):
+        c = CID.hash_of(b"hello")
+        assert (c.version, c.codec, c.mh_code) == (1, DAG_CBOR, BLAKE2B_256)
+        assert c.digest == PurePythonCID.hash_of(b"hello").digest
+
+    def test_negative_field_rejected(self):
+        with pytest.raises(ValueError):
+            CID(-1, DAG_CBOR, BLAKE2B_256, b"\x00" * 32)
+
+    def test_non_int_field_rejected(self):
+        with pytest.raises(TypeError):
+            CID("1", DAG_CBOR, BLAKE2B_256, b"\x00" * 32)
+
+    def test_hash_of_variants(self):
+        for codec, mh in [
+            (DAG_CBOR, BLAKE2B_256),
+            (RAW, BLAKE2B_256),
+            (DAG_CBOR, SHA2_256),
+            (RAW, IDENTITY),
+        ]:
+            n = CID.hash_of(b"payload", codec, mh)
+            p = PurePythonCID.hash_of(b"payload", codec, mh)
+            assert n.to_bytes() == p.to_bytes(), (codec, mh)
+
+    def test_hash_of_unsupported_mh_rejected(self):
+        with pytest.raises(ValueError, match="unsupported multihash code"):
+            CID.hash_of(b"x", mh_code=0x99)
+        with pytest.raises(ValueError, match="unsupported multihash code"):
+            PurePythonCID.hash_of(b"x", mh_code=0x99)
+
+    def test_parse_coercions(self):
+        c = CID.hash_of(b"p")
+        assert CID.parse(c) is c
+        assert CID.parse(c.to_bytes()) == c
+        assert CID.parse(str(c)) == c
+
+    def test_parse_accepts_either_implementation(self):
+        """Both parse() implementations pass a CID of EITHER type through
+        unchanged (code-review finding: the rebind used to make each
+        reject the other's instances)."""
+        n = CID.hash_of(b"cross")
+        p = PurePythonCID.hash_of(b"cross")
+        assert CID.parse(p) is p
+        assert PurePythonCID.parse(n) is n
+        assert PurePythonCID.parse(p) is p
+
+    def test_encode_accepts_either_implementation(self):
+        from ipc_proofs_tpu.core import dagcbor
+
+        n = CID.hash_of(b"enc")
+        p = PurePythonCID.hash_of(b"enc")
+        assert dagcbor.encode({"c": p}) == dagcbor.encode({"c": n})
+
+    def test_field_overflow_rejected(self):
+        """>128-bit fields must raise, never silently truncate (the 3.13
+        PyLong_AsNativeBytes return-size contract)."""
+        with pytest.raises((OverflowError, ValueError)):
+            CID(2**128 + 1, DAG_CBOR, BLAKE2B_256, b"\x00" * 32)
+
+
+class TestCodecParity:
+    def test_from_bytes_error_messages(self):
+        cases = [
+            (b"", "truncated uvarint"),
+            (b"\x00\x01", "unsupported CID version 0"),
+            (b"\x01\x71", "truncated uvarint"),
+            (b"\x01\x71\x12\x20\xaa", "truncated CID multihash digest"),
+            (CID.hash_of(b"x").to_bytes() + b"\x00", "trailing bytes after CID"),
+            (b"\x80" * 10 + b"\x01", "uvarint too long"),
+        ]
+        for raw, msg in cases:
+            with pytest.raises(ValueError, match=msg):
+                CID.from_bytes(raw)
+            with pytest.raises(ValueError, match=msg):
+                PurePythonCID.from_bytes(raw)
+
+    def test_nonminimal_varint_bytes_tolerated_reencodes_canonical(self):
+        c = CID.hash_of(b"payload")
+        noncanon = b"\x01\xf1\x00\xa0\xe4\x02\x20" + c.digest
+        x = CID.from_bytes(noncanon)
+        assert x == c
+        assert x.to_bytes() == c.to_bytes()  # memo never stores non-canonical
+
+    def test_big_identity_cid_roundtrip(self):
+        big = CID(1, DAG_CBOR, IDENTITY, bytes(range(256)) + b"x" * 100)
+        bigp = PurePythonCID(1, DAG_CBOR, IDENTITY, bytes(range(256)) + b"x" * 100)
+        assert str(big) == str(bigp)
+        assert CID.from_string(str(big)) == big
+        assert CID.from_bytes(big.to_bytes()) == big
+
+    def test_from_string_surfaces_detailed_byte_errors(self):
+        """from_string reports the specific from_bytes failure (version /
+        truncation / trailing), not the tolerant boundary's generic
+        message — message parity with PurePythonCID.from_string."""
+        from ipc_proofs_tpu.core.cid import _b32_encode_lower
+
+        c = CID.hash_of(b"payload")
+        v2 = b"\x02" + c.to_bytes()[1:]
+        s = "b" + _b32_encode_lower(v2)
+        with pytest.raises(ValueError, match="unsupported CID version 2"):
+            CID.from_string(s)
+        with pytest.raises(ValueError, match="unsupported CID version 2"):
+            PurePythonCID.from_string(s)
+
+    def test_string_rejections_match(self):
+        c = str(CID.hash_of(b"q"))
+        bad = ["", "z" + c[1:], "b", c[:-1], c[:-1] + "!", c.upper(), "b0" + c[2:]]
+        for s in bad:
+            with pytest.raises(ValueError):
+                CID.from_string(s)
+            with pytest.raises(ValueError):
+                PurePythonCID.from_string(s)
+
+    def test_memoization_returns_same_objects(self):
+        c = CID.hash_of(b"memo")
+        assert c.to_bytes() is c.to_bytes()
+        assert str(c) == str(c)
+        assert hash(c) == hash(c)
+
+
+class TestSemanticsParity:
+    def test_mixed_equality_and_hash(self):
+        n = CID.hash_of(b"same")
+        p = PurePythonCID.hash_of(b"same")
+        assert n == p and p == n
+        assert not (n != p) and not (p != n)
+        assert hash(n) == hash(p)
+        assert n in {p} and p in {n}
+        assert {n: 1}[p] == 1
+
+    def test_inequality_against_non_cid(self):
+        c = CID.hash_of(b"x")
+        assert c != 42
+        assert c != "bafy"
+        assert c != b"\x01"
+        assert not (c == object())
+
+    def test_ordering_matches_pure(self):
+        rng = random.Random(7)
+        data = [bytes(rng.randrange(256) for _ in range(8)) for _ in range(64)]
+        ns = sorted(CID.hash_of(d) for d in data)
+        ps = sorted(PurePythonCID.hash_of(d) for d in data)
+        assert [str(a) for a in ns] == [str(a) for a in ps]
+        for a, b in zip(ns, ns[1:]):
+            assert a < b or a == b
+            assert a <= b and b >= a
+
+    def test_repr(self):
+        c = CID.hash_of(b"r")
+        assert repr(c) == f"CID({c})"
+        assert repr(c) == repr(PurePythonCID.hash_of(b"r"))
+
+    def test_pickle_roundtrip(self):
+        c = CID.hash_of(b"pickle")
+        out = pickle.loads(pickle.dumps(c))
+        assert out == c and str(out) == str(c)
+
+    def test_immutable(self):
+        c = CID.hash_of(b"frozen")
+        with pytest.raises((AttributeError, TypeError)):
+            c.digest = b"\x00"
+        with pytest.raises((AttributeError, TypeError)):
+            c.version = 2
+
+    def test_decoder_link_type(self):
+        """Tag-42 links built by the C decoder ARE the module CID type."""
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+        from ipc_proofs_tpu.core import dagcbor
+
+        ext = load_dagcbor_ext()
+        assert ext is not None
+        c = CID.hash_of(b"link")
+        enc = dagcbor.encode({"l": c, "xs": [c]})
+        for decoded in (ext.decode(enc), dagcbor.decode(enc)):
+            assert type(decoded["l"]) is CID
+            assert decoded["l"] == c and decoded["xs"] == [c]
