@@ -1,0 +1,149 @@
+"""Contract-parity tests: `contracts/TopdownMessenger.sol` vs the Python model.
+
+The Foundry toolchain is absent in this environment (NOTES_r05.md), so the
+forge test (`contracts/test/TopdownMessenger.t.sol`) cannot run here. These
+tests assert the SAME three proof-relevant invariants offline:
+
+1. slot-0 mapping layout — the nonce for a subnet lives at
+   ``keccak256(abi.encode(subnetId, uint256(0)))``;
+2. pre-increment emission — after ``trigger``, the stored nonce equals the
+   last emitted event's nonce;
+3. topic shape — topic0 is ``keccak256("NewTopDownMessage(bytes32,uint256)")``
+   and topic1 the raw indexed bytes32 subnet id;
+
+and additionally run BOTH proof engines over a fixture world built from the
+modeled post-`trigger` state, checking that a storage proof and an event
+proof over the same checkpoint agree — the parity the reference's Foundry
+project (zero tests) never established. Reference:
+``topdown-messenger/src/TopdownMessenger.sol:1-33``.
+"""
+
+import re
+from pathlib import Path
+
+from ipc_proofs_tpu.core.hashes import keccak256
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
+from ipc_proofs_tpu.proofs.generator import (
+    EventProofSpec,
+    StorageProofSpec,
+    generate_proof_bundle,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+from ipc_proofs_tpu.state.storage import calculate_storage_slot, compute_mapping_slot
+
+_SOL = Path(__file__).resolve().parent.parent / "contracts" / "TopdownMessenger.sol"
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "subnet-a"
+ACTOR = 7001
+
+
+def _model_trigger(storage: dict, subnet32: bytes, count: int) -> list[int]:
+    """The Solidity `trigger` body, modeled: returns emitted nonces."""
+    slot = compute_mapping_slot(subnet32, 0)
+    nonce = int.from_bytes(storage.get(slot, b""), "big")
+    emitted = []
+    for _ in range(count):
+        nonce += 1  # pre-increment: bump BEFORE emit
+        emitted.append(nonce)
+    storage[slot] = nonce.to_bytes(32, "big")
+    return emitted
+
+
+class TestSourceInvariants:
+    """Light static checks that the .sol source declares the shapes the
+    model assumes — if the contract is edited incompatibly, these fail
+    before any chain deploy would."""
+
+    def test_subnets_is_first_state_variable(self):
+        src = _SOL.read_text()
+        body = src.split("contract TopdownMessenger", 1)[1]
+        decls = re.findall(
+            r"^\s*(mapping\([^)]*\)|uint\d*|bytes\d*|address|bool)\s+"
+            r"(?:public\s+|private\s+|internal\s+)?(\w+)\s*;",
+            body,
+            re.M,
+        )
+        assert decls, "no state variable declarations found"
+        kind, name = decls[0]
+        assert name == "subnets" and kind.startswith("mapping(bytes32")
+
+    def test_event_signature_and_emission_order(self):
+        src = _SOL.read_text()
+        assert "event NewTopDownMessage(bytes32 indexed subnetId, uint256 nonce)" in src
+        body = src.split("function trigger", 1)[1].split("}", 2)[-2]
+        # the nonce += 1 must textually precede the emit inside the loop
+        bump = src.index("nonce += 1")
+        emit = src.index("emit NewTopDownMessage")
+        assert bump < emit
+
+    def test_topic0_is_signature_keccak(self):
+        assert hash_event_signature(SIG) == keccak256(SIG.encode())
+
+
+class TestSlotLayout:
+    def test_mapping_slot_is_solidity_abi_encoding(self):
+        """compute_mapping_slot == keccak256(abi.encode(key, uint256(0)))
+        — computed here from first principles (32-byte key ++ 32-byte
+        zero-padded slot index), the layout `vm.load` would read."""
+        key32 = ascii_to_bytes32(SUBNET)
+        abi_encoded = key32 + (0).to_bytes(32, "big")
+        assert compute_mapping_slot(key32, 0) == keccak256(abi_encoded)
+        assert calculate_storage_slot(SUBNET, 0) == keccak256(abi_encoded)
+
+
+class TestTriggerParity:
+    def test_model_pre_increment(self):
+        storage: dict = {}
+        sub32 = ascii_to_bytes32(SUBNET)
+        assert _model_trigger(storage, sub32, 3) == [1, 2, 3]
+        assert _model_trigger(storage, sub32, 2) == [4, 5]
+        slot = compute_mapping_slot(sub32, 0)
+        assert int.from_bytes(storage[slot], "big") == 5  # storage == last nonce
+
+    def test_storage_and_event_proofs_agree_after_trigger(self):
+        """The forge test's invariant, proven through the PROOF ENGINES:
+        build the post-trigger chain state, generate a storage proof of the
+        nonce slot and event proofs of the emissions, verify both, and
+        check the storage value equals the last event's nonce."""
+        storage: dict = {}
+        sub32 = ascii_to_bytes32(SUBNET)
+        emitted = _model_trigger(storage, sub32, 3)
+        events = [
+            [
+                EventFixture(
+                    emitter=ACTOR,
+                    signature=SIG,
+                    topic1=SUBNET,
+                    data=n.to_bytes(32, "big"),
+                )
+                for n in emitted
+            ]
+        ]
+        world = build_chain(
+            [ContractFixture(actor_id=ACTOR, storage=dict(storage))], events
+        )
+        slot = compute_mapping_slot(sub32, 0)
+        bundle = generate_proof_bundle(
+            world.store,
+            world.parent,
+            world.child,
+            [StorageProofSpec(actor_id=ACTOR, slot=slot)],
+            [EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)],
+        )
+        assert len(bundle.event_proofs) == len(emitted)
+        result = verify_proof_bundle(
+            bundle,
+            TrustPolicy.accept_all(),
+            event_filter=create_event_filter(SIG, SUBNET),
+        )
+        assert result.all_valid()
+        stored_nonce = int(bundle.storage_proofs[0].value, 16)
+        last_event_nonce = int.from_bytes(
+            bytes.fromhex(bundle.event_proofs[-1].event_data.data.removeprefix("0x")),
+            "big",
+        )
+        assert stored_nonce == last_event_nonce == emitted[-1]
