@@ -30,14 +30,18 @@ implementation (`ipc_proofs_tpu.crypto.bls`):
   compares the post-delta table's CID against each cert's
   ``supplemental_data.power_table``.
 
-Interop caveats (documented divergences pending real-chain vectors, which a
-zero-egress environment cannot fetch): the signing payload is a canonical
-dag-cbor encoding of the same fields go-f3's ``MarshalPayloadForSigning``
-covers (not byte-identical to go-f3's marshaling), hash-to-G2 uses
-deterministic try-and-increment rather than RFC 9380 SSWU (see
-`crypto/bls.py`), and ``signers`` bitmaps are plain LSB-first bitmaps, not
-Filecoin RLE+. Each is a swap-in point; the trust semantics — forged,
-under-quorum, or wrong-table certificates are rejected — hold regardless.
+Round 5 closes the three wire-interop gaps that round 4 documented as
+caveats: the signing payload is go-f3's ``Payload.MarshalForSigning``
+binary layout (`proofs/gpbft.py` — DECIDE phase over the EC chain key),
+hash-to-G2 is RFC 9380 SSWU with the standard BLS POP ciphersuite DSTs
+(`crypto/bls.py`), and ``signers`` bytes are a strict Filecoin RLE+
+bitfield (`crypto/rleplus.py`), exactly go-bitfield's serialization. The
+residual risk, recorded in each module's docstring: byte-level fixtures
+from a live go-f3 node are unfetchable offline (NOTES_r05.md), so field
+order in the payload layout rests on the public go-f3 source as
+reconstructed, with every field isolated to one line for a one-line fix
+should a vector ever disagree. The trust semantics — forged, under-quorum,
+or wrong-table certificates are rejected — are pinned by tests either way.
 """
 
 from __future__ import annotations
@@ -226,8 +230,9 @@ class FinalityCertificate:
     instance: int
     ec_chain: list[ECTipSet] = field(default_factory=list)
     supplemental_data: SupplementalData = field(default_factory=SupplementalData)
-    # signers: LSB-first bitmap bytes over power-table rows (sorted by
-    # participant id), or an explicit list of row indices
+    # signers: Filecoin RLE+ bitfield bytes over power-table rows (sorted
+    # by participant id) — go-bitfield's wire format, what go-f3
+    # certificates carry — or an explicit list of row indices
     signers: "bytes | list[int]" = b""
     signature: bytes = b""
     power_table_delta: list[PowerTableDelta] = field(default_factory=list)
@@ -266,9 +271,14 @@ class FinalityCertificate:
             ],
         )
 
-    def signer_indices(self) -> list[int]:
+    def signer_indices(self, max_index: Optional[int] = None) -> list[int]:
         """Power-table row indices of the signers: the explicit list form,
-        or set bits of the LSB-first bitmap. Sorted, duplicates rejected."""
+        or the set bits of the RLE+ bitfield (strict go-bitfield decode —
+        `crypto/rleplus.py`). Sorted, duplicates rejected.
+
+        ``max_index`` bounds the decoded bitfield width (callers that know
+        the table size pass it, so a crafted few-byte certificate cannot
+        force materializing millions of indices before the range check)."""
         if isinstance(self.signers, list):
             idxs = list(self.signers)
             if len(set(idxs)) != len(idxs):
@@ -276,34 +286,33 @@ class FinalityCertificate:
             if any(i < 0 for i in idxs):
                 raise ValueError("negative signer index")
             return sorted(idxs)
-        idxs = []
-        for byte_pos, byte in enumerate(self.signers):
-            for bit in range(8):
-                if byte >> bit & 1:
-                    idxs.append(byte_pos * 8 + bit)
-        return idxs
+        raw = bytes(self.signers)
+        if not raw:
+            return []  # unset optional field (wire empty bitfield is b"\x00")
+        from ipc_proofs_tpu.crypto import rleplus
 
-    def signing_payload(self) -> bytes:
-        """Canonical decide-payload bytes the aggregate signature covers:
-        dag-cbor over (instance, supplemental data, EC chain) — the same
-        field set go-f3's ``MarshalPayloadForSigning`` commits to (byte
-        parity pending vectors; module docstring)."""
-        from ipc_proofs_tpu.core.dagcbor import encode as cbor_encode
+        max_bits = rleplus.MAX_BITS_DEFAULT if max_index is None else max_index
+        return rleplus.decode_rleplus(raw, max_bits=max_bits)
 
-        return cbor_encode(
-            [
-                "F3-DECIDE",
-                self.instance,
-                self.supplemental_data.commitments,
-                self.supplemental_data.power_table,
-                [
-                    [list(ts.key), ts.epoch, ts.power_table, ts.commitments]
-                    for ts in self.ec_chain
-                ],
-            ]
+    def signing_payload(self, network: str | None = None) -> bytes:
+        """The byte string the aggregate signature covers: go-f3's
+        ``Payload.MarshalForSigning`` for this instance's DECIDE over the
+        certificate's EC chain (`proofs/gpbft.py` documents the layout and
+        its derivation)."""
+        from ipc_proofs_tpu.proofs import gpbft
+
+        kwargs = {} if network is None else {"network": network}
+        return gpbft.payload_marshal_for_signing(
+            self.instance,
+            self.ec_chain,
+            self.supplemental_data.commitments,
+            self.supplemental_data.power_table,
+            **kwargs,
         )
 
-    def verify_signature(self, table: "Sequence[PowerTableEntry]") -> None:
+    def verify_signature(
+        self, table: "Sequence[PowerTableEntry]", network: Optional[str] = None
+    ) -> None:
         """Verify the aggregate BLS signature and the >2/3 power quorum
         against ``table`` (the committee for this instance — the power
         table BEFORE this certificate's delta is applied).
@@ -324,7 +333,7 @@ class FinalityCertificate:
         rows = sorted(table, key=lambda e: e.participant_id)
         if not rows:
             raise ValueError("empty power table")
-        idxs = self.signer_indices()
+        idxs = self.signer_indices(max_index=len(rows))
         if not idxs:
             raise ValueError(f"certificate {self.instance} has no signers")
         if idxs[-1] >= len(rows):
@@ -356,7 +365,8 @@ class FinalityCertificate:
             )
         for entry, pk in zip(signer_rows, pks):
             _check_pop(self.instance, entry, pk)
-        if not bls.verify_aggregate_same_message(pks, self.signing_payload(), sig):
+        payload = self.signing_payload(network=network) if network else self.signing_payload()
+        if not bls.verify_aggregate_same_message(pks, payload, sig):
             raise ValueError(
                 f"certificate {self.instance} aggregate BLS signature is invalid"
             )
@@ -502,6 +512,7 @@ class FinalityCertificateChain:
         initial_power_table: Optional[Sequence[PowerTableEntry]] = None,
         verify_signatures: bool = False,
         verify_table_cids: bool = False,
+        network: Optional[str] = None,
     ) -> Optional[list[PowerTableEntry]]:
         """Validate the chain; returns the final power table (None when no
         initial table was given).
@@ -550,7 +561,7 @@ class FinalityCertificateChain:
                         f"(epoch {prev_head.epoch}) — forked or gapped chain"
                     )
             if verify_signatures:
-                cert.verify_signature(table)
+                cert.verify_signature(table, network=network)
                 if not cert.supplemental_data.power_table:
                     raise ValueError(
                         f"certificate {cert.instance} carries no power-table "

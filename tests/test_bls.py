@@ -39,14 +39,22 @@ def _table():
     ]
 
 
+
+def _cid(tag: str) -> str:
+    """A real CID string for a test label (the go-f3 payload layout
+    marshals raw CID bytes, so keys must parse as CIDs)."""
+    from ipc_proofs_tpu.core.cid import CID
+
+    return str(CID.hash_of(tag.encode()))
+
 def _cert(signer_ids, instance=0, tamper_sig=False, signers_as_bitmap=False):
     cert = FinalityCertificate(
         instance=instance,
         ec_chain=[
-            ECTipSet(key=["bafy-parent"], epoch=100, power_table="pt-cid"),
-            ECTipSet(key=["bafy-head"], epoch=101, power_table="pt-cid"),
+            ECTipSet(key=[_cid("bafy-parent")], epoch=100, power_table=_cid("pt-cid")),
+            ECTipSet(key=[_cid("bafy-head")], epoch=101, power_table=_cid("pt-cid")),
         ],
-        supplemental_data=SupplementalData(power_table="bafy-next-table"),
+        supplemental_data=SupplementalData(power_table=_cid("bafy-next-table")),
     )
     payload = cert.signing_payload()
     sig = bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in signer_ids])
@@ -54,10 +62,9 @@ def _cert(signer_ids, instance=0, tamper_sig=False, signers_as_bitmap=False):
         sig = bls.aggregate_signatures([sig, bls.g2_generator()])
     cert.signature = bls.g2_compress(sig)
     if signers_as_bitmap:
-        bitmap = bytearray(1)
-        for i in signer_ids:
-            bitmap[0] |= 1 << i
-        cert.signers = bytes(bitmap)
+        from ipc_proofs_tpu.crypto.rleplus import encode_rleplus
+
+        cert.signers = encode_rleplus(sorted(signer_ids))
     else:
         cert.signers = list(signer_ids)
     return cert
@@ -166,7 +173,7 @@ class TestCertificateSignature:
         )
         cert = FinalityCertificate(
             instance=0,
-            ec_chain=[ECTipSet(key=["bafy-a"], epoch=100, power_table="pt")],
+            ec_chain=[ECTipSet(key=[_cid("bafy-a")], epoch=100, power_table=_cid("pt"))],
         )
         cert.signers = [0, 1, 2, 3]
         cert.signature = bls.g2_compress(
@@ -256,8 +263,8 @@ class TestChainWithSignaturesAndTableCids:
         cert1 = FinalityCertificate(
             instance=1,
             ec_chain=[
-                ECTipSet(key=["bafy-head"], epoch=101, power_table="pt-cid"),
-                ECTipSet(key=["bafy-next"], epoch=102, power_table="pt-cid"),
+                ECTipSet(key=[_cid("bafy-head")], epoch=101, power_table=_cid("pt-cid")),
+                ECTipSet(key=[_cid("bafy-next")], epoch=102, power_table=_cid("pt-cid")),
             ],
             supplemental_data=SupplementalData(power_table=str(power_table_cid(table1))),
             power_table_delta=delta,
@@ -286,8 +293,8 @@ class TestChainWithSignaturesAndTableCids:
         cert0 = FinalityCertificate(
             instance=0,
             ec_chain=[
-                ECTipSet(key=["bafy-a"], epoch=100, power_table="pt"),
-                ECTipSet(key=["bafy-b"], epoch=101, power_table="pt"),
+                ECTipSet(key=[_cid("bafy-a")], epoch=100, power_table=_cid("pt")),
+                ECTipSet(key=[_cid("bafy-b")], epoch=101, power_table=_cid("pt")),
             ],
             supplemental_data=SupplementalData(power_table=str(power_table_cid(table1))),
             power_table_delta=[
@@ -306,8 +313,8 @@ class TestChainWithSignaturesAndTableCids:
         cert1 = FinalityCertificate(
             instance=1,
             ec_chain=[
-                ECTipSet(key=["bafy-b"], epoch=101, power_table="pt"),
-                ECTipSet(key=["bafy-c"], epoch=102, power_table="pt"),
+                ECTipSet(key=[_cid("bafy-b")], epoch=101, power_table=_cid("pt")),
+                ECTipSet(key=[_cid("bafy-c")], epoch=102, power_table=_cid("pt")),
             ],
             supplemental_data=SupplementalData(power_table=str(power_table_cid(table1))),
         )
@@ -327,7 +334,7 @@ class TestChainWithSignaturesAndTableCids:
     def test_wrong_table_commitment_rejected(self):
         table0 = _table()
         cert0 = _cert([0, 1, 2], instance=0)
-        cert0.supplemental_data = SupplementalData(power_table="bafy-wrong")
+        cert0.supplemental_data = SupplementalData(power_table=_cid("bafy-wrong"))
         payload = cert0.signing_payload()
         cert0.signature = bls.g2_compress(
             bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in (0, 1, 2)])

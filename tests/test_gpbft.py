@@ -1,0 +1,171 @@
+"""go-f3 gpbft signing payload: golden layout bytes + certificate wiring.
+
+The golden test constructs the expected `Payload.MarshalForSigning` byte
+string independently (field by field, straight from the documented layout)
+and pins `proofs/gpbft.py` against it, so any accidental reordering or
+width change breaks loudly. NOTES_r05.md records why live go-f3 fixtures
+are unavailable; the layout's derivation is documented in the module.
+"""
+
+import struct
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.proofs import gpbft
+from ipc_proofs_tpu.proofs.cert import ECTipSet, FinalityCertificate, SupplementalData
+
+
+def _cid(tag: str) -> CID:
+    return CID.hash_of(tag.encode())
+
+
+class TestLayout:
+    def test_golden_payload_bytes(self):
+        pt0, pt1, ptn = _cid("pt-0"), _cid("pt-1"), _cid("pt-next")
+        blk_a, blk_b, blk_c = _cid("blk-a"), _cid("blk-b"), _cid("blk-c")
+        chain = [
+            ECTipSet(key=[str(blk_a), str(blk_b)], epoch=100, power_table=str(pt0)),
+            ECTipSet(key=[str(blk_c)], epoch=101, power_table=str(pt1),
+                     commitments=b"\x11" * 32),
+        ]
+        got = gpbft.payload_marshal_for_signing(
+            instance=7,
+            ec_chain=chain,
+            supplemental_commitments=b"\x22" * 32,
+            supplemental_power_table=str(ptn),
+            network="filecoin",
+        )
+
+        key0 = blk_a.to_bytes() + blk_b.to_bytes()
+        key1 = blk_c.to_bytes()
+        expected = (
+            b"GPBFT:filecoin:"
+            + struct.pack(">Q", 7)      # instance
+            + struct.pack(">Q", 0)      # round (DECIDE)
+            + struct.pack(">B", 5)      # phase = DECIDE
+            + b"\x22" * 32              # supplemental commitments
+            # ECChain.Key():
+            + struct.pack(">q", 100) + bytes(32)
+            + struct.pack(">I", len(key0)) + key0 + pt0.to_bytes()
+            + struct.pack(">q", 101) + b"\x11" * 32
+            + struct.pack(">I", len(key1)) + key1 + pt1.to_bytes()
+            + ptn.to_bytes()            # supplemental power table CID
+        )
+        assert got == expected
+
+    def test_field_sensitivity(self):
+        """Every field perturbs the payload (nothing silently ignored)."""
+        chain = [ECTipSet(key=[str(_cid("b"))], epoch=5, power_table=str(_cid("p")))]
+        base = dict(
+            instance=1,
+            ec_chain=chain,
+            supplemental_commitments=b"",
+            supplemental_power_table=str(_cid("n")),
+        )
+        ref = gpbft.payload_marshal_for_signing(**base)
+        assert gpbft.payload_marshal_for_signing(**{**base, "instance": 2}) != ref
+        assert gpbft.payload_marshal_for_signing(**{**base, "round_": 1}) != ref
+        assert gpbft.payload_marshal_for_signing(**{**base, "phase": 4}) != ref
+        assert gpbft.payload_marshal_for_signing(**{**base, "network": "calibnet"}) != ref
+        assert (
+            gpbft.payload_marshal_for_signing(
+                **{**base, "supplemental_commitments": b"\x01" + bytes(31)}
+            )
+            != ref
+        )
+        other_chain = [
+            ECTipSet(key=[str(_cid("b"))], epoch=6, power_table=str(_cid("p")))
+        ]
+        assert gpbft.payload_marshal_for_signing(**{**base, "ec_chain": other_chain}) != ref
+
+    def test_negative_epoch_and_bad_commitments(self):
+        chain = [ECTipSet(key=[str(_cid("b"))], epoch=-1, power_table=str(_cid("p")))]
+        out = gpbft.payload_marshal_for_signing(
+            instance=0, ec_chain=chain, supplemental_commitments=b"",
+            supplemental_power_table="",
+        )
+        assert struct.pack(">q", -1) in out  # int64, not uint64
+        bad = [ECTipSet(key=[str(_cid("b"))], epoch=0, power_table=str(_cid("p")),
+                        commitments=b"\x01\x02")]
+        with pytest.raises(ValueError, match="32 bytes"):
+            gpbft.payload_marshal_for_signing(
+                instance=0, ec_chain=bad, supplemental_commitments=b"",
+                supplemental_power_table="",
+            )
+
+
+class TestCertificateWiring:
+    def test_signing_payload_uses_gpbft_layout(self):
+        chain = [ECTipSet(key=[str(_cid("b"))], epoch=9, power_table=str(_cid("p")))]
+        cert = FinalityCertificate(
+            instance=3,
+            ec_chain=chain,
+            supplemental_data=SupplementalData(power_table=str(_cid("n"))),
+        )
+        assert cert.signing_payload() == gpbft.payload_marshal_for_signing(
+            instance=3,
+            ec_chain=chain,
+            supplemental_commitments=b"",
+            supplemental_power_table=str(_cid("n")),
+        )
+        # network override flows through
+        assert cert.signing_payload(network="calibnet") != cert.signing_payload()
+
+    def test_rleplus_signers_roundtrip(self):
+        from ipc_proofs_tpu.crypto.rleplus import encode_rleplus
+
+        cert = FinalityCertificate(instance=0, signers=encode_rleplus([0, 2, 5]))
+        assert cert.signer_indices() == [0, 2, 5]
+
+    def test_malformed_rleplus_signers_rejected(self):
+        cert = FinalityCertificate(instance=0, signers=bytes([0x01]))
+        with pytest.raises(ValueError):
+            cert.signer_indices()
+
+    def test_empty_signers_conventions(self):
+        # b"" = unset dataclass default → no signers; b"\x00" = wire-level
+        # empty bitfield (go-bitfield's encoder output for zero runs)
+        assert FinalityCertificate(instance=0, signers=b"").signer_indices() == []
+        assert FinalityCertificate(instance=0, signers=b"\x00").signer_indices() == []
+
+    def test_wide_bitfield_bounded_by_table_size(self):
+        """A few-byte certificate encoding a 2^24-bit run must be rejected
+        by the width bound, not materialized (memory-amplification DoS)."""
+        from ipc_proofs_tpu.crypto.rleplus import encode_rleplus
+
+        wide = encode_rleplus([1 << 22])  # ~4M-bit bitfield, 6 bytes
+        cert = FinalityCertificate(instance=0, signers=wide)
+        with pytest.raises(ValueError, match="exceeds"):
+            cert.signer_indices(max_index=16)
+
+    def test_network_threads_through_verification(self):
+        """verify_signature(network=...) verifies a certificate signed for
+        a non-default network name (code-review finding: the parameter
+        did not thread through, so only 'filecoin' ever verified)."""
+        import base64
+
+        from ipc_proofs_tpu.crypto import bls
+        from ipc_proofs_tpu.proofs.cert import PowerTableEntry
+
+        sk = 424242
+        pk = bls.sk_to_pk(sk)
+        table = [
+            PowerTableEntry(
+                participant_id=0,
+                power=10,
+                signing_key=base64.b64encode(bls.g1_compress(pk)).decode(),
+                pop=base64.b64encode(bls.g2_compress(bls.pop_prove(sk))).decode(),
+            )
+        ]
+        cert = FinalityCertificate(
+            instance=1,
+            ec_chain=[ECTipSet(key=[str(_cid("b"))], epoch=1, power_table=str(_cid("p")))],
+            supplemental_data=SupplementalData(power_table=str(_cid("n"))),
+            signers=[0],
+        )
+        sig = bls.sign(sk, cert.signing_payload(network="calibnet"))
+        cert.signature = bls.g2_compress(sig)
+        cert.verify_signature(table, network="calibnet")  # verifies
+        with pytest.raises(ValueError, match="signature is invalid"):
+            cert.verify_signature(table)  # default network: payload differs

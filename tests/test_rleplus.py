@@ -1,0 +1,93 @@
+"""Filecoin RLE+ bitfields: vectors, strict canonicality, roundtrip fuzz.
+
+The signers field of a go-f3 certificate is an RLE+ bitfield
+(go-bitfield's wire format); `crypto/rleplus.py` implements it with the
+spec's minimality rules. The decisive property, pinned by fuzz here: every
+byte string either rejects or decodes to a value whose re-encoding is the
+input — one serialization per bitfield, no malleability.
+"""
+
+import random
+
+import pytest
+
+from ipc_proofs_tpu.crypto.rleplus import decode_rleplus, encode_rleplus
+
+
+class TestVectors:
+    def test_empty(self):
+        # go-bitfield's encoder emits the bare version header for an empty
+        # bitfield; its decoder rejects zero-length input
+        assert encode_rleplus([]) == bytes([0x00])
+        assert decode_rleplus(bytes([0x00])) == []
+        with pytest.raises(ValueError):
+            decode_rleplus(b"")
+
+    def test_bit_zero(self):
+        # bits (LSB-first): 00 version, 1 first-run-value, 1 single-run
+        assert encode_rleplus([0]) == bytes([0x0C])
+        assert decode_rleplus(bytes([0x0C])) == [0]
+
+    def test_bit_one(self):
+        # 00 version, 0 first=zeros, 1 single zero-run, 1 single one-run
+        assert encode_rleplus([1]) == bytes([0x18])
+        assert decode_rleplus(bytes([0x18])) == [1]
+
+    def test_short_and_long_blocks(self):
+        idxs = list(range(2, 18))  # 0-run of 2 (short), 1-run of 16 (long)
+        assert decode_rleplus(encode_rleplus(idxs)) == idxs
+
+    def test_sparse_large(self):
+        idxs = [0, 1000, 100000]
+        assert decode_rleplus(encode_rleplus(idxs)) == idxs
+
+
+class TestStrictness:
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            bytes([0x01]),        # version bit 1
+            bytes([0x02]),        # version bit 2
+            bytes([0x04]),        # first=1 but no runs: non-minimal empty
+            bytes([0x00, 0x00]),  # empty bitfield padded past one byte
+        ],
+    )
+    def test_invalid_headers_rejected(self, bad):
+        with pytest.raises(ValueError):
+            decode_rleplus(bad)
+
+    def test_max_bits_cap(self):
+        huge = encode_rleplus([10**6])
+        with pytest.raises(ValueError, match="exceeds"):
+            decode_rleplus(huge, max_bits=1000)
+
+    def test_unsorted_and_duplicate_indices_rejected(self):
+        with pytest.raises(ValueError):
+            encode_rleplus([3, 2])
+        with pytest.raises(ValueError):
+            encode_rleplus([2, 2])
+        with pytest.raises(ValueError):
+            encode_rleplus([-1])
+
+
+class TestCanonicality:
+    def test_roundtrip_fuzz(self):
+        rng = random.Random(7)
+        for _ in range(2000):
+            idxs = sorted(rng.sample(range(300), rng.randrange(0, 50)))
+            assert decode_rleplus(encode_rleplus(idxs)) == idxs
+
+    def test_every_accepted_string_is_canonical(self):
+        """Random blobs: accepted ⇒ re-encode equals input exactly."""
+        rng = random.Random(8)
+        accepted = rejected = 0
+        for _ in range(20000):
+            blob = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 10)))
+            try:
+                idxs = decode_rleplus(blob, max_bits=1 << 20)
+            except ValueError:
+                rejected += 1
+                continue
+            accepted += 1
+            assert encode_rleplus(idxs) == blob, blob.hex()
+        assert accepted and rejected
