@@ -1,0 +1,125 @@
+"""Worker process for the two-process jax.distributed smoke test.
+
+Run as: python tests/_multihost_worker.py <process_id> <num_processes>
+<coordinator_port> <output_json_path>
+
+Each process owns 2 virtual CPU devices (so the global mesh is dp=2 over
+DCN-like process boundaries × sp=2 intra-process), initializes
+jax.distributed against the localhost coordinator, takes its contiguous
+half of the tipset range via host_local_pairs, assembles the GLOBAL
+sharded arrays from process-local data, runs the sharded match pipeline
+over the (2,2) mesh, and writes its view of the results (the replicated
+proof count, the allgathered receipt-hit matrix, and its mesh facts) as
+JSON for the parent test to compare against the single-process reference.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    proc_id, nprocs, port, out_path = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    )
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+    os.environ["JAX_PROCESS_ID"] = str(proc_id)
+
+    import jax
+
+    # The env var alone is NOT enough on hosts with a device plugin: the
+    # plugin registers at interpreter startup and distributed.initialize
+    # would touch it (hanging forever against a dead tunnel) — the config
+    # update forces CPU before any backend discovery (verify-skill gotcha).
+    jax.config.update("jax_platforms", "cpu")
+
+    from ipc_proofs_tpu.parallel.multihost import (
+        global_mesh,
+        host_local_pairs,
+        initialize_distributed,
+    )
+
+    assert initialize_distributed() is True, "distributed init returned False"
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == nprocs
+    assert jax.local_device_count() == 2
+    assert jax.device_count() == 2 * nprocs
+
+    mesh = global_mesh(sp=2)
+    assert mesh.shape == {"dp": nprocs, "sp": 2}
+
+    # the same synthetic world on every process (seeded)
+    from ipc_proofs_tpu.parallel.pipeline import (
+        match_pipeline,
+        sharded_match_pipeline,
+        synthetic_event_batch,
+    )
+
+    T, R, E = 8, 4, 4
+    topic0, topic1 = b"\x11" * 32, b"\x22" * 32
+    batch = synthetic_event_batch(T, R, E, topic0, topic1, match_rate=0.3, seed=7)
+
+    # contiguous epoch shard for THIS host (the multi-host partitioning
+    # under test), then global arrays assembled from process-local slices
+    pairs = list(range(T))
+    mine = host_local_pairs(pairs)
+    assert mine, "process received an empty shard"
+    sl = slice(mine[0], mine[-1] + 1)
+
+    def globalize(local, spec):
+        sharding = NamedSharding(mesh, spec)
+        global_shape = (T,) + local.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, local, global_shape)
+
+    g_topics = globalize(batch.topics[sl], P("dp", None, "sp", None, None))
+    g_ntopics = globalize(batch.n_topics[sl], P("dp", None, "sp"))
+    g_emitters = globalize(batch.emitters[sl], P("dp", None, "sp"))
+    g_valid = globalize(batch.valid[sl], P("dp", None, "sp"))
+
+    from ipc_proofs_tpu.parallel.pipeline import make_specs_u32
+
+    spec0, spec1 = make_specs_u32(topic0, topic1)
+    repl = NamedSharding(mesh, P())
+    r_spec0 = multihost_utils.host_local_array_to_global_array(spec0, mesh, P())
+    r_spec1 = multihost_utils.host_local_array_to_global_array(spec1, mesh, P())
+    r_actor = multihost_utils.host_local_array_to_global_array(
+        np.int32(-1), mesh, P()
+    )
+    del repl
+
+    jitted, _shard = sharded_match_pipeline(mesh)
+    hits, mask, count = jitted(
+        g_topics, g_ntopics, g_emitters, g_valid, r_spec0, r_spec1, r_actor
+    )
+
+    # the replicated count is addressable everywhere; gather the sharded
+    # hits so every process holds the full matrix
+    full_hits = multihost_utils.process_allgather(hits, tiled=True)
+    result = {
+        "process_id": proc_id,
+        "count": int(np.asarray(count)),
+        "hits": np.asarray(full_hits).astype(int).ravel().tolist(),
+        "my_pairs": mine,
+        "devices": jax.device_count(),
+        "mesh": dict(mesh.shape),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
