@@ -208,22 +208,70 @@ def _leg_e2e(args) -> dict:
         del profiled
 
     # --- measured end-to-end passes (best of 3 — steady state, GC settled) --
+    # On multi-core hosts, verification ALSO overlaps generation: chunk k
+    # verifies on a worker thread while chunk k+1 generates
+    # (generate_and_verify_range_overlapped; bit-identical bundles and
+    # verdicts pinned by tests/test_range.py), COMPOSED with the pipelined
+    # driver's scan/record overlap inside each generation chunk. The e2e
+    # wall then measures the overlapped pipeline, not gen+verify in
+    # sequence. IPC_BENCH_OVERLAP_VERIFY=1 forces it on (=0 forces off).
+    _overlap_env = os.environ.get("IPC_BENCH_OVERLAP_VERIFY", "")
+    overlap_gen_verify = (
+        _overlap_env not in ("", "0") if _overlap_env != "" else n_cores > 1
+    )
+    if overlap_gen_verify:
+        # outer chunks feed the verify worker; inner pipelined chunks keep
+        # the scan(k+1)/record(k) overlap — shapes compiled during warmup
+        verify_chunk_pairs = min(len(pairs), 2 * chunk_size if n_cores > 1 else 1024)
+        gen_chunk = chunk_size if n_cores > 1 else verify_chunk_pairs
+
+        def _gen_chunk_fn(store, chunk, chunk_spec, **kwargs):
+            if n_cores > 1:
+                return generate_event_proofs_for_range_pipelined(
+                    store, chunk, chunk_spec, chunk_size=gen_chunk, **kwargs
+                )
+            return generate_event_proofs_for_range(store, chunk, chunk_spec, **kwargs)
+
     del bundle, results
     best = None
     for _ in range(3):
         gc.collect()
         metrics = Metrics()
-        t_gen0 = time.perf_counter()
-        bundle = _generate(metrics=metrics)
-        t_gen = time.perf_counter() - t_gen0
-        results, vstages = _staged_verify(bundle, backend)
-        assert all(results)
-        t_verify = sum(vstages.values())
-        if best is None or t_gen + t_verify < best[0] + best[1]:
-            best = (t_gen, t_verify, bundle, metrics, vstages)
-    t_gen, t_verify, bundle, metrics, vstages = best
+        if overlap_gen_verify:
+            from ipc_proofs_tpu.proofs.range import generate_and_verify_range_overlapped
+
+            t0 = time.perf_counter()
+            bundle, chunk_out = generate_and_verify_range_overlapped(
+                bs, pairs, spec, chunk_size=verify_chunk_pairs,
+                verify_chunk=lambda b: _staged_verify(b, backend),
+                match_backend=backend, metrics=metrics,
+                generate_fn=_gen_chunk_fn,
+            )
+            t_wall = time.perf_counter() - t0
+            results = [r for res, _ in chunk_out for r in res]
+            assert all(results) and len(results) == len(bundle.event_proofs)
+            vstages = {}
+            for _, chunk_stages in chunk_out:
+                for name, seconds in chunk_stages.items():
+                    vstages[name] = vstages.get(name, 0.0) + seconds
+            # generation occupies the calling thread for ~the whole wall;
+            # verification runs concurrently, so t_gen + t_verify > t_e2e
+            # by design — the headline rate divides by the WALL
+            t_gen = t_wall
+            t_verify = sum(vstages.values())
+            t_e2e_candidate = t_wall
+        else:
+            t_gen0 = time.perf_counter()
+            bundle = _generate(metrics=metrics)
+            t_gen = time.perf_counter() - t_gen0
+            results, vstages = _staged_verify(bundle, backend)
+            assert all(results)
+            t_verify = sum(vstages.values())
+            t_e2e_candidate = t_gen + t_verify
+        if best is None or t_e2e_candidate < best[0]:
+            best = (t_e2e_candidate, t_gen, t_verify, bundle, metrics, vstages)
+    t_e2e, t_gen, t_verify, bundle, metrics, vstages = best
     n_proofs = len(bundle.event_proofs)
-    t_e2e = t_gen + t_verify
 
     # NOTE: under the pipelined driver (multi-core hosts) generation stages
     # overlap (chunk k+1 scans on a worker thread while chunk k records), so
@@ -268,13 +316,18 @@ def _leg_e2e(args) -> dict:
         "devices": len(jax.devices()),
         "host_cores": n_cores,
         "scan_threads": scan_threads,
-        "pipeline_chunk": chunk_size,
+        # the ACTUAL generation chunking of the measured path, plus the
+        # outer verify-overlap chunking when gen_verify_overlap is on
+        "pipeline_chunk": gen_chunk if overlap_gen_verify else chunk_size,
+        "verify_chunk_pairs": verify_chunk_pairs if overlap_gen_verify else None,
         "events_per_sec_e2e": round(events_per_sec, 1),
         "proofs": n_proofs,
-        # generation stages overlap across pipeline threads; their
+        # generation stages overlap across pipeline threads (and, with
+        # gen_verify_overlap, verification overlaps generation too); their
         # sum may exceed the e2e wall the headline rate is based on
         "stages_ms": {k: round(v * 1000, 1) for k, v in stages.items()},
-        "stages_overlap": n_cores > 1,
+        "stages_overlap": n_cores > 1 or overlap_gen_verify,
+        "gen_verify_overlap": overlap_gen_verify,
         "_platform": jax_platform,
     }
 

@@ -47,7 +47,55 @@ __all__ = [
     "generate_event_proofs_for_range",
     "generate_event_proofs_for_range_chunked",
     "generate_event_proofs_for_range_pipelined",
+    "generate_and_verify_range_overlapped",
 ]
+
+
+def generate_and_verify_range_overlapped(
+    store: Blockstore,
+    pairs: Sequence[TipsetPair],
+    spec: EventProofSpec,
+    chunk_size: int,
+    verify_chunk,
+    match_backend=None,
+    metrics: Optional[Metrics] = None,
+    storage_specs=None,
+    generate_fn=None,
+) -> "tuple[UnifiedProofBundle, list]":
+    """Overlap VERIFICATION with generation across chunks: chunk k's bundle
+    verifies on a worker thread while chunk k+1 generates on the calling
+    thread — the generation-verification analog of the pipelined driver's
+    scan/record overlap, and the last structural concurrency on the
+    headline path that needs no extra hardware. Passing the pipelined
+    driver as ``generate_fn`` composes the two overlaps:
+    scan(k+1) ∥ record(k) within generation, verify(k-1) alongside both.
+
+    ``verify_chunk(bundle) -> result`` is the caller's verification closure
+    (it runs off-thread; per-chunk results are returned in chunk order).
+    Each chunk bundle is self-contained (its witness covers its proofs), so
+    per-chunk verdicts match whole-bundle verification verdict-for-verdict;
+    the merged bundle is bit-identical to the chunked driver's over the
+    same ``chunk_size`` (it IS the chunked driver's — one merge
+    implementation, hooked) — both pinned by tests/test_range.py.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    verify_results: list = []
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        futures: list = []
+        merged = generate_event_proofs_for_range_chunked(
+            store,
+            pairs,
+            spec,
+            chunk_size=chunk_size,
+            match_backend=match_backend,
+            metrics=metrics,
+            storage_specs=storage_specs,
+            generate_fn=generate_fn,
+            on_chunk=lambda bundle: futures.append(pool.submit(verify_chunk, bundle)),
+        )
+        verify_results = [f.result() for f in futures]
+    return merged, verify_results
 
 
 @dataclass
@@ -66,6 +114,8 @@ def generate_event_proofs_for_range_chunked(
     metrics: Optional[Metrics] = None,
     storage_specs=None,
     scan_workers: int = 0,
+    generate_fn=None,
+    on_chunk=None,
 ) -> UnifiedProofBundle:
     """Chunked, resumable range generation.
 
@@ -76,6 +126,12 @@ def generate_event_proofs_for_range_chunked(
     bundle deduplicates witness blocks across chunks. ``storage_specs``
     prove at every pair of every chunk and ride the same resumable
     checkpoints (both proof kinds serialize in the chunk bundles).
+
+    ``generate_fn`` overrides the per-chunk generator (same signature as
+    `generate_event_proofs_for_range` minus ``scan_workers`` — e.g. the
+    pipelined driver for intra-generation overlap). ``on_chunk(bundle)``
+    is called with every chunk bundle as it becomes available (generated
+    OR resumed) — the hook the gen/verify-overlapped driver builds on.
     """
     import hashlib
     import os
@@ -128,21 +184,33 @@ def generate_event_proofs_for_range_chunked(
                 bundle = UnifiedProofBundle.from_json(fh.read())
             metrics.count("range_chunks_resumed")
         else:
-            bundle = generate_event_proofs_for_range(
-                store,
-                chunk,
-                spec,
-                match_backend=match_backend,
-                metrics=metrics,
-                storage_specs=storage_specs,
-                scan_workers=scan_workers,
-            )
+            if generate_fn is not None:
+                bundle = generate_fn(
+                    store,
+                    chunk,
+                    spec,
+                    match_backend=match_backend,
+                    metrics=metrics,
+                    storage_specs=storage_specs,
+                )
+            else:
+                bundle = generate_event_proofs_for_range(
+                    store,
+                    chunk,
+                    spec,
+                    match_backend=match_backend,
+                    metrics=metrics,
+                    storage_specs=storage_specs,
+                    scan_workers=scan_workers,
+                )
             if path is not None:
                 tmp = path + ".tmp"
                 with open(tmp, "w") as fh:
                     fh.write(bundle.to_json())
                 os.replace(tmp, path)  # atomic: partial writes never count
             metrics.count("range_chunks_generated")
+        if on_chunk is not None:
+            on_chunk(bundle)
         storage_proofs.extend(bundle.storage_proofs)
         event_proofs.extend(bundle.event_proofs)
         all_blocks.update(bundle.blocks)

@@ -92,6 +92,45 @@ class TestRangeDriver:
                 assert piped.to_json() == reference, (backend, chunk_size)
         assert len(piped.event_proofs) == expected
 
+    def test_overlapped_gen_verify_bit_identical(self):
+        """The generation/verification-overlapped driver (bench headline
+        path on multi-core hosts) must emit exactly the chunked driver's
+        merged bundle, and its per-chunk verdicts must equal whole-bundle
+        verification verdict-for-verdict."""
+        from ipc_proofs_tpu.proofs.range import (
+            generate_and_verify_range_overlapped,
+            generate_event_proofs_for_range_chunked,
+        )
+
+        bs, pairs, expected = _make_range(7)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+
+        def verify_chunk(bundle):
+            return verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results
+
+        for chunk_size in (1, 3, 7, 100):
+            reference = generate_event_proofs_for_range_chunked(
+                bs, pairs, spec, chunk_size=chunk_size
+            )
+            merged, chunk_results = generate_and_verify_range_overlapped(
+                bs, pairs, spec, chunk_size=chunk_size, verify_chunk=verify_chunk
+            )
+            assert merged.to_json() == reference.to_json(), chunk_size
+            flat = [r for res in chunk_results for r in res]
+            whole = verify_proof_bundle(merged, TrustPolicy.accept_all()).event_results
+            assert flat == whole, chunk_size
+            assert all(flat) and len(flat) == expected
+
+    def test_overlapped_empty_range(self):
+        from ipc_proofs_tpu.proofs.range import generate_and_verify_range_overlapped
+
+        bs, pairs, _ = _make_range(1)
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        merged, results = generate_and_verify_range_overlapped(
+            bs, [], spec, chunk_size=4, verify_chunk=lambda b: ["ran"]
+        )
+        assert merged.event_proofs == [] and results == []
+
     def test_pipelined_empty_range(self):
         from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
 
