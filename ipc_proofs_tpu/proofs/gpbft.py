@@ -54,13 +54,26 @@ DECIDE_PHASE = 5
 DEFAULT_NETWORK = "filecoin"
 
 
-def _commitments32(raw: bytes, what: str) -> bytes:
-    """Commitments are a fixed [32]byte in go-f3; empty means all-zero."""
-    if not raw:
+def commitments32(raw: bytes, what: str, strict: bool = False) -> bytes:
+    """Commitments are a fixed [32]byte in go-f3; empty means all-zero on
+    the ENCODE side (the dataclass default). ``strict`` (wire decode)
+    requires exactly 32 bytes — cborgen rejects any other length, and
+    tolerating b"" there would create a second wire form."""
+    if not raw and not strict:
         return bytes(32)
     if len(raw) != 32:
         raise ValueError(f"{what} commitments must be 32 bytes, got {len(raw)}")
     return bytes(raw)
+
+
+_commitments32 = commitments32  # internal alias
+
+
+def tipset_key_bytes(key: "Sequence[str]") -> bytes:
+    """Lotus ``TipSetKey.Bytes()``: the blocks' binary CIDs concatenated."""
+    from ipc_proofs_tpu.core.cid import CID
+
+    return b"".join(CID.from_string(c).to_bytes() for c in key)
 
 
 def ec_chain_key(tipsets: Sequence) -> bytes:
@@ -75,7 +88,7 @@ def ec_chain_key(tipsets: Sequence) -> bytes:
     for ts in tipsets:
         out += struct.pack(">q", ts.epoch)
         out += _commitments32(ts.commitments, "ECTipSet")
-        key_bytes = b"".join(CID.from_string(c).to_bytes() for c in ts.key)
+        key_bytes = tipset_key_bytes(ts.key)
         out += struct.pack(">I", len(key_bytes))
         out += key_bytes
         out += CID.from_string(ts.power_table).to_bytes()
