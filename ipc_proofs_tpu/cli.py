@@ -222,6 +222,91 @@ def _cmd_vectors(args) -> int:
     return 0
 
 
+def _cmd_cert(args) -> int:
+    """Inspect / validate F3 finality certificates (JSON or go-f3 CBOR)."""
+    from ipc_proofs_tpu.proofs.cert import (
+        FinalityCertificate,
+        FinalityCertificateChain,
+        PowerTableEntry,
+    )
+    from ipc_proofs_tpu.proofs.cert_cbor import (
+        certificate_from_cbor,
+        certificate_to_cbor,
+    )
+
+    def load_cert(path: str) -> FinalityCertificate:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        # JSON certificates are Forest-style objects; anything that does
+        # not parse as a JSON object is treated as certexchange CBOR
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            return certificate_from_cbor(raw)
+        return FinalityCertificate.from_json_obj(obj)
+
+    certs = [load_cert(p) for p in args.certificates]
+    chain = FinalityCertificateChain(certificates=certs)
+
+    table = None
+    if args.power_table:
+        with open(args.power_table) as fh:
+            rows = json.load(fh)
+        if not isinstance(rows, list):
+            raise SystemExit("power table JSON must be a list of rows")
+        table = [
+            PowerTableEntry(
+                participant_id=int(r["ParticipantID"]),
+                power=int(r["Power"]),
+                signing_key=str(r["SigningKey"]),
+                pop=str(r.get("Pop", "")),
+            )
+            for r in rows
+        ]
+
+    if args.verify_signatures and table is None:
+        raise SystemExit("--verify-signatures requires --power-table")
+
+    if args.emit_cbor:
+        if len(certs) != 1:
+            raise SystemExit("--emit-cbor takes exactly one certificate")
+        with open(args.emit_cbor, "wb") as fh:
+            fh.write(certificate_to_cbor(certs[0]))
+        log.info("wrote certexchange CBOR → %s", args.emit_cbor)
+
+    status = "ok"
+    error = None
+    final_table_size = None
+    try:
+        final = chain.validate(
+            initial_power_table=table,
+            verify_signatures=args.verify_signatures,
+            verify_table_cids=table is not None,
+            network=args.network,
+        )
+        final_table_size = len(final) if final is not None else None
+    except ValueError as exc:
+        status, error = "invalid", str(exc)
+
+    print(
+        json.dumps(
+            {
+                "certificates": len(certs),
+                "instances": [c.instance for c in certs],
+                "epochs": [
+                    [c.ec_chain[0].epoch, c.ec_chain[-1].epoch] if c.ec_chain else None
+                    for c in certs
+                ],
+                "signatures_verified": bool(args.verify_signatures) and status == "ok",
+                "final_power_table_rows": final_table_size,
+                "status": status,
+                "error": error,
+            }
+        )
+    )
+    return 0 if status == "ok" else 1
+
+
 def _cmd_demo(args) -> int:
     """The reference `main.rs` flow, hermetic: synthesize a chain, generate
     one storage + one event proof, verify offline, print results."""
@@ -360,6 +445,38 @@ def main(argv=None) -> int:
     vec.add_argument("--height", type=int, required=True)
     vec.add_argument("-o", "--output", default=None)
     vec.set_defaults(fn=_cmd_vectors)
+
+    cert = sub.add_parser(
+        "cert",
+        help="inspect/validate F3 finality certificates (Forest JSON or "
+        "go-f3 certexchange CBOR; chain continuity, delta replay, table "
+        "commitments, optional BLS verification)",
+    )
+    cert.add_argument("certificates", nargs="+", help="certificate files (JSON or CBOR)")
+    cert.add_argument(
+        "--power-table",
+        default=None,
+        help="initial power table JSON [{ParticipantID, Power, SigningKey, Pop?}, …] "
+        "for the first certificate's instance (enables delta replay + commitments)",
+    )
+    cert.add_argument(
+        "--verify-signatures",
+        action="store_true",
+        help="verify each certificate's aggregate BLS signature and >2/3 quorum "
+        "(requires --power-table)",
+    )
+    cert.add_argument(
+        "--network",
+        default=None,
+        help='gpbft network name in the signing payload (default "filecoin")',
+    )
+    cert.add_argument(
+        "--emit-cbor",
+        default=None,
+        metavar="PATH",
+        help="re-encode the (single) certificate in go-f3 certexchange CBOR",
+    )
+    cert.set_defaults(fn=_cmd_cert)
 
     demo = sub.add_parser("demo", help="hermetic end-to-end demo on a synthetic chain")
     demo.set_defaults(fn=_cmd_demo)

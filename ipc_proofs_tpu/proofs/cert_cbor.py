@@ -197,7 +197,7 @@ def certificate_from_cbor(raw: bytes) -> FinalityCertificate:
     if not isinstance(deltas, list):
         raise ValueError("PowerTableDelta must be a list")
     decode_rleplus(signers)  # validate the bitfield at the trust boundary
-    return FinalityCertificate(
+    cert = FinalityCertificate(
         instance=instance,
         ec_chain=[_tipset_from_obj(t) for t in chain],
         supplemental_data=SupplementalData(
@@ -208,3 +208,12 @@ def certificate_from_cbor(raw: bytes) -> FinalityCertificate:
         signature=signature,
         power_table_delta=[_delta_from_obj(d) for d in deltas],
     )
+    # whole-certificate canonicality: re-encode and require byte equality.
+    # This closes every residual second-wire-form path in one check — the
+    # round-5 soak caught a tag-42 link with a non-minimal multihash-code
+    # varint that the block-level CID tolerance accepts and re-encodes
+    # one byte shorter (cborgen emits only canonical forms, so a
+    # non-canonical certificate is never a go-f3 artifact).
+    if certificate_to_cbor(cert) != raw:
+        raise ValueError("non-canonical certificate encoding")
+    return cert

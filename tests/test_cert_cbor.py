@@ -154,6 +154,23 @@ class TestCodec:
         with pytest.raises(ValueError):
             certificate_from_cbor(cbor_encode(mutate(obj)))
 
+    def test_nonminimal_link_varint_rejected(self):
+        """Regression for the round-5 soak find: a tag-42 link whose
+        multihash-code varint is non-minimal decodes through the
+        block-level CID tolerance but re-encodes shorter — a second wire
+        form for the same certificate. The whole-certificate canonical
+        re-encode check must reject it."""
+        base = certificate_to_cbor(_cert())
+        canon = bytes.fromhex("58270001 71a0e402 20".replace(" ", ""))
+        assert canon in base  # byte-string head + identity prefix + CIDv1
+        # lengthen the mh-code varint 0xb220: a0 e4 02 -> a0 e4 82 00
+        # (adds a redundant zero group) and bump the byte-string length
+        noncanon = bytes.fromhex("58280001 71a0e482 0020".replace(" ", ""))
+        mutated = base.replace(canon, noncanon, 1)
+        assert mutated != base
+        with pytest.raises(ValueError, match="non-canonical"):
+            certificate_from_cbor(mutated)
+
     def test_fuzz_garbage_never_leaks_and_accepts_are_canonical(self):
         """Byte-level mutations must reject as ValueError only (the same
         contract as the JSON trust boundary), and every ACCEPTED mutant —

@@ -139,6 +139,70 @@ class TestCertificateWiring:
         with pytest.raises(ValueError, match="exceeds"):
             cert.signer_indices(max_index=16)
 
+    def test_cert_cli_validates_signed_cbor_chain(self, tmp_path, capsys):
+        """`cli.py cert`: a go-f3-CBOR certificate with a correct table
+        commitment and an aggregate signature from a >2/3 quorum validates
+        end-to-end (delta replay + commitment + BLS); tampering the
+        signature flips the verdict."""
+        import json
+
+        from ipc_proofs_tpu import cli
+        from ipc_proofs_tpu.crypto import bls
+        from ipc_proofs_tpu.crypto.rleplus import encode_rleplus
+        from ipc_proofs_tpu.proofs.cert import power_table_cid
+        from ipc_proofs_tpu.proofs.cert_cbor import certificate_to_cbor
+        from tests.test_bls import KEY_STRS, POPS, POWERS, SKS, _table
+
+        table_rows = _table()
+        cert = FinalityCertificate(
+            instance=0,
+            ec_chain=[
+                ECTipSet(key=[str(_cid("b0"))], epoch=100, power_table=str(_cid("pt"))),
+                ECTipSet(key=[str(_cid("b1"))], epoch=101, power_table=str(_cid("pt"))),
+            ],
+            supplemental_data=SupplementalData(
+                power_table=str(power_table_cid(table_rows))  # no deltas
+            ),
+            signers=encode_rleplus([0, 1, 2]),
+        )
+        payload = cert.signing_payload()
+        sig = bls.aggregate_signatures([bls.sign(SKS[i], payload) for i in (0, 1, 2)])
+        cert.signature = bls.g2_compress(sig)
+
+        cert_path = tmp_path / "cert.cbor"
+        cert_path.write_bytes(certificate_to_cbor(cert))
+        table_path = tmp_path / "table.json"
+        table_path.write_text(
+            json.dumps(
+                [
+                    {"ParticipantID": i, "Power": POWERS[i],
+                     "SigningKey": KEY_STRS[i], "Pop": POPS[i]}
+                    for i in range(4)
+                ]
+            )
+        )
+        rc = cli.main(
+            ["cert", str(cert_path), "--power-table", str(table_path),
+             "--verify-signatures"]
+        )
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["status"] == "ok", out
+        assert out["signatures_verified"] is True
+        assert out["final_power_table_rows"] == 4
+
+        # tampered signature must flip the verdict (the encoder emits the
+        # signature bytes verbatim; rejection happens at verification)
+        bad = FinalityCertificate(**{**cert.__dict__})
+        bad.signature = bytes(96)
+        bad_path = tmp_path / "bad.cbor"
+        bad_path.write_bytes(certificate_to_cbor(bad))
+        rc = cli.main(
+            ["cert", str(bad_path), "--power-table", str(table_path),
+             "--verify-signatures"]
+        )
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and out["status"] == "invalid"
+
     def test_network_threads_through_verification(self):
         """verify_signature(network=...) verifies a certificate signed for
         a non-default network name (code-review finding: the parameter
