@@ -204,7 +204,12 @@ def config3_storage_slots(quick: bool):
 
 
 def config4_witness_cids(quick: bool):
-    """1M recorded IPLD blocks → blake2b-256 CID recompute on device."""
+    """1M recorded IPLD blocks → blake2b-256 CID recompute, measured on
+    the best backend the verifier would pick for THIS platform: the
+    device kernel on a chip, the C++ batch hasher off-chip (timing the
+    XLA emulation of the device kernel on a CPU host produced a
+    ~4-orders-slower number that said nothing about the platform)."""
+    import jax
     import numpy as np
 
     from ipc_proofs_tpu.core.hashes import blake2b_256
@@ -214,6 +219,49 @@ def config4_witness_cids(quick: bool):
     rng = np.random.default_rng(0)
     payload = rng.integers(0, 256, size=(n_blocks, block_size), dtype=np.uint8)
     messages = [payload[i].tobytes() for i in range(n_blocks)]
+
+    if jax.devices()[0].platform != "tpu":
+        from ipc_proofs_tpu.backend.native import load_native
+
+        from ipc_proofs_tpu.backend.native import load_native, load_scan_ext
+
+        candidates = []
+        sample = min(20_000, n_blocks)
+        t0 = time.perf_counter()
+        for i in range(sample):
+            blake2b_256(messages[i])
+        scalar_rate = sample / (time.perf_counter() - t0)
+        candidates.append((scalar_rate, "scalar-hashlib"))
+        scan = load_scan_ext()
+        if scan is not None and hasattr(scan, "verify_blake2b_blocks"):
+            # THE production verify path: in-place recompute+compare
+            digests = [blake2b_256(m) for m in messages]
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                assert scan.verify_blake2b_blocks(digests, messages) is True
+                best = min(best, time.perf_counter() - t0)
+            candidates.append((n_blocks / best, "scan-ext-verify"))
+        native = load_native()
+        if native is not None:
+            assert native.blake2b256_batch(messages[:1])[0] == blake2b_256(messages[0])
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                native.blake2b256_batch(messages)
+                best = min(best, time.perf_counter() - t0)
+            candidates.append((n_blocks / best, "cpp-batch"))
+        if len(candidates) > 1:
+            # report the best path the platform actually offers, labeled —
+            # the verifier itself picks scan-ext-verify when built
+            rate, kernel = max(candidates)
+            detail = ", ".join(f"{k} {r:,.0f}" for r, k in candidates)
+            _log(f"config4: {rate:,.0f} CIDs/s best ({kernel}; {detail})")
+            _emit("witness_cid_recompute_per_sec", rate, "CIDs/s",
+                  vs_baseline=round(rate / scalar_rate, 2), kernel=kernel)
+            return
+        messages = messages[: min(n_blocks, 20_000)]
+        n_blocks = len(messages)  # no native paths: tiny-shape XLA fallback
 
     from ipc_proofs_tpu.ops.cid_bench import blake2b_cid_bench_setup
     from ipc_proofs_tpu.utils.timing import measure_pass_seconds

@@ -23,6 +23,13 @@ class CpuBackend:
 
     def __init__(self, use_native: bool = True):
         self._native = load_native() if use_native else None
+        self._scan_verify = None
+        if use_native:
+            from ipc_proofs_tpu.backend.native import load_scan_ext
+
+            ext = load_scan_ext()
+            if ext is not None and hasattr(ext, "verify_blake2b_blocks"):
+                self._scan_verify = ext.verify_blake2b_blocks
 
     @property
     def has_native(self) -> bool:
@@ -41,6 +48,10 @@ class CpuBackend:
     def verify_block_cids(
         self, cids_digests: Sequence[bytes], blocks: Sequence[bytes]
     ) -> bool:
+        if self._scan_verify is not None:
+            # in-place CPython-API batch (no packing, GIL-released loop):
+            # ~2× the ctypes batch path at witness-node sizes
+            return self._scan_verify(cids_digests, blocks)
         if self._native is not None:
             return self._native.verify_blake2b_batch(list(cids_digests), list(blocks))
         return all(

@@ -2343,6 +2343,183 @@ done:
 /* the effective scan fan-out (IPC_SCAN_THREADS env or core count, capped)
  * — exposed so observability (bench JSON) reports exactly what the
  * scanner uses instead of re-deriving it with divergent logic */
+/* ---------------------------------------------------------- blake2b-256
+ * Same implementation as backend/native/hashes.cpp; embedded here so the
+ * batch verify below can hash in place without the ctypes packing round
+ * trip. Pinned against hashlib across block sizes (incl. the multi-block
+ * loop and exact 128-multiples) by tests/test_backend.py
+ * TestScanExtBatchVerify. */
+static const uint64_t b2b_iv[8] = {
+    0x6A09E667F3BCC908ULL, 0xBB67AE8584CAA73BULL, 0x3C6EF372FE94F82BULL,
+    0xA54FF53A5F1D36F1ULL, 0x510E527FADE682D1ULL, 0x9B05688C2B3E6C1FULL,
+    0x1F83D9ABFB41BD6BULL, 0x5BE0CD19137E2179ULL};
+
+static const uint8_t b2b_sigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t b2b_rotr64(uint64_t v, int n) {
+  return (v >> n) | (v << (64 - n));
+}
+
+#define B2B_G(a, b, c, d, x, y)           \
+  v[a] += v[b] + (x);                     \
+  v[d] = b2b_rotr64(v[d] ^ v[a], 32);     \
+  v[c] += v[d];                           \
+  v[b] = b2b_rotr64(v[b] ^ v[c], 24);     \
+  v[a] += v[b] + (y);                     \
+  v[d] = b2b_rotr64(v[d] ^ v[a], 16);     \
+  v[c] += v[d];                           \
+  v[b] = b2b_rotr64(v[b] ^ v[c], 63);
+
+static void b2b_compress(uint64_t h[8], const uint8_t *block, uint64_t t,
+                         int last) {
+  uint64_t v[16], m[16];
+  for (int i = 0; i < 8; ++i) v[i] = h[i];
+  for (int i = 0; i < 8; ++i) v[i + 8] = b2b_iv[i];
+  v[12] ^= t;
+  if (last) v[14] = ~v[14];
+  for (int i = 0; i < 16; ++i) memcpy(&m[i], block + 8 * i, 8);
+  for (int r = 0; r < 12; ++r) {
+    const uint8_t *s = b2b_sigma[r];
+    B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+  for (int i = 0; i < 8; ++i) h[i] ^= v[i] ^ v[i + 8];
+}
+
+static void blake2b256_one(const uint8_t *data, uint64_t len, uint8_t *out) {
+  uint64_t h[8];
+  for (int i = 0; i < 8; ++i) h[i] = b2b_iv[i];
+  h[0] ^= 0x01010020ULL; /* digest 32, key 0, fanout 1, depth 1 */
+  uint64_t offset = 0;
+  while (len > 128 && len - offset > 128) {
+    b2b_compress(h, data + offset, offset + 128, 0);
+    offset += 128;
+  }
+  uint8_t block[128] = {0};
+  memcpy(block, data + offset, len - offset);
+  b2b_compress(h, block, len, 1);
+  memcpy(out, h, 32);
+}
+
+/* verify_blake2b_blocks(digests, blocks) -> bool: batch witness-CID
+ * verification with ZERO packing — reads every PyBytes in place and runs
+ * the whole hash loop with the GIL released. Replaces the ctypes batch
+ * path, whose Python-side offset/length packing and buffer copies cost
+ * more than the hashing itself at witness-node sizes (~200 B). */
+static PyObject *py_verify_blake2b_blocks(PyObject *self, PyObject *args) {
+  (void)self;
+  PyObject *digests_arg, *blocks_arg;
+  if (!PyArg_ParseTuple(args, "OO", &digests_arg, &blocks_arg)) return NULL;
+  PyObject *digests = PySequence_Fast(digests_arg, "digests must be a sequence");
+  if (!digests) return NULL;
+  PyObject *blocks = PySequence_Fast(blocks_arg, "blocks must be a sequence");
+  if (!blocks) {
+    Py_DECREF(digests);
+    return NULL;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(digests);
+  int ok = 1;
+  if (n != PySequence_Fast_GET_SIZE(blocks)) {
+    Py_DECREF(digests);
+    Py_DECREF(blocks);
+    PyErr_SetString(PyExc_ValueError, "digests and blocks must have equal length");
+    return NULL;
+  }
+  /* collect raw pointers under the GIL (bytes fast path; any other
+   * buffer-protocol object — bytearray, memoryview — via GetBuffer,
+   * matching the tolerant paths this replaces), then hash without it */
+  const uint8_t **dptr = NULL, **bptr = NULL;
+  Py_ssize_t *blen = NULL;
+  Py_buffer *views = NULL; /* held views for non-bytes items */
+  Py_ssize_t n_views = 0;
+  if (n) {
+    dptr = malloc(sizeof(*dptr) * (size_t)n);
+    bptr = malloc(sizeof(*bptr) * (size_t)n);
+    blen = malloc(sizeof(*blen) * (size_t)n);
+    views = malloc(sizeof(*views) * (size_t)n * 2);
+    if (!dptr || !bptr || !blen || !views) {
+      free(dptr);
+      free(bptr);
+      free(blen);
+      free(views);
+      Py_DECREF(digests);
+      Py_DECREF(blocks);
+      return PyErr_NoMemory();
+    }
+  }
+  int bad_input = 0;
+  for (Py_ssize_t i = 0; i < n && !bad_input; i++) {
+    PyObject *d = PySequence_Fast_GET_ITEM(digests, i);
+    PyObject *b = PySequence_Fast_GET_ITEM(blocks, i);
+    if (PyBytes_Check(d)) {
+      dptr[i] = (const uint8_t *)PyBytes_AS_STRING(d);
+      if (PyBytes_GET_SIZE(d) != 32) bad_input = 1;
+    } else if (PyObject_GetBuffer(d, &views[n_views], PyBUF_SIMPLE) == 0) {
+      dptr[i] = (const uint8_t *)views[n_views].buf;
+      if (views[n_views].len != 32) bad_input = 1;
+      n_views++;
+    } else {
+      PyErr_Clear();
+      bad_input = 1;
+      break;
+    }
+    if (PyBytes_Check(b)) {
+      bptr[i] = (const uint8_t *)PyBytes_AS_STRING(b);
+      blen[i] = PyBytes_GET_SIZE(b);
+    } else if (PyObject_GetBuffer(b, &views[n_views], PyBUF_SIMPLE) == 0) {
+      bptr[i] = (const uint8_t *)views[n_views].buf;
+      blen[i] = views[n_views].len;
+      n_views++;
+    } else {
+      PyErr_Clear();
+      bad_input = 1;
+    }
+  }
+  if (!bad_input) {
+    Py_BEGIN_ALLOW_THREADS;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      uint8_t out[32];
+      blake2b256_one(bptr[i], (uint64_t)blen[i], out);
+      if (memcmp(out, dptr[i], 32) != 0) {
+        ok = 0;
+        break;
+      }
+    }
+    Py_END_ALLOW_THREADS;
+  }
+  for (Py_ssize_t i = 0; i < n_views; i++) PyBuffer_Release(&views[i]);
+  free(dptr);
+  free(bptr);
+  free(blen);
+  free(views);
+  Py_DECREF(digests);
+  Py_DECREF(blocks);
+  if (bad_input) {
+    PyErr_SetString(PyExc_ValueError,
+                    "expected buffer blocks and 32-byte digests");
+    return NULL;
+  }
+  return PyBool_FromLong(ok);
+}
+
 static PyObject *py_scan_threads(PyObject *self, PyObject *noarg) {
   (void)self;
   (void)noarg;
@@ -2350,6 +2527,9 @@ static PyObject *py_scan_threads(PyObject *self, PyObject *noarg) {
 }
 
 static PyMethodDef methods[] = {
+    {"verify_blake2b_blocks", py_verify_blake2b_blocks, METH_VARARGS,
+     "verify_blake2b_blocks(digests, blocks) -> bool: batch blake2b-256 "
+     "witness verification in place (no packing; GIL released)."},
     {"scan_threads", py_scan_threads, METH_NOARGS,
      "Effective scan thread count (IPC_SCAN_THREADS env or capped core "
      "count) — the value scan_events_batch fans out to."},

@@ -292,13 +292,19 @@ def reconstruct_execution_orders_batch(
         all_present = all(r is not None for r in raws)
         batch_clean = False
         if all_present:
-            from ipc_proofs_tpu.backend.native import load_native
+            from ipc_proofs_tpu.backend.native import load_native, load_scan_ext
 
-            native = load_native()  # memoized by the loader itself
-            if native is not None:
-                batch_clean = native.verify_blake2b_batch(
+            ext = load_scan_ext()  # loaders memoize
+            if ext is not None and hasattr(ext, "verify_blake2b_blocks"):
+                batch_clean = ext.verify_blake2b_blocks(
                     [c[6:] for c in recompute_cids], raws
                 )
+            else:
+                native = load_native()
+                if native is not None:
+                    batch_clean = native.verify_blake2b_batch(
+                        [c[6:] for c in recompute_cids], raws
+                    )
         if not batch_clean:
             for g, cid_b, raw_block in zip(recompute_group, recompute_cids, raws):
                 if results[g] is None:
