@@ -285,28 +285,34 @@ static int cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
   }
 }
 
-/* CID byte validation with CID.from_bytes acceptance: CIDv1 only, varint
- * (codec, mh_code, mh_len) prefix, digest exactly mh_len bytes, nothing
- * trailing. */
-static int cid_bytes_valid(const uint8_t *d, Py_ssize_t n) {
-  Py_ssize_t pos = 0;
-  unsigned __int128 version, codec, mh_code, mh_len;
-  if (cid_uvarint(d, n, &pos, &version) < 0 || version != 1) return 0;
-  if (cid_uvarint(d, n, &pos, &codec) < 0) return 0;
-  if (cid_uvarint(d, n, &pos, &mh_code) < 0) return 0;
-  if (cid_uvarint(d, n, &pos, &mh_len) < 0) return 0;
-  return (unsigned __int128)(n - pos) == mh_len;
-}
-
 /* like cid_uvarint but flags non-minimal encodings (a multi-byte varint
- * whose most significant group is zero) — only canonical encodings may be
- * memoized as a CID's to_bytes value */
+ * whose most significant group is zero) — every decode boundary rejects
+ * those, so only canonical encodings ever construct a CID */
 static int cid_uvarint_min(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
                            unsigned __int128 *out, int *minimal) {
   Py_ssize_t start = *pos;
   if (cid_uvarint(d, n, pos, out) < 0) return -1;
   *minimal &= (*pos - start) == 1 || d[*pos - 1] != 0;
   return 0;
+}
+
+/* CID byte validation with CID.from_bytes acceptance: CIDv1 only, MINIMAL
+ * varint (codec, mh_code, mh_len) prefix, digest exactly mh_len bytes,
+ * nothing trailing. Used by the validating skip path, which must reject
+ * exactly the bytes every decode path rejects — a tolerant check here
+ * would let a non-minimal link in a skipped field pass decode_lite while
+ * the full decode raises (the lite/full acceptance contract,
+ * state/header.py). */
+static int cid_bytes_valid(const uint8_t *d, Py_ssize_t n) {
+  Py_ssize_t pos = 0;
+  unsigned __int128 version, codec, mh_code, mh_len;
+  int minimal = 1;
+  if (cid_uvarint_min(d, n, &pos, &version, &minimal) < 0 || version != 1)
+    return 0;
+  if (cid_uvarint_min(d, n, &pos, &codec, &minimal) < 0) return 0;
+  if (cid_uvarint_min(d, n, &pos, &mh_code, &minimal) < 0) return 0;
+  if (cid_uvarint_min(d, n, &pos, &mh_len, &minimal) < 0) return 0;
+  return minimal && (unsigned __int128)(n - pos) == mh_len;
 }
 
 /* uvarint values can exceed u64 (shift cap 63 admits up to ~2^70); Python
@@ -652,9 +658,9 @@ static PyObject *cid_cls_make(PyObject *cls, PyObject *args, PyObject *kwds) {
 
 /* CID.from_bytes parity, including the error messages of the pure-Python
  * generic path. detailed=0 gives make_cid's single "malformed CID bytes"
- * (the tolerant tag-42 / make_cids boundary). Memoizes raw as to_bytes
- * IFF every varint is minimal — the no-malleability rule shared with the
- * Python fast paths (only canonical encodings may be memoized). */
+ * (the tag-42 / make_cids boundary). Non-minimal varints REJECT, so every
+ * accepted decode is the canonical encoding and raw is always safe to
+ * memoize as to_bytes. */
 static PyObject *cid_from_raw(const uint8_t *raw, Py_ssize_t n, int detailed) {
   Py_ssize_t pos = 0;
   unsigned __int128 version = 0, codec = 0, mh_code = 0, mh_len = 0;
@@ -683,6 +689,15 @@ static PyObject *cid_from_raw(const uint8_t *raw, Py_ssize_t n, int detailed) {
     PyErr_SetString(PyExc_ValueError, "trailing bytes after CID");
     return NULL;
   }
+  /* strict minimal varints (go-varint / rust unsigned-varint parity):
+   * tolerating a non-minimal prefix gives one logical CID two byte forms,
+   * and the batch walkers' raw spans then disagree with the scalar
+   * decoders' canonical re-encodes (round-5 exec-order fuzz find). */
+  if (!minimal) {
+    if (!detailed) goto generic;
+    PyErr_SetString(PyExc_ValueError, "non-canonical CID byte encoding");
+    return NULL;
+  }
   {
     PyObject *digest =
         PyBytes_FromStringAndSize((const char *)raw + pos, n - pos);
@@ -690,12 +705,10 @@ static PyObject *cid_from_raw(const uint8_t *raw, Py_ssize_t n, int detailed) {
     CIDObject *o = (CIDObject *)cid_new_parts(version, codec, mh_code, digest);
     Py_DECREF(digest);
     if (!o) return NULL;
-    if (minimal) {
-      o->bytes_memo = PyBytes_FromStringAndSize((const char *)raw, n);
-      if (!o->bytes_memo) {
-        Py_DECREF(o);
-        return NULL;
-      }
+    o->bytes_memo = PyBytes_FromStringAndSize((const char *)raw, n);
+    if (!o->bytes_memo) {
+      Py_DECREF(o);
+      return NULL;
     }
     return (PyObject *)o;
   }
@@ -795,19 +808,10 @@ static PyObject *cid_from_str_item(PyObject *item) {
   /* detailed=1: CID.from_string surfaces from_bytes' specific messages
    * (unsupported version / truncated digest / trailing bytes), not the
    * tolerant tag-42 boundary's generic one */
+  /* cid_from_raw itself rejects non-minimal varints ("non-canonical CID
+   * byte encoding"), so any CID it returns is the canonical decode of
+   * this string */
   cid = cid_from_raw(dec, nbytes, 1);
-  if (cid) {
-    /* canonical varints only at the STRING boundary (CID.from_string
-     * parity): a non-minimal varint prefix would be a second string for
-     * the same CID. cid_from_raw sets the to_bytes memo IFF every varint
-     * was minimal — that flag is the single source of truth. */
-    if (!((CIDObject *)cid)->bytes_memo) {
-      Py_DECREF(cid);
-      cid = NULL;
-      PyErr_Format(PyExc_ValueError, "non-canonical CID byte encoding in %R",
-                   item);
-    }
-  }
 done:
   if (dec != buf) free(dec);
   return cid;

@@ -289,7 +289,7 @@ static int rd_uint(Parser *p, uint64_t *value) {
 }
 
 /* uvarint with the same acceptance as core/varint.decode_uvarint (shift
- * capped so values stay under 2^70; non-minimal encodings accepted) */
+ * capped so values stay under 2^70) */
 static int scan_cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
                             unsigned __int128 *out) {
   unsigned __int128 value = 0;
@@ -307,20 +307,33 @@ static int scan_cid_uvarint(const uint8_t *d, Py_ssize_t n, Py_ssize_t *pos,
   }
 }
 
+/* scan_cid_uvarint + strict minimality: a multi-byte varint whose final
+ * (most-significant) byte is zero is a second encoding of the same value
+ * and rejects, exactly like CID.from_bytes / go-varint / unsigned-varint */
+static int scan_cid_uvarint_min(const uint8_t *d, Py_ssize_t n,
+                                Py_ssize_t *pos, unsigned __int128 *out) {
+  Py_ssize_t start = *pos;
+  if (scan_cid_uvarint(d, n, pos, out) < 0) return -1;
+  if (*pos - start > 1 && d[*pos - 1] == 0) return -1; /* non-minimal */
+  return 0;
+}
+
 /* structural CID validation, mirroring CID.from_bytes acceptance (version
- * must be 1; digest length must equal the mh_len varint; no trailing
- * bytes). The Python decoders validate EVERY CID in a node they decode, so
- * the scanner must reject the same bytes — otherwise a witness node whose
- * unrelated sibling entry carries a corrupt CID scans clean here while the
- * scalar replay rejects it, and the two verify paths diverge (found by
- * tests/test_batch_verifier_fuzz.py). */
+ * must be 1; minimal varints; digest length must equal the mh_len varint;
+ * no trailing bytes). The Python decoders validate EVERY CID in a node
+ * they decode, so the scanner must reject the same bytes — otherwise a
+ * witness node whose unrelated sibling entry carries a corrupt CID scans
+ * clean here while the scalar replay rejects it, and the two verify paths
+ * diverge (found by tests/test_batch_verifier_fuzz.py; the minimality leg
+ * by the round-5 exec-order fuzz: a non-minimal link varint made this
+ * walker's raw span disagree with the scalar canonical re-encode). */
 static int scan_cid_valid(const uint8_t *d, Py_ssize_t n) {
   Py_ssize_t pos = 0;
   unsigned __int128 version, codec, mh_code, mh_len;
-  if (scan_cid_uvarint(d, n, &pos, &version) < 0 || version != 1) return 0;
-  if (scan_cid_uvarint(d, n, &pos, &codec) < 0) return 0;
-  if (scan_cid_uvarint(d, n, &pos, &mh_code) < 0) return 0;
-  if (scan_cid_uvarint(d, n, &pos, &mh_len) < 0) return 0;
+  if (scan_cid_uvarint_min(d, n, &pos, &version) < 0 || version != 1) return 0;
+  if (scan_cid_uvarint_min(d, n, &pos, &codec) < 0) return 0;
+  if (scan_cid_uvarint_min(d, n, &pos, &mh_code) < 0) return 0;
+  if (scan_cid_uvarint_min(d, n, &pos, &mh_len) < 0) return 0;
   return (unsigned __int128)(n - pos) == mh_len;
 }
 
@@ -651,7 +664,8 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
       walk_err(E_VALUE, "event entry must be a 4-tuple");
       return -1;
     }
-    if (skip_item(p) < 0) return -1; /* flags */
+    uint64_t flags_u64;
+    if (rd_uint(p, &flags_u64) < 0) return -1; /* flags: u64 (serde parity) */
     int major;
     uint64_t klen;
     if (rd_head(p, &major, &klen) < 0) return -1;
@@ -661,7 +675,8 @@ static int emit_event(Scan *s, Parser *p, int32_t pair_id, int32_t rcpt_idx,
     }
     const uint8_t *key = p->data + p->pos;
     p->pos += (Py_ssize_t)klen;
-    if (skip_item(p) < 0) return -1; /* codec */
+    uint64_t codec_u64;
+    if (rd_uint(p, &codec_u64) < 0) return -1; /* codec: u64 (serde parity) */
     const uint8_t *vptr;
     Py_ssize_t vlen;
     if (rd_bytes(p, &vptr, &vlen) < 0) return -1; /* value (always bytes) */

@@ -11,7 +11,7 @@ from dataclasses import dataclass
 from functools import total_ordering
 
 from ipc_proofs_tpu.core.hashes import blake2b_256
-from ipc_proofs_tpu.core.varint import decode_uvarint, encode_uvarint
+from ipc_proofs_tpu.core.varint import decode_uvarint_min, encode_uvarint
 
 # codecs
 DAG_CBOR = 0x71
@@ -159,10 +159,7 @@ class CID:
         # On the fast paths ``raw`` is the canonical encoding by
         # construction (fixed minimal-varint prefixes), so it is stashed as
         # the to_bytes memo — witness loading and claim construction
-        # re-encode every CID they touch. The generic path does NOT stash:
-        # decode_uvarint accepts non-minimal varints, and memoizing a
-        # non-canonical input would make to_bytes malleable (two byte forms
-        # for one logical CID diverging across byte-keyed maps and claims).
+        # re-encode every CID they touch.
         if len(raw) == 38 and raw[1] == 0x71 and raw[:6] == b"\x01\x71\xa0\xe4\x02\x20":
             out = cls._make(1, DAG_CBOR, BLAKE2B_256, raw[6:])
         elif len(raw) == 38 and raw[:6] == b"\x01\x55\xa0\xe4\x02\x20":
@@ -170,18 +167,30 @@ class CID:
         elif len(raw) == 36 and raw[:4] == b"\x01\x55\x12\x20":
             out = cls._make(1, RAW, SHA2_256, raw[4:])
         else:
-            version, off = decode_uvarint(raw)
+            version, off, minimal = decode_uvarint_min(raw)
             if version != 1:
                 raise ValueError(f"unsupported CID version {version}")
-            codec, off = decode_uvarint(raw, off)
-            mh_code, off = decode_uvarint(raw, off)
-            mh_len, off = decode_uvarint(raw, off)
+            codec, off, m = decode_uvarint_min(raw, off)
+            minimal &= m
+            mh_code, off, m = decode_uvarint_min(raw, off)
+            minimal &= m
+            mh_len, off, m = decode_uvarint_min(raw, off)
+            minimal &= m
             digest = raw[off : off + mh_len]
             if len(digest) != mh_len:
                 raise ValueError("truncated CID multihash digest")
             if off + mh_len != len(raw):
                 raise ValueError("trailing bytes after CID")
-            return cls._make(version, codec, mh_code, digest)
+            # strict minimal varints: go-varint and rust unsigned-varint
+            # (the reference's CID stack) both reject non-minimal
+            # encodings, and tolerating them gives one logical CID two
+            # byte forms — the batch/scalar paths then disagree on raw
+            # spans vs re-encodes (found by the round-5 exec-order fuzz).
+            if not minimal:
+                raise ValueError("non-canonical CID byte encoding")
+            out = cls._make(version, codec, mh_code, digest)
+        # accepted ⇒ canonical encoding (minimal varints enforced above),
+        # so raw is always safe to memoize as the to_bytes value
         out.__dict__["_bytes"] = bytes(raw)
         return out
 
@@ -193,12 +202,10 @@ class CID:
             raise ValueError(f"unsupported multibase prefix {text[0]!r} (base32 only)")
         raw = _b32_decode_lower(text[1:])
         out = cls.from_bytes(raw)
-        # canonical-bytes check: from_bytes tolerates non-minimal varint
-        # prefixes (in-block tag-42 acceptance is governed by chain
-        # compatibility), but at the STRING boundary — where claims live —
-        # a non-minimal encoding would be a second string for the same CID.
-        # to_bytes() is the canonical re-encode (memoized from `raw` itself
-        # on the canonical fast paths, so this compare is cheap there).
+        # belt-and-braces canonical check: from_bytes itself rejects
+        # non-minimal varints, so any accepted decode re-encodes to `raw`;
+        # the compare is kept as defense in depth at the STRING boundary
+        # where claims live (memoized on the fast paths, so it is cheap).
         if out.to_bytes() != raw:
             raise ValueError(f"non-canonical CID byte encoding in {text!r}")
         return out

@@ -18,6 +18,16 @@ def encode_uvarint(value: int) -> bytes:
             return bytes(out)
 
 
+def decode_uvarint_min(data: bytes, offset: int = 0) -> tuple[int, int, bool]:
+    """``decode_uvarint`` plus a minimality flag: ``(value, new_offset,
+    minimal)``. A multi-byte varint whose final (most-significant) byte is
+    zero is a second encoding of the same value; go-varint and rust
+    unsigned-varint both reject it, and so do this package's CID decoders
+    (mirrors the C extensions' ``cid_uvarint_min``)."""
+    value, pos = decode_uvarint(data, offset)
+    return value, pos, pos - offset == 1 or data[pos - 1] != 0
+
+
 def decode_uvarint(data: bytes, offset: int = 0) -> tuple[int, int]:
     """Decode an unsigned LEB128 varint from ``data`` at ``offset``.
 

@@ -94,10 +94,10 @@ def split_tipset_key(raw: bytes) -> list[CID]:
         if end > n:
             raise ValueError("truncated CID in tipset key")
         cid = CID.from_bytes(raw[start:end])
-        # canonical-bytes only: a non-minimal varint prefix would be a
-        # SECOND wire form for the same certificate that still verifies
-        # (signing_payload is computed over canonical CIDs) — wire
-        # malleability at the trust boundary
+        # belt-and-braces: from_bytes itself rejects non-minimal varints,
+        # so any accepted decode re-encodes to the same bytes; the compare
+        # stays as defense in depth at this trust boundary (a second wire
+        # form here would be certificate malleability)
         if cid.to_bytes() != raw[start:end]:
             raise ValueError("non-canonical CID encoding in tipset key")
         out.append(cid)

@@ -48,7 +48,20 @@ class EventEntry:
     def from_tuple(cls, fields: list) -> "EventEntry":
         if not (isinstance(fields, list) and len(fields) == 4):
             raise ValueError("event entry must be a 4-tuple")
-        return cls(flags=fields[0], key=fields[1], codec=fields[2], value=fields[3])
+        # fvm_shared's Entry is {flags: u64, key: String, codec: u64,
+        # value: RawBytes}: every field's CBOR major must match or serde
+        # rejects the block. The native scanner's emit_event enforces the
+        # same four (rd_uint flags/codec, major-3 key, rd_bytes value).
+        flags, key, codec, value = fields
+        if not isinstance(flags, int) or isinstance(flags, bool) or flags < 0:
+            raise ValueError("event entry flags must be an unsigned int")
+        if not isinstance(key, str):
+            raise ValueError("event entry key must be text")
+        if not isinstance(codec, int) or isinstance(codec, bool) or codec < 0:
+            raise ValueError("event entry codec must be an unsigned int")
+        if not isinstance(value, bytes):
+            raise ValueError("event entry value must be bytes")
+        return cls(flags=flags, key=key, codec=codec, value=value)
 
     def to_tuple(self) -> list:
         return [self.flags, self.key, self.codec, self.value]
@@ -62,6 +75,8 @@ class ActorEvent:
 
     @classmethod
     def from_cbor(cls, value: list) -> "ActorEvent":
+        if not isinstance(value, list):
+            raise ValueError("ActorEvent entries must be an array")
         return cls(entries=[EventEntry.from_tuple(e) for e in value])
 
     def to_cbor(self) -> list:
@@ -79,7 +94,12 @@ class StampedEvent:
     def from_cbor(cls, value: list) -> "StampedEvent":
         if not (isinstance(value, list) and len(value) == 2):
             raise ValueError("StampedEvent must be a 2-tuple")
-        return cls(emitter=value[0], event=ActorEvent.from_cbor(value[1]))
+        emitter = value[0]
+        # ActorID is u64 (CBOR major 0): a text/bytes/negative emitter must
+        # reject exactly like the native scanner's rd_uint / serde's u64.
+        if not isinstance(emitter, int) or isinstance(emitter, bool) or emitter < 0:
+            raise ValueError("StampedEvent emitter must be an unsigned int")
+        return cls(emitter=emitter, event=ActorEvent.from_cbor(value[1]))
 
     def to_cbor(self) -> list:
         return [self.emitter, self.event.to_cbor()]

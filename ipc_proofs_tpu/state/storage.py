@@ -89,16 +89,25 @@ def read_storage_slot(
         and isinstance(obj[1], int)
     ):
         hamt = HAMT.load(store, obj[0], bit_width=obj[1])
-        return hamt.get(slot_key)
+        return _slot_bytes(hamt.get(slot_key))
 
     # B2) {"root": cid, "bitwidth": n} wrapper
     if isinstance(obj, dict) and isinstance(obj.get("root"), CID) and "bitwidth" in obj:
         hamt = HAMT.load(store, obj["root"], bit_width=obj["bitwidth"])
-        return hamt.get(slot_key)
+        return _slot_bytes(hamt.get(slot_key))
 
     # C) direct HAMT at the root, protocol default bit width
     hamt = HAMT.load(store, contract_state_root, bit_width=HAMT_BIT_WIDTH)
-    return hamt.get(slot_key)
+    return _slot_bytes(hamt.get(slot_key))
+
+
+def _slot_bytes(value) -> Optional[bytes]:
+    """A slot HAMT's values are byte buffers; the reference's typed HAMT
+    deserialize makes any other CBOR type a decode ERROR in the selected
+    arm (no further fallback), so reject rather than fall through."""
+    if value is not None and not isinstance(value, bytes):
+        raise ValueError("storage slot value must be bytes")
+    return value
 
 
 def classify_storage_root(obj) -> "tuple[str, object, int]":
@@ -141,11 +150,20 @@ def classify_storage_root(obj) -> "tuple[str, object, int]":
 
 def _small_map_shape(obj) -> bool:
     """SmallMap *shape* check — exactly `_small_map_lookup`'s acceptance,
-    key-independent (the cascade's matched/fall-through is type-driven)."""
+    key-independent (the cascade's matched/fall-through is type-driven).
+    Values must be CBOR bytes: the reference's SmallMap arm deserializes
+    values as byte buffers, so a text-valued map fails that arm and the
+    cascade falls through (round-5 soak find: a text value classified as
+    SmallMap leaked a TypeError out of the hex compare)."""
     if not (isinstance(obj, dict) and set(obj) == {"v"} and isinstance(obj["v"], list)):
         return False
     for pair in obj["v"]:
-        if not (isinstance(pair, list) and len(pair) == 2 and isinstance(pair[0], bytes)):
+        if not (
+            isinstance(pair, list)
+            and len(pair) == 2
+            and isinstance(pair[0], bytes)
+            and isinstance(pair[1], bytes)
+        ):
             return False
     return True
 

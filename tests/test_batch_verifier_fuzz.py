@@ -288,8 +288,12 @@ class TestAdversarialWitnessBytes:
             self._assert_agree(bundle.proofs, blocks)
 
 
-@pytest.mark.parametrize("seed", [0xF3, 0xBEEF, 2026])
+@pytest.mark.parametrize("seed", [0xF3, 0xBEEF, 2026, 106567516])
 def test_randomized_mutation_differential(seed):
+    # 106567516: round-5 soak find — a mutant whose event-entry value
+    # decoded as CBOR text crashed the scalar replay's hex compare
+    # (AttributeError) where the native scan rejects; StampedEvent.from_cbor
+    # now rejects non-bytes values / non-text keys / non-u64 emitters.
     rng = random.Random(seed)
     base = make_bundle(n_pairs=2)
     agree_raise = 0

@@ -124,12 +124,13 @@ class TestCodecParity:
             with pytest.raises(ValueError, match=msg):
                 PurePythonCID.from_bytes(raw)
 
-    def test_nonminimal_varint_bytes_tolerated_reencodes_canonical(self):
+    def test_nonminimal_varint_bytes_rejected_both_impls(self):
         c = CID.hash_of(b"payload")
         noncanon = b"\x01\xf1\x00\xa0\xe4\x02\x20" + c.digest
-        x = CID.from_bytes(noncanon)
-        assert x == c
-        assert x.to_bytes() == c.to_bytes()  # memo never stores non-canonical
+        with pytest.raises(ValueError, match="non-canonical"):
+            CID.from_bytes(noncanon)
+        with pytest.raises(ValueError, match="non-canonical"):
+            PurePythonCID.from_bytes(noncanon)
 
     def test_big_identity_cid_roundtrip(self):
         big = CID(1, DAG_CBOR, IDENTITY, bytes(range(256)) + b"x" * 100)
