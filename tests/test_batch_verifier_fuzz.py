@@ -288,6 +288,36 @@ class TestAdversarialWitnessBytes:
             self._assert_agree(bundle.proofs, blocks)
 
 
+@pytest.mark.parametrize("seed", [0xD1CE, 77310])
+def test_shape_varied_mutation_differential(seed):
+    """Same mutation machinery over base worlds of VARIED shape (pair
+    count, claim encoding) — the fixed-shape differential below only ever
+    explores one base world's acceptance territory. In-suite slice of the
+    round-5 shape-varied soak (2,000 worlds x 120 mutants, clean)."""
+    rng = random.Random(seed)
+    agree_raise = agree_ok = 0
+    for _ in range(4):
+        base = make_bundle(
+            n_pairs=rng.choice([1, 2, 3, 4]),
+            encoding=rng.choice(["compact", "concat"]),
+        )
+        for _ in range(30):
+            proofs, blocks = _mutate_bundle(rng, base.proofs, base.blocks)
+            if rng.random() < 0.3:
+                proofs, blocks = _mutate_bundle(rng, proofs, blocks)
+            mutated = EventProofBundle(proofs=proofs, blocks=blocks)
+            scalar = _outcome(mutated, batch=False)
+            batch = _outcome(mutated, batch=True)
+            assert _comparable(scalar) == _comparable(batch), (
+                f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
+            )
+            if scalar[0] == "raise":
+                agree_raise += 1
+            else:
+                agree_ok += 1
+    assert agree_raise and agree_ok  # the sweep exercised both regimes
+
+
 @pytest.mark.parametrize("seed", [0xF3, 0xBEEF, 2026, 106567516])
 def test_randomized_mutation_differential(seed):
     # 106567516: round-5 soak find — a mutant whose event-entry value

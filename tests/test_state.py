@@ -162,6 +162,17 @@ class TestHeader:
             for _ in range(rng.randint(1, 3)):
                 mutated[rng.randrange(len(mutated))] = rng.randrange(256)
             cases.append(bytes(mutated))
+        for _ in range(400):  # insert/delete mutations shift every later field
+            mutated = bytearray(raw)
+            for _ in range(rng.randint(1, 4)):
+                k = rng.randrange(3)
+                if k == 0:
+                    mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+                elif k == 1 and len(mutated) > 1:
+                    del mutated[rng.randrange(len(mutated))]
+                else:
+                    mutated.insert(rng.randrange(len(mutated) + 1), rng.randrange(256))
+            cases.append(bytes(mutated))
         # structurally interesting: non-list, short list, bad utf-8 text,
         # non-string map key, f16, bad CID bytes in a tag
         cases.append(cbor_encode({"a": 1}))

@@ -231,6 +231,37 @@ class TestMalformedTreeNodes:
             list(amt.items())
 
 
+@pytest.mark.parametrize("seed", [0x5A5A, 88230])
+def test_shape_varied_storage_mutation_differential(seed):
+    """Same mutation machinery over base worlds of VARIED shape (storage
+    encoding mix, slot count) — in-suite slice of the round-5 shape-varied
+    soak (2,000 worlds x 120 mutants, clean)."""
+    _native_or_skip()
+    rng = random.Random(seed)
+    encs = ["direct", "wrapper_tuple", "wrapper_map", "inline"]
+    agree_raise = agree_ok = 0
+    for _ in range(3):
+        base = make_storage_bundle(
+            encodings=tuple(rng.choice(encs) for _ in range(rng.randrange(1, 5))),
+            n_slots=rng.choice([1, 2, 3, 5]),
+        )
+        base_proofs, base_blocks = base.storage_proofs, base.blocks
+        for _ in range(30):
+            proofs, blocks = _mutate(rng, base_proofs, base_blocks)
+            if rng.random() < 0.3:
+                proofs, blocks = _mutate(rng, proofs, blocks)
+            scalar = _outcome(proofs, blocks, batch=False)
+            batch = _outcome(proofs, blocks, batch=True)
+            assert _comparable(scalar) == _comparable(batch), (
+                f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
+            )
+            if scalar[0] == "raise":
+                agree_raise += 1
+            else:
+                agree_ok += 1
+    assert agree_raise and agree_ok  # the sweep exercised both regimes
+
+
 @pytest.mark.parametrize("seed", [7, 0xA17, 424242, 102662185])
 def test_randomized_storage_mutation_differential(seed):
     # 102662185: round-5 soak find — a SmallMap mutant whose value decoded
