@@ -288,6 +288,28 @@ class TestAdversarialWitnessBytes:
             self._assert_agree(bundle.proofs, blocks)
 
 
+def _run_differential(rng, seed, base, rounds):
+    """Shared mutate-and-compare loop for the fixed-shape and shape-varied
+    differentials: mutate (occasionally twice), run both verify paths,
+    assert outcome parity. Returns (agree_raise, agree_ok) tallies."""
+    agree_raise = agree_ok = 0
+    for _ in range(rounds):
+        proofs, blocks = _mutate_bundle(rng, base.proofs, base.blocks)
+        if rng.random() < 0.3:
+            proofs, blocks = _mutate_bundle(rng, proofs, blocks)
+        mutated = EventProofBundle(proofs=proofs, blocks=blocks)
+        scalar = _outcome(mutated, batch=False)
+        batch = _outcome(mutated, batch=True)
+        assert _comparable(scalar) == _comparable(batch), (
+            f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
+        )
+        if scalar[0] == "raise":
+            agree_raise += 1
+        else:
+            agree_ok += 1
+    return agree_raise, agree_ok
+
+
 @pytest.mark.parametrize("seed", [0xD1CE, 77310])
 def test_shape_varied_mutation_differential(seed):
     """Same mutation machinery over base worlds of VARIED shape (pair
@@ -301,20 +323,9 @@ def test_shape_varied_mutation_differential(seed):
             n_pairs=rng.choice([1, 2, 3, 4]),
             encoding=rng.choice(["compact", "concat"]),
         )
-        for _ in range(30):
-            proofs, blocks = _mutate_bundle(rng, base.proofs, base.blocks)
-            if rng.random() < 0.3:
-                proofs, blocks = _mutate_bundle(rng, proofs, blocks)
-            mutated = EventProofBundle(proofs=proofs, blocks=blocks)
-            scalar = _outcome(mutated, batch=False)
-            batch = _outcome(mutated, batch=True)
-            assert _comparable(scalar) == _comparable(batch), (
-                f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
-            )
-            if scalar[0] == "raise":
-                agree_raise += 1
-            else:
-                agree_ok += 1
+        r, o = _run_differential(rng, seed, base, 30)
+        agree_raise += r
+        agree_ok += o
     assert agree_raise and agree_ok  # the sweep exercised both regimes
 
 
@@ -325,20 +336,6 @@ def test_randomized_mutation_differential(seed):
     # (AttributeError) where the native scan rejects; StampedEvent.from_cbor
     # now rejects non-bytes values / non-text keys / non-u64 emitters.
     rng = random.Random(seed)
-    base = make_bundle(n_pairs=2)
-    agree_raise = 0
-    for _ in range(150):
-        proofs, blocks = _mutate_bundle(rng, base.proofs, base.blocks)
-        # occasionally stack a second structural mutation
-        if rng.random() < 0.3:
-            proofs, blocks = _mutate_bundle(rng, proofs, blocks)
-        mutated = EventProofBundle(proofs=proofs, blocks=blocks)
-        scalar = _outcome(mutated, batch=False)
-        batch = _outcome(mutated, batch=True)
-        assert _comparable(scalar) == _comparable(batch), (
-            f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
-        )
-        if scalar[0] == "raise":
-            agree_raise += 1
+    agree_raise, agree_ok = _run_differential(rng, seed, make_bundle(n_pairs=2), 150)
     # sanity: the sweep actually exercised both regimes
-    assert 0 < agree_raise < 150
+    assert agree_raise and agree_ok

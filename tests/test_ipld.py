@@ -265,3 +265,59 @@ class TestHamtBatchLookup:
         root = hamt_build(bs, {b"a": b"1"})
         with pytest.raises(ValueError):
             hamt_get_batch(bs, [root], [3], [b"a"])
+
+
+class TestRandomShapeEquivalence:
+    """Seeded random tree shapes — in-suite slice of the round-5 soak
+    (10k HAMTs + 10k AMTs, clean): writer -> reader round-trips, and the
+    C batch HAMT walker agrees with the scalar reader on every key."""
+
+    @pytest.mark.parametrize("seed", [0x7EE5, 901144])
+    def test_random_hamts_batch_equals_scalar(self, seed):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.ipld.hamt import hamt_get_batch
+
+        ext = load_scan_ext()
+        if ext is None or not hasattr(ext, "hamt_lookup_batch"):
+            pytest.skip("native hamt_lookup_batch unavailable")
+        rng = random.Random(seed)
+        for _ in range(40):
+            bw = rng.choice([2, 3, 4, 5, 6, 8])
+            kv = {
+                rng.randbytes(rng.randrange(1, 40)): rng.randbytes(rng.randrange(0, 40))
+                for _ in range(rng.randrange(1, 120))
+            }
+            bs = MemoryBlockstore()
+            root = hamt_build(bs, kv, bit_width=bw)
+            h = HAMT.load(bs, root, bit_width=bw)
+            keys = list(kv) + [rng.randbytes(8) for _ in range(10)]
+            rng.shuffle(keys)
+            out = hamt_get_batch(bs, [root], [0] * len(keys), keys, bit_width=bw)
+            assert out is not None
+            for k, v in zip(keys, out):
+                assert h.get(k) == v, (bw, k.hex())
+            assert dict(h.items()) == kv
+
+    @pytest.mark.parametrize("seed", [0xA321, 550901])
+    def test_random_amts_roundtrip(self, seed):
+        rng = random.Random(seed)
+        for _ in range(40):
+            v0 = rng.random() < 0.5
+            bw = 3 if v0 else rng.choice([1, 2, 3, 4, 5, 8])
+            hi = rng.choice([50, 1000, 100000])
+            entries = {
+                rng.randrange(hi): rng.randbytes(rng.randrange(0, 30))
+                for _ in range(rng.randrange(0, 150))
+            }
+            bs = MemoryBlockstore()
+            if v0:
+                root = amt_build_v0(bs, entries)
+                a = AMT.load(bs, root, expected_version=0)
+            else:
+                root = amt_build(bs, entries, bit_width=bw)
+                a = AMT.load(bs, root, expected_version=3)
+            got = {}
+            a.for_each(lambda i, v: got.__setitem__(i, v))
+            assert got == entries
+            for probe in list(entries)[:10] + [rng.randrange(hi) for _ in range(5)]:
+                assert a.get(probe) == entries.get(probe)

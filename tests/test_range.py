@@ -324,3 +324,39 @@ class TestDriverEquivalenceAcrossAmtShapes:
             fused, TrustPolicy.accept_all(), verify_witness_cids=True
         )
         assert result.all_valid()
+
+    @pytest.mark.parametrize("seed", [0xAB5, 300271])
+    def test_random_worlds_bit_identical(self, seed, monkeypatch):
+        """Seeded random world shapes and chunkings — in-suite slice of the
+        round-5 range-driver soak (500 random worlds, clean)."""
+        import random
+
+        from ipc_proofs_tpu.fixtures import build_range_world
+        from ipc_proofs_tpu.proofs.range import (
+            generate_event_proofs_for_range_pipelined,
+        )
+
+        rng = random.Random(seed)
+        for _ in range(5):
+            bs, pairs, n_match = build_range_world(
+                rng.choice([1, 3, 7, 16]),
+                rng.choice([1, 4, 16]),
+                rng.choice([1, 2, 5]),
+                rng.choice([0.0, 0.05, 0.3]),
+                signature=SIG,
+                topic1=SUBNET,
+                actor_id=ACTOR,
+            )
+            spec = EventProofSpec(
+                event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR
+            )
+            monkeypatch.setenv("IPC_SCAN_FUSED_MATCH", "1")
+            flat = generate_event_proofs_for_range(bs, pairs, spec)
+            monkeypatch.setenv("IPC_SCAN_FUSED_MATCH", "0")
+            unfused = generate_event_proofs_for_range(bs, pairs, spec)
+            monkeypatch.setenv("IPC_SCAN_FUSED_MATCH", "1")
+            piped = generate_event_proofs_for_range_pipelined(
+                bs, pairs, spec, chunk_size=rng.choice([1, 2, 5, 64])
+            )
+            assert flat.to_json() == unfused.to_json() == piped.to_json()
+            assert len(flat.event_proofs) == n_match

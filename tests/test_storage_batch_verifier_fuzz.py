@@ -231,6 +231,27 @@ class TestMalformedTreeNodes:
             list(amt.items())
 
 
+def _run_differential(rng, seed, base_proofs, base_blocks, rounds):
+    """Shared mutate-and-compare loop for the fixed-shape and shape-varied
+    differentials: mutate (occasionally twice), run both verify paths,
+    assert outcome parity. Returns (agree_raise, agree_ok) tallies."""
+    agree_raise = agree_ok = 0
+    for _ in range(rounds):
+        proofs, blocks = _mutate(rng, base_proofs, base_blocks)
+        if rng.random() < 0.3:
+            proofs, blocks = _mutate(rng, proofs, blocks)
+        scalar = _outcome(proofs, blocks, batch=False)
+        batch = _outcome(proofs, blocks, batch=True)
+        assert _comparable(scalar) == _comparable(batch), (
+            f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
+        )
+        if scalar[0] == "raise":
+            agree_raise += 1
+        else:
+            agree_ok += 1
+    return agree_raise, agree_ok
+
+
 @pytest.mark.parametrize("seed", [0x5A5A, 88230])
 def test_shape_varied_storage_mutation_differential(seed):
     """Same mutation machinery over base worlds of VARIED shape (storage
@@ -245,20 +266,9 @@ def test_shape_varied_storage_mutation_differential(seed):
             encodings=tuple(rng.choice(encs) for _ in range(rng.randrange(1, 5))),
             n_slots=rng.choice([1, 2, 3, 5]),
         )
-        base_proofs, base_blocks = base.storage_proofs, base.blocks
-        for _ in range(30):
-            proofs, blocks = _mutate(rng, base_proofs, base_blocks)
-            if rng.random() < 0.3:
-                proofs, blocks = _mutate(rng, proofs, blocks)
-            scalar = _outcome(proofs, blocks, batch=False)
-            batch = _outcome(proofs, blocks, batch=True)
-            assert _comparable(scalar) == _comparable(batch), (
-                f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
-            )
-            if scalar[0] == "raise":
-                agree_raise += 1
-            else:
-                agree_ok += 1
+        r, o = _run_differential(rng, seed, base.storage_proofs, base.blocks, 30)
+        agree_raise += r
+        agree_ok += o
     assert agree_raise and agree_ok  # the sweep exercised both regimes
 
 
@@ -271,17 +281,7 @@ def test_randomized_storage_mutation_differential(seed):
     _native_or_skip()
     rng = random.Random(seed)
     base = make_storage_bundle(encodings=("direct", "inline", "wrapper_tuple"))
-    base_proofs, base_blocks = base.storage_proofs, base.blocks
-    disagree_free_raises = 0
-    for _ in range(120):
-        proofs, blocks = _mutate(rng, base_proofs, base_blocks)
-        if rng.random() < 0.3:
-            proofs, blocks = _mutate(rng, proofs, blocks)
-        scalar = _outcome(proofs, blocks, batch=False)
-        batch = _outcome(proofs, blocks, batch=True)
-        assert _comparable(scalar) == _comparable(batch), (
-            f"divergence under seed={seed}: scalar={scalar!r} batch={batch!r}"
-        )
-        if scalar[0] == "raise":
-            disagree_free_raises += 1
-    assert 0 < disagree_free_raises < 120  # both regimes exercised
+    agree_raise, agree_ok = _run_differential(
+        rng, seed, base.storage_proofs, base.blocks, 120
+    )
+    assert agree_raise and agree_ok  # both regimes exercised
