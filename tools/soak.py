@@ -131,6 +131,7 @@ def phase_cert(rng, quick):
             continue
         accepted += 1
         assert certificate_to_cbor(cert) == raw, raw.hex()
+    assert accepted and rejected  # both regimes exercised, no vacuous pass
     log(f"cert cbor mutants: {n}, {accepted} accepted all canonical, {rejected} rejected")
 
 
@@ -252,9 +253,12 @@ def phase_range(rng, quick):
     from ipc_proofs_tpu.fixtures import build_range_world
     from ipc_proofs_tpu.proofs.generator import EventProofSpec
     from ipc_proofs_tpu.proofs.range import (
+        generate_and_verify_range_overlapped,
         generate_event_proofs_for_range,
         generate_event_proofs_for_range_pipelined,
     )
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
 
     SIG, SUBNET, ACTOR = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1", 1001
     n = 20 if quick else 500
@@ -269,26 +273,53 @@ def phase_range(rng, quick):
             actor_id=ACTOR,
         )
         spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+        # half the worlds also prove a storage slot grid at every pair
+        # (mixed range bundles exercise the batched storage generator)
+        storage_specs = None
+        if rng.random() < 0.5:
+            from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
+
+            storage_specs = [
+                MappingSlotSpec(actor_id=ACTOR, key=SUBNET, slot_index=0),
+                MappingSlotSpec(actor_id=ACTOR, key="absent-subnet", slot_index=0),
+            ]
         prior = os.environ.get("IPC_SCAN_FUSED_MATCH")
         try:
             os.environ["IPC_SCAN_FUSED_MATCH"] = "1"
-            flat = generate_event_proofs_for_range(bs, pairs, spec)
+            flat = generate_event_proofs_for_range(
+                bs, pairs, spec, storage_specs=storage_specs
+            )
             os.environ["IPC_SCAN_FUSED_MATCH"] = "0"
-            unfused = generate_event_proofs_for_range(bs, pairs, spec)
+            unfused = generate_event_proofs_for_range(
+                bs, pairs, spec, storage_specs=storage_specs
+            )
         finally:
             if prior is None:
                 del os.environ["IPC_SCAN_FUSED_MATCH"]
             else:
                 os.environ["IPC_SCAN_FUSED_MATCH"] = prior
         piped = generate_event_proofs_for_range_pipelined(
-            bs, pairs, spec, chunk_size=rng.choice([1, 2, 5, 64])
+            bs, pairs, spec, chunk_size=rng.choice([1, 2, 5, 64]),
+            storage_specs=storage_specs,
+        )
+        overlapped, chunk_results = generate_and_verify_range_overlapped(
+            bs,
+            pairs,
+            spec,
+            chunk_size=rng.choice([1, 2, 5, 64]),
+            verify_chunk=lambda bundle: verify_proof_bundle(
+                bundle, TrustPolicy.accept_all(), verify_witness_cids=True
+            ),
+            storage_specs=storage_specs,
         )
         ref = flat.to_json()
         assert unfused.to_json() == ref, f"unfused diverged, world {w}"
         assert piped.to_json() == ref, f"pipelined diverged, world {w}"
+        assert overlapped.to_json() == ref, f"overlapped diverged, world {w}"
+        assert all(r.all_valid() for r in chunk_results), f"verify failed, world {w}"
         assert len(flat.event_proofs) == n_match, f"count mismatch, world {w}"
         if (w + 1) % max(1, n // 4) == 0:
-            log(f"range drivers: {w+1}/{n} random worlds bit-identical")
+            log(f"range drivers: {w+1}/{n} random worlds bit-identical + verified")
 
 
 def phase_json(rng, quick):
