@@ -475,11 +475,17 @@ typedef struct {
   Py_ssize_t klen;
   const uint8_t *val;
   Py_ssize_t vlen; /* -2 = value is not bytes (lazily errors, dict parity) */
+  PyObject *kobj;  /* strong refs (persistent snapshots only): a put_keyed
+                    * overwrite swaps in a NEW equal-content bytes object
+                    * and drops the old one — borrowed val pointers would
+                    * dangle across calls. Transient builds leave NULL. */
+  PyObject *vobj;
 } CEntry;
 
 typedef struct CMap {
   CEntry *slots;
   size_t mask; /* capacity - 1, capacity a power of two */
+  int strong;  /* 1 = entries hold kobj/vobj references (persistent) */
 } CMap;
 
 static uint64_t cmap_hash(const uint8_t *d, Py_ssize_t n) {
@@ -498,13 +504,14 @@ static uint64_t cmap_hash(const uint8_t *d, Py_ssize_t n) {
   return h;
 }
 
-static int cmap_build(CMap *m, PyObject *dict) {
+static int cmap_build(CMap *m, PyObject *dict, int strong) {
   Py_ssize_t n = PyDict_Size(dict);
   size_t cap = 16;
   while (cap < (size_t)n * 2 + 1) cap <<= 1;
   m->slots = calloc(cap, sizeof(CEntry));
   if (!m->slots) return walk_err(E_MEM, "out of memory");
   m->mask = cap - 1;
+  m->strong = strong;
   PyObject *k, *v;
   Py_ssize_t pos = 0;
   while (PyDict_Next(dict, &pos, &k, &v)) {
@@ -520,6 +527,15 @@ static int cmap_build(CMap *m, PyObject *dict) {
       e.val = NULL;
       e.vlen = -2;
     }
+    if (strong) {
+      Py_INCREF(k);
+      Py_INCREF(v);
+      e.kobj = k;
+      e.vobj = v;
+    } else {
+      e.kobj = NULL;
+      e.vobj = NULL;
+    }
     size_t i = cmap_hash(e.key, e.klen) & m->mask;
     while (m->slots[i].key) i = (i + 1) & m->mask;
     m->slots[i] = e;
@@ -528,6 +544,12 @@ static int cmap_build(CMap *m, PyObject *dict) {
 }
 
 static void cmap_free(CMap *m) {
+  if (m->slots && m->strong) {
+    for (size_t i = 0; i <= m->mask; i++) {
+      Py_XDECREF(m->slots[i].kobj);
+      Py_XDECREF(m->slots[i].vobj);
+    }
+  }
   free(m->slots);
   m->slots = NULL;
 }
@@ -541,6 +563,124 @@ static const CEntry *cmap_get(const CMap *m, const uint8_t *key,
     i = (i + 1) & m->mask;
   }
   return NULL;
+}
+
+/* ---------------- persistent snapshot (BlockSnapshot) ----------------
+ *
+ * cmap_build is O(|dict|) — at range scale (~100k blocks) it costs about
+ * as much as the probe savings it buys, paid again by EVERY native call.
+ * A BlockSnapshot makes the table a first-class Python object the driver
+ * builds once per store and passes to every walker: content-addressed
+ * stores only ever ADD blocks, so a cached snapshot's hits stay valid
+ * forever (entries hold strong refs — see CEntry.kobj) and misses fall
+ * through to the live dict probe in get_block. Wrappers rebuild on any
+ * dict-size change; the multi-thread arm additionally requires the
+ * snapshot to be complete (size equal) since jobs cannot touch the dict. */
+
+typedef struct {
+  PyObject_HEAD
+  PyObject *dict;   /* the snapshotted block dict (strong) */
+  CMap map;         /* strong entries */
+  Py_ssize_t built; /* PyDict_Size at build time (freshness stamp) */
+} SnapshotObj;
+
+static PyTypeObject Snapshot_Type; /* fwd */
+
+static void snapshot_dealloc(SnapshotObj *o) {
+  PyObject_GC_UnTrack(o);
+  cmap_free(&o->map);
+  Py_XDECREF(o->dict);
+  PyObject_GC_Del(o);
+}
+
+static int snapshot_traverse(SnapshotObj *o, visitproc visit, void *arg) {
+  Py_VISIT(o->dict);
+  /* the map's strong entries are real references (a non-bytes value or an
+   * overwritten one may be held ONLY here) — invisible refs would make
+   * cycles through them uncollectable */
+  if (o->map.slots && o->map.strong) {
+    for (size_t i = 0; i <= o->map.mask; i++) {
+      Py_VISIT(o->map.slots[i].kobj);
+      Py_VISIT(o->map.slots[i].vobj);
+    }
+  }
+  return 0;
+}
+
+static int snapshot_clear_(SnapshotObj *o) {
+  Py_CLEAR(o->dict);
+  cmap_free(&o->map);
+  return 0;
+}
+
+static PyObject *snapshot_get_n_blocks(SnapshotObj *o, void *c) {
+  (void)c;
+  return PyLong_FromSsize_t(o->built);
+}
+
+static PyGetSetDef snapshot_getset[] = {
+    {"n_blocks", (getter)snapshot_get_n_blocks, NULL,
+     "dict size at build time (freshness stamp)", NULL},
+    {NULL, NULL, NULL, NULL, NULL}};
+
+static PyTypeObject Snapshot_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "ipc_scan_ext.BlockSnapshot",
+    .tp_basicsize = sizeof(SnapshotObj),
+    .tp_dealloc = (destructor)snapshot_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)snapshot_traverse,
+    .tp_clear = (inquiry)snapshot_clear_,
+    .tp_getset = snapshot_getset,
+    .tp_doc = "GIL-free block-map snapshot reusable across native walks",
+};
+
+static PyObject *py_make_snapshot(PyObject *self, PyObject *arg) {
+  (void)self;
+  if (!PyDict_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "make_snapshot expects a dict");
+    return NULL;
+  }
+  SnapshotObj *o = PyObject_GC_New(SnapshotObj, &Snapshot_Type);
+  if (!o) return NULL;
+  o->dict = NULL;
+  o->map.slots = NULL;
+  o->map.mask = 0;
+  o->map.strong = 0;
+  o->built = 0;
+  t_err.kind = E_NONE;
+  if (cmap_build(&o->map, arg, 1) < 0) {
+    PyObject_GC_Del(o);
+    raise_walk_err();
+    return NULL;
+  }
+  Py_INCREF(arg);
+  o->dict = arg;
+  o->built = PyDict_Size(arg);
+  PyObject_GC_Track(o);
+  return (PyObject *)o;
+}
+
+/* Resolve an optional snapshot= argument against the call's block dict.
+ * Returns 0 on success (out/out_complete set; both NULL/0 when snapshot is
+ * None), -1 with an exception for type or dict-identity misuse. */
+static int snapshot_resolve(PyObject *snap_obj, PyObject *blocks,
+                            const CMap **out, int *out_complete) {
+  *out = NULL;
+  *out_complete = 0;
+  if (!snap_obj || snap_obj == Py_None) return 0;
+  if (!PyObject_TypeCheck(snap_obj, &Snapshot_Type)) {
+    PyErr_SetString(PyExc_TypeError, "snapshot must be a BlockSnapshot");
+    return -1;
+  }
+  SnapshotObj *sn = (SnapshotObj *)snap_obj;
+  if (sn->dict != blocks) {
+    PyErr_SetString(PyExc_ValueError,
+                    "snapshot was built over a different block dict");
+    return -1;
+  }
+  *out = &sn->map;
+  *out_complete = PyDict_Size(blocks) == sn->built;
+  return 0;
 }
 
 /* a fetched block: data/len always valid on success; obj non-NULL iff a
@@ -573,16 +713,23 @@ static int get_block(Scan *s, const uint8_t *cid, Py_ssize_t clen,
   if (record_touch(s, cid, clen) < 0) return -1;
   if (s->cmap) { /* GIL-free path */
     const CEntry *e = cmap_get(s->cmap, cid, clen);
-    if (!e) {
+    if (e) {
+      if (e->vlen == -2)
+        return walk_err(E_TYPE, "block map values must be bytes");
+      out->data = e->val;
+      out->len = e->vlen;
+      if (s->validate && validate_block(out->data, out->len) < 0) return -1;
+      return 1;
+    }
+    /* miss: with the live dict in reach (GIL-held single-thread paths) fall
+     * through to the dict probe — a persistent snapshot may be stale (the
+     * content-addressed store only ever ADDS blocks, so hits above are
+     * always valid and only new blocks can be missed). Threaded jobs have
+     * s->blocks == NULL and keep the terminal miss semantics. */
+    if (!s->blocks) {
       if (s->skip_missing) return 0;
       return walk_err(E_KEY, "missing block");
     }
-    if (e->vlen == -2)
-      return walk_err(E_TYPE, "block map values must be bytes");
-    out->data = e->val;
-    out->len = e->vlen;
-    if (s->validate && validate_block(out->data, out->len) < 0) return -1;
-    return 1;
   }
   PyObject *key = PyBytes_FromStringAndSize((const char *)cid, clen);
   if (!key) return -1;
@@ -1217,6 +1364,70 @@ static int scan_threads_default(void) {
   return t > 8 ? 8 : t;
 }
 
+/* Fan the roots out over `threads` pthread jobs probing `map` (a complete
+ * snapshot — jobs never touch the Python dict), then merge chunk outputs
+ * into `s` in job order (first error in root order wins, identical to the
+ * sequential walk). Shared by the transient-build and provided-snapshot
+ * arms of py_scan_events_batch. Returns 0, or -1 with an exception set. */
+static int scan_fanout(Scan *s, const uint8_t **cids, const Py_ssize_t *lens,
+                       Py_ssize_t n_roots, int threads, const CMap *map) {
+  ScanJob *jobs = calloc(threads, sizeof(ScanJob));
+  pthread_t *tids = malloc(sizeof(pthread_t) * threads);
+  if (!jobs || !tids) {
+    free(jobs);
+    free(tids);
+    PyErr_NoMemory();
+    return -1;
+  }
+  Py_ssize_t chunk = (n_roots + threads - 1) / threads;
+  int started = 0;
+  for (int t = 0; t < threads; t++) {
+    /* s's output vecs are still empty here, so a struct copy hands each
+     * worker the config (skip_missing/want_payload) with zeroed outputs */
+    jobs[t].s = *s;
+    jobs[t].s.blocks = NULL;
+    jobs[t].s.fallback = NULL;
+    jobs[t].s.cmap = map;
+    jobs[t].cids = cids;
+    jobs[t].lens = lens;
+    jobs[t].lo = t * chunk;
+    jobs[t].hi = (t + 1) * chunk < n_roots ? (t + 1) * chunk : n_roots;
+    if (jobs[t].lo >= jobs[t].hi) break;
+    started++;
+  }
+  Py_BEGIN_ALLOW_THREADS;
+  for (int t = 0; t < started; t++)
+    if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
+      /* run inline if a thread can't spawn — correctness over speed */
+      scan_job_run(&jobs[t]);
+      tids[t] = 0;
+    }
+  for (int t = 0; t < started; t++)
+    if (tids[t]) pthread_join(tids[t], NULL);
+  Py_END_ALLOW_THREADS;
+
+  int rc = 0;
+  int err_at = -1;
+  for (int t = 0; t < started; t++)
+    if (jobs[t].err.kind != E_NONE && err_at < 0) err_at = t;
+  if (err_at >= 0) {
+    raise_err(&jobs[err_at].err);
+    rc = -1;
+  } else {
+    int merge_rc = 0;
+    for (int t = 0; t < started && merge_rc == 0; t++)
+      merge_rc = scan_merge(s, &jobs[t].s);
+    if (merge_rc < 0) {
+      raise_walk_err();
+      rc = -1;
+    }
+  }
+  for (int t = 0; t < started; t++) scan_free(&jobs[t].s);
+  free(jobs);
+  free(tids);
+  return rc;
+}
+
 static PyObject *scan_result_dict(Scan *s) {
   if (s->match_mode)
     return Py_BuildValue(
@@ -1248,15 +1459,20 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *fallback = Py_None;
   PyObject *match_fp_obj = Py_None, *match_actor_obj = Py_None;
+  PyObject *snap_obj = Py_None;
   int skip_missing = 0, want_payload = 0, validate_blocks = 0;
   static char *kwlist[] = {"blocks", "roots", "fallback", "skip_missing",
                            "want_payload", "match_fp", "match_actor",
-                           "validate_blocks", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|OppOOp", kwlist,
+                           "validate_blocks", "snapshot", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|OppOOpO", kwlist,
                                    &PyDict_Type, &blocks, &roots, &fallback,
                                    &skip_missing, &want_payload,
                                    &match_fp_obj, &match_actor_obj,
-                                   &validate_blocks))
+                                   &validate_blocks, &snap_obj))
+    return NULL;
+  const CMap *snap_map = NULL;
+  int snap_complete = 0;
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
     return NULL;
   PyObject *seq = PySequence_Fast(roots, "roots must be a sequence of cid bytes");
   if (!seq) return NULL;
@@ -1331,19 +1547,46 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
   int threads = scan_threads_default();
   const char *no_snap = getenv("IPC_SCAN_NO_SNAPSHOT"); /* test/debug knob:
       force the Python-dict sequential walk to keep a true differential
-      reference for the snapshot path */
+      reference for the snapshot path (disables provided snapshots too) */
+  if (no_snap && no_snap[0] == '1') snap_map = NULL;
   /* cmap_build is O(|dict|); without parallelism it only pays when the
    * scan touches a meaningful fraction of the store (a range scan touches
    * ~25 blocks per root), so the SINGLE-THREAD arm keeps the per-probe
    * dict walk for a huge dict with a tiny scan. The multi-thread arm
-   * always snapshots — it needs the GIL-free table regardless of ratio. */
+   * always snapshots — it needs the GIL-free table regardless of ratio.
+   * A PROVIDED persistent snapshot skips the build entirely: single-chunk
+   * use is unconditional (misses fall through to the dict probe, so
+   * staleness and fallback callables are safe); the threaded arm uses it
+   * only when complete and fallback-free, else builds transient. */
   int snapshot_pays =
       n_roots >= 64 && PyDict_Size(blocks) / n_roots <= 256;
+  int want_threads = threads > 1 && n_roots >= 2 * threads && n_roots >= 64 &&
+                     (fallback == NULL || fallback == Py_None);
+  if (snap_map && !(want_threads && !snap_complete)) {
+    if (threads > (int)(n_roots / 32) && n_roots / 32 >= 2)
+      threads = (int)(n_roots / 32);
+    if (!want_threads || threads <= 1) {
+      /* single chunk over the provided snapshot, GIL HELD — misses fall
+       * through to the dict probe in get_block, so staleness and fallback
+       * callables are both safe here */
+      s.cmap = snap_map;
+      int rc_scan = scan_roots_range(&s, cids, lens, 0, n_roots);
+      s.cmap = NULL;
+      if (rc_scan < 0) {
+        raise_walk_err();
+        goto fail;
+      }
+      goto done_scan;
+    }
+    if (scan_fanout(&s, cids, lens, n_roots, threads, snap_map) < 0)
+      goto fail;
+    goto done_scan;
+  }
   if ((fallback == NULL || fallback == Py_None) &&
       (snapshot_pays || (threads > 1 && n_roots >= 2 * threads && n_roots >= 64)) &&
       !(no_snap && no_snap[0] == '1')) {
     CMap cmap = {0};
-    if (cmap_build(&cmap, blocks) < 0) {
+    if (cmap_build(&cmap, blocks, 0) < 0) {
       raise_walk_err();
       goto fail;
     }
@@ -1366,68 +1609,9 @@ static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
       }
       goto done_scan;
     }
-    ScanJob *jobs = calloc(threads, sizeof(ScanJob));
-    pthread_t *tids = malloc(sizeof(pthread_t) * threads);
-    if (!jobs || !tids) {
-      free(jobs);
-      free(tids);
-      cmap_free(&cmap);
-      PyErr_NoMemory();
-      goto fail;
-    }
-    Py_ssize_t chunk = (n_roots + threads - 1) / threads;
-    int started = 0;
-    for (int t = 0; t < threads; t++) {
-      /* s's output vecs are still empty here, so a struct copy hands each
-       * worker the config (skip_missing/want_payload) with zeroed outputs */
-      jobs[t].s = s;
-      jobs[t].s.blocks = NULL;
-      jobs[t].s.fallback = NULL;
-      jobs[t].s.cmap = &cmap;
-      jobs[t].cids = cids;
-      jobs[t].lens = lens;
-      jobs[t].lo = t * chunk;
-      jobs[t].hi = (t + 1) * chunk < n_roots ? (t + 1) * chunk : n_roots;
-      if (jobs[t].lo >= jobs[t].hi) break;
-      started++;
-    }
-    int spawn_failed = 0;
-    Py_BEGIN_ALLOW_THREADS;
-    for (int t = 0; t < started; t++)
-      if (pthread_create(&tids[t], NULL, scan_job_run, &jobs[t]) != 0) {
-        /* run inline if a thread can't spawn — correctness over speed */
-        scan_job_run(&jobs[t]);
-        tids[t] = 0;
-        spawn_failed++;
-      }
-    for (int t = 0; t < started; t++)
-      if (tids[t]) pthread_join(tids[t], NULL);
-    Py_END_ALLOW_THREADS;
-    (void)spawn_failed;
+    int fanout_rc = scan_fanout(&s, cids, lens, n_roots, threads, &cmap);
     cmap_free(&cmap);
-
-    /* first error in root order wins (identical to the sequential walk:
-     * earlier roots' output exists, later error aborts everything) */
-    int err_at = -1;
-    for (int t = 0; t < started; t++)
-      if (jobs[t].err.kind != E_NONE && err_at < 0) err_at = t;
-    if (err_at >= 0) {
-      raise_err(&jobs[err_at].err);
-      for (int t = 0; t < started; t++) scan_free(&jobs[t].s);
-      free(jobs);
-      free(tids);
-      goto fail;
-    }
-    int merge_rc = 0;
-    for (int t = 0; t < started && merge_rc == 0; t++)
-      merge_rc = scan_merge(&s, &jobs[t].s);
-    for (int t = 0; t < started; t++) scan_free(&jobs[t].s);
-    free(jobs);
-    free(tids);
-    if (merge_rc < 0) {
-      raise_walk_err();
-      goto fail;
-    }
+    if (fanout_rc < 0) goto fail;
   } else {
     if (scan_roots_range(&s, cids, lens, 0, n_roots) < 0) {
       raise_walk_err();
@@ -1564,13 +1748,18 @@ static int txmeta_is_canonical(const uint8_t *raw, Py_ssize_t rlen,
 
 static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
                                         PyObject *kwargs) {
-  PyObject *blocks, *groups, *fallback = Py_None;
+  PyObject *blocks, *groups, *fallback = Py_None, *snap_obj = Py_None;
   int headers = 1, want_touched = 1, validate_blocks = 0;
   static char *kwlist[] = {"blocks", "groups", "fallback", "headers",
-                           "want_touched", "validate_blocks", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|Oppp", kwlist,
+                           "want_touched", "validate_blocks", "snapshot", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!O|OpppO", kwlist,
                                    &PyDict_Type, &blocks, &groups, &fallback,
-                                   &headers, &want_touched, &validate_blocks))
+                                   &headers, &want_touched, &validate_blocks,
+                                   &snap_obj))
+    return NULL;
+  const CMap *snap_map = NULL;
+  int snap_complete = 0;
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
     return NULL;
   PyObject *gseq = PySequence_Fast(groups, "groups must be a sequence");
   if (!gseq) return NULL;
@@ -1582,6 +1771,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
   s.blocks = blocks;
   s.fallback = fallback;
   s.validate = validate_blocks;
+  s.cmap = snap_map; /* GIL held throughout: misses fall through to dict */
 
   Vec msg_pool = {0}, msg_off = {0}, msg_len = {0}, msg_goff = {0};
   Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
@@ -1785,11 +1975,16 @@ static void scan_rewind(Scan *s, const ScanMark *m) {
 
 static PyObject *py_record_receipt_paths(PyObject *self, PyObject *args,
                                          PyObject *kwargs) {
-  PyObject *blocks, *roots, *wanted, *fallback = Py_None;
-  static char *kwlist[] = {"blocks", "roots", "wanted", "fallback", NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OO|O", kwlist,
+  PyObject *blocks, *roots, *wanted, *fallback = Py_None, *snap_obj = Py_None;
+  static char *kwlist[] = {"blocks", "roots", "wanted", "fallback", "snapshot",
+                           NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OO|OO", kwlist,
                                    &PyDict_Type, &blocks, &roots, &wanted,
-                                   &fallback))
+                                   &fallback, &snap_obj))
+    return NULL;
+  const CMap *snap_map = NULL;
+  int snap_complete = 0;
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
     return NULL;
   PyObject *rseq = PySequence_Fast(roots, "roots must be a sequence");
   if (!rseq) return NULL;
@@ -1811,6 +2006,7 @@ static PyObject *py_record_receipt_paths(PyObject *self, PyObject *args,
   memset(&s, 0, sizeof(s));
   s.blocks = blocks;
   s.fallback = (fallback == Py_None) ? NULL : fallback;
+  s.cmap = snap_map; /* GIL held throughout: misses fall through to dict */
   s.want_payload = 1;
   Vec touch_pool = {0}, touch_off = {0}, touch_len = {0}, touch_goff = {0};
   Vec failed = {0};
@@ -2190,15 +2386,20 @@ static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
 static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
   PyObject *blocks, *roots, *owners, *keys, *fallback = Py_None;
+  PyObject *snap_obj = Py_None;
   int bit_width = 5, skip_missing = 0, want_touched = 0, validate_blocks = 0;
   static char *kwlist[] = {"blocks",      "roots",        "owners",
                            "keys",        "bit_width",    "fallback",
                            "skip_missing", "want_touched", "validate_blocks",
-                           NULL};
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOppp", kwlist,
+                           "snapshot",    NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|iOpppO", kwlist,
                                    &PyDict_Type, &blocks, &roots, &owners,
                                    &keys, &bit_width, &fallback, &skip_missing,
-                                   &want_touched, &validate_blocks))
+                                   &want_touched, &validate_blocks, &snap_obj))
+    return NULL;
+  const CMap *hamt_snap_map = NULL;
+  int hamt_snap_complete = 0;
+  if (snapshot_resolve(snap_obj, blocks, &hamt_snap_map, &hamt_snap_complete) < 0)
     return NULL;
   if (bit_width < 1 || bit_width > 8) {
     PyErr_SetString(PyExc_ValueError, "bit_width must be in [1, 8]");
@@ -2225,6 +2426,7 @@ static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
   s.fallback = fallback;
   s.skip_missing = skip_missing;
   s.validate = validate_blocks;
+  s.cmap = hamt_snap_map; /* GIL held: misses fall through to dict */
 
   Py_ssize_t n_roots = PySequence_Fast_GET_SIZE(rseq);
   Py_ssize_t n = PySequence_Fast_GET_SIZE(kseq);
@@ -2577,10 +2779,25 @@ static PyMethodDef methods[] = {
      " path walks to each wanted index plus full events-AMT walks beneath,"
      " returning flat payload-mode event arrays, touched block CIDs (grouped),"
      " and per-group failed flags."},
+    {"make_snapshot", py_make_snapshot, METH_O,
+     "make_snapshot(blocks_dict) -> BlockSnapshot: persistent GIL-free "
+     "probe table over the dict, reusable across native walks via their "
+     "snapshot= argument (hits stay valid because content-addressed stores "
+     "only add blocks; misses fall through to the live dict)."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_scan_ext",
                                        "Native receipts/events AMT scanner",
                                        -1, methods};
 
-PyMODINIT_FUNC PyInit_ipc_scan_ext(void) { return PyModule_Create(&moduledef); }
+PyMODINIT_FUNC PyInit_ipc_scan_ext(void) {
+  PyObject *m = PyModule_Create(&moduledef);
+  if (!m) return NULL;
+  if (PyType_Ready(&Snapshot_Type) < 0 ||
+      PyModule_AddObjectRef(m, "BlockSnapshot",
+                            (PyObject *)&Snapshot_Type) < 0) {
+    Py_DECREF(m);
+    return NULL;
+  }
+  return m;
+}

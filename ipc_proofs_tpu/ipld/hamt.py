@@ -84,7 +84,11 @@ def hamt_get_batch(
     bytes in positions the targeted walk skips must fail like the scalar
     reader's full decode)."""
     from ipc_proofs_tpu.backend.native import load_scan_ext
-    from ipc_proofs_tpu.proofs.scan_native import _raw_view, split_pooled
+    from ipc_proofs_tpu.proofs.scan_native import (
+        _raw_view,
+        _snap_kw,
+        split_pooled,
+    )
 
     ext = load_scan_ext()
     if ext is None or not hasattr(ext, "hamt_lookup_batch"):
@@ -99,6 +103,7 @@ def hamt_get_batch(
         fallback=fallback,
         skip_missing=skip_missing,
         validate_blocks=validate_blocks,
+        **_snap_kw(store, raw),
     )
     found = out["found"]
     spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])
@@ -121,7 +126,11 @@ def hamt_get_batch_touched(
     import numpy as np
 
     from ipc_proofs_tpu.backend.native import load_scan_ext
-    from ipc_proofs_tpu.proofs.scan_native import _raw_view, split_pooled
+    from ipc_proofs_tpu.proofs.scan_native import (
+        _raw_view,
+        _snap_kw,
+        split_pooled,
+    )
 
     ext = load_scan_ext()
     if ext is None or not hasattr(ext, "hamt_lookup_batch"):
@@ -135,6 +144,7 @@ def hamt_get_batch_touched(
         bit_width=bit_width,
         fallback=fallback,
         want_touched=True,
+        **_snap_kw(store, raw),
     )
     found = out["found"]
     spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])

@@ -100,7 +100,7 @@ def _native_exec_orders(
     ``validate_blocks`` full-validates every fetched block (verify-side
     callers only — the store holds adversarial witness bytes there)."""
     from ipc_proofs_tpu.backend.native import load_scan_ext
-    from ipc_proofs_tpu.proofs.scan_native import _raw_view
+    from ipc_proofs_tpu.proofs.scan_native import _raw_view, _snap_kw
 
     ext = load_scan_ext()
     if ext is None:
@@ -114,6 +114,7 @@ def _native_exec_orders(
             headers=headers,
             want_touched=want_touched,
             validate_blocks=validate_blocks,
+            **_snap_kw(store, raw),
         )
     except Exception:
         return None

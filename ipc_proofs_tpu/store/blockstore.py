@@ -69,6 +69,11 @@ class MemoryBlockstore:
         self._blocks: dict[CID, bytes] = {}
         self._raw: dict[bytes, bytes] = {}  # cid.to_bytes() -> data
         self._verify = verify_cids
+        # bumped on EVERY write (including same-CID overwrites, which leave
+        # len() unchanged) — the native scan-snapshot cache invalidates on
+        # this, so an overwrite with different bytes can never be served
+        # stale from a cached probe table (size-only checks would miss it)
+        self._mutations = 0
 
     def get(self, cid: CID) -> Optional[bytes]:
         return self._blocks.get(cid)
@@ -81,6 +86,7 @@ class MemoryBlockstore:
         data = bytes(data)
         self._blocks[cid] = data
         self._raw[cid.to_bytes()] = data
+        self._mutations += 1
 
     def has(self, cid: CID) -> bool:
         return cid in self._blocks
@@ -101,6 +107,7 @@ class MemoryBlockstore:
             data = bytes(block.data)
             cid_map[block.cid] = data
             raw_map[block.cid.to_bytes()] = data
+            self._mutations += 1
 
     def raw_map(self) -> dict[bytes, bytes]:
         """Live view keyed by raw CID bytes — the native scanner's fast path

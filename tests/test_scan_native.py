@@ -573,3 +573,136 @@ class TestFusedMatchRandomizedDifferential:
             assert scalar == fast == [True] * len(bundle.proofs), trial
             n_bundles += len(bundle.proofs)
         assert n_bundles > 0  # the sweep actually exercised matches
+
+
+class TestBlockSnapshot:
+    """Persistent snapshot semantics: identical outputs, safe staleness
+    (content-addressed stores only add blocks — hits stay valid, misses
+    fall through to the live dict), strong refs across value replacement,
+    and strict misuse errors."""
+
+    def _world(self, n_pairs=6):
+        bs = MemoryBlockstore()
+        roots = []
+        for p in range(n_pairs):
+            events = [
+                [EventFixture(emitter=ACTOR, signature=SIG, topic1=f"net-{p}")],
+                [EventFixture(emitter=9, signature="Noise()", topic1="x")],
+            ]
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)], events,
+                parent_height=70 + p, store=bs,
+            )
+            roots.append(world.child.blocks[0].parent_message_receipts)
+        return bs, roots
+
+    def test_snapshot_scan_identical(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, roots = self._world()
+        raw = bs.raw_map()
+        snap = ext.make_snapshot(raw)
+        rb = [c.to_bytes() for c in roots]
+        plain = ext.scan_events_batch(raw, rb, None)
+        snapped = ext.scan_events_batch(raw, rb, None, snapshot=snap)
+        assert plain == snapped
+        assert snap.n_blocks == len(raw)
+
+    def test_stale_snapshot_falls_through_to_dict(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, roots = self._world(2)
+        raw = bs.raw_map()
+        snap = ext.make_snapshot(raw)
+        # grow the store AFTER the snapshot: new pair's blocks are only in
+        # the dict; the stale snapshot must still scan them correctly
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="late")]]
+        world = build_chain(
+            [ContractFixture(actor_id=ACTOR)], events,
+            parent_height=99, store=bs,
+        )
+        roots = roots + [world.child.blocks[0].parent_message_receipts]
+        rb = [c.to_bytes() for c in roots]
+        assert snap.n_blocks < len(raw)
+        plain = ext.scan_events_batch(raw, rb, None)
+        snapped = ext.scan_events_batch(raw, rb, None, snapshot=snap)
+        assert plain == snapped
+
+    def test_value_replacement_keeps_old_object_alive(self):
+        """put_keyed overwrites swap in NEW equal-content bytes objects; a
+        cached snapshot must hold strong refs so its hit pointers never
+        dangle (and content-addressing makes the stale value equal)."""
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, roots = self._world(2)
+        raw = bs.raw_map()
+        snap = ext.make_snapshot(raw)
+        rb = [c.to_bytes() for c in roots]
+        before = ext.scan_events_batch(raw, rb, None, snapshot=snap)
+        # replace every value object (equal content) — old objects would be
+        # freed if the snapshot borrowed instead of owning
+        for k in list(raw):
+            raw[k] = bytes(bytearray(raw[k]))
+        import gc
+
+        gc.collect()
+        after = ext.scan_events_batch(raw, rb, None, snapshot=snap)
+        assert before == after
+
+    def test_wrong_dict_and_wrong_type_rejected(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, roots = self._world(1)
+        raw = bs.raw_map()
+        snap = ext.make_snapshot(dict(raw))  # different dict object
+        rb = [c.to_bytes() for c in roots]
+        with pytest.raises(ValueError):
+            ext.scan_events_batch(raw, rb, None, snapshot=snap)
+        with pytest.raises(TypeError):
+            ext.scan_events_batch(raw, rb, None, snapshot=object())
+        with pytest.raises(TypeError):
+            ext.make_snapshot([("a", "b")])
+
+    def test_wrapper_caches_and_rebuilds(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.proofs.scan_native import _raw_view, _snapshot_of
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, roots = self._world(2)
+        raw, _ = _raw_view(bs)
+        s1 = _snapshot_of(bs, raw)
+        s2 = _snapshot_of(bs, raw)
+        assert s1 is s2  # cached while the store is unchanged
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="grow")]]
+        build_chain(
+            [ContractFixture(actor_id=ACTOR)], events,
+            parent_height=120, store=bs,
+        )
+        s3 = _snapshot_of(bs, raw)
+        assert s3 is not s1 and s3.n_blocks == len(raw)
+
+    def test_no_snapshot_env_disables(self, monkeypatch):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.proofs.scan_native import _raw_view, _snapshot_of
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, _ = self._world(1)
+        raw, _ = _raw_view(bs)
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
+        assert _snapshot_of(bs, raw) is None
