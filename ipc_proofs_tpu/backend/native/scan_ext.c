@@ -573,9 +573,10 @@ static const CEntry *cmap_get(const CMap *m, const uint8_t *key,
  * builds once per store and passes to every walker: content-addressed
  * stores only ever ADD blocks, so a cached snapshot's hits stay valid
  * forever (entries hold strong refs — see CEntry.kobj) and misses fall
- * through to the live dict probe in get_block. Wrappers rebuild on any
- * dict-size change; the multi-thread arm additionally requires the
- * snapshot to be complete (size equal) since jobs cannot touch the dict. */
+ * through to the live dict probe in get_block. Wrappers rebuild on the
+ * store's MUTATION COUNTER (size alone would miss same-size overwrites);
+ * the multi-thread arm additionally requires the snapshot to be complete
+ * (size equal) since jobs cannot touch the dict. */
 
 typedef struct {
   PyObject_HEAD
@@ -2641,6 +2642,157 @@ static void blake2b256_one(const uint8_t *data, uint64_t len, uint8_t *out) {
  * the whole hash loop with the GIL released. Replaces the ctypes batch
  * path, whose Python-side offset/length packing and buffer copies cost
  * more than the hashing itself at witness-node sizes (~200 B). */
+/* ---------------- witness materialization ----------------
+ *
+ * Phase D of the range driver: turn the deduplicated witness CID-byte set
+ * into the bundle's CID-sorted ProofBlock list. The Python loop paid a
+ * dict probe + CID indexing + a Python-level fast-constructor call per
+ * block (~2 us x thousands of blocks per chunk); this does the sort, the
+ * probes (snapshot table first), and the instance construction in C. CID
+ * objects still come from ONE call to the passed make_cids batch (the
+ * dagcbor extension owns the CID type), so acceptance of malformed CID
+ * bytes is exactly the Python path's. */
+
+typedef struct {
+  const uint8_t *ptr;
+  Py_ssize_t len;
+  PyObject *obj;
+} SortSpan;
+
+static int span_cmp(const void *a, const void *b) {
+  const SortSpan *x = (const SortSpan *)a, *y = (const SortSpan *)b;
+  Py_ssize_t n = x->len < y->len ? x->len : y->len;
+  int c = memcmp(x->ptr, y->ptr, (size_t)n);
+  if (c) return c;
+  return x->len < y->len ? -1 : (x->len > y->len ? 1 : 0);
+}
+
+static PyObject *py_materialize_blocks(PyObject *self, PyObject *args,
+                                       PyObject *kwargs) {
+  PyObject *blocks, *todo, *make_cids, *cls;
+  PyObject *fallback = Py_None, *snap_obj = Py_None;
+  static char *kwlist[] = {"blocks", "todo",     "make_cids", "cls",
+                           "fallback", "snapshot", NULL};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "O!OOO|OO", kwlist,
+                                   &PyDict_Type, &blocks, &todo, &make_cids,
+                                   &cls, &fallback, &snap_obj))
+    return NULL;
+  if (!PyType_Check(cls)) {
+    PyErr_SetString(PyExc_TypeError, "cls must be a type");
+    return NULL;
+  }
+  const CMap *snap_map = NULL;
+  int snap_complete = 0;
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
+    return NULL;
+  PyObject *seq = PySequence_Fast(todo, "todo must be a sequence of cid bytes");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+  SortSpan *spans = malloc(sizeof(SortSpan) * (n ? n : 1));
+  PyObject *sorted_list = NULL, *cids = NULL, *result = NULL;
+  PyObject *name_cid = NULL, *name_data = NULL;
+  if (!spans) {
+    PyErr_NoMemory();
+    goto out;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyBytes_Check(item)) {
+      PyErr_SetString(PyExc_TypeError, "todo entries must be cid bytes");
+      goto out;
+    }
+    spans[i].ptr = (const uint8_t *)PyBytes_AS_STRING(item);
+    spans[i].len = PyBytes_GET_SIZE(item);
+    spans[i].obj = item;
+  }
+  qsort(spans, (size_t)n, sizeof(SortSpan), span_cmp);
+
+  sorted_list = PyList_New(n);
+  if (!sorted_list) goto out;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_INCREF(spans[i].obj);
+    PyList_SET_ITEM(sorted_list, i, spans[i].obj);
+  }
+  /* ONE batch call constructs every CID object (dagcbor ext's make_cids);
+   * malformed bytes raise exactly as the Python loop's */
+  cids = PyObject_CallOneArg(make_cids, sorted_list);
+  if (!cids) goto out;
+  PyObject *cid_seq = PySequence_Fast(cids, "make_cids must return a sequence");
+  if (!cid_seq) goto out;
+  if (PySequence_Fast_GET_SIZE(cid_seq) != n) {
+    Py_DECREF(cid_seq);
+    PyErr_SetString(PyExc_ValueError, "make_cids returned wrong length");
+    goto out;
+  }
+
+  name_cid = PyUnicode_InternFromString("cid");
+  name_data = PyUnicode_InternFromString("data");
+  if (!name_cid || !name_data) {
+    Py_DECREF(cid_seq);
+    goto out;
+  }
+  PyTypeObject *tp = (PyTypeObject *)cls;
+  result = PyList_New(n);
+  if (!result) {
+    Py_DECREF(cid_seq);
+    goto out;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *data = NULL; /* borrowed or owned per branch below */
+    PyObject *owned = NULL;
+    if (snap_map) {
+      const CEntry *e = cmap_get(snap_map, spans[i].ptr, spans[i].len);
+      if (e && e->vobj && PyBytes_Check(e->vobj)) data = e->vobj;
+    }
+    if (!data) {
+      data = PyDict_GetItemWithError(blocks, spans[i].obj);
+      if (!data && PyErr_Occurred()) goto item_fail;
+    }
+    PyObject *cid = PySequence_Fast_GET_ITEM(cid_seq, i);
+    if (!data && fallback != Py_None) {
+      owned = PyObject_CallOneArg(fallback, cid);
+      if (!owned) goto item_fail;
+      if (owned == Py_None) {
+        Py_CLEAR(owned);
+      } else {
+        data = owned;
+      }
+    }
+    if (!data) {
+      PyErr_Format(PyExc_KeyError, "missing witness block %S", cid);
+      goto item_fail;
+    }
+    /* ProofBlock._make from C: bare instance + generic setattr (bypasses
+     * the frozen-dataclass __setattr__ exactly like object.__setattr__) */
+    PyObject *inst = tp->tp_alloc(tp, 0);
+    if (!inst) goto item_fail;
+    if (PyObject_GenericSetAttr(inst, name_cid, cid) < 0 ||
+        PyObject_GenericSetAttr(inst, name_data, data) < 0) {
+      Py_DECREF(inst);
+      goto item_fail;
+    }
+    Py_XDECREF(owned);
+    PyList_SET_ITEM(result, i, inst);
+    continue;
+  item_fail:
+    Py_XDECREF(owned);
+    Py_DECREF(cid_seq);
+    Py_CLEAR(result);
+    goto out;
+  }
+  Py_DECREF(cid_seq);
+
+out:
+  free(spans);
+  Py_XDECREF(sorted_list);
+  Py_XDECREF(cids);
+  Py_XDECREF(name_cid);
+  Py_XDECREF(name_data);
+  Py_DECREF(seq);
+  return result;
+}
+
 static PyObject *py_verify_blake2b_blocks(PyObject *self, PyObject *args) {
   (void)self;
   PyObject *digests_arg, *blocks_arg;
@@ -2779,6 +2931,12 @@ static PyMethodDef methods[] = {
      " path walks to each wanted index plus full events-AMT walks beneath,"
      " returning flat payload-mode event arrays, touched block CIDs (grouped),"
      " and per-group failed flags."},
+    {"materialize_blocks",
+     (PyCFunction)(void (*)(void))py_materialize_blocks,
+     METH_VARARGS | METH_KEYWORDS,
+     "materialize_blocks(blocks_dict, todo, make_cids, cls, fallback=None, "
+     "snapshot=None) -> CID-byte-sorted list of cls instances (cid=, data=) "
+     "— Phase D witness materialization in one C pass."},
     {"make_snapshot", py_make_snapshot, METH_O,
      "make_snapshot(blocks_dict) -> BlockSnapshot: persistent GIL-free "
      "probe table over the dict, reusable across native walks via their "

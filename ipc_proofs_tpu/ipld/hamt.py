@@ -103,7 +103,7 @@ def hamt_get_batch(
         fallback=fallback,
         skip_missing=skip_missing,
         validate_blocks=validate_blocks,
-        **_snap_kw(store, raw),
+        **_snap_kw(store, raw, len(keys)),
     )
     found = out["found"]
     spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])
@@ -144,7 +144,7 @@ def hamt_get_batch_touched(
         bit_width=bit_width,
         fallback=fallback,
         want_touched=True,
-        **_snap_kw(store, raw),
+        **_snap_kw(store, raw, len(keys)),
     )
     found = out["found"]
     spans = split_pooled(out["val_pool"], out["val_off"], out["val_len"])

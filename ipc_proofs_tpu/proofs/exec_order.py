@@ -114,7 +114,7 @@ def _native_exec_orders(
             headers=headers,
             want_touched=want_touched,
             validate_blocks=validate_blocks,
-            **_snap_kw(store, raw),
+            **_snap_kw(store, raw, len(groups)),
         )
     except Exception:
         return None

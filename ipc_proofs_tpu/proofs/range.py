@@ -527,20 +527,47 @@ def _materialize_witness(
 ) -> "list[ProofBlock]":
     """Phase D: ONE materialization for the whole bundle — CID objects come
     from one batched C call, block bytes from the raw byte-keyed map (one
-    dict probe each; the CID-keyed store path would pay a hash+eq on every
+    probe each; the CID-keyed store path would pay a hash+eq on every
     freshly parsed CID). ``extra_blocks`` (scalar-fallback and storage
     blocks, already materialized) dedup against the byte set by CID bytes.
-    Output is CID-byte-sorted — the bundle's canonical witness order."""
-    from ipc_proofs_tpu.backend.native import load_dagcbor_ext
+    Output is CID-byte-sorted — the bundle's canonical witness order.
+
+    Fast path: ``scan_ext.materialize_blocks`` does the sort, the probes
+    (persistent snapshot table first), and the ProofBlock construction in
+    one C pass; CID parsing stays the dagcbor extension's batch call either
+    way, so malformed-CID acceptance is identical."""
+    from ipc_proofs_tpu.backend.native import load_dagcbor_ext, load_scan_ext
     from ipc_proofs_tpu.core.cid import CID
-    from ipc_proofs_tpu.proofs.scan_native import _raw_view
+    from ipc_proofs_tpu.proofs.scan_native import _raw_view, _snap_kw
 
     by_cid: "dict[bytes, ProofBlock]" = {}
     for block in extra_blocks:
         by_cid[block.cid.to_bytes()] = block
-    todo = sorted(witness_bytes - by_cid.keys() if by_cid else witness_bytes)
+    todo_set = witness_bytes - by_cid.keys() if by_cid else witness_bytes
     raw_map, _ = _raw_view(cached)
     ext = load_dagcbor_ext()
+    scan_ext = load_scan_ext()
+    if (
+        ext is not None
+        and hasattr(ext, "make_cids")
+        and scan_ext is not None
+        and hasattr(scan_ext, "materialize_blocks")
+    ):
+        todo_list = list(todo_set)
+        blocks = scan_ext.materialize_blocks(
+            raw_map,
+            todo_list,
+            ext.make_cids,
+            ProofBlock,
+            lambda cid: cached.get(cid),
+            **_snap_kw(cached, raw_map, len(todo_list)),
+        )
+        if not by_cid:
+            return blocks  # already CID-byte-sorted
+        for block in blocks:
+            by_cid[block.cid.to_bytes()] = block
+        return [by_cid[k] for k in sorted(by_cid)]
+    todo = sorted(todo_set)
     if ext is not None and hasattr(ext, "make_cids"):
         cids = ext.make_cids(todo)
     else:

@@ -188,15 +188,22 @@ def _raw_view(store: Blockstore):
     return {}, fallback
 
 
-def _snapshot_of(store: Blockstore, raw: dict):
+def _snapshot_of(store: Blockstore, raw: dict, work: "Optional[int]" = None):
     """Persistent C probe table over ``raw``, cached on the owning
-    MemoryBlockstore and rebuilt whenever the dict size changed. At range
-    scale the per-call snapshot build costs about as much as the probe
-    savings it buys (~100k blocks ≈ milliseconds) — reusing one table
-    across every native walk of a pipeline pass removes that entirely.
-    Safe by construction: content-addressed stores only ever ADD blocks,
-    so hits on a stale table stay valid (entries hold strong refs) and
-    misses fall through to the live dict probe inside the C walker.
+    MemoryBlockstore and invalidated by the store's MUTATION COUNTER (not
+    dict size — a put_keyed overwrite with different bytes leaves len()
+    unchanged but must never be served stale). At range scale the per-call
+    transient build costs about as much as the probe savings it buys
+    (~100k blocks ≈ milliseconds) — reusing one table across every native
+    walk of a pipeline pass removes that entirely. Safe by construction:
+    content-addressed stores only ever ADD blocks, so hits on a stale
+    table stay valid (entries hold strong refs) and misses fall through to
+    the live dict probe inside the C walker.
+
+    ``work`` (roots/keys/blocks the caller is about to touch) gates the
+    BUILD: a fresh cached table is always returned (free), but a tiny walk
+    over a huge un-snapshotted store keeps the legacy path rather than
+    paying an O(|store|) build — mirroring the C side's snapshot_pays.
     Returns None (legacy transient path) when the extension lacks
     snapshots, the store is not memory-backed, or IPC_SCAN_NO_SNAPSHOT=1.
     """
@@ -214,23 +221,22 @@ def _snapshot_of(store: Blockstore, raw: dict):
     ext = load_scan_ext()
     if ext is None or not hasattr(ext, "make_snapshot"):
         return None
-    # invalidate on the store's mutation counter, not dict size: a put_keyed
-    # overwrite with different bytes leaves len() unchanged but must never be
-    # served stale from the cached table
     version = owner._mutations
     cached = getattr(owner, "_scan_snapshot", None)
     if cached is not None and cached[0] == version:
         return cached[1]
+    if work is not None and (work < 64 or len(raw) > 256 * work):
+        return None  # build would cost more than the probes it replaces
     snap = ext.make_snapshot(raw)
     owner._scan_snapshot = (version, snap)
     return snap
 
 
-def _snap_kw(store: Blockstore, raw: dict) -> dict:
+def _snap_kw(store: Blockstore, raw: dict, work: "Optional[int]" = None) -> dict:
     """``{"snapshot": snap}`` or ``{}`` — the kwarg is omitted entirely when
     there is no snapshot, so an extension build predating the snapshot API
     keeps working instead of raising TypeError on the unknown keyword."""
-    snap = _snapshot_of(store, raw)
+    snap = _snapshot_of(store, raw, work)
     return {"snapshot": snap} if snap is not None else {}
 
 
@@ -290,7 +296,7 @@ def record_receipt_paths(
         [c.to_bytes() for c in receipts_roots],
         [list(map(int, w)) for w in wanted],
         fallback,
-        **_snap_kw(store, raw),
+        **_snap_kw(store, raw, len(receipts_roots)),
     )
     n = out["n_events"]
     batch = ScanBatch(
@@ -351,7 +357,7 @@ def scan_match_hits(
         fallback,
         match_fp=topic_fingerprint(topic0, topic1),
         match_actor=actor_id_filter,
-        **_snap_kw(store, raw),
+        **_snap_kw(store, raw, len(receipts_roots)),
     )
     return (
         out["n_events"],
@@ -393,7 +399,7 @@ def scan_events_flat(
         skip_missing=skip_missing,
         want_payload=want_payload,
         validate_blocks=validate_blocks,
-        **_snap_kw(store, raw),
+        **_snap_kw(store, raw, len(receipts_roots)),
     )
     n = out["n_events"]
     return ScanBatch(

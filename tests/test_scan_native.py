@@ -695,6 +695,48 @@ class TestBlockSnapshot:
         s3 = _snapshot_of(bs, raw)
         assert s3 is not s1 and s3.n_blocks == len(raw)
 
+    @pytest.mark.parametrize("stale", [False, True])
+    def test_threaded_fanout_with_snapshot(self, monkeypatch, stale):
+        """>=64 roots + IPC_SCAN_THREADS>1 exercises the provided-snapshot
+        threaded arm (complete snapshot) and, when stale, the downgrade to
+        a transient build — both must match the sequential dict walk."""
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs = MemoryBlockstore()
+        roots = []
+        for p in range(96):
+            events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1=f"t{p}")]]
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)], events,
+                parent_height=300 + p, store=bs,
+            )
+            roots.append(world.child.blocks[0].parent_message_receipts)
+        raw = bs.raw_map()
+        if stale:
+            snap = ext.make_snapshot(dict(list(raw.items())[: len(raw) // 2]))
+            # a half-dict snapshot of a DIFFERENT dict is rejected; build a
+            # stale one properly: snapshot, then grow the store
+            snap = ext.make_snapshot(raw)
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)],
+                [[EventFixture(emitter=ACTOR, signature=SIG, topic1="zz")]],
+                parent_height=500, store=bs,
+            )
+            roots.append(world.child.blocks[0].parent_message_receipts)
+            assert snap.n_blocks < len(raw)
+        else:
+            snap = ext.make_snapshot(raw)
+        rb = [c.to_bytes() for c in roots]
+        monkeypatch.setenv("IPC_SCAN_THREADS", "4")
+        threaded = ext.scan_events_batch(raw, rb, None, snapshot=snap)
+        monkeypatch.setenv("IPC_SCAN_THREADS", "1")
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
+        sequential = ext.scan_events_batch(raw, rb, None)
+        assert threaded == sequential
+
     def test_no_snapshot_env_disables(self, monkeypatch):
         from ipc_proofs_tpu.backend.native import load_scan_ext
         from ipc_proofs_tpu.proofs.scan_native import _raw_view, _snapshot_of
@@ -706,3 +748,66 @@ class TestBlockSnapshot:
         raw, _ = _raw_view(bs)
         monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
         assert _snapshot_of(bs, raw) is None
+
+
+class TestMaterializeBlocks:
+    """C witness materialization ≡ the Python loop: same blocks, same
+    order, same type/frozen semantics, same errors."""
+
+    def _witness(self):
+        bs = MemoryBlockstore()
+        events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1="m")]]
+        build_chain([ContractFixture(actor_id=ACTOR)], events,
+                    parent_height=10, store=bs)
+        return bs, sorted(bs.raw_map())
+
+    def test_identical_to_python_loop(self):
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext, load_scan_ext
+        from ipc_proofs_tpu.proofs.bundle import ProofBlock
+
+        ext = load_scan_ext()
+        dext = load_dagcbor_ext()
+        if not hasattr(ext, "materialize_blocks") or dext is None:
+            pytest.skip("extension predates materialize_blocks")
+        bs, todo = self._witness()
+        raw = bs.raw_map()
+        import random
+
+        shuffled = list(todo)
+        random.Random(7).shuffle(shuffled)  # C sorts internally
+        out = ext.materialize_blocks(raw, shuffled, dext.make_cids, ProofBlock)
+        cids = dext.make_cids(todo)
+        ref = [ProofBlock._make(c, raw[b]) for c, b in zip(cids, todo)]
+        assert len(out) == len(ref)
+        for a, b in zip(out, ref):
+            assert type(a) is ProofBlock and a.cid == b.cid and a.data == b.data
+
+    def test_frozen_and_missing_semantics(self):
+        import dataclasses
+
+        from ipc_proofs_tpu.backend.native import load_dagcbor_ext, load_scan_ext
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.proofs.bundle import ProofBlock
+
+        ext = load_scan_ext()
+        dext = load_dagcbor_ext()
+        if not hasattr(ext, "materialize_blocks") or dext is None:
+            pytest.skip("extension predates materialize_blocks")
+        cid = CID.hash_of(b"x")
+        raw = {cid.to_bytes(): b"x"}
+        (block,) = ext.materialize_blocks(raw, [cid.to_bytes()], dext.make_cids, ProofBlock)
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            block.cid = None
+        absent = CID.hash_of(b"absent").to_bytes()
+        with pytest.raises(KeyError):
+            ext.materialize_blocks(raw, [absent], dext.make_cids, ProofBlock)
+        # fallback path: absent blocks resolved by the callable
+        blocks = ext.materialize_blocks(
+            raw, [absent], dext.make_cids, ProofBlock,
+            lambda cid_obj: b"fetched",
+        )
+        assert blocks[0].data == b"fetched"
+        with pytest.raises(TypeError):
+            ext.materialize_blocks(raw, [b"ok", "not-bytes"], dext.make_cids, ProofBlock)
+        with pytest.raises(ValueError):
+            ext.materialize_blocks(raw, [b"\x00garbage"], dext.make_cids, ProofBlock)
