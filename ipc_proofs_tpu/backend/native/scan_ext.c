@@ -662,12 +662,13 @@ static PyObject *py_make_snapshot(PyObject *self, PyObject *arg) {
 }
 
 /* Resolve an optional snapshot= argument against the call's block dict.
- * Returns 0 on success (out/out_complete set; both NULL/0 when snapshot is
- * None), -1 with an exception for type or dict-identity misuse. */
+ * Returns 0 on success (*out set, NULL when snapshot is None;
+ * *out_complete set when the pointer is non-NULL — only the threaded scan
+ * arm cares), -1 with an exception for type or dict-identity misuse. */
 static int snapshot_resolve(PyObject *snap_obj, PyObject *blocks,
                             const CMap **out, int *out_complete) {
   *out = NULL;
-  *out_complete = 0;
+  if (out_complete) *out_complete = 0;
   if (!snap_obj || snap_obj == Py_None) return 0;
   if (!PyObject_TypeCheck(snap_obj, &Snapshot_Type)) {
     PyErr_SetString(PyExc_TypeError, "snapshot must be a BlockSnapshot");
@@ -680,7 +681,7 @@ static int snapshot_resolve(PyObject *snap_obj, PyObject *blocks,
     return -1;
   }
   *out = &sn->map;
-  *out_complete = PyDict_Size(blocks) == sn->built;
+  if (out_complete) *out_complete = PyDict_Size(blocks) == sn->built;
   return 0;
 }
 
@@ -1037,27 +1038,35 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
   int64_t span = 1;
   for (int h = 0; h < height; h++) span *= width;
 
+  /* iterate SET bits via ctz instead of testing all `width` slots — same
+   * ascending slot order and pos counting; bits at positions >= width are
+   * ignored exactly as the slot-bounded loop ignored them */
   int pos = 0;
   uint64_t used_values = 0;
-  for (int slot = 0; slot < width; slot++) {
-    if (!((bmap[slot >> 3] >> (slot & 7)) & 1)) continue;
-    if (height == 0) {
-      if ((uint64_t)pos >= n_values) {
-        walk_err(E_VALUE, "AMT leaf bitmap/values mismatch");
-        goto out;
+  for (int byte_i = 0; byte_i * 8 < width; byte_i++) {
+    unsigned bits = bmap[byte_i];
+    if (width - byte_i * 8 < 8) bits &= (1u << (width - byte_i * 8)) - 1;
+    while (bits) {
+      int slot = byte_i * 8 + __builtin_ctz(bits);
+      bits &= bits - 1;
+      if (height == 0) {
+        if ((uint64_t)pos >= n_values) {
+          walk_err(E_VALUE, "AMT leaf bitmap/values mismatch");
+          goto out;
+        }
+        if (fn(s, p, base + slot, ctx) < 0) goto out;
+        used_values++;
+      } else {
+        if ((uint64_t)pos >= n_links) {
+          walk_err(E_VALUE, "AMT node bitmap/links mismatch");
+          goto out;
+        }
+        if (walk_node(s, link_ptr[pos], link_len[pos], NULL, bit_width,
+                      height - 1, base + slot * span, fn, ctx) < 0)
+          goto out;
       }
-      if (fn(s, p, base + slot, ctx) < 0) goto out;
-      used_values++;
-    } else {
-      if ((uint64_t)pos >= n_links) {
-        walk_err(E_VALUE, "AMT node bitmap/links mismatch");
-        goto out;
-      }
-      if (walk_node(s, link_ptr[pos], link_len[pos], NULL, bit_width,
-                    height - 1, base + slot * span, fn, ctx) < 0)
-        goto out;
+      pos++;
     }
-    pos++;
   }
   if (height == 0 && used_values != n_values) {
     walk_err(E_VALUE, "AMT leaf value count mismatch");
@@ -1173,7 +1182,8 @@ static int amt_get_path(Scan *s, Parser node, int bit_width, int height,
       goto out;
     }
     int pos = 0; /* popcount of set bits below slot */
-    for (int i = 0; i < slot; i++) pos += (bmap[i >> 3] >> (i & 7)) & 1;
+    for (int i = 0; i < (slot >> 3); i++) pos += __builtin_popcount(bmap[i]);
+    pos += __builtin_popcount(bmap[slot >> 3] & ((1u << (slot & 7)) - 1u));
 
     uint64_t n_links;
     if (rd_array(&node, &n_links) < 0) goto out;
@@ -1759,8 +1769,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
                                    &snap_obj))
     return NULL;
   const CMap *snap_map = NULL;
-  int snap_complete = 0;
-  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, NULL) < 0)
     return NULL;
   PyObject *gseq = PySequence_Fast(groups, "groups must be a sequence");
   if (!gseq) return NULL;
@@ -1984,8 +1993,7 @@ static PyObject *py_record_receipt_paths(PyObject *self, PyObject *args,
                                    &fallback, &snap_obj))
     return NULL;
   const CMap *snap_map = NULL;
-  int snap_complete = 0;
-  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, NULL) < 0)
     return NULL;
   PyObject *rseq = PySequence_Fast(roots, "roots must be a sequence");
   if (!rseq) return NULL;
@@ -2272,7 +2280,17 @@ static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
       return 0; /* absent */
     }
     uint32_t pos = 0;
-    for (uint32_t j = 0; j < idx; j++) pos += (uint32_t)bitfield_bit(bf, bflen, j);
+    /* popcount of set bits below idx (bitfield_bit semantics: bit i of the
+     * big-endian minimal bytes, LSB order from the END of the buffer) */
+    for (uint32_t j = 0; j < (idx >> 3); j++) {
+      Py_ssize_t bpos = bflen - 1 - (Py_ssize_t)j;
+      if (bpos >= 0) pos += (uint32_t)__builtin_popcount(bf[bpos]);
+    }
+    {
+      Py_ssize_t bpos = bflen - 1 - (Py_ssize_t)(idx >> 3);
+      if (bpos >= 0)
+        pos += (uint32_t)__builtin_popcount(bf[bpos] & ((1u << (idx & 7)) - 1u));
+    }
     uint64_t n_ptrs;
     if (rd_array(&p, &n_ptrs) < 0 || pos >= n_ptrs) {
       block_release(&node);
@@ -2399,8 +2417,7 @@ static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
                                    &want_touched, &validate_blocks, &snap_obj))
     return NULL;
   const CMap *hamt_snap_map = NULL;
-  int hamt_snap_complete = 0;
-  if (snapshot_resolve(snap_obj, blocks, &hamt_snap_map, &hamt_snap_complete) < 0)
+  if (snapshot_resolve(snap_obj, blocks, &hamt_snap_map, NULL) < 0)
     return NULL;
   if (bit_width < 1 || bit_width > 8) {
     PyErr_SetString(PyExc_ValueError, "bit_width must be in [1, 8]");
@@ -2642,6 +2659,277 @@ static void blake2b256_one(const uint8_t *data, uint64_t len, uint8_t *out) {
  * the whole hash loop with the GIL released. Replaces the ctypes batch
  * path, whose Python-side offset/length packing and buffer copies cost
  * more than the hashing itself at witness-node sizes (~200 B). */
+/* ---------------- claim construction ----------------
+ *
+ * The tail of Phase C: turn the matched rows' columns into
+ * EventProof/EventData instances. The Python loop paid ~2 us per claim in
+ * dict+instance construction and hex rendering; this builds the kwargs
+ * dicts and instances in C (instance + `__dict__` assignment — the C
+ * mirror of EventProof._make) with hex rendered straight from the pools.
+ * Slicing semantics mirror Python's (out-of-range clamps, never raises),
+ * so malformed inputs produce byte-identical claims to the Python loop. */
+
+static PyObject *hex0x_from(const uint8_t *pool, Py_ssize_t pool_len,
+                            Py_ssize_t start, Py_ssize_t stop) {
+  static const char digits[] = "0123456789abcdef";
+  if (start < 0) start = 0;
+  if (stop > pool_len) stop = pool_len;
+  if (stop < start) stop = start;
+  Py_ssize_t n = stop - start;
+  PyObject *out = PyUnicode_New(2 + 2 * n, 127);
+  if (!out) return NULL;
+  Py_UCS1 *buf = PyUnicode_1BYTE_DATA(out);
+  buf[0] = '0';
+  buf[1] = 'x';
+  for (Py_ssize_t i = 0; i < n; i++) {
+    buf[2 + 2 * i] = (Py_UCS1)digits[pool[start + i] >> 4];
+    buf[3 + 2 * i] = (Py_UCS1)digits[pool[start + i] & 15];
+  }
+  return out;
+}
+
+/* build one instance of `cls` whose __dict__ becomes `fields` (stolen) —
+ * EventProof._make / EventData._make semantics */
+static PyObject *instance_with_dict(PyTypeObject *cls, PyObject *fields) {
+  PyObject *inst = cls->tp_alloc(cls, 0);
+  if (!inst) {
+    Py_DECREF(fields);
+    return NULL;
+  }
+  if (PyObject_SetAttrString(inst, "__dict__", fields) < 0) {
+    Py_DECREF(fields);
+    Py_DECREF(inst);
+    return NULL;
+  }
+  Py_DECREF(fields);
+  return inst;
+}
+
+typedef struct {
+  Py_buffer view;
+  const void *buf;
+  Py_ssize_t n; /* element count */
+} ClaimBuf;
+
+static int claim_buf(PyObject *obj, int itemsize, ClaimBuf *out,
+                     const char *name) {
+  if (PyObject_GetBuffer(obj, &out->view, PyBUF_SIMPLE) < 0) return -1;
+  if (out->view.len % itemsize != 0) {
+    PyBuffer_Release(&out->view);
+    PyErr_Format(PyExc_ValueError, "%s buffer size not a multiple of %d",
+                 name, itemsize);
+    return -1;
+  }
+  out->buf = out->view.buf;
+  out->n = out->view.len / itemsize;
+  return 0;
+}
+
+static PyObject *py_build_event_claims(PyObject *self, PyObject *args,
+                                       PyObject *kwargs) {
+  PyObject *strs, *rows_o, *group_o, *msgpos_o, *sbase_o, *nparents_o,
+      *pepoch_o, *cepoch_o, *exec_o, *event_o, *emit_o, *ntop_o, *toff_o,
+      *doff_o, *dlen_o, *proof_cls, *data_cls;
+  Py_buffer tpool, dpool;
+  static char *kwlist[] = {
+      "strs",       "rows",       "group_of",  "msg_pos",     "str_base",
+      "n_parents",  "parent_epoch", "child_epoch", "exec_idx", "event_idx",
+      "emitters",   "n_topics",   "topics_off", "data_off",   "data_len",
+      "topics_pool", "data_pool", "proof_cls", "data_cls",    NULL};
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "O!OOOOOOOOOOOOOOy*y*OO", kwlist, &PyList_Type, &strs,
+          &rows_o, &group_o, &msgpos_o, &sbase_o, &nparents_o, &pepoch_o,
+          &cepoch_o, &exec_o, &event_o, &emit_o, &ntop_o, &toff_o, &doff_o,
+          &dlen_o, &tpool, &dpool, &proof_cls, &data_cls))
+    return NULL;
+  PyObject *result = NULL;
+  ClaimBuf rows = {0}, group = {0}, msgpos = {0}, sbase = {0}, nparents = {0},
+           pepoch = {0}, cepoch = {0}, execb = {0}, eventb = {0}, emitb = {0},
+           ntopb = {0}, toffb = {0}, doffb = {0}, dlenb = {0};
+  int have = 0;
+  if (!PyType_Check(proof_cls) || !PyType_Check(data_cls)) {
+    PyErr_SetString(PyExc_TypeError, "proof_cls/data_cls must be types");
+    goto done;
+  }
+  if (claim_buf(rows_o, 8, &rows, "rows") < 0) goto done;
+  have = 1;
+  if (claim_buf(group_o, 8, &group, "group_of") < 0) goto done;
+  have = 2;
+  if (claim_buf(msgpos_o, 8, &msgpos, "msg_pos") < 0) goto done;
+  have = 3;
+  if (claim_buf(sbase_o, 8, &sbase, "str_base") < 0) goto done;
+  have = 4;
+  if (claim_buf(nparents_o, 8, &nparents, "n_parents") < 0) goto done;
+  have = 5;
+  if (claim_buf(pepoch_o, 8, &pepoch, "parent_epoch") < 0) goto done;
+  have = 6;
+  if (claim_buf(cepoch_o, 8, &cepoch, "child_epoch") < 0) goto done;
+  have = 7;
+  if (claim_buf(exec_o, 4, &execb, "exec_idx") < 0) goto done;
+  have = 8;
+  if (claim_buf(event_o, 4, &eventb, "event_idx") < 0) goto done;
+  have = 9;
+  if (claim_buf(emit_o, 8, &emitb, "emitters") < 0) goto done;
+  have = 10;
+  if (claim_buf(ntop_o, 4, &ntopb, "n_topics") < 0) goto done;
+  have = 11;
+  if (claim_buf(toff_o, 4, &toffb, "topics_off") < 0) goto done;
+  have = 12;
+  if (claim_buf(doff_o, 4, &doffb, "data_off") < 0) goto done;
+  have = 13;
+  if (claim_buf(dlen_o, 4, &dlenb, "data_len") < 0) goto done;
+  have = 14;
+
+  {
+    Py_ssize_t n_claims = rows.n;
+    Py_ssize_t n_groups = sbase.n;
+    Py_ssize_t n_strs = PyList_GET_SIZE(strs);
+    const int64_t *rows_a = (const int64_t *)rows.buf;
+    const int64_t *group_a = (const int64_t *)group.buf;
+    const int64_t *msgpos_a = (const int64_t *)msgpos.buf;
+    const int64_t *sbase_a = (const int64_t *)sbase.buf;
+    const int64_t *nparents_a = (const int64_t *)nparents.buf;
+    const int64_t *pepoch_a = (const int64_t *)pepoch.buf;
+    const int64_t *cepoch_a = (const int64_t *)cepoch.buf;
+    const int32_t *exec_a = (const int32_t *)execb.buf;
+    const int32_t *event_a = (const int32_t *)eventb.buf;
+    const uint64_t *emit_a = (const uint64_t *)emitb.buf;
+    const int32_t *ntop_a = (const int32_t *)ntopb.buf;
+    const uint32_t *toff_a = (const uint32_t *)toffb.buf;
+    const uint32_t *doff_a = (const uint32_t *)doffb.buf;
+    const uint32_t *dlen_a = (const uint32_t *)dlenb.buf;
+    if (group.n != n_claims || msgpos.n != n_claims) {
+      PyErr_SetString(PyExc_ValueError, "claim column length mismatch");
+      goto done;
+    }
+    if (nparents.n != n_groups || pepoch.n != n_groups ||
+        cepoch.n != n_groups) {
+      PyErr_SetString(PyExc_ValueError, "group column length mismatch");
+      goto done;
+    }
+    Py_ssize_t n_rows_total = execb.n;
+    if (eventb.n != n_rows_total || emitb.n != n_rows_total ||
+        ntopb.n != n_rows_total || toffb.n != n_rows_total ||
+        doffb.n != n_rows_total || dlenb.n != n_rows_total) {
+      PyErr_SetString(PyExc_ValueError, "row column length mismatch");
+      goto done;
+    }
+    result = PyList_New(n_claims);
+    if (!result) goto done;
+    for (Py_ssize_t j = 0; j < n_claims; j++) {
+      int64_t row = rows_a[j], g = group_a[j], mp = msgpos_a[j];
+      if (g < 0 || g >= n_groups || row < 0 || row >= n_rows_total ||
+          mp < 0 || mp >= n_strs) {
+        PyErr_SetString(PyExc_IndexError, "claim index out of range");
+        goto claims_fail;
+      }
+      int64_t base = sbase_a[g], np_ = nparents_a[g];
+      if (base < 0 || np_ < 0 || base + np_ >= n_strs) {
+        PyErr_SetString(PyExc_IndexError, "group string span out of range");
+        goto claims_fail;
+      }
+      /* event_data */
+      int32_t nt = ntop_a[row];
+      if (nt < 0) nt = 0;
+      PyObject *topics = PyList_New(nt);
+      if (!topics) goto claims_fail;
+      for (int32_t k = 0; k < nt; k++) {
+        Py_ssize_t start = (Py_ssize_t)toff_a[row] + 32 * (Py_ssize_t)k;
+        PyObject *t = hex0x_from((const uint8_t *)tpool.buf, tpool.len,
+                                 start, start + 32);
+        if (!t) {
+          Py_DECREF(topics);
+          goto claims_fail;
+        }
+        PyList_SET_ITEM(topics, k, t);
+      }
+      PyObject *data_str =
+          hex0x_from((const uint8_t *)dpool.buf, dpool.len,
+                     (Py_ssize_t)doff_a[row],
+                     (Py_ssize_t)doff_a[row] + (Py_ssize_t)dlen_a[row]);
+      if (!data_str) {
+        Py_DECREF(topics);
+        goto claims_fail;
+      }
+      /* explicit dict construction: Py_BuildValue's "N" does not release
+       * pre-consumed arguments on failure, so an allocation failure
+       * mid-batch would leak the built topics/data/parents objects */
+      PyObject *emitter = PyLong_FromUnsignedLongLong(emit_a[row]);
+      PyObject *ed_fields = emitter ? PyDict_New() : NULL;
+      int ed_ok =
+          ed_fields != NULL &&
+          PyDict_SetItemString(ed_fields, "emitter", emitter) == 0 &&
+          PyDict_SetItemString(ed_fields, "topics", topics) == 0 &&
+          PyDict_SetItemString(ed_fields, "data", data_str) == 0;
+      Py_XDECREF(emitter);
+      Py_DECREF(topics);
+      Py_DECREF(data_str);
+      if (!ed_ok) {
+        Py_XDECREF(ed_fields);
+        goto claims_fail;
+      }
+      PyObject *event_data =
+          instance_with_dict((PyTypeObject *)data_cls, ed_fields);
+      if (!event_data) goto claims_fail;
+
+      PyObject *parents = PyList_GetSlice(strs, base, base + np_);
+      PyObject *pe = PyLong_FromLongLong(pepoch_a[g]);
+      PyObject *ce = PyLong_FromLongLong(cepoch_a[g]);
+      PyObject *xi = PyLong_FromLong(exec_a[row]);
+      PyObject *ei = PyLong_FromLong(event_a[row]);
+      PyObject *fields =
+          (parents && pe && ce && xi && ei) ? PyDict_New() : NULL;
+      int ok_f =
+          fields != NULL &&
+          PyDict_SetItemString(fields, "parent_epoch", pe) == 0 &&
+          PyDict_SetItemString(fields, "child_epoch", ce) == 0 &&
+          PyDict_SetItemString(fields, "parent_tipset_cids", parents) == 0 &&
+          PyDict_SetItemString(fields, "child_block_cid",
+                               PyList_GET_ITEM(strs, base + np_)) == 0 &&
+          PyDict_SetItemString(fields, "message_cid",
+                               PyList_GET_ITEM(strs, mp)) == 0 &&
+          PyDict_SetItemString(fields, "exec_index", xi) == 0 &&
+          PyDict_SetItemString(fields, "event_index", ei) == 0 &&
+          PyDict_SetItemString(fields, "event_data", event_data) == 0;
+      Py_XDECREF(parents);
+      Py_XDECREF(pe);
+      Py_XDECREF(ce);
+      Py_XDECREF(xi);
+      Py_XDECREF(ei);
+      Py_DECREF(event_data);
+      if (!ok_f) {
+        Py_XDECREF(fields);
+        goto claims_fail;
+      }
+      PyObject *proof = instance_with_dict((PyTypeObject *)proof_cls, fields);
+      if (!proof) goto claims_fail;
+      PyList_SET_ITEM(result, j, proof);
+    }
+    goto done;
+  claims_fail:
+    Py_CLEAR(result);
+  }
+
+done:
+  if (have >= 1) PyBuffer_Release(&rows.view);
+  if (have >= 2) PyBuffer_Release(&group.view);
+  if (have >= 3) PyBuffer_Release(&msgpos.view);
+  if (have >= 4) PyBuffer_Release(&sbase.view);
+  if (have >= 5) PyBuffer_Release(&nparents.view);
+  if (have >= 6) PyBuffer_Release(&pepoch.view);
+  if (have >= 7) PyBuffer_Release(&cepoch.view);
+  if (have >= 8) PyBuffer_Release(&execb.view);
+  if (have >= 9) PyBuffer_Release(&eventb.view);
+  if (have >= 10) PyBuffer_Release(&emitb.view);
+  if (have >= 11) PyBuffer_Release(&ntopb.view);
+  if (have >= 12) PyBuffer_Release(&toffb.view);
+  if (have >= 13) PyBuffer_Release(&doffb.view);
+  if (have >= 14) PyBuffer_Release(&dlenb.view);
+  PyBuffer_Release(&tpool);
+  PyBuffer_Release(&dpool);
+  return result;
+}
+
 /* ---------------- witness materialization ----------------
  *
  * Phase D of the range driver: turn the deduplicated witness CID-byte set
@@ -2682,8 +2970,7 @@ static PyObject *py_materialize_blocks(PyObject *self, PyObject *args,
     return NULL;
   }
   const CMap *snap_map = NULL;
-  int snap_complete = 0;
-  if (snapshot_resolve(snap_obj, blocks, &snap_map, &snap_complete) < 0)
+  if (snapshot_resolve(snap_obj, blocks, &snap_map, NULL) < 0)
     return NULL;
   PyObject *seq = PySequence_Fast(todo, "todo must be a sequence of cid bytes");
   if (!seq) return NULL;
@@ -2931,6 +3218,13 @@ static PyMethodDef methods[] = {
      " path walks to each wanted index plus full events-AMT walks beneath,"
      " returning flat payload-mode event arrays, touched block CIDs (grouped),"
      " and per-group failed flags."},
+    {"build_event_claims",
+     (PyCFunction)(void (*)(void))py_build_event_claims,
+     METH_VARARGS | METH_KEYWORDS,
+     "build_event_claims(strs, rows, group_of, msg_pos, str_base, n_parents,"
+     " parent_epoch, child_epoch, exec_idx, event_idx, emitters, n_topics,"
+     " topics_off, data_off, data_len, topics_pool, data_pool, proof_cls,"
+     " data_cls) -> list[EventProof] — Phase C claim construction in C."},
     {"materialize_blocks",
      (PyCFunction)(void (*)(void))py_materialize_blocks,
      METH_VARARGS | METH_KEYWORDS,

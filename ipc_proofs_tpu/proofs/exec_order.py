@@ -281,7 +281,11 @@ def reconstruct_execution_orders_batch(
     # one call; any mismatch localizes scalar so per-group failure
     # semantics stay exactly the scalar path's.
     if recompute_cids:
-        raw_map = store.raw_map() if hasattr(store, "raw_map") else None
+        raw_map = (
+            store._raw_readonly()
+            if hasattr(store, "_raw_readonly")
+            else store.raw_map() if hasattr(store, "raw_map") else None
+        )
         raws = []
         for cid_b in recompute_cids:
             raw_block = (

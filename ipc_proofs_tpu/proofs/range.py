@@ -716,7 +716,25 @@ def _record_pass2_native(
     from ipc_proofs_tpu.backend.native import load_dagcbor_ext
 
     witness: set[bytes] = set()
+    witness_items: list[bytes] = []  # good-group flat appends; one union below
     goff = rec.row_offsets(len(matching_pairs))
+    # ONE vectorized pass resolves every claim row and its group up front
+    # (a per-group nonzero over the mask slice was ~2 us x thousands of
+    # groups). side="right" minus 1 maps a row offset to the unique group
+    # whose [goff[g], goff[g+1]) span contains it, including through runs
+    # of empty groups with equal offsets.
+    rows_by_group: "dict[int, tuple[list[int], list[int]]]" = {}
+    if mask.size:
+        sel = np.nonzero(mask)[0]
+        if len(sel):
+            sel_group = (np.searchsorted(goff, sel, side="right") - 1).tolist()
+            sel_exec = sb.exec_idx[sel].tolist()
+            for g_, r_, e_ in zip(sel_group, sel.tolist(), sel_exec):
+                entry = rows_by_group.get(g_)
+                if entry is None:
+                    entry = rows_by_group[g_] = ([], [])
+                entry[0].append(r_)
+                entry[1].append(e_)
     per_group_proofs: "list[list]" = [[] for _ in matching_pairs]
     claim_rows: "list[tuple[int, int]]" = []  # (group, row)
     str_bytes: "list[bytes]" = []  # cid bytes to render, in claim order
@@ -747,85 +765,132 @@ def _record_pass2_native(
             if i >= len(exec_msgs):
                 raise KeyError(f"missing message at execution index {i}")
 
-        for parent_cid in pair.parent.cids:
-            witness.add(parent_cid.to_bytes())
-        witness.add(pair.child.cids[0].to_bytes())
-        witness.add(pair.child.blocks[0].parent_message_receipts.to_bytes())
-        for header in pair.parent.blocks:
-            witness.add(header.messages.to_bytes())
-        witness.update(exec_touched)
-        witness.update(rec.touched(g))
+        # flat appends here, ONE set union after the loop — per-group set
+        # inserts were a top cost of the assembly at range scale
+        witness_items.extend(c.to_bytes() for c in pair.parent.cids)
+        witness_items.append(pair.child.cids[0].to_bytes())
+        witness_items.append(pair.child.blocks[0].parent_message_receipts.to_bytes())
+        witness_items.extend(h.messages.to_bytes() for h in pair.parent.blocks)
+        witness_items.extend(exec_touched)
+        witness_items.extend(rec.touched(g))
 
-        lo, hi = int(goff[g]), int(goff[g + 1])
-        if lo == hi:
+        grp = rows_by_group.get(g)
+        if grp is None:
             continue
-        rows = np.nonzero(mask[lo:hi])[0]
-        if not len(rows):
-            continue
+        rows, execs = grp
         group_str_base[g] = len(str_bytes)
         str_bytes.extend(c.to_bytes() for c in pair.parent.cids)
         str_bytes.append(pair.child.cids[0].to_bytes())
-        for rel in rows:
-            row = int(rel) + lo
+        for row, exec_i in zip(rows, execs):
             claim_rows.append((g, row))
-            str_bytes.append(exec_msgs[int(sb.exec_idx[row])])
+            str_bytes.append(exec_msgs[exec_i])
 
+    witness.update(witness_items)
     ext = load_dagcbor_ext()
     if ext is not None and hasattr(ext, "cid_strs"):
         strs = ext.cid_strs(str_bytes)
     else:
         strs = [str(CID.from_bytes(b)) for b in str_bytes]
 
-    # gather every claim's columns in one numpy fancy-index per column —
-    # per-claim np-scalar int() conversions were the loop's hottest ops
-    if claim_rows:
-        rows_arr = np.fromiter(
-            (row for _, row in claim_rows), dtype=np.int64, count=len(claim_rows)
-        )
-        exec_idx_l = sb.exec_idx[rows_arr].tolist()
-        event_idx_l = sb.event_idx[rows_arr].tolist()
-        emitters_l = sb.emitters[rows_arr].tolist()
-        n_topics_l = sb.n_topics[rows_arr].tolist()
-        toff_l = sb.topics_off[rows_arr].tolist()
-        doff_l = sb.data_off[rows_arr].tolist()
-        dlen_l = sb.data_len[rows_arr].tolist()
-    topics_pool = sb.topics_pool
-    data_pool = sb.data_pool
-    make_proof = EventProof._make
-    make_data = EventData._make
-
+    # message-cid string positions are laid out per group after its
+    # parents+child block; claims of one group are contiguous in claim_rows
+    msg_pos: "list[int]" = []
     pos = 0
-    for j, (g, row) in enumerate(claim_rows):
-        pair = matching_pairs[g][0]
-        base = group_str_base[g]
-        n_parents = len(pair.parent.cids)
-        # claims of one group are contiguous in claim_rows; `pos` walks the
-        # message-cid slots laid out after the group's parents+child block
-        if pos < base + n_parents + 1:
-            pos = base + n_parents + 1
-        nt = n_topics_l[j]
-        toff = toff_l[j]
-        doff = doff_l[j]
-        per_group_proofs[g].append(
-            make_proof(
-                parent_epoch=pair.parent.height,
-                child_epoch=pair.child.height,
-                parent_tipset_cids=strs[base : base + n_parents],
-                child_block_cid=strs[base + n_parents],
-                message_cid=strs[pos],
-                exec_index=exec_idx_l[j],
-                event_index=event_idx_l[j],
-                event_data=make_data(
-                    emitter=emitters_l[j],
-                    topics=[
-                        "0x" + topics_pool[toff + 32 * k : toff + 32 * (k + 1)].hex()
-                        for k in range(nt)
-                    ],
-                    data="0x" + data_pool[doff : doff + dlen_l[j]].hex(),
-                ),
-            )
-        )
+    for g, _row in claim_rows:
+        base = group_str_base[g] + len(matching_pairs[g][0].parent.cids) + 1
+        pos = base if pos < base else pos
+        msg_pos.append(pos)
         pos += 1
+
+    from ipc_proofs_tpu.backend.native import load_scan_ext
+
+    scan_ext = load_scan_ext()
+    if claim_rows and scan_ext is not None and hasattr(scan_ext, "build_event_claims"):
+        n_groups = len(matching_pairs)
+        claims = scan_ext.build_event_claims(
+            strs=strs,
+            rows=np.fromiter(
+                (row for _, row in claim_rows), np.int64, count=len(claim_rows)
+            ),
+            group_of=np.fromiter(
+                (g for g, _ in claim_rows), np.int64, count=len(claim_rows)
+            ),
+            msg_pos=np.asarray(msg_pos, np.int64),
+            str_base=np.fromiter(
+                (group_str_base.get(g, 0) for g in range(n_groups)),
+                np.int64, count=n_groups,
+            ),
+            n_parents=np.fromiter(
+                (len(p.parent.cids) for p, _ in matching_pairs),
+                np.int64, count=n_groups,
+            ),
+            parent_epoch=np.fromiter(
+                (p.parent.height for p, _ in matching_pairs),
+                np.int64, count=n_groups,
+            ),
+            child_epoch=np.fromiter(
+                (p.child.height for p, _ in matching_pairs),
+                np.int64, count=n_groups,
+            ),
+            exec_idx=sb.exec_idx,
+            event_idx=sb.event_idx,
+            emitters=sb.emitters,
+            n_topics=sb.n_topics,
+            topics_off=sb.topics_off,
+            data_off=sb.data_off,
+            data_len=sb.data_len,
+            topics_pool=sb.topics_pool,
+            data_pool=sb.data_pool,
+            proof_cls=EventProof,
+            data_cls=EventData,
+        )
+        for (g, _row), proof in zip(claim_rows, claims):
+            per_group_proofs[g].append(proof)
+    else:
+        # gather every claim's columns in one numpy fancy-index per column —
+        # per-claim np-scalar int() conversions were the loop's hottest ops
+        if claim_rows:
+            rows_arr = np.fromiter(
+                (row for _, row in claim_rows), dtype=np.int64, count=len(claim_rows)
+            )
+            exec_idx_l = sb.exec_idx[rows_arr].tolist()
+            event_idx_l = sb.event_idx[rows_arr].tolist()
+            emitters_l = sb.emitters[rows_arr].tolist()
+            n_topics_l = sb.n_topics[rows_arr].tolist()
+            toff_l = sb.topics_off[rows_arr].tolist()
+            doff_l = sb.data_off[rows_arr].tolist()
+            dlen_l = sb.data_len[rows_arr].tolist()
+        topics_pool = sb.topics_pool
+        data_pool = sb.data_pool
+        make_proof = EventProof._make
+        make_data = EventData._make
+
+        for j, (g, row) in enumerate(claim_rows):
+            pair = matching_pairs[g][0]
+            base = group_str_base[g]
+            n_parents = len(pair.parent.cids)
+            nt = n_topics_l[j]
+            toff = toff_l[j]
+            doff = doff_l[j]
+            per_group_proofs[g].append(
+                make_proof(
+                    parent_epoch=pair.parent.height,
+                    child_epoch=pair.child.height,
+                    parent_tipset_cids=strs[base : base + n_parents],
+                    child_block_cid=strs[base + n_parents],
+                    message_cid=strs[msg_pos[j]],
+                    exec_index=exec_idx_l[j],
+                    event_index=event_idx_l[j],
+                    event_data=make_data(
+                        emitter=emitters_l[j],
+                        topics=[
+                            "0x" + topics_pool[toff + 32 * k : toff + 32 * (k + 1)].hex()
+                            for k in range(nt)
+                        ],
+                        data="0x" + data_pool[doff : doff + dlen_l[j]].hex(),
+                    ),
+                )
+            )
 
     proofs: list = []
     for group_proofs in per_group_proofs:

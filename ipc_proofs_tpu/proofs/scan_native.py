@@ -14,6 +14,7 @@ deliberately witness-free in both designs.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -176,7 +177,7 @@ def has_raw_map(store: Blockstore) -> bool:
 def _raw_view(store: Blockstore):
     """(raw_dict, fallback_callable) for the C scanner's block access."""
     if isinstance(store, MemoryBlockstore):
-        return store.raw_map(), None
+        return store._raw_readonly(), None
     if isinstance(store, CachedBlockstore):
         inner_raw, inner_fallback = _raw_view(store._inner)
         if inner_fallback is None:
@@ -186,6 +187,9 @@ def _raw_view(store: Blockstore):
         return store.get(CID.from_bytes(cid_bytes))
 
     return {}, fallback
+
+
+_snapshot_build_lock = threading.Lock()
 
 
 def _snapshot_of(store: Blockstore, raw: dict, work: "Optional[int]" = None):
@@ -214,7 +218,7 @@ def _snapshot_of(store: Blockstore, raw: dict, work: "Optional[int]" = None):
     owner = store
     while isinstance(owner, CachedBlockstore):
         owner = owner._inner
-    if not isinstance(owner, MemoryBlockstore) or owner.raw_map() is not raw:
+    if not isinstance(owner, MemoryBlockstore) or owner._raw_readonly() is not raw:
         return None
     from ipc_proofs_tpu.backend.native import load_scan_ext
 
@@ -227,8 +231,15 @@ def _snapshot_of(store: Blockstore, raw: dict, work: "Optional[int]" = None):
         return cached[1]
     if work is not None and (work < 64 or len(raw) > 256 * work):
         return None  # build would cost more than the probes it replaces
-    snap = ext.make_snapshot(raw)
-    owner._scan_snapshot = (version, snap)
+    # serialize builds: the pipelined driver's scan worker and the record
+    # phase can race here, and a duplicate O(|store|) build is exactly the
+    # cost this cache exists to remove
+    with _snapshot_build_lock:
+        cached = getattr(owner, "_scan_snapshot", None)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        snap = ext.make_snapshot(raw)
+        owner._scan_snapshot = (version, snap)
     return snap
 
 

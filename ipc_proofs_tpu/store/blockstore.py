@@ -111,7 +111,21 @@ class MemoryBlockstore:
 
     def raw_map(self) -> dict[bytes, bytes]:
         """Live view keyed by raw CID bytes — the native scanner's fast path
-        (C-side dict lookups, no CID object construction per block)."""
+        (C-side dict lookups, no CID object construction per block).
+
+        Counts as a WRITE for snapshot purposes: callers legitimately mutate
+        the returned dict directly (tests model corruption exactly this
+        way), which the put_keyed mutation counter cannot see — so every
+        grab of the mutable view conservatively invalidates any cached scan
+        snapshot. Internal read-only consumers use `_raw_readonly()`, which
+        does not. A held reference must not be mutated after later native
+        walks; re-grab the view instead."""
+        self._mutations += 1
+        return self._raw
+
+    def _raw_readonly(self) -> dict[bytes, bytes]:
+        """`raw_map()` for internal readers that promise not to mutate —
+        does not invalidate the cached scan snapshot."""
         return self._raw
 
 

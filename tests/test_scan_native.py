@@ -811,3 +811,29 @@ class TestMaterializeBlocks:
             ext.materialize_blocks(raw, [b"ok", "not-bytes"], dext.make_cids, ProofBlock)
         with pytest.raises(ValueError):
             ext.materialize_blocks(raw, [b"\x00garbage"], dext.make_cids, ProofBlock)
+
+    def test_raw_map_grab_invalidates_cached_snapshot(self):
+        """Direct mutation through raw_map() (how tests model corruption)
+        cannot be seen by the put_keyed mutation counter — so grabbing the
+        mutable view must itself invalidate the cached snapshot, or a
+        forged block would be scanned with its pre-mutation bytes."""
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.proofs.scan_native import _raw_view, _snapshot_of
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs, _todo = self._witness()
+        raw, _ = _raw_view(bs)
+        s1 = _snapshot_of(bs, raw)
+        assert s1 is not None
+        # the grab alone (before any mutation) must force a rebuild
+        view = bs.raw_map()
+        s2 = _snapshot_of(bs, raw)
+        assert s2 is not s1
+        # and a mutation through the grabbed view is visible to the next
+        # walk because the NEXT grab invalidates again
+        key = next(iter(view))
+        bs.raw_map()[key] = view[key]
+        s3 = _snapshot_of(bs, raw)
+        assert s3 is not s2
