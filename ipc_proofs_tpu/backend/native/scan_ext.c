@@ -977,6 +977,11 @@ done:;
 
 typedef int (*leaf_fn)(Scan *s, Parser *p, int64_t index, void *ctx);
 
+/* receipts-leaf batching (scan pipeline; defined after the walkers) */
+static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx);
+static int receipt_batch_run(Scan *s, Parser *p, const int64_t *indices,
+                             int n_idx, void *ctx);
+
 static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
                      Parser *inline_node, int bit_width, int height,
                      int64_t base, leaf_fn fn, void *ctx) {
@@ -1037,6 +1042,40 @@ static int walk_node(Scan *s, const uint8_t *cid, Py_ssize_t clen,
   /* pop-count ascending slots; links/values appear in set-bit order */
   int64_t span = 1;
   for (int h = 0; h < height; h++) span *= width;
+
+  /* Receipts-leaf pipeline: on the snapshot path (no touch recording —
+   * the scan never records), collect the leaf's value slots first, then
+   * run the 3-pass parse/prefetch/walk batch. Error ORDER is preserved:
+   * a structural bitmap/values error at slot k is DEFERRED until the
+   * prefix's receipts (and their events AMTs) processed cleanly — exactly
+   * when the sequential walk would have reached it. */
+  if (height == 0 && fn == receipt_leaf && s->cmap && !s->touch_pool) {
+    int64_t slots_buf[256];
+    int n_slots = 0;
+    const char *deferred = NULL;
+    for (int byte_i = 0; byte_i * 8 < width && !deferred; byte_i++) {
+      unsigned bits = bmap[byte_i];
+      if (width - byte_i * 8 < 8) bits &= (1u << (width - byte_i * 8)) - 1;
+      while (bits) {
+        int slot = byte_i * 8 + __builtin_ctz(bits);
+        bits &= bits - 1;
+        if ((uint64_t)n_slots >= n_values) {
+          deferred = "AMT leaf bitmap/values mismatch";
+          break;
+        }
+        slots_buf[n_slots++] = base + slot;
+      }
+    }
+    if (!deferred && (uint64_t)n_slots != n_values)
+      deferred = "AMT leaf value count mismatch";
+    if (receipt_batch_run(s, p, slots_buf, n_slots, ctx) < 0) goto out;
+    if (deferred) {
+      walk_err(E_VALUE, deferred);
+      goto out;
+    }
+    rc = 0;
+    goto out;
+  }
 
   /* iterate SET bits via ctz instead of testing all `width` slots — same
    * ascending slot order and pos counting; bits at positions >= width are
@@ -1252,8 +1291,11 @@ typedef struct {
   int32_t pair_id;
 } RcptCtx;
 
-static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
-  RcptCtx *c = (RcptCtx *)ctx;
+/* parse one receipt tuple; on success *has_ev/*ev_cid/*ev_len describe its
+ * events root (absent for 3-tuples and null links) */
+static int receipt_parse(Parser *p, const uint8_t **ev_cid, Py_ssize_t *ev_len,
+                         int *has_ev) {
+  *has_ev = 0;
   uint64_t arity;
   if (rd_array(p, &arity) < 0) return -1;
   if (arity != 3 && arity != 4) {
@@ -1264,12 +1306,19 @@ static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   if (skip_item(p) < 0) return -1; /* return_data */
   if (skip_item(p) < 0) return -1; /* gas_used */
   if (arity == 3) return 0;        /* no events root */
+  int ok;
+  if (rd_cid_or_null(p, ev_cid, ev_len, &ok) < 0) return -1;
+  *has_ev = ok; /* null events root: skip (scan_receipt_events parity) */
+  return 0;
+}
+
+static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
+  RcptCtx *c = (RcptCtx *)ctx;
   const uint8_t *ev_cid;
   Py_ssize_t ev_len;
-  int ok;
-  if (rd_cid_or_null(p, &ev_cid, &ev_len, &ok) < 0) return -1;
-  if (!ok) return 0; /* null events root: skip (scan_receipt_events parity) */
-
+  int has_ev;
+  if (receipt_parse(p, &ev_cid, &ev_len, &has_ev) < 0) return -1;
+  if (!has_ev) return 0;
   if (index > INT32_MAX) {
     walk_err(E_VALUE, "receipt index exceeds int32 range");
     return -1;
@@ -1277,6 +1326,87 @@ static int receipt_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
   s->n_receipts++;
   EvCtx ec = {c->pair_id, (int32_t)index, 0};
   return walk_amt_root(s, ev_cid, ev_len, 3, event_leaf, &ec);
+}
+
+/* The scan's hottest memory pattern is one dependent-load chain per
+ * receipt: cmap slot -> block bytes -> AMT root parse. Per LEAF (up to
+ * `width` receipts) the batch splits it into passes so the loads overlap:
+ * pass 1 parses every receipt and prefetches its events root's cmap slot;
+ * pass 2 resolves the slots and prefetches the block bytes; pass 3 walks
+ * each events AMT in index order. Semantics are the sequential loop's
+ * exactly — a parse error at receipt k is DEFERRED until receipts < k
+ * (and their events AMTs) completed, which is when the sequential walk
+ * would have hit it; cmap misses re-enter the ordinary get_block path. */
+static int receipt_batch_run(Scan *s, Parser *p, const int64_t *indices,
+                             int n_idx, void *ctx) {
+  RcptCtx *c = (RcptCtx *)ctx;
+  const uint8_t *ev_cid[256];
+  Py_ssize_t ev_len[256];
+  int64_t ev_index[256];
+  const CEntry *ents[256];
+  int n_ev = 0;
+  /* a pass-1 parse error must not land in the first-wins t_err yet: the
+   * sequential walk runs EARLIER receipts' events AMTs before reaching the
+   * malformed receipt, so any error THEY raise (missing block, non-bytes
+   * value, OOM) takes precedence. Stash the parse error, clear t_err, and
+   * restore it only if the prefix's walks recorded nothing. */
+  WalkErr deferred_err;
+  deferred_err.kind = E_NONE;
+  int parse_failed = 0;
+  for (int i = 0; i < n_idx; i++) {
+    const uint8_t *cid = NULL;
+    Py_ssize_t clen = 0;
+    int has = 0;
+    if (receipt_parse(p, &cid, &clen, &has) < 0) {
+      deferred_err = t_err;
+      t_err.kind = E_NONE;
+      parse_failed = 1;
+      break;
+    }
+    if (!has) continue;
+    if (indices[i] > INT32_MAX) {
+      deferred_err.kind = E_VALUE;
+      strcpy(deferred_err.msg, "receipt index exceeds int32 range");
+      parse_failed = 1;
+      break;
+    }
+    ev_cid[n_ev] = cid;
+    ev_len[n_ev] = clen;
+    ev_index[n_ev] = indices[i];
+    __builtin_prefetch(&s->cmap->slots[cmap_hash(cid, clen) & s->cmap->mask]);
+    n_ev++;
+  }
+  for (int k = 0; k < n_ev; k++) {
+    ents[k] = cmap_get(s->cmap, ev_cid[k], ev_len[k]);
+    if (ents[k] && ents[k]->vlen >= 0) {
+      __builtin_prefetch(ents[k]->val);
+      if (ents[k]->vlen > 64) __builtin_prefetch(ents[k]->val + 64);
+      if (ents[k]->vlen > 128) __builtin_prefetch(ents[k]->val + 128);
+    }
+  }
+  for (int k = 0; k < n_ev; k++) {
+    s->n_receipts++;
+    EvCtx ec = {c->pair_id, (int32_t)ev_index[k], 0};
+    const CEntry *e = ents[k];
+    if (!e) {
+      /* miss: the ordinary root walk redoes get_block, which falls
+       * through to the live dict / fallback exactly as unbatched */
+      if (walk_amt_root(s, ev_cid[k], ev_len[k], 3, event_leaf, &ec) < 0)
+        return -1;
+      continue;
+    }
+    if (e->vlen == -2) return walk_err(E_TYPE, "block map values must be bytes");
+    if (s->validate && validate_block(e->val, e->vlen) < 0) return -1;
+    Parser rp = {e->val, e->vlen, 0};
+    int bw, h;
+    if (parse_amt_root(&rp, 3, &bw, &h) < 0) return -1;
+    if (walk_node(s, NULL, 0, &rp, bw, h, 0, event_leaf, &ec) < 0) return -1;
+  }
+  if (parse_failed) {
+    if (t_err.kind == E_NONE && !PyErr_Occurred()) t_err = deferred_err;
+    return -1;
+  }
+  return 0;
 }
 
 /* ---------------- module entry ---------------- */

@@ -837,3 +837,47 @@ class TestMaterializeBlocks:
         bs.raw_map()[key] = view[key]
         s3 = _snapshot_of(bs, raw)
         assert s3 is not s2
+
+
+class TestReceiptBatchErrorOrder:
+    """The batched receipts-leaf pipeline parses ahead of the walks; error
+    PRECEDENCE must still be the sequential loop's — an earlier receipt's
+    events-walk failure (here: missing block, KeyError) outranks a later
+    receipt's parse error (ValueError) even though the batch discovers the
+    parse error first."""
+
+    def test_earlier_walk_error_beats_later_parse_error(self, monkeypatch):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.ipld.amt import AMT
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs = MemoryBlockstore()
+        events = [
+            [EventFixture(emitter=ACTOR, signature=SIG, topic1="a")],
+            [EventFixture(emitter=ACTOR, signature=SIG, topic1="b")],
+        ]
+        world = build_chain([ContractFixture(actor_id=ACTOR)], events, store=bs)
+        root = world.child.blocks[0].parent_message_receipts
+        receipts = dict(AMT.load(bs, root, expected_version=0).items())
+        ev_root_0 = receipts[0][3]  # receipt 0's events root CID
+
+        d = dict(bs.raw_map())
+        del d[ev_root_0.to_bytes()]  # receipt 0's events walk: KeyError
+        # truncate the receipts root block inside receipt 1's tuple tail:
+        # its parse now fails with a truncation ValueError
+        d[root.to_bytes()] = d[root.to_bytes()][:-2]
+        rb = [root.to_bytes()]
+
+        monkeypatch.setenv("IPC_SCAN_NO_SNAPSHOT", "1")
+        with pytest.raises((KeyError, ValueError)) as seq_err:
+            ext.scan_events_batch(d, rb, None)
+        monkeypatch.delenv("IPC_SCAN_NO_SNAPSHOT")
+        snap = ext.make_snapshot(d)
+        with pytest.raises((KeyError, ValueError)) as batch_err:
+            ext.scan_events_batch(d, rb, None, snapshot=snap)
+        assert type(batch_err.value) is type(seq_err.value)
+        assert str(batch_err.value) == str(seq_err.value)
+        # and the sequential error really is the earlier receipt's walk error
+        assert isinstance(seq_err.value, KeyError)
