@@ -1403,7 +1403,10 @@ static int receipt_batch_run(Scan *s, Parser *p, const int64_t *indices,
     if (walk_node(s, NULL, 0, &rp, bw, h, 0, event_leaf, &ec) < 0) return -1;
   }
   if (parse_failed) {
-    if (t_err.kind == E_NONE && !PyErr_Occurred()) t_err = deferred_err;
+    /* pass 3 completed without error to reach here, so nothing newer can
+     * be pending — and NO PyErr calls on this path: it runs on GIL-free
+     * worker threads with no Python thread state */
+    if (t_err.kind == E_NONE) t_err = deferred_err;
     return -1;
   }
   return 0;
@@ -1942,6 +1945,27 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
     if (vec_push(&touch_goff, &tcount, 4) < 0) goto out;
     if (vec_push(&tx_goff, &xcount, 4) < 0) goto out;
 
+    /* overlap the NEXT group's first dependent loads (header/TxMeta probe
+     * slots) with this group's walk — snapshot path only. Peek ONLY
+     * list/tuple groups: PySequence_Fast on a one-shot iterator would
+     * exhaust it before its real pass (lists/tuples convert
+     * idempotently); other group types just skip the prefetch. */
+    if (snap_map && g + 1 < n_groups) {
+      PyObject *nxt = PySequence_Fast_GET_ITEM(gseq, g + 1);
+      if (PyList_Check(nxt) || PyTuple_Check(nxt)) {
+        Py_ssize_t nn = PySequence_Size(nxt);
+        for (Py_ssize_t i = 0; i < nn; i++) {
+          PyObject *o = PyList_Check(nxt) ? PyList_GET_ITEM(nxt, i)
+                                          : PyTuple_GET_ITEM(nxt, i);
+          if (PyBytes_Check(o))
+            __builtin_prefetch(
+                &snap_map->slots[cmap_hash((const uint8_t *)PyBytes_AS_STRING(o),
+                                           PyBytes_GET_SIZE(o)) &
+                                 snap_map->mask]);
+        }
+      }
+    }
+
     PyObject *grp = PySequence_Fast(PySequence_Fast_GET_ITEM(gseq, g),
                                     "group must be a sequence of cid bytes");
     if (!grp) goto out;
@@ -2165,6 +2189,15 @@ static PyObject *py_record_receipt_paths(PyObject *self, PyObject *args,
     if (!PyBytes_Check(root)) {
       PyErr_SetString(PyExc_TypeError, "roots must be bytes (raw CID bytes)");
       goto out;
+    }
+    /* overlap the next group's root probe with this group's walks */
+    if (snap_map && g + 1 < n_groups) {
+      PyObject *nr = PySequence_Fast_GET_ITEM(rseq, g + 1);
+      if (PyBytes_Check(nr))
+        __builtin_prefetch(
+            &snap_map->slots[cmap_hash((const uint8_t *)PyBytes_AS_STRING(nr),
+                                       PyBytes_GET_SIZE(nr)) &
+                             snap_map->mask]);
     }
     int ok = 1;
     BlockRef root_block = {0};

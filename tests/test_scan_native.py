@@ -881,3 +881,59 @@ class TestReceiptBatchErrorOrder:
         assert str(batch_err.value) == str(seq_err.value)
         # and the sequential error really is the earlier receipt's walk error
         assert isinstance(seq_err.value, KeyError)
+
+    def test_threaded_batch_with_malformed_receipt(self, monkeypatch):
+        """A malformed receipt on the GIL-free threaded snapshot path must
+        surface as the proper error (not crash): the deferred-error restore
+        runs on worker threads with no Python thread state."""
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.ipld.amt import AMT
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs = MemoryBlockstore()
+        roots = []
+        for p in range(96):
+            events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1=f"x{p}")],
+                      [EventFixture(emitter=ACTOR, signature=SIG, topic1=f"y{p}")]]
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)], events,
+                parent_height=2000 + p, store=bs,
+            )
+            roots.append(world.child.blocks[0].parent_message_receipts)
+        d = dict(bs.raw_map())
+        # truncate one mid-range receipts root inside its second receipt
+        bad = roots[40]
+        d[bad.to_bytes()] = d[bad.to_bytes()][:-2]
+        snap = ext.make_snapshot(d)
+        rb = [c.to_bytes() for c in roots]
+        monkeypatch.setenv("IPC_SCAN_THREADS", "4")
+        with pytest.raises(ValueError):
+            ext.scan_events_batch(d, rb, None, snapshot=snap)
+
+    def test_exec_orders_generator_groups_with_snapshot(self):
+        """collect_exec_orders accepts one-shot iterables for groups; the
+        next-group prefetch peek must not exhaust them."""
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        ext = load_scan_ext()
+        if not hasattr(ext, "make_snapshot"):
+            pytest.skip("extension predates snapshots")
+        bs = MemoryBlockstore()
+        tx_groups = []
+        for p in range(3):
+            events = [[EventFixture(emitter=ACTOR, signature=SIG, topic1=f"g{p}")]]
+            world = build_chain(
+                [ContractFixture(actor_id=ACTOR)], events,
+                parent_height=3000 + p, store=bs,
+            )
+            tx_groups.append([h.messages.to_bytes() for h in world.parent.blocks])
+        raw = bs.raw_map()
+        snap = ext.make_snapshot(raw)
+        lists = ext.collect_exec_orders(raw, tx_groups, None, headers=False)
+        gens = ext.collect_exec_orders(
+            raw, [iter(g) for g in tx_groups], None, headers=False,
+            snapshot=snap,
+        )
+        assert lists == gens
