@@ -739,6 +739,7 @@ def _record_pass2_native(
     claim_rows: "list[tuple[int, int]]" = []  # (group, row)
     str_bytes: "list[bytes]" = []  # cid bytes to render, in claim order
     group_str_base: "dict[int, int]" = {}  # group → offset of its parents+child
+    good: "list[int]" = []  # native-handled groups (witness gathered flat below)
     for g, (pair, matching) in enumerate(matching_pairs):
         walk = walks[g]
         if walk is None or rec.failed[g]:
@@ -764,15 +765,8 @@ def _record_pass2_native(
         for i in matching:
             if i >= len(exec_msgs):
                 raise KeyError(f"missing message at execution index {i}")
-
-        # flat appends here, ONE set union after the loop — per-group set
-        # inserts were a top cost of the assembly at range scale
-        witness_items.extend(c.to_bytes() for c in pair.parent.cids)
-        witness_items.append(pair.child.cids[0].to_bytes())
-        witness_items.append(pair.child.blocks[0].parent_message_receipts.to_bytes())
-        witness_items.extend(h.messages.to_bytes() for h in pair.parent.blocks)
+        good.append(g)
         witness_items.extend(exec_touched)
-        witness_items.extend(rec.touched(g))
 
         grp = rows_by_group.get(g)
         if grp is None:
@@ -785,6 +779,24 @@ def _record_pass2_native(
             claim_rows.append((g, row))
             str_bytes.append(exec_msgs[exec_i])
 
+    # header-derived witness CIDs for all good groups in four flat
+    # comprehensions (per-group extends cost a genexp per group), plus the
+    # recorder's touched blocks — ALL of them when no group fell back
+    # (the common case: one list, no per-group slicing)
+    good_pairs = [matching_pairs[g][0] for g in good]
+    witness_items += [c.to_bytes() for p in good_pairs for c in p.parent.cids]
+    witness_items += [p.child.cids[0].to_bytes() for p in good_pairs]
+    witness_items += [
+        p.child.blocks[0].parent_message_receipts.to_bytes() for p in good_pairs
+    ]
+    witness_items += [
+        h.messages.to_bytes() for p in good_pairs for h in p.parent.blocks
+    ]
+    if len(good) == len(matching_pairs):
+        witness_items.extend(rec.all_touched())
+    else:
+        for g in good:
+            witness_items.extend(rec.touched(g))
     witness.update(witness_items)
     ext = load_dagcbor_ext()
     if ext is not None and hasattr(ext, "cid_strs"):

@@ -274,6 +274,16 @@ class RecordBatch:
         lo, hi = int(self._touch_goff[group]), int(self._touch_goff[group + 1])
         return self._touch_items[lo:hi]
 
+    def all_touched(self) -> "list[bytes]":
+        """Every group's touched blocks as ONE list (failed groups' spans
+        are truncated in C, so this is the union over successful groups) —
+        callers whose groups ALL succeeded skip the per-group slicing."""
+        if self._touch_items is None:
+            self._touch_items = split_pooled(
+                self._touch_pool, self._touch_off, self._touch_len
+            )
+        return self._touch_items
+
     def row_offsets(self, n_groups: int) -> np.ndarray:
         """Group row boundaries into ``batch`` as one [n_groups+1] array
         (rows are emitted in ascending group order): group g's events are
