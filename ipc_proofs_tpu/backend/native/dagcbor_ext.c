@@ -1045,6 +1045,85 @@ static int skip_item_inner(Parser *p) {
 static const char header_keep[16] = {0, 0, 0, 0, 0, 1, 1, 1,
                                      1, 1, 1, 0, 1, 0, 1, 0};
 
+/* decode_header_lite(raw) -> (parents, height, parent_state_root,
+ * parent_message_receipts, messages): the five fields verification reads,
+ * with state/header.py's _validate_core_fields folded in. Acceptance is
+ * EXACTLY decode_header + the Python validation: the full grammar is
+ * walked first (so a later field's grammar error outranks a type
+ * error, as in the Python ordering), then the kept fields type-check. */
+static const char header_lite_keep[16] = {0, 0, 0, 0, 0, 1, 0, 1,
+                                          1, 1, 1, 0, 0, 0, 0, 0};
+
+static PyObject *py_decode_header_lite(PyObject *self, PyObject *arg) {
+  (void)self;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  Parser p = {(const uint8_t *)view.buf, view.len, 0, 0};
+  PyObject *kept[16] = {0};
+  PyObject *result = NULL;
+  int major;
+  uint64_t value;
+  int info = parse_head(&p, &major, &value);
+  if (info < 0) goto done;
+  if (major != 4 || value != 16) {
+    Parser q = {(const uint8_t *)view.buf, view.len, 0, 0};
+    if (skip_item(&q) < 0) goto done;
+    if (q.pos != q.len) {
+      PyErr_Format(PyExc_ValueError, "trailing bytes after CBOR item (%zd bytes)",
+                   (Py_ssize_t)(q.len - q.pos));
+      goto done;
+    }
+    PyErr_SetString(PyExc_ValueError, "block header is not a 16-tuple");
+    goto done;
+  }
+  if ((uint64_t)view.len - p.pos < value) {
+    PyErr_SetString(PyExc_ValueError, "CBOR array length exceeds input");
+    goto done;
+  }
+  p.depth = 1; /* outer array consumed via parse_head (see decode_header) */
+  for (int i = 0; i < 16; i++) {
+    if (header_lite_keep[i]) {
+      kept[i] = parse_item(&p);
+      if (!kept[i]) goto done;
+    } else if (skip_item(&p) < 0) {
+      goto done;
+    }
+  }
+  if (p.pos != p.len) {
+    PyErr_Format(PyExc_ValueError, "trailing bytes after CBOR item (%zd bytes)",
+                 (Py_ssize_t)(p.len - p.pos));
+    goto done;
+  }
+  /* _validate_core_fields parity (same messages, same order) */
+  if (!PyList_Check(kept[5])) {
+    PyErr_SetString(PyExc_ValueError, "header parents must be a CID list");
+    goto done;
+  }
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(kept[5]); i++) {
+    if (!PyObject_TypeCheck(PyList_GET_ITEM(kept[5], i), &CID_Type)) {
+      PyErr_SetString(PyExc_ValueError, "header parents must be a CID list");
+      goto done;
+    }
+  }
+  {
+    static const int idxs[3] = {8, 9, 10};
+    static const char *names[3] = {"parent_state_root",
+                                   "parent_message_receipts", "messages"};
+    for (int k = 0; k < 3; k++) {
+      if (!PyObject_TypeCheck(kept[idxs[k]], &CID_Type)) {
+        PyErr_Format(PyExc_ValueError, "header field %s must be a CID",
+                     names[k]);
+        goto done;
+      }
+    }
+  }
+  result = PyTuple_Pack(5, kept[5], kept[7], kept[8], kept[9], kept[10]);
+done:
+  for (int i = 0; i < 16; i++) Py_XDECREF(kept[i]);
+  PyBuffer_Release(&view);
+  return result;
+}
+
 static PyObject *py_decode_header(PyObject *self, PyObject *arg) {
   (void)self;
   Py_buffer view;
@@ -1284,6 +1363,10 @@ static PyMethodDef methods[] = {
     {"decode_header", py_decode_header, METH_O,
      "Decode a 16-field block header, materializing only the fields "
      "verification reads (others validated and returned as None)."},
+    {"decode_header_lite", py_decode_header_lite, METH_O,
+     "decode_header(raw) narrowed to (parents, height, parent_state_root, "
+     "parent_message_receipts, messages) with the core-field type "
+     "validation folded in (state/header.py LiteHeader parity)."},
     {"set_cid_factory", py_set_cid_factory, METH_O,
      "Register callable(bytes)->CID used for tag-42 links when no CID "
      "class is registered (set_cid_class takes precedence)."},

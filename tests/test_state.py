@@ -452,3 +452,74 @@ class TestStorage:
         root2 = put_cbor(bs2, [bad_inner, 5])
         with pytest.raises(ValueError, match="must be bytes"):
             read_storage_slot(bs2, root2, self.SLOT)
+
+
+class TestDecodeHeaderLiteNative:
+    """The C ``decode_header_lite`` re-implements the 16-field walk with its
+    own keep mask and folded validation — pin its acceptance against the
+    full Python decode differentially (error FAMILY may narrow from
+    UnicodeDecodeError to its ValueError parent on skipped text fields;
+    accept/reject and field values must agree exactly)."""
+
+    def _raw(self):
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.state.header import BlockHeader
+
+        return BlockHeader(
+            parents=[CID.hash_of(b"p1"), CID.hash_of(b"p2")],
+            height=991,
+            parent_state_root=CID.hash_of(b"sr"),
+            parent_message_receipts=CID.hash_of(b"rr"),
+            messages=CID.hash_of(b"mm"),
+        ).encode()
+
+    def test_acceptance_differential_vs_full_decode(self):
+        import random
+
+        import pytest
+
+        from ipc_proofs_tpu.state.header import (
+            BlockHeader,
+            _native_decode_header_lite,
+        )
+
+        lite = _native_decode_header_lite()
+        if lite is False:
+            pytest.skip("native decode_header_lite unavailable")
+        raw = self._raw()
+        cases = [raw, raw + b"\x00"]
+        cases += [raw[:k] for k in range(len(raw))]
+        rng = random.Random(8495)
+        for _ in range(600):
+            mutated = bytearray(raw)
+            for _ in range(rng.randint(1, 4)):
+                k = rng.randrange(3)
+                if k == 0:
+                    mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+                elif k == 1 and len(mutated) > 1:
+                    del mutated[rng.randrange(len(mutated))]
+                else:
+                    mutated.insert(rng.randrange(len(mutated) + 1), rng.randrange(256))
+            cases.append(bytes(mutated))
+        accepted = 0
+        for case in cases:
+            try:
+                full = BlockHeader.decode(case)
+                full_err = None
+            except ValueError:  # UnicodeDecodeError is a ValueError subclass
+                full, full_err = None, ValueError
+            try:
+                out = lite(case)
+                lite_err = None
+            except ValueError:
+                out, lite_err = None, ValueError
+            assert (full_err is None) == (lite_err is None), case.hex()
+            if full_err is None:
+                parents, height, psr, pmr, msgs = out
+                assert parents == full.parents
+                assert height == full.height
+                assert psr == full.parent_state_root
+                assert pmr == full.parent_message_receipts
+                assert msgs == full.messages
+                accepted += 1
+        assert accepted >= 1  # the valid header itself

@@ -154,7 +154,7 @@ def phase_dagcbor(rng, quick):
 
 def phase_header(rng, quick):
     from ipc_proofs_tpu.core.cid import CID
-    from ipc_proofs_tpu.state.header import BlockHeader
+    from ipc_proofs_tpu.state.header import BlockHeader, decode_header_lite
 
     r = random.Random(rng.randrange(1 << 30))
     h = BlockHeader(
@@ -189,8 +189,20 @@ def phase_header(rng, quick):
         except (ValueError, KeyError) as e:
             lite, lite_err = None, type(e)
         assert (full_err is None) == (lite_err is None), case.hex()
+        # the module-level decode_header_lite (C 5-field fast path) has its
+        # OWN keep mask and folded validation — same accept/reject set
+        # (UnicodeDecodeError narrows to its ValueError parent on skipped
+        # text fields, so compare at the ValueError family)
+        try:
+            lh = decode_header_lite(case)
+            lh_err = None
+        except (ValueError, KeyError):
+            lh, lh_err = None, True
+        assert (full_err is None) == (lh_err is None), case.hex()
         if full_err is None:
             assert lite.parents == full.parents and lite.height == full.height
+            assert lh.parents == full.parents and lh.height == full.height
+            assert lh.messages == full.messages
             agree += 1
     log(f"header lite/full acceptance: {n} mutants, {agree} accepted identically")
 
