@@ -635,6 +635,71 @@ static PyTypeObject Snapshot_Type = {
     .tp_doc = "GIL-free block-map snapshot reusable across native walks",
 };
 
+/* bulk_load_blocks(blocks, cid_dict, raw_dict) -> count: the witness
+ * loader's hot loop (per ProofBlock: cid/data attribute reads, the
+ * memoized cid.to_bytes(), and two dict inserts) in one C pass. `data`
+ * values must already be bytes (ProofBlock holds bytes by construction);
+ * a non-bytes data raises TypeError with nothing half-loaded beyond the
+ * items before it — identical to the Python loop's bytes() failure. */
+static PyObject *py_bulk_load_blocks(PyObject *self, PyObject *args) {
+  (void)self;
+  PyObject *blocks, *cid_dict, *raw_dict;
+  if (!PyArg_ParseTuple(args, "OO!O!", &blocks, &PyDict_Type, &cid_dict,
+                        &PyDict_Type, &raw_dict))
+    return NULL;
+  PyObject *seq = PySequence_Fast(blocks, "blocks must be a sequence");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *name_cid = PyUnicode_InternFromString("cid");
+  PyObject *name_data = PyUnicode_InternFromString("data");
+  PyObject *name_to_bytes = PyUnicode_InternFromString("to_bytes");
+  if (!name_cid || !name_data || !name_to_bytes) goto fail;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *block = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *cid = PyObject_GetAttr(block, name_cid);
+    if (!cid) goto fail;
+    PyObject *data = PyObject_GetAttr(block, name_data);
+    if (!data) {
+      Py_DECREF(cid);
+      goto fail;
+    }
+    if (!PyBytes_Check(data)) {
+      /* mirror bytes(block.data): accept anything the buffer protocol
+       * accepts by falling back to PyBytes_FromObject */
+      PyObject *converted = PyBytes_FromObject(data);
+      Py_DECREF(data);
+      if (!converted) {
+        Py_DECREF(cid);
+        goto fail;
+      }
+      data = converted;
+    }
+    PyObject *key = PyObject_CallMethodNoArgs(cid, name_to_bytes);
+    if (!key) {
+      Py_DECREF(cid);
+      Py_DECREF(data);
+      goto fail;
+    }
+    int rc = PyDict_SetItem(cid_dict, cid, data);
+    if (rc == 0) rc = PyDict_SetItem(raw_dict, key, data);
+    Py_DECREF(cid);
+    Py_DECREF(data);
+    Py_DECREF(key);
+    if (rc < 0) goto fail;
+  }
+  Py_DECREF(name_cid);
+  Py_DECREF(name_data);
+  Py_DECREF(name_to_bytes);
+  Py_DECREF(seq);
+  return PyLong_FromSsize_t(n);
+fail:
+  Py_XDECREF(name_cid);
+  Py_XDECREF(name_data);
+  Py_XDECREF(name_to_bytes);
+  Py_DECREF(seq);
+  return NULL;
+}
+
 static PyObject *py_make_snapshot(PyObject *self, PyObject *arg) {
   (void)self;
   if (!PyDict_Check(arg)) {
@@ -3394,6 +3459,10 @@ static PyMethodDef methods[] = {
      "materialize_blocks(blocks_dict, todo, make_cids, cls, fallback=None, "
      "snapshot=None) -> CID-byte-sorted list of cls instances (cid=, data=) "
      "— Phase D witness materialization in one C pass."},
+    {"bulk_load_blocks", py_bulk_load_blocks, METH_VARARGS,
+     "bulk_load_blocks(blocks, cid_dict, raw_dict) -> count: load "
+     "ProofBlock-shaped items into a MemoryBlockstore's two maps in one "
+     "C pass (the witness loader's hot loop)."},
     {"make_snapshot", py_make_snapshot, METH_O,
      "make_snapshot(blocks_dict) -> BlockSnapshot: persistent GIL-free "
      "probe table over the dict, reusable across native walks via their "

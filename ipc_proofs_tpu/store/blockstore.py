@@ -101,12 +101,25 @@ class MemoryBlockstore:
         """Bulk load of ``ProofBlock``-shaped items (``.cid``/``.data``)
         WITHOUT per-block CID verification — the witness loader's fast path
         when verification happens elsewhere (or is explicitly skipped).
-        Keeps both internal maps in sync in the one place that owns them."""
-        cid_map, raw_map = self._blocks, self._raw
-        for block in blocks:
-            data = bytes(block.data)
-            cid_map[block.cid] = data
-            raw_map[block.cid.to_bytes()] = data
+        Keeps both internal maps in sync in the one place that owns them.
+        One C pass when the scan extension provides ``bulk_load_blocks``."""
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+
+        # bump AFTER the inserts (finally: even a partial load invalidates):
+        # a pre-bump would let a concurrently built scan snapshot cache the
+        # post-bump version over the pre-insert dict and serve overwritten
+        # CIDs stale forever
+        try:
+            ext = load_scan_ext()
+            if ext is not None and hasattr(ext, "bulk_load_blocks"):
+                ext.bulk_load_blocks(blocks, self._blocks, self._raw)
+                return
+            cid_map, raw_map = self._blocks, self._raw
+            for block in blocks:
+                data = bytes(block.data)
+                cid_map[block.cid] = data
+                raw_map[block.cid.to_bytes()] = data
+        finally:
             self._mutations += 1
 
     def raw_map(self) -> dict[bytes, bytes]:
