@@ -116,7 +116,13 @@ class MemoryBlockstore:
                 return
             cid_map, raw_map = self._blocks, self._raw
             for block in blocks:
-                data = bytes(block.data)
+                data = block.data
+                if isinstance(data, int):
+                    # bytes(int) would mean "n zero bytes" — a malformed
+                    # block, and the C fast path's PyBytes_FromObject
+                    # rejects it; the fallback must reject identically
+                    raise TypeError("block data must be bytes-like, not int")
+                data = bytes(data)
                 cid_map[block.cid] = data
                 raw_map[block.cid.to_bytes()] = data
         finally:

@@ -121,3 +121,58 @@ class TestPutCbor:
         raw = bs.get(txmeta_cid)
         assert raw is not None
         assert CID.hash_of(raw) == txmeta_cid
+
+
+class TestBulkLoadBlocks:
+    """C bulk loader ≡ the Python loop: same maps, same partial-load-on-
+    error semantics, same acceptance of buffer-protocol data."""
+
+    def test_matches_python_loop_and_mutation_counter(self):
+        from ipc_proofs_tpu.backend.native import load_scan_ext
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.proofs.bundle import ProofBlock
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        ext = load_scan_ext()
+        if ext is None or not hasattr(ext, "bulk_load_blocks"):
+            import pytest
+
+            pytest.skip("extension predates bulk_load_blocks")
+        blocks = [
+            ProofBlock._make(CID.hash_of(bytes([i])), bytes([i]) * 3)
+            for i in range(50)
+        ]
+        fast = MemoryBlockstore()
+        v0 = fast._mutations
+        fast.put_many_trusted(blocks)
+        assert fast._mutations > v0  # snapshot invalidation happened
+        slow = MemoryBlockstore()
+        cid_map, raw_map = slow._blocks, slow._raw
+        for b in blocks:
+            data = bytes(b.data)
+            cid_map[b.cid] = data
+            raw_map[b.cid.to_bytes()] = data
+        assert fast._blocks == slow._blocks
+        assert fast._raw == slow._raw
+
+    def test_memoryview_data_and_bad_data_type(self):
+        """Both the C fast path and the Python fallback accept buffer-
+        protocol data and reject int data with TypeError (bytes(int) would
+        silently mean 'n zero bytes'), leaving blocks BEFORE the failing
+        one loaded — partial-load-on-error parity."""
+        import pytest
+
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.proofs.bundle import ProofBlock
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        cid = CID.hash_of(b"mv")
+        bs = MemoryBlockstore()
+        bs.put_many_trusted([ProofBlock._make(cid, memoryview(b"mv-data"))])
+        assert bs.get(cid) == b"mv-data"
+        v = bs._mutations
+        good = ProofBlock._make(CID.hash_of(b"good"), b"good-data")
+        with pytest.raises(TypeError):
+            bs.put_many_trusted([good, ProofBlock._make(CID.hash_of(b"x"), 123)])
+        assert bs._mutations > v  # even a failed load invalidates
+        assert bs.get(good.cid) == b"good-data"  # prefix landed (both paths)
