@@ -663,9 +663,12 @@ static PyObject *py_bulk_load_blocks(PyObject *self, PyObject *args) {
       Py_DECREF(cid);
       goto fail;
     }
-    if (!PyBytes_Check(data)) {
+    if (!PyBytes_CheckExact(data)) {
       /* mirror bytes(block.data): accept anything the buffer protocol
-       * accepts by falling back to PyBytes_FromObject */
+       * accepts by falling back to PyBytes_FromObject. CheckExact (not
+       * Check) so bytes SUBCLASSES are normalized to exact bytes too —
+       * the Python fallback's bytes(data) does, and the two loaders must
+       * store byte-identical object types (ADVICE.md #5) */
       PyObject *converted = PyBytes_FromObject(data);
       Py_DECREF(data);
       if (!converted) {

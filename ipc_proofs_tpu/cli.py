@@ -360,6 +360,116 @@ def _cmd_demo(args) -> int:
     return 0 if result.all_valid() else 1
 
 
+def _cmd_serve(args) -> int:
+    """Long-running proof service: micro-batched verify/generate over HTTP.
+
+    Three store modes:
+    - default: verify-only (``POST /v1/verify`` + ``/metrics``/``/healthz``);
+    - ``--demo-world N``: hermetic synthetic range world with N tipset
+      pairs — enables ``POST /v1/generate {"pair_index": i}`` with no
+      network egress (the serving analogue of ``demo``);
+    - ``--endpoint`` + ``--from-height/--to-height``: RPC-backed store,
+      pair table fetched from the chain (requires ``--event-sig/--topic1``).
+    """
+    import signal
+
+    from ipc_proofs_tpu.proofs.event_verifier import create_event_filter
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import TipsetPair
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.serve import ProofHTTPServer, ProofService, ServiceConfig
+
+    store, pairs, spec = None, [], None
+    if args.demo_world:
+        from ipc_proofs_tpu.fixtures import build_range_world
+
+        sig = args.event_sig or "NewTopDownMessage(bytes32,uint256)"
+        topic1 = args.topic1 or "calib-subnet-1"
+        store, pairs, n_matching = build_range_world(
+            args.demo_world, signature=sig, topic1=topic1
+        )
+        spec = EventProofSpec(event_signature=sig, topic_1=topic1)
+        log.info(
+            "demo world: %d pairs, %d matching events", len(pairs), n_matching
+        )
+    elif args.endpoint:
+        from ipc_proofs_tpu.proofs.chain import Tipset
+        from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+
+        if args.from_height is None or args.to_height is None:
+            log.error("--endpoint requires --from-height and --to-height")
+            return 2
+        if not (args.event_sig and args.topic1):
+            log.error("--endpoint requires --event-sig and --topic1")
+            return 2
+        client = LotusClient(
+            args.endpoint, bearer_token=args.token, timeout_s=args.timeout
+        )
+        tipsets = [
+            Tipset.fetch(client, h)
+            for h in range(args.from_height, args.to_height + 2)
+        ]
+        pairs = [
+            TipsetPair(parent=tipsets[i], child=tipsets[i + 1])
+            for i in range(len(tipsets) - 1)
+        ]
+        store = RpcBlockstore(client)
+        spec = EventProofSpec(
+            event_signature=args.event_sig, topic_1=args.topic1
+        )
+
+    if args.f3_cert:
+        from ipc_proofs_tpu.proofs.cert import FinalityCertificate
+
+        with open(args.f3_cert) as fh:
+            cert = FinalityCertificate.from_json_obj(json.load(fh))
+        policy = TrustPolicy.with_f3_certificate(cert)
+    else:
+        log.warning("no F3 certificate — accept-all trust (testing only)")
+        policy = TrustPolicy.accept_all()
+
+    service = ProofService(
+        store=store,
+        spec=spec,
+        trust_policy=policy,
+        event_filter=(
+            create_event_filter(args.event_sig, args.topic1)
+            if args.event_sig and args.topic1
+            else None
+        ),
+        config=ServiceConfig(
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_capacity=args.queue_capacity,
+            workers=args.workers,
+            cache_max_bytes=args.cache_max_bytes,
+            cache_ttl_s=args.cache_ttl_s,
+            verify_witness_cids=args.check_cids,
+        ),
+    )
+    httpd = ProofHTTPServer(service, host=args.host, port=args.port, pairs=pairs)
+    log.info(
+        "serving on %s (verify%s; max_batch=%d max_wait=%.1fms capacity=%d "
+        "workers=%d)",
+        httpd.address,
+        " + generate" if spec is not None and store is not None else " only",
+        args.max_batch, args.max_wait_ms, args.queue_capacity, args.workers,
+    )
+
+    def _sigterm(_signum, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        log.info("draining (flushing accepted requests)…")
+    finally:
+        httpd.shutdown()
+    log.info("drained; final metrics:\n%s", json.dumps(service.metrics_snapshot()))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ipc-proofs-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -480,6 +590,50 @@ def main(argv=None) -> int:
 
     demo = sub.add_parser("demo", help="hermetic end-to-end demo on a synthetic chain")
     demo.set_defaults(fn=_cmd_demo)
+
+    srv = sub.add_parser(
+        "serve",
+        help="long-running proof service: micro-batched verify/generate "
+        "over JSON-HTTP with backpressure, deadlines, and /metrics",
+    )
+    srv.add_argument("--host", default="127.0.0.1")
+    srv.add_argument("--port", type=int, default=8411)
+    srv.add_argument(
+        "--demo-world", type=int, default=0, metavar="N_PAIRS",
+        help="serve a hermetic synthetic range world with N tipset pairs "
+        "(enables /v1/generate with zero egress)",
+    )
+    srv.add_argument("--endpoint", default=None, help="Lotus JSON-RPC endpoint URL")
+    srv.add_argument("--token", default=None)
+    srv.add_argument("--timeout", type=float, default=250.0)
+    srv.add_argument("--from-height", type=int, default=None)
+    srv.add_argument("--to-height", type=int, default=None)
+    srv.add_argument("--event-sig", default=None)
+    srv.add_argument("--topic1", default=None)
+    srv.add_argument("--f3-cert", default=None, help="F3 finality certificate JSON")
+    srv.add_argument("--check-cids", action="store_true")
+    srv.add_argument(
+        "--max-batch", type=int, default=32,
+        help="flush a micro-batch at this many requests",
+    )
+    srv.add_argument(
+        "--max-wait-ms", type=float, default=4.0,
+        help="…or when the oldest queued request has waited this long",
+    )
+    srv.add_argument(
+        "--queue-capacity", type=int, default=256,
+        help="bounded admission queue; beyond this requests get 503 + Retry-After",
+    )
+    srv.add_argument("--workers", type=int, default=2, help="batch-execution threads")
+    srv.add_argument(
+        "--cache-max-bytes", type=int, default=256 * 1024 * 1024,
+        help="shared block-cache budget (LRU-evicting)",
+    )
+    srv.add_argument(
+        "--cache-ttl-s", type=float, default=None,
+        help="optional TTL on cached blocks",
+    )
+    srv.set_defaults(fn=_cmd_serve)
 
     args = parser.parse_args(argv)
     if getattr(args, "event_sig", None) and not getattr(args, "topic1", None):

@@ -55,7 +55,32 @@ __all__ = [
     "scan_receipts_from_api",
     "match_receipt_indices",
     "record_matching_receipts",
+    "single_pass_witness_cids",
 ]
+
+
+def single_pass_witness_cids(store: Blockstore, parent: Tipset, child: Tipset) -> "set[CID]":
+    """The SINGLE-PASS comparator: every CID a one-pass generator would ship.
+
+    A generator without the pass-1 filter records while it scans, so its
+    witness contains every block the scan touches — the whole receipts AMT
+    plus the events AMT of EVERY receipt, matching or not. The two-pass
+    design re-records only matching receipts (pass 2), which is the
+    60-80 % witness saving the reference README credits for sparse event
+    sets. This function measures the counterfactual so that saving is a
+    bench artifact (`witness_reduction_pct` in bench.py) instead of a
+    documentation claim: run it on the same (parent, child) the two-pass
+    bundle proved, sum the block sizes, compare.
+
+    Returns the CID set rather than a byte count so range-level callers can
+    union across pairs first — the two-pass bundle deduplicates its witness
+    range-wide, and a fair comparator must too.
+    """
+    recorder = RecordingBlockstore(store)
+    collector = WitnessCollector(recorder)
+    collect_base_witness_and_exec_order(collector, recorder, parent, child)
+    scan_receipt_events(recorder, child.blocks[0].parent_message_receipts)
+    return collector.needed_cids() | recorder.take_seen()
 
 
 class EventMatcher:

@@ -14,7 +14,6 @@ deliberately witness-free in both designs.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -189,9 +188,6 @@ def _raw_view(store: Blockstore):
     return {}, fallback
 
 
-_snapshot_build_lock = threading.Lock()
-
-
 def _snapshot_of(store: Blockstore, raw: dict, work: "Optional[int]" = None):
     """Persistent C probe table over ``raw``, cached on the owning
     MemoryBlockstore and invalidated by the store's MUTATION COUNTER (not
@@ -231,10 +227,13 @@ def _snapshot_of(store: Blockstore, raw: dict, work: "Optional[int]" = None):
         return cached[1]
     if work is not None and (work < 64 or len(raw) > 256 * work):
         return None  # build would cost more than the probes it replaces
-    # serialize builds: the pipelined driver's scan worker and the record
-    # phase can race here, and a duplicate O(|store|) build is exactly the
-    # cost this cache exists to remove
-    with _snapshot_build_lock:
+    # serialize builds PER STORE: the pipelined driver's scan worker and the
+    # record phase can race here, and a duplicate O(|store|) build is exactly
+    # the cost this cache exists to remove — but builds for *different*
+    # stores are independent and must not serialize on one module-global
+    # lock (the serve worker pool builds generator and verifier snapshots
+    # concurrently; ADVICE.md #4)
+    with owner._snapshot_lock:
         cached = getattr(owner, "_scan_snapshot", None)
         if cached is not None and cached[0] == version:
             return cached[1]

@@ -1,0 +1,38 @@
+"""Proof-serving daemon: dynamic micro-batching over the batch engines.
+
+The inference-serving shape — continuous batching, bounded admission with
+backpressure, per-request deadlines, graceful drain, latency-percentile
+observability — grafted onto the proof pipeline. See `serve/batcher.py`
+(coalescing + admission), `serve/service.py` (the service proper),
+`serve/httpd.py` (JSON-over-HTTP front end), and README "Serving".
+"""
+
+from ipc_proofs_tpu.serve.batcher import (
+    DeadlineExceededError,
+    MicroBatcher,
+    PendingResult,
+    QueueFullError,
+    ServiceClosedError,
+)
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.serve.service import (
+    GenerateResponse,
+    ProofService,
+    ServiceConfig,
+    VerifyResponse,
+    sequential_verify_baseline,
+)
+
+__all__ = [
+    "DeadlineExceededError",
+    "GenerateResponse",
+    "MicroBatcher",
+    "PendingResult",
+    "ProofHTTPServer",
+    "ProofService",
+    "QueueFullError",
+    "ServiceClosedError",
+    "ServiceConfig",
+    "VerifyResponse",
+    "sequential_verify_baseline",
+]

@@ -1,0 +1,272 @@
+"""Dynamic micro-batcher: bounded admission, flush-on-size-or-deadline.
+
+The serving half of the inference-serving shape grafted onto the proof
+pipeline (see PAPERS.md — Reddio's decoupling of request admission from
+batched execution). Individual requests arrive one at a time; the batch
+engines (`proofs/event_verifier.py` grouped replay, `proofs/range.py`)
+only pay off when fed many proofs per call. The `MicroBatcher` bridges
+them:
+
+- **admission** is a bounded queue. A full queue REJECTS immediately with
+  a retry hint (`QueueFullError.retry_after_s`) — it never blocks the
+  caller and never grows without bound, so a traffic spike degrades into
+  fast 503s instead of memory exhaustion and collapse.
+- **coalescing** flushes a batch when it reaches ``max_batch`` requests OR
+  the oldest queued request has waited ``max_wait_ms`` — whichever comes
+  first. Under load, batches fill instantly and the wait bound never
+  binds; at low traffic, a lone request pays at most ``max_wait_ms`` of
+  extra latency.
+- **deadlines** are per request: a request whose deadline passed while it
+  sat in the queue is completed with `DeadlineExceededError` at dequeue
+  time rather than wasting batch capacity on an answer nobody is waiting
+  for.
+- **drain** (`close(drain=True)`) stops admission, flushes everything
+  already accepted, and joins the batcher thread — an accepted request is
+  never dropped by shutdown.
+
+The batcher owns one assembly thread; the flush callback may optionally be
+dispatched to a shared executor so batch *assembly* overlaps batch
+*execution* (the service's worker pool).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+__all__ = [
+    "DeadlineExceededError",
+    "MicroBatcher",
+    "PendingResult",
+    "QueueFullError",
+    "ServiceClosedError",
+]
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue is full; retry after ``retry_after_s`` seconds."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"admission queue full; retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class ServiceClosedError(RuntimeError):
+    """The service is draining or stopped; no new requests are admitted."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before it could be processed."""
+
+
+class PendingResult:
+    """A slot for one request's eventual result (a minimal future).
+
+    ``threading.Event`` + result/error pair rather than
+    `concurrent.futures.Future` so completion stays allocation-light and
+    the batcher controls exactly who may complete it.
+    """
+
+    __slots__ = ("payload", "deadline", "enqueued_at", "_done", "_result", "_error")
+
+    def __init__(self, payload, deadline: Optional[float], enqueued_at: float):
+        self.payload = payload
+        self.deadline = deadline  # absolute time.monotonic() instant, or None
+        self.enqueued_at = enqueued_at
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def complete(self, result) -> None:
+        self._result = result
+        self._done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the request completes; raise its error if it failed."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request not complete within wait timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class MicroBatcher:
+    """Coalesce individual submissions into bounded, deadline-aware batches.
+
+    ``flush_fn(batch)`` receives a non-empty ``list[PendingResult]`` and
+    must complete (or fail) every element. If it raises instead, the
+    batcher fails every still-pending element with that exception — a
+    buggy flush can never strand callers in ``result()`` forever.
+    """
+
+    def __init__(
+        self,
+        flush_fn: Callable[[list[PendingResult]], None],
+        max_batch: int = 32,
+        max_wait_ms: float = 4.0,
+        capacity: int = 256,
+        name: str = "batch",
+        metrics: Optional[Metrics] = None,
+        executor=None,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._flush_fn = flush_fn
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1000.0
+        self._capacity = capacity
+        self._name = name
+        self._metrics = metrics if metrics is not None else Metrics()
+        self._executor = executor
+        self._queue: deque[PendingResult] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        # EWMA of recent flush wall times, seeding the retry-after hint for
+        # rejected requests: "queue depth / batch size" flushes still ahead
+        # of you, each costing roughly this long
+        self._avg_flush_s = self._max_wait_s
+        self._thread = threading.Thread(
+            target=self._run, name=f"micro-batcher-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # --- admission ---------------------------------------------------------
+
+    def submit(self, payload, timeout_s: Optional[float] = None) -> PendingResult:
+        """Admit one request; never blocks.
+
+        Raises `ServiceClosedError` after `close()`, `QueueFullError` when
+        the bounded queue is at capacity.
+        """
+        now = time.monotonic()
+        deadline = (now + timeout_s) if timeout_s is not None else None
+        with self._cond:
+            if self._closed:
+                self._metrics.count(f"serve.rejected_closed.{self._name}")
+                raise ServiceClosedError(f"{self._name} batcher is draining")
+            if len(self._queue) >= self._capacity:
+                self._metrics.count(f"serve.rejected_full.{self._name}")
+                batches_ahead = max(1, len(self._queue) // self._max_batch)
+                raise QueueFullError(
+                    retry_after_s=max(0.001, batches_ahead * self._avg_flush_s)
+                )
+            pending = PendingResult(payload, deadline, now)
+            self._queue.append(pending)
+            self._metrics.set_gauge(
+                f"serve.queue_depth.{self._name}", len(self._queue)
+            )
+            self._metrics.count(f"serve.accepted.{self._name}")
+            self._cond.notify_all()
+        return pending
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # --- batch assembly ----------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue and self._closed:
+                    return
+                batch = [self._queue.popleft()]
+                # the window opens at the OLDEST member's arrival, so a
+                # request's queueing latency is bounded by max_wait even
+                # when stragglers keep trickling in behind it
+                window_end = batch[0].enqueued_at + self._max_wait_s
+                while len(batch) < self._max_batch:
+                    if self._queue:
+                        batch.append(self._queue.popleft())
+                        continue
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cond.wait(remaining)
+                    if not self._queue and (
+                        self._closed or time.monotonic() >= window_end
+                    ):
+                        break
+                self._metrics.set_gauge(
+                    f"serve.queue_depth.{self._name}", len(self._queue)
+                )
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[PendingResult]) -> None:
+        now = time.monotonic()
+        live: list[PendingResult] = []
+        for pending in batch:
+            if pending.deadline is not None and now > pending.deadline:
+                self._metrics.count(f"serve.deadline_exceeded.{self._name}")
+                pending.fail(
+                    DeadlineExceededError(
+                        f"deadline exceeded after "
+                        f"{now - pending.enqueued_at:.3f}s in queue"
+                    )
+                )
+            else:
+                live.append(pending)
+        if not live:
+            return
+        self._metrics.observe(f"serve.batch_size.{self._name}", len(live))
+        if self._executor is not None:
+            self._executor.submit(self._flush, live)
+        else:
+            self._flush(live)
+
+    def _flush(self, batch: list[PendingResult]) -> None:
+        start = time.monotonic()
+        try:
+            self._flush_fn(batch)
+        except BaseException as exc:  # noqa: BLE001 — strand no caller
+            for pending in batch:
+                if not pending.done():
+                    pending.fail(exc)
+        finally:
+            elapsed = time.monotonic() - start
+            self._avg_flush_s = 0.8 * self._avg_flush_s + 0.2 * elapsed
+            for pending in batch:
+                if not pending.done():
+                    pending.fail(
+                        RuntimeError(
+                            f"{self._name} flush returned without completing "
+                            "this request (bug in flush_fn)"
+                        )
+                    )
+
+    # --- shutdown ----------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop admitting. ``drain=True`` flushes everything accepted and
+        joins the batcher thread; ``drain=False`` fails queued requests
+        with `ServiceClosedError` (in-flight flushes still finish)."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    self._queue.popleft().fail(
+                        ServiceClosedError(f"{self._name} batcher stopped")
+                    )
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

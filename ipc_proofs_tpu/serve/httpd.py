@@ -1,0 +1,207 @@
+"""JSON-over-HTTP front end for `ProofService` (stdlib `http.server` only).
+
+A deliberately thin shim: every serving decision — batching, admission,
+deadlines, drain — lives in `serve/service.py`; this module only maps HTTP
+to the in-process API and serving errors to status codes:
+
+- ``POST /v1/verify``  → `QueueFullError` ⇒ 503 + ``Retry-After``,
+  `ServiceClosedError` ⇒ 503 (draining), `DeadlineExceededError` ⇒ 504,
+  malformed bundle ⇒ 400.
+- ``POST /v1/generate`` → same mapping; the request names a tipset pair by
+  index into the server's configured pair table (the hermetic/demo mode —
+  a production deployment would resolve pairs from its chain store).
+- ``GET /metrics``  → `utils/metrics.py` snapshot (stage timers, queue
+  depths, batch sizes, p50/p90/p99 latency, rejection counters) as JSON.
+- ``GET /healthz``  → ``{"status": "ok" | "draining"}``.
+
+`ThreadingHTTPServer` gives one thread per connection; those threads do no
+proof work — they block on ``PendingResult.result()`` while the service's
+worker pool executes batches, so slow clients never stall batch execution.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.proofs.range import TipsetPair
+from ipc_proofs_tpu.serve.batcher import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServiceClosedError,
+)
+from ipc_proofs_tpu.serve.service import ProofService
+
+__all__ = ["ProofHTTPServer"]
+
+_MAX_BODY_BYTES = 64 * 1024 * 1024  # one bundle; far above any sane request
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per server subclass via ProofHTTPServer
+    service: ProofService
+    pairs: Sequence[TipsetPair]
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    # --- plumbing ----------------------------------------------------------
+
+    def _send_json(self, status: int, obj: dict, headers: Optional[dict] = None):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > _MAX_BODY_BYTES:
+            raise ValueError(f"Content-Length required, 0 < n <= {_MAX_BODY_BYTES}")
+        obj = json.loads(self.rfile.read(length))
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        return obj
+
+    # --- routes ------------------------------------------------------------
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            self._send_json(200, self.service.metrics_snapshot())
+        elif self.path == "/healthz":
+            status = "draining" if self.service.draining else "ok"
+            self._send_json(200 if status == "ok" else 503, {"status": status})
+        else:
+            self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def do_POST(self):
+        try:
+            body = self._read_json_body()
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._send_json(400, {"error": f"bad request body: {exc}"})
+            return
+        if self.path == "/v1/verify":
+            self._handle_verify(body)
+        elif self.path == "/v1/generate":
+            self._handle_generate(body)
+        else:
+            self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _handle_verify(self, body: dict):
+        try:
+            bundle = UnifiedProofBundle.from_json_obj(body.get("bundle", body))
+        except (ValueError, KeyError, TypeError) as exc:
+            self._send_json(400, {"error": f"malformed bundle: {exc}"})
+            return
+        timeout_s = body.get("timeout_s")
+        self._submit(
+            lambda: self.service.verify(bundle, timeout_s=timeout_s),
+            lambda resp: {
+                "storage_results": resp.storage_results,
+                "event_results": resp.event_results,
+                "all_valid": resp.all_valid(),
+                "batch_size": resp.batch_size,
+            },
+        )
+
+    def _handle_generate(self, body: dict):
+        idx = body.get("pair_index")
+        if not isinstance(idx, int) or not (0 <= idx < len(self.pairs)):
+            self._send_json(
+                400,
+                {
+                    "error": "pair_index must be an int in "
+                    f"[0, {len(self.pairs)}) (server pair table)"
+                },
+            )
+            return
+        timeout_s = body.get("timeout_s")
+        self._submit(
+            lambda: self.service.generate(self.pairs[idx], timeout_s=timeout_s),
+            lambda resp: {
+                "bundle": resp.bundle.to_json_obj(),
+                "n_event_proofs": resp.n_event_proofs,
+                "batch_size": resp.batch_size,
+            },
+        )
+
+    def _submit(self, call, render):
+        try:
+            resp = call()
+        except QueueFullError as exc:
+            self._send_json(
+                503,
+                {"error": "queue full", "retry_after_s": exc.retry_after_s},
+                headers={"Retry-After": f"{max(1, round(exc.retry_after_s))}"},
+            )
+        except ServiceClosedError:
+            self._send_json(503, {"error": "service draining"})
+        except DeadlineExceededError as exc:
+            self._send_json(504, {"error": str(exc)})
+        except RuntimeError as exc:
+            self._send_json(400, {"error": str(exc)})
+        else:
+            self._send_json(200, render(resp))
+
+
+class ProofHTTPServer:
+    """Own one `ProofService` behind a threading HTTP server.
+
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` after
+    construction. `serve_forever()` blocks; `start()` runs the accept loop
+    on a daemon thread. `shutdown()` stops accepting, then drains the
+    service — zero accepted requests are lost.
+    """
+
+    def __init__(
+        self,
+        service: ProofService,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        pairs: Optional[Sequence[TipsetPair]] = None,
+    ):
+        self.service = service
+        handler = type(
+            "_BoundHandler",
+            (_Handler,),
+            {"service": service, "pairs": list(pairs or [])},
+        )
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    def start(self) -> "ProofHTTPServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="proof-httpd", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, timeout: Optional[float] = None) -> None:
+        """Stop the accept loop, then drain the service (flushes all
+        accepted work before returning)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self.service.drain(timeout=timeout)

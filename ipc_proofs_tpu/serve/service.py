@@ -1,0 +1,370 @@
+"""Long-running proof service: micro-batched verify/generate with drain.
+
+`ProofService` is the in-process API (the HTTP front end in
+`serve/httpd.py` is a thin shim over it). Two independent `MicroBatcher`s
+feed the existing batch engines:
+
+- **verify**: N individual `UnifiedProofBundle`s merge into ONE bundle —
+  witness blocks deduplicated, proofs concatenated in request order — and
+  a single `verify_proof_bundle` call replays them all (grouped event
+  replay + batched storage walk). Per-request verdicts are split back out
+  by position. Requests whose witness blocks CONFLICT (same CID, different
+  bytes — one of them is lying) are partitioned into compatible sub-merges
+  rather than letting one forged block poison a neighbor's verdict.
+- **generate**: N individual tipset-pair requests deduplicate into one
+  pair list for `generate_event_proofs_for_range` (one device match call
+  for the whole micro-batch). Each response carries its own pair's proofs
+  — bit-identical to generating that pair alone — plus the micro-batch's
+  shared deduplicated witness (a sound superset: every response bundle
+  verifies independently; batching trades some response bytes for the
+  shared scan).
+
+All workers share one `CachedBlockstore` over the chain store, backed by a
+`BlockCache` (size-capped + TTL) so the cache survives millions of
+requests without becoming a slow OOM.
+
+Verification policy (trust policy, event filter, witness-CID checking) is
+service-level configuration, fixed at startup: a real deployment serves
+one subnet's trust root, and batching is only sound when every request in
+a merge is judged under the same policy.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from time import monotonic
+from typing import Optional, Sequence
+
+from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
+from ipc_proofs_tpu.proofs.range import TipsetPair, generate_event_proofs_for_range
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.serve.batcher import MicroBatcher, PendingResult
+from ipc_proofs_tpu.store.blockstore import BlockCache, CachedBlockstore
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+__all__ = [
+    "GenerateResponse",
+    "ProofService",
+    "ServiceConfig",
+    "VerifyResponse",
+]
+
+
+@dataclass
+class ServiceConfig:
+    """Tuning knobs for the serving loop (see README "Serving")."""
+
+    max_batch: int = 32  # flush when a batch reaches this many requests…
+    max_wait_ms: float = 4.0  # …or the oldest member has waited this long
+    queue_capacity: int = 256  # bounded admission; beyond this → 503
+    workers: int = 2  # batch-execution pool (assembly overlaps execution)
+    cache_max_bytes: int = 256 * 1024 * 1024  # shared BlockCache budget
+    cache_ttl_s: Optional[float] = None  # optional entry TTL
+    verify_witness_cids: bool = False  # recompute witness CIDs on verify
+
+
+@dataclass
+class VerifyResponse:
+    """Per-request verdicts, split out of the merged-batch result."""
+
+    storage_results: list[bool]
+    event_results: list[bool]
+    batch_size: int  # how many requests shared the replay (observability)
+
+    def all_valid(self) -> bool:
+        return all(self.storage_results) and all(self.event_results)
+
+
+@dataclass
+class GenerateResponse:
+    """One request's bundle: its pair's proofs + the batch's shared witness."""
+
+    bundle: UnifiedProofBundle
+    batch_size: int
+
+    @property
+    def n_event_proofs(self) -> int:
+        return len(self.bundle.event_proofs)
+
+
+def _pair_key(pair: TipsetPair) -> tuple:
+    return (
+        tuple(str(c) for c in pair.parent.cids),
+        tuple(str(c) for c in pair.child.cids),
+    )
+
+
+@dataclass
+class _GenerateRequest:
+    pair: TipsetPair
+    key: tuple = field(init=False)
+
+    def __post_init__(self):
+        self.key = _pair_key(self.pair)
+
+
+class ProofService:
+    """Micro-batching proof server (in-process API).
+
+    ``store`` + ``spec`` enable the generate path (omit both for a
+    verify-only service); ``trust_policy`` defaults to accept-all, which —
+    as everywhere else in this repo — is for development and tests only.
+    """
+
+    def __init__(
+        self,
+        store=None,
+        spec=None,
+        trust_policy: Optional[TrustPolicy] = None,
+        event_filter=None,
+        config: Optional[ServiceConfig] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.config = config or ServiceConfig()
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._trust = trust_policy or TrustPolicy.accept_all()
+        self._event_filter = event_filter
+        self._spec = spec
+        self.block_cache = BlockCache(
+            max_bytes=self.config.cache_max_bytes, ttl_s=self.config.cache_ttl_s
+        )
+        self._store = (
+            CachedBlockstore(store, shared_cache=self.block_cache)
+            if store is not None
+            else None
+        )
+        self._executor = ThreadPoolExecutor(
+            max_workers=self.config.workers, thread_name_prefix="proof-serve"
+        )
+        self._drained = False
+        self._drain_lock = threading.Lock()
+        self._verify_batcher = MicroBatcher(
+            self._flush_verify,
+            max_batch=self.config.max_batch,
+            max_wait_ms=self.config.max_wait_ms,
+            capacity=self.config.queue_capacity,
+            name="verify",
+            metrics=self.metrics,
+            executor=self._executor,
+        )
+        self._generate_batcher = (
+            MicroBatcher(
+                self._flush_generate,
+                max_batch=self.config.max_batch,
+                max_wait_ms=self.config.max_wait_ms,
+                capacity=self.config.queue_capacity,
+                name="generate",
+                metrics=self.metrics,
+                executor=self._executor,
+            )
+            if self._store is not None and self._spec is not None
+            else None
+        )
+
+    # --- public API --------------------------------------------------------
+
+    def submit_verify(
+        self, bundle: UnifiedProofBundle, timeout_s: Optional[float] = None
+    ) -> PendingResult:
+        """Admit one verify request; returns immediately with a pending slot.
+
+        Raises `QueueFullError` / `ServiceClosedError` at admission time;
+        ``.result()`` raises `DeadlineExceededError` if ``timeout_s`` passes
+        before the batch containing it is processed."""
+        return self._verify_batcher.submit(bundle, timeout_s=timeout_s)
+
+    def verify(
+        self, bundle: UnifiedProofBundle, timeout_s: Optional[float] = None
+    ) -> VerifyResponse:
+        """Blocking verify: submit and wait for the micro-batched verdict."""
+        return self.submit_verify(bundle, timeout_s=timeout_s).result()
+
+    def submit_generate(
+        self, pair: TipsetPair, timeout_s: Optional[float] = None
+    ) -> PendingResult:
+        if self._generate_batcher is None:
+            raise RuntimeError(
+                "generate path disabled: service was built without store/spec"
+            )
+        return self._generate_batcher.submit(
+            _GenerateRequest(pair), timeout_s=timeout_s
+        )
+
+    def generate(
+        self, pair: TipsetPair, timeout_s: Optional[float] = None
+    ) -> GenerateResponse:
+        return self.submit_generate(pair, timeout_s=timeout_s).result()
+
+    @property
+    def draining(self) -> bool:
+        return self._verify_batcher.closed
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["block_cache"] = self.block_cache.stats()
+        if self._store is not None:
+            snap["block_cache"]["hits"] = self._store.hits
+            snap["block_cache"]["misses"] = self._store.misses
+        return snap
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: stop admitting, flush everything accepted,
+        wait for in-flight batches, release the worker pool. Idempotent."""
+        with self._drain_lock:
+            if self._drained:
+                return
+            self._drained = True
+        self._verify_batcher.close(drain=True, timeout=timeout)
+        if self._generate_batcher is not None:
+            self._generate_batcher.close(drain=True, timeout=timeout)
+        self._executor.shutdown(wait=True)
+
+    close = drain
+
+    def __enter__(self) -> "ProofService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.drain()
+
+    # --- verify batching ---------------------------------------------------
+
+    def _flush_verify(self, batch: list[PendingResult]) -> None:
+        """Merge → one `verify_proof_bundle` → split verdicts by span.
+
+        Conflicting witness blocks (same CID, different bytes) partition
+        the batch greedily: each request joins the current merge unless one
+        of its blocks contradicts a block already merged, in which case it
+        starts/joins a later sub-merge. Verdicts are unaffected — a merge
+        only ever contains mutually consistent witnesses, and within one
+        merge identical CIDs carry identical bytes, so deduplication is
+        lossless."""
+        remaining = batch
+        while remaining:
+            merged: list[PendingResult] = []
+            deferred: list[PendingResult] = []
+            by_cid: dict = {}
+            for pending in remaining:
+                bundle: UnifiedProofBundle = pending.payload
+                conflict = any(
+                    by_cid.get(b.cid, b.data) != b.data for b in bundle.blocks
+                )
+                if conflict:
+                    deferred.append(pending)
+                else:
+                    for b in bundle.blocks:
+                        by_cid.setdefault(b.cid, b.data)
+                    merged.append(pending)
+            self._verify_merged(merged)
+            remaining = deferred
+
+    def _verify_merged(self, merged: list[PendingResult]) -> None:
+        storage_proofs: list = []
+        event_proofs: list = []
+        blocks: list[ProofBlock] = []
+        seen: set = set()
+        spans: list[tuple[int, int, int, int]] = []
+        for pending in merged:
+            bundle: UnifiedProofBundle = pending.payload
+            s0, e0 = len(storage_proofs), len(event_proofs)
+            storage_proofs.extend(bundle.storage_proofs)
+            event_proofs.extend(bundle.event_proofs)
+            for b in bundle.blocks:
+                if b.cid not in seen:
+                    seen.add(b.cid)
+                    blocks.append(b)
+            spans.append((s0, len(storage_proofs), e0, len(event_proofs)))
+
+        with self.metrics.stage("serve.verify_batch"):
+            result = verify_proof_bundle(
+                UnifiedProofBundle(
+                    storage_proofs=storage_proofs,
+                    event_proofs=event_proofs,
+                    blocks=blocks,
+                ),
+                self._trust,
+                event_filter=self._event_filter,
+                verify_witness_cids=self.config.verify_witness_cids,
+            )
+        self.metrics.count("serve.batches.verify")
+
+        now = monotonic()
+        for pending, (s0, s1, e0, e1) in zip(merged, spans):
+            self.metrics.observe(
+                "serve.latency_ms.verify", (now - pending.enqueued_at) * 1e3
+            )
+            pending.complete(
+                VerifyResponse(
+                    storage_results=result.storage_results[s0:s1],
+                    event_results=result.event_results[e0:e1],
+                    batch_size=len(merged),
+                )
+            )
+
+    # --- generate batching -------------------------------------------------
+
+    def _flush_generate(self, batch: list[PendingResult]) -> None:
+        """Deduplicate pairs → one range-driver call → split proofs by pair."""
+        unique: dict[tuple, TipsetPair] = {}
+        for pending in batch:
+            req: _GenerateRequest = pending.payload
+            unique.setdefault(req.key, req.pair)
+        pairs = list(unique.values())
+
+        with self.metrics.stage("serve.generate_batch"):
+            bundle = generate_event_proofs_for_range(
+                self._store, pairs, self._spec, metrics=self.metrics
+            )
+        self.metrics.count("serve.batches.generate")
+
+        by_key: dict[tuple, list] = {key: [] for key in unique}
+        # EventProof pins (parent_tipset_cids, child_block_cid); a child
+        # block cid identifies its pair within one batch
+        child_block_to_key: dict[str, tuple] = {}
+        for key, pair in unique.items():
+            for c in pair.child.cids:
+                child_block_to_key[str(c)] = key
+        for proof in bundle.event_proofs:
+            by_key[child_block_to_key[proof.child_block_cid]].append(proof)
+
+        now = monotonic()
+        for pending in batch:
+            req = pending.payload
+            self.metrics.observe(
+                "serve.latency_ms.generate", (now - pending.enqueued_at) * 1e3
+            )
+            pending.complete(
+                GenerateResponse(
+                    bundle=UnifiedProofBundle(
+                        storage_proofs=[],
+                        event_proofs=list(by_key[req.key]),
+                        blocks=bundle.blocks,
+                    ),
+                    batch_size=len(batch),
+                )
+            )
+
+
+def sequential_verify_baseline(
+    bundles: Sequence[UnifiedProofBundle],
+    trust_policy: Optional[TrustPolicy] = None,
+    event_filter=None,
+) -> list[VerifyResponse]:
+    """The per-request comparator: one `verify_proof_bundle` call per
+    request, no coalescing. The serve bench leg and the bit-identical
+    concurrency test measure the micro-batcher against exactly this."""
+    trust = trust_policy or TrustPolicy.accept_all()
+    out = []
+    for bundle in bundles:
+        result = verify_proof_bundle(bundle, trust, event_filter=event_filter)
+        out.append(
+            VerifyResponse(
+                storage_results=result.storage_results,
+                event_results=result.event_results,
+                batch_size=1,
+            )
+        )
+    return out

@@ -18,6 +18,8 @@ Reference parity:
 from __future__ import annotations
 
 import threading
+import time
+from collections import OrderedDict
 from typing import Iterable, Optional, Protocol, runtime_checkable
 
 from ipc_proofs_tpu.core.cid import CID
@@ -27,6 +29,7 @@ __all__ = [
     "MemoryBlockstore",
     "RecordingBlockstore",
     "CachedBlockstore",
+    "BlockCache",
     "put_cbor",
 ]
 
@@ -74,6 +77,11 @@ class MemoryBlockstore:
         # this, so an overwrite with different bytes can never be served
         # stale from a cached probe table (size-only checks would miss it)
         self._mutations = 0
+        # serializes THIS store's scan-snapshot builds; per-store (not
+        # module-global) so independent stores — e.g. the serve pool's
+        # generator and verifier stores — never serialize each other's
+        # O(|store|) builds (ADVICE.md #4)
+        self._snapshot_lock = threading.Lock()
 
     def get(self, cid: CID) -> Optional[bytes]:
         return self._blocks.get(cid)
@@ -183,17 +191,114 @@ class RecordingBlockstore:
             return frozenset(self._seen)
 
 
+class BlockCache:
+    """Size-capped, TTL-evicting LRU block cache for LONG-LIVED processes.
+
+    The plain-dict cache `CachedBlockstore` defaults to is right for one
+    pipeline run: it grows for the run's duration and dies with it. A
+    serving daemon (`ipc_proofs_tpu/serve/`) holds ONE cache across millions
+    of requests, so unbounded growth is a slow OOM and entries can outlive
+    the chain data they mirror. This cache bounds both axes:
+
+    - ``max_bytes``: total cached block bytes; least-recently-used entries
+      evict first (content-addressed data never goes stale, so LRU eviction
+      is purely a memory policy, never a correctness one);
+    - ``ttl_s``: optional per-entry time-to-live — entries older than this
+      read as misses and are dropped. For immutable chain blocks a TTL is
+      about bounding the working set of a drifting access pattern, not
+      freshness.
+
+    Thread-safe; duck-compatible with the dict operations
+    `CachedBlockstore` performs (get/put/contains/len).
+    """
+
+    def __init__(
+        self,
+        max_bytes: int = 256 * 1024 * 1024,
+        ttl_s: Optional[float] = None,
+        clock=time.monotonic,
+    ):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self._entries: "OrderedDict[CID, tuple[bytes, float]]" = OrderedDict()
+        self._max_bytes = max_bytes
+        self._ttl_s = ttl_s
+        self._clock = clock
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.evictions = 0
+        self.expirations = 0
+
+    def get(self, cid: CID) -> Optional[bytes]:
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(cid)
+            if entry is None:
+                return None
+            data, stored_at = entry
+            if self._ttl_s is not None and now - stored_at > self._ttl_s:
+                del self._entries[cid]
+                self._bytes -= len(data)
+                self.expirations += 1
+                return None
+            self._entries.move_to_end(cid)
+            return data
+
+    def put(self, cid: CID, data: bytes) -> None:
+        data = bytes(data)
+        if len(data) > self._max_bytes:
+            return  # a block larger than the whole budget is never cached
+        with self._lock:
+            old = self._entries.pop(cid, None)
+            if old is not None:
+                self._bytes -= len(old[0])
+            self._entries[cid] = (data, self._clock())
+            self._bytes += len(data)
+            while self._bytes > self._max_bytes:
+                _, (evicted, _) = self._entries.popitem(last=False)
+                self._bytes -= len(evicted)
+                self.evictions += 1
+
+    def __contains__(self, cid: CID) -> bool:
+        return self.get(cid) is not None  # TTL-respecting membership
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self._max_bytes,
+                "ttl_s": self._ttl_s,
+                "evictions": self.evictions,
+                "expirations": self.expirations,
+            }
+
+
 class CachedBlockstore:
     """Memoizing wrapper; the cache can be shared across instances.
 
     Reference `cached_blockstore.rs` shares via `Rc<RefCell<HashMap>>` and is
     explicitly single-threaded; here a `threading.Lock` protects the dict so
     the async prefetcher can populate it from worker threads.
+
+    ``shared_cache`` may be a plain dict (pipeline runs: unbounded, dies
+    with the run) or a `BlockCache` (serving daemons: size-capped + TTL).
+    A `BlockCache` carries its own lock, so the wrapper skips the dict lock
+    for it.
     """
 
-    def __init__(self, inner: Blockstore, shared_cache: Optional[dict[CID, bytes]] = None):
+    def __init__(
+        self,
+        inner: Blockstore,
+        shared_cache: "Optional[dict[CID, bytes] | BlockCache]" = None,
+    ):
         self._inner = inner
         self._cache = shared_cache if shared_cache is not None else {}
+        self._evicting = isinstance(self._cache, BlockCache)
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -206,30 +311,45 @@ class CachedBlockstore:
         return self._cache
 
     def get(self, cid: CID) -> Optional[bytes]:
-        with self._lock:
+        if self._evicting:
             cached = self._cache.get(cid)
+        else:
+            with self._lock:
+                cached = self._cache.get(cid)
         if cached is not None:
             self.hits += 1
             return cached
         self.misses += 1
         data = self._inner.get(cid)
         if data is not None:
-            with self._lock:
-                self._cache[cid] = data
+            self._cache_put(cid, data)
         return data
 
+    def _cache_put(self, cid: CID, data: bytes) -> None:
+        if self._evicting:
+            self._cache.put(cid, data)
+        else:
+            with self._lock:
+                self._cache[cid] = data
+
     def put_keyed(self, cid: CID, data: bytes) -> None:
-        with self._lock:
-            self._cache[cid] = bytes(data)
+        self._cache_put(cid, bytes(data))
         self._inner.put_keyed(cid, data)
 
     def has(self, cid: CID) -> bool:
-        with self._lock:
+        if self._evicting:
             if cid in self._cache:
                 return True
+        else:
+            with self._lock:
+                if cid in self._cache:
+                    return True
         return self._inner.has(cid)
 
     def cache_stats(self) -> tuple[int, int]:
         """(entries, total bytes) — reference `cached_blockstore.rs:40-45`."""
+        if self._evicting:
+            stats = self._cache.stats()
+            return stats["entries"], stats["bytes"]
         with self._lock:
             return len(self._cache), sum(len(v) for v in self._cache.values())

@@ -1,4 +1,13 @@
-"""Per-stage timers and counters."""
+"""Per-stage timers, counters, gauges, and latency histograms.
+
+`Metrics` started as the batch pipeline's stage-timer sink (one instance per
+run); the proof-serving daemon (`ipc_proofs_tpu/serve/`) extends it with the
+serving vocabulary — gauges for instantaneous state (queue depth, in-flight
+batches) and bounded-reservoir histograms for request-latency percentiles
+(p50/p90/p99) and batch-size distributions. One `Metrics` instance can back
+a long-lived process: histograms are ring buffers (latest `maxlen`
+observations), so snapshots stay O(maxlen) forever.
+"""
 
 from __future__ import annotations
 
@@ -8,7 +17,7 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-__all__ = ["StageTimer", "Metrics", "get_metrics"]
+__all__ = ["StageTimer", "Histogram", "Metrics", "get_metrics"]
 
 
 @dataclass
@@ -21,12 +30,62 @@ class StageTimer:
         self.calls += 1
 
 
+class Histogram:
+    """Bounded reservoir of observations with percentile snapshots.
+
+    Keeps the most recent ``maxlen`` observations in a ring buffer —
+    percentiles therefore describe *recent* behavior, which is what a
+    serving dashboard wants (a startup spike ages out instead of skewing
+    p99 forever). Not thread-safe on its own; `Metrics` serializes access.
+    """
+
+    __slots__ = ("_ring", "_maxlen", "_next", "count", "total")
+
+    def __init__(self, maxlen: int = 8192):
+        self._ring: list[float] = []
+        self._maxlen = maxlen
+        self._next = 0  # ring insertion cursor once full
+        self.count = 0  # lifetime observations
+        self.total = 0.0  # lifetime sum
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if len(self._ring) < self._maxlen:
+            self._ring.append(value)
+        else:
+            self._ring[self._next] = value
+            self._next = (self._next + 1) % self._maxlen
+
+    def percentiles(self, qs=(0.5, 0.9, 0.99)) -> dict[str, float]:
+        """Nearest-rank percentiles over the retained window ({} if empty)."""
+        if not self._ring:
+            return {}
+        ordered = sorted(self._ring)
+        n = len(ordered)
+        out = {}
+        for q in qs:
+            rank = min(n - 1, max(0, int(q * n + 0.5) - 1))
+            out[f"p{int(q * 100)}"] = ordered[rank]
+        return out
+
+    def snapshot(self) -> dict:
+        out = {
+            "count": self.count,
+            "mean": (self.total / self.count) if self.count else 0.0,
+        }
+        out.update(self.percentiles())
+        return out
+
+
 @dataclass
 class Metrics:
-    """Thread-safe stage timers + counters; one instance per pipeline run."""
+    """Thread-safe stage timers + counters + gauges + histograms."""
 
     timers: dict[str, StageTimer] = field(default_factory=dict)
     counters: dict[str, int] = field(default_factory=dict)
+    gauges: dict[str, float] = field(default_factory=dict)
+    histograms: dict[str, Histogram] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     @contextmanager
@@ -43,15 +102,39 @@ class Metrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Instantaneous state (queue depth, in-flight); last write wins."""
+        with self._lock:
+            self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one histogram observation (latency ms, batch size, …)."""
+        with self._lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = Histogram()
+            hist.observe(value)
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "timers": {
                     k: {"total_s": round(v.total_s, 6), "calls": v.calls}
                     for k, v in self.timers.items()
                 },
                 "counters": dict(self.counters),
             }
+            if self.gauges:
+                out["gauges"] = dict(self.gauges)
+            if self.histograms:
+                out["histograms"] = {
+                    k: {
+                        key: (round(val, 6) if isinstance(val, float) else val)
+                        for key, val in h.snapshot().items()
+                    }
+                    for k, h in self.histograms.items()
+                }
+            return out
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), indent=2)

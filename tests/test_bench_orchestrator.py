@@ -122,6 +122,18 @@ def _orchestrate_with(monkeypatch, capsys, leg_results, requested=None):
     return json.loads(capsys.readouterr().out.strip())
 
 
+_SERVE_OK = {
+    "serve_batched_rps": 2000.0, "serve_sequential_rps": 800.0,
+    "serve_speedup_vs_sequential": 2.5, "serve_concurrency": 32,
+    "serve_requests": 256, "serve_p99_latency_ms": 9.5,
+    "serve_mean_batch": 24.0, "serve_rejections": 0,
+}
+
+_WITNESS_OK = {
+    "witness_reduction_pct": 96.0, "witness_two_pass_bytes": 25_000,
+    "witness_single_pass_bytes": 650_000, "witness_sample_pairs": 64,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -146,12 +158,17 @@ class TestOrchestrate:
             "cid": [({"witness_cid_kernel_per_sec": 1e8}, "ok:tpu")],
             "baseline": [({"scalar_baseline_proofs_per_sec": 125.0}, "ok:cpu")],
             "native_baseline": [({"native_baseline_proofs_per_sec": 1000.0}, "ok:cpu")],
+            "serve": [(dict(_SERVE_OK), "ok:cpu")],
+            "witness": [(dict(_WITNESS_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
         assert out["vs_native_baseline"] == 5.0
         assert out["watchdog_fallback"] is False
         assert out["legs"]["e2e"] == "ok:tpu"
+        assert out["legs"]["serve"] == "ok:cpu"
+        assert out["serve_speedup_vs_sequential"] == 2.5
+        assert out["witness_reduction_pct"] == 96.0
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -161,6 +178,8 @@ class TestOrchestrate:
             "cid": [({"witness_cid_kernel_per_sec": 1e4}, "ok:cpu")],
             "baseline": [({"scalar_baseline_proofs_per_sec": 100.0}, "ok:cpu")],
             "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
+            "serve": [(dict(_SERVE_OK), "ok:cpu")],
+            "witness": [(dict(_WITNESS_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -171,6 +190,7 @@ class TestOrchestrate:
         assert requested == [
             ("e2e", "default"), ("e2e", "cpu"), ("kernel", "cpu"),
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
+            ("serve", "cpu"), ("witness", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -180,6 +200,8 @@ class TestOrchestrate:
             "cid": [({"witness_cid_kernel_per_sec": 1e4}, "ok:cpu")],
             "baseline": [({"scalar_baseline_proofs_per_sec": 100.0}, "ok:cpu")],
             "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
+            "serve": [(dict(_SERVE_OK), "ok:cpu")],
+            "witness": [(dict(_WITNESS_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -220,6 +242,8 @@ class TestOrchestrate:
             "cid": [(None, "timeout:cpu")],
             "baseline": [(None, "error:cpu")],
             "native_baseline": [(None, "error:cpu")],
+            "serve": [(None, "error:cpu")],
+            "witness": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -227,6 +251,8 @@ class TestOrchestrate:
             "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
             "stages_overlap", "vs_baseline", "vs_native_baseline",
             "device_mask_kernel_events_per_sec", "witness_cid_kernel_per_sec",
+            "serve_speedup_vs_sequential", "serve_batched_rps",
+            "witness_reduction_pct",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -176,3 +176,24 @@ class TestBulkLoadBlocks:
             bs.put_many_trusted([good, ProofBlock._make(CID.hash_of(b"x"), 123)])
         assert bs._mutations > v  # even a failed load invalidates
         assert bs.get(good.cid) == b"good-data"  # prefix landed (both paths)
+
+    def test_bytes_subclass_stored_as_exact_bytes(self):
+        """A bytes SUBCLASS must round-trip through the loader as plain
+        bytes, not be trusted as-is: PyBytes_Check alone would let a
+        subclass with overridden behavior sit in the store and break the
+        `fast._blocks == slow._blocks`-style equality the scan relies on.
+        The C path gates on PyBytes_CheckExact and falls through to
+        PyBytes_FromObject for everything else."""
+        from ipc_proofs_tpu.core.cid import CID
+        from ipc_proofs_tpu.proofs.bundle import ProofBlock
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+
+        class TaggedBytes(bytes):
+            pass
+
+        cid = CID.hash_of(b"sub")
+        bs = MemoryBlockstore()
+        bs.put_many_trusted([ProofBlock._make(cid, TaggedBytes(b"sub-data"))])
+        got = bs.get(cid)
+        assert got == b"sub-data"
+        assert type(got) is bytes  # normalized, not the subclass
