@@ -88,6 +88,15 @@ def _parse_args(argv=None):
         help="measured e2e passes; the headline is the best (--quick uses 3)",
     )
     parser.add_argument(
+        "--scan-threads", type=int, default=None,
+        help="scan+match workers for the e2e leg's stage-overlapped pipeline "
+        "(default: the process affinity core count)",
+    )
+    parser.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="chunks buffered between the e2e pipeline's stages",
+    )
+    parser.add_argument(
         "--serve-requests", type=int, default=256,
         help="closed-loop requests for the serve leg (--quick uses 96)",
     )
@@ -150,8 +159,12 @@ def _setup_platform(args) -> str:
 
 
 def _leg_e2e(args) -> dict:
-    """The headline: best-of-3 end-to-end generate+verify at the bench shape.
-    Returns every headline JSON field except the baseline ratios."""
+    """The headline: best-of-n end-to-end generate+verify at the bench shape,
+    measured TWICE — serial (flat generation, then staged verification) and
+    stage-overlapped (scan ∥ record ∥ verify on the bounded-queue pipeline)
+    — so the artifact reports the pipelined headline next to the serial
+    figure and their ratio. Returns every headline JSON field except the
+    baseline ratios."""
     jax_platform = _setup_platform(args)
     import gc
 
@@ -161,8 +174,8 @@ def _leg_e2e(args) -> dict:
     from ipc_proofs_tpu.fixtures import build_range_world
     from ipc_proofs_tpu.proofs.generator import EventProofSpec
     from ipc_proofs_tpu.proofs.range import (
+        generate_and_verify_range_overlapped,
         generate_event_proofs_for_range,
-        generate_event_proofs_for_range_pipelined,
     )
     from ipc_proofs_tpu.utils.metrics import Metrics
 
@@ -181,156 +194,142 @@ def _leg_e2e(args) -> dict:
     spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
     backend = get_backend("tpu")
 
-    # --- warmup: compile every jit kernel at the measurement shapes ---------
-    # generation: phase-overlapped chunked driver on multi-core hosts (scan
-    # chunk k+1 on a worker thread while chunk k records); the flat
-    # single-chunk driver on one core, where the worker thread only adds
-    # timeslicing overhead. Bit-identical either way (tests/test_range.py).
-    n_cores = (
+    # honest host introspection: cpu_count is the machine; the affinity mask
+    # is what THIS process may actually use (containers/cgroups shrink it)
+    host_cores = os.cpu_count() or 1
+    host_cores_affinity = (
         len(os.sched_getaffinity(0))
         if hasattr(os, "sched_getaffinity")
-        else (os.cpu_count() or 1)
+        else host_cores
     )
-    if n_cores > 1:
-        chunk_size = 1024
+    scan_threads = args.scan_threads or host_cores_affinity or 1
+    pipeline_depth = max(1, args.pipeline_depth)
+    # pipelined chunking: enough chunks in flight to feed every scan worker
+    # plus the queue depth, floored so tiny worlds still form a pipeline
+    pipe_chunk = max(1, min(1024, len(pairs) // max(4, 2 * scan_threads)))
+    # IPC_BENCH_OVERLAP_VERIFY=0 is the escape hatch back to serial-only
+    measure_pipelined = os.environ.get("IPC_BENCH_OVERLAP_VERIFY", "") != "0"
 
-        def _generate(metrics=None):
-            return generate_event_proofs_for_range_pipelined(
-                bs, pairs, spec, chunk_size=chunk_size,
-                match_backend=backend, metrics=metrics,
-            )
-    else:
-        chunk_size = len(pairs)  # reported as pipeline_chunk: one flat chunk
+    def _run_serial(metrics):
+        t0 = time.perf_counter()
+        bundle = generate_event_proofs_for_range(
+            bs, pairs, spec, match_backend=backend, metrics=metrics
+        )
+        t_gen = time.perf_counter() - t0
+        results, vstages = _staged_verify(bundle, backend)
+        assert all(results) and len(results) == len(bundle.event_proofs)
+        return bundle, t_gen, sum(vstages.values()), vstages
 
-        def _generate(metrics=None):
-            return generate_event_proofs_for_range(
-                bs, pairs, spec, match_backend=backend, metrics=metrics
-            )
+    def _run_pipelined(metrics):
+        # scan (scan_threads workers) ∥ record ∥ verify in ONE bounded-queue
+        # executor; bundle + verdicts bit-identical to serial (tests pin it)
+        t0 = time.perf_counter()
+        bundle, chunk_out = generate_and_verify_range_overlapped(
+            bs, pairs, spec, chunk_size=pipe_chunk,
+            verify_chunk=lambda b: _staged_verify(b, backend),
+            match_backend=backend, metrics=metrics,
+            scan_threads=scan_threads, pipeline_depth=pipeline_depth,
+        )
+        t_wall = time.perf_counter() - t0
+        results = [r for res, _ in chunk_out for r in res]
+        assert all(results) and len(results) == len(bundle.event_proofs)
+        vstages: dict = {}
+        for _, chunk_stages in chunk_out:
+            for name, seconds in chunk_stages.items():
+                vstages[name] = vstages.get(name, 0.0) + seconds
+        return bundle, t_wall, sum(vstages.values()), vstages
 
+    # --- warmup: compile every jit kernel at BOTH measurement shapes --------
+    # (the flat driver matches one range-sized batch; the pipelined driver
+    # matches pipe_chunk-sized batches — separate jit shapes). The second
+    # pipelined pass settles allocator pools at the headline shape so the
+    # measured reps sample the plateau, not the ramp.
     t0 = time.perf_counter()
-    bundle = _generate()
-    results, _ = _staged_verify(bundle, backend)
-    assert all(results) and len(results) == len(bundle.event_proofs)
-    _log(f"bench: warmup (incl. jit compile) {time.perf_counter() - t0:.1f}s")
-    # second warm pass: the first pass interleaves jit compiles with its
-    # execution, leaving allocator pools and branch-predictor state colder
-    # than steady state; one more full pass settles them so the measured
-    # reps sample the plateau, not the ramp (VERDICT r05 "what's weak" #2 —
-    # the reproducible driver number sat just below the README band)
-    t0 = time.perf_counter()
-    bundle = _generate()
-    results, _ = _staged_verify(bundle, backend)
-    assert all(results)
-    _log(f"bench: second warm pass {time.perf_counter() - t0:.1f}s")
+    _run_serial(Metrics())
+    _log(f"bench: serial warmup (incl. jit compile) {time.perf_counter() - t0:.1f}s")
+    if measure_pipelined:
+        t0 = time.perf_counter()
+        _run_pipelined(Metrics())
+        _run_pipelined(Metrics())
+        _log(f"bench: pipelined warmup ×2 {time.perf_counter() - t0:.1f}s")
 
     # optional profiler trace of one representative pass (not measured)
     if args.profile:
         from ipc_proofs_tpu.utils.profiling import maybe_profile
 
         with maybe_profile(args.profile):
-            profiled = _generate()
-            _staged_verify(profiled, backend)
-        del profiled
+            if measure_pipelined:
+                _run_pipelined(Metrics())
+            else:
+                _run_serial(Metrics())
 
-    # --- measured end-to-end passes (best of 3 — steady state, GC settled) --
-    # On multi-core hosts, verification ALSO overlaps generation: chunk k
-    # verifies on a worker thread while chunk k+1 generates
-    # (generate_and_verify_range_overlapped; bit-identical bundles and
-    # verdicts pinned by tests/test_range.py), COMPOSED with the pipelined
-    # driver's scan/record overlap inside each generation chunk. The e2e
-    # wall then measures the overlapped pipeline, not gen+verify in
-    # sequence. IPC_BENCH_OVERLAP_VERIFY=1 forces it on (=0 forces off).
-    _overlap_env = os.environ.get("IPC_BENCH_OVERLAP_VERIFY", "")
-    overlap_gen_verify = (
-        _overlap_env not in ("", "0") if _overlap_env != "" else n_cores > 1
-    )
-    if overlap_gen_verify:
-        # outer chunks feed the verify worker; inner pipelined chunks keep
-        # the scan(k+1)/record(k) overlap — shapes compiled during warmup
-        verify_chunk_pairs = min(len(pairs), 2 * chunk_size if n_cores > 1 else 1024)
-        gen_chunk = chunk_size if n_cores > 1 else verify_chunk_pairs
-
-        def _gen_chunk_fn(store, chunk, chunk_spec, **kwargs):
-            if n_cores > 1:
-                return generate_event_proofs_for_range_pipelined(
-                    store, chunk, chunk_spec, chunk_size=gen_chunk, **kwargs
-                )
-            return generate_event_proofs_for_range(store, chunk, chunk_spec, **kwargs)
-
-    del bundle, results
-    best = None
+    # --- measured end-to-end passes (best of n — steady state, GC settled) --
     n_reps = 3 if args.quick else args.e2e_reps
-    rep_walls: list[float] = []
-    for _ in range(n_reps):
-        gc.collect()
-        metrics = Metrics()
-        if overlap_gen_verify:
-            from ipc_proofs_tpu.proofs.range import generate_and_verify_range_overlapped
 
-            t0 = time.perf_counter()
-            bundle, chunk_out = generate_and_verify_range_overlapped(
-                bs, pairs, spec, chunk_size=verify_chunk_pairs,
-                verify_chunk=lambda b: _staged_verify(b, backend),
-                match_backend=backend, metrics=metrics,
-                generate_fn=_gen_chunk_fn,
-            )
-            t_wall = time.perf_counter() - t0
-            results = [r for res, _ in chunk_out for r in res]
-            assert all(results) and len(results) == len(bundle.event_proofs)
-            vstages = {}
-            for _, chunk_stages in chunk_out:
-                for name, seconds in chunk_stages.items():
-                    vstages[name] = vstages.get(name, 0.0) + seconds
-            # generation occupies the calling thread for ~the whole wall;
-            # verification runs concurrently, so t_gen + t_verify > t_e2e
-            # by design — the headline rate divides by the WALL
-            t_gen = t_wall
-            t_verify = sum(vstages.values())
-            t_e2e_candidate = t_wall
-        else:
-            t_gen0 = time.perf_counter()
-            bundle = _generate(metrics=metrics)
-            t_gen = time.perf_counter() - t_gen0
-            results, vstages = _staged_verify(bundle, backend)
-            assert all(results)
-            t_verify = sum(vstages.values())
-            t_e2e_candidate = t_gen + t_verify
-        rep_walls.append(t_e2e_candidate)
-        if best is None or t_e2e_candidate < best[0]:
-            best = (t_e2e_candidate, t_gen, t_verify, bundle, metrics, vstages)
-    t_e2e, t_gen, t_verify, bundle, metrics, vstages = best
+    def _measure(run) -> tuple:
+        best = None
+        walls: list[float] = []
+        for _ in range(n_reps):
+            gc.collect()
+            metrics = Metrics()
+            bundle, t_wall, t_verify, vstages = run(metrics)
+            walls.append(t_wall)
+            if best is None or t_wall < best[0]:
+                best = (t_wall, t_verify, bundle, metrics, vstages)
+        return best, walls
+
+    serial_best, serial_walls = _measure(_run_serial)
+    pipe_best, pipe_walls = (None, [])
+    if measure_pipelined:
+        pipe_best, pipe_walls = _measure(_run_pipelined)
+
+    # headline = the pipelined pipeline when measured (the serial figure
+    # rides along for the speedup ratio); serial otherwise
+    t_e2e, t_verify, bundle, metrics, vstages = pipe_best or serial_best
+    rep_walls = pipe_walls or serial_walls
     n_proofs = len(bundle.event_proofs)
+    serial_wall = serial_best[0]
 
-    # NOTE: under the pipelined driver (multi-core hosts) generation stages
-    # overlap (chunk k+1 scans on a worker thread while chunk k records), so
-    # scan+match+record can exceed the generation wall time; the flat driver
-    # (single-core hosts) reports non-overlapping stages. e2e rates are wall.
-    gtimers = json.loads(metrics.to_json())["timers"]
+    # NOTE: under the pipelined engine stages overlap across worker threads,
+    # so busy sums (stages_ms) can exceed the e2e wall; stages_wall_ms is
+    # each stage's interval-union wall — the honest per-stage clock. e2e
+    # rates always divide by the measured WALL.
+    snap = metrics.snapshot()
+    gtimers = snap["timers"]
     stages = {
         "scan": gtimers.get("range_scan", {}).get("total_s", 0.0),
         "match": gtimers.get("range_match", {}).get("total_s", 0.0),
         "record": gtimers.get("range_record", {}).get("total_s", 0.0),
         **vstages,
     }
+    stages_wall = {
+        name: timer["wall_s"]
+        for name, timer in gtimers.items()
+        if name.startswith("range_")
+    }
     stage_str = " ".join(f"{k}={v * 1000:.0f}ms" for k, v in stages.items())
     proofs_per_sec = n_proofs / t_e2e
     events_per_sec = total_events / t_e2e
+    serial_proofs_per_sec = n_proofs / serial_wall
+    speedup = serial_wall / t_e2e if pipe_best is not None else None
     _log(
-        f"bench: e2e gen {t_gen * 1e3:.0f}ms + verify {t_verify * 1e3:.0f}ms → "
-        f"{n_proofs} proofs, {len(bundle.blocks)} witness blocks "
+        f"bench: e2e wall {t_e2e * 1e3:.0f}ms (verify busy {t_verify * 1e3:.0f}ms "
+        f"concurrent) → {n_proofs} proofs, {len(bundle.blocks)} witness blocks "
         f"({bundle.witness_bytes()} B)"
     )
     _log(f"bench: stages {stage_str}")
     _log(
-        f"bench: {proofs_per_sec:,.0f} proofs/s e2e, "
-        f"{events_per_sec:,.0f} events/s scanned e2e"
+        f"bench: {proofs_per_sec:,.0f} proofs/s e2e pipelined vs "
+        f"{serial_proofs_per_sec:,.0f} serial"
+        + (f" ({speedup:.2f}x)" if speedup else "")
     )
 
-    # ask the scanner itself (C scan_threads_default) rather than re-deriving
+    # the C scanner sizes its own intra-chunk thread pool; report it next to
+    # the pipeline's scan workers rather than conflating the two
     from ipc_proofs_tpu.backend.native import load_scan_ext
 
     _scan_ext = load_scan_ext()
-    scan_threads = (
+    native_scan_threads = (
         int(_scan_ext.scan_threads())
         if _scan_ext is not None and hasattr(_scan_ext, "scan_threads")
         else None
@@ -342,24 +341,31 @@ def _leg_e2e(args) -> dict:
         "unit": "proofs/s",
         "platform": jax_platform,
         "devices": len(jax.devices()),
-        "host_cores": n_cores,
-        "scan_threads": scan_threads,
-        # the ACTUAL generation chunking of the measured path, plus the
-        # outer verify-overlap chunking when gen_verify_overlap is on
-        "pipeline_chunk": gen_chunk if overlap_gen_verify else chunk_size,
-        "verify_chunk_pairs": verify_chunk_pairs if overlap_gen_verify else None,
+        "host_cores": host_cores,
+        "host_cores_affinity": host_cores_affinity,
+        # the pipeline's effective scan+match worker count for this leg
+        "scan_threads": scan_threads if pipe_best is not None else 1,
+        "native_scan_threads": native_scan_threads,
+        "pipeline_depth": pipeline_depth if pipe_best is not None else None,
+        "pipeline_chunk": pipe_chunk if pipe_best is not None else len(pairs),
         "events_per_sec_e2e": round(events_per_sec, 1),
         "proofs": n_proofs,
-        # generation stages overlap across pipeline threads (and, with
-        # gen_verify_overlap, verification overlaps generation too); their
-        # sum may exceed the e2e wall the headline rate is based on
+        # busy sums can exceed the e2e wall when stages overlap;
+        # stages_wall_ms is the per-stage interval-union wall
         "stages_ms": {k: round(v * 1000, 1) for k, v in stages.items()},
-        "stages_overlap": n_cores > 1 or overlap_gen_verify,
-        "gen_verify_overlap": overlap_gen_verify,
-        # measurement policy, recorded so the headline is auditable: two
-        # warm passes, best of n_reps; every rep's wall kept for honesty
-        # (the spread is the run-to-run noise the 'best' is picked from)
-        "e2e_policy": f"warm2-bestof{n_reps}",
+        "stages_wall_ms": {k: round(v * 1000, 1) for k, v in stages_wall.items()},
+        "stages_overlap": pipe_best is not None,
+        "gen_verify_overlap": pipe_best is not None,
+        "overlap_efficiency": snap.get("overlap_efficiency"),
+        # the serial figure measured in the SAME process at the same shape,
+        # and the headline's ratio to it — the honest single-host speedup
+        "serial_proofs_per_sec": round(serial_proofs_per_sec, 1),
+        "serial_e2e_reps_s": [round(w, 4) for w in serial_walls],
+        "pipeline_speedup_vs_serial": round(speedup, 3) if speedup else None,
+        # measurement policy, recorded so the headline is auditable: warm
+        # passes per variant, best of n_reps; every rep's wall kept for
+        # honesty (the spread is the noise the 'best' is picked from)
+        "e2e_policy": f"warm-bestof{n_reps}-serial+pipelined",
         "e2e_reps_s": [round(w, 4) for w in rep_walls],
         "_platform": jax_platform,
     }
@@ -828,9 +834,12 @@ def _native_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
 # every headline key the e2e leg emits — the total-failure fallback nulls
 # exactly this schema so consumers can always index the full key set
 _E2E_SCHEMA_KEYS = (
-    "value", "platform", "devices", "host_cores", "scan_threads",
+    "value", "platform", "devices", "host_cores", "host_cores_affinity",
+    "scan_threads", "native_scan_threads", "pipeline_depth",
     "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
-    "stages_overlap", "e2e_policy", "e2e_reps_s",
+    "stages_wall_ms", "stages_overlap", "gen_verify_overlap",
+    "overlap_efficiency", "serial_proofs_per_sec", "serial_e2e_reps_s",
+    "pipeline_speedup_vs_serial", "e2e_policy", "e2e_reps_s",
 )
 
 
@@ -870,7 +879,10 @@ def _run_leg(name: str, args, platform: str) -> tuple:
         "--e2e-reps", str(args.e2e_reps),
         "--serve-requests", str(args.serve_requests),
         "--serve-concurrency", str(args.serve_concurrency),
+        "--pipeline-depth", str(args.pipeline_depth),
     ]
+    if args.scan_threads is not None:
+        cmd += ["--scan-threads", str(args.scan_threads)]
     if args.quick:
         cmd.append("--quick")
     if args.profile and name == "e2e":

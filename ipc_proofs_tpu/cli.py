@@ -180,6 +180,26 @@ def _cmd_range(args) -> int:
     backend = get_backend(args.backend) if args.backend != "none" else None
     from ipc_proofs_tpu.utils.profiling import maybe_profile
 
+    generate_fn = None
+    if args.pipeline_depth > 0:
+        # stage-overlapped engine per checkpoint chunk: each outer chunk
+        # splits into sub-chunks so scan workers overlap recording while
+        # checkpointing (and resume) stay at --chunk-size granularity
+        import functools
+        import os as _os
+
+        eff_threads = args.scan_threads or _os.cpu_count() or 1
+        from ipc_proofs_tpu.proofs.range import (
+            generate_event_proofs_for_range_pipelined,
+        )
+
+        generate_fn = functools.partial(
+            generate_event_proofs_for_range_pipelined,
+            chunk_size=max(1, args.chunk_size // max(2, eff_threads)),
+            scan_threads=args.scan_threads,
+            pipeline_depth=args.pipeline_depth,
+        )
+
     with maybe_profile(args.profile):
         bundle = generate_event_proofs_for_range_chunked(
             RpcBlockstore(client),
@@ -191,6 +211,7 @@ def _cmd_range(args) -> int:
             metrics=metrics,
             storage_specs=storage_specs,
             scan_workers=args.scan_workers,
+            generate_fn=generate_fn,
         )
     output = args.output or "range_bundle.json"
     with open(output, "w") as fh:
@@ -445,6 +466,8 @@ def _cmd_serve(args) -> int:
             cache_max_bytes=args.cache_max_bytes,
             cache_ttl_s=args.cache_ttl_s,
             verify_witness_cids=args.check_cids,
+            range_scan_threads=args.scan_threads,
+            range_pipeline_depth=args.pipeline_depth,
         ),
     )
     httpd = ProofHTTPServer(service, host=args.host, port=args.port, pairs=pairs)
@@ -533,6 +556,16 @@ def main(argv=None) -> int:
         "fetches strictly one block at a time)",
     )
     rng.add_argument("--chunk-size", type=int, default=64)
+    rng.add_argument(
+        "--scan-threads", type=int, default=None,
+        help="scan+match workers in the stage-overlapped pipeline "
+        "(default: os.cpu_count())",
+    )
+    rng.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="chunks buffered between pipeline stages (bounded queues); "
+        "0 disables the stage-overlapped engine",
+    )
     rng.add_argument("--checkpoint-dir", default=None)
     rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
     rng.add_argument("-o", "--output", default=None)
@@ -632,6 +665,15 @@ def main(argv=None) -> int:
     srv.add_argument(
         "--cache-ttl-s", type=float, default=None,
         help="optional TTL on cached blocks",
+    )
+    srv.add_argument(
+        "--scan-threads", type=int, default=None,
+        help="scan+match workers for multi-pair generate batches "
+        "(stage-overlapped range engine; default: os.cpu_count())",
+    )
+    srv.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="chunks buffered between range-pipeline stages",
     )
     srv.set_defaults(fn=_cmd_serve)
 

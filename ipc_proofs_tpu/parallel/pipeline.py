@@ -1,22 +1,37 @@
-"""The sharded batch event-match pipeline — the framework's flagship step.
+"""The batch event-match pipelines — the framework's flagship steps.
 
-Replaces the reference's sequential pass-1 scan (one Python/Rust loop over
-receipts × events, `src/proofs/events/generator.rs:206-239`) with one fused
-device computation over a padded ``[tipset, receipt, event]`` tensor:
+Two pipelines live here:
 
-    mask    = topic0/topic1/emitter predicate per event   (elementwise)
-    hits    = any-reduce over the event axis per receipt  (psum over ``sp``)
-    count   = global proof count                          (full reduce)
+1. The **device match pipeline** (`match_pipeline` /
+   `sharded_match_pipeline`): replaces the reference's sequential pass-1
+   scan (one Python/Rust loop over receipts × events,
+   `src/proofs/events/generator.rs:206-239`) with one fused device
+   computation over a padded ``[tipset, receipt, event]`` tensor:
 
-Sharding: tipsets over ``dp``, events over ``sp``. With jit + NamedSharding
-XLA inserts the all-reduces over ICI; no hand-written collectives needed —
-exactly the recipe the scaling playbook prescribes.
+       mask    = topic0/topic1/emitter predicate per event   (elementwise)
+       hits    = any-reduce over the event axis per receipt  (psum over ``sp``)
+       count   = global proof count                          (full reduce)
+
+   Sharding: tipsets over ``dp``, events over ``sp``. With jit +
+   NamedSharding XLA inserts the all-reduces over ICI; no hand-written
+   collectives needed — exactly the recipe the scaling playbook prescribes.
+
+2. The **host stage pipeline** (`PipelineStage` / `run_pipeline`): a
+   bounded-queue, order-preserving, multi-worker staged executor for the
+   chunked proof drivers. Stage k+1 of chunk i runs concurrently with
+   stage k of chunk i+1 (scan ∥ record ∥ verify), each stage with its own
+   worker count, with backpressure (``depth`` buffered results per
+   inter-stage queue) so a fast scan can't balloon memory ahead of a slow
+   record, and fail-fast cancellation: the first worker exception cancels
+   all pending work and re-raises in the caller.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -26,6 +41,8 @@ __all__ = [
     "match_pipeline",
     "sharded_match_pipeline",
     "make_specs_u32",
+    "PipelineStage",
+    "run_pipeline",
 ]
 
 
@@ -152,3 +169,195 @@ def sharded_match_pipeline(mesh, donate: bool = False):
         )
 
     return jitted, shard_batch
+
+
+# --------------------------------------------------------------------------
+# host stage pipeline: bounded-queue, order-preserving staged executor
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class PipelineStage:
+    """One stage of a host pipeline: ``fn(item) -> result`` applied by
+    ``workers`` threads. Results are forwarded downstream in INPUT order
+    regardless of worker completion order, so a multi-worker stage feeding
+    an order-sensitive consumer (e.g. chunk-ordered claim emission) stays
+    deterministic. ``metrics_stage``, if set, times every ``fn`` call under
+    that `Metrics` stage name (the caller passes the `Metrics` to
+    `run_pipeline`)."""
+
+    name: str
+    fn: Callable[[Any], Any]
+    workers: int = 1
+    metrics_stage: Optional[str] = None
+
+
+class _Cancel:
+    """First-exception-wins cancellation token shared by every worker."""
+
+    __slots__ = ("_event", "_lock", "exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.exc: Optional[BaseException] = None
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self.exc is None:
+                self.exc = exc
+        self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+
+_STOP = object()  # end-of-stream sentinel (one per downstream worker)
+_POLL_S = 0.05  # queue poll granularity; bounds cancellation latency
+
+
+def _put(q: "queue.Queue", item, cancel: _Cancel) -> bool:
+    """Blocking put that aborts (returns False) once the pipeline cancels —
+    no worker can stay wedged against a full queue whose consumer died."""
+    while not cancel.is_set():
+        try:
+            q.put(item, timeout=_POLL_S)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _get(q: "queue.Queue", cancel: _Cancel):
+    while not cancel.is_set():
+        try:
+            return q.get(timeout=_POLL_S)
+        except queue.Empty:
+            continue
+    return _STOP
+
+
+class _OrderedEmitter:
+    """Reorder buffer between a stage's workers and the next queue: workers
+    finish out of order, downstream receives strict input order. Emitting a
+    result may block on the bounded downstream queue — that IS the
+    backpressure (at most ``depth`` results buffered ahead of the
+    consumer, plus what the workers hold in flight)."""
+
+    def __init__(self, n_items: int, out_q: "queue.Queue", n_stops: int, cancel: _Cancel):
+        self._lock = threading.Lock()
+        self._buffer: dict[int, Any] = {}
+        self._next = 0
+        self._n = n_items
+        self._out_q = out_q
+        self._n_stops = n_stops  # sentinels owed downstream (0 = caller-consumed)
+        self._cancel = cancel
+
+    def emit(self, seq: int, value) -> bool:
+        with self._lock:
+            self._buffer[seq] = value
+            while self._next in self._buffer:
+                if not _put(self._out_q, (self._next, self._buffer.pop(self._next)), self._cancel):
+                    return False
+                self._next += 1
+            if self._next == self._n:
+                for _ in range(self._n_stops):
+                    if not _put(self._out_q, _STOP, self._cancel):
+                        return False
+        return True
+
+
+def _stage_worker(stage: PipelineStage, in_q, emit, cancel: _Cancel, metrics) -> None:
+    while True:
+        task = _get(in_q, cancel)
+        if task is _STOP:
+            return
+        seq, item = task
+        try:
+            if metrics is not None and stage.metrics_stage:
+                with metrics.stage(stage.metrics_stage):
+                    result = stage.fn(item)
+            else:
+                result = stage.fn(item)
+        except BaseException as exc:  # noqa: BLE001 — must cancel on ANY failure
+            cancel.fail(exc)
+            return
+        if not emit(seq, result):
+            return
+
+
+def run_pipeline(
+    items: Sequence,
+    stages: Sequence[PipelineStage],
+    depth: int = 2,
+    metrics=None,
+) -> list:
+    """Run every item through ``stages`` with inter-stage overlap: item i's
+    stage k+1 runs while item i+1 is still in stage k. Returns the final
+    stage's results in input order.
+
+    - Each inter-stage queue buffers at most ``depth`` completed results;
+      peak memory is ~``depth + workers`` items per stage, regardless of
+      ``len(items)``.
+    - A worker exception cancels the whole pipeline (pending work is
+      dropped, in-flight work is abandoned at the next queue operation)
+      and re-raises the ORIGINAL exception in the caller — never a
+      deadlock, pinned by tests/test_pipeline_executor.py.
+    - ``metrics``: a `Metrics` whose ``stage(...)`` times each stage's
+      ``fn`` calls under the stage's ``metrics_stage`` name (thread-safe;
+      overlapped stages report busy + union wall separately).
+    """
+    items = list(items)
+    stages = list(stages)
+    if not stages:
+        raise ValueError("run_pipeline needs at least one stage")
+    n = len(items)
+    if n == 0:
+        return []
+    depth = max(1, int(depth))
+    cancel = _Cancel()
+    queues: list[queue.Queue] = [queue.Queue(maxsize=depth) for _ in range(len(stages) + 1)]
+
+    threads: list[threading.Thread] = []
+    for i, stage in enumerate(stages):
+        workers = max(1, int(stage.workers))
+        # sentinels owed to the NEXT stage's workers; the final queue is
+        # consumed by the caller, who counts results instead
+        n_stops = max(1, int(stages[i + 1].workers)) if i + 1 < len(stages) else 0
+        emitter = _OrderedEmitter(n, queues[i + 1], n_stops, cancel)
+        for w in range(workers):
+            t = threading.Thread(
+                target=_stage_worker,
+                args=(stage, queues[i], emitter.emit, cancel, metrics),
+                name=f"pipeline-{stage.name}-{w}",
+                daemon=True,
+            )
+            threads.append(t)
+            t.start()
+
+    def _feed():
+        for seq, item in enumerate(items):
+            if not _put(queues[0], (seq, item), cancel):
+                return
+        for _ in range(max(1, int(stages[0].workers))):
+            if not _put(queues[0], _STOP, cancel):
+                return
+
+    feeder = threading.Thread(target=_feed, name="pipeline-feeder", daemon=True)
+    feeder.start()
+
+    results: list = []
+    final_q = queues[-1]
+    while len(results) < n:
+        task = _get(final_q, cancel)
+        if task is _STOP:  # cancelled mid-stream
+            break
+        _seq, value = task
+        results.append(value)  # emitters guarantee seq order
+
+    feeder.join()
+    for t in threads:
+        t.join()
+    if cancel.exc is not None:
+        raise cancel.exc
+    return results

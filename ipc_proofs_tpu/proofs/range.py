@@ -61,26 +61,50 @@ def generate_and_verify_range_overlapped(
     metrics: Optional[Metrics] = None,
     storage_specs=None,
     generate_fn=None,
+    scan_threads: "int | None" = None,
+    pipeline_depth: int = 2,
 ) -> "tuple[UnifiedProofBundle, list]":
     """Overlap VERIFICATION with generation across chunks: chunk k's bundle
-    verifies on a worker thread while chunk k+1 generates on the calling
-    thread — the generation-verification analog of the pipelined driver's
-    scan/record overlap, and the last structural concurrency on the
-    headline path that needs no extra hardware. Passing the pipelined
-    driver as ``generate_fn`` composes the two overlaps:
-    scan(k+1) ∥ record(k) within generation, verify(k-1) alongside both.
+    verifies while chunk k+1 generates — the generation-verification
+    analog of the pipelined driver's scan/record overlap, and the last
+    structural concurrency on the headline path that needs no extra
+    hardware.
+
+    Default path (no ``generate_fn``, no ``storage_specs``): the
+    integrated three-stage pipeline — scan (``scan_threads`` workers)
+    ∥ record ∥ verify in ONE bounded-queue executor
+    (`generate_event_proofs_for_range_pipelined` with its verify stage),
+    so scan(k+1), record(k), and verify(k-1) all run concurrently.
+    Otherwise it composes over the chunked driver: chunk bundles verify
+    on a worker thread via the ``on_chunk`` hook (keeps checkpoints and
+    per-chunk storage proofs working with a custom ``generate_fn``).
 
     ``verify_chunk(bundle) -> result`` is the caller's verification closure
     (it runs off-thread; per-chunk results are returned in chunk order).
     Each chunk bundle is self-contained (its witness covers its proofs), so
     per-chunk verdicts match whole-bundle verification verdict-for-verdict;
     the merged bundle is bit-identical to the chunked driver's over the
-    same ``chunk_size`` (it IS the chunked driver's — one merge
-    implementation, hooked) — both pinned by tests/test_range.py.
+    same ``chunk_size`` — both pinned by tests/test_range.py.
     """
+    if generate_fn is None and storage_specs is None:
+        verify_results: list = []
+        merged = generate_event_proofs_for_range_pipelined(
+            store,
+            pairs,
+            spec,
+            chunk_size=chunk_size,
+            match_backend=match_backend,
+            metrics=metrics,
+            scan_threads=scan_threads,
+            pipeline_depth=pipeline_depth,
+            verify_chunk=verify_chunk,
+            verify_results=verify_results,
+        )
+        return merged, verify_results
+
     from concurrent.futures import ThreadPoolExecutor
 
-    verify_results: list = []
+    verify_results = []
     with ThreadPoolExecutor(max_workers=1) as pool:
         futures: list = []
         merged = generate_event_proofs_for_range_chunked(
@@ -591,56 +615,95 @@ def generate_event_proofs_for_range_pipelined(
     match_backend=None,
     metrics: Optional[Metrics] = None,
     storage_specs=None,
+    scan_threads: "int | None" = None,
+    pipeline_depth: int = 2,
+    verify_chunk=None,
+    verify_results: "list | None" = None,
 ) -> UnifiedProofBundle:
-    """Phase-overlapped range generation: the range is split into chunks
-    and chunk k+1's scan+match runs on a worker thread while chunk k
-    records on the calling thread, so the scan leg and any in-flight
-    device mask dispatch stop serializing with pass-2 recording.
+    """Stage-overlapped range generation on the bounded-queue pipeline
+    executor (`parallel.pipeline.run_pipeline`): the range splits into
+    chunks that flow scan+match (``scan_threads`` workers, default
+    ``os.cpu_count()``) → record (one worker, chunk order) → optional
+    incremental verify, with at most ``pipeline_depth`` chunks buffered
+    between stages. Chunk k records while chunks k+1.. scan; with
+    ``verify_chunk`` set, chunk k-1 replays alongside both
+    (verify-while-generate).
 
     Bundle output is bit-identical to the unpipelined driver over the same
-    chunking (chunks are merged in order; the witness union is CID-sorted,
-    and per-chunk claim emission order is deterministic) — enforced by
-    tests/test_range.py. Overlap pays on multi-core hosts and on hosts
-    where the device dispatch has real latency (tunneled chips); on a
-    single-core host it degrades gracefully to roughly the chunked
-    driver's cost. No checkpointing — use
-    `generate_event_proofs_for_range_chunked` for resumable runs.
+    chunking (the ordered emitter hands chunks to the record stage in
+    input order; the witness union is CID-sorted, and per-chunk claim
+    emission order is deterministic) — enforced by tests/test_range.py.
+    A worker exception cancels pending work and re-raises here. Overlap
+    pays on multi-core hosts and on hosts where the device dispatch or
+    block fetches have real latency; on a single-core host it degrades
+    gracefully to roughly the chunked driver's cost.
+
+    ``verify_chunk(bundle) -> result`` switches the record stage to emit a
+    self-contained bundle per chunk (its witness covers exactly its
+    proofs) for the verify stage; per-chunk results append to
+    ``verify_results`` in chunk order. Storage specs still prove
+    range-wide and appear only in the merged bundle. No checkpointing —
+    use `generate_event_proofs_for_range_chunked` for resumable runs.
     """
-    from concurrent.futures import ThreadPoolExecutor
+    import os
+
+    from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
 
     metrics = metrics or Metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
     chunks = [pairs[k : k + chunk_size] for k in range(0, len(pairs), chunk_size)]
+    if scan_threads is None:
+        scan_threads = os.cpu_count() or 1
+    scan_threads = max(1, int(scan_threads))
 
     event_proofs: list = []
     witness_bytes: set[bytes] = set()
     fallback_blocks: list[ProofBlock] = []
-    with ThreadPoolExecutor(max_workers=1) as pool:
-        pending = None
-        if chunks:
-            pending = pool.submit(
-                _scan_and_match, cached, chunks[0], spec, matcher, match_backend, metrics
+    chunk_blocks: set[ProofBlock] = set()
+
+    def _scan(chunk):
+        # _scan_and_match times itself (range_scan / range_match) — the
+        # executor must not wrap it again (no metrics_stage here)
+        return chunk, _scan_and_match(
+            cached, chunk, spec, matcher, match_backend, metrics
+        )
+
+    def _record(scanned):
+        chunk, (matching_per_pair, native_ok) = scanned
+        with metrics.stage("range_record"):
+            proofs, chunk_witness, chunk_fallback = _record_chunk(
+                cached, chunk, matching_per_pair, matcher, spec, native_ok
             )
-        for k, chunk in enumerate(chunks):
-            matching_per_pair, native_ok = pending.result()
-            if k + 1 < len(chunks):
-                pending = pool.submit(
-                    _scan_and_match,
-                    cached,
-                    chunks[k + 1],
-                    spec,
-                    matcher,
-                    match_backend,
-                    metrics,
-                )
-            with metrics.stage("range_record"):
-                proofs, chunk_witness, chunk_fallback = _record_chunk(
-                    cached, chunk, matching_per_pair, matcher, spec, native_ok
-                )
             event_proofs.extend(proofs)
-            witness_bytes |= chunk_witness
-            fallback_blocks.extend(chunk_fallback)
+            if verify_chunk is None:
+                witness_bytes.update(chunk_witness)
+                fallback_blocks.extend(chunk_fallback)
+                return None
+            # verify mode: materialize a self-contained chunk bundle so the
+            # verify stage can replay it while later chunks scan/record
+            blocks = _materialize_witness(cached, chunk_witness, chunk_fallback)
+            chunk_blocks.update(blocks)
+        return UnifiedProofBundle(
+            storage_proofs=[], event_proofs=proofs, blocks=blocks
+        )
+
+    stages = [
+        PipelineStage("scan", _scan, workers=scan_threads),
+        PipelineStage("record", _record),
+    ]
+    if verify_chunk is not None:
+
+        def _verify(bundle):
+            with metrics.stage("range_verify"):
+                return verify_chunk(bundle)
+
+        stages.append(PipelineStage("verify", _verify))
+
+    if chunks:
+        results = run_pipeline(chunks, stages, depth=max(1, pipeline_depth))
+        if verify_chunk is not None and verify_results is not None:
+            verify_results.extend(results)
     metrics.count("range_proofs", len(event_proofs))
 
     storage_proofs: list = []
@@ -654,7 +717,10 @@ def generate_event_proofs_for_range_pipelined(
         fallback_blocks.extend(storage_blocks)
 
     with metrics.stage("range_record"):
-        blocks = _materialize_witness(cached, witness_bytes, fallback_blocks)
+        # verify mode pre-materialized per-chunk blocks; they merge (and
+        # dedup by CID bytes) with any storage leg in the final sort
+        extra = list(chunk_blocks) + fallback_blocks if chunk_blocks else fallback_blocks
+        blocks = _materialize_witness(cached, witness_bytes, extra)
     return UnifiedProofBundle(
         storage_proofs=storage_proofs,
         event_proofs=event_proofs,

@@ -38,7 +38,11 @@ from time import monotonic
 from typing import Optional, Sequence
 
 from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
-from ipc_proofs_tpu.proofs.range import TipsetPair, generate_event_proofs_for_range
+from ipc_proofs_tpu.proofs.range import (
+    TipsetPair,
+    generate_event_proofs_for_range,
+    generate_event_proofs_for_range_pipelined,
+)
 from ipc_proofs_tpu.proofs.trust import TrustPolicy
 from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
 from ipc_proofs_tpu.serve.batcher import MicroBatcher, PendingResult
@@ -64,6 +68,12 @@ class ServiceConfig:
     cache_max_bytes: int = 256 * 1024 * 1024  # shared BlockCache budget
     cache_ttl_s: Optional[float] = None  # optional entry TTL
     verify_witness_cids: bool = False  # recompute witness CIDs on verify
+    # multi-pair generate batches run the stage-overlapped range engine:
+    # chunks of range_chunk_size pairs flow scan(range_scan_threads) →
+    # record with range_pipeline_depth chunks buffered between stages
+    range_chunk_size: int = 8
+    range_scan_threads: Optional[int] = None  # None → os.cpu_count()
+    range_pipeline_depth: int = 2
 
 
 @dataclass
@@ -315,9 +325,22 @@ class ProofService:
         pairs = list(unique.values())
 
         with self.metrics.stage("serve.generate_batch"):
-            bundle = generate_event_proofs_for_range(
-                self._store, pairs, self._spec, metrics=self.metrics
-            )
+            if len(pairs) > 1:
+                # multi-pair batch: stage-overlapped engine (bit-identical
+                # output; scan of later chunks overlaps recording)
+                bundle = generate_event_proofs_for_range_pipelined(
+                    self._store,
+                    pairs,
+                    self._spec,
+                    chunk_size=self.config.range_chunk_size,
+                    metrics=self.metrics,
+                    scan_threads=self.config.range_scan_threads,
+                    pipeline_depth=self.config.range_pipeline_depth,
+                )
+            else:
+                bundle = generate_event_proofs_for_range(
+                    self._store, pairs, self._spec, metrics=self.metrics
+                )
         self.metrics.count("serve.batches.generate")
 
         by_key: dict[tuple, list] = {key: [] for key in unique}

@@ -47,27 +47,55 @@ class LotusClient:
         bearer_token: Optional[str] = None,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         max_retries: int = 3,
+        block_timeout_s: float = 30.0,
+        backoff_base_s: float = 0.25,
+        backoff_max_s: float = 10.0,
+        session=None,
+        metrics=None,
     ):
+        """``timeout_s`` bounds general RPC calls (state queries can be
+        legitimately slow — the reference's 250 s); ``block_timeout_s``
+        bounds single-block fetches, which are small and must fail fast so a
+        stalled node can't wedge a pipeline scan worker for minutes. Retry
+        sleeps grow ``backoff_base_s * 2**attempt`` capped at
+        ``backoff_max_s``; every retry increments the ``rpc.retries``
+        counter on ``metrics`` (default: the process-global `Metrics`).
+        ``session`` injects any object with ``.post`` (tests use a fake —
+        no ``requests`` needed)."""
         self.endpoint = endpoint
         self.timeout_s = timeout_s
         self.max_retries = max_retries
+        self.block_timeout_s = block_timeout_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self._headers = {"Content-Type": "application/json"}
         if bearer_token:
             self._headers["Authorization"] = f"Bearer {bearer_token}"
         self._id_lock = threading.Lock()
         self._next_id = 1
-        # requests imported lazily so hermetic tests never need it
-        import importlib
+        if metrics is None:
+            from ipc_proofs_tpu.utils.metrics import get_metrics
 
-        self._requests = importlib.import_module("requests")
-        self._session = self._requests.Session()
+            metrics = get_metrics()
+        self._metrics = metrics
+        if session is not None:
+            self._session = session
+        else:
+            # requests imported lazily so hermetic tests never need it
+            import importlib
 
-    def request(self, method: str, params: Any) -> Any:
-        """Issue one JSON-RPC request; returns the `result` member."""
+            self._session = importlib.import_module("requests").Session()
+
+    def request(self, method: str, params: Any, timeout_s: Optional[float] = None) -> Any:
+        """Issue one JSON-RPC request; returns the `result` member.
+
+        ``timeout_s`` overrides the client default for this call (block
+        fetches pass the tighter ``block_timeout_s``)."""
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1
         payload = {"jsonrpc": "2.0", "method": method, "params": params, "id": req_id}
+        deadline = self.timeout_s if timeout_s is None else timeout_s
         last_err: Exception | None = None
         for attempt in range(self.max_retries):
             try:
@@ -75,7 +103,7 @@ class LotusClient:
                     self.endpoint,
                     data=json.dumps(payload),
                     headers=self._headers,
-                    timeout=self.timeout_s,
+                    timeout=deadline,
                 )
                 resp.raise_for_status()
                 body = resp.json()
@@ -94,12 +122,19 @@ class LotusClient:
                         "RPC %s attempt %d/%d failed (%s) — retrying",
                         method, attempt + 1, self.max_retries, exc,
                     )
-                    time.sleep(min(2.0**attempt, 10.0))
+                    self._metrics.count("rpc.retries")
+                    time.sleep(
+                        min(self.backoff_max_s, self.backoff_base_s * 2.0**attempt)
+                    )
+        self._metrics.count("rpc.failures")
         raise RuntimeError(f"RPC {method} failed after {self.max_retries} attempts") from last_err
 
     def chain_read_obj(self, cid: CID) -> Optional[bytes]:
-        """Fetch one raw IPLD block (`Filecoin.ChainReadObj`)."""
-        result = self.request("Filecoin.ChainReadObj", [{"/": str(cid)}])
+        """Fetch one raw IPLD block (`Filecoin.ChainReadObj`) under the
+        fail-fast ``block_timeout_s`` deadline."""
+        result = self.request(
+            "Filecoin.ChainReadObj", [{"/": str(cid)}], timeout_s=self.block_timeout_s
+        )
         if result is None:
             return None
         return base64.b64decode(result)

@@ -22,8 +22,19 @@ __all__ = ["StageTimer", "Histogram", "Metrics", "get_metrics"]
 
 @dataclass
 class StageTimer:
+    """Accumulates one named stage's busy time and wall-clock time.
+
+    ``total_s`` sums every entry's elapsed time (8 workers × 1 s each →
+    8 s busy). ``wall_s`` is the union of the entry intervals (the same 8
+    concurrent workers → ~1 s wall) — the honest per-stage wall-clock when
+    pipeline workers overlap. For purely sequential code the two agree.
+    """
+
     total_s: float = 0.0
     calls: int = 0
+    wall_s: float = 0.0
+    _active: int = 0  # concurrent (outermost) entries right now
+    _wall_start: float = 0.0  # perf_counter when _active went 0 → 1
 
     def add(self, seconds: float) -> None:
         self.total_s += seconds
@@ -80,23 +91,77 @@ class Histogram:
 
 @dataclass
 class Metrics:
-    """Thread-safe stage timers + counters + gauges + histograms."""
+    """Thread-safe stage timers + counters + gauges + histograms.
+
+    `stage()` is re-entrant per thread (nesting the SAME stage name on one
+    thread accumulates only the outermost span — a recursive driver can't
+    double-count itself) and safe under concurrency (pipeline workers
+    timing the same stage from N threads accumulate busy time additively
+    while ``wall_s`` tracks the interval union). The ratio of total busy
+    time to the union wall across all stages is the derived
+    ``overlap_efficiency`` (1.0 = fully serial; >1 = stages overlapped),
+    reported by `snapshot()` once any stage has run.
+    """
 
     timers: dict[str, StageTimer] = field(default_factory=dict)
     counters: dict[str, int] = field(default_factory=dict)
     gauges: dict[str, float] = field(default_factory=dict)
     histograms: dict[str, Histogram] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _tls: threading.local = field(default_factory=threading.local, repr=False)
+    # union wall across ALL stages (any-stage-active intervals)
+    _union_active: int = field(default=0, repr=False)
+    _union_start: float = field(default=0.0, repr=False)
+    union_wall_s: float = field(default=0.0, repr=False)
 
     @contextmanager
     def stage(self, name: str):
+        depths = getattr(self._tls, "depths", None)
+        if depths is None:
+            depths = self._tls.depths = {}
+        if depths.get(name, 0):
+            # same-thread re-entry of the same stage: the outermost span
+            # already covers this interval — count nothing extra
+            depths[name] += 1
+            try:
+                yield
+            finally:
+                depths[name] -= 1
+            return
+        depths[name] = 1
         start = time.perf_counter()
+        with self._lock:
+            timer = self.timers.setdefault(name, StageTimer())
+            if timer._active == 0:
+                timer._wall_start = start
+            timer._active += 1
+            if self._union_active == 0:
+                self._union_start = start
+            self._union_active += 1
         try:
             yield
         finally:
-            elapsed = time.perf_counter() - start
+            end = time.perf_counter()
             with self._lock:
-                self.timers.setdefault(name, StageTimer()).add(elapsed)
+                timer.add(end - start)
+                timer._active -= 1
+                if timer._active == 0:
+                    timer.wall_s += end - timer._wall_start
+                self._union_active -= 1
+                if self._union_active == 0:
+                    self.union_wall_s += end - self._union_start
+            depths[name] -= 1
+            if not depths[name]:
+                del depths[name]
+
+    def overlap_efficiency(self) -> "float | None":
+        """Busy-over-wall across all stages: how much stage work ran per
+        unit of stage wall-clock. 1.0 means fully serial; N-way overlapped
+        stages approach N. None until any stage completes."""
+        with self._lock:
+            busy = sum(t.total_s for t in self.timers.values())
+            wall = self.union_wall_s
+        return (busy / wall) if wall > 0 else None
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -119,11 +184,18 @@ class Metrics:
         with self._lock:
             out = {
                 "timers": {
-                    k: {"total_s": round(v.total_s, 6), "calls": v.calls}
+                    k: {
+                        "total_s": round(v.total_s, 6),
+                        "calls": v.calls,
+                        "wall_s": round(v.wall_s, 6),
+                    }
                     for k, v in self.timers.items()
                 },
                 "counters": dict(self.counters),
             }
+            busy = sum(t.total_s for t in self.timers.values())
+            if self.union_wall_s > 0:
+                out["overlap_efficiency"] = round(busy / self.union_wall_s, 4)
             if self.gauges:
                 out["gauges"] = dict(self.gauges)
             if self.histograms:

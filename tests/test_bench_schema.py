@@ -1,0 +1,114 @@
+"""Tier-1 gate on bench reporting: every checked-in BENCH_*.json must pass
+`tools/check_bench_schema.py`, and the newest must carry the full current
+e2e key set (overlap flags, serial comparison, host introspection) — so a
+leg that stops emitting a key fails here, not at artifact-consumption
+time."""
+
+import glob
+import json
+import os
+import re
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from check_bench_schema import check_artifact, main  # noqa: E402
+
+ARTIFACTS = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+
+
+def _round_key(path):
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+NEWEST = max(ARTIFACTS, key=_round_key, default=None)
+
+
+class TestCheckedInArtifacts:
+    def test_artifacts_exist(self):
+        assert ARTIFACTS, "no BENCH_*.json artifacts checked in"
+
+    @pytest.mark.parametrize("path", ARTIFACTS, ids=os.path.basename)
+    def test_artifact_passes_schema(self, path):
+        with open(path) as fh:
+            obj = json.load(fh)
+        assert check_artifact(obj) == []
+
+    def test_newest_has_full_current_schema(self):
+        with open(NEWEST) as fh:
+            obj = json.load(fh)
+        assert check_artifact(obj, require_current=True) == [], NEWEST
+
+    def test_newest_reports_overlap_flags(self):
+        """The stage-overlapped engine is the headline path: the current
+        artifact must say so and carry the serial comparison."""
+        with open(NEWEST) as fh:
+            obj = json.load(fh)
+        assert obj["stages_overlap"] is True
+        assert obj["gen_verify_overlap"] is True
+        assert obj["serial_proofs_per_sec"] is not None
+        assert obj["pipeline_speedup_vs_serial"] is not None
+        assert obj["host_cores"] == obj["host_cores"] and obj["host_cores"] >= 1
+
+    def test_cli_accepts_all_artifacts(self, capsys):
+        assert main(ARTIFACTS) == 0
+        assert main(["--require-current", NEWEST]) == 0
+        capsys.readouterr()
+
+
+class TestSyntheticRegressions:
+    def _current(self):
+        with open(NEWEST) as fh:
+            return json.load(fh)
+
+    def test_missing_core_key_fails(self):
+        obj = self._current()
+        del obj["value"]
+        assert any("value" in p for p in check_artifact(obj))
+
+    def test_type_drift_fails(self):
+        obj = self._current()
+        obj["proofs"] = "656"  # stringified number = consumer breakage
+        assert any("proofs" in p for p in check_artifact(obj))
+
+    def test_bool_does_not_pass_as_number(self):
+        obj = self._current()
+        obj["events_per_sec_e2e"] = True
+        assert any("events_per_sec_e2e" in p for p in check_artifact(obj))
+
+    def test_non_numeric_stage_fails(self):
+        obj = self._current()
+        obj["stages_ms"]["scan"] = "31ms"
+        assert any("stages_ms" in p for p in check_artifact(obj))
+
+    def test_dropped_current_key_fails_only_current_mode(self):
+        obj = self._current()
+        del obj["gen_verify_overlap"]
+        assert check_artifact(obj) == []  # old vintages may lack it
+        assert any(
+            "gen_verify_overlap" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_null_schema_artifact_is_valid_noncurrent(self):
+        """The orchestrator's total-failure artifact (all keys null) must
+        still validate — honesty is part of the schema."""
+        obj = {
+            "metric": "event_proofs_per_sec_4k_range_e2e",
+            "unit": "proofs/s",
+            **{k: None for k in (
+                "value", "platform", "devices", "host_cores", "scan_threads",
+                "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
+                "stages_overlap", "e2e_policy", "e2e_reps_s",
+            )},
+        }
+        assert check_artifact(obj) == []
+
+    def test_legacy_wrapper_rejected_as_current(self):
+        obj = {"cmd": "python bench.py", "rc": 0, "tail": "", "n": 1, "parsed": None}
+        assert check_artifact(obj) == []
+        assert check_artifact(obj, require_current=True) != []

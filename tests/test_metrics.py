@@ -1,0 +1,107 @@
+"""Metrics stage-timer concurrency tests: the pipeline executor hammers one
+`Metrics` from many worker threads, so `stage()` must accumulate under a
+lock, nest re-entrantly per thread, and report honest wall-clock (interval
+union) next to additive busy time."""
+
+import threading
+import time
+
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+class TestStageThreadSafety:
+    def test_eight_threads_hammering_one_stage(self):
+        """8 threads × 200 entries each: calls and busy totals must come out
+        exact (no lost updates), and the stage wall must not exceed the run's
+        real wall-clock."""
+        m = Metrics()
+        n_threads, n_iters = 8, 200
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(n_iters):
+                with m.stage("hammer"):
+                    pass
+                m.count("hits")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        run_wall = time.perf_counter() - t0
+
+        snap = m.snapshot()
+        timer = snap["timers"]["hammer"]
+        assert timer["calls"] == n_threads * n_iters
+        assert snap["counters"]["hits"] == n_threads * n_iters
+        assert timer["total_s"] >= 0.0
+        # interval union can never exceed the real elapsed wall (+ slack)
+        assert timer["wall_s"] <= run_wall + 0.05
+
+    def test_concurrent_stages_report_union_wall(self):
+        """N workers sleeping concurrently in one stage: busy sums the per
+        -thread elapsed (~N × sleep) while wall_s stays ~one sleep."""
+        m = Metrics()
+        n_threads, sleep_s = 4, 0.05
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            with m.stage("overlapped"):
+                time.sleep(sleep_s)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        timer = m.snapshot()["timers"]["overlapped"]
+        assert timer["total_s"] >= n_threads * sleep_s * 0.9
+        assert timer["wall_s"] < n_threads * sleep_s * 0.9  # genuinely unioned
+        assert timer["wall_s"] >= sleep_s * 0.9
+
+        eff = m.overlap_efficiency()
+        assert eff is not None and eff > 1.5  # 4-way overlap, generous floor
+
+    def test_same_thread_reentry_counts_outermost_only(self):
+        """Nested same-name stages on one thread must not double-count: the
+        recursive inner spans are already inside the outer interval."""
+        m = Metrics()
+        with m.stage("recursive"):
+            with m.stage("recursive"):
+                with m.stage("recursive"):
+                    time.sleep(0.02)
+        timer = m.snapshot()["timers"]["recursive"]
+        assert timer["calls"] == 1
+        assert 0.015 <= timer["total_s"] < 0.2
+        # busy and wall agree for a single-threaded span
+        assert abs(timer["total_s"] - timer["wall_s"]) < 1e-3
+
+    def test_distinct_stage_names_nest_normally(self):
+        m = Metrics()
+        with m.stage("outer"):
+            with m.stage("inner"):
+                time.sleep(0.01)
+        snap = m.snapshot()["timers"]
+        assert snap["outer"]["calls"] == 1 and snap["inner"]["calls"] == 1
+        assert snap["outer"]["total_s"] >= snap["inner"]["total_s"]
+
+    def test_serial_stages_efficiency_near_one(self):
+        m = Metrics()
+        for _ in range(3):
+            with m.stage("a"):
+                time.sleep(0.01)
+            with m.stage("b"):
+                time.sleep(0.01)
+        eff = m.overlap_efficiency()
+        assert eff is not None and 0.9 <= eff <= 1.1
+        assert m.snapshot()["overlap_efficiency"] == round(eff, 4)
+
+    def test_no_stages_yet(self):
+        m = Metrics()
+        assert m.overlap_efficiency() is None
+        assert "overlap_efficiency" not in m.snapshot()

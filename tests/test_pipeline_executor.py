@@ -1,0 +1,192 @@
+"""Unit tests for the host stage pipeline (`parallel.pipeline.run_pipeline`):
+ordering under out-of-order completion, bounded-queue backpressure, error
+propagation without deadlock, and thread-safe stage metrics."""
+
+import threading
+import time
+
+import pytest
+
+from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+def _run_with_deadline(fn, seconds=30.0):
+    """Run fn on a thread with a join deadline: a deadlocked pipeline fails
+    the test instead of hanging the whole tier-1 suite."""
+    out: dict = {}
+
+    def target():
+        try:
+            out["result"] = fn()
+        except BaseException as exc:  # noqa: BLE001
+            out["exc"] = exc
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(seconds)
+    assert not t.is_alive(), "pipeline deadlocked (join deadline hit)"
+    if "exc" in out:
+        raise out["exc"]
+    return out["result"]
+
+
+class TestOrdering:
+    def test_single_stage_identity_order(self):
+        results = run_pipeline(list(range(50)), [PipelineStage("x", lambda v: v * 2)])
+        assert results == [v * 2 for v in range(50)]
+
+    def test_multi_worker_stage_preserves_input_order(self):
+        """Workers finishing out of order (reverse-proportional sleeps) must
+        still emit downstream in input order."""
+
+        def slow_for_early(v):
+            time.sleep(0.002 * (20 - v) if v < 20 else 0)
+            return v
+
+        seen_by_second_stage = []
+
+        def record(v):
+            seen_by_second_stage.append(v)
+            return v
+
+        results = run_pipeline(
+            list(range(20)),
+            [
+                PipelineStage("jitter", slow_for_early, workers=4),
+                PipelineStage("record", record, workers=1),
+            ],
+            depth=3,
+        )
+        assert results == list(range(20))
+        assert seen_by_second_stage == list(range(20))
+
+    def test_three_stages_compose(self):
+        results = run_pipeline(
+            list(range(10)),
+            [
+                PipelineStage("a", lambda v: v + 1, workers=3),
+                PipelineStage("b", lambda v: v * 10, workers=2),
+                PipelineStage("c", lambda v: v - 5),
+            ],
+            depth=1,
+        )
+        assert results == [(v + 1) * 10 - 5 for v in range(10)]
+
+    def test_empty_items(self):
+        assert run_pipeline([], [PipelineStage("x", lambda v: v)]) == []
+
+    def test_more_workers_than_items(self):
+        results = run_pipeline([7], [PipelineStage("x", lambda v: v + 1, workers=8)], depth=1)
+        assert results == [8]
+
+    def test_no_stages_raises(self):
+        with pytest.raises(ValueError):
+            run_pipeline([1, 2], [])
+
+
+class TestBackpressure:
+    def test_bounded_depth_limits_readahead(self):
+        """With depth=2 a fast producer can run at most depth + workers
+        items ahead of a slow consumer — never the whole input."""
+        lock = threading.Lock()
+        produced: list[int] = []
+        consumed: list[int] = []
+        max_lead = 0
+
+        def produce(v):
+            nonlocal max_lead
+            with lock:
+                produced.append(v)
+                max_lead = max(max_lead, len(produced) - len(consumed))
+            return v
+
+        def consume(v):
+            time.sleep(0.005)
+            with lock:
+                consumed.append(v)
+            return v
+
+        run_pipeline(
+            list(range(30)),
+            [PipelineStage("fast", produce, workers=1), PipelineStage("slow", consume)],
+            depth=2,
+        )
+        # 1 in the producer, 2 buffered, 1 in the consumer (+1 slack)
+        assert max_lead <= 5
+        assert consumed == list(range(30))
+
+
+class TestErrorPropagation:
+    def test_worker_exception_propagates(self):
+        class Boom(RuntimeError):
+            pass
+
+        def maybe_boom(v):
+            if v == 7:
+                raise Boom("worker died")
+            return v
+
+        def run():
+            with pytest.raises(Boom, match="worker died"):
+                run_pipeline(
+                    list(range(100)),
+                    [
+                        PipelineStage("scan", maybe_boom, workers=4),
+                        PipelineStage("record", lambda v: v),
+                    ],
+                    depth=2,
+                )
+
+        _run_with_deadline(run)
+
+    def test_downstream_exception_cancels_blocked_producers(self):
+        """A failure in the LAST stage must unwedge producers blocked on the
+        bounded queue (the classic pipeline deadlock)."""
+
+        def slow_fail(v):
+            time.sleep(0.01)
+            raise ValueError("sink failed")
+
+        def run():
+            with pytest.raises(ValueError, match="sink failed"):
+                run_pipeline(
+                    list(range(200)),
+                    [
+                        PipelineStage("produce", lambda v: bytes(1000), workers=2),
+                        PipelineStage("sink", slow_fail),
+                    ],
+                    depth=1,
+                )
+
+        _run_with_deadline(run)
+
+    def test_first_exception_wins(self):
+        def boom(v):
+            raise KeyError(v)
+
+        def run():
+            with pytest.raises(KeyError):
+                run_pipeline(list(range(10)), [PipelineStage("boom", boom, workers=3)])
+
+        _run_with_deadline(run)
+
+
+class TestStageMetrics:
+    def test_stage_timers_recorded_per_stage(self):
+        m = Metrics()
+        run_pipeline(
+            list(range(8)),
+            [
+                PipelineStage("a", lambda v: time.sleep(0.005) or v, workers=4,
+                              metrics_stage="pipe_a"),
+                PipelineStage("b", lambda v: v, metrics_stage="pipe_b"),
+            ],
+            depth=2,
+            metrics=m,
+        )
+        snap = m.snapshot()["timers"]
+        assert snap["pipe_a"]["calls"] == 8
+        assert snap["pipe_b"]["calls"] == 8
+        # 4 workers sleeping concurrently: busy exceeds union wall
+        assert snap["pipe_a"]["total_s"] > snap["pipe_a"]["wall_s"]

@@ -1,0 +1,208 @@
+"""Differential tests for the stage-overlapped range driver: the pipelined
+engine must emit byte-identical bundles to the chunked driver across the
+(scan_threads × pipeline_depth × chunk_size) grid, survive empty ranges,
+and propagate worker exceptions without deadlocking the executor."""
+
+import threading
+
+import pytest
+
+from ipc_proofs_tpu.backend import get_backend
+from ipc_proofs_tpu.fixtures import ContractFixture, EventFixture, build_chain
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import (
+    TipsetPair,
+    generate_and_verify_range_overlapped,
+    generate_event_proofs_for_range_chunked,
+    generate_event_proofs_for_range_pipelined,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "pipe-subnet"
+ACTOR = 777
+
+
+def _make_range(n_pairs=4):
+    """n_pairs independent synthetic worlds sharing one blockstore."""
+    bs = MemoryBlockstore()
+    pairs = []
+    expected = 0
+    for p in range(n_pairs):
+        events = [
+            [EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET,
+                          data=p.to_bytes(32, "big"))] if p % 2 == 0 else [],
+            [EventFixture(emitter=ACTOR, signature="Noise()", topic1=SUBNET)],
+        ]
+        if p % 2 == 0:
+            expected += 1
+        world = build_chain(
+            [ContractFixture(actor_id=ACTOR)],
+            events,
+            parent_height=100 + 2 * p,
+            store=bs,
+        )
+        pairs.append(TipsetPair(parent=world.parent, child=world.child))
+    return bs, pairs, expected
+
+
+SPEC = dict(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+
+
+class TestDifferentialGrid:
+    @pytest.mark.parametrize("scan_threads", [1, 4])
+    @pytest.mark.parametrize("pipeline_depth", [1, 3])
+    @pytest.mark.parametrize("chunk_size", [1, 7, 512])
+    def test_pipelined_matches_chunked(self, scan_threads, pipeline_depth, chunk_size):
+        bs, pairs, expected = _make_range(7)
+        spec = EventProofSpec(**SPEC)
+        reference = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=chunk_size
+        ).to_json()
+        for backend in (None, get_backend("cpu")):
+            piped = generate_event_proofs_for_range_pipelined(
+                bs, pairs, spec,
+                chunk_size=chunk_size,
+                match_backend=backend,
+                scan_threads=scan_threads,
+                pipeline_depth=pipeline_depth,
+            )
+            assert piped.to_json() == reference, (backend, scan_threads, pipeline_depth)
+        assert len(piped.event_proofs) == expected
+
+    @pytest.mark.parametrize("scan_threads", [1, 4])
+    def test_integrated_verify_matches_chunked(self, scan_threads):
+        """verify-while-generate: merged bundle identical to the chunked
+        driver, per-chunk verdicts equal to whole-bundle verification."""
+        bs, pairs, expected = _make_range(7)
+        spec = EventProofSpec(**SPEC)
+
+        def verify_chunk(bundle):
+            return verify_proof_bundle(bundle, TrustPolicy.accept_all()).event_results
+
+        for chunk_size in (1, 3, 512):
+            reference = generate_event_proofs_for_range_chunked(
+                bs, pairs, spec, chunk_size=chunk_size
+            )
+            merged, chunk_results = generate_and_verify_range_overlapped(
+                bs, pairs, spec, chunk_size=chunk_size,
+                verify_chunk=verify_chunk, scan_threads=scan_threads,
+            )
+            assert merged.to_json() == reference.to_json(), chunk_size
+            flat = [r for res in chunk_results for r in res]
+            whole = verify_proof_bundle(merged, TrustPolicy.accept_all()).event_results
+            assert flat == whole, chunk_size
+            assert all(flat) and len(flat) == expected
+
+    def test_empty_range(self):
+        bs, _, _ = _make_range(1)
+        spec = EventProofSpec(**SPEC)
+        bundle = generate_event_proofs_for_range_pipelined(
+            bs, [], spec, scan_threads=4, pipeline_depth=3
+        )
+        assert bundle.event_proofs == [] and bundle.blocks == []
+        results: list = []
+        bundle = generate_event_proofs_for_range_pipelined(
+            bs, [], spec, verify_chunk=lambda b: ["ran"], verify_results=results
+        )
+        assert bundle.event_proofs == [] and results == []
+
+
+class TestWorkerFailure:
+    def _drive_with_deadline(self, fn, seconds=30.0):
+        out: dict = {}
+
+        def target():
+            try:
+                out["result"] = fn()
+            except BaseException as exc:  # noqa: BLE001
+                out["exc"] = exc
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        t.join(seconds)
+        assert not t.is_alive(), "pipelined driver deadlocked on worker failure"
+        if "exc" in out:
+            raise out["exc"]
+        return out["result"]
+
+    def test_scan_worker_exception_propagates(self, monkeypatch):
+        import ipc_proofs_tpu.proofs.range as range_mod
+
+        bs, pairs, _ = _make_range(6)
+        spec = EventProofSpec(**SPEC)
+        real = range_mod._scan_and_match
+        calls = []
+
+        def flaky(cached, chunk, *a, **kw):
+            calls.append(chunk)
+            if len(calls) == 3:
+                raise RuntimeError("scan worker died mid-range")
+            return real(cached, chunk, *a, **kw)
+
+        monkeypatch.setattr(range_mod, "_scan_and_match", flaky)
+
+        def run():
+            with pytest.raises(RuntimeError, match="scan worker died"):
+                generate_event_proofs_for_range_pipelined(
+                    bs, pairs, spec, chunk_size=1, scan_threads=4, pipeline_depth=2
+                )
+
+        self._drive_with_deadline(run)
+
+    def test_record_worker_exception_propagates(self, monkeypatch):
+        import ipc_proofs_tpu.proofs.range as range_mod
+
+        bs, pairs, _ = _make_range(6)
+        spec = EventProofSpec(**SPEC)
+
+        def boom(*a, **kw):
+            raise ValueError("record stage died")
+
+        monkeypatch.setattr(range_mod, "_record_chunk", boom)
+
+        def run():
+            with pytest.raises(ValueError, match="record stage died"):
+                generate_event_proofs_for_range_pipelined(
+                    bs, pairs, spec, chunk_size=2, scan_threads=2
+                )
+
+        self._drive_with_deadline(run)
+
+    def test_verify_stage_exception_propagates(self):
+        bs, pairs, _ = _make_range(4)
+        spec = EventProofSpec(**SPEC)
+
+        def bad_verify(bundle):
+            raise KeyError("verifier rejected chunk")
+
+        def run():
+            with pytest.raises(KeyError, match="verifier rejected chunk"):
+                generate_event_proofs_for_range_pipelined(
+                    bs, pairs, spec, chunk_size=1, verify_chunk=bad_verify
+                )
+
+        self._drive_with_deadline(run)
+
+
+class TestPipelineMetrics:
+    def test_stage_timers_and_overlap_efficiency(self):
+        bs, pairs, expected = _make_range(6)
+        spec = EventProofSpec(**SPEC)
+        m = Metrics()
+        results: list = []
+        generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2, scan_threads=2,
+            verify_chunk=lambda b: len(b.event_proofs), verify_results=results,
+            metrics=m,
+        )
+        assert sum(results) == expected
+        snap = m.snapshot()
+        for stage in ("range_scan", "range_record", "range_verify"):
+            assert stage in snap["timers"], stage
+            assert snap["timers"][stage]["wall_s"] <= snap["timers"][stage]["total_s"] + 1e-6
+        assert snap["counters"]["range_proofs"] == expected
+        assert "overlap_efficiency" in snap

@@ -172,16 +172,13 @@ class TestRpcRetries:
     def _client(self, fail_times):
         from ipc_proofs_tpu.store.rpc import LotusClient
 
-        client = LotusClient.__new__(LotusClient)
-        client.endpoint = "http://fake"
-        client.timeout_s = 1.0
-        client.max_retries = 3
-        client._headers = {}
-        import threading
-
-        client._id_lock = threading.Lock()
-        client._next_id = 1
-        client._session = FlakyClient(fail_times)
+        client = LotusClient(
+            "http://fake",
+            timeout_s=1.0,
+            max_retries=3,
+            session=FlakyClient(fail_times),
+            metrics=Metrics(),
+        )
         return client
 
     def test_retries_then_succeeds(self, monkeypatch):

@@ -1,0 +1,126 @@
+"""LotusClient retry/timeout behavior: bounded exponential backoff on
+transport errors, fail-fast block-fetch deadline, retry counters, and no
+retry on protocol-level RpcError — all via an injected fake session (no
+`requests` dependency)."""
+
+import base64
+
+import pytest
+
+from ipc_proofs_tpu.store import rpc as rpc_mod
+from ipc_proofs_tpu.store.rpc import LotusClient, RpcError
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+class _Response:
+    def __init__(self, result=None, error=None):
+        self._body = {"jsonrpc": "2.0", "result": result, "id": 1}
+        if error is not None:
+            self._body["error"] = error
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self._body
+
+
+class _FlakySession:
+    """Raises a transport error for the first ``fail_times`` posts, then
+    answers with ``result``. Records every timeout the client passed."""
+
+    def __init__(self, fail_times=0, result=None, error=None):
+        self.fail_times = fail_times
+        self.result = result
+        self.error = error
+        self.posts = 0
+        self.timeouts: list[float] = []
+
+    def post(self, endpoint, data=None, headers=None, timeout=None):
+        self.posts += 1
+        self.timeouts.append(timeout)
+        if self.posts <= self.fail_times:
+            raise ConnectionError(f"transport down (post {self.posts})")
+        return _Response(result=self.result, error=self.error)
+
+
+def _client(session, metrics, **kw):
+    kw.setdefault("max_retries", 4)
+    return LotusClient("http://fake", session=session, metrics=metrics, **kw)
+
+
+class TestRetries:
+    def test_transport_errors_retry_then_succeed(self, monkeypatch):
+        sleeps: list[float] = []
+        monkeypatch.setattr(rpc_mod.time, "sleep", sleeps.append)
+        m = Metrics()
+        session = _FlakySession(fail_times=2, result="ok")
+        client = _client(session, m, backoff_base_s=0.25, backoff_max_s=10.0)
+        assert client.request("Filecoin.Thing", []) == "ok"
+        assert session.posts == 3
+        assert m.snapshot()["counters"]["rpc.retries"] == 2
+        # exponential: base * 2**attempt
+        assert sleeps == [0.25, 0.5]
+
+    def test_backoff_is_bounded(self, monkeypatch):
+        sleeps: list[float] = []
+        monkeypatch.setattr(rpc_mod.time, "sleep", sleeps.append)
+        m = Metrics()
+        session = _FlakySession(fail_times=5, result="ok")
+        client = _client(
+            session, m, max_retries=6, backoff_base_s=1.0, backoff_max_s=3.0
+        )
+        assert client.request("Filecoin.Thing", []) == "ok"
+        assert sleeps == [1.0, 2.0, 3.0, 3.0, 3.0]  # capped at backoff_max_s
+
+    def test_exhaustion_raises_and_counts_failure(self, monkeypatch):
+        monkeypatch.setattr(rpc_mod.time, "sleep", lambda s: None)
+        m = Metrics()
+        session = _FlakySession(fail_times=99)
+        client = _client(session, m, max_retries=3)
+        with pytest.raises(RuntimeError, match="failed after 3 attempts"):
+            client.request("Filecoin.Thing", [])
+        assert session.posts == 3
+        counters = m.snapshot()["counters"]
+        assert counters["rpc.retries"] == 2  # sleeps between the 3 attempts
+        assert counters["rpc.failures"] == 1
+
+    def test_rpc_error_is_not_retried(self, monkeypatch):
+        monkeypatch.setattr(
+            rpc_mod.time, "sleep",
+            lambda s: pytest.fail("must not sleep on protocol errors"),
+        )
+        m = Metrics()
+        session = _FlakySession(error={"code": -32601, "message": "no such method"})
+        client = _client(session, m)
+        with pytest.raises(RpcError, match="-32601"):
+            client.request("Filecoin.Nope", [])
+        assert session.posts == 1
+        assert "rpc.retries" not in m.snapshot()["counters"]
+
+
+class TestTimeouts:
+    def test_block_fetch_uses_fail_fast_deadline(self):
+        m = Metrics()
+        raw = b"\x01\x02\x03"
+        session = _FlakySession(result=base64.b64encode(raw).decode())
+        client = _client(session, m, timeout_s=250.0, block_timeout_s=30.0)
+        from ipc_proofs_tpu.core.cid import CID
+
+        cid = CID.hash_of(b"block")
+        assert client.chain_read_obj(cid) == raw
+        assert session.timeouts == [30.0]  # not the general 250 s deadline
+
+    def test_general_requests_keep_long_deadline(self):
+        m = Metrics()
+        session = _FlakySession(result={})
+        client = _client(session, m, timeout_s=250.0, block_timeout_s=30.0)
+        client.request("Filecoin.StateLookupID", [])
+        assert session.timeouts == [250.0]
+
+    def test_per_call_override_wins(self):
+        m = Metrics()
+        session = _FlakySession(result={})
+        client = _client(session, m, timeout_s=250.0)
+        client.request("Filecoin.Thing", [], timeout_s=5.0)
+        assert session.timeouts == [5.0]

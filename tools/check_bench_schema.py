@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Validate BENCH_*.json artifacts against the bench reporting schema.
+
+Catches bench-reporting regressions at test time instead of at
+artifact-consumption time: a leg that silently stops emitting a key, a
+type drift (string where a number was), or a headline missing the overlap
+flags. Two strictness levels:
+
+- every artifact (any vintage) must carry the CORE keys with sane types;
+- the CURRENT artifact (``--require-current`` / ``require_current=True``)
+  must carry the full present-day e2e key set — the orchestrator's
+  ``_E2E_SCHEMA_KEYS`` contract plus the satellite leg keys.
+
+Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
+
+    python tools/check_bench_schema.py BENCH_*.json
+    python tools/check_bench_schema.py --require-current BENCH_r07.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# round ≤5 artifacts are raw run-capture wrappers: the orchestrator JSON
+# (when the run parsed) sits under "parsed"
+_WRAPPER_KEYS = {"cmd", "rc", "tail"}
+
+# every orchestrator artifact, any vintage, must have these
+_CORE_REQUIRED = {
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+}
+
+# known keys with their expected types; None is always allowed (legs can
+# fail and the orchestrator nulls their keys honestly)
+_NUM = (int, float)
+_KNOWN_TYPES = {
+    "platform": str,
+    "devices": int,
+    "host_cores": int,
+    "host_cores_affinity": int,
+    "scan_threads": int,
+    "native_scan_threads": int,
+    "pipeline_depth": int,
+    "pipeline_chunk": int,
+    "verify_chunk_pairs": int,
+    "events_per_sec_e2e": _NUM,
+    "proofs": int,
+    "stages_ms": dict,
+    "stages_wall_ms": dict,
+    "stages_overlap": bool,
+    "gen_verify_overlap": bool,
+    "overlap_efficiency": _NUM,
+    "serial_proofs_per_sec": _NUM,
+    "serial_e2e_reps_s": list,
+    "pipeline_speedup_vs_serial": _NUM,
+    "e2e_policy": str,
+    "e2e_reps_s": list,
+    "vs_baseline": _NUM,
+    "vs_native_baseline": _NUM,
+    "scalar_baseline_proofs_per_sec": _NUM,
+    "native_baseline_proofs_per_sec": _NUM,
+    "device_mask_kernel_events_per_sec": _NUM,
+    "witness_cid_kernel_per_sec": _NUM,
+    "witness_cid_kernel": str,
+    "serve_batched_rps": _NUM,
+    "serve_sequential_rps": _NUM,
+    "serve_speedup_vs_sequential": _NUM,
+    "serve_concurrency": int,
+    "serve_requests": int,
+    "serve_p99_latency_ms": _NUM,
+    "serve_mean_batch": _NUM,
+    "serve_rejections": int,
+    "witness_reduction_pct": _NUM,
+    "witness_two_pass_bytes": int,
+    "witness_single_pass_bytes": int,
+    "witness_sample_pairs": int,
+    "legs": dict,
+    "watchdog_fallback": bool,
+}
+
+# the CURRENT artifact must report the full e2e contract: host
+# introspection, pipeline knobs, both overlap flags, and the serial
+# comparison the speedup ratio is derived from
+_CURRENT_REQUIRED = (
+    "platform", "devices", "host_cores", "host_cores_affinity",
+    "scan_threads", "native_scan_threads", "pipeline_depth",
+    "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
+    "stages_wall_ms", "stages_overlap", "gen_verify_overlap",
+    "overlap_efficiency", "serial_proofs_per_sec", "serial_e2e_reps_s",
+    "pipeline_speedup_vs_serial", "e2e_policy", "e2e_reps_s",
+    "vs_baseline", "vs_native_baseline",
+    "scalar_baseline_proofs_per_sec", "native_baseline_proofs_per_sec",
+    "serve_batched_rps", "serve_speedup_vs_sequential",
+    "witness_reduction_pct", "legs", "watchdog_fallback",
+)
+
+
+def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
+    """Return a list of problems ([] = valid).
+
+    ``require_current`` additionally demands the full present-day key set
+    (apply it to the newest artifact only — old vintages legitimately
+    predate newer keys).
+    """
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"artifact is {type(obj).__name__}, expected object"]
+
+    if _WRAPPER_KEYS <= set(obj):
+        # legacy run-capture wrapper: validate the parsed payload when the
+        # wrapped run succeeded; a failed capture (parsed: null) is honest
+        if require_current:
+            problems.append("current artifact must be orchestrator JSON, not a run-capture wrapper")
+        parsed = obj.get("parsed")
+        if parsed is None:
+            return problems
+        return problems + [f"parsed: {p}" for p in check_artifact(parsed)]
+
+    for key, types in _CORE_REQUIRED.items():
+        if key not in obj:
+            problems.append(f"missing required key {key!r}")
+        elif obj[key] is not None and not isinstance(obj[key], types):
+            problems.append(
+                f"{key!r} is {type(obj[key]).__name__}, expected {types}"
+            )
+    # the headline may be null only in the total-failure artifact, which
+    # still carries the schema — "value" must then EXIST and be null
+    if "value" in obj and obj["value"] is None and obj.get("platform") is not None:
+        problems.append("null value with a non-null platform (partial schema)")
+
+    for key, types in _KNOWN_TYPES.items():
+        if key in obj and obj[key] is not None and not isinstance(obj[key], types):
+            # bool is an int subclass; don't let flags pass as numbers
+            problems.append(
+                f"{key!r} is {type(obj[key]).__name__}, expected {types}"
+            )
+        if (
+            key in obj
+            and isinstance(obj[key], bool)
+            and not (types is bool or types == bool)
+        ):
+            problems.append(f"{key!r} is bool, expected {types}")
+
+    for key in ("stages_ms", "stages_wall_ms"):
+        val = obj.get(key)
+        if isinstance(val, dict):
+            for stage, ms in val.items():
+                if not isinstance(ms, (int, float)) or isinstance(ms, bool):
+                    problems.append(f"{key}[{stage!r}] is not a number")
+
+    for key in ("e2e_reps_s", "serial_e2e_reps_s"):
+        val = obj.get(key)
+        if isinstance(val, list) and any(
+            not isinstance(v, (int, float)) or isinstance(v, bool) for v in val
+        ):
+            problems.append(f"{key!r} has non-numeric entries")
+
+    if require_current:
+        for key in _CURRENT_REQUIRED:
+            if key not in obj:
+                problems.append(f"current artifact missing key {key!r}")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
+    parser.add_argument(
+        "--require-current",
+        action="store_true",
+        help="demand the full present-day key set (newest artifact only)",
+    )
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.artifacts:
+        try:
+            with open(path) as fh:
+                obj = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"{path}: UNREADABLE ({exc})")
+            rc = 1
+            continue
+        problems = check_artifact(obj, require_current=args.require_current)
+        if problems:
+            rc = 1
+            print(f"{path}: {len(problems)} problem(s)")
+            for p in problems:
+                print(f"  - {p}")
+        else:
+            print(f"{path}: ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
