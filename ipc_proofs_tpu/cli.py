@@ -27,6 +27,37 @@ from ipc_proofs_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 
+def _make_rpc_client(args):
+    """Build the chain client: one `LotusClient`, or an `EndpointPool`
+    across ``--endpoint`` + every ``--endpoints`` replica (failover,
+    circuit breakers, hedged fetches, per-endpoint integrity demotion)."""
+    from ipc_proofs_tpu.store.rpc import LotusClient
+
+    endpoints = [args.endpoint] if args.endpoint else []
+    for extra in getattr(args, "endpoints", None) or []:
+        if extra not in endpoints:
+            endpoints.append(extra)
+    if not endpoints:
+        raise ValueError("no RPC endpoint configured")
+    clients = [
+        LotusClient(e, bearer_token=args.token, timeout_s=args.timeout)
+        for e in endpoints
+    ]
+    if len(clients) == 1:
+        return clients[0]
+    from ipc_proofs_tpu.store.failover import EndpointPool
+
+    log.info(
+        "endpoint pool: %d endpoints (breaker_threshold=%d hedge_ms=%s)",
+        len(clients), args.breaker_threshold, args.hedge_ms,
+    )
+    return EndpointPool(
+        clients,
+        breaker_threshold=args.breaker_threshold,
+        hedge_ms=args.hedge_ms,
+    )
+
+
 def _cmd_generate(args) -> int:
     from ipc_proofs_tpu.backend import get_backend
     from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
@@ -41,7 +72,7 @@ def _cmd_generate(args) -> int:
     from ipc_proofs_tpu.utils.metrics import get_metrics
 
     metrics = get_metrics()
-    client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
+    client = _make_rpc_client(args)
 
     with metrics.stage("fetch_tipsets"):
         parent = Tipset.fetch(client, args.height)
@@ -152,7 +183,7 @@ def _cmd_range(args) -> int:
         return 2
 
     metrics = get_metrics()
-    client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
+    client = _make_rpc_client(args)
 
     actor_id = None
     if args.contract:
@@ -233,7 +264,7 @@ def _cmd_vectors(args) -> int:
     from ipc_proofs_tpu.proofs.vectors import capture_vectors, check_vectors, write_vectors
     from ipc_proofs_tpu.store.rpc import LotusClient
 
-    client = LotusClient(args.endpoint, bearer_token=args.token, timeout_s=args.timeout)
+    client = _make_rpc_client(args)
     doc = capture_vectors(client, args.height)
     n = check_vectors(doc)  # never write vectors we cannot re-verify
     output = args.output or "vectors.json"
@@ -413,9 +444,11 @@ def _cmd_serve(args) -> int:
         log.info(
             "demo world: %d pairs, %d matching events", len(pairs), n_matching
         )
-    elif args.endpoint:
+    endpoint_pool = None
+    if not args.demo_world and (args.endpoint or args.endpoints):
         from ipc_proofs_tpu.proofs.chain import Tipset
-        from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+        from ipc_proofs_tpu.store.failover import EndpointPool
+        from ipc_proofs_tpu.store.rpc import RpcBlockstore
 
         if args.from_height is None or args.to_height is None:
             log.error("--endpoint requires --from-height and --to-height")
@@ -423,9 +456,9 @@ def _cmd_serve(args) -> int:
         if not (args.event_sig and args.topic1):
             log.error("--endpoint requires --event-sig and --topic1")
             return 2
-        client = LotusClient(
-            args.endpoint, bearer_token=args.token, timeout_s=args.timeout
-        )
+        client = _make_rpc_client(args)
+        if isinstance(client, EndpointPool):
+            endpoint_pool = client  # /healthz reports per-endpoint breakers
         tipsets = [
             Tipset.fetch(client, h)
             for h in range(args.from_height, args.to_height + 2)
@@ -469,6 +502,7 @@ def _cmd_serve(args) -> int:
             range_scan_threads=args.scan_threads,
             range_pipeline_depth=args.pipeline_depth,
         ),
+        endpoint_pool=endpoint_pool,
     )
     httpd = ProofHTTPServer(service, host=args.host, port=args.port, pairs=pairs)
     log.info(
@@ -497,10 +531,30 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ipc-proofs-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
+    def add_failover_flags(p):
+        p.add_argument(
+            "--endpoints", action="append", default=None, metavar="URL",
+            help="additional Lotus endpoint replicas (repeatable) — enables "
+            "the failover pool: circuit breakers, health-scored routing, "
+            "hedged fetches, per-endpoint integrity demotion",
+        )
+        p.add_argument(
+            "--hedge-ms", type=float, default=None,
+            help="hedged block fetches: fire a second fetch on the next "
+            "healthy endpoint after this many ms (floor; the observed p99 "
+            "raises it). Default: hedging off",
+        )
+        p.add_argument(
+            "--breaker-threshold", type=int, default=5,
+            help="consecutive failures that open an endpoint's circuit "
+            "breaker (default 5)",
+        )
+
     gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")
     gen.add_argument("--endpoint", required=True, help="Lotus JSON-RPC endpoint URL")
     gen.add_argument("--token", default=None, help="bearer token")
     gen.add_argument("--timeout", type=float, default=250.0)
+    add_failover_flags(gen)
     gen.add_argument("--height", type=int, required=True, help="parent epoch H (child is H+1)")
     gen.add_argument("--contract", help="EVM contract address 0x…")
     gen.add_argument("--actor-id", type=int, default=None, help="skip address resolution")
@@ -535,6 +589,7 @@ def main(argv=None) -> int:
     rng.add_argument("--endpoint", required=True)
     rng.add_argument("--token", default=None)
     rng.add_argument("--timeout", type=float, default=250.0)
+    add_failover_flags(rng)
     rng.add_argument("--from-height", type=int, required=True)
     rng.add_argument("--to-height", type=int, required=True)
     rng.add_argument("--contract", default=None)
@@ -639,6 +694,7 @@ def main(argv=None) -> int:
     srv.add_argument("--endpoint", default=None, help="Lotus JSON-RPC endpoint URL")
     srv.add_argument("--token", default=None)
     srv.add_argument("--timeout", type=float, default=250.0)
+    add_failover_flags(srv)
     srv.add_argument("--from-height", type=int, default=None)
     srv.add_argument("--to-height", type=int, default=None)
     srv.add_argument("--event-sig", default=None)

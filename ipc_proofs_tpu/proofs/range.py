@@ -63,6 +63,9 @@ def generate_and_verify_range_overlapped(
     generate_fn=None,
     scan_threads: "int | None" = None,
     pipeline_depth: int = 2,
+    checkpoint_dir: "str | None" = None,
+    scan_retries: int = 2,
+    force_pipeline: "bool | None" = None,
 ) -> "tuple[UnifiedProofBundle, list]":
     """Overlap VERIFICATION with generation across chunks: chunk k's bundle
     verifies while chunk k+1 generates — the generation-verification
@@ -99,6 +102,9 @@ def generate_and_verify_range_overlapped(
             pipeline_depth=pipeline_depth,
             verify_chunk=verify_chunk,
             verify_results=verify_results,
+            checkpoint_dir=checkpoint_dir,
+            scan_retries=scan_retries,
+            force_pipeline=force_pipeline,
         )
         return merged, verify_results
 
@@ -112,6 +118,7 @@ def generate_and_verify_range_overlapped(
             pairs,
             spec,
             chunk_size=chunk_size,
+            checkpoint_dir=checkpoint_dir,
             match_backend=match_backend,
             metrics=metrics,
             storage_specs=storage_specs,
@@ -126,6 +133,43 @@ def generate_and_verify_range_overlapped(
 class TipsetPair:
     parent: Tipset
     child: Tipset
+
+
+def _request_spec_repr(spec: EventProofSpec, chunk_size: int, storage_specs) -> bytes:
+    """Byte identity of one range request for checkpoint keying.
+
+    Checkpoints are only valid for the exact request that wrote them —
+    the digest covers the event spec, storage specs, and chunk size, so a
+    re-run with different specs regenerates instead of silently resuming
+    stale bundles. (Shared by the chunked and pipelined drivers; both
+    produce interchangeable checkpoint files.)
+    """
+    return repr(
+        (
+            spec.event_signature,
+            spec.topic_1,
+            spec.actor_id_filter,
+            chunk_size,
+            [
+                (s.actor_id, s.key32().hex(), s.slot_index)
+                for s in (storage_specs or [])
+            ],
+        )
+    ).encode()
+
+
+def _chunk_checkpoint_digest(spec_repr: bytes, chunk) -> str:
+    """Digest of (request identity, chunk tipset identity) — a chunk of a
+    DIFFERENT epoch range never resumes from a shared checkpoint dir."""
+    import hashlib
+
+    h = hashlib.sha256(spec_repr)
+    for pair in chunk:
+        for cid in pair.parent.cids:
+            h.update(cid.to_bytes())
+        for cid in pair.child.cids:
+            h.update(cid.to_bytes())
+    return h.hexdigest()[:12]
 
 
 def generate_event_proofs_for_range_chunked(
@@ -157,39 +201,13 @@ def generate_event_proofs_for_range_chunked(
     is called with every chunk bundle as it becomes available (generated
     OR resumed) — the hook the gen/verify-overlapped driver builds on.
     """
-    import hashlib
     import os
 
     metrics = metrics or Metrics()
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
 
-    # checkpoints are only valid for the exact request that wrote them —
-    # each chunk's filename carries a digest of (event spec, storage specs,
-    # chunk size, AND the chunk's own tipset identity), so a re-run with
-    # different specs OR over a different epoch range regenerates instead
-    # of silently resuming stale bundles
-    spec_repr = repr(
-        (
-            spec.event_signature,
-            spec.topic_1,
-            spec.actor_id_filter,
-            chunk_size,
-            [
-                (s.actor_id, s.key32().hex(), s.slot_index)
-                for s in (storage_specs or [])
-            ],
-        )
-    ).encode()
-
-    def _chunk_digest(chunk) -> str:
-        h = hashlib.sha256(spec_repr)
-        for pair in chunk:
-            for cid in pair.parent.cids:
-                h.update(cid.to_bytes())
-            for cid in pair.child.cids:
-                h.update(cid.to_bytes())
-        return h.hexdigest()[:12]
+    spec_repr = _request_spec_repr(spec, chunk_size, storage_specs)
 
     storage_proofs = []
     event_proofs = []
@@ -198,7 +216,8 @@ def generate_event_proofs_for_range_chunked(
         chunk = pairs[start : start + chunk_size]
         path = (
             os.path.join(
-                checkpoint_dir, f"chunk_{_chunk_digest(chunk)}_{chunk_index:04d}.json"
+                checkpoint_dir,
+                f"chunk_{_chunk_checkpoint_digest(spec_repr, chunk)}_{chunk_index:04d}.json",
             )
             if checkpoint_dir is not None
             else None
@@ -619,6 +638,9 @@ def generate_event_proofs_for_range_pipelined(
     pipeline_depth: int = 2,
     verify_chunk=None,
     verify_results: "list | None" = None,
+    checkpoint_dir: "str | None" = None,
+    scan_retries: int = 2,
+    force_pipeline: "bool | None" = None,
 ) -> UnifiedProofBundle:
     """Stage-overlapped range generation on the bounded-queue pipeline
     executor (`parallel.pipeline.run_pipeline`): the range splits into
@@ -635,19 +657,34 @@ def generate_event_proofs_for_range_pipelined(
     emission order is deterministic) — enforced by tests/test_range.py.
     A worker exception cancels pending work and re-raises here. Overlap
     pays on multi-core hosts and on hosts where the device dispatch or
-    block fetches have real latency; on a single-core host it degrades
-    gracefully to roughly the chunked driver's cost.
+    block fetches have real latency.
+
+    **Single-core fallback:** on a host where ``os.cpu_count() == 1`` the
+    pipeline's queue/thread overhead costs more than the overlap pays
+    (BENCH_r07: 0.62× vs serial), so the driver runs the SAME stage
+    functions inline per chunk — bit-identical output by construction.
+    Override with ``force_pipeline=True`` (or env ``IPC_FORCE_PIPELINE=1``)
+    to keep the threaded pipeline regardless.
 
     ``verify_chunk(bundle) -> result`` switches the record stage to emit a
     self-contained bundle per chunk (its witness covers exactly its
     proofs) for the verify stage; per-chunk results append to
     ``verify_results`` in chunk order. Storage specs still prove
-    range-wide and appear only in the merged bundle. No checkpointing —
-    use `generate_event_proofs_for_range_chunked` for resumable runs.
+    range-wide and appear only in the merged bundle.
+
+    ``checkpoint_dir`` makes the pipelined path resumable with the same
+    per-chunk checkpoint files as `generate_event_proofs_for_range_chunked`
+    (interchangeable digests): finished chunks load from disk in the scan
+    stage (skipping the store entirely) and new chunk bundles are written
+    atomically as they record. ``scan_retries`` bounds transparent
+    re-scans of a chunk after a transient store/RPC error — a scan is a
+    pure read, so re-running it is deterministic; semantic `RpcError`s
+    fail fast.
     """
     import os
 
     from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
+    from ipc_proofs_tpu.store.rpc import RpcError
 
     metrics = metrics or Metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
@@ -656,42 +693,101 @@ def generate_event_proofs_for_range_pipelined(
     if scan_threads is None:
         scan_threads = os.cpu_count() or 1
     scan_threads = max(1, int(scan_threads))
+    if force_pipeline is None:
+        force_pipeline = os.environ.get("IPC_FORCE_PIPELINE", "") == "1"
+    serial_fallback = (os.cpu_count() or 1) == 1 and not force_pipeline
+
+    spec_repr = None
+    if checkpoint_dir is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        spec_repr = _request_spec_repr(spec, chunk_size, storage_specs)
+
+    def _ckpt_path(index: int, chunk) -> "str | None":
+        if checkpoint_dir is None:
+            return None
+        return os.path.join(
+            checkpoint_dir,
+            f"chunk_{_chunk_checkpoint_digest(spec_repr, chunk)}_{index:04d}.json",
+        )
+
+    # checkpoint mode (like verify mode) materializes self-contained
+    # per-chunk bundles; the cheap shared-witness path needs neither
+    per_chunk_bundles = verify_chunk is not None or checkpoint_dir is not None
 
     event_proofs: list = []
     witness_bytes: set[bytes] = set()
     fallback_blocks: list[ProofBlock] = []
     chunk_blocks: set[ProofBlock] = set()
 
-    def _scan(chunk):
+    def _scan_once(chunk):
         # _scan_and_match times itself (range_scan / range_match) — the
         # executor must not wrap it again (no metrics_stage here)
-        return chunk, _scan_and_match(
-            cached, chunk, spec, matcher, match_backend, metrics
-        )
+        return _scan_and_match(cached, chunk, spec, matcher, match_backend, metrics)
+
+    def _scan(item):
+        index, chunk = item
+        path = _ckpt_path(index, chunk)
+        if path is not None and os.path.exists(path):
+            return index, chunk, None  # resumed — record loads from disk
+        attempt = 0
+        while True:
+            try:
+                return index, chunk, _scan_once(chunk)
+            except RpcError:
+                raise  # semantic protocol errors: retrying re-asks the same question
+            except (ConnectionError, TimeoutError, OSError, RuntimeError) as exc:
+                attempt += 1
+                if attempt > max(0, scan_retries):
+                    raise
+                metrics.count("range_scan_retries")
+                from ipc_proofs_tpu.utils.log import get_logger
+
+                get_logger(__name__).warning(
+                    "scan of chunk %d failed (%s) — retry %d/%d",
+                    index, exc, attempt, scan_retries,
+                )
 
     def _record(scanned):
-        chunk, (matching_per_pair, native_ok) = scanned
+        index, chunk, scan_out = scanned
+        path = _ckpt_path(index, chunk)
+        if scan_out is None:
+            with metrics.stage("range_record"):
+                with open(path) as fh:
+                    bundle = UnifiedProofBundle.from_json(fh.read())
+                metrics.count("range_chunks_resumed")
+                event_proofs.extend(bundle.event_proofs)
+                chunk_blocks.update(bundle.blocks)
+            return bundle if verify_chunk is not None else None
+        matching_per_pair, native_ok = scan_out
         with metrics.stage("range_record"):
             proofs, chunk_witness, chunk_fallback = _record_chunk(
                 cached, chunk, matching_per_pair, matcher, spec, native_ok
             )
             event_proofs.extend(proofs)
-            if verify_chunk is None:
+            if not per_chunk_bundles:
                 witness_bytes.update(chunk_witness)
                 fallback_blocks.extend(chunk_fallback)
                 return None
-            # verify mode: materialize a self-contained chunk bundle so the
-            # verify stage can replay it while later chunks scan/record
+            # verify/checkpoint mode: materialize a self-contained chunk
+            # bundle so it can replay off-thread and/or persist to disk
             blocks = _materialize_witness(cached, chunk_witness, chunk_fallback)
             chunk_blocks.update(blocks)
-        return UnifiedProofBundle(
-            storage_proofs=[], event_proofs=proofs, blocks=blocks
-        )
+            bundle = UnifiedProofBundle(
+                storage_proofs=[], event_proofs=proofs, blocks=blocks
+            )
+            if path is not None:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    fh.write(bundle.to_json())
+                os.replace(tmp, path)  # atomic: partial writes never count
+                metrics.count("range_chunks_generated")
+        return bundle if verify_chunk is not None else None
 
     stages = [
         PipelineStage("scan", _scan, workers=scan_threads),
         PipelineStage("record", _record),
     ]
+    stage_fns = [_scan, _record]
     if verify_chunk is not None:
 
         def _verify(bundle):
@@ -699,9 +795,20 @@ def generate_event_proofs_for_range_pipelined(
                 return verify_chunk(bundle)
 
         stages.append(PipelineStage("verify", _verify))
+        stage_fns.append(_verify)
 
-    if chunks:
-        results = run_pipeline(chunks, stages, depth=max(1, pipeline_depth))
+    items = list(enumerate(chunks))
+    if items:
+        if serial_fallback:
+            metrics.count("range_pipeline_serial_fallback")
+            results = []
+            for item in items:
+                out = item
+                for fn in stage_fns:
+                    out = fn(out)
+                results.append(out)
+        else:
+            results = run_pipeline(items, stages, depth=max(1, pipeline_depth))
         if verify_chunk is not None and verify_results is not None:
             verify_results.extend(results)
     metrics.count("range_proofs", len(event_proofs))

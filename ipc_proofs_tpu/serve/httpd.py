@@ -12,7 +12,11 @@ to the in-process API and serving errors to status codes:
   a production deployment would resolve pairs from its chain store).
 - ``GET /metrics``  → `utils/metrics.py` snapshot (stage timers, queue
   depths, batch sizes, p50/p90/p99 latency, rejection counters) as JSON.
-- ``GET /healthz``  → ``{"status": "ok" | "draining"}``.
+- ``GET /healthz``  → ``{"status": "ok" | "degraded" | "draining"}``; with
+  an `EndpointPool` attached, ``"degraded"`` means some endpoint's circuit
+  breaker is open/half-open and the body carries per-endpoint breaker
+  state (still HTTP 200 — the service itself serves from what remains;
+  draining stays 503).
 
 `ThreadingHTTPServer` gives one thread per connection; those threads do no
 proof work — they block on ``PendingResult.result()`` while the service's
@@ -77,8 +81,10 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             self._send_json(200, self.service.metrics_snapshot())
         elif self.path == "/healthz":
-            status = "draining" if self.service.draining else "ok"
-            self._send_json(200 if status == "ok" else 503, {"status": status})
+            health = self.service.health()
+            # draining = stop routing here (503); degraded = still serving
+            # from healthy endpoints, breaker detail in the body (200)
+            self._send_json(503 if health["status"] == "draining" else 200, health)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
 

@@ -132,12 +132,16 @@ class ProofService:
         event_filter=None,
         config: Optional[ServiceConfig] = None,
         metrics: Optional[Metrics] = None,
+        endpoint_pool=None,
     ):
         self.config = config or ServiceConfig()
         self.metrics = metrics if metrics is not None else Metrics()
         self._trust = trust_policy or TrustPolicy.accept_all()
         self._event_filter = event_filter
         self._spec = spec
+        # optional store.failover.EndpointPool: when the backing store is
+        # RPC-fed, /healthz reports per-endpoint breaker state through it
+        self._endpoint_pool = endpoint_pool
         self.block_cache = BlockCache(
             max_bytes=self.config.cache_max_bytes, ttl_s=self.config.cache_ttl_s
         )
@@ -211,6 +215,20 @@ class ProofService:
     @property
     def draining(self) -> bool:
         return self._verify_batcher.closed
+
+    def health(self) -> dict:
+        """Liveness summary for `/healthz`.
+
+        ``"draining"`` once shutdown started (stop routing traffic here);
+        ``"degraded"`` when the endpoint pool has an open/half-open breaker
+        (still serving — from the remaining endpoints — but worth paging
+        on); ``"ok"`` otherwise. Includes per-endpoint breaker state when a
+        pool is attached."""
+        if self.draining:
+            return {"status": "draining"}
+        if self._endpoint_pool is not None:
+            return self._endpoint_pool.health()
+        return {"status": "ok"}
 
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()

@@ -14,7 +14,20 @@ from ipc_proofs_tpu.store.blockstore import (
     MemoryBlockstore,
     RecordingBlockstore,
 )
-from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+from ipc_proofs_tpu.store.failover import EndpointPool
+from ipc_proofs_tpu.store.faults import (
+    FaultPlan,
+    FaultyBlockstore,
+    FaultySession,
+    LocalLotusSession,
+)
+from ipc_proofs_tpu.store.rpc import (
+    IntegrityError,
+    LotusClient,
+    RpcBlockstore,
+    RpcError,
+    verify_block_bytes,
+)
 
 __all__ = [
     "Blockstore",
@@ -23,4 +36,12 @@ __all__ = [
     "CachedBlockstore",
     "LotusClient",
     "RpcBlockstore",
+    "RpcError",
+    "IntegrityError",
+    "verify_block_bytes",
+    "EndpointPool",
+    "FaultPlan",
+    "FaultySession",
+    "FaultyBlockstore",
+    "LocalLotusSession",
 ]

@@ -1,0 +1,390 @@
+"""Multi-endpoint Lotus failover: health scoring, circuit breakers, hedged
+block fetches, and content-addressed integrity verification.
+
+A single Lotus endpoint is a single point of failure *and* a single point
+of trust. `EndpointPool` wraps N `LotusClient`s and gives the proof
+pipeline three guarantees:
+
+- **Availability** — requests fail over across endpoints, ordered by a
+  health score (EWMA of recent success). A circuit breaker per endpoint
+  opens after ``breaker_threshold`` consecutive failures (stops hammering a
+  dead node), then admits a single half-open probe after
+  ``breaker_reset_s``; a successful probe closes the breaker.
+- **Tail latency** — optional hedged block fetches: if the primary fetch
+  has not answered within a p99-based hedge delay, a second fetch fires on
+  the next-healthiest endpoint and the first *valid* answer wins
+  (``rpc.hedge_wins`` counts races the hedge won).
+- **Integrity** — every block fetched through the pool is re-hashed
+  against the requested CID. A mismatch is a `IntegrityError`: the
+  endpoint answered confidently with wrong bytes, so it is demoted
+  immediately (breaker opens) and the fetch retries elsewhere. Corrupt
+  bytes can therefore never enter a witness bundle.
+
+Determinism: the pool takes an injectable ``clock`` so breaker timing is
+testable without sleeping; all fault-injection lives in `store.faults`.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from concurrent.futures import wait as futures_wait
+from typing import Any, Optional
+
+import time
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcError, verify_block_bytes
+from ipc_proofs_tpu.utils.metrics import Histogram
+
+__all__ = ["EndpointPool", "EndpointState", "IntegrityError"]
+
+# Breaker states
+_CLOSED = "closed"
+_OPEN = "open"
+_HALF_OPEN = "half_open"
+
+# EWMA smoothing for the per-endpoint health score (higher alpha = reacts
+# faster to the latest outcome).
+_SCORE_ALPHA = 0.2
+
+
+class EndpointState:
+    """Mutable per-endpoint health record (guarded by the pool's lock)."""
+
+    __slots__ = (
+        "client", "index", "score", "consecutive_failures", "breaker",
+        "opened_at", "probe_in_flight", "successes", "failures", "demotions",
+    )
+
+    def __init__(self, client: LotusClient, index: int):
+        self.client = client
+        self.index = index
+        self.score = 1.0  # EWMA success rate; 1.0 = perfectly healthy
+        self.consecutive_failures = 0
+        self.breaker = _CLOSED
+        self.opened_at = 0.0
+        self.probe_in_flight = False
+        self.successes = 0
+        self.failures = 0
+        self.demotions = 0  # integrity-mismatch demotions
+
+    @property
+    def endpoint(self) -> str:
+        return getattr(self.client, "endpoint", f"endpoint-{self.index}")
+
+    def snapshot(self) -> dict:
+        return {
+            "endpoint": self.endpoint,
+            "breaker": self.breaker,
+            "score": round(self.score, 4),
+            "consecutive_failures": self.consecutive_failures,
+            "successes": self.successes,
+            "failures": self.failures,
+            "integrity_demotions": self.demotions,
+        }
+
+
+class EndpointPool:
+    """N `LotusClient`s behind one client-shaped facade.
+
+    Duck-types the client surface the blockstore and proof drivers use
+    (``request``, ``chain_read_obj``, ``chain_get_parent_receipts``), so an
+    `EndpointPool` drops in anywhere a `LotusClient` goes. Exposes
+    ``verifies_integrity = True`` so `RpcBlockstore` skips its own
+    (redundant) hash check — verification must happen *here*, per
+    endpoint, so the pool knows which endpoint lied.
+    """
+
+    verifies_integrity = True
+
+    def __init__(
+        self,
+        clients: "list[LotusClient]",
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 30.0,
+        hedge_ms: Optional[float] = None,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        """``breaker_threshold`` consecutive failures open an endpoint's
+        breaker; after ``breaker_reset_s`` one half-open probe is admitted.
+        ``hedge_ms`` enables hedged block fetches with that floor delay in
+        milliseconds (the effective delay is the larger of the floor and
+        the observed p99 fetch latency); ``None`` disables hedging.
+        ``clock`` injects a monotonic time source for deterministic breaker
+        tests."""
+        if not clients:
+            raise ValueError("EndpointPool needs at least one client")
+        self._endpoints = [EndpointState(c, i) for i, c in enumerate(clients)]
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_reset_s = breaker_reset_s
+        self.hedge_ms = hedge_ms
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._latency = Histogram(maxlen=512)  # pool-wide block-fetch seconds
+        self._executor: Optional[ThreadPoolExecutor] = None
+        if metrics is None:
+            from ipc_proofs_tpu.utils.metrics import get_metrics
+
+            metrics = get_metrics()
+        self._metrics = metrics
+
+    # ------------------------------------------------------------------
+    # client facade
+
+    @property
+    def endpoint(self) -> str:
+        return ",".join(ep.endpoint for ep in self._endpoints)
+
+    @property
+    def endpoints(self) -> "list[str]":
+        return [ep.endpoint for ep in self._endpoints]
+
+    def request(self, method: str, params: Any, timeout_s: Optional[float] = None) -> Any:
+        """Issue one JSON-RPC request with failover.
+
+        Transport failures (and exhausted-retry `RuntimeError`s from the
+        underlying client) rotate to the next endpoint; a semantic
+        `RpcError` is the *node answering* — it propagates immediately,
+        because every replica would say the same thing."""
+        last: Optional[Exception] = None
+        for ep in self._candidates():
+            if not self._begin_attempt(ep):
+                continue
+            t0 = self._clock()
+            try:
+                result = ep.client.request(method, params, timeout_s=timeout_s)
+            except RpcError:
+                # the endpoint is up and talking protocol; its answer is
+                # authoritative even when it is an error
+                self._record_success(ep, self._clock() - t0, observe_latency=False)
+                raise
+            except Exception as exc:
+                self._record_failure(ep)
+                last = exc
+                continue
+            self._record_success(ep, self._clock() - t0, observe_latency=False)
+            return result
+        raise RuntimeError(
+            f"all {len(self._endpoints)} endpoints failed for {method}"
+        ) from last
+
+    def chain_get_parent_receipts(self, block_cid: CID) -> "Optional[list[dict]]":
+        return self.request("Filecoin.ChainGetParentReceipts", [{"/": str(block_cid)}])
+
+    def chain_read_obj(self, cid: CID) -> Optional[bytes]:
+        """Fetch one block with failover, integrity verification, and
+        (when enabled) hedging. Returns the verified bytes, ``None`` when
+        the chain has no such block, or raises: `IntegrityError` if every
+        endpoint returned corrupt bytes, `RuntimeError` if every endpoint
+        failed."""
+        candidates = self._candidates()
+        if self.hedge_ms is not None and len(candidates) >= 2:
+            return self._hedged_read(cid, candidates)
+        last: Optional[Exception] = None
+        for ep in candidates:
+            if not self._begin_attempt(ep):
+                continue
+            try:
+                return self._read_one(ep, cid)
+            except Exception as exc:
+                last = exc
+                continue
+        if isinstance(last, IntegrityError):
+            raise last  # every endpoint returned corrupt bytes — say so
+        raise RuntimeError(
+            f"all {len(self._endpoints)} endpoints failed reading {cid}"
+        ) from last
+
+    # ------------------------------------------------------------------
+    # health reporting
+
+    def health(self) -> dict:
+        """Status summary for `/healthz`: ``"ok"`` when every breaker is
+        closed, ``"degraded"`` when any endpoint is open/half-open."""
+        with self._lock:
+            eps = [ep.snapshot() for ep in self._endpoints]
+        degraded = any(e["breaker"] != _CLOSED for e in eps)
+        return {"status": "degraded" if degraded else "ok", "endpoints": eps}
+
+    @property
+    def degraded(self) -> bool:
+        return self.health()["status"] == "degraded"
+
+    # ------------------------------------------------------------------
+    # internals
+
+    def _candidates(self) -> "list[EndpointState]":
+        """Every endpoint, ordered by how much we trust it right now.
+
+        Open breakers past their reset window transition to half-open
+        (probe admission happens per-attempt in `_begin_attempt`). An open
+        breaker inside the window is ordered LAST rather than excluded:
+        callers walk the list front to back, so a tripped endpoint is only
+        tried after everything healthier has failed — the breaker still
+        sheds routine load off a failing endpoint, but a request that only
+        it could serve (the others just failed too) is never refused
+        outright. Excluding it entirely let one bad block on the sole
+        remaining endpoint fail a whole read while a recovered-but-tripped
+        replica sat idle."""
+        now = self._clock()
+        eligible: list[EndpointState] = []
+        tripped: list[EndpointState] = []
+        with self._lock:
+            for ep in self._endpoints:
+                if ep.breaker == _OPEN:
+                    if now - ep.opened_at >= self.breaker_reset_s:
+                        ep.breaker = _HALF_OPEN
+                        ep.probe_in_flight = False
+                    else:
+                        tripped.append(ep)
+                        continue
+                eligible.append(ep)
+            eligible.sort(key=lambda e: (-e.score, e.index))
+            tripped.sort(key=lambda e: (-e.score, e.index))
+        return eligible + tripped
+
+    def _begin_attempt(self, ep: EndpointState) -> bool:
+        """Admission check right before an actual attempt: a half-open
+        breaker admits exactly one in-flight probe (cleared by the
+        attempt's `_record_success`/`_record_failure`)."""
+        with self._lock:
+            if ep.breaker == _HALF_OPEN:
+                if ep.probe_in_flight:
+                    return False
+                ep.probe_in_flight = True
+            return True
+
+    def _record_success(self, ep: EndpointState, latency_s: float, observe_latency: bool = True) -> None:
+        with self._lock:
+            ep.successes += 1
+            ep.consecutive_failures = 0
+            ep.probe_in_flight = False
+            if ep.breaker != _CLOSED:
+                ep.breaker = _CLOSED
+            ep.score = (1.0 - _SCORE_ALPHA) * ep.score + _SCORE_ALPHA
+            if observe_latency:
+                self._latency.observe(latency_s)
+
+    def _record_failure(self, ep: EndpointState, demote: bool = False) -> None:
+        with self._lock:
+            ep.failures += 1
+            ep.consecutive_failures += 1
+            ep.probe_in_flight = False
+            ep.score = (1.0 - _SCORE_ALPHA) * ep.score
+            tripped = demote or ep.breaker == _HALF_OPEN or (
+                ep.consecutive_failures >= self.breaker_threshold
+            )
+            if tripped and ep.breaker != _OPEN:
+                ep.breaker = _OPEN
+                ep.opened_at = self._clock()
+                self._metrics.count("failover.breaker_open")
+            elif tripped:
+                ep.opened_at = self._clock()
+
+    def _read_one(self, ep: EndpointState, cid: CID) -> Optional[bytes]:
+        """Fetch + verify one block from one endpoint, recording outcome."""
+        t0 = self._clock()
+        try:
+            data = ep.client.chain_read_obj(cid)
+        except RpcError:
+            self._record_success(ep, self._clock() - t0, observe_latency=False)
+            raise
+        except Exception:
+            self._record_failure(ep)
+            raise
+        if data is not None and not verify_block_bytes(cid, data):
+            self._metrics.count("rpc.integrity_failures")
+            with self._lock:
+                ep.demotions += 1
+            self._record_failure(ep, demote=True)
+            raise IntegrityError(cid, ep.endpoint)
+        self._record_success(ep, self._clock() - t0)
+        return data
+
+    def _hedge_delay_s(self) -> float:
+        floor = (self.hedge_ms or 0.0) / 1000.0
+        with self._lock:
+            pcts = self._latency.percentiles((0.99,)) if self._latency.count >= 16 else {}
+        return max(floor, pcts.get("p99", 0.0))
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=max(8, 2 * len(self._endpoints)),
+                    thread_name_prefix="hedge",
+                )
+            return self._executor
+
+    def _hedged_read(self, cid: CID, candidates: "list[EndpointState]") -> Optional[bytes]:
+        """Primary fetch with a delayed hedge on the next endpoint; first
+        valid (verified) answer wins. Endpoints beyond the first two serve
+        as failover if both racers fail."""
+        primary: Optional[EndpointState] = None
+        rest: list[EndpointState] = []
+        for i, ep in enumerate(candidates):
+            if self._begin_attempt(ep):
+                primary, rest = ep, candidates[i + 1:]
+                break
+        if primary is None:
+            raise RuntimeError(f"no endpoint admits a read for {cid}")
+        pool = self._get_executor()
+        fut_primary = pool.submit(self._read_one, primary, cid)
+        try:
+            return fut_primary.result(timeout=self._hedge_delay_s())
+        except FutureTimeoutError:
+            pass  # primary is slow — fire the hedge
+        except Exception:
+            # primary failed fast: plain failover, not a hedge race
+            for ep in rest:
+                if not self._begin_attempt(ep):
+                    continue
+                try:
+                    return self._read_one(ep, cid)
+                except Exception:
+                    continue
+            raise
+        secondary: Optional[EndpointState] = None
+        fallback: list[EndpointState] = []
+        for i, ep in enumerate(rest):
+            if self._begin_attempt(ep):
+                secondary, fallback = ep, rest[i + 1:]
+                break
+        if secondary is None:
+            # nowhere to hedge to — just wait for the primary
+            return fut_primary.result()
+        self._metrics.count("rpc.hedges")
+        fut_hedge = pool.submit(self._read_one, secondary, cid)
+        pending = {fut_primary, fut_hedge}
+        last: Optional[Exception] = None
+        while pending:
+            done, pending = futures_wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                try:
+                    result = fut.result()
+                except Exception as exc:
+                    last = exc
+                    continue
+                if fut is fut_hedge:
+                    self._metrics.count("rpc.hedge_wins")
+                return result
+        # both racers failed — try any remaining endpoints before giving up
+        for ep in fallback:
+            if not self._begin_attempt(ep):
+                continue
+            try:
+                return self._read_one(ep, cid)
+            except Exception as exc:
+                last = exc
+        raise RuntimeError(
+            f"all {len(self._endpoints)} endpoints failed reading {cid} (hedged)"
+        ) from last
+
+    def close(self) -> None:
+        with self._lock:
+            executor, self._executor = self._executor, None
+        if executor is not None:
+            executor.shutdown(wait=False)

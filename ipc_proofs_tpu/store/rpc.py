@@ -16,17 +16,34 @@ Improvements over the reference:
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
+import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Iterable, Optional
 
-from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, IDENTITY, SHA2_256
+from ipc_proofs_tpu.core.hashes import blake2b_256
 
-__all__ = ["LotusClient", "RpcBlockstore", "RpcError"]
+__all__ = [
+    "LotusClient",
+    "RpcBlockstore",
+    "RpcError",
+    "IntegrityError",
+    "verify_block_bytes",
+    "DEFAULT_RETRYABLE_RPC_CODES",
+]
 
 DEFAULT_TIMEOUT_S = 250.0  # reference `src/client/lotus.rs:11`
+
+# JSON-RPC error codes worth retrying with backoff: Lotus nodes behind
+# gateways surface rate limiting as a protocol-level error rather than an
+# HTTP 429. Semantic errors (method not found, actor not found, bad params)
+# must stay fail-fast — retrying them just re-asks the same question.
+DEFAULT_RETRYABLE_RPC_CODES = frozenset({429, -429})
+_TRANSIENT_RPC_MARKERS = ("too many requests", "rate limit", "try again")
 
 
 class RpcError(RuntimeError):
@@ -36,6 +53,39 @@ class RpcError(RuntimeError):
         super().__init__(f"RPC error {code}: {message}")
         self.code = code
         self.message = message
+
+
+class IntegrityError(RuntimeError):
+    """Fetched block bytes do not hash to the requested CID.
+
+    This is a *trust* failure, not a transport failure: the endpoint
+    answered confidently with wrong bytes, so re-asking the same endpoint
+    is pointless (and dangerous). The failover pool treats it as an
+    immediate demotion of the offending endpoint and retries elsewhere.
+    """
+
+    def __init__(self, cid: CID, endpoint: str = "?", reason: str = "failed multihash verification"):
+        super().__init__(f"block bytes for {cid} {reason} (endpoint {endpoint})")
+        self.cid = cid
+        self.endpoint = endpoint
+
+
+def verify_block_bytes(cid: CID, data: bytes) -> bool:
+    """Recompute ``data``'s multihash against ``cid``'s digest.
+
+    Returns True when the digest matches (or the multihash function is one
+    we cannot compute — unknown codes are accepted rather than rejected,
+    since we cannot prove them wrong; every CID this codebase produces or
+    fetches uses blake2b-256 / sha2-256 / identity, all verifiable).
+    """
+    mh = cid.mh_code
+    if mh == BLAKE2B_256:
+        return blake2b_256(bytes(data)) == cid.digest
+    if mh == SHA2_256:
+        return hashlib.sha256(bytes(data)).digest() == cid.digest
+    if mh == IDENTITY:
+        return bytes(data) == bytes(cid.digest)
+    return True
 
 
 class LotusClient:
@@ -52,14 +102,28 @@ class LotusClient:
         backoff_max_s: float = 10.0,
         session=None,
         metrics=None,
+        rng: Optional[random.Random] = None,
+        retryable_rpc_codes: frozenset[int] = DEFAULT_RETRYABLE_RPC_CODES,
     ):
         """``timeout_s`` bounds general RPC calls (state queries can be
         legitimately slow — the reference's 250 s); ``block_timeout_s``
         bounds single-block fetches, which are small and must fail fast so a
-        stalled node can't wedge a pipeline scan worker for minutes. Retry
-        sleeps grow ``backoff_base_s * 2**attempt`` capped at
-        ``backoff_max_s``; every retry increments the ``rpc.retries``
-        counter on ``metrics`` (default: the process-global `Metrics`).
+        stalled node can't wedge a pipeline scan worker for minutes.
+
+        Retry sleeps use *full jitter*: ``uniform(0, min(backoff_max_s,
+        backoff_base_s * 2**attempt))``, so N scan workers retrying the same
+        flapped node spread out instead of thundering-herding it in
+        lockstep. ``rng`` injects the jitter source for deterministic tests
+        (default: a private `random.Random`). Every retry increments the
+        ``rpc.retries`` counter on ``metrics`` (default: the process-global
+        `Metrics`).
+
+        ``retryable_rpc_codes`` names JSON-RPC *protocol* error codes that
+        get the same backoff treatment as transport errors (rate limiting);
+        any other `RpcError` is semantic and fails fast. Messages matching
+        a rate-limit marker ("too many requests", …) are retried regardless
+        of code, since gateways are inconsistent about codes.
+
         ``session`` injects any object with ``.post`` (tests use a fake —
         no ``requests`` needed)."""
         self.endpoint = endpoint
@@ -68,6 +132,8 @@ class LotusClient:
         self.block_timeout_s = block_timeout_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
+        self.retryable_rpc_codes = retryable_rpc_codes
+        self._rng = rng if rng is not None else random.Random()
         self._headers = {"Content-Type": "application/json"}
         if bearer_token:
             self._headers["Authorization"] = f"Bearer {bearer_token}"
@@ -111,23 +177,35 @@ class LotusClient:
                     err = body["error"]
                     raise RpcError(err.get("code", -1), err.get("message", "unknown"))
                 return body.get("result")
-            except RpcError:
-                raise  # protocol-level errors are not retryable
+            except RpcError as exc:
+                if not self._rpc_error_retryable(exc):
+                    raise  # semantic protocol errors are not retryable
+                last_err = exc
+                if attempt + 1 < self.max_retries:
+                    self._backoff(method, attempt, exc)
             except Exception as exc:  # transport errors: retry with backoff
                 last_err = exc
                 if attempt + 1 < self.max_retries:
-                    from ipc_proofs_tpu.utils.log import get_logger
-
-                    get_logger(__name__).warning(
-                        "RPC %s attempt %d/%d failed (%s) — retrying",
-                        method, attempt + 1, self.max_retries, exc,
-                    )
-                    self._metrics.count("rpc.retries")
-                    time.sleep(
-                        min(self.backoff_max_s, self.backoff_base_s * 2.0**attempt)
-                    )
+                    self._backoff(method, attempt, exc)
         self._metrics.count("rpc.failures")
         raise RuntimeError(f"RPC {method} failed after {self.max_retries} attempts") from last_err
+
+    def _rpc_error_retryable(self, exc: RpcError) -> bool:
+        if exc.code in self.retryable_rpc_codes:
+            return True
+        message = (exc.message or "").lower()
+        return any(marker in message for marker in _TRANSIENT_RPC_MARKERS)
+
+    def _backoff(self, method: str, attempt: int, exc: Exception) -> None:
+        from ipc_proofs_tpu.utils.log import get_logger
+
+        get_logger(__name__).warning(
+            "RPC %s attempt %d/%d failed (%s) — retrying",
+            method, attempt + 1, self.max_retries, exc,
+        )
+        self._metrics.count("rpc.retries")
+        bound = min(self.backoff_max_s, self.backoff_base_s * 2.0**attempt)
+        time.sleep(self._rng.uniform(0.0, bound))
 
     def chain_read_obj(self, cid: CID) -> Optional[bytes]:
         """Fetch one raw IPLD block (`Filecoin.ChainReadObj`) under the
@@ -137,7 +215,12 @@ class LotusClient:
         )
         if result is None:
             return None
-        return base64.b64decode(result)
+        try:
+            return base64.b64decode(result)
+        except (ValueError, TypeError) as exc:
+            # a payload that does not even decode is corrupt data from the
+            # node — same trust failure as a multihash mismatch
+            raise IntegrityError(cid, self.endpoint, reason=f"are undecodable ({exc})") from exc
 
     def chain_get_parent_receipts(self, block_cid: CID) -> Optional[list[dict]]:
         """Fetch a block's parent receipts as API JSON
@@ -151,17 +234,40 @@ class LotusClient:
 class RpcBlockstore:
     """Read-only blockstore over `Filecoin.ChainReadObj`.
 
+    Every `get()` verifies the returned bytes against the requested CID's
+    multihash — content addressing means the store never has to trust the
+    node; a lying or bit-rotted endpoint raises `IntegrityError` instead of
+    poisoning a witness. (When ``client`` is an `EndpointPool` the pool
+    verifies per-endpoint — so it can demote the liar and retry elsewhere —
+    and the store skips the redundant second hash.)
+
     `prefetch()` fans out block fetches over a thread pool into a target
     cache dict — the host-side feeder that replaces the reference's
-    one-blocking-HTTP-call-per-block pattern.
+    one-blocking-HTTP-call-per-block pattern. It fails SOFT: per-CID
+    failures are collected and returned instead of aborting the wave, since
+    the demand path re-fetches (and re-raises) on miss anyway.
     """
 
-    def __init__(self, client: LotusClient, prefetch_workers: int = 16):
+    def __init__(self, client: LotusClient, prefetch_workers: int = 16, metrics=None):
         self._client = client
         self._prefetch_workers = prefetch_workers
+        if metrics is None:
+            metrics = getattr(client, "_metrics", None)
+        if metrics is None:
+            from ipc_proofs_tpu.utils.metrics import get_metrics
+
+            metrics = get_metrics()
+        self._metrics = metrics
 
     def get(self, cid: CID) -> Optional[bytes]:
-        return self._client.chain_read_obj(cid)
+        data = self._client.chain_read_obj(cid)
+        if data is None:
+            return None
+        if not getattr(self._client, "verifies_integrity", False):
+            if not verify_block_bytes(cid, data):
+                self._metrics.count("rpc.integrity_failures")
+                raise IntegrityError(cid, getattr(self._client, "endpoint", "?"))
+        return data
 
     def put_keyed(self, cid: CID, data: bytes) -> None:
         raise NotImplementedError("RpcBlockstore is read-only")
@@ -169,18 +275,36 @@ class RpcBlockstore:
     def has(self, cid: CID) -> bool:
         return self.get(cid) is not None
 
-    def prefetch(self, cids: Iterable[CID], into: dict[CID, bytes]) -> None:
-        """Concurrently fetch ``cids`` into the shared cache dict ``into``."""
+    def prefetch(self, cids: Iterable[CID], into: dict[CID, bytes]) -> "dict[CID, Exception]":
+        """Concurrently fetch ``cids`` into the shared cache dict ``into``.
+
+        Returns a (possibly empty) map of CID → exception for fetches that
+        failed; the wave itself never aborts on one bad block."""
         todo = [c for c in cids if c not in into]
         if not todo:
-            return
+            return {}
         lock = threading.Lock()
+        failures: dict[CID, Exception] = {}
 
         def fetch(cid: CID) -> None:
-            data = self.get(cid)
+            try:
+                data = self.get(cid)
+            except Exception as exc:
+                with lock:
+                    failures[cid] = exc
+                return
             if data is not None:
                 with lock:
                     into[cid] = data
 
         with ThreadPoolExecutor(max_workers=self._prefetch_workers) as pool:
             list(pool.map(fetch, todo))
+        if failures:
+            from ipc_proofs_tpu.utils.log import get_logger
+
+            self._metrics.count("rpc.prefetch_failures", len(failures))
+            get_logger(__name__).warning(
+                "prefetch: %d/%d block fetches failed (demand path will re-fetch)",
+                len(failures), len(todo),
+            )
+        return failures

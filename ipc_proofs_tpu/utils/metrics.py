@@ -17,7 +17,38 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-__all__ = ["StageTimer", "Histogram", "Metrics", "get_metrics"]
+__all__ = [
+    "StageTimer",
+    "Histogram",
+    "Metrics",
+    "get_metrics",
+    "RESILIENCE_COUNTERS",
+]
+
+# Counter vocabulary of the fault-tolerance layer (store/failover.py,
+# store/rpc.py, proofs/range.py). Counters are created on first use; this
+# tuple is the documented contract so dashboards and the bench resilience
+# leg agree on names:
+#   rpc.retries             — transport/ratelimit retries inside LotusClient
+#   rpc.failures            — requests that exhausted their retry budget
+#   rpc.integrity_failures  — fetched block bytes failed CID verification
+#   rpc.prefetch_failures   — per-CID failures absorbed by fail-soft prefetch
+#   rpc.hedges              — hedged secondary fetches fired
+#   rpc.hedge_wins          — races where the hedge answered first
+#   failover.breaker_open   — circuit-breaker open transitions
+#   range_scan_retries      — transparent chunk re-scans after transient errors
+#   range_pipeline_serial_fallback — pipelined driver ran inline (1-core host)
+RESILIENCE_COUNTERS = (
+    "rpc.retries",
+    "rpc.failures",
+    "rpc.integrity_failures",
+    "rpc.prefetch_failures",
+    "rpc.hedges",
+    "rpc.hedge_wins",
+    "failover.breaker_open",
+    "range_scan_retries",
+    "range_pipeline_serial_fallback",
+)
 
 
 @dataclass

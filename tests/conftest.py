@@ -13,6 +13,12 @@ import os
 # relay (slow, serialized across processes). Overriding the env var is not
 # enough — the config must be updated after the sitecustomize registration.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# CI hosts are often single-core, where the pipelined drivers would
+# auto-fall-back to the inline serial path — force the real threaded
+# pipeline so its concurrency stays under test. Dedicated fallback tests
+# (tests/test_failover.py) clear this and pin the single-core behavior.
+os.environ.setdefault("IPC_FORCE_PIPELINE", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -134,6 +134,14 @@ _WITNESS_OK = {
     "witness_single_pass_bytes": 650_000, "witness_sample_pairs": 64,
 }
 
+_RESILIENCE_OK = {
+    "resilience_fault_free_proofs_per_sec": 750.0,
+    "integrity_overhead_pct": 1.2,
+    "proofs_per_sec_at_fault_rate": 430.0,
+    "resilience_fault_rate": 0.1,
+    "recovery_ms": 0.05,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -160,6 +168,7 @@ class TestOrchestrate:
             "native_baseline": [({"native_baseline_proofs_per_sec": 1000.0}, "ok:cpu")],
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
+            "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -167,8 +176,11 @@ class TestOrchestrate:
         assert out["watchdog_fallback"] is False
         assert out["legs"]["e2e"] == "ok:tpu"
         assert out["legs"]["serve"] == "ok:cpu"
+        assert out["legs"]["resilience"] == "ok:cpu"
         assert out["serve_speedup_vs_sequential"] == 2.5
         assert out["witness_reduction_pct"] == 96.0
+        assert out["integrity_overhead_pct"] == 1.2
+        assert out["proofs_per_sec_at_fault_rate"] == 430.0
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -180,6 +192,7 @@ class TestOrchestrate:
             "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
+            "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -190,7 +203,7 @@ class TestOrchestrate:
         assert requested == [
             ("e2e", "default"), ("e2e", "cpu"), ("kernel", "cpu"),
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
-            ("serve", "cpu"), ("witness", "cpu"),
+            ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -202,6 +215,7 @@ class TestOrchestrate:
             "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
+            "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -244,6 +258,7 @@ class TestOrchestrate:
             "native_baseline": [(None, "error:cpu")],
             "serve": [(None, "error:cpu")],
             "witness": [(None, "error:cpu")],
+            "resilience": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -252,7 +267,8 @@ class TestOrchestrate:
             "stages_overlap", "vs_baseline", "vs_native_baseline",
             "device_mask_kernel_events_per_sec", "witness_cid_kernel_per_sec",
             "serve_speedup_vs_sequential", "serve_batched_rps",
-            "witness_reduction_pct",
+            "witness_reduction_pct", "integrity_overhead_pct",
+            "proofs_per_sec_at_fault_rate", "recovery_ms",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

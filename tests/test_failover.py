@@ -1,0 +1,370 @@
+"""EndpointPool semantics: failover routing, circuit-breaker lifecycle on
+an injected clock, content-addressed integrity demotion, last-resort
+routing of tripped endpoints, hedged reads, prefetch fail-soft, the
+pipelined driver's single-core serial fallback and checkpoint/resume, and
+degraded health reporting — all hermetic (LocalLotusSession, no network).
+"""
+
+import base64
+import json
+import os
+import time
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore, put_cbor
+from ipc_proofs_tpu.store.failover import EndpointPool
+from ipc_proofs_tpu.store.faults import LocalLotusSession
+from ipc_proofs_tpu.store.rpc import (
+    IntegrityError,
+    LotusClient,
+    RpcBlockstore,
+    RpcError,
+)
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+class _Resp:
+    def __init__(self, body):
+        self._body = body
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self._body
+
+
+class _Switchable:
+    """A LocalLotusSession whose failure mode can be flipped mid-test:
+    ``ok`` (serve honestly), ``dead`` (transport error), ``corrupt``
+    (bit-flip every block), ``slow`` (sleep then serve)."""
+
+    def __init__(self, store, mode="ok", slow_s=0.2):
+        self._inner = LocalLotusSession(store)
+        self.mode = mode
+        self.slow_s = slow_s
+        self.calls = 0
+
+    def post(self, url, data=None, headers=None, timeout=None):
+        self.calls += 1
+        if self.mode == "dead":
+            raise ConnectionError("endpoint down")
+        if self.mode == "slow":
+            time.sleep(self.slow_s)
+        resp = self._inner.post(url, data=data, headers=headers, timeout=timeout)
+        if self.mode != "corrupt":
+            return resp
+        body = dict(resp.json())
+        result = body.get("result")
+        if isinstance(result, str):
+            raw = bytearray(base64.b64decode(result))
+            raw[0] ^= 1
+            body["result"] = base64.b64encode(bytes(raw)).decode("ascii")
+        return _Resp(body)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _world():
+    store = MemoryBlockstore()
+    cid = put_cbor(store, {"k": b"value", "n": 7})
+    return store, cid, store.get(cid)
+
+
+def _client(session, **kw):
+    kw.setdefault("max_retries", 1)  # failover is the pool's job in these tests
+    return LotusClient("http://ep", session=session, **kw)
+
+
+def _pool(sessions, **kw):
+    kw.setdefault("breaker_threshold", 2)
+    kw.setdefault("breaker_reset_s", 30.0)
+    clock = kw.pop("clock", None) or _Clock()
+    pool = EndpointPool(
+        [_client(s) for s in sessions], clock=clock, **kw
+    )
+    return pool, clock
+
+
+class TestFailoverRouting:
+    def test_read_fails_over_to_healthy_endpoint(self):
+        store, cid, raw = _world()
+        dead, healthy = _Switchable(store, "dead"), _Switchable(store)
+        m = Metrics()
+        pool, _ = _pool([dead, healthy], metrics=m)
+        assert pool.chain_read_obj(cid) == raw
+        snaps = pool.health()["endpoints"]
+        assert snaps[0]["failures"] == 1 and snaps[1]["successes"] == 1
+
+    def test_request_exhaustion_raises_runtime_error(self):
+        store, _, _ = _world()
+        pool, _ = _pool([_Switchable(store, "dead"), _Switchable(store, "dead")])
+        with pytest.raises(RuntimeError, match="all 2 endpoints failed"):
+            pool.request("Filecoin.ChainHead", [])
+
+    def test_rpc_error_is_authoritative_no_failover(self):
+        # a node answering with a protocol error IS an answer — the pool
+        # must not re-ask a replica (it would say the same thing)
+        store, _, _ = _world()
+        a, b = _Switchable(store), _Switchable(store)
+        pool, _ = _pool([a, b])
+        with pytest.raises(RpcError, match="-32601"):
+            pool.request("Filecoin.NoSuchMethod", [])
+        assert a.calls == 1 and b.calls == 0
+        # and it counts as endpoint health, not failure
+        assert pool.health()["endpoints"][0]["consecutive_failures"] == 0
+
+
+class TestBreakerLifecycle:
+    def test_threshold_opens_then_half_open_probe_closes(self):
+        store, cid, raw = _world()
+        flaky = _Switchable(store, "dead")
+        m = Metrics()
+        pool, clock = _pool([flaky], metrics=m, breaker_threshold=2)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                pool.chain_read_obj(cid)
+        assert pool.health()["status"] == "degraded"
+        assert pool.health()["endpoints"][0]["breaker"] == "open"
+        assert m.snapshot()["counters"]["failover.breaker_open"] == 1
+
+        clock.advance(31.0)  # past breaker_reset_s
+        flaky.mode = "ok"  # endpoint recovered
+        assert pool.chain_read_obj(cid) == raw  # the half-open probe
+        assert pool.health()["endpoints"][0]["breaker"] == "closed"
+        assert pool.health()["status"] == "ok"
+
+    def test_half_open_failure_reopens(self):
+        store, cid, _ = _world()
+        flaky = _Switchable(store, "dead")
+        pool, clock = _pool([flaky], breaker_threshold=1)
+        with pytest.raises(RuntimeError):
+            pool.chain_read_obj(cid)
+        clock.advance(31.0)
+        with pytest.raises(RuntimeError):  # probe fails → open again
+            pool.chain_read_obj(cid)
+        assert pool.health()["endpoints"][0]["breaker"] == "open"
+
+    def test_open_endpoint_sheds_load_but_is_last_resort(self):
+        store, cid, raw = _world()
+        dead, healthy = _Switchable(store, "dead"), _Switchable(store)
+        pool, _ = _pool([dead, healthy], breaker_threshold=1)
+        assert pool.chain_read_obj(cid) == raw  # dead tried first, fails over
+        dead_calls = dead.calls
+        assert dead_calls >= 1
+        # while the breaker is open-in-window, routine reads skip the
+        # tripped endpoint entirely...
+        for _ in range(3):
+            assert pool.chain_read_obj(cid) == raw
+        assert dead.calls == dead_calls
+        # ...but when every healthier endpoint fails, the tripped one is
+        # still tried rather than the read being refused outright
+        healthy.mode = "dead"
+        dead.mode = "ok"
+        assert pool.chain_read_obj(cid) == raw
+        assert dead.calls == dead_calls + 1
+
+
+class TestIntegrity:
+    def test_corrupt_endpoint_demoted_and_read_recovers(self):
+        store, cid, raw = _world()
+        corrupt, healthy = _Switchable(store, "corrupt"), _Switchable(store)
+        m = Metrics()
+        pool, _ = _pool([corrupt, healthy], metrics=m)
+        assert pool.chain_read_obj(cid) == raw  # served by the honest one
+        snaps = pool.health()["endpoints"]
+        assert snaps[0]["integrity_demotions"] == 1
+        assert snaps[0]["breaker"] == "open"  # one lie trips immediately
+        assert m.snapshot()["counters"]["rpc.integrity_failures"] == 1
+
+    def test_all_corrupt_raises_integrity_error(self):
+        store, cid, _ = _world()
+        pool, _ = _pool([_Switchable(store, "corrupt"), _Switchable(store, "corrupt")])
+        with pytest.raises(IntegrityError, match="multihash"):
+            pool.chain_read_obj(cid)
+
+    def test_rpc_blockstore_verifies_single_client(self):
+        # without a pool the blockstore itself recomputes the multihash
+        store, cid, _ = _world()
+        m = Metrics()
+        client = _client(_Switchable(store, "corrupt"))
+        bs = RpcBlockstore(client, metrics=m)
+        with pytest.raises(IntegrityError):
+            bs.get(cid)
+        assert m.snapshot()["counters"]["rpc.integrity_failures"] == 1
+
+    def test_rpc_blockstore_trusts_verifying_pool(self):
+        store, cid, raw = _world()
+        pool, _ = _pool([_Switchable(store)])
+        assert pool.verifies_integrity is True
+        assert RpcBlockstore(pool).get(cid) == raw
+
+
+class TestHedgedReads:
+    def test_hedge_fires_and_wins_on_slow_primary(self):
+        store, cid, raw = _world()
+        slow, fast = _Switchable(store, "slow", slow_s=0.5), _Switchable(store)
+        m = Metrics()
+        # real clock here: the hedge delay is wall time inside futures
+        pool = EndpointPool(
+            [_client(slow), _client(fast)], hedge_ms=1.0, metrics=m,
+        )
+        try:
+            t0 = time.perf_counter()
+            assert pool.chain_read_obj(cid) == raw
+            assert time.perf_counter() - t0 < 0.45  # did not wait out the primary
+            counters = m.snapshot()["counters"]
+            assert counters["rpc.hedges"] == 1
+            assert counters["rpc.hedge_wins"] == 1
+        finally:
+            pool.close()
+
+    def test_no_hedge_when_primary_is_fast(self):
+        store, cid, raw = _world()
+        m = Metrics()
+        pool = EndpointPool(
+            [_client(_Switchable(store)), _client(_Switchable(store))],
+            hedge_ms=200.0, metrics=m,
+        )
+        try:
+            assert pool.chain_read_obj(cid) == raw
+            assert "rpc.hedges" not in m.snapshot()["counters"]
+        finally:
+            pool.close()
+
+
+class TestPrefetchFailSoft:
+    def test_prefetch_absorbs_failures_and_reports_them(self):
+        store, cid, _ = _world()
+        missing = CID.hash_of(b"no such block")
+        m = Metrics()
+        bs = RpcBlockstore(_client(_Switchable(store, "dead")), metrics=m)
+        cache: dict = {}
+        failures = bs.prefetch([cid, missing], cache)  # must NOT raise
+        assert set(failures) == {cid, missing}
+        assert cache == {}
+        assert m.snapshot()["counters"]["rpc.prefetch_failures"] == 2
+
+    def test_prefetch_clean_run_reports_nothing(self):
+        store, cid, raw = _world()
+        bs = RpcBlockstore(_client(_Switchable(store)))
+        cache: dict = {}
+        assert bs.prefetch([cid], cache) == {}
+        assert cache[cid] == raw
+
+
+def _range_world():
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+
+    sig, t1, actor = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1", 1001
+    bs, pairs, _ = build_range_world(
+        4, 2, 1, 0.5, signature=sig, topic1=t1, actor_id=actor
+    )
+    spec = EventProofSpec(event_signature=sig, topic_1=t1, actor_id_filter=actor)
+    return bs, pairs, spec
+
+
+class TestSerialFallback:
+    def test_single_core_host_runs_inline_bit_identically(self, monkeypatch):
+        from ipc_proofs_tpu.proofs.range import (
+            generate_event_proofs_for_range,
+            generate_event_proofs_for_range_pipelined,
+        )
+
+        bs, pairs, spec = _range_world()
+        reference = generate_event_proofs_for_range(bs, pairs, spec).to_json()
+        monkeypatch.delenv("IPC_FORCE_PIPELINE", raising=False)
+        monkeypatch.setattr(os, "cpu_count", lambda: 1)
+        m = Metrics()
+        bundle = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2, metrics=m
+        )
+        assert bundle.to_json() == reference
+        assert m.snapshot()["counters"]["range_pipeline_serial_fallback"] >= 1
+
+    def test_force_pipeline_overrides_single_core(self, monkeypatch):
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+        bs, pairs, spec = _range_world()
+        monkeypatch.setattr(os, "cpu_count", lambda: 1)
+        m = Metrics()
+        generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2, metrics=m, force_pipeline=True
+        )
+        assert "range_pipeline_serial_fallback" not in m.snapshot()["counters"]
+
+
+class TestPipelinedCheckpoints:
+    def test_checkpoint_then_resume_from_empty_store(self, tmp_path):
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+        bs, pairs, spec = _range_world()
+        ckpt = str(tmp_path / "ckpts")
+        m1 = Metrics()
+        first = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2, metrics=m1, checkpoint_dir=ckpt,
+            force_pipeline=True,
+        )
+        assert m1.snapshot()["counters"]["range_chunks_generated"] == 2
+        assert len(os.listdir(ckpt)) == 2
+
+        # a resume must not need the chain at all: hand it an EMPTY store
+        m2 = Metrics()
+        resumed = generate_event_proofs_for_range_pipelined(
+            MemoryBlockstore(), pairs, spec, chunk_size=2, metrics=m2,
+            checkpoint_dir=ckpt, force_pipeline=True,
+        )
+        assert resumed.to_json() == first.to_json()
+        assert m2.snapshot()["counters"]["range_chunks_resumed"] == 2
+
+    def test_checkpoints_are_spec_keyed(self, tmp_path):
+        from ipc_proofs_tpu.proofs.generator import EventProofSpec
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+        bs, pairs, spec = _range_world()
+        ckpt = str(tmp_path / "ckpts")
+        generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2, checkpoint_dir=ckpt, force_pipeline=True
+        )
+        # a different spec must not resume another spec's chunks
+        other = EventProofSpec(
+            event_signature=spec.event_signature, topic_1="other-subnet",
+            actor_id_filter=spec.actor_id_filter,
+        )
+        m = Metrics()
+        generate_event_proofs_for_range_pipelined(
+            bs, pairs, other, chunk_size=2, metrics=m, checkpoint_dir=ckpt,
+            force_pipeline=True,
+        )
+        assert "range_chunks_resumed" not in m.snapshot()["counters"]
+        assert len(os.listdir(ckpt)) == 4  # both specs checkpointed side by side
+
+
+class TestServiceHealth:
+    def test_health_reports_pool_degradation(self):
+        from ipc_proofs_tpu.serve.service import ProofService
+
+        store, cid, _ = _world()
+        dead = _Switchable(store, "dead")
+        pool, _ = _pool([dead, _Switchable(store)], breaker_threshold=1)
+        service = ProofService(store=MemoryBlockstore(), endpoint_pool=pool)
+        try:
+            assert service.health()["status"] == "ok"
+            pool.chain_read_obj(cid)  # trips the dead endpoint's breaker
+            health = service.health()
+            assert health["status"] == "degraded"
+            assert health["endpoints"][0]["breaker"] == "open"
+        finally:
+            service.drain()

@@ -148,10 +148,42 @@ class TestWorkerFailure:
         def run():
             with pytest.raises(RuntimeError, match="scan worker died"):
                 generate_event_proofs_for_range_pipelined(
-                    bs, pairs, spec, chunk_size=1, scan_threads=4, pipeline_depth=2
+                    bs, pairs, spec, chunk_size=1, scan_threads=4,
+                    pipeline_depth=2, scan_retries=0,
                 )
 
         self._drive_with_deadline(run)
+
+    def test_transient_scan_failure_is_retried(self, monkeypatch):
+        # with the default retry budget a one-off scan fault self-heals and
+        # the bundle is byte-identical to the clean run (persistent faults
+        # still propagate — pinned above with scan_retries=0)
+        import ipc_proofs_tpu.proofs.range as range_mod
+
+        bs, pairs, _ = _make_range(6)
+        spec = EventProofSpec(**SPEC)
+        reference = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=1, scan_threads=4, pipeline_depth=2
+        )
+        real = range_mod._scan_and_match
+        calls = []
+
+        def flaky(cached, chunk, *a, **kw):
+            calls.append(chunk)
+            if len(calls) == 3:
+                raise RuntimeError("scan worker died once")
+            return real(cached, chunk, *a, **kw)
+
+        monkeypatch.setattr(range_mod, "_scan_and_match", flaky)
+
+        def run():
+            return generate_event_proofs_for_range_pipelined(
+                bs, pairs, spec, chunk_size=1, scan_threads=4, pipeline_depth=2
+            )
+
+        bundle = self._drive_with_deadline(run)
+        assert bundle.to_json() == reference.to_json()
+        assert len(calls) > len(pairs)  # the failed chunk really re-scanned
 
     def test_record_worker_exception_propagates(self, monkeypatch):
         import ipc_proofs_tpu.proofs.range as range_mod

@@ -1,15 +1,25 @@
-"""LotusClient retry/timeout behavior: bounded exponential backoff on
-transport errors, fail-fast block-fetch deadline, retry counters, and no
-retry on protocol-level RpcError — all via an injected fake session (no
-`requests` dependency)."""
+"""LotusClient retry/timeout behavior: bounded full-jitter exponential
+backoff on transport errors, fail-fast block-fetch deadline, retry
+counters, retry of transient JSON-RPC codes (rate limits), and no retry on
+semantic RpcError — all via an injected fake session (no `requests`
+dependency) and an injected rng (deterministic backoff)."""
 
 import base64
+import random
 
 import pytest
 
 from ipc_proofs_tpu.store import rpc as rpc_mod
 from ipc_proofs_tpu.store.rpc import LotusClient, RpcError
 from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+class _MaxJitterRng:
+    """Stands in for the client's backoff rng: always draws the upper
+    bound, so tests can assert the exact exponential envelope."""
+
+    def uniform(self, lo, hi):
+        return hi
 
 
 class _Response:
@@ -46,6 +56,7 @@ class _FlakySession:
 
 def _client(session, metrics, **kw):
     kw.setdefault("max_retries", 4)
+    kw.setdefault("rng", _MaxJitterRng())
     return LotusClient("http://fake", session=session, metrics=metrics, **kw)
 
 
@@ -59,7 +70,7 @@ class TestRetries:
         assert client.request("Filecoin.Thing", []) == "ok"
         assert session.posts == 3
         assert m.snapshot()["counters"]["rpc.retries"] == 2
-        # exponential: base * 2**attempt
+        # exponential envelope: base * 2**attempt (rng pinned to the bound)
         assert sleeps == [0.25, 0.5]
 
     def test_backoff_is_bounded(self, monkeypatch):
@@ -72,6 +83,23 @@ class TestRetries:
         )
         assert client.request("Filecoin.Thing", []) == "ok"
         assert sleeps == [1.0, 2.0, 3.0, 3.0, 3.0]  # capped at backoff_max_s
+
+    def test_backoff_is_full_jitter(self, monkeypatch):
+        # with a real rng every sleep is uniform in [0, envelope]: never
+        # above the exponential bound, and (over 5 draws with a seeded rng)
+        # not all AT the bound — the thundering-herd fix is actually live
+        sleeps: list[float] = []
+        monkeypatch.setattr(rpc_mod.time, "sleep", sleeps.append)
+        session = _FlakySession(fail_times=5, result="ok")
+        client = _client(
+            session, Metrics(), max_retries=6,
+            backoff_base_s=1.0, backoff_max_s=3.0, rng=random.Random(7),
+        )
+        assert client.request("Filecoin.Thing", []) == "ok"
+        envelopes = [1.0, 2.0, 3.0, 3.0, 3.0]
+        assert len(sleeps) == len(envelopes)
+        assert all(0.0 <= s <= e for s, e in zip(sleeps, envelopes))
+        assert sleeps != envelopes
 
     def test_exhaustion_raises_and_counts_failure(self, monkeypatch):
         monkeypatch.setattr(rpc_mod.time, "sleep", lambda s: None)
@@ -97,6 +125,79 @@ class TestRetries:
             client.request("Filecoin.Nope", [])
         assert session.posts == 1
         assert "rpc.retries" not in m.snapshot()["counters"]
+
+
+class _RateLimitedSession:
+    """Returns a JSON-RPC error for the first ``error_times`` posts, then a
+    result — a node shedding load, not a node that can't answer."""
+
+    def __init__(self, error, error_times=2, result="ok"):
+        self.error = error
+        self.error_times = error_times
+        self.result = result
+        self.posts = 0
+
+    def post(self, endpoint, data=None, headers=None, timeout=None):
+        self.posts += 1
+        if self.posts <= self.error_times:
+            return _Response(error=self.error)
+        return _Response(result=self.result)
+
+
+class TestRetryableRpcCodes:
+    """Transient protocol errors (rate limits) retry like transport faults;
+    everything else at the protocol level stays fail-fast."""
+
+    def test_rate_limit_code_is_retried(self, monkeypatch):
+        monkeypatch.setattr(rpc_mod.time, "sleep", lambda s: None)
+        m = Metrics()
+        session = _RateLimitedSession({"code": 429, "message": "slow down"})
+        client = _client(session, m)
+        assert client.request("Filecoin.Thing", []) == "ok"
+        assert session.posts == 3
+        assert m.snapshot()["counters"]["rpc.retries"] == 2
+
+    def test_rate_limit_message_marker_is_retried(self, monkeypatch):
+        # some gateways send rate-limit text under a generic code
+        monkeypatch.setattr(rpc_mod.time, "sleep", lambda s: None)
+        session = _RateLimitedSession(
+            {"code": 1, "message": "Too Many Requests, try later"}
+        )
+        client = _client(session, Metrics())
+        assert client.request("Filecoin.Thing", []) == "ok"
+        assert session.posts == 3
+
+    def test_rate_limit_exhaustion_raises_runtime_error(self, monkeypatch):
+        monkeypatch.setattr(rpc_mod.time, "sleep", lambda s: None)
+        m = Metrics()
+        session = _RateLimitedSession(
+            {"code": 429, "message": "slow down"}, error_times=99
+        )
+        client = _client(session, m, max_retries=3)
+        with pytest.raises(RuntimeError, match="failed after 3 attempts"):
+            client.request("Filecoin.Thing", [])
+        assert session.posts == 3
+        assert m.snapshot()["counters"]["rpc.failures"] == 1
+
+    def test_semantic_code_still_fails_fast(self, monkeypatch):
+        monkeypatch.setattr(
+            rpc_mod.time, "sleep",
+            lambda s: pytest.fail("must not sleep on semantic errors"),
+        )
+        session = _RateLimitedSession({"code": 1, "message": "actor not found"})
+        client = _client(session, Metrics())
+        with pytest.raises(RpcError, match="actor not found"):
+            client.request("Filecoin.Thing", [])
+        assert session.posts == 1
+
+    def test_custom_retryable_code_set(self, monkeypatch):
+        monkeypatch.setattr(rpc_mod.time, "sleep", lambda s: None)
+        session = _RateLimitedSession({"code": -777, "message": "custom transient"})
+        client = _client(
+            session, Metrics(), retryable_rpc_codes=frozenset({-777})
+        )
+        assert client.request("Filecoin.Thing", []) == "ok"
+        assert session.posts == 3
 
 
 class TestTimeouts:

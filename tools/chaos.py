@@ -1,0 +1,225 @@
+"""Chaos differential driver: the byte-identity-or-typed-error invariant.
+
+Runs the pipelined range driver through the REAL client stack —
+`LotusClient` (retries, jitter, retryable codes) → `EndpointPool`
+(failover, breakers, integrity verification) → `RpcBlockstore` — against
+hermetic in-process "Lotus nodes" (`store.faults.LocalLotusSession`)
+wrapped in seeded fault injectors (`FaultySession`). For every fault seed
+the run must either:
+
+- produce a bundle **byte-identical** to the fault-free reference, or
+- raise a **typed error** (`IntegrityError` / `RpcError` / `RuntimeError`
+  / transport errors).
+
+A bundle that differs from the reference ("divergent") or an exception
+outside the typed set ("untyped") is a real bug — most critically, a
+bit-flipped block that slipped past CID verification into a witness.
+
+Usage:
+    python tools/chaos.py SEED [--runs N] [--pairs P] [--fault-rate R ...]
+                               [--quick]
+
+Importable: `tools/soak.py` registers `phase_chaos`, and
+tests/test_chaos.py drives `chaos_run`/`run_grid` over a pinned seed grid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import (
+    generate_event_proofs_for_range,
+    generate_event_proofs_for_range_pipelined,
+)
+from ipc_proofs_tpu.store.failover import EndpointPool
+from ipc_proofs_tpu.store.faults import FaultPlan, FaultySession, LocalLotusSession
+from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcBlockstore, RpcError
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG, SUBNET, ACTOR = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1", 1001
+
+# The complete set of acceptable failure types under fault injection.
+# Anything else escaping the driver is an invariant violation.
+TYPED_ERRORS = (
+    IntegrityError,
+    RpcError,
+    RuntimeError,
+    ConnectionError,
+    TimeoutError,
+    OSError,
+)
+
+
+def build_world(n_pairs: int = 12, receipts_per_pair: int = 4,
+                events_per_receipt: int = 2, match_rate: float = 0.2):
+    """Hermetic range world + spec + fault-free reference bundle JSON."""
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair, events_per_receipt, match_rate,
+        signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+    reference = generate_event_proofs_for_range(store, pairs, spec).to_json()
+    return store, pairs, spec, reference
+
+
+def chaos_run(
+    store,
+    pairs,
+    spec,
+    reference: str,
+    seed: int,
+    fault_rate: float = 0.2,
+    n_endpoints: int = 2,
+    chunk_size: int = 4,
+    hedge_ms: "float | None" = None,
+    max_retries: int = 3,
+) -> dict:
+    """One seeded chaos run; returns {"outcome": ..., ...} where outcome is
+    "identical" | "typed_error" | "divergent" | "untyped_error" (the last
+    two are invariant violations)."""
+    metrics = Metrics()
+    plans = [
+        FaultPlan(seed * 101 + i, fault_rate=fault_rate) for i in range(n_endpoints)
+    ]
+    clients = [
+        LotusClient(
+            f"http://chaos-{i}",
+            session=FaultySession(LocalLotusSession(store), plans[i], sleep=lambda s: None),
+            metrics=metrics,
+            max_retries=max_retries,
+            backoff_base_s=0.0005,
+            backoff_max_s=0.002,
+            rng=random.Random(seed + i),
+        )
+        for i in range(n_endpoints)
+    ]
+    pool = EndpointPool(
+        clients,
+        breaker_threshold=3,
+        breaker_reset_s=0.01,
+        hedge_ms=hedge_ms,
+        metrics=metrics,
+    )
+    rpc_store = RpcBlockstore(pool, metrics=metrics)
+    try:
+        bundle = generate_event_proofs_for_range_pipelined(
+            rpc_store,
+            pairs,
+            spec,
+            chunk_size=chunk_size,
+            metrics=metrics,
+            scan_threads=1,  # deterministic fault-draw order
+            scan_retries=2,
+            force_pipeline=True,
+        )
+    except TYPED_ERRORS as exc:
+        return {
+            "outcome": "typed_error",
+            "error": type(exc).__name__,
+            "faults": [p.snapshot() for p in plans],
+            "counters": metrics.snapshot()["counters"],
+        }
+    except Exception as exc:  # noqa: BLE001 — the invariant check itself
+        return {
+            "outcome": "untyped_error",
+            "error": f"{type(exc).__name__}: {exc}",
+            "faults": [p.snapshot() for p in plans],
+        }
+    finally:
+        pool.close()
+    outcome = "identical" if bundle.to_json() == reference else "divergent"
+    return {
+        "outcome": outcome,
+        "faults": [p.snapshot() for p in plans],
+        "counters": metrics.snapshot()["counters"],
+    }
+
+
+def run_grid(
+    base_seed: int,
+    runs: int = 20,
+    fault_rates=(0.05, 0.3, 0.6),
+    n_pairs: int = 12,
+    log=lambda msg: None,
+) -> dict:
+    """Seed × fault-rate grid; returns a summary with per-outcome counts.
+
+    ``ok`` is True iff no run was divergent or untyped AND at least one
+    run in each regime occurred somewhere (identical + typed/absorbed),
+    so a vacuous all-crash or all-clean grid does not silently pass."""
+    store, pairs, spec, reference = build_world(n_pairs=n_pairs)
+    counts = {"identical": 0, "typed_error": 0, "divergent": 0, "untyped_error": 0}
+    violations = []
+    total_faults = 0
+    bitflips = 0
+    for rate in fault_rates:
+        for k in range(runs):
+            seed = base_seed + k
+            res = chaos_run(store, pairs, spec, reference, seed, fault_rate=rate)
+            counts[res["outcome"]] += 1
+            for f in res["faults"]:
+                total_faults += f["faults_injected"]
+                bitflips += f["by_kind"].get("bitflip", 0)
+            if res["outcome"] in ("divergent", "untyped_error"):
+                violations.append({"seed": seed, "fault_rate": rate, **res})
+            log(
+                f"chaos seed={seed} rate={rate}: {res['outcome']} "
+                f"({sum(f['faults_injected'] for f in res['faults'])} faults)"
+            )
+    ok = (
+        not violations
+        and counts["identical"] > 0  # faults absorbed at least once
+        and total_faults > 0  # the schedule actually injected something
+    )
+    return {
+        "ok": ok,
+        "runs": runs * len(fault_rates),
+        "counts": counts,
+        "total_faults_injected": total_faults,
+        "bitflips_injected": bitflips,
+        "violations": violations,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("seed", type=int, help="base seed for the fault grid")
+    ap.add_argument("--runs", type=int, default=20, help="seeds per fault rate")
+    ap.add_argument("--pairs", type=int, default=12)
+    ap.add_argument(
+        "--fault-rate", type=float, action="append", default=None,
+        help="fault rates to sweep (repeatable; default 0.05 0.3 0.6)",
+    )
+    ap.add_argument("--quick", action="store_true", help="small world, fewer runs")
+    args = ap.parse_args(argv)
+
+    runs = 5 if args.quick and args.runs == 20 else args.runs
+    n_pairs = 6 if args.quick else args.pairs
+    rates = tuple(args.fault_rate) if args.fault_rate else (0.05, 0.3, 0.6)
+
+    t0 = time.time()
+    summary = run_grid(
+        args.seed, runs=runs, fault_rates=rates, n_pairs=n_pairs,
+        log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+    )
+    print(json.dumps(summary, indent=2))
+    if not summary["ok"]:
+        print("CHAOS INVARIANT VIOLATED", file=sys.stderr)
+        return 1
+    print("CHAOS CLEAN")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

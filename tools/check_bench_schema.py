@@ -78,6 +78,11 @@ _KNOWN_TYPES = {
     "witness_two_pass_bytes": int,
     "witness_single_pass_bytes": int,
     "witness_sample_pairs": int,
+    "resilience_fault_free_proofs_per_sec": _NUM,
+    "integrity_overhead_pct": _NUM,
+    "proofs_per_sec_at_fault_rate": _NUM,
+    "resilience_fault_rate": _NUM,
+    "recovery_ms": _NUM,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -95,7 +100,10 @@ _CURRENT_REQUIRED = (
     "vs_baseline", "vs_native_baseline",
     "scalar_baseline_proofs_per_sec", "native_baseline_proofs_per_sec",
     "serve_batched_rps", "serve_speedup_vs_sequential",
-    "witness_reduction_pct", "legs", "watchdog_fallback",
+    "witness_reduction_pct",
+    "resilience_fault_free_proofs_per_sec", "integrity_overhead_pct",
+    "proofs_per_sec_at_fault_rate", "resilience_fault_rate", "recovery_ms",
+    "legs", "watchdog_fallback",
 )
 
 

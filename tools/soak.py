@@ -12,8 +12,9 @@ Usage:
     python tools/soak.py BASE_SEED [phase ...] [--quick]
 
 Phases (default: all): event storage shapes codec rleplus cert dagcbor
-header trees range json. Every phase derives its seeds from BASE_SEED, so
-a NOTES entry of (base seed, phase) reproduces a run exactly.
+header trees range json chaos. Every phase derives its seeds from
+BASE_SEED, so a NOTES entry of (base seed, phase) reproduces a run
+exactly.
 """
 
 from __future__ import annotations
@@ -347,6 +348,27 @@ def phase_json(rng, quick):
     log(f"bundle+cert JSON garbage: {n} fresh seeds each clean")
 
 
+def phase_chaos(rng, quick):
+    # fault-injection differential: under any seeded fault schedule the
+    # pipelined driver must emit a bundle byte-identical to the fault-free
+    # run or raise a typed error (tools/chaos.py holds the harness)
+    import chaos
+
+    summary = chaos.run_grid(
+        rng.randrange(1 << 30),
+        runs=5 if quick else 40,
+        n_pairs=6 if quick else 16,
+        log=log,
+    )
+    assert summary["ok"], summary
+    log(
+        f"chaos differential: {summary['runs']} runs clean "
+        f"({summary['counts']['identical']} identical, "
+        f"{summary['counts']['typed_error']} typed errors, "
+        f"{summary['total_faults_injected']} faults injected)"
+    )
+
+
 PHASES = {
     "event": phase_event,
     "storage": phase_storage,
@@ -359,6 +381,7 @@ PHASES = {
     "trees": phase_trees,
     "range": phase_range,
     "json": phase_json,
+    "chaos": phase_chaos,
 }
 
 
