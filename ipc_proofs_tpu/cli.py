@@ -181,6 +181,21 @@ def _cmd_range(args) -> int:
         # tens of thousands of RPC calls
         log.error("--storage-slot requires --contract")
         return 2
+    if args.resume:
+        # --resume is an assertion that a journaled job already exists; a
+        # typo'd --job-dir must fail loudly, not silently start from scratch
+        import os as _os
+
+        from ipc_proofs_tpu.jobs import JOBS_MANIFEST_NAME
+
+        if not args.job_dir:
+            log.error("--resume requires --job-dir")
+            return 2
+        if not _os.path.exists(_os.path.join(args.job_dir, JOBS_MANIFEST_NAME)):
+            log.error(
+                "--resume: no job manifest in %s (nothing to resume)", args.job_dir
+            )
+            return 2
 
     metrics = get_metrics()
     client = _make_rpc_client(args)
@@ -243,6 +258,7 @@ def _cmd_range(args) -> int:
             storage_specs=storage_specs,
             scan_workers=args.scan_workers,
             generate_fn=generate_fn,
+            job_dir=args.job_dir,
         )
     output = args.output or "range_bundle.json"
     with open(output, "w") as fh:
@@ -504,7 +520,19 @@ def _cmd_serve(args) -> int:
         ),
         endpoint_pool=endpoint_pool,
     )
-    httpd = ProofHTTPServer(service, host=args.host, port=args.port, pairs=pairs)
+    durable = None
+    if args.queue_dir:
+        from ipc_proofs_tpu.serve.durable import DurableAdmission
+
+        durable = DurableAdmission(service, args.queue_dir, pairs=pairs)
+        if durable.resumed_jobs:
+            log.info(
+                "durable queue: re-executed %d admitted-but-unfinished "
+                "request(s) from %s", durable.resumed_jobs, args.queue_dir,
+            )
+    httpd = ProofHTTPServer(
+        service, host=args.host, port=args.port, pairs=pairs, durable=durable
+    )
     log.info(
         "serving on %s (verify%s; max_batch=%d max_wait=%.1fms capacity=%d "
         "workers=%d)",
@@ -622,6 +650,18 @@ def main(argv=None) -> int:
         "0 disables the stage-overlapped engine",
     )
     rng.add_argument("--checkpoint-dir", default=None)
+    rng.add_argument(
+        "--job-dir", default=None, metavar="DIR",
+        help="write-ahead journal for crash-safe resume: every completed "
+        "chunk is fsync'd to DIR/journal.bin; re-running with the same "
+        "flags skips committed chunks (SIGKILL-safe — torn tail records "
+        "are discarded)",
+    )
+    rng.add_argument(
+        "--resume", action="store_true",
+        help="require an existing job manifest in --job-dir (fail instead "
+        "of silently starting a fresh job)",
+    )
     rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
     rng.add_argument("-o", "--output", default=None)
     rng.add_argument("--metrics", action="store_true")
@@ -730,6 +770,13 @@ def main(argv=None) -> int:
     srv.add_argument(
         "--pipeline-depth", type=int, default=2,
         help="chunks buffered between range-pipeline stages",
+    )
+    srv.add_argument(
+        "--queue-dir", default=None, metavar="DIR",
+        help="durable admission queue: requests are journaled (fsync) to "
+        "DIR/queue.bin before execution, idempotency_key dedupes client "
+        "retries, and admitted-but-unfinished requests re-execute on "
+        "restart (/healthz reports resumed_jobs / journal_bytes)",
     )
     srv.set_defaults(fn=_cmd_serve)
 

@@ -1,0 +1,35 @@
+"""Crash-safe resumable jobs: chunk-granular write-ahead journaling.
+
+A *job* is one long-running proof request (a range generation, a serve
+admission queue) whose progress survives process death. The journal is
+the durability primitive (`journal.py`: fsync'd, length-prefixed,
+CRC-checksummed append-only records with torn-tail recovery); `job.py`
+builds the range-job layer on top (manifest identity, completed-chunk
+replay, `resume_or_create`).
+"""
+
+from ipc_proofs_tpu.jobs.journal import (
+    JOURNAL_MAGIC,
+    JournalError,
+    JournalWriter,
+    read_journal,
+)
+from ipc_proofs_tpu.jobs.job import (
+    JOBS_JOURNAL_NAME,
+    JOBS_MANIFEST_NAME,
+    RangeJob,
+    job_manifest,
+    resume_or_create,
+)
+
+__all__ = [
+    "JOURNAL_MAGIC",
+    "JournalError",
+    "JournalWriter",
+    "read_journal",
+    "JOBS_JOURNAL_NAME",
+    "JOBS_MANIFEST_NAME",
+    "RangeJob",
+    "job_manifest",
+    "resume_or_create",
+]

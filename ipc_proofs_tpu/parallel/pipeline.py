@@ -184,12 +184,22 @@ class PipelineStage:
     an order-sensitive consumer (e.g. chunk-ordered claim emission) stays
     deterministic. ``metrics_stage``, if set, times every ``fn`` call under
     that `Metrics` stage name (the caller passes the `Metrics` to
-    `run_pipeline`)."""
+    `run_pipeline`).
+
+    ``drain_on_cancel``: when another stage's failure cancels the
+    pipeline, this stage's queued-but-unclaimed inputs still run
+    (inline, best-effort, exceptions swallowed) before the original
+    exception re-raises in the caller. For a stage whose ``fn`` has
+    durable side effects — e.g. the range driver's record stage
+    journaling completed chunks — this salvages work upstream stages
+    already paid for, so a resume after the abort doesn't redo it.
+    Results are discarded; only the side effects matter."""
 
     name: str
     fn: Callable[[Any], Any]
     workers: int = 1
     metrics_stage: Optional[str] = None
+    drain_on_cancel: bool = False
 
 
 class _Cancel:
@@ -359,5 +369,28 @@ def run_pipeline(
     for t in threads:
         t.join()
     if cancel.exc is not None:
+        _drain_cancelled(stages, queues)
         raise cancel.exc
     return results
+
+
+def _drain_cancelled(stages: "list[PipelineStage]", queues: "list[queue.Queue]") -> None:
+    """Post-cancellation salvage: run ``drain_on_cancel`` stages' queued
+    inputs inline (all workers have exited, so the queues are frozen).
+    Best-effort — a drain failure must not mask the original exception."""
+    for i, stage in enumerate(stages):
+        if not stage.drain_on_cancel:
+            continue
+        q = queues[i]
+        while True:
+            try:
+                task = q.get_nowait()
+            except queue.Empty:
+                break
+            if task is _STOP:
+                continue
+            _seq, item = task
+            try:
+                stage.fn(item)
+            except BaseException:  # noqa: BLE001 — salvage is best-effort
+                pass

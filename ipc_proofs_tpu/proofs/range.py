@@ -66,6 +66,7 @@ def generate_and_verify_range_overlapped(
     checkpoint_dir: "str | None" = None,
     scan_retries: int = 2,
     force_pipeline: "bool | None" = None,
+    job_dir: "str | None" = None,
 ) -> "tuple[UnifiedProofBundle, list]":
     """Overlap VERIFICATION with generation across chunks: chunk k's bundle
     verifies while chunk k+1 generates — the generation-verification
@@ -105,6 +106,7 @@ def generate_and_verify_range_overlapped(
             checkpoint_dir=checkpoint_dir,
             scan_retries=scan_retries,
             force_pipeline=force_pipeline,
+            job_dir=job_dir,
         )
         return merged, verify_results
 
@@ -119,6 +121,7 @@ def generate_and_verify_range_overlapped(
             spec,
             chunk_size=chunk_size,
             checkpoint_dir=checkpoint_dir,
+            job_dir=job_dir,
             match_backend=match_backend,
             metrics=metrics,
             storage_specs=storage_specs,
@@ -184,6 +187,7 @@ def generate_event_proofs_for_range_chunked(
     scan_workers: int = 0,
     generate_fn=None,
     on_chunk=None,
+    job_dir: "str | None" = None,
 ) -> UnifiedProofBundle:
     """Chunked, resumable range generation.
 
@@ -200,6 +204,13 @@ def generate_event_proofs_for_range_chunked(
     pipelined driver for intra-generation overlap). ``on_chunk(bundle)``
     is called with every chunk bundle as it becomes available (generated
     OR resumed) — the hook the gen/verify-overlapped driver builds on.
+
+    ``job_dir`` adds write-ahead journaling on top of (or instead of)
+    checkpoint files: each completed chunk commits one fsync'd journal
+    record (`ipc_proofs_tpu.jobs`), and a re-run with the same job dir
+    resumes from the last committed chunk even after SIGKILL mid-write
+    (torn tails are discarded). Checkpoint hits are re-committed into
+    the journal so either artifact alone can resume the run.
     """
     import os
 
@@ -208,55 +219,79 @@ def generate_event_proofs_for_range_chunked(
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     spec_repr = _request_spec_repr(spec, chunk_size, storage_specs)
+    job = None
+    if job_dir is not None:
+        from ipc_proofs_tpu.jobs import job_manifest, resume_or_create
+
+        job = resume_or_create(
+            job_dir, job_manifest(spec_repr, pairs, chunk_size), metrics=metrics
+        )
 
     storage_proofs = []
     event_proofs = []
     all_blocks: set[ProofBlock] = set()
-    for chunk_index, start in enumerate(range(0, len(pairs), chunk_size)):
-        chunk = pairs[start : start + chunk_size]
-        path = (
-            os.path.join(
-                checkpoint_dir,
-                f"chunk_{_chunk_checkpoint_digest(spec_repr, chunk)}_{chunk_index:04d}.json",
+    try:
+        for chunk_index, start in enumerate(range(0, len(pairs), chunk_size)):
+            chunk = pairs[start : start + chunk_size]
+            digest = (
+                _chunk_checkpoint_digest(spec_repr, chunk)
+                if (checkpoint_dir is not None or job is not None)
+                else None
             )
-            if checkpoint_dir is not None
-            else None
-        )
-        if path is not None and os.path.exists(path):
-            with open(path) as fh:
-                bundle = UnifiedProofBundle.from_json(fh.read())
-            metrics.count("range_chunks_resumed")
-        else:
-            if generate_fn is not None:
-                bundle = generate_fn(
-                    store,
-                    chunk,
-                    spec,
-                    match_backend=match_backend,
-                    metrics=metrics,
-                    storage_specs=storage_specs,
+            path = (
+                os.path.join(
+                    checkpoint_dir, f"chunk_{digest}_{chunk_index:04d}.json"
                 )
+                if checkpoint_dir is not None
+                else None
+            )
+            if job is not None and job.has_chunk(chunk_index):
+                bundle = UnifiedProofBundle.from_json_obj(
+                    job.bundle_obj(chunk_index, digest)
+                )
+                metrics.count("range_chunks_resumed")
+            elif path is not None and os.path.exists(path):
+                with open(path) as fh:
+                    bundle = UnifiedProofBundle.from_json(fh.read())
+                metrics.count("range_chunks_resumed")
+                if job is not None:  # checkpoint hit the journal missed
+                    job.commit_chunk(chunk_index, digest, bundle)
             else:
-                bundle = generate_event_proofs_for_range(
-                    store,
-                    chunk,
-                    spec,
-                    match_backend=match_backend,
-                    metrics=metrics,
-                    storage_specs=storage_specs,
-                    scan_workers=scan_workers,
-                )
-            if path is not None:
-                tmp = path + ".tmp"
-                with open(tmp, "w") as fh:
-                    fh.write(bundle.to_json())
-                os.replace(tmp, path)  # atomic: partial writes never count
-            metrics.count("range_chunks_generated")
-        if on_chunk is not None:
-            on_chunk(bundle)
-        storage_proofs.extend(bundle.storage_proofs)
-        event_proofs.extend(bundle.event_proofs)
-        all_blocks.update(bundle.blocks)
+                if generate_fn is not None:
+                    bundle = generate_fn(
+                        store,
+                        chunk,
+                        spec,
+                        match_backend=match_backend,
+                        metrics=metrics,
+                        storage_specs=storage_specs,
+                    )
+                else:
+                    bundle = generate_event_proofs_for_range(
+                        store,
+                        chunk,
+                        spec,
+                        match_backend=match_backend,
+                        metrics=metrics,
+                        storage_specs=storage_specs,
+                        scan_workers=scan_workers,
+                    )
+                if path is not None:
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as fh:
+                        fh.write(bundle.to_json())
+                    os.replace(tmp, path)  # atomic: partial writes never count
+                if job is not None:
+                    job.commit_chunk(chunk_index, digest, bundle)
+                metrics.count("range_chunks_generated")
+            if on_chunk is not None:
+                on_chunk(bundle)
+            storage_proofs.extend(bundle.storage_proofs)
+            event_proofs.extend(bundle.event_proofs)
+            all_blocks.update(bundle.blocks)
+    finally:
+        if job is not None:
+            job.close()
 
     return UnifiedProofBundle(
         storage_proofs=storage_proofs,
@@ -641,6 +676,7 @@ def generate_event_proofs_for_range_pipelined(
     checkpoint_dir: "str | None" = None,
     scan_retries: int = 2,
     force_pipeline: "bool | None" = None,
+    job_dir: "str | None" = None,
 ) -> UnifiedProofBundle:
     """Stage-overlapped range generation on the bounded-queue pipeline
     executor (`parallel.pipeline.run_pipeline`): the range splits into
@@ -680,6 +716,16 @@ def generate_event_proofs_for_range_pipelined(
     re-scans of a chunk after a transient store/RPC error — a scan is a
     pure read, so re-running it is deterministic; semantic `RpcError`s
     fail fast.
+
+    ``job_dir`` is the stronger durability contract
+    (`ipc_proofs_tpu.jobs`): every completed chunk appends one fsync'd
+    write-ahead journal record, so a SIGKILL at ANY byte — including
+    mid-record (torn tail) — resumes to a byte-identical final bundle
+    (pinned by tools/crashtest.py). The record stage journals chunks as
+    they complete; with a verify stage the verdict journals with the
+    chunk. On a worker failure the journaling stage's queued inputs are
+    drained (`PipelineStage.drain_on_cancel`) so chunks whose upstream
+    work finished are still committed before the exception re-raises.
     """
     import os
 
@@ -698,9 +744,22 @@ def generate_event_proofs_for_range_pipelined(
     serial_fallback = (os.cpu_count() or 1) == 1 and not force_pipeline
 
     spec_repr = None
+    if checkpoint_dir is not None or job_dir is not None:
+        spec_repr = _request_spec_repr(spec, chunk_size, storage_specs)
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
-        spec_repr = _request_spec_repr(spec, chunk_size, storage_specs)
+    job = None
+    if job_dir is not None:
+        from ipc_proofs_tpu.jobs import job_manifest, resume_or_create
+
+        job = resume_or_create(
+            job_dir, job_manifest(spec_repr, pairs, chunk_size), metrics=metrics
+        )
+
+    def _chunk_digest(chunk) -> "str | None":
+        if spec_repr is None:
+            return None
+        return _chunk_checkpoint_digest(spec_repr, chunk)
 
     def _ckpt_path(index: int, chunk) -> "str | None":
         if checkpoint_dir is None:
@@ -710,9 +769,11 @@ def generate_event_proofs_for_range_pipelined(
             f"chunk_{_chunk_checkpoint_digest(spec_repr, chunk)}_{index:04d}.json",
         )
 
-    # checkpoint mode (like verify mode) materializes self-contained
-    # per-chunk bundles; the cheap shared-witness path needs neither
-    per_chunk_bundles = verify_chunk is not None or checkpoint_dir is not None
+    # checkpoint/journal mode (like verify mode) materializes self-contained
+    # per-chunk bundles; the cheap shared-witness path needs none of them
+    per_chunk_bundles = (
+        verify_chunk is not None or checkpoint_dir is not None or job is not None
+    )
 
     event_proofs: list = []
     witness_bytes: set[bytes] = set()
@@ -726,6 +787,8 @@ def generate_event_proofs_for_range_pipelined(
 
     def _scan(item):
         index, chunk = item
+        if job is not None and job.has_chunk(index):
+            return index, chunk, None  # journal-committed — record replays it
         path = _ckpt_path(index, chunk)
         if path is not None and os.path.exists(path):
             return index, chunk, None  # resumed — record loads from disk
@@ -752,12 +815,19 @@ def generate_event_proofs_for_range_pipelined(
         path = _ckpt_path(index, chunk)
         if scan_out is None:
             with metrics.stage("range_record"):
-                with open(path) as fh:
-                    bundle = UnifiedProofBundle.from_json(fh.read())
+                if job is not None and job.has_chunk(index):
+                    bundle = UnifiedProofBundle.from_json_obj(
+                        job.bundle_obj(index, _chunk_digest(chunk))
+                    )
+                else:
+                    with open(path) as fh:
+                        bundle = UnifiedProofBundle.from_json(fh.read())
                 metrics.count("range_chunks_resumed")
                 event_proofs.extend(bundle.event_proofs)
                 chunk_blocks.update(bundle.blocks)
-            return bundle if verify_chunk is not None else None
+            if verify_chunk is not None:
+                return index, chunk, bundle, False  # resumed: already journaled
+            return None
         matching_per_pair, native_ok = scan_out
         with metrics.stage("range_record"):
             proofs, chunk_witness, chunk_fallback = _record_chunk(
@@ -768,8 +838,8 @@ def generate_event_proofs_for_range_pipelined(
                 witness_bytes.update(chunk_witness)
                 fallback_blocks.extend(chunk_fallback)
                 return None
-            # verify/checkpoint mode: materialize a self-contained chunk
-            # bundle so it can replay off-thread and/or persist to disk
+            # verify/checkpoint/journal mode: materialize a self-contained
+            # chunk bundle so it can replay off-thread and/or persist
             blocks = _materialize_witness(cached, chunk_witness, chunk_fallback)
             chunk_blocks.update(blocks)
             bundle = UnifiedProofBundle(
@@ -780,59 +850,102 @@ def generate_event_proofs_for_range_pipelined(
                 with open(tmp, "w") as fh:
                     fh.write(bundle.to_json())
                 os.replace(tmp, path)  # atomic: partial writes never count
+            if path is not None or job is not None:
                 metrics.count("range_chunks_generated")
-        return bundle if verify_chunk is not None else None
+            if job is not None and verify_chunk is None:
+                # no verify stage: the record stage IS the commit point
+                job.commit_chunk(index, _chunk_digest(chunk), bundle)
+        if verify_chunk is not None:
+            return index, chunk, bundle, True
+        return None
 
     stages = [
         PipelineStage("scan", _scan, workers=scan_threads),
-        PipelineStage("record", _record),
+        # with a journal and no verify stage, record is the commit point:
+        # drain its queue on abort so finished scans still journal
+        PipelineStage(
+            "record",
+            _record,
+            drain_on_cancel=job is not None and verify_chunk is None,
+        ),
     ]
     stage_fns = [_scan, _record]
     if verify_chunk is not None:
 
-        def _verify(bundle):
+        def _verify(recorded):
+            index, chunk, bundle, fresh = recorded
             with metrics.stage("range_verify"):
-                return verify_chunk(bundle)
+                result = verify_chunk(bundle)
+            if job is not None and fresh:
+                # commit chunk + verdict in ONE record (the journal's
+                # per-chunk contract); resumed chunks re-verify but don't
+                # re-commit
+                job.commit_chunk(
+                    index, _chunk_digest(chunk), bundle, verify=_verdict_obj(result)
+                )
+            return result
 
-        stages.append(PipelineStage("verify", _verify))
+        stages.append(
+            PipelineStage("verify", _verify, drain_on_cancel=job is not None)
+        )
         stage_fns.append(_verify)
 
     items = list(enumerate(chunks))
-    if items:
-        if serial_fallback:
-            metrics.count("range_pipeline_serial_fallback")
-            results = []
-            for item in items:
-                out = item
-                for fn in stage_fns:
-                    out = fn(out)
-                results.append(out)
-        else:
-            results = run_pipeline(items, stages, depth=max(1, pipeline_depth))
-        if verify_chunk is not None and verify_results is not None:
-            verify_results.extend(results)
-    metrics.count("range_proofs", len(event_proofs))
+    try:
+        if items:
+            if serial_fallback:
+                metrics.count("range_pipeline_serial_fallback")
+                results = []
+                for item in items:
+                    out = item
+                    for fn in stage_fns:
+                        out = fn(out)
+                    results.append(out)
+            else:
+                results = run_pipeline(items, stages, depth=max(1, pipeline_depth))
+            if verify_chunk is not None and verify_results is not None:
+                verify_results.extend(results)
+        metrics.count("range_proofs", len(event_proofs))
 
-    storage_proofs: list = []
-    if storage_specs:
-        with metrics.stage("range_storage"):
-            storage_proofs, storage_witness, storage_blocks = _storage_for_pairs(
-                cached, pairs, storage_specs, match_backend
+        storage_proofs: list = []
+        if storage_specs:
+            with metrics.stage("range_storage"):
+                storage_proofs, storage_witness, storage_blocks = _storage_for_pairs(
+                    cached, pairs, storage_specs, match_backend
+                )
+            metrics.count("range_storage_proofs", len(storage_proofs))
+            witness_bytes |= storage_witness
+            fallback_blocks.extend(storage_blocks)
+
+        with metrics.stage("range_record"):
+            # verify mode pre-materialized per-chunk blocks; they merge (and
+            # dedup by CID bytes) with any storage leg in the final sort
+            extra = (
+                list(chunk_blocks) + fallback_blocks if chunk_blocks else fallback_blocks
             )
-        metrics.count("range_storage_proofs", len(storage_proofs))
-        witness_bytes |= storage_witness
-        fallback_blocks.extend(storage_blocks)
+            blocks = _materialize_witness(cached, witness_bytes, extra)
+        return UnifiedProofBundle(
+            storage_proofs=storage_proofs,
+            event_proofs=event_proofs,
+            blocks=blocks,
+        )
+    finally:
+        if job is not None:
+            job.close()
 
-    with metrics.stage("range_record"):
-        # verify mode pre-materialized per-chunk blocks; they merge (and
-        # dedup by CID bytes) with any storage leg in the final sort
-        extra = list(chunk_blocks) + fallback_blocks if chunk_blocks else fallback_blocks
-        blocks = _materialize_witness(cached, witness_bytes, extra)
-    return UnifiedProofBundle(
-        storage_proofs=storage_proofs,
-        event_proofs=event_proofs,
-        blocks=blocks,
-    )
+
+def _verdict_obj(result):
+    """Best-effort JSON projection of a caller's verify verdict for the
+    journal record (the verdict is informational — resumed chunks
+    re-verify live, so fidelity beyond JSON-representability isn't
+    load-bearing)."""
+    import json
+
+    try:
+        json.dumps(result)
+        return result
+    except (TypeError, ValueError):
+        return repr(result)
 
 
 def _record_pass2_native(

@@ -14,6 +14,7 @@ from ipc_proofs_tpu.serve.batcher import (
     QueueFullError,
     ServiceClosedError,
 )
+from ipc_proofs_tpu.serve.durable import DurableAdmission
 from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
 from ipc_proofs_tpu.serve.service import (
     GenerateResponse,
@@ -25,6 +26,7 @@ from ipc_proofs_tpu.serve.service import (
 
 __all__ = [
     "DeadlineExceededError",
+    "DurableAdmission",
     "GenerateResponse",
     "MicroBatcher",
     "PendingResult",

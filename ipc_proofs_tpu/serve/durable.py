@@ -1,0 +1,253 @@
+"""Durable admission queue: serve requests journaled before ACK.
+
+PR 1's daemon loses every queued request on restart; this wraps
+`ProofService` with the jobs journal (`ipc_proofs_tpu.jobs.journal`) so
+an accepted request survives process death:
+
+- **admit record** appended (fsync'd) BEFORE the request executes — the
+  client's ACK therefore implies durable intent;
+- **done record** appended with the rendered result once the batcher
+  answers — replay skips finished work and serves retried clients from
+  the cache;
+- on restart, admits without a matching done **re-execute** through the
+  fresh service (``serve.requests_replayed`` counter; `/healthz` reports
+  ``resumed_jobs`` / ``journal_bytes``).
+
+Idempotency keys: a client that retries a timed-out request with the
+same ``idempotency_key`` gets the cached result instead of a second
+execution; concurrent duplicates coalesce onto one in-flight execution.
+Keys are client-chosen; omitted keys get a server-generated UUID (no
+dedup across retries — the key IS the dedup handle).
+
+At-least-once semantics: a request that failed *admission* (queue full /
+draining / deadline) keeps its admit record but writes no done record —
+the next restart re-executes it. Semantic failures (bad request) write a
+done-with-error record so a poison request can't crash-loop the replay.
+
+Journal I/O is fail-soft end-to-end (`JournalWriter` degrades to
+in-memory on ENOSPC/EROFS with ``jobs.journal_failures``): the service
+keeps answering, it just stops being able to resume.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Any, Optional, Sequence
+
+from ipc_proofs_tpu.jobs.journal import JournalError, JournalWriter, read_journal
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.serve.batcher import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServiceClosedError,
+)
+from ipc_proofs_tpu.utils.log import get_logger
+
+__all__ = ["DurableAdmission", "QUEUE_JOURNAL_NAME"]
+
+QUEUE_JOURNAL_NAME = "queue.bin"
+
+logger = get_logger(__name__)
+
+# admission-layer failures: the request never (finishably) executed, so
+# its admit record stays pending and the next restart re-executes it
+_ADMISSION_ERRORS = (QueueFullError, ServiceClosedError, DeadlineExceededError)
+
+
+class _Inflight:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class DurableAdmission:
+    """Journal-backed idempotent request layer over one `ProofService`."""
+
+    def __init__(
+        self,
+        service,
+        queue_dir: str,
+        pairs: Sequence = (),
+        metrics=None,
+        replay: bool = True,
+    ):
+        self.service = service
+        self.pairs = list(pairs)
+        self.metrics = metrics if metrics is not None else service.metrics
+        os.makedirs(queue_dir, exist_ok=True)
+        self._path = os.path.join(queue_dir, QUEUE_JOURNAL_NAME)
+        self._lock = threading.Lock()
+        self._results: "dict[str, dict]" = {}  # key → rendered done payload
+        self._inflight: "dict[str, _Inflight]" = {}
+        self.resumed_jobs = 0  # admitted-but-unfinished requests re-executed
+
+        pending: "list[dict]" = []
+        if os.path.exists(self._path):
+            records, good_offset, torn = read_journal(self._path)
+            if torn:
+                logger.warning(
+                    "serve queue journal %s has a torn tail — truncating to "
+                    "%d bytes", self._path, good_offset,
+                )
+                with open(self._path, "r+b") as fh:
+                    fh.truncate(good_offset)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            admits: "dict[str, dict]" = {}
+            order: "list[str]" = []
+            for pos, rec in enumerate(records):
+                if not isinstance(rec, dict) or not isinstance(rec.get("key"), str):
+                    raise JournalError(
+                        f"malformed serve queue record {pos} in {self._path}"
+                    )
+                kind = rec.get("t")
+                if kind == "admit":
+                    if rec["key"] not in admits:
+                        admits[rec["key"]] = rec
+                        order.append(rec["key"])
+                elif kind == "done":
+                    self._results[rec["key"]] = rec["payload"]
+                else:
+                    raise JournalError(
+                        f"unknown serve queue record type {kind!r} ({pos})"
+                    )
+            pending = [admits[k] for k in order if k not in self._results]
+        self._writer = JournalWriter(self._path, metrics=self.metrics)
+        if replay and pending:
+            self._replay(pending)
+
+    # --- restart replay ----------------------------------------------------
+
+    def _replay(self, pending: "list[dict]") -> None:
+        for rec in pending:
+            self.resumed_jobs += 1
+            self.metrics.count("serve.requests_replayed")
+            key, kind, payload = rec["key"], rec["kind"], rec["payload"]
+            try:
+                result = self._execute(kind, payload, timeout_s=None)
+                done = {"ok": True, "result": result}
+            except Exception as exc:  # noqa: BLE001 — replay must terminate
+                # any failure (even admission) finishes with an error here:
+                # a poison request must not crash-loop every restart
+                done = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            self._finish(key, done)
+
+    # --- execution ---------------------------------------------------------
+
+    def _execute(self, kind: str, payload: Any, timeout_s: "float | None") -> dict:
+        if kind == "verify":
+            bundle = UnifiedProofBundle.from_json_obj(payload)
+            resp = self.service.verify(bundle, timeout_s=timeout_s)
+            return {
+                "storage_results": resp.storage_results,
+                "event_results": resp.event_results,
+                "all_valid": resp.all_valid(),
+                "batch_size": resp.batch_size,
+            }
+        if kind == "generate":
+            if not isinstance(payload, int) or not (0 <= payload < len(self.pairs)):
+                raise ValueError(
+                    f"pair_index {payload!r} outside [0, {len(self.pairs)})"
+                )
+            resp = self.service.generate(self.pairs[payload], timeout_s=timeout_s)
+            return {
+                "bundle": resp.bundle.to_json_obj(),
+                "n_event_proofs": resp.n_event_proofs,
+                "batch_size": resp.batch_size,
+            }
+        raise ValueError(f"unknown request kind {kind!r}")
+
+    def _finish(self, key: str, done_payload: dict) -> None:
+        self._writer.append({"t": "done", "key": key, "payload": done_payload})
+        with self._lock:
+            self._results[key] = done_payload
+            flight = self._inflight.pop(key, None)
+        if flight is not None:
+            flight.result = done_payload
+            flight.event.set()
+
+    # --- public API --------------------------------------------------------
+
+    def submit(
+        self,
+        kind: str,
+        payload: Any,
+        idempotency_key: "str | None" = None,
+        timeout_s: "float | None" = None,
+    ) -> "tuple[str, dict, bool]":
+        """Admit one request; returns ``(key, done_payload, cached)``.
+
+        ``done_payload`` is ``{"ok": True, "result": ...}`` or
+        ``{"ok": False, "error": ...}``; ``cached`` is True when the
+        answer came from the idempotency cache (or a concurrent duplicate
+        execution) instead of a fresh one. Admission errors re-raise.
+        """
+        key = idempotency_key or f"auto-{uuid.uuid4().hex}"
+        with self._lock:
+            hit = self._results.get(key)
+            if hit is not None:
+                self.metrics.count("serve.idempotent_hits")
+                return key, hit, True
+            flight = self._inflight.get(key)
+            if flight is None:
+                owner = True
+                flight = self._inflight[key] = _Inflight()
+            else:
+                owner = False
+        if not owner:
+            # duplicate of an in-flight request: one execution, shared result
+            flight.event.wait()
+            self.metrics.count("serve.idempotent_hits")
+            if flight.error is not None:
+                raise flight.error
+            return key, flight.result, True
+
+        # durable intent BEFORE execution: the ACK implies the journal has it
+        self._writer.append(
+            {"t": "admit", "key": key, "kind": kind, "payload": payload}
+        )
+        try:
+            result = self._execute(kind, payload, timeout_s=timeout_s)
+        except _ADMISSION_ERRORS as exc:
+            # never executed: leave the admit pending for restart replay,
+            # release any coalesced waiters with the same failure
+            with self._lock:
+                self._inflight.pop(key, None)
+            flight.error = exc
+            flight.event.set()
+            raise
+        except Exception as exc:  # noqa: BLE001 — semantic failure: cache it
+            done = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            self._finish(key, done)
+            return key, done, False
+        done = {"ok": True, "result": result}
+        self._finish(key, done)
+        return key, done, False
+
+    # --- observability / lifecycle ----------------------------------------
+
+    @property
+    def journal_bytes(self) -> int:
+        return self._writer.journal_bytes
+
+    def health_fields(self) -> dict:
+        """Merged into `/healthz` by the HTTP front end."""
+        with self._lock:
+            cached = len(self._results)
+            inflight = len(self._inflight)
+        return {
+            "durable_queue": True,
+            "resumed_jobs": self.resumed_jobs,
+            "journal_bytes": self.journal_bytes,
+            "completed_requests": cached,
+            "inflight_requests": inflight,
+            "journal_degraded": self._writer.degraded,
+        }
+
+    def close(self) -> None:
+        self._writer.close()

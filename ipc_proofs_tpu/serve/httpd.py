@@ -18,6 +18,12 @@ to the in-process API and serving errors to status codes:
   state (still HTTP 200 — the service itself serves from what remains;
   draining stays 503).
 
+With a `DurableAdmission` queue attached (``serve --queue-dir``), POSTs
+route through the journal: the request is fsync'd before execution, an
+``idempotency_key`` in the body dedupes client retries (the response
+carries ``idempotency_key`` and ``cached``), and ``/healthz`` reports
+``resumed_jobs`` / ``journal_bytes`` from the queue journal.
+
 `ThreadingHTTPServer` gives one thread per connection; those threads do no
 proof work — they block on ``PendingResult.result()`` while the service's
 worker pool executes batches, so slow clients never stall batch execution.
@@ -48,6 +54,7 @@ class _Handler(BaseHTTPRequestHandler):
     # set per server subclass via ProofHTTPServer
     service: ProofService
     pairs: Sequence[TipsetPair]
+    durable = None  # Optional[DurableAdmission]
 
     protocol_version = "HTTP/1.1"
 
@@ -82,6 +89,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, self.service.metrics_snapshot())
         elif self.path == "/healthz":
             health = self.service.health()
+            if self.durable is not None:
+                health.update(self.durable.health_fields())
             # draining = stop routing here (503); degraded = still serving
             # from healthy endpoints, breaker detail in the body (200)
             self._send_json(503 if health["status"] == "draining" else 200, health)
@@ -108,6 +117,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"malformed bundle: {exc}"})
             return
         timeout_s = body.get("timeout_s")
+        if self.durable is not None:
+            # bundle already validated above — journal the raw JSON obj
+            self._submit_durable("verify", body.get("bundle", body), body)
+            return
         self._submit(
             lambda: self.service.verify(bundle, timeout_s=timeout_s),
             lambda resp: {
@@ -130,6 +143,9 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         timeout_s = body.get("timeout_s")
+        if self.durable is not None:
+            self._submit_durable("generate", idx, body)
+            return
         self._submit(
             lambda: self.service.generate(self.pairs[idx], timeout_s=timeout_s),
             lambda resp: {
@@ -157,6 +173,37 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(200, render(resp))
 
+    def _submit_durable(self, kind: str, payload, body: dict):
+        """Route one request through the durable admission queue.
+
+        Same error mapping as `_submit`, but the 200 body is the journaled
+        done payload: ``{"ok": ..., "result"|"error": ...}`` plus the
+        ``idempotency_key`` that names it and ``cached`` (True when served
+        from the idempotency cache instead of a fresh execution)."""
+        key = body.get("idempotency_key")
+        if key is not None and not isinstance(key, str):
+            self._send_json(400, {"error": "idempotency_key must be a string"})
+            return
+        try:
+            key, done, cached = self.durable.submit(
+                kind, payload, idempotency_key=key,
+                timeout_s=body.get("timeout_s"),
+            )
+        except QueueFullError as exc:
+            self._send_json(
+                503,
+                {"error": "queue full", "retry_after_s": exc.retry_after_s},
+                headers={"Retry-After": f"{max(1, round(exc.retry_after_s))}"},
+            )
+        except ServiceClosedError:
+            self._send_json(503, {"error": "service draining"})
+        except DeadlineExceededError as exc:
+            self._send_json(504, {"error": str(exc)})
+        else:
+            self._send_json(
+                200, dict(done, idempotency_key=key, cached=cached)
+            )
+
 
 class ProofHTTPServer:
     """Own one `ProofService` behind a threading HTTP server.
@@ -173,12 +220,14 @@ class ProofHTTPServer:
         host: str = "127.0.0.1",
         port: int = 0,
         pairs: Optional[Sequence[TipsetPair]] = None,
+        durable=None,
     ):
         self.service = service
+        self.durable = durable
         handler = type(
             "_BoundHandler",
             (_Handler,),
-            {"service": service, "pairs": list(pairs or [])},
+            {"service": service, "pairs": list(pairs or []), "durable": durable},
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
@@ -211,3 +260,5 @@ class ProofHTTPServer:
         if self._thread is not None:
             self._thread.join(timeout)
         self.service.drain(timeout=timeout)
+        if self.durable is not None:
+            self.durable.close()

@@ -23,6 +23,7 @@ __all__ = [
     "Metrics",
     "get_metrics",
     "RESILIENCE_COUNTERS",
+    "DURABILITY_COUNTERS",
 ]
 
 # Counter vocabulary of the fault-tolerance layer (store/failover.py,
@@ -48,6 +49,28 @@ RESILIENCE_COUNTERS = (
     "failover.breaker_open",
     "range_scan_retries",
     "range_pipeline_serial_fallback",
+)
+
+# Counter vocabulary of the durability layer (jobs/journal.py, jobs/job.py,
+# proofs/range.py job wiring, serve/durable.py):
+#   jobs.chunks_replayed    — journal records re-admitted on job resume
+#   jobs.resume_ms          — milliseconds spent replaying the journal
+#   jobs.commit_us          — thread-CPU microseconds spent inside commit
+#                             records (serialize + checksum + write +
+#                             fsync): the journal's attributable cost,
+#                             measured where it happens. CPU time, not
+#                             wall: in the pipelined record stage, wall
+#                             time would also count GIL/IO waits that
+#                             overlap the next chunk's scan
+#   jobs.journal_failures   — records lost to fail-soft journal I/O degrade
+#   serve.requests_replayed — admitted-but-unfinished serve requests
+#                             re-executed on daemon restart
+DURABILITY_COUNTERS = (
+    "jobs.chunks_replayed",
+    "jobs.resume_ms",
+    "jobs.commit_us",
+    "jobs.journal_failures",
+    "serve.requests_replayed",
 )
 
 

@@ -142,6 +142,14 @@ _RESILIENCE_OK = {
     "recovery_ms": 0.05,
 }
 
+_DURABILITY_OK = {
+    "durability_journal_overhead_pct": 3.5,
+    "durability_resume_ms": 25.0,
+    "durability_replay_chunks_per_sec": 850.0,
+    "durability_journal_bytes": 1_700_000,
+    "durability_chunks": 6,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -169,6 +177,7 @@ class TestOrchestrate:
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
+            "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -177,10 +186,12 @@ class TestOrchestrate:
         assert out["legs"]["e2e"] == "ok:tpu"
         assert out["legs"]["serve"] == "ok:cpu"
         assert out["legs"]["resilience"] == "ok:cpu"
+        assert out["legs"]["durability"] == "ok:cpu"
         assert out["serve_speedup_vs_sequential"] == 2.5
         assert out["witness_reduction_pct"] == 96.0
         assert out["integrity_overhead_pct"] == 1.2
         assert out["proofs_per_sec_at_fault_rate"] == 430.0
+        assert out["durability_journal_overhead_pct"] == 3.5
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -193,6 +204,7 @@ class TestOrchestrate:
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
+            "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -204,6 +216,7 @@ class TestOrchestrate:
             ("e2e", "default"), ("e2e", "cpu"), ("kernel", "cpu"),
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
             ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
+            ("durability", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -216,6 +229,7 @@ class TestOrchestrate:
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
+            "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -259,6 +273,7 @@ class TestOrchestrate:
             "serve": [(None, "error:cpu")],
             "witness": [(None, "error:cpu")],
             "resilience": [(None, "error:cpu")],
+            "durability": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -269,6 +284,7 @@ class TestOrchestrate:
             "serve_speedup_vs_sequential", "serve_batched_rps",
             "witness_reduction_pct", "integrity_overhead_pct",
             "proofs_per_sec_at_fault_rate", "recovery_ms",
+            "durability_journal_overhead_pct", "durability_resume_ms",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

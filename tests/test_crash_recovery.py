@@ -1,0 +1,73 @@
+"""Pinned-seed crash-recovery grid (tools/crashtest.py harness).
+
+Each grid point SIGKILLs a real child process running the journaled
+pipelined range driver — at a chunk-commit boundary or mid-record (torn
+frame) — then resumes it and demands the final bundle be byte-identical
+to an uninterrupted run. The seeds are pinned so the exact kill points
+are reproducible; `tools/soak.py crash` runs the same harness with fresh
+seeds at scale."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+import crashtest  # noqa: E402
+
+
+@pytest.mark.parametrize("seed", [20260805, 7])
+def test_sigkill_grid_resumes_byte_identical(seed):
+    summary = crashtest.run_grid(seed, points=8, n_pairs=12, chunk_size=2)
+    assert summary["ok"], summary["violations"]
+    assert summary["counts"] == {"identical": summary["points"]}
+    # the grid must exercise BOTH kill flavors: clean boundary commits and
+    # torn mid-record frames (different recovery paths)
+    torn = [t for _, t in summary["kill_points"] if t is not None]
+    assert torn and len(torn) < summary["points"]
+
+
+def test_single_boundary_kill_point_detail(tmp_path):
+    """One kill point end to end with the internals exposed: the journal
+    holds exactly crash_at+1 records after a boundary kill, and the resumed
+    run replays every one of them."""
+    shape = {
+        "pairs": 8, "chunk_size": 2, "receipts": 3, "events": 2,
+        "match_rate": 0.3,
+    }
+    store, pairs, spec = crashtest._build_world(8, 3, 2, 0.3)
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+    reference = generate_event_proofs_for_range_pipelined(
+        store, pairs, spec, chunk_size=2, scan_threads=2, force_pipeline=True
+    ).to_json()
+    res = crashtest.crash_run(
+        reference, shape, crash_at=1, torn=None, workdir=str(tmp_path), tag="t"
+    )
+    assert res["outcome"] == "identical", res
+    assert res["records_after_crash"] == 2
+    assert res["chunks_replayed"] == 2
+    assert not res["torn_tail"]
+
+
+def test_single_torn_kill_point_detail(tmp_path):
+    """Torn mid-record kill: the partial frame is visible post-mortem as a
+    torn tail, then discarded on resume."""
+    shape = {
+        "pairs": 8, "chunk_size": 2, "receipts": 3, "events": 2,
+        "match_rate": 0.3,
+    }
+    store, pairs, spec = crashtest._build_world(8, 3, 2, 0.3)
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+    reference = generate_event_proofs_for_range_pipelined(
+        store, pairs, spec, chunk_size=2, scan_threads=2, force_pipeline=True
+    ).to_json()
+    res = crashtest.crash_run(
+        reference, shape, crash_at=2, torn=64, workdir=str(tmp_path), tag="t"
+    )
+    assert res["outcome"] == "identical", res
+    assert res["records_after_crash"] == 2  # the torn 3rd record is not counted
+    assert res["torn_tail"]
+    assert res["chunks_replayed"] == 2

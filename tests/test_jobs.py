@@ -1,0 +1,391 @@
+"""Write-ahead job journal tests: framing round-trips, torn-tail recovery,
+corruption detection (bit flips, bad magic, duplicate records, manifest
+mismatch), fail-soft degrade, and byte-identical resume through the real
+range drivers. All hermetic and tier-1."""
+
+import json
+import os
+import struct
+import zlib
+
+import pytest
+
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.jobs import (
+    JOBS_JOURNAL_NAME,
+    JOBS_MANIFEST_NAME,
+    JOURNAL_MAGIC,
+    JournalError,
+    JournalWriter,
+    job_manifest,
+    read_journal,
+    resume_or_create,
+)
+from ipc_proofs_tpu.jobs.journal import encode_record
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import (
+    generate_event_proofs_for_range_chunked,
+    generate_event_proofs_for_range_pipelined,
+)
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+
+_HEADER = struct.Struct("<4sII")
+
+
+def _frame(payload: bytes) -> bytes:
+    return _HEADER.pack(JOURNAL_MAGIC, len(payload), zlib.crc32(payload)) + payload
+
+
+def _write_records(path, objs):
+    with open(path, "ab") as fh:
+        for obj in objs:
+            fh.write(_frame(encode_record(obj)))
+
+
+class TestJournalFraming:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "j.bin")
+        w = JournalWriter(path)
+        objs = [{"t": "chunk", "chunk": i, "x": "y" * i} for i in range(5)]
+        for obj in objs:
+            assert w.append(obj) is True
+        w.close()
+        records, good_offset, torn = read_journal(path)
+        assert records == objs
+        assert not torn
+        assert good_offset == os.path.getsize(path)
+
+    @pytest.mark.parametrize("cut", [1, 4, 11, 12, 13, 20])
+    def test_torn_tail_is_recovered_not_fatal(self, tmp_path, cut):
+        """A frame cut anywhere — inside the header or the payload — is
+        crash residue: the reader keeps the good prefix and flags torn."""
+        path = str(tmp_path / "j.bin")
+        _write_records(path, [{"chunk": 0}])
+        partial = _frame(encode_record({"chunk": 1, "pad": "z" * 40}))[:cut]
+        with open(path, "ab") as fh:
+            fh.write(partial)
+        records, good_offset, torn = read_journal(path)
+        assert records == [{"chunk": 0}]
+        assert torn
+        assert good_offset == os.path.getsize(path) - cut
+
+    def test_bit_flip_in_complete_record_raises(self, tmp_path):
+        path = str(tmp_path / "j.bin")
+        _write_records(path, [{"chunk": 0, "bundle": "b" * 64}, {"chunk": 1}])
+        with open(path, "r+b") as fh:
+            fh.seek(_HEADER.size + 10)  # inside the first payload
+            byte = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([byte[0] ^ 0x40]))
+        with pytest.raises(JournalError, match="checksum mismatch"):
+            read_journal(path)
+
+    def test_bad_magic_raises(self, tmp_path):
+        path = str(tmp_path / "j.bin")
+        _write_records(path, [{"chunk": 0}])
+        with open(path, "r+b") as fh:
+            fh.write(b"XXXX")
+        with pytest.raises(JournalError, match="bad journal magic"):
+            read_journal(path)
+
+    def test_non_json_payload_with_valid_crc_raises(self, tmp_path):
+        """CRC-valid garbage (interleaved writer, not bit rot) is still a
+        typed error — never a silently wrong record."""
+        path = str(tmp_path / "j.bin")
+        payload = b"\xff\xfenot json"
+        with open(path, "wb") as fh:
+            fh.write(_HEADER.pack(JOURNAL_MAGIC, len(payload), zlib.crc32(payload)))
+            fh.write(payload)
+        with pytest.raises(JournalError, match="not valid JSON"):
+            read_journal(path)
+
+    def test_empty_journal(self, tmp_path):
+        path = str(tmp_path / "j.bin")
+        open(path, "wb").close()
+        assert read_journal(path) == ([], 0, False)
+
+
+class _BrokenFile:
+    """File stub whose writes fail like a full/read-only disk."""
+
+    def __init__(self, err=28):  # ENOSPC
+        self._err = err
+
+    def write(self, data):
+        raise OSError(self._err, os.strerror(self._err))
+
+    def flush(self):
+        pass
+
+    def fileno(self):
+        raise OSError(self._err, os.strerror(self._err))
+
+    def close(self):
+        pass
+
+
+class TestFailSoft:
+    def test_enospc_degrades_permanently_and_counts(self, tmp_path):
+        path = str(tmp_path / "j.bin")
+        metrics = Metrics()
+        w = JournalWriter(path, metrics=metrics)
+        assert w.append({"chunk": 0}) is True
+        w._fh = _BrokenFile()  # disk fills mid-run
+        assert w.append({"chunk": 1}) is False
+        assert w.degraded
+        # degrade is permanent: even if the disk recovers, a partial frame
+        # may sit at the tail — appending after it would corrupt mid-file
+        assert w.append({"chunk": 2}) is False
+        w.close()
+        counters = metrics.snapshot()["counters"]
+        assert counters["jobs.journal_failures"] == 2
+        # the record that made it before the failure is intact on disk
+        records, _, torn = read_journal(path)
+        assert records == [{"chunk": 0}] and not torn
+
+    def test_degraded_job_still_finishes_with_correct_bundle(self, tmp_path):
+        """End to end: journal on a read-only dir → run completes, bundle
+        identical, failures counted, no exception."""
+        store, pairs, _ = build_range_world(
+            4, 2, 2, 0.3, signature=SIG, topic1=SUBNET, actor_id=ACTOR
+        )
+        spec = EventProofSpec(
+            event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR
+        )
+        reference = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2, force_pipeline=True
+        )
+        job_dir = tmp_path / "job"
+        metrics = Metrics()
+        job = resume_or_create(
+            str(job_dir), job_manifest(b"spec", pairs, 2), metrics=metrics
+        )
+        job._writer._fh = _BrokenFile(30)  # EROFS from the first append on
+        try:
+            for i in range(2):
+                assert job.commit_chunk(i, None, reference) is False
+            assert job.degraded
+            # the in-memory completed map still serves the run
+            assert job.has_chunk(0) and job.has_chunk(1)
+        finally:
+            job.close()
+        assert metrics.snapshot()["counters"]["jobs.journal_failures"] == 2
+
+
+def _manifest(n_pairs=4, chunk_size=2):
+    store, pairs, _ = build_range_world(
+        n_pairs, 1, 1, 0.0, signature=SIG, topic1=SUBNET, actor_id=ACTOR
+    )
+    return job_manifest(b"params", pairs, chunk_size)
+
+
+class TestResumeOrCreate:
+    def test_fresh_dir_writes_manifest(self, tmp_path):
+        man = _manifest()
+        with resume_or_create(str(tmp_path / "job"), man) as job:
+            assert job.completed == {}
+        with open(tmp_path / "job" / JOBS_MANIFEST_NAME) as fh:
+            assert json.load(fh) == man
+
+    def test_manifest_mismatch_raises(self, tmp_path):
+        job_dir = str(tmp_path / "job")
+        resume_or_create(job_dir, _manifest(chunk_size=2)).close()
+        with pytest.raises(JournalError, match="manifest mismatch"):
+            resume_or_create(job_dir, _manifest(chunk_size=4))
+
+    def test_duplicate_chunk_record_raises(self, tmp_path):
+        job_dir = tmp_path / "job"
+        man = _manifest()
+        resume_or_create(str(job_dir), man).close()
+        _write_records(
+            str(job_dir / JOBS_JOURNAL_NAME),
+            [
+                {"t": "chunk", "chunk": 0, "digest": "d", "bundle": {}, "verify": None},
+                {"t": "chunk", "chunk": 0, "digest": "d", "bundle": {}, "verify": None},
+            ],
+        )
+        with pytest.raises(JournalError, match="duplicate journal record"):
+            resume_or_create(str(job_dir), man)
+
+    def test_chunk_index_out_of_range_raises(self, tmp_path):
+        job_dir = tmp_path / "job"
+        man = _manifest()  # n_chunks == 2
+        resume_or_create(str(job_dir), man).close()
+        _write_records(
+            str(job_dir / JOBS_JOURNAL_NAME),
+            [{"t": "chunk", "chunk": 7, "digest": "d", "bundle": {}, "verify": None}],
+        )
+        with pytest.raises(JournalError, match="outside"):
+            resume_or_create(str(job_dir), man)
+
+    def test_verdict_before_chunk_raises(self, tmp_path):
+        job_dir = tmp_path / "job"
+        man = _manifest()
+        resume_or_create(str(job_dir), man).close()
+        _write_records(
+            str(job_dir / JOBS_JOURNAL_NAME),
+            [{"t": "verdict", "chunk": 0, "digest": "d", "verify": 1}],
+        )
+        with pytest.raises(JournalError, match="precedes"):
+            resume_or_create(str(job_dir), man)
+
+    def test_unknown_record_type_raises(self, tmp_path):
+        job_dir = tmp_path / "job"
+        man = _manifest()
+        resume_or_create(str(job_dir), man).close()
+        _write_records(
+            str(job_dir / JOBS_JOURNAL_NAME), [{"t": "mystery", "chunk": 0}]
+        )
+        with pytest.raises(JournalError, match="unknown journal record type"):
+            resume_or_create(str(job_dir), man)
+
+    def test_torn_tail_truncated_and_appendable(self, tmp_path):
+        job_dir = tmp_path / "job"
+        man = _manifest()
+        resume_or_create(str(job_dir), man).close()
+        jpath = str(job_dir / JOBS_JOURNAL_NAME)
+        good = {"t": "chunk", "chunk": 0, "digest": "d", "bundle": {"k": 1}, "verify": None}
+        _write_records(jpath, [good])
+        committed_size = os.path.getsize(jpath)
+        with open(jpath, "ab") as fh:  # crash mid-append of chunk 1
+            fh.write(_frame(encode_record({"t": "chunk", "chunk": 1}))[:9])
+        metrics = Metrics()
+        with resume_or_create(str(job_dir), man, metrics=metrics) as job:
+            assert set(job.completed) == {0}
+            assert os.path.getsize(jpath) == committed_size  # tail gone
+            assert job.commit_chunk(1, "d2", _FakeBundle({"k": 2})) is True
+        records, _, torn = read_journal(jpath)
+        assert [r["chunk"] for r in records] == [0, 1] and not torn
+        assert metrics.snapshot()["counters"]["jobs.chunks_replayed"] == 1
+
+    def test_resume_counters_and_gauge(self, tmp_path):
+        job_dir = str(tmp_path / "job")
+        man = _manifest()
+        with resume_or_create(job_dir, man) as job:
+            job.commit_chunk(0, "d0", _FakeBundle({"a": 1}), verify=7)
+            job.commit_verdict(0, "d0", verify=9)
+        metrics = Metrics()
+        with resume_or_create(job_dir, man, metrics=metrics) as job:
+            assert job.completed[0]["verify"] == 9  # verdict replayed on top
+            snap = metrics.snapshot()
+            assert snap["counters"]["jobs.chunks_replayed"] == 1
+            assert "jobs.resume_ms" in snap["counters"]
+            assert snap["gauges"]["jobs.journal_bytes"] == job.journal_bytes > 0
+
+    def test_bundle_obj_digest_mismatch_raises(self, tmp_path):
+        with resume_or_create(str(tmp_path / "job"), _manifest()) as job:
+            job.commit_chunk(0, "aaa", _FakeBundle({}))
+            assert job.bundle_obj(0, "aaa") == {}
+            with pytest.raises(JournalError, match="different range"):
+                job.bundle_obj(0, "bbb")
+
+
+class _FakeBundle:
+    def __init__(self, obj):
+        self._obj = obj
+
+    def to_json_obj(self):
+        return self._obj
+
+
+@pytest.fixture(scope="module")
+def range_world():
+    store, pairs, n_match = build_range_world(
+        6, 3, 2, 0.3, signature=SIG, topic1=SUBNET, actor_id=ACTOR
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+    return store, pairs, spec
+
+
+class TestRangeDriverResume:
+    def test_pipelined_resume_byte_identical(self, tmp_path, range_world):
+        store, pairs, spec = range_world
+        reference = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2, force_pipeline=True
+        ).to_json()
+        job_dir = str(tmp_path / "job")
+        first = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=job_dir,
+        )
+        assert first.to_json() == reference
+        metrics = Metrics()
+        resumed = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=job_dir, metrics=metrics,
+        )
+        assert resumed.to_json() == reference
+        counters = metrics.snapshot()["counters"]
+        assert counters["jobs.chunks_replayed"] == 3
+        assert counters["range_chunks_resumed"] == 3
+        assert "range_chunks_generated" not in counters
+
+    def test_chunked_resume_byte_identical(self, tmp_path, range_world):
+        store, pairs, spec = range_world
+        reference = generate_event_proofs_for_range_chunked(
+            store, pairs, spec, chunk_size=2
+        ).to_json()
+        job_dir = str(tmp_path / "job")
+        assert (
+            generate_event_proofs_for_range_chunked(
+                store, pairs, spec, chunk_size=2, job_dir=job_dir
+            ).to_json()
+            == reference
+        )
+        metrics = Metrics()
+        resumed = generate_event_proofs_for_range_chunked(
+            store, pairs, spec, chunk_size=2, job_dir=job_dir, metrics=metrics
+        )
+        assert resumed.to_json() == reference
+        assert metrics.snapshot()["counters"]["range_chunks_resumed"] == 3
+
+    def test_job_dir_bound_to_request(self, tmp_path, range_world):
+        """Re-running with a different chunking against the same job dir is
+        a different request: typed failure, never a silently spliced bundle."""
+        store, pairs, spec = range_world
+        job_dir = str(tmp_path / "job")
+        generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=job_dir,
+        )
+        with pytest.raises(JournalError, match="manifest mismatch"):
+            generate_event_proofs_for_range_pipelined(
+                store, pairs, spec, chunk_size=3, scan_threads=2,
+                force_pipeline=True, job_dir=job_dir,
+            )
+
+    def test_partial_journal_resume_generates_only_missing(
+        self, tmp_path, range_world
+    ):
+        """Drop the last committed chunk record: the resume regenerates
+        exactly that chunk and reuses the rest."""
+        store, pairs, spec = range_world
+        job_dir = tmp_path / "job"
+        reference = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=str(job_dir),
+        ).to_json()
+        jpath = str(job_dir / JOBS_JOURNAL_NAME)
+        records, _, _ = read_journal(jpath)
+        assert len(records) == 3
+        with open(jpath, "r+b") as fh:  # amputate the final record cleanly
+            data = fh.read()
+            last = _frame(encode_record(records[-1]))
+            assert data.endswith(last)
+            fh.truncate(len(data) - len(last))
+        metrics = Metrics()
+        resumed = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=str(job_dir), metrics=metrics,
+        )
+        assert resumed.to_json() == reference
+        counters = metrics.snapshot()["counters"]
+        assert counters["range_chunks_resumed"] == 2
+        assert counters["range_chunks_generated"] == 1
+        # the journal is whole again
+        records, _, torn = read_journal(jpath)
+        assert len(records) == 3 and not torn

@@ -1,0 +1,314 @@
+"""Durable admission queue tests (`serve/durable.py`): journaled-before-ACK,
+idempotency-key dedup (in-process, across restart, and concurrent), restart
+replay of admitted-but-unfinished requests, fail-soft journal degrade, and
+the HTTP wiring (`idempotency_key` pass-through, /healthz durable fields).
+Hermetic: MemoryBlockstore worlds, ephemeral ports."""
+
+import json
+import threading
+from http.client import HTTPConnection
+
+import pytest
+
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.jobs import read_journal
+from ipc_proofs_tpu.jobs.journal import JournalWriter
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.serve import (
+    DurableAdmission,
+    ProofHTTPServer,
+    ProofService,
+    ServiceConfig,
+)
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+
+
+@pytest.fixture(scope="module")
+def world():
+    store, pairs, _ = build_range_world(4, 2, 2, 0.5, signature=SIG, topic1=SUBNET)
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET)
+    return store, pairs, spec
+
+
+def _service(world, metrics=None):
+    store, pairs, spec = world
+    return ProofService(
+        store=store,
+        spec=spec,
+        trust_policy=TrustPolicy.accept_all(),
+        event_filter=None,
+        config=ServiceConfig(workers=1, max_wait_ms=1.0),
+        metrics=metrics,
+    )
+
+
+class TestDurableAdmission:
+    def test_journaled_before_ack_and_idempotent(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        try:
+            key, done, cached = d.submit("generate", 0, idempotency_key="g-1")
+            assert key == "g-1" and done["ok"] and not cached
+            # the ACKed request is on disk: one admit + one done record
+            records, _, torn = read_journal(str(tmp_path / "queue.bin"))
+            assert [r["t"] for r in records] == ["admit", "done"] and not torn
+            assert records[0]["key"] == records[1]["key"] == "g-1"
+            # retry with the same key: cached, no re-execution
+            _, done2, cached2 = d.submit("generate", 0, idempotency_key="g-1")
+            assert cached2 and done2 == done
+            records2, _, _ = read_journal(str(tmp_path / "queue.bin"))
+            assert len(records2) == 2  # the cache hit wrote nothing
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_verify_and_semantic_failure_roundtrip(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        try:
+            _, gen, _ = d.submit("generate", 1, idempotency_key="g")
+            _, ver, _ = d.submit(
+                "verify", gen["result"]["bundle"], idempotency_key="v"
+            )
+            assert ver["ok"] and ver["result"]["all_valid"] is True
+            # a bad pair index is a SEMANTIC failure: cached as a done-error
+            # so a poison request can never crash-loop the restart replay
+            _, bad, cached = d.submit("generate", 99, idempotency_key="bad")
+            assert not bad["ok"] and "pair_index" in bad["error"] and not cached
+            _, bad2, cached2 = d.submit("generate", 99, idempotency_key="bad")
+            assert cached2 and bad2 == bad
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_cache_survives_restart(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        _, done, _ = d.submit("generate", 0, idempotency_key="g-1")
+        d.close()
+        svc.drain()
+        svc2 = _service(world)
+        d2 = DurableAdmission(svc2, str(tmp_path), pairs=pairs)
+        try:
+            assert d2.resumed_jobs == 0  # nothing was unfinished
+            _, done2, cached = d2.submit("generate", 0, idempotency_key="g-1")
+            assert cached and done2 == done
+        finally:
+            d2.close()
+            svc2.drain()
+
+    def test_unfinished_admit_replayed_on_restart(self, tmp_path, world):
+        """An admit with no done record is a request that was ACKed but died
+        with the process — the restart re-executes it."""
+        _, pairs, _ = world
+        w = JournalWriter(str(tmp_path / "queue.bin"))
+        w.append({"t": "admit", "key": "crashed", "kind": "generate", "payload": 1})
+        w.close()
+        metrics = Metrics()
+        svc = _service(world, metrics=metrics)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        try:
+            assert d.resumed_jobs == 1
+            assert (
+                metrics.snapshot()["counters"]["serve.requests_replayed"] == 1
+            )
+            # the replayed result is cached under the client's key
+            _, done, cached = d.submit("generate", 1, idempotency_key="crashed")
+            assert cached and done["ok"]
+            # and durably recorded: a second restart replays nothing
+            d.close()
+            svc.drain()
+            svc2 = _service(world)
+            d2 = DurableAdmission(svc2, str(tmp_path), pairs=pairs)
+            assert d2.resumed_jobs == 0
+            d2.close()
+            svc2.drain()
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_poison_admit_finishes_with_error_once(self, tmp_path, world):
+        _, pairs, _ = world
+        w = JournalWriter(str(tmp_path / "queue.bin"))
+        w.append({"t": "admit", "key": "poison", "kind": "generate", "payload": 999})
+        w.close()
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        d.close()
+        svc.drain()
+        # the failed replay wrote a done-error record: no second replay
+        svc2 = _service(world)
+        d2 = DurableAdmission(svc2, str(tmp_path), pairs=pairs)
+        try:
+            assert d2.resumed_jobs == 0
+            _, done, cached = d2.submit("generate", 999, idempotency_key="poison")
+            assert cached and not done["ok"]
+        finally:
+            d2.close()
+            svc2.drain()
+
+    def test_torn_queue_tail_truncated(self, tmp_path, world):
+        _, pairs, _ = world
+        w = JournalWriter(str(tmp_path / "queue.bin"))
+        w.append({"t": "admit", "key": "k1", "kind": "generate", "payload": 0})
+        w.append({"t": "done", "key": "k1", "payload": {"ok": True, "result": {}}})
+        w.close()
+        with open(tmp_path / "queue.bin", "ab") as fh:
+            fh.write(b"IPJ1\x99")  # crash mid-append
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        try:
+            _, done, cached = d.submit("verify", {}, idempotency_key="k1")
+            assert cached and done == {"ok": True, "result": {}}
+            records, _, torn = read_journal(str(tmp_path / "queue.bin"))
+            assert len(records) == 2 and not torn
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_concurrent_same_key_coalesces(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        results = []
+
+        def go():
+            results.append(d.submit("generate", 0, idempotency_key="same"))
+
+        try:
+            threads = [threading.Thread(target=go) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            assert len(results) == 6
+            dones = [r[1] for r in results]
+            assert all(done == dones[0] and done["ok"] for done in dones)
+            # exactly one execution reached the journal
+            records, _, _ = read_journal(str(tmp_path / "queue.bin"))
+            assert [r["t"] for r in records] == ["admit", "done"]
+            assert sum(1 for _, _, cached in results if not cached) == 1
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_journal_degrade_keeps_serving(self, tmp_path, world):
+        _, pairs, _ = world
+        metrics = Metrics()
+        svc = _service(world, metrics=metrics)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+
+        class _Broken:
+            def write(self, data):
+                raise OSError(28, "No space left on device")
+
+            def flush(self):
+                pass
+
+            def close(self):
+                pass
+
+        d._writer._fh = _Broken()
+        try:
+            _, done, _ = d.submit("generate", 0, idempotency_key="g")
+            assert done["ok"]  # request served despite the dead journal
+            assert d.health_fields()["journal_degraded"] is True
+            assert metrics.snapshot()["counters"]["jobs.journal_failures"] >= 1
+        finally:
+            d.close()
+            svc.drain()
+
+
+def _post(port, path, obj):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request(
+            "POST", path, body=json.dumps(obj),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class TestDurableHTTP:
+    @pytest.fixture()
+    def server(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs)
+        srv = ProofHTTPServer(svc, pairs=pairs, durable=d).start()
+        yield srv
+        srv.shutdown(timeout=30)
+
+    def test_generate_verify_with_keys(self, server):
+        status, resp = _post(
+            server.port, "/v1/generate",
+            {"pair_index": 0, "idempotency_key": "g-1"},
+        )
+        assert status == 200 and resp["ok"]
+        assert resp["idempotency_key"] == "g-1" and resp["cached"] is False
+        status2, resp2 = _post(
+            server.port, "/v1/generate",
+            {"pair_index": 0, "idempotency_key": "g-1"},
+        )
+        assert status2 == 200 and resp2["cached"] is True
+        assert resp2["result"] == resp["result"]
+        status3, resp3 = _post(
+            server.port, "/v1/verify",
+            {"bundle": resp["result"]["bundle"], "idempotency_key": "v-1"},
+        )
+        assert status3 == 200 and resp3["ok"]
+        assert resp3["result"]["all_valid"] is True
+
+    def test_omitted_key_gets_auto_key(self, server):
+        _, gen = _post(server.port, "/v1/generate", {"pair_index": 1})
+        status, resp = _post(
+            server.port, "/v1/verify", {"bundle": gen["result"]["bundle"]}
+        )
+        assert status == 200 and resp["idempotency_key"].startswith("auto-")
+
+    def test_non_string_key_rejected(self, server):
+        status, resp = _post(
+            server.port, "/v1/generate", {"pair_index": 0, "idempotency_key": 5}
+        )
+        assert status == 400 and "idempotency_key" in resp["error"]
+
+    def test_malformed_bundle_still_400(self, server):
+        """Validation happens before admission: garbage never reaches the
+        journal."""
+        status, _ = _post(
+            server.port, "/v1/verify",
+            {"bundle": {"nope": 1}, "idempotency_key": "bad"},
+        )
+        assert status == 400
+        records, _, _ = read_journal(
+            str(server.durable._writer.path)
+        )
+        assert all(r["key"] != "bad" for r in records)
+
+    def test_healthz_reports_durable_fields(self, server):
+        status, health = _get(server.port, "/healthz")
+        assert status == 200
+        assert health["durable_queue"] is True
+        assert health["resumed_jobs"] == 0
+        assert isinstance(health["journal_bytes"], int)
+        assert health["journal_degraded"] is False

@@ -83,6 +83,11 @@ _KNOWN_TYPES = {
     "proofs_per_sec_at_fault_rate": _NUM,
     "resilience_fault_rate": _NUM,
     "recovery_ms": _NUM,
+    "durability_journal_overhead_pct": _NUM,
+    "durability_resume_ms": _NUM,
+    "durability_replay_chunks_per_sec": _NUM,
+    "durability_journal_bytes": int,
+    "durability_chunks": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -103,6 +108,9 @@ _CURRENT_REQUIRED = (
     "witness_reduction_pct",
     "resilience_fault_free_proofs_per_sec", "integrity_overhead_pct",
     "proofs_per_sec_at_fault_rate", "resilience_fault_rate", "recovery_ms",
+    "durability_journal_overhead_pct", "durability_resume_ms",
+    "durability_replay_chunks_per_sec", "durability_journal_bytes",
+    "durability_chunks",
     "legs", "watchdog_fallback",
 )
 

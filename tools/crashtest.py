@@ -1,0 +1,301 @@
+"""Crash-recovery harness: SIGKILL the range driver, resume, demand bit-identity.
+
+The chaos invariant (tools/chaos.py) extended to process death: for every
+kill point the journaled range job must resume to a final bundle
+**byte-identical** to an uninterrupted run. The harness forks the REAL
+driver (`generate_event_proofs_for_range_pipelined` with ``job_dir``) as a
+child process and kills it via the journal writer's env fault hook
+(`ipc_proofs_tpu.jobs.journal.JournalWriter`):
+
+- ``IPC_JOURNAL_CRASH_AT=N`` — SIGKILL at the N-th journal append,
+  *after* the record is fully fsync'd (chunk-boundary kill);
+- ``+ IPC_JOURNAL_CRASH_TORN=K`` — SIGKILL after only the first K bytes
+  of the frame reach disk (torn mid-record write — the resume must
+  discard the tail and regenerate that chunk).
+
+A real ``os.kill(getpid(), SIGKILL)``: no destructors, no atexit, no
+buffered-file flush — exactly a preemption or OOM kill. The parent
+observes rc ``-SIGKILL``, re-runs the child with the same job dir and no
+crash env, and compares the final bundle bytes against the reference.
+
+Usage:
+    python tools/crashtest.py SEED [--points N] [--pairs P] [--chunk-size C]
+                                   [--quick]
+
+Importable: `run_grid(base_seed, ...)` backs tests/test_crash_recovery.py
+(pinned seeds) and the `tools/soak.py` crash phase. The ``--child``
+entrypoint is the forked driver — not for interactive use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SIG, SUBNET, ACTOR = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1", 1001
+
+
+def _build_world(n_pairs: int, receipts: int, events: int, match_rate: float):
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts, events, match_rate,
+        signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR)
+    return store, pairs, spec
+
+
+def child_main(args) -> int:
+    """Forked driver: deterministic world → journaled pipelined range run.
+
+    The world is a pure function of the shape arguments, so the crashed
+    child, the resumed child, and the parent's reference all see the same
+    blocks — any byte divergence is the journal's fault, never the data's.
+    """
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    store, pairs, spec = _build_world(
+        args.pairs, args.receipts, args.events, args.match_rate
+    )
+    metrics = Metrics()
+    bundle = generate_event_proofs_for_range_pipelined(
+        store,
+        pairs,
+        spec,
+        chunk_size=args.chunk_size,
+        metrics=metrics,
+        scan_threads=2,
+        force_pipeline=True,
+        job_dir=args.job_dir,
+    )
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(bundle.to_json())
+    os.replace(tmp, args.out)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as fh:
+            json.dump({"counters": metrics.snapshot()["counters"]}, fh)
+    return 0
+
+
+def _spawn_child(
+    job_dir: str,
+    out: str,
+    shape: dict,
+    crash_at: "int | None" = None,
+    torn: "int | None" = None,
+    metrics_out: "str | None" = None,
+    timeout_s: float = 300.0,
+) -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--job-dir", job_dir, "--out", out,
+        "--pairs", str(shape["pairs"]), "--chunk-size", str(shape["chunk_size"]),
+        "--receipts", str(shape["receipts"]), "--events", str(shape["events"]),
+        "--match-rate", str(shape["match_rate"]),
+    ]
+    if metrics_out:
+        cmd += ["--metrics-out", metrics_out]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["IPC_FORCE_PIPELINE"] = "1"
+    env.pop("IPC_JOURNAL_CRASH_AT", None)
+    env.pop("IPC_JOURNAL_CRASH_TORN", None)
+    if crash_at is not None:
+        env["IPC_JOURNAL_CRASH_AT"] = str(crash_at)
+        if torn is not None:
+            env["IPC_JOURNAL_CRASH_TORN"] = str(torn)
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout_s
+    )
+
+
+def crash_run(
+    reference: str,
+    shape: dict,
+    crash_at: int,
+    torn: "int | None",
+    workdir: str,
+    tag: "str | int" = 0,
+) -> dict:
+    """One kill point: crash the child at ``crash_at`` (optionally torn at
+    byte ``torn``), resume it, and check the final bundle bytes.
+
+    ``tag`` must be unique per call — it keys the job dir, and a repeated
+    (crash_at, torn) draw must NOT resume the earlier call's journal (a
+    fully-committed job never appends, so the crash hook would never fire).
+    """
+    from ipc_proofs_tpu.jobs import JOBS_JOURNAL_NAME, read_journal
+
+    job_dir = os.path.join(workdir, f"job_{tag}_at{crash_at}_torn{torn}")
+    out = os.path.join(workdir, f"out_{tag}_at{crash_at}_torn{torn}.json")
+    metrics_out = out + ".metrics"
+    res = {"crash_at": crash_at, "torn": torn}
+
+    crashed = _spawn_child(job_dir, out, shape, crash_at=crash_at, torn=torn)
+    if crashed.returncode != -signal.SIGKILL:
+        res["outcome"] = "no_crash"
+        res["rc"] = crashed.returncode
+        res["stderr"] = crashed.stderr[-2000:]
+        return res
+
+    # post-mortem: the journal must hold exactly the committed prefix —
+    # crash_at records for a torn kill (+1 when the frame fully landed)
+    jpath = os.path.join(job_dir, JOBS_JOURNAL_NAME)
+    n_records, torn_tail = 0, False
+    if os.path.exists(jpath):
+        records, _, torn_tail = read_journal(jpath)
+        n_records = len(records)
+    res["records_after_crash"] = n_records
+    res["torn_tail"] = torn_tail
+    expect = crash_at if torn is not None else crash_at + 1
+    if n_records != expect:
+        res["outcome"] = "journal_mismatch"
+        res["expected_records"] = expect
+        return res
+
+    resumed = _spawn_child(job_dir, out, shape, metrics_out=metrics_out)
+    if resumed.returncode != 0:
+        res["outcome"] = "resume_failed"
+        res["rc"] = resumed.returncode
+        res["stderr"] = resumed.stderr[-2000:]
+        return res
+    with open(out) as fh:
+        final = fh.read()
+    with open(metrics_out) as fh:
+        counters = json.load(fh)["counters"]
+    res["chunks_replayed"] = counters.get("jobs.chunks_replayed", 0)
+    res["chunks_resumed"] = counters.get("range_chunks_resumed", 0)
+    res["outcome"] = "identical" if final == reference else "divergent"
+    if res["outcome"] == "identical" and res["chunks_replayed"] != n_records:
+        res["outcome"] = "replay_miscount"  # resumed run must reuse every commit
+    return res
+
+
+def run_grid(
+    base_seed: int,
+    points: int = 8,
+    n_pairs: int = 12,
+    chunk_size: int = 2,
+    receipts: int = 4,
+    events: int = 2,
+    match_rate: float = 0.2,
+    log=lambda msg: None,
+) -> dict:
+    """Seeded kill-point grid: half chunk-boundary kills, half torn
+    mid-record writes, kill indices drawn over the whole chunk range.
+    ``ok`` iff every point crashed, resumed, and reproduced the reference
+    byte-for-byte — and both kill flavors actually occurred."""
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+    shape = {
+        "pairs": n_pairs, "chunk_size": chunk_size,
+        "receipts": receipts, "events": events, "match_rate": match_rate,
+    }
+    n_chunks = (n_pairs + chunk_size - 1) // chunk_size
+    store, pairs, spec = _build_world(n_pairs, receipts, events, match_rate)
+    reference = generate_event_proofs_for_range_pipelined(
+        store, pairs, spec, chunk_size=chunk_size, scan_threads=2,
+        force_pipeline=True,
+    ).to_json()
+
+    rng = random.Random(base_seed)
+    kill_points = []
+    for i in range(points):
+        crash_at = rng.randrange(n_chunks - 1) if n_chunks > 1 else 0
+        if i % 2 == 0:
+            kill_points.append((crash_at, None))  # boundary kill
+        else:
+            # torn write: tear inside the 12-byte header or the payload
+            kill_points.append((crash_at, rng.choice([1, 5, 11, 13, 64, 4096])))
+
+    counts: dict[str, int] = {}
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="crashtest_") as workdir:
+        for i, (crash_at, torn) in enumerate(kill_points):
+            res = crash_run(reference, shape, crash_at, torn, workdir, tag=i)
+            counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+            if res["outcome"] != "identical":
+                violations.append(res)
+            log(
+                f"kill at record {crash_at}"
+                + (f" torn@{torn}B" if torn is not None else " (boundary)")
+                + f": {res['outcome']}"
+                + (
+                    f" ({res.get('records_after_crash')} committed, "
+                    f"{res.get('chunks_replayed')} replayed)"
+                    if "records_after_crash" in res else ""
+                )
+            )
+    boundary = sum(1 for _, t in kill_points if t is None)
+    ok = (
+        not violations
+        and boundary > 0
+        and boundary < len(kill_points)  # both flavors exercised
+    )
+    return {
+        "ok": ok,
+        "points": len(kill_points),
+        "kill_points": kill_points,
+        "n_chunks": n_chunks,
+        "counts": counts,
+        "violations": violations,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("seed", nargs="?", type=int, help="base seed for the kill grid")
+    ap.add_argument("--points", type=int, default=8, help="kill points to test")
+    ap.add_argument("--pairs", type=int, default=12)
+    ap.add_argument("--chunk-size", type=int, default=2)
+    ap.add_argument("--receipts", type=int, default=4)
+    ap.add_argument("--events", type=int, default=2)
+    ap.add_argument("--match-rate", type=float, default=0.2)
+    ap.add_argument("--quick", action="store_true", help="fewer kill points")
+    # --child: the forked driver entrypoint (internal)
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--job-dir", help=argparse.SUPPRESS)
+    ap.add_argument("--out", help=argparse.SUPPRESS)
+    ap.add_argument("--metrics-out", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        if not args.job_dir or not args.out:
+            ap.error("--child needs --job-dir and --out")
+        return child_main(args)
+    if args.seed is None:
+        ap.error("seed is required")
+
+    points = 4 if args.quick and args.points == 8 else args.points
+    t0 = time.time()
+    summary = run_grid(
+        args.seed, points=points, n_pairs=args.pairs,
+        chunk_size=args.chunk_size, receipts=args.receipts,
+        events=args.events, match_rate=args.match_rate,
+        log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+    )
+    print(json.dumps(summary, indent=2))
+    if not summary["ok"]:
+        print("CRASH-RECOVERY INVARIANT VIOLATED", file=sys.stderr)
+        return 1
+    print("CRASH RECOVERY CLEAN")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

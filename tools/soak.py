@@ -12,7 +12,7 @@ Usage:
     python tools/soak.py BASE_SEED [phase ...] [--quick]
 
 Phases (default: all): event storage shapes codec rleplus cert dagcbor
-header trees range json chaos. Every phase derives its seeds from
+header trees range json chaos crash. Every phase derives its seeds from
 BASE_SEED, so a NOTES entry of (base seed, phase) reproduces a run
 exactly.
 """
@@ -369,6 +369,26 @@ def phase_chaos(rng, quick):
     )
 
 
+def phase_crash(rng, quick):
+    # crash-recovery differential: SIGKILL the journaled range driver at
+    # fresh seeded kill points (chunk boundaries + torn mid-record writes),
+    # resume, and demand a bundle byte-identical to the uninterrupted run
+    # (tools/crashtest.py holds the harness)
+    import crashtest
+
+    summary = crashtest.run_grid(
+        rng.randrange(1 << 30),
+        points=4 if quick else 16,
+        n_pairs=8 if quick else 16,
+        log=log,
+    )
+    assert summary["ok"], summary
+    log(
+        f"crash recovery: {summary['points']} kill points over "
+        f"{summary['n_chunks']} chunks, all resumed byte-identical"
+    )
+
+
 PHASES = {
     "event": phase_event,
     "storage": phase_storage,
@@ -382,6 +402,7 @@ PHASES = {
     "range": phase_range,
     "json": phase_json,
     "chaos": phase_chaos,
+    "crash": phase_crash,
 }
 
 
