@@ -56,7 +56,7 @@ ACTOR = 1001
 
 LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
-    "witness", "resilience", "durability",
+    "witness", "resilience", "durability", "observability",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -71,6 +71,7 @@ _LEG_TIMEOUTS = {
     "witness": (300.0, 150.0),
     "resilience": (300.0, 150.0),
     "durability": (300.0, 150.0),
+    "observability": (300.0, 150.0),
 }
 
 
@@ -960,6 +961,95 @@ def _leg_durability(args) -> dict:
     }
 
 
+def _leg_observability(args) -> dict:
+    """Observability measurements (host-only, hermetic): what the trace
+    spine (`ipc_proofs_tpu/obs/`) costs when fully enabled:
+
+    - ``trace_overhead_pct`` — wall-clock cost of running the pipelined
+      range driver with the span collector enabled (every stage, RPC, and
+      journal span recorded) vs. the always-on default (flight ring
+      only). Off/on reps are interleaved and each side takes its best-of-4
+      so a load spike on a shared host lands on both sides instead of
+      biasing one; clamped at 0 because the delta is within scheduler
+      noise when the spine is doing its job. The budget is ≤ 3 %;
+    - ``spans_per_proof`` — spans recorded per event proof produced, the
+      tracing "weight" of one unit of useful work;
+    - ``observability_spans_recorded`` / ``observability_spans_dropped``
+      — collector totals for the traced run (drops mean the capacity
+      default is too small for this workload shape)."""
+    import gc
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.obs import disable_tracing, enable_tracing
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    n_pairs = 48 if args.quick else 96
+    chunk_size = 8 if args.quick else 16
+    bs, pairs, _ = build_range_world(
+        n_pairs, 48, 8, 0.1,
+        signature=SIG, topic1=TOPIC1, actor_id=ACTOR, base_height=60_000_000,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
+
+    def _run(metrics):
+        t0 = time.perf_counter()
+        bundle = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=chunk_size, metrics=metrics,
+            scan_threads=1, force_pipeline=True,
+        )
+        return bundle, time.perf_counter() - t0
+
+    disable_tracing()  # baseline = the always-on default (flight ring only)
+    _run(Metrics())  # warm (jit compile, extension load)
+    # interleave off/on reps: a load spike on a shared host hits both
+    # sides instead of biasing whichever mode happened to run during it
+    t_off = t_on = None
+    spans_recorded = spans_dropped = 0
+    bundle_off = bundle_on = None
+    try:
+        for _ in range(4):
+            gc.collect()
+            disable_tracing()
+            bundle_off, wall = _run(Metrics())
+            if t_off is None or wall < t_off:
+                t_off = wall
+            gc.collect()
+            m = Metrics()
+            enable_tracing(metrics=m)
+            bundle_on, wall = _run(m)
+            counters = m.snapshot()["counters"]
+            if t_on is None or wall < t_on:
+                t_on = wall
+                spans_recorded = counters.get("trace.spans_recorded", 0)
+                spans_dropped = counters.get("trace.spans_dropped", 0)
+    finally:
+        disable_tracing()
+    assert bundle_on.to_json() == bundle_off.to_json(), (
+        "traced bundle diverged from the untraced run"
+    )
+
+    n_proofs = len(bundle_on.event_proofs)
+    overhead_pct = max(0.0, 100.0 * (t_on - t_off) / t_off)
+    spans_per_proof = spans_recorded / n_proofs if n_proofs else None
+    _log(
+        f"bench: observability ({n_pairs} pairs, {n_proofs} proofs): trace "
+        f"overhead {overhead_pct:.2f}% ({t_on * 1000:.0f}ms traced vs "
+        f"{t_off * 1000:.0f}ms untraced), {spans_recorded} spans recorded "
+        f"({spans_dropped} dropped), {spans_per_proof:.1f} spans/proof"
+    )
+    return {
+        "trace_overhead_pct": round(overhead_pct, 2),
+        "spans_per_proof": (
+            round(spans_per_proof, 2) if spans_per_proof is not None else None
+        ),
+        "observability_spans_recorded": spans_recorded,
+        "observability_spans_dropped": spans_dropped,
+        "observability_pairs": n_pairs,
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -970,6 +1060,7 @@ _LEG_FNS = {
     "witness": _leg_witness,
     "resilience": _leg_resilience,
     "durability": _leg_durability,
+    "observability": _leg_observability,
 }
 
 
@@ -1255,6 +1346,8 @@ def _orchestrate(args) -> None:
     legs_status["resilience"] = status
     durability, status = _run_leg("durability", args, "cpu")
     legs_status["durability"] = status
+    observability, status = _run_leg("observability", args, "cpu")
+    legs_status["observability"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -1303,6 +1396,13 @@ def _orchestrate(args) -> None:
     )
     for k in _DURABILITY_KEYS:
         out[k] = (durability or {}).get(k)
+    _OBSERVABILITY_KEYS = (
+        "trace_overhead_pct", "spans_per_proof",
+        "observability_spans_recorded", "observability_spans_dropped",
+        "observability_pairs",
+    )
+    for k in _OBSERVABILITY_KEYS:
+        out[k] = (observability or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

@@ -27,10 +27,12 @@ from ipc_proofs_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 
-def _make_rpc_client(args):
+def _make_rpc_client(args, metrics=None):
     """Build the chain client: one `LotusClient`, or an `EndpointPool`
     across ``--endpoint`` + every ``--endpoints`` replica (failover,
-    circuit breakers, hedged fetches, per-endpoint integrity demotion)."""
+    circuit breakers, hedged fetches, per-endpoint integrity demotion).
+    ``metrics`` routes RPC/pool counters into the caller's registry
+    instead of each object's own private one."""
     from ipc_proofs_tpu.store.rpc import LotusClient
 
     endpoints = [args.endpoint] if args.endpoint else []
@@ -40,7 +42,9 @@ def _make_rpc_client(args):
     if not endpoints:
         raise ValueError("no RPC endpoint configured")
     clients = [
-        LotusClient(e, bearer_token=args.token, timeout_s=args.timeout)
+        LotusClient(
+            e, bearer_token=args.token, timeout_s=args.timeout, metrics=metrics
+        )
         for e in endpoints
     ]
     if len(clients) == 1:
@@ -55,6 +59,33 @@ def _make_rpc_client(args):
         clients,
         breaker_threshold=args.breaker_threshold,
         hedge_ms=args.hedge_ms,
+        metrics=metrics,
+    )
+
+
+def _start_tracing(args) -> bool:
+    """Enable the span collector when ``--trace-out`` was given."""
+    if not getattr(args, "trace_out", None):
+        return False
+    from ipc_proofs_tpu.obs import enable_tracing
+
+    enable_tracing()
+    return True
+
+
+def _finish_tracing(args) -> None:
+    """Export collected spans to ``--trace-out`` as Chrome trace JSON
+    (load at ui.perfetto.dev or chrome://tracing)."""
+    from ipc_proofs_tpu.obs import disable_tracing, get_collector, write_chrome_trace
+
+    collector = get_collector()
+    spans = collector.snapshot() if collector is not None else []
+    dropped = collector.dropped if collector is not None else 0
+    disable_tracing()
+    n = write_chrome_trace(args.trace_out, spans)
+    log.info(
+        "trace: %d events → %s%s", n, args.trace_out,
+        f" ({dropped} spans dropped at capacity)" if dropped else "",
     )
 
 
@@ -72,7 +103,8 @@ def _cmd_generate(args) -> int:
     from ipc_proofs_tpu.utils.metrics import get_metrics
 
     metrics = get_metrics()
-    client = _make_rpc_client(args)
+    tracing = _start_tracing(args)
+    client = _make_rpc_client(args, metrics=metrics)
 
     with metrics.stage("fetch_tipsets"):
         parent = Tipset.fetch(client, args.height)
@@ -119,6 +151,8 @@ def _cmd_generate(args) -> int:
     )
     if args.metrics:
         print(metrics.to_json(), file=sys.stderr)
+    if tracing:
+        _finish_tracing(args)
     return 0
 
 
@@ -198,7 +232,8 @@ def _cmd_range(args) -> int:
             return 2
 
     metrics = get_metrics()
-    client = _make_rpc_client(args)
+    tracing = _start_tracing(args)
+    client = _make_rpc_client(args, metrics=metrics)
 
     actor_id = None
     if args.contract:
@@ -269,6 +304,8 @@ def _cmd_range(args) -> int:
     )
     if args.metrics:
         print(metrics.to_json(), file=sys.stderr)
+    if tracing:
+        _finish_tracing(args)
     return 0
 
 
@@ -446,6 +483,12 @@ def _cmd_serve(args) -> int:
     from ipc_proofs_tpu.proofs.range import TipsetPair
     from ipc_proofs_tpu.proofs.trust import TrustPolicy
     from ipc_proofs_tpu.serve import ProofHTTPServer, ProofService, ServiceConfig
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    # the service owns its metrics registry (not the process-global one) so
+    # /metrics reflects exactly this server; the RPC client/pool feed it too
+    metrics = Metrics()
+    tracing = _start_tracing(args)
 
     store, pairs, spec = None, [], None
     if args.demo_world:
@@ -472,7 +515,7 @@ def _cmd_serve(args) -> int:
         if not (args.event_sig and args.topic1):
             log.error("--endpoint requires --event-sig and --topic1")
             return 2
-        client = _make_rpc_client(args)
+        client = _make_rpc_client(args, metrics=metrics)
         if isinstance(client, EndpointPool):
             endpoint_pool = client  # /healthz reports per-endpoint breakers
         tipsets = [
@@ -517,8 +560,10 @@ def _cmd_serve(args) -> int:
             verify_witness_cids=args.check_cids,
             range_scan_threads=args.scan_threads,
             range_pipeline_depth=args.pipeline_depth,
+            slow_request_ms=args.slow_ms,
         ),
         endpoint_pool=endpoint_pool,
+        metrics=metrics,
     )
     durable = None
     if args.queue_dir:
@@ -551,11 +596,16 @@ def _cmd_serve(args) -> int:
         log.info("draining (flushing accepted requests)…")
     finally:
         httpd.shutdown()
+        if tracing:
+            _finish_tracing(args)
     log.info("drained; final metrics:\n%s", json.dumps(service.metrics_snapshot()))
     return 0
 
 
 def main(argv=None) -> int:
+    from ipc_proofs_tpu.obs import install_crash_dump
+
+    install_crash_dump()  # unhandled errors dump the flight recorder
     parser = argparse.ArgumentParser(prog="ipc-proofs-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -601,6 +651,11 @@ def main(argv=None) -> int:
     )
     gen.add_argument("-o", "--output", default=None)
     gen.add_argument("--metrics", action="store_true")
+    gen.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="export all request/stage/RPC spans as Chrome trace-event "
+        "JSON (open at ui.perfetto.dev)",
+    )
     gen.set_defaults(fn=_cmd_generate)
 
     ver = sub.add_parser("verify", help="verify a saved bundle offline")
@@ -671,6 +726,12 @@ def main(argv=None) -> int:
         metavar="DIR",
         help="emit a jax.profiler trace of generation into DIR "
         "(TensorBoard/Perfetto format)",
+    )
+    rng.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="export all request/stage/RPC spans as Chrome trace-event "
+        "JSON (open at ui.perfetto.dev); unlike --profile this traces the "
+        "whole run — scans, RPC retries, journal fsyncs — not just XLA",
     )
     rng.set_defaults(fn=_cmd_range)
 
@@ -777,6 +838,16 @@ def main(argv=None) -> int:
         "DIR/queue.bin before execution, idempotency_key dedupes client "
         "retries, and admitted-but-unfinished requests re-execute on "
         "restart (/healthz reports resumed_jobs / journal_bytes)",
+    )
+    srv.add_argument(
+        "--slow-ms", type=float, default=1000.0,
+        help="log a WARNING with the request's full span tree when a "
+        "request takes longer than this end-to-end (default 1000)",
+    )
+    srv.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="export every request's spans as Chrome trace-event JSON on "
+        "shutdown (open at ui.perfetto.dev)",
     )
     srv.set_defaults(fn=_cmd_serve)
 

@@ -159,7 +159,14 @@ class JournalWriter:
             if self._metrics is not None:
                 self._metrics.count("jobs.journal_failures")
             return False
+        from ipc_proofs_tpu.obs.trace import span as _span
+
+        with _span("journal.append") as sp:
+            return self._append_framed(obj, sp)
+
+    def _append_framed(self, obj: Any, sp) -> bool:
         frame = _frame(encode_record(obj))
+        sp.set_attr("bytes", len(frame))
         if self._crash_at is not None and self._records == self._crash_at:
             self._crash(frame)
         self._records += 1

@@ -1,0 +1,65 @@
+"""End-to-end request observability: trace spine, Perfetto export,
+Prometheus exposition, and an always-on flight recorder.
+
+- `obs/trace.py`  — contextvar-propagated `TraceContext` + `span()`,
+  bounded `SpanCollector` (opt-in via `enable_tracing()` / `--trace-out`)
+- `obs/export.py` — Chrome trace-event JSON for ui.perfetto.dev
+- `obs/prom.py`   — Prometheus text exposition over `Metrics` snapshots
+- `obs/flight.py` — bounded ring of recent spans + WARN/ERROR log records,
+  served at `/debug/flight`, dumped to stderr on unhandled errors
+
+See README "Observability".
+"""
+
+from ipc_proofs_tpu.obs.export import (
+    chrome_trace_events,
+    chrome_trace_obj,
+    write_chrome_trace,
+)
+from ipc_proofs_tpu.obs.flight import (
+    FlightLogHandler,
+    FlightRecorder,
+    get_flight_recorder,
+    install_crash_dump,
+)
+from ipc_proofs_tpu.obs.prom import CONTENT_TYPE, render_prometheus
+from ipc_proofs_tpu.obs.trace import (
+    Span,
+    SpanCollector,
+    TraceContext,
+    current_context,
+    disable_tracing,
+    enable_tracing,
+    format_span_tree,
+    get_collector,
+    root_span,
+    span,
+    spans_for_trace,
+    tracing_enabled,
+    use_context,
+)
+
+__all__ = [
+    "CONTENT_TYPE",
+    "FlightLogHandler",
+    "FlightRecorder",
+    "Span",
+    "SpanCollector",
+    "TraceContext",
+    "chrome_trace_events",
+    "chrome_trace_obj",
+    "current_context",
+    "disable_tracing",
+    "enable_tracing",
+    "format_span_tree",
+    "get_collector",
+    "get_flight_recorder",
+    "install_crash_dump",
+    "render_prometheus",
+    "root_span",
+    "span",
+    "spans_for_trace",
+    "tracing_enabled",
+    "use_context",
+    "write_chrome_trace",
+]

@@ -1,0 +1,82 @@
+"""Chrome trace-event JSON export — load the file into ui.perfetto.dev (or
+chrome://tracing) and every worker thread gets its own lane of complete
+("ph":"X") events, with trace/span/parent ids in args for correlation.
+
+Format reference: the Trace Event Format doc (Google, "JSON Array Format"
+/ object form with a ``traceEvents`` key). We emit:
+  - one ``M`` (metadata) event per thread naming its lane, plus a process
+    name, and
+  - one ``X`` (complete) event per span with ``ts``/``dur`` in
+    microseconds on the monotonic clock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["chrome_trace_events", "chrome_trace_obj", "write_chrome_trace"]
+
+
+def chrome_trace_events(spans) -> list[dict]:
+    """Render spans (obs.trace.Span) as a Chrome trace-event list."""
+    pid = os.getpid()
+    events: list[dict] = [
+        {
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "name": "process_name",
+            "args": {"name": "ipc-proofs-tpu"},
+        }
+    ]
+    named_threads: set[int] = set()
+    for sp in spans:
+        tid = sp.thread_id or 0
+        if tid not in named_threads:
+            named_threads.add(tid)
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": sp.thread_name or f"thread-{tid}"},
+                }
+            )
+        args = {
+            "trace_id": sp.trace_id,
+            "span_id": sp.span_id,
+            "parent_id": sp.parent_id,
+        }
+        if sp.attrs:
+            args.update(sp.attrs)
+        events.append(
+            {
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "name": sp.name,
+                "cat": "span",
+                "ts": sp.ts_us,
+                "dur": max(1, sp.dur_us),  # Perfetto hides zero-width slices
+                "args": args,
+            }
+        )
+    return events
+
+
+def chrome_trace_obj(spans) -> dict:
+    return {
+        "traceEvents": chrome_trace_events(spans),
+        "displayTimeUnit": "ms",
+    }
+
+
+def write_chrome_trace(path: str, spans) -> int:
+    """Write the export; returns the number of span events written."""
+    obj = chrome_trace_obj(spans)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)
+        fh.write("\n")
+    return sum(1 for e in obj["traceEvents"] if e.get("ph") == "X")

@@ -277,23 +277,28 @@ class _OrderedEmitter:
         return True
 
 
-def _stage_worker(stage: PipelineStage, in_q, emit, cancel: _Cancel, metrics) -> None:
-    while True:
-        task = _get(in_q, cancel)
-        if task is _STOP:
-            return
-        seq, item = task
-        try:
-            if metrics is not None and stage.metrics_stage:
-                with metrics.stage(stage.metrics_stage):
+def _stage_worker(
+    stage: PipelineStage, in_q, emit, cancel: _Cancel, metrics, trace_ctx=None
+) -> None:
+    from ipc_proofs_tpu.obs.trace import use_context
+
+    with use_context(trace_ctx):
+        while True:
+            task = _get(in_q, cancel)
+            if task is _STOP:
+                return
+            seq, item = task
+            try:
+                if metrics is not None and stage.metrics_stage:
+                    with metrics.stage(stage.metrics_stage):
+                        result = stage.fn(item)
+                else:
                     result = stage.fn(item)
-            else:
-                result = stage.fn(item)
-        except BaseException as exc:  # noqa: BLE001 — must cancel on ANY failure
-            cancel.fail(exc)
-            return
-        if not emit(seq, result):
-            return
+            except BaseException as exc:  # noqa: BLE001 — must cancel on ANY failure
+                cancel.fail(exc)
+                return
+            if not emit(seq, result):
+                return
 
 
 def run_pipeline(
@@ -328,6 +333,13 @@ def run_pipeline(
     cancel = _Cancel()
     queues: list[queue.Queue] = [queue.Queue(maxsize=depth) for _ in range(len(stages) + 1)]
 
+    # the caller's TraceContext hops the bounded queues with the work:
+    # every stage worker thread re-installs it so spans opened inside
+    # stage fns (e.g. via metrics.stage) parent into the caller's trace
+    from ipc_proofs_tpu.obs.trace import current_context
+
+    trace_ctx = current_context()
+
     threads: list[threading.Thread] = []
     for i, stage in enumerate(stages):
         workers = max(1, int(stage.workers))
@@ -338,7 +350,7 @@ def run_pipeline(
         for w in range(workers):
             t = threading.Thread(
                 target=_stage_worker,
-                args=(stage, queues[i], emitter.emit, cancel, metrics),
+                args=(stage, queues[i], emitter.emit, cancel, metrics, trace_ctx),
                 name=f"pipeline-{stage.name}-{w}",
                 daemon=True,
             )

@@ -40,7 +40,7 @@ from ipc_proofs_tpu.proofs.generator import EventProofSpec
 from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.events import StampedEvent
 from ipc_proofs_tpu.store.blockstore import Blockstore, CachedBlockstore
-from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
 
 __all__ = [
     "TipsetPair",
@@ -214,7 +214,7 @@ def generate_event_proofs_for_range_chunked(
     """
     import os
 
-    metrics = metrics or Metrics()
+    metrics = metrics if metrics is not None else get_metrics()
     if checkpoint_dir is not None:
         os.makedirs(checkpoint_dir, exist_ok=True)
 
@@ -323,7 +323,7 @@ def generate_event_proofs_for_range(
     across the whole range). Slot-preimage keccaks hash ONCE range-wide;
     both proof kinds share one deduplicated witness.
     """
-    metrics = metrics or Metrics()
+    metrics = metrics if metrics is not None else get_metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
     matching_per_pair, native_ok = _scan_and_match(
@@ -732,7 +732,7 @@ def generate_event_proofs_for_range_pipelined(
     from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
     from ipc_proofs_tpu.store.rpc import RpcError
 
-    metrics = metrics or Metrics()
+    metrics = metrics if metrics is not None else get_metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
     chunks = [pairs[k : k + chunk_size] for k in range(0, len(pairs), chunk_size)]

@@ -36,6 +36,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ipc_proofs_tpu.obs.trace import current_context
 from ipc_proofs_tpu.utils.metrics import Metrics
 
 __all__ = [
@@ -71,14 +72,31 @@ class PendingResult:
     ``threading.Event`` + result/error pair rather than
     `concurrent.futures.Future` so completion stays allocation-light and
     the batcher controls exactly who may complete it.
+
+    Carries the submitter's `TraceContext` (``trace_ctx``) across the
+    queue hop so batch execution can parent its spans into the request's
+    trace, and the dispatch instant (``dispatched_at``) so the per-request
+    ``server_timing`` breakdown can attribute pure queue wait separately
+    from batch execution.
     """
 
-    __slots__ = ("payload", "deadline", "enqueued_at", "_done", "_result", "_error")
+    __slots__ = (
+        "payload",
+        "deadline",
+        "enqueued_at",
+        "dispatched_at",
+        "trace_ctx",
+        "_done",
+        "_result",
+        "_error",
+    )
 
     def __init__(self, payload, deadline: Optional[float], enqueued_at: float):
         self.payload = payload
         self.deadline = deadline  # absolute time.monotonic() instant, or None
         self.enqueued_at = enqueued_at
+        self.dispatched_at: Optional[float] = None
+        self.trace_ctx = None  # obs.trace.TraceContext captured at submit
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -166,6 +184,7 @@ class MicroBatcher:
                     retry_after_s=max(0.001, batches_ahead * self._avg_flush_s)
                 )
             pending = PendingResult(payload, deadline, now)
+            pending.trace_ctx = current_context()
             self._queue.append(pending)
             self._metrics.set_gauge(
                 f"serve.queue_depth.{self._name}", len(self._queue)
@@ -213,6 +232,7 @@ class MicroBatcher:
         now = time.monotonic()
         live: list[PendingResult] = []
         for pending in batch:
+            pending.dispatched_at = now
             if pending.deadline is not None and now > pending.deadline:
                 self._metrics.count(f"serve.deadline_exceeded.{self._name}")
                 pending.fail(

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import uuid
 from typing import Any, Optional, Sequence
 
@@ -148,6 +149,8 @@ class DurableAdmission:
                 "event_results": resp.event_results,
                 "all_valid": resp.all_valid(),
                 "batch_size": resp.batch_size,
+                "trace_id": resp.trace_id,
+                "server_timing": dict(resp.server_timing),
             }
         if kind == "generate":
             if not isinstance(payload, int) or not (0 <= payload < len(self.pairs)):
@@ -159,6 +162,8 @@ class DurableAdmission:
                 "bundle": resp.bundle.to_json_obj(),
                 "n_event_proofs": resp.n_event_proofs,
                 "batch_size": resp.batch_size,
+                "trace_id": resp.trace_id,
+                "server_timing": dict(resp.server_timing),
             }
         raise ValueError(f"unknown request kind {kind!r}")
 
@@ -208,11 +213,18 @@ class DurableAdmission:
             return key, flight.result, True
 
         # durable intent BEFORE execution: the ACK implies the journal has it
+        j0 = time.perf_counter()
         self._writer.append(
             {"t": "admit", "key": key, "kind": kind, "payload": payload}
         )
+        journal_ms = round((time.perf_counter() - j0) * 1e3, 3)
         try:
             result = self._execute(kind, payload, timeout_s=timeout_s)
+            # surface the admission fsync in this request's latency
+            # breakdown (the done-record append overlaps the response)
+            timing = result.get("server_timing")
+            if isinstance(timing, dict):
+                timing["journal_ms"] = journal_ms
         except _ADMISSION_ERRORS as exc:
             # never executed: leave the admit pending for restart replay,
             # release any coalesced waiters with the same failure

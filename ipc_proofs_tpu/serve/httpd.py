@@ -12,11 +12,20 @@ to the in-process API and serving errors to status codes:
   a production deployment would resolve pairs from its chain store).
 - ``GET /metrics``  → `utils/metrics.py` snapshot (stage timers, queue
   depths, batch sizes, p50/p90/p99 latency, rejection counters) as JSON.
+- ``GET /metrics.prom`` → the same snapshot in Prometheus text exposition
+  format (`obs/prom.py`) for a stock Prometheus scraper.
+- ``GET /debug/flight`` → the always-on flight recorder: last N completed
+  spans + recent WARN/ERROR log records (`obs/flight.py`).
 - ``GET /healthz``  → ``{"status": "ok" | "degraded" | "draining"}``; with
   an `EndpointPool` attached, ``"degraded"`` means some endpoint's circuit
   breaker is open/half-open and the body carries per-endpoint breaker
   state (still HTTP 200 — the service itself serves from what remains;
   draining stays 503).
+
+Every POST opens a trace root span (`obs/trace.py`) on the handler thread
+before admission, so batching/execution spans parent into the request's
+trace; 200 responses carry ``trace_id`` + ``server_timing`` in the body
+and a standards-shaped ``Server-Timing`` header.
 
 With a `DurableAdmission` queue attached (``serve --queue-dir``), POSTs
 route through the journal: the request is fsync'd before execution, an
@@ -36,6 +45,10 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
+from ipc_proofs_tpu.obs.flight import get_flight_recorder
+from ipc_proofs_tpu.obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
+from ipc_proofs_tpu.obs.prom import render_prometheus
+from ipc_proofs_tpu.obs.trace import root_span
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.proofs.range import TipsetPair
 from ipc_proofs_tpu.serve.batcher import (
@@ -73,6 +86,24 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, status: int, text: str, content_type: str):
+        body = text.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @staticmethod
+    def _server_timing_header(timing: dict) -> str:
+        """RFC-shaped Server-Timing value: ``queue;dur=1.2, verify;dur=3.4``
+        (metric names come from the server_timing dict, ``_ms`` stripped)."""
+        parts = []
+        for key, value in timing.items():
+            name = key[:-3] if key.endswith("_ms") else key
+            parts.append(f"{name};dur={value}")
+        return ", ".join(parts)
+
     def _read_json_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
         if length <= 0 or length > _MAX_BODY_BYTES:
@@ -87,6 +118,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/metrics":
             self._send_json(200, self.service.metrics_snapshot())
+        elif self.path == "/metrics.prom":
+            self._send_text(
+                200,
+                render_prometheus(self.service.metrics.snapshot()),
+                _PROM_CONTENT_TYPE,
+            )
+        elif self.path == "/debug/flight":
+            self._send_json(200, get_flight_recorder().snapshot())
         elif self.path == "/healthz":
             health = self.service.health()
             if self.durable is not None:
@@ -104,9 +143,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request body: {exc}"})
             return
         if self.path == "/v1/verify":
-            self._handle_verify(body)
+            # the root span opens BEFORE admission on this handler thread,
+            # so the batcher captures it and execution parents under it
+            with root_span("http.verify", {"path": self.path}):
+                self._handle_verify(body)
         elif self.path == "/v1/generate":
-            self._handle_generate(body)
+            with root_span("http.generate", {"path": self.path}):
+                self._handle_generate(body)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
 
@@ -128,6 +171,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "event_results": resp.event_results,
                 "all_valid": resp.all_valid(),
                 "batch_size": resp.batch_size,
+                "trace_id": resp.trace_id,
+                "server_timing": resp.server_timing,
             },
         )
 
@@ -152,6 +197,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "bundle": resp.bundle.to_json_obj(),
                 "n_event_proofs": resp.n_event_proofs,
                 "batch_size": resp.batch_size,
+                "trace_id": resp.trace_id,
+                "server_timing": resp.server_timing,
             },
         )
 
@@ -171,7 +218,11 @@ class _Handler(BaseHTTPRequestHandler):
         except RuntimeError as exc:
             self._send_json(400, {"error": str(exc)})
         else:
-            self._send_json(200, render(resp))
+            headers = None
+            timing = getattr(resp, "server_timing", None)
+            if timing:
+                headers = {"Server-Timing": self._server_timing_header(timing)}
+            self._send_json(200, render(resp), headers=headers)
 
     def _submit_durable(self, kind: str, payload, body: dict):
         """Route one request through the durable admission queue.

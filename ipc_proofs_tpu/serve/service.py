@@ -43,11 +43,19 @@ from ipc_proofs_tpu.proofs.range import (
     generate_event_proofs_for_range,
     generate_event_proofs_for_range_pipelined,
 )
+from ipc_proofs_tpu.obs.trace import (
+    format_span_tree,
+    spans_for_trace,
+    use_context,
+)
 from ipc_proofs_tpu.proofs.trust import TrustPolicy
 from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
 from ipc_proofs_tpu.serve.batcher import MicroBatcher, PendingResult
 from ipc_proofs_tpu.store.blockstore import BlockCache, CachedBlockstore
+from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.metrics import Metrics
+
+log = get_logger(__name__)
 
 __all__ = [
     "GenerateResponse",
@@ -74,6 +82,9 @@ class ServiceConfig:
     range_chunk_size: int = 8
     range_scan_threads: Optional[int] = None  # None → os.cpu_count()
     range_pipeline_depth: int = 2
+    # requests slower than this auto-log their span tree (flight ring) with
+    # trace_id correlation and bump the serve.slow_requests counter
+    slow_request_ms: float = 1000.0
 
 
 @dataclass
@@ -83,6 +94,11 @@ class VerifyResponse:
     storage_results: list[bool]
     event_results: list[bool]
     batch_size: int  # how many requests shared the replay (observability)
+    # per-request latency attribution (queue_ms / batch_wait_ms /
+    # verify_ms …), computed from this request's own timestamps — the
+    # components sum to the admission→completion wall
+    server_timing: dict = field(default_factory=dict)
+    trace_id: str = ""
 
     def all_valid(self) -> bool:
         return all(self.storage_results) and all(self.event_results)
@@ -94,6 +110,8 @@ class GenerateResponse:
 
     bundle: UnifiedProofBundle
     batch_size: int
+    server_timing: dict = field(default_factory=dict)
+    trace_id: str = ""
 
     @property
     def n_event_proofs(self) -> int:
@@ -258,6 +276,43 @@ class ProofService:
     def __exit__(self, *exc) -> None:
         self.drain()
 
+    # --- per-request latency attribution -----------------------------------
+
+    def _request_timing(
+        self, pending: PendingResult, exec_start: float, now: float, exec_key: str
+    ) -> dict:
+        """queue_ms (admission → batch dispatch) + batch_wait_ms (dispatch →
+        execution start on a worker) + <exec_key> (batch execution): the
+        components cover the admission→completion interval end to end."""
+        dispatched = pending.dispatched_at or exec_start
+        return {
+            "queue_ms": round(max(0.0, dispatched - pending.enqueued_at) * 1e3, 3),
+            "batch_wait_ms": round(max(0.0, exec_start - dispatched) * 1e3, 3),
+            exec_key: round(max(0.0, now - exec_start) * 1e3, 3),
+        }
+
+    def _maybe_log_slow(
+        self, pending: PendingResult, kind: str, total_ms: float, timing: dict
+    ) -> None:
+        if total_ms <= self.config.slow_request_ms:
+            return
+        self.metrics.count("serve.slow_requests")
+        trace_id = pending.trace_ctx.trace_id if pending.trace_ctx else ""
+        tree = ""
+        if trace_id:
+            spans = spans_for_trace(trace_id)
+            if spans:
+                tree = "\n" + format_span_tree(spans)
+        log.warning(
+            "slow %s request: %.1fms (threshold %.0fms) trace_id=%s timing=%s%s",
+            kind,
+            total_ms,
+            self.config.slow_request_ms,
+            trace_id or "-",
+            timing,
+            tree,
+        )
+
     # --- verify batching ---------------------------------------------------
 
     def _flush_verify(self, batch: list[PendingResult]) -> None:
@@ -290,6 +345,7 @@ class ProofService:
             remaining = deferred
 
     def _verify_merged(self, merged: list[PendingResult]) -> None:
+        exec_start = monotonic()
         storage_proofs: list = []
         event_proofs: list = []
         blocks: list[ProofBlock] = []
@@ -306,59 +362,74 @@ class ProofService:
                     blocks.append(b)
             spans.append((s0, len(storage_proofs), e0, len(event_proofs)))
 
-        with self.metrics.stage("serve.verify_batch"):
-            result = verify_proof_bundle(
-                UnifiedProofBundle(
-                    storage_proofs=storage_proofs,
-                    event_proofs=event_proofs,
-                    blocks=blocks,
-                ),
-                self._trust,
-                event_filter=self._event_filter,
-                verify_witness_cids=self.config.verify_witness_cids,
-            )
+        # the batch executes once, under the OLDEST member's trace: its
+        # request tree gets the full execution spans, while every member
+        # still gets its own server_timing/trace_id from its timestamps
+        with use_context(merged[0].trace_ctx):
+            with self.metrics.stage("serve.verify_batch"):
+                result = verify_proof_bundle(
+                    UnifiedProofBundle(
+                        storage_proofs=storage_proofs,
+                        event_proofs=event_proofs,
+                        blocks=blocks,
+                    ),
+                    self._trust,
+                    event_filter=self._event_filter,
+                    verify_witness_cids=self.config.verify_witness_cids,
+                )
         self.metrics.count("serve.batches.verify")
 
         now = monotonic()
+        slow: list[tuple[PendingResult, float, dict]] = []
         for pending, (s0, s1, e0, e1) in zip(merged, spans):
-            self.metrics.observe(
-                "serve.latency_ms.verify", (now - pending.enqueued_at) * 1e3
-            )
+            total_ms = (now - pending.enqueued_at) * 1e3
+            timing = self._request_timing(pending, exec_start, now, "verify_ms")
+            self.metrics.observe("serve.latency_ms.verify", total_ms)
             pending.complete(
                 VerifyResponse(
                     storage_results=result.storage_results[s0:s1],
                     event_results=result.event_results[e0:e1],
                     batch_size=len(merged),
+                    server_timing=timing,
+                    trace_id=(
+                        pending.trace_ctx.trace_id if pending.trace_ctx else ""
+                    ),
                 )
             )
+            if total_ms > self.config.slow_request_ms:
+                slow.append((pending, total_ms, timing))
+        for pending, total_ms, timing in slow:
+            self._maybe_log_slow(pending, "verify", total_ms, timing)
 
     # --- generate batching -------------------------------------------------
 
     def _flush_generate(self, batch: list[PendingResult]) -> None:
         """Deduplicate pairs → one range-driver call → split proofs by pair."""
+        exec_start = monotonic()
         unique: dict[tuple, TipsetPair] = {}
         for pending in batch:
             req: _GenerateRequest = pending.payload
             unique.setdefault(req.key, req.pair)
         pairs = list(unique.values())
 
-        with self.metrics.stage("serve.generate_batch"):
-            if len(pairs) > 1:
-                # multi-pair batch: stage-overlapped engine (bit-identical
-                # output; scan of later chunks overlaps recording)
-                bundle = generate_event_proofs_for_range_pipelined(
-                    self._store,
-                    pairs,
-                    self._spec,
-                    chunk_size=self.config.range_chunk_size,
-                    metrics=self.metrics,
-                    scan_threads=self.config.range_scan_threads,
-                    pipeline_depth=self.config.range_pipeline_depth,
-                )
-            else:
-                bundle = generate_event_proofs_for_range(
-                    self._store, pairs, self._spec, metrics=self.metrics
-                )
+        with use_context(batch[0].trace_ctx):
+            with self.metrics.stage("serve.generate_batch"):
+                if len(pairs) > 1:
+                    # multi-pair batch: stage-overlapped engine (bit-identical
+                    # output; scan of later chunks overlaps recording)
+                    bundle = generate_event_proofs_for_range_pipelined(
+                        self._store,
+                        pairs,
+                        self._spec,
+                        chunk_size=self.config.range_chunk_size,
+                        metrics=self.metrics,
+                        scan_threads=self.config.range_scan_threads,
+                        pipeline_depth=self.config.range_pipeline_depth,
+                    )
+                else:
+                    bundle = generate_event_proofs_for_range(
+                        self._store, pairs, self._spec, metrics=self.metrics
+                    )
         self.metrics.count("serve.batches.generate")
 
         by_key: dict[tuple, list] = {key: [] for key in unique}
@@ -372,11 +443,12 @@ class ProofService:
             by_key[child_block_to_key[proof.child_block_cid]].append(proof)
 
         now = monotonic()
+        slow: list[tuple[PendingResult, float, dict]] = []
         for pending in batch:
             req = pending.payload
-            self.metrics.observe(
-                "serve.latency_ms.generate", (now - pending.enqueued_at) * 1e3
-            )
+            total_ms = (now - pending.enqueued_at) * 1e3
+            timing = self._request_timing(pending, exec_start, now, "generate_ms")
+            self.metrics.observe("serve.latency_ms.generate", total_ms)
             pending.complete(
                 GenerateResponse(
                     bundle=UnifiedProofBundle(
@@ -385,8 +457,16 @@ class ProofService:
                         blocks=bundle.blocks,
                     ),
                     batch_size=len(batch),
+                    server_timing=timing,
+                    trace_id=(
+                        pending.trace_ctx.trace_id if pending.trace_ctx else ""
+                    ),
                 )
             )
+            if total_ms > self.config.slow_request_ms:
+                slow.append((pending, total_ms, timing))
+        for pending, total_ms, timing in slow:
+            self._maybe_log_slow(pending, "generate", total_ms, timing)
 
 
 def sequential_verify_baseline(

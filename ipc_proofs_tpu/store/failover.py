@@ -180,23 +180,27 @@ class EndpointPool:
         the chain has no such block, or raises: `IntegrityError` if every
         endpoint returned corrupt bytes, `RuntimeError` if every endpoint
         failed."""
+        from ipc_proofs_tpu.obs.trace import span as _span
+
         candidates = self._candidates()
-        if self.hedge_ms is not None and len(candidates) >= 2:
-            return self._hedged_read(cid, candidates)
-        last: Optional[Exception] = None
-        for ep in candidates:
-            if not self._begin_attempt(ep):
-                continue
-            try:
-                return self._read_one(ep, cid)
-            except Exception as exc:
-                last = exc
-                continue
-        if isinstance(last, IntegrityError):
-            raise last  # every endpoint returned corrupt bytes — say so
-        raise RuntimeError(
-            f"all {len(self._endpoints)} endpoints failed reading {cid}"
-        ) from last
+        with _span("pool.read") as sp:
+            if self.hedge_ms is not None and len(candidates) >= 2:
+                sp.set_attr("hedged", True)
+                return self._hedged_read(cid, candidates)
+            last: Optional[Exception] = None
+            for ep in candidates:
+                if not self._begin_attempt(ep):
+                    continue
+                try:
+                    return self._read_one(ep, cid)
+                except Exception as exc:
+                    last = exc
+                    continue
+            if isinstance(last, IntegrityError):
+                raise last  # every endpoint returned corrupt bytes — say so
+            raise RuntimeError(
+                f"all {len(self._endpoints)} endpoints failed reading {cid}"
+            ) from last
 
     # ------------------------------------------------------------------
     # health reporting
@@ -304,6 +308,12 @@ class EndpointPool:
         self._record_success(ep, self._clock() - t0)
         return data
 
+    def _read_one_traced(self, ctx, ep: EndpointState, cid: CID) -> Optional[bytes]:
+        from ipc_proofs_tpu.obs.trace import use_context
+
+        with use_context(ctx):
+            return self._read_one(ep, cid)
+
     def _hedge_delay_s(self) -> float:
         floor = (self.hedge_ms or 0.0) / 1000.0
         with self._lock:
@@ -332,7 +342,12 @@ class EndpointPool:
         if primary is None:
             raise RuntimeError(f"no endpoint admits a read for {cid}")
         pool = self._get_executor()
-        fut_primary = pool.submit(self._read_one, primary, cid)
+        # racer threads inherit the caller's trace context so their RPC
+        # spans stay inside the request's tree
+        from ipc_proofs_tpu.obs.trace import current_context
+
+        ctx = current_context()
+        fut_primary = pool.submit(self._read_one_traced, ctx, primary, cid)
         try:
             return fut_primary.result(timeout=self._hedge_delay_s())
         except FutureTimeoutError:
@@ -357,7 +372,7 @@ class EndpointPool:
             # nowhere to hedge to — just wait for the primary
             return fut_primary.result()
         self._metrics.count("rpc.hedges")
-        fut_hedge = pool.submit(self._read_one, secondary, cid)
+        fut_hedge = pool.submit(self._read_one_traced, ctx, secondary, cid)
         pending = {fut_primary, fut_hedge}
         last: Optional[Exception] = None
         while pending:

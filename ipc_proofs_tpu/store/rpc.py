@@ -163,31 +163,41 @@ class LotusClient:
         payload = {"jsonrpc": "2.0", "method": method, "params": params, "id": req_id}
         deadline = self.timeout_s if timeout_s is None else timeout_s
         last_err: Exception | None = None
-        for attempt in range(self.max_retries):
-            try:
-                resp = self._session.post(
-                    self.endpoint,
-                    data=json.dumps(payload),
-                    headers=self._headers,
-                    timeout=deadline,
-                )
-                resp.raise_for_status()
-                body = resp.json()
-                if "error" in body and body["error"] is not None:
-                    err = body["error"]
-                    raise RpcError(err.get("code", -1), err.get("message", "unknown"))
-                return body.get("result")
-            except RpcError as exc:
-                if not self._rpc_error_retryable(exc):
-                    raise  # semantic protocol errors are not retryable
-                last_err = exc
-                if attempt + 1 < self.max_retries:
-                    self._backoff(method, attempt, exc)
-            except Exception as exc:  # transport errors: retry with backoff
-                last_err = exc
-                if attempt + 1 < self.max_retries:
-                    self._backoff(method, attempt, exc)
-        self._metrics.count("rpc.failures")
+        from ipc_proofs_tpu.obs.trace import span as _span
+
+        # one span per RPC *call* (all attempts), parented by whatever
+        # request/stage context is ambient on the calling thread
+        with _span(f"rpc.{method}", {"endpoint": self.endpoint}) as sp:
+            for attempt in range(self.max_retries):
+                try:
+                    resp = self._session.post(
+                        self.endpoint,
+                        data=json.dumps(payload),
+                        headers=self._headers,
+                        timeout=deadline,
+                    )
+                    resp.raise_for_status()
+                    body = resp.json()
+                    if "error" in body and body["error"] is not None:
+                        err = body["error"]
+                        raise RpcError(err.get("code", -1), err.get("message", "unknown"))
+                    if attempt:
+                        sp.set_attr("retries", attempt)
+                    return body.get("result")
+                except RpcError as exc:
+                    if not self._rpc_error_retryable(exc):
+                        sp.set_attr("error", str(exc))
+                        raise  # semantic protocol errors are not retryable
+                    last_err = exc
+                    if attempt + 1 < self.max_retries:
+                        self._backoff(method, attempt, exc)
+                except Exception as exc:  # transport errors: retry with backoff
+                    last_err = exc
+                    if attempt + 1 < self.max_retries:
+                        self._backoff(method, attempt, exc)
+            self._metrics.count("rpc.failures")
+            sp.set_attr("retries", self.max_retries - 1)
+            sp.set_attr("error", str(last_err))
         raise RuntimeError(f"RPC {method} failed after {self.max_retries} attempts") from last_err
 
     def _rpc_error_retryable(self, exc: RpcError) -> bool:

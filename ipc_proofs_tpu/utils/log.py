@@ -10,21 +10,55 @@ subscriber, so its logs are dropped, and everything user-visible is ad-hoc
 
 Level comes from ``IPC_LOG_LEVEL`` (DEBUG/INFO/WARNING/ERROR, default
 INFO); output is one stderr line per record with timestamp, level and
-logger name. The handler attaches once to the `ipc_proofs` root, so
+logger name, or — with ``IPC_LOG_FORMAT=json`` — one JSON object per line
+carrying the active trace_id (obs/trace.py) so log lines correlate with
+exported spans. The handler attaches once to the `ipc_proofs` root, so
 applications embedding the library can replace it with their own handlers
-via standard `logging` configuration.
+via standard `logging` configuration. Regardless of which handler formats
+stderr, WARN/ERROR records are mirrored into the always-on flight
+recorder (obs/flight.py).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
+import time
 
-__all__ = ["get_logger"]
+__all__ = ["get_logger", "JsonLineFormatter"]
 
 _ROOT = "ipc_proofs"
 _configured = False
+
+
+class JsonLineFormatter(logging.Formatter):
+    """One JSON object per record: ts/level/logger/msg, the active trace_id
+    when a span is open on the emitting thread, and the exception text."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj: dict = {
+            "ts": round(record.created, 3),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)
+            ),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        try:  # lazy import: log is imported by everything, obs only here
+            from ipc_proofs_tpu.obs.trace import current_context
+
+            ctx = current_context()
+            if ctx is not None:
+                obj["trace_id"] = ctx.trace_id
+                obj["span_id"] = ctx.span_id
+        except Exception:
+            pass
+        if record.exc_info and record.exc_info[0] is not None:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj, separators=(",", ":"), default=str)
 
 
 def _configure() -> None:
@@ -33,23 +67,38 @@ def _configure() -> None:
         return
     _configured = True
     root = logging.getLogger(_ROOT)
+    # The flight recorder mirrors WARN/ERROR records regardless of how the
+    # embedding application configures formatting — it never writes to a
+    # stream, so it composes with any handler setup.
+    try:
+        from ipc_proofs_tpu.obs.flight import FlightLogHandler
+
+        root.addHandler(FlightLogHandler())
+    except Exception:
+        pass
     # Respect an embedding application's config: if the app configured
     # either the `ipc_proofs` logger or the process root logger (e.g.
     # logging.basicConfig), attach nothing and let records propagate
     # through its handlers. Only a genuinely unconfigured process gets the
     # library's own stderr handler + level default.
-    if root.handlers or logging.getLogger().handlers:
+    app_handlers = [
+        h for h in root.handlers if h.__class__.__name__ != "FlightLogHandler"
+    ]
+    if app_handlers or logging.getLogger().handlers:
         if "IPC_LOG_LEVEL" in os.environ:
             level = os.environ["IPC_LOG_LEVEL"].upper()
             root.setLevel(getattr(logging, level, logging.INFO))
         return
     handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(
-        logging.Formatter(
-            "%(asctime)s %(levelname)s %(name)s: %(message)s",
-            datefmt="%H:%M:%S",
+    if os.environ.get("IPC_LOG_FORMAT", "").lower() == "json":
+        handler.setFormatter(JsonLineFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s",
+                datefmt="%H:%M:%S",
+            )
         )
-    )
     root.addHandler(handler)
     level = os.environ.get("IPC_LOG_LEVEL", "INFO").upper()
     root.setLevel(getattr(logging, level, logging.INFO))

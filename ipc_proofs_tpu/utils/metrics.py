@@ -24,6 +24,7 @@ __all__ = [
     "get_metrics",
     "RESILIENCE_COUNTERS",
     "DURABILITY_COUNTERS",
+    "OBSERVABILITY_COUNTERS",
 ]
 
 # Counter vocabulary of the fault-tolerance layer (store/failover.py,
@@ -72,6 +73,35 @@ DURABILITY_COUNTERS = (
     "jobs.journal_failures",
     "serve.requests_replayed",
 )
+
+# Counter vocabulary of the observability layer (obs/trace.py,
+# serve/service.py slow-request detection):
+#   trace.spans_recorded — spans accepted by the active SpanCollector
+#   trace.spans_dropped  — spans discarded once the collector hit capacity
+#   serve.slow_requests  — serve requests whose wall exceeded the
+#                          slow-request threshold (their span tree is
+#                          auto-logged with trace_id correlation)
+OBSERVABILITY_COUNTERS = (
+    "trace.spans_recorded",
+    "trace.spans_dropped",
+    "serve.slow_requests",
+)
+
+# Lazily-bound obs.trace.span factory: `Metrics.stage()` opens a span per
+# outermost entry so every stage-timed site in the codebase is traced for
+# free. The import is deferred to first use to keep utils.metrics (imported
+# everywhere) free of an import cycle with obs.
+_span_factory = None
+
+
+def _stage_span(name: str):
+    global _span_factory
+    factory = _span_factory
+    if factory is None:
+        from ipc_proofs_tpu.obs.trace import span as factory
+
+        _span_factory = factory
+    return factory(name)
 
 
 @dataclass
@@ -161,6 +191,7 @@ class Metrics:
     counters: dict[str, int] = field(default_factory=dict)
     gauges: dict[str, float] = field(default_factory=dict)
     histograms: dict[str, Histogram] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _tls: threading.local = field(default_factory=threading.local, repr=False)
     # union wall across ALL stages (any-stage-active intervals)
@@ -183,27 +214,34 @@ class Metrics:
                 depths[name] -= 1
             return
         depths[name] = 1
-        start = time.perf_counter()
-        with self._lock:
-            timer = self.timers.setdefault(name, StageTimer())
-            if timer._active == 0:
-                timer._wall_start = start
-            timer._active += 1
-            if self._union_active == 0:
-                self._union_start = start
-            self._union_active += 1
         try:
-            yield
+            # every outermost stage entry is also a trace span: the span
+            # spine (obs/trace.py) gets stage lanes for free at every
+            # existing `metrics.stage(...)` site, parented by whatever
+            # TraceContext is ambient on this thread
+            with _stage_span(name):
+                start = time.perf_counter()
+                with self._lock:
+                    timer = self.timers.setdefault(name, StageTimer())
+                    if timer._active == 0:
+                        timer._wall_start = start
+                    timer._active += 1
+                    if self._union_active == 0:
+                        self._union_start = start
+                    self._union_active += 1
+                try:
+                    yield
+                finally:
+                    end = time.perf_counter()
+                    with self._lock:
+                        timer.add(end - start)
+                        timer._active -= 1
+                        if timer._active == 0:
+                            timer.wall_s += end - timer._wall_start
+                        self._union_active -= 1
+                        if self._union_active == 0:
+                            self.union_wall_s += end - self._union_start
         finally:
-            end = time.perf_counter()
-            with self._lock:
-                timer.add(end - start)
-                timer._active -= 1
-                if timer._active == 0:
-                    timer.wall_s += end - timer._wall_start
-                self._union_active -= 1
-                if self._union_active == 0:
-                    self.union_wall_s += end - self._union_start
             depths[name] -= 1
             if not depths[name]:
                 del depths[name]
@@ -246,6 +284,7 @@ class Metrics:
                     for k, v in self.timers.items()
                 },
                 "counters": dict(self.counters),
+                "uptime_s": round(time.time() - self.created_at, 3),
             }
             busy = sum(t.total_s for t in self.timers.values())
             if self.union_wall_s > 0:

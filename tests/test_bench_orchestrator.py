@@ -150,6 +150,14 @@ _DURABILITY_OK = {
     "durability_chunks": 6,
 }
 
+_OBSERVABILITY_OK = {
+    "trace_overhead_pct": 0.8,
+    "spans_per_proof": 0.1,
+    "observability_spans_recorded": 19,
+    "observability_spans_dropped": 0,
+    "observability_pairs": 48,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -178,6 +186,7 @@ class TestOrchestrate:
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
+            "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -192,6 +201,9 @@ class TestOrchestrate:
         assert out["integrity_overhead_pct"] == 1.2
         assert out["proofs_per_sec_at_fault_rate"] == 430.0
         assert out["durability_journal_overhead_pct"] == 3.5
+        assert out["legs"]["observability"] == "ok:cpu"
+        assert out["trace_overhead_pct"] == 0.8
+        assert out["spans_per_proof"] == 0.1
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -205,6 +217,7 @@ class TestOrchestrate:
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
+            "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -216,7 +229,7 @@ class TestOrchestrate:
             ("e2e", "default"), ("e2e", "cpu"), ("kernel", "cpu"),
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
             ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
-            ("durability", "cpu"),
+            ("durability", "cpu"), ("observability", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -230,6 +243,7 @@ class TestOrchestrate:
             "witness": [(dict(_WITNESS_OK), "ok:cpu")],
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
+            "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -274,6 +288,7 @@ class TestOrchestrate:
             "witness": [(None, "error:cpu")],
             "resilience": [(None, "error:cpu")],
             "durability": [(None, "error:cpu")],
+            "observability": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -285,6 +300,7 @@ class TestOrchestrate:
             "witness_reduction_pct", "integrity_overhead_pct",
             "proofs_per_sec_at_fault_rate", "recovery_ms",
             "durability_journal_overhead_pct", "durability_resume_ms",
+            "trace_overhead_pct", "spans_per_proof",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -1,0 +1,503 @@
+"""End-to-end observability (`ipc_proofs_tpu/obs/`): span parentage and
+contextvar propagation across pipeline workers and RPC retries, trace
+isolation under concurrent serving (one connected tree per request, no
+cross-request leakage), Perfetto/Chrome trace-event schema, strict
+Prometheus text-exposition parsing, server_timing accounting, the
+always-on flight recorder, JSON log lines, and the traceview summarizer.
+"""
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ipc_proofs_tpu.obs import (
+    FlightLogHandler,
+    chrome_trace_obj,
+    current_context,
+    disable_tracing,
+    enable_tracing,
+    get_collector,
+    get_flight_recorder,
+    render_prometheus,
+    root_span,
+    span,
+    spans_for_trace,
+    use_context,
+    write_chrome_trace,
+)
+from ipc_proofs_tpu.utils.metrics import OBSERVABILITY_COUNTERS, Metrics
+
+
+@pytest.fixture()
+def collector():
+    """Fresh opt-in span collector per test; always disabled after, and
+    the (global) flight ring cleared so tests can't see each other."""
+    get_flight_recorder().clear()
+    c = enable_tracing(metrics=Metrics())
+    try:
+        yield c
+    finally:
+        disable_tracing()
+        get_flight_recorder().clear()
+
+
+# --------------------------------------------------------------------------
+# span spine
+# --------------------------------------------------------------------------
+
+
+class TestSpanSpine:
+    def test_nested_spans_share_trace_and_parent(self, collector):
+        with span("outer") as outer:
+            with span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        spans = collector.snapshot()
+        assert [s.name for s in spans] == ["inner", "outer"]  # exit order
+        assert not spans[1].parent_id  # outer is the trace root
+
+    def test_root_span_forces_new_trace(self, collector):
+        with span("a") as a:
+            with root_span("b") as b:
+                assert b.trace_id != a.trace_id
+                assert not b.parent_id
+
+    def test_context_propagates_across_pipeline_workers(self, collector):
+        from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
+
+        def work(v):
+            with span("work"):
+                return v * 2
+
+        with root_span("job") as root:
+            out = run_pipeline(
+                list(range(16)),
+                [PipelineStage("double", work, workers=4)],
+            )
+        assert out == [v * 2 for v in range(16)]
+        works = [s for s in collector.snapshot() if s.name == "work"]
+        assert len(works) == 16
+        # every worker-thread span landed in the submitting trace
+        assert {s.trace_id for s in works} == {root.trace_id}
+        assert any(s.thread_id != root.thread_id for s in works)
+
+    def test_rpc_retry_span_records_retries(self, collector):
+        from tests.test_rpc_retry import _FlakySession, _client
+
+        client = _client(_FlakySession(fail_times=2, result="ok"), Metrics())
+        with root_span("req") as root:
+            assert client.request("Filecoin.Thing", []) == "ok"
+        rpc = [s for s in collector.snapshot() if s.name == "rpc.Filecoin.Thing"]
+        assert len(rpc) == 1
+        assert rpc[0].trace_id == root.trace_id
+        assert rpc[0].parent_id == root.span_id
+        assert rpc[0].attrs["retries"] == 2
+
+    def test_use_context_none_is_noop(self, collector):
+        with use_context(None):
+            assert current_context() is None
+
+
+# --------------------------------------------------------------------------
+# Perfetto / Chrome trace-event export
+# --------------------------------------------------------------------------
+
+
+def _make_spans(collector, n=3):
+    with root_span("root"):
+        for i in range(n):
+            with span(f"child{i}", {"i": i}):
+                pass
+    return collector.snapshot()
+
+
+class TestPerfettoExport:
+    def test_chrome_trace_schema(self, collector, tmp_path):
+        spans = _make_spans(collector)
+        path = tmp_path / "trace.json"
+        n = write_chrome_trace(str(path), spans)
+        assert n == len(spans)
+
+        obj = json.loads(path.read_text())
+        assert isinstance(obj["traceEvents"], list)
+        complete = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        meta = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+        assert len(complete) == len(spans)
+        assert {e["name"] for e in meta} >= {"process_name", "thread_name"}
+        for e in complete:
+            # the Chrome trace-event contract: name/ts/dur/pid/tid, µs ints
+            assert isinstance(e["name"], str) and e["name"]
+            assert isinstance(e["ts"], int) and e["ts"] >= 0
+            assert isinstance(e["dur"], int) and e["dur"] >= 1
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+            assert re.fullmatch(r"[0-9a-f]{16}", e["args"]["trace_id"])
+            assert e["args"]["span_id"]
+
+    def test_children_nest_inside_root_interval(self, collector):
+        spans = _make_spans(collector)
+        events = chrome_trace_obj(spans)["traceEvents"]
+        xs = {e["args"]["span_id"]: e for e in events if e["ph"] == "X"}
+        root = next(e for e in xs.values() if e["name"] == "root")
+        for e in xs.values():
+            if e["args"].get("parent_id") == root["args"]["span_id"]:
+                assert e["ts"] >= root["ts"]
+                assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition
+# --------------------------------------------------------------------------
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"  # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\""  # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\})?"  # more labels
+    r" -?[0-9.e+-]+(\.[0-9]+)?$"  # value
+)
+
+
+def _check_prom_text(text: str) -> "dict[str, str]":
+    """Strict 0.0.4 line-format check; returns {family: TYPE}."""
+    types: "dict[str, str]" = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) == 4, line
+        elif line.startswith("# TYPE "):
+            _, _, family, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "summary"), line
+            assert family not in types, f"duplicate TYPE for {family}"
+            types[family] = kind
+        else:
+            assert _PROM_SAMPLE.fullmatch(line), f"malformed sample: {line!r}"
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            family = re.sub(r"_(total|sum|count)$", "", name)
+            assert name in types or family in types, f"undeclared family: {line!r}"
+    return types
+
+
+class TestPrometheus:
+    def test_render_parses_strictly(self):
+        m = Metrics()
+        m.count("serve.requests", 3)
+        m.set_gauge("queue_depth", 7)
+        with m.stage("verify"):
+            pass
+        m.observe("latency_ms", 12.5)
+        m.observe("latency_ms", 2.0)
+        text = render_prometheus(m.snapshot())
+        types = _check_prom_text(text)
+        # classic 0.0.4: counter TYPE lines carry the full _total name
+        assert types["ipc_serve_requests_total"] == "counter"
+        assert "ipc_serve_requests_total 3" in text
+        assert types["ipc_uptime_seconds"] == "gauge"
+        assert 'ipc_stage_calls_total{stage="verify"} 1' in text
+        assert types["ipc_latency_ms"] == "summary"
+        assert 'quantile="0.99"' in text
+
+    def test_label_escaping(self):
+        m = Metrics()
+        with m.stage('we"ird\\stage'):
+            pass
+        _check_prom_text(render_prometheus(m.snapshot()))
+
+
+# --------------------------------------------------------------------------
+# concurrent serving: isolation + server_timing
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="class")
+def obs_server():
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.trust import TrustPolicy
+    from ipc_proofs_tpu.serve import ProofHTTPServer, ProofService, ServiceConfig
+
+    get_flight_recorder().clear()
+    collector = enable_tracing(metrics=Metrics())
+    sig, topic1 = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1"
+    store, pairs, _ = build_range_world(4, signature=sig, topic1=topic1)
+    svc = ProofService(
+        store=store,
+        spec=EventProofSpec(event_signature=sig, topic_1=topic1),
+        trust_policy=TrustPolicy.accept_all(),
+        config=ServiceConfig(max_batch=8, max_wait_ms=2.0, workers=2,
+                             queue_capacity=256),
+        metrics=Metrics(),
+    )
+    httpd = ProofHTTPServer(svc, port=0, pairs=pairs).start()
+    try:
+        yield httpd, collector
+    finally:
+        httpd.shutdown(timeout=10)
+        disable_tracing()
+        get_flight_recorder().clear()
+
+
+def _post(base, path, obj):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req) as resp:
+        body = json.load(resp)
+        header = resp.headers.get("Server-Timing")
+    return body, header, (time.perf_counter() - t0) * 1e3
+
+
+class TestServeTracing:
+    N = 32
+
+    def test_concurrent_requests_get_isolated_trees(self, obs_server):
+        httpd, collector = obs_server
+        results, errors = [], []
+
+        def one(i):
+            # 32 simultaneous connects can overflow the stdlib server's
+            # accept backlog → kernel RST; a client retry is the remedy
+            for attempt in range(3):
+                try:
+                    results.append(_post(httpd.address, "/v1/generate",
+                                         {"pair_index": i % 4}))
+                    return
+                except ConnectionResetError:
+                    time.sleep(0.05 * (attempt + 1))
+                except Exception as exc:  # noqa: BLE001 — surfaced below
+                    errors.append(exc)
+                    return
+            errors.append(ConnectionResetError(f"request {i}: 3 resets"))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(self.N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors and len(results) == self.N
+
+        trace_ids = [body["trace_id"] for body, _, _ in results]
+        assert len(set(trace_ids)) == self.N  # one fresh trace per request
+
+        spans = collector.snapshot()
+        by_trace = {}
+        for s in spans:
+            by_trace.setdefault(s.trace_id, []).append(s)
+        for tid in trace_ids:
+            tree = by_trace[tid]
+            ids = {s.span_id for s in tree}
+            roots = [s for s in tree if s.parent_id not in ids]
+            # exactly one connected tree: a single root (the http span),
+            # every other span's parent inside the same trace
+            assert len(roots) == 1, [s.name for s in roots]
+            assert roots[0].name == "http.generate"
+
+        for body, header, wall_ms in results:
+            timing = body["server_timing"]
+            assert set(timing) >= {"queue_ms", "batch_wait_ms", "generate_ms"}
+            assert all(v >= 0 for v in timing.values())
+            total = sum(timing.values())
+            # the accounted stages cover admission→completion, which the
+            # client-observed wall strictly contains (plus HTTP overhead)
+            assert total <= wall_ms * 1.1 + 10
+            assert header and "generate;dur=" in header
+
+    def test_single_request_timing_close_to_wall(self, obs_server):
+        httpd, _ = obs_server
+        body, _, wall_ms = _post(httpd.address, "/v1/generate", {"pair_index": 0})
+        total = sum(body["server_timing"].values())
+        assert total <= wall_ms  # accounted time can't exceed the wall
+        assert total >= wall_ms * 0.5  # …and covers the bulk of it
+
+    def test_flight_and_prom_endpoints(self, obs_server):
+        httpd, _ = obs_server
+        _post(httpd.address, "/v1/generate", {"pair_index": 0})
+        flight = json.load(
+            urllib.request.urlopen(f"{httpd.address}/debug/flight")
+        )
+        assert flight["spans"] and all("trace_id" in s for s in flight["spans"])
+        prom = urllib.request.urlopen(
+            f"{httpd.address}/metrics.prom"
+        ).read().decode()
+        types = _check_prom_text(prom)
+        assert types.get("ipc_serve_batches_generate_total") == "counter"
+        assert "ipc_uptime_seconds" in types
+
+
+# --------------------------------------------------------------------------
+# flight recorder + logs
+# --------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_always_on_even_without_collector(self):
+        disable_tracing()
+        fr = get_flight_recorder()
+        fr.clear()
+        with span("background"):
+            pass
+        snap = fr.snapshot()
+        assert [s["name"] for s in snap["spans"]] == ["background"]
+        fr.clear()
+
+    def test_warn_logs_captured_and_dumped(self, collector):
+        logger = logging.getLogger("ipc_proofs.test_obs")
+        logger.addHandler(FlightLogHandler())
+        try:
+            logger.warning("disk on fire")
+        finally:
+            logger.handlers.clear()
+        snap = get_flight_recorder().snapshot()
+        assert any("disk on fire" in l["msg"] for l in snap["logs"])
+
+        import io
+
+        buf = io.StringIO()
+        get_flight_recorder().dump(buf)
+        assert "disk on fire" in buf.getvalue()
+
+    def test_ring_is_bounded(self, collector):
+        fr = get_flight_recorder()
+        cap = fr.snapshot()["span_capacity"]
+        for i in range(cap + 50):
+            with span(f"s{i}"):
+                pass
+        assert len(fr.snapshot()["spans"]) == cap
+
+    def test_slow_request_logging(self):
+        from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+        from ipc_proofs_tpu.proofs.trust import TrustPolicy
+        from ipc_proofs_tpu.serve import ProofService, ServiceConfig
+
+        class _Capture(logging.Handler):
+            def __init__(self):
+                super().__init__(logging.WARNING)
+                self.messages: list[str] = []
+
+            def emit(self, record):
+                self.messages.append(record.getMessage())
+
+        get_flight_recorder().clear()
+        m = Metrics()
+        svc = ProofService(
+            trust_policy=TrustPolicy.accept_all(),
+            config=ServiceConfig(max_batch=2, max_wait_ms=1.0,
+                                 slow_request_ms=0.0),  # everything is slow
+            metrics=m,
+        )
+        bundle = UnifiedProofBundle(storage_proofs=[], event_proofs=[], blocks=[])
+        cap = _Capture()
+        logging.getLogger("ipc_proofs").addHandler(cap)
+        try:
+            with root_span("http.verify"):
+                resp = svc.verify(bundle)
+        finally:
+            logging.getLogger("ipc_proofs").removeHandler(cap)
+            svc.drain()
+        assert resp.trace_id
+        assert m.snapshot()["counters"]["serve.slow_requests"] >= 1
+        slow = [msg for msg in cap.messages if "slow verify" in msg]
+        assert slow and resp.trace_id in slow[0]
+
+
+class TestJsonLog:
+    def test_json_formatter_carries_trace_context(self, collector):
+        from ipc_proofs_tpu.utils.log import JsonLineFormatter
+
+        rec = logging.LogRecord(
+            "ipc_proofs.x", logging.WARNING, __file__, 1, "boom %d", (7,), None
+        )
+        with span("ctx") as sp:
+            line = JsonLineFormatter().format(rec)
+        obj = json.loads(line)
+        assert obj["msg"] == "boom 7"
+        assert obj["level"] == "WARNING"
+        assert obj["trace_id"] == sp.trace_id
+
+    def test_json_formatter_without_context(self):
+        from ipc_proofs_tpu.utils.log import JsonLineFormatter
+
+        rec = logging.LogRecord(
+            "ipc_proofs.x", logging.INFO, __file__, 1, "plain", (), None
+        )
+        obj = json.loads(JsonLineFormatter().format(rec))
+        assert "trace_id" not in obj
+
+
+# --------------------------------------------------------------------------
+# metrics additions
+# --------------------------------------------------------------------------
+
+
+class TestMetricsObservability:
+    def test_uptime_monotone(self):
+        m = Metrics()
+        snap = m.snapshot()
+        assert snap["uptime_s"] >= 0
+        time.sleep(0.01)
+        assert m.snapshot()["uptime_s"] >= snap["uptime_s"]
+
+    def test_observability_counters_registered(self, collector):
+        assert "trace.spans_recorded" in OBSERVABILITY_COUNTERS
+        assert "trace.spans_dropped" in OBSERVABILITY_COUNTERS
+        assert "serve.slow_requests" in OBSERVABILITY_COUNTERS
+        m = Metrics()
+        c = enable_tracing(metrics=m)
+        with span("counted"):
+            pass
+        assert m.snapshot()["counters"]["trace.spans_recorded"] == 1
+
+    def test_spans_for_trace_reads_flight_ring(self, collector):
+        with root_span("r") as root:
+            with span("c"):
+                pass
+        found = spans_for_trace(root.trace_id)
+        assert [s.name for s in found] == ["r", "c"]  # start-ordered
+
+
+# --------------------------------------------------------------------------
+# traceview
+# --------------------------------------------------------------------------
+
+
+class TestTraceview:
+    def test_summarize_critical_path(self, collector, tmp_path):
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            from traceview import load_events, summarize
+        finally:
+            sys.path.pop(0)
+
+        with root_span("req"):
+            with span("stage_a"):
+                with span("stage_b"):
+                    time.sleep(0.002)
+            with span("stage_c"):
+                pass
+        path = tmp_path / "t.json"
+        write_chrome_trace(str(path), collector.snapshot())
+
+        summary = summarize(load_events(str(path)))
+        assert summary["n_traces"] == 1
+        assert set(summary["stages"]) == {"req", "stage_a", "stage_b", "stage_c"}
+        trace = summary["traces"][0]
+        assert trace["root"] == "req"
+        # widest child at each hop: req → stage_a → stage_b
+        assert [h["name"] for h in trace["critical_path"]] == [
+            "req", "stage_a", "stage_b",
+        ]
+        assert all(h["self_us"] >= 0 for h in trace["critical_path"])
+        assert trace["widest"][0]["name"] == "req"
+        # stage totals reconcile with the raw spans
+        spans = {s.name: s.dur_us for s in collector.snapshot()}
+        assert summary["stages"]["stage_b"]["total_us"] == max(
+            1, spans["stage_b"]
+        )

@@ -88,6 +88,11 @@ _KNOWN_TYPES = {
     "durability_replay_chunks_per_sec": _NUM,
     "durability_journal_bytes": int,
     "durability_chunks": int,
+    "trace_overhead_pct": _NUM,
+    "spans_per_proof": _NUM,
+    "observability_spans_recorded": int,
+    "observability_spans_dropped": int,
+    "observability_pairs": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -111,6 +116,7 @@ _CURRENT_REQUIRED = (
     "durability_journal_overhead_pct", "durability_resume_ms",
     "durability_replay_chunks_per_sec", "durability_journal_bytes",
     "durability_chunks",
+    "trace_overhead_pct", "spans_per_proof",
     "legs", "watchdog_fallback",
 )
 

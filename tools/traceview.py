@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Offline summarizer for ``--trace-out`` Chrome trace JSON.
+
+Perfetto answers "show me everything"; this answers the two questions an
+operator actually asks a trace first, without leaving the terminal:
+
+- **where did the time go** — per-stage aggregate (count / total / mean /
+  max) over every complete span, plus each trace's *critical path*: the
+  chain from the root through its widest child at every level, with the
+  unattributed self-time gap at each hop;
+- **what was slow** — the top-5 widest spans per trace.
+
+Usage::
+
+    python tools/traceview.py trace.json            # human summary
+    python tools/traceview.py trace.json --json     # machine-readable
+    python tools/traceview.py trace.json --trace ID # one trace only
+
+The input is the Chrome trace-event JSON written by
+``ipc_proofs_tpu.obs.export.write_chrome_trace`` (``--trace-out`` on
+``generate`` / ``range`` / ``serve``); any trace-event file whose ``X``
+events carry ``args.trace_id`` / ``args.span_id`` works.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["load_events", "summarize", "main"]
+
+TOP_WIDEST = 5
+
+
+def load_events(path: str) -> "list[dict]":
+    """Complete (``ph == "X"``) events from a trace file; accepts both the
+    ``{"traceEvents": [...]}`` object form and a bare JSON array."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    events = obj.get("traceEvents", obj) if isinstance(obj, dict) else obj
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome trace-event file")
+    return [e for e in events if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+def _critical_path(root: dict, children: "dict[str, list[dict]]") -> "list[dict]":
+    """Root → widest child at every level. ``self_us`` is the hop's
+    unattributed gap: its duration minus the widest child's — time spent
+    in the span itself (or in siblings the path doesn't descend into)."""
+    path = []
+    node = root
+    while node is not None:
+        kids = children.get(node["args"]["span_id"], [])
+        widest = max(kids, key=lambda e: e.get("dur", 0), default=None)
+        path.append(
+            {
+                "name": node["name"],
+                "dur_us": node.get("dur", 0),
+                "self_us": node.get("dur", 0)
+                - (widest.get("dur", 0) if widest is not None else 0),
+            }
+        )
+        node = widest
+    return path
+
+
+def summarize(events: "list[dict]", trace_id: "str | None" = None) -> dict:
+    """Aggregate a list of ``X`` events (see `load_events`)."""
+    if trace_id is not None:
+        events = [e for e in events if e.get("args", {}).get("trace_id") == trace_id]
+
+    stages: "dict[str, dict]" = {}
+    for e in events:
+        st = stages.setdefault(
+            e["name"], {"count": 0, "total_us": 0, "max_us": 0}
+        )
+        st["count"] += 1
+        st["total_us"] += e.get("dur", 0)
+        st["max_us"] = max(st["max_us"], e.get("dur", 0))
+    for st in stages.values():
+        st["mean_us"] = round(st["total_us"] / st["count"], 1)
+
+    by_trace: "dict[str, list[dict]]" = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+
+    traces = []
+    for tid, evs in by_trace.items():
+        ids = {e["args"]["span_id"] for e in evs}
+        children: "dict[str, list[dict]]" = {}
+        roots = []
+        for e in evs:
+            parent = e["args"].get("parent_id")
+            if parent in ids:
+                children.setdefault(parent, []).append(e)
+            else:
+                roots.append(e)
+        root = max(roots, key=lambda e: e.get("dur", 0), default=None)
+        widest = sorted(evs, key=lambda e: e.get("dur", 0), reverse=True)
+        traces.append(
+            {
+                "trace_id": tid,
+                "spans": len(evs),
+                "root": root["name"] if root is not None else None,
+                "wall_us": root.get("dur", 0) if root is not None else None,
+                "critical_path": (
+                    _critical_path(root, children) if root is not None else []
+                ),
+                "widest": [
+                    {"name": e["name"], "dur_us": e.get("dur", 0)}
+                    for e in widest[:TOP_WIDEST]
+                ],
+            }
+        )
+    traces.sort(key=lambda t: t["wall_us"] or 0, reverse=True)
+    return {"n_events": len(events), "n_traces": len(traces), "stages": stages,
+            "traces": traces}
+
+
+def _fmt_us(us) -> str:
+    return f"{us / 1000:.2f}ms" if us is not None else "?"
+
+
+def _print_human(summary: dict) -> None:
+    print(f"{summary['n_events']} spans, {summary['n_traces']} traces")
+    print("\nper-stage totals (busiest first):")
+    order = sorted(
+        summary["stages"].items(), key=lambda kv: kv[1]["total_us"], reverse=True
+    )
+    for name, st in order:
+        print(
+            f"  {name:<28} x{st['count']:<5} total {_fmt_us(st['total_us']):>10}"
+            f"  mean {_fmt_us(st['mean_us']):>9}  max {_fmt_us(st['max_us']):>9}"
+        )
+    for t in summary["traces"]:
+        print(
+            f"\ntrace {t['trace_id']}  ({t['spans']} spans, "
+            f"root {t['root']}, wall {_fmt_us(t['wall_us'])})"
+        )
+        print("  critical path:")
+        for hop in t["critical_path"]:
+            print(
+                f"    {hop['name']:<28} {_fmt_us(hop['dur_us']):>10}"
+                f"  (self {_fmt_us(hop['self_us'])})"
+            )
+        print(f"  top-{TOP_WIDEST} widest:")
+        for w in t["widest"]:
+            print(f"    {w['name']:<28} {_fmt_us(w['dur_us']):>10}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="traceview", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("trace", help="Chrome trace JSON (--trace-out output)")
+    parser.add_argument("--trace-id", default=None, help="summarize one trace only")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    summary = summarize(load_events(args.trace), trace_id=args.trace_id)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        _print_human(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
