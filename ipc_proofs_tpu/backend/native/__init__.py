@@ -118,7 +118,7 @@ def load_dagcbor_ext():
                 if hasattr(module, "set_cid_class"):
                     module.set_cid_class(CID)
             _dagcbor_cached = module
-        except Exception:
+        except Exception:  # fail-soft: native codec is an optional accelerator — the pure-Python codec is the reference fallback
             _dagcbor_cached = None
         return _dagcbor_cached
 
@@ -146,7 +146,7 @@ def load_scan_ext():
             return None
         try:
             _scan_cached = _build_cpython_ext(_SCAN_SRC, _SCAN_SO, "ipc_scan_ext")
-        except Exception:
+        except Exception:  # fail-soft: no compiler / failed build → pure-Python scan path, bit-identical by contract
             _scan_cached = None
         return _scan_cached
 

@@ -1183,6 +1183,7 @@ done:
 }
 
 static PyObject *py_decode(PyObject *self, PyObject *arg) {
+  (void)self;
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
   Parser p = {(const uint8_t *)view.buf, view.len, 0, 0};
@@ -1385,7 +1386,8 @@ static PyMethodDef methods[] = {
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_dagcbor_ext",
-                                       "Fast DAG-CBOR decoder", -1, methods};
+                                       "Fast DAG-CBOR decoder", -1, methods,
+                                       NULL, NULL, NULL, NULL};
 
 PyMODINIT_FUNC PyInit_ipc_dagcbor_ext(void) {
   s_version = PyUnicode_InternFromString("version");

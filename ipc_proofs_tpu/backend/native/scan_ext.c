@@ -389,6 +389,8 @@ static int vec_reserve(Vec *v, size_t need) {
 }
 
 static int vec_push(Vec *v, const void *src, size_t n) {
+  /* empty source vecs have buf == NULL, and memcpy(dst, NULL, 0) is UB */
+  if (n == 0) return 0;
   if (vec_reserve(v, v->len + n) < 0) return -1;
   memcpy(v->buf + v->len, src, n);
   v->len += n;
@@ -1359,7 +1361,7 @@ typedef struct {
   int32_t pair_id;
 } RcptCtx;
 
-/* parse one receipt tuple; on success *has_ev/*ev_cid/*ev_len describe its
+/* parse one receipt tuple; on success *has_ev / *ev_cid / *ev_len describe its
  * events root (absent for 3-tuples and null links) */
 static int receipt_parse(Parser *p, const uint8_t **ev_cid, Py_ssize_t *ev_len,
                          int *has_ev) {
@@ -1669,6 +1671,7 @@ static PyObject *scan_result_dict(Scan *s) {
 
 static PyObject *py_scan_events_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
+  (void)self;
   PyObject *blocks, *roots, *fallback = Py_None;
   PyObject *match_fp_obj = Py_None, *match_actor_obj = Py_None;
   PyObject *snap_obj = Py_None;
@@ -1892,6 +1895,7 @@ static int sink_seen_grow(CidSink *sink) {
 }
 
 static int msg_leaf(Scan *s, Parser *p, int64_t index, void *ctx) {
+  (void)s;
   (void)index;
   CidSink *sink = (CidSink *)ctx;
   const uint8_t *cid;
@@ -1960,6 +1964,7 @@ static int txmeta_is_canonical(const uint8_t *raw, Py_ssize_t rlen,
 
 static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
                                         PyObject *kwargs) {
+  (void)self;
   PyObject *blocks, *groups, *fallback = Py_None, *snap_obj = Py_None;
   int headers = 1, want_touched = 1, validate_blocks = 0;
   static char *kwlist[] = {"blocks", "groups", "fallback", "headers",
@@ -1993,7 +1998,7 @@ static PyObject *py_collect_exec_orders(PyObject *self, PyObject *args,
     s.touch_off = &touch_off;
     s.touch_len = &touch_len;
   }
-  CidSink sink = {&msg_pool, &msg_off, &msg_len};
+  CidSink sink = {&msg_pool, &msg_off, &msg_len, NULL, 0, 0, 0};
 
   int rc = -1;
   for (Py_ssize_t g = 0; g < n_groups; g++) {
@@ -2207,6 +2212,7 @@ static void scan_rewind(Scan *s, const ScanMark *m) {
 
 static PyObject *py_record_receipt_paths(PyObject *self, PyObject *args,
                                          PyObject *kwargs) {
+  (void)self;
   PyObject *blocks, *roots, *wanted, *fallback = Py_None, *snap_obj = Py_None;
   static char *kwlist[] = {"blocks", "roots", "wanted", "fallback", "snapshot",
                            NULL};
@@ -2635,6 +2641,7 @@ static int hamt_get_one(Scan *s, const uint8_t *root, Py_ssize_t rlen,
 
 static PyObject *py_hamt_lookup_batch(PyObject *self, PyObject *args,
                                       PyObject *kwargs) {
+  (void)self;
   PyObject *blocks, *roots, *owners, *keys, *fallback = Py_None;
   PyObject *snap_obj = Py_None;
   int bit_width = 5, skip_missing = 0, want_touched = 0, validate_blocks = 0;
@@ -2958,6 +2965,7 @@ static int claim_buf(PyObject *obj, int itemsize, ClaimBuf *out,
 
 static PyObject *py_build_event_claims(PyObject *self, PyObject *args,
                                        PyObject *kwargs) {
+  (void)self;
   PyObject *strs, *rows_o, *group_o, *msgpos_o, *sbase_o, *nparents_o,
       *pepoch_o, *cepoch_o, *exec_o, *event_o, *emit_o, *ntop_o, *toff_o,
       *doff_o, *dlen_o, *proof_cls, *data_cls;
@@ -3188,6 +3196,7 @@ static int span_cmp(const void *a, const void *b) {
 
 static PyObject *py_materialize_blocks(PyObject *self, PyObject *args,
                                        PyObject *kwargs) {
+  (void)self;
   PyObject *blocks, *todo, *make_cids, *cls;
   PyObject *fallback = Py_None, *snap_obj = Py_None;
   static char *kwlist[] = {"blocks", "todo",     "make_cids", "cls",
@@ -3475,7 +3484,7 @@ static PyMethodDef methods[] = {
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "ipc_scan_ext",
                                        "Native receipts/events AMT scanner",
-                                       -1, methods};
+                                       -1, methods, NULL, NULL, NULL, NULL};
 
 PyMODINIT_FUNC PyInit_ipc_scan_ext(void) {
   PyObject *m = PyModule_Create(&moduledef);

@@ -86,7 +86,7 @@ class TpuBackend:
                     probe = jnp.zeros((TILE, 17), jnp.uint32)
                     np.asarray(keccak256_single_block_pallas(probe, probe))
                     self._pallas_ok = True
-                except Exception:  # Mosaic rejection / unsupported runtime
+                except Exception:  # fail-soft: Mosaic rejection / unsupported runtime — XLA kernel path takes over, same digests
                     self._pallas_ok = False
         return self._pallas_ok
 
@@ -173,7 +173,7 @@ class TpuBackend:
                 digests = blake2b256_two_block_pallas(
                     jnp.asarray(m_lo), jnp.asarray(m_hi), jnp.asarray(lengths)
                 )
-            except Exception:  # Mosaic rejection — use the XLA kernel
+            except Exception:  # fail-soft: Mosaic rejection — the XLA kernel computes the same digests
                 self._pallas_two_block_ok = False
             else:
                 self._pallas_two_block_ok = True

@@ -22,7 +22,7 @@ import subprocess
 import threading
 from pathlib import Path
 
-__all__ = ["load", "build_cpython_ext", "host_build_id", "BUILD_DIR"]
+__all__ = ["load", "build_cpython_ext", "host_build_id", "BUILD_DIR", "SAN_FLAGS"]
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "backend" / "native"
 BUILD_DIR = _NATIVE_DIR / "build"
@@ -31,6 +31,18 @@ _DAGCBOR_SO = BUILD_DIR / "ipc_dagcbor_ext.so"
 
 _lock = threading.Lock()
 _cached: "object | None | bool" = False  # False = not attempted yet
+
+# sanitizer build profile (tools/build_native_san.py sets IPC_PROOFS_SAN=1):
+# ASan+UBSan with the warning set promoted to errors, frame pointers kept
+# for usable reports
+SAN_FLAGS = (
+    "-fsanitize=address,undefined",
+    "-fno-omit-frame-pointer",
+    "-g",
+    "-Wall",
+    "-Wextra",
+    "-Werror",
+)
 
 
 def host_build_id() -> str:
@@ -61,6 +73,11 @@ def build_cpython_ext(src: Path, so: Path, mod_name: str):
     import sysconfig
 
     BUILD_DIR.mkdir(exist_ok=True)
+    # sanitized builds live under distinct names (.san.so + own host stamp)
+    # so they never collide with the fast-path cache of the same source
+    sanitize = bool(os.environ.get("IPC_PROOFS_SAN"))
+    if sanitize:
+        so = so.with_name(so.name[: -len(so.suffix)] + ".san" + so.suffix)
     stamp = so.with_suffix(so.suffix + ".host")
     host_id = host_build_id()
     cached = (
@@ -73,6 +90,8 @@ def build_cpython_ext(src: Path, so: Path, mod_name: str):
         include = sysconfig.get_paths()["include"]
         base = ["gcc", "-O3", "-shared", "-fPIC", "-pthread", f"-I{include}",
                 str(src), "-o", str(so)]
+        if sanitize:
+            base[1:1] = list(SAN_FLAGS)
         try:
             # host-tuned codegen measurably helps the scan parse loop;
             # retry portable if the toolchain rejects -march=native
@@ -101,6 +120,6 @@ def load():
             return None
         try:
             _cached = build_cpython_ext(_DAGCBOR_SRC, _DAGCBOR_SO, "ipc_dagcbor_ext")
-        except Exception:
+        except Exception:  # fail-soft: no toolchain → pure-Python CID/codec, bit-identical by contract
             _cached = None
         return _cached

@@ -299,7 +299,7 @@ def _bind_native_cid():
         import ipc_proofs_tpu.core._cid_native as _cid_native
 
         ext = _cid_native.load()  # honors IPC_PROOFS_NO_NATIVE itself
-    except Exception:
+    except Exception:  # fail-soft: import/build failure keeps the pure-Python CID class, bit-identical by contract
         return None
     return getattr(ext, "CID", None) if ext is not None else None
 

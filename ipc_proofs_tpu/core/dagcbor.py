@@ -209,7 +209,7 @@ def _resolve_native():
         # load_dagcbor_ext registers the CID factory/class hooks itself —
         # that loader is the single registration site
         _native = load_dagcbor_ext()
-    except Exception:
+    except Exception:  # fail-soft: native codec unavailable → pure-Python encoder/decoder, bit-identical by contract
         _native = None
     return _native
 

@@ -122,6 +122,7 @@ class RangeJob:
     def commit_chunk(self, index: int, digest: "str | None", bundle, verify=None) -> bool:
         """Durably record chunk ``index`` as complete (fail-soft)."""
         t0 = time.thread_time()
+        w0 = time.perf_counter()
         rec = {
             "t": "chunk",
             "chunk": index,
@@ -131,28 +132,35 @@ class RangeJob:
         }
         ok = self._writer.append(rec)
         self.completed[index] = rec
-        self._commit_done(t0)
+        self._commit_done(t0, w0)
         return ok
 
     def commit_verdict(self, index: int, digest: "str | None", verify) -> bool:
         """Attach a verify verdict to an already-committed chunk."""
         t0 = time.thread_time()
+        w0 = time.perf_counter()
         ok = self._writer.append(
             {"t": "verdict", "chunk": index, "digest": digest, "verify": verify}
         )
         if index in self.completed:
             self.completed[index]["verify"] = verify
-        self._commit_done(t0)
+        self._commit_done(t0, w0)
         return ok
 
-    def _commit_done(self, t0: float) -> None:
-        # thread CPU time, not wall clock: commits run in the pipelined
-        # driver's record stage, where wall time would also count GIL/IO
-        # waits spent productively scanning the NEXT chunk — CPU time is
-        # the part a commit actually steals from compute
+    def _commit_done(self, t0: float, w0: float) -> None:
+        # Two clocks on purpose. jobs.commit_us is thread CPU time:
+        # commits run in the pipelined driver's record stage, where wall
+        # time would also count GIL/IO waits spent productively scanning
+        # the NEXT chunk — CPU time is the part a commit actually steals
+        # from compute. jobs.chunk_journal_us is wall time: the fsync
+        # latency a waiting request experiences, surfaced per-request as
+        # `journal_ms` in the serve plane's Server-Timing breakdown.
         if self._metrics is not None:
             self._metrics.count(
                 "jobs.commit_us", int((time.thread_time() - t0) * 1e6)
+            )
+            self._metrics.count(
+                "jobs.chunk_journal_us", int((time.perf_counter() - w0) * 1e6)
             )
         self._update_gauge()
 

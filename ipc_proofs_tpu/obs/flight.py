@@ -37,9 +37,9 @@ class FlightRecorder:
         span_capacity: int = DEFAULT_SPAN_CAPACITY,
         log_capacity: int = DEFAULT_LOG_CAPACITY,
     ):
-        self._spans: deque = deque(maxlen=span_capacity)
-        self._logs: deque = deque(maxlen=log_capacity)
         self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=span_capacity)  # guarded-by: _lock
+        self._logs: deque = deque(maxlen=log_capacity)  # guarded-by: _lock
 
     def record_span(self, sp) -> None:
         with self._lock:
@@ -54,9 +54,10 @@ class FlightRecorder:
         with self._lock:
             spans = [sp.to_dict() for sp in self._spans]
             logs = [dict(e) for e in self._logs]
+            capacity = self._spans.maxlen
         return {
             "captured_at": round(time.time(), 3),
-            "span_capacity": self._spans.maxlen,
+            "span_capacity": capacity,
             "spans": spans,
             "logs": logs,
         }
@@ -85,7 +86,7 @@ class FlightRecorder:
                 )
             stream.write("---- end flight recorder ----\n")
             stream.flush()
-        except Exception:
+        except Exception:  # fail-soft: the crash dump runs inside an excepthook — it must never mask the original crash
             pass
 
 
@@ -119,7 +120,7 @@ class FlightLogHandler(logging.Handler):
                     )
                 ).strip()
             self._recorder.record_log(entry)
-        except Exception:  # a diagnostic channel must never take the app down
+        except Exception:  # fail-soft: a diagnostic channel must never take the app down
             pass
 
 

@@ -125,9 +125,9 @@ class SpanCollector:
 
     def __init__(self, capacity: int = 100_000, metrics=None):
         self.capacity = capacity
-        self._spans: list[Span] = []
-        self._dropped = 0
         self._lock = threading.Lock()
+        self._spans: list[Span] = []  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
         self._metrics = metrics
 
     def record(self, sp: Span) -> None:

@@ -210,7 +210,7 @@ class _Cancel:
     def __init__(self):
         self._event = threading.Event()
         self._lock = threading.Lock()
-        self.exc: Optional[BaseException] = None
+        self.exc: Optional[BaseException] = None  # guarded-by: _lock
 
     def fail(self, exc: BaseException) -> None:
         with self._lock:
@@ -256,8 +256,8 @@ class _OrderedEmitter:
 
     def __init__(self, n_items: int, out_q: "queue.Queue", n_stops: int, cancel: _Cancel):
         self._lock = threading.Lock()
-        self._buffer: dict[int, Any] = {}
-        self._next = 0
+        self._buffer: dict[int, Any] = {}  # guarded-by: _lock
+        self._next = 0  # guarded-by: _lock
         self._n = n_items
         self._out_q = out_q
         self._n_stops = n_stops  # sentinels owed downstream (0 = caller-consumed)
@@ -294,7 +294,7 @@ def _stage_worker(
                         result = stage.fn(item)
                 else:
                     result = stage.fn(item)
-            except BaseException as exc:  # noqa: BLE001 — must cancel on ANY failure
+            except BaseException as exc:  # fail-soft: worker strands no one — the failure cancels the pipeline and re-raises in the driver
                 cancel.fail(exc)
                 return
             if not emit(seq, result):
@@ -404,5 +404,5 @@ def _drain_cancelled(stages: "list[PipelineStage]", queues: "list[queue.Queue]")
             _seq, item = task
             try:
                 stage.fn(item)
-            except BaseException:  # noqa: BLE001 — salvage is best-effort
+            except BaseException:  # fail-soft: drain-after-cancel salvage — the original failure is already propagating to the driver
                 pass

@@ -116,7 +116,7 @@ def _native_exec_orders(
             validate_blocks=validate_blocks,
             **_snap_kw(store, raw, len(groups)),
         )
-    except Exception:
+    except Exception:  # fail-soft: native walker is an accelerator — None routes to the scalar walker, bit-identical by contract
         return None
 
 

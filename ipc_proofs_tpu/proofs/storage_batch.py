@@ -115,7 +115,7 @@ def generate_storage_proofs_batch(
     # Phase 3: one state-tree walk per distinct contract.
     with metrics.stage("actor_walks"):
         contract_info: dict[int, tuple] = {}
-        for actor_id in {s.actor_id for s in specs}:
+        for actor_id in sorted({s.actor_id for s in specs}):
             recorder = RecordingBlockstore(cached)
             actor = get_actor_state(recorder, parent_state_root, Address.new_id(actor_id))
             evm_state_raw = recorder.get(actor.state)
@@ -202,7 +202,8 @@ def generate_storage_proofs_for_pairs(
     # Phase B: unique state roots → actors roots (StateRoot block is part
     # of the witness; missing → the scalar get_actor_state KeyError).
     actors_root: dict[CID, CID] = {}
-    for psr in set(pair_psr):
+    # dict.fromkeys = dedup in first-seen pair order (set order is salted)
+    for psr in dict.fromkeys(pair_psr):
         raw = cached.get(psr)
         if raw is None:
             raise KeyError(f"missing StateRoot {psr}")

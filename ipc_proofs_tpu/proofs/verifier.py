@@ -58,13 +58,13 @@ def verify_proof_bundle(
     def child_verifier(epoch, cid):
         try:
             return trust_policy.verify_child_header(epoch, cid)
-        except Exception:
+        except Exception:  # fail-soft: a throwing trust policy is a rejection — the proof verdict reports invalid, never crashes verify
             return False
 
     def parent_verifier(epoch, cids):
         try:
             return trust_policy.verify_parent_tipset(epoch, cids)
-        except Exception:
+        except Exception:  # fail-soft: a throwing trust policy is a rejection — the proof verdict reports invalid, never crashes verify
             return False
 
     # Storage proofs: batched replay when the native HAMT walker is

@@ -151,13 +151,13 @@ class MicroBatcher:
         self._name = name
         self._metrics = metrics if metrics is not None else Metrics()
         self._executor = executor
-        self._queue: deque[PendingResult] = deque()
         self._cond = threading.Condition()
-        self._closed = False
+        self._queue: deque[PendingResult] = deque()  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         # EWMA of recent flush wall times, seeding the retry-after hint for
         # rejected requests: "queue depth / batch size" flushes still ahead
         # of you, each costing roughly this long
-        self._avg_flush_s = self._max_wait_s
+        self._avg_flush_s = self._max_wait_s  # guarded-by: _cond
         self._thread = threading.Thread(
             target=self._run, name=f"micro-batcher-{name}", daemon=True
         )
@@ -255,13 +255,14 @@ class MicroBatcher:
         start = time.monotonic()
         try:
             self._flush_fn(batch)
-        except BaseException as exc:  # noqa: BLE001 — strand no caller
+        except BaseException as exc:  # fail-soft: strand no caller — the error reaches every waiter via pending.fail()
             for pending in batch:
                 if not pending.done():
                     pending.fail(exc)
         finally:
             elapsed = time.monotonic() - start
-            self._avg_flush_s = 0.8 * self._avg_flush_s + 0.2 * elapsed
+            with self._cond:
+                self._avg_flush_s = 0.8 * self._avg_flush_s + 0.2 * elapsed
             for pending in batch:
                 if not pending.done():
                     pending.fail(
@@ -289,4 +290,5 @@ class MicroBatcher:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed

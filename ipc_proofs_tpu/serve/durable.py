@@ -83,8 +83,9 @@ class DurableAdmission:
         os.makedirs(queue_dir, exist_ok=True)
         self._path = os.path.join(queue_dir, QUEUE_JOURNAL_NAME)
         self._lock = threading.Lock()
-        self._results: "dict[str, dict]" = {}  # key → rendered done payload
-        self._inflight: "dict[str, _Inflight]" = {}
+        # key → rendered done payload
+        self._results: "dict[str, dict]" = {}  # guarded-by: _lock
+        self._inflight: "dict[str, _Inflight]" = {}  # guarded-by: _lock
         self.resumed_jobs = 0  # admitted-but-unfinished requests re-executed
 
         pending: "list[dict]" = []
@@ -132,7 +133,7 @@ class DurableAdmission:
             try:
                 result = self._execute(kind, payload, timeout_s=None)
                 done = {"ok": True, "result": result}
-            except Exception as exc:  # noqa: BLE001 — replay must terminate
+            except Exception as exc:  # fail-soft: replay must terminate — a poison request journals as an error result, not a restart crash-loop
                 # any failure (even admission) finishes with an error here:
                 # a poison request must not crash-loop every restart
                 done = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
@@ -233,7 +234,7 @@ class DurableAdmission:
             flight.error = exc
             flight.event.set()
             raise
-        except Exception as exc:  # noqa: BLE001 — semantic failure: cache it
+        except Exception as exc:  # fail-soft: semantic failure — journalled as the request's durable (idempotent) error result
             done = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
             self._finish(key, done)
             return key, done, False

@@ -297,7 +297,9 @@ class ProofHTTPServer:
         self._httpd.serve_forever(poll_interval=0.1)
 
     def start(self) -> "ProofHTTPServer":
-        self._thread = threading.Thread(
+        # start()/shutdown() are owner-thread lifecycle calls with a
+        # happens-before edge through Thread.start()/join(); no lock needed
+        self._thread = threading.Thread(  # ipclint: disable=race-unannotated
             target=self.serve_forever, name="proof-httpd", daemon=True
         )
         self._thread.start()

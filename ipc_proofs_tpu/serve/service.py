@@ -31,6 +31,8 @@ a merge is judged under the same policy.
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -41,6 +43,7 @@ from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
 from ipc_proofs_tpu.proofs.range import (
     TipsetPair,
     generate_event_proofs_for_range,
+    generate_event_proofs_for_range_chunked,
     generate_event_proofs_for_range_pipelined,
 )
 from ipc_proofs_tpu.obs.trace import (
@@ -82,6 +85,10 @@ class ServiceConfig:
     range_chunk_size: int = 8
     range_scan_threads: Optional[int] = None  # None → os.cpu_count()
     range_pipeline_depth: int = 2
+    # write-ahead journal dir for generate batches: chunk commits become
+    # durable/resumable and each response's Server-Timing grows a
+    # `journal_ms` entry (wall time spent fsyncing chunk records)
+    range_job_dir: Optional[str] = None
     # requests slower than this auto-log their span tree (flight ring) with
     # trace_id correlation and bump the serve.slow_requests counter
     slow_request_ms: float = 1000.0
@@ -171,8 +178,8 @@ class ProofService:
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )
-        self._drained = False
         self._drain_lock = threading.Lock()
+        self._drained = False  # guarded-by: _drain_lock
         self._verify_batcher = MicroBatcher(
             self._flush_verify,
             max_batch=self.config.max_batch,
@@ -403,6 +410,22 @@ class ProofService:
 
     # --- generate batching -------------------------------------------------
 
+    def _batch_job_dir(self, unique: dict) -> Optional[str]:
+        """Per-batch journal dir under ``config.range_job_dir``.
+
+        A job manifest binds its directory to one exact request (spec +
+        pair range), so each distinct batch composition needs its own
+        subdirectory; the key digest makes a re-submitted identical batch
+        land on the same journal and resume instead of regenerate.
+        """
+        root = self.config.range_job_dir
+        if not root:
+            return None
+        ident = hashlib.sha256(repr(sorted(unique)).encode()).hexdigest()[:16]
+        path = os.path.join(root, f"batch-{ident}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
     def _flush_generate(self, batch: list[PendingResult]) -> None:
         """Deduplicate pairs → one range-driver call → split proofs by pair."""
         exec_start = monotonic()
@@ -412,6 +435,8 @@ class ProofService:
             unique.setdefault(req.key, req.pair)
         pairs = list(unique.values())
 
+        job_dir = self._batch_job_dir(unique)
+        journal_us0 = self.metrics.counter_value("jobs.chunk_journal_us")
         with use_context(batch[0].trace_ctx):
             with self.metrics.stage("serve.generate_batch"):
                 if len(pairs) > 1:
@@ -425,12 +450,30 @@ class ProofService:
                         metrics=self.metrics,
                         scan_threads=self.config.range_scan_threads,
                         pipeline_depth=self.config.range_pipeline_depth,
+                        job_dir=job_dir,
+                    )
+                elif job_dir is not None:
+                    # journalled single-pair path: the chunked driver is the
+                    # serial engine plus write-ahead chunk commits
+                    bundle = generate_event_proofs_for_range_chunked(
+                        self._store,
+                        pairs,
+                        self._spec,
+                        chunk_size=self.config.range_chunk_size,
+                        metrics=self.metrics,
+                        job_dir=job_dir,
                     )
                 else:
                     bundle = generate_event_proofs_for_range(
                         self._store, pairs, self._spec, metrics=self.metrics
                     )
         self.metrics.count("serve.batches.generate")
+        # Wall-clock microseconds the range driver spent journalling chunk
+        # commits while this batch executed (one flush thread drives the
+        # generate queue, so the counter delta is this batch's journalling)
+        journal_us = (
+            self.metrics.counter_value("jobs.chunk_journal_us") - journal_us0
+        )
 
         by_key: dict[tuple, list] = {key: [] for key in unique}
         # EventProof pins (parent_tipset_cids, child_block_cid); a child
@@ -448,6 +491,8 @@ class ProofService:
             req = pending.payload
             total_ms = (now - pending.enqueued_at) * 1e3
             timing = self._request_timing(pending, exec_start, now, "generate_ms")
+            if journal_us > 0:
+                timing["journal_ms"] = round(journal_us / 1e3, 3)
             self.metrics.observe("serve.latency_ms.generate", total_ms)
             pending.complete(
                 GenerateResponse(

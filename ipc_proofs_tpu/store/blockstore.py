@@ -166,7 +166,7 @@ class RecordingBlockstore:
 
     def __init__(self, inner: Blockstore):
         self._inner = inner
-        self._seen: set[CID] = set()
+        self._seen: set[CID] = set()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def get(self, cid: CID) -> Optional[bytes]:
@@ -220,14 +220,14 @@ class BlockCache:
     ):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
-        self._entries: "OrderedDict[CID, tuple[bytes, float]]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[CID, tuple[bytes, float]]" = OrderedDict()  # guarded-by: _lock
         self._max_bytes = max_bytes
         self._ttl_s = ttl_s
         self._clock = clock
-        self._bytes = 0
-        self._lock = threading.Lock()
-        self.evictions = 0
-        self.expirations = 0
+        self._bytes = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        self.expirations = 0  # guarded-by: _lock
 
     def get(self, cid: CID) -> Optional[bytes]:
         now = self._clock()

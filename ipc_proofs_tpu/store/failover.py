@@ -123,8 +123,9 @@ class EndpointPool:
         self.hedge_ms = hedge_ms
         self._clock = clock
         self._lock = threading.Lock()
-        self._latency = Histogram(maxlen=512)  # pool-wide block-fetch seconds
-        self._executor: Optional[ThreadPoolExecutor] = None
+        # pool-wide block-fetch seconds
+        self._latency = Histogram(maxlen=512)  # guarded-by: _lock
+        self._executor: Optional[ThreadPoolExecutor] = None  # guarded-by: _lock
         if metrics is None:
             from ipc_proofs_tpu.utils.metrics import get_metrics
 
@@ -161,7 +162,7 @@ class EndpointPool:
                 # authoritative even when it is an error
                 self._record_success(ep, self._clock() - t0, observe_latency=False)
                 raise
-            except Exception as exc:
+            except Exception as exc:  # fail-soft: failover — failure feeds the breaker; re-raised below once every endpoint has been tried
                 self._record_failure(ep)
                 last = exc
                 continue
@@ -193,7 +194,7 @@ class EndpointPool:
                     continue
                 try:
                     return self._read_one(ep, cid)
-                except Exception as exc:
+                except Exception as exc:  # fail-soft: failover — _read_one already recorded the failure (and demoted on corruption); re-raised below after the last endpoint
                     last = exc
                     continue
             if isinstance(last, IntegrityError):
@@ -359,7 +360,7 @@ class EndpointPool:
                     continue
                 try:
                     return self._read_one(ep, cid)
-                except Exception:
+                except Exception:  # fail-soft: failover — recorded by _read_one; the primary's error re-raises below when no endpoint answers
                     continue
             raise
         secondary: Optional[EndpointState] = None
@@ -380,7 +381,7 @@ class EndpointPool:
             for fut in done:
                 try:
                     result = fut.result()
-                except Exception as exc:
+                except Exception as exc:  # fail-soft: hedge race — one racer losing is expected; surfaced via `from last` if both lose
                     last = exc
                     continue
                 if fut is fut_hedge:
@@ -392,8 +393,10 @@ class EndpointPool:
                 continue
             try:
                 return self._read_one(ep, cid)
-            except Exception as exc:
+            except Exception as exc:  # fail-soft: failover — recorded by _read_one; re-raised below after the last fallback
                 last = exc
+        if isinstance(last, IntegrityError):
+            raise last  # every endpoint returned corrupt bytes — say so
         raise RuntimeError(
             f"all {len(self._endpoints)} endpoints failed reading {cid} (hedged)"
         ) from last

@@ -71,11 +71,11 @@ class FaultPlan:
         self.kinds = tuple(kinds)
         self.latency_s = latency_s
         self.max_faults = max_faults
-        self.faults_injected = 0
-        self.calls_seen = 0
-        self.by_kind: dict[str, int] = {}
-        self._rng = random.Random(f"faultplan:{seed}")
         self._lock = threading.Lock()
+        self.faults_injected = 0  # guarded-by: _lock
+        self.calls_seen = 0  # guarded-by: _lock
+        self.by_kind: dict[str, int] = {}  # guarded-by: _lock
+        self._rng = random.Random(f"faultplan:{seed}")  # guarded-by: _lock
 
     def draw(self) -> Optional[str]:
         """One schedule step: returns a fault kind or None (no fault)."""

@@ -138,7 +138,7 @@ class LotusClient:
         if bearer_token:
             self._headers["Authorization"] = f"Bearer {bearer_token}"
         self._id_lock = threading.Lock()
-        self._next_id = 1
+        self._next_id = 1  # guarded-by: _id_lock
         if metrics is None:
             from ipc_proofs_tpu.utils.metrics import get_metrics
 
@@ -191,7 +191,7 @@ class LotusClient:
                     last_err = exc
                     if attempt + 1 < self.max_retries:
                         self._backoff(method, attempt, exc)
-                except Exception as exc:  # transport errors: retry with backoff
+                except Exception as exc:  # fail-soft: transport errors retry with backoff; exhausted retries re-raise below `from last_err`
                     last_err = exc
                     if attempt + 1 < self.max_retries:
                         self._backoff(method, attempt, exc)
@@ -299,7 +299,7 @@ class RpcBlockstore:
         def fetch(cid: CID) -> None:
             try:
                 data = self.get(cid)
-            except Exception as exc:
+            except Exception as exc:  # fail-soft: prefetch is advisory — the failure is counted, logged, and the block refetched on demand
                 with lock:
                     failures[cid] = exc
                 return

@@ -54,7 +54,7 @@ class JsonLineFormatter(logging.Formatter):
             if ctx is not None:
                 obj["trace_id"] = ctx.trace_id
                 obj["span_id"] = ctx.span_id
-        except Exception:
+        except Exception:  # fail-soft: trace decoration is best-effort — a log line without trace_id beats no log line
             pass
         if record.exc_info and record.exc_info[0] is not None:
             obj["exc"] = self.formatException(record.exc_info)
@@ -74,7 +74,7 @@ def _configure() -> None:
         from ipc_proofs_tpu.obs.flight import FlightLogHandler
 
         root.addHandler(FlightLogHandler())
-    except Exception:
+    except Exception:  # fail-soft: the flight-ring mirror is optional — logging must work even if obs cannot import
         pass
     # Respect an embedding application's config: if the app configured
     # either the `ipc_proofs` logger or the process root logger (e.g.

@@ -25,6 +25,12 @@ __all__ = [
     "RESILIENCE_COUNTERS",
     "DURABILITY_COUNTERS",
     "OBSERVABILITY_COUNTERS",
+    "RANGE_COUNTERS",
+    "SERVE_COUNTERS",
+    "PIPELINE_STAGES",
+    "SERVE_GAUGES",
+    "DURABILITY_GAUGES",
+    "SERVE_HISTOGRAMS",
 ]
 
 # Counter vocabulary of the fault-tolerance layer (store/failover.py,
@@ -63,6 +69,12 @@ RESILIENCE_COUNTERS = (
 #                             wall: in the pipelined record stage, wall
 #                             time would also count GIL/IO waits that
 #                             overlap the next chunk's scan
+#   jobs.chunk_journal_us   — wall-clock microseconds spent journalling
+#                             per chunk/verdict commit (serialize +
+#                             write + fsync). Unlike jobs.commit_us this
+#                             is wall time: it is what a waiting request
+#                             actually experiences, so it is the number
+#                             surfaced as `journal_ms` in Server-Timing
 #   jobs.journal_failures   — records lost to fail-soft journal I/O degrade
 #   serve.requests_replayed — admitted-but-unfinished serve requests
 #                             re-executed on daemon restart
@@ -70,6 +82,7 @@ DURABILITY_COUNTERS = (
     "jobs.chunks_replayed",
     "jobs.resume_ms",
     "jobs.commit_us",
+    "jobs.chunk_journal_us",
     "jobs.journal_failures",
     "serve.requests_replayed",
 )
@@ -85,6 +98,75 @@ OBSERVABILITY_COUNTERS = (
     "trace.spans_recorded",
     "trace.spans_dropped",
     "serve.slow_requests",
+)
+
+# Counter vocabulary of the proof engines (proofs/range.py,
+# proofs/storage_batch.py): work-item counts the bench legs and the
+# `--metrics` CLI flag report.
+#   range_events            — event claims matched across the range
+#   range_chunks_generated  — chunks proven fresh this run
+#   range_chunks_resumed    — chunks satisfied from the journal on resume
+#   range_proofs            — event-claim proofs emitted
+#   range_storage_proofs    — storage-slot proofs emitted
+#   batch_contracts         — distinct contracts in a storage batch
+#   batch_slots             — storage slots read in a storage batch
+RANGE_COUNTERS = (
+    "range_events",
+    "range_chunks_generated",
+    "range_chunks_resumed",
+    "range_proofs",
+    "range_storage_proofs",
+    "batch_contracts",
+    "batch_slots",
+)
+
+# Counter vocabulary of the serve plane (serve/batcher.py,
+# serve/service.py, serve/durable.py). `<family>.*` entries are
+# per-batcher families — the batcher interpolates its queue name
+# (`generate`/`verify`) into the counter, e.g. `serve.accepted.generate`.
+SERVE_COUNTERS = (
+    "serve.accepted.*",
+    "serve.rejected_closed.*",
+    "serve.rejected_full.*",
+    "serve.deadline_exceeded.*",
+    "serve.batches.generate",
+    "serve.batches.verify",
+    "serve.idempotent_hits",
+)
+
+# Stage-timer vocabulary (`Metrics.stage(...)`): every `with
+# metrics.stage("name")` site in the tree must use one of these names —
+# a typo'd stage silently forks a new timer that no bench leg reads.
+PIPELINE_STAGES = (
+    "fetch_tipsets",
+    "resolve_address",
+    "actor_walks",
+    "slot_hash",
+    "slot_reads",
+    "materialize",
+    "generate",
+    "range_scan",
+    "range_match",
+    "range_record",
+    "range_verify",
+    "range_storage",
+    "serve.generate_batch",
+    "serve.verify_batch",
+)
+
+# Gauge vocabulary: instantaneous state, overwritten not accumulated.
+SERVE_GAUGES = (
+    "serve.queue_depth.*",  # per-batcher queue depth (generate/verify)
+)
+DURABILITY_GAUGES = (
+    "jobs.journal_bytes",  # bytes in the active job's write-ahead journal
+)
+
+# Histogram vocabulary: bounded-reservoir distributions (p50/p90/p99).
+SERVE_HISTOGRAMS = (
+    "serve.latency_ms.generate",
+    "serve.latency_ms.verify",
+    "serve.batch_size.*",  # per-batcher flushed-batch sizes
 )
 
 # Lazily-bound obs.trace.span factory: `Metrics.stage()` opens a span per
@@ -258,6 +340,13 @@ class Metrics:
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+
+    def counter_value(self, name: str) -> int:
+        """Current value of one counter (0 when never incremented) —
+        lets callers attribute deltas, e.g. the serve plane turning
+        `jobs.chunk_journal_us` growth into a request's `journal_ms`."""
+        with self._lock:
+            return self.counters.get(name, 0)
 
     def set_gauge(self, name: str, value: float) -> None:
         """Instantaneous state (queue depth, in-flight); last write wins."""

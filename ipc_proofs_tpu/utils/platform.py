@@ -110,6 +110,6 @@ def _probe_once(
         log(f"bench: probe {attempt}/{attempts} exited rc={probe.returncode}")
     except subprocess.TimeoutExpired:
         log(f"bench: probe {attempt}/{attempts} timed out after {probe_timeout:.0f}s")
-    except Exception as exc:  # pragma: no cover
+    except Exception as exc:  # pragma: no cover — fail-soft: a failed TPU probe downgrades the bench to CPU, logged above
         log(f"bench: probe {attempt}/{attempts} failed ({exc})")
     return None

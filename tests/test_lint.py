@@ -1,0 +1,309 @@
+"""ipclint: each rule family fires on a known-bad fixture, annotations and
+suppressions are honored, and — the actual point — the real tree is clean.
+
+The fixture tests pin the *meaning* of each rule with a minimal snippet, so
+a future engine change that silently stops detecting (say) unguarded writes
+fails here rather than going unnoticed while the tree check keeps passing
+vacuously. The tree test is the enforcement: `python -m tools.ipclint
+ipc_proofs_tpu tools` exiting 0 is a tier-1 invariant of this repo.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.ipclint import RULES, lint_paths
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def run_lint(tmp_path: Path, files: "dict[str, str]", check_vocab: bool = False):
+    """Write ``files`` (rel path → source) under tmp_path and lint them."""
+    for rel, source in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(source))
+    run = lint_paths([str(tmp_path)], repo_root=str(tmp_path), check_vocab=check_vocab)
+    return [(f.rule, f.line) for f in run.findings]
+
+
+def rules_of(findings) -> set:
+    return {rule for rule, _ in findings}
+
+
+class TestRaceRules:
+    def test_unguarded_write_fires_race_guard(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.hits = 0  # guarded-by: _lock
+
+                def ok(self):
+                    with self._lock:
+                        self.hits += 1
+
+                def bad(self):
+                    self.hits += 1
+        '''})
+        assert rules_of(findings) == {"race-guard"}
+
+    def test_guarded_access_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.hits = 0  # guarded-by: _lock
+
+                def ok(self):
+                    with self._lock:
+                        self.hits += 1
+        '''})
+        assert findings == []
+
+    def test_locked_decorator_counts_as_held(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.hits = 0  # guarded-by: _lock
+
+                @locked
+                def ok(self):
+                    self.hits += 1
+        '''})
+        assert findings == []
+
+    def test_thread_spawner_with_shared_attr_needs_annotation(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            class Spawner:
+                def __init__(self):
+                    self.total = 0
+                    self._t = threading.Thread(target=self._work)
+                    self._t.start()
+
+                def _work(self):
+                    self.total += 1
+
+                def read(self):
+                    return self.total
+        '''})
+        assert "race-unannotated" in rules_of(findings)
+
+
+class TestDetRules:
+    DET_REL = "ipc_proofs_tpu/core/mod.py"  # inside a proof-path package
+
+    def test_wall_clock_in_det_scope(self, tmp_path):
+        findings = run_lint(tmp_path, {self.DET_REL: '''
+            import time
+
+            def stamp():
+                return time.time()
+        '''})
+        assert rules_of(findings) == {"det-wallclock"}
+
+    def test_unseeded_random_in_det_scope(self, tmp_path):
+        findings = run_lint(tmp_path, {self.DET_REL: '''
+            import random
+
+            def pick():
+                return random.random()
+        '''})
+        assert rules_of(findings) == {"det-random"}
+
+    def test_seeded_rng_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, {self.DET_REL: '''
+            import random
+
+            def pick():
+                return random.Random("seed").random()
+        '''})
+        assert findings == []
+
+    def test_set_iteration_in_det_scope(self, tmp_path):
+        findings = run_lint(tmp_path, {self.DET_REL: '''
+            def walk(items):
+                for x in set(items):
+                    yield x
+        '''})
+        assert rules_of(findings) == {"det-setiter"}
+
+    def test_float_arithmetic_in_det_scope(self, tmp_path):
+        findings = run_lint(tmp_path, {self.DET_REL: '''
+            def scale(n):
+                return n * 0.5
+        '''})
+        assert rules_of(findings) == {"det-float"}
+
+    def test_pathlib_join_is_not_float_division(self, tmp_path):
+        findings = run_lint(tmp_path, {self.DET_REL: '''
+            from pathlib import Path
+
+            def build_dir(root):
+                return Path(root) / "backend" / "native"
+        '''})
+        assert findings == []
+
+    def test_same_code_outside_det_scope_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, {"ipc_proofs_tpu/serve/mod.py": '''
+            import time
+
+            def stamp():
+                return time.time()
+        '''})
+        assert findings == []
+
+
+class TestErrRules:
+    def test_bare_except(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+        '''})
+        assert rules_of(findings) == {"err-bare"}
+
+    def test_swallowed_exception(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        '''})
+        assert rules_of(findings) == {"err-swallow"}
+
+    def test_fail_soft_comment_justifies_swallow(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():
+                try:
+                    g()
+                except Exception:  # fail-soft: diagnostics must never take the app down
+                    pass
+        '''})
+        assert findings == []
+
+    def test_reraise_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():
+                try:
+                    g()
+                except Exception as exc:
+                    raise RuntimeError("wrapped") from exc
+        '''})
+        assert findings == []
+
+
+class TestVocabRules:
+    METRICS_REL = "ipc_proofs_tpu/utils/metrics.py"
+
+    def test_unknown_counter_and_dead_entry(self, tmp_path):
+        findings = run_lint(tmp_path, {
+            self.METRICS_REL: '''
+                DEMO_COUNTERS = (
+                    "events.seen",
+                    "events.never_counted",
+                )
+            ''',
+            "ipc_proofs_tpu/serve/mod.py": '''
+                def f(metrics):
+                    metrics.count("events.seen")
+                    metrics.count("events.with_typo")
+            ''',
+        }, check_vocab=True)
+        assert rules_of(findings) == {"vocab-unknown", "vocab-dead"}
+
+    def test_wildcard_entry_matches_fstring(self, tmp_path):
+        findings = run_lint(tmp_path, {
+            self.METRICS_REL: '''
+                DEMO_COUNTERS = ("serve.accepted.*",)
+            ''',
+            "ipc_proofs_tpu/serve/mod.py": '''
+                def f(metrics, kind):
+                    metrics.count(f"serve.accepted.{kind}")
+            ''',
+        }, check_vocab=True)
+        assert findings == []
+
+
+class TestSuppression:
+    def test_disable_comment_suppresses(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():
+                try:
+                    g()
+                except Exception:  # ipclint: disable=err-swallow
+                    pass
+        '''})
+        assert findings == []
+
+    def test_unused_disable_is_stale(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():  # ipclint: disable=err-swallow
+                return 1
+        '''})
+        assert rules_of(findings) == {"stale-suppression"}
+
+    def test_unknown_rule_in_disable_is_stale(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def f():
+                try:
+                    g()
+                except Exception:  # ipclint: disable=no-such-rule
+                    pass
+        '''})
+        assert "stale-suppression" in rules_of(findings)
+
+
+class TestRealTree:
+    def test_repo_is_lint_clean(self):
+        """The enforcement test: the shipped tree has zero findings."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ipclint", "ipc_proofs_tpu", "tools"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, f"ipclint found violations:\n{proc.stdout}"
+
+    def test_check_all_gate_passes(self):
+        """The chained gate (ipclint → bench schema → sanitizer probe)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.check_all"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+
+    def test_rule_registry_is_stable(self):
+        # every rule the fixtures above exercise must stay registered —
+        # removing one from RULES would turn its disables into stale noise
+        assert {
+            "race-guard", "race-unannotated", "det-wallclock", "det-random",
+            "det-setiter", "det-float", "err-bare", "err-swallow",
+            "vocab-unknown", "vocab-dead", "stale-suppression",
+        } <= set(RULES)
+
+
+class TestSanitizerHarness:
+    def test_probe_reports_availability(self):
+        from tools.build_native_san import probe_toolchain
+
+        ok, detail = probe_toolchain()
+        assert isinstance(ok, bool)
+        assert detail  # libasan preload string, or a human-readable reason
+        if not ok:
+            pytest.skip(f"sanitizer toolchain unavailable: {detail}")

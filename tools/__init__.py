@@ -1,0 +1,5 @@
+"""Repo tooling: lint, bench-schema validation, chaos/crash harnesses.
+
+Package marker so ``python -m tools.ipclint`` and ``python -m
+tools.check_all`` resolve from the repo root without installation.
+"""

@@ -1,0 +1,209 @@
+"""Sanitizer-hardened build + test of the native C extensions.
+
+Builds ``scan_ext.c`` and ``dagcbor_ext.c`` with ASan+UBSan and the full
+warning set promoted to errors (``-fsanitize=address,undefined -Wall
+-Wextra -Werror``), then runs the native test subset against the
+sanitized modules. Memory errors (heap overflow, use-after-free) and
+undefined behavior (signed overflow, misaligned loads, bad shifts) in the
+C scanner/codec become hard test failures instead of silent corruption.
+
+Mechanics: the sanitized ``.so``s are cached under distinct names
+(``*.san.so`` — see ``core._cid_native.build_cpython_ext``) so they never
+poison the fast-path build cache. The test subprocess runs with
+``IPC_PROOFS_SAN=1`` (builder picks the sanitized cache) and
+``LD_PRELOAD=libasan.so`` (the Python binary itself is uninstrumented, so
+the ASan runtime must be first in the process; ``detect_leaks=0`` because
+CPython's interned objects look like leaks to lsan).
+
+Exit codes: 0 = clean run *or* graceful skip (no gcc / no libasan — CI
+images without the toolchain shouldn't fail tier-1); 1 = compile warning,
+sanitizer report, or test failure. ``--strict`` turns a skip into a
+failure for environments that must have the toolchain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+NATIVE_DIR = REPO_ROOT / "ipc_proofs_tpu" / "backend" / "native"
+SOURCES = ("scan_ext.c", "dagcbor_ext.c")
+MODULES = ("ipc_scan_ext", "ipc_dagcbor_ext")
+
+# the tests that exercise the C extensions end-to-end, including the
+# malformed-input fuzz corpora (exactly where ASan/UBSan pay off)
+NATIVE_TESTS = (
+    "tests/test_scan_native.py",
+    "tests/test_native_dagcbor.py",
+    "tests/test_native_cid_type.py",
+    "tests/test_codec_exec_fuzz.py",
+    "tests/test_batch_verifier_fuzz.py",
+)
+
+_PROBE_C = "int main(void) { return 0; }\n"
+
+
+def _gcc_file(name: str) -> "str | None":
+    """Resolve a runtime library through gcc; None when not installed."""
+    try:
+        out = subprocess.run(
+            ["gcc", "-print-file-name=" + name],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) and os.path.exists(path) else None
+
+
+def probe_toolchain() -> "tuple[bool, str]":
+    """Can this host compile AND run sanitized code?
+
+    Returns (ok, detail) — detail is the LD_PRELOAD string on success, a
+    human-readable skip reason on failure.
+    """
+    try:
+        out = subprocess.run(
+            ["gcc", "-print-file-name=libasan.so"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False, "gcc not found"
+    libasan = out.stdout.strip()
+    # gcc echoes the bare name back when the runtime isn't installed
+    if not libasan or not os.path.isabs(libasan) or not os.path.exists(libasan):
+        return False, "libasan runtime not installed"
+    # libstdc++ must ride along in LD_PRELOAD: python doesn't link it, so
+    # when ASan initializes its __cxa_throw interceptor the real symbol is
+    # absent, and the first C++ throw from a later-dlopened lib (jaxlib's
+    # MLIR bindings) hits an AddressSanitizer CHECK instead of unwinding
+    libstdcpp = _gcc_file("libstdc++.so.6")
+    preload = f"{libasan} {libstdcpp}" if libstdcpp else libasan
+    with tempfile.TemporaryDirectory(prefix="san_probe_") as td:
+        src = Path(td) / "probe.c"
+        exe = Path(td) / "probe"
+        src.write_text(_PROBE_C)
+        try:
+            subprocess.run(
+                ["gcc", "-fsanitize=address,undefined", str(src), "-o", str(exe)],
+                check=True, capture_output=True, timeout=60,
+            )
+            subprocess.run(
+                [str(exe)], check=True, capture_output=True, timeout=30,
+                env={**os.environ, "ASAN_OPTIONS": "detect_leaks=0"},
+            )
+        except (OSError, subprocess.SubprocessError):
+            return False, "sanitized probe failed to compile/run"
+    return True, preload
+
+
+def build_sanitized(preload: str, verbose: bool = True) -> int:
+    """Compile both extensions sanitized + warning-clean; 0 on success.
+
+    Builds through the shared builder (with IPC_PROOFS_SAN=1) so the
+    ``.san.so`` names, host stamps, and flag set stay in one place — but in
+    a SUBPROCESS, because the builder imports the module it built and the
+    sanitized .so cannot load into this (unpreloaded) interpreter.
+    """
+    code = (
+        "from pathlib import Path\n"
+        "from ipc_proofs_tpu.core import _cid_native as n\n"
+        f"native = Path({str(NATIVE_DIR)!r})\n"
+        f"for src, mod in zip({SOURCES!r}, {MODULES!r}):\n"
+        "    n.build_cpython_ext(native / src, n.BUILD_DIR / (mod + '.so'), mod)\n"
+    )
+    # the builder imports each module right after compiling it, so the
+    # build subprocess needs the ASan runtime preloaded too; detect_leaks=0
+    # also keeps LSan from failing the gcc child processes at exit
+    env = {
+        **os.environ,
+        "IPC_PROOFS_SAN": "1",
+        "JAX_PLATFORMS": "cpu",
+        "LD_PRELOAD": preload,
+        "ASAN_OPTIONS": "detect_leaks=0",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO_ROOT,
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    if proc.returncode != 0:
+        if verbose:
+            sys.stderr.write(proc.stderr)
+            print("build_native_san: sanitized build FAILED", file=sys.stderr)
+        return 1
+    if verbose:
+        for mod in MODULES:
+            so = NATIVE_DIR / "build" / f"{mod}.san.so"
+            print(f"build_native_san: built {so.relative_to(REPO_ROOT)}")
+    return 0
+
+
+def run_tests(preload: str, extra_pytest_args: "list[str] | None" = None) -> int:
+    """Run the native test subset against the sanitized extensions."""
+    env = {
+        **os.environ,
+        "IPC_PROOFS_SAN": "1",
+        "LD_PRELOAD": preload,
+        # CPython's arenas/interned strings read as leaks; everything else
+        # (overflow, UAF) still aborts the run
+        "ASAN_OPTIONS": "detect_leaks=0",
+        "UBSAN_OPTIONS": "print_stacktrace=1:halt_on_error=1",
+        "JAX_PLATFORMS": "cpu",
+    }
+    # -s: sanitizer reports print to the real stderr as the process dies —
+    # pytest's fd capture would swallow them along with the crashed test
+    cmd = [
+        sys.executable, "-m", "pytest", "-q", "-s", "-m", "not slow",
+        "-p", "no:cacheprovider",
+        *NATIVE_TESTS,
+        *(extra_pytest_args or []),
+    ]
+    proc = subprocess.run(cmd, cwd=REPO_ROOT, timeout=1800, env=env)
+    return proc.returncode
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.build_native_san",
+        description="ASan/UBSan build + native test subset for the C extensions",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="fail (exit 1) instead of skipping when the toolchain is missing",
+    )
+    ap.add_argument(
+        "--build-only", action="store_true",
+        help="compile the sanitized extensions but skip the test run",
+    )
+    ap.add_argument(
+        "pytest_args", nargs="*",
+        help="extra args forwarded to pytest (e.g. -k decode)",
+    )
+    args = ap.parse_args(argv)
+
+    ok, detail = probe_toolchain()
+    if not ok:
+        print(f"build_native_san: SKIP ({detail})", file=sys.stderr)
+        return 1 if args.strict else 0
+    preload = detail
+
+    rc = build_sanitized(preload)
+    if rc != 0:
+        return rc
+    if args.build_only:
+        return 0
+    rc = run_tests(preload, args.pytest_args)
+    if rc != 0:
+        print("build_native_san: sanitized tests FAILED", file=sys.stderr)
+        return rc
+    print("build_native_san: sanitized build + native tests clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

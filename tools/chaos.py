@@ -130,7 +130,7 @@ def chaos_run(
             "faults": [p.snapshot() for p in plans],
             "counters": metrics.snapshot()["counters"],
         }
-    except Exception as exc:  # noqa: BLE001 — the invariant check itself
+    except Exception as exc:  # fail-soft: an untyped escape IS the harness finding — reported as outcome=untyped_error
         return {
             "outcome": "untyped_error",
             "error": f"{type(exc).__name__}: {exc}",

@@ -1,0 +1,73 @@
+"""One-shot static gate: every repo-native checker in sequence.
+
+Chains the analyzers that guard invariants tests can't see directly:
+
+1. **ipclint** — lock discipline, determinism, error taxonomy, metrics
+   vocabulary over ``ipc_proofs_tpu`` + ``tools`` (AST-level, fast);
+2. **bench schema** — every ``BENCH_*.json`` artifact still parses against
+   the reporting contract;
+3. **sanitizer probe** — the ASan/UBSan toolchain is present and a probe
+   binary compiles and runs (reported, never fatal: images without the
+   toolchain run the first two gates and skip the third). Pass ``--san``
+   to run the full sanitized build + native test subset instead of the
+   probe.
+
+Exit 0 only when every gate passes. Designed for pre-commit / CI::
+
+    python -m tools.check_all          # lint + schema + toolchain probe
+    python -m tools.check_all --san    # …with the full sanitizer run
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _gate(name: str, argv: "list[str]") -> bool:
+    print(f"check_all: [{name}] {' '.join(argv)}", flush=True)
+    proc = subprocess.run([sys.executable, *argv], cwd=REPO_ROOT, timeout=1800)
+    ok = proc.returncode == 0
+    print(f"check_all: [{name}] {'ok' if ok else f'FAILED (exit {proc.returncode})'}")
+    return ok
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.check_all", description="run every repo-native static gate"
+    )
+    ap.add_argument(
+        "--san", action="store_true",
+        help="run the full sanitizer build + native tests, not just the probe",
+    )
+    args = ap.parse_args(argv)
+
+    ok = _gate("ipclint", ["-m", "tools.ipclint", "ipc_proofs_tpu", "tools"])
+
+    artifacts = sorted(str(p.name) for p in REPO_ROOT.glob("BENCH_*.json"))
+    if artifacts:
+        ok &= _gate("bench-schema", ["tools/check_bench_schema.py", *artifacts])
+    else:
+        print("check_all: [bench-schema] no BENCH_*.json artifacts — skipped")
+
+    if args.san:
+        ok &= _gate("sanitizer", ["-m", "tools.build_native_san"])
+    else:
+        from tools.build_native_san import probe_toolchain
+
+        available, detail = probe_toolchain()
+        if available:
+            print("check_all: [sanitizer] toolchain available (probe compiled+ran)")
+        else:
+            print(f"check_all: [sanitizer] SKIP ({detail})")
+
+    print("check_all: " + ("all gates passed" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""ipclint — project-native static analysis for ipc-proofs-tpu.
+
+Encodes this codebase's real invariants as machine-checked AST rules:
+
+* ``race-guard`` / ``race-unannotated`` — lock-discipline lint over the
+  ``# guarded-by: <lock>`` annotation convention (checks_race).
+* ``det-wallclock`` / ``det-random`` / ``det-setiter`` / ``det-float`` —
+  determinism lint for the proof-path packages (checks_det).
+* ``err-bare`` / ``err-swallow`` — error-taxonomy lint: no bare
+  ``except:``; ``except Exception`` must re-raise or carry a
+  ``# fail-soft:`` justification (checks_err).
+* ``vocab-unknown`` / ``vocab-dead`` — metrics/trace vocabulary lint
+  against the declared ``*_COUNTERS``/``*_STAGES``/``*_GAUGES``/
+  ``*_HISTOGRAMS`` tuples in ``utils/metrics.py`` (checks_vocab).
+* ``stale-suppression`` — an ``# ipclint: disable=<rule>`` comment that
+  suppressed nothing.
+
+Run as ``python -m tools.ipclint [paths...]`` (defaults to
+``ipc_proofs_tpu tools``); exits non-zero iff findings remain after
+suppressions.
+"""
+
+from tools.ipclint.engine import Finding, LintRun, lint_paths
+
+__all__ = ["Finding", "LintRun", "lint_paths", "RULES"]
+
+#: Every rule id the suite can emit (suppression comments are validated
+#: against this set so a typo'd disable is itself an error).
+RULES = (
+    "race-guard",
+    "race-unannotated",
+    "det-wallclock",
+    "det-random",
+    "det-setiter",
+    "det-float",
+    "err-bare",
+    "err-swallow",
+    "vocab-unknown",
+    "vocab-dead",
+    "stale-suppression",
+)

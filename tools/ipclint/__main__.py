@@ -1,0 +1,43 @@
+"""CLI: ``python -m tools.ipclint [paths...]`` — exit 0 iff clean.
+
+Defaults to linting ``ipc_proofs_tpu tools`` from the repo root, which
+is the invocation pinned by ``tests/test_lint.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.ipclint import lint_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ipclint",
+        description="Project-native static analysis for ipc-proofs-tpu.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["ipc_proofs_tpu", "tools"],
+        help="files or directories to lint (default: ipc_proofs_tpu tools)",
+    )
+    parser.add_argument(
+        "--no-vocab", action="store_true",
+        help="skip the cross-file metrics-vocabulary rules",
+    )
+    args = parser.parse_args(argv)
+
+    run = lint_paths(args.paths, check_vocab=not args.no_vocab)
+    for finding in run.findings:
+        print(finding.render())
+    n_files = len(run.files)
+    if run.findings:
+        print(f"ipclint: {len(run.findings)} finding(s) in {n_files} file(s)",
+              file=sys.stderr)
+        return 1
+    print(f"ipclint: clean ({n_files} files)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

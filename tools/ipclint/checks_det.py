@@ -1,0 +1,123 @@
+"""Determinism lint for the proof-path packages (core/ ipld/ state/
+proofs/ crypto/) — the packages whose output is the byte-exact witness.
+
+* ``det-wallclock`` — wall-clock reads (``time.time``, ``datetime.now``,
+  …).  ``time.monotonic``/``perf_counter``/``thread_time`` are allowed:
+  they measure duration, and durations only feed metrics, never witness
+  bytes.
+* ``det-random`` — module-level ``random.*`` use and unseeded RNG
+  construction (``random.Random()`` / ``np.random.default_rng()`` with
+  no seed).  Seeded constructors are fine — they are how the fault plan
+  and fuzz tests stay reproducible.
+* ``det-setiter`` — iterating directly over a set literal, set
+  comprehension or ``set(...)``/``frozenset(...)`` call in a ``for`` or
+  comprehension: set ordering is salted per process, so any such loop
+  feeding witness output diverges between runs.  Wrap in ``sorted()``.
+* ``det-float`` — float arithmetic: true division (except ``pathlib``
+  ``/`` joins, recognised by a string-literal operand) and float
+  constants used in arithmetic.  Consensus values are integers and
+  bytes; floats round differently across platforms.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ipclint.engine import LintRun, SourceFile
+
+__all__ = ["check"]
+
+_WALL_TIME_FNS = frozenset(
+    {"time", "time_ns", "localtime", "gmtime", "ctime", "strftime", "asctime"}
+)
+_WALL_DT_FNS = frozenset({"now", "utcnow", "today"})
+_SET_MAKERS = frozenset({"set", "frozenset"})
+_SEEDED_CTORS = frozenset({"Random", "default_rng", "RandomState", "Generator"})
+
+
+def _base_name(node: ast.expr) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _check_call(run: LintRun, sf: SourceFile, node: ast.Call) -> None:
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return
+    value = func.value
+
+    # time.time() and friends
+    if isinstance(value, ast.Name) and value.id == "time" and func.attr in _WALL_TIME_FNS:
+        run.add(sf, node.lineno, "det-wallclock",
+                f"wall-clock read time.{func.attr}() in a proof-path package")
+        return
+    # datetime.now()/utcnow()/today() — on datetime/date or datetime.datetime
+    if func.attr in _WALL_DT_FNS and _base_name(value) in ("datetime", "date"):
+        run.add(sf, node.lineno, "det-wallclock",
+                f"wall-clock read {ast.unparse(func)}() in a proof-path package")
+        return
+
+    # random module use: random.<fn>(), np.random.<fn>()
+    is_random_mod = isinstance(value, ast.Name) and value.id == "random"
+    is_np_random = (
+        isinstance(value, ast.Attribute)
+        and value.attr == "random"
+        and _base_name(value) in ("np", "numpy", "jnp", "jax")
+    )
+    if is_random_mod or is_np_random:
+        if func.attr in _SEEDED_CTORS:
+            if not node.args and not node.keywords:
+                run.add(sf, node.lineno, "det-random",
+                        f"unseeded RNG construction {ast.unparse(func)}()")
+        else:
+            run.add(sf, node.lineno, "det-random",
+                    f"module-level RNG call {ast.unparse(func)}() "
+                    f"(process-global state; use a seeded instance)")
+
+
+def _is_set_expr(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in _SET_MAKERS
+    )
+
+
+def _check_iter(run: LintRun, sf: SourceFile, it: ast.expr) -> None:
+    if _is_set_expr(it):
+        run.add(sf, it.lineno, "det-setiter",
+                "iteration order over a set is salted per process — wrap in "
+                "sorted() so downstream output is byte-stable")
+
+
+def _check_float(run: LintRun, sf: SourceFile, node: ast.BinOp) -> None:
+    if isinstance(node.op, ast.Div):
+        # pathlib's `/` join always has a string-literal operand somewhere
+        for side in (node.left, node.right):
+            if isinstance(side, ast.Constant) and isinstance(side.value, str):
+                return
+        run.add(sf, node.lineno, "det-float",
+                "true division produces floats — consensus values are "
+                "integers (use // or Fraction)")
+        return
+    for side in (node.left, node.right):
+        if isinstance(side, ast.Constant) and isinstance(side.value, float):
+            run.add(sf, node.lineno, "det-float",
+                    "float constant in arithmetic in a proof-path package")
+            return
+
+
+def check(run: LintRun, sf: SourceFile) -> None:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            _check_call(run, sf, node)
+        elif isinstance(node, ast.For):
+            _check_iter(run, sf, node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                _check_iter(run, sf, gen.iter)
+        elif isinstance(node, ast.BinOp):
+            _check_float(run, sf, node)

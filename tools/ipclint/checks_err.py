@@ -1,0 +1,59 @@
+"""Error-taxonomy lint (``err-bare`` / ``err-swallow``).
+
+The product is a byte-exact witness: a swallowed exception doesn't crash
+the run, it silently produces a *different answer* (missing chunk,
+un-demoted endpoint, un-journaled record).  So:
+
+* ``err-bare`` — bare ``except:`` is never allowed; it catches
+  ``KeyboardInterrupt``/``SystemExit`` and masks the crash-fault hooks
+  the crashtest harness relies on.
+* ``err-swallow`` — an ``except Exception:`` (or ``BaseException``)
+  handler must either contain a ``raise`` (re-raise or conversion to a
+  typed error such as ``JournalError``/``IntegrityError``/``RpcError``)
+  or carry a ``# fail-soft: <why>`` justification on the ``except`` line
+  (or the line directly above) explaining why degrading is correct.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ipclint.engine import LintRun, SourceFile
+
+__all__ = ["check"]
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _broad_name(type_node: ast.expr) -> str:
+    """'Exception'/'BaseException' when the handler catches one, else ''."""
+    candidates = (
+        type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+    )
+    for cand in candidates:
+        if isinstance(cand, ast.Name) and cand.id in _BROAD:
+            return cand.id
+        if isinstance(cand, ast.Attribute) and cand.attr in _BROAD:
+            return cand.attr
+    return ""
+
+
+def check(run: LintRun, sf: SourceFile) -> None:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            run.add(sf, node.lineno, "err-bare",
+                    "bare `except:` — catch a concrete type, or at minimum "
+                    "`except Exception` with a fail-soft justification")
+            continue
+        broad = _broad_name(node.type)
+        if not broad:
+            continue
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            continue  # re-raises or converts to a typed error
+        if sf.fail_soft(node.lineno):
+            continue
+        run.add(sf, node.lineno, "err-swallow",
+                f"`except {broad}` swallows the error — re-raise, convert to "
+                f"a typed error, or justify with `# fail-soft: <why>`")

@@ -1,0 +1,187 @@
+"""Lock-discipline race lint (``race-guard`` / ``race-unannotated``).
+
+Convention: a shared attribute of a class is annotated at its
+``__init__`` assignment (or any assignment) with a trailing
+``# guarded-by: <lockattr>`` comment.  The checker then verifies every
+``self.<attr>`` read or write in the class body is *lexically* inside a
+``with self.<lockattr>:`` block (``threading.Lock``, ``RLock`` and
+``Condition`` are all used directly as context managers in this tree),
+or inside a method marked with a ``@locked`` decorator (meaning: the
+caller must already hold the lock).
+
+``__init__`` is exempt — construction happens-before publication to
+other threads.  The check is lexical, not interprocedural: a closure
+*defined* inside a ``with`` block counts as lock-held even though it may
+run later; that approximation is deliberate (this tree's worker
+closures capture the lock discipline of their definition site).
+
+``race-unannotated`` is the discovery half: in a class that spawns
+threads (creates ``threading.Thread``/``Timer`` or a
+``ThreadPoolExecutor`` anywhere in its body), any attribute mutated
+outside ``__init__`` from two or more distinct methods must carry a
+``guarded-by`` annotation (or an explicit suppression explaining why it
+is safe).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Set
+
+from tools.ipclint.engine import LintRun, SourceFile
+
+__all__ = ["check"]
+
+_SPAWNER_NAMES = frozenset({"Thread", "ThreadPoolExecutor", "Timer"})
+
+
+def _terminal_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _spawns_threads(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _terminal_name(node.func) in _SPAWNER_NAMES:
+            return True
+    return False
+
+
+def _is_locked_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return _terminal_name(dec) == "locked" or (
+        isinstance(dec, ast.Name) and dec.id == "locked"
+    )
+
+
+def _self_attr(node: ast.expr) -> str:
+    """Return the attribute name when ``node`` is ``self.<attr>``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _collect_guarded(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr -> lock attr, from ``# guarded-by:`` comments on assignments."""
+    guarded: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr and attr not in guarded:
+                lock = sf.guarded_by(node.lineno)
+                if lock:
+                    guarded[attr] = lock
+    return guarded
+
+
+def _check_method(
+    run: LintRun,
+    sf: SourceFile,
+    cls: ast.ClassDef,
+    method: ast.AST,
+    guarded: Dict[str, str],
+) -> None:
+    all_held = any(_is_locked_decorator(d) for d in method.decorator_list)
+    flagged: Set[int] = set()
+
+    def walk(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in node.items:
+                walk(item.context_expr, held)
+                lock = _self_attr(item.context_expr)
+                if lock:
+                    newly.add(lock)
+            inner = held | newly
+            for child in node.body:
+                walk(child, inner)
+            return
+        attr = _self_attr(node)
+        if attr and attr in guarded:
+            lock = guarded[attr]
+            if not all_held and lock not in held and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                run.add(
+                    sf, node.lineno, "race-guard",
+                    f"'{cls.name}.{attr}' is guarded-by '{lock}' but accessed "
+                    f"outside `with self.{lock}:` in {method.name}()",
+                )
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in method.body:
+        walk(stmt, frozenset())
+
+
+def _check_unannotated(
+    run: LintRun,
+    sf: SourceFile,
+    cls: ast.ClassDef,
+    methods: List[ast.AST],
+    guarded: Dict[str, str],
+) -> None:
+    # A data race needs a writer and a second thread touching the same
+    # attribute: flag attrs mutated outside __init__ that at least one
+    # *other* method also reads or writes (each public method of a
+    # thread-spawning class is a potential thread entry point).
+    mutators: Dict[str, Set[str]] = {}
+    accessors: Dict[str, Set[str]] = {}
+    first_site: Dict[str, int] = {}
+    for method in methods:
+        if method.name == "__init__":
+            continue
+        for node in ast.walk(method):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr and attr not in guarded:
+                    mutators.setdefault(attr, set()).add(method.name)
+                    first_site.setdefault(attr, node.lineno)
+            attr = _self_attr(node)
+            if attr and attr not in guarded:
+                accessors.setdefault(attr, set()).add(method.name)
+    for attr, writer_names in sorted(mutators.items()):
+        touching = accessors.get(attr, set()) | writer_names
+        if len(touching) >= 2:
+            run.add(
+                sf, first_site[attr], "race-unannotated",
+                f"'{cls.name}.{attr}' is mutated in "
+                f"{', '.join(sorted(writer_names))}() and touched from "
+                f"{len(touching)} methods of a thread-spawning class but has "
+                f"no `# guarded-by:` annotation",
+            )
+
+
+def check(run: LintRun, sf: SourceFile) -> None:
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = _collect_guarded(sf, cls)
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for method in methods:
+            if method.name == "__init__":
+                continue
+            if guarded:
+                _check_method(run, sf, cls, method, guarded)
+        if _spawns_threads(cls):
+            _check_unannotated(run, sf, cls, methods, guarded)

@@ -1,0 +1,163 @@
+"""Metrics/trace vocabulary lint (``vocab-unknown`` / ``vocab-dead``).
+
+``utils/metrics.py`` declares the full counter/stage/gauge/histogram
+vocabulary as module-level tuples named ``*_COUNTERS`` / ``*_STAGES`` /
+``*_GAUGES`` / ``*_HISTOGRAMS``.  Entries ending in ``.*`` are prefix
+wildcards for per-instance families built with f-strings (e.g.
+``serve.accepted.*`` covers ``f"serve.accepted.{name}"``).
+
+* ``vocab-unknown`` — a string literal passed to ``metrics.count()`` /
+  ``stage()`` / ``set_gauge()`` / ``observe()`` that matches no declared
+  entry of that kind.  This is the typo catcher: a misspelt counter name
+  doesn't error at runtime, it silently mints a new series that never
+  shows up where dashboards look.
+* ``vocab-dead`` — a declared entry no call site references: stale
+  vocabulary reads as live telemetry to operators.
+
+Only calls on receivers named ``metrics`` / ``_metrics`` / ``m`` are
+inspected (that is the project-wide naming convention for the
+:class:`Metrics` handle); non-literal name arguments are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.ipclint.engine import LintRun, SourceFile
+
+__all__ = ["check"]
+
+_KIND_BY_METHOD = {
+    "count": "counter",
+    "stage": "stage",
+    "set_gauge": "gauge",
+    "observe": "histogram",
+}
+_KIND_BY_SUFFIX = {
+    "_COUNTERS": "counter",
+    "_STAGES": "stage",
+    "_GAUGES": "gauge",
+    "_HISTOGRAMS": "histogram",
+}
+_METRICS_RECEIVERS = frozenset({"metrics", "_metrics", "m"})
+
+
+def _terminal(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _load_vocab(vocab_sf: SourceFile) -> Dict[str, List[Tuple[str, int]]]:
+    """kind -> [(entry, lineno)] from module-level tuple assignments."""
+    vocab: Dict[str, List[Tuple[str, int]]] = {
+        k: [] for k in _KIND_BY_SUFFIX.values()
+    }
+    for node in vocab_sf.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        kind = next(
+            (k for suf, k in _KIND_BY_SUFFIX.items() if target.id.endswith(suf)),
+            None,
+        )
+        if kind is None or not isinstance(node.value, (ast.Tuple, ast.List)):
+            continue
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vocab[kind].append((elt.value, elt.lineno))
+    return vocab
+
+
+def _name_arg(call: ast.Call) -> Optional[ast.expr]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _literal_forms(node: ast.expr) -> List[str]:
+    """Concrete name strings (or ``prefix.*`` patterns for f-strings)
+    denoted by a metric-name expression; [] when non-literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):  # e.g. count("a" if cond else "b")
+        return _literal_forms(node.body) + _literal_forms(node.orelse)
+    if isinstance(node, ast.JoinedStr):
+        prefix = ""
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                prefix += part.value
+            else:
+                break
+        if prefix:
+            return [prefix + "*" if not prefix.endswith("*") else prefix]
+        return []
+    return []
+
+
+def _matches(entry: str, form: str) -> bool:
+    if entry.endswith(".*"):
+        prefix = entry[:-1]  # "serve.accepted."
+        if form.endswith("*"):
+            return form[:-1] == prefix
+        return form.startswith(prefix)
+    if form.endswith("*"):
+        return False  # f-string can only satisfy a wildcard entry
+    return form == entry
+
+
+def check(run: LintRun, vocab_sf: SourceFile) -> None:
+    vocab = _load_vocab(vocab_sf)
+    used: Dict[str, set] = {k: set() for k in vocab}
+
+    for sf in run.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            forms_here: List[Tuple[str, List[str], int]] = []
+            method = (
+                node.func.attr if isinstance(node.func, ast.Attribute) else ""
+            )
+            if (
+                method in _KIND_BY_METHOD
+                and isinstance(node.func, ast.Attribute)
+                and _terminal(node.func.value) in _METRICS_RECEIVERS
+            ):
+                arg = _name_arg(node)
+                if arg is not None:
+                    forms_here.append(
+                        (_KIND_BY_METHOD[method], _literal_forms(arg), node.lineno)
+                    )
+            # PipelineStage(..., metrics_stage="...") names a stage too
+            for kw in node.keywords:
+                if kw.arg == "metrics_stage":
+                    forms_here.append(("stage", _literal_forms(kw.value), kw.value.lineno))
+            for kind, forms, lineno in forms_here:
+                for form in forms:
+                    hits = [e for e, _ in vocab[kind] if _matches(e, form)]
+                    if hits:
+                        used[kind].update(hits)
+                    else:
+                        shown = form[:-1] + "{…}" if form.endswith("*") else form
+                        run.add(
+                            sf, lineno, "vocab-unknown",
+                            f"{kind} name '{shown}' is not declared in any "
+                            f"*_{kind.upper()}S vocabulary in utils/metrics.py",
+                        )
+
+    for kind, entries in vocab.items():
+        for entry, lineno in entries:
+            if entry not in used[kind]:
+                run.add(
+                    vocab_sf, lineno, "vocab-dead",
+                    f"{kind} vocabulary entry '{entry}' has no call site — "
+                    f"remove it or wire it up",
+                )

@@ -1,0 +1,205 @@
+"""Lint engine: file discovery, comment maps, suppressions, orchestration.
+
+The checkers work on :class:`SourceFile` objects which pair the parsed
+AST with a line → comment map extracted by ``tokenize`` (comments are
+invisible to ``ast``, but two of the project conventions —
+``# guarded-by: <lock>`` and ``# fail-soft: <why>`` — live in comments,
+as do ``# ipclint: disable=<rule>`` suppressions).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["Finding", "SourceFile", "LintRun", "lint_paths"]
+
+#: Proof-path packages subject to the determinism rules (det-*).
+DET_PACKAGES = frozenset({"core", "ipld", "state", "proofs", "crypto"})
+
+_DISABLE_RE = re.compile(r"ipclint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_FAIL_SOFT_RE = re.compile(r"fail-soft:\s*(\S.*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str  # display (repo-relative) path
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class SourceFile:
+    """A parsed Python file plus its comment/suppression side tables."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        # line -> comment text (text after '#', stripped); extracted with
+        # tokenize so '#' inside string literals is never misread.
+        self.comments: Dict[int, str] = {}
+        # lines whose comment is the whole line (vs trailing a statement)
+        self._own_line: Set[int] = set()
+        lines = source.splitlines()
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                row, col = tok.start
+                self.comments[row] = tok.string.lstrip("#").strip()
+                if not lines[row - 1][:col].strip():
+                    self._own_line.add(row)
+        # line -> set of rule ids disabled on that line
+        self.disables: Dict[int, Set[str]] = {}
+        for line, text in self.comments.items():
+            m = _DISABLE_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.disables[line] = rules
+
+    @property
+    def in_det_scope(self) -> bool:
+        parts = Path(self.rel).parts
+        for i, part in enumerate(parts[:-1]):
+            if part == "ipc_proofs_tpu" and parts[i + 1] in DET_PACKAGES:
+                return True
+        return False
+
+    def comment_near(self, line: int) -> str:
+        """Comment text attached to ``line``: the trailing comment on the
+        line itself plus a *full-line* comment directly above (convention
+        for statements too long to carry a trailing annotation) — a
+        trailing comment above belongs to that statement, not this one."""
+        pieces = []
+        if line - 1 in self._own_line:
+            pieces.append(self.comments[line - 1])
+        here = self.comments.get(line)
+        if here is not None:
+            pieces.append(here)
+        return " ".join(pieces)
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        m = _GUARDED_BY_RE.search(self.comment_near(line))
+        return m.group(1) if m else None
+
+    def fail_soft(self, line: int) -> Optional[str]:
+        m = _FAIL_SOFT_RE.search(self.comment_near(line))
+        return m.group(1) if m else None
+
+
+class LintRun:
+    """Collects findings across files, honouring per-line suppressions."""
+
+    def __init__(self, known_rules: Iterable[str]):
+        self.known_rules = frozenset(known_rules)
+        self.files: List[SourceFile] = []
+        self.findings: List[Finding] = []
+        # (file, line, rule) suppressions that actually fired
+        self._used: Set[Tuple[str, int, str]] = set()
+
+    def add(self, sf: SourceFile, line: int, rule: str, message: str) -> None:
+        disabled = sf.disables.get(line, ())
+        if rule in disabled:
+            self._used.add((sf.rel, line, rule))
+            return
+        self.findings.append(Finding(sf.rel, line, rule, message))
+
+    def finish(self) -> List[Finding]:
+        """Emit stale-suppression findings and return the sorted list."""
+        for sf in self.files:
+            for line, rules in sf.disables.items():
+                for rule in sorted(rules):
+                    if rule not in self.known_rules:
+                        self.findings.append(Finding(
+                            sf.rel, line, "stale-suppression",
+                            f"disable names unknown rule '{rule}'",
+                        ))
+                    elif (sf.rel, line, rule) not in self._used:
+                        self.findings.append(Finding(
+                            sf.rel, line, "stale-suppression",
+                            f"suppression of '{rule}' no longer matches "
+                            f"any finding — remove it",
+                        ))
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        return self.findings
+
+
+def _iter_py_files(root: Path) -> Iterable[Path]:
+    if root.is_file():
+        if root.suffix == ".py":
+            yield root
+        return
+    for path in sorted(root.rglob("*.py")):
+        parts = path.relative_to(root).parts
+        if any(p.startswith(".") or p == "__pycache__" for p in parts):
+            continue
+        yield path
+
+
+def _find_vocab_file(repo_root: Path, files: List[SourceFile]) -> Optional[SourceFile]:
+    for sf in files:
+        if sf.rel.replace("\\", "/").endswith("ipc_proofs_tpu/utils/metrics.py"):
+            return sf
+    # vocab may live outside the scanned paths (e.g. linting tools/ only)
+    cand = repo_root / "ipc_proofs_tpu" / "utils" / "metrics.py"
+    if cand.is_file():
+        rel = str(cand.relative_to(repo_root))
+        return SourceFile(cand, rel, cand.read_text(encoding="utf-8"))
+    return None
+
+
+def lint_paths(
+    paths: Iterable[str],
+    repo_root: Optional[str] = None,
+    known_rules: Optional[Iterable[str]] = None,
+    check_vocab: bool = True,
+) -> LintRun:
+    """Lint every ``*.py`` under ``paths`` and return the finished run.
+
+    ``repo_root`` anchors display paths and the metrics-vocabulary
+    lookup; it defaults to the parent of this package's parent (the
+    repo checkout). ``check_vocab=False`` skips the cross-file
+    vocabulary rules — used by fixture tests that lint snippets with
+    no metrics module in scope.
+    """
+    from tools import ipclint as _pkg
+    from tools.ipclint import checks_det, checks_err, checks_race, checks_vocab
+
+    root = Path(repo_root) if repo_root else Path(__file__).resolve().parents[2]
+    run = LintRun(known_rules if known_rules is not None else _pkg.RULES)
+
+    for raw in paths:
+        p = Path(raw)
+        if not p.is_absolute():
+            p = root / p
+        for f in _iter_py_files(p):
+            try:
+                rel = str(f.resolve().relative_to(root.resolve()))
+            except ValueError:
+                rel = str(f)
+            run.files.append(SourceFile(f, rel, f.read_text(encoding="utf-8")))
+
+    for sf in run.files:
+        checks_race.check(run, sf)
+        checks_err.check(run, sf)
+        if sf.in_det_scope:
+            checks_det.check(run, sf)
+
+    if check_vocab:
+        vocab_sf = _find_vocab_file(root, run.files)
+        if vocab_sf is not None:
+            checks_vocab.check(run, vocab_sf)
+
+    run.finish()
+    return run
