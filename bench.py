@@ -94,9 +94,14 @@ def _parse_args(argv=None):
         help="measured e2e passes; the headline is the best (--quick uses 3)",
     )
     parser.add_argument(
-        "--scan-threads", type=int, default=None,
-        help="scan+match workers for the e2e leg's stage-overlapped pipeline "
+        "--threads", type=int, default=None,
+        help="ONE thread budget for the e2e leg's range engine, partitioned "
+        "over scan/record/verify workers + native scan fan-out "
         "(default: the process affinity core count)",
+    )
+    parser.add_argument(
+        "--scan-threads", type=int, default=None,
+        help="legacy: pin the e2e pipeline's scan+match worker count",
     )
     parser.add_argument(
         "--pipeline-depth", type=int, default=2,
@@ -208,7 +213,14 @@ def _leg_e2e(args) -> dict:
         if hasattr(os, "sched_getaffinity")
         else host_cores
     )
-    scan_threads = args.scan_threads or host_cores_affinity or 1
+    # the bench resolves the SAME budget the drivers would and passes the
+    # split explicitly, so the artifact records the real parallelism
+    from ipc_proofs_tpu.utils.threads import resolve_thread_budget
+
+    budget = resolve_thread_budget(
+        threads=args.threads, scan_threads=args.scan_threads
+    )
+    scan_threads = budget.scan_workers
     pipeline_depth = max(1, args.pipeline_depth)
     # pipelined chunking: enough chunks in flight to feed every scan worker
     # plus the queue depth, floored so tiny worlds still form a pipeline
@@ -235,6 +247,9 @@ def _leg_e2e(args) -> dict:
             verify_chunk=lambda b: _staged_verify(b, backend),
             match_backend=backend, metrics=metrics,
             scan_threads=scan_threads, pipeline_depth=pipeline_depth,
+            record_workers=budget.record_workers,
+            verify_workers=budget.verify_workers,
+            threads=args.threads,
         )
         t_wall = time.perf_counter() - t0
         results = [r for res, _ in chunk_out for r in res]
@@ -349,8 +364,12 @@ def _leg_e2e(args) -> dict:
         "devices": len(jax.devices()),
         "host_cores": host_cores,
         "host_cores_affinity": host_cores_affinity,
-        # the pipeline's effective scan+match worker count for this leg
+        # the pipeline's effective per-stage worker counts for this leg,
+        # plus the ONE budget they were partitioned from
         "scan_threads": scan_threads if pipe_best is not None else 1,
+        "record_workers": budget.record_workers if pipe_best is not None else 1,
+        "verify_workers": budget.verify_workers if pipe_best is not None else 1,
+        "effective_threads": budget.total,
         "native_scan_threads": native_scan_threads,
         "pipeline_depth": pipeline_depth if pipe_best is not None else None,
         "pipeline_chunk": pipe_chunk if pipe_best is not None else len(pairs),
@@ -1207,7 +1226,8 @@ def _native_baseline(n_pairs_sample: int, receipts: int, events: int) -> float:
 # exactly this schema so consumers can always index the full key set
 _E2E_SCHEMA_KEYS = (
     "value", "platform", "devices", "host_cores", "host_cores_affinity",
-    "scan_threads", "native_scan_threads", "pipeline_depth",
+    "scan_threads", "record_workers", "verify_workers", "effective_threads",
+    "native_scan_threads", "pipeline_depth",
     "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
     "stages_wall_ms", "stages_overlap", "gen_verify_overlap",
     "overlap_efficiency", "serial_proofs_per_sec", "serial_e2e_reps_s",
@@ -1255,6 +1275,8 @@ def _run_leg(name: str, args, platform: str) -> tuple:
     ]
     if args.scan_threads is not None:
         cmd += ["--scan-threads", str(args.scan_threads)]
+    if args.threads is not None:
+        cmd += ["--threads", str(args.threads)]
     if args.quick:
         cmd.append("--quick")
     if args.profile and name == "e2e":

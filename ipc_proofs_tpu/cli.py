@@ -64,29 +64,41 @@ def _make_rpc_client(args, metrics=None):
 
 
 def _start_tracing(args) -> bool:
-    """Enable the span collector when ``--trace-out`` was given."""
-    if not getattr(args, "trace_out", None):
+    """Enable the span collector when ``--trace-out`` or ``--trace-otlp``
+    was given; ``--trace-sample`` head-samples whole traces at the
+    collector (the always-on flight ring is unaffected)."""
+    if not (getattr(args, "trace_out", None) or getattr(args, "trace_otlp", None)):
         return False
     from ipc_proofs_tpu.obs import enable_tracing
 
-    enable_tracing()
+    enable_tracing(sample=getattr(args, "trace_sample", 1.0))
     return True
 
 
 def _finish_tracing(args) -> None:
-    """Export collected spans to ``--trace-out`` as Chrome trace JSON
-    (load at ui.perfetto.dev or chrome://tracing)."""
-    from ipc_proofs_tpu.obs import disable_tracing, get_collector, write_chrome_trace
+    """Export collected spans to ``--trace-out`` (Chrome trace JSON, load
+    at ui.perfetto.dev or chrome://tracing) and/or ``--trace-otlp``
+    (OTLP/JSON, POST-able to a collector's /v1/traces)."""
+    from ipc_proofs_tpu.obs import (
+        disable_tracing,
+        get_collector,
+        write_chrome_trace,
+        write_otlp_trace,
+    )
 
     collector = get_collector()
     spans = collector.snapshot() if collector is not None else []
     dropped = collector.dropped if collector is not None else 0
     disable_tracing()
-    n = write_chrome_trace(args.trace_out, spans)
-    log.info(
-        "trace: %d events → %s%s", n, args.trace_out,
-        f" ({dropped} spans dropped at capacity)" if dropped else "",
-    )
+    if getattr(args, "trace_out", None):
+        n = write_chrome_trace(args.trace_out, spans)
+        log.info(
+            "trace: %d events → %s%s", n, args.trace_out,
+            f" ({dropped} spans dropped at capacity)" if dropped else "",
+        )
+    if getattr(args, "trace_otlp", None):
+        n = write_otlp_trace(args.trace_otlp, spans)
+        log.info("trace: %d spans → %s (OTLP/JSON)", n, args.trace_otlp)
 
 
 def _cmd_generate(args) -> int:
@@ -267,17 +279,20 @@ def _cmd_range(args) -> int:
         # splits into sub-chunks so scan workers overlap recording while
         # checkpointing (and resume) stay at --chunk-size granularity
         import functools
-        import os as _os
 
-        eff_threads = args.scan_threads or _os.cpu_count() or 1
         from ipc_proofs_tpu.proofs.range import (
             generate_event_proofs_for_range_pipelined,
         )
+        from ipc_proofs_tpu.utils.threads import resolve_thread_budget
 
+        budget = resolve_thread_budget(
+            threads=args.threads, scan_threads=args.scan_threads
+        )
         generate_fn = functools.partial(
             generate_event_proofs_for_range_pipelined,
-            chunk_size=max(1, args.chunk_size // max(2, eff_threads)),
+            chunk_size=max(1, args.chunk_size // max(2, budget.total)),
             scan_threads=args.scan_threads,
+            threads=args.threads,
             pipeline_depth=args.pipeline_depth,
         )
 
@@ -560,6 +575,7 @@ def _cmd_serve(args) -> int:
             verify_witness_cids=args.check_cids,
             range_scan_threads=args.scan_threads,
             range_pipeline_depth=args.pipeline_depth,
+            threads=args.threads,
             slow_request_ms=args.slow_ms,
         ),
         endpoint_pool=endpoint_pool,
@@ -628,6 +644,21 @@ def main(argv=None) -> int:
             "breaker (default 5)",
         )
 
+    def add_trace_export_flags(p):
+        p.add_argument(
+            "--trace-otlp", default=None, metavar="PATH",
+            help="also export collected spans as OTLP/JSON "
+            "(resourceSpans/scopeSpans shape — POST to any OpenTelemetry "
+            "collector's /v1/traces)",
+        )
+        p.add_argument(
+            "--trace-sample", type=float, default=1.0, metavar="RATE",
+            help="head-sample collected traces at this rate in [0,1] "
+            "(decided once per trace from its id, so exported trees are "
+            "never torn; the always-on flight recorder ignores sampling). "
+            "Default 1.0",
+        )
+
     gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")
     gen.add_argument("--endpoint", required=True, help="Lotus JSON-RPC endpoint URL")
     gen.add_argument("--token", default=None, help="bearer token")
@@ -656,6 +687,7 @@ def main(argv=None) -> int:
         help="export all request/stage/RPC spans as Chrome trace-event "
         "JSON (open at ui.perfetto.dev)",
     )
+    add_trace_export_flags(gen)
     gen.set_defaults(fn=_cmd_generate)
 
     ver = sub.add_parser("verify", help="verify a saved bundle offline")
@@ -695,9 +727,17 @@ def main(argv=None) -> int:
     )
     rng.add_argument("--chunk-size", type=int, default=64)
     rng.add_argument(
+        "--threads", type=int, default=None,
+        help="ONE thread budget for the whole range engine: partitioned "
+        "over scan/record/verify stage workers and the native scanner's "
+        "per-call fan-out so the process never oversubscribes "
+        "(flag > IPC_THREADS env > --scan-threads > IPC_SCAN_THREADS > "
+        "CPU affinity; the resolved split is logged once)",
+    )
+    rng.add_argument(
         "--scan-threads", type=int, default=None,
-        help="scan+match workers in the stage-overlapped pipeline "
-        "(default: os.cpu_count())",
+        help="legacy: pin the scan+match stage worker count (also sets "
+        "the whole budget when --threads/IPC_THREADS are absent)",
     )
     rng.add_argument(
         "--pipeline-depth", type=int, default=2,
@@ -733,6 +773,7 @@ def main(argv=None) -> int:
         "JSON (open at ui.perfetto.dev); unlike --profile this traces the "
         "whole run — scans, RPC retries, journal fsyncs — not just XLA",
     )
+    add_trace_export_flags(rng)
     rng.set_defaults(fn=_cmd_range)
 
     vec = sub.add_parser(
@@ -824,9 +865,17 @@ def main(argv=None) -> int:
         help="optional TTL on cached blocks",
     )
     srv.add_argument(
+        "--threads", type=int, default=None,
+        help="ONE thread budget for multi-pair generate batches "
+        "(stage-overlapped range engine): partitioned over "
+        "scan/record/verify workers + native scan fan-out "
+        "(flag > IPC_THREADS > --scan-threads > IPC_SCAN_THREADS > "
+        "CPU affinity)",
+    )
+    srv.add_argument(
         "--scan-threads", type=int, default=None,
-        help="scan+match workers for multi-pair generate batches "
-        "(stage-overlapped range engine; default: os.cpu_count())",
+        help="legacy: pin the scan+match stage worker count for "
+        "multi-pair generate batches",
     )
     srv.add_argument(
         "--pipeline-depth", type=int, default=2,
@@ -849,6 +898,7 @@ def main(argv=None) -> int:
         help="export every request's spans as Chrome trace-event JSON on "
         "shutdown (open at ui.perfetto.dev)",
     )
+    add_trace_export_flags(srv)
     srv.set_defaults(fn=_cmd_serve)
 
     args = parser.parse_args(argv)

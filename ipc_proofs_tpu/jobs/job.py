@@ -35,6 +35,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 from typing import Any, Optional
 
@@ -83,7 +84,16 @@ def job_manifest(spec_repr: bytes, pairs, chunk_size: int) -> dict:
 
 
 class RangeJob:
-    """One resumable range job: completed-chunk map + journal appender."""
+    """One resumable range job: completed-chunk map + journal appender.
+
+    Commit methods are thread-safe: the pipelined driver's record and
+    verify stages run several workers, and `JournalWriter.append` is NOT
+    safe to call concurrently (interleaved frames would tear the journal,
+    and the ``IPC_JOURNAL_CRASH_AT`` record-count clock in the crash
+    harness must tick one append at a time). One lock serializes every
+    append together with its completed-map update, so a journal record
+    and the in-memory map can never disagree mid-commit.
+    """
 
     def __init__(
         self,
@@ -95,21 +105,24 @@ class RangeJob:
     ):
         self.job_dir = job_dir
         self.manifest = manifest
-        self.completed = completed  # chunk index → journal record
-        self._writer = writer
+        self._lock = threading.Lock()
+        self.completed = completed  # guarded-by: _lock
+        self._writer = writer  # guarded-by: _lock
         self._metrics = metrics
 
     # -- resume side -----------------------------------------------------
 
     def has_chunk(self, index: int) -> bool:
-        return index in self.completed
+        with self._lock:
+            return index in self.completed
 
     def bundle_obj(self, index: int, expect_digest: "str | None" = None) -> Any:
         """The committed bundle JSON object for chunk ``index``; verifies
         the stored per-chunk digest when the caller knows it — a mismatch
         means the journal belongs to different data and must never be
         spliced into this run's bundle."""
-        rec = self.completed[index]
+        with self._lock:
+            rec = self.completed[index]
         if expect_digest is not None and rec.get("digest") != expect_digest:
             raise JournalError(
                 f"journal chunk {index} digest {rec.get('digest')!r} != "
@@ -130,24 +143,28 @@ class RangeJob:
             "bundle": bundle.to_json_obj(),
             "verify": verify,
         }
-        ok = self._writer.append(rec)
-        self.completed[index] = rec
-        self._commit_done(t0, w0)
+        with self._lock:
+            ok = self._writer.append(rec)
+            self.completed[index] = rec
+            jb = self._writer.journal_bytes
+        self._commit_done(t0, w0, jb)
         return ok
 
     def commit_verdict(self, index: int, digest: "str | None", verify) -> bool:
         """Attach a verify verdict to an already-committed chunk."""
         t0 = time.thread_time()
         w0 = time.perf_counter()
-        ok = self._writer.append(
-            {"t": "verdict", "chunk": index, "digest": digest, "verify": verify}
-        )
-        if index in self.completed:
-            self.completed[index]["verify"] = verify
-        self._commit_done(t0, w0)
+        with self._lock:
+            ok = self._writer.append(
+                {"t": "verdict", "chunk": index, "digest": digest, "verify": verify}
+            )
+            if index in self.completed:
+                self.completed[index]["verify"] = verify
+            jb = self._writer.journal_bytes
+        self._commit_done(t0, w0, jb)
         return ok
 
-    def _commit_done(self, t0: float, w0: float) -> None:
+    def _commit_done(self, t0: float, w0: float, journal_bytes: int) -> None:
         # Two clocks on purpose. jobs.commit_us is thread CPU time:
         # commits run in the pipelined driver's record stage, where wall
         # time would also count GIL/IO waits spent productively scanning
@@ -162,22 +179,21 @@ class RangeJob:
             self._metrics.count(
                 "jobs.chunk_journal_us", int((time.perf_counter() - w0) * 1e6)
             )
-        self._update_gauge()
-
-    def _update_gauge(self) -> None:
-        if self._metrics is not None:
-            self._metrics.set_gauge("jobs.journal_bytes", self._writer.journal_bytes)
+            self._metrics.set_gauge("jobs.journal_bytes", journal_bytes)
 
     @property
     def journal_bytes(self) -> int:
-        return self._writer.journal_bytes
+        with self._lock:
+            return self._writer.journal_bytes
 
     @property
     def degraded(self) -> bool:
-        return self._writer.degraded
+        with self._lock:
+            return self._writer.degraded
 
     def close(self) -> None:
-        self._writer.close()
+        with self._lock:
+            self._writer.close()
 
     def __enter__(self) -> "RangeJob":
         return self

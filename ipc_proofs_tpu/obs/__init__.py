@@ -3,7 +3,8 @@ Prometheus exposition, and an always-on flight recorder.
 
 - `obs/trace.py`  — contextvar-propagated `TraceContext` + `span()`,
   bounded `SpanCollector` (opt-in via `enable_tracing()` / `--trace-out`)
-- `obs/export.py` — Chrome trace-event JSON for ui.perfetto.dev
+- `obs/export.py` — Chrome trace-event JSON for ui.perfetto.dev, plus
+  OTLP/JSON for OpenTelemetry collectors (`--trace-otlp`)
 - `obs/prom.py`   — Prometheus text exposition over `Metrics` snapshots
 - `obs/flight.py` — bounded ring of recent spans + WARN/ERROR log records,
   served at `/debug/flight`, dumped to stderr on unhandled errors
@@ -14,7 +15,9 @@ See README "Observability".
 from ipc_proofs_tpu.obs.export import (
     chrome_trace_events,
     chrome_trace_obj,
+    otlp_trace_obj,
     write_chrome_trace,
+    write_otlp_trace,
 )
 from ipc_proofs_tpu.obs.flight import (
     FlightLogHandler,
@@ -55,6 +58,7 @@ __all__ = [
     "get_collector",
     "get_flight_recorder",
     "install_crash_dump",
+    "otlp_trace_obj",
     "render_prometheus",
     "root_span",
     "span",
@@ -62,4 +66,5 @@ __all__ = [
     "tracing_enabled",
     "use_context",
     "write_chrome_trace",
+    "write_otlp_trace",
 ]

@@ -1,13 +1,22 @@
-"""Chrome trace-event JSON export — load the file into ui.perfetto.dev (or
-chrome://tracing) and every worker thread gets its own lane of complete
-("ph":"X") events, with trace/span/parent ids in args for correlation.
+"""Trace export: Chrome trace-event JSON for Perfetto, and OTLP-shaped
+JSON for OpenTelemetry tooling.
 
-Format reference: the Trace Event Format doc (Google, "JSON Array Format"
-/ object form with a ``traceEvents`` key). We emit:
+Chrome export — load the file into ui.perfetto.dev (or chrome://tracing)
+and every worker thread gets its own lane of complete ("ph":"X") events,
+with trace/span/parent ids in args for correlation. Format reference: the
+Trace Event Format doc (Google, "JSON Array Format" / object form with a
+``traceEvents`` key). We emit:
   - one ``M`` (metadata) event per thread naming its lane, plus a process
     name, and
   - one ``X`` (complete) event per span with ``ts``/``dur`` in
     microseconds on the monotonic clock.
+
+OTLP export — the OTLP/JSON `ExportTraceServiceRequest` shape
+(``resourceSpans`` → ``scopeSpans`` → ``spans``) so the file can be
+POSTed to any collector's ``/v1/traces`` endpoint or inspected with
+OTel-aware tooling. Ids are hex, zero-padded to the protocol widths
+(32-char traceId, 16-char spanId); timestamps are epoch nanoseconds
+reconstructed from the span's wall clock plus its monotonic duration.
 """
 
 from __future__ import annotations
@@ -15,7 +24,13 @@ from __future__ import annotations
 import json
 import os
 
-__all__ = ["chrome_trace_events", "chrome_trace_obj", "write_chrome_trace"]
+__all__ = [
+    "chrome_trace_events",
+    "chrome_trace_obj",
+    "write_chrome_trace",
+    "otlp_trace_obj",
+    "write_otlp_trace",
+]
 
 
 def chrome_trace_events(spans) -> list[dict]:
@@ -80,3 +95,56 @@ def write_chrome_trace(path: str, spans) -> int:
         json.dump(obj, fh)
         fh.write("\n")
     return sum(1 for e in obj["traceEvents"] if e.get("ph") == "X")
+
+
+def _otlp_attr(key: str, value) -> dict:
+    """One OTLP KeyValue; everything non-stringy stringifies — the
+    exporter carries diagnostics, not typed telemetry."""
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def otlp_trace_obj(spans) -> dict:
+    """Render spans (obs.trace.Span) as one OTLP/JSON
+    ExportTraceServiceRequest object."""
+    otlp_spans: list[dict] = []
+    for sp in spans:
+        start_ns = int(sp.wall_ts * 1e9)
+        attrs = [_otlp_attr("thread.name", sp.thread_name or "")]
+        if sp.attrs:
+            attrs.extend(_otlp_attr(k, v) for k, v in sp.attrs.items())
+        rec = {
+            "traceId": sp.trace_id.zfill(32),
+            "spanId": sp.span_id.zfill(16),
+            "name": sp.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(start_ns + sp.dur_us * 1000),
+            "attributes": attrs,
+        }
+        if sp.parent_id:
+            rec["parentSpanId"] = sp.parent_id.zfill(16)
+        otlp_spans.append(rec)
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [_otlp_attr("service.name", "ipc-proofs-tpu")]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "ipc_proofs_tpu.obs"},
+                        "spans": otlp_spans,
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def write_otlp_trace(path: str, spans) -> int:
+    """Write the OTLP export; returns the number of spans written."""
+    obj = otlp_trace_obj(spans)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)
+        fh.write("\n")
+    return len(obj["resourceSpans"][0]["scopeSpans"][0]["spans"])

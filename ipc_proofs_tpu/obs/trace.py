@@ -55,11 +55,15 @@ def _new_trace_id() -> str:
 
 @dataclass(frozen=True)
 class TraceContext:
-    """The propagated identity: which trace we are in and which span is the
-    ambient parent for anything opened next."""
+    """The propagated identity: which trace we are in, which span is the
+    ambient parent for anything opened next, and whether this trace won
+    the head-sampling draw (decided ONCE at the root and carried with the
+    context, so every pipeline stage worker and batcher flush inherits
+    the same verdict via `use_context`)."""
 
     trace_id: str
     span_id: str
+    sampled: bool = True
 
 
 class Span:
@@ -81,6 +85,7 @@ class Span:
         "thread_id",
         "thread_name",
         "attrs",
+        "sampled",
     )
 
     def __init__(self, name: str, trace_id: str, span_id: str, parent_id: str):
@@ -94,6 +99,7 @@ class Span:
         self.thread_id = 0
         self.thread_name = ""
         self.attrs: dict | None = None
+        self.sampled = True
 
     def set_attr(self, key: str, value) -> None:
         if self.attrs is None:
@@ -153,6 +159,13 @@ class SpanCollector:
         with self._lock:
             return list(self._spans)
 
+    def sampled_out(self) -> None:
+        """Count a span skipped because its trace lost the sampling draw
+        (the flight ring still holds it)."""
+        m = self._metrics
+        if m is not None:
+            m.count("trace.spans_sampled_out")
+
     @property
     def dropped(self) -> int:
         with self._lock:
@@ -167,22 +180,48 @@ class SpanCollector:
 # flight recorder is separate and always on.
 _collector: "SpanCollector | None" = None
 
+# Head-sampling rate for NEW traces (decided once per trace at its root
+# span; children inherit the verdict through TraceContext.sampled).
+_sample_rate: float = 1.0
 
-def enable_tracing(capacity: int = 100_000, metrics=None) -> SpanCollector:
+
+def enable_tracing(
+    capacity: int = 100_000, metrics=None, sample: float = 1.0
+) -> SpanCollector:
     """Install (and return) the global span collector. Idempotent-ish: a
-    second call replaces the collector, which is what tests want."""
-    global _collector
+    second call replaces the collector, which is what tests want.
+
+    ``sample`` is the head-sampling rate in [0, 1]: each new trace draws
+    once, deterministically from its trace id, and the whole trace keeps
+    or loses collector retention together (no torn trees). The always-on
+    flight ring ignores sampling — crash/slow-request forensics never go
+    dark."""
+    global _collector, _sample_rate
     if metrics is None:
         from ipc_proofs_tpu.utils.metrics import get_metrics
 
         metrics = get_metrics()
+    _sample_rate = min(1.0, max(0.0, float(sample)))
     _collector = SpanCollector(capacity=capacity, metrics=metrics)
     return _collector
 
 
 def disable_tracing() -> None:
-    global _collector
+    global _collector, _sample_rate
     _collector = None
+    _sample_rate = 1.0
+
+
+def _sample_decision(trace_id: str) -> bool:
+    """Deterministic per-trace draw: the leading 32 trace-id bits as a
+    uniform in [0, 1) compared against the rate — the same trace id gets
+    the same verdict in every process (OTLP-style head sampling)."""
+    rate = _sample_rate
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return int(trace_id[:8], 16) / 0x100000000 < rate
 
 
 def get_collector() -> "SpanCollector | None":
@@ -213,13 +252,17 @@ def use_context(ctx: "TraceContext | None"):
 
 
 def _record(sp: Span) -> None:
-    # flight ring first (always on), then the opt-in collector
+    # flight ring first (always on, sampling-blind), then the opt-in
+    # collector — which only keeps spans of traces that won the draw
     from ipc_proofs_tpu.obs.flight import get_flight_recorder
 
     get_flight_recorder().record_span(sp)
     col = _collector
     if col is not None:
-        col.record(sp)
+        if sp.sampled:
+            col.record(sp)
+        else:
+            col.sampled_out()
 
 
 @contextmanager
@@ -229,8 +272,10 @@ def span(name: str, attrs: "dict | None" = None):
     parent = _CTX.get()
     if parent is None:
         trace_id, parent_id = _new_trace_id(), ""
+        sampled = _sample_decision(trace_id)
     else:
         trace_id, parent_id = parent.trace_id, parent.span_id
+        sampled = parent.sampled
     sp = Span(name, trace_id, f"{next(_span_ids):x}", parent_id)
     if attrs:
         sp.attrs = dict(attrs)
@@ -238,7 +283,8 @@ def span(name: str, attrs: "dict | None" = None):
     sp.thread_id = t.ident or 0
     sp.thread_name = t.name
     sp.wall_ts = time.time()
-    token = _CTX.set(TraceContext(trace_id, sp.span_id))
+    sp.sampled = sampled
+    token = _CTX.set(TraceContext(trace_id, sp.span_id, sampled))
     start = time.perf_counter_ns()
     sp.ts_us = start // 1000
     try:

@@ -43,6 +43,7 @@ __all__ = [
     "make_specs_u32",
     "PipelineStage",
     "run_pipeline",
+    "MatchCoalescer",
 ]
 
 
@@ -169,6 +170,109 @@ def sharded_match_pipeline(mesh, donate: bool = False):
         )
 
     return jitted, shard_batch
+
+
+# --------------------------------------------------------------------------
+# device-call coalescing across concurrent pipeline workers
+# --------------------------------------------------------------------------
+
+
+class _MatchReq:
+    """One worker's parked fp-match request inside a `MatchCoalescer`."""
+
+    __slots__ = ("fp", "n_topics", "emitters", "valid", "key", "done", "result", "exc")
+
+    def __init__(self, fp, n_topics, emitters, valid, key):
+        self.fp = fp
+        self.n_topics = n_topics
+        self.emitters = emitters
+        self.valid = valid
+        self.key = key  # (topic0, topic1, actor_id) — only equal keys combine
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+
+
+class MatchCoalescer:
+    """Combine concurrent ``event_match_mask_fp`` calls from in-flight scan
+    workers into one larger device call.
+
+    Leader-based combining with NO added latency window: every caller
+    parks its request, then queues on the device lock. Whoever gets the
+    lock claims everything parked so far and issues one concatenated call
+    for each distinct (topic0, topic1, actor) key; callers whose request
+    was serviced by an earlier leader skip the call entirely. While a
+    leader is inside the device call, later arrivals pile up behind the
+    lock — so batches grow exactly when the device is the bottleneck and
+    a lone call proceeds immediately.
+
+    Bit-identity: the fp predicate is elementwise per event, so a mask
+    computed over a concatenation, split back at the input offsets,
+    equals the per-request masks — same contract the sharded device
+    pipeline relies on. Counted as ``range_match_coalesced`` (requests
+    that rode another caller's device call).
+    """
+
+    def __init__(self, backend, metrics=None):
+        self._backend = backend
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._call_lock = threading.Lock()  # serializes device dispatch
+        self._pending: "list[_MatchReq]" = []  # guarded-by: _lock
+
+    def match_fp(self, fp, n_topics, emitters, valid, topic0, topic1, actor_id):
+        """Drop-in for ``backend.event_match_mask_fp`` (same signature,
+        same return contract: a mask at least as long as the input)."""
+        req = _MatchReq(fp, n_topics, emitters, valid, (topic0, topic1, actor_id))
+        with self._lock:
+            self._pending.append(req)
+        with self._call_lock:
+            if req.done.is_set():
+                batch: "list[_MatchReq]" = []
+            else:
+                with self._lock:
+                    batch = self._pending
+                    self._pending = []
+            if batch:
+                self._run(batch)
+        if req.exc is not None:
+            raise req.exc
+        return req.result
+
+    def _run(self, batch: "list[_MatchReq]") -> None:
+        groups: "dict[tuple, list[_MatchReq]]" = {}
+        for r in batch:
+            groups.setdefault(r.key, []).append(r)
+        for key, reqs in groups.items():
+            topic0, topic1, actor_id = key
+            try:
+                if len(reqs) == 1:
+                    r = reqs[0]
+                    r.result = self._backend.event_match_mask_fp(
+                        r.fp, r.n_topics, r.emitters, r.valid,
+                        topic0, topic1, actor_id,
+                    )
+                else:
+                    out = self._backend.event_match_mask_fp(
+                        np.concatenate([r.fp for r in reqs]),
+                        np.concatenate([r.n_topics for r in reqs]),
+                        np.concatenate([r.emitters for r in reqs]),
+                        np.concatenate([r.valid for r in reqs]),
+                        topic0, topic1, actor_id,
+                    )
+                    off = 0
+                    for r in reqs:
+                        n = len(r.fp)
+                        r.result = out[off : off + n]
+                        off += n
+                    if self._metrics is not None:
+                        self._metrics.count("range_match_coalesced", len(reqs) - 1)
+            except BaseException as exc:  # fail-soft: every parked waiter re-raises this from its own match_fp call — nothing is swallowed
+                for r in reqs:
+                    r.exc = exc
+            finally:
+                for r in reqs:
+                    r.done.set()
 
 
 # --------------------------------------------------------------------------

@@ -24,6 +24,7 @@ the reference's unified bundle (`src/proofs/generator.rs:25-95`).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -67,6 +68,9 @@ def generate_and_verify_range_overlapped(
     scan_retries: int = 2,
     force_pipeline: "bool | None" = None,
     job_dir: "str | None" = None,
+    record_workers: "int | None" = None,
+    verify_workers: "int | None" = None,
+    threads: "int | None" = None,
 ) -> "tuple[UnifiedProofBundle, list]":
     """Overlap VERIFICATION with generation across chunks: chunk k's bundle
     verifies while chunk k+1 generates — the generation-verification
@@ -74,14 +78,14 @@ def generate_and_verify_range_overlapped(
     structural concurrency on the headline path that needs no extra
     hardware.
 
-    Default path (no ``generate_fn``, no ``storage_specs``): the
-    integrated three-stage pipeline — scan (``scan_threads`` workers)
-    ∥ record ∥ verify in ONE bounded-queue executor
+    Default path (no ``generate_fn``): the integrated pipeline — scan
+    (``scan_threads`` workers) ∥ record (``record_workers``) ∥ merge ∥
+    verify (``verify_workers``) in ONE bounded-queue executor
     (`generate_event_proofs_for_range_pipelined` with its verify stage),
-    so scan(k+1), record(k), and verify(k-1) all run concurrently.
-    Otherwise it composes over the chunked driver: chunk bundles verify
-    on a worker thread via the ``on_chunk`` hook (keeps checkpoints and
-    per-chunk storage proofs working with a custom ``generate_fn``).
+    so scan(k+1), record(k), and verify(k-1) all run concurrently; storage
+    specs flow through the same pipeline as storage chunks. With a custom
+    ``generate_fn`` it composes over the chunked driver instead: chunk
+    bundles verify on a worker thread via the ``on_chunk`` hook.
 
     ``verify_chunk(bundle) -> result`` is the caller's verification closure
     (it runs off-thread; per-chunk results are returned in chunk order).
@@ -90,7 +94,7 @@ def generate_and_verify_range_overlapped(
     the merged bundle is bit-identical to the chunked driver's over the
     same ``chunk_size`` — both pinned by tests/test_range.py.
     """
-    if generate_fn is None and storage_specs is None:
+    if generate_fn is None:
         verify_results: list = []
         merged = generate_event_proofs_for_range_pipelined(
             store,
@@ -99,6 +103,7 @@ def generate_and_verify_range_overlapped(
             chunk_size=chunk_size,
             match_backend=match_backend,
             metrics=metrics,
+            storage_specs=storage_specs,
             scan_threads=scan_threads,
             pipeline_depth=pipeline_depth,
             verify_chunk=verify_chunk,
@@ -107,6 +112,9 @@ def generate_and_verify_range_overlapped(
             scan_retries=scan_retries,
             force_pipeline=force_pipeline,
             job_dir=job_dir,
+            record_workers=record_workers,
+            verify_workers=verify_workers,
+            threads=threads,
         )
         return merged, verify_results
 
@@ -353,20 +361,27 @@ def generate_event_proofs_for_range(
 
 
 def _storage_for_pairs(
-    cached: Blockstore, pairs: Sequence[TipsetPair], storage_specs, hash_backend
+    cached: Blockstore,
+    pairs: Sequence[TipsetPair],
+    storage_specs,
+    hash_backend,
+    slots=None,
 ) -> "tuple[list, set[bytes], list[ProofBlock]]":
     """Prove every storage spec at every pair: slot digests hashed once for
-    the whole range. Returns ``(proofs, witness_cid_bytes,
-    fallback_blocks)`` — the range-batched generator contributes raw CID
-    bytes for the shared end-of-bundle materialization; the per-pair scalar
-    fallback (no native walker) contributes materialized blocks."""
+    the whole range (``slots`` carries the precomputed digests when the
+    pipelined driver proves per-chunk). Returns ``(proofs,
+    witness_cid_bytes, fallback_blocks)`` — the range-batched generator
+    contributes raw CID bytes for the shared end-of-bundle
+    materialization; the per-pair scalar fallback (no native walker)
+    contributes materialized blocks."""
     from ipc_proofs_tpu.proofs.storage_batch import (
         generate_storage_proofs_batch,
         generate_storage_proofs_for_pairs,
         hash_slot_specs,
     )
 
-    slots = hash_slot_specs(storage_specs, hash_backend)
+    if slots is None:
+        slots = hash_slot_specs(storage_specs, hash_backend)
     batched = generate_storage_proofs_for_pairs(cached, pairs, storage_specs, slots)
     if batched is not None:
         proofs, witness_bytes = batched
@@ -394,11 +409,19 @@ def _scan_and_match(
     match_backend,
     metrics: Metrics,
     scan_workers: int = 0,
+    match_call=None,
+    native_threads: "int | None" = None,
 ) -> "tuple[list[list[int]], bool]":
     """Phases A+B: scan every pair's receipts/events, run the match
     predicate, return (matching receipt indices per pair, whether the
     native scan pathway ran — the record phase reuses the same fast block
-    access when it did)."""
+    access when it did).
+
+    ``match_call`` substitutes for ``match_backend.event_match_mask_fp``
+    on the unfused fp path (the pipelined driver passes a
+    `parallel.pipeline.MatchCoalescer` so concurrent chunks share one
+    device call). ``native_threads`` caps the native scanner's per-call
+    pthread fan-out (the caller's share of the process thread budget)."""
     # Phase A: host decode of every pair's receipts + events. With a match
     # backend the native scanner emits flat tensors directly (no per-event
     # Python objects); otherwise (or if the C extension is unavailable) the
@@ -425,7 +448,12 @@ def _scan_and_match(
 
             if has_raw_map(cached):
                 hits = scan_match_hits(
-                    cached, roots, matcher.topic0, matcher.topic1, spec.actor_id_filter
+                    cached,
+                    roots,
+                    matcher.topic0,
+                    matcher.topic1,
+                    spec.actor_id_filter,
+                    threads=native_threads,
                 )
                 if hits is not None:
                     n_events, hit_pairs, hit_exec = hits
@@ -448,7 +476,7 @@ def _scan_and_match(
             # every fetch through the C fallback callable, losing the
             # scan_workers thread-pool overlap that hides network latency.
             if has_raw_map(cached):
-                scan_batch = scan_events_flat(cached, roots)
+                scan_batch = scan_events_flat(cached, roots, threads=native_threads)
         if scan_batch is None:
             if scan_workers > 0:
                 from concurrent.futures import ThreadPoolExecutor
@@ -469,7 +497,12 @@ def _scan_and_match(
                 # fingerprint path when the backend offers it: 8× less
                 # host→device transfer; pass 2 confirms hits exactly either way
                 if hasattr(match_backend, "event_match_mask_fp"):
-                    mask = match_backend.event_match_mask_fp(
+                    fp_call = (
+                        match_call
+                        if match_call is not None
+                        else match_backend.event_match_mask_fp
+                    )
+                    mask = fp_call(
                         scan_batch.fp,
                         scan_batch.n_topics,
                         scan_batch.emitters,
@@ -661,6 +694,76 @@ def _materialize_witness(
     return [by_cid[k] for k in sorted(by_cid)]
 
 
+_SKIP = object()
+"""Merge-stage sentinel: "folded; nothing for the verify stage"."""
+
+
+class _MergeFold:
+    """Merge-on-arrival accumulator for the pipelined driver.
+
+    The old pipelined driver buffered every chunk's witness CIDs in shared
+    sets mutated by a single record worker and ran ONE post-drain
+    CID-sorted union + materialization after the pipeline finished — a
+    serial tail that grew with range size. This fold replaces it: the
+    merge stage folds each chunk's output the moment the ordered emitter
+    delivers it (input order), materializing only the CIDs no earlier
+    chunk already contributed, so the post-pipeline step shrinks to one
+    final sort over already-materialized blocks.
+
+    Bit-identity with the post-drain union holds by construction: the
+    store is content-addressed (one CID ⇒ one byte string, so first-wins
+    vs last-wins insertion is immaterial), the per-chunk ``todo`` sets
+    partition exactly the CID set the old single pass covered, and
+    `finish` emits the same canonical CID-byte-sorted order.
+
+    The merge stage runs one worker, but the accumulator is still
+    lock-guarded: the driver thread reads the proof counts after the
+    pipeline drains, and the serial fallback folds from the caller
+    thread.
+    """
+
+    def __init__(self, cached: Blockstore):
+        self._cached = cached
+        self._lock = threading.Lock()
+        self.event_proofs: list = []  # guarded-by: _lock
+        self.storage_proofs: list = []  # guarded-by: _lock
+        self._by_cid: "dict[bytes, ProofBlock]" = {}  # guarded-by: _lock
+
+    def fold(self, proofs, witness_bytes, extra_blocks, storage: bool = False):
+        """Fold one chunk's output: proofs concatenate in arrival order
+        (= input order under the ordered emitter), already-materialized
+        ``extra_blocks`` register by CID bytes, and only the
+        not-yet-seen ``witness_bytes`` CIDs materialize from the store."""
+        with self._lock:
+            (self.storage_proofs if storage else self.event_proofs).extend(proofs)
+            for block in extra_blocks:
+                self._by_cid.setdefault(block.cid.to_bytes(), block)
+            todo = set(witness_bytes) - self._by_cid.keys()
+            if todo:
+                for block in _materialize_witness(self._cached, todo):
+                    self._by_cid.setdefault(block.cid.to_bytes(), block)
+
+    def finish(self) -> UnifiedProofBundle:
+        """One final CID-byte sort over the (already materialized) union
+        — the bundle's canonical witness order."""
+        with self._lock:
+            return UnifiedProofBundle(
+                storage_proofs=self.storage_proofs,
+                event_proofs=self.event_proofs,
+                blocks=[self._by_cid[k] for k in sorted(self._by_cid)],
+            )
+
+    @property
+    def n_event_proofs(self) -> int:
+        with self._lock:
+            return len(self.event_proofs)
+
+    @property
+    def n_storage_proofs(self) -> int:
+        with self._lock:
+            return len(self.storage_proofs)
+
+
 def generate_event_proofs_for_range_pipelined(
     store: Blockstore,
     pairs: Sequence[TipsetPair],
@@ -677,23 +780,40 @@ def generate_event_proofs_for_range_pipelined(
     scan_retries: int = 2,
     force_pipeline: "bool | None" = None,
     job_dir: "str | None" = None,
+    record_workers: "int | None" = None,
+    verify_workers: "int | None" = None,
+    threads: "int | None" = None,
 ) -> UnifiedProofBundle:
     """Stage-overlapped range generation on the bounded-queue pipeline
-    executor (`parallel.pipeline.run_pipeline`): the range splits into
-    chunks that flow scan+match (``scan_threads`` workers, default
-    ``os.cpu_count()``) → record (one worker, chunk order) → optional
-    incremental verify, with at most ``pipeline_depth`` chunks buffered
-    between stages. Chunk k records while chunks k+1.. scan; with
-    ``verify_chunk`` set, chunk k-1 replays alongside both
-    (verify-while-generate).
+    executor (`parallel.pipeline.run_pipeline`): chunks flow scan+match →
+    record → merge → optional verify with at most ``pipeline_depth``
+    chunks buffered between stages. Every stage except merge is
+    multi-worker. The shared thread budget
+    (`utils.threads.resolve_thread_budget`: ``threads`` > ``IPC_THREADS``
+    > ``scan_threads`` > ``IPC_SCAN_THREADS`` > CPU affinity) partitions
+    into scan/record/verify workers plus the native scanner's per-call
+    pthread fan-out, so the process never runs more threads than the
+    budget; ``record_workers`` / ``verify_workers`` override their shares
+    explicitly.
 
-    Bundle output is bit-identical to the unpipelined driver over the same
-    chunking (the ordered emitter hands chunks to the record stage in
-    input order; the witness union is CID-sorted, and per-chunk claim
-    emission order is deterministic) — enforced by tests/test_range.py.
-    A worker exception cancels pending work and re-raises here. Overlap
-    pays on multi-core hosts and on hosts where the device dispatch or
-    block fetches have real latency.
+    Record is chunk-local — each worker builds its own proofs +
+    witness-CID buffer with no shared state — and the single-worker merge
+    stage folds outputs in input order (`_MergeFold`), replacing the old
+    post-drain serial witness union. Storage specs no longer prove in a
+    range-wide pass after the pipeline: each chunk's storage leg rides
+    the SAME pipeline as a tagged storage item (slot keccaks still hashed
+    once up front), so storage proving overlaps event scan/record. When
+    several scan workers are in flight on the unfused fp-match path,
+    their per-chunk device predicate calls coalesce into one batched
+    dispatch (`parallel.pipeline.MatchCoalescer`) — fewer, larger device
+    calls with bit-identical masks (the predicate is elementwise).
+
+    Bundle output is bit-identical to the unpipelined driver over the
+    same chunking for ANY worker/depth/chunk-size combination (pinned by
+    tests/test_range_pipeline.py's grid): the ordered emitter hands the
+    merge stage chunk outputs in input order, proofs concatenate in chunk
+    order, and the witness union is content-addressed and CID-sorted. A
+    worker exception cancels pending work and re-raises here.
 
     **Single-core fallback:** on a host where ``os.cpu_count() == 1`` the
     pipeline's queue/thread overhead costs more than the overlap pays
@@ -705,8 +825,8 @@ def generate_event_proofs_for_range_pipelined(
     ``verify_chunk(bundle) -> result`` switches the record stage to emit a
     self-contained bundle per chunk (its witness covers exactly its
     proofs) for the verify stage; per-chunk results append to
-    ``verify_results`` in chunk order. Storage specs still prove
-    range-wide and appear only in the merged bundle.
+    ``verify_results`` in chunk order. Storage proofs appear only in the
+    merged bundle, never in per-chunk bundles.
 
     ``checkpoint_dir`` makes the pipelined path resumable with the same
     per-chunk checkpoint files as `generate_event_proofs_for_range_chunked`
@@ -721,24 +841,37 @@ def generate_event_proofs_for_range_pipelined(
     (`ipc_proofs_tpu.jobs`): every completed chunk appends one fsync'd
     write-ahead journal record, so a SIGKILL at ANY byte — including
     mid-record (torn tail) — resumes to a byte-identical final bundle
-    (pinned by tools/crashtest.py). The record stage journals chunks as
-    they complete; with a verify stage the verdict journals with the
-    chunk. On a worker failure the journaling stage's queued inputs are
-    drained (`PipelineStage.drain_on_cancel`) so chunks whose upstream
-    work finished are still committed before the exception re-raises.
+    (pinned by tools/crashtest.py, including its concurrent-record
+    seeds). Concurrent record workers may commit chunks out of index
+    order — the journal's per-index completed map makes that resume-safe
+    — and `jobs.RangeJob` serializes the appends, so the journal's
+    record-count clock stays deterministic. On a worker failure the
+    journaling stage's queued inputs are drained
+    (`PipelineStage.drain_on_cancel`) so chunks whose upstream work
+    finished are still committed before the exception re-raises.
     """
     import os
 
-    from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
+    from ipc_proofs_tpu.parallel.pipeline import (
+        MatchCoalescer,
+        PipelineStage,
+        run_pipeline,
+    )
     from ipc_proofs_tpu.store.rpc import RpcError
+    from ipc_proofs_tpu.utils.threads import resolve_thread_budget
 
     metrics = metrics if metrics is not None else get_metrics()
     matcher = EventMatcher(spec.event_signature, spec.topic_1)
     cached = CachedBlockstore(store)
     chunks = [pairs[k : k + chunk_size] for k in range(0, len(pairs), chunk_size)]
-    if scan_threads is None:
-        scan_threads = os.cpu_count() or 1
-    scan_threads = max(1, int(scan_threads))
+    budget = resolve_thread_budget(threads=threads, scan_threads=scan_threads)
+    scan_workers = budget.scan_workers
+    rec_workers = (
+        max(1, int(record_workers)) if record_workers else budget.record_workers
+    )
+    ver_workers = (
+        max(1, int(verify_workers)) if verify_workers else budget.verify_workers
+    )
     if force_pipeline is None:
         force_pipeline = os.environ.get("IPC_FORCE_PIPELINE", "") == "1"
     serial_fallback = (os.cpu_count() or 1) == 1 and not force_pipeline
@@ -775,27 +908,52 @@ def generate_event_proofs_for_range_pipelined(
         verify_chunk is not None or checkpoint_dir is not None or job is not None
     )
 
-    event_proofs: list = []
-    witness_bytes: set[bytes] = set()
-    fallback_blocks: list[ProofBlock] = []
-    chunk_blocks: set[ProofBlock] = set()
+    storage_slots = None
+    if storage_specs:
+        from ipc_proofs_tpu.proofs.storage_batch import hash_slot_specs
+
+        # one keccak batch covers every chunk's storage leg
+        with metrics.stage("range_storage"):
+            storage_slots = hash_slot_specs(storage_specs, match_backend)
+
+    fold = _MergeFold(cached)
+
+    match_call = None
+    if (
+        not serial_fallback
+        and scan_workers > 1
+        and match_backend is not None
+        and hasattr(match_backend, "event_match_mask_fp")
+    ):
+        match_call = MatchCoalescer(match_backend, metrics=metrics).match_fp
 
     def _scan_once(chunk):
         # _scan_and_match times itself (range_scan / range_match) — the
         # executor must not wrap it again (no metrics_stage here)
-        return _scan_and_match(cached, chunk, spec, matcher, match_backend, metrics)
+        return _scan_and_match(
+            cached,
+            chunk,
+            spec,
+            matcher,
+            match_backend,
+            metrics,
+            match_call=match_call,
+            native_threads=budget.native_scan_threads,
+        )
 
     def _scan(item):
-        index, chunk = item
+        kind, index, chunk = item
+        if kind == "storage":
+            return item  # storage proves in the record stage; nothing to scan
         if job is not None and job.has_chunk(index):
-            return index, chunk, None  # journal-committed — record replays it
+            return kind, index, chunk, None  # journal-committed — record replays it
         path = _ckpt_path(index, chunk)
         if path is not None and os.path.exists(path):
-            return index, chunk, None  # resumed — record loads from disk
+            return kind, index, chunk, None  # resumed — record loads from disk
         attempt = 0
         while True:
             try:
-                return index, chunk, _scan_once(chunk)
+                return kind, index, chunk, _scan_once(chunk)
             except RpcError:
                 raise  # semantic protocol errors: retrying re-asks the same question
             except (ConnectionError, TimeoutError, OSError, RuntimeError) as exc:
@@ -811,7 +969,18 @@ def generate_event_proofs_for_range_pipelined(
                 )
 
     def _record(scanned):
-        index, chunk, scan_out = scanned
+        # chunk-local: every branch returns a tagged tuple for the merge
+        # stage and touches NO shared accumulator (that is what lets the
+        # stage run several workers while staying bit-identical)
+        if scanned[0] == "storage":
+            _, index, chunk = scanned
+            with metrics.stage("range_storage"):
+                proofs, witness, blocks = _storage_for_pairs(
+                    cached, chunk, storage_specs, match_backend, slots=storage_slots
+                )
+            metrics.count("range_storage_proofs", len(proofs))
+            return "storage", proofs, witness, blocks
+        _, index, chunk, scan_out = scanned
         path = _ckpt_path(index, chunk)
         if scan_out is None:
             with metrics.stage("range_record"):
@@ -823,25 +992,17 @@ def generate_event_proofs_for_range_pipelined(
                     with open(path) as fh:
                         bundle = UnifiedProofBundle.from_json(fh.read())
                 metrics.count("range_chunks_resumed")
-                event_proofs.extend(bundle.event_proofs)
-                chunk_blocks.update(bundle.blocks)
-            if verify_chunk is not None:
-                return index, chunk, bundle, False  # resumed: already journaled
-            return None
+            return "bundle", index, chunk, bundle, False  # already journaled
         matching_per_pair, native_ok = scan_out
         with metrics.stage("range_record"):
             proofs, chunk_witness, chunk_fallback = _record_chunk(
                 cached, chunk, matching_per_pair, matcher, spec, native_ok
             )
-            event_proofs.extend(proofs)
             if not per_chunk_bundles:
-                witness_bytes.update(chunk_witness)
-                fallback_blocks.extend(chunk_fallback)
-                return None
+                return "chunk", proofs, chunk_witness, chunk_fallback
             # verify/checkpoint/journal mode: materialize a self-contained
             # chunk bundle so it can replay off-thread and/or persist
             blocks = _materialize_witness(cached, chunk_witness, chunk_fallback)
-            chunk_blocks.update(blocks)
             bundle = UnifiedProofBundle(
                 storage_proofs=[], event_proofs=proofs, blocks=blocks
             )
@@ -854,25 +1015,45 @@ def generate_event_proofs_for_range_pipelined(
                 metrics.count("range_chunks_generated")
             if job is not None and verify_chunk is None:
                 # no verify stage: the record stage IS the commit point
+                # (RangeJob serializes concurrent workers' appends)
                 job.commit_chunk(index, _chunk_digest(chunk), bundle)
+        return "bundle", index, chunk, bundle, True
+
+    def _merge(recorded):
+        kind = recorded[0]
+        with metrics.stage("range_merge"):
+            if kind == "storage":
+                _, proofs, witness, blocks = recorded
+                fold.fold(proofs, witness, blocks, storage=True)
+                return _SKIP
+            if kind == "chunk":
+                _, proofs, witness, blocks = recorded
+                fold.fold(proofs, witness, blocks)
+                return _SKIP
+            _, index, chunk, bundle, fresh = recorded
+            fold.fold(bundle.event_proofs, (), bundle.blocks)
         if verify_chunk is not None:
-            return index, chunk, bundle, True
-        return None
+            return index, chunk, bundle, fresh
+        return _SKIP
 
     stages = [
-        PipelineStage("scan", _scan, workers=scan_threads),
+        PipelineStage("scan", _scan, workers=scan_workers),
         # with a journal and no verify stage, record is the commit point:
         # drain its queue on abort so finished scans still journal
         PipelineStage(
             "record",
             _record,
+            workers=rec_workers,
             drain_on_cancel=job is not None and verify_chunk is None,
         ),
+        PipelineStage("merge", _merge),
     ]
-    stage_fns = [_scan, _record]
+    stage_fns = [_scan, _record, _merge]
     if verify_chunk is not None:
 
         def _verify(recorded):
+            if recorded is _SKIP:
+                return _SKIP  # storage item — nothing to replay
             index, chunk, bundle, fresh = recorded
             with metrics.stage("range_verify"):
                 result = verify_chunk(bundle)
@@ -886,11 +1067,19 @@ def generate_event_proofs_for_range_pipelined(
             return result
 
         stages.append(
-            PipelineStage("verify", _verify, drain_on_cancel=job is not None)
+            PipelineStage(
+                "verify", _verify, workers=ver_workers, drain_on_cancel=job is not None
+            )
         )
         stage_fns.append(_verify)
 
-    items = list(enumerate(chunks))
+    # storage items interleave with their event chunk so both legs of
+    # chunk k are in flight together; merge still folds in input order
+    items: list = []
+    for index, chunk in enumerate(chunks):
+        items.append(("event", index, chunk))
+        if storage_specs:
+            items.append(("storage", index, chunk))
     try:
         if items:
             if serial_fallback:
@@ -904,31 +1093,10 @@ def generate_event_proofs_for_range_pipelined(
             else:
                 results = run_pipeline(items, stages, depth=max(1, pipeline_depth))
             if verify_chunk is not None and verify_results is not None:
-                verify_results.extend(results)
-        metrics.count("range_proofs", len(event_proofs))
-
-        storage_proofs: list = []
-        if storage_specs:
-            with metrics.stage("range_storage"):
-                storage_proofs, storage_witness, storage_blocks = _storage_for_pairs(
-                    cached, pairs, storage_specs, match_backend
-                )
-            metrics.count("range_storage_proofs", len(storage_proofs))
-            witness_bytes |= storage_witness
-            fallback_blocks.extend(storage_blocks)
-
-        with metrics.stage("range_record"):
-            # verify mode pre-materialized per-chunk blocks; they merge (and
-            # dedup by CID bytes) with any storage leg in the final sort
-            extra = (
-                list(chunk_blocks) + fallback_blocks if chunk_blocks else fallback_blocks
-            )
-            blocks = _materialize_witness(cached, witness_bytes, extra)
-        return UnifiedProofBundle(
-            storage_proofs=storage_proofs,
-            event_proofs=event_proofs,
-            blocks=blocks,
-        )
+                verify_results.extend(r for r in results if r is not _SKIP)
+        metrics.count("range_proofs", fold.n_event_proofs)
+        with metrics.stage("range_merge"):
+            return fold.finish()
     finally:
         if job is not None:
             job.close()

@@ -250,6 +250,15 @@ def _snap_kw(store: Blockstore, raw: dict, work: "Optional[int]" = None) -> dict
     return {"snapshot": snap} if snap is not None else {}
 
 
+def _threads_kw(ext, threads: "Optional[int]") -> dict:
+    """``{"threads": n}`` or ``{}`` — same capability-probe pattern as
+    `_snap_kw`: the kwarg is omitted when the caller wants the env default
+    OR when a cached extension build predates the threads API."""
+    if threads is None or not hasattr(ext, "SCAN_BATCH_THREADS_KW"):
+        return {}
+    return {"threads": int(threads)}
+
+
 @dataclass
 class RecordBatch:
     """Native pass-2 output: payload-mode event arrays over every event of
@@ -351,6 +360,7 @@ def scan_match_hits(
     topic0: bytes,
     topic1: bytes,
     actor_id_filter: "Optional[int]",
+    threads: "Optional[int]" = None,
 ) -> "Optional[tuple[int, np.ndarray, np.ndarray]]":
     """Fused Phase A+B: ONE C walk scans every receipts AMT AND evaluates
     the fp match predicate per event in-register, returning
@@ -378,6 +388,7 @@ def scan_match_hits(
         match_fp=topic_fingerprint(topic0, topic1),
         match_actor=actor_id_filter,
         **_snap_kw(store, raw, len(receipts_roots)),
+        **_threads_kw(ext, threads),
     )
     return (
         out["n_events"],
@@ -392,6 +403,7 @@ def scan_events_flat(
     skip_missing: bool = False,
     want_payload: bool = False,
     validate_blocks: bool = False,
+    threads: "Optional[int]" = None,
 ) -> Optional[ScanBatch]:
     """Scan every receipts AMT in ``receipts_roots``; None if the native
     extension is unavailable (callers use the Python scan path).
@@ -420,6 +432,7 @@ def scan_events_flat(
         want_payload=want_payload,
         validate_blocks=validate_blocks,
         **_snap_kw(store, raw, len(receipts_roots)),
+        **_threads_kw(ext, threads),
     )
     n = out["n_events"]
     return ScanBatch(

@@ -80,11 +80,16 @@ class ServiceConfig:
     cache_ttl_s: Optional[float] = None  # optional entry TTL
     verify_witness_cids: bool = False  # recompute witness CIDs on verify
     # multi-pair generate batches run the stage-overlapped range engine:
-    # chunks of range_chunk_size pairs flow scan(range_scan_threads) →
-    # record with range_pipeline_depth chunks buffered between stages
+    # chunks of range_chunk_size pairs flow scan → record → merge (→
+    # verify) with range_pipeline_depth chunks buffered between stages.
+    # `threads` is the engine's ONE shared budget (--threads; partitioned
+    # over stage workers + native scan fan-out by
+    # utils.threads.resolve_thread_budget); range_scan_threads is the
+    # legacy knob that pins the scan stage width
     range_chunk_size: int = 8
-    range_scan_threads: Optional[int] = None  # None → os.cpu_count()
+    range_scan_threads: Optional[int] = None
     range_pipeline_depth: int = 2
+    threads: Optional[int] = None
     # write-ahead journal dir for generate batches: chunk commits become
     # durable/resumable and each response's Server-Timing grows a
     # `journal_ms` entry (wall time spent fsyncing chunk records)
@@ -449,6 +454,7 @@ class ProofService:
                         chunk_size=self.config.range_chunk_size,
                         metrics=self.metrics,
                         scan_threads=self.config.range_scan_threads,
+                        threads=self.config.threads,
                         pipeline_depth=self.config.range_pipeline_depth,
                         job_dir=job_dir,
                     )

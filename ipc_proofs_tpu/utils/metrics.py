@@ -89,14 +89,17 @@ DURABILITY_COUNTERS = (
 
 # Counter vocabulary of the observability layer (obs/trace.py,
 # serve/service.py slow-request detection):
-#   trace.spans_recorded — spans accepted by the active SpanCollector
-#   trace.spans_dropped  — spans discarded once the collector hit capacity
-#   serve.slow_requests  — serve requests whose wall exceeded the
-#                          slow-request threshold (their span tree is
-#                          auto-logged with trace_id correlation)
+#   trace.spans_recorded    — spans accepted by the active SpanCollector
+#   trace.spans_dropped     — spans discarded once the collector hit capacity
+#   trace.spans_sampled_out — spans from unsampled traces the collector
+#                             skipped (the flight ring still records them)
+#   serve.slow_requests     — serve requests whose wall exceeded the
+#                             slow-request threshold (their span tree is
+#                             auto-logged with trace_id correlation)
 OBSERVABILITY_COUNTERS = (
     "trace.spans_recorded",
     "trace.spans_dropped",
+    "trace.spans_sampled_out",
     "serve.slow_requests",
 )
 
@@ -108,6 +111,8 @@ OBSERVABILITY_COUNTERS = (
 #   range_chunks_resumed    — chunks satisfied from the journal on resume
 #   range_proofs            — event-claim proofs emitted
 #   range_storage_proofs    — storage-slot proofs emitted
+#   range_match_coalesced   — device match calls saved by the coalescer
+#                             (requests folded into another chunk's batch)
 #   batch_contracts         — distinct contracts in a storage batch
 #   batch_slots             — storage slots read in a storage batch
 RANGE_COUNTERS = (
@@ -116,6 +121,7 @@ RANGE_COUNTERS = (
     "range_chunks_resumed",
     "range_proofs",
     "range_storage_proofs",
+    "range_match_coalesced",
     "batch_contracts",
     "batch_slots",
 )
@@ -148,6 +154,7 @@ PIPELINE_STAGES = (
     "range_scan",
     "range_match",
     "range_record",
+    "range_merge",
     "range_verify",
     "range_storage",
     "serve.generate_batch",

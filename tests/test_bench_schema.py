@@ -15,7 +15,11 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
-from check_bench_schema import check_artifact, main  # noqa: E402
+from check_bench_schema import (  # noqa: E402
+    check_artifact,
+    main,
+    speedup_gate_skip_reason,
+)
 
 ARTIFACTS = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
 
@@ -112,3 +116,63 @@ class TestSyntheticRegressions:
         obj = {"cmd": "python bench.py", "rc": 0, "tail": "", "n": 1, "parsed": None}
         assert check_artifact(obj) == []
         assert check_artifact(obj, require_current=True) != []
+
+
+class TestSpeedupGate:
+    """pipeline_speedup_vs_serial ≥ 1.0 is enforced (require_current) on
+    hosts with spare cores, and skipped WITH A REASON on 1–2 core hosts."""
+
+    def _current(self):
+        with open(NEWEST) as fh:
+            return json.load(fh)
+
+    def test_sub_serial_speedup_fails_on_multicore_host(self):
+        obj = self._current()
+        obj["host_cores"] = 8
+        obj["pipeline_speedup_vs_serial"] = 0.62  # the r07–r10 regression
+        assert check_artifact(obj) == []  # non-current vintages unaffected
+        problems = check_artifact(obj, require_current=True)
+        assert any("speedup gate" in p for p in problems), problems
+
+    def test_speedup_at_or_above_one_passes(self):
+        obj = self._current()
+        obj["host_cores"] = 8
+        obj["pipeline_speedup_vs_serial"] = 1.0
+        assert not any(
+            "speedup gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_missing_speedup_fails_on_multicore_host(self):
+        obj = self._current()
+        obj["host_cores"] = 4
+        obj["pipeline_speedup_vs_serial"] = None
+        problems = check_artifact(obj, require_current=True)
+        assert any("speedup gate" in p for p in problems), problems
+
+    @pytest.mark.parametrize("cores", [1, 2, None])
+    def test_gate_skipped_with_reason_on_small_hosts(self, cores):
+        obj = self._current()
+        obj["host_cores"] = cores
+        obj["pipeline_speedup_vs_serial"] = 0.5
+        reason = speedup_gate_skip_reason(obj)
+        assert reason is not None and str(cores) in reason
+        assert not any(
+            "speedup gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_gate_applies_above_two_cores(self):
+        obj = self._current()
+        obj["host_cores"] = 3
+        assert speedup_gate_skip_reason(obj) is None
+
+    def test_cli_prints_skip_reason(self, tmp_path, capsys):
+        obj = self._current()
+        obj["host_cores"] = 1
+        obj["pipeline_speedup_vs_serial"] = 0.5
+        path = tmp_path / "BENCH_small_host.json"
+        path.write_text(json.dumps(obj))
+        main(["--require-current", str(path)])  # rc covered elsewhere
+        out = capsys.readouterr().out
+        assert "speedup gate SKIPPED" in out and "host_cores=1" in out

@@ -28,6 +28,21 @@ def test_sigkill_grid_resumes_byte_identical(seed):
     assert torn and len(torn) < summary["points"]
 
 
+def test_sigkill_during_concurrent_record_commits():
+    """SIGKILL while TWO record workers are committing chunks concurrently.
+
+    The journal's count-clock is serialized under the job lock, so the kill
+    still lands at exactly the N-th append — but which chunk indices
+    committed first is scheduling-dependent. The invariant is unchanged:
+    the resumed run must reuse every committed record (whatever order they
+    landed in) and reproduce the reference byte-for-byte."""
+    summary = crashtest.run_grid(
+        20260805, points=4, n_pairs=12, chunk_size=2, record_workers=2
+    )
+    assert summary["ok"], summary["violations"]
+    assert summary["counts"] == {"identical": summary["points"]}
+
+
 def test_single_boundary_kill_point_detail(tmp_path):
     """One kill point end to end with the internals exposed: the journal
     holds exactly crash_at+1 records after a boundary kill, and the resumed

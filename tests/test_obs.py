@@ -150,6 +150,139 @@ class TestPerfettoExport:
 
 
 # --------------------------------------------------------------------------
+# trace sampling
+# --------------------------------------------------------------------------
+
+
+class TestTraceSampling:
+    def _sampled_tracing(self, rate, metrics=None):
+        get_flight_recorder().clear()
+        return enable_tracing(metrics=metrics, sample=rate)
+
+    def test_sample_zero_skips_collector_not_flight_ring(self):
+        m = Metrics()
+        col = self._sampled_tracing(0.0, m)
+        try:
+            with root_span("r"):
+                with span("c"):
+                    pass
+            assert col.snapshot() == []
+            # the flight recorder is exempt: crash forensics never sampled out
+            ring = [s["name"] for s in get_flight_recorder().snapshot()["spans"]]
+            assert set(ring) >= {"r", "c"}
+            assert m.snapshot()["counters"]["trace.spans_sampled_out"] == 2
+        finally:
+            disable_tracing()
+            get_flight_recorder().clear()
+
+    def test_sample_one_keeps_everything(self):
+        col = self._sampled_tracing(1.0)
+        try:
+            with root_span("r"):
+                with span("c"):
+                    pass
+            assert {s.name for s in col.snapshot()} == {"r", "c"}
+        finally:
+            disable_tracing()
+            get_flight_recorder().clear()
+
+    def test_whole_trace_decision_children_inherit(self):
+        # the decision is per TRACE (deterministic on trace_id), never per
+        # span: a kept root keeps all descendants, a dropped root drops all
+        col = self._sampled_tracing(0.5)
+        try:
+            for _ in range(20):
+                with root_span("r"):
+                    with span("c"):
+                        pass
+            by_trace: dict = {}
+            for s in col.snapshot():
+                by_trace.setdefault(s.trace_id, set()).add(s.name)
+            assert all(names == {"r", "c"} for names in by_trace.values())
+        finally:
+            disable_tracing()
+            get_flight_recorder().clear()
+
+    def test_decision_deterministic_on_trace_id(self):
+        from ipc_proofs_tpu.obs import trace as trace_mod
+
+        trace_mod._sample_rate = 0.5
+        try:
+            tid = "80000000" + "0" * 8
+            assert trace_mod._sample_decision(tid) is False  # 0.5 exactly → out
+            assert trace_mod._sample_decision("0" * 16) is True
+            # same id, same verdict, every time
+            assert trace_mod._sample_decision(tid) == trace_mod._sample_decision(tid)
+        finally:
+            trace_mod._sample_rate = 1.0
+
+    def test_sampling_propagates_to_pipeline_workers(self):
+        # a dropped trace stays dropped inside stage worker threads — the
+        # workers re-enter the submitting TraceContext, sampled bit included
+        from ipc_proofs_tpu.parallel.pipeline import PipelineStage, run_pipeline
+
+        m = Metrics()
+        col = self._sampled_tracing(0.0, m)
+        try:
+
+            def work(v):
+                with span("work"):
+                    return v + 1
+
+            with root_span("job"):
+                out = run_pipeline(
+                    list(range(8)), [PipelineStage("s", work, workers=3)]
+                )
+            assert out == list(range(1, 9))
+            assert col.snapshot() == []
+            assert m.snapshot()["counters"]["trace.spans_sampled_out"] >= 9
+        finally:
+            disable_tracing()
+            get_flight_recorder().clear()
+
+
+# --------------------------------------------------------------------------
+# OTLP/JSON export
+# --------------------------------------------------------------------------
+
+
+class TestOtlpExport:
+    def test_otlp_shape(self, collector, tmp_path):
+        from ipc_proofs_tpu.obs import otlp_trace_obj, write_otlp_trace
+
+        spans = _make_spans(collector)
+        obj = otlp_trace_obj(spans)
+        rs = obj["resourceSpans"]
+        assert len(rs) == 1
+        attrs = {a["key"]: a["value"]["stringValue"]
+                 for a in rs[0]["resource"]["attributes"]}
+        assert attrs["service.name"] == "ipc-proofs-tpu"
+        scope = rs[0]["scopeSpans"][0]
+        assert scope["scope"]["name"] == "ipc_proofs_tpu.obs"
+        otlp = scope["spans"]
+        assert len(otlp) == len(spans)
+        roots = [s for s in otlp if "parentSpanId" not in s]
+        assert len(roots) == 1 and roots[0]["name"] == "root"
+        for s in otlp:
+            # OTLP/JSON contract: hex ids at full width, ns times as strings
+            assert re.fullmatch(r"[0-9a-f]{32}", s["traceId"])
+            assert re.fullmatch(r"[0-9a-f]{16}", s["spanId"])
+            assert s["kind"] == 1
+            start, end = int(s["startTimeUnixNano"]), int(s["endTimeUnixNano"])
+            assert isinstance(s["startTimeUnixNano"], str)  # int64-safe
+            assert end >= start > 10**18  # plausibly nanoseconds since epoch
+        child = next(s for s in otlp if s["name"] == "child0")
+        assert child["parentSpanId"] == roots[0]["spanId"]
+        i_attr = {a["key"]: a["value"]["stringValue"] for a in child["attributes"]}
+        assert i_attr["i"] == "0"
+
+        path = tmp_path / "trace.otlp.json"
+        n = write_otlp_trace(str(path), spans)
+        assert n == len(spans)
+        assert json.loads(path.read_text()) == obj
+
+
+# --------------------------------------------------------------------------
 # Prometheus text exposition
 # --------------------------------------------------------------------------
 
@@ -285,7 +418,16 @@ class TestServeTracing:
         trace_ids = [body["trace_id"] for body, _, _ in results]
         assert len(set(trace_ids)) == self.N  # one fresh trace per request
 
-        spans = collector.snapshot()
+        # the response is written INSIDE the http.generate span, so the
+        # root lands in the collector a beat after the client returns —
+        # wait for every trace's root instead of racing the handler exit
+        deadline = time.time() + 5
+        while True:
+            spans = collector.snapshot()
+            rooted = {s.trace_id for s in spans if s.name == "http.generate"}
+            if set(trace_ids) <= rooted or time.time() > deadline:
+                break
+            time.sleep(0.01)
         by_trace = {}
         for s in spans:
             by_trace.setdefault(s.trace_id, []).append(s)

@@ -295,3 +295,136 @@ class TestDrainOnCancel:
 
         _run_with_deadline(run)
         assert calls and calls[0] == 0
+
+
+class TestMatchCoalescer:
+    """Leader-based combining of concurrent fp-match device calls."""
+
+    class _FakeBackend:
+        """Elementwise fp predicate: concat-then-split must equal per-call."""
+
+        def __init__(self, on_call=None):
+            self.calls: list[int] = []
+            self._on_call = on_call
+
+        def event_match_mask_fp(self, fp, n_topics, emitters, valid,
+                                topic0, topic1, actor_id):
+            self.calls.append(len(fp))
+            if self._on_call is not None:
+                self._on_call()
+            return (fp % 2 == 0) & (valid > 0)
+
+    @staticmethod
+    def _req(rng, n, key):
+        import numpy as np
+
+        from ipc_proofs_tpu.parallel.pipeline import _MatchReq
+
+        fp = rng.integers(0, 1000, size=n, dtype=np.uint64)
+        nt = rng.integers(1, 4, size=n, dtype=np.int32)
+        em = rng.integers(0, 5, size=n, dtype=np.int64)
+        valid = rng.integers(0, 2, size=n, dtype=np.int32)
+        return _MatchReq(fp, nt, em, valid, key)
+
+    def test_batched_run_splits_identically(self):
+        """One concatenated device call, split at input offsets, equals the
+        per-request masks — and only same-key requests combine."""
+        import numpy as np
+
+        from ipc_proofs_tpu.parallel.pipeline import MatchCoalescer
+
+        rng = np.random.default_rng(7)
+        key_a = (b"t0", b"t1", 7)
+        key_b = (b"t0", b"other", None)
+        reqs = [self._req(rng, n, key_a) for n in (3, 5, 1)]
+        reqs += [self._req(rng, 4, key_b)]
+        backend = self._FakeBackend()
+        m = Metrics()
+        c = MatchCoalescer(backend, metrics=m)
+        c._run(list(reqs))
+
+        reference = self._FakeBackend()
+        for r in reqs:
+            expect = reference.event_match_mask_fp(
+                r.fp, r.n_topics, r.emitters, r.valid, *r.key
+            )
+            assert np.array_equal(r.result, expect), r.key
+            assert r.done.is_set() and r.exc is None
+        # key_a rode ONE concatenated call, key_b its own: 2 device calls
+        assert sorted(backend.calls) == [4, 9]
+        assert m.snapshot()["counters"]["range_match_coalesced"] == 2
+
+    def test_concurrent_callers_coalesce(self):
+        """Four threads: the first holds the device lock until the other
+        three have parked, so one follower-leader claims all three in a
+        single concatenated call. Masks must equal the uncoalesced ones."""
+        import numpy as np
+
+        from ipc_proofs_tpu.parallel.pipeline import MatchCoalescer
+
+        rng = np.random.default_rng(11)
+        key = (b"sig", b"sub", 1)
+        reqs = [self._req(rng, 2 + i, key) for i in range(4)]
+        m = Metrics()
+        holder: dict = {}
+
+        def first_call_waits():
+            if len(backend.calls) == 1:  # only the very first device call
+                deadline = time.time() + 10
+                while len(holder["c"]._pending) < 3 and time.time() < deadline:
+                    time.sleep(0.001)
+
+        backend = self._FakeBackend(on_call=first_call_waits)
+        c = MatchCoalescer(backend, metrics=m)
+        holder["c"] = c
+
+        results: dict = {}
+
+        def call(i, r):
+            results[i] = c.match_fp(
+                r.fp, r.n_topics, r.emitters, r.valid, *r.key
+            )
+
+        def run():
+            threads = [
+                threading.Thread(target=call, args=(i, r), daemon=True)
+                for i, r in enumerate(reqs)
+            ]
+            threads[0].start()
+            deadline = time.time() + 10
+            while not backend.calls and time.time() < deadline:
+                time.sleep(0.001)  # thread 0 is inside the device call
+            for t in threads[1:]:
+                t.start()
+            for t in threads:
+                t.join(15)
+                assert not t.is_alive(), "coalescer deadlocked"
+
+        _run_with_deadline(run)
+        reference = self._FakeBackend()
+        for i, r in enumerate(reqs):
+            expect = reference.event_match_mask_fp(
+                r.fp, r.n_topics, r.emitters, r.valid, *r.key
+            )
+            assert np.array_equal(results[i], expect), i
+        assert len(backend.calls) == 2  # leader's own + one combined call
+        assert m.snapshot()["counters"]["range_match_coalesced"] == 2
+
+    def test_backend_exception_reaches_every_waiter(self):
+        import numpy as np
+
+        from ipc_proofs_tpu.parallel.pipeline import MatchCoalescer
+
+        class _Boom:
+            def event_match_mask_fp(self, *a):
+                raise RuntimeError("device fell over")
+
+        rng = np.random.default_rng(3)
+        c = MatchCoalescer(_Boom())
+        reqs = [self._req(rng, 3, (b"a", b"b", None)) for _ in range(2)]
+        c._run(list(reqs))
+        for r in reqs:
+            assert isinstance(r.exc, RuntimeError) and r.done.is_set()
+        with pytest.raises(RuntimeError, match="device fell over"):
+            c.match_fp(reqs[0].fp, reqs[0].n_topics, reqs[0].emitters,
+                       reqs[0].valid, b"a", b"b", None)

@@ -97,6 +97,86 @@ class TestDifferentialGrid:
             assert flat == whole, chunk_size
             assert all(flat) and len(flat) == expected
 
+    @pytest.mark.parametrize("record_workers", [1, 3])
+    @pytest.mark.parametrize("verify_workers", [1, 2])
+    @pytest.mark.parametrize("chunk_size", [1, 3, 512])
+    def test_worker_grid_bit_identical(self, record_workers, verify_workers, chunk_size):
+        """The parallel record/verify engine is a pure perf change: every
+        (record_workers × verify_workers × chunk_size) point must emit the
+        byte-identical bundle AND the identical in-order verdict stream the
+        chunked driver produces."""
+        bs, pairs, expected = _make_range(7)
+        spec = EventProofSpec(**SPEC)
+        reference = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=chunk_size
+        ).to_json()
+        results: list = []
+        piped = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec,
+            chunk_size=chunk_size,
+            record_workers=record_workers,
+            verify_workers=verify_workers,
+            verify_chunk=lambda b: len(b.event_proofs),
+            verify_results=results,
+        )
+        assert piped.to_json() == reference, (record_workers, verify_workers)
+        assert sum(results) == expected
+        # verdicts arrive in chunk order even with parallel verify workers
+        n_chunks = (len(pairs) + chunk_size - 1) // chunk_size
+        assert len(results) == n_chunks
+
+    @pytest.mark.parametrize("record_workers", [1, 3])
+    def test_worker_grid_with_storage_specs(self, record_workers):
+        """Storage chunks now flow THROUGH the pipeline (not a post-pipeline
+        range-wide pass): parallel record workers must still concatenate
+        storage proofs in (pair, spec) order and fold one deduplicated
+        CID-sorted witness."""
+        from ipc_proofs_tpu.proofs.storage_batch import MappingSlotSpec
+        from ipc_proofs_tpu.state.storage import calculate_storage_slot
+
+        bs = MemoryBlockstore()
+        pairs = []
+        for p in range(5):
+            world = build_chain(
+                [ContractFixture(
+                    actor_id=ACTOR,
+                    storage={calculate_storage_slot("subnet-x", 0): bytes([p + 1])},
+                )],
+                [[EventFixture(emitter=ACTOR, signature=SIG, topic1=SUBNET)]],
+                parent_height=100 + 2 * p,
+                store=bs,
+            )
+            pairs.append(TipsetPair(parent=world.parent, child=world.child))
+        spec = EventProofSpec(**SPEC)
+        storage_specs = [MappingSlotSpec(actor_id=ACTOR, key="subnet-x", slot_index=0)]
+        backend = get_backend("cpu")
+        reference = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2,
+            match_backend=backend, storage_specs=storage_specs,
+        )
+        piped = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2,
+            match_backend=backend, storage_specs=storage_specs,
+            record_workers=record_workers, scan_threads=2,
+        )
+        assert len(piped.storage_proofs) == 5
+        assert [str(b.cid) for b in piped.blocks] == [str(b.cid) for b in reference.blocks]
+        assert verify_proof_bundle(piped, TrustPolicy.accept_all()).all_valid()
+
+    def test_unified_threads_knob_drives_workers(self):
+        """threads= resolves one shared budget; the result is still
+        bit-identical to the serial reference (the budget only changes WHO
+        does the work, never what is emitted)."""
+        bs, pairs, _ = _make_range(6)
+        spec = EventProofSpec(**SPEC)
+        reference = generate_event_proofs_for_range_chunked(
+            bs, pairs, spec, chunk_size=2
+        ).to_json()
+        piped = generate_event_proofs_for_range_pipelined(
+            bs, pairs, spec, chunk_size=2, threads=4,
+        )
+        assert piped.to_json() == reference
+
     def test_empty_range(self):
         bs, _, _ = _make_range(1)
         spec = EventProofSpec(**SPEC)

@@ -1,0 +1,93 @@
+"""Unit tests for the unified thread budget (utils/threads.py).
+
+The budget collapses --threads / IPC_THREADS / --scan-threads /
+IPC_SCAN_THREADS into ONE total and partitions it so that
+``scan_workers × native_scan_threads`` never exceeds the total — the
+oversubscription fix. Precedence: flag beats env, unified beats legacy.
+"""
+
+import pytest
+
+from ipc_proofs_tpu.utils.threads import ThreadBudget, resolve_thread_budget
+
+
+class TestPrecedence:
+    def test_threads_flag_wins_over_everything(self):
+        b = resolve_thread_budget(
+            threads=8, scan_threads=None,
+            env={"IPC_THREADS": "2", "IPC_SCAN_THREADS": "16"}, log=False,
+        )
+        assert b.total == 8 and b.source == "--threads"
+
+    def test_ipc_threads_env_beats_legacy_knobs(self):
+        b = resolve_thread_budget(
+            env={"IPC_THREADS": "6", "IPC_SCAN_THREADS": "16"}, log=False
+        )
+        assert b.total == 6 and b.source == "IPC_THREADS"
+
+    def test_legacy_flag_beats_legacy_env(self):
+        # the env×flag oversubscription bug: before the budget, BOTH applied
+        # (flag → stage workers, env → native fan-out, multiplied). Now the
+        # flag wins and the env is only the fallback.
+        b = resolve_thread_budget(
+            scan_threads=4, env={"IPC_SCAN_THREADS": "16"}, log=False
+        )
+        assert b.total == 4 and b.source == "--scan-threads"
+        assert b.scan_workers == 4  # historical meaning: pins the scan stage
+
+    def test_legacy_env_fallback(self):
+        b = resolve_thread_budget(env={"IPC_SCAN_THREADS": "5"}, log=False)
+        assert b.total == 5 and b.source == "IPC_SCAN_THREADS"
+        assert b.scan_workers == 5
+
+    def test_affinity_default(self):
+        b = resolve_thread_budget(env={}, log=False)
+        assert b.source == "cpu-affinity" and b.total >= 1
+
+    def test_non_integer_env_ignored(self):
+        b = resolve_thread_budget(env={"IPC_THREADS": "lots"}, log=False)
+        assert b.source == "cpu-affinity"
+
+    def test_explicit_scan_threads_pins_stage_under_unified_total(self):
+        b = resolve_thread_budget(threads=8, scan_threads=2, env={}, log=False)
+        assert b.total == 8 and b.source == "--threads"
+        assert b.scan_workers == 2
+        assert b.native_scan_threads == 4  # 8 // 2
+
+
+class TestPartition:
+    @pytest.mark.parametrize("total", [1, 2, 3, 4, 7, 8, 16, 64])
+    def test_no_oversubscription(self, total):
+        b = resolve_thread_budget(threads=total, env={}, log=False)
+        assert b.scan_workers * b.native_scan_threads <= b.total
+        assert b.scan_workers >= 1 and b.record_workers >= 1
+        assert b.verify_workers >= 1 and b.native_scan_threads >= 1
+
+    def test_partition_shape_8(self):
+        b = resolve_thread_budget(threads=8, env={}, log=False)
+        assert b == ThreadBudget(
+            total=8, scan_workers=4, record_workers=2, verify_workers=2,
+            native_scan_threads=2, source="--threads",
+        )
+
+    def test_partition_shape_1(self):
+        b = resolve_thread_budget(threads=1, env={}, log=False)
+        assert (b.scan_workers, b.record_workers, b.verify_workers) == (1, 1, 1)
+        assert b.native_scan_threads == 1
+
+    def test_clamped_to_64(self):
+        b = resolve_thread_budget(threads=1000, env={}, log=False)
+        assert b.total == 64
+
+    def test_budget_logged_once_per_resolution(self):
+        # the package logger doesn't propagate to root, so assert on the
+        # dedup registry: a repeated identical resolution adds nothing
+        import ipc_proofs_tpu.utils.threads as threads_mod
+
+        resolve_thread_budget(threads=63, env={})
+        n = len(threads_mod._logged)
+        assert n >= 1
+        resolve_thread_budget(threads=63, env={})
+        assert len(threads_mod._logged) == n
+        resolve_thread_budget(threads=62, env={})
+        assert len(threads_mod._logged) == n + 1

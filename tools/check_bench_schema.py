@@ -9,7 +9,10 @@ flags. Two strictness levels:
 - every artifact (any vintage) must carry the CORE keys with sane types;
 - the CURRENT artifact (``--require-current`` / ``require_current=True``)
   must carry the full present-day e2e key set — the orchestrator's
-  ``_E2E_SCHEMA_KEYS`` contract plus the satellite leg keys.
+  ``_E2E_SCHEMA_KEYS`` contract plus the satellite leg keys — AND pass
+  the perf gate: ``pipeline_speedup_vs_serial >= 1.0`` whenever
+  ``host_cores > 2`` (hosts without spare cores skip the gate with a
+  printed reason — see `speedup_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -43,6 +46,9 @@ _KNOWN_TYPES = {
     "host_cores": int,
     "host_cores_affinity": int,
     "scan_threads": int,
+    "record_workers": int,
+    "verify_workers": int,
+    "effective_threads": int,
     "native_scan_threads": int,
     "pipeline_depth": int,
     "pipeline_chunk": int,
@@ -102,7 +108,8 @@ _KNOWN_TYPES = {
 # comparison the speedup ratio is derived from
 _CURRENT_REQUIRED = (
     "platform", "devices", "host_cores", "host_cores_affinity",
-    "scan_threads", "native_scan_threads", "pipeline_depth",
+    "scan_threads", "record_workers", "verify_workers", "effective_threads",
+    "native_scan_threads", "pipeline_depth",
     "pipeline_chunk", "events_per_sec_e2e", "proofs", "stages_ms",
     "stages_wall_ms", "stages_overlap", "gen_verify_overlap",
     "overlap_efficiency", "serial_proofs_per_sec", "serial_e2e_reps_s",
@@ -185,7 +192,41 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
         for key in _CURRENT_REQUIRED:
             if key not in obj:
                 problems.append(f"current artifact missing key {key!r}")
+        # the perf gate: with spare cores the stage-overlapped engine must
+        # actually BEAT the serial engine, not just exist (>2 because two
+        # cores barely cover scan+record and the ratio sits at the noise
+        # floor; 1-core hosts run the serial fallback by design)
+        if speedup_gate_skip_reason(obj) is None:
+            speedup = obj.get("pipeline_speedup_vs_serial")
+            if not isinstance(speedup, _NUM) or isinstance(speedup, bool):
+                problems.append(
+                    "speedup gate: pipeline_speedup_vs_serial is "
+                    f"{speedup!r} on a {obj.get('host_cores')}-core host "
+                    "(pipelined leg did not run?)"
+                )
+            elif speedup < 1.0:
+                problems.append(
+                    f"speedup gate: pipeline_speedup_vs_serial={speedup} "
+                    f"< 1.0 on a {obj.get('host_cores')}-core host — the "
+                    "stage-overlapped engine must beat serial when cores "
+                    "are available"
+                )
     return problems
+
+
+def speedup_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the ≥1.0 pipeline-speedup gate does NOT apply to this artifact
+    (None when it does). Callers print the reason so a skipped gate is
+    visible, never silent."""
+    cores = obj.get("host_cores")
+    if not isinstance(cores, int):
+        return f"host_cores={cores!r} (unknown host shape)"
+    if cores <= 2:
+        return (
+            f"host_cores={cores} ≤ 2 — stage overlap cannot pay without "
+            "spare cores (1-core hosts run the serial fallback by design)"
+        )
+    return None
 
 
 def main(argv=None) -> int:
@@ -207,6 +248,10 @@ def main(argv=None) -> int:
             rc = 1
             continue
         problems = check_artifact(obj, require_current=args.require_current)
+        if args.require_current:
+            reason = speedup_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: speedup gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")

@@ -20,7 +20,7 @@ crash env, and compares the final bundle bytes against the reference.
 
 Usage:
     python tools/crashtest.py SEED [--points N] [--pairs P] [--chunk-size C]
-                                   [--quick]
+                                   [--record-workers W] [--quick]
 
 Importable: `run_grid(base_seed, ...)` backs tests/test_crash_recovery.py
 (pinned seeds) and the `tools/soak.py` crash phase. The ``--child``
@@ -79,6 +79,7 @@ def child_main(args) -> int:
         chunk_size=args.chunk_size,
         metrics=metrics,
         scan_threads=2,
+        record_workers=args.record_workers,
         force_pipeline=True,
         job_dir=args.job_dir,
     )
@@ -107,6 +108,7 @@ def _spawn_child(
         "--pairs", str(shape["pairs"]), "--chunk-size", str(shape["chunk_size"]),
         "--receipts", str(shape["receipts"]), "--events", str(shape["events"]),
         "--match-rate", str(shape["match_rate"]),
+        "--record-workers", str(shape.get("record_workers") or 1),
     ]
     if metrics_out:
         cmd += ["--metrics-out", metrics_out]
@@ -194,23 +196,31 @@ def run_grid(
     receipts: int = 4,
     events: int = 2,
     match_rate: float = 0.2,
+    record_workers: int = 1,
     log=lambda msg: None,
 ) -> dict:
     """Seeded kill-point grid: half chunk-boundary kills, half torn
     mid-record writes, kill indices drawn over the whole chunk range.
     ``ok`` iff every point crashed, resumed, and reproduced the reference
-    byte-for-byte — and both kill flavors actually occurred."""
+    byte-for-byte — and both kill flavors actually occurred.
+
+    ``record_workers > 1`` kills the child while several record workers
+    are committing concurrently: the journal's count-clock (serialized
+    under the job lock) still fires at the N-th append, but WHICH chunk
+    indices made it in is scheduling-dependent — the count-based
+    post-mortem and replay checks are deliberately order-agnostic."""
     from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
 
     shape = {
         "pairs": n_pairs, "chunk_size": chunk_size,
         "receipts": receipts, "events": events, "match_rate": match_rate,
+        "record_workers": record_workers,
     }
     n_chunks = (n_pairs + chunk_size - 1) // chunk_size
     store, pairs, spec = _build_world(n_pairs, receipts, events, match_rate)
     reference = generate_event_proofs_for_range_pipelined(
         store, pairs, spec, chunk_size=chunk_size, scan_threads=2,
-        force_pipeline=True,
+        record_workers=record_workers, force_pipeline=True,
     ).to_json()
 
     rng = random.Random(base_seed)
@@ -266,6 +276,10 @@ def main(argv=None) -> int:
     ap.add_argument("--receipts", type=int, default=4)
     ap.add_argument("--events", type=int, default=2)
     ap.add_argument("--match-rate", type=float, default=0.2)
+    ap.add_argument(
+        "--record-workers", type=int, default=1,
+        help="record-stage workers in the child (>1 = concurrent commits)",
+    )
     ap.add_argument("--quick", action="store_true", help="fewer kill points")
     # --child: the forked driver entrypoint (internal)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
@@ -287,6 +301,7 @@ def main(argv=None) -> int:
         args.seed, points=points, n_pairs=args.pairs,
         chunk_size=args.chunk_size, receipts=args.receipts,
         events=args.events, match_rate=args.match_rate,
+        record_workers=args.record_workers,
         log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
     )
     print(json.dumps(summary, indent=2))
