@@ -56,7 +56,7 @@ ACTOR = 1001
 
 LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
-    "witness", "resilience", "durability", "observability",
+    "witness", "resilience", "durability", "observability", "storage",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -72,6 +72,7 @@ _LEG_TIMEOUTS = {
     "resilience": (300.0, 150.0),
     "durability": (300.0, 150.0),
     "observability": (300.0, 150.0),
+    "storage": (300.0, 150.0),
 }
 
 
@@ -1069,6 +1070,170 @@ def _leg_observability(args) -> dict:
     }
 
 
+def _leg_storage(args) -> dict:
+    """Tiered-store measurements (host-only, hermetic): what the disk tier
+    (`ipc_proofs_tpu/storex/`) and the chain-follow prefetch buy on a
+    range request whose blocks live behind an RPC with real latency:
+
+    - ``cold_vs_warm_speedup`` — wall-clock ratio of a cold-RPC run
+      (every block over `LotusClient`, per-call simulated network delay)
+      to a disk-warm run after a simulated restart (fresh memory cache,
+      same segment files). The warm run must issue ZERO RPC calls and
+      produce a byte-identical bundle — both asserted, not assumed;
+    - ``disk_hit_ratio`` — fraction of the warm run's block reads served
+      (multihash-verified) from the disk tier;
+    - ``prefetch_hit_ratio`` — fraction of a request's block reads served
+      locally after the `ChainFollower` pre-warmed the tipset spines into
+      a fresh store (the follower only walks the spine + first-level
+      links, so this is < 1 by design — it measures how much of a real
+      request the follower anticipates)."""
+    import gc
+    import shutil
+    import tempfile
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+    from ipc_proofs_tpu.store.faults import LocalLotusSession
+    from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+    from ipc_proofs_tpu.storex import ChainFollower, SegmentStore, TieredBlockstore
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    n_pairs = 12 if args.quick else 32
+    bs, pairs, _ = build_range_world(
+        n_pairs, 32, 8, 0.1,
+        signature=SIG, topic1=TOPIC1, actor_id=ACTOR, base_height=70_000_000,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR)
+
+    # every RPC pays this much simulated network latency, so cold-vs-warm
+    # measures fetch avoidance against a realistic wire, not dict lookups
+    delay_s = 0.0002
+
+    class _SlowSession:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def post(self, url, data=None, headers=None, timeout=None):
+            time.sleep(delay_s)
+            return self._inner.post(url, data=data, headers=headers, timeout=timeout)
+
+    def _client(metrics):
+        return LotusClient(
+            "http://bench-storage",
+            session=_SlowSession(LocalLotusSession(bs)),
+            metrics=metrics,
+        )
+
+    def _run(store, metrics=None):
+        t0 = time.perf_counter()
+        bundle = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=8, metrics=metrics,
+            scan_threads=1, force_pipeline=True,
+        )
+        return bundle, time.perf_counter() - t0
+
+    workdir = tempfile.mkdtemp(prefix="bench_storage_")
+    try:
+        _run(bs)  # warm (jit compile, extension load) off the wire entirely
+
+        # --- cold: every block over RPC, no disk tier -----------------------
+        t_cold = rpc_cold = None
+        bundle_cold = None
+        for _ in range(2):
+            gc.collect()
+            m = Metrics()
+            bundle_cold, wall = _run(RpcBlockstore(_client(m)), metrics=m)
+            calls = m.snapshot()["counters"].get("rpc.calls", 0)
+            if t_cold is None or wall < t_cold:
+                t_cold, rpc_cold = wall, calls
+
+        # --- populate the disk tier, then restart into it -------------------
+        store_dir = os.path.join(workdir, "store")
+        m_pop = Metrics()
+        disk = SegmentStore(store_dir, metrics=m_pop)
+        _run(TieredBlockstore(RpcBlockstore(_client(m_pop)), disk, metrics=m_pop))
+        disk.close()
+
+        # fresh SegmentStore + empty memory cache over the same files: the
+        # restart path — the index rebuilds from the segment frames
+        t_warm = rpc_warm = None
+        hit_ratio = None
+        disk_bytes = disk_entries = 0
+        bundle_warm = None
+        for _ in range(2):
+            gc.collect()
+            m = Metrics()
+            disk = SegmentStore(store_dir, metrics=m)
+            tiered = TieredBlockstore(
+                RpcBlockstore(_client(m)), disk, metrics=m
+            )
+            bundle_warm, wall = _run(tiered, metrics=m)
+            counters = m.snapshot()["counters"]
+            calls = counters.get("rpc.calls", 0)
+            if t_warm is None or wall < t_warm:
+                t_warm, rpc_warm = wall, calls
+                d_hits = counters.get("storex.disk_hits", 0)
+                d_misses = counters.get("storex.disk_misses", 0)
+                hit_ratio = d_hits / (d_hits + d_misses) if d_hits + d_misses else None
+                stats = disk.stats()
+                disk_bytes, disk_entries = stats["bytes"], stats["entries"]
+            disk.close()
+        assert bundle_warm.to_json() == bundle_cold.to_json(), (
+            "disk-warm bundle diverged from the cold-RPC run"
+        )
+        assert rpc_warm == 0, f"disk-warm run issued {rpc_warm} RPC calls"
+
+        # --- follower prefetch into a fresh store ---------------------------
+        m = Metrics()
+        disk = SegmentStore(os.path.join(workdir, "follow"), metrics=m)
+        tiered = TieredBlockstore(RpcBlockstore(_client(m)), disk, metrics=m)
+        follower = ChainFollower(_client(m), tiered, metrics=m)
+        for pair in pairs:
+            follower.prefetch_tipset(pair.parent)
+            follower.prefetch_tipset(pair.child)
+        counters = m.snapshot()["counters"]
+        prefetched = counters.get("follow.blocks_prefetched", 0)
+        h0, mi0 = tiered.hits, tiered.misses
+        dh0 = counters.get("storex.disk_hits", 0)
+        bundle_follow, _ = _run(tiered, metrics=m)
+        counters = m.snapshot()["counters"]
+        served_mem = tiered.hits - h0
+        served_disk = counters.get("storex.disk_hits", 0) - dh0
+        total_gets = served_mem + (tiered.misses - mi0)
+        prefetch_ratio = (
+            (served_mem + served_disk) / total_gets if total_gets else None
+        )
+        disk.close()
+        assert bundle_follow.to_json() == bundle_cold.to_json(), (
+            "follower-prefetched bundle diverged from the cold-RPC run"
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    speedup = t_cold / t_warm if t_warm else None
+    _log(
+        f"bench: storage ({n_pairs} pairs): cold {t_cold * 1000:.0f}ms "
+        f"({rpc_cold} RPC calls) vs disk-warm {t_warm * 1000:.0f}ms "
+        f"({rpc_warm} RPC calls) = {speedup:.2f}x; disk_hit_ratio "
+        f"{hit_ratio:.3f} over {disk_entries} blocks ({disk_bytes}B); "
+        f"follower prefetched {prefetched} blocks → prefetch_hit_ratio "
+        f"{prefetch_ratio:.3f}"
+    )
+    return {
+        "cold_vs_warm_speedup": round(speedup, 2) if speedup else None,
+        "disk_hit_ratio": round(hit_ratio, 4) if hit_ratio is not None else None,
+        "prefetch_hit_ratio": (
+            round(prefetch_ratio, 4) if prefetch_ratio is not None else None
+        ),
+        "storage_cold_rpc_calls": rpc_cold,
+        "storage_warm_rpc_calls": rpc_warm,
+        "storage_prefetched_blocks": prefetched,
+        "storage_disk_bytes": disk_bytes,
+        "storage_pairs": n_pairs,
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -1080,6 +1245,7 @@ _LEG_FNS = {
     "resilience": _leg_resilience,
     "durability": _leg_durability,
     "observability": _leg_observability,
+    "storage": _leg_storage,
 }
 
 
@@ -1370,6 +1536,8 @@ def _orchestrate(args) -> None:
     legs_status["durability"] = status
     observability, status = _run_leg("observability", args, "cpu")
     legs_status["observability"] = status
+    storage, status = _run_leg("storage", args, "cpu")
+    legs_status["storage"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -1425,6 +1593,13 @@ def _orchestrate(args) -> None:
     )
     for k in _OBSERVABILITY_KEYS:
         out[k] = (observability or {}).get(k)
+    _STORAGE_KEYS = (
+        "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
+        "storage_cold_rpc_calls", "storage_warm_rpc_calls",
+        "storage_prefetched_blocks", "storage_disk_bytes", "storage_pairs",
+    )
+    for k in _STORAGE_KEYS:
+        out[k] = (storage or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

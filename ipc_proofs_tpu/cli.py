@@ -64,10 +64,14 @@ def _make_rpc_client(args, metrics=None):
 
 
 def _start_tracing(args) -> bool:
-    """Enable the span collector when ``--trace-out`` or ``--trace-otlp``
-    was given; ``--trace-sample`` head-samples whole traces at the
-    collector (the always-on flight ring is unaffected)."""
-    if not (getattr(args, "trace_out", None) or getattr(args, "trace_otlp", None)):
+    """Enable the span collector when ``--trace-out``, ``--trace-otlp`` or
+    ``--trace-otlp-url`` was given; ``--trace-sample`` head-samples whole
+    traces at the collector (the always-on flight ring is unaffected)."""
+    if not (
+        getattr(args, "trace_out", None)
+        or getattr(args, "trace_otlp", None)
+        or getattr(args, "trace_otlp_url", None)
+    ):
         return False
     from ipc_proofs_tpu.obs import enable_tracing
 
@@ -78,7 +82,8 @@ def _start_tracing(args) -> bool:
 def _finish_tracing(args) -> None:
     """Export collected spans to ``--trace-out`` (Chrome trace JSON, load
     at ui.perfetto.dev or chrome://tracing) and/or ``--trace-otlp``
-    (OTLP/JSON, POST-able to a collector's /v1/traces)."""
+    (OTLP/JSON file), and/or POST them to a live collector at
+    ``--trace-otlp-url`` (retried, fail-soft)."""
     from ipc_proofs_tpu.obs import (
         disable_tracing,
         get_collector,
@@ -99,6 +104,13 @@ def _finish_tracing(args) -> None:
     if getattr(args, "trace_otlp", None):
         n = write_otlp_trace(args.trace_otlp, spans)
         log.info("trace: %d spans → %s (OTLP/JSON)", n, args.trace_otlp)
+    if getattr(args, "trace_otlp_url", None):
+        from ipc_proofs_tpu.obs.export import post_otlp_trace
+
+        if post_otlp_trace(args.trace_otlp_url, spans):
+            log.info(
+                "trace: %d spans POSTed → %s", len(spans), args.trace_otlp_url
+            )
 
 
 def _cmd_generate(args) -> int:
@@ -296,9 +308,20 @@ def _cmd_range(args) -> int:
             pipeline_depth=args.pipeline_depth,
         )
 
+    store = RpcBlockstore(client)
+    disk = None
+    if args.store_dir:
+        from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
+
+        disk = SegmentStore(
+            args.store_dir, cap_bytes=args.store_cap_bytes, metrics=metrics
+        )
+        store = TieredBlockstore(store, disk, metrics=metrics)
+        log.info("disk tier: %s (%s)", args.store_dir, disk.stats())
+
     with maybe_profile(args.profile):
         bundle = generate_event_proofs_for_range_chunked(
-            RpcBlockstore(client),
+            store,
             pairs,
             spec,
             chunk_size=args.chunk_size,
@@ -317,6 +340,8 @@ def _cmd_range(args) -> int:
         "range bundle: %d event + %d storage proofs, %d witness blocks → %s",
         len(bundle.event_proofs), len(bundle.storage_proofs), len(bundle.blocks), output,
     )
+    if disk is not None:
+        disk.close()
     if args.metrics:
         print(metrics.to_json(), file=sys.stderr)
     if tracing:
@@ -519,6 +544,7 @@ def _cmd_serve(args) -> int:
             "demo world: %d pairs, %d matching events", len(pairs), n_matching
         )
     endpoint_pool = None
+    client = None
     if not args.demo_world and (args.endpoint or args.endpoints):
         from ipc_proofs_tpu.proofs.chain import Tipset
         from ipc_proofs_tpu.store.failover import EndpointPool
@@ -577,15 +603,38 @@ def _cmd_serve(args) -> int:
             range_pipeline_depth=args.pipeline_depth,
             threads=args.threads,
             slow_request_ms=args.slow_ms,
+            store_dir=args.store_dir,
+            store_cap_bytes=args.store_cap_bytes,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
     )
+    follower = None
+    if args.follow:
+        if client is None or service.blockstore is None:
+            log.error("--follow requires --endpoint (a chain to follow)")
+            service.drain()
+            return 2
+        from ipc_proofs_tpu.storex import ChainFollower
+
+        follower = ChainFollower(
+            client, service.blockstore, metrics=metrics, poll_s=args.follow_poll_s
+        )
+        follower.start()
+        log.info(
+            "chain follower: tailing finalized tipsets every %.1fs",
+            args.follow_poll_s,
+        )
     durable = None
     if args.queue_dir:
         from ipc_proofs_tpu.serve.durable import DurableAdmission
 
-        durable = DurableAdmission(service, args.queue_dir, pairs=pairs)
+        durable = DurableAdmission(
+            service,
+            args.queue_dir,
+            pairs=pairs,
+            results_max_bytes=args.results_cache_bytes,
+        )
         if durable.resumed_jobs:
             log.info(
                 "durable queue: re-executed %d admitted-but-unfinished "
@@ -611,6 +660,8 @@ def _cmd_serve(args) -> int:
     except KeyboardInterrupt:
         log.info("draining (flushing accepted requests)…")
     finally:
+        if follower is not None:
+            follower.stop()
         httpd.shutdown()
         if tracing:
             _finish_tracing(args)
@@ -644,12 +695,35 @@ def main(argv=None) -> int:
             "breaker (default 5)",
         )
 
+    def add_store_flags(p):
+        p.add_argument(
+            "--store-dir", default=None, metavar="DIR",
+            help="disk tier for fetched blocks: content-addressed "
+            "append-only segment files under DIR, CRC-framed and "
+            "multihash-verified on every read, LRU-evicted at "
+            "--store-cap-bytes. Survives restarts — a re-run over the same "
+            "heights refetches nothing",
+        )
+        p.add_argument(
+            "--store-cap-bytes", type=int, default=1 << 30,
+            help="byte cap on the disk tier (whole cold segments are "
+            "evicted; default 1 GiB)",
+        )
+
     def add_trace_export_flags(p):
         p.add_argument(
             "--trace-otlp", default=None, metavar="PATH",
             help="also export collected spans as OTLP/JSON "
             "(resourceSpans/scopeSpans shape — POST to any OpenTelemetry "
             "collector's /v1/traces)",
+        )
+        p.add_argument(
+            "--trace-otlp-url", default=None, metavar="URL",
+            help="POST collected spans as OTLP/JSON to a live collector "
+            "endpoint (e.g. http://localhost:4318/v1/traces); retried with "
+            "bounded exponential backoff, fail-soft — a dead collector "
+            "costs a warning and a trace.otlp_post_failures tick, never "
+            "the run",
         )
         p.add_argument(
             "--trace-sample", type=float, default=1.0, metavar="RATE",
@@ -744,6 +818,7 @@ def main(argv=None) -> int:
         help="chunks buffered between pipeline stages (bounded queues); "
         "0 disables the stage-overlapped engine",
     )
+    add_store_flags(rng)
     rng.add_argument("--checkpoint-dir", default=None)
     rng.add_argument(
         "--job-dir", default=None, metavar="DIR",
@@ -881,12 +956,33 @@ def main(argv=None) -> int:
         "--pipeline-depth", type=int, default=2,
         help="chunks buffered between range-pipeline stages",
     )
+    add_store_flags(srv)
+    srv.add_argument(
+        "--follow", action="store_true",
+        help="chain-follow prefetch: a daemon thread tails finalized "
+        "tipsets (ChainHead minus a finality lag) and pre-warms the "
+        "tiered store with headers, receipts-AMT and state-HAMT spine "
+        "blocks — requests about recent tipsets then complete with zero "
+        "upstream block fetches (requires --endpoint; best with "
+        "--store-dir)",
+    )
+    srv.add_argument(
+        "--follow-poll-s", type=float, default=15.0,
+        help="chain-follower poll interval in seconds (default 15)",
+    )
     srv.add_argument(
         "--queue-dir", default=None, metavar="DIR",
         help="durable admission queue: requests are journaled (fsync) to "
         "DIR/queue.bin before execution, idempotency_key dedupes client "
         "retries, and admitted-but-unfinished requests re-execute on "
         "restart (/healthz reports resumed_jobs / journal_bytes)",
+    )
+    srv.add_argument(
+        "--results-cache-bytes", type=int, default=64 * 1024 * 1024,
+        help="byte cap on the in-memory completed-request result cache "
+        "(with --queue-dir): colder results spill to their journal frame "
+        "and are re-read (CRC-verified) on an idempotent retry "
+        "(default 64 MiB)",
     )
     srv.add_argument(
         "--slow-ms", type=float, default=1000.0,

@@ -35,12 +35,19 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import signal
 import threading
 import time
 from typing import Any, Optional
 
-from ipc_proofs_tpu.jobs.journal import JournalError, JournalWriter, read_journal
+from ipc_proofs_tpu.jobs.journal import (
+    JournalError,
+    JournalWriter,
+    frame_record,
+    read_journal,
+)
 from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.threads import locked
 
 __all__ = [
     "JOBS_MANIFEST_NAME",
@@ -102,6 +109,7 @@ class RangeJob:
         completed: "dict[int, dict]",
         writer: JournalWriter,
         metrics=None,
+        compact_threshold_bytes: "Optional[int]" = None,
     ):
         self.job_dir = job_dir
         self.manifest = manifest
@@ -109,6 +117,10 @@ class RangeJob:
         self.completed = completed  # guarded-by: _lock
         self._writer = writer  # guarded-by: _lock
         self._metrics = metrics
+        # auto-compaction trigger (None/0 = manual `compact()` only)
+        self._compact_threshold = compact_threshold_bytes
+        self.compactions = 0  # guarded-by: _lock
+        self._last_compact_bytes = 0  # guarded-by: _lock
 
     # -- resume side -----------------------------------------------------
 
@@ -146,6 +158,7 @@ class RangeJob:
         with self._lock:
             ok = self._writer.append(rec)
             self.completed[index] = rec
+            self._maybe_compact_locked()
             jb = self._writer.journal_bytes
         self._commit_done(t0, w0, jb)
         return ok
@@ -160,9 +173,106 @@ class RangeJob:
             )
             if index in self.completed:
                 self.completed[index]["verify"] = verify
+            self._maybe_compact_locked()
             jb = self._writer.journal_bytes
         self._commit_done(t0, w0, jb)
         return ok
+
+    # -- compaction ------------------------------------------------------
+
+    def compact(self) -> bool:
+        """Snapshot the committed prefix into a fresh journal and swap it
+        in atomically, bounding replay time.
+
+        The fresh journal holds ONE merged chunk record per completed
+        chunk (verdicts already folded into their chunk record in
+        `completed`), in chunk order — replaying it reconstructs exactly
+        the current completed map, so a crash at ANY byte is safe:
+
+        - before the `os.replace`: the original journal is untouched (the
+          snapshot is built in a ``.compact`` sidecar, which a later open
+          simply overwrites);
+        - after the `os.replace`: the journal IS the snapshot and replays
+          to the same state.
+
+        Returns True when the swap happened; False when skipped (degraded
+        writer, nothing committed) or failed fail-soft (OSError — the
+        original journal keeps appending as before).
+        """
+        with self._lock:
+            return self._compact_locked()
+
+    @locked
+    def _maybe_compact_locked(self) -> None:
+        threshold = self._compact_threshold
+        if not threshold:
+            return
+        size = self._writer.journal_bytes
+        if size < threshold:
+            return
+        # require real growth since the last snapshot, or every commit past
+        # the threshold would re-snapshot an already-compact journal
+        if self._last_compact_bytes and size < int(1.5 * self._last_compact_bytes):
+            return
+        self._compact_locked()
+
+    @locked
+    def _compact_locked(self) -> bool:
+        if self._writer.degraded or not self.completed:
+            return False
+        jpath = self._writer.path
+        tmp = jpath + ".compact"
+        snapshot = b"".join(
+            frame_record(self.completed[index]) for index in sorted(self.completed)
+        )
+        crash_bytes = os.environ.get("IPC_COMPACT_CRASH_BYTES", "")
+        try:
+            with open(tmp, "wb") as fh:
+                if crash_bytes:
+                    # crash hook (tools/crashtest.py): persist only the first
+                    # K bytes of the snapshot, then die by real SIGKILL — the
+                    # swap never happened, the live journal must be untouched
+                    k = max(0, min(int(crash_bytes), len(snapshot) - 1))
+                    fh.write(snapshot[:k])
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                    os.kill(os.getpid(), signal.SIGKILL)
+                fh.write(snapshot)
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError as exc:
+            logger.warning(
+                "journal compaction of %s failed pre-swap (%s) — continuing "
+                "on the uncompacted journal", jpath, exc,
+            )
+            return False
+        fsync = self._writer._fsync
+        self._writer.close()
+        try:
+            os.replace(tmp, jpath)
+        except OSError as exc:
+            self._writer = JournalWriter(jpath, metrics=self._metrics, fsync=fsync)
+            logger.warning(
+                "journal compaction of %s failed at swap (%s) — continuing "
+                "on the uncompacted journal", jpath, exc,
+            )
+            return False
+        if os.environ.get("IPC_COMPACT_CRASH_POST", ""):
+            # crash hook: die right after the atomic swap — the journal IS
+            # the snapshot now and must replay to the same completed map
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._writer = JournalWriter(jpath, metrics=self._metrics, fsync=fsync)
+        self.compactions += 1
+        self._last_compact_bytes = self._writer.journal_bytes
+        metrics = self._metrics
+        if metrics is not None:
+            metrics.count("jobs.compactions")
+            metrics.set_gauge("jobs.journal_bytes", self._last_compact_bytes)
+        logger.info(
+            "journal %s compacted: %d chunks, %d bytes", jpath,
+            len(self.completed), self._last_compact_bytes,
+        )
+        return True
 
     def _commit_done(self, t0: float, w0: float, journal_bytes: int) -> None:
         # Two clocks on purpose. jobs.commit_us is thread CPU time:
@@ -216,6 +326,7 @@ def resume_or_create(
     manifest: dict,
     metrics=None,
     fsync: bool = True,
+    compact_threshold_bytes: "Optional[int]" = None,
 ) -> RangeJob:
     """Open (resuming) or initialize a job directory.
 
@@ -226,7 +337,19 @@ def resume_or_create(
     map, a torn tail is truncated away, duplicate or malformed chunk
     records raise `JournalError`. Replay cost surfaces as
     ``jobs.chunks_replayed`` / ``jobs.resume_ms``.
+
+    ``compact_threshold_bytes`` arms auto-compaction: once the journal
+    grows past it, commits snapshot the committed prefix and swap it in
+    (`RangeJob.compact`). Defaults to the ``IPC_JOURNAL_COMPACT_BYTES``
+    env var; unset/0 means manual compaction only.
     """
+    if compact_threshold_bytes is None:
+        raw = os.environ.get("IPC_JOURNAL_COMPACT_BYTES", "")
+        if raw:
+            try:
+                compact_threshold_bytes = int(raw)
+            except ValueError:
+                logger.warning("ignoring non-integer IPC_JOURNAL_COMPACT_BYTES=%r", raw)
     t0 = time.perf_counter()
     os.makedirs(job_dir, exist_ok=True)
     mpath = os.path.join(job_dir, JOBS_MANIFEST_NAME)
@@ -299,4 +422,7 @@ def resume_or_create(
             metrics.count("jobs.chunks_replayed", n_replayed)
         metrics.count("jobs.resume_ms", int((time.perf_counter() - t0) * 1000))
         metrics.set_gauge("jobs.journal_bytes", writer.journal_bytes)
-    return RangeJob(job_dir, manifest, completed, writer, metrics=metrics)
+    return RangeJob(
+        job_dir, manifest, completed, writer, metrics=metrics,
+        compact_threshold_bytes=compact_threshold_bytes,
+    )

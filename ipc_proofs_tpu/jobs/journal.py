@@ -46,10 +46,23 @@ from typing import Any, Optional
 
 from ipc_proofs_tpu.utils.log import get_logger
 
-__all__ = ["JOURNAL_MAGIC", "JournalError", "JournalWriter", "read_journal"]
+__all__ = [
+    "JOURNAL_MAGIC",
+    "FRAME_HEADER",
+    "JournalError",
+    "JournalWriter",
+    "frame_record",
+    "read_journal",
+    "read_journal_entries",
+    "read_record_at",
+]
 
 JOURNAL_MAGIC = b"IPJ1"
 _HEADER = struct.Struct("<4sII")  # magic, payload length, crc32(payload)
+# the framing contract, exported: the storex segment store reuses the same
+# header layout (with its own magic) so one CRC/torn-tail discipline covers
+# every append-only file in the tree
+FRAME_HEADER = _HEADER
 
 logger = get_logger(__name__)
 
@@ -71,6 +84,49 @@ def encode_record(obj: Any) -> bytes:
     return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode("utf-8")
 
 
+def frame_record(obj: Any) -> bytes:
+    """One complete journal frame for ``obj`` — the exact bytes `append`
+    would write. Exported for the compaction path, which rebuilds a
+    journal offline and atomically swaps it in."""
+    return _frame(encode_record(obj))
+
+
+def read_journal_entries(path: str) -> "tuple[list[tuple[Any, int, int]], int, bool]":
+    """Like `read_journal` but each entry carries its frame location:
+    ``(record, offset, end)`` with ``offset`` the frame start and ``end``
+    one past the payload — so callers (the serve result spill) can later
+    re-read a single record with `read_record_at` instead of pinning
+    every payload in memory."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    entries: "list[tuple[Any, int, int]]" = []
+    off = 0
+    size = len(data)
+    while off < size:
+        if size - off < _HEADER.size:
+            return entries, off, True  # torn header at the tail
+        magic, length, crc = _HEADER.unpack_from(data, off)
+        if magic != JOURNAL_MAGIC:
+            raise JournalError(f"bad journal magic at offset {off}: {magic!r}")
+        end = off + _HEADER.size + length
+        if end > size:
+            return entries, off, True  # torn payload at the tail
+        payload = data[off + _HEADER.size : end]
+        if zlib.crc32(payload) != crc:
+            raise JournalError(
+                f"journal record checksum mismatch at offset {off} "
+                f"(record {len(entries)})"
+            )
+        try:
+            entries.append((json.loads(payload), off, end))
+        except ValueError as exc:
+            raise JournalError(
+                f"journal record at offset {off} is not valid JSON: {exc}"
+            ) from exc
+        off = end
+    return entries, off, False
+
+
 def read_journal(path: str) -> "tuple[list[Any], int, bool]":
     """Replay every record in ``path``.
 
@@ -82,34 +138,35 @@ def read_journal(path: str) -> "tuple[list[Any], int, bool]":
     not explainable by a torn sequential append: bad magic, CRC mismatch
     on a fully-present frame, or a payload that isn't valid JSON.
     """
+    entries, good_offset, torn = read_journal_entries(path)
+    return [rec for rec, _, _ in entries], good_offset, torn
+
+
+def read_record_at(path: str, offset: int) -> Any:
+    """Re-read ONE record whose frame starts at ``offset`` (as reported by
+    `read_journal_entries`). Full integrity discipline applies: bad magic,
+    CRC mismatch, a frame extending past EOF, or undecodable JSON all
+    raise `JournalError` — a spilled result is either byte-verified or
+    reported corrupt, never silently wrong."""
     with open(path, "rb") as fh:
-        data = fh.read()
-    records: list[Any] = []
-    off = 0
-    size = len(data)
-    while off < size:
-        if size - off < _HEADER.size:
-            return records, off, True  # torn header at the tail
-        magic, length, crc = _HEADER.unpack_from(data, off)
+        fh.seek(offset)
+        header = fh.read(_HEADER.size)
+        if len(header) < _HEADER.size:
+            raise JournalError(f"record at offset {offset} extends past EOF")
+        magic, length, crc = _HEADER.unpack(header)
         if magic != JOURNAL_MAGIC:
-            raise JournalError(f"bad journal magic at offset {off}: {magic!r}")
-        end = off + _HEADER.size + length
-        if end > size:
-            return records, off, True  # torn payload at the tail
-        payload = data[off + _HEADER.size : end]
-        if zlib.crc32(payload) != crc:
-            raise JournalError(
-                f"journal record checksum mismatch at offset {off} "
-                f"(record {len(records)})"
-            )
-        try:
-            records.append(json.loads(payload))
-        except ValueError as exc:
-            raise JournalError(
-                f"journal record at offset {off} is not valid JSON: {exc}"
-            ) from exc
-        off = end
-    return records, off, False
+            raise JournalError(f"bad journal magic at offset {offset}: {magic!r}")
+        payload = fh.read(length)
+    if len(payload) < length:
+        raise JournalError(f"record at offset {offset} extends past EOF")
+    if zlib.crc32(payload) != crc:
+        raise JournalError(f"journal record checksum mismatch at offset {offset}")
+    try:
+        return json.loads(payload)
+    except ValueError as exc:
+        raise JournalError(
+            f"journal record at offset {offset} is not valid JSON: {exc}"
+        ) from exc
 
 
 class JournalWriter:

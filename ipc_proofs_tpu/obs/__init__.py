@@ -16,6 +16,7 @@ from ipc_proofs_tpu.obs.export import (
     chrome_trace_events,
     chrome_trace_obj,
     otlp_trace_obj,
+    post_otlp_trace,
     write_chrome_trace,
     write_otlp_trace,
 )
@@ -59,6 +60,7 @@ __all__ = [
     "get_flight_recorder",
     "install_crash_dump",
     "otlp_trace_obj",
+    "post_otlp_trace",
     "render_prometheus",
     "root_span",
     "span",

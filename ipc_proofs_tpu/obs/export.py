@@ -17,12 +17,24 @@ POSTed to any collector's ``/v1/traces`` endpoint or inspected with
 OTel-aware tooling. Ids are hex, zero-padded to the protocol widths
 (32-char traceId, 16-char spanId); timestamps are epoch nanoseconds
 reconstructed from the span's wall clock plus its monotonic duration.
+
+`post_otlp_trace` ships the same object over HTTP to a live collector
+(``--trace-otlp-url``): retried with bounded full-jitter exponential
+backoff on 5xx/429/connection errors, never retried on other 4xx (the
+payload won't get better), and fail-soft throughout — a dead collector
+costs a warning and a ``trace.otlp_post_failures`` tick, never the run.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
+import time
+import urllib.error
+import urllib.request
+
+from ipc_proofs_tpu.utils.log import get_logger
 
 __all__ = [
     "chrome_trace_events",
@@ -30,7 +42,10 @@ __all__ = [
     "write_chrome_trace",
     "otlp_trace_obj",
     "write_otlp_trace",
+    "post_otlp_trace",
 ]
+
+logger = get_logger(__name__)
 
 
 def chrome_trace_events(spans) -> list[dict]:
@@ -148,3 +163,73 @@ def write_otlp_trace(path: str, spans) -> int:
         json.dump(obj, fh)
         fh.write("\n")
     return len(obj["resourceSpans"][0]["scopeSpans"][0]["spans"])
+
+
+def _default_opener(url: str, body: bytes, timeout_s: float) -> int:
+    """POST ``body`` as OTLP/JSON; returns the HTTP status code."""
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.status
+
+
+# statuses worth a retry: the collector is overloaded or briefly down,
+# not rejecting the payload
+_RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+def post_otlp_trace(
+    url: str,
+    spans,
+    metrics=None,
+    max_attempts: int = 4,
+    base_delay_s: float = 0.25,
+    max_delay_s: float = 4.0,
+    timeout_s: float = 10.0,
+    opener=None,
+    sleep=time.sleep,
+    rng=None,
+) -> bool:
+    """POST spans to an OTLP/JSON collector endpoint; True on 2xx.
+
+    Bounded full-jitter exponential backoff between attempts (same
+    discipline as the RPC client): ``delay = uniform(0, min(max_delay,
+    base * 2**attempt))``. Connection errors and 5xx/429 retry up to
+    ``max_attempts``; any other HTTP status is terminal — re-sending an
+    unacceptable payload can't fix it. Every failure path returns False
+    after counting ``trace.otlp_post_failures`` (fail-soft: trace export
+    must never take down the work it describes). ``opener``/``sleep``/
+    ``rng`` are injectable so tests exercise the retry schedule without a
+    network or a clock.
+    """
+    if metrics is None:
+        from ipc_proofs_tpu.utils.metrics import get_metrics
+
+        metrics = get_metrics()
+    if opener is None:
+        opener = _default_opener
+    if rng is None:
+        rng = random.Random()
+    body = json.dumps(otlp_trace_obj(spans)).encode("utf-8")
+    last_reason = "no attempts made"
+    for attempt in range(max(1, int(max_attempts))):
+        if attempt:
+            cap = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
+            sleep(rng.uniform(0.0, cap))
+        try:
+            status = opener(url, body, timeout_s)
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+        except Exception as exc:  # fail-soft: connection-level failure — retry, then give up with a counter, never raise
+            last_reason = f"{type(exc).__name__}: {exc}"
+            continue
+        if 200 <= status < 300:
+            metrics.count("trace.otlp_posts")
+            return True
+        last_reason = f"HTTP {status}"
+        if status not in _RETRYABLE_STATUSES:
+            break  # terminal: the payload won't get better on a resend
+    metrics.count("trace.otlp_post_failures")
+    logger.warning("OTLP trace POST to %s failed (%s)", url, last_reason)
+    return False

@@ -17,7 +17,10 @@ Idempotency keys: a client that retries a timed-out request with the
 same ``idempotency_key`` gets the cached result instead of a second
 execution; concurrent duplicates coalesce onto one in-flight execution.
 Keys are client-chosen; omitted keys get a server-generated UUID (no
-dedup across retries — the key IS the dedup handle).
+dedup across retries — the key IS the dedup handle). The result cache is
+byte-bounded (`_ResultCache`): hot payloads live in an LRU capped by
+``results_max_bytes`` and cold ones are re-read from their own ``done``
+frame in the journal, so dedup survives restart without unbounded RSS.
 
 At-least-once semantics: a request that failed *admission* (queue full /
 draining / deadline) keeps its admit record but writes no done record —
@@ -35,10 +38,18 @@ import os
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Any, Optional, Sequence
 
-from ipc_proofs_tpu.jobs.journal import JournalError, JournalWriter, read_journal
+from ipc_proofs_tpu.jobs.journal import (
+    JournalError,
+    JournalWriter,
+    encode_record,
+    read_journal_entries,
+    read_record_at,
+)
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.utils.threads import locked
 from ipc_proofs_tpu.serve.batcher import (
     DeadlineExceededError,
     QueueFullError,
@@ -66,6 +77,113 @@ class _Inflight:
         self.error: Optional[BaseException] = None
 
 
+class _ResultCache:
+    """Completed-request results: bounded hot LRU over a journal spill.
+
+    The ``done`` record every result already writes to ``queue.bin`` IS
+    the disk copy — this cache never writes a second one. In memory it
+    keeps only ``key → frame offset`` plus a byte-bounded hot LRU of
+    payloads, so idempotency dedup survives restart while RSS stays
+    bounded no matter how many requests the process has answered.
+
+    A spilled hit re-reads its frame through `read_record_at`
+    (CRC-verified); a corrupt or unreadable frame drops the entry
+    fail-soft and the caller re-executes the request (at-least-once) —
+    the cache never serves bytes the journal can't vouch for.
+    """
+
+    def __init__(self, path: str, max_bytes: int, metrics=None):
+        self._path = path
+        self._max_bytes = max(1, int(max_bytes))
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        # offset None = result was never durably framed (degraded journal):
+        # once it ages out of the hot tier it is gone and re-executes
+        self._offsets: "dict[str, Optional[int]]" = {}  # guarded-by: _lock
+        # key → (payload, encoded size); coldest first
+        self._hot: "OrderedDict[str, tuple]" = OrderedDict()  # guarded-by: _lock
+        self._hot_bytes = 0  # guarded-by: _lock
+
+    def seed(self, key: str, offset: int) -> None:
+        """Index a replayed done record without loading its payload."""
+        with self._lock:
+            self._offsets[key] = offset
+
+    def put(self, key: str, offset: "Optional[int]", payload: dict) -> None:
+        with self._lock:
+            self._offsets[key] = offset
+            self._insert_hot_locked(key, payload)
+
+    @locked
+    def _insert_hot_locked(self, key: str, payload: dict) -> None:
+        size = len(encode_record(payload))
+        old = self._hot.pop(key, None)
+        if old is not None:
+            self._hot_bytes -= old[1]
+        if size <= self._max_bytes:
+            self._hot[key] = (payload, size)
+            self._hot_bytes += size
+        evicted = 0
+        while self._hot_bytes > self._max_bytes and self._hot:
+            _, (_, esize) = self._hot.popitem(last=False)
+            self._hot_bytes -= esize
+            evicted += 1
+        metrics = self._metrics
+        if metrics is not None:
+            if evicted:
+                metrics.count("serve.result_cache_evictions", evicted)
+            metrics.set_gauge("serve.result_cache_bytes", self._hot_bytes)
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._hot.get(key)
+            if entry is not None:
+                self._hot.move_to_end(key)
+                return entry[0]
+            if key not in self._offsets:
+                return None
+            offset = self._offsets[key]
+        if offset is None:
+            return None
+        try:
+            rec = read_record_at(self._path, offset)
+        except (JournalError, OSError) as exc:
+            logger.warning(
+                "result cache: spilled frame for %s unreadable (%s) — "
+                "dropping entry; the request will re-execute", key, exc,
+            )
+            self._drop(key, offset)
+            return None
+        if not isinstance(rec, dict) or rec.get("key") != key:
+            logger.warning(
+                "result cache: frame at %d does not belong to %s — "
+                "dropping entry; the request will re-execute", offset, key,
+            )
+            self._drop(key, offset)
+            return None
+        payload = rec.get("payload")
+        with self._lock:
+            self._insert_hot_locked(key, payload)
+        return payload
+
+    def _drop(self, key: str, offset: "Optional[int]") -> None:
+        with self._lock:
+            if self._offsets.get(key) == offset:
+                del self._offsets[key]
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._offsets
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._offsets)
+
+    def hot_bytes(self) -> int:
+        with self._lock:
+            return self._hot_bytes
+
+
 class DurableAdmission:
     """Journal-backed idempotent request layer over one `ProofService`."""
 
@@ -76,6 +194,7 @@ class DurableAdmission:
         pairs: Sequence = (),
         metrics=None,
         replay: bool = True,
+        results_max_bytes: int = 64 * 1024 * 1024,
     ):
         self.service = service
         self.pairs = list(pairs)
@@ -83,14 +202,18 @@ class DurableAdmission:
         os.makedirs(queue_dir, exist_ok=True)
         self._path = os.path.join(queue_dir, QUEUE_JOURNAL_NAME)
         self._lock = threading.Lock()
-        # key → rendered done payload
-        self._results: "dict[str, dict]" = {}  # guarded-by: _lock
+        # serializes journal appends AND makes (offset, append) atomic so a
+        # done record's spill offset is exact even under concurrent submits
+        self._jlock = threading.Lock()
+        self._results = _ResultCache(
+            self._path, results_max_bytes, metrics=self.metrics
+        )
         self._inflight: "dict[str, _Inflight]" = {}  # guarded-by: _lock
         self.resumed_jobs = 0  # admitted-but-unfinished requests re-executed
 
         pending: "list[dict]" = []
         if os.path.exists(self._path):
-            records, good_offset, torn = read_journal(self._path)
+            entries, good_offset, torn = read_journal_entries(self._path)
             if torn:
                 logger.warning(
                     "serve queue journal %s has a torn tail — truncating to "
@@ -102,7 +225,7 @@ class DurableAdmission:
                     os.fsync(fh.fileno())
             admits: "dict[str, dict]" = {}
             order: "list[str]" = []
-            for pos, rec in enumerate(records):
+            for pos, (rec, offset, _end) in enumerate(entries):
                 if not isinstance(rec, dict) or not isinstance(rec.get("key"), str):
                     raise JournalError(
                         f"malformed serve queue record {pos} in {self._path}"
@@ -113,7 +236,8 @@ class DurableAdmission:
                         admits[rec["key"]] = rec
                         order.append(rec["key"])
                 elif kind == "done":
-                    self._results[rec["key"]] = rec["payload"]
+                    # index only — the payload stays on disk until asked for
+                    self._results.seed(rec["key"], offset)
                 else:
                     raise JournalError(
                         f"unknown serve queue record type {kind!r} ({pos})"
@@ -169,9 +293,15 @@ class DurableAdmission:
         raise ValueError(f"unknown request kind {kind!r}")
 
     def _finish(self, key: str, done_payload: dict) -> None:
-        self._writer.append({"t": "done", "key": key, "payload": done_payload})
+        with self._jlock:
+            offset = self._writer.journal_bytes
+            ok = self._writer.append(
+                {"t": "done", "key": key, "payload": done_payload}
+            )
+        # a degraded (in-memory) append has no frame to point at — the hot
+        # tier is then the only copy and the entry dies with eviction
+        self._results.put(key, offset if ok else None, done_payload)
         with self._lock:
-            self._results[key] = done_payload
             flight = self._inflight.pop(key, None)
         if flight is not None:
             flight.result = done_payload
@@ -194,13 +324,21 @@ class DurableAdmission:
         execution) instead of a fresh one. Admission errors re-raise.
         """
         key = idempotency_key or f"auto-{uuid.uuid4().hex}"
+        # fast path outside _lock: a spilled hit may touch disk
+        hit = self._results.get(key)
+        if hit is not None:
+            self.metrics.count("serve.idempotent_hits")
+            return key, hit, True
         with self._lock:
-            hit = self._results.get(key)
-            if hit is not None:
-                self.metrics.count("serve.idempotent_hits")
-                return key, hit, True
             flight = self._inflight.get(key)
             if flight is None:
+                # re-check under _lock: _finish publishes the result before
+                # dropping the inflight entry, so a miss-then-no-flight race
+                # must look again before re-executing
+                hit = self._results.get(key)
+                if hit is not None:
+                    self.metrics.count("serve.idempotent_hits")
+                    return key, hit, True
                 owner = True
                 flight = self._inflight[key] = _Inflight()
             else:
@@ -215,9 +353,10 @@ class DurableAdmission:
 
         # durable intent BEFORE execution: the ACK implies the journal has it
         j0 = time.perf_counter()
-        self._writer.append(
-            {"t": "admit", "key": key, "kind": kind, "payload": payload}
-        )
+        with self._jlock:
+            self._writer.append(
+                {"t": "admit", "key": key, "kind": kind, "payload": payload}
+            )
         journal_ms = round((time.perf_counter() - j0) * 1e3, 3)
         try:
             result = self._execute(kind, payload, timeout_s=timeout_s)
@@ -251,13 +390,13 @@ class DurableAdmission:
     def health_fields(self) -> dict:
         """Merged into `/healthz` by the HTTP front end."""
         with self._lock:
-            cached = len(self._results)
             inflight = len(self._inflight)
         return {
             "durable_queue": True,
             "resumed_jobs": self.resumed_jobs,
             "journal_bytes": self.journal_bytes,
-            "completed_requests": cached,
+            "completed_requests": len(self._results),
+            "result_cache_hot_bytes": self._results.hot_bytes(),
             "inflight_requests": inflight,
             "journal_degraded": self._writer.degraded,
         }

@@ -21,7 +21,10 @@ feed the existing batch engines:
 
 All workers share one `CachedBlockstore` over the chain store, backed by a
 `BlockCache` (size-capped + TTL) so the cache survives millions of
-requests without becoming a slow OOM.
+requests without becoming a slow OOM. With ``store_dir`` set the cache
+grows a second, disk-resident tier (`storex.TieredBlockstore` over a
+`SegmentStore`): blocks fetched once survive restarts and are shared by
+every worker, so a warm tipset serves with zero upstream RPC fetches.
 
 Verification policy (trust policy, event filter, witness-CID checking) is
 service-level configuration, fixed at startup: a real deployment serves
@@ -97,6 +100,11 @@ class ServiceConfig:
     # requests slower than this auto-log their span tree (flight ring) with
     # trace_id correlation and bump the serve.slow_requests counter
     slow_request_ms: float = 1000.0
+    # disk tier (storex.SegmentStore) under the shared BlockCache: blocks
+    # persist across restarts in append-only segment files, LRU-evicted at
+    # store_cap_bytes; None keeps the memory-only CachedBlockstore
+    store_dir: Optional[str] = None
+    store_cap_bytes: int = 1 * 1024 * 1024 * 1024
 
 
 @dataclass
@@ -175,11 +183,25 @@ class ProofService:
         self.block_cache = BlockCache(
             max_bytes=self.config.cache_max_bytes, ttl_s=self.config.cache_ttl_s
         )
-        self._store = (
-            CachedBlockstore(store, shared_cache=self.block_cache)
-            if store is not None
-            else None
-        )
+        self._disk_store = None
+        if store is not None and self.config.store_dir:
+            from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
+
+            self._disk_store = SegmentStore(
+                self.config.store_dir,
+                cap_bytes=self.config.store_cap_bytes,
+                metrics=self.metrics,
+            )
+            self._store = TieredBlockstore(
+                store,
+                self._disk_store,
+                cache=self.block_cache,
+                metrics=self.metrics,
+            )
+        elif store is not None:
+            self._store = CachedBlockstore(store, shared_cache=self.block_cache)
+        else:
+            self._store = None
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )
@@ -260,12 +282,21 @@ class ProofService:
             return self._endpoint_pool.health()
         return {"status": "ok"}
 
+    @property
+    def blockstore(self):
+        """The service's layered store (tiered when ``store_dir`` is set) —
+        the `ChainFollower` prefetches into exactly this object so demand
+        traffic and the follower share one warm tier."""
+        return self._store
+
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
         snap["block_cache"] = self.block_cache.stats()
         if self._store is not None:
             snap["block_cache"]["hits"] = self._store.hits
             snap["block_cache"]["misses"] = self._store.misses
+        if self._disk_store is not None:
+            snap["disk_store"] = self._disk_store.stats()
         return snap
 
     def drain(self, timeout: Optional[float] = None) -> None:
@@ -279,6 +310,8 @@ class ProofService:
         if self._generate_batcher is not None:
             self._generate_batcher.close(drain=True, timeout=timeout)
         self._executor.shutdown(wait=True)
+        if self._disk_store is not None:
+            self._disk_store.close()
 
     close = drain
 

@@ -160,6 +160,10 @@ class LotusClient:
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1
+        # one tick per logical call (not per retry): the auditable "did we
+        # touch the node at all" counter — a disk-warm request must leave
+        # this at a delta of zero
+        self._metrics.count("rpc.calls")
         payload = {"jsonrpc": "2.0", "method": method, "params": params, "id": req_id}
         deadline = self.timeout_s if timeout_s is None else timeout_s
         last_err: Exception | None = None

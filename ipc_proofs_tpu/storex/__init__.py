@@ -1,0 +1,33 @@
+"""storex: tiered content-addressed block storage + chain-follow prefetch.
+
+Two storage tiers under one `Blockstore`-shaped wrapper:
+
+- tier 1: the existing in-memory `BlockCache` (or a plain dict) — hot,
+  per-process, dies with the process;
+- tier 2: `SegmentStore` — a disk-resident CID → bytes store in
+  append-only segment files with the journal's CRC framing, an in-memory
+  offset index rebuilt on open, and byte-capped LRU segment eviction.
+  It survives restarts, so every worker (and every restart) shares one
+  warm tier.
+
+`TieredBlockstore` slots where `CachedBlockstore` sits today (same
+`hits`/`misses`/`cache_stats()` surface); `ChainFollower` tails
+finalized tipsets and pre-populates the spine blocks (headers,
+receipts-AMT root, state-HAMT root) before the first request asks.
+
+Integrity stance: every disk read is multihash re-verified
+(`store.rpc.verify_block_bytes`), so disk corruption is an availability
+event — evict + refetch from the inner store — never a correctness one.
+"""
+
+from ipc_proofs_tpu.storex.segments import SEGMENT_MAGIC, SegmentStore, SegmentStoreError
+from ipc_proofs_tpu.storex.tiered import TieredBlockstore
+from ipc_proofs_tpu.storex.follower import ChainFollower
+
+__all__ = [
+    "SEGMENT_MAGIC",
+    "SegmentStore",
+    "SegmentStoreError",
+    "TieredBlockstore",
+    "ChainFollower",
+]

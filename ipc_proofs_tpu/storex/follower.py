@@ -1,0 +1,236 @@
+"""`ChainFollower`: tail finalized tipsets, pre-warm the tiered store.
+
+A daemon thread polls the chain head (``Filecoin.ChainHead``) and walks
+every newly finalized height through `Tipset.fetch`, prefetching the
+blocks a proof request touches first into the local tiers via
+`TieredBlockstore.put_local`:
+
+- the block header CIDs of the tipset itself;
+- each header's ``parent_state_root``, ``parent_message_receipts`` and
+  ``messages`` roots;
+- one level of IPLD links under the state root and receipts root — the
+  top of the state-HAMT and receipts-AMT spines every claim walk starts
+  from.
+
+By the time a user asks about a finalized tipset, the spine is already
+on disk and the request completes without a single RPC block fetch.
+
+Fail-soft end to end: every error (head poll, tipset fetch, block fetch,
+undecodable link block) is counted as ``follow.errors`` and retried on
+the next poll — the follower can degrade to useless, never to fatal.
+Blocks are multihash-verified BEFORE they are stored (unless the client
+pool already verifies), so the follower can't poison the disk tier.
+
+Works against anything with ``request``/``chain_read_obj`` — a
+`LotusClient`, an `EndpointPool`, or a test fake over a fixture world —
+which is what makes prefetch determinism testable under the seeded
+fault harness.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.core.dagcbor import decode as dagcbor_decode
+from ipc_proofs_tpu.proofs.chain import Tipset
+from ipc_proofs_tpu.store.rpc import verify_block_bytes
+from ipc_proofs_tpu.utils.log import get_logger
+
+__all__ = ["ChainFollower"]
+
+logger = get_logger(__name__)
+
+# cap on first-level links walked under each root block: the spine top is
+# what latency cares about (deeper nodes load on demand); an adversarially
+# wide node must not turn one poll into an unbounded crawl
+_MAX_LINKS_PER_ROOT = 32
+
+
+def _first_level_links(data: bytes) -> "list[CID]":
+    """The CID links directly inside one DAG-CBOR block, document order,
+    bounded by `_MAX_LINKS_PER_ROOT`. Undecodable blocks yield []."""
+    try:
+        obj = dagcbor_decode(data)
+    except Exception:  # fail-soft: a non-CBOR root (raw block) simply has no links to follow
+        return []
+    links: "list[CID]" = []
+    stack = [obj]
+    while stack and len(links) < _MAX_LINKS_PER_ROOT:
+        node = stack.pop(0)
+        if isinstance(node, CID):
+            links.append(node)
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        elif isinstance(node, dict):
+            # deterministic order: sorted keys (dict order is insertion
+            # order from the decoder, but sorting costs nothing and pins it)
+            stack.extend(node[k] for k in sorted(node))
+    return links
+
+
+class ChainFollower:
+    """Daemon thread that keeps the tiered store warm along the chain.
+
+    ``lag`` holds the follower ``lag`` epochs behind the reported head —
+    tail *finalized* tipsets, not the live edge. ``start_height`` begins
+    the tail at a fixed height (default: the finalized tip at first
+    successful poll, i.e. follow forward only).
+    """
+
+    def __init__(
+        self,
+        client,
+        store,
+        metrics=None,
+        poll_s: float = 15.0,
+        lag: int = 1,
+        start_height: Optional[int] = None,
+        max_tipsets_per_poll: int = 16,
+    ):
+        self._client = client
+        self._store = store
+        if metrics is None:
+            from ipc_proofs_tpu.utils.metrics import get_metrics
+
+            metrics = get_metrics()
+        self._metrics = metrics
+        self.poll_s = poll_s
+        self.lag = max(0, int(lag))
+        self.max_tipsets_per_poll = max(1, int(max_tipsets_per_poll))
+        self._lock = threading.Lock()
+        self._next_height: Optional[int] = start_height  # guarded-by: _lock
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._stop = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="chain-follower", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # fail-soft: the follower is advisory — errors are counted in poll_once, the daemon must outlive them all
+                self._metrics.count("follow.errors")
+
+    # -- one poll (synchronous — tests drive this directly) ---------------
+
+    def poll_once(self) -> int:
+        """Advance over newly finalized tipsets; returns tipsets warmed."""
+        try:
+            head = self._client.request("Filecoin.ChainHead", [])
+            head_height = int(head["Height"])
+        except Exception as exc:  # fail-soft: head poll failure is counted and retried next tick
+            self._metrics.count("follow.errors")
+            logger.warning("chain follower: head poll failed (%s)", exc)
+            return 0
+        target = head_height - self.lag
+        with self._lock:
+            if self._next_height is None:
+                self._next_height = target  # follow forward from the tip
+            nxt = self._next_height
+        done = 0
+        while nxt <= target and done < self.max_tipsets_per_poll:
+            if self._stop.is_set():
+                break
+            try:
+                tipset = Tipset.fetch(self._client, nxt)
+                self.prefetch_tipset(tipset)
+            except Exception as exc:  # fail-soft: one bad height is counted and retried next poll; never fatal
+                self._metrics.count("follow.errors")
+                logger.warning(
+                    "chain follower: prefetch of height %d failed (%s)", nxt, exc
+                )
+                break
+            self._metrics.count("follow.tipsets")
+            nxt += 1
+            done += 1
+            with self._lock:
+                self._next_height = nxt
+        return done
+
+    # -- block plumbing ---------------------------------------------------
+
+    def _put_local(self, cid: CID, data: bytes) -> None:
+        put = getattr(self._store, "put_local", None)
+        if put is not None:
+            put(cid, data)
+        else:
+            self._store.put_keyed(cid, data)
+
+    def _fetch_block(self, cid: CID) -> Optional[bytes]:
+        """Fetch + verify + store one block; returns its bytes (None when
+        it was already local or the endpoint had nothing)."""
+        has_local = getattr(self._store, "has_local", None)
+        if has_local is not None and has_local(cid):
+            return None
+        data = self._client.chain_read_obj(cid)
+        if data is None:
+            return None
+        if not getattr(self._client, "verifies_integrity", False):
+            if not verify_block_bytes(cid, data):
+                # a lying endpoint must not poison the disk tier; skip the
+                # block (demand path will fetch-and-verify with retries)
+                self._metrics.count("follow.errors")
+                logger.warning("chain follower: %s failed verification — skipped", cid)
+                return None
+        self._put_local(cid, data)
+        self._metrics.count("follow.blocks_prefetched")
+        return data
+
+    def prefetch_tipset(self, tipset: Tipset) -> None:
+        """Warm every spine block of one tipset (public: tests and the
+        bench drive this directly with fixture tipsets, no RPC tail)."""
+        spine: "list[CID]" = list(tipset.cids)
+        roots: "list[CID]" = []
+        for header in tipset.blocks:
+            spine.append(header.parent_state_root)
+            spine.append(header.parent_message_receipts)
+            spine.append(header.messages)
+            roots.append(header.parent_state_root)
+            roots.append(header.parent_message_receipts)
+        seen: "set[CID]" = set()
+        for cid in spine:
+            if cid in seen:
+                continue
+            seen.add(cid)
+            self._fetch_block(cid)
+        for root in roots:
+            # one level under the state/receipts roots: the HAMT/AMT spine
+            # top every walk descends through first
+            data = self._root_bytes(root)
+            if data is None:
+                continue
+            for link in _first_level_links(data):
+                if link in seen:
+                    continue
+                seen.add(link)
+                self._fetch_block(link)
+
+    def _root_bytes(self, root: CID) -> Optional[bytes]:
+        getter = getattr(self._store, "get", None)
+        if getter is not None:
+            try:
+                return getter(root)
+            except Exception:  # fail-soft: a store read error only skips link expansion for this root
+                self._metrics.count("follow.errors")
+                return None
+        return None

@@ -1,0 +1,382 @@
+"""Disk tier: CID → bytes in append-only CRC-framed segment files.
+
+Layout (``<root>/seg-00000001.blk``, ``seg-00000002.blk``, …)::
+
+    MAGIC   4 bytes   b"IPS1"
+    LEN     4 bytes   u32 payload length
+    CRC     4 bytes   u32 crc32(payload)
+    PAYLOAD           u16 cid_len | cid raw bytes | block bytes
+
+Same ``len|CRC32`` framing discipline as the write-ahead journal
+(`jobs.journal.FRAME_HEADER` — the header struct is literally shared),
+with the segment store's own magic so a journal can never be mistaken
+for a segment. The in-memory offset index is rebuilt by scanning every
+segment on open; a torn tail (crash mid-append) is truncated away like
+journal crash residue, and a corrupt frame mid-file truncates the
+segment at that point — the dropped blocks refetch on demand, so
+corruption only ever costs availability.
+
+Reads re-verify TWICE: the frame CRC (did the disk return what was
+written?) and the block multihash against the requested CID (is what was
+written actually this block?). Either mismatch evicts the entry, counts
+``storex.integrity_evictions``, and reports a miss so the caller
+refetches from the inner store — corrupt bytes are never served.
+
+Eviction is byte-capped LRU at *segment* granularity: the store tracks
+per-segment last-touch recency and deletes whole cold segment files when
+the cap is exceeded (content-addressed data never goes stale, so this is
+purely a disk-budget policy). The active tail segment is never evicted.
+
+Writes are flush-only (no per-block fsync): the disk tier is a cache of
+refetchable chain data, not a durability log — a lost tail costs a
+refetch, and the rebuild scan already handles any torn residue.
+Write errors (ENOSPC/EROFS) degrade the store to read-only fail-soft,
+counted as ``storex.write_failures``.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Optional
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.jobs.journal import FRAME_HEADER
+from ipc_proofs_tpu.store.rpc import verify_block_bytes
+from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.threads import locked
+
+__all__ = ["SEGMENT_MAGIC", "SegmentStore", "SegmentStoreError"]
+
+SEGMENT_MAGIC = b"IPS1"
+_CID_LEN = struct.Struct("<H")
+_SEGMENT_GLOB_PREFIX = "seg-"
+_SEGMENT_SUFFIX = ".blk"
+
+logger = get_logger(__name__)
+
+
+class SegmentStoreError(ValueError):
+    """Typed segment-store misuse: the root path is not usable as a store
+    directory, or a segment file name lies about its id. Frame-level
+    corruption never raises this — it is handled by truncate/evict +
+    refetch (availability, not correctness)."""
+
+
+class _Segment:
+    __slots__ = ("seg_id", "path", "size", "raws")
+
+    def __init__(self, seg_id: int, path: str, size: int = 0):
+        self.seg_id = seg_id
+        self.path = path
+        self.size = size
+        self.raws: "list[bytes]" = []  # raw CIDs indexed into this segment
+
+
+def _segment_path(root: str, seg_id: int) -> str:
+    return os.path.join(root, f"{_SEGMENT_GLOB_PREFIX}{seg_id:08d}{_SEGMENT_SUFFIX}")
+
+
+def _scan_segment(path: str) -> "tuple[list[tuple[bytes, int, int]], int, bool]":
+    """Scan one segment file: ``([(cid_raw, offset, frame_len)], good_size,
+    dirty)``. Stops at the first torn OR corrupt frame; ``good_size`` is
+    the byte offset to truncate to and ``dirty`` says truncation is
+    needed. Pure function of the file — no store state touched."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    entries: "list[tuple[bytes, int, int]]" = []
+    off = 0
+    size = len(data)
+    while off < size:
+        if size - off < FRAME_HEADER.size:
+            return entries, off, True  # torn header at the tail
+        magic, length, crc = FRAME_HEADER.unpack_from(data, off)
+        end = off + FRAME_HEADER.size + length
+        if magic != SEGMENT_MAGIC:
+            logger.warning(
+                "segment %s: bad magic at offset %d — truncating (blocks "
+                "past it refetch on demand)", path, off,
+            )
+            return entries, off, True
+        if end > size:
+            return entries, off, True  # torn payload at the tail
+        payload = data[off + FRAME_HEADER.size : end]
+        if zlib.crc32(payload) != crc or length < _CID_LEN.size:
+            logger.warning(
+                "segment %s: corrupt frame at offset %d — truncating (blocks "
+                "past it refetch on demand)", path, off,
+            )
+            return entries, off, True
+        (cid_len,) = _CID_LEN.unpack_from(payload, 0)
+        if _CID_LEN.size + cid_len > length:
+            logger.warning(
+                "segment %s: malformed frame at offset %d — truncating", path, off,
+            )
+            return entries, off, True
+        cid_raw = payload[_CID_LEN.size : _CID_LEN.size + cid_len]
+        entries.append((cid_raw, off, end - off))
+        off = end
+    return entries, off, False
+
+
+class SegmentStore:
+    """Byte-capped disk block store over append-only segment files.
+
+    Thread-safe: one lock guards the index, the segment LRU, and the
+    active tail writer (appends are short buffered writes). Frame reads
+    happen outside the lock against immutable committed bytes; a read
+    racing an eviction sees a vanished file and reports a plain miss.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        cap_bytes: int = 1 << 30,
+        segment_max_bytes: int = 64 * 1024 * 1024,
+        metrics=None,
+    ):
+        if cap_bytes <= 0:
+            raise SegmentStoreError("cap_bytes must be positive")
+        os.makedirs(root, exist_ok=True)
+        if not os.path.isdir(root):
+            raise SegmentStoreError(f"segment store root {root!r} is not a directory")
+        self.root = root
+        self._cap_bytes = cap_bytes
+        self._segment_max_bytes = max(1, segment_max_bytes)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        # raw CID bytes -> (seg_id, frame offset, frame length)
+        self._index: "dict[bytes, tuple[int, int, int]]" = {}  # guarded-by: _lock
+        # seg_id -> _Segment, ordered coldest-first (LRU)
+        self._segments: "OrderedDict[int, _Segment]" = OrderedDict()  # guarded-by: _lock
+        self._total_bytes = 0  # guarded-by: _lock
+        self._active: Optional[_Segment] = None  # guarded-by: _lock
+        self._active_fh = None  # guarded-by: _lock
+        self.degraded = False  # guarded-by: _lock
+        self._warned = False  # guarded-by: _lock
+
+        # -- index rebuild: scan every segment, truncate torn/corrupt tails
+        next_id = 1
+        for name in sorted(os.listdir(root)):
+            if not (name.startswith(_SEGMENT_GLOB_PREFIX) and name.endswith(_SEGMENT_SUFFIX)):
+                continue
+            try:
+                seg_id = int(name[len(_SEGMENT_GLOB_PREFIX) : -len(_SEGMENT_SUFFIX)])
+            except ValueError as exc:
+                raise SegmentStoreError(f"segment file name {name!r} has no id") from exc
+            path = os.path.join(root, name)
+            entries, good_size, dirty = _scan_segment(path)
+            if dirty:
+                with open(path, "r+b") as fh:
+                    fh.truncate(good_size)
+            seg = _Segment(seg_id, path, good_size)
+            for cid_raw, off, frame_len in entries:
+                prior = self._index.get(cid_raw)
+                if prior is not None:
+                    # duplicate insert across segments (two writers raced a
+                    # miss); keep the newest, the bytes verify identically
+                    continue
+                self._index[cid_raw] = (seg_id, off, frame_len)
+                seg.raws.append(cid_raw)
+            self._segments[seg_id] = seg
+            self._total_bytes += seg.size
+            next_id = max(next_id, seg_id + 1)
+        self._next_id = next_id  # guarded-by: _lock
+
+    # -- internals (call with _lock HELD) ---------------------------------
+
+    @locked
+    def _open_active_locked(self) -> None:
+        seg = _Segment(self._next_id, _segment_path(self.root, self._next_id))
+        self._next_id += 1
+        self._active_fh = open(seg.path, "ab")
+        self._active = seg
+        self._segments[seg.seg_id] = seg  # newest == hottest end
+
+    @locked
+    def _evict_locked(self) -> None:
+        while self._total_bytes > self._cap_bytes and len(self._segments) > 1:
+            seg_id, seg = next(iter(self._segments.items()))
+            if self._active is not None and seg_id == self._active.seg_id:
+                # the tail is somehow the coldest — never evict it; move it
+                # to the hot end and stop
+                self._segments.move_to_end(seg_id)
+                return
+            del self._segments[seg_id]
+            self._total_bytes -= seg.size
+            for cid_raw in seg.raws:
+                entry = self._index.get(cid_raw)
+                if entry is not None and entry[0] == seg_id:
+                    del self._index[cid_raw]
+            try:
+                os.remove(seg.path)
+            except OSError:
+                pass  # fail-soft: the index entry is gone either way; a leftover file is reclaimed on next open
+            metrics = self._metrics
+            if metrics is not None:
+                metrics.count("storex.evictions")
+            self._gauge_locked()
+
+    @locked
+    def _gauge_locked(self) -> None:
+        metrics = self._metrics
+        if metrics is not None:
+            metrics.set_gauge("storex.disk_bytes", self._total_bytes)
+
+    def _drop_entry(self, cid_raw: bytes, entry: "tuple[int, int, int]") -> None:
+        with self._lock:
+            if self._index.get(cid_raw) == entry:
+                del self._index[cid_raw]
+
+    # -- public API -------------------------------------------------------
+
+    def get(self, cid: CID) -> Optional[bytes]:
+        """Verified read: frame CRC + multihash, or a counted miss."""
+        cid_raw = cid.to_bytes()
+        with self._lock:
+            entry = self._index.get(cid_raw)
+            path = None
+            if entry is not None:
+                seg = self._segments.get(entry[0])
+                if seg is not None:
+                    self._segments.move_to_end(entry[0])
+                    path = seg.path
+                # an active-tail read must see buffered bytes
+                if (
+                    self._active is not None
+                    and entry[0] == self._active.seg_id
+                    and self._active_fh is not None
+                ):
+                    self._active_fh.flush()
+        metrics = self._metrics
+        if entry is None or path is None:
+            if metrics is not None:
+                metrics.count("storex.disk_misses")
+            return None
+        seg_id, off, frame_len = entry
+        data = self._read_verified(cid, cid_raw, path, off, frame_len)
+        if data is None:
+            # corrupt on disk: evict so the caller's refetch repopulates a
+            # clean copy — corruption is an availability event by design
+            self._drop_entry(cid_raw, entry)
+            if metrics is not None:
+                metrics.count("storex.integrity_evictions")
+                metrics.count("storex.disk_misses")
+            return None
+        if metrics is not None:
+            metrics.count("storex.disk_hits")
+        return data
+
+    def _read_verified(
+        self, cid: CID, cid_raw: bytes, path: str, off: int, frame_len: int
+    ) -> Optional[bytes]:
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(off)
+                frame = fh.read(frame_len)
+        except OSError:
+            return None  # segment evicted/unreadable under us: plain miss
+        if len(frame) != frame_len or frame_len < FRAME_HEADER.size + _CID_LEN.size:
+            return None
+        magic, length, crc = FRAME_HEADER.unpack_from(frame, 0)
+        if magic != SEGMENT_MAGIC or FRAME_HEADER.size + length != frame_len:
+            return None
+        payload = frame[FRAME_HEADER.size :]
+        if zlib.crc32(payload) != crc:
+            return None
+        (cid_len,) = _CID_LEN.unpack_from(payload, 0)
+        if _CID_LEN.size + cid_len > length:
+            return None
+        if payload[_CID_LEN.size : _CID_LEN.size + cid_len] != cid_raw:
+            return None
+        data = payload[_CID_LEN.size + cid_len :]
+        if not verify_block_bytes(cid, data):
+            return None
+        return data
+
+    def put(self, cid: CID, data: bytes) -> bool:
+        """Append one block (True iff it reached the segment tail)."""
+        data = bytes(data)
+        cid_raw = cid.to_bytes()
+        payload = _CID_LEN.pack(len(cid_raw)) + cid_raw + data
+        frame = (
+            FRAME_HEADER.pack(SEGMENT_MAGIC, len(payload), zlib.crc32(payload))
+            + payload
+        )
+        with self._lock:
+            if self.degraded:
+                return False
+            if cid_raw in self._index:
+                return True  # content-addressed: already present, identical
+            try:
+                if self._active_fh is None:
+                    self._open_active_locked()
+                off = self._active.size
+                self._active_fh.write(frame)
+                self._active_fh.flush()
+            except OSError as exc:
+                # ENOSPC/EROFS: degrade to read-only — the warm tier keeps
+                # serving what it has, new blocks just stop spilling
+                self.degraded = True
+                metrics = self._metrics
+                if metrics is not None:
+                    metrics.count("storex.write_failures")
+                if not self._warned:
+                    self._warned = True
+                    logger.warning(
+                        "segment store %s unwritable (%s) — degrading to "
+                        "read-only", self.root, exc,
+                    )
+                return False
+            self._index[cid_raw] = (self._active.seg_id, off, len(frame))
+            self._active.raws.append(cid_raw)
+            self._active.size += len(frame)
+            self._total_bytes += len(frame)
+            self._segments.move_to_end(self._active.seg_id)
+            if self._active.size >= self._segment_max_bytes:
+                try:
+                    self._active_fh.close()
+                except OSError:
+                    pass  # fail-soft: the bytes are flushed; a close error does not lose them
+                self._active_fh = None
+                self._active = None
+            self._evict_locked()
+            self._gauge_locked()
+        return True
+
+    def contains(self, cid: CID) -> bool:
+        with self._lock:
+            return cid.to_bytes() in self._index
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._index),
+                "bytes": self._total_bytes,
+                "cap_bytes": self._cap_bytes,
+                "segments": len(self._segments),
+                "degraded": self.degraded,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._active_fh is not None:
+                try:
+                    self._active_fh.close()
+                except OSError:
+                    pass  # fail-soft: flushed bytes survive; rebuild handles any residue
+                self._active_fh = None
+                self._active = None
+
+    def __enter__(self) -> "SegmentStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -27,9 +27,11 @@ __all__ = [
     "OBSERVABILITY_COUNTERS",
     "RANGE_COUNTERS",
     "SERVE_COUNTERS",
+    "STOREX_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
     "DURABILITY_GAUGES",
+    "STOREX_GAUGES",
     "SERVE_HISTOGRAMS",
 ]
 
@@ -46,7 +48,12 @@ __all__ = [
 #   failover.breaker_open   — circuit-breaker open transitions
 #   range_scan_retries      — transparent chunk re-scans after transient errors
 #   range_pipeline_serial_fallback — pipelined driver ran inline (1-core host)
+#   rpc.calls               — JSON-RPC requests issued (all methods, before
+#                             retries): the denominator every cache/prefetch
+#                             claim is audited against — a disk-warm request
+#                             must show a delta of ZERO
 RESILIENCE_COUNTERS = (
+    "rpc.calls",
     "rpc.retries",
     "rpc.failures",
     "rpc.integrity_failures",
@@ -76,6 +83,8 @@ RESILIENCE_COUNTERS = (
 #                             actually experiences, so it is the number
 #                             surfaced as `journal_ms` in Server-Timing
 #   jobs.journal_failures   — records lost to fail-soft journal I/O degrade
+#   jobs.compactions        — journal committed-prefix snapshots swapped in
+#                             (each one re-bounds replay time)
 #   serve.requests_replayed — admitted-but-unfinished serve requests
 #                             re-executed on daemon restart
 DURABILITY_COUNTERS = (
@@ -84,6 +93,7 @@ DURABILITY_COUNTERS = (
     "jobs.commit_us",
     "jobs.chunk_journal_us",
     "jobs.journal_failures",
+    "jobs.compactions",
     "serve.requests_replayed",
 )
 
@@ -96,11 +106,17 @@ DURABILITY_COUNTERS = (
 #   serve.slow_requests     — serve requests whose wall exceeded the
 #                             slow-request threshold (their span tree is
 #                             auto-logged with trace_id correlation)
+#   trace.otlp_posts        — OTLP/JSON batches POSTed to a collector
+#   trace.otlp_post_failures— collector POSTs that exhausted their retry
+#                             budget (fail-soft: the run never fails on
+#                             telemetry delivery)
 OBSERVABILITY_COUNTERS = (
     "trace.spans_recorded",
     "trace.spans_dropped",
     "trace.spans_sampled_out",
     "serve.slow_requests",
+    "trace.otlp_posts",
+    "trace.otlp_post_failures",
 )
 
 # Counter vocabulary of the proof engines (proofs/range.py,
@@ -138,6 +154,33 @@ SERVE_COUNTERS = (
     "serve.batches.generate",
     "serve.batches.verify",
     "serve.idempotent_hits",
+    "serve.result_cache_evictions",
+)
+
+# Counter vocabulary of the tiered block store + chain follower
+# (storex/segments.py, storex/tiered.py, storex/follower.py):
+#   storex.disk_hits           — verified reads served from the disk tier
+#   storex.disk_misses         — disk-tier lookups that fell through to the
+#                                inner store (includes integrity evictions)
+#   storex.evictions           — whole segments LRU-evicted over the byte cap
+#   storex.integrity_evictions — disk frames that failed CRC or multihash
+#                                re-verification: evicted + refetched, the
+#                                corruption-is-an-availability-event counter
+#   storex.write_failures      — blocks the disk tier could not spill
+#                                (ENOSPC/EROFS fail-soft read-only degrade)
+#   follow.tipsets             — finalized tipsets the chain follower warmed
+#   follow.blocks_prefetched   — spine blocks the follower stored locally
+#   follow.errors              — follower errors absorbed fail-soft (head
+#                                polls, fetches, verification skips)
+STOREX_COUNTERS = (
+    "storex.disk_hits",
+    "storex.disk_misses",
+    "storex.evictions",
+    "storex.integrity_evictions",
+    "storex.write_failures",
+    "follow.tipsets",
+    "follow.blocks_prefetched",
+    "follow.errors",
 )
 
 # Stage-timer vocabulary (`Metrics.stage(...)`): every `with
@@ -164,9 +207,13 @@ PIPELINE_STAGES = (
 # Gauge vocabulary: instantaneous state, overwritten not accumulated.
 SERVE_GAUGES = (
     "serve.queue_depth.*",  # per-batcher queue depth (generate/verify)
+    "serve.result_cache_bytes",  # hot bytes in the spilled result cache
 )
 DURABILITY_GAUGES = (
     "jobs.journal_bytes",  # bytes in the active job's write-ahead journal
+)
+STOREX_GAUGES = (
+    "storex.disk_bytes",  # bytes across all disk-tier segment files
 )
 
 # Histogram vocabulary: bounded-reservoir distributions (p50/p90/p99).

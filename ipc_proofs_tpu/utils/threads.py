@@ -27,9 +27,18 @@ from typing import Mapping, Optional
 
 from ipc_proofs_tpu.utils.log import get_logger
 
-__all__ = ["ThreadBudget", "resolve_thread_budget"]
+__all__ = ["ThreadBudget", "locked", "resolve_thread_budget"]
 
 logger = get_logger(__name__)
+
+
+def locked(fn):
+    """Document (and tell the race lint) that a method's CALLER must
+    already hold the instance lock guarding the attributes it touches.
+    Pure annotation — no runtime behavior; the lint treats the decorated
+    body as lock-held instead of demanding a lexical ``with self._lock:``.
+    """
+    return fn
 
 _log_lock = threading.Lock()
 _logged: "set[tuple]" = set()  # guarded-by: _log_lock

@@ -158,6 +158,17 @@ _OBSERVABILITY_OK = {
     "observability_pairs": 48,
 }
 
+_STORAGE_OK = {
+    "cold_vs_warm_speedup": 5.9,
+    "disk_hit_ratio": 1.0,
+    "prefetch_hit_ratio": 0.18,
+    "storage_cold_rpc_calls": 541,
+    "storage_warm_rpc_calls": 0,
+    "storage_prefetched_blocks": 101,
+    "storage_disk_bytes": 260_000,
+    "storage_pairs": 12,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -187,6 +198,7 @@ class TestOrchestrate:
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
+            "storage": [(dict(_STORAGE_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -204,6 +216,9 @@ class TestOrchestrate:
         assert out["legs"]["observability"] == "ok:cpu"
         assert out["trace_overhead_pct"] == 0.8
         assert out["spans_per_proof"] == 0.1
+        assert out["legs"]["storage"] == "ok:cpu"
+        assert out["cold_vs_warm_speedup"] == 5.9
+        assert out["storage_warm_rpc_calls"] == 0
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -218,6 +233,7 @@ class TestOrchestrate:
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
+            "storage": [(dict(_STORAGE_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -230,6 +246,7 @@ class TestOrchestrate:
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
             ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
             ("durability", "cpu"), ("observability", "cpu"),
+            ("storage", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -244,6 +261,7 @@ class TestOrchestrate:
             "resilience": [(dict(_RESILIENCE_OK), "ok:cpu")],
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
+            "storage": [(dict(_STORAGE_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -289,6 +307,7 @@ class TestOrchestrate:
             "resilience": [(None, "error:cpu")],
             "durability": [(None, "error:cpu")],
             "observability": [(None, "error:cpu")],
+            "storage": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -301,6 +320,7 @@ class TestOrchestrate:
             "proofs_per_sec_at_fault_rate", "recovery_ms",
             "durability_journal_overhead_pct", "durability_resume_ms",
             "trace_overhead_pct", "spans_per_proof",
+            "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -86,3 +86,37 @@ def test_single_torn_kill_point_detail(tmp_path):
     assert res["records_after_crash"] == 2  # the torn 3rd record is not counted
     assert res["torn_tail"]
     assert res["chunks_replayed"] == 2
+
+
+@pytest.mark.parametrize("seed", [20260805])
+def test_compaction_crash_grid_resumes_byte_identical(seed):
+    """SIGKILL during journal compaction — mid-sidecar-write (torn
+    ``.compact`` tmp) and immediately after the atomic swap — must leave a
+    parseable journal that resumes to the reference bytes. The original
+    journal is untouched until the `os.replace`, so both kill flavors
+    recover."""
+    summary = crashtest.run_compaction_grid(seed, n_pairs=12, chunk_size=2)
+    assert summary["ok"], summary["violations"]
+    assert summary["counts"] == {"identical": summary["points"]}
+    modes = {m for m, _ in summary["kill_points"]}
+    assert modes == {"torn_tmp", "post_swap"}
+
+
+def test_single_compaction_kill_point_detail(tmp_path):
+    """One torn-sidecar kill end to end: the ``.compact`` tmp exists (the
+    crash landed mid-snapshot), the real journal still parses, and the
+    resume reproduces the reference byte-for-byte."""
+    shape = {
+        "pairs": 8, "chunk_size": 2, "receipts": 3, "events": 2,
+        "match_rate": 0.3,
+    }
+    store, pairs, spec = crashtest._build_world(8, 3, 2, 0.3)
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+    reference = generate_event_proofs_for_range_pipelined(
+        store, pairs, spec, chunk_size=2, scan_threads=2, force_pipeline=True
+    ).to_json()
+    res = crashtest.compaction_crash_run(
+        reference, shape, "torn_tmp", str(tmp_path), tag="t", torn_bytes=7
+    )
+    assert res["outcome"] == "identical", res

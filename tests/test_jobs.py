@@ -389,3 +389,99 @@ class TestRangeDriverResume:
         # the journal is whole again
         records, _, torn = read_journal(jpath)
         assert len(records) == 3 and not torn
+
+
+class TestCompaction:
+    def test_manual_compact_shrinks_and_replays_identically(self, tmp_path):
+        """chunk+verdict records fold into one merged record per chunk;
+        the swapped-in journal replays to the same completed map."""
+        job_dir = str(tmp_path / "job")
+        man = _manifest()  # n_chunks == 2
+        metrics = Metrics()
+        with resume_or_create(job_dir, man, metrics=metrics) as job:
+            for i in range(2):
+                job.commit_chunk(i, f"d{i}", _FakeBundle({"k": i}))
+                job.commit_verdict(i, f"d{i}", {"ok": True})
+            before = dict(job.completed)
+            size_before = os.path.getsize(tmp_path / "job" / JOBS_JOURNAL_NAME)
+            assert job.compact() is True
+            assert job.compactions == 1
+        jpath = str(tmp_path / "job" / JOBS_JOURNAL_NAME)
+        assert os.path.getsize(jpath) < size_before
+        records, _, torn = read_journal(jpath)
+        assert not torn
+        assert [r["chunk"] for r in records] == [0, 1]  # one record per chunk
+        assert all(r["verify"] == {"ok": True} for r in records)
+        with resume_or_create(job_dir, man) as job2:
+            assert job2.completed == before
+        counters = metrics.snapshot()["counters"]
+        assert counters["jobs.compactions"] == 1
+        assert metrics.snapshot()["gauges"]["jobs.journal_bytes"] == os.path.getsize(jpath)
+
+    def test_compact_noop_when_empty(self, tmp_path):
+        with resume_or_create(str(tmp_path / "job"), _manifest()) as job:
+            assert job.compact() is False
+            assert job.compactions == 0
+
+    def test_compact_noop_when_degraded(self, tmp_path):
+        with resume_or_create(str(tmp_path / "job"), _manifest()) as job:
+            job.commit_chunk(0, "d", _FakeBundle({}))
+            job._writer._fh = _BrokenFile(30)
+            job.commit_chunk(1, "d", _FakeBundle({}))  # degrades the writer
+            assert job.degraded
+            assert job.compact() is False
+
+    def test_auto_compaction_threshold_and_growth_guard(self, tmp_path):
+        """threshold=1 → every commit is past the threshold, but the 1.5×
+        growth guard keeps re-snapshots from firing on every append."""
+        job_dir = str(tmp_path / "job")
+        man = _manifest(n_pairs=8, chunk_size=2)  # n_chunks == 4
+        with resume_or_create(job_dir, man, compact_threshold_bytes=1) as job:
+            for i in range(4):
+                job.commit_chunk(i, f"d{i}", _FakeBundle({"payload": "x" * 200}))
+            assert job.compactions >= 1
+            n_compactions = job.compactions
+            assert n_compactions < 4  # the growth guard gated some commits
+            before = dict(job.completed)
+        jpath = str(tmp_path / "job" / JOBS_JOURNAL_NAME)
+        records, _, torn = read_journal(jpath)
+        assert not torn and len(records) == 4
+        with resume_or_create(job_dir, man) as job2:
+            assert job2.completed == before
+
+    def test_env_var_arms_auto_compaction(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("IPC_JOURNAL_COMPACT_BYTES", "1")
+        with resume_or_create(str(tmp_path / "job"), _manifest()) as job:
+            job.commit_chunk(0, "d", _FakeBundle({"k": 0}))
+            assert job.compactions == 1
+        monkeypatch.setenv("IPC_JOURNAL_COMPACT_BYTES", "not-a-number")
+        with resume_or_create(str(tmp_path / "job2"), _manifest()) as job:
+            job.commit_chunk(0, "d", _FakeBundle({"k": 0}))
+            assert job.compactions == 0  # malformed env ignored, warned
+
+    def test_driver_run_with_compaction_is_byte_identical(
+        self, tmp_path, range_world, monkeypatch
+    ):
+        """End to end: auto-compaction armed under the real pipelined
+        driver — the bundle is unchanged and a resume replays the
+        compacted journal to the same bytes."""
+        store, pairs, spec = range_world
+        reference = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2, force_pipeline=True
+        ).to_json()
+        monkeypatch.setenv("IPC_JOURNAL_COMPACT_BYTES", "1")
+        job_dir = str(tmp_path / "job")
+        first = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=job_dir,
+        )
+        assert first.to_json() == reference
+        metrics = Metrics()
+        resumed = generate_event_proofs_for_range_pipelined(
+            store, pairs, spec, chunk_size=2, scan_threads=2,
+            force_pipeline=True, job_dir=job_dir, metrics=metrics,
+        )
+        assert resumed.to_json() == reference
+        counters = metrics.snapshot()["counters"]
+        assert counters["range_chunks_resumed"] == 3
+        assert "range_chunks_generated" not in counters

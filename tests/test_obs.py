@@ -643,3 +643,110 @@ class TestTraceview:
         assert summary["stages"]["stage_b"]["total_us"] == max(
             1, spans["stage_b"]
         )
+
+
+# --------------------------------------------------------------------------
+# OTLP POST (--trace-otlp-url)
+# --------------------------------------------------------------------------
+
+
+class TestOtlpPost:
+    """`post_otlp_trace`: bounded full-jitter retry against an injectable
+    opener/sleep/rng — no network, no clock, fully deterministic."""
+
+    def _post(self, script, spans, metrics, **kwargs):
+        """Run one post; ``script`` lists per-attempt outcomes (int status
+        or an exception to raise). Returns (ok, request bodies, sleeps)."""
+        import random as _random
+
+        from ipc_proofs_tpu.obs import post_otlp_trace
+
+        script = list(script)
+        calls, sleeps = [], []
+
+        def opener(url, body, timeout_s):
+            assert url == "http://collector:4318/v1/traces"
+            calls.append(body)
+            action = script.pop(0) if script else 200
+            if isinstance(action, Exception):
+                raise action
+            return action
+
+        ok = post_otlp_trace(
+            "http://collector:4318/v1/traces", spans, metrics=metrics,
+            opener=opener, sleep=sleeps.append, rng=_random.Random(7),
+            **kwargs,
+        )
+        return ok, calls, sleeps
+
+    def test_success_counts_and_posts_valid_otlp(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, calls, sleeps = self._post([200], spans, m)
+        assert ok and len(calls) == 1 and sleeps == []
+        counters = m.snapshot()["counters"]
+        assert counters["trace.otlp_posts"] == 1
+        assert "trace.otlp_post_failures" not in counters
+        body = json.loads(calls[0].decode("utf-8"))
+        assert len(body["resourceSpans"][0]["scopeSpans"][0]["spans"]) == len(spans)
+
+    def test_5xx_retries_until_success(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, calls, sleeps = self._post([500, 503], spans, m)
+        assert ok and len(calls) == 3 and len(sleeps) == 2
+        assert m.snapshot()["counters"]["trace.otlp_posts"] == 1
+
+    def test_exhausted_retries_fail_soft(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, calls, sleeps = self._post([503, 503, 503, 503], spans, m)
+        assert not ok and len(calls) == 4 and len(sleeps) == 3
+        counters = m.snapshot()["counters"]
+        assert counters["trace.otlp_post_failures"] == 1
+        assert "trace.otlp_posts" not in counters
+
+    def test_4xx_is_terminal_no_retry(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, calls, sleeps = self._post([400, 200], spans, m)
+        assert not ok and len(calls) == 1 and sleeps == []
+        assert m.snapshot()["counters"]["trace.otlp_post_failures"] == 1
+
+    def test_429_is_retryable(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, calls, _ = self._post([429, 200], spans, m)
+        assert ok and len(calls) == 2
+
+    def test_connection_errors_retry(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, calls, _ = self._post(
+            [OSError("refused"), OSError("reset"), 200], spans, m
+        )
+        assert ok and len(calls) == 3
+        assert m.snapshot()["counters"]["trace.otlp_posts"] == 1
+
+    def test_http_error_exception_maps_to_status(self, collector):
+        import urllib.error
+
+        spans = _make_spans(collector)
+        m = Metrics()
+        err = urllib.error.HTTPError(
+            "http://collector:4318/v1/traces", 503, "unavailable", {}, None
+        )
+        ok, calls, _ = self._post([err, 200], spans, m)
+        assert ok and len(calls) == 2
+
+    def test_backoff_is_bounded_full_jitter(self, collector):
+        spans = _make_spans(collector)
+        m = Metrics()
+        ok, _, sleeps = self._post(
+            [503] * 5, spans, m,
+            max_attempts=5, base_delay_s=1.0, max_delay_s=2.0,
+        )
+        assert not ok and len(sleeps) == 4
+        # full jitter: uniform(0, min(max_delay, base * 2**(attempt-1)))
+        for i, s in enumerate(sleeps):
+            assert 0.0 <= s <= min(2.0, 1.0 * 2**i)

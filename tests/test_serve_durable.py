@@ -312,3 +312,109 @@ class TestDurableHTTP:
         assert health["resumed_jobs"] == 0
         assert isinstance(health["journal_bytes"], int)
         assert health["journal_degraded"] is False
+
+
+def _done_frame_span(jpath: str, key: str):
+    """(offset, end) of the ``done`` frame for ``key`` in the queue journal."""
+    from ipc_proofs_tpu.jobs.journal import read_journal_entries
+
+    entries, _, _ = read_journal_entries(jpath)
+    for rec, offset, end in entries:
+        if rec.get("t") == "done" and rec.get("key") == key:
+            return offset, end
+    raise AssertionError(f"no done record for {key!r}")
+
+
+class TestResultSpill:
+    """The completed-request cache is byte-bounded: cold results are
+    re-read from their own ``done`` frame in the journal (CRC-verified),
+    so dedup survives eviction AND restart without unbounded RSS — and a
+    corrupt spilled frame re-executes instead of serving garbage."""
+
+    def test_evicted_result_served_from_disk(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        # 1-byte hot tier: no payload ever stays in memory
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs, results_max_bytes=1)
+        try:
+            _, done, cached = d.submit("generate", 0, idempotency_key="g-1")
+            assert done["ok"] and not cached
+            assert d.health_fields()["result_cache_hot_bytes"] == 0
+            # the repeat is a disk hit: same payload, nothing re-executed
+            _, done2, cached2 = d.submit("generate", 0, idempotency_key="g-1")
+            assert cached2 and done2 == done
+            records, _, torn = read_journal(str(tmp_path / "queue.bin"))
+            assert [r["t"] for r in records] == ["admit", "done"] and not torn
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_hot_tier_bounded_and_evictions_counted(self, tmp_path, world):
+        _, pairs, _ = world
+        metrics = Metrics()
+        svc = _service(world, metrics=metrics)
+        cap = 4096
+        d = DurableAdmission(
+            svc, str(tmp_path), pairs=pairs, results_max_bytes=cap
+        )
+        try:
+            for i in range(6):
+                _, done, _ = d.submit("generate", i % 2, idempotency_key=f"g-{i}")
+                assert done["ok"]
+            assert d.health_fields()["result_cache_hot_bytes"] <= cap
+            snap = metrics.snapshot()
+            assert snap["counters"]["serve.result_cache_evictions"] >= 1
+            assert snap["gauges"]["serve.result_cache_bytes"] <= cap
+            # every key still deduplicates, hot or spilled
+            for i in range(6):
+                _, done, cached = d.submit("generate", i % 2, idempotency_key=f"g-{i}")
+                assert cached and done["ok"]
+        finally:
+            d.close()
+            svc.drain()
+
+    def test_spilled_dedup_survives_restart(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs, results_max_bytes=1)
+        _, done, _ = d.submit("generate", 1, idempotency_key="g-r")
+        d.close()
+        # restart with the same 1-byte hot tier: the replay seeds only the
+        # key → offset index (no payload load), the hit re-reads the frame
+        d2 = DurableAdmission(svc, str(tmp_path), pairs=pairs, results_max_bytes=1)
+        try:
+            assert d2.health_fields()["result_cache_hot_bytes"] == 0
+            _, done2, cached = d2.submit("generate", 1, idempotency_key="g-r")
+            assert cached and done2 == done
+            records, _, _ = read_journal(str(tmp_path / "queue.bin"))
+            assert len(records) == 2  # nothing re-executed, nothing re-written
+        finally:
+            d2.close()
+            svc.drain()
+
+    def test_corrupt_spilled_frame_reexecutes(self, tmp_path, world):
+        _, pairs, _ = world
+        svc = _service(world)
+        d = DurableAdmission(svc, str(tmp_path), pairs=pairs, results_max_bytes=1)
+        try:
+            _, done, _ = d.submit("generate", 0, idempotency_key="g-c")
+            jpath = str(tmp_path / "queue.bin")
+            offset, end = _done_frame_span(jpath, "g-c")
+            with open(jpath, "r+b") as fh:  # flip a byte inside the payload
+                fh.seek(end - 2)
+                b = fh.read(1)
+                fh.seek(end - 2)
+                fh.write(bytes([b[0] ^ 0x40]))
+            # the CRC check rejects the frame → the entry drops → the
+            # request re-executes (at-least-once, never garbage)
+            _, done2, cached = d.submit("generate", 0, idempotency_key="g-c")
+            assert not cached
+            # a fresh execution: same bundle bytes, fresh trace identity
+            assert done2["ok"]
+            assert done2["result"]["bundle"] == done["result"]["bundle"]
+            # the fresh done frame makes the key cacheable again
+            _, done3, cached3 = d.submit("generate", 0, idempotency_key="g-c")
+            assert cached3 and done3 == done2
+        finally:
+            d.close()
+            svc.drain()

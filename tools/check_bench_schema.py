@@ -99,6 +99,14 @@ _KNOWN_TYPES = {
     "observability_spans_recorded": int,
     "observability_spans_dropped": int,
     "observability_pairs": int,
+    "cold_vs_warm_speedup": _NUM,
+    "disk_hit_ratio": _NUM,
+    "prefetch_hit_ratio": _NUM,
+    "storage_cold_rpc_calls": int,
+    "storage_warm_rpc_calls": int,
+    "storage_prefetched_blocks": int,
+    "storage_disk_bytes": int,
+    "storage_pairs": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -124,6 +132,7 @@ _CURRENT_REQUIRED = (
     "durability_replay_chunks_per_sec", "durability_journal_bytes",
     "durability_chunks",
     "trace_overhead_pct", "spans_per_proof",
+    "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
     "legs", "watchdog_fallback",
 )
 

@@ -18,6 +18,13 @@ buffered-file flush — exactly a preemption or OOM kill. The parent
 observes rc ``-SIGKILL``, re-runs the child with the same job dir and no
 crash env, and compares the final bundle bytes against the reference.
 
+Compaction kills (``--compaction`` / `run_compaction_grid`): with
+``IPC_JOURNAL_COMPACT_BYTES=1`` arming auto-compaction on the first
+commit, ``IPC_COMPACT_CRASH_BYTES=K`` tears the snapshot sidecar at byte
+K and dies before the atomic swap (live journal must be untouched), and
+``IPC_COMPACT_CRASH_POST=1`` dies right after ``os.replace`` (the
+journal IS the snapshot). Every point must resume byte-identical.
+
 Usage:
     python tools/crashtest.py SEED [--points N] [--pairs P] [--chunk-size C]
                                    [--record-workers W] [--quick]
@@ -101,6 +108,7 @@ def _spawn_child(
     torn: "int | None" = None,
     metrics_out: "str | None" = None,
     timeout_s: float = 300.0,
+    extra_env: "dict | None" = None,
 ) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
@@ -115,12 +123,20 @@ def _spawn_child(
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["IPC_FORCE_PIPELINE"] = "1"
-    env.pop("IPC_JOURNAL_CRASH_AT", None)
-    env.pop("IPC_JOURNAL_CRASH_TORN", None)
+    for key in (
+        "IPC_JOURNAL_CRASH_AT",
+        "IPC_JOURNAL_CRASH_TORN",
+        "IPC_JOURNAL_COMPACT_BYTES",
+        "IPC_COMPACT_CRASH_BYTES",
+        "IPC_COMPACT_CRASH_POST",
+    ):
+        env.pop(key, None)
     if crash_at is not None:
         env["IPC_JOURNAL_CRASH_AT"] = str(crash_at)
         if torn is not None:
             env["IPC_JOURNAL_CRASH_TORN"] = str(torn)
+    if extra_env:
+        env.update(extra_env)
     return subprocess.run(
         cmd, env=env, capture_output=True, text=True, timeout=timeout_s
     )
@@ -186,6 +202,137 @@ def crash_run(
     if res["outcome"] == "identical" and res["chunks_replayed"] != n_records:
         res["outcome"] = "replay_miscount"  # resumed run must reuse every commit
     return res
+
+
+def compaction_crash_run(
+    reference: str,
+    shape: dict,
+    mode: str,
+    workdir: str,
+    tag: "str | int" = 0,
+    torn_bytes: int = 7,
+) -> dict:
+    """One kill-during-compaction point.
+
+    The child runs with ``IPC_JOURNAL_COMPACT_BYTES=1`` so the very first
+    chunk commit triggers a compaction, which the crash hook then kills:
+
+    - ``mode="torn_tmp"``: ``IPC_COMPACT_CRASH_BYTES`` tears the snapshot
+      sidecar at ``torn_bytes`` and SIGKILLs BEFORE the atomic swap — the
+      live journal must be untouched (the torn sidecar is crash residue);
+    - ``mode="post_swap"``: ``IPC_COMPACT_CRASH_POST`` SIGKILLs right
+      AFTER ``os.replace`` — the journal now IS the snapshot and must
+      replay to the same committed set.
+
+    Either way the resumed run must reproduce the reference bundle
+    byte-for-byte, and the post-crash journal must parse with no
+    integrity error at any byte.
+    """
+    from ipc_proofs_tpu.jobs import JOBS_JOURNAL_NAME, read_journal
+
+    job_dir = os.path.join(workdir, f"compact_{tag}_{mode}")
+    out = os.path.join(workdir, f"compact_out_{tag}_{mode}.json")
+    res: dict = {"mode": mode}
+    extra = {"IPC_JOURNAL_COMPACT_BYTES": "1"}
+    if mode == "torn_tmp":
+        extra["IPC_COMPACT_CRASH_BYTES"] = str(torn_bytes)
+    elif mode == "post_swap":
+        extra["IPC_COMPACT_CRASH_POST"] = "1"
+    else:
+        raise ValueError(f"unknown compaction crash mode {mode!r}")
+
+    crashed = _spawn_child(job_dir, out, shape, extra_env=extra)
+    if crashed.returncode != -signal.SIGKILL:
+        res["outcome"] = "no_crash"
+        res["rc"] = crashed.returncode
+        res["stderr"] = crashed.stderr[-2000:]
+        return res
+
+    jpath = os.path.join(job_dir, JOBS_JOURNAL_NAME)
+    try:
+        records, _, torn_tail = read_journal(jpath)
+    except Exception as exc:  # fail-soft: a corrupt journal is the grid's FINDING, reported as a violation, not a harness crash
+        res["outcome"] = "journal_corrupt"
+        res["error"] = f"{type(exc).__name__}: {exc}"
+        return res
+    res["records_after_crash"] = len(records)
+    res["torn_tail"] = torn_tail
+    if mode == "torn_tmp":
+        # swap never happened: the torn sidecar must still be sitting there
+        # and the live journal must hold the committed records untouched
+        res["sidecar_left"] = os.path.exists(jpath + ".compact")
+        if not res["sidecar_left"]:
+            res["outcome"] = "sidecar_missing"
+            return res
+    if not records:
+        res["outcome"] = "journal_empty"  # compaction fired after ≥1 commit
+        return res
+
+    resumed = _spawn_child(job_dir, out, shape)
+    if resumed.returncode != 0:
+        res["outcome"] = "resume_failed"
+        res["rc"] = resumed.returncode
+        res["stderr"] = resumed.stderr[-2000:]
+        return res
+    with open(out) as fh:
+        final = fh.read()
+    res["outcome"] = "identical" if final == reference else "divergent"
+    return res
+
+
+def run_compaction_grid(
+    base_seed: int,
+    n_pairs: int = 12,
+    chunk_size: int = 2,
+    receipts: int = 4,
+    events: int = 2,
+    match_rate: float = 0.2,
+    log=lambda msg: None,
+) -> dict:
+    """Kill-during-compaction grid: torn-sidecar kills at several byte
+    offsets plus the post-swap kill. ``ok`` iff every point crashed,
+    left a parseable journal, resumed, and reproduced the reference."""
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
+
+    shape = {
+        "pairs": n_pairs, "chunk_size": chunk_size,
+        "receipts": receipts, "events": events, "match_rate": match_rate,
+        "record_workers": 1,
+    }
+    store, pairs, spec = _build_world(n_pairs, receipts, events, match_rate)
+    reference = generate_event_proofs_for_range_pipelined(
+        store, pairs, spec, chunk_size=chunk_size, scan_threads=2,
+        force_pipeline=True,
+    ).to_json()
+
+    rng = random.Random(base_seed)
+    points = [
+        ("torn_tmp", rng.choice([1, 5, 11])),  # inside the first frame header
+        ("torn_tmp", rng.choice([13, 64, 200])),  # inside a payload
+        ("post_swap", 0),
+    ]
+    counts: dict[str, int] = {}
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="crashtest_compact_") as workdir:
+        for i, (mode, torn_bytes) in enumerate(points):
+            res = compaction_crash_run(
+                reference, shape, mode, workdir, tag=i, torn_bytes=torn_bytes
+            )
+            counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+            if res["outcome"] != "identical":
+                violations.append(res)
+            log(
+                f"compaction kill [{mode}"
+                + (f" torn@{torn_bytes}B" if mode == "torn_tmp" else "")
+                + f"]: {res['outcome']}"
+            )
+    return {
+        "ok": not violations,
+        "points": len(points),
+        "kill_points": points,
+        "counts": counts,
+        "violations": violations,
+    }
 
 
 def run_grid(
@@ -281,6 +428,11 @@ def main(argv=None) -> int:
         help="record-stage workers in the child (>1 = concurrent commits)",
     )
     ap.add_argument("--quick", action="store_true", help="fewer kill points")
+    ap.add_argument(
+        "--compaction", action="store_true",
+        help="also run the kill-during-compaction grid (torn snapshot "
+        "sidecar + post-swap kills via IPC_COMPACT_CRASH_*)",
+    )
     # --child: the forked driver entrypoint (internal)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--job-dir", help=argparse.SUPPRESS)
@@ -304,6 +456,14 @@ def main(argv=None) -> int:
         record_workers=args.record_workers,
         log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
     )
+    if args.compaction:
+        summary["compaction"] = run_compaction_grid(
+            args.seed, n_pairs=args.pairs, chunk_size=args.chunk_size,
+            receipts=args.receipts, events=args.events,
+            match_rate=args.match_rate,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+        summary["ok"] = summary["ok"] and summary["compaction"]["ok"]
     print(json.dumps(summary, indent=2))
     if not summary["ok"]:
         print("CRASH-RECOVERY INVARIANT VIOLATED", file=sys.stderr)
