@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -537,7 +538,11 @@ def _cmd_serve(args) -> int:
         sig = args.event_sig or "NewTopDownMessage(bytes32,uint256)"
         topic1 = args.topic1 or "calib-subnet-1"
         store, pairs, n_matching = build_range_world(
-            args.demo_world, signature=sig, topic1=topic1
+            args.demo_world,
+            receipts_per_pair=args.demo_receipts,
+            match_rate=args.demo_match_rate,
+            signature=sig,
+            topic1=topic1,
         )
         spec = EventProofSpec(event_signature=sig, topic_1=topic1)
         log.info(
@@ -605,26 +610,45 @@ def _cmd_serve(args) -> int:
             slow_request_ms=args.slow_ms,
             store_dir=args.store_dir,
             store_cap_bytes=args.store_cap_bytes,
+            store_owner=args.store_owner,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
     )
     follower = None
+    leader_lock = None
     if args.follow:
         if client is None or service.blockstore is None:
             log.error("--follow requires --endpoint (a chain to follow)")
             service.drain()
             return 2
-        from ipc_proofs_tpu.storex import ChainFollower
+        from ipc_proofs_tpu.storex import ChainFollower, FollowLeaderLock
 
-        follower = ChainFollower(
-            client, service.blockstore, metrics=metrics, poll_s=args.follow_poll_s
-        )
-        follower.start()
-        log.info(
-            "chain follower: tailing finalized tipsets every %.1fs",
-            args.follow_poll_s,
-        )
+        lead = True
+        if args.store_dir:
+            # shared disk tier → exactly one follower per cluster: the
+            # flock winner tails the chain for everyone, losers serve only
+            # (and the kernel hands the lock to a successor if we die)
+            leader_lock = FollowLeaderLock(args.store_dir)
+            lead = leader_lock.try_acquire(metrics=metrics)
+        if lead:
+            follower = ChainFollower(
+                client,
+                service.blockstore,
+                metrics=metrics,
+                poll_s=args.follow_poll_s,
+            )
+            follower.start()
+            log.info(
+                "chain follower: tailing finalized tipsets every %.1fs%s",
+                args.follow_poll_s,
+                " (elected leader)" if args.store_dir else "",
+            )
+        else:
+            log.info(
+                "chain follower: another shard leads (%s) — serving only",
+                leader_lock.path,
+            )
     durable = None
     if args.queue_dir:
         from ipc_proofs_tpu.serve.durable import DurableAdmission
@@ -643,6 +667,12 @@ def _cmd_serve(args) -> int:
     httpd = ProofHTTPServer(
         service, host=args.host, port=args.port, pairs=pairs, durable=durable
     )
+    if args.port_file:
+        # atomic write: a polling parent never reads a half-written port
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(str(httpd.port))
+        os.replace(tmp, args.port_file)
     log.info(
         "serving on %s (verify%s; max_batch=%d max_wait=%.1fms capacity=%d "
         "workers=%d)",
@@ -663,9 +693,121 @@ def _cmd_serve(args) -> int:
         if follower is not None:
             follower.stop()
         httpd.shutdown()
+        if leader_lock is not None:
+            leader_lock.release()
         if tracing:
             _finish_tracing(args)
     log.info("drained; final metrics:\n%s", json.dumps(service.metrics_snapshot()))
+    return 0
+
+
+def _cmd_cluster(args) -> int:
+    """Sharded serve plane: spawn N serve shards + the consistent-hash
+    router, all over one hermetic ``--demo-world``.
+
+    Each shard is a full ``serve`` child process (own GIL, own durable
+    queue under ``--queue-dir/s<k>``, own ``--store-owner`` token in the
+    shared ``--store-dir``); the router front door speaks the exact
+    single-daemon wire protocol, so existing clients work unchanged.
+    """
+    import signal
+
+    from ipc_proofs_tpu.cluster import (
+        ClusterRouter,
+        RouterHTTPServer,
+        spawn_serve_shard,
+    )
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    if args.shards < 1:
+        log.error("--shards must be >= 1")
+        return 2
+    if not args.demo_world:
+        log.error("cluster currently requires --demo-world (hermetic mode)")
+        return 2
+
+    metrics = Metrics()
+    tracing = _start_tracing(args)
+    sig = args.event_sig or "NewTopDownMessage(bytes32,uint256)"
+    topic1 = args.topic1 or "calib-subnet-1"
+    # the router needs the pair table the shards will rebuild — the world
+    # builder is deterministic, so building it here yields the same table
+    _store, pairs, _n = build_range_world(
+        args.demo_world,
+        receipts_per_pair=args.demo_receipts,
+        match_rate=args.demo_match_rate,
+        signature=sig,
+        topic1=topic1,
+    )
+
+    extra: "list[str]" = [
+        "--demo-receipts", str(args.demo_receipts),
+        "--demo-match-rate", str(args.demo_match_rate),
+    ]
+    if args.store_cap_bytes is not None:
+        extra += ["--store-cap-bytes", str(args.store_cap_bytes)]
+
+    shards = []
+    try:
+        for k in range(args.shards):
+            name = f"s{k}"
+            shards.append(
+                spawn_serve_shard(
+                    name,
+                    args.demo_world,
+                    sig,
+                    topic1,
+                    store_dir=args.store_dir,
+                    queue_dir=(
+                        os.path.join(args.queue_dir, name)
+                        if args.queue_dir
+                        else None
+                    ),
+                    extra_args=extra,
+                )
+            )
+            log.info("shard %s up at %s", name, shards[-1].url)
+    except RuntimeError as exc:
+        log.error("shard spawn failed: %s", exc)
+        for sh in shards:
+            sh.kill()
+        return 1
+
+    router = ClusterRouter(
+        {sh.name: sh.url for sh in shards},
+        pairs,
+        steal_threshold=args.steal_threshold,
+        metrics=metrics,
+    )
+    httpd = RouterHTTPServer(router, host=args.host, port=args.port)
+    httpd.start()
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(str(httpd.port))
+        os.replace(tmp, args.port_file)
+    log.info(
+        "cluster router on %s (%d shards, steal_threshold=%d, pairs=%d)",
+        httpd.address, len(shards), args.steal_threshold, len(pairs),
+    )
+
+    def _sigterm(_signum, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        log.info("cluster draining (router first, then shards)…")
+    finally:
+        httpd.shutdown()
+        for sh in shards:
+            sh.stop()
+        if tracing:
+            _finish_tracing(args)
+    log.info("cluster down; router metrics:\n%s", json.dumps(metrics.snapshot()))
     return 0
 
 
@@ -904,9 +1046,23 @@ def main(argv=None) -> int:
     srv.add_argument("--host", default="127.0.0.1")
     srv.add_argument("--port", type=int, default=8411)
     srv.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="write the bound port to PATH once listening (atomic rename) — "
+        "how a parent that spawned this daemon on --port 0 learns where it "
+        "landed (the cluster subcommand uses this)",
+    )
+    srv.add_argument(
         "--demo-world", type=int, default=0, metavar="N_PAIRS",
         help="serve a hermetic synthetic range world with N tipset pairs "
         "(enables /v1/generate with zero egress)",
+    )
+    srv.add_argument(
+        "--demo-receipts", type=int, default=16, metavar="N",
+        help="receipts per pair in the --demo-world (default 16)",
+    )
+    srv.add_argument(
+        "--demo-match-rate", type=float, default=0.01,
+        help="fraction of demo-world events matching the spec (default 0.01)",
     )
     srv.add_argument("--endpoint", default=None, help="Lotus JSON-RPC endpoint URL")
     srv.add_argument("--token", default=None)
@@ -958,6 +1114,13 @@ def main(argv=None) -> int:
     )
     add_store_flags(srv)
     srv.add_argument(
+        "--store-owner", default=None, metavar="TOKEN",
+        help="join a SHARED --store-dir under this owner token (cluster "
+        "shards): this process appends only to its own seg-TOKEN.* "
+        "segments, reads everyone's, and eviction coordinates through a "
+        "directory flock. Omit for an exclusive single-writer store",
+    )
+    srv.add_argument(
         "--follow", action="store_true",
         help="chain-follow prefetch: a daemon thread tails finalized "
         "tipsets (ChainHead minus a finality lag) and pre-warms the "
@@ -996,6 +1159,58 @@ def main(argv=None) -> int:
     )
     add_trace_export_flags(srv)
     srv.set_defaults(fn=_cmd_serve)
+
+    clu = sub.add_parser(
+        "cluster",
+        help="sharded serve plane: N serve shard processes behind a "
+        "consistent-hash scatter-gather router (single-daemon wire "
+        "protocol at the front door)",
+    )
+    clu.add_argument("--host", default="127.0.0.1")
+    clu.add_argument("--port", type=int, default=8410)
+    clu.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="write the router's bound port to PATH once listening",
+    )
+    clu.add_argument(
+        "--shards", type=int, default=4, metavar="N",
+        help="serve shard processes to spawn (default 4)",
+    )
+    clu.add_argument(
+        "--steal-threshold", type=int, default=4, metavar="D",
+        help="steal a request from its affine shard when that shard's "
+        "in-flight depth exceeds the least-loaded shard's by D "
+        "(affinity is a cache hint, never a correctness constraint; "
+        "default 4)",
+    )
+    clu.add_argument(
+        "--demo-world", type=int, default=0, metavar="N_PAIRS",
+        help="hermetic synthetic range world served by every shard "
+        "(deterministic build → identical pair table in each; required)",
+    )
+    clu.add_argument(
+        "--demo-receipts", type=int, default=16, metavar="N",
+        help="receipts per pair in the demo world (default 16)",
+    )
+    clu.add_argument(
+        "--demo-match-rate", type=float, default=0.01,
+        help="fraction of demo-world events matching the spec (default 0.01)",
+    )
+    clu.add_argument("--event-sig", default=None)
+    clu.add_argument("--topic1", default=None)
+    add_store_flags(clu)
+    clu.add_argument(
+        "--queue-dir", default=None, metavar="DIR",
+        help="durable admission root: each shard journals under DIR/s<k> "
+        "(crash recovery + idempotency dedup per shard — what makes the "
+        "router's at-least-once failover retries safe)",
+    )
+    clu.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="export router spans as Chrome trace-event JSON on shutdown",
+    )
+    add_trace_export_flags(clu)
+    clu.set_defaults(fn=_cmd_cluster)
 
     args = parser.parse_args(argv)
     if getattr(args, "event_sig", None) and not getattr(args, "topic1", None):

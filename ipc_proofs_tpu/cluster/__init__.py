@@ -1,0 +1,57 @@
+"""Sharded serve plane: consistent-hash router over N full serve daemons.
+
+The cluster scales the serve plane horizontally without weakening any
+single-daemon guarantee, because a shard IS a complete serve daemon
+(micro-batching, bounded admission, durable-queue crash recovery, tiered
+disk store) and the router adds only placement:
+
+- `hashring.py` — deterministic sha256 consistent hashing of pair keys
+  onto shard names (vnodes; affinity = cache hint, never correctness);
+- `shard.py`    — shard lifecycle: in-process `LocalShard` for tests,
+  `spawn_serve_shard` subprocess children for real parallelism;
+- `router.py`   — `ClusterRouter`: steal-aware placement, at-least-once
+  failover under stable idempotency keys, scatter-gather ranges, health
+  aggregation; `RouterHTTPServer` speaks the single-daemon wire protocol;
+- `gather.py`   — the byte-identity merge law: per-shard range bundles
+  union back into exactly the single-daemon bundle bytes.
+
+Shards can share one ``--store-dir`` disk tier (per-owner segment files,
+flock-coordinated eviction — `storex/segments.py`) and elect one chain
+follower (`storex.FollowLeaderLock`). See README "Cluster serving" and
+the ``cluster`` CLI subcommand.
+"""
+
+from ipc_proofs_tpu.cluster.gather import (
+    MergeConflictError,
+    merge_range_bundles,
+    partition_indexes,
+)
+from ipc_proofs_tpu.cluster.hashring import HashRing, pair_ring_key
+from ipc_proofs_tpu.cluster.router import (
+    ClusterRouter,
+    NoShardsError,
+    RouterHTTPServer,
+    ShardClient,
+    ShardUnavailable,
+)
+from ipc_proofs_tpu.cluster.shard import (
+    LocalShard,
+    SubprocessShard,
+    spawn_serve_shard,
+)
+
+__all__ = [
+    "ClusterRouter",
+    "HashRing",
+    "LocalShard",
+    "MergeConflictError",
+    "NoShardsError",
+    "RouterHTTPServer",
+    "ShardClient",
+    "ShardUnavailable",
+    "SubprocessShard",
+    "merge_range_bundles",
+    "pair_ring_key",
+    "partition_indexes",
+    "spawn_serve_shard",
+]

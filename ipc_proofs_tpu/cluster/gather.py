@@ -1,0 +1,105 @@
+"""Scatter partitioning + byte-identical merge of per-shard range bundles.
+
+The canonical range bundle (what every range driver in
+``proofs/range.py`` emits, and what the chunk-grid bit-identity tests
+pin) is:
+
+- **event proofs** in pair order — pair ``i``'s proofs before pair
+  ``i+1``'s, each pair's proofs in deterministic scan order;
+- **storage proofs** likewise in pair order;
+- **witness blocks** deduplicated by CID and sorted by
+  ``cid.to_bytes()`` (the ``_MergeFold.finish()`` / chunked-driver
+  ordering).
+
+Because each pair's proof bytes depend only on that pair, and the
+witness-block *set* depends only on the pair set, a range request split
+across N shards in ANY partition merges back to the exact bytes the
+single-daemon run produces: re-interleave the proofs into the request's
+global pair order (a proof names its pair via ``child_block_cid``) and
+re-sort the CID-union of the witness blocks. That is the whole
+correctness story of the scatter-gather path — no shard coordination,
+no merge ambiguity, bit-identity by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
+
+__all__ = ["MergeConflictError", "merge_range_bundles", "partition_indexes"]
+
+
+class MergeConflictError(ValueError):
+    """Two shards shipped different bytes for the same witness CID — one
+    of them is lying or corrupt. Never silently picks a side."""
+
+
+def partition_indexes(
+    indexes: Sequence[int], assign: Dict[int, str]
+) -> "Dict[str, List[int]]":
+    """Group request pair-indexes by their assigned shard, preserving the
+    request's relative order inside each group (``assign`` maps pair index
+    → shard name; the router builds it from the hash ring + steal state).
+    """
+    groups: "Dict[str, List[int]]" = {}
+    for idx in indexes:
+        groups.setdefault(assign[idx], []).append(idx)
+    return groups
+
+
+def merge_range_bundles(
+    bundles: Sequence[UnifiedProofBundle],
+    pairs: Sequence,
+    indexes: Sequence[int],
+) -> UnifiedProofBundle:
+    """Merge per-shard sub-bundles into the canonical single-daemon bundle.
+
+    ``pairs`` is the full pair table; ``indexes`` the requested global
+    pair indexes in request order (the order the single-daemon comparator
+    would generate them in). Every proof in every sub-bundle must map to
+    one of ``indexes`` via its ``child_block_cid``.
+    """
+    # child block CID -> global pair index (a child block cid identifies
+    # its pair — the same mapping the micro-batcher splits batches with)
+    child_to_idx: "Dict[str, int]" = {}
+    for idx in indexes:
+        for c in pairs[idx].child.cids:
+            child_to_idx[str(c)] = idx
+
+    event_buckets: "Dict[int, list]" = {idx: [] for idx in indexes}
+    storage_buckets: "Dict[int, list]" = {idx: [] for idx in indexes}
+    by_cid: "Dict[bytes, ProofBlock]" = {}
+    for bundle in bundles:
+        for proof in bundle.event_proofs:
+            idx = child_to_idx.get(proof.child_block_cid)
+            if idx is None:
+                raise MergeConflictError(
+                    f"event proof for unknown child block "
+                    f"{proof.child_block_cid} (not in this request)"
+                )
+            event_buckets[idx].append(proof)
+        for proof in bundle.storage_proofs:
+            idx = child_to_idx.get(proof.child_block_cid)
+            if idx is None:
+                raise MergeConflictError(
+                    f"storage proof for unknown child block "
+                    f"{proof.child_block_cid} (not in this request)"
+                )
+            storage_buckets[idx].append(proof)
+        for block in bundle.blocks:
+            raw = block.cid.to_bytes()
+            prior = by_cid.get(raw)
+            if prior is None:
+                by_cid[raw] = block
+            elif prior.data != block.data:
+                raise MergeConflictError(
+                    f"witness block {block.cid} has conflicting bytes "
+                    "across shards"
+                )
+
+    return UnifiedProofBundle(
+        storage_proofs=[p for idx in indexes for p in storage_buckets[idx]],
+        event_proofs=[p for idx in indexes for p in event_buckets[idx]],
+        blocks=[by_cid[raw] for raw in sorted(by_cid)],
+    )

@@ -1,0 +1,543 @@
+"""Consistent-hash front-end router over N shard daemons.
+
+The router is the cluster's single client-facing door. Placement is a
+two-layer decision:
+
+1. **Affinity** — the hash ring (`cluster/hashring.py`) maps a request's
+   pair key onto a shard, so repeated traffic for one tipset pair lands
+   where its witness blocks are already cached. Affinity is a CACHE hint.
+2. **Stealing** — if the affine shard's in-flight depth exceeds the
+   least-loaded shard's by ``steal_threshold``, the request is stolen by
+   the least-loaded shard instead (``cluster.steals``). Any shard can
+   serve any key, so stealing can never be wrong — it only trades cache
+   warmth for queue latency.
+
+Failover follows from the same property: a shard that stops answering is
+marked dead, its ring arc redistributes to the survivors
+(``cluster.shard_failovers``), and the in-flight request is re-dispatched
+to the next shard **with the same idempotency key** it was first sent
+with. Delivery is at-least-once; the durable queue's idempotency dedup
+(PR 4) absorbs the retry, so a request that executed on a shard that died
+mid-response is served from that shard's journal on recovery rather than
+double-executed — and without durable queues the replay merely
+regenerates a deterministic (identical) response.
+
+Range requests scatter-gather: pairs partition by per-pair affinity
+(steal-aware), each group dispatches concurrently as one
+``/v1/generate_range`` sub-request carrying the router span's trace
+carrier (one trace covers the fan-out), and the sub-bundles merge through
+`cluster.gather.merge_range_bundles` into bytes identical to a
+single-daemon run. See README "Cluster serving".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.request
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+from ipc_proofs_tpu.cluster.gather import merge_range_bundles, partition_indexes
+from ipc_proofs_tpu.cluster.hashring import HashRing, pair_ring_key
+from ipc_proofs_tpu.obs.trace import (
+    carrier_from_context,
+    current_context,
+    root_span,
+    span,
+    use_context,
+)
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.threads import locked
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+__all__ = [
+    "ClusterRouter",
+    "NoShardsError",
+    "RouterHTTPServer",
+    "ShardClient",
+    "ShardUnavailable",
+]
+
+logger = get_logger(__name__)
+
+
+class ShardUnavailable(RuntimeError):
+    """Transport-level shard failure (refused, reset, timed out) — the
+    signal that triggers failover. An HTTP error status is NOT this:
+    a shard that answers 4xx/5xx is alive and its answer is authoritative."""
+
+
+class NoShardsError(RuntimeError):
+    """Every shard is dead (or was born dead) — nothing to route to."""
+
+
+class ShardClient:
+    """Minimal stdlib HTTP client for one shard base URL.
+
+    Returns ``(status, json_obj)`` for whatever the shard answered;
+    raises `ShardUnavailable` only for transport failures. No retries
+    here — retry/failover policy belongs to the router, which must
+    preserve idempotency keys across attempts.
+    """
+
+    def __init__(self, name: str, base_url: str, timeout_s: float = 120.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def post(self, path: str, body: dict) -> "tuple[int, dict]":
+        data = json.dumps(body).encode("utf-8")
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        return self._roundtrip(req)
+
+    def get(self, path: str) -> "tuple[int, dict]":
+        req = urllib.request.Request(self.base_url + path, method="GET")
+        return self._roundtrip(req)
+
+    def _roundtrip(self, req) -> "tuple[int, dict]":
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            # an HTTP status IS an answer from a live shard — pass it up
+            try:
+                obj = json.loads(exc.read())
+            except (ValueError, OSError):
+                obj = {"error": f"shard returned {exc.code}"}
+            return exc.code, obj
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as exc:
+            raise ShardUnavailable(f"shard {self.name}: {exc}") from exc
+
+
+class _ShardState:
+    __slots__ = ("client", "alive", "inflight")
+
+    def __init__(self, client: ShardClient):
+        self.client = client
+        self.alive = True
+        self.inflight = 0
+
+
+class ClusterRouter:
+    """Route requests across shard daemons; steal, fail over, gather.
+
+    ``shards`` maps shard name → base URL (or pre-built `ShardClient`).
+    ``pairs`` is the shared pair table every shard was built with — the
+    router speaks pair indexes on the wire exactly like the single-daemon
+    HTTP API, so a cluster of one is protocol-identical to plain serve.
+    """
+
+    def __init__(
+        self,
+        shards: "Dict[str, str] | Dict[str, ShardClient]",
+        pairs: Sequence,
+        steal_threshold: int = 4,
+        vnodes: int = 64,
+        metrics: Optional[Metrics] = None,
+        request_timeout_s: float = 120.0,
+        max_workers: int = 16,
+    ):
+        if not shards:
+            raise NoShardsError("a cluster needs at least one shard")
+        self.pairs = list(pairs)
+        self.steal_threshold = max(1, int(steal_threshold))
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._lock = threading.Lock()
+        self._shards: "Dict[str, _ShardState]" = {}  # guarded-by: _lock
+        self._ring = HashRing(vnodes=vnodes)  # guarded-by: _lock
+        for name, target in shards.items():
+            client = (
+                target
+                if isinstance(target, ShardClient)
+                else ShardClient(name, target, timeout_s=request_timeout_s)
+            )
+            self._shards[name] = _ShardState(client)
+            self._ring.add(name)
+        self._keys = [pair_ring_key(p) for p in self.pairs]
+        self._executor = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="cluster-scatter"
+        )
+        self._gauge_alive_locked()
+
+    # --- placement (all under _lock) --------------------------------------
+
+    @locked
+    def _gauge_alive_locked(self) -> None:
+        self.metrics.set_gauge(
+            "cluster.shards_alive",
+            sum(1 for s in self._shards.values() if s.alive),
+        )
+
+    @locked
+    def _affinity_locked(self, key: str) -> str:
+        return self._ring.node_for(key)
+
+    @locked
+    def _place_locked(self, key: str) -> str:
+        """Affinity shard unless stealing wins (see module docstring)."""
+        if not len(self._ring):
+            raise NoShardsError("all shards are dead")
+        affine = self._affinity_locked(key)
+        least = min(
+            (s for s in self._shards.values() if s.alive),
+            key=lambda s: (s.inflight, s.client.name),
+        ).client.name
+        if (
+            least != affine
+            and self._shards[affine].inflight - self._shards[least].inflight
+            >= self.steal_threshold
+        ):
+            self.metrics.count("cluster.steals")
+            return least
+        return affine
+
+    def _acquire(self, key: str) -> "tuple[str, ShardClient]":
+        with self._lock:
+            name = self._place_locked(key)
+            state = self._shards[name]
+            state.inflight += 1
+            self.metrics.set_gauge(f"cluster.inflight.{name}", state.inflight)
+            return name, state.client
+
+    def _release(self, name: str) -> None:
+        with self._lock:
+            state = self._shards.get(name)
+            if state is not None and state.inflight > 0:
+                state.inflight -= 1
+                self.metrics.set_gauge(
+                    f"cluster.inflight.{name}", state.inflight
+                )
+
+    def _mark_dead(self, name: str) -> None:
+        with self._lock:
+            state = self._shards.get(name)
+            if state is None or not state.alive:
+                return  # concurrent requests race to report one death once
+            state.alive = False
+            self._ring.remove(name)
+            self._gauge_alive_locked()
+        self.metrics.count("cluster.shard_errors")
+        logger.warning(
+            "cluster: shard %s unreachable — ring arc redistributed", name
+        )
+
+    def revive(self, name: str) -> None:
+        """Re-admit a recovered shard (ops action / test hook): its ring
+        arc comes back and traffic re-affinitizes on the next request."""
+        with self._lock:
+            state = self._shards.get(name)
+            if state is None or state.alive:
+                return
+            state.alive = True
+            self._ring.add(name)
+            self._gauge_alive_locked()
+
+    def alive_shards(self) -> "List[str]":
+        with self._lock:
+            return sorted(n for n, s in self._shards.items() if s.alive)
+
+    # --- dispatch with failover -------------------------------------------
+
+    def _dispatch(self, key: str, path: str, body: dict) -> "tuple[int, dict]":
+        """Send one request, failing over (same idempotency key) until a
+        live shard answers or none remain. At-least-once by construction:
+        a shard that died after executing leaves a journaled result the
+        retry's dedup key recovers instead of re-executing."""
+        body = dict(body)
+        body.setdefault("idempotency_key", uuid.uuid4().hex)
+        carrier = carrier_from_context()
+        if carrier is not None:
+            body["trace"] = carrier
+        attempted: "set[str]" = set()
+        while True:
+            name, client = self._acquire(key)
+            if name in attempted:
+                # the ring only has shards we already failed against —
+                # give up rather than hot-loop on a flapping shard
+                self._release(name)
+                raise NoShardsError(
+                    f"no shard answered {path} (tried {sorted(attempted)})"
+                )
+            attempted.add(name)
+            self.metrics.count("cluster.sub_requests")
+            try:
+                with span(
+                    "cluster.dispatch", {"shard": name, "path": path}
+                ):
+                    return client.post(path, body)
+            except ShardUnavailable:
+                self._mark_dead(name)
+                # every re-dispatch after a death is a failover — including
+                # the first attempt finding a corpse
+                self.metrics.count("cluster.shard_failovers")
+            finally:
+                self._release(name)
+
+    # --- public request API ------------------------------------------------
+
+    def generate(
+        self,
+        pair_index: int,
+        timeout_s: Optional[float] = None,
+        idempotency_key: Optional[str] = None,
+    ) -> "tuple[int, dict]":
+        """Route one single-pair generate to its affine shard."""
+        if not (
+            isinstance(pair_index, int)
+            and not isinstance(pair_index, bool)
+            and 0 <= pair_index < len(self.pairs)
+        ):
+            return 400, {
+                "error": f"pair_index must be an int in [0, {len(self.pairs)})"
+            }
+        self.metrics.count("cluster.requests")
+        body: dict = {"pair_index": pair_index}
+        if timeout_s is not None:
+            body["timeout_s"] = timeout_s
+        if idempotency_key is not None:
+            body["idempotency_key"] = idempotency_key
+        with root_span("cluster.generate", {"pair_index": pair_index}):
+            return self._dispatch(self._keys[pair_index], "/v1/generate", body)
+
+    def verify(self, body: dict) -> "tuple[int, dict]":
+        """Route one verify. Verification has no data affinity (the bundle
+        travels with the request), so the key is the bundle digest — it
+        spreads uniformly and repeats of the same bundle reuse a shard's
+        verify-side caches."""
+        self.metrics.count("cluster.requests")
+        bundle_obj = body.get("bundle", body)
+        key = hashlib.sha256(
+            json.dumps(bundle_obj, sort_keys=True).encode()
+        ).hexdigest()
+        with root_span("cluster.verify"):
+            return self._dispatch(key, "/v1/verify", dict(body))
+
+    def generate_range(
+        self,
+        pair_indexes: Sequence[int],
+        chunk_size: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+    ) -> "tuple[int, dict]":
+        """Scatter a multi-pair range across shards, gather one canonical
+        bundle (byte-identical to a single-daemon run over the same list).
+        """
+        n = len(self.pairs)
+        idxs = list(pair_indexes)
+        if not idxs or not all(
+            isinstance(i, int) and not isinstance(i, bool) and 0 <= i < n
+            for i in idxs
+        ):
+            return 400, {
+                "error": f"pair_indexes must be non-empty ints in [0, {n})"
+            }
+        self.metrics.count("cluster.requests")
+        self.metrics.count("cluster.scatter_requests")
+        with root_span(
+            "cluster.generate_range", {"n_pairs": len(idxs)}
+        ) as sp:
+            with self._lock:
+                if not len(self._ring):
+                    raise NoShardsError("all shards are dead")
+                assign = {
+                    idx: self._affinity_locked(self._keys[idx]) for idx in idxs
+                }
+            groups = partition_indexes(idxs, assign)
+            sp.set_attr("n_groups", len(groups))
+            ctx = current_context()  # scatter threads parent under this span
+
+            def one(group: "List[int]") -> "tuple[int, dict]":
+                body: dict = {"pair_indexes": group}
+                if chunk_size is not None:
+                    body["chunk_size"] = chunk_size
+                if timeout_s is not None:
+                    body["timeout_s"] = timeout_s
+                # group affinity = first member's key: the whole group was
+                # binned by that shard's arc, and failover re-keys anyway
+                with use_context(ctx):
+                    return self._dispatch(
+                        self._keys[group[0]], "/v1/generate_range", body
+                    )
+
+            futures = {
+                name: self._executor.submit(one, group)
+                for name, group in groups.items()
+            }
+            sub_bundles: "List[UnifiedProofBundle]" = []
+            for name, fut in futures.items():
+                status, obj = fut.result()  # NoShardsError propagates
+                if status != 200:
+                    # a shard's error verdict is the scatter's verdict —
+                    # partial bundles are never silently merged
+                    return status, obj
+                payload = obj.get("result", obj) if obj.get("ok", True) else obj
+                if "bundle" not in payload:
+                    return 502, {
+                        "error": f"shard group {name} returned no bundle",
+                        "shard_response": obj,
+                    }
+                sub_bundles.append(
+                    UnifiedProofBundle.from_json_obj(payload["bundle"])
+                )
+            merged = merge_range_bundles(sub_bundles, self.pairs, idxs)
+            return 200, {
+                "bundle": merged.to_json_obj(),
+                "n_event_proofs": len(merged.event_proofs),
+                "n_pairs": len(idxs),
+                "n_groups": len(groups),
+                "trace_id": sp.trace_id,
+            }
+
+    # --- cluster health / metrics -----------------------------------------
+
+    def healthz(self) -> "tuple[int, dict]":
+        """Aggregate shard health: ``ok`` iff every live shard says ok,
+        ``degraded`` when any shard is dead or degraded but at least one
+        serves, 503 ``unavailable`` when none do."""
+        with self._lock:
+            states = {n: s.alive for n, s in self._shards.items()}
+            clients = {n: s.client for n, s in self._shards.items()}
+        shard_health: "Dict[str, dict]" = {}
+        n_ok = 0
+        for name, alive in states.items():
+            if not alive:
+                shard_health[name] = {"status": "dead"}
+                continue
+            try:
+                _status, obj = clients[name].get("/healthz")
+            except ShardUnavailable:
+                self._mark_dead(name)
+                shard_health[name] = {"status": "dead"}
+                continue
+            shard_health[name] = obj
+            if obj.get("status") == "ok":
+                n_ok += 1
+        serving = sum(
+            1
+            for h in shard_health.values()
+            if h.get("status") not in ("dead", "draining")
+        )
+        if serving == 0:
+            return 503, {"status": "unavailable", "shards": shard_health}
+        status = "ok" if n_ok == len(shard_health) else "degraded"
+        return 200, {
+            "status": status,
+            "shards": shard_health,
+            "shards_alive": serving,
+        }
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=True)
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: ClusterRouter
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    def _send_json(self, status: int, obj: dict):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            status, obj = self.router.healthz()
+            self._send_json(status, obj)
+        elif self.path == "/metrics":
+            self._send_json(200, self.router.metrics_snapshot())
+        else:
+            self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0 or length > 64 * 1024 * 1024:
+                raise ValueError("Content-Length required")
+            body = json.loads(self.rfile.read(length))
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._send_json(400, {"error": f"bad request body: {exc}"})
+            return
+        try:
+            if self.path == "/v1/generate":
+                status, obj = self.router.generate(
+                    body.get("pair_index"),
+                    timeout_s=body.get("timeout_s"),
+                    idempotency_key=body.get("idempotency_key"),
+                )
+            elif self.path == "/v1/verify":
+                status, obj = self.router.verify(body)
+            elif self.path == "/v1/generate_range":
+                status, obj = self.router.generate_range(
+                    body.get("pair_indexes") or [],
+                    chunk_size=body.get("chunk_size"),
+                    timeout_s=body.get("timeout_s"),
+                )
+            else:
+                status, obj = 404, {"error": f"no such path: {self.path}"}
+        except NoShardsError as exc:
+            status, obj = 503, {"error": str(exc)}
+        self._send_json(status, obj)
+
+
+class RouterHTTPServer:
+    """The cluster's client-facing HTTP door (same wire protocol as the
+    single-daemon `ProofHTTPServer`, so clients don't know it's a cluster)."""
+
+    def __init__(
+        self, router: ClusterRouter, host: str = "127.0.0.1", port: int = 0
+    ):
+        self.router = router
+        handler = type("_BoundRouterHandler", (_RouterHandler,), {"router": router})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    def start(self) -> "RouterHTTPServer":
+        # start()/shutdown() are owner-thread lifecycle calls with a
+        # happens-before edge through Thread.start()/join(); no lock needed
+        self._thread = threading.Thread(  # ipclint: disable=race-unannotated
+            target=self.serve_forever, name="cluster-router-httpd", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, timeout: Optional[float] = None) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self.router.close()

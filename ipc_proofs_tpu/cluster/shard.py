@@ -1,0 +1,221 @@
+"""Shard workers: a full serve daemon per shard, in-process or spawned.
+
+A shard is not a thinner thing than a daemon — it IS `ProofService` +
+`ProofHTTPServer` (+ `DurableAdmission` when given a queue dir), so
+everything the single-daemon stack guarantees (micro-batching, bounded
+admission, crash-recovery via the durable queue, the tiered disk store)
+survives sharding unchanged. The router treats a shard as an opaque HTTP
+base URL; these classes only manage lifecycle.
+
+Two flavors:
+
+- `LocalShard` — in-process, for tests and the scatter-gather identity
+  grid: same pair table object, ephemeral port, and a ``kill()`` that
+  abandons in-flight work (`ProofHTTPServer.abort`) to simulate a shard
+  crash without tearing down the test process.
+- `SubprocessShard` (via `spawn_serve_shard`) — a real
+  ``python -m ipc_proofs_tpu.cli serve`` child process, which is what the
+  cluster CLI and the bench's linearity leg use: separate GILs, separate
+  crash domains. The child writes its bound port to ``--port-file``
+  (ephemeral ports can't be known up front) and each child gets its own
+  ``--store-owner`` token so N children can share one ``--store-dir``.
+
+Shards must agree on the pair table (the router speaks pair indexes).
+`fixtures.build_range_world` is fully deterministic, so every child
+spawned with the same ``--demo-world`` arguments rebuilds the identical
+world and table — no table-shipping protocol needed for the hermetic
+modes this repo serves.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional, Sequence
+
+from ipc_proofs_tpu.serve.durable import DurableAdmission
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
+from ipc_proofs_tpu.utils.log import get_logger
+
+__all__ = ["LocalShard", "SubprocessShard", "spawn_serve_shard"]
+
+logger = get_logger(__name__)
+
+
+class LocalShard:
+    """One in-process shard daemon (service + HTTP front end).
+
+    ``store_wrapper`` wraps the blockstore before the service sees it —
+    the hook the fault-harness tests use to inject seeded RPC faults into
+    exactly one shard of a scatter.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        store,
+        pairs: Sequence,
+        spec,
+        config: Optional[ServiceConfig] = None,
+        queue_dir: Optional[str] = None,
+        metrics=None,
+        trust_policy=None,
+        event_filter=None,
+        store_wrapper=None,
+    ):
+        self.name = name
+        self.pairs = list(pairs)
+        if store_wrapper is not None:
+            store = store_wrapper(store)
+        self.service = ProofService(
+            store=store,
+            spec=spec,
+            trust_policy=trust_policy,
+            event_filter=event_filter,
+            config=config,
+            metrics=metrics,
+        )
+        self.durable = (
+            DurableAdmission(
+                self.service, queue_dir, pairs=self.pairs,
+                metrics=self.service.metrics,
+            )
+            if queue_dir
+            else None
+        )
+        self.httpd = ProofHTTPServer(
+            self.service, port=0, pairs=self.pairs, durable=self.durable
+        )
+
+    def start(self) -> "LocalShard":
+        self.httpd.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return self.httpd.address
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        """Graceful: drain accepted work, then release everything."""
+        self.httpd.shutdown(timeout=timeout)
+
+    def kill(self) -> None:
+        """Crash simulation: the port goes connection-refused with work
+        possibly still in flight; the service is NOT drained."""
+        self.httpd.abort()
+
+    def __enter__(self) -> "LocalShard":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class SubprocessShard:
+    """Handle to one spawned ``serve`` child process."""
+
+    def __init__(self, name: str, proc: subprocess.Popen, url: str):
+        self.name = name
+        self.proc = proc
+        self.url = url
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        """Graceful: SIGTERM (the serve CLI drains on it), then wait."""
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+
+    def kill(self) -> None:
+        """Crash simulation: SIGKILL, no drain, no journal flush."""
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=5.0)
+
+
+def spawn_serve_shard(
+    name: str,
+    demo_world: int,
+    event_sig: str,
+    topic1: str,
+    store_dir: Optional[str] = None,
+    queue_dir: Optional[str] = None,
+    extra_args: Sequence[str] = (),
+    startup_timeout_s: float = 60.0,
+) -> SubprocessShard:
+    """Spawn one ``serve`` child on an ephemeral port and wait for it.
+
+    The child rebuilds the deterministic ``--demo-world`` (identical pair
+    table in every shard) and reports its bound port through a temp
+    ``--port-file``. With ``store_dir`` set the child joins the shared
+    disk tier under its own ``--store-owner`` token (= ``name``).
+    """
+    fd, port_file = tempfile.mkstemp(prefix=f"shard-{name}-", suffix=".port")
+    os.close(fd)
+    os.remove(port_file)  # the child's atomic write recreates it
+    cmd = [
+        sys.executable,
+        "-m",
+        "ipc_proofs_tpu.cli",
+        "serve",
+        "--port",
+        "0",
+        "--port-file",
+        port_file,
+        "--demo-world",
+        str(demo_world),
+        "--event-sig",
+        event_sig,
+        "--topic1",
+        topic1,
+    ]
+    if store_dir:
+        cmd += ["--store-dir", store_dir, "--store-owner", name]
+    if queue_dir:
+        cmd += ["--queue-dir", queue_dir]
+    cmd += list(extra_args)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env=env,
+        start_new_session=True,  # a router SIGINT must not strafe the shards
+    )
+    deadline = time.monotonic() + startup_timeout_s
+    port = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"shard {name!r} exited with {proc.returncode} before binding"
+            )
+        try:
+            with open(port_file) as fh:
+                text = fh.read().strip()
+            if text:
+                port = int(text)
+                break
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    if port is None:
+        proc.kill()
+        raise RuntimeError(
+            f"shard {name!r} did not report a port within {startup_timeout_s}s"
+        )
+    try:
+        os.remove(port_file)
+    except OSError:
+        pass
+    return SubprocessShard(name, proc, f"http://127.0.0.1:{port}")

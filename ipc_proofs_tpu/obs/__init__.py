@@ -31,6 +31,9 @@ from ipc_proofs_tpu.obs.trace import (
     Span,
     SpanCollector,
     TraceContext,
+    adopted_span,
+    carrier_from_context,
+    context_from_carrier,
     current_context,
     disable_tracing,
     enable_tracing,
@@ -50,8 +53,11 @@ __all__ = [
     "Span",
     "SpanCollector",
     "TraceContext",
+    "adopted_span",
+    "carrier_from_context",
     "chrome_trace_events",
     "chrome_trace_obj",
+    "context_from_carrier",
     "current_context",
     "disable_tracing",
     "enable_tracing",

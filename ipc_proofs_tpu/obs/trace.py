@@ -40,6 +40,9 @@ __all__ = [
     "tracing_enabled",
     "spans_for_trace",
     "format_span_tree",
+    "carrier_from_context",
+    "context_from_carrier",
+    "adopted_span",
 ]
 
 _CTX: ContextVar["TraceContext | None"] = ContextVar("ipc_trace_ctx", default=None)
@@ -340,6 +343,55 @@ def root_span(name: str, attrs: "dict | None" = None):
     """Open a span that FORCES a new trace, ignoring any ambient context —
     the request boundary (HTTP admission, a CLI invocation)."""
     token = _CTX.set(None)
+    try:
+        with span(name, attrs) as sp:
+            yield sp
+    finally:
+        _CTX.reset(token)
+
+
+def carrier_from_context(ctx: "TraceContext | None" = None) -> "dict | None":
+    """The wire form of a trace context: a JSON-able dict a request body
+    can carry across a process boundary (the cluster router → shard hop).
+    Defaults to the ambient context; None when there is none to carry."""
+    if ctx is None:
+        ctx = _CTX.get()
+    if ctx is None:
+        return None
+    return {
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+        "sampled": ctx.sampled,
+    }
+
+
+def context_from_carrier(carrier) -> "TraceContext | None":
+    """Parse a `carrier_from_context` dict back into a `TraceContext`.
+    Carriers arrive in untrusted request bodies, so anything malformed is
+    simply no context — tracing must never make a request fail."""
+    if not isinstance(carrier, dict):
+        return None
+    trace_id = carrier.get("trace_id")
+    span_id = carrier.get("span_id")
+    if not (isinstance(trace_id, str) and trace_id):
+        return None
+    if not (isinstance(span_id, str) and span_id):
+        return None
+    return TraceContext(trace_id, span_id, bool(carrier.get("sampled", True)))
+
+
+@contextmanager
+def adopted_span(name: str, carrier=None, attrs: "dict | None" = None):
+    """The cross-process request boundary: open a span parented under a
+    remote ``carrier`` (so a shard's spans nest under the router's dispatch
+    span and one trace covers the whole scatter-gather), or fall back to
+    `root_span` when no valid carrier came with the request."""
+    ctx = context_from_carrier(carrier)
+    if ctx is None:
+        with root_span(name, attrs) as sp:
+            yield sp
+        return
+    token = _CTX.set(ctx)
     try:
         with span(name, attrs) as sp:
             yield sp

@@ -290,6 +290,33 @@ class DurableAdmission:
                 "trace_id": resp.trace_id,
                 "server_timing": dict(resp.server_timing),
             }
+        if kind == "generate_range":
+            if not isinstance(payload, dict):
+                raise ValueError("generate_range payload must be an object")
+            idxs = payload.get("pair_indexes")
+            n = len(self.pairs)
+            if (
+                not isinstance(idxs, list)
+                or not idxs
+                or not all(
+                    isinstance(i, int)
+                    and not isinstance(i, bool)
+                    and 0 <= i < n
+                    for i in idxs
+                )
+            ):
+                raise ValueError(
+                    f"pair_indexes must be a non-empty list of ints in [0, {n})"
+                )
+            bundle = self.service.generate_range(
+                [self.pairs[i] for i in idxs],
+                chunk_size=payload.get("chunk_size"),
+            )
+            return {
+                "bundle": bundle.to_json_obj(),
+                "n_event_proofs": len(bundle.event_proofs),
+                "n_pairs": len(idxs),
+            }
         raise ValueError(f"unknown request kind {kind!r}")
 
     def _finish(self, key: str, done_payload: dict) -> None:

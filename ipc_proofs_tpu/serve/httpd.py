@@ -10,6 +10,11 @@ to the in-process API and serving errors to status codes:
 - ``POST /v1/generate`` → same mapping; the request names a tipset pair by
   index into the server's configured pair table (the hermetic/demo mode —
   a production deployment would resolve pairs from its chain store).
+- ``POST /v1/generate_range`` → multi-pair canonical range bundle for an
+  explicit ``pair_indexes`` list — the scatter-gather sub-request the
+  cluster router dispatches (see `cluster/router.py`). A ``trace``
+  carrier in any POST body parents this request's spans under the remote
+  caller's span (`obs.adopted_span`).
 - ``GET /metrics``  → `utils/metrics.py` snapshot (stage timers, queue
   depths, batch sizes, p50/p90/p99 latency, rejection counters) as JSON.
 - ``GET /metrics.prom`` → the same snapshot in Prometheus text exposition
@@ -48,7 +53,7 @@ from typing import Optional, Sequence
 from ipc_proofs_tpu.obs.flight import get_flight_recorder
 from ipc_proofs_tpu.obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ipc_proofs_tpu.obs.prom import render_prometheus
-from ipc_proofs_tpu.obs.trace import root_span
+from ipc_proofs_tpu.obs.trace import adopted_span
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.proofs.range import TipsetPair
 from ipc_proofs_tpu.serve.batcher import (
@@ -142,14 +147,23 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as exc:
             self._send_json(400, {"error": f"bad request body: {exc}"})
             return
+        # the span opens BEFORE admission on this handler thread, so the
+        # batcher captures it and execution parents under it. A "trace"
+        # carrier in the body (the cluster router's scatter hop) parents
+        # this request's spans under the remote dispatch span — one trace
+        # covers the whole scatter-gather; without one this is a trace root
+        carrier = body.get("trace")
         if self.path == "/v1/verify":
-            # the root span opens BEFORE admission on this handler thread,
-            # so the batcher captures it and execution parents under it
-            with root_span("http.verify", {"path": self.path}):
+            with adopted_span("http.verify", carrier, {"path": self.path}):
                 self._handle_verify(body)
         elif self.path == "/v1/generate":
-            with root_span("http.generate", {"path": self.path}):
+            with adopted_span("http.generate", carrier, {"path": self.path}):
                 self._handle_generate(body)
+        elif self.path == "/v1/generate_range":
+            with adopted_span(
+                "http.generate_range", carrier, {"path": self.path}
+            ):
+                self._handle_generate_range(body)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
 
@@ -199,6 +213,56 @@ class _Handler(BaseHTTPRequestHandler):
                 "batch_size": resp.batch_size,
                 "trace_id": resp.trace_id,
                 "server_timing": resp.server_timing,
+            },
+        )
+
+    def _handle_generate_range(self, body: dict):
+        """One multi-pair range sub-request (the scatter-gather unit).
+
+        ``pair_indexes`` selects rows of the server pair table; the
+        response bundle is the canonical chunked-driver bytes for exactly
+        those pairs, so the router can union sub-bundles bit-identically.
+        """
+        idxs = body.get("pair_indexes")
+        n = len(self.pairs)
+        # bool is an int subclass — reject it explicitly, True is not a row
+        if (
+            not isinstance(idxs, list)
+            or not idxs
+            or not all(
+                isinstance(i, int) and not isinstance(i, bool) and 0 <= i < n
+                for i in idxs
+            )
+        ):
+            self._send_json(
+                400,
+                {
+                    "error": "pair_indexes must be a non-empty list of ints "
+                    f"in [0, {n}) (server pair table)"
+                },
+            )
+            return
+        chunk = body.get("chunk_size")
+        if chunk is not None and (
+            not isinstance(chunk, int) or isinstance(chunk, bool) or chunk < 1
+        ):
+            self._send_json(400, {"error": "chunk_size must be a positive int"})
+            return
+        if self.durable is not None:
+            self._submit_durable(
+                "generate_range",
+                {"pair_indexes": list(idxs), "chunk_size": chunk},
+                body,
+            )
+            return
+        self._submit(
+            lambda: self.service.generate_range(
+                [self.pairs[i] for i in idxs], chunk_size=chunk
+            ),
+            lambda bundle: {
+                "bundle": bundle.to_json_obj(),
+                "n_event_proofs": len(bundle.event_proofs),
+                "n_pairs": len(idxs),
             },
         )
 
@@ -315,3 +379,15 @@ class ProofHTTPServer:
         self.service.drain(timeout=timeout)
         if self.durable is not None:
             self.durable.close()
+
+    def abort(self) -> None:
+        """Crash simulation: stop serving WITHOUT draining.
+
+        Closes the listener and abandons everything in flight — exactly
+        what a shard process dying looks like to the cluster router, which
+        is what failover tests need to exercise. The durable queue's
+        journal is left as crash residue for recovery-on-restart."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(1.0)

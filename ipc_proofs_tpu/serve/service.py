@@ -56,7 +56,11 @@ from ipc_proofs_tpu.obs.trace import (
 )
 from ipc_proofs_tpu.proofs.trust import TrustPolicy
 from ipc_proofs_tpu.proofs.verifier import verify_proof_bundle
-from ipc_proofs_tpu.serve.batcher import MicroBatcher, PendingResult
+from ipc_proofs_tpu.serve.batcher import (
+    MicroBatcher,
+    PendingResult,
+    ServiceClosedError,
+)
 from ipc_proofs_tpu.store.blockstore import BlockCache, CachedBlockstore
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.metrics import Metrics
@@ -105,6 +109,11 @@ class ServiceConfig:
     # store_cap_bytes; None keeps the memory-only CachedBlockstore
     store_dir: Optional[str] = None
     store_cap_bytes: int = 1 * 1024 * 1024 * 1024
+    # owner token for a store_dir SHARED between shard daemons: each
+    # process appends only to its own seg-<owner>.* segments and eviction
+    # coordinates through the directory flock (see storex/segments.py).
+    # None = exclusive single-writer store (the pre-cluster behavior)
+    store_owner: Optional[str] = None
 
 
 @dataclass
@@ -191,6 +200,7 @@ class ProofService:
                 self.config.store_dir,
                 cap_bytes=self.config.store_cap_bytes,
                 metrics=self.metrics,
+                owner=self.config.store_owner,
             )
             self._store = TieredBlockstore(
                 store,
@@ -263,6 +273,40 @@ class ProofService:
         self, pair: TipsetPair, timeout_s: Optional[float] = None
     ) -> GenerateResponse:
         return self.submit_generate(pair, timeout_s=timeout_s).result()
+
+    def generate_range(
+        self, pairs: Sequence[TipsetPair], chunk_size: Optional[int] = None
+    ) -> UnifiedProofBundle:
+        """One canonical range bundle for an explicit pair list.
+
+        This is the scatter-gather sub-request: the cluster router already
+        grouped pairs per shard, so it calls straight through to the
+        chunked range driver instead of the micro-batcher (re-batching an
+        already-batched group would only add latency). The chunked driver
+        is the canonical comparator — its bundle is byte-identical to the
+        single-daemon run over the same pairs, which is what lets
+        `cluster.gather.merge_range_bundles` reassemble shard outputs
+        into the exact single-process bytes.
+        """
+        if self._store is None or self._spec is None:
+            raise RuntimeError(
+                "generate path disabled: service was built without store/spec"
+            )
+        if self.draining:
+            raise ServiceClosedError("service is draining")
+        pairs = list(pairs)
+        if not pairs:
+            raise RuntimeError("generate_range needs at least one pair")
+        with self.metrics.stage("serve.generate_batch"):
+            bundle = generate_event_proofs_for_range_chunked(
+                self._store,
+                pairs,
+                self._spec,
+                chunk_size=chunk_size or self.config.range_chunk_size,
+                metrics=self.metrics,
+            )
+        self.metrics.count("serve.batches.generate")
+        return bundle
 
     @property
     def draining(self) -> bool:

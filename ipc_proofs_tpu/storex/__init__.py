@@ -22,7 +22,7 @@ event — evict + refetch from the inner store — never a correctness one.
 
 from ipc_proofs_tpu.storex.segments import SEGMENT_MAGIC, SegmentStore, SegmentStoreError
 from ipc_proofs_tpu.storex.tiered import TieredBlockstore
-from ipc_proofs_tpu.storex.follower import ChainFollower
+from ipc_proofs_tpu.storex.follower import ChainFollower, FollowLeaderLock
 
 __all__ = [
     "SEGMENT_MAGIC",
@@ -30,4 +30,5 @@ __all__ = [
     "SegmentStoreError",
     "TieredBlockstore",
     "ChainFollower",
+    "FollowLeaderLock",
 ]

@@ -29,8 +29,14 @@ fault harness.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX: leader election degrades to always-win
+    fcntl = None
 
 from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.core.dagcbor import decode as dagcbor_decode
@@ -38,9 +44,69 @@ from ipc_proofs_tpu.proofs.chain import Tipset
 from ipc_proofs_tpu.store.rpc import verify_block_bytes
 from ipc_proofs_tpu.utils.log import get_logger
 
-__all__ = ["ChainFollower"]
+__all__ = ["ChainFollower", "FollowLeaderLock"]
 
 logger = get_logger(__name__)
+
+class FollowLeaderLock:
+    """Single-follower election for a shared ``--store-dir``.
+
+    When N serve shards share one disk tier, exactly one of them should
+    tail the chain (N followers would fetch every spine block N times and
+    race each other's puts for nothing). Election is an ``fcntl.flock``
+    on ``<root>/follow.leader.lock``: the winner holds the lock for its
+    lifetime, losers skip starting their follower, and the kernel releases
+    the lock when the holder dies — so a crashed leader's successor wins
+    the very next election with no timeouts or heartbeats. Winning is
+    counted as ``follow.leader_elections``.
+
+    On platforms without ``fcntl`` every candidate "wins" (honest
+    degradation: a duplicated follower wastes fetches, never corrupts —
+    puts are content-addressed).
+    """
+
+    def __init__(self, root: str, name: str = "follow.leader.lock"):
+        os.makedirs(root, exist_ok=True)
+        self.path = os.path.join(root, name)
+        self._fh = None
+
+    def try_acquire(self, metrics=None) -> bool:
+        """Non-blocking election attempt; True iff this process leads."""
+        if self._fh is not None:
+            return True  # already held
+        if fcntl is None:  # pragma: no cover - non-POSIX
+            self._fh = open(self.path, "ab")
+            return True
+        fh = open(self.path, "ab")
+        try:
+            fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            fh.close()
+            return False  # another process leads
+        self._fh = fh
+        if metrics is None:
+            from ipc_proofs_tpu.utils.metrics import get_metrics
+
+            metrics = get_metrics()
+        metrics.count("follow.leader_elections")
+        return True
+
+    def release(self) -> None:
+        fh = self._fh
+        self._fh = None
+        if fh is not None:
+            fh.close()  # closing the fd releases the flock
+
+    @property
+    def held(self) -> bool:
+        return self._fh is not None
+
+    def __enter__(self) -> "FollowLeaderLock":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
 
 # cap on first-level links walked under each root block: the spine top is
 # what latency cares about (deeper nodes load on demand); an adversarially

@@ -1,6 +1,7 @@
 """Disk tier: CID → bytes in append-only CRC-framed segment files.
 
-Layout (``<root>/seg-00000001.blk``, ``seg-00000002.blk``, …)::
+Layout (``<root>/seg-00000001.blk``, ``seg-00000002.blk``, …; in shared
+mode ``seg-<owner>.00000001.blk`` — see below)::
 
     MAGIC   4 bytes   b"IPS1"
     LEN     4 bytes   u32 payload length
@@ -27,6 +28,22 @@ per-segment last-touch recency and deletes whole cold segment files when
 the cap is exceeded (content-addressed data never goes stale, so this is
 purely a disk-budget policy). The active tail segment is never evicted.
 
+**Shared mode** (``owner="s0"``): N processes — the cluster's shard
+daemons — share ONE store directory. Each writer appends only to its own
+``seg-<owner>.<id>.blk`` segments (so appends never interleave), while
+the rebuild scan indexes EVERY owner's segments (a block any shard
+fetched is warm for all of them). Eviction then coordinates through an
+``fcntl.flock`` on ``<root>/evict.lock``: the evicting process computes
+the real directory total, never deletes ANY owner's highest-id segment
+(that is some process's active tail), prefers its own LRU-cold segments
+and falls back to other owners' oldest non-tail segments, and counts
+each removal as ``storex.evictions`` + ``storex.shared_evictions``. A
+reader racing a removal sees a vanished file and degrades to a plain
+miss — availability, never correctness. Because each process only
+re-checks the directory when it rolls a segment, the shared cap can
+transiently overshoot by ~(writers × segment_max_bytes); that bound is
+the price of not stat-ing the directory on every put.
+
 Writes are flush-only (no per-block fsync): the disk tier is a cache of
 refetchable chain data, not a durability log — a lost tail costs a
 refetch, and the rebuild scan already handles any torn residue.
@@ -43,6 +60,11 @@ import zlib
 from collections import OrderedDict
 from typing import Optional
 
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX: shared eviction degrades to local
+    fcntl = None
+
 from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.jobs.journal import FRAME_HEADER
 from ipc_proofs_tpu.store.rpc import verify_block_bytes
@@ -55,29 +77,51 @@ SEGMENT_MAGIC = b"IPS1"
 _CID_LEN = struct.Struct("<H")
 _SEGMENT_GLOB_PREFIX = "seg-"
 _SEGMENT_SUFFIX = ".blk"
+_EVICT_LOCK_NAME = "evict.lock"
 
 logger = get_logger(__name__)
 
 
 class SegmentStoreError(ValueError):
     """Typed segment-store misuse: the root path is not usable as a store
-    directory, or a segment file name lies about its id. Frame-level
-    corruption never raises this — it is handled by truncate/evict +
-    refetch (availability, not correctness)."""
+    directory, a segment file name lies about its id, or an owner token
+    is not filename-safe. Frame-level corruption never raises this — it
+    is handled by truncate/evict + refetch (availability, not
+    correctness)."""
 
 
 class _Segment:
-    __slots__ = ("seg_id", "path", "size", "raws")
+    __slots__ = ("key", "owner", "seg_id", "path", "size", "raws")
 
-    def __init__(self, seg_id: int, path: str, size: int = 0):
+    def __init__(self, key: str, owner: str, seg_id: int, path: str, size: int = 0):
+        self.key = key  # basename — unique across owners (seg ids are not)
+        self.owner = owner
         self.seg_id = seg_id
         self.path = path
         self.size = size
         self.raws: "list[bytes]" = []  # raw CIDs indexed into this segment
 
 
-def _segment_path(root: str, seg_id: int) -> str:
-    return os.path.join(root, f"{_SEGMENT_GLOB_PREFIX}{seg_id:08d}{_SEGMENT_SUFFIX}")
+def _segment_name(owner: str, seg_id: int) -> str:
+    if owner:
+        return f"{_SEGMENT_GLOB_PREFIX}{owner}.{seg_id:08d}{_SEGMENT_SUFFIX}"
+    return f"{_SEGMENT_GLOB_PREFIX}{seg_id:08d}{_SEGMENT_SUFFIX}"
+
+
+def _parse_segment_name(name: str) -> "tuple[str, int] | None":
+    """``(owner, seg_id)`` of a segment file name (owner ``""`` for the
+    legacy single-writer form), or None when it is not a segment file."""
+    if not (name.startswith(_SEGMENT_GLOB_PREFIX) and name.endswith(_SEGMENT_SUFFIX)):
+        return None
+    rem = name[len(_SEGMENT_GLOB_PREFIX) : -len(_SEGMENT_SUFFIX)]
+    if "." in rem:
+        owner, _, id_str = rem.rpartition(".")
+    else:
+        owner, id_str = "", rem
+    try:
+        return owner, int(id_str)
+    except ValueError:
+        raise SegmentStoreError(f"segment file name {name!r} has no id") from None
 
 
 def _scan_segment(path: str) -> "tuple[list[tuple[bytes, int, int]], int, bool]":
@@ -129,6 +173,10 @@ class SegmentStore:
     active tail writer (appends are short buffered writes). Frame reads
     happen outside the lock against immutable committed bytes; a read
     racing an eviction sees a vanished file and reports a plain miss.
+
+    ``owner`` switches on shared mode: this process appends only to its
+    own ``seg-<owner>.*`` segments and eviction coordinates with the
+    other owners through the ``evict.lock`` flock (see module docstring).
     """
 
     def __init__(
@@ -137,9 +185,17 @@ class SegmentStore:
         cap_bytes: int = 1 << 30,
         segment_max_bytes: int = 64 * 1024 * 1024,
         metrics=None,
+        owner: Optional[str] = None,
     ):
         if cap_bytes <= 0:
             raise SegmentStoreError("cap_bytes must be positive")
+        if owner is not None and (
+            not owner
+            or not all(ch.isalnum() or ch in "-_" for ch in owner)
+        ):
+            raise SegmentStoreError(
+                f"owner token {owner!r} must be non-empty [A-Za-z0-9_-]"
+            )
         os.makedirs(root, exist_ok=True)
         if not os.path.isdir(root):
             raise SegmentStoreError(f"segment store root {root!r} is not a directory")
@@ -147,70 +203,89 @@ class SegmentStore:
         self._cap_bytes = cap_bytes
         self._segment_max_bytes = max(1, segment_max_bytes)
         self._metrics = metrics
+        self._owner = owner or ""
+        self.shared = owner is not None
         self._lock = threading.Lock()
-        # raw CID bytes -> (seg_id, frame offset, frame length)
-        self._index: "dict[bytes, tuple[int, int, int]]" = {}  # guarded-by: _lock
-        # seg_id -> _Segment, ordered coldest-first (LRU)
-        self._segments: "OrderedDict[int, _Segment]" = OrderedDict()  # guarded-by: _lock
+        # raw CID bytes -> (segment key, frame offset, frame length)
+        self._index: "dict[bytes, tuple[str, int, int]]" = {}  # guarded-by: _lock
+        # segment key (basename) -> _Segment, ordered coldest-first (LRU)
+        self._segments: "OrderedDict[str, _Segment]" = OrderedDict()  # guarded-by: _lock
         self._total_bytes = 0  # guarded-by: _lock
         self._active: Optional[_Segment] = None  # guarded-by: _lock
         self._active_fh = None  # guarded-by: _lock
         self.degraded = False  # guarded-by: _lock
         self._warned = False  # guarded-by: _lock
 
-        # -- index rebuild: scan every segment, truncate torn/corrupt tails
+        # -- index rebuild: scan every owner's segments, truncate
+        #    torn/corrupt tails (only our own — another owner's tail may
+        #    be mid-append right now and is theirs to repair on reopen)
         next_id = 1
         for name in sorted(os.listdir(root)):
-            if not (name.startswith(_SEGMENT_GLOB_PREFIX) and name.endswith(_SEGMENT_SUFFIX)):
+            parsed = _parse_segment_name(name)
+            if parsed is None:
                 continue
-            try:
-                seg_id = int(name[len(_SEGMENT_GLOB_PREFIX) : -len(_SEGMENT_SUFFIX)])
-            except ValueError as exc:
-                raise SegmentStoreError(f"segment file name {name!r} has no id") from exc
+            seg_owner, seg_id = parsed
             path = os.path.join(root, name)
-            entries, good_size, dirty = _scan_segment(path)
-            if dirty:
+            try:
+                entries, good_size, dirty = _scan_segment(path)
+            except OSError:
+                continue  # vanished under a concurrent shared eviction
+            if dirty and seg_owner == self._owner:
                 with open(path, "r+b") as fh:
                     fh.truncate(good_size)
-            seg = _Segment(seg_id, path, good_size)
+            seg = _Segment(name, seg_owner, seg_id, path, good_size)
             for cid_raw, off, frame_len in entries:
-                prior = self._index.get(cid_raw)
-                if prior is not None:
+                if cid_raw in self._index:
                     # duplicate insert across segments (two writers raced a
-                    # miss); keep the newest, the bytes verify identically
+                    # miss); keep the first, the bytes verify identically
                     continue
-                self._index[cid_raw] = (seg_id, off, frame_len)
+                self._index[cid_raw] = (name, off, frame_len)
                 seg.raws.append(cid_raw)
-            self._segments[seg_id] = seg
+            self._segments[name] = seg
             self._total_bytes += seg.size
-            next_id = max(next_id, seg_id + 1)
+            if seg_owner == self._owner:
+                next_id = max(next_id, seg_id + 1)
         self._next_id = next_id  # guarded-by: _lock
 
     # -- internals (call with _lock HELD) ---------------------------------
 
     @locked
     def _open_active_locked(self) -> None:
-        seg = _Segment(self._next_id, _segment_path(self.root, self._next_id))
+        name = _segment_name(self._owner, self._next_id)
+        seg = _Segment(
+            name, self._owner, self._next_id, os.path.join(self.root, name)
+        )
         self._next_id += 1
         self._active_fh = open(seg.path, "ab")
         self._active = seg
-        self._segments[seg.seg_id] = seg  # newest == hottest end
+        self._segments[name] = seg  # newest == hottest end
+
+    @locked
+    def _forget_segment_locked(self, key: str) -> None:
+        """Drop one segment from the in-memory view (deleted on disk —
+        by us or by another owner's eviction pass)."""
+        seg = self._segments.pop(key, None)
+        if seg is None:
+            return
+        self._total_bytes -= seg.size
+        for cid_raw in seg.raws:
+            entry = self._index.get(cid_raw)
+            if entry is not None and entry[0] == key:
+                del self._index[cid_raw]
 
     @locked
     def _evict_locked(self) -> None:
+        if self.shared:
+            self._evict_shared_locked()
+            return
         while self._total_bytes > self._cap_bytes and len(self._segments) > 1:
-            seg_id, seg = next(iter(self._segments.items()))
-            if self._active is not None and seg_id == self._active.seg_id:
+            key, seg = next(iter(self._segments.items()))
+            if self._active is not None and key == self._active.key:
                 # the tail is somehow the coldest — never evict it; move it
                 # to the hot end and stop
-                self._segments.move_to_end(seg_id)
+                self._segments.move_to_end(key)
                 return
-            del self._segments[seg_id]
-            self._total_bytes -= seg.size
-            for cid_raw in seg.raws:
-                entry = self._index.get(cid_raw)
-                if entry is not None and entry[0] == seg_id:
-                    del self._index[cid_raw]
+            self._forget_segment_locked(key)
             try:
                 os.remove(seg.path)
             except OSError:
@@ -221,12 +296,103 @@ class SegmentStore:
             self._gauge_locked()
 
     @locked
+    def _evict_shared_locked(self) -> None:
+        """Cross-process eviction: serialize with the other owners via the
+        ``evict.lock`` flock, then evict against the DIRECTORY total (our
+        in-memory total only sees segments we know about)."""
+        if fcntl is None:  # pragma: no cover - non-POSIX
+            # no POSIX file locks: behave like the single-writer store
+            # (honest degradation — still never evicts our own tail)
+            self.shared = False
+            self._evict_locked()
+            self.shared = True
+            return
+        try:
+            lock_fh = open(os.path.join(self.root, _EVICT_LOCK_NAME), "ab")
+        except OSError:
+            return  # fail-soft: an unopenable lock file skips this pass; the next roll retries
+        try:
+            fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
+            self._evict_shared_under_flock_locked()
+        finally:
+            lock_fh.close()  # closing the fd releases the flock
+
+    @locked
+    def _evict_shared_under_flock_locked(self) -> None:
+        # directory truth: every owner's segments, sizes/ages from disk
+        files: "dict[str, tuple[str, int, int, float]]" = {}
+        for name in os.listdir(self.root):
+            try:
+                parsed = _parse_segment_name(name)
+            except SegmentStoreError:
+                continue  # foreign residue is not ours to judge here
+            if parsed is None:
+                continue
+            try:
+                st = os.stat(os.path.join(self.root, name))
+            except OSError:
+                continue
+            files[name] = (parsed[0], parsed[1], st.st_size, st.st_mtime)
+
+        # reconcile: segments we indexed that another owner already
+        # evicted (their pass counted it; we only fix our accounting)
+        for key in [k for k in self._segments if k not in files]:
+            if self._active is not None and key == self._active.key:
+                continue
+            self._forget_segment_locked(key)
+
+        total = sum(size for _, _, size, _ in files.values())
+        if total <= self._cap_bytes:
+            self._gauge_locked()
+            return
+
+        # never evict ANY owner's highest-id segment: ids grow
+        # monotonically per owner, so that is some process's active tail
+        per_owner_max: "dict[str, tuple[int, str]]" = {}
+        for name, (owner, seg_id, _, _) in files.items():
+            cur = per_owner_max.get(owner)
+            if cur is None or seg_id > cur[0]:
+                per_owner_max[owner] = (seg_id, name)
+        protected = {name for _, name in per_owner_max.values()}
+        if self._active is not None:
+            protected.add(self._active.key)
+
+        # victims: our own LRU-cold segments first (we know their heat),
+        # then other owners' oldest-mtime segments (mtime is the only
+        # cross-process recency signal we have)
+        own = [
+            key
+            for key in self._segments
+            if key in files and files[key][0] == self._owner
+        ]
+        foreign = sorted(
+            (name for name, meta in files.items() if meta[0] != self._owner),
+            key=lambda name: (files[name][3], name),
+        )
+        metrics = self._metrics
+        for name in [*own, *foreign]:
+            if total <= self._cap_bytes:
+                break
+            if name in protected:
+                continue
+            try:
+                os.remove(os.path.join(self.root, name))
+            except OSError:
+                continue  # fail-soft: an unremovable file just stays; the cap re-checks next roll
+            total -= files[name][2]
+            self._forget_segment_locked(name)
+            if metrics is not None:
+                metrics.count("storex.evictions")
+                metrics.count("storex.shared_evictions")
+        self._gauge_locked()
+
+    @locked
     def _gauge_locked(self) -> None:
         metrics = self._metrics
         if metrics is not None:
             metrics.set_gauge("storex.disk_bytes", self._total_bytes)
 
-    def _drop_entry(self, cid_raw: bytes, entry: "tuple[int, int, int]") -> None:
+    def _drop_entry(self, cid_raw: bytes, entry: "tuple[str, int, int]") -> None:
         with self._lock:
             if self._index.get(cid_raw) == entry:
                 del self._index[cid_raw]
@@ -247,7 +413,7 @@ class SegmentStore:
                 # an active-tail read must see buffered bytes
                 if (
                     self._active is not None
-                    and entry[0] == self._active.seg_id
+                    and entry[0] == self._active.key
                     and self._active_fh is not None
                 ):
                     self._active_fh.flush()
@@ -256,7 +422,7 @@ class SegmentStore:
             if metrics is not None:
                 metrics.count("storex.disk_misses")
             return None
-        seg_id, off, frame_len = entry
+        _key, off, frame_len = entry
         data = self._read_verified(cid, cid_raw, path, off, frame_len)
         if data is None:
             # corrupt on disk: evict so the caller's refetch repopulates a
@@ -331,11 +497,13 @@ class SegmentStore:
                         "read-only", self.root, exc,
                     )
                 return False
-            self._index[cid_raw] = (self._active.seg_id, off, len(frame))
+            key = self._active.key
+            self._index[cid_raw] = (key, off, len(frame))
             self._active.raws.append(cid_raw)
             self._active.size += len(frame)
             self._total_bytes += len(frame)
-            self._segments.move_to_end(self._active.seg_id)
+            self._segments.move_to_end(key)
+            rolled = False
             if self._active.size >= self._segment_max_bytes:
                 try:
                     self._active_fh.close()
@@ -343,7 +511,12 @@ class SegmentStore:
                     pass  # fail-soft: the bytes are flushed; a close error does not lose them
                 self._active_fh = None
                 self._active = None
-            self._evict_locked()
+                rolled = True
+            # shared mode re-checks the directory only on a roll (or when
+            # our own view is over cap): stat-ing N owners' files per put
+            # would put a syscall storm on the hot path
+            if not self.shared or rolled or self._total_bytes > self._cap_bytes:
+                self._evict_locked()
             self._gauge_locked()
         return True
 
@@ -363,6 +536,8 @@ class SegmentStore:
                 "cap_bytes": self._cap_bytes,
                 "segments": len(self._segments),
                 "degraded": self.degraded,
+                "owner": self._owner or None,
+                "shared": self.shared,
             }
 
     def close(self) -> None:

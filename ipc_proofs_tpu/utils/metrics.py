@@ -28,10 +28,12 @@ __all__ = [
     "RANGE_COUNTERS",
     "SERVE_COUNTERS",
     "STOREX_COUNTERS",
+    "CLUSTER_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
     "DURABILITY_GAUGES",
     "STOREX_GAUGES",
+    "CLUSTER_GAUGES",
     "SERVE_HISTOGRAMS",
 ]
 
@@ -168,19 +170,54 @@ SERVE_COUNTERS = (
 #                                corruption-is-an-availability-event counter
 #   storex.write_failures      — blocks the disk tier could not spill
 #                                (ENOSPC/EROFS fail-soft read-only degrade)
+#   storex.shared_evictions    — segments removed under the cross-process
+#                                eviction lock of a SHARED store dir (one
+#                                --store-dir serving N shard daemons); a
+#                                subset of storex.evictions, counted by
+#                                the shard that ran the eviction pass
 #   follow.tipsets             — finalized tipsets the chain follower warmed
 #   follow.blocks_prefetched   — spine blocks the follower stored locally
 #   follow.errors              — follower errors absorbed fail-soft (head
 #                                polls, fetches, verification skips)
+#   follow.leader_elections    — times a daemon won the follow-leader lock
+#                                (cluster mode runs ONE ChainFollower per
+#                                shared --store-dir, not one per shard)
 STOREX_COUNTERS = (
     "storex.disk_hits",
     "storex.disk_misses",
     "storex.evictions",
     "storex.integrity_evictions",
+    "storex.shared_evictions",
     "storex.write_failures",
     "follow.tipsets",
     "follow.blocks_prefetched",
     "follow.errors",
+    "follow.leader_elections",
+)
+
+# Counter vocabulary of the cluster plane (cluster/router.py,
+# cluster/gather.py): the consistent-hash front end over N shard serve
+# daemons.
+#   cluster.requests         — single-key requests routed (verify/generate)
+#   cluster.scatter_requests — multi-pair range requests scatter-gathered
+#   cluster.sub_requests     — per-shard sub-requests a scatter produced
+#   cluster.steals           — requests routed AWAY from their hash-affine
+#                              shard because queue-depth imbalance crossed
+#                              --steal-threshold (affinity is a cache hint,
+#                              never a correctness constraint)
+#   cluster.shard_errors     — transport-level shard failures observed
+#                              (connection refused/reset/timeout)
+#   cluster.shard_failovers  — re-dispatches of in-flight requests to a
+#                              surviving shard after a shard death; the
+#                              retry reuses the same idempotency key, so
+#                              at-least-once + dedup absorbs the repeat
+CLUSTER_COUNTERS = (
+    "cluster.requests",
+    "cluster.scatter_requests",
+    "cluster.sub_requests",
+    "cluster.steals",
+    "cluster.shard_errors",
+    "cluster.shard_failovers",
 )
 
 # Stage-timer vocabulary (`Metrics.stage(...)`): every `with
@@ -214,6 +251,10 @@ DURABILITY_GAUGES = (
 )
 STOREX_GAUGES = (
     "storex.disk_bytes",  # bytes across all disk-tier segment files
+)
+CLUSTER_GAUGES = (
+    "cluster.shards_alive",  # shards currently routable (ring members)
+    "cluster.inflight.*",  # per-shard outstanding requests (steal signal)
 )
 
 # Histogram vocabulary: bounded-reservoir distributions (p50/p90/p99).

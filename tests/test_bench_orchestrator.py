@@ -169,6 +169,16 @@ _STORAGE_OK = {
     "storage_pairs": 12,
 }
 
+_CLUSTER_OK = {
+    "aggregate_proofs_per_sec": 720.0,
+    "cluster_linearity_4shard": 0.85,
+    "steal_events": 8,
+    "cluster_rps_1shard": 430.0,
+    "cluster_rps_4shard": 1460.0,
+    "cluster_pairs": 16,
+    "cluster_requests": 64,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -199,6 +209,7 @@ class TestOrchestrate:
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
+            "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -219,6 +230,10 @@ class TestOrchestrate:
         assert out["legs"]["storage"] == "ok:cpu"
         assert out["cold_vs_warm_speedup"] == 5.9
         assert out["storage_warm_rpc_calls"] == 0
+        assert out["legs"]["cluster"] == "ok:cpu"
+        assert out["cluster_linearity_4shard"] == 0.85
+        assert out["aggregate_proofs_per_sec"] == 720.0
+        assert out["steal_events"] == 8
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -234,6 +249,7 @@ class TestOrchestrate:
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
+            "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -246,7 +262,7 @@ class TestOrchestrate:
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
             ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
             ("durability", "cpu"), ("observability", "cpu"),
-            ("storage", "cpu"),
+            ("storage", "cpu"), ("cluster", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -262,6 +278,7 @@ class TestOrchestrate:
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
+            "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -308,6 +325,7 @@ class TestOrchestrate:
             "durability": [(None, "error:cpu")],
             "observability": [(None, "error:cpu")],
             "storage": [(None, "error:cpu")],
+            "cluster": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -321,6 +339,8 @@ class TestOrchestrate:
             "durability_journal_overhead_pct", "durability_resume_ms",
             "trace_overhead_pct", "spans_per_proof",
             "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
+            "cluster_linearity_4shard", "aggregate_proofs_per_sec",
+            "steal_events",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -17,6 +17,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 from check_bench_schema import (  # noqa: E402
     check_artifact,
+    cluster_gate_skip_reason,
     main,
     speedup_gate_skip_reason,
 )
@@ -176,3 +177,65 @@ class TestSpeedupGate:
         main(["--require-current", str(path)])  # rc covered elsewhere
         out = capsys.readouterr().out
         assert "speedup gate SKIPPED" in out and "host_cores=1" in out
+
+
+class TestClusterGate:
+    """cluster_linearity_4shard ≥ 0.8 is enforced (require_current) on
+    hosts with spare cores, and skipped WITH A REASON on 1–2 core hosts
+    where four shard processes time-slice the same cores."""
+
+    def _current(self):
+        with open(NEWEST) as fh:
+            return json.load(fh)
+
+    def test_sublinear_scaling_fails_on_multicore_host(self):
+        obj = self._current()
+        obj["host_cores"] = 8
+        obj["pipeline_speedup_vs_serial"] = 1.2  # keep the other gate green
+        obj["cluster_linearity_4shard"] = 0.4
+        assert check_artifact(obj) == []  # non-current vintages unaffected
+        problems = check_artifact(obj, require_current=True)
+        assert any("cluster gate" in p for p in problems), problems
+
+    def test_linearity_at_or_above_gate_passes(self):
+        obj = self._current()
+        obj["host_cores"] = 8
+        obj["cluster_linearity_4shard"] = 0.8
+        assert not any(
+            "cluster gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_missing_linearity_fails_on_multicore_host(self):
+        obj = self._current()
+        obj["host_cores"] = 4
+        obj["cluster_linearity_4shard"] = None
+        problems = check_artifact(obj, require_current=True)
+        assert any("cluster gate" in p for p in problems), problems
+
+    @pytest.mark.parametrize("cores", [1, 2, None])
+    def test_gate_skipped_with_reason_on_small_hosts(self, cores):
+        obj = self._current()
+        obj["host_cores"] = cores
+        obj["cluster_linearity_4shard"] = 0.2
+        reason = cluster_gate_skip_reason(obj)
+        assert reason is not None and str(cores) in reason
+        assert not any(
+            "cluster gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_gate_applies_above_two_cores(self):
+        obj = self._current()
+        obj["host_cores"] = 3
+        assert cluster_gate_skip_reason(obj) is None
+
+    def test_cli_prints_skip_reason(self, tmp_path, capsys):
+        obj = self._current()
+        obj["host_cores"] = 1
+        obj["cluster_linearity_4shard"] = 0.2
+        path = tmp_path / "BENCH_small_cluster_host.json"
+        path.write_text(json.dumps(obj))
+        main(["--require-current", str(path)])
+        out = capsys.readouterr().out
+        assert "cluster gate SKIPPED" in out and "host_cores=1" in out

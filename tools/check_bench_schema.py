@@ -10,9 +10,10 @@ flags. Two strictness levels:
 - the CURRENT artifact (``--require-current`` / ``require_current=True``)
   must carry the full present-day e2e key set — the orchestrator's
   ``_E2E_SCHEMA_KEYS`` contract plus the satellite leg keys — AND pass
-  the perf gate: ``pipeline_speedup_vs_serial >= 1.0`` whenever
-  ``host_cores > 2`` (hosts without spare cores skip the gate with a
-  printed reason — see `speedup_gate_skip_reason`).
+  the perf gates: ``pipeline_speedup_vs_serial >= 1.0`` and
+  ``cluster_linearity_4shard >= 0.8``, each whenever ``host_cores > 2``
+  (hosts without spare cores skip the gates with a printed reason — see
+  `speedup_gate_skip_reason` / `cluster_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -107,6 +108,13 @@ _KNOWN_TYPES = {
     "storage_prefetched_blocks": int,
     "storage_disk_bytes": int,
     "storage_pairs": int,
+    "cluster_linearity_4shard": _NUM,
+    "aggregate_proofs_per_sec": _NUM,
+    "steal_events": int,
+    "cluster_rps_1shard": _NUM,
+    "cluster_rps_4shard": _NUM,
+    "cluster_pairs": int,
+    "cluster_requests": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -133,6 +141,7 @@ _CURRENT_REQUIRED = (
     "durability_chunks",
     "trace_overhead_pct", "spans_per_proof",
     "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
+    "cluster_linearity_4shard", "aggregate_proofs_per_sec", "steal_events",
     "legs", "watchdog_fallback",
 )
 
@@ -220,6 +229,25 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     "stage-overlapped engine must beat serial when cores "
                     "are available"
                 )
+        # the cluster gate: with spare cores, 4 shard processes must keep
+        # ≥ 80% of ideal linear scaling over 1 shard. A 1-core host
+        # time-slices the shard processes (linearity collapses by design),
+        # so the gate applies on the same host shape as the speedup gate.
+        if cluster_gate_skip_reason(obj) is None:
+            linearity = obj.get("cluster_linearity_4shard")
+            if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
+                problems.append(
+                    "cluster gate: cluster_linearity_4shard is "
+                    f"{linearity!r} on a {obj.get('host_cores')}-core host "
+                    "(cluster leg did not run?)"
+                )
+            elif linearity < 0.8:
+                problems.append(
+                    f"cluster gate: cluster_linearity_4shard={linearity} "
+                    f"< 0.8 on a {obj.get('host_cores')}-core host — "
+                    "4 shard processes must scale near-linearly when cores "
+                    "are available"
+                )
     return problems
 
 
@@ -234,6 +262,21 @@ def speedup_gate_skip_reason(obj: dict) -> "str | None":
         return (
             f"host_cores={cores} ≤ 2 — stage overlap cannot pay without "
             "spare cores (1-core hosts run the serial fallback by design)"
+        )
+    return None
+
+
+def cluster_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the ≥0.8 cluster-linearity gate does NOT apply to this artifact
+    (None when it does). Callers print the reason so a skipped gate is
+    visible, never silent."""
+    cores = obj.get("host_cores")
+    if not isinstance(cores, int):
+        return f"host_cores={cores!r} (unknown host shape)"
+    if cores <= 2:
+        return (
+            f"host_cores={cores} ≤ 2 — four shard processes time-slice the "
+            "same cores, so linearity over one shard cannot hold"
         )
     return None
 
@@ -261,6 +304,9 @@ def main(argv=None) -> int:
             reason = speedup_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: speedup gate SKIPPED ({reason})")
+            reason = cluster_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: cluster gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")
