@@ -11,6 +11,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from ipc_proofs_tpu.utils.lockdep import named_lock
 from pathlib import Path
 from typing import Optional
 
@@ -23,7 +24,7 @@ _SO_PATH = _BUILD_DIR / "libipchashes.so"
 _SCAN_SRC = Path(__file__).parent / "scan_ext.c"
 _SCAN_SO = _BUILD_DIR / "ipc_scan_ext.so"
 
-_lock = threading.Lock()
+_lock = named_lock("native._lock")
 _cached: "NativeHashes | None | bool" = False  # False = not attempted yet
 _dagcbor_cached: "object | None | bool" = False
 _scan_cached: "object | None | bool" = False
@@ -160,7 +161,7 @@ def load_native() -> Optional[NativeHashes]:
         if os.environ.get("IPC_PROOFS_NO_NATIVE"):
             _cached = None
             return None
-        so = _build()
+        so = _build()  # ipclint: disable=lock-held-blocking (one-time toolchain build, serialized by design)
         if so is None:
             _cached = None
             return None

@@ -55,6 +55,7 @@ from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.threads import locked
 from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = [
     "ClusterRouter",
@@ -153,7 +154,7 @@ class ClusterRouter:
         self.pairs = list(pairs)
         self.steal_threshold = max(1, int(steal_threshold))
         self.metrics = metrics if metrics is not None else Metrics()
-        self._lock = threading.Lock()
+        self._lock = named_lock("ClusterRouter._lock")
         self._shards: "Dict[str, _ShardState]" = {}  # guarded-by: _lock
         self._ring = HashRing(vnodes=vnodes)  # guarded-by: _lock
         for name, target in shards.items():

@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import subprocess
 import threading
+from ipc_proofs_tpu.utils.lockdep import named_lock
 from pathlib import Path
 
 __all__ = ["load", "build_cpython_ext", "host_build_id", "BUILD_DIR", "SAN_FLAGS"]
@@ -29,7 +30,7 @@ BUILD_DIR = _NATIVE_DIR / "build"
 _DAGCBOR_SRC = _NATIVE_DIR / "dagcbor_ext.c"
 _DAGCBOR_SO = BUILD_DIR / "ipc_dagcbor_ext.so"
 
-_lock = threading.Lock()
+_lock = named_lock("_cid_native._lock")
 _cached: "object | None | bool" = False  # False = not attempted yet
 
 # sanitizer build profile (tools/build_native_san.py sets IPC_PROOFS_SAN=1):
@@ -119,7 +120,7 @@ def load():
             _cached = None
             return None
         try:
-            _cached = build_cpython_ext(_DAGCBOR_SRC, _DAGCBOR_SO, "ipc_dagcbor_ext")
+            _cached = build_cpython_ext(_DAGCBOR_SRC, _DAGCBOR_SO, "ipc_dagcbor_ext")  # ipclint: disable=lock-held-blocking (one-time toolchain build, serialized by design)
         except Exception:  # fail-soft: no toolchain → pure-Python CID/codec, bit-identical by contract
             _cached = None
         return _cached

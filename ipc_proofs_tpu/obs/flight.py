@@ -14,6 +14,7 @@ import json
 import logging
 import sys
 import threading
+from ipc_proofs_tpu.utils.lockdep import named_lock
 import time
 import traceback
 from collections import deque
@@ -37,7 +38,7 @@ class FlightRecorder:
         span_capacity: int = DEFAULT_SPAN_CAPACITY,
         log_capacity: int = DEFAULT_LOG_CAPACITY,
     ):
-        self._lock = threading.Lock()
+        self._lock = named_lock("FlightRecorder._lock")
         self._spans: deque = deque(maxlen=span_capacity)  # guarded-by: _lock
         self._logs: deque = deque(maxlen=log_capacity)  # guarded-by: _lock
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from ipc_proofs_tpu.utils.lockdep import named_lock
 import time
 import uuid
 from contextlib import contextmanager
@@ -134,7 +135,7 @@ class SpanCollector:
 
     def __init__(self, capacity: int = 100_000, metrics=None):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = named_lock("SpanCollector._lock")
         self._spans: list[Span] = []  # guarded-by: _lock
         self._dropped = 0  # guarded-by: _lock
         self._metrics = metrics

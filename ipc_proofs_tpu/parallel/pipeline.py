@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from ipc_proofs_tpu.utils.lockdep import named_lock
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
@@ -216,8 +217,8 @@ class MatchCoalescer:
     def __init__(self, backend, metrics=None):
         self._backend = backend
         self._metrics = metrics
-        self._lock = threading.Lock()
-        self._call_lock = threading.Lock()  # serializes device dispatch
+        self._lock = named_lock("MatchCoalescer._lock")
+        self._call_lock = named_lock("MatchCoalescer._call_lock")  # serializes device dispatch
         self._pending: "list[_MatchReq]" = []  # guarded-by: _lock
 
     def match_fp(self, fp, n_topics, emitters, valid, topic0, topic1, actor_id):
@@ -226,6 +227,7 @@ class MatchCoalescer:
         req = _MatchReq(fp, n_topics, emitters, valid, (topic0, topic1, actor_id))
         with self._lock:
             self._pending.append(req)
+        # lock-order: MatchCoalescer._call_lock < MatchCoalescer._lock
         with self._call_lock:
             if req.done.is_set():
                 batch: "list[_MatchReq]" = []
@@ -313,7 +315,7 @@ class _Cancel:
 
     def __init__(self):
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = named_lock("_Cancel._lock")
         self.exc: Optional[BaseException] = None  # guarded-by: _lock
 
     def fail(self, exc: BaseException) -> None:
@@ -359,7 +361,7 @@ class _OrderedEmitter:
     consumer, plus what the workers hold in flight)."""
 
     def __init__(self, n_items: int, out_q: "queue.Queue", n_stops: int, cancel: _Cancel):
-        self._lock = threading.Lock()
+        self._lock = named_lock("_OrderedEmitter._lock")
         self._buffer: dict[int, Any] = {}  # guarded-by: _lock
         self._next = 0  # guarded-by: _lock
         self._n = n_items

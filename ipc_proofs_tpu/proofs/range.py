@@ -42,6 +42,7 @@ from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.events import StampedEvent
 from ipc_proofs_tpu.store.blockstore import Blockstore, CachedBlockstore
 from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = [
     "TipsetPair",
@@ -724,7 +725,7 @@ class _MergeFold:
 
     def __init__(self, cached: Blockstore):
         self._cached = cached
-        self._lock = threading.Lock()
+        self._lock = named_lock("_MergeFold._lock")
         self.event_proofs: list = []  # guarded-by: _lock
         self.storage_proofs: list = []  # guarded-by: _lock
         self._by_cid: "dict[bytes, ProofBlock]" = {}  # guarded-by: _lock

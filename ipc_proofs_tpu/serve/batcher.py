@@ -38,6 +38,7 @@ from typing import Callable, Optional
 
 from ipc_proofs_tpu.obs.trace import current_context
 from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.utils.lockdep import named_condition
 
 __all__ = [
     "DeadlineExceededError",
@@ -151,7 +152,7 @@ class MicroBatcher:
         self._name = name
         self._metrics = metrics if metrics is not None else Metrics()
         self._executor = executor
-        self._cond = threading.Condition()
+        self._cond = named_condition("MicroBatcher._cond")
         self._queue: deque[PendingResult] = deque()  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
         # EWMA of recent flush wall times, seeding the retry-after hint for

@@ -56,6 +56,7 @@ from ipc_proofs_tpu.serve.batcher import (
     ServiceClosedError,
 )
 from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = ["DurableAdmission", "QUEUE_JOURNAL_NAME"]
 
@@ -96,7 +97,7 @@ class _ResultCache:
         self._path = path
         self._max_bytes = max(1, int(max_bytes))
         self._metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = named_lock("_ResultCache._lock")
         # offset None = result was never durably framed (degraded journal):
         # once it ages out of the hot tier it is gone and re-executes
         self._offsets: "dict[str, Optional[int]]" = {}  # guarded-by: _lock
@@ -201,10 +202,10 @@ class DurableAdmission:
         self.metrics = metrics if metrics is not None else service.metrics
         os.makedirs(queue_dir, exist_ok=True)
         self._path = os.path.join(queue_dir, QUEUE_JOURNAL_NAME)
-        self._lock = threading.Lock()
+        self._lock = named_lock("DurableAdmission._lock")
         # serializes journal appends AND makes (offset, append) atomic so a
         # done record's spill offset is exact even under concurrent submits
-        self._jlock = threading.Lock()
+        self._jlock = named_lock("DurableAdmission._jlock")
         self._results = _ResultCache(
             self._path, results_max_bytes, metrics=self.metrics
         )
@@ -322,7 +323,7 @@ class DurableAdmission:
     def _finish(self, key: str, done_payload: dict) -> None:
         with self._jlock:
             offset = self._writer.journal_bytes
-            ok = self._writer.append(
+            ok = self._writer.append(  # ipclint: disable=lock-held-blocking (durability: done-frames serialize under the journal lock)
                 {"t": "done", "key": key, "payload": done_payload}
             )
         # a degraded (in-memory) append has no frame to point at — the hot
@@ -381,7 +382,7 @@ class DurableAdmission:
         # durable intent BEFORE execution: the ACK implies the journal has it
         j0 = time.perf_counter()
         with self._jlock:
-            self._writer.append(
+            self._writer.append(  # ipclint: disable=lock-held-blocking (durability: admit-frames serialize under the journal lock)
                 {"t": "admit", "key": key, "kind": kind, "payload": payload}
             )
         journal_ms = round((time.perf_counter() - j0) * 1e3, 3)

@@ -64,6 +64,7 @@ from ipc_proofs_tpu.serve.batcher import (
 from ipc_proofs_tpu.store.blockstore import BlockCache, CachedBlockstore
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 log = get_logger(__name__)
 
@@ -215,7 +216,7 @@ class ProofService:
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )
-        self._drain_lock = threading.Lock()
+        self._drain_lock = named_lock("ProofService._drain_lock")
         self._drained = False  # guarded-by: _drain_lock
         self._verify_batcher = MicroBatcher(
             self._flush_verify,

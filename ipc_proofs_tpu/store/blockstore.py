@@ -23,6 +23,7 @@ from collections import OrderedDict
 from typing import Iterable, Optional, Protocol, runtime_checkable
 
 from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = [
     "Blockstore",
@@ -81,7 +82,7 @@ class MemoryBlockstore:
         # module-global) so independent stores — e.g. the serve pool's
         # generator and verifier stores — never serialize each other's
         # O(|store|) builds (ADVICE.md #4)
-        self._snapshot_lock = threading.Lock()
+        self._snapshot_lock = named_lock("MemoryBlockstore._snapshot_lock")
 
     def get(self, cid: CID) -> Optional[bytes]:
         return self._blocks.get(cid)
@@ -167,7 +168,7 @@ class RecordingBlockstore:
     def __init__(self, inner: Blockstore):
         self._inner = inner
         self._seen: set[CID] = set()  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = named_lock("RecordingBlockstore._lock")
 
     def get(self, cid: CID) -> Optional[bytes]:
         with self._lock:
@@ -220,7 +221,7 @@ class BlockCache:
     ):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
-        self._lock = threading.Lock()
+        self._lock = named_lock("BlockCache._lock")
         self._entries: "OrderedDict[CID, tuple[bytes, float]]" = OrderedDict()  # guarded-by: _lock
         self._max_bytes = max_bytes
         self._ttl_s = ttl_s
@@ -299,7 +300,7 @@ class CachedBlockstore:
         self._inner = inner
         self._cache = shared_cache if shared_cache is not None else {}
         self._evicting = isinstance(self._cache, BlockCache)
-        self._lock = threading.Lock()
+        self._lock = named_lock("CachedBlockstore._lock")
         self.hits = 0
         self.misses = 0
 

@@ -37,6 +37,7 @@ import time
 from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcError, verify_block_bytes
 from ipc_proofs_tpu.utils.metrics import Histogram
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = ["EndpointPool", "EndpointState", "IntegrityError"]
 
@@ -122,7 +123,7 @@ class EndpointPool:
         self.breaker_reset_s = breaker_reset_s
         self.hedge_ms = hedge_ms
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("EndpointPool._lock")
         # pool-wide block-fetch seconds
         self._latency = Histogram(maxlen=512)  # guarded-by: _lock
         self._executor: Optional[ThreadPoolExecutor] = None  # guarded-by: _lock

@@ -33,6 +33,7 @@ import time
 from typing import Iterable, Optional
 
 from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = [
     "FAULT_KINDS",
@@ -71,7 +72,7 @@ class FaultPlan:
         self.kinds = tuple(kinds)
         self.latency_s = latency_s
         self.max_faults = max_faults
-        self._lock = threading.Lock()
+        self._lock = named_lock("FaultPlan._lock")
         self.faults_injected = 0  # guarded-by: _lock
         self.calls_seen = 0  # guarded-by: _lock
         self.by_kind: dict[str, int] = {}  # guarded-by: _lock

@@ -26,6 +26,7 @@ from typing import Any, Iterable, Optional
 
 from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, IDENTITY, SHA2_256
 from ipc_proofs_tpu.core.hashes import blake2b_256
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = [
     "LotusClient",
@@ -137,7 +138,7 @@ class LotusClient:
         self._headers = {"Content-Type": "application/json"}
         if bearer_token:
             self._headers["Authorization"] = f"Bearer {bearer_token}"
-        self._id_lock = threading.Lock()
+        self._id_lock = named_lock("LotusClient._id_lock")
         self._next_id = 1  # guarded-by: _id_lock
         if metrics is None:
             from ipc_proofs_tpu.utils.metrics import get_metrics
@@ -297,7 +298,7 @@ class RpcBlockstore:
         todo = [c for c in cids if c not in into]
         if not todo:
             return {}
-        lock = threading.Lock()
+        lock = named_lock("rpc.prefetch_failures")
         failures: dict[CID, Exception] = {}
 
         def fetch(cid: CID) -> None:

@@ -43,6 +43,7 @@ from ipc_proofs_tpu.core.dagcbor import decode as dagcbor_decode
 from ipc_proofs_tpu.proofs.chain import Tipset
 from ipc_proofs_tpu.store.rpc import verify_block_bytes
 from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.lockdep import named_lock, note_flock_acquired
 
 __all__ = ["ChainFollower", "FollowLeaderLock"]
 
@@ -84,6 +85,9 @@ class FollowLeaderLock:
             fh.close()
             return False  # another process leads
         self._fh = fh
+        # a lifetime lease, not a scoped hold: witness it in the lockdep
+        # order graph without pushing a stack frame
+        note_flock_acquired("follow.leader")
         if metrics is None:
             from ipc_proofs_tpu.utils.metrics import get_metrics
 
@@ -165,7 +169,7 @@ class ChainFollower:
         self.poll_s = poll_s
         self.lag = max(0, int(lag))
         self.max_tipsets_per_poll = max(1, int(max_tipsets_per_poll))
-        self._lock = threading.Lock()
+        self._lock = named_lock("ChainFollower._lock")
         self._next_height: Optional[int] = start_height  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
         self._stop = threading.Event()

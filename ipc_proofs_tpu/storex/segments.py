@@ -70,6 +70,7 @@ from ipc_proofs_tpu.jobs.journal import FRAME_HEADER
 from ipc_proofs_tpu.store.rpc import verify_block_bytes
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.threads import locked
+from ipc_proofs_tpu.utils.lockdep import flock_frame, named_lock
 
 __all__ = ["SEGMENT_MAGIC", "SegmentStore", "SegmentStoreError"]
 
@@ -205,7 +206,7 @@ class SegmentStore:
         self._metrics = metrics
         self._owner = owner or ""
         self.shared = owner is not None
-        self._lock = threading.Lock()
+        self._lock = named_lock("SegmentStore._lock")
         # raw CID bytes -> (segment key, frame offset, frame length)
         self._index: "dict[bytes, tuple[str, int, int]]" = {}  # guarded-by: _lock
         # segment key (basename) -> _Segment, ordered coldest-first (LRU)
@@ -308,14 +309,13 @@ class SegmentStore:
             self.shared = True
             return
         try:
-            lock_fh = open(os.path.join(self.root, _EVICT_LOCK_NAME), "ab")
+            # lock-order: SegmentStore._lock < flock:storex.evict
+            with flock_frame(
+                os.path.join(self.root, _EVICT_LOCK_NAME), "storex.evict"
+            ):
+                self._evict_shared_under_flock_locked()
         except OSError:
             return  # fail-soft: an unopenable lock file skips this pass; the next roll retries
-        try:
-            fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
-            self._evict_shared_under_flock_locked()
-        finally:
-            lock_fh.close()  # closing the fd releases the flock
 
     @locked
     def _evict_shared_under_flock_locked(self) -> None:

@@ -26,6 +26,7 @@ from typing import Optional
 from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.store.blockstore import BlockCache, Blockstore
 from ipc_proofs_tpu.storex.segments import SegmentStore
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = ["TieredBlockstore"]
 
@@ -49,7 +50,7 @@ class TieredBlockstore:
         self._disk = disk
         self._cache = cache if cache is not None else {}
         self._evicting = isinstance(self._cache, BlockCache)
-        self._lock = threading.Lock()
+        self._lock = named_lock("TieredBlockstore._lock")
         self._metrics = metrics
         self.hits = 0  # tier-1 hits, same meaning as CachedBlockstore.hits
         self.misses = 0

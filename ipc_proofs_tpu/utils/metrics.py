@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import threading
+from ipc_proofs_tpu.utils.lockdep import named_lock
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -369,7 +370,12 @@ class Metrics:
     gauges: dict[str, float] = field(default_factory=dict)
     histograms: dict[str, Histogram] = field(default_factory=dict)
     created_at: float = field(default_factory=time.time)
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    # every subsystem counts/gauges while holding its own lock, so the
+    # metrics lock is a terminal leaf in the global acquisition order:
+    # lock-order: * < Metrics._lock
+    _lock: threading.Lock = field(
+        default_factory=lambda: named_lock("Metrics._lock"), repr=False
+    )
     _tls: threading.local = field(default_factory=threading.local, repr=False)
     # union wall across ALL stages (any-stage-active intervals)
     _union_active: int = field(default=0, repr=False)

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Mapping, Optional
 
 from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = ["ThreadBudget", "locked", "resolve_thread_budget"]
 
@@ -40,7 +41,7 @@ def locked(fn):
     """
     return fn
 
-_log_lock = threading.Lock()
+_log_lock = named_lock("threads._log_lock")
 _logged: "set[tuple]" = set()  # guarded-by: _log_lock
 
 

@@ -31,3 +31,88 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except ImportError:  # pragma: no cover
     pass
+
+import gc
+import threading
+import time
+
+import pytest
+
+# Resource-leak sentinel: a test that exits while a non-daemon thread it
+# started is still running, or with a journal/segment/lock file handle
+# still open, passes today and hangs (or corrupts) a future run. The
+# autouse fixture below fails the *leaking* test, which is the only
+# place the leak is still attributable.
+
+# fd targets worth policing: the durable on-disk artifacts whose handles
+# must not outlive their owner (journals, segment files, election locks).
+_FD_PATTERNS = (
+    "journal.bin",
+    "queue.bin",
+    ".blk",
+    "evict.lock",
+    "follow.leader.lock",
+)
+
+_LEAK_GRACE_S = 2.0
+
+
+def _interesting_fds() -> "dict[str, str]":
+    """fd -> target for open fds pointing at durable artifacts (POSIX
+    /proc only; elsewhere the fd half of the sentinel is a no-op)."""
+    out: "dict[str, str]" = {}
+    try:
+        entries = os.listdir("/proc/self/fd")
+    except OSError:  # pragma: no cover - non-/proc platform
+        return out
+    for fd in entries:
+        try:
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue  # the fd closed between listdir and readlink
+        if any(pat in target for pat in _FD_PATTERNS):
+            out[fd] = target
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel():
+    threads_before = set(threading.enumerate())
+    fds_before = set(_interesting_fds())
+    yield
+
+    def leaked_threads():
+        return [
+            t for t in threading.enumerate()
+            if t not in threads_before and t.is_alive() and not t.daemon
+        ]
+
+    def leaked_fds():
+        return {
+            fd: target for fd, target in _interesting_fds().items()
+            if fd not in fds_before
+        }
+
+    deadline = time.monotonic() + _LEAK_GRACE_S
+    threads, fds = leaked_threads(), leaked_fds()
+    collected = False
+    while (threads or fds) and time.monotonic() < deadline:
+        if fds and not collected:
+            # a handle owned by an unreferenced object is a GC artifact,
+            # not an unclosed-file bug; collect once before accusing
+            gc.collect()
+            collected = True
+        time.sleep(0.05)
+        threads, fds = leaked_threads(), leaked_fds()
+    problems = []
+    if threads:
+        problems.append(
+            "leaked non-daemon threads: "
+            + ", ".join(sorted(t.name for t in threads))
+        )
+    if fds:
+        problems.append(
+            "leaked durable-artifact fds: "
+            + ", ".join(f"{fd} -> {target}" for fd, target in sorted(fds.items()))
+        )
+    assert not problems, "; ".join(problems)

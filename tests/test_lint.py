@@ -10,6 +10,7 @@ ipc_proofs_tpu tools` exiting 0 is a tier-1 invariant of this repo.
 
 from __future__ import annotations
 
+import json
 import subprocess
 import sys
 import textwrap
@@ -241,6 +242,186 @@ class TestVocabRules:
         }, check_vocab=True)
         assert findings == []
 
+    def test_concrete_literal_does_not_keep_wildcard_alive(self, tmp_path):
+        # a wildcard family whose only "use" is a concrete literal under
+        # the prefix is dead: the dynamic call sites it existed for are
+        # gone, and the literal belongs in the vocabulary by name
+        findings = run_lint(tmp_path, {
+            self.METRICS_REL: '''
+                DEMO_COUNTERS = ("serve.accepted.*",)
+            ''',
+            "ipc_proofs_tpu/serve/mod.py": '''
+                def f(metrics):
+                    metrics.count("serve.accepted.grpc")
+            ''',
+        }, check_vocab=True)
+        assert rules_of(findings) == {"vocab-dead"}
+
+
+class TestLockOrderRules:
+    PAIR_PREAMBLE = '''
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+    '''
+
+    def test_abba_nesting_is_a_cycle(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": self.PAIR_PREAMBLE + '''
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        '''})
+        assert rules_of(findings) == {"lock-order-cycle"}
+
+    def test_nonreentrant_reentry_is_a_cycle(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        '''})
+        assert rules_of(findings) == {"lock-order-cycle"}
+
+    def test_undeclared_nesting_needs_lock_order_comment(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": self.PAIR_PREAMBLE + '''
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+        '''})
+        assert rules_of(findings) == {"lock-order-undeclared"}
+
+    def test_declared_nesting_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": self.PAIR_PREAMBLE + '''
+            def fwd(self):
+                # lock-order: Pair._a < Pair._b
+                with self._a:
+                    with self._b:
+                        pass
+        '''})
+        assert findings == []
+
+    def test_leaf_wildcard_declaration_covers_all_outers(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": self.PAIR_PREAMBLE + '''
+            def fwd(self):
+                # lock-order: * < Pair._b
+                with self._a:
+                    with self._b:
+                        pass
+        '''})
+        assert findings == []
+
+    def test_stale_lock_order_declaration(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            # lock-order: Ghost._a < Ghost._b
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+        '''})
+        assert rules_of(findings) == {"stale-suppression"}
+
+    def test_interprocedural_edge_through_method_call(self, tmp_path):
+        # outer() never lexically nests the two locks — the edge only
+        # exists through the call, which is the whole point of the pass
+        findings = run_lint(tmp_path, {"mod.py": self.PAIR_PREAMBLE + '''
+            def helper(self):
+                with self._b:
+                    pass
+
+            def outer(self):
+                with self._a:
+                    self.helper()
+        '''})
+        assert rules_of(findings) == {"lock-order-undeclared"}
+
+    def test_blocking_call_under_lock(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        '''})
+        assert rules_of(findings) == {"lock-held-blocking"}
+
+    def test_blocking_reachable_through_callee(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    time.sleep(1.0)
+
+                def outer(self):
+                    with self._lock:
+                        self.helper()
+        '''})
+        assert rules_of(findings) == {"lock-held-blocking"}
+
+    def test_bounded_wait_is_not_blocking(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = threading.Event()
+
+                def ok(self):
+                    with self._lock:
+                        self._done.wait(timeout=0.5)
+        '''})
+        assert findings == []
+
+
+class TestParseError:
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        findings = run_lint(tmp_path, {"mod.py": '''
+            def broken(:
+                pass
+        '''})
+        assert rules_of(findings) == {"parse-error"}
+
+    def test_cli_exits_nonzero_and_emits_json(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n    pass\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ipclint", str(bad),
+             "--no-vocab", "--json"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        records = [json.loads(line) for line in proc.stdout.splitlines() if line]
+        assert any(r["rule"] == "parse-error" for r in records)
+        assert all({"rule", "path", "line", "message"} <= set(r) for r in records)
+
 
 class TestSuppression:
     def test_disable_comment_suppresses(self, tmp_path):
@@ -288,13 +469,25 @@ class TestRealTree:
         )
         assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
 
+    def test_check_all_lockdep_gate_passes(self):
+        """The dynamic gate: lock-heavy tier-1 files under IPC_LOCKDEP=1
+        observe zero inversions (the runtime counterpart of the clean
+        static tree above)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.check_all", "--lockdep"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=900,
+        )
+        assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+
     def test_rule_registry_is_stable(self):
         # every rule the fixtures above exercise must stay registered —
         # removing one from RULES would turn its disables into stale noise
         assert {
             "race-guard", "race-unannotated", "det-wallclock", "det-random",
             "det-setiter", "det-float", "err-bare", "err-swallow",
-            "vocab-unknown", "vocab-dead", "stale-suppression",
+            "vocab-unknown", "vocab-dead", "lock-order-cycle",
+            "lock-held-blocking", "lock-order-undeclared",
+            "stale-suppression", "parse-error",
         } <= set(RULES)
 
 

@@ -1,0 +1,260 @@
+"""Runtime lockdep witness: the dynamic half of the lock-order discipline.
+
+The grid below pins the violation taxonomy (inversion / reentry / hold),
+the cross-primitive graph (thread locks AND flocks feed one order
+graph), the fail-soft recording mode, the waiting-is-not-holding
+Condition contract, and the zero-overhead-when-disabled factory
+behavior. ``tools/check_all.py --lockdep`` re-runs the lock-heavy
+tier-1 files (including this one) under ``IPC_LOCKDEP=1``, so every
+test here must leave the module state exactly as it found it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from ipc_proofs_tpu.utils import lockdep
+from ipc_proofs_tpu.utils.lockdep import (
+    LockOrderError,
+    flock_frame,
+    named_condition,
+    named_lock,
+    named_rlock,
+    note_flock_acquired,
+    order_graph,
+    violations,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def lockdep_strict():
+    """Fresh strict state for one test; restores whatever was active."""
+    saved = lockdep._state
+    lockdep.enable(strict=True, hold_budget_ms=0)
+    yield
+    lockdep._state = saved
+
+
+@pytest.fixture
+def lockdep_soft():
+    saved = lockdep._state
+    lockdep.enable(strict=False, hold_budget_ms=0)
+    yield
+    lockdep._state = saved
+
+
+def _in_thread(fn):
+    """Run ``fn`` on a fresh thread (fresh per-thread stack), re-raising."""
+    box = {}
+
+    def run():
+        try:
+            fn()
+        except BaseException as exc:  # pragma: no cover - only on test failure
+            box["exc"] = exc
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    if "exc" in box:
+        raise box["exc"]
+
+
+class TestInversion:
+    def test_abba_raises_in_strict_mode(self, lockdep_strict):
+        a, b = named_lock("T.a"), named_lock("T.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderError, match="ABBA"):
+                a.acquire()
+
+    def test_abba_across_threads(self, lockdep_strict):
+        a, b = named_lock("T.a"), named_lock("T.b")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        _in_thread(forward)  # witness a < b on another thread's stack
+        with b:
+            with pytest.raises(LockOrderError, match="ABBA"):
+                a.acquire()
+
+    def test_consistent_order_is_silent(self, lockdep_strict):
+        a, b = named_lock("T.a"), named_lock("T.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert violations() == []
+        assert ("T.a", "T.b") in order_graph()
+
+    def test_trylock_adds_no_edges_and_never_inverts(self, lockdep_strict):
+        a, b = named_lock("T.a"), named_lock("T.b")
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+        assert ("T.a", "T.b") not in order_graph()
+        with b:  # would be an ABBA if the trylock had registered an edge
+            with a:
+                pass
+        assert violations() == []
+
+
+class TestFlockMixedGraph:
+    def test_flock_participates_in_the_thread_lock_graph(
+        self, lockdep_strict, tmp_path
+    ):
+        lockfile = str(tmp_path / "x.lock")
+        t = named_lock("T.t")
+        with t:
+            with flock_frame(lockfile, "x"):
+                pass
+        assert ("T.t", "flock:x") in order_graph()
+        with flock_frame(lockfile, "x"):
+            with pytest.raises(LockOrderError, match="ABBA"):
+                t.acquire()
+
+    def test_nonblocking_flock_is_a_trylock(self, lockdep_strict, tmp_path):
+        lockfile = str(tmp_path / "x.lock")
+        t = named_lock("T.t")
+        with t:
+            with flock_frame(lockfile, "x", blocking=False):
+                pass
+        assert ("T.t", "flock:x") not in order_graph()
+
+    def test_note_flock_acquired_witnesses_a_lease(self, lockdep_strict):
+        t = named_lock("T.t")
+        with t:
+            note_flock_acquired("lease")
+        assert ("T.t", "flock:lease") in order_graph()
+
+
+class TestFailSoft:
+    def test_inversion_records_instead_of_raising(self, lockdep_soft):
+        a, b = named_lock("T.a"), named_lock("T.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # fail-soft: recorded, execution continues
+                pass
+        kinds = [v["kind"] for v in violations()]
+        assert kinds == ["inversion"]
+
+    def test_duplicate_violations_are_deduplicated(self, lockdep_soft):
+        a, b = named_lock("T.a"), named_lock("T.b")
+        with a:
+            with b:
+                pass
+        for _ in range(3):
+            with b:
+                with a:
+                    pass
+        assert len(violations()) == 1
+
+    def test_reentry_raises_even_fail_soft(self, lockdep_soft):
+        # proceeding would deadlock the thread on itself; a hung process
+        # out-reports no recorder, so re-entry is always fatal
+        a = named_lock("T.a")
+        a.acquire()
+        try:
+            with pytest.raises(LockOrderError, match="re-acquired"):
+                a.acquire()
+        finally:
+            a.release()
+
+
+class TestPrimitives:
+    def test_rlock_reentry_is_legal(self, lockdep_strict):
+        r = named_rlock("T.r")
+        with r:
+            with r:
+                pass
+        assert violations() == []
+
+    def test_condition_wait_is_not_holding(self, lockdep_soft):
+        lockdep.enable(strict=False, hold_budget_ms=20)
+        cond = named_condition("T.cond")
+        with cond:
+            cond.wait(timeout=0.2)  # 10x the budget, spent NOT holding
+        assert [v for v in violations() if v["kind"] == "hold"] == []
+
+    def test_condition_wait_for_wakes_on_notify(self, lockdep_strict):
+        cond = named_condition("T.cond")
+        ready = []
+
+        def producer():
+            with cond:
+                ready.append(True)
+                cond.notify_all()
+
+        t = threading.Thread(target=producer)
+        with cond:
+            t.start()
+            assert cond.wait_for(lambda: ready, timeout=5)
+        t.join(timeout=5)
+        assert violations() == []
+
+    def test_hold_budget_violation_at_release(self, lockdep_soft):
+        lockdep.enable(strict=False, hold_budget_ms=10)
+        a = named_lock("T.a")
+        with a:
+            time.sleep(0.05)
+        kinds = [v["kind"] for v in violations()]
+        assert kinds == ["hold"]
+
+
+class TestDisabledPath:
+    def test_factories_return_plain_primitives(self):
+        saved = lockdep._state
+        lockdep.disable()
+        try:
+            assert type(named_lock("x")) is type(threading.Lock())
+            assert type(named_rlock("x")) is type(threading.RLock())
+            assert isinstance(named_condition("x"), threading.Condition)
+            assert violations() == [] and order_graph() == {}
+        finally:
+            lockdep._state = saved
+
+    def test_enabled_overhead_is_bounded(self, lockdep_strict):
+        # smoke bound, not a benchmark: 20k tracked acquire/release pairs
+        # must land far under a second, or the opt-in is not shippable
+        a = named_lock("T.a")
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            with a:
+                pass
+        assert time.perf_counter() - t0 < 2.0
+
+
+class TestEnvKnob:
+    @pytest.mark.parametrize(
+        "env_value, expect_strict", [("1", True), ("soft", False)]
+    )
+    def test_env_enables_at_import(self, env_value, expect_strict):
+        code = (
+            "from ipc_proofs_tpu.utils import lockdep\n"
+            "assert lockdep.enabled()\n"
+            f"assert lockdep._state.strict is {expect_strict}\n"
+        )
+        env = dict(os.environ)
+        env["IPC_LOCKDEP"] = env_value
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr

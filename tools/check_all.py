@@ -12,25 +12,62 @@ Chains the analyzers that guard invariants tests can't see directly:
    to run the full sanitized build + native test subset instead of the
    probe.
 
+Pass ``--lockdep`` to add a fourth, *dynamic* gate: the lock-heavy
+tier-1 test files re-run under ``IPC_LOCKDEP=1`` (strict runtime
+lock-order witness, see ``ipc_proofs_tpu/utils/lockdep.py``). Any
+acquisition-order inversion, non-reentrant re-entry, or flock/thread
+mixed-order violation the tests actually exercise raises
+``LockOrderError`` and fails the gate — the static lint proves the
+declared order is acyclic, this gate proves the executed order matches.
+
 Exit 0 only when every gate passes. Designed for pre-commit / CI::
 
-    python -m tools.check_all          # lint + schema + toolchain probe
-    python -m tools.check_all --san    # …with the full sanitizer run
+    python -m tools.check_all            # lint + schema + toolchain probe
+    python -m tools.check_all --san      # …with the full sanitizer run
+    python -m tools.check_all --lockdep  # …plus the runtime lockdep sweep
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import subprocess
 import sys
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
+# The tier-1 files whose tests exercise real cross-thread / cross-process
+# locking: serve plane, durable admission, tiered store + flocked segment
+# eviction, job journal, parallel pipeline, cluster router, thread pools.
+# Pure-math and codec suites add wall-clock but no lock edges, so the
+# lockdep sweep stays a sub-minute gate instead of a full tier-1 re-run.
+LOCKDEP_TEST_FILES = (
+    "tests/test_cluster.py",
+    "tests/test_crash_recovery.py",
+    "tests/test_jobs.py",
+    "tests/test_lockdep.py",
+    "tests/test_parallel.py",
+    "tests/test_range_pipeline.py",
+    "tests/test_serve.py",
+    "tests/test_serve_durable.py",
+    "tests/test_store.py",
+    "tests/test_storex.py",
+    "tests/test_threads.py",
+)
 
-def _gate(name: str, argv: "list[str]") -> bool:
+
+def _gate(
+    name: str, argv: "list[str]", env: "dict[str, str] | None" = None
+) -> bool:
     print(f"check_all: [{name}] {' '.join(argv)}", flush=True)
-    proc = subprocess.run([sys.executable, *argv], cwd=REPO_ROOT, timeout=1800)
+    run_env = None
+    if env:
+        run_env = dict(os.environ)
+        run_env.update(env)
+    proc = subprocess.run(
+        [sys.executable, *argv], cwd=REPO_ROOT, timeout=1800, env=run_env
+    )
     ok = proc.returncode == 0
     print(f"check_all: [{name}] {'ok' if ok else f'FAILED (exit {proc.returncode})'}")
     return ok
@@ -43,6 +80,11 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument(
         "--san", action="store_true",
         help="run the full sanitizer build + native tests, not just the probe",
+    )
+    ap.add_argument(
+        "--lockdep", action="store_true",
+        help="re-run the lock-heavy tier-1 test files under IPC_LOCKDEP=1 "
+        "(strict runtime lock-order witness; any inversion fails the gate)",
     )
     args = ap.parse_args(argv)
 
@@ -64,6 +106,17 @@ def main(argv: "list[str] | None" = None) -> int:
             print("check_all: [sanitizer] toolchain available (probe compiled+ran)")
         else:
             print(f"check_all: [sanitizer] SKIP ({detail})")
+
+    if args.lockdep:
+        present = [f for f in LOCKDEP_TEST_FILES if (REPO_ROOT / f).exists()]
+        ok &= _gate(
+            "lockdep",
+            [
+                "-m", "pytest", *present, "-q", "-m", "not slow",
+                "-p", "no:cacheprovider", "-p", "no:randomly",
+            ],
+            env={"IPC_LOCKDEP": "1", "JAX_PLATFORMS": "cpu"},
+        )
 
     print("check_all: " + ("all gates passed" if ok else "FAILED"))
     return 0 if ok else 1

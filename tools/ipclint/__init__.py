@@ -12,8 +12,15 @@ Encodes this codebase's real invariants as machine-checked AST rules:
 * ``vocab-unknown`` / ``vocab-dead`` — metrics/trace vocabulary lint
   against the declared ``*_COUNTERS``/``*_STAGES``/``*_GAUGES``/
   ``*_HISTOGRAMS`` tuples in ``utils/metrics.py`` (checks_vocab).
-* ``stale-suppression`` — an ``# ipclint: disable=<rule>`` comment that
-  suppressed nothing.
+* ``lock-order-cycle`` / ``lock-held-blocking`` /
+  ``lock-order-undeclared`` — interprocedural lock-order lint over the
+  ``# lock-order: A < B`` declaration convention: the global acquisition
+  graph must be acyclic, declared, and never wait on a blocking
+  primitive while holding a lock (checks_lockorder).
+* ``stale-suppression`` — an ``# ipclint: disable=<rule>`` comment (or
+  ``# lock-order:`` declaration) that suppressed/blessed nothing.
+* ``parse-error`` — a file the linter could not parse; emitted instead
+  of silently skipping so CI can trust a clean run covered every file.
 
 Run as ``python -m tools.ipclint [paths...]`` (defaults to
 ``ipc_proofs_tpu tools``); exits non-zero iff findings remain after
@@ -37,5 +44,9 @@ RULES = (
     "err-swallow",
     "vocab-unknown",
     "vocab-dead",
+    "lock-order-cycle",
+    "lock-held-blocking",
+    "lock-order-undeclared",
     "stale-suppression",
+    "parse-error",
 )

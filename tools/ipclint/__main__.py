@@ -7,6 +7,7 @@ is the invocation pinned by ``tests/test_lint.py``.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from tools.ipclint import lint_paths
@@ -25,11 +26,27 @@ def main(argv=None) -> int:
         "--no-vocab", action="store_true",
         help="skip the cross-file metrics-vocabulary rules",
     )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit findings as one JSON object per line "
+             "(keys: rule, path, line, message)",
+    )
     args = parser.parse_args(argv)
 
     run = lint_paths(args.paths, check_vocab=not args.no_vocab)
     for finding in run.findings:
-        print(finding.render())
+        if args.json:
+            print(json.dumps(
+                {
+                    "rule": finding.rule,
+                    "path": finding.path,
+                    "line": finding.line,
+                    "message": finding.message,
+                },
+                sort_keys=True,
+            ))
+        else:
+            print(finding.render())
     n_files = len(run.files)
     if run.findings:
         print(f"ipclint: {len(run.findings)} finding(s) in {n_files} file(s)",

@@ -1,0 +1,736 @@
+"""Interprocedural lock-order lint (``lock-order-cycle`` /
+``lock-held-blocking`` / ``lock-order-undeclared``).
+
+The ``guarded-by:`` rules (checks_race) prove each access is under *a*
+lock; this family proves the locks themselves are acquired in ONE global
+order — the invariant deadlocks actually violate. Three passes:
+
+1. **Model.** Every lock construction site is identified — ``self._x =
+   threading.Lock()`` (or the lockdep ``named_lock`` / ``named_rlock`` /
+   ``named_condition`` factories, or a dataclass
+   ``field(default_factory=...)``) — and given a stable id:
+   ``ClassName.attr`` for instance locks, ``modbase.var`` for
+   module-level locks, or the literal handed to a ``named_*`` factory.
+   File locks appear as ``flock:<name>`` via the ``flock_frame(path,
+   "name")`` wrapper; a raw blocking ``fcntl.flock`` falls back to
+   ``flock:<modbase>``, and ``LOCK_NB`` trylocks never create incoming
+   edges (a trylock cannot wait, so it cannot deadlock).
+2. **Extract.** Each function body is walked lexically: ``with
+   self._x:`` nesting yields order edges ``outer < inner``; ``@locked``
+   methods start with the instance lock held; blocking primitives
+   (``time.sleep``, ``os.fsync``, subprocess waits, unbounded
+   ``Queue.get`` / ``.join()`` / ``.result()``, ``urlopen``, socket
+   reads) are recorded with the locks held at the call site.  Call
+   edges — ``self.m()``, bare same-module calls, constructor-typed
+   ``self.attr.m()``, and the ``metrics``/``_metrics``/``m`` receiver
+   convention — propagate acquisitions and blocking reachability
+   interprocedurally to a fixpoint.
+3. **Judge.** ``lock-order-cycle``: some path acquires ``A`` before
+   ``B`` and some path the reverse (or a non-reentrant lock re-enters
+   itself — a guaranteed self-deadlock).  ``lock-held-blocking``: a
+   blocking primitive runs (or is reachable through resolved calls)
+   while any lock is held.  ``lock-order-undeclared``: an observed
+   ``A < B`` nesting with no covering ``# lock-order: A < B``
+   declaration — chains (``A < B < C``) declare each adjacent pair,
+   coverage is transitive, and ``# lock-order: * < X`` declares ``X`` a
+   terminal *leaf* lock (anything may hold while taking ``X``).
+   Declarations that stop matching any observed nesting are flagged
+   ``stale-suppression`` — the same can't-outlive-its-reason contract as
+   ``ipclint: disable`` comments.
+
+``Condition.wait(...)`` releases the condition it waits on, so a bare
+``cond.wait()`` under ``with cond:`` is exempt — it is flagged only when
+*other* locks are held across the wait.  Reporting is per ordered pair
+(first site in path/line order), so one declaration covers every site
+that nests the same two locks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.ipclint.engine import LintRun, SourceFile
+
+__all__ = ["check"]
+
+#: threading constructor terminal name -> (reentrant, is_condition)
+_LOCK_CTORS = {
+    "Lock": (False, False),
+    "RLock": (True, False),
+    "Condition": (False, True),
+}
+#: lockdep factory terminal name -> (reentrant, is_condition)
+_NAMED_CTORS = {
+    "named_lock": (False, False),
+    "named_rlock": (True, False),
+    "named_condition": (False, True),
+}
+#: receiver names conventionally bound to the Metrics handle (kept in
+#: sync with checks_vocab._METRICS_RECEIVERS)
+_METRICS_RECEIVERS = frozenset({"metrics", "_metrics", "m"})
+
+_LOCK_ORDER_RE = re.compile(r"lock-order:\s*(.+)")
+_ORDER_TOKEN_RE = re.compile(r"^[A-Za-z0-9_.:\-]+$")
+
+# interprocedural blocking-reachability chains are capped for message
+# sanity; the fixpoint itself is exact
+_MAX_VIA_CHAIN = 3
+
+
+def _terminal(node: Optional[ast.expr]) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _self_attr(node: ast.expr) -> str:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _is_locked_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return _terminal(dec) == "locked"
+
+
+def _str_const(node: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _none_const(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+@dataclass
+class _Lock:
+    lock_id: str
+    reentrant: bool = False
+    condition: bool = False
+
+
+@dataclass
+class _Func:
+    qualname: str
+    sf: SourceFile
+    node: ast.AST
+    owner: Optional["_Class"] = None
+    module: Optional["_Module"] = None
+    entry_held: FrozenSet[str] = frozenset()
+    #: lock ids blocking-acquired anywhere inside (lexically or, after
+    #: the fixpoint, through resolved calls)
+    acquires: Set[str] = field(default_factory=set)
+    #: (outer_id, inner_id, line) — outer held when inner was acquired;
+    #: outer == inner records a non-reentrant self re-entry
+    edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    #: (description, line, locks held at the site)
+    blocking: List[Tuple[str, int, FrozenSet[str]]] = field(default_factory=list)
+    #: (ref, line, locks held at the site)
+    calls: List[Tuple[tuple, int, FrozenSet[str]]] = field(default_factory=list)
+    #: resolved call targets, same order as matching `calls` entries
+    resolved: List[Tuple["_Func", int, FrozenSet[str]]] = field(default_factory=list)
+    #: blocking description -> call chain (qualnames) it is reached through
+    blk: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+
+@dataclass
+class _Class:
+    name: str
+    modkey: str
+    locks: Dict[str, _Lock] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, _Func] = field(default_factory=dict)
+    entry_lock: Optional[str] = None  # lock id @locked methods start holding
+
+
+@dataclass
+class _Module:
+    modkey: str
+    sf: SourceFile
+    locks: Dict[str, _Lock] = field(default_factory=dict)
+    functions: Dict[str, _Func] = field(default_factory=dict)
+    classes: Dict[str, _Class] = field(default_factory=dict)
+
+
+def _modkey(rel: str) -> str:
+    parts = rel.replace("\\", "/").split("/")
+    base = parts[-1]
+    if base.endswith(".py"):
+        base = base[:-3]
+    if base == "__init__" and len(parts) >= 2:
+        base = parts[-2]
+    return base
+
+
+def _lock_ctor(value: ast.expr) -> Optional[Tuple[Optional[str], bool, bool]]:
+    """(explicit_name, reentrant, is_condition) when ``value`` constructs
+    a lock; handles ``x if c else y`` arms, ``named_*`` factories and
+    dataclass ``field(default_factory=...)`` (plain or lambda)."""
+    if isinstance(value, ast.IfExp):
+        return _lock_ctor(value.body) or _lock_ctor(value.orelse)
+    if not isinstance(value, ast.Call):
+        return None
+    t = _terminal(value.func)
+    if t in _LOCK_CTORS:
+        reent, cond = _LOCK_CTORS[t]
+        return (None, reent, cond)
+    if t in _NAMED_CTORS:
+        reent, cond = _NAMED_CTORS[t]
+        name = _str_const(value.args[0]) if value.args else None
+        for kw in value.keywords:
+            if kw.arg == "name":
+                name = _str_const(kw.value) or name
+        return (name, reent, cond)
+    if t == "field":
+        for kw in value.keywords:
+            if kw.arg == "default_factory":
+                fac = kw.value
+                if isinstance(fac, ast.Lambda):
+                    return _lock_ctor(fac.body)
+                ft = _terminal(fac)
+                if ft in _LOCK_CTORS:
+                    reent, cond = _LOCK_CTORS[ft]
+                    return (None, reent, cond)
+    return None
+
+
+def _ctor_class(value: ast.expr) -> str:
+    """Class name when ``value`` is a ``ClassName(...)`` construction."""
+    if isinstance(value, ast.IfExp):
+        return _ctor_class(value.body) or _ctor_class(value.orelse)
+    if isinstance(value, ast.Call):
+        t = _terminal(value.func)
+        if t and t[0].isupper() and t not in _LOCK_CTORS:
+            return t
+    return ""
+
+
+def _blocking_call(call: ast.Call) -> Optional[Tuple[str, Optional[ast.expr]]]:
+    """(description, condition_receiver) when ``call`` can block
+    indefinitely; the receiver is returned for the wait family so the
+    caller can apply the Condition self-release exemption."""
+    func = call.func
+    name = _terminal(func)
+    recv = func.value if isinstance(func, ast.Attribute) else None
+
+    def bounded_by_timeout() -> bool:
+        return any(
+            kw.arg == "timeout" and not _none_const(kw.value)
+            for kw in call.keywords
+        )
+
+    if name == "sleep":
+        return ("time.sleep()", None)
+    if name == "fsync":
+        return ("os.fsync()", None)
+    if name in ("communicate", "check_output", "check_call"):
+        return (f".{name}()", None)
+    if name == "run" and _terminal(recv) == "subprocess":
+        return ("subprocess.run()", None)
+    if name == "urlopen":
+        return ("urlopen()", None)
+    if name == "recv":
+        return (".recv()", None)
+    if name == "accept" and not call.args:
+        return (".accept()", None)
+    if name == "select" and _terminal(recv) == "select":
+        return ("select.select()", None)
+    if name in ("wait", "wait_for"):
+        positional_timeout = len(call.args) >= (1 if name == "wait" else 2)
+        if positional_timeout or bounded_by_timeout():
+            return None
+        return (f".{name}() with no timeout", recv)
+    if name == "join" and not call.args and not bounded_by_timeout():
+        # str.join / os.path.join always carry arguments
+        return (".join() with no timeout", None)
+    if name == "result" and not call.args and not bounded_by_timeout():
+        return (".result() with no timeout", None)
+    if name == "get":
+        if bounded_by_timeout():
+            return None
+        if not call.args and not call.keywords:
+            return ("Queue.get() with no timeout", None)
+        block_true = any(
+            kw.arg == "block"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+        if block_true or (
+            call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value is True
+        ):
+            return ("Queue.get(block=True) with no timeout", None)
+    return None
+
+
+def _call_ref(call: ast.Call) -> Optional[tuple]:
+    func = call.func
+    if isinstance(func, ast.Name):
+        return ("mod", func.id)
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if isinstance(base, ast.Name):
+        if base.id == "self":
+            return ("self", func.attr)
+        if base.id in _METRICS_RECEIVERS:
+            return ("class", "Metrics", func.attr)
+        return None
+    if (
+        isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "self"
+    ):
+        return ("attr", base.attr, func.attr)
+    if isinstance(base, ast.Call) and _terminal(base.func) == "get_metrics":
+        return ("class", "Metrics", func.attr)
+    return None
+
+
+def _flock_arg_names(op: ast.expr) -> Set[str]:
+    return {_terminal(n) for n in ast.walk(op) if isinstance(n, (ast.Name, ast.Attribute))}
+
+
+def _build_class(sf: SourceFile, modkey: str, cls: ast.ClassDef) -> _Class:
+    model = _Class(name=cls.name, modkey=modkey)
+    # class-level lock attributes: dataclass fields and shared class attrs
+    for stmt in cls.body:
+        target: Optional[ast.expr] = None
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            target, value = stmt.target, stmt.value
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target, value = stmt.targets[0], stmt.value
+        if isinstance(target, ast.Name) and value is not None:
+            got = _lock_ctor(value)
+            if got:
+                name, reent, cond = got
+                model.locks[target.id] = _Lock(
+                    name or f"{cls.name}.{target.id}", reent, cond
+                )
+    # instance attributes assigned in any method (canonically __init__)
+    for node in ast.walk(cls):
+        target = None
+        value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        if target is None or value is None:
+            continue
+        attr = _self_attr(target)
+        if not attr:
+            continue
+        got = _lock_ctor(value)
+        if got:
+            name, reent, cond = got
+            model.locks.setdefault(
+                attr, _Lock(name or f"{cls.name}.{attr}", reent, cond)
+            )
+            continue
+        cname = _ctor_class(value)
+        if cname:
+            model.attr_types.setdefault(attr, cname)
+    # the project-wide naming convention for the Metrics handle
+    for conv in ("metrics", "_metrics"):
+        model.attr_types.setdefault(conv, "Metrics")
+    if "_lock" in model.locks:
+        model.entry_lock = model.locks["_lock"].lock_id
+    elif len(model.locks) == 1:
+        model.entry_lock = next(iter(model.locks.values())).lock_id
+    return model
+
+
+def _analyze_func(func: _Func) -> None:
+    owner, module = func.owner, func.module
+
+    def lock_of_expr(expr: ast.expr) -> Optional[Tuple[str, bool, bool]]:
+        """(lock_id, reentrant, blocking_acquire) when ``expr`` denotes a
+        lock acquisition usable as a `with` item."""
+        attr = _self_attr(expr)
+        if attr and owner is not None and attr in owner.locks:
+            lk = owner.locks[attr]
+            return (lk.lock_id, lk.reentrant, True)
+        if isinstance(expr, ast.Name) and expr.id in module.locks:
+            lk = module.locks[expr.id]
+            return (lk.lock_id, lk.reentrant, True)
+        if isinstance(expr, ast.Call) and _terminal(expr.func) == "flock_frame":
+            name = _str_const(expr.args[1]) if len(expr.args) >= 2 else None
+            blocking = True
+            for kw in expr.keywords:
+                if kw.arg == "name":
+                    name = _str_const(kw.value) or name
+                if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+                    blocking = bool(kw.value.value)
+            lock_id = f"flock:{name}" if name else f"flock:{module.modkey}"
+            return (lock_id, False, blocking)
+        return None
+
+    def visit_call(node: ast.Call, held: Tuple[str, ...]) -> None:
+        # raw fcntl.flock: LOCK_UN releases, LOCK_NB trylocks (no edge);
+        # a blocking exclusive/shared flock orders after every held lock
+        if _terminal(node.func) == "flock" and _terminal(
+            getattr(node.func, "value", None)
+        ) == "fcntl" and len(node.args) >= 2:
+            names = _flock_arg_names(node.args[1])
+            if "LOCK_UN" not in names and "LOCK_NB" not in names:
+                lock_id = f"flock:{module.modkey}"
+                for h in held:
+                    func.edges.append((h, lock_id, node.lineno))
+                func.acquires.add(lock_id)
+            return
+        blocking = _blocking_call(node)
+        if blocking is not None:
+            desc, cond_recv = blocking
+            held_eff = held
+            if cond_recv is not None:
+                got = lock_of_expr(cond_recv)
+                if got is not None and got[0] in held:
+                    # cond.wait() releases the condition itself; only
+                    # OTHER locks are held across the wait
+                    held_eff = tuple(h for h in held if h != got[0])
+            func.blocking.append((desc, node.lineno, frozenset(held_eff)))
+        ref = _call_ref(node)
+        if ref is not None:
+            func.calls.append((ref, node.lineno, frozenset(held)))
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            cur = held
+            for item in node.items:
+                walk(item.context_expr, cur)
+                got = lock_of_expr(item.context_expr)
+                if got is None:
+                    continue
+                lock_id, reent, blocking_acq = got
+                if lock_id in cur:
+                    if not reent:
+                        func.edges.append(
+                            (lock_id, lock_id, item.context_expr.lineno)
+                        )
+                    continue
+                if blocking_acq:
+                    for h in cur:
+                        func.edges.append((h, lock_id, item.context_expr.lineno))
+                    func.acquires.add(lock_id)
+                cur = cur + (lock_id,)
+            for child in node.body:
+                walk(child, cur)
+            return
+        if isinstance(node, ast.Call):
+            visit_call(node, held)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # definition-site discipline, matching checks_race: a worker
+            # closure defined under a lock inherits that lock's context
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                walk(child, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    entry = tuple(sorted(func.entry_held))
+    body = getattr(func.node, "body", [])
+    for stmt in body:
+        walk(stmt, entry)
+
+
+def _build_module(sf: SourceFile) -> _Module:
+    modkey = _modkey(sf.rel)
+    module = _Module(modkey=modkey, sf=sf)
+    for stmt in sf.tree.body:
+        target: Optional[ast.expr] = None
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            target, value = stmt.target, stmt.value
+        if isinstance(target, ast.Name) and value is not None:
+            got = _lock_ctor(value)
+            if got:
+                name, reent, cond = got
+                module.locks[target.id] = _Lock(
+                    name or f"{modkey}.{target.id}", reent, cond
+                )
+    for cls in [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]:
+        cmodel = _build_class(sf, modkey, cls)
+        module.classes.setdefault(cls.name, cmodel)
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            entry: FrozenSet[str] = frozenset()
+            if cmodel.entry_lock and any(
+                _is_locked_decorator(d) for d in meth.decorator_list
+            ):
+                entry = frozenset({cmodel.entry_lock})
+            fn = _Func(
+                qualname=f"{cls.name}.{meth.name}",
+                sf=sf,
+                node=meth,
+                owner=cmodel,
+                module=module,
+                entry_held=entry,
+            )
+            cmodel.methods[meth.name] = fn
+    for stmt in sf.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module.functions[stmt.name] = _Func(
+                qualname=f"{modkey}.{stmt.name}",
+                sf=sf,
+                node=stmt,
+                module=module,
+            )
+    return module
+
+
+def _fmt_locks(held: FrozenSet[str]) -> str:
+    return ", ".join(f"'{h}'" for h in sorted(held))
+
+
+def _parse_declarations(
+    run: LintRun,
+) -> Tuple[Dict[Tuple[str, str], Tuple[SourceFile, int]], Dict[str, Tuple[SourceFile, int]]]:
+    """Collect ``# lock-order: A < B [< C ...]`` and ``# lock-order: * <
+    X`` declarations from every linted file."""
+    pairs: Dict[Tuple[str, str], Tuple[SourceFile, int]] = {}
+    leaves: Dict[str, Tuple[SourceFile, int]] = {}
+    for sf in run.files:
+        for line in sorted(sf.comments):
+            m = _LOCK_ORDER_RE.search(sf.comments[line])
+            if not m:
+                continue
+            tokens = [t.strip() for t in m.group(1).split("<")]
+            if len(tokens) == 2 and tokens[0] == "*" and _ORDER_TOKEN_RE.match(tokens[1]):
+                leaves.setdefault(tokens[1], (sf, line))
+                continue
+            if len(tokens) < 2 or not all(_ORDER_TOKEN_RE.match(t) for t in tokens):
+                continue  # malformed: the uncovered edge keeps its finding
+            for a, b in zip(tokens, tokens[1:]):
+                pairs.setdefault((a, b), (sf, line))
+    return pairs, leaves
+
+
+def _closure_path(
+    decl: Dict[Tuple[str, str], Tuple[SourceFile, int]], a: str, b: str
+) -> Optional[List[Tuple[str, str]]]:
+    """Shortest chain of declared pairs deriving ``a < b`` (BFS), or None."""
+    succ: Dict[str, List[str]] = {}
+    for (x, y) in decl:
+        succ.setdefault(x, []).append(y)
+    seen = {a}
+    frontier: List[Tuple[str, List[Tuple[str, str]]]] = [(a, [])]
+    while frontier:
+        node, path = frontier.pop(0)
+        for nxt in sorted(succ.get(node, ())):
+            if nxt in seen:
+                continue
+            step = path + [(node, nxt)]
+            if nxt == b:
+                return step
+            seen.add(nxt)
+            frontier.append((nxt, step))
+    return None
+
+
+def check(run: LintRun) -> None:
+    modules = [_build_module(sf) for sf in run.files]
+
+    class_index: Dict[str, List[_Class]] = {}
+    funcs: List[_Func] = []
+    for module in modules:
+        for cmodel in module.classes.values():
+            class_index.setdefault(cmodel.name, []).append(cmodel)
+            funcs.extend(cmodel.methods.values())
+        funcs.extend(module.functions.values())
+
+    for fn in funcs:
+        _analyze_func(fn)
+
+    def unique_class(name: str, prefer_module: _Module) -> Optional[_Class]:
+        local = prefer_module.classes.get(name)
+        if local is not None:
+            return local
+        cands = class_index.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    for fn in funcs:
+        for ref, line, held in fn.calls:
+            target: Optional[_Func] = None
+            if ref[0] == "self" and fn.owner is not None:
+                target = fn.owner.methods.get(ref[1])
+            elif ref[0] == "mod":
+                target = fn.module.functions.get(ref[1])
+            elif ref[0] == "attr" and fn.owner is not None:
+                cname = fn.owner.attr_types.get(ref[1])
+                if cname:
+                    cls = unique_class(cname, fn.module)
+                    if cls is not None:
+                        target = cls.methods.get(ref[2])
+            elif ref[0] == "class":
+                cls = unique_class(ref[1], fn.module)
+                if cls is not None:
+                    target = cls.methods.get(ref[2])
+            if target is not None and target is not fn:
+                fn.resolved.append((target, line, held))
+
+    # fixpoint: transitive blocking-acquisition sets and blocking
+    # reachability over the resolved call graph (cycles converge because
+    # both propagations are monotone over finite sets)
+    for fn in funcs:
+        for desc, _line, _held in fn.blocking:
+            fn.blk.setdefault(desc, ())
+    changed = True
+    while changed:
+        changed = False
+        for fn in funcs:
+            for callee, _line, _held in fn.resolved:
+                if not callee.acquires <= fn.acquires:
+                    fn.acquires |= callee.acquires
+                    changed = True
+                for desc, path in callee.blk.items():
+                    if desc not in fn.blk and len(path) < _MAX_VIA_CHAIN:
+                        fn.blk[desc] = (callee.qualname,) + path
+                        changed = True
+
+    # ---- lock-held-blocking ------------------------------------------------
+    flagged: Set[Tuple[str, int]] = set()
+    for fn in funcs:
+        for desc, line, held in fn.blocking:
+            if held and (fn.sf.rel, line) not in flagged:
+                flagged.add((fn.sf.rel, line))
+                run.add(
+                    fn.sf, line, "lock-held-blocking",
+                    f"blocking {desc} while holding {_fmt_locks(held)}",
+                )
+        for callee, line, held in fn.resolved:
+            if not held or not callee.blk:
+                continue
+            if callee.entry_held and held <= callee.entry_held:
+                continue  # @locked callee: reported at its own site
+            if (fn.sf.rel, line) in flagged:
+                continue
+            desc = sorted(callee.blk)[0]
+            chain = " -> ".join((callee.qualname,) + callee.blk[desc])
+            flagged.add((fn.sf.rel, line))
+            run.add(
+                fn.sf, line, "lock-held-blocking",
+                f"blocking {desc} is reachable through {chain}() while "
+                f"holding {_fmt_locks(held)}",
+            )
+
+    # ---- observed order edges ---------------------------------------------
+    edge_sites: List[Tuple[str, str, SourceFile, int, str]] = []
+    for fn in funcs:
+        for outer, inner, line in fn.edges:
+            edge_sites.append((outer, inner, fn.sf, line, ""))
+        for callee, line, held in fn.resolved:
+            for inner in sorted(callee.acquires):
+                for outer in sorted(held):
+                    if outer != inner:
+                        edge_sites.append((
+                            outer, inner, fn.sf, line,
+                            f" via call to {callee.qualname}()",
+                        ))
+
+    site_of: Dict[Tuple[str, str], Tuple[SourceFile, int, str]] = {}
+    for outer, inner, sf, line, note in sorted(
+        edge_sites, key=lambda e: (e[0], e[1], e[2].rel, e[3], e[4])
+    ):
+        key = (outer, inner)
+        prev = site_of.get(key)
+        if prev is None or (sf.rel, line) < (prev[0].rel, prev[1]):
+            site_of[key] = (sf, line, note)
+
+    graph: Dict[str, Set[str]] = {}
+    for (outer, inner) in site_of:
+        graph.setdefault(outer, set()).add(inner)
+
+    reach_memo: Dict[str, Set[str]] = {}
+
+    def reachable_from(src: str) -> Set[str]:
+        if src not in reach_memo:
+            seen: Set[str] = set()
+            stack = [src]
+            while stack:
+                node = stack.pop()
+                for nxt in graph.get(node, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            reach_memo[src] = seen
+        return reach_memo[src]
+
+    decl_pairs, leaves = _parse_declarations(run)
+    used_decl: Set[Tuple[str, str]] = set()
+    used_leaves: Set[str] = set()
+
+    for (outer, inner), (sf, line, note) in sorted(
+        site_of.items(), key=lambda kv: (kv[1][0].rel, kv[1][1], kv[0])
+    ):
+        if outer == inner:
+            run.add(
+                sf, line, "lock-order-cycle",
+                f"non-reentrant lock '{outer}' is acquired while already "
+                f"held{note} — guaranteed self-deadlock",
+            )
+            continue
+        if outer in reachable_from(inner):
+            rev = site_of.get((inner, outer))
+            where = (
+                f" (reverse order at {rev[0].rel}:{rev[1]})"
+                if rev is not None
+                else " (reverse order through intermediate locks)"
+            )
+            run.add(
+                sf, line, "lock-order-cycle",
+                f"'{inner}' is acquired while '{outer}' is held{note}, but "
+                f"the opposite order also occurs{where} — ABBA deadlock",
+            )
+            continue
+        path = _closure_path(decl_pairs, outer, inner)
+        if path is not None:
+            used_decl.update(path)
+            continue
+        if inner in leaves:
+            used_leaves.add(inner)
+            continue
+        run.add(
+            sf, line, "lock-order-undeclared",
+            f"'{inner}' is acquired while '{outer}' is held{note} but no "
+            f"`# lock-order: {outer} < {inner}` declaration covers it "
+            f"(use `# lock-order: * < {inner}` for a leaf lock)",
+        )
+
+    # declarations must not outlive the nesting they bless
+    for (a, b), (sf, line) in sorted(
+        decl_pairs.items(), key=lambda kv: (kv[1][0].rel, kv[1][1], kv[0])
+    ):
+        if (a, b) not in used_decl:
+            run.add(
+                sf, line, "stale-suppression",
+                f"lock-order declaration '{a} < {b}' matches no observed "
+                f"acquisition order — remove it",
+            )
+    for leaf, (sf, line) in sorted(
+        leaves.items(), key=lambda kv: (kv[1][0].rel, kv[1][1], kv[0])
+    ):
+        if leaf not in used_leaves:
+            run.add(
+                sf, line, "stale-suppression",
+                f"lock-order declaration '* < {leaf}' matches no observed "
+                f"acquisition order — remove it",
+            )

@@ -12,7 +12,11 @@ wildcards for per-instance families built with f-strings (e.g.
   doesn't error at runtime, it silently mints a new series that never
   shows up where dashboards look.
 * ``vocab-dead`` — a declared entry no call site references: stale
-  vocabulary reads as live telemetry to operators.
+  vocabulary reads as live telemetry to operators.  A wildcard entry is
+  only kept alive by a *wildcard-form* (f-string) call site — a concrete
+  literal under the prefix belongs in the vocabulary literally, so a
+  family whose dynamic call sites were all removed goes dead even if
+  stray literals still match it.
 
 Only calls on receivers named ``metrics`` / ``_metrics`` / ``m`` are
 inspected (that is the project-wide naming convention for the
@@ -144,7 +148,14 @@ def check(run: LintRun, vocab_sf: SourceFile) -> None:
                 for form in forms:
                     hits = [e for e, _ in vocab[kind] if _matches(e, form)]
                     if hits:
-                        used[kind].update(hits)
+                        # a wildcard entry is only kept ALIVE by a wildcard
+                        # (f-string) call site: a concrete literal that
+                        # happens to fall under the prefix should be
+                        # declared literally, not hide behind the family
+                        used[kind].update(
+                            e for e in hits
+                            if form.endswith("*") or not e.endswith(".*")
+                        )
                     else:
                         shown = form[:-1] + "{…}" if form.endswith("*") else form
                         run.add(
@@ -155,7 +166,16 @@ def check(run: LintRun, vocab_sf: SourceFile) -> None:
 
     for kind, entries in vocab.items():
         for entry, lineno in entries:
-            if entry not in used[kind]:
+            if entry in used[kind]:
+                continue
+            if entry.endswith(".*"):
+                run.add(
+                    vocab_sf, lineno, "vocab-dead",
+                    f"wildcard {kind} vocabulary entry '{entry}' has no "
+                    f"matching f-string call site — declare the concrete "
+                    f"names instead, or remove it",
+                )
+            else:
                 run.add(
                     vocab_sf, lineno, "vocab-dead",
                     f"{kind} vocabulary entry '{entry}' has no call site — "

@@ -174,7 +174,13 @@ def lint_paths(
     no metrics module in scope.
     """
     from tools import ipclint as _pkg
-    from tools.ipclint import checks_det, checks_err, checks_race, checks_vocab
+    from tools.ipclint import (
+        checks_det,
+        checks_err,
+        checks_lockorder,
+        checks_race,
+        checks_vocab,
+    )
 
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parents[2]
     run = LintRun(known_rules if known_rules is not None else _pkg.RULES)
@@ -188,13 +194,26 @@ def lint_paths(
                 rel = str(f.resolve().relative_to(root.resolve()))
             except ValueError:
                 rel = str(f)
-            run.files.append(SourceFile(f, rel, f.read_text(encoding="utf-8")))
+            try:
+                sf = SourceFile(f, rel, f.read_text(encoding="utf-8"))
+            except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
+                # an unparseable file must be a loud finding, not a silent
+                # skip — CI trusting "clean" needs every file analyzed
+                line = getattr(exc, "lineno", None) or 1
+                detail = getattr(exc, "msg", None) or str(exc)
+                run.findings.append(
+                    Finding(rel, line, "parse-error", f"file does not parse: {detail}")
+                )
+                continue
+            run.files.append(sf)
 
     for sf in run.files:
         checks_race.check(run, sf)
         checks_err.check(run, sf)
         if sf.in_det_scope:
             checks_det.check(run, sf)
+
+    checks_lockorder.check(run)
 
     if check_vocab:
         vocab_sf = _find_vocab_file(root, run.files)
