@@ -309,7 +309,19 @@ def _cmd_range(args) -> int:
             pipeline_depth=args.pipeline_depth,
         )
 
-    store = RpcBlockstore(client)
+    plane = None
+    if args.batch_rpc:
+        from ipc_proofs_tpu.store.fetchplane import FetchPlane, PlaneBlockstore
+
+        plane = FetchPlane(
+            client, speculate_depth=args.speculate_depth, metrics=metrics
+        )
+        store = PlaneBlockstore(plane)
+        log.info(
+            "fetch plane: batched RPC, speculate_depth=%d", args.speculate_depth
+        )
+    else:
+        store = RpcBlockstore(client)
     disk = None
     if args.store_dir:
         from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
@@ -318,6 +330,9 @@ def _cmd_range(args) -> int:
             args.store_dir, cap_bytes=args.store_cap_bytes, metrics=metrics
         )
         store = TieredBlockstore(store, disk, metrics=metrics)
+        if plane is not None:
+            # tier short-circuit: wants already on disk never hit RPC
+            plane.set_local(store)
         log.info("disk tier: %s (%s)", args.store_dir, disk.stats())
 
     with maybe_profile(args.profile):
@@ -341,6 +356,9 @@ def _cmd_range(args) -> int:
         "range bundle: %d event + %d storage proofs, %d witness blocks → %s",
         len(bundle.event_proofs), len(bundle.storage_proofs), len(bundle.blocks), output,
     )
+    if plane is not None:
+        plane.close()
+        log.info("fetch plane: %s", plane.stats())
     if disk is not None:
         disk.close()
     if args.metrics:
@@ -611,6 +629,8 @@ def _cmd_serve(args) -> int:
             store_dir=args.store_dir,
             store_cap_bytes=args.store_cap_bytes,
             store_owner=args.store_owner,
+            batch_rpc=args.batch_rpc,
+            speculate_depth=args.speculate_depth,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -852,6 +872,22 @@ def main(argv=None) -> int:
             "evicted; default 1 GiB)",
         )
 
+    def add_fetch_plane_flags(p):
+        p.add_argument(
+            "--batch-rpc", action=argparse.BooleanOptionalAction, default=True,
+            help="async fetch plane: ship block wants as JSON-RPC batch "
+            "arrays (one round-trip per wave) and let HAMT/AMT walkers "
+            "prefetch child links speculatively; endpoints that reject "
+            "batch framing fall back to sequential calls automatically. "
+            "--no-batch-rpc restores the one-call-per-block path",
+        )
+        p.add_argument(
+            "--speculate-depth", type=int, default=1, metavar="N",
+            help="how many link levels the fetch plane chases below a "
+            "decoded HAMT/AMT interior node (0 = batch demand fetches "
+            "only, no speculation; default 1)",
+        )
+
     def add_trace_export_flags(p):
         p.add_argument(
             "--trace-otlp", default=None, metavar="PATH",
@@ -961,6 +997,7 @@ def main(argv=None) -> int:
         "0 disables the stage-overlapped engine",
     )
     add_store_flags(rng)
+    add_fetch_plane_flags(rng)
     rng.add_argument("--checkpoint-dir", default=None)
     rng.add_argument(
         "--job-dir", default=None, metavar="DIR",
@@ -1113,6 +1150,7 @@ def main(argv=None) -> int:
         help="chunks buffered between range-pipeline stages",
     )
     add_store_flags(srv)
+    add_fetch_plane_flags(srv)
     srv.add_argument(
         "--store-owner", default=None, metavar="TOKEN",
         help="join a SHARED --store-dir under this owner token (cluster "

@@ -117,6 +117,13 @@ class AMT:
         # verify paths map to verdicts
         if not (isinstance(node, list) and len(node) == 3):
             raise ValueError("malformed AMT node")
+        # the root node is INLINE in the root block (it never passes
+        # through _load_node) — expose its links to the fetch plane here
+        offer = getattr(store, "offer_links", None)
+        if offer is not None and isinstance(node[1], list):
+            links = [p for p in node[1] if isinstance(p, CID)]
+            if links:
+                offer(links)
         return cls(store, root_cid, bit_width, height, count, node, version)
 
     # -- node access --------------------------------------------------------
@@ -128,6 +135,13 @@ class AMT:
         node = cbor_decode(raw)
         if not (isinstance(node, list) and len(node) == 3):
             raise ValueError("malformed AMT node")
+        # async fetch plane: expose an interior node's child links as
+        # speculative wants the moment it decodes (no-op without a plane)
+        offer = getattr(self._store, "offer_links", None)
+        if offer is not None and isinstance(node[1], list):
+            links = [p for p in node[1] if isinstance(p, CID)]
+            if links:
+                offer(links)
         return node
 
     def _node_parts(self, node: list) -> tuple[bytes, list, list]:

@@ -182,6 +182,15 @@ class HAMT:
             and isinstance(node[1], list)
         ):
             raise ValueError("malformed HAMT node")
+        # async fetch plane: the moment an interior node decodes, its child
+        # links become speculative wants — the walker's next descent (or a
+        # sibling walker's) finds them in flight or landed. A no-op against
+        # plain stores (no offer_links anywhere below).
+        offer = getattr(self._store, "offer_links", None)
+        if offer is not None:
+            links = [p for p in node[1] if isinstance(p, CID)]
+            if links:
+                offer(links)
         return node
 
     def get(self, key: bytes) -> Optional[Any]:

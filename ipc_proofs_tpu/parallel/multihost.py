@@ -43,12 +43,34 @@ def initialize_distributed(
         return False
     num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     process_id = process_id if process_id is not None else int(os.environ.get("JAX_PROCESS_ID", "0"))
+    _enable_cpu_collectives(jax)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
     return True
+
+
+def _enable_cpu_collectives(jax) -> None:
+    """Multi-process on the CPU backend needs an explicit cross-process
+    collectives implementation — without one, the first sharded computation
+    dies with "Multiprocess computations aren't implemented on the CPU
+    backend". Select gloo (TCP, in-tree in jaxlib) when the effective
+    platform is CPU and nothing was chosen yet. Must run before the backend
+    is instantiated; a no-op on TPU/GPU platforms, and fail-soft on jax
+    versions without the flag (older jaxlibs fail the first collective with
+    the error above, exactly as before)."""
+    platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in platforms.split(","):
+        return
+    try:
+        import jax._src.xla_bridge as xla_bridge
+
+        if xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # fail-soft: no such flag on this jax version — the backend reports the capability gap itself
+        pass
 
 
 def global_mesh(sp: int = 1):

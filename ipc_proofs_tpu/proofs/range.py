@@ -147,6 +147,24 @@ class TipsetPair:
     child: Tipset
 
 
+def _offer_chunk_spine(store, chunk) -> None:
+    """Async fetch plane look-ahead: offer a chunk's tipset header CIDs as
+    speculative wants before its scan needs them — the plane batch-fetches
+    the headers in one round-trip and chases their receipt/state links
+    while earlier chunks are still recording, so record-stage block fetches
+    land out of order and the order-preserving emitter re-sequences.
+    A no-op against stores without a plane underneath."""
+    offer = getattr(store, "offer_links", None)
+    if offer is None:
+        return
+    links: list = []
+    for pair in chunk:
+        links.extend(pair.parent.cids)
+        links.extend(pair.child.cids)
+    if links:
+        offer(links)
+
+
 def _request_spec_repr(spec: EventProofSpec, chunk_size: int, storage_specs) -> bytes:
     """Byte identity of one range request for checkpoint keying.
 
@@ -266,6 +284,12 @@ def generate_event_proofs_for_range_chunked(
                 if job is not None:  # checkpoint hit the journal missed
                     job.commit_chunk(chunk_index, digest, bundle)
             else:
+                # look ahead one chunk: its headers ride the fetch plane's
+                # batches while THIS chunk scans/records (no-op without one)
+                _offer_chunk_spine(store, chunk)
+                _offer_chunk_spine(
+                    store, pairs[start + chunk_size : start + 2 * chunk_size]
+                )
                 if generate_fn is not None:
                     bundle = generate_fn(
                         store,
@@ -951,6 +975,10 @@ def generate_event_proofs_for_range_pipelined(
         path = _ckpt_path(index, chunk)
         if path is not None and os.path.exists(path):
             return kind, index, chunk, None  # resumed — record loads from disk
+        # several scan workers offer concurrently — their chunks' header
+        # fetches coalesce into shared fetch-plane batches (no-op without
+        # a plane below the cache)
+        _offer_chunk_spine(cached, chunk)
         attempt = 0
         while True:
             try:

@@ -115,6 +115,13 @@ class ServiceConfig:
     # coordinates through the directory flock (see storex/segments.py).
     # None = exclusive single-writer store (the pre-cluster behavior)
     store_owner: Optional[str] = None
+    # async fetch plane (store.fetchplane): when the backing store is
+    # RPC-fed, interpose a want-queue so concurrent walkers' block fetches
+    # ship as JSON-RPC batches and HAMT/AMT child links prefetch
+    # speculatively. batch_rpc=False keeps the sync one-call-per-block
+    # path; speculate_depth=0 batches without speculation
+    batch_rpc: bool = True
+    speculate_depth: int = 1
 
 
 @dataclass
@@ -193,6 +200,23 @@ class ProofService:
         self.block_cache = BlockCache(
             max_bytes=self.config.cache_max_bytes, ttl_s=self.config.cache_ttl_s
         )
+        # async fetch plane: interpose between the local tiers and an
+        # RPC-fed store so concurrent request walkers' block fetches ride
+        # shared JSON-RPC batches and walker-offered links prefetch
+        # speculatively. Only a store that exposes its chain client
+        # (RpcBlockstore.client) gets a plane — plain stores (demo worlds,
+        # memory fixtures) keep the direct path.
+        self.fetch_plane = None
+        plane_client = getattr(store, "client", None)
+        if store is not None and plane_client is not None and self.config.batch_rpc:
+            from ipc_proofs_tpu.store.fetchplane import FetchPlane, PlaneBlockstore
+
+            self.fetch_plane = FetchPlane(
+                plane_client,
+                speculate_depth=self.config.speculate_depth,
+                metrics=self.metrics,
+            )
+            store = PlaneBlockstore(self.fetch_plane)
         self._disk_store = None
         if store is not None and self.config.store_dir:
             from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
@@ -213,6 +237,14 @@ class ProofService:
             self._store = CachedBlockstore(store, shared_cache=self.block_cache)
         else:
             self._store = None
+        if self.fetch_plane is not None:
+            # the plane's tier short-circuit reads the SAME local tiers
+            # that sit above it (TieredBlockstore.get_local never touches
+            # its inner store, so this is not circular): wants satisfiable
+            # locally never reach the queue, landings deposit for next time
+            self.fetch_plane.set_local(
+                self._store if self._disk_store is not None else self.block_cache
+            )
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )
@@ -355,6 +387,8 @@ class ProofService:
         if self._generate_batcher is not None:
             self._generate_batcher.close(drain=True, timeout=timeout)
         self._executor.shutdown(wait=True)
+        if self.fetch_plane is not None:
+            self.fetch_plane.close()
         if self._disk_store is not None:
             self._disk_store.close()
 

@@ -15,6 +15,7 @@ from ipc_proofs_tpu.store.blockstore import (
     RecordingBlockstore,
 )
 from ipc_proofs_tpu.store.failover import EndpointPool
+from ipc_proofs_tpu.store.fetchplane import FetchPlane, PlaneBlockstore
 from ipc_proofs_tpu.store.faults import (
     FaultPlan,
     FaultyBlockstore,
@@ -40,6 +41,8 @@ __all__ = [
     "IntegrityError",
     "verify_block_bytes",
     "EndpointPool",
+    "FetchPlane",
+    "PlaneBlockstore",
     "FaultPlan",
     "FaultySession",
     "FaultyBlockstore",

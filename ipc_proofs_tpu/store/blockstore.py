@@ -181,6 +181,14 @@ class RecordingBlockstore:
     def has(self, cid: CID) -> bool:
         return self._inner.has(cid)
 
+    def offer_links(self, links) -> None:
+        """Forward walker speculation to the fetch plane below, if any.
+        Deliberately NOT recorded: offered links are hints, only blocks a
+        walk actually `get`s belong in a witness."""
+        offer = getattr(self._inner, "offer_links", None)
+        if offer is not None:
+            offer(links)
+
     def take_seen(self) -> set[CID]:
         """Drain and return the set of recorded CIDs."""
         with self._lock:
@@ -346,6 +354,12 @@ class CachedBlockstore:
                 if cid in self._cache:
                     return True
         return self._inner.has(cid)
+
+    def offer_links(self, links) -> None:
+        """Forward walker speculation to the fetch plane below, if any."""
+        offer = getattr(self._inner, "offer_links", None)
+        if offer is not None:
+            offer(links)
 
     def cache_stats(self) -> tuple[int, int]:
         """(entries, total bytes) — reference `cached_blockstore.rs:40-45`."""

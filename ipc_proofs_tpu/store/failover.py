@@ -204,6 +204,154 @@ class EndpointPool:
                 f"all {len(self._endpoints)} endpoints failed reading {cid}"
             ) from last
 
+    def chain_read_obj_many(self, cids: "list[CID]") -> "list[Optional[bytes]]":
+        """Batched `chain_read_obj` with the pool's semantics intact:
+
+        - **breaker/failover** — each batch attempt runs against one
+          endpoint through the same `_begin_attempt` admission and
+          `_record_success`/`_record_failure` accounting as single reads;
+          a transport failure rotates the WHOLE remaining batch to the
+          next candidate.
+        - **integrity demux** — every returned block verifies against its
+          CID *per endpoint*. Blocks that verify are kept even when
+          neighbors in the same response do not (content addressing makes
+          them trustworthy regardless of who served them); the corrupt
+          remainder demotes the endpoint and retries elsewhere.
+        - **hedging** — when enabled, the first attempt races a second
+          endpoint after the usual p99-based delay, first answer wins
+          (counted `rpc.hedges`/`rpc.hedge_wins` like single reads).
+
+        Whatever is still unresolved after every candidate has been tried
+        falls back to per-CID `chain_read_obj`, so the error taxonomy
+        (`IntegrityError` when every endpoint lied, `RuntimeError` when
+        every endpoint failed) is exactly the single-read one."""
+        cids = list(cids)
+        if not cids:
+            return []
+        from ipc_proofs_tpu.obs.trace import span as _span
+
+        results: "dict[int, Optional[bytes]]" = {}
+        todo = list(range(len(cids)))
+        candidates = self._candidates()
+        with _span("pool.read_many") as sp:
+            sp.set_attr("n", len(cids))
+            hedged_first = self.hedge_ms is not None and len(candidates) >= 2
+            for pos, ep in enumerate(candidates):
+                if not todo:
+                    break
+                subset = [cids[i] for i in todo]
+                if hedged_first and pos == 0:
+                    ok = self._hedged_read_many(subset, candidates)
+                    if ok is None:
+                        continue  # both racers failed; keep walking
+                else:
+                    if not self._begin_attempt(ep):
+                        continue
+                    try:
+                        ok = self._read_many_one(ep, subset)
+                    except Exception:  # fail-soft: failover — _read_many_one recorded the failure; the remaining cids walk to the next endpoint, stragglers re-raise typed errors via chain_read_obj below
+                        continue
+                still = []
+                for k, i in enumerate(todo):
+                    if k in ok:
+                        results[i] = ok[k]
+                    else:
+                        still.append(i)
+                todo = still
+            # stragglers (or a pool whose every batch attempt failed):
+            # per-CID reads carry the canonical failover/hedge/error path
+            for i in todo:
+                results[i] = self.chain_read_obj(cids[i])
+        return [results[i] for i in range(len(cids))]
+
+    def _read_many_one(self, ep: EndpointState, subset: "list[CID]") -> "dict[int, Optional[bytes]]":
+        """One endpoint's batch attempt: fetch + verify ``subset``,
+        recording outcome. Returns verified results keyed by subset index
+        (missing keys = corrupt blocks from this endpoint, which demoted
+        it)."""
+        t0 = self._clock()
+        try:
+            blocks = ep.client.chain_read_obj_many(subset)
+        except RpcError:
+            # the endpoint is up and talking protocol; its per-id answer
+            # is authoritative even when it is an error
+            self._record_success(ep, self._clock() - t0, observe_latency=False)
+            raise
+        except Exception:
+            self._record_failure(ep)
+            raise
+        ok: "dict[int, Optional[bytes]]" = {}
+        corrupt = 0
+        for k, (cid, data) in enumerate(zip(subset, blocks)):
+            if data is not None and not verify_block_bytes(cid, data):
+                self._metrics.count("rpc.integrity_failures")
+                corrupt += 1
+                continue
+            ok[k] = data
+        if corrupt:
+            with self._lock:
+                ep.demotions += 1
+            self._record_failure(ep, demote=True)
+        else:
+            self._record_success(ep, self._clock() - t0)
+        return ok
+
+    def _read_many_one_traced(self, ctx, ep: EndpointState, subset: "list[CID]"):
+        from ipc_proofs_tpu.obs.trace import use_context
+
+        with use_context(ctx):
+            return self._read_many_one(ep, subset)
+
+    def _hedged_read_many(
+        self, subset: "list[CID]", candidates: "list[EndpointState]"
+    ) -> "Optional[dict[int, Optional[bytes]]]":
+        """Primary batch with a delayed hedge on the next endpoint; first
+        completed attempt wins. Returns None when both racers failed (the
+        caller keeps walking the candidate list)."""
+        primary: Optional[EndpointState] = None
+        rest: list[EndpointState] = []
+        for i, ep in enumerate(candidates):
+            if self._begin_attempt(ep):
+                primary, rest = ep, candidates[i + 1:]
+                break
+        if primary is None:
+            return None
+        pool = self._get_executor()
+        from ipc_proofs_tpu.obs.trace import current_context
+
+        ctx = current_context()
+        fut_primary = pool.submit(self._read_many_one_traced, ctx, primary, subset)
+        try:
+            return fut_primary.result(timeout=self._hedge_delay_s())
+        except FutureTimeoutError:
+            pass  # primary is slow — fire the hedge
+        except Exception:  # fail-soft: primary failed fast (recorded) — the caller's candidate walk is the failover
+            return None
+        secondary: Optional[EndpointState] = None
+        for ep in rest:
+            if self._begin_attempt(ep):
+                secondary = ep
+                break
+        if secondary is None:
+            try:
+                return fut_primary.result()
+            except Exception:  # fail-soft: recorded by _read_many_one; caller walks on
+                return None
+        self._metrics.count("rpc.hedges")
+        fut_hedge = pool.submit(self._read_many_one_traced, ctx, secondary, subset)
+        pending = {fut_primary, fut_hedge}
+        while pending:
+            done, pending = futures_wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                try:
+                    result = fut.result()
+                except Exception:  # fail-soft: hedge race — one racer losing is expected and recorded
+                    continue
+                if fut is fut_hedge:
+                    self._metrics.count("rpc.hedge_wins")
+                return result
+        return None
+
     # ------------------------------------------------------------------
     # health reporting
 

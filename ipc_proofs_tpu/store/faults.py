@@ -145,8 +145,14 @@ class FaultySession:
 
     def post(self, url, data=None, headers=None, timeout=None):
         method = ""
+        is_batch = False
         try:
-            method = json.loads(data).get("method", "") if data else ""
+            req = json.loads(data) if data else {}
+            if isinstance(req, list):
+                is_batch = True
+                method = req[0].get("method", "") if req else ""
+            else:
+                method = req.get("method", "")
         except (ValueError, AttributeError):
             pass
         fault = self.plan.draw()
@@ -159,7 +165,21 @@ class FaultySession:
         resp = self._inner.post(url, data=data, headers=headers, timeout=timeout)
         if fault not in ("truncate", "bitflip"):
             return resp
+        if is_batch:
+            body = resp.json()
+            if not isinstance(body, list) or not body:
+                return resp  # endpoint rejected the batch — nothing to corrupt
+            # corrupt ONE deterministic entry of the batch: what a lying or
+            # mid-body-dropped connection does to batch framing, and what
+            # exercises the client's per-id error demux
+            entries = [dict(e) for e in body]
+            self._corrupt_entry(entries[self.plan.randrange(len(entries))], fault, method)
+            return _Response(entries)
         body = dict(resp.json())
+        self._corrupt_entry(body, fault, method)
+        return _Response(body)
+
+    def _corrupt_entry(self, body: dict, fault: str, method: str) -> None:
         result = body.get("result")
         if fault == "truncate":
             # half the payload for strings, else a null result — both are
@@ -167,7 +187,6 @@ class FaultySession:
             body["result"] = result[: len(result) // 2] if isinstance(result, str) else None
         elif isinstance(result, str) and method == "Filecoin.ChainReadObj":
             body["result"] = _flip_bit(result, self.plan)
-        return _Response(body)
 
 
 class FaultyBlockstore:
@@ -215,29 +234,51 @@ class LocalLotusSession:
 
     Serves `Filecoin.ChainReadObj` from ``store`` (base64, like the real
     API) and anything in ``responses`` verbatim; unknown methods return a
-    JSON-RPC "method not found" error. Lets chaos tests drive the REAL
+    JSON-RPC "method not found" error. JSON-RPC batch arrays are answered
+    with a response array (shuffled deterministically — real servers answer
+    out of id order, which is what the client's demux must survive) unless
+    ``batch=False``, which models an old gateway: array payloads get a
+    single "invalid request" error object, concluding the client's
+    capability probe negative. Lets chaos tests drive the REAL
     `LotusClient` → `EndpointPool` → `RpcBlockstore` stack with zero
     network.
     """
 
-    def __init__(self, store, responses: Optional[dict] = None):
+    def __init__(self, store, responses: Optional[dict] = None, batch: bool = True):
         self._store = store
         self._responses = dict(responses or {})
+        self._batch = batch
         self.calls = 0
+        self.batch_calls = 0
+        self._shuffle = random.Random("locallotus:batch-order")
 
     def post(self, url, data=None, headers=None, timeout=None):
         self.calls += 1
         req = json.loads(data)
+        if isinstance(req, list):
+            if not self._batch:
+                return _Response({
+                    "jsonrpc": "2.0",
+                    "error": {"code": -32600, "message": "batch requests not supported"},
+                    "id": None,
+                })
+            self.batch_calls += 1
+            replies = [self._answer(one) for one in req]
+            self._shuffle.shuffle(replies)
+            return _Response(replies)
+        return _Response(self._answer(req))
+
+    def _answer(self, req: dict) -> dict:
         method, params, req_id = req.get("method"), req.get("params", []), req.get("id")
         if method == "Filecoin.ChainReadObj":
             cid = CID.from_string(params[0]["/"])
             block = self._store.get(cid)
             result = base64.b64encode(block).decode("ascii") if block is not None else None
-            return _Response({"jsonrpc": "2.0", "result": result, "id": req_id})
+            return {"jsonrpc": "2.0", "result": result, "id": req_id}
         if method in self._responses:
-            return _Response({"jsonrpc": "2.0", "result": self._responses[method], "id": req_id})
-        return _Response({
+            return {"jsonrpc": "2.0", "result": self._responses[method], "id": req_id}
+        return {
             "jsonrpc": "2.0",
             "error": {"code": -32601, "message": f"method '{method}' not found"},
             "id": req_id,
-        })
+        }

@@ -140,6 +140,11 @@ class LotusClient:
             self._headers["Authorization"] = f"Bearer {bearer_token}"
         self._id_lock = named_lock("LotusClient._id_lock")
         self._next_id = 1  # guarded-by: _id_lock
+        # batch-capability probe result: None = unknown (probe on first
+        # batch call), True = endpoint answers JSON-RPC batch arrays,
+        # False = endpoint rejected the framing — all batch reads go
+        # through the sequential path from then on
+        self._batch_ok: Optional[bool] = None  # guarded-by: _id_lock
         if metrics is None:
             from ipc_proofs_tpu.utils.metrics import get_metrics
 
@@ -237,6 +242,147 @@ class LotusClient:
             # node — same trust failure as a multihash mismatch
             raise IntegrityError(cid, self.endpoint, reason=f"are undecodable ({exc})") from exc
 
+    @property
+    def supports_batch(self) -> "Optional[bool]":
+        """Batch-capability probe state (None until the first batch call)."""
+        with self._id_lock:
+            return self._batch_ok
+
+    def chain_read_obj_many(self, cids: "list[CID]") -> "list[Optional[bytes]]":
+        """Fetch many raw IPLD blocks in ONE JSON-RPC batch round-trip.
+
+        Frames the reads as a JSON-RPC 2.0 batch array and demuxes the
+        response array by request id (servers may answer out of order).
+        Entries align with ``cids``: verified-decodable bytes or None for
+        absent blocks. Error handling is per id: an entry the server
+        answered with an ``error`` member (or did not answer at all) is
+        refetched through the sequential `chain_read_obj` path, so typed
+        errors (`RpcError`/`IntegrityError`/exhausted-retry `RuntimeError`)
+        surface exactly as they would without batching.
+
+        Capability is probed ONCE: the first endpoint response that is not
+        a JSON array (old gateways answer batch payloads with a single
+        "invalid request" object, some with an HTTP 4xx) marks the endpoint
+        batch-incapable and this call — and every later one — degrades to
+        sequential reads. Like `chain_read_obj`, bytes are NOT verified
+        here; verification belongs to the callers that know which endpoint
+        to blame (`RpcBlockstore`, `EndpointPool`, the fetch plane)."""
+        cids = list(cids)
+        if not cids:
+            return []
+        with self._id_lock:
+            batch_ok = self._batch_ok
+        if batch_ok is False or len(cids) == 1:
+            return [self.chain_read_obj(c) for c in cids]
+        entries = self._post_batch_read(cids)
+        if entries is None:
+            # endpoint rejected the batch framing — probe concluded, fall
+            # back to one call per block (this time and every time after)
+            return [self.chain_read_obj(c) for c in cids]
+        out: "list[Optional[bytes]]" = []
+        retried = 0
+        for cid, entry in zip(cids, entries):
+            if entry is None or ("error" in entry and entry["error"] is not None):
+                # per-id demux: this id failed (or went unanswered) inside
+                # an otherwise healthy batch — refetch it sequentially so
+                # its error surfaces with the standard retry/typing
+                retried += 1
+                out.append(self.chain_read_obj(cid))
+                continue
+            result = entry.get("result")
+            if result is None:
+                out.append(None)
+                continue
+            try:
+                out.append(base64.b64decode(result))
+            except (ValueError, TypeError) as exc:
+                raise IntegrityError(
+                    cid, self.endpoint, reason=f"are undecodable ({exc})"
+                ) from exc
+        if retried:
+            self._metrics.count("rpc.batch_item_retries", retried)
+        return out
+
+    def _post_batch_read(self, cids: "list[CID]") -> "Optional[list[Optional[dict]]]":
+        """POST one ChainReadObj batch array; returns per-cid response
+        entries (None for unanswered ids), or None overall when the
+        endpoint rejects batch framing (capability probe concluded
+        negative). Transport failures retry with the standard backoff."""
+        with self._id_lock:
+            first_id = self._next_id
+            self._next_id += len(cids)
+        payload = [
+            {
+                "jsonrpc": "2.0",
+                "method": "Filecoin.ChainReadObj",
+                "params": [{"/": str(cid)}],
+                "id": first_id + i,
+            }
+            for i, cid in enumerate(cids)
+        ]
+        # one round-trip = one rpc.calls tick, same as a single request —
+        # that parity is what makes rpc.calls the round-trip denominator
+        # the asyncfetch bench leg measures
+        self._metrics.count("rpc.calls")
+        last_err: Exception | None = None
+        from ipc_proofs_tpu.obs.trace import span as _span
+
+        with _span("rpc.batch", {"endpoint": self.endpoint, "n": len(cids)}) as sp:
+            for attempt in range(self.max_retries):
+                try:
+                    resp = self._session.post(
+                        self.endpoint,
+                        data=json.dumps(payload),
+                        headers=self._headers,
+                        timeout=self.block_timeout_s,
+                    )
+                    resp.raise_for_status()
+                    body = resp.json()
+                except Exception as exc:  # fail-soft: HTTP rejections conclude the probe below; transport errors retry with backoff, exhausted retries re-raise `from last_err`
+                    if getattr(exc, "response", None) is not None:
+                        # an HTTP-status rejection (requests.HTTPError
+                        # carries .response): the endpoint understood us
+                        # and said no — that is a framing rejection, not
+                        # an outage
+                        self._mark_batch_unsupported(sp)
+                        return None
+                    last_err = exc
+                    if attempt + 1 < self.max_retries:
+                        self._backoff("ChainReadObj[batch]", attempt, exc)
+                    continue
+                if not isinstance(body, list):
+                    self._mark_batch_unsupported(sp)
+                    return None
+                with self._id_lock:
+                    self._batch_ok = True
+                self._metrics.count("rpc.batch_calls")
+                self._metrics.count("rpc.batched_reads", len(cids))
+                if attempt:
+                    sp.set_attr("retries", attempt)
+                by_id = {
+                    e.get("id"): e for e in body if isinstance(e, dict)
+                }
+                return [by_id.get(first_id + i) for i in range(len(cids))]
+            self._metrics.count("rpc.failures")
+            sp.set_attr("error", str(last_err))
+        raise RuntimeError(
+            f"RPC ChainReadObj[batch] failed after {self.max_retries} attempts"
+        ) from last_err
+
+    def _mark_batch_unsupported(self, sp) -> None:
+        with self._id_lock:
+            already = self._batch_ok is False
+            self._batch_ok = False
+        sp.set_attr("batch_unsupported", True)
+        if not already:
+            self._metrics.count("rpc.batch_unsupported")
+            from ipc_proofs_tpu.utils.log import get_logger
+
+            get_logger(__name__).info(
+                "endpoint %s rejects JSON-RPC batch framing — using sequential reads",
+                self.endpoint,
+            )
+
     def chain_get_parent_receipts(self, block_cid: CID) -> Optional[list[dict]]:
         """Fetch a block's parent receipts as API JSON
         (`Filecoin.ChainGetParentReceipts`, reference
@@ -256,16 +402,23 @@ class RpcBlockstore:
     verifies per-endpoint — so it can demote the liar and retry elsewhere —
     and the store skips the redundant second hash.)
 
-    `prefetch()` fans out block fetches over a thread pool into a target
-    cache dict — the host-side feeder that replaces the reference's
-    one-blocking-HTTP-call-per-block pattern. It fails SOFT: per-CID
-    failures are collected and returned instead of aborting the wave, since
-    the demand path re-fetches (and re-raises) on miss anyway.
+    `prefetch()` feeds block waves into the shared cache dict — the
+    host-side feeder that replaces the reference's
+    one-blocking-HTTP-call-per-block pattern. When the client speaks
+    JSON-RPC batch framing (`chain_read_obj_many`) a wave ships as a few
+    batch round-trips on the calling thread; otherwise it fans out over a
+    thread pool, one HTTP call per block (the pre-batching behavior). An
+    attached `FetchPlane` (``attach_plane``) takes precedence over both:
+    the wave enters the plane's want-queue and coalesces with concurrent
+    walkers' demand fetches. All three paths fail SOFT: per-CID failures
+    are collected and returned instead of aborting the wave, since the
+    demand path re-fetches (and re-raises) on miss anyway.
     """
 
     def __init__(self, client: LotusClient, prefetch_workers: int = 16, metrics=None):
         self._client = client
         self._prefetch_workers = prefetch_workers
+        self._plane = None  # optional FetchPlane (attach_plane)
         if metrics is None:
             metrics = getattr(client, "_metrics", None)
         if metrics is None:
@@ -284,20 +437,103 @@ class RpcBlockstore:
                 raise IntegrityError(cid, getattr(self._client, "endpoint", "?"))
         return data
 
+    def get_many(self, cids: "list[CID]") -> "list[Optional[bytes]]":
+        """Batched `get`: one (or few) round-trips when the client speaks
+        batch framing, sequential otherwise. Entries align with ``cids``;
+        every returned block is multihash-verified (unless the client pool
+        already verifies per-endpoint)."""
+        reader = getattr(self._client, "chain_read_obj_many", None)
+        if reader is not None:
+            blocks = reader(list(cids))
+        else:
+            blocks = [self._client.chain_read_obj(c) for c in cids]
+        if not getattr(self._client, "verifies_integrity", False):
+            for cid, data in zip(cids, blocks):
+                if data is not None and not verify_block_bytes(cid, data):
+                    self._metrics.count("rpc.integrity_failures")
+                    raise IntegrityError(cid, getattr(self._client, "endpoint", "?"))
+        return blocks
+
     def put_keyed(self, cid: CID, data: bytes) -> None:
         raise NotImplementedError("RpcBlockstore is read-only")
 
     def has(self, cid: CID) -> bool:
         return self.get(cid) is not None
 
+    @property
+    def client(self):
+        """The underlying `LotusClient` / `EndpointPool` — the fetch-plane
+        wiring needs the client, not this store wrapper."""
+        return self._client
+
+    def attach_plane(self, plane) -> None:
+        """Route future `prefetch` waves through a `FetchPlane`'s
+        want-queue (so they batch and coalesce with demand fetches)."""
+        self._plane = plane  # ipclint: disable=race-unannotated (wiring-time publication: attached before any prefetch/walker traffic)
+
+    def offer_links(self, links: "Iterable[CID]") -> None:
+        """Walker speculation hook — meaningful only with an attached
+        plane (otherwise links are dropped: this store has no queue)."""
+        if self._plane is not None:
+            self._plane.offer_links(links)
+
     def prefetch(self, cids: Iterable[CID], into: dict[CID, bytes]) -> "dict[CID, Exception]":
-        """Concurrently fetch ``cids`` into the shared cache dict ``into``.
+        """Fetch ``cids`` into the shared cache dict ``into``.
 
         Returns a (possibly empty) map of CID → exception for fetches that
         failed; the wave itself never aborts on one bad block."""
         todo = [c for c in cids if c not in into]
         if not todo:
             return {}
+        if self._plane is not None:
+            failures = self._plane.fetch_into(todo, into)
+        elif getattr(self._client, "chain_read_obj_many", None) is not None:
+            failures = self._prefetch_batched(todo, into)
+        else:
+            failures = self._prefetch_pooled(todo, into)
+        if failures:
+            from ipc_proofs_tpu.utils.log import get_logger
+
+            self._metrics.count("rpc.prefetch_failures", len(failures))
+            get_logger(__name__).warning(
+                "prefetch: %d/%d block fetches failed (demand path will re-fetch)",
+                len(failures), len(todo),
+            )
+        return failures
+
+    # chunk size for batched prefetch waves: large enough to amortize the
+    # round-trip, small enough that one bad id can't poison a whole wave's
+    # latency budget
+    _PREFETCH_BATCH = 64
+
+    def _prefetch_batched(self, todo: "list[CID]", into: dict) -> "dict[CID, Exception]":
+        """Prefetch via batch round-trips on the calling thread — no pool:
+        one `chain_read_obj_many` per `_PREFETCH_BATCH` blocks."""
+        failures: dict[CID, Exception] = {}
+        for start in range(0, len(todo), self._PREFETCH_BATCH):
+            chunk = todo[start : start + self._PREFETCH_BATCH]
+            try:
+                blocks = self.get_many(chunk)
+            except Exception:  # fail-soft: prefetch is advisory — retry the chunk per-CID so one bad block only fails itself
+                blocks = None
+            if blocks is not None:
+                for cid, data in zip(chunk, blocks):
+                    if data is not None:
+                        into[cid] = data
+                continue
+            for cid in chunk:
+                try:
+                    data = self.get(cid)
+                except Exception as exc:  # fail-soft: prefetch is advisory — the failure is collected and the block refetched on demand
+                    failures[cid] = exc
+                    continue
+                if data is not None:
+                    into[cid] = data
+        return failures
+
+    def _prefetch_pooled(self, todo: "list[CID]", into: dict) -> "dict[CID, Exception]":
+        """The pre-batching thread-pool fan-out (clients without
+        `chain_read_obj_many`, e.g. bare test fakes)."""
         lock = named_lock("rpc.prefetch_failures")
         failures: dict[CID, Exception] = {}
 
@@ -314,12 +550,4 @@ class RpcBlockstore:
 
         with ThreadPoolExecutor(max_workers=self._prefetch_workers) as pool:
             list(pool.map(fetch, todo))
-        if failures:
-            from ipc_proofs_tpu.utils.log import get_logger
-
-            self._metrics.count("rpc.prefetch_failures", len(failures))
-            get_logger(__name__).warning(
-                "prefetch: %d/%d block fetches failed (demand path will re-fetch)",
-                len(failures), len(todo),
-            )
         return failures

@@ -115,6 +115,19 @@ class TieredBlockstore:
         self._cache_put(cid, data)
         self._disk.put(cid, data)
 
+    def get_local(self, cid: CID) -> Optional[bytes]:
+        """Read from the LOCAL tiers only — never the inner store. The
+        fetch plane's tier short-circuit: a want satisfiable here never
+        enters the want-queue, so warm requests stay at zero RPC."""
+        cached = self._cache_get(cid)
+        if cached is not None:
+            self.hits += 1
+            return cached
+        data = self._disk.get(cid)  # verified; corruption reads as a miss
+        if data is not None:
+            self._cache_put(cid, data)
+        return data
+
     def has_local(self, cid: CID) -> bool:
         """Membership in the LOCAL tiers only — no inner-store (RPC)
         traffic, so the follower can dedup without defeating its point."""
@@ -129,3 +142,11 @@ class TieredBlockstore:
 
     def has(self, cid: CID) -> bool:
         return self.has_local(cid) or self._inner.has(cid)
+
+    def offer_links(self, links) -> None:
+        """Forward walker speculation to the fetch plane below, if any
+        (the plane's own tier short-circuit consults `has_local`, so links
+        already on disk never become wants)."""
+        offer = getattr(self._inner, "offer_links", None)
+        if offer is not None:
+            offer(links)

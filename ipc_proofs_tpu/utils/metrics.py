@@ -24,6 +24,7 @@ __all__ = [
     "Metrics",
     "get_metrics",
     "RESILIENCE_COUNTERS",
+    "ASYNCFETCH_COUNTERS",
     "DURABILITY_COUNTERS",
     "OBSERVABILITY_COUNTERS",
     "RANGE_COUNTERS",
@@ -66,6 +67,55 @@ RESILIENCE_COUNTERS = (
     "failover.breaker_open",
     "range_scan_retries",
     "range_pipeline_serial_fallback",
+)
+
+# Counter vocabulary of the async fetch plane (store/fetchplane.py and the
+# batch framing in store/rpc.py / store/failover.py):
+#   rpc.batch_calls         — JSON-RPC batch-array round-trips issued (each
+#                             also ticks rpc.calls once: a batch IS one
+#                             round-trip, which is the whole point)
+#   rpc.batched_reads       — individual ChainReadObj reads shipped inside
+#                             batch calls (batched_reads / batch_calls =
+#                             achieved batching factor)
+#   rpc.batch_unsupported   — endpoints that rejected batch framing at the
+#                             capability probe (client fell back to
+#                             sequential calls, once, permanently)
+#   rpc.batch_item_retries  — per-id errors demuxed out of a batch response
+#                             and refetched through the sequential path
+#   fetch.wants             — block wants enqueued on the plane (all
+#                             priorities)
+#   fetch.coalesced         — wants that attached to an already-in-flight
+#                             or already-landed fetch instead of enqueueing
+#   fetch.tier_hits         — wants short-circuited by the local tiers
+#                             (RAM/disk) without touching the want-queue
+#   fetch.batches           — dispatcher round-trips (batch or sequential
+#                             fallback waves)
+#   fetch.batched_blocks    — blocks fetched across those round-trips
+#   fetch.speculative_wants — low-priority wants entered by HAMT/AMT
+#                             interior-node speculation
+#   fetch.speculative_used  — speculative blocks a demand get later consumed
+#   fetch.speculative_wasted— speculative blocks fetched but never demanded
+#                             (counted when the plane closes; mis-speculation
+#                             is a cost, never an error)
+#   fetch.speculative_dropped — speculative wants dropped at queue capacity
+#   fetch.speculative_integrity_drops — speculative blocks that failed
+#                             multihash verification and were discarded
+#                             before use (demand path refetches + raises)
+ASYNCFETCH_COUNTERS = (
+    "rpc.batch_calls",
+    "rpc.batched_reads",
+    "rpc.batch_unsupported",
+    "rpc.batch_item_retries",
+    "fetch.wants",
+    "fetch.coalesced",
+    "fetch.tier_hits",
+    "fetch.batches",
+    "fetch.batched_blocks",
+    "fetch.speculative_wants",
+    "fetch.speculative_used",
+    "fetch.speculative_wasted",
+    "fetch.speculative_dropped",
+    "fetch.speculative_integrity_drops",
 )
 
 # Counter vocabulary of the durability layer (jobs/journal.py, jobs/job.py,

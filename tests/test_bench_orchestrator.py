@@ -169,6 +169,17 @@ _STORAGE_OK = {
     "storage_pairs": 12,
 }
 
+_ASYNCFETCH_OK = {
+    "cold_rpc_roundtrips_per_proof": 3.62,
+    "sync_rpc_roundtrips_per_proof": 13.87,
+    "cold_speedup_vs_sync_walker": 2.98,
+    "speculate_waste_pct": 41.69,
+    "asyncfetch_batch_calls": 61,
+    "asyncfetch_cold_rpc_calls": 141,
+    "asyncfetch_sync_rpc_calls": 541,
+    "asyncfetch_pairs": 12,
+}
+
 _CLUSTER_OK = {
     "aggregate_proofs_per_sec": 720.0,
     "cluster_linearity_4shard": 0.85,
@@ -209,6 +220,7 @@ class TestOrchestrate:
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
+            "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
@@ -234,6 +246,11 @@ class TestOrchestrate:
         assert out["cluster_linearity_4shard"] == 0.85
         assert out["aggregate_proofs_per_sec"] == 720.0
         assert out["steal_events"] == 8
+        assert out["legs"]["asyncfetch"] == "ok:cpu"
+        assert out["cold_rpc_roundtrips_per_proof"] == 3.62
+        assert out["sync_rpc_roundtrips_per_proof"] == 13.87
+        assert out["cold_speedup_vs_sync_walker"] == 2.98
+        assert out["speculate_waste_pct"] == 41.69
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -249,6 +266,7 @@ class TestOrchestrate:
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
+            "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
@@ -262,7 +280,7 @@ class TestOrchestrate:
             ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
             ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
             ("durability", "cpu"), ("observability", "cpu"),
-            ("storage", "cpu"), ("cluster", "cpu"),
+            ("storage", "cpu"), ("asyncfetch", "cpu"), ("cluster", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -278,6 +296,7 @@ class TestOrchestrate:
             "durability": [(dict(_DURABILITY_OK), "ok:cpu")],
             "observability": [(dict(_OBSERVABILITY_OK), "ok:cpu")],
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
+            "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
@@ -325,6 +344,7 @@ class TestOrchestrate:
             "durability": [(None, "error:cpu")],
             "observability": [(None, "error:cpu")],
             "storage": [(None, "error:cpu")],
+            "asyncfetch": [(None, "error:cpu")],
             "cluster": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
@@ -339,6 +359,8 @@ class TestOrchestrate:
             "durability_journal_overhead_pct", "durability_resume_ms",
             "trace_overhead_pct", "spans_per_proof",
             "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
+            "cold_rpc_roundtrips_per_proof", "sync_rpc_roundtrips_per_proof",
+            "cold_speedup_vs_sync_walker", "speculate_waste_pct",
             "cluster_linearity_4shard", "aggregate_proofs_per_sec",
             "steal_events",
         ):

@@ -108,6 +108,14 @@ _KNOWN_TYPES = {
     "storage_prefetched_blocks": int,
     "storage_disk_bytes": int,
     "storage_pairs": int,
+    "cold_rpc_roundtrips_per_proof": _NUM,
+    "sync_rpc_roundtrips_per_proof": _NUM,
+    "cold_speedup_vs_sync_walker": _NUM,
+    "speculate_waste_pct": _NUM,
+    "asyncfetch_batch_calls": int,
+    "asyncfetch_cold_rpc_calls": int,
+    "asyncfetch_sync_rpc_calls": int,
+    "asyncfetch_pairs": int,
     "cluster_linearity_4shard": _NUM,
     "aggregate_proofs_per_sec": _NUM,
     "steal_events": int,
@@ -141,6 +149,8 @@ _CURRENT_REQUIRED = (
     "durability_chunks",
     "trace_overhead_pct", "spans_per_proof",
     "cold_vs_warm_speedup", "disk_hit_ratio", "prefetch_hit_ratio",
+    "cold_rpc_roundtrips_per_proof", "sync_rpc_roundtrips_per_proof",
+    "cold_speedup_vs_sync_walker", "speculate_waste_pct",
     "cluster_linearity_4shard", "aggregate_proofs_per_sec", "steal_events",
     "legs", "watchdog_fallback",
 )
@@ -229,6 +239,34 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     "stage-overlapped engine must beat serial when cores "
                     "are available"
                 )
+        # the asyncfetch gate: the fetch plane must issue STRICTLY fewer
+        # RPC round-trips per proof than the sync walker in the SAME
+        # artifact — batching that doesn't collapse round-trips is a
+        # regression, regardless of host shape (round-trip counts are
+        # deterministic I/O accounting, not scheduling)
+        if asyncfetch_gate_skip_reason(obj) is None:
+            cold = obj.get("cold_rpc_roundtrips_per_proof")
+            sync = obj.get("sync_rpc_roundtrips_per_proof")
+            for name, val in (
+                ("cold_rpc_roundtrips_per_proof", cold),
+                ("sync_rpc_roundtrips_per_proof", sync),
+            ):
+                if not isinstance(val, _NUM) or isinstance(val, bool):
+                    problems.append(
+                        f"asyncfetch gate: {name} is {val!r} "
+                        "(asyncfetch leg did not run?)"
+                    )
+            if (
+                isinstance(cold, _NUM) and not isinstance(cold, bool)
+                and isinstance(sync, _NUM) and not isinstance(sync, bool)
+                and cold >= sync
+            ):
+                problems.append(
+                    f"asyncfetch gate: cold_rpc_roundtrips_per_proof={cold} "
+                    f">= sync_rpc_roundtrips_per_proof={sync} — the fetch "
+                    "plane must need strictly fewer round-trips than the "
+                    "sync walker"
+                )
         # the cluster gate: with spare cores, 4 shard processes must keep
         # ≥ 80% of ideal linear scaling over 1 shard. A 1-core host
         # time-slices the shard processes (linearity collapses by design),
@@ -263,6 +301,20 @@ def speedup_gate_skip_reason(obj: dict) -> "str | None":
             f"host_cores={cores} ≤ 2 — stage overlap cannot pay without "
             "spare cores (1-core hosts run the serial fallback by design)"
         )
+    return None
+
+
+def asyncfetch_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the cold-below-sync round-trip gate does NOT apply (None when
+    it does). The gate is host-shape independent — round-trip counts are
+    I/O accounting — so the only skip is an artifact that predates the
+    asyncfetch leg entirely (no keys at all, old vintage validated
+    without --require-current)."""
+    if (
+        "cold_rpc_roundtrips_per_proof" not in obj
+        and "sync_rpc_roundtrips_per_proof" not in obj
+    ):
+        return "artifact predates the asyncfetch leg"
     return None
 
 
@@ -307,6 +359,9 @@ def main(argv=None) -> int:
             reason = cluster_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: cluster gate SKIPPED ({reason})")
+            reason = asyncfetch_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: asyncfetch gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")
