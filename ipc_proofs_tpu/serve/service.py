@@ -239,12 +239,11 @@ class ProofService:
             self._store = None
         if self.fetch_plane is not None:
             # the plane's tier short-circuit reads the SAME local tiers
-            # that sit above it (TieredBlockstore.get_local never touches
-            # its inner store, so this is not circular): wants satisfiable
+            # that sit above it (both TieredBlockstore and CachedBlockstore
+            # expose get_local/has_local/put_local that never touch their
+            # inner store, so this is not circular): wants satisfiable
             # locally never reach the queue, landings deposit for next time
-            self.fetch_plane.set_local(
-                self._store if self._disk_store is not None else self.block_cache
-            )
+            self.fetch_plane.set_local(self._store)
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )

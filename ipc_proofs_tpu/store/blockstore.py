@@ -361,6 +361,31 @@ class CachedBlockstore:
         if offer is not None:
             offer(links)
 
+    # -- local-tier surface (`TieredBlockstore` parity) --------------------
+    # The fetch plane's short-circuit binds whatever store sits above it
+    # as its local tiers; these read/populate the MEMORY CACHE ONLY and
+    # never touch the inner store — the inner store may itself sit over
+    # the plane, so an inner-store read here would recurse.
+
+    def get_local(self, cid: CID) -> Optional[bytes]:
+        if self._evicting:
+            cached = self._cache.get(cid)
+        else:
+            with self._lock:
+                cached = self._cache.get(cid)
+        if cached is not None:
+            self.hits += 1
+        return cached
+
+    def has_local(self, cid: CID) -> bool:
+        if self._evicting:
+            return cid in self._cache
+        with self._lock:
+            return cid in self._cache
+
+    def put_local(self, cid: CID, data: bytes) -> None:
+        self._cache_put(cid, bytes(data))
+
     def cache_stats(self) -> tuple[int, int]:
         """(entries, total bytes) — reference `cached_blockstore.rs:40-45`."""
         if self._evicting:

@@ -85,7 +85,7 @@ def _child_links(data: bytes, cap: int = _MAX_LINKS_PER_BLOCK) -> "list[CID]":
 class _Want:
     """One block want: queue entry + completion slot its waiters poll."""
 
-    __slots__ = ("cid", "depth", "speculative", "done", "data", "error", "used")
+    __slots__ = ("cid", "depth", "speculative", "done", "data", "error", "used", "waiters")
 
     def __init__(self, cid: CID, speculative: bool, depth: int):
         self.cid = cid
@@ -95,6 +95,7 @@ class _Want:
         self.data: Optional[bytes] = None  # guarded-by: FetchPlane._cond
         self.error: Optional[Exception] = None  # guarded-by: FetchPlane._cond
         self.used = False  # guarded-by: FetchPlane._cond
+        self.waiters = 0  # demand waiters attached; guarded-by: FetchPlane._cond
 
 
 class FetchPlane:
@@ -321,12 +322,15 @@ class FetchPlane:
             want = self._wants.get(cid)
             if want is not None:
                 self._metrics.count("fetch.coalesced")
+                want.waiters += 1
                 if not want.done and want.speculative:
                     # promote: a walker is now blocked on this block. If
                     # it is still queued it moves to the demand lane and
                     # stops counting as a speculative fetch; if already in
                     # flight it stays speculative (the fetch was issued on
-                    # speculation's dime — landing will count as used).
+                    # speculation's dime — landing will count as used, and
+                    # a failure re-lanes to demand in _complete because
+                    # waiters > 0).
                     try:
                         self._spec_q.remove(cid)
                     except ValueError:
@@ -337,6 +341,7 @@ class FetchPlane:
                         self._cond.notify()
                 return want
             want = _Want(cid, speculative=False, depth=0)
+            want.waiters = 1
             self._wants[cid] = want
             self._demand_q.append(cid)
             self._metrics.count("fetch.wants")
@@ -433,9 +438,16 @@ class FetchPlane:
             except Exception:  # fail-soft: one poisoned batch must not fail unrelated wants — retry per-CID below for cid-precise typed errors
                 blocks = None
         if reader is None or blocks is None:
+            # waiter-attached speculative wants must NOT take the soft
+            # path: an error swallowed into None would surface to the
+            # demand waiter as "block absent" — a lie. They fetch
+            # demand-style so failures stay typed (and re-lane via
+            # _complete's waiter check).
+            with self._cond:
+                soft = {w.cid: w.speculative and w.waiters == 0 for w in batch}
             blocks = []
             for want in batch:
-                if want.speculative:
+                if soft[want.cid]:
                     blocks.append(self._read_one_soft(want.cid))
                     continue
                 try:
@@ -483,9 +495,21 @@ class FetchPlane:
         with self._cond:
             for want, data, error in completions:
                 if error is _DISCARD or (want.speculative and error is not None):
-                    # failed speculation: forget the want entirely so a
-                    # later demand get re-enqueues from scratch
-                    self._wants.pop(want.cid, None)
+                    if want.waiters and not want.done:
+                        # a demand waiter attached while this speculative
+                        # fetch was in flight (too late for _register_demand
+                        # to re-lane it): re-run it on the demand lane so
+                        # the waiter gets the sync walker's contract —
+                        # refetch, typed error on failure — instead of
+                        # waiting forever on a silently forgotten want
+                        want.speculative = False
+                        self._demand_q.append(want.cid)
+                        self._cond.notify()
+                    else:
+                        # unobserved failed speculation: forget the want
+                        # entirely so a later demand get re-enqueues from
+                        # scratch
+                        self._wants.pop(want.cid, None)
                     continue
                 want.data = data
                 want.error = error

@@ -46,6 +46,14 @@ DEFAULT_TIMEOUT_S = 250.0  # reference `src/client/lotus.rs:11`
 DEFAULT_RETRYABLE_RPC_CODES = frozenset({429, -429})
 _TRANSIENT_RPC_MARKERS = ("too many requests", "rate limit", "try again")
 
+# HTTP statuses that mean "this endpoint understood the request and rejects
+# JSON-RPC batch framing" — the batch-capability probe concludes negative on
+# these ONLY. Everything else (5xx outages, 429 rate limits, auth failures)
+# is transient transport trouble handled by the normal retry/backoff and
+# must never demote the endpoint to sequential reads for the process's
+# lifetime.
+_BATCH_REJECT_STATUSES = frozenset({400, 404, 405, 501})
+
 
 class RpcError(RuntimeError):
     """JSON-RPC level error (the `error` member of the response)."""
@@ -262,9 +270,12 @@ class LotusClient:
 
         Capability is probed ONCE: the first endpoint response that is not
         a JSON array (old gateways answer batch payloads with a single
-        "invalid request" object, some with an HTTP 4xx) marks the endpoint
-        batch-incapable and this call — and every later one — degrades to
-        sequential reads. Like `chain_read_obj`, bytes are NOT verified
+        "invalid request" object, some with a framing-style HTTP 4xx —
+        400/404/405/501) marks the endpoint batch-incapable and this call —
+        and every later one — degrades to sequential reads. Transient
+        failures (5xx, 429, timeouts) retry with the standard backoff and
+        never conclude the probe, and an endpoint whose batch calls have
+        already succeeded is never demoted by a later error of any kind. Like `chain_read_obj`, bytes are NOT verified
         here; verification belongs to the callers that know which endpoint
         to blame (`RpcBlockstore`, `EndpointPool`, the fetch plane)."""
         cids = list(cids)
@@ -311,6 +322,7 @@ class LotusClient:
         with self._id_lock:
             first_id = self._next_id
             self._next_id += len(cids)
+            batch_confirmed = self._batch_ok is True
         payload = [
             {
                 "jsonrpc": "2.0",
@@ -338,12 +350,18 @@ class LotusClient:
                     )
                     resp.raise_for_status()
                     body = resp.json()
-                except Exception as exc:  # fail-soft: HTTP rejections conclude the probe below; transport errors retry with backoff, exhausted retries re-raise `from last_err`
-                    if getattr(exc, "response", None) is not None:
-                        # an HTTP-status rejection (requests.HTTPError
-                        # carries .response): the endpoint understood us
-                        # and said no — that is a framing rejection, not
-                        # an outage
+                except Exception as exc:  # fail-soft: framing 4xx concludes the probe below; transport errors retry with backoff, exhausted retries re-raise `from last_err`
+                    status = getattr(
+                        getattr(exc, "response", None), "status_code", None
+                    )
+                    if status in _BATCH_REJECT_STATUSES and not batch_confirmed:
+                        # the endpoint understood us and said no to the
+                        # framing itself (old gateways answer batch arrays
+                        # with 400/404/405/501) — a capability conclusion.
+                        # A 5xx/429 is a transient outage, and ANY status
+                        # from an endpoint whose batch calls have already
+                        # succeeded is a blip: neither may demote the
+                        # process to sequential reads for its lifetime.
                         self._mark_batch_unsupported(sp)
                         return None
                     last_err = exc
@@ -351,8 +369,20 @@ class LotusClient:
                         self._backoff("ChainReadObj[batch]", attempt, exc)
                     continue
                 if not isinstance(body, list):
-                    self._mark_batch_unsupported(sp)
-                    return None
+                    if not batch_confirmed:
+                        # old gateways answer a batch array with a single
+                        # "invalid request" object: probe concludes negative
+                        self._mark_batch_unsupported(sp)
+                        return None
+                    # a batch-confirmed endpoint answered non-array — a
+                    # proxy blip, not a capability change: retry like any
+                    # transport failure
+                    last_err = RuntimeError(
+                        f"non-array response to JSON-RPC batch from {self.endpoint}"
+                    )
+                    if attempt + 1 < self.max_retries:
+                        self._backoff("ChainReadObj[batch]", attempt, last_err)
+                    continue
                 with self._id_lock:
                     self._batch_ok = True
                 self._metrics.count("rpc.batch_calls")

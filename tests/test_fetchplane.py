@@ -8,6 +8,7 @@ batched mode. All hermetic and tier-1."""
 import json
 import threading
 import time
+import types
 
 import pytest
 
@@ -40,6 +41,14 @@ ACTOR = 1001
 # else escaping is a harness finding (mirrors tools/chaos.py)
 TYPED_ERRORS = (IntegrityError, RpcError, RuntimeError, ConnectionError,
                 TimeoutError, OSError)
+
+
+class _HttpStatusError(Exception):
+    """requests.HTTPError stand-in: carries .response.status_code."""
+
+    def __init__(self, status: int):
+        super().__init__(f"HTTP {status}")
+        self.response = types.SimpleNamespace(status_code=status)
 
 
 def _blocks(n: int, tag: bytes = b"blk") -> "list[tuple[CID, bytes]]":
@@ -164,6 +173,89 @@ class TestBatchFraming:
         got = client.chain_read_obj_many([c for c, _ in blocks])
         assert got == [d for _, d in blocks]
         assert m.snapshot()["counters"]["rpc.batch_item_retries"] == 1
+
+    def test_transient_5xx_retries_and_does_not_demote(self):
+        # one 503 (gateway blip) must NOT conclude the capability probe:
+        # the batch retries under backoff, succeeds, and the endpoint
+        # stays batch-capable
+        blocks = _blocks(6)
+        bs = _store_with(blocks)
+
+        class _FlakyOnceSession(LocalLotusSession):
+            flaked = False
+
+            def post(self, url, data=None, headers=None, timeout=None):
+                body = json.loads(data) if data else {}
+                if isinstance(body, list) and not self.flaked:
+                    self.flaked = True
+                    raise _HttpStatusError(503)
+                return super().post(url, data=data, headers=headers, timeout=timeout)
+
+        m = Metrics()
+        client = LotusClient(
+            "http://flaky", session=_FlakyOnceSession(bs), metrics=m,
+            max_retries=3, backoff_base_s=0.0, backoff_max_s=0.0,
+        )
+        got = client.chain_read_obj_many([c for c, _ in blocks])
+        assert got == [d for _, d in blocks]
+        assert client.supports_batch is True  # NOT demoted to sequential
+        counters = m.snapshot()["counters"]
+        assert counters["rpc.batch_calls"] == 1
+        assert counters.get("rpc.batch_unsupported", 0) == 0
+
+    def test_framing_4xx_concludes_probe_negative(self):
+        # a 405 to the array payload IS a framing rejection: probe
+        # concludes once, reads degrade to sequential and still succeed
+        blocks = _blocks(4)
+        bs = _store_with(blocks)
+
+        class _Reject405Session(LocalLotusSession):
+            def post(self, url, data=None, headers=None, timeout=None):
+                body = json.loads(data) if data else {}
+                if isinstance(body, list):
+                    raise _HttpStatusError(405)
+                return super().post(url, data=data, headers=headers, timeout=timeout)
+
+        m = Metrics()
+        client = LotusClient(
+            "http://reject", session=_Reject405Session(bs), metrics=m
+        )
+        got = client.chain_read_obj_many([c for c, _ in blocks])
+        assert got == [d for _, d in blocks]
+        assert client.supports_batch is False
+        assert m.snapshot()["counters"]["rpc.batch_unsupported"] == 1
+
+    def test_confirmed_endpoint_survives_later_4xx(self):
+        # hundreds of successful batch calls then a proxy answers one with
+        # a 400: a batch-CONFIRMED endpoint is never demoted — the error
+        # retries and the next wave ships batched again
+        blocks = _blocks(5)
+        bs = _store_with(blocks)
+
+        class _LateRejectSession(LocalLotusSession):
+            reject_next = False
+
+            def post(self, url, data=None, headers=None, timeout=None):
+                body = json.loads(data) if data else {}
+                if isinstance(body, list) and self.reject_next:
+                    self.reject_next = False
+                    raise _HttpStatusError(400)
+                return super().post(url, data=data, headers=headers, timeout=timeout)
+
+        m = Metrics()
+        session = _LateRejectSession(bs)
+        client = LotusClient(
+            "http://late", session=session, metrics=m,
+            max_retries=3, backoff_base_s=0.0, backoff_max_s=0.0,
+        )
+        cids = [c for c, _ in blocks]
+        assert client.chain_read_obj_many(cids) == [d for _, d in blocks]
+        assert client.supports_batch is True
+        session.reject_next = True
+        assert client.chain_read_obj_many(cids) == [d for _, d in blocks]
+        assert client.supports_batch is True  # still batch-capable
+        assert m.snapshot()["counters"].get("rpc.batch_unsupported", 0) == 0
+        assert m.snapshot()["counters"]["rpc.batch_calls"] == 2
 
     def test_no_batch_endpoint_probe_concludes_once(self):
         # an old gateway answers array payloads with one "invalid request"
@@ -304,6 +396,137 @@ class TestFetchPlane:
         counters = m.snapshot()["counters"]
         assert counters["fetch.speculative_integrity_drops"] == 1
         assert counters["rpc.integrity_failures"] >= 1
+
+    def test_demand_on_inflight_failed_speculation_raises_not_hangs(self):
+        # THE coalesce race: a demand get attaches to a speculative want
+        # that has already drained into a dispatcher batch; the fetch then
+        # fails verification. The waiter must get the typed IntegrityError
+        # via a demand-lane rerun — never wait forever on a want the plane
+        # silently forgot.
+        good = b"honest bytes for the in-flight race"
+        cid = CID.hash_of(good)
+        bs = MemoryBlockstore()
+        bs.put_keyed(cid, b"corrupt " + good)  # the endpoint always lies
+        m = Metrics()
+        inner = _client(bs, m)
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class _GatedClient:
+            verifies_integrity = False
+            endpoint = "http://gated"
+
+            def chain_read_obj_many(self, cids):
+                entered.set()
+                assert gate.wait(5.0)
+                return inner.chain_read_obj_many(cids)
+
+            def chain_read_obj(self, c):
+                return inner.chain_read_obj(c)
+
+        plane = FetchPlane(
+            _GatedClient(), local={}, speculate_depth=1, workers=1, metrics=m
+        )
+        try:
+            plane.speculate([cid])
+            assert entered.wait(5.0)  # the speculative fetch is in flight
+            outcome: list = []
+
+            def _demand():
+                try:
+                    outcome.append(plane.get(cid))
+                except Exception as exc:
+                    outcome.append(exc)
+
+            t = threading.Thread(target=_demand)
+            t.start()
+            time.sleep(0.05)  # let the demand coalesce onto the want
+            gate.set()
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "demand get hung on a failed speculative want"
+            assert isinstance(outcome[0], IntegrityError)
+        finally:
+            gate.set()
+            plane.close()
+
+    def test_transient_failure_during_coalesced_speculation_recovers(self):
+        # same race, transient flavor: the in-flight speculative batch dies
+        # with a transport error while a demand waiter is attached. The
+        # want re-lanes to demand and the retry delivers the actual bytes —
+        # not None (which would read as "block absent") and not a hang.
+        blocks = _blocks(1, tag=b"tr")
+        cid, data = blocks[0]
+        bs = _store_with(blocks)
+        m = Metrics()
+        inner = _client(bs, m)
+        gate = threading.Event()
+        entered = threading.Event()
+        fail_state = {"batch": True, "scalar": 1}
+
+        class _FlakyGatedClient:
+            verifies_integrity = False
+            endpoint = "http://flaky-gated"
+
+            def chain_read_obj_many(self, cids):
+                entered.set()
+                assert gate.wait(5.0)
+                if fail_state["batch"]:
+                    fail_state["batch"] = False
+                    raise ConnectionError("injected batch outage")
+                return inner.chain_read_obj_many(cids)
+
+            def chain_read_obj(self, c):
+                if fail_state["scalar"] > 0:
+                    fail_state["scalar"] -= 1
+                    raise ConnectionError("injected scalar outage")
+                return inner.chain_read_obj(c)
+
+        plane = FetchPlane(
+            _FlakyGatedClient(), local={}, speculate_depth=1, workers=1, metrics=m
+        )
+        try:
+            plane.speculate([cid])
+            assert entered.wait(5.0)
+            outcome: list = []
+
+            def _demand():
+                try:
+                    outcome.append(plane.get(cid))
+                except Exception as exc:
+                    outcome.append(exc)
+
+            t = threading.Thread(target=_demand)
+            t.start()
+            time.sleep(0.05)
+            gate.set()
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "demand get hung after transient batch failure"
+            assert outcome[0] == data
+        finally:
+            gate.set()
+            plane.close()
+
+    def test_cached_blockstore_serves_as_local_tier(self):
+        # CachedBlockstore exposes the get_local/has_local/put_local
+        # surface: landings deposit into its cache and the short-circuit
+        # reads it back without touching RPC again
+        from ipc_proofs_tpu.store.blockstore import CachedBlockstore
+
+        blocks = _blocks(4, tag=b"cbl")
+        bs = _store_with(blocks)
+        client = _client(bs)
+        local = CachedBlockstore(MemoryBlockstore())
+        with FetchPlane(client, local=local, metrics=Metrics()) as plane:
+            for cid, data in blocks:
+                assert plane.get(cid) == data
+            calls = client._session.calls
+            for cid, data in blocks:  # warm pass: all local, zero RPC
+                assert plane.get(cid) == data
+            assert client._session.calls == calls
+        for cid, data in blocks:
+            assert local.get_local(cid) == data
+            assert local.has_local(cid)
+        assert local._inner.get(blocks[0][0]) is None  # cache only, never inner
 
     def test_demand_integrity_failure_is_typed(self):
         good = b"another honest block"

@@ -433,6 +433,95 @@ class TestGenerate:
             )
 
 
+class TestFetchPlaneWiring:
+    """The fetch-plane interposition in ProofService.__init__: an RPC-fed
+    store gets a plane whose local tier IS the service's layered store, in
+    both memory-cache and disk-tier (`store_dir`) modes, and landings
+    deposit so warm repeats stay at zero RPC."""
+
+    def _rpc_world(self):
+        from ipc_proofs_tpu.fixtures import build_range_world
+        from ipc_proofs_tpu.store.faults import LocalLotusSession
+        from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+
+        bs, pairs, _ = build_range_world(3, receipts_per_pair=4,
+                                         events_per_receipt=2, match_rate=0.5)
+        m = Metrics()
+        session = LocalLotusSession(bs)
+        store = RpcBlockstore(
+            LotusClient("http://serve-plane", session=session, metrics=m),
+            metrics=m,
+        )
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET)
+        return bs, pairs, spec, store, session
+
+    def test_memory_mode_plane_local_is_cached_store(self):
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+
+        bs, pairs, spec, store, session = self._rpc_world()
+        with ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=1),
+        ) as svc:
+            assert svc.fetch_plane is not None
+            assert isinstance(svc._store, CachedBlockstore)
+            # the plane short-circuits through the SAME local tier the
+            # walkers populate (CachedBlockstore exposes get_local/
+            # has_local/put_local that never touch its inner store)
+            assert svc.fetch_plane._local is svc._store
+            pair = TipsetPair(parent=pairs[0].parent, child=pairs[0].child)
+            resp = svc.generate(pair)
+            solo = generate_event_proofs_for_range(bs, [pairs[0]], spec)
+            assert (
+                [p.to_json_obj() for p in resp.bundle.event_proofs]
+                == [p.to_json_obj() for p in solo.event_proofs]
+            )
+            # landings deposited: a warm repeat makes no new RPC calls
+            cold_calls = session.calls
+            assert cold_calls > 0
+            resp2 = svc.generate(pair)
+            assert session.calls == cold_calls
+            assert (
+                [p.to_json_obj() for p in resp2.bundle.event_proofs]
+                == [p.to_json_obj() for p in resp.bundle.event_proofs]
+            )
+
+    def test_disk_mode_plane_local_is_tiered_store(self, tmp_path):
+        from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range
+        from ipc_proofs_tpu.storex import TieredBlockstore
+
+        bs, pairs, spec, store, session = self._rpc_world()
+        with ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=1,
+                                 store_dir=str(tmp_path)),
+        ) as svc:
+            assert svc.fetch_plane is not None
+            assert isinstance(svc._store, TieredBlockstore)
+            assert svc.fetch_plane._local is svc._store
+            resp = svc.generate(TipsetPair(parent=pairs[0].parent,
+                                           child=pairs[0].child))
+            solo = generate_event_proofs_for_range(bs, [pairs[0]], spec)
+            assert (
+                [p.to_json_obj() for p in resp.bundle.event_proofs]
+                == [p.to_json_obj() for p in solo.event_proofs]
+            )
+            # fetched blocks persisted through put_local into the disk tier
+            assert svc._disk_store.stats()["entries"] > 0
+
+    def test_batch_rpc_false_keeps_direct_path(self):
+        bs, pairs, spec, store, _ = self._rpc_world()
+        with ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=1,
+                                 batch_rpc=False),
+        ) as svc:
+            assert svc.fetch_plane is None
+            resp = svc.generate(TipsetPair(parent=pairs[0].parent,
+                                           child=pairs[0].child))
+            assert resp.bundle.event_proofs
+
+
 class TestHTTP:
     @pytest.fixture()
     def server(self, world, full_bundle):

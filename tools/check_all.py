@@ -45,6 +45,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 LOCKDEP_TEST_FILES = (
     "tests/test_cluster.py",
     "tests/test_crash_recovery.py",
+    "tests/test_fetchplane.py",
     "tests/test_jobs.py",
     "tests/test_lockdep.py",
     "tests/test_parallel.py",
