@@ -57,7 +57,7 @@ ACTOR = 1001
 LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
-    "asyncfetch", "cluster",
+    "asyncfetch", "cluster", "onchip",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -76,6 +76,7 @@ _LEG_TIMEOUTS = {
     "storage": (300.0, 150.0),
     "asyncfetch": (300.0, 150.0),
     "cluster": (420.0, 240.0),
+    "onchip": (480.0, 240.0),
 }
 
 
@@ -1527,6 +1528,150 @@ def _leg_cluster(args) -> dict:
     }
 
 
+def _leg_onchip(args) -> dict:
+    """The on-chip half, sharded (PR 12): mesh-pjit event matching across
+    every local device + device-batched multihash verification.
+
+    Correctness is ASSERTED on every run, never sampled:
+    - the mesh-sharded fingerprint match must be bit-identical to the
+      single-device path over the same arrays;
+    - `verify_blocks_batch` verdicts must equal the scalar
+      `verify_block_bytes` loop — including deliberately corrupted blocks,
+      every one of which must be caught;
+    - cold-path integrity checking must issue ≤ 1 device dispatch per
+      size-class chunk (the whole point of batching the verify plane).
+
+    Measured numbers:
+    - ``device_linearity_Nchip`` — rate(N devices) / (N × rate(1 device))
+      for the match kernel; gated ≥ 0.8 by check_bench_schema only on
+      multi-device hosts (a 1-device host still records the number — it
+      honestly shows the pjit-path overhead against the plain-jit path);
+    - ``batch_verify_speedup`` — scalar hashlib loop wall / batched device
+      plane wall over the same blocks (recorded honestly: on a CPU-only
+      host the XLA u32-lane emulation loses to hashlib and this is < 1).
+    """
+    jax_platform = _setup_platform(args)
+    import jax
+    import numpy as np
+
+    from ipc_proofs_tpu.backend.tpu import TpuBackend
+    from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, DAG_CBOR
+    from ipc_proofs_tpu.core.hashes import blake2b_256
+    from ipc_proofs_tpu.ops.verify_jax import verify_blocks_batch
+    from ipc_proofs_tpu.parallel.mesh import make_mesh
+    from ipc_proofs_tpu.proofs.scan_native import topic_fingerprint
+    from ipc_proofs_tpu.state.events import ascii_to_bytes32, hash_event_signature
+    from ipc_proofs_tpu.store.rpc import verify_block_bytes
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    # force the device path for the single-device comparator (the host
+    # crossover would otherwise answer from numpy and time the wrong thing)
+    os.environ["IPC_TPU_MATCH_MIN_EVENTS"] = "0"
+    os.environ["IPC_VERIFY_MIN_BYTES"] = "0"
+
+    topic0 = hash_event_signature(SIG)
+    topic1 = ascii_to_bytes32(TOPIC1)
+    fp_target = topic_fingerprint(topic0, topic1)
+    n_dev = len(jax.devices())
+
+    n_events = 1 << (16 if args.quick else 20)
+    rng = np.random.default_rng(7)
+    fp = rng.integers(0, 1 << 63, size=n_events, dtype=np.uint64)
+    n_topics = rng.integers(2, 4, size=n_events).astype(np.int32)
+    emitters = rng.integers(0, 50, size=n_events).astype(np.int64)
+    valid = rng.random(n_events) < 0.95
+    hit = rng.random(n_events) < args.match_rate
+    fp[hit] = np.uint64(fp_target)  # plant real matches
+
+    b1 = TpuBackend()
+    bN = TpuBackend(mesh=make_mesh(n_dev))
+
+    def match(backend):
+        return np.asarray(
+            backend.event_match_mask_fp(
+                fp, n_topics, emitters, valid, topic0, topic1, None
+            )
+        )[:n_events]
+
+    mask1 = match(b1)  # also warms each path's jit cache
+    maskN = match(bN)
+    assert np.array_equal(mask1, maskN), (
+        "mesh-sharded match diverged from the single-device path"
+    )
+    assert mask1[valid & hit].all(), "planted matches were missed"
+
+    def match_rate_of(backend) -> float:
+        k = 3 if args.quick else 10
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _i in range(k):
+                match(backend)
+            best = min(best, time.perf_counter() - t0)
+        return n_events * k / best
+
+    rate_1 = match_rate_of(b1)
+    rate_n = match_rate_of(bN)
+    linearity = rate_n / (n_dev * rate_1)
+
+    # --- batched multihash verification -------------------------------------
+    n_blocks = 256 if args.quick else 1024
+    block_bytes = 1024  # uniform size → one size class → minimal chunking
+    payload = rng.integers(0, 256, size=(n_blocks, block_bytes), dtype=np.uint8)
+    blocks = [payload[i].tobytes() for i in range(n_blocks)]
+    cids = [CID.hash_of(b, codec=DAG_CBOR, mh_code=BLAKE2B_256) for b in blocks]
+    corrupt = set(range(0, n_blocks, 37))
+    for i in corrupt:  # flip one byte — every corruption must be caught
+        blocks[i] = bytes([blocks[i][0] ^ 0x01]) + blocks[i][1:]
+
+    m = Metrics()
+    verify_blocks_batch(cids, blocks)  # warm (compile) outside the timing
+    d0 = m.counter_value("verify.device_calls")
+    got = verify_blocks_batch(cids, blocks, metrics=m)
+    device_calls = m.counter_value("verify.device_calls") - d0
+    n_chunks = -(-n_blocks // 512)  # _CHUNK_MAX_MSGS
+    assert device_calls <= n_chunks, (
+        f"cold-path verify used {device_calls} device calls for "
+        f"{n_chunks} chunk(s)"
+    )
+    want = [verify_block_bytes(c, b) for c, b in zip(cids, blocks)]
+    assert got == want, "batch verify verdicts diverged from the scalar path"
+    assert all(not got[i] for i in corrupt), "a corrupted block slipped through"
+    assert all(got[i] for i in range(n_blocks) if i not in corrupt)
+
+    def best_of(fn) -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_batch = best_of(lambda: verify_blocks_batch(cids, blocks))
+    t_scalar = best_of(
+        lambda: [verify_block_bytes(c, b) for c, b in zip(cids, blocks)]
+    )
+    speedup = t_scalar / t_batch
+    assert blake2b_256(blocks[1]) == cids[1].digest  # sanity on the fixture
+
+    _log(
+        f"bench: onchip ({n_dev} device(s)): match {rate_1:,.0f} ev/s @1 vs "
+        f"{rate_n:,.0f} ev/s @{n_dev} (linearity {linearity:.2f}); "
+        f"verify {n_blocks}×{block_bytes}B in {device_calls} device call(s), "
+        f"batch {t_batch*1e3:.1f} ms vs scalar {t_scalar*1e3:.1f} ms "
+        f"(speedup {speedup:.2f}); mesh + batch verdicts bit-identical ✓"
+    )
+    return {
+        "device_linearity_Nchip": round(linearity, 3),
+        "batch_verify_speedup": round(speedup, 3),
+        "onchip_devices": n_dev,
+        "onchip_match_events": n_events,
+        "onchip_verify_blocks": n_blocks,
+        "onchip_device_calls": int(device_calls),
+        "_platform": jax_platform,
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -1541,6 +1686,7 @@ _LEG_FNS = {
     "storage": _leg_storage,
     "asyncfetch": _leg_asyncfetch,
     "cluster": _leg_cluster,
+    "onchip": _leg_onchip,
 }
 
 
@@ -1814,6 +1960,12 @@ def _orchestrate(args) -> None:
         device_platform = "cpu"
         watchdog_fallback = True
 
+    onchip, status = _run_leg("onchip", args, device_platform)
+    legs_status["onchip"] = status
+    if status.startswith("timeout") and device_platform != "cpu":
+        device_platform = "cpu"
+        watchdog_fallback = True
+
     # --- host-only baselines (never touch the tunnel) -----------------------
     baseline, status = _run_leg("baseline", args, "cpu")
     legs_status["baseline"] = status
@@ -1914,6 +2066,12 @@ def _orchestrate(args) -> None:
     )
     for k in _CLUSTER_KEYS:
         out[k] = (cluster or {}).get(k)
+    _ONCHIP_KEYS = (
+        "device_linearity_Nchip", "batch_verify_speedup", "onchip_devices",
+        "onchip_match_events", "onchip_verify_blocks", "onchip_device_calls",
+    )
+    for k in _ONCHIP_KEYS:
+        out[k] = (onchip or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

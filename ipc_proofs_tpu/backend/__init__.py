@@ -63,21 +63,38 @@ class BatchHashBackend(Protocol):
 _BACKENDS: dict[str, BatchHashBackend] = {}
 
 
-def get_backend(name: str = "cpu") -> BatchHashBackend:
-    """Backend registry; instances are cached (kernels stay jitted)."""
-    if name in _BACKENDS:
-        return _BACKENDS[name]
+def get_backend(
+    name: str = "cpu", mesh_devices: Optional[int] = None
+) -> BatchHashBackend:
+    """Backend registry; instances are cached (kernels stay jitted).
+
+    ``mesh_devices`` (tpu only) lays event-match batches across that many
+    local devices via pjit/NamedSharding; ``None`` keeps the single-device
+    path. Mesh variants cache separately so a meshed and an unmeshed caller
+    in one process each keep their own jitted functions.
+    """
+    key = name if mesh_devices is None else f"{name}:mesh{mesh_devices}"
+    if key in _BACKENDS:
+        return _BACKENDS[key]
     if name == "cpu":
+        if mesh_devices is not None:
+            raise ValueError("mesh_devices requires --backend=tpu")
         from ipc_proofs_tpu.backend.cpu import CpuBackend
 
         backend: BatchHashBackend = CpuBackend()
     elif name == "tpu":
         from ipc_proofs_tpu.backend.tpu import TpuBackend
 
-        backend = TpuBackend()
+        if mesh_devices is not None:
+            from ipc_proofs_tpu.parallel.mesh import make_mesh
+
+            # 0 = all local devices (make_mesh(None) enumerates them)
+            backend = TpuBackend(mesh=make_mesh(mesh_devices or None))
+        else:
+            backend = TpuBackend()
     else:
         raise ValueError(f"unknown backend {name!r} (expected cpu|tpu)")
-    _BACKENDS[name] = backend
+    _BACKENDS[key] = backend
     return backend
 
 

@@ -283,7 +283,13 @@ def _cmd_range(args) -> int:
             MappingSlotSpec(actor_id=actor_id, key=key, slot_index=args.slot_index)
             for key in args.storage_slot
         ]
-    backend = get_backend(args.backend) if args.backend != "none" else None
+    backend = (
+        get_backend(args.backend, mesh_devices=args.mesh_devices)
+        if args.backend != "none"
+        else None
+    )
+    if backend is not None and getattr(backend, "mesh", None) is not None:
+        log.info("mesh-sharded matching: %d device(s)", backend.mesh.size)
     from ipc_proofs_tpu.utils.profiling import maybe_profile
 
     generate_fn = None
@@ -314,11 +320,14 @@ def _cmd_range(args) -> int:
         from ipc_proofs_tpu.store.fetchplane import FetchPlane, PlaneBlockstore
 
         plane = FetchPlane(
-            client, speculate_depth=args.speculate_depth, metrics=metrics
+            client,
+            speculate_depth=args.speculate_depth,
+            metrics=metrics,
+            batch_verify=args.batch_verify,
         )
         store = PlaneBlockstore(plane)
         log.info(
-            "fetch plane: batched RPC, speculate_depth=%d", args.speculate_depth
+            "fetch plane: batched RPC, speculate_depth=%s", args.speculate_depth
         )
     else:
         store = RpcBlockstore(client)
@@ -327,7 +336,10 @@ def _cmd_range(args) -> int:
         from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
 
         disk = SegmentStore(
-            args.store_dir, cap_bytes=args.store_cap_bytes, metrics=metrics
+            args.store_dir,
+            cap_bytes=args.store_cap_bytes,
+            metrics=metrics,
+            batch_verify=args.batch_verify,
         )
         store = TieredBlockstore(store, disk, metrics=metrics)
         if plane is not None:
@@ -631,6 +643,9 @@ def _cmd_serve(args) -> int:
             store_owner=args.store_owner,
             batch_rpc=args.batch_rpc,
             speculate_depth=args.speculate_depth,
+            match_backend=(None if args.backend == "none" else args.backend),
+            mesh_devices=args.mesh_devices,
+            batch_verify=args.batch_verify,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -657,6 +672,7 @@ def _cmd_serve(args) -> int:
                 service.blockstore,
                 metrics=metrics,
                 poll_s=args.follow_poll_s,
+                batch_verify=args.batch_verify,
             )
             follower.start()
             log.info(
@@ -831,6 +847,19 @@ def _cmd_cluster(args) -> int:
     return 0
 
 
+def speculate_depth_arg(value):
+    # "auto" → adaptive backoff (FetchPlane lowers the depth when the
+    # speculation waste ratio spikes); anything else must parse as int
+    if value == "auto":
+        return value
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'auto', got {value!r}"
+        )
+
+
 def main(argv=None) -> int:
     from ipc_proofs_tpu.obs import install_crash_dump
 
@@ -882,10 +911,31 @@ def main(argv=None) -> int:
             "--no-batch-rpc restores the one-call-per-block path",
         )
         p.add_argument(
-            "--speculate-depth", type=int, default=1, metavar="N",
+            "--speculate-depth", type=speculate_depth_arg, default=1,
+            metavar="N|auto",
             help="how many link levels the fetch plane chases below a "
             "decoded HAMT/AMT interior node (0 = batch demand fetches "
-            "only, no speculation; default 1)",
+            "only, no speculation; default 1). 'auto' starts at 2 and "
+            "backs off one level whenever a 64-fetch speculation window "
+            "wastes more than 60%% of what it fetched "
+            "(fetch.speculate_depth_downshifts counts the backoffs)",
+        )
+
+    def add_onchip_flags(p):
+        p.add_argument(
+            "--mesh-devices", type=int, default=None, metavar="N",
+            help="shard coalesced event-match batches across the first N "
+            "local accelerator devices via pjit/NamedSharding (0 = all "
+            "devices). Requires --backend tpu; results are bit-identical "
+            "to the single-device path",
+        )
+        p.add_argument(
+            "--batch-verify", action="store_true",
+            help="verify chunk-granular read paths (fetch-plane landings, "
+            "disk-tier reads, follower prefetch) with the device-batched "
+            "multihash plane (ops.verify_jax) instead of per-block host "
+            "hashing; verdicts are identical, small batches stay on the "
+            "host (IPC_VERIFY_MIN_BYTES crossover)",
         )
 
     def add_trace_export_flags(p):
@@ -1012,6 +1062,7 @@ def main(argv=None) -> int:
         "of silently starting a fresh job)",
     )
     rng.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
+    add_onchip_flags(rng)
     rng.add_argument("-o", "--output", default=None)
     rng.add_argument("--metrics", action="store_true")
     rng.add_argument(
@@ -1151,6 +1202,12 @@ def main(argv=None) -> int:
     )
     add_store_flags(srv)
     add_fetch_plane_flags(srv)
+    srv.add_argument(
+        "--backend", default="none", choices=["cpu", "tpu", "none"],
+        help="batch backend for generate-range event matching (default "
+        "none = pure-python matcher)",
+    )
+    add_onchip_flags(srv)
     srv.add_argument(
         "--store-owner", default=None, metavar="TOKEN",
         help="join a SHARED --store-dir under this owner token (cluster "

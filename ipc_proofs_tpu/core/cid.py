@@ -20,6 +20,7 @@ RAW = 0x55
 # multihash codes
 BLAKE2B_256 = 0xB220
 SHA2_256 = 0x12
+KECCAK_256 = 0x1B
 IDENTITY = 0x00
 
 __all__ = [
@@ -28,6 +29,7 @@ __all__ = [
     "RAW",
     "BLAKE2B_256",
     "SHA2_256",
+    "KECCAK_256",
     "IDENTITY",
     "cids_from_strings",
     "cid_strings",
@@ -145,6 +147,10 @@ class CID:
             import hashlib
 
             digest = hashlib.sha256(data).digest()
+        elif mh_code == KECCAK_256:
+            from ipc_proofs_tpu.core.hashes import keccak256
+
+            digest = keccak256(data)
         elif mh_code == IDENTITY:
             digest = data
         else:

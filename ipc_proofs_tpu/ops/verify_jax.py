@@ -1,0 +1,191 @@
+"""Device-batched multihash verification: the on-chip integrity plane.
+
+Every cold read path re-hashes witness blocks before anything observes
+them (`store.rpc.verify_block_bytes`) — per-block Python on exactly the
+workload the batch hash kernels were built for. `verify_blocks_batch`
+turns one chunk's worth of blocks (a fetch-plane landed wave, a follower
+prefetch batch, a segment-store multi-read) into ONE fused device call
+per multihash family: blake2b-256 rides `ops.blake2b_jax.blake2b256_blocks`
+and keccak-256 rides `ops.keccak_jax.keccak256_blocks`, both packed
+host-side by `ops.pack` into size-class chunks so a batch of 1 KiB blocks
+never pads to its largest member.
+
+Verdict contract: ``verify_blocks_batch(cids, blocks)[i]`` equals
+``verify_block_bytes(cids[i], blocks[i])`` for every i — including the
+"unknown multihash codes are accepted" rule — pinned by the differential
+grid in tests/test_verify_batch.py. Codes without a device kernel
+(sha2-256, identity, unknown) and sub-crossover batches take the scalar
+lane; the verdicts are identical either way, only the hashing lane moves.
+
+Shape discipline mirrors the match path: message counts pad to
+power-of-two buckets and block counts to power-of-two size classes, so
+repeated waves compile O(log² n) kernel shapes, not one per batch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, IDENTITY, KECCAK_256, SHA2_256
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
+
+__all__ = ["verify_blocks_batch", "batch_min_bytes"]
+
+# Below this many payload bytes in one batch, XLA dispatch + packing costs
+# more than hashlib's C loop — the scalar lane runs instead (verdicts are
+# identical; this is the same crossover discipline as backend.tpu).
+_DEFAULT_MIN_BYTES = 256 * 1024
+
+# one device call hashes at most this many messages (bounds the padded
+# [N, B, words] tensor one size-class chunk packs)
+_CHUNK_MAX_MSGS = 512
+_MIN_MSG_BUCKET = 8
+
+_jax_ok: "bool | None" = None
+
+
+def batch_min_bytes() -> int:
+    """Device-lane crossover in payload bytes (env IPC_VERIFY_MIN_BYTES)."""
+    try:
+        return int(os.environ.get("IPC_VERIFY_MIN_BYTES", _DEFAULT_MIN_BYTES))
+    except ValueError:
+        return _DEFAULT_MIN_BYTES
+
+
+def _device_ready() -> bool:
+    global _jax_ok
+    if _jax_ok is None:
+        try:
+            import jax  # noqa: F401
+
+            _jax_ok = True
+        except Exception:  # fail-soft: no jax = scalar lane, never an error
+            _jax_ok = False
+    return _jax_ok
+
+
+def _verify_one(cid: CID, data: bytes) -> bool:
+    """Scalar verdict — same rules as `store.rpc.verify_block_bytes`
+    (kept import-cycle-free here; the differential test pins equality)."""
+    mh = cid.mh_code
+    data = bytes(data)
+    if mh == BLAKE2B_256:
+        return blake2b_256(data) == cid.digest
+    if mh == SHA2_256:
+        return hashlib.sha256(data).digest() == cid.digest
+    if mh == KECCAK_256:
+        return keccak256(data) == cid.digest
+    if mh == IDENTITY:
+        return data == bytes(cid.digest)
+    return True
+
+
+def _pow2_at_least(n: int, minimum: int) -> int:
+    bucket = minimum
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def _size_class_chunks(idxs: "list[int]", blocks_needed: "list[int]"):
+    """Partition message indices into (class_blocks, [idx, …]) chunks:
+    messages group by power-of-two block-count class (so one huge block
+    never inflates everyone's padding) and each chunk holds at most
+    `_CHUNK_MAX_MSGS` messages."""
+    by_class: "dict[int, list[int]]" = {}
+    for i in idxs:
+        by_class.setdefault(_pow2_at_least(blocks_needed[i], 1), []).append(i)
+    for cls in sorted(by_class):
+        members = by_class[cls]
+        for start in range(0, len(members), _CHUNK_MAX_MSGS):
+            yield cls, members[start : start + _CHUNK_MAX_MSGS]
+
+
+def _device_digests(code: int, chunk_msgs: "list[bytes]", cls: int) -> "list[bytes]":
+    """One fused kernel dispatch: digests of `chunk_msgs` (padded to a
+    power-of-two message bucket; the filler digests are discarded)."""
+    from ipc_proofs_tpu.ops.pack import digests_to_bytes, pad_blake2b, pad_keccak
+
+    n_real = len(chunk_msgs)
+    bucket = _pow2_at_least(n_real, _MIN_MSG_BUCKET)
+    msgs = chunk_msgs + [b""] * (bucket - n_real)
+    if code == BLAKE2B_256:
+        from ipc_proofs_tpu.ops.blake2b_jax import blake2b256_blocks
+
+        blocks_t, counts, lengths = pad_blake2b(msgs, max_blocks=cls)
+        out = blake2b256_blocks(blocks_t, counts, lengths)
+    else:  # KECCAK_256
+        from ipc_proofs_tpu.ops.keccak_jax import keccak256_blocks
+
+        blocks_t, counts = pad_keccak(msgs, max_blocks=cls)
+        out = keccak256_blocks(blocks_t, counts)
+    return digests_to_bytes(np.asarray(out))[:n_real]
+
+
+def verify_blocks_batch(
+    cids: Sequence[CID], blocks: Sequence[bytes], metrics=None
+) -> "list[bool]":
+    """Batch form of `verify_block_bytes`: one verdict per (cid, block).
+
+    blake2b-256 and keccak-256 blocks hash in fused device batches when
+    the batch clears the crossover (`batch_min_bytes`); everything else —
+    and every block when jax is unavailable — verifies on the scalar
+    lane. Verdicts are bit-identical across lanes by construction.
+    """
+    cids = list(cids)
+    blocks = [bytes(b) for b in blocks]
+    if len(cids) != len(blocks):
+        raise ValueError(f"{len(cids)} cids vs {len(blocks)} blocks")
+    n = len(cids)
+    verdicts = [False] * n
+    if metrics is not None:
+        metrics.count("verify.batch_calls")
+        metrics.count("verify.batch_blocks", n)
+    if n == 0:
+        return verdicts
+
+    device_idx: "dict[int, list[int]]" = {BLAKE2B_256: [], KECCAK_256: []}
+    scalar_idx: "list[int]" = []
+    for i, cid in enumerate(cids):
+        lane = device_idx.get(cid.mh_code)
+        (lane if lane is not None else scalar_idx).append(i)
+
+    batchable = device_idx[BLAKE2B_256] + device_idx[KECCAK_256]
+    device_bytes = sum(len(blocks[i]) for i in batchable)
+    if not (
+        _device_ready() and len(batchable) >= 2 and device_bytes >= batch_min_bytes()
+    ):
+        scalar_idx.extend(batchable)
+        device_idx = {BLAKE2B_256: [], KECCAK_256: []}
+
+    for code, idxs in device_idx.items():
+        if not idxs:
+            continue
+        if code == BLAKE2B_256:
+            from ipc_proofs_tpu.ops.blake2b_jax import BLOCK_BYTES
+
+            need = [max(1, -(-len(blocks[i]) // BLOCK_BYTES)) for i in range(n)]
+        else:
+            from ipc_proofs_tpu.ops.keccak_jax import RATE_BYTES
+
+            need = [len(blocks[i]) // RATE_BYTES + 1 for i in range(n)]
+        try:
+            for cls, chunk in _size_class_chunks(idxs, need):
+                digests = _device_digests(code, [blocks[i] for i in chunk], cls)
+                for i, digest in zip(chunk, digests):
+                    verdicts[i] = digest == cids[i].digest
+                if metrics is not None:
+                    metrics.count("verify.device_calls")
+                    metrics.count("verify.device_blocks", len(chunk))
+        except Exception:  # fail-soft: a device fault must never fail a read path — the scalar lane re-derives the same verdicts
+            scalar_idx.extend(idxs)
+
+    for i in scalar_idx:
+        verdicts[i] = _verify_one(cids[i], blocks[i])
+    if metrics is not None and scalar_idx:
+        metrics.count("verify.scalar_blocks", len(scalar_idx))
+    return verdicts

@@ -31,6 +31,7 @@ from __future__ import annotations
 import queue
 import threading
 from ipc_proofs_tpu.utils.lockdep import named_lock
+from ipc_proofs_tpu.utils.threads import locked
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
@@ -212,6 +213,14 @@ class MatchCoalescer:
     equals the per-request masks — same contract the sharded device
     pipeline relies on. Counted as ``range_match_coalesced`` (requests
     that rode another caller's device call).
+
+    Dispatch discipline: every batch — coalesced or lone — pads to a
+    `pad_to_bucket` power-of-two bucket (mesh-divisible when the backend
+    carries a device mesh, so `sharded_fp_mask_fn` lays the rows evenly
+    across all chips) with ``valid=False`` filler rows BEFORE the device
+    call. Coalesced sums land on arbitrary sizes, so without this the jit
+    cache compiles one kernel per batch size; with it, O(log n) shapes
+    total. First-seen dispatch shapes tick ``range_match_retraces``.
     """
 
     def __init__(self, backend, metrics=None):
@@ -220,6 +229,7 @@ class MatchCoalescer:
         self._lock = named_lock("MatchCoalescer._lock")
         self._call_lock = named_lock("MatchCoalescer._call_lock")  # serializes device dispatch
         self._pending: "list[_MatchReq]" = []  # guarded-by: _lock
+        self._shapes: "set[int]" = set()  # bucketed dispatch sizes seen; guarded-by: _call_lock (dispatch is serialized)
 
     def match_fp(self, fp, n_topics, emitters, valid, topic0, topic1, actor_id):
         """Drop-in for ``backend.event_match_mask_fp`` (same signature,
@@ -241,6 +251,32 @@ class MatchCoalescer:
             raise req.exc
         return req.result
 
+    @locked  # caller holds _call_lock (match_fp's dispatch section)
+    def _pad_dispatch(self, fp, n_topics, emitters, valid):
+        """Pad one dispatch batch to its power-of-two bucket (mesh-divisible
+        under a device mesh) with valid=False filler rows — filler never
+        matches (elementwise predicate), and requests split back at their
+        original offsets, so results are bit-identical to the unpadded
+        call."""
+        from ipc_proofs_tpu.ops.match_jax import pad_to_bucket
+
+        n = len(fp)
+        bucket = pad_to_bucket(n)
+        mesh = getattr(self._backend, "mesh", None)
+        if mesh is not None:  # rows must split evenly across every device
+            bucket += (-bucket) % mesh.size
+        if bucket != n:
+            pad = bucket - n
+            fp = np.concatenate([fp, np.zeros((pad,) + fp.shape[1:], fp.dtype)])
+            n_topics = np.concatenate([n_topics, np.zeros(pad, n_topics.dtype)])
+            emitters = np.concatenate([emitters, np.zeros(pad, emitters.dtype)])
+            valid = np.concatenate([valid, np.zeros(pad, valid.dtype)])
+        if bucket not in self._shapes:
+            self._shapes.add(bucket)
+            if self._metrics is not None:
+                self._metrics.count("range_match_retraces")
+        return fp, n_topics, emitters, valid
+
     def _run(self, batch: "list[_MatchReq]") -> None:
         groups: "dict[tuple, list[_MatchReq]]" = {}
         for r in batch:
@@ -250,25 +286,27 @@ class MatchCoalescer:
             try:
                 if len(reqs) == 1:
                     r = reqs[0]
-                    r.result = self._backend.event_match_mask_fp(
+                    fp, n_topics, emitters, valid = (
                         r.fp, r.n_topics, r.emitters, r.valid,
-                        topic0, topic1, actor_id,
                     )
                 else:
-                    out = self._backend.event_match_mask_fp(
-                        np.concatenate([r.fp for r in reqs]),
-                        np.concatenate([r.n_topics for r in reqs]),
-                        np.concatenate([r.emitters for r in reqs]),
-                        np.concatenate([r.valid for r in reqs]),
-                        topic0, topic1, actor_id,
-                    )
-                    off = 0
-                    for r in reqs:
-                        n = len(r.fp)
-                        r.result = out[off : off + n]
-                        off += n
-                    if self._metrics is not None:
-                        self._metrics.count("range_match_coalesced", len(reqs) - 1)
+                    fp = np.concatenate([r.fp for r in reqs])
+                    n_topics = np.concatenate([r.n_topics for r in reqs])
+                    emitters = np.concatenate([r.emitters for r in reqs])
+                    valid = np.concatenate([r.valid for r in reqs])
+                fp, n_topics, emitters, valid = self._pad_dispatch(
+                    fp, n_topics, emitters, valid
+                )
+                out = self._backend.event_match_mask_fp(
+                    fp, n_topics, emitters, valid, topic0, topic1, actor_id
+                )
+                off = 0
+                for r in reqs:
+                    n = len(r.fp)
+                    r.result = out[off : off + n]
+                    off += n
+                if self._metrics is not None and len(reqs) > 1:
+                    self._metrics.count("range_match_coalesced", len(reqs) - 1)
             except BaseException as exc:  # fail-soft: every parked waiter re-raises this from its own match_fp call — nothing is swallowed
                 for r in reqs:
                     r.exc = exc

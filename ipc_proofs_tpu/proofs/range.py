@@ -944,9 +944,14 @@ def generate_event_proofs_for_range_pipelined(
     fold = _MergeFold(cached)
 
     match_call = None
+    # A mesh-carrying backend wants the coalescer even with one scan worker:
+    # the coalescer's bucket padding keeps dispatch shapes mesh-divisible.
     if (
         not serial_fallback
-        and scan_workers > 1
+        and (
+            scan_workers > 1
+            or getattr(match_backend, "mesh", None) is not None
+        )
         and match_backend is not None
         and hasattr(match_backend, "event_match_mask_fp")
     ):

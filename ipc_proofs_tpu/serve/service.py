@@ -121,7 +121,18 @@ class ServiceConfig:
     # speculatively. batch_rpc=False keeps the sync one-call-per-block
     # path; speculate_depth=0 batches without speculation
     batch_rpc: bool = True
-    speculate_depth: int = 1
+    # "auto" starts at FetchPlane.AUTO_START_DEPTH and backs off when the
+    # speculation waste ratio spikes (fetch.speculate_depth_downshifts)
+    speculate_depth: "int | str" = 1
+    # on-chip half (PR 12): match_backend name routes generate-range event
+    # matching through a BatchHashBackend; mesh_devices lays coalesced
+    # match batches across that many local devices (0 = all, None = no
+    # mesh); batch_verify swaps chunk-granular read-path multihash checks
+    # (fetch plane landings, disk-tier reads) for the device-batched
+    # ops.verify_jax plane
+    match_backend: Optional[str] = None
+    mesh_devices: Optional[int] = None
+    batch_verify: bool = False
 
 
 @dataclass
@@ -215,6 +226,7 @@ class ProofService:
                 plane_client,
                 speculate_depth=self.config.speculate_depth,
                 metrics=self.metrics,
+                batch_verify=self.config.batch_verify,
             )
             store = PlaneBlockstore(self.fetch_plane)
         self._disk_store = None
@@ -226,6 +238,7 @@ class ProofService:
                 cap_bytes=self.config.store_cap_bytes,
                 metrics=self.metrics,
                 owner=self.config.store_owner,
+                batch_verify=self.config.batch_verify,
             )
             self._store = TieredBlockstore(
                 store,
@@ -244,6 +257,15 @@ class ProofService:
             # inner store, so this is not circular): wants satisfiable
             # locally never reach the queue, landings deposit for next time
             self.fetch_plane.set_local(self._store)
+        # on-chip half: the generate-range drivers offload event matching
+        # (and, under a mesh, shard each coalesced batch across devices)
+        self._match_backend = None
+        if self.config.match_backend:
+            from ipc_proofs_tpu.backend import get_backend
+
+            self._match_backend = get_backend(
+                self.config.match_backend, mesh_devices=self.config.mesh_devices
+            )
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )
@@ -336,6 +358,7 @@ class ProofService:
                 self._spec,
                 chunk_size=chunk_size or self.config.range_chunk_size,
                 metrics=self.metrics,
+                match_backend=self._match_backend,
             )
         self.metrics.count("serve.batches.generate")
         return bundle
@@ -568,6 +591,7 @@ class ProofService:
                         threads=self.config.threads,
                         pipeline_depth=self.config.range_pipeline_depth,
                         job_dir=job_dir,
+                        match_backend=self._match_backend,
                     )
                 elif job_dir is not None:
                     # journalled single-pair path: the chunked driver is the
@@ -579,10 +603,15 @@ class ProofService:
                         chunk_size=self.config.range_chunk_size,
                         metrics=self.metrics,
                         job_dir=job_dir,
+                        match_backend=self._match_backend,
                     )
                 else:
                     bundle = generate_event_proofs_for_range(
-                        self._store, pairs, self._spec, metrics=self.metrics
+                        self._store,
+                        pairs,
+                        self._spec,
+                        metrics=self.metrics,
+                        match_backend=self._match_backend,
                     )
         self.metrics.count("serve.batches.generate")
         # Wall-clock microseconds the range driver spent journalling chunk

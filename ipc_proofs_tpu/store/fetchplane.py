@@ -116,22 +116,39 @@ class FetchPlane:
     counted under it.
     """
 
+    # --speculate-depth auto: start here and back off one level per
+    # window whose counted waste ratio crosses the threshold
+    AUTO_START_DEPTH = 2
+    AUTO_WASTE_THRESHOLD = 0.6
+
     def __init__(
         self,
         client,
         local=None,
         *,
         batch_max: int = 64,
-        speculate_depth: int = 1,
+        speculate_depth: "int | str" = 1,
         workers: int = 2,
         spec_queue_cap: int = 512,
         landed_cap: int = 2048,
+        batch_verify: bool = False,
+        auto_window: int = 64,
         metrics=None,
     ):
         self._client = client
         self._local = local
         self.batch_max = max(1, int(batch_max))
+        self.adaptive_depth = speculate_depth == "auto"
+        if self.adaptive_depth:
+            speculate_depth = self.AUTO_START_DEPTH
+        # adaptive mode lowers this under _cond; the unlocked reads in
+        # speculate()/_fulfil are advisory depth gates, so a stale read
+        # costs at most one over-deep speculation wave, never correctness
         self.speculate_depth = max(0, int(speculate_depth))
+        self.batch_verify = batch_verify
+        self._auto_window = max(1, int(auto_window))
+        self._auto_fetched0 = 0  # window snapshot; guarded-by: _cond
+        self._auto_used0 = 0  # guarded-by: _cond
         self._n_workers = max(1, int(workers))
         self.spec_queue_cap = max(1, int(spec_queue_cap))
         self.landed_cap = max(1, int(landed_cap))
@@ -241,6 +258,7 @@ class FetchPlane:
                 "speculative_wasted": fetched - used,
                 "waste_pct": (100.0 * (fetched - used) / fetched) if fetched else 0.0,
                 "in_flight": len(self._wants),
+                "speculate_depth": self.speculate_depth,
             }
 
     def close(self) -> None:
@@ -455,13 +473,35 @@ class FetchPlane:
                 except Exception as exc:  # fail-soft: captured per-want; demand waiters re-raise it typed
                     blocks.append(exc)
         verifies = getattr(self._client, "verifies_integrity", False)
+        verdicts: "dict[int, bool]" = {}
+        if self.batch_verify and not verifies:
+            # one fused device call verifies the whole landed wave (the
+            # chunk-granular integrity batching — per-want semantics below
+            # are unchanged, only the hashing lane moves)
+            wave = [
+                (i, want, data)
+                for i, (want, data) in enumerate(zip(batch, blocks))
+                if data is not None and not isinstance(data, Exception)
+            ]
+            if wave:
+                from ipc_proofs_tpu.ops.verify_jax import verify_blocks_batch
+
+                oks = verify_blocks_batch(
+                    [w.cid for _, w, _ in wave],
+                    [d for _, _, d in wave],
+                    metrics=self._metrics,
+                )
+                verdicts = {i: ok for (i, _, _), ok in zip(wave, oks)}
         completions: "list[tuple[_Want, Optional[bytes], Optional[Exception]]]" = []
         chase: "list[tuple[bytes, int]]" = []
-        for want, data in zip(batch, blocks):
+        for i, (want, data) in enumerate(zip(batch, blocks)):
             if isinstance(data, Exception):
                 completions.append((want, None, data))
                 continue
-            if data is not None and not verifies and not verify_block_bytes(want.cid, data):
+            ok = verdicts.get(i)
+            if ok is None and data is not None and not verifies:
+                ok = verify_block_bytes(want.cid, data)
+            if data is not None and not verifies and not ok:
                 if want.speculative:
                     # discard before anything can observe it; the demand
                     # path will refetch-and-raise with endpoint blame
@@ -525,7 +565,30 @@ class FetchPlane:
             while len(self._landed_spec) > self.landed_cap:
                 evicted, _ = self._landed_spec.popitem(last=False)
                 self._wants.pop(evicted, None)
+            if self.adaptive_depth:
+                self._maybe_downshift_locked()
             self._cond.notify_all()
+
+    @locked
+    def _maybe_downshift_locked(self) -> None:
+        """Adaptive speculation backoff (--speculate-depth auto): once a
+        window's worth of speculative fetches has landed, compare that
+        window's waste ratio (fetched-but-not-yet-used over fetched)
+        against the threshold and lower the depth one level when it
+        spikes — atypical state shapes (wide HAMT fan-out, sparse reads)
+        stop paying for deep speculation. Use-lag makes the ratio an
+        overestimate, so backoff is conservative by construction; depth 0
+        still batches demand fetches."""
+        window = self._spec_fetched - self._auto_fetched0
+        if window < self._auto_window:
+            return
+        used = self._spec_used - self._auto_used0
+        waste_ratio = (window - used) / window
+        self._auto_fetched0 = self._spec_fetched
+        self._auto_used0 = self._spec_used
+        if waste_ratio > self.AUTO_WASTE_THRESHOLD and self.speculate_depth > 0:
+            self.speculate_depth -= 1  # ipclint: disable=race-unannotated (lowered only here under _cond; unlocked readers tolerate one stale wave — backoff, not correctness)
+            self._metrics.count("fetch.speculate_depth_downshifts")
 
     def _fail_batch(self, batch: "list[_Want]", exc: Exception) -> None:
         self._complete([(w, None, exc) for w in batch])

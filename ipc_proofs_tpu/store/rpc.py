@@ -24,8 +24,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Iterable, Optional
 
-from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, IDENTITY, SHA2_256
-from ipc_proofs_tpu.core.hashes import blake2b_256
+from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, IDENTITY, KECCAK_256, SHA2_256
+from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
 from ipc_proofs_tpu.utils.lockdep import named_lock
 
 __all__ = [
@@ -85,13 +85,17 @@ def verify_block_bytes(cid: CID, data: bytes) -> bool:
     Returns True when the digest matches (or the multihash function is one
     we cannot compute — unknown codes are accepted rather than rejected,
     since we cannot prove them wrong; every CID this codebase produces or
-    fetches uses blake2b-256 / sha2-256 / identity, all verifiable).
+    fetches uses blake2b-256 / sha2-256 / keccak-256 / identity, all
+    verifiable). The batch form is `ops.verify_jax.verify_blocks_batch`
+    — verdict-identical, one fused device call per chunk.
     """
     mh = cid.mh_code
     if mh == BLAKE2B_256:
         return blake2b_256(bytes(data)) == cid.digest
     if mh == SHA2_256:
         return hashlib.sha256(bytes(data)).digest() == cid.digest
+    if mh == KECCAK_256:
+        return keccak256(bytes(data)) == cid.digest
     if mh == IDENTITY:
         return bytes(data) == bytes(cid.digest)
     return True

@@ -31,6 +31,7 @@ __all__ = [
     "SERVE_COUNTERS",
     "STOREX_COUNTERS",
     "CLUSTER_COUNTERS",
+    "VERIFY_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
     "DURABILITY_GAUGES",
@@ -101,6 +102,10 @@ RESILIENCE_COUNTERS = (
 #   fetch.speculative_integrity_drops — speculative blocks that failed
 #                             multihash verification and were discarded
 #                             before use (demand path refetches + raises)
+#   fetch.speculate_depth_downshifts — adaptive-depth backoffs: windows
+#                             whose counted waste ratio crossed the
+#                             threshold and lowered speculate_depth by one
+#                             (--speculate-depth auto)
 ASYNCFETCH_COUNTERS = (
     "rpc.batch_calls",
     "rpc.batched_reads",
@@ -116,6 +121,7 @@ ASYNCFETCH_COUNTERS = (
     "fetch.speculative_wasted",
     "fetch.speculative_dropped",
     "fetch.speculative_integrity_drops",
+    "fetch.speculate_depth_downshifts",
 )
 
 # Counter vocabulary of the durability layer (jobs/journal.py, jobs/job.py,
@@ -182,6 +188,11 @@ OBSERVABILITY_COUNTERS = (
 #   range_storage_proofs    — storage-slot proofs emitted
 #   range_match_coalesced   — device match calls saved by the coalescer
 #                             (requests folded into another chunk's batch)
+#   range_match_retraces    — first-seen coalesced dispatch shapes: each
+#                             tick is a (bucketed) batch shape the match
+#                             kernel had not compiled before, so the
+#                             counter growing like O(log n) — not one per
+#                             batch — is the no-unbounded-retracing pin
 #   batch_contracts         — distinct contracts in a storage batch
 #   batch_slots             — storage slots read in a storage batch
 RANGE_COUNTERS = (
@@ -191,8 +202,29 @@ RANGE_COUNTERS = (
     "range_proofs",
     "range_storage_proofs",
     "range_match_coalesced",
+    "range_match_retraces",
     "batch_contracts",
     "batch_slots",
+)
+
+# Counter vocabulary of the batched integrity plane
+# (ops/verify_jax.py::verify_blocks_batch — wired into the fetch plane's
+# landed waves, the follower's prefetch batches, and SegmentStore.get_many):
+#   verify.batch_calls    — verify_blocks_batch invocations (≤ 1 per
+#                           read-path chunk by construction)
+#   verify.batch_blocks   — blocks those calls verified (all lanes)
+#   verify.device_calls   — fused kernel dispatches (one per size-class
+#                           chunk; the bench's ≤-1-device-call-per-chunk
+#                           assertion reads this)
+#   verify.device_blocks  — blocks hashed on the device lane
+#   verify.scalar_blocks  — blocks verified on the scalar lane (odd codes,
+#                           sub-crossover batches, device fail-soft)
+VERIFY_COUNTERS = (
+    "verify.batch_calls",
+    "verify.batch_blocks",
+    "verify.device_calls",
+    "verify.device_blocks",
+    "verify.scalar_blocks",
 )
 
 # Counter vocabulary of the serve plane (serve/batcher.py,

@@ -1,0 +1,153 @@
+"""Adaptive speculation-depth tests (--speculate-depth auto): the plane
+starts at AUTO_START_DEPTH, watches the per-window waste ratio, and
+downshifts one level per wasteful window until it bottoms out at 0 —
+counted in ``fetch.speculate_depth_downshifts`` and visible in
+``stats()``.  Plain integer depths never move.  All hermetic tier-1."""
+
+import time
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+from ipc_proofs_tpu.store.faults import LocalLotusSession
+from ipc_proofs_tpu.store.fetchplane import FetchPlane
+from ipc_proofs_tpu.store.rpc import LotusClient
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+def _blocks(n: int, tag: bytes = b"spec") -> "list[tuple[CID, bytes]]":
+    out = []
+    for i in range(n):
+        data = (tag + b"-%04d-" % i) * (i % 5 + 2)
+        out.append((CID.hash_of(data), data))
+    return out
+
+
+def _store_with(blocks) -> MemoryBlockstore:
+    bs = MemoryBlockstore()
+    for cid, data in blocks:
+        bs.put_keyed(cid, data)
+    return bs
+
+
+def _client(bs, metrics=None):
+    return LotusClient(
+        "http://adaptive-spec-test", session=LocalLotusSession(bs),
+        metrics=metrics or Metrics(),
+    )
+
+
+def _wait_until(cond, timeout_s: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+class TestAutoDepth:
+    def test_auto_starts_at_the_default_depth(self):
+        bs = _store_with([])
+        with FetchPlane(_client(bs), local={}, speculate_depth="auto") as plane:
+            assert plane.adaptive_depth is True
+            assert plane.speculate_depth == FetchPlane.AUTO_START_DEPTH
+            assert plane.stats()["speculate_depth"] == FetchPlane.AUTO_START_DEPTH
+
+    def test_integer_depth_is_not_adaptive(self):
+        bs = _store_with([])
+        with FetchPlane(_client(bs), local={}, speculate_depth=3) as plane:
+            assert plane.adaptive_depth is False
+            assert plane.speculate_depth == 3
+
+    def test_wasteful_windows_downshift_to_zero(self):
+        """Two windows of pure waste (speculated, landed, never read) take
+        auto depth 2 → 1 → 0; at 0 further speculation is refused."""
+        window = 8
+        blocks = _blocks(3 * window)
+        bs = _store_with(blocks)
+        m = Metrics()
+        with FetchPlane(
+            _client(bs, m), local={}, metrics=m,
+            speculate_depth="auto", auto_window=window,
+        ) as plane:
+            cids = [c for c, _ in blocks]
+            plane.speculate(cids[:window])
+            assert _wait_until(
+                lambda: plane.stats()["speculative_fetched"] >= window
+            )
+            assert _wait_until(lambda: plane.stats()["speculate_depth"] == 1)
+            plane.speculate(cids[window : 2 * window])
+            assert _wait_until(
+                lambda: plane.stats()["speculative_fetched"] >= 2 * window
+            )
+            assert _wait_until(lambda: plane.stats()["speculate_depth"] == 0)
+            # depth 0: new speculation is dropped at the door
+            plane.speculate(cids[2 * window :])
+            time.sleep(0.05)
+            assert plane.stats()["speculative_fetched"] == 2 * window
+        counters = m.snapshot()["counters"]
+        assert counters["fetch.speculate_depth_downshifts"] == 2
+
+    def test_useful_windows_hold_the_depth(self):
+        """Speculation that is consumed as it lands stays put — the
+        window's waste ratio never crosses AUTO_WASTE_THRESHOLD.  Waves of
+        two, consumed immediately: when the window check fires at 8
+        fetched, at most the newest wave is still unread (ratio ≤ 0.25)."""
+        window = 8
+        blocks = _blocks(12)  # 1.5 windows
+        bs = _store_with(blocks)
+        m = Metrics()
+        with FetchPlane(
+            _client(bs, m), local={}, metrics=m,
+            speculate_depth="auto", auto_window=window,
+        ) as plane:
+            for i in range(0, len(blocks), 2):
+                wave = blocks[i : i + 2]
+                plane.speculate([c for c, _ in wave])
+                assert _wait_until(
+                    lambda: plane.stats()["speculative_fetched"] >= i + 2
+                )
+                for cid, data in wave:
+                    assert plane.get(cid) == data
+            stats = plane.stats()
+            assert stats["speculative_used"] == len(blocks)
+            assert stats["speculate_depth"] == FetchPlane.AUTO_START_DEPTH
+        assert (
+            m.snapshot()["counters"].get("fetch.speculate_depth_downshifts", 0)
+            == 0
+        )
+
+    def test_integer_depth_never_downshifts(self):
+        window = 8
+        blocks = _blocks(window)
+        bs = _store_with(blocks)
+        m = Metrics()
+        with FetchPlane(
+            _client(bs, m), local={}, metrics=m,
+            speculate_depth=2, auto_window=window,
+        ) as plane:
+            plane.speculate([c for c, _ in blocks])  # pure waste, never read
+            assert _wait_until(
+                lambda: plane.stats()["speculative_fetched"] >= window
+            )
+            time.sleep(0.05)
+            assert plane.stats()["speculate_depth"] == 2
+        assert (
+            m.snapshot()["counters"].get("fetch.speculate_depth_downshifts", 0)
+            == 0
+        )
+
+
+class TestCliParsing:
+    def test_auto_and_integers_parse(self, tmp_path):
+        import argparse
+
+        from ipc_proofs_tpu.cli import speculate_depth_arg
+
+        assert speculate_depth_arg("auto") == "auto"
+        assert speculate_depth_arg("3") == 3
+        assert speculate_depth_arg("0") == 0
+        with pytest.raises(argparse.ArgumentTypeError, match="integer or 'auto'"):
+            speculate_depth_arg("bogus")

@@ -190,6 +190,15 @@ _CLUSTER_OK = {
     "cluster_requests": 64,
 }
 
+_ONCHIP_OK = {
+    "device_linearity_Nchip": 0.92,
+    "batch_verify_speedup": 4.1,
+    "onchip_devices": 4,
+    "onchip_match_events": 1 << 20,
+    "onchip_verify_blocks": 1024,
+    "onchip_device_calls": 2,
+}
+
 _E2E_OK = {
     "metric": "event_proofs_per_sec_4k_range_e2e",
     "value": 5000.0,
@@ -212,6 +221,7 @@ class TestOrchestrate:
             "e2e": [(dict(_E2E_OK, platform="tpu"), "ok:tpu")],
             "kernel": [({"device_mask_kernel_events_per_sec": 6e9}, "ok:tpu")],
             "cid": [({"witness_cid_kernel_per_sec": 1e8}, "ok:tpu")],
+            "onchip": [(dict(_ONCHIP_OK), "ok:tpu")],
             "baseline": [({"scalar_baseline_proofs_per_sec": 125.0}, "ok:cpu")],
             "native_baseline": [({"native_baseline_proofs_per_sec": 1000.0}, "ok:cpu")],
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
@@ -251,6 +261,10 @@ class TestOrchestrate:
         assert out["sync_rpc_roundtrips_per_proof"] == 13.87
         assert out["cold_speedup_vs_sync_walker"] == 2.98
         assert out["speculate_waste_pct"] == 41.69
+        assert out["legs"]["onchip"] == "ok:tpu"
+        assert out["device_linearity_Nchip"] == 0.92
+        assert out["batch_verify_speedup"] == 4.1
+        assert out["onchip_devices"] == 4
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -258,6 +272,7 @@ class TestOrchestrate:
             "e2e": [(None, "timeout:default"), (dict(_E2E_OK), "ok:cpu")],
             "kernel": [({"device_mask_kernel_events_per_sec": 1e8}, "ok:cpu")],
             "cid": [({"witness_cid_kernel_per_sec": 1e4}, "ok:cpu")],
+            "onchip": [(dict(_ONCHIP_OK, onchip_devices=1), "ok:cpu")],
             "baseline": [({"scalar_baseline_proofs_per_sec": 100.0}, "ok:cpu")],
             "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
@@ -277,10 +292,11 @@ class TestOrchestrate:
         # cpu (not just reported as cpu by the canned results)
         assert requested == [
             ("e2e", "default"), ("e2e", "cpu"), ("kernel", "cpu"),
-            ("cid", "cpu"), ("baseline", "cpu"), ("native_baseline", "cpu"),
-            ("serve", "cpu"), ("witness", "cpu"), ("resilience", "cpu"),
-            ("durability", "cpu"), ("observability", "cpu"),
-            ("storage", "cpu"), ("asyncfetch", "cpu"), ("cluster", "cpu"),
+            ("cid", "cpu"), ("onchip", "cpu"), ("baseline", "cpu"),
+            ("native_baseline", "cpu"), ("serve", "cpu"), ("witness", "cpu"),
+            ("resilience", "cpu"), ("durability", "cpu"),
+            ("observability", "cpu"), ("storage", "cpu"),
+            ("asyncfetch", "cpu"), ("cluster", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -288,6 +304,7 @@ class TestOrchestrate:
             "e2e": [(dict(_E2E_OK, platform="tpu"), "ok:tpu")],
             "kernel": [(None, "timeout:default")],
             "cid": [({"witness_cid_kernel_per_sec": 1e4}, "ok:cpu")],
+            "onchip": [(dict(_ONCHIP_OK), "ok:cpu")],
             "baseline": [({"scalar_baseline_proofs_per_sec": 100.0}, "ok:cpu")],
             "native_baseline": [({"native_baseline_proofs_per_sec": 800.0}, "ok:cpu")],
             "serve": [(dict(_SERVE_OK), "ok:cpu")],
@@ -336,6 +353,7 @@ class TestOrchestrate:
             "e2e": [(None, "timeout:default"), (None, "timeout:cpu")],
             "kernel": [(None, "timeout:cpu")],
             "cid": [(None, "timeout:cpu")],
+            "onchip": [(None, "timeout:cpu")],
             "baseline": [(None, "error:cpu")],
             "native_baseline": [(None, "error:cpu")],
             "serve": [(None, "error:cpu")],
@@ -362,7 +380,7 @@ class TestOrchestrate:
             "cold_rpc_roundtrips_per_proof", "sync_rpc_roundtrips_per_proof",
             "cold_speedup_vs_sync_walker", "speculate_waste_pct",
             "cluster_linearity_4shard", "aggregate_proofs_per_sec",
-            "steal_events",
+            "steal_events", "device_linearity_Nchip", "batch_verify_speedup",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -19,6 +19,7 @@ from check_bench_schema import (  # noqa: E402
     check_artifact,
     cluster_gate_skip_reason,
     main,
+    onchip_gate_skip_reason,
     speedup_gate_skip_reason,
 )
 
@@ -239,3 +240,71 @@ class TestClusterGate:
         main(["--require-current", str(path)])
         out = capsys.readouterr().out
         assert "cluster gate SKIPPED" in out and "host_cores=1" in out
+
+
+class TestOnchipGate:
+    """device_linearity_Nchip ≥ 0.8 is enforced (require_current) on
+    multi-device hosts, and skipped WITH A REASON when the mesh and the
+    single-device comparator share one chip (ratio = pjit overhead, not
+    device scaling)."""
+
+    def _current(self):
+        with open(NEWEST) as fh:
+            obj = json.load(fh)
+        # keep the unrelated gates green whatever vintage NEWEST is
+        obj["host_cores"] = 8
+        obj["pipeline_speedup_vs_serial"] = 1.2
+        obj["cluster_linearity_4shard"] = 0.9
+        obj["batch_verify_speedup"] = 1.5
+        return obj
+
+    def test_sublinear_scaling_fails_on_multidevice_host(self):
+        obj = self._current()
+        obj["onchip_devices"] = 4
+        obj["device_linearity_Nchip"] = 0.4
+        assert check_artifact(obj) == []  # non-current vintages unaffected
+        problems = check_artifact(obj, require_current=True)
+        assert any("onchip gate" in p for p in problems), problems
+
+    def test_linearity_at_or_above_gate_passes(self):
+        obj = self._current()
+        obj["onchip_devices"] = 4
+        obj["device_linearity_Nchip"] = 0.8
+        assert not any(
+            "onchip gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_missing_linearity_fails_on_multidevice_host(self):
+        obj = self._current()
+        obj["onchip_devices"] = 4
+        obj["device_linearity_Nchip"] = None
+        problems = check_artifact(obj, require_current=True)
+        assert any("onchip gate" in p for p in problems), problems
+
+    @pytest.mark.parametrize("devices", [1, 0, None])
+    def test_gate_skipped_with_reason_on_single_device(self, devices):
+        obj = self._current()
+        obj["onchip_devices"] = devices
+        obj["device_linearity_Nchip"] = 0.2
+        reason = onchip_gate_skip_reason(obj)
+        assert reason is not None and str(devices) in reason
+        assert not any(
+            "onchip gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_gate_applies_above_one_device(self):
+        obj = self._current()
+        obj["onchip_devices"] = 2
+        assert onchip_gate_skip_reason(obj) is None
+
+    def test_cli_prints_skip_reason(self, tmp_path, capsys):
+        obj = self._current()
+        obj["onchip_devices"] = 1
+        obj["device_linearity_Nchip"] = 0.2
+        path = tmp_path / "BENCH_single_chip_host.json"
+        path.write_text(json.dumps(obj))
+        main(["--require-current", str(path)])
+        out = capsys.readouterr().out
+        assert "onchip gate SKIPPED" in out and "onchip_devices=1" in out

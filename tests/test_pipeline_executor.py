@@ -350,8 +350,11 @@ class TestMatchCoalescer:
             )
             assert np.array_equal(r.result, expect), r.key
             assert r.done.is_set() and r.exc is None
-        # key_a rode ONE concatenated call, key_b its own: 2 device calls
-        assert sorted(backend.calls) == [4, 9]
+        # key_a rode ONE concatenated call, key_b its own: 2 device calls,
+        # each padded to its pow-2 dispatch bucket (PR 12 mesh padding)
+        from ipc_proofs_tpu.ops.match_jax import pad_to_bucket
+
+        assert sorted(backend.calls) == sorted([pad_to_bucket(4), pad_to_bucket(9)])
         assert m.snapshot()["counters"]["range_match_coalesced"] == 2
 
     def test_concurrent_callers_coalesce(self):

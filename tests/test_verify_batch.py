@@ -1,0 +1,275 @@
+"""Device-batched multihash verification tests: the differential grid
+pinning `verify_blocks_batch` verdict-identical to `verify_block_bytes`
+over every supported multihash code × message size × corrupt-bit position
+(on both the device and scalar lanes), plus the read-path wiring —
+SegmentStore.get_many / verify_scan, the fetch plane's landed-wave batch
+verify, and the chain follower's prefetch wave. All hermetic and tier-1
+(JAX_PLATFORMS=cpu: the "device" lane is XLA-on-CPU, same kernels)."""
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import (
+    BLAKE2B_256,
+    CID,
+    DAG_CBOR,
+    IDENTITY,
+    KECCAK_256,
+    SHA2_256,
+)
+from ipc_proofs_tpu.core.hashes import keccak256
+from ipc_proofs_tpu.ops.verify_jax import batch_min_bytes, verify_blocks_batch
+from ipc_proofs_tpu.store.rpc import verify_block_bytes
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+UNKNOWN_CODE = 0x15  # no verifier for it: accepted by contract
+
+# straddles the blake2b (128 B) and keccak (136 B) block boundaries
+SIZES = (0, 1, 100, 127, 128, 129, 136, 137, 300, 1500)
+
+
+def _cid_for(code: int, data: bytes) -> CID:
+    if code == KECCAK_256:
+        return CID(1, DAG_CBOR, KECCAK_256, keccak256(data))
+    if code == UNKNOWN_CODE:
+        return CID(1, DAG_CBOR, UNKNOWN_CODE, b"\x00" * 32)
+    return CID.hash_of(data, mh_code=code)
+
+
+def _flip(data: bytes, bit: int) -> bytes:
+    byte, off = divmod(bit, 8)
+    return data[:byte] + bytes([data[byte] ^ (1 << off)]) + data[byte + 1 :]
+
+
+def _grid() -> "tuple[list[CID], list[bytes]]":
+    """Every code × size, plus corrupt variants with a bit flipped at the
+    start, middle, and end of the payload."""
+    cids, blocks = [], []
+    for code in (BLAKE2B_256, SHA2_256, KECCAK_256, IDENTITY, UNKNOWN_CODE):
+        for size in SIZES:
+            data = bytes((i * 31 + size + code) % 256 for i in range(size))
+            cids.append(_cid_for(code, data))
+            blocks.append(data)
+            if size == 0:
+                continue
+            nbits = size * 8
+            for bit in (0, nbits // 2, nbits - 1):
+                cids.append(_cid_for(code, data))
+                blocks.append(_flip(data, bit))
+    return cids, blocks
+
+
+class TestDifferentialGrid:
+    @pytest.mark.parametrize("lane", ["device", "scalar"])
+    def test_batch_equals_scalar_verdicts(self, lane, monkeypatch):
+        monkeypatch.setenv(
+            "IPC_VERIFY_MIN_BYTES", "0" if lane == "device" else "999999999"
+        )
+        cids, blocks = _grid()
+        m = Metrics()
+        got = verify_blocks_batch(cids, blocks, metrics=m)
+        want = [verify_block_bytes(c, b) for c, b in zip(cids, blocks)]
+        assert got == want
+        counters = m.snapshot()["counters"]
+        assert counters["verify.batch_blocks"] == len(cids)
+        if lane == "device":
+            assert counters["verify.device_calls"] >= 1
+        else:
+            assert counters.get("verify.device_calls", 0) == 0
+
+    @pytest.mark.parametrize("lane", ["device", "scalar"])
+    def test_every_flipped_bit_is_caught(self, lane, monkeypatch):
+        """For the verified codes, EVERY corrupt variant must fail — one
+        undetected bit flip is an integrity hole, not a rounding error."""
+        monkeypatch.setenv(
+            "IPC_VERIFY_MIN_BYTES", "0" if lane == "device" else "999999999"
+        )
+        cids, blocks = _grid()
+        got = verify_blocks_batch(cids, blocks)
+        for cid, data, ok in zip(cids, blocks, got):
+            if cid.mh_code == UNKNOWN_CODE:
+                assert ok is True  # unknown codes are accepted by contract
+                continue
+            expect = verify_block_bytes(cid, data)
+            assert ok == expect, (cid.mh_code, len(data))
+        # at least one corrupt variant exists per verified code and none pass
+        for code in (BLAKE2B_256, SHA2_256, KECCAK_256, IDENTITY):
+            bad = [
+                ok
+                for cid, data, ok in zip(cids, blocks, got)
+                if cid.mh_code == code and not verify_block_bytes(cid, data)
+            ]
+            assert bad and not any(bad)
+
+    def test_size_class_mix_one_huge_block(self, monkeypatch):
+        """A single huge block must not inflate the small blocks' padding —
+        and must not change anyone's verdict (size-class chunking)."""
+        monkeypatch.setenv("IPC_VERIFY_MIN_BYTES", "0")
+        small = [b"s%02d" % i * 20 for i in range(40)]
+        huge = bytes(range(256)) * 64  # 16 KiB: a different pow2 class
+        blocks = small + [huge]
+        cids = [CID.hash_of(b) for b in blocks]
+        m = Metrics()
+        assert verify_blocks_batch(cids, blocks, metrics=m) == [True] * len(blocks)
+        counters = m.snapshot()["counters"]
+        assert counters["verify.device_calls"] == 2  # one per size class
+
+    def test_empty_and_mismatched_inputs(self):
+        assert verify_blocks_batch([], []) == []
+        with pytest.raises(ValueError):
+            verify_blocks_batch([CID.hash_of(b"x")], [])
+
+    def test_crossover_default_sends_small_batches_scalar(self, monkeypatch):
+        monkeypatch.delenv("IPC_VERIFY_MIN_BYTES", raising=False)
+        assert batch_min_bytes() == 256 * 1024
+        blocks = [b"tiny-%d" % i for i in range(4)]
+        cids = [CID.hash_of(b) for b in blocks]
+        m = Metrics()
+        assert verify_blocks_batch(cids, blocks, metrics=m) == [True] * 4
+        counters = m.snapshot()["counters"]
+        assert counters.get("verify.device_calls", 0) == 0
+        assert counters["verify.scalar_blocks"] == 4
+
+
+class TestSegmentStoreWiring:
+    def _blocks(self, n):
+        return [
+            (CID.hash_of((b"seg-%03d-" % i) * (i % 4 + 2)), (b"seg-%03d-" % i) * (i % 4 + 2))
+            for i in range(n)
+        ]
+
+    def test_get_many_matches_scalar_gets(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("IPC_VERIFY_MIN_BYTES", "0")
+        from ipc_proofs_tpu.storex import SegmentStore
+
+        m = Metrics()
+        store = SegmentStore(str(tmp_path), metrics=m, batch_verify=True)
+        blocks = self._blocks(12)
+        for cid, data in blocks:
+            store.put(cid, data)
+        missing = CID.hash_of(b"never stored")
+        got = store.get_many([c for c, _ in blocks] + [missing])
+        assert got == {c: d for c, d in blocks}
+        counters = m.snapshot()["counters"]
+        assert counters["storex.disk_hits"] == 12
+        assert counters["storex.disk_misses"] == 1
+        assert counters["verify.batch_calls"] == 1
+        store.close()
+
+    def test_get_many_evicts_multihash_liars(self, tmp_path):
+        from ipc_proofs_tpu.storex import SegmentStore
+
+        m = Metrics()
+        store = SegmentStore(str(tmp_path), metrics=m, batch_verify=True)
+        honest = self._blocks(3)
+        for cid, data in honest:
+            store.put(cid, data)
+        liar = CID.hash_of(b"the bytes this cid claims")
+        store.put(liar, b"entirely different bytes")  # frame CRC still valid
+        got = store.get_many([c for c, _ in honest] + [liar])
+        assert got == {c: d for c, d in honest}
+        assert liar not in got
+        counters = m.snapshot()["counters"]
+        assert counters["storex.integrity_evictions"] == 1
+        assert not store.contains(liar)  # dropped, same as a scalar get
+        store.close()
+
+    def test_verify_scan_drops_liars_at_open(self, tmp_path):
+        from ipc_proofs_tpu.storex import SegmentStore
+
+        store = SegmentStore(str(tmp_path))
+        honest = self._blocks(4)
+        for cid, data in honest:
+            store.put(cid, data)
+        liar = CID.hash_of(b"claimed content")
+        store.put(liar, b"actual content")
+        store.close()
+
+        m = Metrics()
+        reopened = SegmentStore(
+            str(tmp_path), metrics=m, batch_verify=True, verify_scan=True
+        )
+        assert not reopened.contains(liar)
+        for cid, data in honest:
+            assert reopened.get(cid) == data
+        assert m.snapshot()["counters"]["storex.integrity_evictions"] == 1
+        reopened.close()
+
+
+class TestFetchPlaneWiring:
+    def test_landed_wave_batch_verifies(self, monkeypatch):
+        monkeypatch.setenv("IPC_VERIFY_MIN_BYTES", "0")
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+        from ipc_proofs_tpu.store.faults import LocalLotusSession
+        from ipc_proofs_tpu.store.fetchplane import FetchPlane
+        from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient
+
+        blocks = [
+            (CID.hash_of(b"plane-%d-" % i * 3), b"plane-%d-" % i * 3)
+            for i in range(6)
+        ]
+        bs = MemoryBlockstore()
+        for cid, data in blocks:
+            bs.put_keyed(cid, data)
+        liar = CID.hash_of(b"honest plane bytes")
+        bs.put_keyed(liar, b"corrupt plane bytes")
+        m = Metrics()
+        client = LotusClient(
+            "http://verify-batch-test", session=LocalLotusSession(bs), metrics=m
+        )
+        with FetchPlane(client, local={}, metrics=m, batch_verify=True) as plane:
+            for cid, data in blocks:
+                assert plane.get(cid) == data
+            with pytest.raises(IntegrityError):
+                plane.get(liar)
+        counters = m.snapshot()["counters"]
+        assert counters["verify.batch_calls"] >= 1
+        assert counters["rpc.integrity_failures"] >= 1
+
+    def test_batch_verify_off_is_the_default_scalar_path(self):
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+        from ipc_proofs_tpu.store.faults import LocalLotusSession
+        from ipc_proofs_tpu.store.fetchplane import FetchPlane
+        from ipc_proofs_tpu.store.rpc import LotusClient
+
+        cid = CID.hash_of(b"default-path block")
+        bs = MemoryBlockstore()
+        bs.put_keyed(cid, b"default-path block")
+        m = Metrics()
+        client = LotusClient(
+            "http://verify-default-test", session=LocalLotusSession(bs), metrics=m
+        )
+        with FetchPlane(client, local={}, metrics=m) as plane:
+            assert plane.get(cid) == b"default-path block"
+        assert m.snapshot()["counters"].get("verify.batch_calls", 0) == 0
+
+
+class TestFollowerWiring:
+    def test_prefetch_wave_batch_verifies_and_skips_liars(self, monkeypatch):
+        monkeypatch.setenv("IPC_VERIFY_MIN_BYTES", "0")
+        from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
+        from ipc_proofs_tpu.store.faults import LocalLotusSession
+        from ipc_proofs_tpu.store.rpc import LotusClient
+        from ipc_proofs_tpu.storex import ChainFollower
+
+        blocks = [
+            (CID.hash_of(b"follow-%d-" % i * 4), b"follow-%d-" % i * 4)
+            for i in range(5)
+        ]
+        bs = MemoryBlockstore()
+        for cid, data in blocks:
+            bs.put_keyed(cid, data)
+        liar = CID.hash_of(b"honest follower bytes")
+        bs.put_keyed(liar, b"corrupt follower bytes")
+        m = Metrics()
+        client = LotusClient(
+            "http://follower-batch-test", session=LocalLotusSession(bs), metrics=m
+        )
+        local = MemoryBlockstore()
+        follower = ChainFollower(client, local, metrics=m, batch_verify=True)
+        out = follower._fetch_blocks([c for c, _ in blocks] + [liar])
+        assert out == {c: d for c, d in blocks}
+        assert local.get(liar) is None  # the liar never reached the store
+        counters = m.snapshot()["counters"]
+        assert counters["verify.batch_calls"] >= 1
+        assert counters["follow.blocks_prefetched"] == 5
+        assert counters["follow.errors"] == 1

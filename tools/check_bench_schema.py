@@ -13,7 +13,10 @@ flags. Two strictness levels:
   the perf gates: ``pipeline_speedup_vs_serial >= 1.0`` and
   ``cluster_linearity_4shard >= 0.8``, each whenever ``host_cores > 2``
   (hosts without spare cores skip the gates with a printed reason — see
-  `speedup_gate_skip_reason` / `cluster_gate_skip_reason`).
+  `speedup_gate_skip_reason` / `cluster_gate_skip_reason`), plus
+  ``device_linearity_Nchip >= 0.8`` whenever ``onchip_devices > 1``
+  (single-device hosts skip with a printed reason — see
+  `onchip_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -123,6 +126,12 @@ _KNOWN_TYPES = {
     "cluster_rps_4shard": _NUM,
     "cluster_pairs": int,
     "cluster_requests": int,
+    "device_linearity_Nchip": _NUM,
+    "batch_verify_speedup": _NUM,
+    "onchip_devices": int,
+    "onchip_match_events": int,
+    "onchip_verify_blocks": int,
+    "onchip_device_calls": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -152,6 +161,7 @@ _CURRENT_REQUIRED = (
     "cold_rpc_roundtrips_per_proof", "sync_rpc_roundtrips_per_proof",
     "cold_speedup_vs_sync_walker", "speculate_waste_pct",
     "cluster_linearity_4shard", "aggregate_proofs_per_sec", "steal_events",
+    "device_linearity_Nchip", "batch_verify_speedup",
     "legs", "watchdog_fallback",
 )
 
@@ -271,6 +281,26 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
         # ≥ 80% of ideal linear scaling over 1 shard. A 1-core host
         # time-slices the shard processes (linearity collapses by design),
         # so the gate applies on the same host shape as the speedup gate.
+        # the onchip gate: with more than one accelerator device, the
+        # mesh-sharded match kernel must keep ≥ 80% of ideal linear
+        # scaling over the single-device path. A 1-device host runs both
+        # sides on the same chip — the ratio then measures pjit dispatch
+        # overhead, not scaling — so the gate only applies multi-device.
+        if onchip_gate_skip_reason(obj) is None:
+            linearity = obj.get("device_linearity_Nchip")
+            if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
+                problems.append(
+                    "onchip gate: device_linearity_Nchip is "
+                    f"{linearity!r} on a {obj.get('onchip_devices')}-device "
+                    "host (onchip leg did not run?)"
+                )
+            elif linearity < 0.8:
+                problems.append(
+                    f"onchip gate: device_linearity_Nchip={linearity} "
+                    f"< 0.8 on a {obj.get('onchip_devices')}-device host — "
+                    "mesh-sharded matching must scale near-linearly across "
+                    "local devices"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -333,6 +363,22 @@ def cluster_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def onchip_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the ≥0.8 device-linearity gate does NOT apply to this artifact
+    (None when it does). Callers print the reason so a skipped gate is
+    visible, never silent."""
+    devices = obj.get("onchip_devices")
+    if not isinstance(devices, int):
+        return f"onchip_devices={devices!r} (unknown device count)"
+    if devices <= 1:
+        return (
+            f"onchip_devices={devices} ≤ 1 — mesh and single-device paths "
+            "share the one chip, so the ratio measures pjit dispatch "
+            "overhead, not device scaling"
+        )
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -362,6 +408,9 @@ def main(argv=None) -> int:
             reason = asyncfetch_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: asyncfetch gate SKIPPED ({reason})")
+            reason = onchip_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: onchip gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")
