@@ -57,7 +57,7 @@ ACTOR = 1001
 LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
-    "asyncfetch", "cluster", "onchip",
+    "asyncfetch", "cluster", "standing", "onchip",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -76,6 +76,7 @@ _LEG_TIMEOUTS = {
     "storage": (300.0, 150.0),
     "asyncfetch": (300.0, 150.0),
     "cluster": (420.0, 240.0),
+    "standing": (420.0, 240.0),
     "onchip": (480.0, 240.0),
 }
 
@@ -1672,6 +1673,136 @@ def _leg_onchip(args) -> dict:
     }
 
 
+def _leg_standing(args) -> dict:
+    """Standing queries (host-only, hermetic): push fan-out throughput and
+    delivery lag at 1k and 10k subscriptions over one shared world.
+
+    Subscriptions alternate between TWO distinct filters, so the
+    amortization invariant is load-bearing: proofs generate once per
+    distinct (pair, filter) and fan out to every subscriber —
+    ``standing_generations_per_tipset`` can never exceed
+    ``standing_distinct_filters`` regardless of subscriber count (gated
+    host-shape-independently by ``tools/check_bench_schema.py``, and
+    ASSERTED here on every run).
+
+    - ``standing_proofs_pushed_per_sec_{1k,10k}`` — acked webhook pushes
+      per second of matching+fan-out wall time (instant opener: this
+      measures the streaming plane, not a sink's network);
+    - ``standing_delivery_lag_{p50,p99}_ms`` — per-delivery lag from the
+      tipset's match cycle starting to its webhook landing, at 10k subs.
+    """
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.subs import StandingQueries
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    n_pairs = 3 if args.quick else 5
+    receipts, match_rate = 8, 0.5
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, match_rate=match_rate,
+        signature=SIG, topic1=TOPIC1, actor_id=ACTOR,
+    )
+    filters = (
+        {"signature": SIG, "topic1": TOPIC1},
+        {"signature": SIG, "topic1": TOPIC1, "actor_id": ACTOR},
+    )
+
+    def measure(n_subs: int) -> dict:
+        root = tempfile.mkdtemp(prefix="bench_standing_")
+        m = Metrics()
+        arrivals: "list[tuple[float, int]]" = []
+        arrivals_lock = threading.Lock()
+
+        def opener(url: str, body: bytes, timeout_s: float) -> int:
+            tipset = json.loads(body)["tipset"]
+            with arrivals_lock:
+                arrivals.append((time.perf_counter(), tipset))
+            return 200
+
+        sq = StandingQueries(
+            root, store=store, metrics=m, fsync=False,
+            log_cap_bytes=1 << 30, push_max_inflight=8,
+            opener=opener, sleep=lambda s: None, rng=random.Random(0),
+        )
+        try:
+            for i in range(n_subs):
+                sq.subscribe({
+                    "filter": filters[i % len(filters)],
+                    "target": {"mode": "webhook",
+                               "url": f"http://sink.invalid/{i}"},
+                })
+            feed_t: "dict[int, float]" = {}
+            t0 = time.perf_counter()
+            for pair in pairs:
+                feed_t[pair.child.height] = time.perf_counter()
+                sq.matcher.match_pair(pair)
+            sq.push.drain()  # wait for every webhook to land
+            wall = time.perf_counter() - t0
+            snap = m.snapshot()["counters"]
+            lags_ms = sorted(
+                (t - feed_t[ts]) * 1e3 for t, ts in arrivals
+            )
+            gens = snap.get("subs.generations", 0)
+            tipsets = snap.get("subs.tipsets_matched", 0)
+            gens_per_tipset = gens / tipsets if tipsets else None
+            assert gens_per_tipset is not None and (
+                gens_per_tipset <= len(filters)
+            ), (
+                f"standing leg: {gens_per_tipset} generations/tipset with "
+                f"{len(filters)} distinct filters — fan-out did not amortize"
+            )
+            return {
+                "pushed_per_sec": snap.get("subs.pushes", 0) / wall,
+                "lags_ms": lags_ms,
+                "gens_per_tipset": gens_per_tipset,
+                "pushes": snap.get("subs.pushes", 0),
+                "failures": snap.get("subs.push_failures", 0),
+            }
+        finally:
+            sq.drain()
+            shutil.rmtree(root, ignore_errors=True)
+
+    r1k = measure(1_000)
+    r10k = measure(10_000)
+    assert not r1k["failures"] and not r10k["failures"], (
+        "standing leg: instant-opener pushes must never exhaust retries"
+    )
+
+    def _pct(sorted_vals: "list[float]", q: float) -> "float | None":
+        if not sorted_vals:
+            return None
+        return sorted_vals[int(q * (len(sorted_vals) - 1))]
+
+    lag_p50 = _pct(r10k["lags_ms"], 0.50)
+    lag_p99 = _pct(r10k["lags_ms"], 0.99)
+    _log(
+        f"bench: standing ({n_pairs} tipsets, {len(filters)} filters): "
+        f"{r1k['pushed_per_sec']:,.0f} proofs pushed/s @1k subs, "
+        f"{r10k['pushed_per_sec']:,.0f}/s @10k "
+        f"(lag p50 {lag_p50:.1f} ms, p99 {lag_p99:.1f} ms; "
+        f"{r10k['gens_per_tipset']:.1f} generations/tipset ≤ "
+        f"{len(filters)} filters ✓)"
+    )
+    return {
+        "standing_proofs_pushed_per_sec_1k": round(r1k["pushed_per_sec"], 1),
+        "standing_proofs_pushed_per_sec_10k": round(r10k["pushed_per_sec"], 1),
+        "standing_delivery_lag_p50_ms": (
+            round(lag_p50, 3) if lag_p50 is not None else None
+        ),
+        "standing_delivery_lag_p99_ms": (
+            round(lag_p99, 3) if lag_p99 is not None else None
+        ),
+        "standing_subscriptions": 10_000,
+        "standing_tipsets": n_pairs,
+        "standing_distinct_filters": len(filters),
+        "standing_generations_per_tipset": round(r10k["gens_per_tipset"], 3),
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -1686,6 +1817,7 @@ _LEG_FNS = {
     "storage": _leg_storage,
     "asyncfetch": _leg_asyncfetch,
     "cluster": _leg_cluster,
+    "standing": _leg_standing,
     "onchip": _leg_onchip,
 }
 
@@ -1989,6 +2121,8 @@ def _orchestrate(args) -> None:
     legs_status["asyncfetch"] = status
     cluster, status = _run_leg("cluster", args, "cpu")
     legs_status["cluster"] = status
+    standing, status = _run_leg("standing", args, "cpu")
+    legs_status["standing"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -2066,6 +2200,15 @@ def _orchestrate(args) -> None:
     )
     for k in _CLUSTER_KEYS:
         out[k] = (cluster or {}).get(k)
+    _STANDING_KEYS = (
+        "standing_proofs_pushed_per_sec_1k",
+        "standing_proofs_pushed_per_sec_10k",
+        "standing_delivery_lag_p50_ms", "standing_delivery_lag_p99_ms",
+        "standing_subscriptions", "standing_tipsets",
+        "standing_distinct_filters", "standing_generations_per_tipset",
+    )
+    for k in _STANDING_KEYS:
+        out[k] = (standing or {}).get(k)
     _ONCHIP_KEYS = (
         "device_linearity_Nchip", "batch_verify_speedup", "onchip_devices",
         "onchip_match_events", "onchip_verify_blocks", "onchip_device_calls",

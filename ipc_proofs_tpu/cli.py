@@ -700,8 +700,39 @@ def _cmd_serve(args) -> int:
                 "durable queue: re-executed %d admitted-but-unfinished "
                 "request(s) from %s", durable.resumed_jobs, args.queue_dir,
             )
+    subs = None
+    if args.subs_dir:
+        from ipc_proofs_tpu.subs import StandingQueries
+
+        if service.blockstore is None:
+            log.error("--subs-dir needs a store (--demo-world or --endpoint)")
+            service.drain()
+            return 2
+        subs = StandingQueries(
+            args.subs_dir,
+            store=service.blockstore,
+            metrics=metrics,
+            chunk_size=service.config.range_chunk_size,
+            match_backend=service.match_backend,
+            log_cap_bytes=args.subs_log_cap_bytes,
+            push_max_inflight=args.push_max_inflight,
+            retry_attempts=args.delivery_retry_attempts,
+            retry_base_s=args.delivery_retry_base_s,
+            retry_max_s=args.delivery_retry_max_s,
+        )
+        if subs.registry.replayed:
+            log.info(
+                "standing queries: %d subscription(s) active after replay, "
+                "%d unacked delivery(ies) re-pushing",
+                len(subs.registry), subs.log.pending_total(),
+            )
+        if follower is not None:
+            # the streaming plane: each finalized tipset the (leader)
+            # follower warms also drives match → generate-once → fan-out
+            follower.add_finalized_hook(subs.on_tipset)
     httpd = ProofHTTPServer(
-        service, host=args.host, port=args.port, pairs=pairs, durable=durable
+        service, host=args.host, port=args.port, pairs=pairs, durable=durable,
+        subs=subs,
     )
     if args.port_file:
         # atomic write: a polling parent never reads a half-written port
@@ -783,11 +814,27 @@ def _cmd_cluster(args) -> int:
     ]
     if args.store_cap_bytes is not None:
         extra += ["--store-cap-bytes", str(args.store_cap_bytes)]
+    if args.subs_dir:
+        # push/retry knobs are cluster-wide; the registry itself shards
+        # per process (DIR/s<k>) and the router places subscriptions on
+        # their filter-affine arc
+        extra += [
+            "--push-max-inflight", str(args.push_max_inflight),
+            "--delivery-retry-attempts", str(args.delivery_retry_attempts),
+            "--delivery-retry-base-s", str(args.delivery_retry_base_s),
+            "--delivery-retry-max-s", str(args.delivery_retry_max_s),
+            "--subs-log-cap-bytes", str(args.subs_log_cap_bytes),
+        ]
 
     shards = []
     try:
         for k in range(args.shards):
             name = f"s{k}"
+            shard_extra = list(extra)
+            if args.subs_dir:
+                shard_extra += [
+                    "--subs-dir", os.path.join(args.subs_dir, name)
+                ]
             shards.append(
                 spawn_serve_shard(
                     name,
@@ -800,7 +847,7 @@ def _cmd_cluster(args) -> int:
                         if args.queue_dir
                         else None
                     ),
-                    extra_args=extra,
+                    extra_args=shard_extra,
                 )
             )
             log.info("shard %s up at %s", name, shards[-1].url)
@@ -919,6 +966,42 @@ def main(argv=None) -> int:
             "backs off one level whenever a 64-fetch speculation window "
             "wastes more than 60%% of what it fetched "
             "(fetch.speculate_depth_downshifts counts the backoffs)",
+        )
+
+    def add_subs_flags(p):
+        p.add_argument(
+            "--subs-dir", default=None, metavar="DIR",
+            help="standing queries: durable subscription registry + "
+            "delivery log under DIR (IPJ1 journals — registrations and "
+            "unacked deliveries survive restart). Mounts /v1/subscribe, "
+            "/v1/unsubscribe, /v1/subscriptions and the long-poll "
+            "/v1/deliveries; with --follow, each finalized tipset "
+            "generates once per distinct filter and fans out to every "
+            "subscriber (webhook push or long-poll)",
+        )
+        p.add_argument(
+            "--push-max-inflight", type=int, default=4, metavar="N",
+            help="webhook push worker threads (bounded fan-out; default 4)",
+        )
+        p.add_argument(
+            "--delivery-retry-attempts", type=int, default=4, metavar="N",
+            help="webhook attempts per delivery before leaving it unacked "
+            "for long-poll / next-cycle re-push (default 4)",
+        )
+        p.add_argument(
+            "--delivery-retry-base-s", type=float, default=0.25,
+            help="full-jitter backoff base delay between webhook attempts "
+            "(default 0.25)",
+        )
+        p.add_argument(
+            "--delivery-retry-max-s", type=float, default=4.0,
+            help="full-jitter backoff delay cap (default 4.0)",
+        )
+        p.add_argument(
+            "--subs-log-cap-bytes", type=int, default=64 * 1024 * 1024,
+            help="compact the delivery journal above this size — only "
+            "acked history is dropped, unacked deliveries always survive "
+            "(default 64 MiB)",
         )
 
     def add_onchip_flags(p):
@@ -1202,6 +1285,7 @@ def main(argv=None) -> int:
     )
     add_store_flags(srv)
     add_fetch_plane_flags(srv)
+    add_subs_flags(srv)
     srv.add_argument(
         "--backend", default="none", choices=["cpu", "tpu", "none"],
         help="batch backend for generate-range event matching (default "
@@ -1294,6 +1378,7 @@ def main(argv=None) -> int:
     clu.add_argument("--event-sig", default=None)
     clu.add_argument("--topic1", default=None)
     add_store_flags(clu)
+    add_subs_flags(clu)
     clu.add_argument(
         "--queue-dir", default=None, metavar="DIR",
         help="durable admission root: each shard journals under DIR/s<k> "

@@ -28,6 +28,18 @@ Range requests scatter-gather: pairs partition by per-pair affinity
 carrier (one trace covers the fan-out), and the sub-bundles merge through
 `cluster.gather.merge_range_bundles` into bytes identical to a
 single-daemon run. See README "Cluster serving".
+
+Standing queries shard differently: a subscription is STATE, not a
+request, so it must live on exactly the shard that owns its filter's
+ring arc (`subscription_ring_key` — all subscribers of one filter
+colocate, preserving the generate-once amortization). Subscription
+routes therefore use `_dispatch_affine`, which never steals. The router
+mirrors ``sub_id → (ring_key, register body)`` so that when a shard
+dies, `_mark_dead` re-registers the dead arc's subscriptions on their
+new affine shards under the ORIGINAL subscription ids
+(``cluster.subs_rearced``) — the registry's durable dedup absorbs
+replays, and unacked deliveries re-push from the surviving shard's
+journal on recovery.
 """
 
 from __future__ import annotations
@@ -40,7 +52,8 @@ import urllib.request
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 from ipc_proofs_tpu.cluster.gather import merge_range_bundles, partition_indexes
 from ipc_proofs_tpu.cluster.hashring import HashRing, pair_ring_key
@@ -52,6 +65,7 @@ from ipc_proofs_tpu.obs.trace import (
     use_context,
 )
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.subs.registry import normalize_filter, subscription_ring_key
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.threads import locked
 from ipc_proofs_tpu.utils.metrics import Metrics
@@ -166,6 +180,9 @@ class ClusterRouter:
             self._shards[name] = _ShardState(client)
             self._ring.add(name)
         self._keys = [pair_ring_key(p) for p in self.pairs]
+        # sub_id → (ring_key, register body): the failover mirror that lets
+        # _mark_dead re-home a dead shard's subscription arc.
+        self._standing: "Dict[str, Tuple[str, dict]]" = {}  # guarded-by: _lock
         self._executor = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="cluster-scatter"
         )
@@ -221,10 +238,17 @@ class ClusterRouter:
                 )
 
     def _mark_dead(self, name: str) -> None:
+        rearc: "List[Tuple[str, str, dict]]" = []
         with self._lock:
             state = self._shards.get(name)
             if state is None or not state.alive:
                 return  # concurrent requests race to report one death once
+            # Collect the dying shard's subscription arc BEFORE the ring
+            # mutates — node_for() must still see the old topology to know
+            # which subscriptions lived there.
+            for sid, (key, body) in self._standing.items():
+                if len(self._ring) and self._ring.node_for(key) == name:
+                    rearc.append((sid, key, body))
             state.alive = False
             self._ring.remove(name)
             self._gauge_alive_locked()
@@ -232,6 +256,34 @@ class ClusterRouter:
         logger.warning(
             "cluster: shard %s unreachable — ring arc redistributed", name
         )
+        self._rearc_subscriptions(name, rearc)
+
+    def _rearc_subscriptions(
+        self, dead: str, rearc: "List[Tuple[str, str, dict]]"
+    ) -> None:
+        """Re-register a dead shard's subscriptions on their new affine
+        shards under the ORIGINAL sub ids — the registries' durable dedup
+        absorbs replays, so this is safe to repeat."""
+        for sid, key, body in rearc:
+            try:
+                status, _obj = self._dispatch_affine(
+                    key, "/v1/subscribe", dict(body)
+                )
+            except NoShardsError:
+                logger.warning(
+                    "cluster: no shard left to re-home subscriptions from %s",
+                    dead,
+                )
+                return
+            if status == 200:
+                self.metrics.count("cluster.subs_rearced")
+            else:  # fail-soft: a live shard rejected the replay — log & go on
+                logger.warning(
+                    "cluster: re-registering %s after %s died failed: %s",
+                    sid,
+                    dead,
+                    status,
+                )
 
     def revive(self, name: str) -> None:
         """Re-admit a recovered shard (ops action / test hook): its ring
@@ -284,6 +336,143 @@ class ClusterRouter:
                 self.metrics.count("cluster.shard_failovers")
             finally:
                 self._release(name)
+
+    def _dispatch_affine(
+        self, key: str, path: str, body: Optional[dict] = None
+    ) -> "tuple[int, dict]":
+        """Affinity-PINNED dispatch for subscription state. Unlike
+        `_dispatch` this never steals: the registry shard owning ``key``'s
+        arc is the only one holding that filter's subscriptions, so the
+        request must land there. Failover recomputes the arc owner after
+        `_mark_dead` shrinks the ring (which also re-homes the dead arc's
+        subscriptions — see `_rearc_subscriptions`)."""
+        attempted: "set[str]" = set()
+        while True:
+            with self._lock:
+                if not len(self._ring):
+                    raise NoShardsError("all shards are dead")
+                name = self._affinity_locked(key)
+                client = self._shards[name].client
+            if name in attempted:
+                raise NoShardsError(
+                    f"no shard answered {path} (tried {sorted(attempted)})"
+                )
+            attempted.add(name)
+            self.metrics.count("cluster.sub_requests")
+            try:
+                if body is None:
+                    return client.get(path)
+                return client.post(path, dict(body))
+            except ShardUnavailable:
+                self._mark_dead(name)
+                self.metrics.count("cluster.shard_failovers")
+
+    # --- standing-query routes ---------------------------------------------
+
+    def subscribe(self, body: dict) -> "tuple[int, dict]":
+        """Route ``POST /v1/subscribe`` to the filter arc's owning shard
+        and mirror the registration for failover re-homing."""
+        self.metrics.count("cluster.subscribe_requests")
+        try:
+            filt = normalize_filter((body or {}).get("filter"))
+        except ValueError as exc:
+            return 400, {"error": str(exc)}
+        key = subscription_ring_key(filt)
+        send = dict(body)
+        send["filter"] = filt
+        status, obj = self._dispatch_affine(key, "/v1/subscribe", send)
+        if status == 200 and isinstance(obj, dict) and obj.get("sub_id"):
+            mirrored = dict(send)
+            mirrored["sub_id"] = obj["sub_id"]
+            with self._lock:
+                self._standing[obj["sub_id"]] = (key, mirrored)
+        return status, obj
+
+    def unsubscribe(self, body: dict) -> "tuple[int, dict]":
+        """Route ``POST /v1/unsubscribe`` via the mirror when the sub is
+        known; broadcast to every live shard otherwise (a router restart
+        loses the in-memory mirror, not the shards' durable registries)."""
+        sub_id = str((body or {}).get("sub_id") or "")
+        if not sub_id:
+            return 400, {"error": "body.sub_id is required"}
+        with self._lock:
+            entry = self._standing.pop(sub_id, None)
+        if entry is not None:
+            return self._dispatch_affine(
+                entry[0], "/v1/unsubscribe", {"sub_id": sub_id}
+            )
+        removed = False
+        for name in self.alive_shards():
+            with self._lock:
+                state = self._shards.get(name)
+                if state is None or not state.alive:
+                    continue
+                client = state.client
+            try:
+                status, obj = client.post(
+                    "/v1/unsubscribe", {"sub_id": sub_id}
+                )
+            except ShardUnavailable:
+                self._mark_dead(name)
+                continue
+            if status == 200 and isinstance(obj, dict) and obj.get("removed"):
+                removed = True
+        return 200, {"removed": removed}
+
+    def subscriptions(self) -> "tuple[int, dict]":
+        """Aggregate ``GET /v1/subscriptions`` across live shards."""
+        subs: "List[dict]" = []
+        per_shard: "Dict[str, int]" = {}
+        for name in self.alive_shards():
+            with self._lock:
+                state = self._shards.get(name)
+                if state is None or not state.alive:
+                    continue
+                client = state.client
+            try:
+                status, obj = client.get("/v1/subscriptions")
+            except ShardUnavailable:
+                self._mark_dead(name)
+                continue
+            if status != 200 or not isinstance(obj, dict):
+                continue
+            got = obj.get("subscriptions") or []
+            per_shard[name] = len(got)
+            subs.extend(got)
+        subs.sort(key=lambda s: s.get("sub_id", ""))
+        return 200, {
+            "count": len(subs),
+            "subscriptions": subs,
+            "shards": per_shard,
+        }
+
+    def deliveries(
+        self, sub_id: str, cursor: int = 0, wait_s: float = 0.0
+    ) -> "tuple[int, dict]":
+        """Proxy the long-poll fallback to the sub's owning shard. Falls
+        back to probing every live shard when the mirror doesn't know the
+        sub (router restarted; the shards' registries are the truth)."""
+        qs = f"/v1/deliveries?sub={sub_id}&cursor={int(cursor)}"
+        if wait_s:
+            qs += f"&wait_s={float(wait_s)}"
+        with self._lock:
+            entry = self._standing.get(sub_id)
+        if entry is not None:
+            return self._dispatch_affine(entry[0], qs)
+        for name in self.alive_shards():
+            with self._lock:
+                state = self._shards.get(name)
+                if state is None or not state.alive:
+                    continue
+                client = state.client
+            try:
+                status, obj = client.get(qs)
+            except ShardUnavailable:
+                self._mark_dead(name)
+                continue
+            if status == 200:
+                return status, obj
+        return 404, {"error": f"no such subscription: {sub_id}"}
 
     # --- public request API ------------------------------------------------
 
@@ -461,11 +650,33 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        if self.path == "/healthz":
+        parts = urlsplit(self.path)
+        if parts.path == "/healthz":
             status, obj = self.router.healthz()
             self._send_json(status, obj)
-        elif self.path == "/metrics":
+        elif parts.path == "/metrics":
             self._send_json(200, self.router.metrics_snapshot())
+        elif parts.path == "/v1/subscriptions":
+            status, obj = self.router.subscriptions()
+            self._send_json(status, obj)
+        elif parts.path == "/v1/deliveries":
+            try:
+                qs = parse_qs(parts.query)
+                sub_id = (qs.get("sub") or [""])[0]
+                if not sub_id:
+                    raise ValueError("query param 'sub' is required")
+                cursor = int((qs.get("cursor") or ["0"])[0])
+                wait_s = min(30.0, float((qs.get("wait_s") or ["0"])[0]))
+            except ValueError as exc:
+                self._send_json(400, {"error": f"bad query: {exc}"})
+                return
+            try:
+                status, obj = self.router.deliveries(
+                    sub_id, cursor=cursor, wait_s=wait_s
+                )
+            except NoShardsError as exc:
+                status, obj = 503, {"error": str(exc)}
+            self._send_json(status, obj)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
 
@@ -495,6 +706,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     chunk_size=body.get("chunk_size"),
                     timeout_s=body.get("timeout_s"),
                 )
+            elif self.path == "/v1/subscribe":
+                status, obj = self.router.subscribe(body)
+            elif self.path == "/v1/unsubscribe":
+                status, obj = self.router.unsubscribe(body)
             else:
                 status, obj = 404, {"error": f"no such path: {self.path}"}
         except NoShardsError as exc:

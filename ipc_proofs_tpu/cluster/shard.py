@@ -66,6 +66,7 @@ class LocalShard:
         trust_policy=None,
         event_filter=None,
         store_wrapper=None,
+        subs=None,
     ):
         self.name = name
         self.pairs = list(pairs)
@@ -87,8 +88,10 @@ class LocalShard:
             if queue_dir
             else None
         )
+        self.subs = subs  # StandingQueries, when the shard serves streams
         self.httpd = ProofHTTPServer(
-            self.service, port=0, pairs=self.pairs, durable=self.durable
+            self.service, port=0, pairs=self.pairs, durable=self.durable,
+            subs=subs,
         )
 
     def start(self) -> "LocalShard":

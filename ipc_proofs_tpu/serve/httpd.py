@@ -25,7 +25,15 @@ to the in-process API and serving errors to status codes:
   an `EndpointPool` attached, ``"degraded"`` means some endpoint's circuit
   breaker is open/half-open and the body carries per-endpoint breaker
   state (still HTTP 200 — the service itself serves from what remains;
-  draining stays 503).
+  draining stays 503). With a follower attached the body carries
+  ``last_finalized_epoch``; with standing queries, subscription/delivery
+  gauges.
+- ``POST /v1/subscribe`` / ``POST /v1/unsubscribe`` /
+  ``GET /v1/subscriptions`` / ``GET /v1/deliveries?sub=<id>&cursor=<n>``
+  → the standing-query plane (`ipc_proofs_tpu/subs/`), mounted when the
+  server is built with ``subs=`` (``serve --subs-dir``). Deliveries is
+  the long-poll fallback to webhook push; asking from cursor N acks
+  everything ≤ N.
 
 Every POST opens a trace root span (`obs/trace.py`) on the handler thread
 before admission, so batching/execution spans parent into the request's
@@ -49,6 +57,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
+from urllib.parse import parse_qs, urlsplit
 
 from ipc_proofs_tpu.obs.flight import get_flight_recorder
 from ipc_proofs_tpu.obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
@@ -73,6 +82,7 @@ class _Handler(BaseHTTPRequestHandler):
     service: ProofService
     pairs: Sequence[TipsetPair]
     durable = None  # Optional[DurableAdmission]
+    subs = None  # Optional[subs.StandingQueries]
 
     protocol_version = "HTTP/1.1"
 
@@ -121,25 +131,64 @@ class _Handler(BaseHTTPRequestHandler):
     # --- routes ------------------------------------------------------------
 
     def do_GET(self):
-        if self.path == "/metrics":
+        path = urlsplit(self.path).path
+        if path == "/metrics":
             self._send_json(200, self.service.metrics_snapshot())
-        elif self.path == "/metrics.prom":
+        elif path == "/metrics.prom":
             self._send_text(
                 200,
                 render_prometheus(self.service.metrics.snapshot()),
                 _PROM_CONTENT_TYPE,
             )
-        elif self.path == "/debug/flight":
+        elif path == "/debug/flight":
             self._send_json(200, get_flight_recorder().snapshot())
-        elif self.path == "/healthz":
+        elif path == "/healthz":
             health = self.service.health()
             if self.durable is not None:
                 health.update(self.durable.health_fields())
+            if self.subs is not None:
+                health.update(self.subs.health_fields())
+            epoch = self.service.metrics.snapshot().get("gauges", {}).get(
+                "follow.last_finalized_epoch"
+            )
+            if epoch is not None:
+                health["last_finalized_epoch"] = int(epoch)
             # draining = stop routing here (503); degraded = still serving
             # from healthy endpoints, breaker detail in the body (200)
             self._send_json(503 if health["status"] == "draining" else 200, health)
+        elif path == "/v1/subscriptions":
+            if self.subs is None:
+                self._send_json(404, {"error": "standing queries disabled"})
+            else:
+                self._send_json(200, self.subs.subscriptions())
+        elif path == "/v1/deliveries":
+            self._handle_deliveries()
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _handle_deliveries(self):
+        """``GET /v1/deliveries?sub=<id>&cursor=<n>[&wait_s=<s>]`` — the
+        long-poll fallback: acks everything ≤ cursor, returns what's
+        above it (blocking up to ``wait_s``, capped server-side)."""
+        if self.subs is None:
+            self._send_json(404, {"error": "standing queries disabled"})
+            return
+        q = parse_qs(urlsplit(self.path).query)
+        sub_id = (q.get("sub") or [""])[0]
+        if not sub_id:
+            self._send_json(400, {"error": "sub query parameter required"})
+            return
+        try:
+            cursor = int((q.get("cursor") or ["0"])[0])
+            wait_s = min(30.0, max(0.0, float((q.get("wait_s") or ["0"])[0])))
+        except ValueError:
+            self._send_json(400, {"error": "cursor/wait_s must be numeric"})
+            return
+        out = self.subs.deliveries(sub_id, cursor=cursor, wait_s=wait_s)
+        if out is None:
+            self._send_json(404, {"error": f"no such subscription: {sub_id}"})
+        else:
+            self._send_json(200, out)
 
     def do_POST(self):
         try:
@@ -164,8 +213,30 @@ class _Handler(BaseHTTPRequestHandler):
                 "http.generate_range", carrier, {"path": self.path}
             ):
                 self._handle_generate_range(body)
+        elif self.path == "/v1/subscribe":
+            self._handle_subscribe(body)
+        elif self.path == "/v1/unsubscribe":
+            self._handle_unsubscribe(body)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _handle_subscribe(self, body: dict):
+        if self.subs is None:
+            self._send_json(404, {"error": "standing queries disabled"})
+            return
+        try:
+            self._send_json(200, self.subs.subscribe(body))
+        except ValueError as exc:
+            self._send_json(400, {"error": str(exc)})
+
+    def _handle_unsubscribe(self, body: dict):
+        if self.subs is None:
+            self._send_json(404, {"error": "standing queries disabled"})
+            return
+        try:
+            self._send_json(200, self.subs.unsubscribe(body))
+        except ValueError as exc:
+            self._send_json(400, {"error": str(exc)})
 
     def _handle_verify(self, body: dict):
         try:
@@ -336,13 +407,20 @@ class ProofHTTPServer:
         port: int = 0,
         pairs: Optional[Sequence[TipsetPair]] = None,
         durable=None,
+        subs=None,
     ):
         self.service = service
         self.durable = durable
+        self.subs = subs
         handler = type(
             "_BoundHandler",
             (_Handler,),
-            {"service": service, "pairs": list(pairs or []), "durable": durable},
+            {
+                "service": service,
+                "pairs": list(pairs or []),
+                "durable": durable,
+                "subs": subs,
+            },
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
@@ -371,11 +449,19 @@ class ProofHTTPServer:
 
     def shutdown(self, timeout: Optional[float] = None) -> None:
         """Stop the accept loop, then drain the service (flushes all
-        accepted work before returning)."""
+        accepted work before returning).
+
+        Order matters: the standing-query plane drains FIRST — its push
+        workers read proof payloads and its matcher reads the blockstore,
+        so they must finish before `service.drain()` closes the fetch
+        plane and the tiered store underneath them (a SIGTERM mid-push
+        must never make a delivery read from a closed tier)."""
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self.subs is not None:
+            self.subs.drain()
         self.service.drain(timeout=timeout)
         if self.durable is not None:
             self.durable.close()

@@ -388,6 +388,13 @@ class ProofService:
         traffic and the follower share one warm tier."""
         return self._store
 
+    @property
+    def match_backend(self):
+        """The resolved device match backend (None on the host path) —
+        the standing-query matcher generates through the same backend so
+        streamed bundles are byte-identical to request/response ones."""
+        return self._match_backend
+
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
         snap["block_cache"] = self.block_cache.stats()

@@ -30,8 +30,9 @@ fault harness.
 from __future__ import annotations
 
 import os
+import random
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 try:
     import fcntl
@@ -153,6 +154,18 @@ class ChainFollower:
     tail *finalized* tipsets, not the live edge. ``start_height`` begins
     the tail at a fixed height (default: the finalized tip at first
     successful poll, i.e. follow forward only).
+
+    ``poll_jitter`` spreads each sleep uniformly over
+    ``poll_s * (1 ± poll_jitter)``: N shards tailing one Lotus endpoint
+    with identical periods synchronize into a thundering herd of
+    simultaneous head polls; jitter decorrelates them. Every poll is
+    counted as ``follow.polls`` and the last finalized height lands in
+    the ``follow.last_finalized_epoch`` gauge (surfaced by ``/healthz``).
+
+    Finalized-tipset hooks (`add_finalized_hook`) fire once per newly
+    finalized height, after its spine is warmed — the standing-query
+    matcher rides this. A raising hook is fail-soft (``follow.errors``):
+    it never stalls the follow loop or blocks later heights.
     """
 
     def __init__(
@@ -165,6 +178,8 @@ class ChainFollower:
         start_height: Optional[int] = None,
         max_tipsets_per_poll: int = 16,
         batch_verify: bool = False,
+        poll_jitter: float = 0.1,
+        rng: Optional[random.Random] = None,
     ):
         self._client = client
         self._store = store
@@ -177,12 +192,20 @@ class ChainFollower:
             metrics = get_metrics()
         self._metrics = metrics
         self.poll_s = poll_s
+        self.poll_jitter = min(0.9, max(0.0, float(poll_jitter)))
+        self._rng = rng if rng is not None else random.Random()
         self.lag = max(0, int(lag))
         self.max_tipsets_per_poll = max(1, int(max_tipsets_per_poll))
         self._lock = named_lock("ChainFollower._lock")
         self._next_height: Optional[int] = start_height  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._hooks: "list[Callable]" = []  # guarded-by: _lock
         self._stop = threading.Event()
+
+    def add_finalized_hook(self, hook: Callable) -> None:
+        """Register ``hook(tipset)`` to fire once per finalized height."""
+        with self._lock:
+            self._hooks.append(hook)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -204,8 +227,14 @@ class ChainFollower:
         if thread is not None:
             thread.join(timeout=timeout_s)
 
+    def _poll_delay(self) -> float:
+        """One jittered sleep: uniform over ``poll_s * (1 ± poll_jitter)``."""
+        if self.poll_jitter <= 0.0:
+            return self.poll_s
+        return self.poll_s * (1.0 + self._rng.uniform(-self.poll_jitter, self.poll_jitter))
+
     def _run(self) -> None:
-        while not self._stop.wait(self.poll_s):
+        while not self._stop.wait(self._poll_delay()):
             try:
                 self.poll_once()
             except Exception:  # fail-soft: the follower is advisory — errors are counted in poll_once, the daemon must outlive them all
@@ -214,7 +243,13 @@ class ChainFollower:
     # -- one poll (synchronous — tests drive this directly) ---------------
 
     def poll_once(self) -> int:
-        """Advance over newly finalized tipsets; returns tipsets warmed."""
+        """Advance over newly finalized tipsets; returns tipsets warmed.
+
+        Idempotent on an unchanged head: no per-height work runs and no
+        finalized hooks fire — the matcher's exactly-once-per-height
+        contract rides on this.
+        """
+        self._metrics.count("follow.polls")
         try:
             head = self._client.request("Filecoin.ChainHead", [])
             head_height = int(head["Height"])
@@ -241,11 +276,27 @@ class ChainFollower:
                 )
                 break
             self._metrics.count("follow.tipsets")
+            self._metrics.set_gauge("follow.last_finalized_epoch", tipset.height)
+            self._fire_hooks(tipset)
             nxt += 1
             done += 1
             with self._lock:
                 self._next_height = nxt
         return done
+
+    def _fire_hooks(self, tipset: Tipset) -> None:
+        with self._lock:
+            hooks = list(self._hooks)
+        for hook in hooks:
+            try:
+                hook(tipset)
+            except Exception as exc:  # fail-soft: a broken subscriber plane must not stall chain following
+                self._metrics.count("follow.errors")
+                logger.warning(
+                    "chain follower: finalized hook failed at height %d (%s)",
+                    tipset.height,
+                    exc,
+                )
 
     # -- block plumbing ---------------------------------------------------
 

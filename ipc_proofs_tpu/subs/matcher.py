@@ -1,0 +1,194 @@
+"""Standing-query matcher: the follower's tipset-finalized hook.
+
+On each finalized tipset the matcher forms the (previous, current)
+`TipsetPair` and compiles the active subscription set down to its
+**distinct filters** — generation cost scales with filters, never with
+subscribers (``subs.generations`` counts exactly one per (pair, filter);
+the bench gate asserts generations per tipset ≤ distinct filters).
+
+Each distinct filter generates through the SAME driver the
+request/response path uses (`generate_event_proofs_for_range_chunked`
+with the service's chunk size and match backend), so a pushed bundle is
+byte-identical to what `/v1/generate_range` would return for the same
+(pair, filter). Distinct filters generate concurrently, and when the
+match backend speaks the fp-mask protocol their per-chunk device
+predicate calls route through ONE shared
+`parallel.pipeline.MatchCoalescer` — one batched device match dispatch
+serves every subscriber of the tipset.
+
+Everything here is fail-soft: a filter whose generation raises counts
+``subs.errors`` and the other filters still deliver; the follower's hook
+wrapper catches the rest (``follow.errors``) so the follow loop never
+stalls on the streaming plane.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from ipc_proofs_tpu.proofs.generator import EventProofSpec, StorageProofSpec
+from ipc_proofs_tpu.subs.registry import Subscription, filter_key
+from ipc_proofs_tpu.utils.lockdep import named_lock
+from ipc_proofs_tpu.utils.log import get_logger
+from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
+
+__all__ = ["StandingQueryMatcher"]
+
+logger = get_logger(__name__)
+
+
+class _CoalescingBackend:
+    """Backend proxy routing fp-mask calls through one shared coalescer.
+
+    Concurrent per-filter generations each scan the same tipset pair;
+    wrapping the backend so ``event_match_mask_fp`` is a shared
+    `MatchCoalescer.match_fp` (a documented drop-in for it) folds their
+    simultaneous predicate calls into one batched device dispatch.
+    Every other attribute (mesh, flat/fused entry points, ...) delegates
+    to the real backend, and the coalescer's masks are bit-identical to
+    unbatched calls (elementwise predicate), so bundles don't change.
+    """
+
+    def __init__(self, backend, metrics: Optional[Metrics] = None):
+        from ipc_proofs_tpu.parallel.pipeline import MatchCoalescer
+
+        self._backend = backend
+        self.event_match_mask_fp = MatchCoalescer(backend, metrics=metrics).match_fp
+
+    def __getattr__(self, name):
+        return getattr(self._backend, name)
+
+
+def _bundle_digest(bundle_obj: dict) -> str:
+    """Content digest of a bundle's canonical JSON — the idempotency-key
+    ingredient that makes matcher replays of a (pair, filter) dedup."""
+    canon = json.dumps(bundle_obj, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+class StandingQueryMatcher:
+    """Compiles the active filter set against each finalized tipset pair."""
+
+    def __init__(
+        self,
+        registry,
+        log,
+        push,
+        store,
+        metrics: Optional[Metrics] = None,
+        chunk_size: int = 8,
+        match_backend=None,
+        gen_workers: int = 2,
+    ):
+        self._registry = registry
+        self._log = log
+        self._push = push
+        self._store = store
+        self._metrics = metrics if metrics is not None else get_metrics()
+        self.chunk_size = max(1, int(chunk_size))
+        if match_backend is not None and hasattr(match_backend, "event_match_mask_fp"):
+            match_backend = _CoalescingBackend(match_backend, metrics=self._metrics)
+        self._backend = match_backend
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(1, int(gen_workers)), thread_name_prefix="subs-match"
+        )
+        self._lock = named_lock("StandingQueryMatcher._lock")
+        self._prev = None  # guarded-by: _lock (previous finalized tipset)
+        self._closed = False  # guarded-by: _lock
+
+    def on_tipset(self, tipset) -> int:
+        """The `ChainFollower` finalized hook: pair this tipset with the
+        previous one and match. Returns deliveries appended."""
+        with self._lock:
+            if self._closed:
+                return 0
+            prev, self._prev = self._prev, tipset
+        if prev is None or tipset.height <= prev.height:
+            return 0  # first observation (no pair yet) or a replayed height
+        from ipc_proofs_tpu.proofs.range import TipsetPair
+
+        return self.match_pair(TipsetPair(parent=prev, child=tipset))
+
+    def match_pair(self, pair) -> int:
+        """One matching cycle: re-push stragglers, generate once per
+        distinct filter, fan the bundles out."""
+        subs = self._registry.active()
+        self._metrics.count("subs.tipsets_matched")
+        # Convergence first: deliveries whose webhook failed on an earlier
+        # cycle re-enqueue before this tipset's new work.
+        self._push.repush_pending(self._registry)
+        if not subs:
+            return 0
+        groups: Dict[str, Tuple[dict, List[Subscription]]] = {}
+        for sub in subs:
+            fkey = filter_key(sub.filter)
+            if fkey not in groups:
+                groups[fkey] = (sub.filter, [])
+            groups[fkey][1].append(sub)
+        futures = {
+            fkey: self._executor.submit(self._generate, filt, pair)
+            for fkey, (filt, _members) in groups.items()
+        }
+        appended = 0
+        for fkey, fut in futures.items():
+            try:
+                payload, digest = fut.result()
+            except Exception as exc:  # fail-soft: one filter's generation failure must not starve the other filters' subscribers
+                self._metrics.count("subs.errors")
+                logger.warning("standing-query generation failed: %s", exc)
+                continue
+            if payload is None:
+                self._metrics.count("subs.empty_matches")
+                continue
+            for sub in groups[fkey][1]:
+                d = self._log.append(sub.sub_id, pair.child.height, digest, payload)
+                if d is None:
+                    continue  # idempotent replay of a served (pair, filter)
+                self._metrics.count("subs.notifications")
+                appended += 1
+                self._push.push(sub, d)
+        return appended
+
+    def _generate(self, filt: dict, pair):
+        """One generation per distinct (pair, filter) — the amortized unit."""
+        from ipc_proofs_tpu.proofs.range import (
+            generate_event_proofs_for_range_chunked,
+        )
+
+        spec = EventProofSpec(
+            event_signature=filt["signature"],
+            topic_1=filt.get("topic1"),
+            actor_id_filter=filt.get("actor_id"),
+        )
+        storage_specs = None
+        if "slot" in filt:
+            storage_specs = [
+                StorageProofSpec(
+                    actor_id=filt["actor_id"], slot=bytes.fromhex(filt["slot"])
+                )
+            ]
+        bundle = generate_event_proofs_for_range_chunked(
+            self._store,
+            [pair],
+            spec,
+            chunk_size=self.chunk_size,
+            match_backend=self._backend,
+            metrics=self._metrics,
+            storage_specs=storage_specs,
+        )
+        self._metrics.count("subs.generations")
+        if not bundle.event_proofs and not bundle.storage_proofs:
+            return None, None
+        bundle_obj = bundle.to_json_obj()
+        return {"bundle": bundle_obj}, _bundle_digest(bundle_obj)
+
+    def drain(self) -> None:
+        """Stop matching and wait for in-flight generations."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._executor.shutdown(wait=True)

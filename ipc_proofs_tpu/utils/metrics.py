@@ -31,12 +31,14 @@ __all__ = [
     "SERVE_COUNTERS",
     "STOREX_COUNTERS",
     "CLUSTER_COUNTERS",
+    "SUBS_COUNTERS",
     "VERIFY_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
     "DURABILITY_GAUGES",
     "STOREX_GAUGES",
     "CLUSTER_GAUGES",
+    "SUBS_GAUGES",
     "SERVE_HISTOGRAMS",
 ]
 
@@ -261,10 +263,13 @@ SERVE_COUNTERS = (
 #   follow.tipsets             — finalized tipsets the chain follower warmed
 #   follow.blocks_prefetched   — spine blocks the follower stored locally
 #   follow.errors              — follower errors absorbed fail-soft (head
-#                                polls, fetches, verification skips)
+#                                polls, fetches, verification skips,
+#                                raising finalized hooks)
 #   follow.leader_elections    — times a daemon won the follow-leader lock
 #                                (cluster mode runs ONE ChainFollower per
 #                                shared --store-dir, not one per shard)
+#   follow.polls               — head polls attempted (jittered cadence;
+#                                polls × poll_s sanity-checks herd spread)
 STOREX_COUNTERS = (
     "storex.disk_hits",
     "storex.disk_misses",
@@ -276,6 +281,61 @@ STOREX_COUNTERS = (
     "follow.blocks_prefetched",
     "follow.errors",
     "follow.leader_elections",
+    "follow.polls",
+)
+
+# Counter vocabulary of the standing-query subsystem (ipc_proofs_tpu/subs/):
+#   subs.registered        — subscriptions accepted into the registry
+#   subs.unsubscribed      — subscriptions removed
+#   subs.replays_absorbed  — duplicate subscribe(sub_id) calls absorbed
+#                            idempotently (journal replays, cluster
+#                            failover re-registration)
+#   subs.tipsets_matched   — finalized tipset pairs the matcher compiled
+#                            the active filter set against
+#   subs.generations       — proof generations run, one per distinct
+#                            (pair, filter) — the fan-out amortization
+#                            counter (≤ distinct filters per tipset,
+#                            NEVER per subscriber)
+#   subs.notifications     — deliveries fanned out to subscribers
+#   subs.empty_matches     — (pair, filter) generations with zero proofs
+#                            (nothing to deliver — not an error)
+#   subs.errors            — per-filter generation failures absorbed
+#                            fail-soft (other filters still deliver)
+#   subs.deliveries        — delivery-log appends (monotonic cursors)
+#   subs.delivery_dedup    — appends absorbed by an already-seen
+#                            idempotency key (matcher replays)
+#   subs.acks              — deliveries acked (push 2xx or long-poll
+#                            cursor advance)
+#   subs.duplicate_acks    — ack attempts for unknown/already-acked
+#                            cursors, refused (the no-duplicate-ack guard)
+#   subs.pushes            — webhook pushes that landed (2xx)
+#   subs.push_retries      — webhook attempts after the first (full-jitter
+#                            backoff)
+#   subs.push_failures     — pushes that exhausted retries (delivery stays
+#                            unacked for long-poll / next-cycle re-push)
+#   subs.log_failures      — registry/delivery journal writes or
+#                            compactions that failed (ENOSPC/EROFS
+#                            fail-soft: the run completes in-memory)
+#   subs.log_compactions   — delivery-journal rewrites under the byte cap
+#                            (drops only acked history)
+SUBS_COUNTERS = (
+    "subs.registered",
+    "subs.unsubscribed",
+    "subs.replays_absorbed",
+    "subs.tipsets_matched",
+    "subs.generations",
+    "subs.notifications",
+    "subs.empty_matches",
+    "subs.errors",
+    "subs.deliveries",
+    "subs.delivery_dedup",
+    "subs.acks",
+    "subs.duplicate_acks",
+    "subs.pushes",
+    "subs.push_retries",
+    "subs.push_failures",
+    "subs.log_failures",
+    "subs.log_compactions",
 )
 
 # Counter vocabulary of the cluster plane (cluster/router.py,
@@ -294,6 +354,11 @@ STOREX_COUNTERS = (
 #                              surviving shard after a shard death; the
 #                              retry reuses the same idempotency key, so
 #                              at-least-once + dedup absorbs the repeat
+#   cluster.subscribe_requests — standing-query registrations routed to
+#                              their filter-affine shard
+#   cluster.subs_rearced     — subscriptions re-registered on a surviving
+#                              shard after their home shard died (original
+#                              sub ids; registry dedup absorbs replays)
 CLUSTER_COUNTERS = (
     "cluster.requests",
     "cluster.scatter_requests",
@@ -301,6 +366,8 @@ CLUSTER_COUNTERS = (
     "cluster.steals",
     "cluster.shard_errors",
     "cluster.shard_failovers",
+    "cluster.subscribe_requests",
+    "cluster.subs_rearced",
 )
 
 # Stage-timer vocabulary (`Metrics.stage(...)`): every `with
@@ -334,6 +401,13 @@ DURABILITY_GAUGES = (
 )
 STOREX_GAUGES = (
     "storex.disk_bytes",  # bytes across all disk-tier segment files
+    "follow.last_finalized_epoch",  # last height the follower warmed (healthz)
+)
+SUBS_GAUGES = (
+    "subs.active",  # registered subscriptions
+    "subs.pending_deliveries",  # unacked deliveries across all subscriptions
+    "subs.push_inflight",  # webhook pushes currently in flight
+    "subs.log_bytes",  # bytes in the delivery journal (cap trigger)
 )
 CLUSTER_GAUGES = (
     "cluster.shards_alive",  # shards currently routable (ring members)

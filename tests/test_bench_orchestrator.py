@@ -190,6 +190,17 @@ _CLUSTER_OK = {
     "cluster_requests": 64,
 }
 
+_STANDING_OK = {
+    "standing_proofs_pushed_per_sec_1k": 5400.0,
+    "standing_proofs_pushed_per_sec_10k": 5200.0,
+    "standing_delivery_lag_p50_ms": 950.0,
+    "standing_delivery_lag_p99_ms": 2200.0,
+    "standing_subscriptions": 10_000,
+    "standing_tipsets": 3,
+    "standing_distinct_filters": 2,
+    "standing_generations_per_tipset": 2.0,
+}
+
 _ONCHIP_OK = {
     "device_linearity_Nchip": 0.92,
     "batch_verify_speedup": 4.1,
@@ -232,6 +243,7 @@ class TestOrchestrate:
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
             "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
+            "standing": [(dict(_STANDING_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -265,6 +277,9 @@ class TestOrchestrate:
         assert out["device_linearity_Nchip"] == 0.92
         assert out["batch_verify_speedup"] == 4.1
         assert out["onchip_devices"] == 4
+        assert out["legs"]["standing"] == "ok:cpu"
+        assert out["standing_proofs_pushed_per_sec_10k"] == 5200.0
+        assert out["standing_generations_per_tipset"] == 2.0
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -283,6 +298,7 @@ class TestOrchestrate:
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
             "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
+            "standing": [(dict(_STANDING_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -296,7 +312,7 @@ class TestOrchestrate:
             ("native_baseline", "cpu"), ("serve", "cpu"), ("witness", "cpu"),
             ("resilience", "cpu"), ("durability", "cpu"),
             ("observability", "cpu"), ("storage", "cpu"),
-            ("asyncfetch", "cpu"), ("cluster", "cpu"),
+            ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -315,6 +331,7 @@ class TestOrchestrate:
             "storage": [(dict(_STORAGE_OK), "ok:cpu")],
             "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
+            "standing": [(dict(_STANDING_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -364,6 +381,7 @@ class TestOrchestrate:
             "storage": [(None, "error:cpu")],
             "asyncfetch": [(None, "error:cpu")],
             "cluster": [(None, "error:cpu")],
+            "standing": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -381,6 +399,10 @@ class TestOrchestrate:
             "cold_speedup_vs_sync_walker", "speculate_waste_pct",
             "cluster_linearity_4shard", "aggregate_proofs_per_sec",
             "steal_events", "device_linearity_Nchip", "batch_verify_speedup",
+            "standing_proofs_pushed_per_sec_1k",
+            "standing_proofs_pushed_per_sec_10k",
+            "standing_delivery_lag_p50_ms", "standing_delivery_lag_p99_ms",
+            "standing_generations_per_tipset",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

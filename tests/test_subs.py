@@ -1,0 +1,1013 @@
+"""Standing queries: registry, delivery log, push fan-out, matcher, e2e.
+
+Covers the streaming plane end to end:
+
+- `SubscriptionRegistry` — filter/target normalization, durable replay,
+  torn-tail recovery, idempotent re-registration;
+- `DeliveryLog` — monotonic cursors, idempotency dedup, duplicate-ack
+  guard, content-addressed payload frames, long-poll wakeup, byte-capped
+  compaction that never drops an unacked delivery, ENOSPC fail-soft;
+- `PushDelivery` — transient-failure convergence with bounded full-jitter
+  retry, terminal 4xx fail-fast, exhausted-then-repush convergence;
+- `ChainFollower` satellites — jittered poll delay bounds, poll counter +
+  last-finalized gauge, raising-hook fail-soft, unchanged-head idempotence;
+- `StandingQueryMatcher` — one generation per distinct (pair, filter),
+  fan-out to every subscriber, replay dedup, per-filter fail-soft;
+- the serve plane — /v1/subscribe|subscriptions|deliveries routes,
+  /healthz merge, and SIGTERM-mid-push shutdown ordering (delivery
+  workers drain before the service);
+- the 4-assertion end-to-end: a real `ChainFollower` over a seeded
+  `LocalLotusSession` driving fan-out byte-identical to the
+  request/response path, generate-once accounting, transient-webhook
+  convergence without duplicate acks, and SIGKILL/restart survival;
+- cluster failover: a dead shard's subscription arc re-registers on the
+  survivor under the ORIGINAL sub ids.
+"""
+
+import json
+import os
+import random
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ipc_proofs_tpu.cluster import ClusterRouter, LocalShard
+from ipc_proofs_tpu.cluster.hashring import HashRing
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.jobs.journal import read_journal_entries
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
+from ipc_proofs_tpu.store.faults import LocalLotusSession
+from ipc_proofs_tpu.store.rpc import LotusClient
+from ipc_proofs_tpu.storex import ChainFollower
+from ipc_proofs_tpu.subs import (
+    DeliveryLog,
+    PushDelivery,
+    StandingQueries,
+    StandingQueryMatcher,
+    Subscription,
+    SubscriptionRegistry,
+    filter_key,
+    normalize_filter,
+    normalize_target,
+    subscription_ring_key,
+)
+from ipc_proofs_tpu.subs.delivery import DELIVERY_JOURNAL
+from ipc_proofs_tpu.subs.matcher import _bundle_digest
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+
+FILTER_A = {"signature": SIG, "topic1": SUBNET}
+FILTER_B = {"signature": SIG, "topic1": SUBNET, "actor_id": ACTOR}
+
+_NOSLEEP = lambda s: None  # noqa: E731 — push retry seam: no real sleeps
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        4,
+        receipts_per_pair=6,
+        events_per_receipt=3,
+        match_rate=0.5,
+        signature=SIG,
+        topic1=SUBNET,
+        actor_id=ACTOR,
+        base_height=41_000,
+    )
+
+
+def _counters(m):
+    return m.snapshot()["counters"]
+
+
+def _gauges(m):
+    return m.snapshot().get("gauges", {})
+
+
+def _wait_until(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _expected(store, pair, filt):
+    """The request/response path's bundle for (pair, filter) — the byte
+    oracle every pushed/pulled delivery must match exactly."""
+    spec = EventProofSpec(
+        event_signature=filt["signature"],
+        topic_1=filt["topic1"],
+        actor_id_filter=filt.get("actor_id"),
+    )
+    bundle = generate_event_proofs_for_range_chunked(
+        store, [pair], spec, chunk_size=8
+    )
+    obj = bundle.to_json_obj()
+    return obj, _bundle_digest(obj)
+
+
+class _RecordingOpener:
+    """Webhook seam: records every POST, answers via ``behavior(obj)``."""
+
+    def __init__(self, behavior=None):
+        self._lock = threading.Lock()
+        self._calls = []
+        self._behavior = behavior
+
+    def __call__(self, url, body, timeout_s):
+        obj = json.loads(body)
+        with self._lock:
+            self._calls.append((url, body, obj))
+        return 200 if self._behavior is None else self._behavior(obj)
+
+    def calls(self, sub_id=None):
+        with self._lock:
+            out = list(self._calls)
+        if sub_id is None:
+            return out
+        return [c for c in out if c[2]["sub_id"] == sub_id]
+
+
+class _BrokenFile:
+    """A file handle on a full/readonly filesystem (mirrors test_jobs)."""
+
+    def __init__(self, err=28):  # ENOSPC
+        self._err = err
+
+    def write(self, data):
+        raise OSError(self._err, os.strerror(self._err))
+
+    def flush(self):
+        raise OSError(self._err, os.strerror(self._err))
+
+    def fileno(self):
+        raise OSError(self._err, os.strerror(self._err))
+
+    def close(self):
+        pass
+
+
+def _tipset_api_json(tipset):
+    return {
+        "Cids": [{"/": str(c)} for c in tipset.cids],
+        "Height": tipset.height,
+        "Blocks": [
+            {
+                "Parents": [{"/": str(p)} for p in header.parents],
+                "Height": header.height,
+                "ParentStateRoot": {"/": str(header.parent_state_root)},
+                "ParentMessageReceipts": {
+                    "/": str(header.parent_message_receipts)
+                },
+                "Messages": {"/": str(header.messages)},
+                "Timestamp": header.timestamp,
+            }
+            for header in tipset.blocks
+        ],
+    }
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+
+class TestFilterNormalization:
+    def test_minimal_filter_normalizes(self):
+        filt = normalize_filter({"signature": SIG, "topic1": SUBNET})
+        assert filt == {"signature": SIG, "topic1": SUBNET}
+
+    def test_actor_and_slot_pass_through(self):
+        filt = normalize_filter(dict(FILTER_B, slot="ab" * 32))
+        assert filt["actor_id"] == ACTOR
+        assert filt["slot"] == "ab" * 32
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {"topic1": SUBNET},  # signature required
+            {"signature": SIG},  # topic1 required (EventMatcher needs it)
+            dict(FILTER_A, actor_id=True),  # bool is not an actor id
+            dict(FILTER_A, slot="ab" * 32),  # slot requires actor_id
+            dict(FILTER_B, slot="xyz"),  # slot must be 64-hex
+            dict(FILTER_A, surprise=1),  # unknown keys rejected
+            "not a dict",
+            None,
+        ],
+    )
+    def test_bad_filters_rejected(self, bad):
+        with pytest.raises(ValueError):
+            normalize_filter(bad)
+
+    def test_target_normalization(self):
+        assert normalize_target(None)["mode"] == "poll"
+        t = normalize_target({"url": "http://hooks/x"})
+        assert t["mode"] == "webhook" and t["url"] == "http://hooks/x"
+        with pytest.raises(ValueError):
+            normalize_target({"mode": "webhook"})  # webhook needs a url
+        with pytest.raises(ValueError):
+            normalize_target({"mode": "webhook", "url": "no-scheme"})
+
+    def test_filter_key_is_order_canonical(self):
+        a = {"signature": SIG, "topic1": SUBNET, "actor_id": ACTOR}
+        b = {"actor_id": ACTOR, "topic1": SUBNET, "signature": SIG}
+        assert filter_key(normalize_filter(a)) == filter_key(normalize_filter(b))
+        assert subscription_ring_key(normalize_filter(a)).startswith("subs:")
+
+
+class TestSubscriptionRegistry:
+    def test_register_unsubscribe_roundtrip(self, tmp_path):
+        m = Metrics()
+        reg = SubscriptionRegistry(str(tmp_path), metrics=m, fsync=False)
+        sub, created = reg.subscribe(FILTER_A, {"url": "http://h/1"}, sub_id="s1")
+        assert created and sub.sub_id == "s1"
+        assert sub.target["mode"] == "webhook"
+        # duplicate id absorbs idempotently — the failover/replay guarantee
+        again, created2 = reg.subscribe(FILTER_B, None, sub_id="s1")
+        assert not created2 and again.filter == sub.filter
+        assert _counters(m)["subs.replays_absorbed"] == 1
+        assert len(reg) == 1
+        assert reg.unsubscribe("s1") and not reg.unsubscribe("s1")
+        assert reg.active() == []
+        reg.close()
+
+    def test_restart_replays_registrations(self, tmp_path):
+        reg = SubscriptionRegistry(str(tmp_path), metrics=Metrics(), fsync=False)
+        for i in range(3):
+            reg.subscribe(FILTER_A if i % 2 else FILTER_B, None, sub_id=f"s{i}")
+        reg.unsubscribe("s1")
+        reg.close()
+
+        reg2 = SubscriptionRegistry(str(tmp_path), metrics=Metrics(), fsync=False)
+        assert sorted(s.sub_id for s in reg2.active()) == ["s0", "s2"]
+        assert reg2.replayed == 4  # 3 sub frames + 1 unsub frame
+        assert reg2.get("s0").filter == normalize_filter(FILTER_B)
+        reg2.close()
+
+    def test_torn_tail_is_truncated_not_fatal(self, tmp_path):
+        reg = SubscriptionRegistry(str(tmp_path), metrics=Metrics(), fsync=False)
+        reg.subscribe(FILTER_A, None, sub_id="keep")
+        reg.close()
+        from ipc_proofs_tpu.jobs.journal import frame_record
+
+        half = frame_record({"op": "sub", "id": "lost", "filter": FILTER_A})
+        with open(reg.path, "ab") as fh:
+            fh.write(half[: len(half) // 2])  # crash mid-write: torn frame
+        reg2 = SubscriptionRegistry(str(tmp_path), metrics=Metrics(), fsync=False)
+        assert [s.sub_id for s in reg2.active()] == ["keep"]
+        # and the journal is clean again: a third open replays fine
+        reg2.subscribe(FILTER_B, None, sub_id="k2")
+        reg2.close()
+        reg3 = SubscriptionRegistry(str(tmp_path), metrics=Metrics(), fsync=False)
+        assert len(reg3) == 2
+        reg3.close()
+
+    def test_enospc_fail_soft(self, tmp_path):
+        m = Metrics()
+        reg = SubscriptionRegistry(str(tmp_path), metrics=m, fsync=False)
+        reg._writer._fh = _BrokenFile()
+        sub, created = reg.subscribe(FILTER_A, None, sub_id="mem-only")
+        assert created and reg.get("mem-only") is sub  # run completes in-memory
+        assert reg.degraded
+        assert _counters(m)["subs.log_failures"] >= 1
+        reg.close()
+
+
+# --------------------------------------------------------------------------
+# delivery log
+# --------------------------------------------------------------------------
+
+
+class TestDeliveryLog:
+    def test_cursors_dedup_and_duplicate_ack_guard(self, tmp_path):
+        m = Metrics()
+        log = DeliveryLog(str(tmp_path), metrics=m, fsync=False)
+        pay = {"bundle": {"n": 1}}
+        d1 = log.append("s1", 100, "aa" * 16, pay)
+        d2 = log.append("s1", 101, "bb" * 16, pay)
+        assert (d1.cursor, d2.cursor) == (1, 2)
+        assert log.append("s1", 100, "aa" * 16, pay) is None  # idempotent
+        assert _counters(m)["subs.delivery_dedup"] == 1
+        assert log.pending_total() == 2
+        assert log.ack("s1", 1) is True
+        assert log.ack("s1", 1) is False  # duplicate-ack guard
+        assert _counters(m)["subs.duplicate_acks"] == 1
+        assert [d.cursor for d in log.pending("s1")] == [2]
+        assert log.ack_through("s1", 10) == 1
+        assert log.pending_total() == 0
+        log.close()
+
+    def test_restart_resolves_content_addressed_payloads(self, tmp_path):
+        log = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        shared = {"bundle": {"blocks": ["cc" * 64], "n": 7}}
+        dg = "d1" * 16
+        log.append("s1", 100, dg, shared)
+        log.append("s2", 100, dg, shared)  # same proof, second subscriber
+        log.append("s1", 101, "e2" * 16, {"bundle": {"n": 8}})
+        log.ack("s2", 1)
+        log.close()
+
+        entries, _, torn = read_journal_entries(
+            os.path.join(str(tmp_path), DELIVERY_JOURNAL)
+        )
+        assert not torn
+        pays = [r for r, _, _ in entries if r.get("op") == "pay"]
+        assert len(pays) == 2  # one frame per digest, NOT per subscriber
+        dlvs = [r for r, _, _ in entries if r.get("op") == "dlv"]
+        assert all("payload" not in r for r in dlvs)
+
+        log2 = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        assert log2.pending_total() == 2
+        assert log2.pending("s1")[0].payload == shared  # digest resolved
+        assert log2.pending("s1")[1].payload == {"bundle": {"n": 8}}
+        assert log2.pending("s2") == []
+        # idempotency keys survive: the matcher replaying this (pair,
+        # filter) after restart dedups instead of double-delivering
+        assert log2.append("s1", 100, dg, shared) is None
+        log2.close()
+
+    def test_long_poll_wakes_on_append(self, tmp_path):
+        log = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        out = {}
+
+        def waiter():
+            t0 = time.monotonic()
+            out["entries"] = log.entries_after("s1", 0, wait_s=10.0)
+            out["elapsed"] = time.monotonic() - t0
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.15)
+        log.append("s1", 1, "aa" * 16, {"bundle": {"n": 1}})
+        t.join(timeout=8.0)
+        assert not t.is_alive()
+        assert [e.cursor for e in out["entries"]] == [1]
+        assert out["elapsed"] < 8.0  # woken by the append, not the timeout
+        log.close()
+
+    def test_compaction_caps_bytes_without_losing_unacked(self, tmp_path):
+        m = Metrics()
+        # cap_bytes clamps to the 64 KiB floor; ~4 KiB payloads overflow it
+        log = DeliveryLog(str(tmp_path), metrics=m, cap_bytes=1, fsync=False)
+        blob = {"bundle": {"x": "ab" * 2048}}
+        for i in range(40):
+            d = log.append("s1", i, f"{i:02d}" * 16, blob)
+            if i < 37:
+                log.ack("s1", d.cursor)
+        assert _counters(m)["subs.log_compactions"] >= 1
+        assert [d.cursor for d in log.pending("s1")] == [38, 39, 40]
+        log.close()
+
+        log2 = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        # truncation only ever dropped entries below the acked cursor
+        assert [d.cursor for d in log2.pending("s1")] == [38, 39, 40]
+        assert log2.pending("s1")[0].payload == blob
+        # acked history is gone from disk but its dedup window is not
+        assert log2.append("s1", 5, "05" * 16, blob) is None
+        assert log2.journal_bytes < 40 * 4200
+        log2.close()
+
+    def test_enospc_fail_soft_serves_from_memory(self, tmp_path):
+        m = Metrics()
+        log = DeliveryLog(str(tmp_path), metrics=m, fsync=False)
+        log.append("s1", 1, "aa" * 16, {"bundle": {"n": 1}})
+        log._writer._fh = _BrokenFile()
+        d = log.append("s1", 2, "bb" * 16, {"bundle": {"n": 2}})
+        assert d is not None and d.cursor == 2  # the run completes
+        assert log.degraded
+        assert _counters(m)["subs.log_failures"] >= 1
+        assert [e.cursor for e in log.entries_after("s1", 0)] == [1, 2]
+        assert log.ack("s1", 2) is True  # acks keep working in-memory
+        assert [e.cursor for e in log.pending("s1")] == [1]
+        log.close()
+
+
+# --------------------------------------------------------------------------
+# webhook push
+# --------------------------------------------------------------------------
+
+
+def _webhook_sub(sub_id="w1", filt=FILTER_A, url="http://hooks/w1"):
+    return Subscription(
+        sub_id=sub_id,
+        filter=normalize_filter(filt),
+        target={"mode": "webhook", "url": url},
+    )
+
+
+class TestPushDelivery:
+    def test_transient_failure_converges_without_duplicate_ack(self, tmp_path):
+        m = Metrics()
+        log = DeliveryLog(str(tmp_path), metrics=m, fsync=False)
+        codes = iter([503, 503, 200])
+        opener = _RecordingOpener(lambda obj: next(codes, 200))
+        push = PushDelivery(
+            log, metrics=m, max_attempts=4, base_delay_s=0.01, max_delay_s=0.02,
+            opener=opener, sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        sub = _webhook_sub()
+        d = log.append("w1", 7, "aa" * 16, {"bundle": {"n": 1}})
+        fut = push.push(sub, d)
+        assert fut.result(timeout=30) is True
+        c = _counters(m)
+        assert c["subs.push_retries"] == 2
+        assert c["subs.pushes"] == 1 and c["subs.acks"] == 1
+        assert "subs.duplicate_acks" not in c
+        assert log.pending("w1") == []
+        push.drain()
+        log.close()
+
+    def test_terminal_client_error_fails_fast(self, tmp_path):
+        m = Metrics()
+        log = DeliveryLog(str(tmp_path), metrics=m, fsync=False)
+        opener = _RecordingOpener(lambda obj: 400)
+        push = PushDelivery(
+            log, metrics=m, max_attempts=4, opener=opener,
+            sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        d = log.append("w1", 7, "aa" * 16, {"bundle": {"n": 1}})
+        assert push.push(_webhook_sub(), d).result(timeout=30) is False
+        c = _counters(m)
+        assert c["subs.push_failures"] == 1
+        assert "subs.push_retries" not in c  # 4xx never retries
+        assert len(log.pending("w1")) == 1  # unacked: long-poll still owns it
+        push.drain()
+        log.close()
+
+    def test_exhausted_push_converges_via_repush(self, tmp_path):
+        m = Metrics()
+        log = DeliveryLog(str(tmp_path), metrics=m, fsync=False)
+        state = {"code": 503}
+        opener = _RecordingOpener(lambda obj: state["code"])
+        push = PushDelivery(
+            log, metrics=m, max_attempts=2, base_delay_s=0.01, max_delay_s=0.02,
+            opener=opener, sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        reg = SubscriptionRegistry(str(tmp_path), metrics=m, fsync=False)
+        reg.subscribe(FILTER_A, {"url": "http://hooks/w1"}, sub_id="w1")
+        d = log.append("w1", 7, "aa" * 16, {"bundle": {"n": 1}})
+        assert push.push(reg.get("w1"), d).result(timeout=30) is False
+        assert _counters(m)["subs.push_failures"] == 1
+        assert len(log.pending("w1")) == 1
+
+        state["code"] = 200  # webhook endpoint recovers
+        assert push.repush_pending(reg) == 1
+        assert _wait_until(lambda: not log.pending("w1"))
+        c = _counters(m)
+        assert c["subs.acks"] == 1 and "subs.duplicate_acks" not in c
+        push.drain()
+        log.close()
+        reg.close()
+
+    def test_poll_targets_are_never_pushed(self, tmp_path):
+        log = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        push = PushDelivery(log, metrics=Metrics(), opener=_RecordingOpener())
+        sub = Subscription(
+            sub_id="p1", filter=normalize_filter(FILTER_A), target={"mode": "poll"}
+        )
+        d = log.append("p1", 7, "aa" * 16, {"bundle": {"n": 1}})
+        assert push.push(sub, d) is None
+        push.drain()
+        log.close()
+
+
+# --------------------------------------------------------------------------
+# follower satellites
+# --------------------------------------------------------------------------
+
+
+def _follow_client(bs, responses, m):
+    return LotusClient(
+        "http://test-follow",
+        session=LocalLotusSession(bs, responses=responses),
+        metrics=m,
+    )
+
+
+class TestFollowerSatellites:
+    def test_poll_delay_is_jittered_and_bounded(self, world):
+        bs, _, _ = world
+        f = ChainFollower(object(), bs, poll_s=10.0, rng=random.Random(0))
+        delays = [f._poll_delay() for _ in range(64)]
+        assert all(9.0 <= d <= 11.0 for d in delays)  # poll_s * (1 ± 0.1)
+        assert len(set(delays)) > 1  # actually jittered, not constant
+        assert ChainFollower(
+            object(), bs, poll_s=10.0, poll_jitter=0.0
+        )._poll_delay() == 10.0
+        # absurd jitter clamps to 0.9: the delay can never hit zero
+        clamped = ChainFollower(object(), bs, poll_s=10.0, poll_jitter=5.0)
+        assert clamped.poll_jitter == 0.9
+        assert all(1.0 <= clamped._poll_delay() <= 19.0 for _ in range(64))
+
+    def test_poll_counter_and_finalized_gauge(self, world):
+        bs, pairs, _ = world
+        child = pairs[0].child
+        responses = {
+            "Filecoin.ChainHead": {
+                "Height": child.height + 1,
+                "Cids": [{"/": str(c)} for c in child.cids],
+            },
+            "Filecoin.ChainGetTipSetByHeight": _tipset_api_json(child),
+        }
+        m = Metrics()
+        follower = ChainFollower(_follow_client(bs, responses, m), bs, metrics=m, lag=1)
+        assert follower.poll_once() == 1
+        # unchanged head: counted poll, no re-processing — idempotent
+        assert follower.poll_once() == 0
+        c = _counters(m)
+        assert c["follow.polls"] == 2
+        assert c["follow.tipsets"] == 1
+        assert _gauges(m)["follow.last_finalized_epoch"] == child.height
+
+    def test_raising_hook_is_fail_soft(self, world):
+        bs, pairs, _ = world
+        child = pairs[0].child
+        responses = {
+            "Filecoin.ChainHead": {
+                "Height": child.height + 1,
+                "Cids": [{"/": str(c)} for c in child.cids],
+            },
+            "Filecoin.ChainGetTipSetByHeight": _tipset_api_json(child),
+        }
+        m = Metrics()
+        follower = ChainFollower(_follow_client(bs, responses, m), bs, metrics=m, lag=1)
+        seen = []
+        follower.add_finalized_hook(lambda ts: 1 / 0)
+        follower.add_finalized_hook(lambda ts: seen.append(ts.height))
+        assert follower.poll_once() == 1  # the tipset still lands
+        assert seen == [child.height]  # later hooks still fire
+        assert _counters(m)["follow.errors"] >= 1
+
+
+# --------------------------------------------------------------------------
+# matcher
+# --------------------------------------------------------------------------
+
+
+def _stack(root, store, opener, m=None):
+    m = m if m is not None else Metrics()
+    reg = SubscriptionRegistry(root, metrics=m, fsync=False)
+    log = DeliveryLog(root, metrics=m, fsync=False)
+    push = PushDelivery(
+        log, metrics=m, max_attempts=3, base_delay_s=0.01, max_delay_s=0.02,
+        opener=opener, sleep=_NOSLEEP, rng=random.Random(0),
+    )
+    matcher = StandingQueryMatcher(reg, log, push, store, metrics=m, chunk_size=8)
+    return m, reg, log, push, matcher
+
+
+def _drain_stack(reg, log, push, matcher):
+    matcher.drain()
+    push.drain()
+    log.close()
+    reg.close()
+
+
+class TestStandingQueryMatcher:
+    def test_generate_once_fans_out_byte_identical(self, tmp_path, world):
+        store, pairs, _ = world
+        opener = _RecordingOpener()
+        m, reg, log, push, matcher = _stack(str(tmp_path), store, opener)
+        reg.subscribe(FILTER_A, {"url": "http://h/a1"}, sub_id="w-a1")
+        reg.subscribe(FILTER_A, {"url": "http://h/a2"}, sub_id="w-a2")
+        reg.subscribe(FILTER_B, {"url": "http://h/b1"}, sub_id="w-b1")
+        try:
+            assert matcher.match_pair(pairs[0]) == 3
+            # 3 subscribers, 2 distinct filters, exactly 2 generations
+            assert _counters(m)["subs.generations"] == 2
+            assert _wait_until(lambda: log.pending_total() == 0)
+            assert _counters(m)["subs.pushes"] == 3
+            for sub_id, filt in (("w-a1", FILTER_A), ("w-a2", FILTER_A),
+                                 ("w-b1", FILTER_B)):
+                obj, digest = _expected(store, pairs[0], normalize_filter(filt))
+                calls = opener.calls(sub_id)
+                assert len(calls) == 1
+                _url, body, envelope = calls[0]
+                assert envelope["digest"] == digest
+                assert envelope["tipset"] == pairs[0].child.height
+                # byte identity with the request/response path's bundle
+                raw = json.dumps(obj, sort_keys=True)
+                assert body.decode("utf-8").endswith(', "bundle": ' + raw + "}")
+        finally:
+            _drain_stack(reg, log, push, matcher)
+
+    def test_on_tipset_pairs_and_replay_dedups(self, tmp_path, world):
+        store, pairs, _ = world
+        m, reg, log, push, matcher = _stack(
+            str(tmp_path), store, _RecordingOpener()
+        )
+        reg.subscribe(FILTER_A, None, sub_id="p-a")  # poll target
+        try:
+            assert matcher.on_tipset(pairs[0].parent) == 0  # first: no pair yet
+            assert matcher.on_tipset(pairs[0].child) == 1
+            # a replayed height is a no-op, not a re-delivery
+            assert matcher.on_tipset(pairs[0].child) == 0
+            # replaying the full matching cycle dedups on the idempotency key
+            assert matcher.match_pair(pairs[0]) == 0
+            assert _counters(m)["subs.delivery_dedup"] >= 1
+            assert log.pending_total() == 1
+        finally:
+            _drain_stack(reg, log, push, matcher)
+
+    def test_one_failing_filter_does_not_starve_the_rest(
+        self, tmp_path, world, monkeypatch
+    ):
+        store, pairs, _ = world
+        import ipc_proofs_tpu.proofs.range as range_mod
+
+        real = range_mod.generate_event_proofs_for_range_chunked
+
+        def boom_for_filter_a(store_, pairs_, spec, **kw):
+            if spec.actor_id_filter is None:  # FILTER_A has no actor_id
+                raise RuntimeError("seeded generation fault")
+            return real(store_, pairs_, spec, **kw)
+
+        monkeypatch.setattr(
+            range_mod, "generate_event_proofs_for_range_chunked", boom_for_filter_a
+        )
+        opener = _RecordingOpener()
+        m, reg, log, push, matcher = _stack(str(tmp_path), store, opener)
+        reg.subscribe(FILTER_A, {"url": "http://h/a"}, sub_id="w-a")
+        reg.subscribe(FILTER_B, {"url": "http://h/b"}, sub_id="w-b")
+        try:
+            assert matcher.match_pair(pairs[0]) == 1  # B delivered
+            assert _counters(m)["subs.errors"] == 1  # A counted, not raised
+            assert _wait_until(lambda: len(opener.calls("w-b")) == 1)
+            assert opener.calls("w-a") == []
+        finally:
+            _drain_stack(reg, log, push, matcher)
+
+
+# --------------------------------------------------------------------------
+# serve plane: HTTP routes, healthz, shutdown ordering
+# --------------------------------------------------------------------------
+
+
+def _http_json(url, body=None, timeout=30):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url,
+        data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+        method="POST" if data else "GET",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestServePlane:
+    def test_subscription_routes_and_healthz(self, tmp_path, world):
+        store, pairs, _ = world
+        svc = ProofService(
+            store=store,
+            spec=EventProofSpec(SIG, SUBNET),
+            config=ServiceConfig(max_batch=4, max_wait_ms=5.0, workers=1),
+        )
+        sq = StandingQueries(
+            str(tmp_path), store=store, fsync=False,
+            opener=_RecordingOpener(), sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        httpd = ProofHTTPServer(svc, port=0, pairs=pairs, subs=sq).start()
+        try:
+            status, obj = _http_json(
+                httpd.address + "/v1/subscribe",
+                {"filter": FILTER_A, "sub_id": "http-1"},
+            )
+            assert status == 200 and obj == {"sub_id": "http-1", "created": True}
+            status, obj = _http_json(httpd.address + "/v1/subscriptions")
+            assert status == 200 and obj["count"] == 1
+            assert obj["subscriptions"][0]["sub_id"] == "http-1"
+
+            sq.matcher.match_pair(pairs[0])
+            status, obj = _http_json(
+                httpd.address + "/v1/deliveries?sub=http-1&cursor=0"
+            )
+            assert status == 200 and len(obj["deliveries"]) == 1
+            expect, digest = _expected(store, pairs[0], normalize_filter(FILTER_A))
+            assert obj["deliveries"][0]["digest"] == digest
+            assert obj["deliveries"][0]["payload"]["bundle"] == expect
+
+            status, health = _http_json(httpd.address + "/healthz")
+            assert health["subscriptions"] == 1
+            assert health["pending_deliveries"] == 1
+            assert health["subs_degraded"] is False
+
+            status, obj = _http_json(
+                httpd.address + "/v1/unsubscribe", {"sub_id": "http-1"}
+            )
+            assert status == 200 and obj == {"removed": True}
+        finally:
+            httpd.shutdown(timeout=30)
+
+    def test_sigterm_mid_push_drains_workers_before_service(
+        self, tmp_path, world
+    ):
+        """The shutdown-ordering regression: a SIGTERM landing while a
+        webhook POST is in flight must drain the delivery workers (the
+        push completes and acks) BEFORE the proof service closes."""
+        store, pairs, _ = world
+        entered = threading.Event()
+        release = threading.Event()
+
+        def blocking_opener(url, body, timeout_s):
+            entered.set()
+            assert release.wait(timeout=30)
+            return 200
+
+        m = Metrics()
+        svc = ProofService(
+            store=store,
+            spec=EventProofSpec(SIG, SUBNET),
+            config=ServiceConfig(max_batch=4, max_wait_ms=5.0, workers=1),
+        )
+        sq = StandingQueries(
+            str(tmp_path), store=store, metrics=m, fsync=False,
+            opener=blocking_opener, sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        httpd = ProofHTTPServer(svc, port=0, pairs=pairs, subs=sq).start()
+
+        order = []
+        orig_subs_drain, orig_svc_drain = sq.drain, svc.drain
+        sq.drain = lambda: (order.append("subs"), orig_subs_drain())[-1]
+        svc.drain = lambda *a, **k: (
+            order.append("service"), orig_svc_drain(*a, **k)
+        )[-1]
+
+        sub, _ = sq.registry.subscribe(
+            FILTER_A, {"url": "http://hooks/block"}, sub_id="wh-block"
+        )
+        d = sq.log.append("wh-block", 41_001, "aa" * 16, {"bundle": {"n": 1}})
+        sq.push.push(sub, d)
+        assert entered.wait(timeout=10)  # the POST is now mid-flight
+
+        def _raise_kbd(signum, frame):
+            raise KeyboardInterrupt  # what the serve CLI's handler does
+
+        old = signal.signal(signal.SIGTERM, _raise_kbd)
+        try:
+            releaser = threading.Timer(0.3, release.set)
+            releaser.start()
+            with pytest.raises(KeyboardInterrupt):
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(10)
+            httpd.shutdown(timeout=30)  # the CLI's finally block
+            releaser.join()
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        assert order == ["subs", "service"]
+        assert sq.log.pending("wh-block") == []  # in-flight push landed+acked
+        assert _counters(m)["subs.pushes"] == 1
+
+
+# --------------------------------------------------------------------------
+# end to end: follower → matcher → fan-out → restart
+# --------------------------------------------------------------------------
+
+
+class TestEndToEndStanding:
+    def test_follow_match_push_restart(self, tmp_path, world):
+        store, pairs, _ = world
+        root = str(tmp_path / "subs")
+        m = Metrics()
+
+        # webhook behavior: wh-flaky's endpoint is down for the whole first
+        # life of the daemon; wh-a1's endpoint drops exactly one request
+        # (transient); everything else is healthy.
+        flaky_lock = threading.Lock()
+        state = {"wh-a1-drops": 1}
+
+        def behavior(envelope):
+            if envelope["sub_id"] == "wh-flaky":
+                return 503
+            with flaky_lock:
+                if envelope["sub_id"] == "wh-a1" and state["wh-a1-drops"]:
+                    state["wh-a1-drops"] -= 1
+                    return 503
+            return 200
+
+        opener = _RecordingOpener(behavior)
+        sq = StandingQueries(
+            root, store=store, metrics=m, fsync=False, push_max_inflight=2,
+            opener=opener, sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        for sub_id, filt, url in (
+            ("wh-a1", FILTER_A, "http://hooks/a1"),
+            ("wh-a2", FILTER_A, "http://hooks/a2"),
+            ("poll-a", FILTER_A, None),
+            ("wh-b1", FILTER_B, "http://hooks/b1"),
+            ("wh-flaky", FILTER_B, "http://hooks/flaky"),
+            ("poll-b", FILTER_B, None),
+        ):
+            body = {"filter": filt, "sub_id": sub_id}
+            if url:
+                body["target"] = {"url": url}
+            assert sq.subscribe(body)["created"]
+
+        # a real follower over a seeded local session; head advances one
+        # height per poll so each poll finalizes exactly one tipset
+        session = LocalLotusSession(store)
+        client = LotusClient("http://test-follow", session=session, metrics=m)
+        follower = ChainFollower(client, store, metrics=m, lag=1)
+        follower.add_finalized_hook(sq.on_tipset)
+        feed = []
+        for p in pairs[:3]:
+            feed.extend([p.parent, p.child])
+        for ts in feed:
+            session._responses["Filecoin.ChainHead"] = {
+                "Height": ts.height + 1,
+                "Cids": [{"/": str(c)} for c in ts.cids],
+            }
+            session._responses["Filecoin.ChainGetTipSetByHeight"] = (
+                _tipset_api_json(ts)
+            )
+            assert follower.poll_once() == 1
+
+        # convergence: 3 healthy webhook subs × 3 matched pairs all acked;
+        # pending = 2 poll subs × 3 + wh-flaky's 3 stranded deliveries
+        assert _wait_until(
+            lambda: _counters(m).get("subs.pushes", 0) == 9
+            and sq.log.pending_total() == 9
+            and _gauges(m).get("subs.push_inflight") == 0
+        ), _counters(m)
+
+        c = _counters(m)
+        # (2) exactly one generation per distinct (pair, filter): the
+        # follower observed 5 pairs (3 real + 2 parent-gap pairs with no
+        # receipts) and 2 distinct filters were registered throughout
+        assert c["subs.tipsets_matched"] == 5
+        assert c["subs.generations"] == 5 * 2
+        assert c["subs.empty_matches"] == 2 * 2
+        assert c["subs.notifications"] == 6 * 3  # every subscriber, every pair
+        # (3) the transient wh-a1 failure converged via in-push retry and
+        # nothing ever acked twice
+        assert c["subs.push_retries"] >= 1
+        assert "subs.duplicate_acks" not in c
+        assert c["subs.push_failures"] >= 3  # wh-flaky exhausted each pair
+
+        # (1) every delivery is byte-identical to the request/response
+        # path's bundle for the same (pair, filter) — pushed and polled
+        for sub_id, filt in (("wh-a1", FILTER_A), ("wh-b1", FILTER_B)):
+            for pair in pairs[:3]:
+                obj, digest = _expected(store, pair, normalize_filter(filt))
+                raw = json.dumps(obj, sort_keys=True)
+                acked = [
+                    (u, b, env)
+                    for (u, b, env) in opener.calls(sub_id)
+                    if env["tipset"] == pair.child.height
+                ]
+                assert acked, (sub_id, pair.child.height)
+                for _u, body, env in acked:
+                    assert env["digest"] == digest
+                    assert body.decode("utf-8").endswith(
+                        ', "bundle": ' + raw + "}"
+                    )
+        polled = sq.deliveries("poll-a", cursor=0)
+        assert [e["tipset"] for e in polled["deliveries"]] == [
+            p.child.height for p in pairs[:3]
+        ]
+        for entry, pair in zip(polled["deliveries"], pairs[:3]):
+            obj, digest = _expected(store, pair, normalize_filter(FILTER_A))
+            assert entry["digest"] == digest
+            assert entry["payload"]["bundle"] == obj
+
+        # (4) SIGKILL: no drain, no close — just abandon the instance and
+        # replay the journals. Registrations and unacked deliveries
+        # survive; the constructor's repush converges wh-flaky now that
+        # its endpoint is back.
+        m2 = Metrics()
+        opener2 = _RecordingOpener()
+        sq2 = StandingQueries(
+            root, store=store, metrics=m2, fsync=False,
+            opener=opener2, sleep=_NOSLEEP, rng=random.Random(1),
+        )
+        try:
+            assert len(sq2.registry) == 6
+            assert sorted(s.sub_id for s in sq2.registry.active()) == [
+                "poll-a", "poll-b", "wh-a1", "wh-a2", "wh-b1", "wh-flaky",
+            ]
+            assert _wait_until(lambda: sq2.log.pending_total() == 6)
+            assert len(opener2.calls("wh-flaky")) == 3
+            c2 = _counters(m2)
+            assert c2["subs.acks"] == 3 and "subs.duplicate_acks" not in c2
+            # the poll subscribers' cursors survived verbatim
+            polled2 = sq2.deliveries("poll-b", cursor=0)
+            assert [e["tipset"] for e in polled2["deliveries"]] == [
+                p.child.height for p in pairs[:3]
+            ]
+            obj, _ = _expected(store, pairs[0], normalize_filter(FILTER_B))
+            assert polled2["deliveries"][0]["payload"]["bundle"] == obj
+            # acking through the long-poll cursor releases them for good
+            last = polled2["cursor"]
+            assert sq2.deliveries("poll-b", cursor=last)["deliveries"] == []
+            assert sq2.log.pending("poll-b") == []
+        finally:
+            sq2.drain()
+            sq.drain()  # post-mortem cleanup of the "killed" instance
+
+
+# --------------------------------------------------------------------------
+# cluster failover
+# --------------------------------------------------------------------------
+
+
+class TestClusterStandingFailover:
+    def test_dead_shard_arc_rearcs_under_original_ids(self, tmp_path, world):
+        store, pairs, _ = world
+        shards, sqs = [], []
+        for i in range(2):
+            sq = StandingQueries(
+                str(tmp_path / f"subs{i}"), store=store, fsync=False,
+                opener=_RecordingOpener(), sleep=_NOSLEEP, rng=random.Random(i),
+            )
+            shard = LocalShard(
+                f"s{i}", store, pairs, EventProofSpec(SIG, SUBNET),
+                config=ServiceConfig(max_batch=4, max_wait_ms=5.0, workers=1),
+                subs=sq,
+            ).start()
+            shards.append(shard)
+            sqs.append(sq)
+        rm = Metrics()
+        router = ClusterRouter(
+            {s.name: s.url for s in shards}, pairs, metrics=rm
+        )
+        try:
+            sub_ids = [f"sub-{i}" for i in range(6)]
+            filters = {
+                sid: (FILTER_A if i % 2 == 0 else FILTER_B)
+                for i, sid in enumerate(sub_ids)
+            }
+            for sid in sub_ids:
+                status, obj = router.subscribe(
+                    {"filter": filters[sid], "sub_id": sid}
+                )
+                assert status == 200 and obj["sub_id"] == sid
+            status, obj = router.subscriptions()
+            assert status == 200 and obj["count"] == 6
+
+            # the router places by filter ring key — recompute the owners
+            ring = HashRing()
+            ring.add("s0")
+            ring.add("s1")
+            owner = {
+                sid: ring.node_for(
+                    subscription_ring_key(normalize_filter(filters[sid]))
+                )
+                for sid in sub_ids
+            }
+            dead_name = owner["sub-0"]  # the shard holding FILTER_A's arc
+            dead_idx = int(dead_name[1:])
+            surv_idx = 1 - dead_idx
+
+            # a matched pair on the owning shard streams through the router
+            sqs[dead_idx].matcher.match_pair(pairs[0])
+            status, obj = router.deliveries("sub-0", cursor=0)
+            assert status == 200
+            expect, digest = _expected(store, pairs[0], normalize_filter(FILTER_A))
+            assert [e["digest"] for e in obj["deliveries"]] == [digest]
+            assert obj["deliveries"][0]["payload"]["bundle"] == expect
+
+            shards[dead_idx].kill()  # crash: port refuses, nothing drained
+
+            # failover: aggregation marks the arc dead and re-registers its
+            # subscriptions on the survivor under the ORIGINAL ids
+            def _recovered():
+                status, obj = router.subscriptions()
+                return status == 200 and obj["count"] == 6
+
+            assert _wait_until(_recovered, timeout=30.0)
+            status, obj = router.subscriptions()
+            assert sorted(s["sub_id"] for s in obj["subscriptions"]) == sub_ids
+            assert obj["shards"] == {f"s{surv_idx}": 6}
+            n_moved = sum(1 for sid in sub_ids if owner[sid] == dead_name)
+            assert _counters(rm).get("cluster.subs_rearced", 0) == n_moved
+
+            # the survivor's matcher now serves the re-homed subscribers
+            sqs[surv_idx].matcher.match_pair(pairs[1])
+            status, obj = router.deliveries("sub-0", cursor=0)
+            assert status == 200
+            assert pairs[1].child.height in [
+                e["tipset"] for e in obj["deliveries"]
+            ]
+        finally:
+            router.close()
+            for s in shards:
+                try:
+                    s.stop(timeout=10)
+                except Exception:
+                    pass
+            for sq in sqs:
+                try:
+                    sq.drain()
+                except Exception:
+                    pass

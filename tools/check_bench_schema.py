@@ -16,7 +16,9 @@ flags. Two strictness levels:
   `speedup_gate_skip_reason` / `cluster_gate_skip_reason`), plus
   ``device_linearity_Nchip >= 0.8`` whenever ``onchip_devices > 1``
   (single-device hosts skip with a printed reason — see
-  `onchip_gate_skip_reason`).
+  `onchip_gate_skip_reason`), plus the host-shape-independent standing
+  amortization gate ``standing_generations_per_tipset <=
+  standing_distinct_filters`` (see `standing_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -132,6 +134,14 @@ _KNOWN_TYPES = {
     "onchip_match_events": int,
     "onchip_verify_blocks": int,
     "onchip_device_calls": int,
+    "standing_proofs_pushed_per_sec_1k": _NUM,
+    "standing_proofs_pushed_per_sec_10k": _NUM,
+    "standing_delivery_lag_p50_ms": _NUM,
+    "standing_delivery_lag_p99_ms": _NUM,
+    "standing_subscriptions": int,
+    "standing_tipsets": int,
+    "standing_distinct_filters": int,
+    "standing_generations_per_tipset": _NUM,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -162,6 +172,10 @@ _CURRENT_REQUIRED = (
     "cold_speedup_vs_sync_walker", "speculate_waste_pct",
     "cluster_linearity_4shard", "aggregate_proofs_per_sec", "steal_events",
     "device_linearity_Nchip", "batch_verify_speedup",
+    "standing_proofs_pushed_per_sec_1k", "standing_proofs_pushed_per_sec_10k",
+    "standing_delivery_lag_p50_ms", "standing_delivery_lag_p99_ms",
+    "standing_subscriptions", "standing_tipsets",
+    "standing_distinct_filters", "standing_generations_per_tipset",
     "legs", "watchdog_fallback",
 )
 
@@ -301,6 +315,34 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     "mesh-sharded matching must scale near-linearly across "
                     "local devices"
                 )
+        # the standing gate: fan-out amortization is an invariant, not a
+        # scheduling outcome — proofs generate once per distinct (pair,
+        # filter) and fan out to every subscriber, so generations per
+        # tipset can never exceed the distinct filter count, on any host
+        # shape. Only artifacts predating the leg skip.
+        if standing_gate_skip_reason(obj) is None:
+            gens = obj.get("standing_generations_per_tipset")
+            filts = obj.get("standing_distinct_filters")
+            for name, val in (
+                ("standing_generations_per_tipset", gens),
+                ("standing_distinct_filters", filts),
+            ):
+                if not isinstance(val, _NUM) or isinstance(val, bool):
+                    problems.append(
+                        f"standing gate: {name} is {val!r} "
+                        "(standing leg did not run?)"
+                    )
+            if (
+                isinstance(gens, _NUM) and not isinstance(gens, bool)
+                and isinstance(filts, _NUM) and not isinstance(filts, bool)
+                and gens > filts
+            ):
+                problems.append(
+                    f"standing gate: standing_generations_per_tipset={gens} "
+                    f"> standing_distinct_filters={filts} — fan-out must "
+                    "amortize: one generation per distinct filter shared by "
+                    "all its subscribers"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -379,6 +421,20 @@ def onchip_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def standing_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the generations ≤ distinct-filters amortization gate does NOT
+    apply (None when it does). Like the asyncfetch gate this is
+    host-shape independent — generation counts are deterministic
+    accounting — so the only skip is an artifact predating the standing
+    leg (old vintage validated without --require-current)."""
+    if (
+        "standing_generations_per_tipset" not in obj
+        and "standing_distinct_filters" not in obj
+    ):
+        return "artifact predates the standing leg"
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -411,6 +467,9 @@ def main(argv=None) -> int:
             reason = onchip_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: onchip gate SKIPPED ({reason})")
+            reason = standing_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: standing gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")
