@@ -646,6 +646,10 @@ def _cmd_serve(args) -> int:
             match_backend=(None if args.backend == "none" else args.backend),
             mesh_devices=args.mesh_devices,
             batch_verify=args.batch_verify,
+            witness_delta=(args.witness_delta == "on"),
+            witness_compress=(args.witness_compress == "on"),
+            witness_agg_max=args.witness_agg_max,
+            witness_base_cache=args.witness_base_cache,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -719,6 +723,7 @@ def _cmd_serve(args) -> int:
             retry_attempts=args.delivery_retry_attempts,
             retry_base_s=args.delivery_retry_base_s,
             retry_max_s=args.delivery_retry_max_s,
+            delta=(args.witness_delta == "on"),
         )
         if subs.registry.replayed:
             log.info(
@@ -814,6 +819,14 @@ def _cmd_cluster(args) -> int:
     ]
     if args.store_cap_bytes is not None:
         extra += ["--store-cap-bytes", str(args.store_cap_bytes)]
+    # witness diet knobs are cluster-wide: every shard must negotiate the
+    # same encodings or the router's scatter-gather sees mixed wire shapes
+    extra += [
+        "--witness-delta", args.witness_delta,
+        "--witness-compress", args.witness_compress,
+        "--witness-agg-max", str(args.witness_agg_max),
+        "--witness-base-cache", str(args.witness_base_cache),
+    ]
     if args.subs_dir:
         # push/retry knobs are cluster-wide; the registry itself shards
         # per process (DIR/s<k>) and the router places subscriptions on
@@ -1002,6 +1015,34 @@ def main(argv=None) -> int:
             help="compact the delivery journal above this size — only "
             "acked history is dropped, unacked deliveries always survive "
             "(default 64 MiB)",
+        )
+
+    def add_witness_flags(p):
+        p.add_argument(
+            "--witness-delta", choices=["on", "off"], default="on",
+            help="delta witnesses: honor If-Witness-Base / base_digest on "
+            "requests (ship only blocks the client's base bundle lacks) "
+            "and cut standing-query deliveries against each subscriber's "
+            "acked base. Base mismatches fall back to full bundles "
+            "(witness.delta_fallbacks) — never a wrong delta (default on)",
+        )
+        p.add_argument(
+            "--witness-compress", choices=["on", "off"], default="on",
+            help="compressed witness framing: honor witness_encoding / "
+            "Accept-Witness-Encoding zlib (and zstd when importable) — "
+            "canonical-order block frame + uncompressed digest; 'off' "
+            "rejects compressed encodings with a typed 400 (default on)",
+        )
+        p.add_argument(
+            "--witness-agg-max", type=int, default=1024, metavar="K",
+            help="cap on claims per aggregated generate_range request "
+            "(aggregate: true) — one merged witness + per-claim span "
+            "table; beyond K the request gets a typed 400 (default 1024)",
+        )
+        p.add_argument(
+            "--witness-base-cache", type=int, default=64, metavar="N",
+            help="server-side LRU of witness base digests → CID sets used "
+            "to answer delta requests (default 64 bases)",
         )
 
     def add_onchip_flags(p):
@@ -1286,6 +1327,7 @@ def main(argv=None) -> int:
     add_store_flags(srv)
     add_fetch_plane_flags(srv)
     add_subs_flags(srv)
+    add_witness_flags(srv)
     srv.add_argument(
         "--backend", default="none", choices=["cpu", "tpu", "none"],
         help="batch backend for generate-range event matching (default "
@@ -1379,6 +1421,7 @@ def main(argv=None) -> int:
     clu.add_argument("--topic1", default=None)
     add_store_flags(clu)
     add_subs_flags(clu)
+    add_witness_flags(clu)
     clu.add_argument(
         "--queue-dir", default=None, metavar="DIR",
         help="durable admission root: each shard journals under DIR/s<k> "

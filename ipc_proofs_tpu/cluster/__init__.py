@@ -22,6 +22,7 @@ the ``cluster`` CLI subcommand.
 """
 
 from ipc_proofs_tpu.cluster.gather import (
+    BundleFold,
     MergeConflictError,
     merge_range_bundles,
     partition_indexes,
@@ -41,6 +42,7 @@ from ipc_proofs_tpu.cluster.shard import (
 )
 
 __all__ = [
+    "BundleFold",
     "ClusterRouter",
     "HashRing",
     "LocalShard",

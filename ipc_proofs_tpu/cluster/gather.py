@@ -19,15 +19,31 @@ global pair order (a proof names its pair via ``child_block_cid``) and
 re-sort the CID-union of the witness blocks. That is the whole
 correctness story of the scatter-gather path — no shard coordination,
 no merge ambiguity, bit-identity by construction.
+
+`BundleFold` is the incremental form: the router folds each shard's
+sub-bundle into ONE CID-keyed map as its future completes and sorts the
+union exactly once at `seal()` (``witness.merge_sorts`` counts seals, so
+the bench can prove one sort per scatter rather than one per arrival).
+`merge_range_bundles` stays as the fold-everything-then-seal wrapper.
+The witness plane's cross-request aggregation
+(`ipc_proofs_tpu/witness/aggregate.py`) layers per-claim spans over the
+same canonical bundle — this module owns the merge law, that one the
+claim table.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
+from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
 
-__all__ = ["MergeConflictError", "merge_range_bundles", "partition_indexes"]
+__all__ = [
+    "BundleFold",
+    "MergeConflictError",
+    "merge_range_bundles",
+    "partition_indexes",
+]
 
 
 class MergeConflictError(ValueError):
@@ -48,58 +64,98 @@ def partition_indexes(
     return groups
 
 
-def merge_range_bundles(
-    bundles: Sequence[UnifiedProofBundle],
-    pairs: Sequence,
-    indexes: Sequence[int],
-) -> UnifiedProofBundle:
-    """Merge per-shard sub-bundles into the canonical single-daemon bundle.
+class BundleFold:
+    """Incremental canonical merge: fold sub-bundles as they arrive, sort
+    the witness-CID union ONCE at seal.
 
     ``pairs`` is the full pair table; ``indexes`` the requested global
     pair indexes in request order (the order the single-daemon comparator
-    would generate them in). Every proof in every sub-bundle must map to
-    one of ``indexes`` via its ``child_block_cid``.
+    would generate them in). Every proof in every folded bundle must map
+    to one of ``indexes`` via its ``child_block_cid``.
     """
-    # child block CID -> global pair index (a child block cid identifies
-    # its pair — the same mapping the micro-batcher splits batches with)
-    child_to_idx: "Dict[str, int]" = {}
-    for idx in indexes:
-        for c in pairs[idx].child.cids:
-            child_to_idx[str(c)] = idx
 
-    event_buckets: "Dict[int, list]" = {idx: [] for idx in indexes}
-    storage_buckets: "Dict[int, list]" = {idx: [] for idx in indexes}
-    by_cid: "Dict[bytes, ProofBlock]" = {}
-    for bundle in bundles:
+    def __init__(
+        self,
+        pairs: Sequence,
+        indexes: Sequence[int],
+        metrics: Optional[Metrics] = None,
+    ):
+        self._metrics = metrics if metrics is not None else get_metrics()
+        self.indexes = list(indexes)
+        # child block CID -> global pair index (a child block cid identifies
+        # its pair — the same mapping the micro-batcher splits batches with)
+        self._child_to_idx: "Dict[str, int]" = {}
+        for idx in self.indexes:
+            for c in pairs[idx].child.cids:
+                self._child_to_idx[str(c)] = idx
+        self._event_buckets: "Dict[int, list]" = {i: [] for i in self.indexes}
+        self._storage_buckets: "Dict[int, list]" = {i: [] for i in self.indexes}
+        self._by_cid: "Dict[bytes, ProofBlock]" = {}
+        self._sealed = False
+
+    def fold(self, bundle: UnifiedProofBundle) -> None:
+        """Fold one sub-bundle: bucket its proofs by pair, union its
+        witness blocks into the single CID map (conflict-checked, never
+        sorted here — sorting N times over an ever-growing map is the
+        quadratic arrival cost `seal()` exists to avoid)."""
+        if self._sealed:
+            raise RuntimeError("BundleFold already sealed")
         for proof in bundle.event_proofs:
-            idx = child_to_idx.get(proof.child_block_cid)
+            idx = self._child_to_idx.get(proof.child_block_cid)
             if idx is None:
                 raise MergeConflictError(
                     f"event proof for unknown child block "
                     f"{proof.child_block_cid} (not in this request)"
                 )
-            event_buckets[idx].append(proof)
+            self._event_buckets[idx].append(proof)
         for proof in bundle.storage_proofs:
-            idx = child_to_idx.get(proof.child_block_cid)
+            idx = self._child_to_idx.get(proof.child_block_cid)
             if idx is None:
                 raise MergeConflictError(
                     f"storage proof for unknown child block "
                     f"{proof.child_block_cid} (not in this request)"
                 )
-            storage_buckets[idx].append(proof)
+            self._storage_buckets[idx].append(proof)
         for block in bundle.blocks:
             raw = block.cid.to_bytes()
-            prior = by_cid.get(raw)
+            prior = self._by_cid.get(raw)
             if prior is None:
-                by_cid[raw] = block
+                self._by_cid[raw] = block
             elif prior.data != block.data:
                 raise MergeConflictError(
                     f"witness block {block.cid} has conflicting bytes "
                     "across shards"
                 )
 
-    return UnifiedProofBundle(
-        storage_proofs=[p for idx in indexes for p in storage_buckets[idx]],
-        event_proofs=[p for idx in indexes for p in event_buckets[idx]],
-        blocks=[by_cid[raw] for raw in sorted(by_cid)],
-    )
+    def seal(self) -> UnifiedProofBundle:
+        """One canonical sort over the folded CID union → the exact
+        single-daemon bytes. Counted (``witness.merge_sorts``) so tests
+        and the bench can assert one sort per scatter."""
+        if self._sealed:
+            raise RuntimeError("BundleFold already sealed")
+        self._sealed = True
+        self._metrics.count("witness.merge_sorts")
+        by_cid = self._by_cid
+        return UnifiedProofBundle(
+            storage_proofs=[
+                p for idx in self.indexes for p in self._storage_buckets[idx]
+            ],
+            event_proofs=[
+                p for idx in self.indexes for p in self._event_buckets[idx]
+            ],
+            blocks=[by_cid[raw] for raw in sorted(by_cid)],
+        )
+
+
+def merge_range_bundles(
+    bundles: Sequence[UnifiedProofBundle],
+    pairs: Sequence,
+    indexes: Sequence[int],
+    metrics: Optional[Metrics] = None,
+) -> UnifiedProofBundle:
+    """Merge per-shard sub-bundles into the canonical single-daemon bundle
+    (the all-at-once wrapper over `BundleFold`)."""
+    fold = BundleFold(pairs, indexes, metrics=metrics)
+    for bundle in bundles:
+        fold.fold(bundle)
+    return fold.seal()

@@ -25,9 +25,10 @@ regenerates a deterministic (identical) response.
 Range requests scatter-gather: pairs partition by per-pair affinity
 (steal-aware), each group dispatches concurrently as one
 ``/v1/generate_range`` sub-request carrying the router span's trace
-carrier (one trace covers the fan-out), and the sub-bundles merge through
-`cluster.gather.merge_range_bundles` into bytes identical to a
-single-daemon run. See README "Cluster serving".
+carrier (one trace covers the fan-out), and the sub-bundles fold
+incrementally through `cluster.gather.BundleFold` — one CID map, one
+seal-time sort — into bytes identical to a single-daemon run. See README
+"Cluster serving".
 
 Standing queries shard differently: a subscription is STATE, not a
 request, so it must live on exactly the shard that owns its filter's
@@ -50,12 +51,12 @@ import threading
 import urllib.error
 import urllib.request
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs, urlsplit
 
-from ipc_proofs_tpu.cluster.gather import merge_range_bundles, partition_indexes
+from ipc_proofs_tpu.cluster.gather import BundleFold, partition_indexes
 from ipc_proofs_tpu.cluster.hashring import HashRing, pair_ring_key
 from ipc_proofs_tpu.obs.trace import (
     carrier_from_context,
@@ -518,9 +519,17 @@ class ClusterRouter:
         pair_indexes: Sequence[int],
         chunk_size: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        aggregate: bool = False,
     ) -> "tuple[int, dict]":
         """Scatter a multi-pair range across shards, gather one canonical
         bundle (byte-identical to a single-daemon run over the same list).
+
+        Sub-bundles fold into a `cluster.gather.BundleFold` AS EACH SHARD
+        ANSWERS — one CID map, one sort at seal (``witness.merge_sorts``)
+        — instead of buffering every response and re-sorting per arrival.
+        With ``aggregate=True`` the index list may repeat (K co-tipset
+        claims); the scatter covers the distinct pairs once and the
+        response carries the witness-plane ``claims`` span table.
         """
         n = len(self.pairs)
         idxs = list(pair_indexes)
@@ -531,6 +540,9 @@ class ClusterRouter:
             return 400, {
                 "error": f"pair_indexes must be non-empty ints in [0, {n})"
             }
+        claim_idxs = idxs
+        if aggregate:
+            idxs = list(dict.fromkeys(idxs))
         self.metrics.count("cluster.requests")
         self.metrics.count("cluster.scatter_requests")
         with root_span(
@@ -560,11 +572,12 @@ class ClusterRouter:
                     )
 
             futures = {
-                name: self._executor.submit(one, group)
+                self._executor.submit(one, group): name
                 for name, group in groups.items()
             }
-            sub_bundles: "List[UnifiedProofBundle]" = []
-            for name, fut in futures.items():
+            fold = BundleFold(self.pairs, idxs, metrics=self.metrics)
+            for fut in as_completed(futures):
+                name = futures[fut]
                 status, obj = fut.result()  # NoShardsError propagates
                 if status != 200:
                     # a shard's error verdict is the scatter's verdict —
@@ -576,17 +589,26 @@ class ClusterRouter:
                         "error": f"shard group {name} returned no bundle",
                         "shard_response": obj,
                     }
-                sub_bundles.append(
-                    UnifiedProofBundle.from_json_obj(payload["bundle"])
-                )
-            merged = merge_range_bundles(sub_bundles, self.pairs, idxs)
-            return 200, {
+                fold.fold(UnifiedProofBundle.from_json_obj(payload["bundle"]))
+            merged = fold.seal()
+            out = {
                 "bundle": merged.to_json_obj(),
                 "n_event_proofs": len(merged.event_proofs),
                 "n_pairs": len(idxs),
                 "n_groups": len(groups),
                 "trace_id": sp.trace_id,
             }
+            if aggregate:
+                from ipc_proofs_tpu.witness import aggregate_range_bundle
+
+                out["claims"] = aggregate_range_bundle(
+                    merged,
+                    self.pairs,
+                    idxs,
+                    claim_indexes=claim_idxs,
+                    metrics=self.metrics,
+                ).claims_json()
+            return 200, out
 
     # --- cluster health / metrics -----------------------------------------
 
@@ -705,6 +727,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     body.get("pair_indexes") or [],
                     chunk_size=body.get("chunk_size"),
                     timeout_s=body.get("timeout_s"),
+                    aggregate=body.get("aggregate", False) is True,
                 )
             elif self.path == "/v1/subscribe":
                 status, obj = self.router.subscribe(body)

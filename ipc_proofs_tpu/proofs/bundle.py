@@ -12,6 +12,7 @@ artifact (the reference's only "checkpoint" format, SURVEY.md §5).
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
 from dataclasses import dataclass, field
 
@@ -26,7 +27,21 @@ __all__ = [
     "EventProofBundle",
     "UnifiedProofBundle",
     "UnifiedVerificationResult",
+    "bundle_obj_digest",
 ]
+
+
+def bundle_obj_digest(bundle_obj: dict) -> str:
+    """Canonical content digest of a bundle's JSON object.
+
+    sha256 over the sort-keys/compact-separators serialization — the ONE
+    identity every plane shares: the standing-query idempotency key, the
+    delta-witness base identity (`If-Witness-Base`), and the expansion
+    check that makes a delta apply fail typed instead of producing
+    silently different bytes.
+    """
+    canon = json.dumps(bundle_obj, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
 
 
 # strict JSON field accessors for this trust boundary — bundles are THE
@@ -223,6 +238,16 @@ class UnifiedProofBundle:
 
     def witness_bytes(self) -> int:
         return sum(len(b.data) for b in self.blocks)
+
+    def digest(self) -> str:
+        """Canonical content digest (see `bundle_obj_digest`)."""
+        return bundle_obj_digest(self.to_json_obj())
+
+    def cid_set(self) -> frozenset:
+        """The witness-block CID set as raw ``cid.to_bytes()`` keys — the
+        delta-witness base identity material (a delta against this bundle
+        ships only blocks whose raw CID is absent from this set)."""
+        return frozenset(b.cid.to_bytes() for b in self.blocks)
 
 
 @dataclass

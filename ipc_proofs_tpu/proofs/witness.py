@@ -13,7 +13,14 @@ from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.proofs.bundle import ProofBlock
 from ipc_proofs_tpu.store.blockstore import Blockstore, RecordingBlockstore
 
-__all__ = ["WitnessCollector", "load_witness_store"]
+__all__ = ["WitnessCollector", "block_cid_set", "load_witness_store"]
+
+
+def block_cid_set(blocks: Iterable[ProofBlock]) -> frozenset:
+    """Raw ``cid.to_bytes()`` keys for a block list — the canonical-set
+    identity the delta-witness plane diffs against (see
+    `ipc_proofs_tpu/witness/delta.py`)."""
+    return frozenset(b.cid.to_bytes() for b in blocks)
 
 
 class WitnessCollector:
@@ -51,13 +58,22 @@ class WitnessCollector:
         return blocks
 
 
-def load_witness_store(blocks: Iterable[ProofBlock], verify_cids: bool = False):
+def load_witness_store(
+    blocks: Iterable[ProofBlock],
+    verify_cids: bool = False,
+    base_blocks: "Iterable[ProofBlock] | None" = None,
+):
     """Load witness blocks into an isolated MemoryBlockstore
     (reference `storage/verifier.rs:68-78`, `events/verifier.rs:79-89`).
 
     ``verify_cids=True`` recomputes every CID on load — the explicit
     integrity check the reference skips (SURVEY.md §2b note on `put_keyed`);
     the TPU backend batches the same recomputation.
+
+    ``base_blocks`` is the delta-witness overlay seam: a verifier holding
+    a base epoch's blocks loads them UNDER the delta's blocks (same CID ⇒
+    same bytes by CID-addressing, so overlay order is cosmetic) and
+    verifies without ever materializing the merged block list.
     """
     from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
 
@@ -65,8 +81,13 @@ def load_witness_store(blocks: Iterable[ProofBlock], verify_cids: bool = False):
     if not verify_cids:
         # bulk path: one call, no per-block method dispatch (a range
         # witness is thousands of blocks)
+        if base_blocks is not None:
+            store.put_many_trusted(base_blocks)
         store.put_many_trusted(blocks)
         return store
+    if base_blocks is not None:
+        for block in base_blocks:
+            store.put_keyed(block.cid, block.data)
     for block in blocks:
         store.put_keyed(block.cid, block.data)
     return store

@@ -12,9 +12,22 @@ to the in-process API and serving errors to status codes:
   a production deployment would resolve pairs from its chain store).
 - ``POST /v1/generate_range`` → multi-pair canonical range bundle for an
   explicit ``pair_indexes`` list — the scatter-gather sub-request the
-  cluster router dispatches (see `cluster/router.py`). A ``trace``
+  cluster router dispatches (see `cluster/router.py`). With
+  ``"aggregate": true`` the index list may repeat (K co-tipset claims):
+  ONE canonical bundle over the distinct pairs comes back with a
+  ``claims`` span table (`ipc_proofs_tpu/witness/`). A ``trace``
   carrier in any POST body parents this request's spans under the remote
   caller's span (`obs.adopted_span`).
+
+Witness negotiation (README "Witness diet"): generate bodies may carry
+``witness_encoding`` (or an ``Accept-Witness-Encoding`` header) and
+``base_digest`` (or ``If-Witness-Base``); the chosen encoding is echoed
+in the ``witness_encoding`` field AND a ``Witness-Encoding`` header, an
+unknown encoding is a typed 400 (``error_type: witness_encoding``), and
+an unknown delta base falls back to a full bundle
+(``witness.delta_fallbacks``). ``POST /v1/verify`` accepts plain or
+``blocks_frame``-compressed bundles plus an optional ``claims`` table for
+per-claim verdicts out of one shared replay.
 - ``GET /metrics``  → `utils/metrics.py` snapshot (stage timers, queue
   depths, batch sizes, p50/p90/p99 latency, rejection counters) as JSON.
 - ``GET /metrics.prom`` → the same snapshot in Prometheus text exposition
@@ -71,6 +84,15 @@ from ipc_proofs_tpu.serve.batcher import (
     ServiceClosedError,
 )
 from ipc_proofs_tpu.serve.service import ProofService
+from ipc_proofs_tpu.witness import (
+    AggregatedBundle,
+    WitnessEncodingError,
+    WitnessError,
+    aggregate_range_bundle,
+    encode_bundle_fields,
+    negotiate_witness,
+    parse_bundle_obj,
+)
 
 __all__ = ["ProofHTTPServer"]
 
@@ -118,6 +140,34 @@ class _Handler(BaseHTTPRequestHandler):
             name = key[:-3] if key.endswith("_ms") else key
             parts.append(f"{name};dur={value}")
         return ", ".join(parts)
+
+    def _negotiate_witness(self, body: dict):
+        """Resolve the request's witness options (encoding, delta base).
+
+        Unknown/disabled encodings are a TYPED 400 (``error_type:
+        witness_encoding`` + ``witness.encoding_rejects``), never a silent
+        plain response; returns None after sending the error."""
+        cfg = self.service.config
+        try:
+            return negotiate_witness(
+                body,
+                headers=self.headers,
+                allow_compress=cfg.witness_compress,
+                allow_delta=cfg.witness_delta,
+            )
+        except WitnessEncodingError as exc:
+            self.service.metrics.count("witness.encoding_rejects")
+            self._send_json(400, {"error": str(exc), "error_type": exc.error_type})
+            return None
+
+    def _witness_fields(self, bundle, opts, claims=None) -> dict:
+        return encode_bundle_fields(
+            bundle,
+            opts,
+            bases=self.service.witness_bases,
+            metrics=self.service.metrics,
+            claims=claims,
+        )
 
     def _read_json_body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -238,27 +288,69 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as exc:
             self._send_json(400, {"error": str(exc)})
 
+    @staticmethod
+    def _claim_results(claims, storage_results, event_results) -> list:
+        """Per-claim verdicts: each claim's span slices of the flat
+        per-proof result vectors (one shared replay, K verdicts)."""
+        out = []
+        for c in claims:
+            s = storage_results[c.storage_lo : c.storage_hi]
+            e = event_results[c.event_lo : c.event_hi]
+            out.append(
+                {
+                    "storage_results": s,
+                    "event_results": e,
+                    "all_valid": all(s) and all(e),
+                }
+            )
+        return out
+
     def _handle_verify(self, body: dict):
+        obj = body.get("bundle", body)
         try:
-            bundle = UnifiedProofBundle.from_json_obj(body.get("bundle", body))
+            # plain or compressed (``blocks_frame``) wire form — the
+            # witness-plane parser handles both, digest-checked
+            bundle = parse_bundle_obj(obj)
+            claims = None
+            if body.get("claims") is not None:
+                claims = AggregatedBundle.claims_from_json(
+                    body["claims"], bundle
+                ).claims
+        except WitnessError as exc:
+            self._send_json(
+                400,
+                {"error": str(exc), "error_type": exc.error_type},
+            )
+            return
         except (ValueError, KeyError, TypeError) as exc:
             self._send_json(400, {"error": f"malformed bundle: {exc}"})
             return
         timeout_s = body.get("timeout_s")
         if self.durable is not None:
-            # bundle already validated above — journal the raw JSON obj
-            self._submit_durable("verify", body.get("bundle", body), body)
+            # journal the PLAIN bundle obj (compressed frames expand before
+            # admission, so journal replay never needs the codec)
+            plain = obj if "blocks_frame" not in obj else bundle.to_json_obj()
+            self._submit_durable("verify", plain, body, claims=claims)
             return
-        self._submit(
-            lambda: self.service.verify(bundle, timeout_s=timeout_s),
-            lambda resp: {
+
+        def render(resp):
+            out = {
                 "storage_results": resp.storage_results,
                 "event_results": resp.event_results,
                 "all_valid": resp.all_valid(),
                 "batch_size": resp.batch_size,
                 "trace_id": resp.trace_id,
                 "server_timing": resp.server_timing,
-            },
+            }
+            if claims is not None:
+                out["claim_results"] = self._claim_results(
+                    claims, resp.storage_results, resp.event_results
+                )
+            return out
+
+        self._submit(
+            lambda: self.service.verify(bundle, timeout_s=timeout_s),
+            render,
         )
 
     def _handle_generate(self, body: dict):
@@ -272,19 +364,22 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
             return
+        opts = self._negotiate_witness(body)
+        if opts is None:
+            return
         timeout_s = body.get("timeout_s")
         if self.durable is not None:
-            self._submit_durable("generate", idx, body)
+            self._submit_durable("generate", idx, body, witness=opts)
             return
         self._submit(
             lambda: self.service.generate(self.pairs[idx], timeout_s=timeout_s),
-            lambda resp: {
-                "bundle": resp.bundle.to_json_obj(),
-                "n_event_proofs": resp.n_event_proofs,
-                "batch_size": resp.batch_size,
-                "trace_id": resp.trace_id,
-                "server_timing": resp.server_timing,
-            },
+            lambda resp: dict(
+                self._witness_fields(resp.bundle, opts),
+                n_event_proofs=resp.n_event_proofs,
+                batch_size=resp.batch_size,
+                trace_id=resp.trace_id,
+                server_timing=resp.server_timing,
+            ),
         )
 
     def _handle_generate_range(self, body: dict):
@@ -319,22 +414,60 @@ class _Handler(BaseHTTPRequestHandler):
         ):
             self._send_json(400, {"error": "chunk_size must be a positive int"})
             return
+        aggregate = body.get("aggregate", False)
+        if not isinstance(aggregate, bool):
+            self._send_json(400, {"error": "aggregate must be a boolean"})
+            return
+        opts = self._negotiate_witness(body)
+        if opts is None:
+            return
+        if aggregate and len(idxs) > self.service.config.witness_agg_max:
+            self._send_json(
+                400,
+                {
+                    "error": f"aggregate request carries {len(idxs)} claims, "
+                    f"above --witness-agg-max "
+                    f"{self.service.config.witness_agg_max}",
+                    "error_type": "witness_agg_max",
+                },
+            )
+            return
+        # aggregated requests may repeat pair indexes (K co-tipset claims);
+        # the canonical bundle is generated once over the DISTINCT indexes
+        # and the claim table maps every claim onto its pair's spans
+        gen_idxs = list(dict.fromkeys(idxs)) if aggregate else list(idxs)
         if self.durable is not None:
             self._submit_durable(
                 "generate_range",
-                {"pair_indexes": list(idxs), "chunk_size": chunk},
+                {"pair_indexes": gen_idxs, "chunk_size": chunk},
                 body,
+                witness=opts,
+                claim_indexes=list(idxs) if aggregate else None,
+                gen_indexes=gen_idxs,
             )
             return
+
+        def render(bundle):
+            claims = None
+            if aggregate:
+                claims = aggregate_range_bundle(
+                    bundle,
+                    self.pairs,
+                    gen_idxs,
+                    claim_indexes=idxs,
+                    metrics=self.service.metrics,
+                ).claims_json()
+            return dict(
+                self._witness_fields(bundle, opts, claims=claims),
+                n_event_proofs=len(bundle.event_proofs),
+                n_pairs=len(gen_idxs),
+            )
+
         self._submit(
             lambda: self.service.generate_range(
-                [self.pairs[i] for i in idxs], chunk_size=chunk
+                [self.pairs[i] for i in gen_idxs], chunk_size=chunk
             ),
-            lambda bundle: {
-                "bundle": bundle.to_json_obj(),
-                "n_event_proofs": len(bundle.event_proofs),
-                "n_pairs": len(idxs),
-            },
+            render,
         )
 
     def _submit(self, call, render):
@@ -353,19 +486,67 @@ class _Handler(BaseHTTPRequestHandler):
         except RuntimeError as exc:
             self._send_json(400, {"error": str(exc)})
         else:
-            headers = None
+            obj = render(resp)
+            headers = {}
             timing = getattr(resp, "server_timing", None)
             if timing:
-                headers = {"Server-Timing": self._server_timing_header(timing)}
-            self._send_json(200, render(resp), headers=headers)
+                headers["Server-Timing"] = self._server_timing_header(timing)
+            # satellite contract: the chosen encoding is ALWAYS echoed —
+            # the JSON field plus a header the thinnest client can read
+            if "witness_encoding" in obj:
+                headers["Witness-Encoding"] = obj["witness_encoding"]
+            self._send_json(200, obj, headers=headers or None)
 
-    def _submit_durable(self, kind: str, payload, body: dict):
+    def _rewitness_result(
+        self, result: dict, witness, claims, claim_indexes, gen_indexes
+    ) -> dict:
+        """Re-encode a journaled done payload under this request's witness
+        options.
+
+        The durable journal always holds the PLAIN canonical result (so
+        replay/idempotency never depend on a codec or a base another client
+        declared); aggregation claims, delta encoding and compression are
+        per-response treatments applied on the way out."""
+        if "bundle" in result and witness is not None:
+            bundle = UnifiedProofBundle.from_json_obj(result["bundle"])
+            claims_json = None
+            if claim_indexes is not None:
+                claims_json = aggregate_range_bundle(
+                    bundle,
+                    self.pairs,
+                    gen_indexes,
+                    claim_indexes=claim_indexes,
+                    metrics=self.service.metrics,
+                ).claims_json()
+            result = {k: v for k, v in result.items() if k != "bundle"}
+            result.update(self._witness_fields(bundle, witness, claims=claims_json))
+        if claims is not None and "storage_results" in result:
+            result = dict(
+                result,
+                claim_results=self._claim_results(
+                    claims, result["storage_results"], result["event_results"]
+                ),
+            )
+        return result
+
+    def _submit_durable(
+        self,
+        kind: str,
+        payload,
+        body: dict,
+        witness=None,
+        claims=None,
+        claim_indexes=None,
+        gen_indexes=None,
+    ):
         """Route one request through the durable admission queue.
 
         Same error mapping as `_submit`, but the 200 body is the journaled
         done payload: ``{"ok": ..., "result"|"error": ...}`` plus the
         ``idempotency_key`` that names it and ``cached`` (True when served
-        from the idempotency cache instead of a fresh execution)."""
+        from the idempotency cache instead of a fresh execution). Witness
+        treatments (``witness``/``claims``/``claim_indexes``) re-encode the
+        plain journaled result per-response — see `_rewitness_result`."""
         key = body.get("idempotency_key")
         if key is not None and not isinstance(key, str):
             self._send_json(400, {"error": "idempotency_key must be a string"})
@@ -386,8 +567,17 @@ class _Handler(BaseHTTPRequestHandler):
         except DeadlineExceededError as exc:
             self._send_json(504, {"error": str(exc)})
         else:
+            headers = None
+            if done.get("ok") and isinstance(done.get("result"), dict):
+                result = self._rewitness_result(
+                    done["result"], witness, claims, claim_indexes, gen_indexes
+                )
+                done = dict(done, result=result)
+                if "witness_encoding" in result:
+                    headers = {"Witness-Encoding": result["witness_encoding"]}
             self._send_json(
-                200, dict(done, idempotency_key=key, cached=cached)
+                200, dict(done, idempotency_key=key, cached=cached),
+                headers=headers,
             )
 
 

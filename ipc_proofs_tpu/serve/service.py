@@ -65,6 +65,7 @@ from ipc_proofs_tpu.store.blockstore import BlockCache, CachedBlockstore
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.metrics import Metrics
 from ipc_proofs_tpu.utils.lockdep import named_lock
+from ipc_proofs_tpu.witness.bases import WitnessBaseCache
 
 log = get_logger(__name__)
 
@@ -133,6 +134,17 @@ class ServiceConfig:
     match_backend: Optional[str] = None
     mesh_devices: Optional[int] = None
     batch_verify: bool = False
+    # witness plane (ipc_proofs_tpu/witness/): delta witnesses against
+    # previously served bundles and compressed framing, negotiated
+    # per-request. Disabling compress makes non-identity encodings a
+    # typed 400 (encoding is a contract); disabling delta silently
+    # serves full bundles (delta is an optimization with a sound
+    # degradation). witness_agg_max caps claims per aggregated
+    # generate_range; witness_base_cache bounds the digest→CID-set LRU
+    witness_delta: bool = True
+    witness_compress: bool = True
+    witness_agg_max: int = 1024
+    witness_base_cache: int = 64
 
 
 @dataclass
@@ -266,6 +278,9 @@ class ProofService:
             self._match_backend = get_backend(
                 self.config.match_backend, mesh_devices=self.config.mesh_devices
             )
+        # witness plane: every served bundle registers here under its
+        # canonical digest so later requests can name it as a delta base
+        self.witness_bases = WitnessBaseCache(cap=self.config.witness_base_cache)
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )

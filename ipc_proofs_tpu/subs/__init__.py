@@ -79,6 +79,7 @@ class StandingQueries:
         retry_max_s: float = 4.0,
         push_timeout_s: float = 10.0,
         gen_workers: int = 2,
+        delta: bool = True,
         opener=None,
         sleep=time.sleep,
         rng: Optional[random.Random] = None,
@@ -109,6 +110,7 @@ class StandingQueries:
             chunk_size=chunk_size,
             match_backend=match_backend,
             gen_workers=gen_workers,
+            delta=delta,
         )
         # Restart convergence: deliveries that were unacked at the last
         # shutdown/crash re-push as soon as the daemon is back.

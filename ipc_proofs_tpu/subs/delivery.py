@@ -79,7 +79,14 @@ def delivery_idempotency_key(sub_id: str, tipset: int, digest: str) -> str:
 
 @dataclass(frozen=True)
 class Delivery:
-    """One appended (not-yet-acked) proof delivery."""
+    """One appended (not-yet-acked) proof delivery.
+
+    ``digest`` is always the FULL canonical bundle digest (the client's
+    post-expansion identity and the idempotency-key ingredient);
+    ``payload_digest`` names the bytes actually shipped — identical to
+    ``digest`` for full bundles, distinct for delta payloads, so the
+    content-addressed payload store never conflates a delta with the
+    full bundle it reconstructs."""
 
     sub_id: str
     cursor: int
@@ -87,6 +94,11 @@ class Delivery:
     tipset: int
     digest: str
     payload: dict
+    payload_digest: str = ""
+
+    def __post_init__(self):
+        if not self.payload_digest:
+            object.__setattr__(self, "payload_digest", self.digest)
 
     def to_json_obj(self) -> dict:
         return {
@@ -107,6 +119,13 @@ class _SubLog:
     acked_extra: Set[int] = field(default_factory=set)  # acks above the watermark
     entries: Dict[int, Delivery] = field(default_factory=dict)  # unacked, by cursor
     keys: Set[str] = field(default_factory=set)  # idempotency keys ever appended
+    # delta-witness cursor hygiene: the FULL-bundle digest of the highest
+    # acked delivery — the bundle this subscriber provably holds, i.e. the
+    # only sound delta base. Persisted in sstate frames so compaction
+    # dropping the acked entry (and its pay frame) never leaves a delta
+    # referencing a base the log no longer knows about.
+    base_digest: Optional[str] = None
+    base_cursor: int = 0  # cursor whose ack set base_digest
 
 
 class DeliveryLog:
@@ -174,12 +193,14 @@ class DeliveryLog:
                 sl = self._sub(str(rec["sub"]))
                 cursor = int(rec["cursor"])
                 digest = str(rec["digest"])
-                # dlv frames reference their payload by digest; an inline
-                # "payload" key is the pre-content-addressing format
+                # dlv frames reference their payload by payload digest
+                # (== digest for full bundles); an inline "payload" key is
+                # the pre-content-addressing format
+                pdigest = str(rec.get("pdigest") or digest)
                 payload = (
                     rec["payload"]
                     if "payload" in rec
-                    else self._payloads.get(digest, {})
+                    else self._payloads.get(pdigest, {})
                 )
                 d = Delivery(
                     sub_id=str(rec["sub"]),
@@ -188,14 +209,15 @@ class DeliveryLog:
                     tipset=int(rec["tipset"]),
                     digest=digest,
                     payload=payload or {},
+                    payload_digest=pdigest,
                 )
                 if cursor not in sl.entries:
                     self._pending += 1
                 sl.entries[cursor] = d
                 sl.keys.add(d.key)
                 sl.next_cursor = max(sl.next_cursor, cursor + 1)
-                self._payloads.setdefault(digest, d.payload)
-                self._payload_refs[digest] = self._payload_refs.get(digest, 0) + 1
+                self._payloads.setdefault(pdigest, d.payload)
+                self._payload_refs[pdigest] = self._payload_refs.get(pdigest, 0) + 1
             elif op == "ack":
                 sl = self._sub(str(rec["sub"]))
                 self._ack_entry(sl, int(rec["cursor"]))
@@ -205,6 +227,11 @@ class DeliveryLog:
                 sl.acked = max(sl.acked, int(rec["acked"]))
                 sl.acked_extra.update(int(c) for c in rec.get("acked_extra", []))
                 sl.keys.update(str(k) for k in rec.get("keys", []))
+                if int(rec.get("base_cursor", 0)) >= sl.base_cursor and rec.get(
+                    "base_digest"
+                ):
+                    sl.base_digest = str(rec["base_digest"])
+                    sl.base_cursor = int(rec.get("base_cursor", 0))
         except (KeyError, ValueError, TypeError):
             return  # fail-soft: one bad frame, not the whole replay
 
@@ -220,18 +247,23 @@ class DeliveryLog:
     @locked
     def _ack_entry(self, sl: _SubLog, cursor: int) -> None:
         """Ack + payload-refcount bookkeeping: the last unacked reference
-        to a digest releases its payload from the content store."""
+        to a payload digest releases it from the content store. An ack
+        also advances the sub's delta base: the acked delivery's FULL
+        digest is a bundle the subscriber now provably holds."""
         d = sl.entries.get(cursor)
         self._apply_ack(sl, cursor)
         if d is None:
             return
         self._pending -= 1
-        n = self._payload_refs.get(d.digest, 0) - 1
+        if cursor >= sl.base_cursor:
+            sl.base_digest = d.digest
+            sl.base_cursor = cursor
+        n = self._payload_refs.get(d.payload_digest, 0) - 1
         if n <= 0:
-            self._payload_refs.pop(d.digest, None)
-            self._payloads.pop(d.digest, None)
+            self._payload_refs.pop(d.payload_digest, None)
+            self._payloads.pop(d.payload_digest, None)
         else:
-            self._payload_refs[d.digest] = n
+            self._payload_refs[d.payload_digest] = n
 
     # ---------------------------------------------------------------- mutation
 
@@ -248,11 +280,22 @@ class DeliveryLog:
         self._metrics.set_gauge("subs.log_bytes", self._writer.journal_bytes)
 
     def append(
-        self, sub_id: str, tipset: int, digest: str, payload: dict
+        self,
+        sub_id: str,
+        tipset: int,
+        digest: str,
+        payload: dict,
+        payload_digest: Optional[str] = None,
     ) -> Optional[Delivery]:
         """Append one delivery; returns ``None`` if its idempotency key was
-        already seen (matcher replay absorbed, nothing to deliver twice)."""
+        already seen (matcher replay absorbed, nothing to deliver twice).
+
+        ``payload_digest`` names the shipped bytes when they differ from
+        the full bundle (a delta payload); idempotency stays keyed on the
+        FULL digest, so a delta re-delivery of an already-served proof
+        still dedups."""
         key = delivery_idempotency_key(sub_id, tipset, digest)
+        pdigest = payload_digest or digest
         with self._cond:
             sl = self._sub(sub_id)
             if key in sl.keys:
@@ -267,26 +310,28 @@ class DeliveryLog:
                 tipset=int(tipset),
                 digest=digest,
                 payload=payload,
+                payload_digest=pdigest,
             )
             sl.entries[cursor] = d
             sl.keys.add(key)
             self._pending += 1
-            if digest not in self._payloads:
-                # first subscriber of this proof journals the bundle; the
-                # other 9,999 journal a reference
-                self._payloads[digest] = payload
-                self._append_rec({"op": "pay", "digest": digest, "payload": payload})
-            self._payload_refs[digest] = self._payload_refs.get(digest, 0) + 1
-            self._append_rec(
-                {
-                    "op": "dlv",
-                    "sub": sub_id,
-                    "cursor": cursor,
-                    "key": key,
-                    "tipset": int(tipset),
-                    "digest": digest,
-                }
-            )
+            if pdigest not in self._payloads:
+                # first subscriber of this payload journals it; the other
+                # 9,999 journal a reference
+                self._payloads[pdigest] = payload
+                self._append_rec({"op": "pay", "digest": pdigest, "payload": payload})
+            self._payload_refs[pdigest] = self._payload_refs.get(pdigest, 0) + 1
+            rec = {
+                "op": "dlv",
+                "sub": sub_id,
+                "cursor": cursor,
+                "key": key,
+                "tipset": int(tipset),
+                "digest": digest,
+            }
+            if pdigest != digest:
+                rec["pdigest"] = pdigest
+            self._append_rec(rec)
             self._metrics.count("subs.deliveries")
             self._maybe_compact_locked()
             self._publish_gauges_locked()
@@ -366,6 +411,15 @@ class DeliveryLog:
             sl = self._subs.get(sub_id)
             return (sl.next_cursor - 1) if sl is not None else 0
 
+    def acked_base(self, sub_id: str) -> Optional[str]:
+        """FULL-bundle digest of this sub's highest acked delivery — the
+        only bundle a delta may be cut against (the subscriber provably
+        expanded it). None until the first ack (or for unknown subs);
+        survives compaction via the sstate cursor record."""
+        with self._cond:
+            sl = self._subs.get(sub_id)
+            return sl.base_digest if sl is not None else None
+
     @property
     def degraded(self) -> bool:
         return self._writer.degraded
@@ -401,7 +455,7 @@ class DeliveryLog:
                 live: Dict[str, dict] = {}
                 for sl in self._subs.values():
                     for d in sl.entries.values():
-                        live.setdefault(d.digest, d.payload)
+                        live.setdefault(d.payload_digest, d.payload)
                 for dg in sorted(live):
                     fh.write(
                         frame_record(
@@ -410,32 +464,38 @@ class DeliveryLog:
                     )
                 for sub_id in sorted(self._subs):
                     sl = self._subs[sub_id]
-                    fh.write(
-                        frame_record(
-                            {
-                                "op": "sstate",
-                                "sub": sub_id,
-                                "next": sl.next_cursor,
-                                "acked": sl.acked,
-                                "acked_extra": sorted(sl.acked_extra),
-                                "keys": sorted(sl.keys),
-                            }
-                        )
-                    )
+                    # the sstate frame is the cursor record: it carries the
+                    # sub's delta base digest precisely BECAUSE this rewrite
+                    # drops the acked delivery (and possibly its pay frame)
+                    # that established it — after replay the base identity
+                    # survives even though its bytes are gone, so the delta
+                    # path falls back to a full bundle instead of
+                    # referencing a vanished base
+                    srec = {
+                        "op": "sstate",
+                        "sub": sub_id,
+                        "next": sl.next_cursor,
+                        "acked": sl.acked,
+                        "acked_extra": sorted(sl.acked_extra),
+                        "keys": sorted(sl.keys),
+                    }
+                    if sl.base_digest is not None:
+                        srec["base_digest"] = sl.base_digest
+                        srec["base_cursor"] = sl.base_cursor
+                    fh.write(frame_record(srec))
                     for c in sorted(sl.entries):
                         d = sl.entries[c]
-                        fh.write(
-                            frame_record(
-                                {
-                                    "op": "dlv",
-                                    "sub": sub_id,
-                                    "cursor": d.cursor,
-                                    "key": d.key,
-                                    "tipset": d.tipset,
-                                    "digest": d.digest,
-                                }
-                            )
-                        )
+                        drec = {
+                            "op": "dlv",
+                            "sub": sub_id,
+                            "cursor": d.cursor,
+                            "key": d.key,
+                            "tipset": d.tipset,
+                            "digest": d.digest,
+                        }
+                        if d.payload_digest != d.digest:
+                            drec["pdigest"] = d.payload_digest
+                        fh.write(frame_record(drec))
                 if self._fsync:
                     fh.flush()
                     os.fsync(fh.fileno())  # ipclint: disable=lock-held-blocking (durability: compaction must not race concurrent appends)
@@ -508,10 +568,10 @@ class PushDelivery:
         self._closed = False  # guarded-by: _lock
         self._inflight = 0  # guarded-by: _lock
         self._active: Set[str] = set()  # guarded-by: _lock (in-flight delivery keys)
-        # digest → serialized bundle JSON: fanning one proof out to 10k
-        # subscribers serializes the bundle once, not 10k times. A tipset
-        # cycle touches at most distinct-filters digests, so a tiny bound
-        # suffices.
+        # payload digest → serialized payload JSON: fanning one proof out
+        # to 10k subscribers serializes the bundle (or delta) once, not
+        # 10k times. A tipset cycle touches at most a few digests per
+        # distinct filter, so a tiny bound suffices.
         self._bundle_json: Dict[str, str] = {}  # guarded-by: _lock
         self._bundle_json_cap = 32
 
@@ -541,17 +601,22 @@ class PushDelivery:
                     n += 1
         return n
 
-    def _serialized_bundle(self, delivery: Delivery) -> str:
+    def _serialized_payload(self, delivery: Delivery) -> "tuple[str, str]":
+        """(envelope key, serialized JSON) for this delivery's payload —
+        ``bundle`` for full bundles, ``bundle_delta`` for delta payloads.
+        Cached by PAYLOAD digest: a delta and the full bundle it expands
+        to share a full digest but never a cache slot."""
+        kind = "bundle_delta" if "bundle_delta" in delivery.payload else "bundle"
         with self._lock:
-            cached = self._bundle_json.get(delivery.digest)
+            cached = self._bundle_json.get(delivery.payload_digest)
         if cached is not None:
-            return cached
-        raw = json.dumps(delivery.payload.get("bundle"), sort_keys=True)
+            return kind, cached
+        raw = json.dumps(delivery.payload.get(kind), sort_keys=True)
         with self._lock:
             if len(self._bundle_json) >= self._bundle_json_cap:
                 self._bundle_json.clear()
-            self._bundle_json[delivery.digest] = raw
-        return raw
+            self._bundle_json[delivery.payload_digest] = raw
+        return kind, raw
 
     def _push_one(self, url: str, delivery: Delivery) -> bool:
         envelope = json.dumps(
@@ -564,9 +629,8 @@ class PushDelivery:
             },
             sort_keys=True,
         )
-        body = (
-            envelope[:-1] + ', "bundle": ' + self._serialized_bundle(delivery) + "}"
-        ).encode("utf-8")
+        kind, raw = self._serialized_payload(delivery)
+        body = (envelope[:-1] + f', "{kind}": ' + raw + "}").encode("utf-8")
         try:
             for attempt in range(self.max_attempts):
                 if attempt:

@@ -16,6 +16,15 @@ predicate calls route through ONE shared
 `parallel.pipeline.MatchCoalescer` — one batched device match dispatch
 serves every subscriber of the tipset.
 
+Delta delivery (the witness diet, ROADMAP item 1): the matcher keeps each
+filter's previous (digest, CID set) and, when a subscriber's acked base
+(`DeliveryLog.acked_base`) is exactly that digest, ships a
+``bundle_delta`` payload — only the blocks the base doesn't hold — via
+`ipc_proofs_tpu.witness.delta`. Consecutive epochs share HAMT/AMT
+interiors, so a subscriber who acked epoch N receives a fraction of epoch
+N+1's bytes. Any base mismatch (lagging sub, restart, compaction) falls
+back to the full bundle with ``witness.delta_fallbacks`` counted.
+
 Everything here is fail-soft: a filter whose generation raises counts
 ``subs.errors`` and the other filters still deliver; the follower's hook
 wrapper catches the rest (``follow.errors``) so the follow loop never
@@ -24,11 +33,10 @@ stalls on the streaming plane.
 
 from __future__ import annotations
 
-import hashlib
-import json
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from ipc_proofs_tpu.proofs.bundle import bundle_obj_digest
 from ipc_proofs_tpu.proofs.generator import EventProofSpec, StorageProofSpec
 from ipc_proofs_tpu.subs.registry import Subscription, filter_key
 from ipc_proofs_tpu.utils.lockdep import named_lock
@@ -62,11 +70,11 @@ class _CoalescingBackend:
         return getattr(self._backend, name)
 
 
-def _bundle_digest(bundle_obj: dict) -> str:
-    """Content digest of a bundle's canonical JSON — the idempotency-key
-    ingredient that makes matcher replays of a (pair, filter) dedup."""
-    canon = json.dumps(bundle_obj, sort_keys=True, separators=(",", ":"))
-    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+# Content digest of a bundle's canonical JSON — the idempotency-key
+# ingredient that makes matcher replays of a (pair, filter) dedup, and the
+# delta-witness base identity (kept under its historical name; the shared
+# definition lives beside the bundle type).
+_bundle_digest = bundle_obj_digest
 
 
 class StandingQueryMatcher:
@@ -82,6 +90,7 @@ class StandingQueryMatcher:
         chunk_size: int = 8,
         match_backend=None,
         gen_workers: int = 2,
+        delta: bool = True,
     ):
         self._registry = registry
         self._log = log
@@ -89,6 +98,7 @@ class StandingQueryMatcher:
         self._store = store
         self._metrics = metrics if metrics is not None else get_metrics()
         self.chunk_size = max(1, int(chunk_size))
+        self.delta = bool(delta)
         if match_backend is not None and hasattr(match_backend, "event_match_mask_fp"):
             match_backend = _CoalescingBackend(match_backend, metrics=self._metrics)
         self._backend = match_backend
@@ -98,6 +108,11 @@ class StandingQueryMatcher:
         self._lock = named_lock("StandingQueryMatcher._lock")
         self._prev = None  # guarded-by: _lock (previous finalized tipset)
         self._closed = False  # guarded-by: _lock
+        # delta-witness bases: filter key → (digest, frozenset of raw CIDs)
+        # of the PREVIOUS cycle's bundle. In-memory only — after a restart
+        # the first cycle ships full bundles (witness.delta_fallbacks), the
+        # documented sound degradation.
+        self._filter_bases: Dict[str, Tuple[str, frozenset]] = {}  # guarded-by: _lock
 
     def on_tipset(self, tipset) -> int:
         """The `ChainFollower` finalized hook: pair this tipset with the
@@ -135,22 +150,69 @@ class StandingQueryMatcher:
         appended = 0
         for fkey, fut in futures.items():
             try:
-                payload, digest = fut.result()
+                result = fut.result()
             except Exception as exc:  # fail-soft: one filter's generation failure must not starve the other filters' subscribers
                 self._metrics.count("subs.errors")
                 logger.warning("standing-query generation failed: %s", exc)
                 continue
-            if payload is None:
+            if result is None:
                 self._metrics.count("subs.empty_matches")
                 continue
+            bundle, payload, digest = result
+            with self._lock:
+                prev = self._filter_bases.get(fkey)
+            # one delta per (filter, base) serves every subscriber parked
+            # on that base — same amortization as the generate-once rule
+            deltas: Dict[str, Tuple[dict, str]] = {}
             for sub in groups[fkey][1]:
-                d = self._log.append(sub.sub_id, pair.child.height, digest, payload)
+                pay, pdigest = payload, digest
+                if self.delta:
+                    pay, pdigest = self._delta_payload(
+                        sub, bundle, payload, digest, prev, deltas
+                    )
+                d = self._log.append(
+                    sub.sub_id,
+                    pair.child.height,
+                    digest,
+                    pay,
+                    payload_digest=pdigest,
+                )
                 if d is None:
                     continue  # idempotent replay of a served (pair, filter)
                 self._metrics.count("subs.notifications")
                 appended += 1
                 self._push.push(sub, d)
+            with self._lock:
+                self._filter_bases[fkey] = (digest, bundle.cid_set())
         return appended
+
+    def _delta_payload(
+        self, sub, bundle, payload: dict, digest: str, prev, deltas: dict
+    ) -> "Tuple[dict, str]":
+        """Pick full vs delta for one subscriber.
+
+        A delta ships ONLY when the sub's acked base (the bundle it
+        provably expanded — `DeliveryLog.acked_base`) is exactly the
+        filter's previous digest, whose CID set we still hold. Any
+        mismatch — sub lagging, matcher restarted, base compacted away —
+        falls back to the full bundle and counts
+        ``witness.delta_fallbacks``: degradation, never a wrong delta.
+        """
+        base = self._log.acked_base(sub.sub_id)
+        if base is None or base == digest:
+            return payload, digest  # nothing held yet / replay of same bundle
+        if prev is None or base != prev[0]:
+            self._metrics.count("witness.delta_fallbacks")
+            return payload, digest
+        if base not in deltas:
+            from ipc_proofs_tpu.witness.delta import encode_delta
+
+            dobj = encode_delta(
+                bundle, prev[1], base, digest=digest, metrics=self._metrics
+            )
+            deltas[base] = ({"bundle_delta": dobj}, f"delta:{base}:{digest}")
+        self._metrics.count("witness.delta_hits")
+        return deltas[base]
 
     def _generate(self, filt: dict, pair):
         """One generation per distinct (pair, filter) — the amortized unit."""
@@ -181,9 +243,9 @@ class StandingQueryMatcher:
         )
         self._metrics.count("subs.generations")
         if not bundle.event_proofs and not bundle.storage_proofs:
-            return None, None
+            return None
         bundle_obj = bundle.to_json_obj()
-        return {"bundle": bundle_obj}, _bundle_digest(bundle_obj)
+        return bundle, {"bundle": bundle_obj}, _bundle_digest(bundle_obj)
 
     def drain(self) -> None:
         """Stop matching and wait for in-flight generations."""

@@ -33,6 +33,7 @@ __all__ = [
     "CLUSTER_COUNTERS",
     "SUBS_COUNTERS",
     "VERIFY_COUNTERS",
+    "WITNESS_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
     "DURABILITY_GAUGES",
@@ -336,6 +337,38 @@ SUBS_COUNTERS = (
     "subs.push_failures",
     "subs.log_failures",
     "subs.log_compactions",
+)
+
+# Counter vocabulary of the witness plane (ipc_proofs_tpu/witness/,
+# cluster/gather.py, subs delta delivery): cross-request aggregation,
+# delta witnesses, and compressed framing over the canonical bundle.
+#   witness.aggregated_requests — aggregated bundles emitted (one witness
+#                             shared by K claims)
+#   witness.aggregated_claims — claims folded into those aggregates (the
+#                             amortization numerator)
+#   witness.merge_sorts      — seal-time canonical CID sorts in the
+#                             incremental scatter fold (BundleFold.seal);
+#                             one per scatter, never one per arrival
+#   witness.delta_hits       — responses/deliveries shipped as deltas
+#                             against a known base
+#   witness.delta_fallbacks  — delta requested or eligible but the base
+#                             was unknown/stale/vanished → full bundle
+#                             (the sound degradation, never an error)
+#   witness.delta_blocks_dropped — witness blocks omitted from deltas
+#                             because the base already holds them (the
+#                             bytes-saved numerator)
+#   witness.compressed_frames — compressed witness frames emitted
+#   witness.encoding_rejects — requests naming an unknown/disabled
+#                             encoding, rejected with a typed 4xx
+WITNESS_COUNTERS = (
+    "witness.aggregated_requests",
+    "witness.aggregated_claims",
+    "witness.merge_sorts",
+    "witness.delta_hits",
+    "witness.delta_fallbacks",
+    "witness.delta_blocks_dropped",
+    "witness.compressed_frames",
+    "witness.encoding_rejects",
 )
 
 # Counter vocabulary of the cluster plane (cluster/router.py,

@@ -1,0 +1,81 @@
+"""The witness plane: cross-request aggregation, delta witnesses, and
+compressed framing over the canonical bundle format (ROADMAP item 1).
+
+Witness bytes are the product — the stateless-client literature treats
+witness size as THE scaling metric. In-bundle dedup already collapses a
+single request's repeats; this package removes the remaining cross-
+request waste with three composable layers over the SAME canonical
+bundle (pair-ordered proofs, CID-sorted deduplicated witness):
+
+- `aggregate` — one witness for K co-tipset claims, per-claim verdict
+  split on verify (`AggregatedBundle`, `verify_aggregated`);
+- `delta`     — ship only blocks absent from a client-declared base
+  epoch's canonical CID set; the verifier overlays base + delta
+  (`encode_delta`, `apply_delta`); standing-query subscribers get this
+  automatically (`subs/` delta delivery);
+- `framing`   — optional zlib/zstd frame over the canonical CID
+  ordering, always carrying the uncompressed digest
+  (`compress_blocks`, `decompress_blocks`).
+
+System invariant, pinned by the differential grid in
+``tests/test_witness_diet.py``: any aggregated/delta/compressed response,
+expanded client-side (`wire.expand_response_fields`), is byte-identical
+to the plain bundle — or fails with a typed error (`errors`), never a
+silently different bundle.
+"""
+
+from ipc_proofs_tpu.witness.aggregate import (
+    AggregatedBundle,
+    ClaimSpan,
+    aggregate_range_bundle,
+    verify_aggregated,
+)
+from ipc_proofs_tpu.witness.bases import WitnessBaseCache
+from ipc_proofs_tpu.witness.delta import apply_delta, apply_delta_obj, encode_delta
+from ipc_proofs_tpu.witness.errors import (
+    DeltaBaseMismatchError,
+    DeltaBaseMissingError,
+    WitnessEncodingError,
+    WitnessError,
+    WitnessIntegrityError,
+)
+from ipc_proofs_tpu.witness.framing import (
+    IDENTITY,
+    compress_blocks,
+    decompress_blocks,
+    pack_blocks,
+    supported_encodings,
+)
+from ipc_proofs_tpu.witness.wire import (
+    WitnessOptions,
+    encode_bundle_fields,
+    expand_response_fields,
+    negotiate_witness,
+    parse_bundle_obj,
+)
+
+__all__ = [
+    "AggregatedBundle",
+    "ClaimSpan",
+    "DeltaBaseMismatchError",
+    "DeltaBaseMissingError",
+    "IDENTITY",
+    "WitnessBaseCache",
+    "WitnessEncodingError",
+    "WitnessError",
+    "WitnessIntegrityError",
+    "WitnessOptions",
+    "aggregate_range_bundle",
+    "apply_delta",
+    "apply_delta_obj",
+    "compress_blocks",
+    "decompress_blocks",
+    "encode_bundle_fields",
+    "encode_delta",
+    "expand_response_fields",
+    "negotiate_witness",
+    "pack_blocks",
+    "parse_bundle_obj",
+    "supported_encodings",
+    "verify_aggregated",
+]

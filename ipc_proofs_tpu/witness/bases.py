@@ -1,0 +1,52 @@
+"""Served-bundle base registry: digest → canonical CID set.
+
+To cut a delta the server only needs to know WHICH CIDs the client's
+base holds — never the bytes (the client has those). Every bundle the
+serve plane ships registers here under its canonical digest; a later
+request carrying ``If-Witness-Base: <digest>`` (or ``base_digest`` in
+the body) resolves to that CID set, and a miss falls back to a full
+bundle with ``witness.delta_fallbacks`` counted — delta serving degrades,
+it never errors.
+
+Bounded LRU: a base is a frozenset of ~36-byte keys, so even thousands
+are cheap, but the registry is still capped (eviction = that base now
+falls back to full, which is always sound).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+from ipc_proofs_tpu.utils.lockdep import named_lock
+
+__all__ = ["WitnessBaseCache"]
+
+
+class WitnessBaseCache:
+    """Thread-safe bounded LRU of ``digest → frozenset(raw CID bytes)``."""
+
+    def __init__(self, cap: int = 64):
+        self.cap = max(1, int(cap))
+        self._lock = named_lock("WitnessBaseCache._lock")
+        self._bases: "OrderedDict[str, frozenset]" = OrderedDict()  # guarded-by: _lock
+
+    def register(self, digest: str, cid_set: frozenset) -> None:
+        with self._lock:
+            self._bases[digest] = cid_set
+            self._bases.move_to_end(digest)
+            while len(self._bases) > self.cap:
+                self._bases.popitem(last=False)
+
+    def lookup(self, digest: str) -> Optional[frozenset]:
+        """The base's CID set, refreshing its LRU position; None = unknown
+        (the delta fallback path)."""
+        with self._lock:
+            cids = self._bases.get(digest)
+            if cids is not None:
+                self._bases.move_to_end(digest)
+            return cids
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._bases)

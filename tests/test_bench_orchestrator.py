@@ -132,6 +132,10 @@ _SERVE_OK = {
 _WITNESS_OK = {
     "witness_reduction_pct": 96.0, "witness_two_pass_bytes": 25_000,
     "witness_single_pass_bytes": 650_000, "witness_sample_pairs": 64,
+    "witness_bytes_per_proof_k1": 14_800.0,
+    "witness_bytes_per_proof_k16": 3_700.0,
+    "witness_bytes_per_proof_k256": 290.0,
+    "witness_delta_ratio": 0.49, "witness_compressed_ratio": 0.26,
 }
 
 _RESILIENCE_OK = {

@@ -21,6 +21,7 @@ from check_bench_schema import (  # noqa: E402
     main,
     onchip_gate_skip_reason,
     speedup_gate_skip_reason,
+    witnessdiet_gate_skip_reason,
 )
 
 ARTIFACTS = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
@@ -308,3 +309,60 @@ class TestOnchipGate:
         main(["--require-current", str(path)])
         out = capsys.readouterr().out
         assert "onchip gate SKIPPED" in out and "onchip_devices=1" in out
+
+
+class TestWitnessDietGate:
+    """K=16 aggregated bytes/proof strictly below K=1 AND consecutive-epoch
+    delta ratio < 1.0 are enforced (require_current) on every artifact that
+    carries the witness-diet keys — wire accounting is host-shape
+    independent, so only artifacts predating the leg skip."""
+
+    def _current(self):
+        with open(NEWEST) as fh:
+            return json.load(fh)
+
+    def test_aggregation_must_beat_k1(self):
+        obj = self._current()
+        obj["witness_bytes_per_proof_k16"] = obj["witness_bytes_per_proof_k1"]
+        assert check_artifact(obj) == []  # non-current vintages unaffected
+        problems = check_artifact(obj, require_current=True)
+        assert any("witness-diet gate" in p for p in problems), problems
+
+    def test_delta_must_beat_full_reship(self):
+        obj = self._current()
+        obj["witness_delta_ratio"] = 1.0
+        problems = check_artifact(obj, require_current=True)
+        assert any("witness_delta_ratio=1.0" in p for p in problems), problems
+
+    def test_missing_diet_key_fails_current(self):
+        obj = self._current()
+        obj["witness_delta_ratio"] = None
+        problems = check_artifact(obj, require_current=True)
+        assert any("witness-diet gate" in p for p in problems), problems
+
+    def test_current_artifact_passes(self):
+        obj = self._current()
+        assert witnessdiet_gate_skip_reason(obj) is None
+        assert not any(
+            "witness-diet gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_gate_skipped_only_for_prediet_vintages(self, tmp_path, capsys):
+        obj = self._current()
+        for key in (
+            "witness_bytes_per_proof_k1", "witness_bytes_per_proof_k16",
+            "witness_bytes_per_proof_k256", "witness_delta_ratio",
+            "witness_compressed_ratio",
+        ):
+            obj.pop(key, None)
+        reason = witnessdiet_gate_skip_reason(obj)
+        assert reason is not None and "predates" in reason
+        assert not any(
+            "witness-diet gate" in p for p in check_artifact(obj)
+        )
+        path = tmp_path / "BENCH_prediet_vintage.json"
+        path.write_text(json.dumps(obj))
+        main([str(path)])  # old vintages validate without --require-current
+        out = capsys.readouterr().out
+        assert "FAIL" not in out

@@ -38,8 +38,10 @@ from ipc_proofs_tpu.cluster import ClusterRouter, LocalShard
 from ipc_proofs_tpu.cluster.hashring import HashRing
 from ipc_proofs_tpu.fixtures import build_range_world
 from ipc_proofs_tpu.jobs.journal import read_journal_entries
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.proofs.generator import EventProofSpec
 from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+from ipc_proofs_tpu.witness import apply_delta
 from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
 from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
 from ipc_proofs_tpu.store.faults import LocalLotusSession
@@ -855,8 +857,16 @@ class TestEndToEndStanding:
         assert "subs.duplicate_acks" not in c
         assert c["subs.push_failures"] >= 3  # wh-flaky exhausted each pair
 
-        # (1) every delivery is byte-identical to the request/response
-        # path's bundle for the same (pair, filter) — pushed and polled
+        # (1) every delivery expands byte-identical to the request/response
+        # path's bundle for the same (pair, filter) — full pushes carry
+        # the verbatim bundle; delta pushes (the subscriber acked an
+        # earlier epoch's bundle) expand through the witness plane against
+        # the base they name, digest-checked
+        expected_by_digest = {}
+        for filt in (FILTER_A, FILTER_B):
+            for pair in pairs[:3]:
+                obj, digest = _expected(store, pair, normalize_filter(filt))
+                expected_by_digest[digest] = obj
         for sub_id, filt in (("wh-a1", FILTER_A), ("wh-b1", FILTER_B)):
             for pair in pairs[:3]:
                 obj, digest = _expected(store, pair, normalize_filter(filt))
@@ -869,9 +879,18 @@ class TestEndToEndStanding:
                 assert acked, (sub_id, pair.child.height)
                 for _u, body, env in acked:
                     assert env["digest"] == digest
-                    assert body.decode("utf-8").endswith(
-                        ', "bundle": ' + raw + "}"
-                    )
+                    if "bundle" in env:
+                        assert body.decode("utf-8").endswith(
+                            ', "bundle": ' + raw + "}"
+                        )
+                    else:
+                        base = UnifiedProofBundle.from_json_obj(
+                            expected_by_digest[env["bundle_delta"]["base_digest"]]
+                        )
+                        assert (
+                            apply_delta(env["bundle_delta"], base).to_json_obj()
+                            == obj
+                        )
         polled = sq.deliveries("poll-a", cursor=0)
         assert [e["tipset"] for e in polled["deliveries"]] == [
             p.child.height for p in pairs[:3]

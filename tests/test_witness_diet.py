@@ -1,0 +1,693 @@
+"""Witness-diet tests: the differential grid, serve negotiation, and the
+subs delta plane (ROADMAP item 1).
+
+The system invariant under test: any aggregated / delta / compressed
+response, expanded client-side, is byte-identical to the plain canonical
+bundle — or fails with a typed error, never a silently different bundle.
+The grid pins every combination of aggregation K ∈ {1, 16, 256}, delta
+base ∈ {match, stale, missing}, and compression ∈ {off, on}.
+
+Everything is hermetic (build_range_world stores, ephemeral localhost
+ports, no egress) and tier-1.
+"""
+
+import json
+import random
+import threading
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from ipc_proofs_tpu.cluster.gather import BundleFold, merge_range_bundles
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import (
+    generate_event_proofs_for_range_chunked,
+)
+from ipc_proofs_tpu.proofs.trust import TrustPolicy
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
+from ipc_proofs_tpu.subs import (
+    DeliveryLog,
+    PushDelivery,
+    StandingQueryMatcher,
+    SubscriptionRegistry,
+)
+from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.witness import (
+    AggregatedBundle,
+    DeltaBaseMismatchError,
+    DeltaBaseMissingError,
+    WitnessBaseCache,
+    WitnessEncodingError,
+    WitnessError,
+    WitnessIntegrityError,
+    WitnessOptions,
+    aggregate_range_bundle,
+    apply_delta,
+    compress_blocks,
+    decompress_blocks,
+    encode_bundle_fields,
+    expand_response_fields,
+    negotiate_witness,
+    supported_encodings,
+    verify_aggregated,
+)
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+
+FILTER_A = {"signature": SIG, "topic1": SUBNET}
+
+_NOSLEEP = lambda s: None  # noqa: E731 — push retry seam: no real sleeps
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        4,
+        receipts_per_pair=6,
+        events_per_receipt=3,
+        match_rate=0.5,
+        signature=SIG,
+        topic1=SUBNET,
+        actor_id=ACTOR,
+        base_height=51_000,
+    )
+
+
+def _range_bundle(store, pairs, idxs):
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET)
+    return generate_event_proofs_for_range_chunked(
+        store, [pairs[i] for i in idxs], spec, chunk_size=8
+    )
+
+
+def _canon(bundle) -> str:
+    """Canonical JSON text — THE byte-identity oracle."""
+    return json.dumps(bundle.to_json_obj(), sort_keys=True, separators=(",", ":"))
+
+
+def _counters(m):
+    return m.snapshot()["counters"]
+
+
+def _wait_until(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# --------------------------------------------------------------------------
+# the differential grid: aggregate × delta × compression
+# --------------------------------------------------------------------------
+
+
+class TestDifferentialGrid:
+    """Every cell expands byte-identical or fails typed — never silently
+    different. The server half is `encode_bundle_fields` (exactly what the
+    HTTP layer calls), the client half `expand_response_fields`."""
+
+    DISTINCT = [0, 1, 2, 3]
+
+    @pytest.fixture(scope="class")
+    def bundles(self, world):
+        store, pairs, _ = world
+        cur = _range_bundle(store, pairs, self.DISTINCT)
+        base = _range_bundle(store, pairs, [0, 1])  # the client's last epoch
+        stale = _range_bundle(store, pairs, [2, 3])  # the WRONG held base
+        assert len({cur.digest(), base.digest(), stale.digest()}) == 3
+        return store, pairs, cur, base, stale
+
+    @pytest.mark.parametrize("k", [1, 16, 256])
+    @pytest.mark.parametrize("base_kind", ["match", "stale", "missing"])
+    @pytest.mark.parametrize("encoding", ["identity", "zlib"])
+    def test_cell(self, bundles, k, base_kind, encoding):
+        _store, pairs, cur, base, stale = bundles
+        m = Metrics()
+        claim_idxs = [self.DISTINCT[i % len(self.DISTINCT)] for i in range(k)]
+        agg = aggregate_range_bundle(
+            cur, pairs, self.DISTINCT, claim_indexes=claim_idxs, metrics=m
+        )
+        assert len(agg.claims) == k
+        assert _counters(m)["witness.aggregated_claims"] == k
+
+        bases = WitnessBaseCache(cap=8)
+        if base_kind != "missing":
+            # the server served (and remembers) the client's base epoch
+            bases.register(base.digest(), base.cid_set())
+        opts = WitnessOptions(encoding=encoding, base_digest=base.digest())
+        fields = encode_bundle_fields(
+            cur, opts, bases=bases, metrics=m, claims=agg.claims_json()
+        )
+
+        # the chosen encoding is always echoed; the digest always rides
+        assert fields["witness_encoding"] == encoding
+        assert fields["digest"] == cur.digest()
+        assert len(fields["claims"]) == k
+
+        if base_kind == "missing":
+            # unknown base ⇒ FULL bundle, counted — the sound degradation
+            assert "bundle" in fields and "bundle_delta" not in fields
+            assert _counters(m)["witness.delta_fallbacks"] == 1
+            if encoding == "zlib":
+                assert "blocks_frame" in fields["bundle"]
+                assert "blocks" not in fields["bundle"]
+            expanded = expand_response_fields(fields)
+            assert _canon(expanded) == _canon(cur)
+        elif base_kind == "match":
+            assert "bundle_delta" in fields
+            assert fields["witness_base"] == base.digest()
+            dobj = fields["bundle_delta"]
+            if encoding == "zlib":
+                assert "delta_blocks_frame" in dobj and "delta_blocks" not in dobj
+            else:
+                # the delta genuinely ships fewer blocks than the full form
+                assert len(dobj["delta_blocks"]) < len(cur.blocks)
+            assert _counters(m)["witness.delta_hits"] == 1
+            assert _counters(m)["witness.delta_blocks_dropped"] > 0
+            expanded = expand_response_fields(fields, base=base)
+            assert _canon(expanded) == _canon(cur)
+        else:  # stale: the client holds a different bundle than declared
+            if "bundle_delta" in fields:
+                with pytest.raises(DeltaBaseMismatchError):
+                    expand_response_fields(fields, base=stale)
+                return  # typed failure IS the cell's correct outcome
+            expanded = expand_response_fields(fields)
+            assert _canon(expanded) == _canon(cur)
+
+        # the claim table survives the wire and re-anchors on the expansion
+        back = AggregatedBundle.claims_from_json(fields["claims"], expanded)
+        assert [c.to_json_obj() for c in back.claims] == fields["claims"]
+
+    def test_delta_without_base_is_typed(self, bundles):
+        _store, _pairs, cur, base, _stale = bundles
+        bases = WitnessBaseCache(cap=8)
+        bases.register(base.digest(), base.cid_set())
+        fields = encode_bundle_fields(
+            cur, WitnessOptions(base_digest=base.digest()), bases=bases,
+            metrics=Metrics(),
+        )
+        assert "bundle_delta" in fields
+        with pytest.raises(DeltaBaseMissingError):
+            expand_response_fields(fields, base=None)
+
+    def test_tampered_delta_blocks_fail_closed(self, bundles):
+        """A delta whose blocks were corrupted in flight re-digests wrong
+        on expansion — typed error, never different bytes."""
+        _store, _pairs, cur, base, _stale = bundles
+        from ipc_proofs_tpu.witness.delta import encode_delta
+
+        dobj = encode_delta(cur, base.cid_set(), base.digest())
+        assert dobj["delta_blocks"], "grid world must produce a nonempty delta"
+        dobj = json.loads(json.dumps(dobj))
+        blk = dobj["delta_blocks"][0]
+        blk["data"] = "00" + blk["data"][2:] if blk["data"][:2] != "00" else (
+            "ff" + blk["data"][2:]
+        )
+        with pytest.raises(DeltaBaseMismatchError):
+            apply_delta(dobj, base)
+
+
+class TestAggregatedVerify:
+    def test_per_claim_verdicts_from_one_replay(self, world):
+        store, pairs, _ = world
+        idxs = [0, 1, 2, 3]
+        cur = _range_bundle(store, pairs, idxs)
+        claim_idxs = [idxs[i % 4] for i in range(16)]
+        agg = aggregate_range_bundle(
+            cur, pairs, idxs, claim_indexes=claim_idxs, metrics=Metrics()
+        )
+        results = verify_aggregated(agg, TrustPolicy.accept_all())
+        assert len(results) == 16
+        for c, r in zip(agg.claims, results):
+            assert r.all_valid()
+            assert len(r.event_results) == c.event_hi - c.event_lo
+        # repeated claims for one pair share that pair's span (the whole
+        # amortization: proofs and witness serialize once for all K)
+        assert agg.claims[0].to_json_obj() == agg.claims[4].to_json_obj()
+
+    def test_aggregate_beats_k_separate_responses(self, world):
+        store, pairs, _ = world
+        idxs = [0, 1, 2, 3]
+        cur = _range_bundle(store, pairs, idxs)
+        agg = aggregate_range_bundle(
+            cur, pairs, idxs, claim_indexes=[idxs[i % 4] for i in range(16)],
+            metrics=Metrics(),
+        )
+        agg_bytes = len(_canon(cur)) + len(json.dumps(agg.claims_json()))
+        solo = {i: len(_canon(_range_bundle(store, pairs, [i]))) for i in idxs}
+        separate_bytes = sum(solo[idxs[i % 4]] for i in range(16))
+        assert agg_bytes < separate_bytes
+
+    def test_claim_span_validation_is_typed(self, world):
+        store, pairs, _ = world
+        cur = _range_bundle(store, pairs, [0])
+        bad = [{"pair_index": 0, "storage_proofs": [0, 0],
+                "event_proofs": [0, len(cur.event_proofs) + 5]}]
+        with pytest.raises(WitnessError):
+            AggregatedBundle.claims_from_json(bad, cur)
+        with pytest.raises(WitnessError):
+            aggregate_range_bundle(cur, pairs, [0], claim_indexes=[3],
+                                   metrics=Metrics())
+
+
+class TestFraming:
+    def test_zlib_roundtrip_preserves_blocks(self, world):
+        store, pairs, _ = world
+        cur = _range_bundle(store, pairs, [0, 1])
+        m = Metrics()
+        frame = compress_blocks(cur.blocks, "zlib", metrics=m)
+        assert _counters(m)["witness.compressed_frames"] == 1
+        back = decompress_blocks(frame)
+        assert [b.to_json_obj() for b in back] == [
+            b.to_json_obj() for b in cur.blocks
+        ]
+        # the frame is an actual diet: canonical ordering lays same-tree
+        # interiors adjacent, so zlib compresses below the JSON hex form
+        json_bytes = len(json.dumps([b.to_json_obj() for b in cur.blocks]))
+        assert len(frame["frame"]) < json_bytes
+
+    def test_corrupt_frame_fails_typed(self, world):
+        import base64
+
+        store, pairs, _ = world
+        cur = _range_bundle(store, pairs, [0])
+        frame = compress_blocks(cur.blocks, "zlib", metrics=Metrics())
+        raw = bytearray(base64.b64decode(frame["frame"]))
+        raw[len(raw) // 2] ^= 0xFF
+        bad = dict(frame, frame=base64.b64encode(bytes(raw)).decode("ascii"))
+        with pytest.raises((WitnessIntegrityError, WitnessEncodingError)):
+            decompress_blocks(bad)
+        # a frame that decompresses but hashes wrong is equally typed
+        other = compress_blocks(cur.blocks[:1], "zlib", metrics=Metrics())
+        mixed = dict(frame, frame=other["frame"])
+        with pytest.raises(WitnessIntegrityError):
+            decompress_blocks(mixed)
+
+    def test_unknown_encoding_is_typed_everywhere(self, world):
+        store, pairs, _ = world
+        cur = _range_bundle(store, pairs, [0])
+        with pytest.raises(WitnessEncodingError):
+            compress_blocks(cur.blocks, "lz4", metrics=Metrics())
+        with pytest.raises(WitnessEncodingError):
+            negotiate_witness({"witness_encoding": "lz4"})
+        assert supported_encodings()[0] == "identity"
+        assert "zlib" in supported_encodings()
+
+
+class TestBundleFold:
+    def test_fold_matches_merge_and_sorts_once(self, world):
+        """Satellite: the scatter-gather fold sorts the witness union ONCE
+        at seal (witness.merge_sorts == 1), byte-identical to the
+        re-sort-per-arrival merge it replaces."""
+        store, pairs, _ = world
+        idxs = [0, 1, 2, 3]
+        subs = [_range_bundle(store, pairs, [i]) for i in idxs]
+        reference = merge_range_bundles(subs, pairs, idxs)
+        m = Metrics()
+        fold = BundleFold(pairs, idxs, metrics=m)
+        for b in random.Random(7).sample(subs, len(subs)):  # arrival order ≠ request order
+            fold.fold(b)
+        merged = fold.seal()
+        assert _canon(merged) == _canon(reference)
+        assert _counters(m)["witness.merge_sorts"] == 1
+
+
+# --------------------------------------------------------------------------
+# serve plane: negotiation, echo, typed rejects, delta + aggregate over HTTP
+# --------------------------------------------------------------------------
+
+
+class TestServeNegotiation:
+    @pytest.fixture()
+    def server(self, world):
+        store, pairs, _ = world
+        svc = ProofService(
+            store=store,
+            spec=EventProofSpec(event_signature=SIG, topic_1=SUBNET),
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=2),
+        )
+        httpd = ProofHTTPServer(svc, pairs=pairs).start()
+        yield httpd, store, pairs
+        httpd.shutdown(timeout=30)
+
+    def _post(self, server, path, obj, headers=None):
+        conn = HTTPConnection("127.0.0.1", server.port, timeout=30)
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", path, json.dumps(obj), hdrs)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), json.loads(resp.read())
+
+    def _get(self, server, path):
+        conn = HTTPConnection("127.0.0.1", server.port, timeout=30)
+        conn.request("GET", path, None, {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    def test_unknown_encoding_typed_400_never_silent_plain(self, server):
+        httpd, _store, _pairs = server
+        for body, hdrs in (
+            ({"pair_index": 0, "witness_encoding": "lz4"}, None),
+            ({"pair_index": 0}, {"Accept-Witness-Encoding": "snappy"}),
+            ({"pair_indexes": [0], "witness_encoding": "lz4"}, None),
+        ):
+            path = "/v1/generate" if "pair_index" in body else "/v1/generate_range"
+            status, _, out = self._post(httpd, path, body, headers=hdrs)
+            assert status == 400
+            assert out["error_type"] == "witness_encoding"
+            assert "bundle" not in out
+        _, snap = self._get(httpd, "/metrics")
+        assert snap["counters"]["witness.encoding_rejects"] == 3
+
+    def test_zlib_echoes_and_expands_byte_identical(self, server):
+        httpd, _store, _pairs = server
+        status, _, plain = self._post(httpd, "/v1/generate", {"pair_index": 0})
+        assert status == 200
+        status, headers, out = self._post(
+            httpd, "/v1/generate", {"pair_index": 0},
+            headers={"Accept-Witness-Encoding": "zlib"},
+        )
+        assert status == 200
+        assert headers["Witness-Encoding"] == "zlib"
+        assert out["witness_encoding"] == "zlib"
+        assert "blocks_frame" in out["bundle"]
+        expanded = expand_response_fields(out)
+        assert json.dumps(expanded.to_json_obj(), sort_keys=True) == json.dumps(
+            plain["bundle"], sort_keys=True
+        )
+
+    def test_delta_roundtrip_and_missing_base_fallback(self, server):
+        httpd, _store, _pairs = server
+        # epoch N: plain full response — the server registers it as a base
+        status, _, first = self._post(
+            httpd, "/v1/generate_range", {"pair_indexes": [0, 1]}
+        )
+        assert status == 200
+        base_digest = first["digest"]
+        base = expand_response_fields(first)
+        # epoch N+1 via the If-Witness-Base header → a delta against N
+        status, headers, out = self._post(
+            httpd, "/v1/generate_range", {"pair_indexes": [0, 1, 2]},
+            headers={"If-Witness-Base": base_digest},
+        )
+        assert status == 200
+        assert headers["Witness-Encoding"] == "identity"
+        assert out["witness_base"] == base_digest
+        assert "bundle_delta" in out and "bundle" not in out
+        status2, _, plain = self._post(
+            httpd, "/v1/generate_range", {"pair_indexes": [0, 1, 2]}
+        )
+        assert status2 == 200
+        expanded = expand_response_fields(out, base=base)
+        assert json.dumps(expanded.to_json_obj(), sort_keys=True) == json.dumps(
+            plain["bundle"], sort_keys=True
+        )
+        # a base this server never saw degrades to FULL, counted
+        status, _, fb = self._post(
+            httpd, "/v1/generate_range",
+            {"pair_indexes": [0, 1], "base_digest": "0" * 64},
+        )
+        assert status == 200
+        assert "bundle" in fb and "bundle_delta" not in fb
+        _, snap = self._get(httpd, "/metrics")
+        assert snap["counters"]["witness.delta_fallbacks"] >= 1
+
+    def test_aggregate_roundtrip_with_claim_verdicts(self, server):
+        httpd, _store, _pairs = server
+        idxs = [0, 1, 0, 1, 2, 0]
+        status, _, out = self._post(
+            httpd, "/v1/generate_range",
+            {"pair_indexes": idxs, "aggregate": True},
+        )
+        assert status == 200
+        assert len(out["claims"]) == len(idxs)
+        assert out["n_pairs"] == 3  # distinct pairs generated once
+        # the aggregated bundle IS the canonical distinct-range bundle
+        status2, _, plain = self._post(
+            httpd, "/v1/generate_range", {"pair_indexes": [0, 1, 2]}
+        )
+        assert json.dumps(out["bundle"], sort_keys=True) == json.dumps(
+            plain["bundle"], sort_keys=True
+        )
+        # one shared verify replay → per-claim verdicts
+        status, _, ver = self._post(
+            httpd, "/v1/verify",
+            {"bundle": out["bundle"], "claims": out["claims"]},
+        )
+        assert status == 200
+        assert ver["all_valid"] is True
+        assert len(ver["claim_results"]) == len(idxs)
+        assert all(c["all_valid"] for c in ver["claim_results"])
+
+    def test_compressed_bundle_accepted_on_verify(self, server):
+        httpd, _store, _pairs = server
+        status, _, out = self._post(
+            httpd, "/v1/generate", {"pair_index": 0, "witness_encoding": "zlib"}
+        )
+        assert status == 200
+        status, _, ver = self._post(httpd, "/v1/verify", {"bundle": out["bundle"]})
+        assert status == 200
+        assert ver["all_valid"] is True
+        # a corrupt frame on the verify path is a typed 400
+        bad = json.loads(json.dumps(out["bundle"]))
+        bad["blocks_frame"]["uncompressed_digest"] = "0" * 64
+        status, _, err = self._post(httpd, "/v1/verify", {"bundle": bad})
+        assert status == 400
+        assert err["error_type"] == "witness_integrity"
+
+    def test_agg_max_and_disabled_knobs(self, world):
+        store, pairs, _ = world
+        svc = ProofService(
+            store=store,
+            spec=EventProofSpec(event_signature=SIG, topic_1=SUBNET),
+            config=ServiceConfig(
+                max_batch=8, max_wait_ms=5.0, workers=1,
+                witness_agg_max=4, witness_compress=False, witness_delta=False,
+            ),
+        )
+        httpd = ProofHTTPServer(svc, pairs=pairs).start()
+        try:
+            status, _, out = self._post(
+                httpd, "/v1/generate_range",
+                {"pair_indexes": [0, 1, 0, 1, 0], "aggregate": True},
+            )
+            assert (status, out["error_type"]) == (400, "witness_agg_max")
+            # compression off is a CONTRACT violation → typed 400
+            status, _, out = self._post(
+                httpd, "/v1/generate",
+                {"pair_index": 0, "witness_encoding": "zlib"},
+            )
+            assert (status, out["error_type"]) == (400, "witness_encoding")
+            # delta off is a DEGRADATION → full bundle, no error
+            status, _, out = self._post(
+                httpd, "/v1/generate",
+                {"pair_index": 0, "base_digest": "0" * 64},
+            )
+            assert status == 200
+            assert "bundle" in out and "bundle_delta" not in out
+        finally:
+            httpd.shutdown(timeout=30)
+
+
+# --------------------------------------------------------------------------
+# subs plane: consecutive-epoch deltas, stale-base fallback, cursor hygiene
+# --------------------------------------------------------------------------
+
+
+class _RecordingOpener:
+    def __init__(self, behavior=None):
+        self._lock = threading.Lock()
+        self._calls = []
+        self._behavior = behavior
+
+    def __call__(self, url, body, timeout_s):
+        obj = json.loads(body)
+        with self._lock:
+            self._calls.append(obj)
+        return 200 if self._behavior is None else self._behavior(obj)
+
+    def calls(self, sub_id=None):
+        with self._lock:
+            out = list(self._calls)
+        if sub_id is None:
+            return out
+        return [c for c in out if c["sub_id"] == sub_id]
+
+
+def _stack(root, store, opener, m=None, delta=True):
+    m = m if m is not None else Metrics()
+    reg = SubscriptionRegistry(root, metrics=m, fsync=False)
+    log = DeliveryLog(root, metrics=m, fsync=False)
+    push = PushDelivery(
+        log, metrics=m, max_attempts=1, base_delay_s=0.01, max_delay_s=0.02,
+        opener=opener, sleep=_NOSLEEP, rng=random.Random(0),
+    )
+    matcher = StandingQueryMatcher(
+        reg, log, push, store, metrics=m, chunk_size=8, delta=delta
+    )
+    return m, reg, log, push, matcher
+
+
+def _drain(reg, log, push, matcher):
+    matcher.drain()
+    push.drain()
+    log.close()
+    reg.close()
+
+
+class TestSubsDeltaDelivery:
+    def _expected_obj(self, store, pair):
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET)
+        return generate_event_proofs_for_range_chunked(
+            store, [pair], spec, chunk_size=8
+        )
+
+    def test_consecutive_epochs_ship_deltas_stale_base_falls_back(
+        self, tmp_path, world
+    ):
+        """w1 acks every epoch → epochs 2,3 arrive as deltas that expand
+        byte-identically. w2's webhook dies at epoch 2, so at epoch 3 its
+        acked base is stale → FULL bundle + witness.delta_fallbacks."""
+        store, pairs, _ = world
+        h2 = pairs[1].child.height
+
+        def behavior(obj):
+            return 500 if obj["sub_id"] == "w2" and obj["tipset"] == h2 else 200
+
+        opener = _RecordingOpener(behavior)
+        m, reg, log, push, matcher = _stack(str(tmp_path), store, opener)
+        reg.subscribe(FILTER_A, {"url": "http://h/w1"}, sub_id="w1")
+        reg.subscribe(FILTER_A, {"url": "http://h/w2"}, sub_id="w2")
+        try:
+            assert matcher.match_pair(pairs[0]) == 2
+            assert _wait_until(lambda: len(opener.calls("w1")) == 1)
+            assert _wait_until(lambda: log.acked_base("w1") is not None)
+            # epoch 1: nothing held yet → full bundles all round
+            assert "bundle" in opener.calls("w1")[0]
+            d1 = log.acked_base("w1")
+
+            assert matcher.match_pair(pairs[1]) == 2
+            assert _wait_until(lambda: log.acked_base("w1") not in (None, d1))
+            env = opener.calls("w1")[1]
+            assert "bundle_delta" in env
+            assert env["bundle_delta"]["base_digest"] == d1
+            base = self._expected_obj(store, pairs[0])
+            expected2 = self._expected_obj(store, pairs[1])
+            expanded = apply_delta(env["bundle_delta"], base)
+            assert _canon(expanded) == _canon(expected2)
+            assert log.acked_base("w2") == d1  # w2's push failed — still on 1
+
+            # epoch 3: w1 deltas from epoch 2; w2's base is stale → full
+            h3 = pairs[2].child.height
+            assert matcher.match_pair(pairs[2]) == 2
+            assert _wait_until(
+                lambda: any(c["tipset"] == h3 for c in opener.calls("w2"))
+            )
+            assert _wait_until(lambda: len(opener.calls("w1")) == 3)
+            env_w1 = opener.calls("w1")[2]
+            assert "bundle_delta" in env_w1
+            assert _canon(
+                apply_delta(env_w1["bundle_delta"], expected2)
+            ) == _canon(self._expected_obj(store, pairs[2]))
+            env_w2 = [c for c in opener.calls("w2") if c["tipset"] == h3][-1]
+            assert "bundle" in env_w2 and "bundle_delta" not in env_w2
+            assert _counters(m)["witness.delta_fallbacks"] >= 1
+            assert _counters(m)["witness.delta_hits"] >= 2
+        finally:
+            _drain(reg, log, push, matcher)
+
+    def test_restart_falls_back_to_full_never_wrong_delta(self, tmp_path, world):
+        """A restarted matcher has no filter bases: the next epoch ships
+        FULL even though the sub's acked base survived in the log."""
+        store, pairs, _ = world
+        opener = _RecordingOpener()
+        m, reg, log, push, matcher = _stack(str(tmp_path), store, opener)
+        reg.subscribe(FILTER_A, {"url": "http://h/w1"}, sub_id="w1")
+        try:
+            assert matcher.match_pair(pairs[0]) == 1
+            assert _wait_until(lambda: log.acked_base("w1") is not None)
+        finally:
+            matcher.drain()
+        matcher2 = StandingQueryMatcher(
+            reg, log, push, store, metrics=m, chunk_size=8, delta=True
+        )
+        try:
+            assert matcher2.match_pair(pairs[1]) == 1
+            assert _wait_until(lambda: len(opener.calls("w1")) == 2)
+            env = opener.calls("w1")[1]
+            assert "bundle" in env and "bundle_delta" not in env
+            assert _counters(m)["witness.delta_fallbacks"] == 1
+        finally:
+            _drain(reg, log, push, matcher2)
+
+    def test_delta_off_always_ships_full(self, tmp_path, world):
+        store, pairs, _ = world
+        opener = _RecordingOpener()
+        m, reg, log, push, matcher = _stack(
+            str(tmp_path), store, opener, delta=False
+        )
+        reg.subscribe(FILTER_A, {"url": "http://h/w1"}, sub_id="w1")
+        try:
+            assert matcher.match_pair(pairs[0]) == 1
+            assert _wait_until(lambda: log.acked_base("w1") is not None)
+            assert matcher.match_pair(pairs[1]) == 1
+            assert _wait_until(lambda: len(opener.calls("w1")) == 2)
+            assert all("bundle" in c for c in opener.calls("w1"))
+            assert "witness.delta_hits" not in _counters(m)
+        finally:
+            _drain(reg, log, push, matcher)
+
+
+class TestDeltaCursorHygiene:
+    def test_acked_base_survives_compaction_and_restart(self, tmp_path):
+        """Satellite: compaction drops an acked delivery's pay frame; the
+        base digest must survive in the cursor record so a restarted
+        stack never cuts a delta against vanished bytes."""
+        m = Metrics()
+        log = DeliveryLog(str(tmp_path), metrics=m, cap_bytes=1, fsync=False)
+        payload = {"bundle": {"x": "y" * 256}}
+        d1 = log.append("s1", 100, "digest-a", payload)
+        assert d1 is not None
+        log.ack_through("s1", d1.cursor)
+        assert log.acked_base("s1") == "digest-a"
+        # cap_bytes=1 → every append compacts; the acked pay frame is gone
+        log.append("s1", 101, "digest-b", {"bundle": {"x": "z" * 256}})
+        log.close()
+
+        log2 = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        try:
+            # the cursor record carried the base identity across the wipe
+            assert log2.acked_base("s1") == "digest-a"
+            # and acking the surviving delivery advances it normally
+            entries = log2.entries_after("s1", d1.cursor)
+            assert [e.digest for e in entries] == ["digest-b"]
+            log2.ack_through("s1", entries[0].cursor)
+            assert log2.acked_base("s1") == "digest-b"
+        finally:
+            log2.close()
+
+    def test_delta_payloads_are_content_addressed_separately(self, tmp_path):
+        """A delta and its full bundle share the FULL digest (idempotency)
+        but not payload bytes — the pay frames must not collide."""
+        log = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        full = {"bundle": {"k": "full"}}
+        delta = {"bundle_delta": {"base_digest": "a", "digest": "dg"}}
+        d1 = log.append("s1", 100, "dg", full)
+        d2 = log.append("s2", 100, "dg", delta, payload_digest="delta:a:dg")
+        assert d1 is not None and d2 is not None
+        assert d1.payload == full and d2.payload == delta
+        log.close()
+        log2 = DeliveryLog(str(tmp_path), metrics=Metrics(), fsync=False)
+        try:
+            # replay resolves each subscriber's OWN payload bytes
+            assert log2.entries_after("s1", 0)[0].payload == full
+            assert log2.entries_after("s2", 0)[0].payload == delta
+        finally:
+            log2.close()

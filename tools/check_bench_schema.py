@@ -90,6 +90,11 @@ _KNOWN_TYPES = {
     "witness_two_pass_bytes": int,
     "witness_single_pass_bytes": int,
     "witness_sample_pairs": int,
+    "witness_bytes_per_proof_k1": _NUM,
+    "witness_bytes_per_proof_k16": _NUM,
+    "witness_bytes_per_proof_k256": _NUM,
+    "witness_delta_ratio": _NUM,
+    "witness_compressed_ratio": _NUM,
     "resilience_fault_free_proofs_per_sec": _NUM,
     "integrity_overhead_pct": _NUM,
     "proofs_per_sec_at_fault_rate": _NUM,
@@ -161,6 +166,9 @@ _CURRENT_REQUIRED = (
     "scalar_baseline_proofs_per_sec", "native_baseline_proofs_per_sec",
     "serve_batched_rps", "serve_speedup_vs_sequential",
     "witness_reduction_pct",
+    "witness_bytes_per_proof_k1", "witness_bytes_per_proof_k16",
+    "witness_bytes_per_proof_k256", "witness_delta_ratio",
+    "witness_compressed_ratio",
     "resilience_fault_free_proofs_per_sec", "integrity_overhead_pct",
     "proofs_per_sec_at_fault_rate", "resilience_fault_rate", "recovery_ms",
     "durability_journal_overhead_pct", "durability_resume_ms",
@@ -343,6 +351,46 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     "amortize: one generation per distinct filter shared by "
                     "all its subscribers"
                 )
+        # the witness-diet gate: aggregation and delta savings are wire
+        # accounting, not scheduling — K=16 co-tipset claims must cost
+        # strictly fewer bytes per proof than K=1 (the claim table shares
+        # one witness), and a consecutive-epoch delta must be strictly
+        # smaller than re-shipping the full bundle. Host-shape
+        # independent; only artifacts predating the leg skip.
+        if witnessdiet_gate_skip_reason(obj) is None:
+            k1 = obj.get("witness_bytes_per_proof_k1")
+            k16 = obj.get("witness_bytes_per_proof_k16")
+            dratio = obj.get("witness_delta_ratio")
+            for name, val in (
+                ("witness_bytes_per_proof_k1", k1),
+                ("witness_bytes_per_proof_k16", k16),
+                ("witness_delta_ratio", dratio),
+            ):
+                if not isinstance(val, _NUM) or isinstance(val, bool):
+                    problems.append(
+                        f"witness-diet gate: {name} is {val!r} "
+                        "(witness leg did not run?)"
+                    )
+            if (
+                isinstance(k1, _NUM) and not isinstance(k1, bool)
+                and isinstance(k16, _NUM) and not isinstance(k16, bool)
+                and k16 >= k1
+            ):
+                problems.append(
+                    f"witness-diet gate: witness_bytes_per_proof_k16={k16} "
+                    f">= witness_bytes_per_proof_k1={k1} — aggregating 16 "
+                    "co-tipset claims must cost strictly fewer bytes per "
+                    "proof than one claim per response"
+                )
+            if (
+                isinstance(dratio, _NUM) and not isinstance(dratio, bool)
+                and dratio >= 1.0
+            ):
+                problems.append(
+                    f"witness-diet gate: witness_delta_ratio={dratio} "
+                    ">= 1.0 — a consecutive-epoch delta must be strictly "
+                    "smaller than re-shipping the full bundle"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -435,6 +483,20 @@ def standing_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def witnessdiet_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the K=16 < K=1 / delta < 1.0 witness-diet gate does NOT apply
+    (None when it does). Wire byte counts are deterministic accounting —
+    host-shape independent — so the only skip is an artifact predating
+    the witness-diet measurements (old vintage validated without
+    --require-current)."""
+    if (
+        "witness_bytes_per_proof_k1" not in obj
+        and "witness_delta_ratio" not in obj
+    ):
+        return "artifact predates the witness-diet leg"
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -467,6 +529,9 @@ def main(argv=None) -> int:
             reason = onchip_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: onchip gate SKIPPED ({reason})")
+            reason = witnessdiet_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: witness-diet gate SKIPPED ({reason})")
             reason = standing_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: standing gate SKIPPED ({reason})")
