@@ -57,7 +57,7 @@ ACTOR = 1001
 LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
-    "asyncfetch", "cluster", "standing", "onchip",
+    "asyncfetch", "cluster", "standing", "fleetobs", "onchip",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -77,6 +77,7 @@ _LEG_TIMEOUTS = {
     "asyncfetch": (300.0, 150.0),
     "cluster": (420.0, 240.0),
     "standing": (420.0, 240.0),
+    "fleetobs": (420.0, 240.0),
     "onchip": (480.0, 240.0),
 }
 
@@ -1606,6 +1607,159 @@ def _leg_cluster(args) -> dict:
     }
 
 
+def _leg_fleetobs(args) -> dict:
+    """Fleet observability overhead (host-only, REAL processes): the same
+    closed-loop generate load through a 2-shard router with the fleet
+    observability plane OFF vs ON (federated metrics scraping, SLO
+    watchdog, per-tenant accounting, head-sampled tracing with in-band
+    span shipping at production rate 0.1).
+
+    - ``fleetobs_overhead_pct`` — throughput cost of the plane; gated
+      ≤ 3% by ``tools/check_bench_schema.py`` on current artifacts from
+      hosts with spare cores (on ≤2-core hosts the scrape/watchdog
+      threads time-slice the request loop, so the ratio is skipped);
+    - correctness is ASSERTED on every run, never sampled: after the
+      measured load, a fully-sampled scatter must graft every shard's
+      shipped span subtree into ONE rooted tree in the router's
+      collector (``fleetobs_stitched_spans`` of them), no orphans.
+
+    Best-of-3 walls per mode: the closed loop over a small demo world is
+    short, and the overhead ratio needs both numerators at their noise
+    floor, not one lucky and one unlucky pass."""
+    import threading
+
+    from ipc_proofs_tpu.cluster import ClusterRouter, spawn_serve_shard
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.obs import disable_tracing, enable_tracing
+    from ipc_proofs_tpu.obs.slo import SloWatchdog, default_targets
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    n_pairs = 8 if args.quick else args.cluster_pairs
+    n_requests = 32 if args.quick else args.cluster_requests
+    receipts, match_rate = 8, 0.25
+    concurrency, n_shards, reps = 8, 2, 3
+
+    _store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, match_rate=match_rate,
+        signature=SIG, topic1=TOPIC1,
+    )
+    base_extra = [
+        "--demo-receipts", str(receipts), "--demo-match-rate", str(match_rate),
+    ]
+
+    def closed_loop(router, observed: bool) -> float:
+        it = iter(range(n_requests))
+        it_lock = threading.Lock()
+        failures: "list" = []
+
+        def client():
+            while True:
+                with it_lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                status, obj = router.generate(
+                    i % len(pairs),
+                    tenant=f"team-{i % 3}" if observed else None,
+                )
+                if status != 200:
+                    failures.append((i, obj))
+                    return
+
+        threads = [threading.Thread(target=client) for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not failures, f"fleetobs leg: {len(failures)} failures"
+        return n_requests / wall
+
+    def measure(observed: bool) -> "tuple[float, int, int]":
+        extra = list(base_extra)
+        if observed:
+            extra += [
+                "--trace-out", os.devnull, "--trace-sample", "0.1",
+                "--slo", "on",
+            ]
+        shards = [
+            spawn_serve_shard(f"s{k}", n_pairs, SIG, TOPIC1, extra_args=extra)
+            for k in range(n_shards)
+        ]
+        m = Metrics()
+        collector = slo = None
+        if observed:
+            collector = enable_tracing(metrics=m, sample=0.1)
+            slo = SloWatchdog(m, default_targets(), interval_s=0.5)
+        router = ClusterRouter(
+            {sh.name: sh.url for sh in shards}, pairs, metrics=m,
+            scrape_interval_s=0.25, scrape_timeout_s=5.0, slo=slo,
+        )
+        try:
+            if observed:
+                router.federation.start()
+                slo.start()
+            for k in range(len(pairs)):  # warm every shard
+                status, _obj = router.generate(k % len(pairs))
+                assert status == 200
+            rps = max(closed_loop(router, observed) for _ in range(reps))
+            grafted = scrapes = 0
+            if observed:
+                # outside the timed window: the stitching law, asserted
+                collector = enable_tracing(metrics=m, sample=1.0)
+                status, obj = router.generate_range(
+                    list(range(len(pairs))), chunk_size=8
+                )
+                assert status == 200, obj
+                tid = obj["trace_id"]
+                spans = [
+                    s for s in collector.snapshot() if s.trace_id == tid
+                ]
+                ids = {s.span_id for s in spans}
+                roots = [
+                    s for s in spans
+                    if not s.parent_id or s.parent_id not in ids
+                ]
+                assert len(roots) == 1, (
+                    "fleetobs leg: sampled scatter did not stitch into one "
+                    f"rooted tree ({len(roots)} roots)"
+                )
+                grafted = sum(1 for s in spans if ":" in s.span_id)
+                assert grafted > 0, "fleetobs leg: no shard subtrees grafted"
+                scrapes = int(
+                    m.snapshot()["counters"].get("fleet.scrapes", 0)
+                )
+            return rps, grafted, scrapes
+        finally:
+            router.close()
+            if observed:
+                disable_tracing()
+            for sh in shards:
+                sh.stop()
+
+    rps_plain, _, _ = measure(False)
+    rps_observed, grafted, scrapes = measure(True)
+    overhead = (
+        (rps_plain - rps_observed) / rps_plain * 100.0 if rps_plain else None
+    )
+    _log(
+        f"bench: fleetobs ({n_pairs} pairs, {n_requests} reqs, "
+        f"c={concurrency}): {rps_plain:,.1f} req/s plain vs "
+        f"{rps_observed:,.1f} req/s observed ({overhead:+.2f}% overhead); "
+        f"{grafted} spans grafted into one rooted tree ✓, {scrapes} scrapes"
+    )
+    return {
+        "fleetobs_overhead_pct": round(overhead, 2) if overhead is not None else None,
+        "fleetobs_rps_plain": round(rps_plain, 1),
+        "fleetobs_rps_observed": round(rps_observed, 1),
+        "fleetobs_stitched_spans": int(grafted),
+        "fleetobs_scrapes": int(scrapes),
+        "fleetobs_pairs": n_pairs,
+        "fleetobs_requests": n_requests,
+    }
+
+
 def _leg_onchip(args) -> dict:
     """The on-chip half, sharded (PR 12): mesh-pjit event matching across
     every local device + device-batched multihash verification.
@@ -1895,6 +2049,7 @@ _LEG_FNS = {
     "asyncfetch": _leg_asyncfetch,
     "cluster": _leg_cluster,
     "standing": _leg_standing,
+    "fleetobs": _leg_fleetobs,
     "onchip": _leg_onchip,
 }
 
@@ -2200,6 +2355,8 @@ def _orchestrate(args) -> None:
     legs_status["cluster"] = status
     standing, status = _run_leg("standing", args, "cpu")
     legs_status["standing"] = status
+    fleetobs, status = _run_leg("fleetobs", args, "cpu")
+    legs_status["fleetobs"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -2289,6 +2446,13 @@ def _orchestrate(args) -> None:
     )
     for k in _STANDING_KEYS:
         out[k] = (standing or {}).get(k)
+    _FLEETOBS_KEYS = (
+        "fleetobs_overhead_pct", "fleetobs_rps_plain",
+        "fleetobs_rps_observed", "fleetobs_stitched_spans",
+        "fleetobs_scrapes", "fleetobs_pairs", "fleetobs_requests",
+    )
+    for k in _FLEETOBS_KEYS:
+        out[k] = (fleetobs or {}).get(k)
     _ONCHIP_KEYS = (
         "device_linearity_Nchip", "batch_verify_speedup", "onchip_devices",
         "onchip_match_events", "onchip_verify_blocks", "onchip_device_calls",

@@ -80,6 +80,23 @@ def _start_tracing(args) -> bool:
     return True
 
 
+def _build_slo_watchdog(args, metrics):
+    """`--slo on` → a configured (not yet started) burn-rate watchdog."""
+    from ipc_proofs_tpu.obs.slo import SloWatchdog, default_targets
+
+    return SloWatchdog(
+        metrics=metrics,
+        targets=default_targets(
+            availability=args.slo_availability,
+            generate_p99_ms=args.slo_generate_p99_ms,
+            delivery_lag_p99_ms=args.slo_delivery_lag_p99_ms,
+        ),
+        fast_window_s=args.slo_fast_window_s,
+        slow_window_s=args.slo_slow_window_s,
+        interval_s=args.slo_interval_s,
+    )
+
+
 def _finish_tracing(args) -> None:
     """Export collected spans to ``--trace-out`` (Chrome trace JSON, load
     at ui.perfetto.dev or chrome://tracing) and/or ``--trace-otlp``
@@ -735,9 +752,16 @@ def _cmd_serve(args) -> int:
             # the streaming plane: each finalized tipset the (leader)
             # follower warms also drives match → generate-once → fan-out
             follower.add_finalized_hook(subs.on_tipset)
+    slo = None
+    if args.slo == "on":
+        slo = _build_slo_watchdog(args, metrics)
+        slo.start()
+    from ipc_proofs_tpu.obs.fleet import TenantLedger
+
     httpd = ProofHTTPServer(
         service, host=args.host, port=args.port, pairs=pairs, durable=durable,
-        subs=subs,
+        subs=subs, slo=slo,
+        tenants=TenantLedger(metrics=metrics, top_k=args.tenant_top_k),
     )
     if args.port_file:
         # atomic write: a polling parent never reads a half-written port
@@ -817,6 +841,14 @@ def _cmd_cluster(args) -> int:
         "--demo-receipts", str(args.demo_receipts),
         "--demo-match-rate", str(args.demo_match_rate),
     ]
+    if tracing:
+        # the shards must run their span collector too so sampled requests
+        # ship their subtree back for stitching (the router grafts them
+        # under its dispatch spans); the shard-side export goes nowhere
+        extra += [
+            "--trace-out", os.devnull,
+            "--trace-sample", str(getattr(args, "trace_sample", 1.0)),
+        ]
     if args.store_cap_bytes is not None:
         extra += ["--store-cap-bytes", str(args.store_cap_bytes)]
     # witness diet knobs are cluster-wide: every shard must negotiate the
@@ -870,11 +902,18 @@ def _cmd_cluster(args) -> int:
             sh.kill()
         return 1
 
+    slo = None
+    if args.slo == "on":
+        slo = _build_slo_watchdog(args, metrics)
     router = ClusterRouter(
         {sh.name: sh.url for sh in shards},
         pairs,
         steal_threshold=args.steal_threshold,
         metrics=metrics,
+        scrape_interval_s=args.scrape_interval_s,
+        scrape_timeout_s=args.scrape_timeout_s,
+        slo=slo,
+        tenant_top_k=args.tenant_top_k,
     )
     httpd = RouterHTTPServer(router, host=args.host, port=args.port)
     httpd.start()
@@ -1083,6 +1122,49 @@ def main(argv=None) -> int:
             "(decided once per trace from its id, so exported trees are "
             "never torn; the always-on flight recorder ignores sampling). "
             "Default 1.0",
+        )
+
+    def add_fleet_obs_flags(p):
+        p.add_argument(
+            "--slo", default="off", choices=["on", "off"],
+            help="run the SLO burn-rate watchdog: multi-window "
+            "(fast/slow) burn rates per declarative target, an 'slo' "
+            "block in /healthz, WARN records into the flight ring, and "
+            "anomaly signatures (breaker flap storms, eviction storms, "
+            "speculation-waste spikes). Default off",
+        )
+        p.add_argument(
+            "--slo-availability", type=float, default=0.999,
+            help="availability objective (fraction of requests that must "
+            "not be rejected/failed; default 0.999)",
+        )
+        p.add_argument(
+            "--slo-generate-p99-ms", type=float, default=2000.0,
+            help="generate latency target: p99 must stay under this "
+            "(default 2000)",
+        )
+        p.add_argument(
+            "--slo-delivery-lag-p99-ms", type=float, default=5000.0,
+            help="standing-query delivery lag target: p99 append→ack lag "
+            "must stay under this (default 5000)",
+        )
+        p.add_argument(
+            "--slo-interval-s", type=float, default=5.0,
+            help="watchdog evaluation interval (default 5)",
+        )
+        p.add_argument(
+            "--slo-fast-window-s", type=float, default=300.0,
+            help="fast burn-rate window (default 300 = 5 min)",
+        )
+        p.add_argument(
+            "--slo-slow-window-s", type=float, default=3600.0,
+            help="slow burn-rate window (default 3600 = 1 h)",
+        )
+        p.add_argument(
+            "--tenant-top-k", type=int, default=8, metavar="K",
+            help="track per-tenant request/byte counters for the first K "
+            "distinct tenants; later tenants aggregate into the 'other' "
+            "bucket (bounds metric cardinality; default 8)",
         )
 
     gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")
@@ -1379,6 +1461,7 @@ def main(argv=None) -> int:
         "shutdown (open at ui.perfetto.dev)",
     )
     add_trace_export_flags(srv)
+    add_fleet_obs_flags(srv)
     srv.set_defaults(fn=_cmd_serve)
 
     clu = sub.add_parser(
@@ -1433,6 +1516,18 @@ def main(argv=None) -> int:
         help="export router spans as Chrome trace-event JSON on shutdown",
     )
     add_trace_export_flags(clu)
+    add_fleet_obs_flags(clu)
+    clu.add_argument(
+        "--scrape-interval-s", type=float, default=5.0,
+        help="fleet federation: router background-scrape interval for "
+        "each shard's /metrics.json + /healthz (default 5)",
+    )
+    clu.add_argument(
+        "--scrape-timeout-s", type=float, default=2.0,
+        help="per-shard scrape timeout; a slow or dead shard drops out "
+        "of the fleet view for that round instead of stalling it "
+        "(default 2)",
+    )
     clu.set_defaults(fn=_cmd_cluster)
 
     args = parser.parse_args(argv)

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import urllib.error
 import urllib.request
@@ -58,6 +59,16 @@ from urllib.parse import parse_qs, urlsplit
 
 from ipc_proofs_tpu.cluster.gather import BundleFold, partition_indexes
 from ipc_proofs_tpu.cluster.hashring import HashRing, pair_ring_key
+from ipc_proofs_tpu.obs.fleet import (
+    FleetFederation,
+    TenantLedger,
+    extract_tenant,
+    graft_spans,
+    merge_flight_snapshots,
+    render_fleet_prometheus,
+)
+from ipc_proofs_tpu.obs.flight import get_flight_recorder
+from ipc_proofs_tpu.obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ipc_proofs_tpu.obs.trace import (
     carrier_from_context,
     current_context,
@@ -163,6 +174,10 @@ class ClusterRouter:
         metrics: Optional[Metrics] = None,
         request_timeout_s: float = 120.0,
         max_workers: int = 16,
+        scrape_interval_s: float = 5.0,
+        scrape_timeout_s: float = 2.0,
+        slo=None,
+        tenant_top_k: int = 8,
     ):
         if not shards:
             raise NoShardsError("a cluster needs at least one shard")
@@ -187,6 +202,18 @@ class ClusterRouter:
         self._executor = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="cluster-scatter"
         )
+        # Fleet observability plane: a short-timeout scraper federating
+        # every shard's metrics/health into one router-side view, a
+        # per-tenant accounting ledger, and an optional SLO watchdog
+        # (owned by the caller; the router only surfaces its status).
+        self.federation = FleetFederation(
+            self._alive_shard_urls,
+            metrics=self.metrics,
+            interval_s=scrape_interval_s,
+            timeout_s=scrape_timeout_s,
+        )
+        self.tenants = TenantLedger(metrics=self.metrics, top_k=tenant_top_k)
+        self.slo = slo
         self._gauge_alive_locked()
 
     # --- placement (all under _lock) --------------------------------------
@@ -237,6 +264,15 @@ class ClusterRouter:
                 self.metrics.set_gauge(
                     f"cluster.inflight.{name}", state.inflight
                 )
+
+    def _alive_shard_urls(self) -> "Dict[str, str]":
+        """Scrape targets for the federation: live shards' base URLs."""
+        with self._lock:
+            return {
+                name: state.client.base_url
+                for name, state in self._shards.items()
+                if state.alive
+            }
 
     def _mark_dead(self, name: str) -> None:
         rearc: "List[Tuple[str, str, dict]]" = []
@@ -329,7 +365,10 @@ class ClusterRouter:
                 with span(
                     "cluster.dispatch", {"shard": name, "path": path}
                 ):
-                    return client.post(path, body)
+                    status, obj = client.post(path, body)
+                if isinstance(obj, dict):
+                    self._graft_shard_spans(name, obj)
+                return status, obj
             except ShardUnavailable:
                 self._mark_dead(name)
                 # every re-dispatch after a death is a failover — including
@@ -337,6 +376,22 @@ class ClusterRouter:
                 self.metrics.count("cluster.shard_failovers")
             finally:
                 self._release(name)
+
+    def _graft_shard_spans(self, shard: str, obj: dict) -> None:
+        """Stitch a shard's shipped span subtree into this process's trace.
+
+        Shards attach a bounded ``spans`` field to sampled responses (see
+        ``httpd._attach_spans``); the router grafts those spans under its
+        own dispatch spans so one scatter-gather renders as ONE tree. The
+        field is stripped either way — clients never see the plumbing.
+        In-process shards (tests' LocalShard) share our span store, so a
+        matching ``spans_pid`` means the subtree is already recorded.
+        """
+        shipped = obj.pop("spans", None)
+        shipped_pid = obj.pop("spans_pid", None)
+        if not shipped or shipped_pid == os.getpid():
+            return
+        graft_spans(shipped, shard, metrics=self.metrics)
 
     def _dispatch_affine(
         self, key: str, path: str, body: Optional[dict] = None
@@ -482,6 +537,7 @@ class ClusterRouter:
         pair_index: int,
         timeout_s: Optional[float] = None,
         idempotency_key: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> "tuple[int, dict]":
         """Route one single-pair generate to its affine shard."""
         if not (
@@ -498,6 +554,8 @@ class ClusterRouter:
             body["timeout_s"] = timeout_s
         if idempotency_key is not None:
             body["idempotency_key"] = idempotency_key
+        if tenant is not None:
+            body["tenant"] = tenant
         with root_span("cluster.generate", {"pair_index": pair_index}):
             return self._dispatch(self._keys[pair_index], "/v1/generate", body)
 
@@ -520,6 +578,7 @@ class ClusterRouter:
         chunk_size: Optional[int] = None,
         timeout_s: Optional[float] = None,
         aggregate: bool = False,
+        tenant: Optional[str] = None,
     ) -> "tuple[int, dict]":
         """Scatter a multi-pair range across shards, gather one canonical
         bundle (byte-identical to a single-daemon run over the same list).
@@ -564,6 +623,8 @@ class ClusterRouter:
                     body["chunk_size"] = chunk_size
                 if timeout_s is not None:
                     body["timeout_s"] = timeout_s
+                if tenant is not None:
+                    body["tenant"] = tenant
                 # group affinity = first member's key: the whole group was
                 # binned by that shard's arc, and failover re-keys anyway
                 with use_context(ctx):
@@ -640,18 +701,124 @@ class ClusterRouter:
             if h.get("status") not in ("dead", "draining")
         )
         if serving == 0:
-            return 503, {"status": "unavailable", "shards": shard_health}
+            out: dict = {"status": "unavailable", "shards": shard_health}
+            if self.slo is not None:
+                out["slo"] = self.slo.status()
+            return 503, out
         status = "ok" if n_ok == len(shard_health) else "degraded"
-        return 200, {
+        out = {
             "status": status,
             "shards": shard_health,
             "shards_alive": serving,
         }
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
+        return 200, out
 
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
 
+    # --- fleet observability plane ----------------------------------------
+
+    def fleet_prom(self) -> str:
+        """One Prometheus exposition for the whole fleet: every shard's
+        counters/gauges/histograms labelled ``shard="s<k>"``, the router's
+        own labelled ``shard="router"``, plus ``shard="fleet"`` aggregates
+        (counter sums, merged histograms). Dead shards simply drop out of
+        the exposition — scraping keeps working while degraded."""
+        latest = self.federation.latest(max_age_s=2.0 * self.federation.interval_s)
+        shard_snaps = {
+            name: entry.get("metrics")
+            for name, entry in latest.get("shards", {}).items()
+        }
+        return render_fleet_prometheus(
+            shard_snaps, router_snap=self.metrics.snapshot()
+        )
+
+    def cluster_status(self) -> "tuple[int, dict]":
+        """The live cluster view: ring topology joined with each shard's
+        scraped health/queue depths, follower finalization progress,
+        delivery backlog, and store-tier bytes — one JSON document."""
+        latest = self.federation.latest(max_age_s=2.0 * self.federation.interval_s)
+        with self._lock:
+            ring = {
+                name: {
+                    "alive": state.alive,
+                    "inflight": state.inflight,
+                    "url": state.client.base_url,
+                }
+                for name, state in self._shards.items()
+            }
+        shards: "Dict[str, dict]" = {}
+        max_epoch: Optional[int] = None
+        backlog = 0
+        disk_bytes = 0
+        for name, entry in latest.get("shards", {}).items():
+            health = entry.get("healthz") or {}
+            snap = entry.get("metrics") or {}
+            gauges = snap.get("gauges") or {}
+            depths = {
+                key[len("serve.queue_depth.") :]: val
+                for key, val in gauges.items()
+                if key.startswith("serve.queue_depth.")
+            }
+            epoch = health.get("last_finalized_epoch")
+            pending = health.get("pending_deliveries")
+            shard_disk = gauges.get("storex.disk_bytes")
+            shards[name] = {
+                "status": health.get("status")
+                or ("unreachable" if entry.get("error") else "unknown"),
+                "scrape_error": entry.get("error"),
+                "queue_depth": depths,
+                "pending_deliveries": pending,
+                "last_finalized_epoch": epoch,
+                "disk_bytes": shard_disk,
+            }
+            if isinstance(epoch, int):
+                max_epoch = epoch if max_epoch is None else max(max_epoch, epoch)
+            if isinstance(pending, (int, float)):
+                backlog += int(pending)
+            if isinstance(shard_disk, (int, float)):
+                disk_bytes += int(shard_disk)
+        counters = self.metrics.snapshot().get("counters", {})
+        out: dict = {
+            "captured_at": latest.get("captured_at"),
+            "ring": ring,
+            "shards": shards,
+            "router": {
+                "requests": counters.get("cluster.requests", 0),
+                "steals": counters.get("cluster.steals", 0),
+                "shard_failovers": counters.get("cluster.shard_failovers", 0),
+                "scrape_errors": counters.get("fleet.scrape_errors", 0),
+            },
+            "last_finalized_epoch": max_epoch,
+            "delivery_backlog": backlog,
+            "store_disk_bytes": disk_bytes,
+        }
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
+        return 200, out
+
+    def flight(self) -> dict:
+        """Aggregate the fleet's flight rings (shards' ``/debug/flight``
+        plus the router's own) into one shard-labelled, newest-first
+        snapshot. Unreachable shards land in ``failed`` — fail-soft."""
+        shard_flights: "Dict[str, Optional[dict]]" = {}
+        for name, url in sorted(self._alive_shard_urls().items()):
+            probe = ShardClient(name, url, timeout_s=self.federation.timeout_s)
+            try:
+                status, obj = probe.get("/debug/flight")
+                shard_flights[name] = obj if status == 200 else None
+            except ShardUnavailable:
+                shard_flights[name] = None
+        return merge_flight_snapshots(
+            shard_flights, local_snap=get_flight_recorder().snapshot()
+        )
+
     def close(self) -> None:
+        self.federation.stop()
+        if self.slo is not None:
+            self.slo.stop()
         self._executor.shutdown(wait=True)
 
 
@@ -671,13 +838,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, status: int, text: str, content_type: str):
+        body = text.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         parts = urlsplit(self.path)
         if parts.path == "/healthz":
             status, obj = self.router.healthz()
             self._send_json(status, obj)
-        elif parts.path == "/metrics":
+        elif parts.path in ("/metrics", "/metrics.json"):
             self._send_json(200, self.router.metrics_snapshot())
+        elif parts.path == "/metrics.prom":
+            self._send_text(200, self.router.fleet_prom(), _PROM_CONTENT_TYPE)
+        elif parts.path == "/v1/cluster/status":
+            status, obj = self.router.cluster_status()
+            self._send_json(status, obj)
+        elif parts.path == "/debug/flight":
+            self._send_json(200, self.router.flight())
         elif parts.path == "/v1/subscriptions":
             status, obj = self.router.subscriptions()
             self._send_json(status, obj)
@@ -713,12 +895,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as exc:
             self._send_json(400, {"error": f"bad request body: {exc}"})
             return
+        if self.path in ("/v1/generate", "/v1/verify", "/v1/generate_range"):
+            # Per-tenant accounting at the front door, and the (sanitized)
+            # tenant rides the forwarded body so shards account it too.
+            tenant = extract_tenant(body, self.headers)
+            self.router.tenants.account(tenant, nbytes=length)
+            if tenant is not None:
+                body["tenant"] = tenant
         try:
             if self.path == "/v1/generate":
                 status, obj = self.router.generate(
                     body.get("pair_index"),
                     timeout_s=body.get("timeout_s"),
                     idempotency_key=body.get("idempotency_key"),
+                    tenant=body.get("tenant"),
                 )
             elif self.path == "/v1/verify":
                 status, obj = self.router.verify(body)
@@ -728,6 +918,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     chunk_size=body.get("chunk_size"),
                     timeout_s=body.get("timeout_s"),
                     aggregate=body.get("aggregate", False) is True,
+                    tenant=body.get("tenant"),
                 )
             elif self.path == "/v1/subscribe":
                 status, obj = self.router.subscribe(body)
@@ -772,6 +963,11 @@ class RouterHTTPServer:
             target=self.serve_forever, name="cluster-router-httpd", daemon=True
         )
         self._thread.start()
+        # background scrape loop + SLO watchdog ride the server lifecycle;
+        # router.close() (via shutdown) stops both
+        self.router.federation.start()
+        if self.router.slo is not None:
+            self.router.slo.start()
         return self
 
     def shutdown(self, timeout: Optional[float] = None) -> None:

@@ -20,12 +20,25 @@ from ipc_proofs_tpu.obs.export import (
     write_chrome_trace,
     write_otlp_trace,
 )
+from ipc_proofs_tpu.obs.fleet import (
+    FleetFederation,
+    TenantLedger,
+    extract_tenant,
+    graft_spans,
+    merge_counters,
+    merge_flight_snapshots,
+    merge_gauges,
+    merge_histograms,
+    render_fleet_prometheus,
+    subtree_for_response,
+)
 from ipc_proofs_tpu.obs.flight import (
     FlightLogHandler,
     FlightRecorder,
     get_flight_recorder,
     install_crash_dump,
 )
+from ipc_proofs_tpu.obs.slo import SloTarget, SloWatchdog, default_targets
 from ipc_proofs_tpu.obs.prom import CONTENT_TYPE, render_prometheus
 from ipc_proofs_tpu.obs.trace import (
     Span,
@@ -48,8 +61,11 @@ from ipc_proofs_tpu.obs.trace import (
 
 __all__ = [
     "CONTENT_TYPE",
+    "FleetFederation",
     "FlightLogHandler",
     "FlightRecorder",
+    "SloTarget",
+    "SloWatchdog",
     "Span",
     "SpanCollector",
     "TraceContext",
@@ -59,18 +75,27 @@ __all__ = [
     "chrome_trace_obj",
     "context_from_carrier",
     "current_context",
+    "default_targets",
     "disable_tracing",
     "enable_tracing",
+    "extract_tenant",
     "format_span_tree",
     "get_collector",
     "get_flight_recorder",
+    "graft_spans",
     "install_crash_dump",
+    "merge_counters",
+    "merge_flight_snapshots",
+    "merge_gauges",
+    "merge_histograms",
     "otlp_trace_obj",
     "post_otlp_trace",
+    "render_fleet_prometheus",
     "render_prometheus",
     "root_span",
     "span",
     "spans_for_trace",
+    "subtree_for_response",
     "tracing_enabled",
     "use_context",
     "write_chrome_trace",

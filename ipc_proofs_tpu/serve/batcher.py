@@ -87,6 +87,7 @@ class PendingResult:
         "enqueued_at",
         "dispatched_at",
         "trace_ctx",
+        "tenant",
         "_done",
         "_result",
         "_error",
@@ -98,6 +99,7 @@ class PendingResult:
         self.enqueued_at = enqueued_at
         self.dispatched_at: Optional[float] = None
         self.trace_ctx = None  # obs.trace.TraceContext captured at submit
+        self.tenant: Optional[str] = None  # sanitized accounting label
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -166,7 +168,12 @@ class MicroBatcher:
 
     # --- admission ---------------------------------------------------------
 
-    def submit(self, payload, timeout_s: Optional[float] = None) -> PendingResult:
+    def submit(
+        self,
+        payload,
+        timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+    ) -> PendingResult:
         """Admit one request; never blocks.
 
         Raises `ServiceClosedError` after `close()`, `QueueFullError` when
@@ -186,6 +193,7 @@ class MicroBatcher:
                 )
             pending = PendingResult(payload, deadline, now)
             pending.trace_ctx = current_context()
+            pending.tenant = tenant
             self._queue.append(pending)
             self._metrics.set_gauge(
                 f"serve.queue_depth.{self._name}", len(self._queue)

@@ -256,7 +256,9 @@ class DurableAdmission:
             self.metrics.count("serve.requests_replayed")
             key, kind, payload = rec["key"], rec["kind"], rec["payload"]
             try:
-                result = self._execute(kind, payload, timeout_s=None)
+                result = self._execute(
+                    kind, payload, timeout_s=None, tenant=rec.get("tenant")
+                )
                 done = {"ok": True, "result": result}
             except Exception as exc:  # fail-soft: replay must terminate — a poison request journals as an error result, not a restart crash-loop
                 # any failure (even admission) finishes with an error here:
@@ -266,10 +268,16 @@ class DurableAdmission:
 
     # --- execution ---------------------------------------------------------
 
-    def _execute(self, kind: str, payload: Any, timeout_s: "float | None") -> dict:
+    def _execute(
+        self,
+        kind: str,
+        payload: Any,
+        timeout_s: "float | None",
+        tenant: "str | None" = None,
+    ) -> dict:
         if kind == "verify":
             bundle = UnifiedProofBundle.from_json_obj(payload)
-            resp = self.service.verify(bundle, timeout_s=timeout_s)
+            resp = self.service.verify(bundle, timeout_s=timeout_s, tenant=tenant)
             return {
                 "storage_results": resp.storage_results,
                 "event_results": resp.event_results,
@@ -283,7 +291,9 @@ class DurableAdmission:
                 raise ValueError(
                     f"pair_index {payload!r} outside [0, {len(self.pairs)})"
                 )
-            resp = self.service.generate(self.pairs[payload], timeout_s=timeout_s)
+            resp = self.service.generate(
+                self.pairs[payload], timeout_s=timeout_s, tenant=tenant
+            )
             return {
                 "bundle": resp.bundle.to_json_obj(),
                 "n_event_proofs": resp.n_event_proofs,
@@ -343,6 +353,7 @@ class DurableAdmission:
         payload: Any,
         idempotency_key: "str | None" = None,
         timeout_s: "float | None" = None,
+        tenant: "str | None" = None,
     ) -> "tuple[str, dict, bool]":
         """Admit one request; returns ``(key, done_payload, cached)``.
 
@@ -381,13 +392,14 @@ class DurableAdmission:
 
         # durable intent BEFORE execution: the ACK implies the journal has it
         j0 = time.perf_counter()
+        admit = {"t": "admit", "key": key, "kind": kind, "payload": payload}
+        if tenant:
+            admit["tenant"] = tenant
         with self._jlock:
-            self._writer.append(  # ipclint: disable=lock-held-blocking (durability: admit-frames serialize under the journal lock)
-                {"t": "admit", "key": key, "kind": kind, "payload": payload}
-            )
+            self._writer.append(admit)  # ipclint: disable=lock-held-blocking (durability: admit-frames serialize under the journal lock)
         journal_ms = round((time.perf_counter() - j0) * 1e3, 3)
         try:
-            result = self._execute(kind, payload, timeout_s=timeout_s)
+            result = self._execute(kind, payload, timeout_s=timeout_s, tenant=tenant)
             # surface the admission fsync in this request's latency
             # breakdown (the done-record append overlaps the response)
             timing = result.get("server_timing")

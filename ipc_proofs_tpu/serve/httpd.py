@@ -67,15 +67,21 @@ worker pool executes batches, so slow clients never stall batch execution.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 from urllib.parse import parse_qs, urlsplit
 
+from ipc_proofs_tpu.obs.fleet import (
+    TenantLedger,
+    extract_tenant,
+    subtree_for_response,
+)
 from ipc_proofs_tpu.obs.flight import get_flight_recorder
 from ipc_proofs_tpu.obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ipc_proofs_tpu.obs.prom import render_prometheus
-from ipc_proofs_tpu.obs.trace import adopted_span
+from ipc_proofs_tpu.obs.trace import adopted_span, tracing_enabled
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.proofs.range import TipsetPair
 from ipc_proofs_tpu.serve.batcher import (
@@ -105,6 +111,8 @@ class _Handler(BaseHTTPRequestHandler):
     pairs: Sequence[TipsetPair]
     durable = None  # Optional[DurableAdmission]
     subs = None  # Optional[subs.StandingQueries]
+    slo = None  # Optional[obs.slo.SloWatchdog]
+    tenants = None  # Optional[obs.fleet.TenantLedger]
 
     protocol_version = "HTTP/1.1"
 
@@ -173,6 +181,7 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         if length <= 0 or length > _MAX_BODY_BYTES:
             raise ValueError(f"Content-Length required, 0 < n <= {_MAX_BODY_BYTES}")
+        self._body_bytes = length  # tenant byte accounting reads this
         obj = json.loads(self.rfile.read(length))
         if not isinstance(obj, dict):
             raise ValueError("request body must be a JSON object")
@@ -182,7 +191,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlsplit(self.path).path
-        if path == "/metrics":
+        if path in ("/metrics", "/metrics.json"):
+            # /metrics.json is the federation scrape surface: the raw
+            # snapshot dict the router's fleet view merges per shard
             self._send_json(200, self.service.metrics_snapshot())
         elif path == "/metrics.prom":
             self._send_text(
@@ -203,6 +214,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
             if epoch is not None:
                 health["last_finalized_epoch"] = int(epoch)
+            if self.slo is not None:
+                health["slo"] = self.slo.status()
             # draining = stop routing here (503); degraded = still serving
             # from healthy endpoints, breaker detail in the body (200)
             self._send_json(503 if health["status"] == "draining" else 200, health)
@@ -252,16 +265,32 @@ class _Handler(BaseHTTPRequestHandler):
         # this request's spans under the remote dispatch span — one trace
         # covers the whole scatter-gather; without one this is a trace root
         carrier = body.get("trace")
+        # tenant accounting at admission: the sanitized label rides the
+        # request through batcher/durable-queue; bytes charge the body size
+        self._tenant = extract_tenant(body, self.headers)
+        self._active_span = None  # set for remote-carried requests (stitching)
+        if self.tenants is not None and self.path in (
+            "/v1/verify",
+            "/v1/generate",
+            "/v1/generate_range",
+        ):
+            self.tenants.account(self._tenant, getattr(self, "_body_bytes", 0))
         if self.path == "/v1/verify":
-            with adopted_span("http.verify", carrier, {"path": self.path}):
+            with adopted_span("http.verify", carrier, {"path": self.path}) as sp:
+                if carrier is not None:
+                    self._active_span = sp
                 self._handle_verify(body)
         elif self.path == "/v1/generate":
-            with adopted_span("http.generate", carrier, {"path": self.path}):
+            with adopted_span("http.generate", carrier, {"path": self.path}) as sp:
+                if carrier is not None:
+                    self._active_span = sp
                 self._handle_generate(body)
         elif self.path == "/v1/generate_range":
             with adopted_span(
                 "http.generate_range", carrier, {"path": self.path}
-            ):
+            ) as sp:
+                if carrier is not None:
+                    self._active_span = sp
                 self._handle_generate_range(body)
         elif self.path == "/v1/subscribe":
             self._handle_subscribe(body)
@@ -349,7 +378,9 @@ class _Handler(BaseHTTPRequestHandler):
             return out
 
         self._submit(
-            lambda: self.service.verify(bundle, timeout_s=timeout_s),
+            lambda: self.service.verify(
+                bundle, timeout_s=timeout_s, tenant=self._tenant
+            ),
             render,
         )
 
@@ -372,7 +403,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._submit_durable("generate", idx, body, witness=opts)
             return
         self._submit(
-            lambda: self.service.generate(self.pairs[idx], timeout_s=timeout_s),
+            lambda: self.service.generate(
+                self.pairs[idx], timeout_s=timeout_s, tenant=self._tenant
+            ),
             lambda resp: dict(
                 self._witness_fields(resp.bundle, opts),
                 n_event_proofs=resp.n_event_proofs,
@@ -487,6 +520,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": str(exc)})
         else:
             obj = render(resp)
+            self._attach_spans(obj)
             headers = {}
             timing = getattr(resp, "server_timing", None)
             if timing:
@@ -496,6 +530,18 @@ class _Handler(BaseHTTPRequestHandler):
             if "witness_encoding" in obj:
                 headers["Witness-Encoding"] = obj["witness_encoding"]
             self._send_json(200, obj, headers=headers or None)
+
+    def _attach_spans(self, obj: dict) -> None:
+        """Ship this request's span subtree in the response for sampled,
+        remote-carried traces — the router grafts it under its dispatch
+        span so one exported tree covers router → shard → workers.
+        ``spans_pid`` lets an in-process caller (LocalShard) recognize its
+        own spans and skip the graft (they are already in its ring)."""
+        sp = getattr(self, "_active_span", None)
+        if sp is None or not sp.sampled or not tracing_enabled():
+            return
+        obj["spans"] = subtree_for_response(sp)
+        obj["spans_pid"] = os.getpid()
 
     def _rewitness_result(
         self, result: dict, witness, claims, claim_indexes, gen_indexes
@@ -554,7 +600,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             key, done, cached = self.durable.submit(
                 kind, payload, idempotency_key=key,
-                timeout_s=body.get("timeout_s"),
+                timeout_s=body.get("timeout_s"), tenant=self._tenant,
             )
         except QueueFullError as exc:
             self._send_json(
@@ -575,10 +621,9 @@ class _Handler(BaseHTTPRequestHandler):
                 done = dict(done, result=result)
                 if "witness_encoding" in result:
                     headers = {"Witness-Encoding": result["witness_encoding"]}
-            self._send_json(
-                200, dict(done, idempotency_key=key, cached=cached),
-                headers=headers,
-            )
+            out = dict(done, idempotency_key=key, cached=cached)
+            self._attach_spans(out)
+            self._send_json(200, out, headers=headers)
 
 
 class ProofHTTPServer:
@@ -598,10 +643,20 @@ class ProofHTTPServer:
         pairs: Optional[Sequence[TipsetPair]] = None,
         durable=None,
         subs=None,
+        slo=None,
+        tenants=None,
     ):
         self.service = service
         self.durable = durable
         self.subs = subs
+        self.slo = slo
+        # tenant accounting is always on (bounded top-K, so it's safe);
+        # pass an explicit ledger to share one across servers or set top_k
+        self.tenants = (
+            tenants
+            if tenants is not None
+            else TenantLedger(metrics=service.metrics)
+        )
         handler = type(
             "_BoundHandler",
             (_Handler,),
@@ -610,6 +665,8 @@ class ProofHTTPServer:
                 "pairs": list(pairs or []),
                 "durable": durable,
                 "subs": subs,
+                "slo": slo,
+                "tenants": self.tenants,
             },
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -650,6 +707,8 @@ class ProofHTTPServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self.slo is not None:
+            self.slo.stop()
         if self.subs is not None:
             self.subs.drain()
         self.service.drain(timeout=timeout)

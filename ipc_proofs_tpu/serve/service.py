@@ -312,36 +312,54 @@ class ProofService:
     # --- public API --------------------------------------------------------
 
     def submit_verify(
-        self, bundle: UnifiedProofBundle, timeout_s: Optional[float] = None
+        self,
+        bundle: UnifiedProofBundle,
+        timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> PendingResult:
         """Admit one verify request; returns immediately with a pending slot.
 
         Raises `QueueFullError` / `ServiceClosedError` at admission time;
         ``.result()`` raises `DeadlineExceededError` if ``timeout_s`` passes
         before the batch containing it is processed."""
-        return self._verify_batcher.submit(bundle, timeout_s=timeout_s)
+        return self._verify_batcher.submit(
+            bundle, timeout_s=timeout_s, tenant=tenant
+        )
 
     def verify(
-        self, bundle: UnifiedProofBundle, timeout_s: Optional[float] = None
+        self,
+        bundle: UnifiedProofBundle,
+        timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> VerifyResponse:
         """Blocking verify: submit and wait for the micro-batched verdict."""
-        return self.submit_verify(bundle, timeout_s=timeout_s).result()
+        return self.submit_verify(
+            bundle, timeout_s=timeout_s, tenant=tenant
+        ).result()
 
     def submit_generate(
-        self, pair: TipsetPair, timeout_s: Optional[float] = None
+        self,
+        pair: TipsetPair,
+        timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> PendingResult:
         if self._generate_batcher is None:
             raise RuntimeError(
                 "generate path disabled: service was built without store/spec"
             )
         return self._generate_batcher.submit(
-            _GenerateRequest(pair), timeout_s=timeout_s
+            _GenerateRequest(pair), timeout_s=timeout_s, tenant=tenant
         )
 
     def generate(
-        self, pair: TipsetPair, timeout_s: Optional[float] = None
+        self,
+        pair: TipsetPair,
+        timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> GenerateResponse:
-        return self.submit_generate(pair, timeout_s=timeout_s).result()
+        return self.submit_generate(
+            pair, timeout_s=timeout_s, tenant=tenant
+        ).result()
 
     def generate_range(
         self, pairs: Sequence[TipsetPair], chunk_size: Optional[int] = None

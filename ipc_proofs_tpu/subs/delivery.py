@@ -154,6 +154,10 @@ class DeliveryLog:
         # running count of unacked entries across all subs — the gauges
         # publish on every append/ack, so this must be O(1), not a sweep
         self._pending = 0  # guarded-by: _cond
+        # idempotency key → append monotonic time, for the delivery-lag
+        # histogram; replayed deliveries have no entry (lag across a
+        # restart would be measuring downtime, not delivery)
+        self._append_ts: Dict[str, float] = {}  # guarded-by: _cond
         self.replayed = 0
         if os.path.exists(self.path):
             entries, good_offset, torn = read_journal_entries(self.path)
@@ -255,6 +259,11 @@ class DeliveryLog:
         if d is None:
             return
         self._pending -= 1
+        t0 = self._append_ts.pop(d.key, None)
+        if t0 is not None:
+            self._metrics.observe(
+                "subs.delivery_lag_ms", (time.monotonic() - t0) * 1000.0
+            )
         if cursor >= sl.base_cursor:
             sl.base_digest = d.digest
             sl.base_cursor = cursor
@@ -315,6 +324,7 @@ class DeliveryLog:
             sl.entries[cursor] = d
             sl.keys.add(key)
             self._pending += 1
+            self._append_ts[key] = time.monotonic()
             if pdigest not in self._payloads:
                 # first subscriber of this payload journals it; the other
                 # 9,999 journal a reference

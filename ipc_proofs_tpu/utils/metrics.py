@@ -34,6 +34,9 @@ __all__ = [
     "SUBS_COUNTERS",
     "VERIFY_COUNTERS",
     "WITNESS_COUNTERS",
+    "FLEET_COUNTERS",
+    "SLO_COUNTERS",
+    "TENANT_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
     "DURABILITY_GAUGES",
@@ -41,6 +44,7 @@ __all__ = [
     "CLUSTER_GAUGES",
     "SUBS_GAUGES",
     "SERVE_HISTOGRAMS",
+    "SUBS_HISTOGRAMS",
 ]
 
 # Counter vocabulary of the fault-tolerance layer (store/failover.py,
@@ -452,6 +456,51 @@ SERVE_HISTOGRAMS = (
     "serve.latency_ms.generate",
     "serve.latency_ms.verify",
     "serve.batch_size.*",  # per-batcher flushed-batch sizes
+)
+
+SUBS_HISTOGRAMS = (
+    "subs.delivery_lag_ms",  # append→ack latency of webhook/long-poll acks
+)
+
+# Fleet observability plane (obs/fleet.py): the router's federation loop
+# scraping every shard's /metrics.json and grafting shard span subtrees.
+#   fleet.scrapes        — per-shard scrape attempts by the federation loop
+#   fleet.scrape_errors  — scrapes that failed (shard dead/slow); the fleet
+#                          view keeps serving degraded and counts the gap
+#   fleet.spans_grafted  — shard-shipped spans re-rooted under the router's
+#                          scatter-gather spans (trace stitching)
+FLEET_COUNTERS = (
+    "fleet.scrapes",
+    "fleet.scrape_errors",
+    "fleet.spans_grafted",
+)
+
+# SLO burn-rate watchdog (obs/slo.py): multi-window availability/latency/
+# integrity targets evaluated from periodic metric snapshots.
+#   slo.evaluations      — watchdog sample passes (manual or timed)
+#   slo.warn_transitions — target entered `warn` (fast or slow window hot)
+#   slo.burn_transitions — target entered `burning` (both windows over page
+#                          rate, or an integrity zero-tolerance tick)
+#   slo.recoveries       — target stepped back to `ok` after the hysteresis
+#                          window of consecutive clean evaluations
+#   slo.anomalies        — anomaly signatures observed (breaker flap storm,
+#                          eviction storm, speculation-waste spike)
+SLO_COUNTERS = (
+    "slo.evaluations",
+    "slo.warn_transitions",
+    "slo.burn_transitions",
+    "slo.recoveries",
+    "slo.anomalies",
+)
+
+# Per-tenant accounting substrate (ROADMAP item 6's QoS meters against
+# these). Bounded cardinality: the first `top_k` tenants seen get their own
+# label; everyone else accumulates into the `other` overflow bucket.
+#   tenant.requests.<slot>  — admitted requests attributed to the slot
+#   tenant.bytes.<slot>     — request body bytes attributed to the slot
+TENANT_COUNTERS = (
+    "tenant.requests.*",
+    "tenant.bytes.*",
 )
 
 # Lazily-bound obs.trace.span factory: `Metrics.stage()` opens a span per

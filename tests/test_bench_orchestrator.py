@@ -205,6 +205,16 @@ _STANDING_OK = {
     "standing_generations_per_tipset": 2.0,
 }
 
+_FLEETOBS_OK = {
+    "fleetobs_overhead_pct": 1.4,
+    "fleetobs_rps_plain": 430.0,
+    "fleetobs_rps_observed": 424.0,
+    "fleetobs_stitched_spans": 16,
+    "fleetobs_scrapes": 6,
+    "fleetobs_pairs": 16,
+    "fleetobs_requests": 64,
+}
+
 _ONCHIP_OK = {
     "device_linearity_Nchip": 0.92,
     "batch_verify_speedup": 4.1,
@@ -248,6 +258,7 @@ class TestOrchestrate:
             "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
+            "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -284,6 +295,9 @@ class TestOrchestrate:
         assert out["legs"]["standing"] == "ok:cpu"
         assert out["standing_proofs_pushed_per_sec_10k"] == 5200.0
         assert out["standing_generations_per_tipset"] == 2.0
+        assert out["legs"]["fleetobs"] == "ok:cpu"
+        assert out["fleetobs_overhead_pct"] == 1.4
+        assert out["fleetobs_stitched_spans"] == 16
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -303,6 +317,7 @@ class TestOrchestrate:
             "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
+            "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -317,6 +332,7 @@ class TestOrchestrate:
             ("resilience", "cpu"), ("durability", "cpu"),
             ("observability", "cpu"), ("storage", "cpu"),
             ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
+            ("fleetobs", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -336,6 +352,7 @@ class TestOrchestrate:
             "asyncfetch": [(dict(_ASYNCFETCH_OK), "ok:cpu")],
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
+            "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -386,6 +403,7 @@ class TestOrchestrate:
             "asyncfetch": [(None, "error:cpu")],
             "cluster": [(None, "error:cpu")],
             "standing": [(None, "error:cpu")],
+            "fleetobs": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -407,6 +425,8 @@ class TestOrchestrate:
             "standing_proofs_pushed_per_sec_10k",
             "standing_delivery_lag_p50_ms", "standing_delivery_lag_p99_ms",
             "standing_generations_per_tipset",
+            "fleetobs_overhead_pct", "fleetobs_rps_plain",
+            "fleetobs_rps_observed", "fleetobs_stitched_spans",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -18,6 +18,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 from check_bench_schema import (  # noqa: E402
     check_artifact,
     cluster_gate_skip_reason,
+    fleetobs_gate_skip_reason,
     main,
     onchip_gate_skip_reason,
     speedup_gate_skip_reason,
@@ -366,3 +367,82 @@ class TestWitnessDietGate:
         main([str(path)])  # old vintages validate without --require-current
         out = capsys.readouterr().out
         assert "FAIL" not in out
+
+
+class TestFleetObsGate:
+    """fleetobs_overhead_pct ≤ 3 is enforced (require_current) whenever
+    the host has spare cores (host_cores > 2); on smaller hosts the
+    scrape/watchdog threads time-slice the request loop, so the ratio is
+    skipped with a printed reason. The ≥1-stitched-span check is
+    correctness and applies regardless of host shape; only artifacts
+    predating the leg skip everything."""
+
+    def _current(self):
+        with open(NEWEST) as fh:
+            obj = json.load(fh)
+        # gate inputs are set explicitly so the tests pin gate SEMANTICS,
+        # not the vintage or host shape of the checked-in artifact
+        obj["host_cores"] = 8
+        obj["fleetobs_overhead_pct"] = 1.2
+        obj["fleetobs_rps_plain"] = 100.0
+        obj["fleetobs_rps_observed"] = 98.8
+        obj["fleetobs_stitched_spans"] = 12
+        return obj
+
+    def test_overhead_above_three_pct_fails(self):
+        obj = self._current()
+        obj["fleetobs_overhead_pct"] = 3.5
+        assert check_artifact(obj) == []  # non-current vintages unaffected
+        problems = check_artifact(obj, require_current=True)
+        assert any("fleetobs gate" in p for p in problems), problems
+
+    def test_overhead_at_or_below_gate_passes(self):
+        obj = self._current()
+        for ovh in (3.0, 0.4, -24.0):  # observed may beat plain (noise)
+            obj["fleetobs_overhead_pct"] = ovh
+            assert not any(
+                "fleetobs gate" in p
+                for p in check_artifact(obj, require_current=True)
+            ), ovh
+
+    def test_missing_overhead_fails_current(self):
+        obj = self._current()
+        obj["fleetobs_overhead_pct"] = None
+        problems = check_artifact(obj, require_current=True)
+        assert any("fleetobs gate" in p for p in problems), problems
+
+    def test_zero_stitched_spans_fails_current(self):
+        obj = self._current()
+        obj["fleetobs_stitched_spans"] = 0
+        problems = check_artifact(obj, require_current=True)
+        assert any("fleetobs_stitched_spans=0" in p for p in problems), problems
+
+    def test_overhead_gate_skips_without_spare_cores(self):
+        obj = self._current()
+        obj["host_cores"] = 1
+        obj["fleetobs_overhead_pct"] = 19.22  # contention, not plane cost
+        reason = fleetobs_gate_skip_reason(obj)
+        assert reason is not None and "time-slice" in reason
+        problems = check_artifact(obj, require_current=True)
+        assert not any("fleetobs_overhead_pct" in p for p in problems)
+        # stitching is correctness, not perf: still enforced on 1 core
+        obj["fleetobs_stitched_spans"] = 0
+        problems = check_artifact(obj, require_current=True)
+        assert any("fleetobs_stitched_spans=0" in p for p in problems)
+
+    def test_gate_skipped_only_for_prefleet_vintages(self, tmp_path, capsys):
+        obj = self._current()
+        for key in (
+            "fleetobs_overhead_pct", "fleetobs_rps_plain",
+            "fleetobs_rps_observed", "fleetobs_stitched_spans",
+            "fleetobs_scrapes", "fleetobs_pairs", "fleetobs_requests",
+        ):
+            obj.pop(key, None)
+        reason = fleetobs_gate_skip_reason(obj)
+        assert reason is not None and "predates" in reason
+        assert not any("fleetobs gate" in p for p in check_artifact(obj))
+        path = tmp_path / "BENCH_prefleet_vintage.json"
+        path.write_text(json.dumps(obj))
+        main(["--require-current", str(path)])
+        out = capsys.readouterr().out
+        assert "fleetobs gate SKIPPED" in out

@@ -1,0 +1,559 @@
+"""Fleet observability plane tests: tenant extraction + bounded top-K
+accounting, snapshot merge math, the federated Prometheus exposition,
+fail-soft federation scrapes, the router's fleet HTTP surfaces
+(``/metrics.prom``, ``/metrics.json``, ``/v1/cluster/status``,
+``/debug/flight``), cross-process span grafting, and the end-to-end
+stitch: a sampled scatter through REAL subprocess shards collapses into
+ONE rooted span tree in the router's collector. All tier-1."""
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from ipc_proofs_tpu.cluster import (
+    ClusterRouter,
+    LocalShard,
+    RouterHTTPServer,
+    spawn_serve_shard,
+)
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.obs import disable_tracing, enable_tracing
+from ipc_proofs_tpu.obs.fleet import (
+    FleetFederation,
+    TenantLedger,
+    extract_tenant,
+    graft_spans,
+    merge_counters,
+    merge_flight_snapshots,
+    merge_gauges,
+    merge_histograms,
+    render_fleet_prometheus,
+)
+from ipc_proofs_tpu.obs.flight import get_flight_recorder
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        4, 4, 2, 0.3, signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+        base_height=61_000,
+    )
+
+
+def _spec():
+    return EventProofSpec(
+        event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR
+    )
+
+
+def _http(url, body=None, headers=None, timeout=30):
+    """(status, parsed-or-text, content_type) for one request; POSTs JSON
+    when ``body`` is given."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, headers=dict(headers or {}))
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read().decode()
+        ctype = resp.headers.get("Content-Type", "")
+        parsed = json.loads(raw) if "json" in ctype else raw
+        return resp.status, parsed, ctype
+
+
+# Strict 0.0.4 exposition check (same contract test_obs pins for the
+# single-process exposition, applied to the fleet render).
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\})?"
+    r" -?[0-9.e+-]+(\.[0-9]+)?$"
+)
+
+
+def _check_prom_text(text: str) -> "dict[str, str]":
+    types: "dict[str, str]" = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) == 4, line
+        elif line.startswith("# TYPE "):
+            _, _, family, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "summary"), line
+            assert family not in types, f"duplicate TYPE for {family}"
+            types[family] = kind
+        else:
+            assert _PROM_SAMPLE.fullmatch(line), f"malformed sample: {line!r}"
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            family = re.sub(r"_(total|sum|count)$", "", name)
+            assert name in types or family in types, f"undeclared: {line!r}"
+    return types
+
+
+# --------------------------------------------------------------------------
+# tenant extraction + bounded accounting
+# --------------------------------------------------------------------------
+
+
+class TestTenantLedger:
+    def test_body_wins_over_header(self):
+        assert extract_tenant(
+            {"tenant": "acme"}, {"X-IPC-Tenant": "other"}
+        ) == "acme"
+        assert extract_tenant({}, {"X-IPC-Tenant": "acme-2"}) == "acme-2"
+
+    def test_sanitized_and_bounded(self):
+        # label-hostile characters collapse to _, length is capped
+        assert extract_tenant({"tenant": 'a b/c"d'}, {}) == "a_b_c_d"
+        assert extract_tenant({"tenant": "x" * 200}, {}) == "x" * 64
+
+    def test_untenanted_is_none(self):
+        assert extract_tenant({}, {}) is None
+        assert extract_tenant({"tenant": ""}, {}) is None
+        assert extract_tenant({"tenant": "   "}, {}) is None
+        assert extract_tenant({"tenant": 7}, {}) is None
+        assert extract_tenant(None, None) is None
+
+    def test_top_k_overflow_pools_into_other(self):
+        m = Metrics()
+        ledger = TenantLedger(metrics=m, top_k=2)
+        assert ledger.account("a", nbytes=10) == "a"
+        assert ledger.account("b") == "b"
+        # third distinct tenant overflows; earlier tenants keep their slot
+        assert ledger.account("c", nbytes=5) == "other"
+        assert ledger.account("a") == "a"
+        assert ledger.account(None) == "other"  # anonymous also pools: K full
+        assert ledger.known() == ["a", "b"]
+        assert m.counter_value("tenant.requests.a") == 2
+        assert m.counter_value("tenant.requests.other") == 2
+        assert m.counter_value("tenant.bytes.a") == 10
+        assert m.counter_value("tenant.bytes.other") == 5
+        # zero-byte accounting must not create a bytes counter
+        assert m.counter_value("tenant.bytes.b") == 0
+
+
+# --------------------------------------------------------------------------
+# merge math
+# --------------------------------------------------------------------------
+
+
+class TestMergeMath:
+    def test_counters_and_gauges_sum(self):
+        assert merge_counters(
+            [{"a": 1, "b": 2}, {"a": 3}, None, {}]
+        ) == {"a": 4, "b": 2}
+        assert merge_gauges([{"depth": 2}, {"depth": 5}]) == {"depth": 7}
+
+    def test_histograms_weighted_mean_and_max_tail(self):
+        merged = merge_histograms(
+            [
+                {"lat": {"count": 2, "mean": 10.0, "p50": 10.0, "p99": 20.0}},
+                {"lat": {"count": 6, "mean": 30.0, "p50": 25.0, "p99": 90.0}},
+                {"lat": {"count": 0, "mean": 999.0, "p99": 999.0}},  # empty: skipped
+            ]
+        )
+        assert merged["lat"]["count"] == 8
+        assert merged["lat"]["mean"] == pytest.approx((10 * 2 + 30 * 6) / 8)
+        # conservative fleet tail: the max across members
+        assert merged["lat"]["p50"] == 25.0
+        assert merged["lat"]["p99"] == 90.0
+
+    def test_all_empty_histograms_vanish(self):
+        assert merge_histograms([{"lat": {"count": 0, "mean": 1.0}}]) == {}
+
+
+# --------------------------------------------------------------------------
+# fleet prometheus exposition
+# --------------------------------------------------------------------------
+
+
+def _snap(counters=None, gauges=None, hists=None, uptime=1.0):
+    out = {"counters": dict(counters or {}), "uptime_s": uptime}
+    if gauges:
+        out["gauges"] = dict(gauges)
+    if hists:
+        out["histograms"] = dict(hists)
+    return out
+
+
+class TestFleetPrometheus:
+    def test_shard_labels_and_fleet_aggregates(self):
+        text = render_fleet_prometheus(
+            {
+                "s0": _snap(
+                    {"serve.requests": 3},
+                    gauges={"serve.queue_depth.http": 2},
+                    hists={"latency_ms": {"count": 2, "mean": 10.0,
+                                          "p50": 10.0, "p99": 20.0}},
+                ),
+                "s1": _snap(
+                    {"serve.requests": 5},
+                    gauges={"serve.queue_depth.http": 1},
+                    hists={"latency_ms": {"count": 2, "mean": 20.0,
+                                          "p50": 18.0, "p99": 40.0}},
+                ),
+            },
+            router_snap=_snap({"cluster.requests": 4}),
+        )
+        types = _check_prom_text(text)
+        assert types["ipc_serve_requests_total"] == "counter"
+        assert types["ipc_uptime_seconds"] == "gauge"
+        assert types["ipc_latency_ms"] == "summary"
+        assert 'ipc_serve_requests_total{shard="s0"} 3' in text
+        assert 'ipc_serve_requests_total{shard="s1"} 5' in text
+        assert 'ipc_serve_requests_total{shard="fleet"} 8' in text
+        assert 'ipc_cluster_requests_total{shard="router"} 4' in text
+        assert 'ipc_cluster_requests_total{shard="fleet"} 4' in text
+        assert 'ipc_serve_queue_depth_http{shard="fleet"} 3' in text
+        # merged fleet summary: max tail, count-weighted _sum, summed count
+        assert 'ipc_latency_ms{shard="fleet",quantile="0.99"} 40' in text
+        assert 'ipc_latency_ms_sum{shard="fleet"} 60' in text
+        assert 'ipc_latency_ms_count{shard="fleet"} 4' in text
+
+    def test_dead_shard_drops_out_but_fleet_serves(self):
+        text = render_fleet_prometheus(
+            {"s0": _snap({"serve.requests": 3}), "s1": None}
+        )
+        _check_prom_text(text)
+        assert 'shard="s0"' in text
+        assert 'shard="s1"' not in text
+        assert 'ipc_serve_requests_total{shard="fleet"} 3' in text
+
+
+# --------------------------------------------------------------------------
+# federation scrape loop (injected fetch: no sockets)
+# --------------------------------------------------------------------------
+
+
+class _FakeShardNet:
+    """In-memory shard fleet for FleetFederation's ``fetch`` hook."""
+
+    def __init__(self):
+        self.calls = []
+        self.requests = 2
+
+    def fetch(self, url, timeout_s):
+        self.calls.append(url)
+        if "dead" in url:
+            raise OSError("connection refused")
+        if url.endswith("/metrics.json"):
+            return _snap({"serve.requests": self.requests})
+        return {"status": "ok"}
+
+
+class TestFleetFederation:
+    def test_scrape_is_fail_soft_per_shard(self):
+        net = _FakeShardNet()
+        m = Metrics()
+        urls = {"s0": "http://h/s0", "s1": "http://dead:1"}
+        fed = FleetFederation(
+            lambda: urls, metrics=m, interval_s=60.0, fetch=net.fetch
+        )
+        result = fed.scrape()
+        good = result["shards"]["s0"]
+        assert good["error"] is None
+        assert good["metrics"]["counters"]["serve.requests"] == 2
+        assert good["healthz"]["status"] == "ok"
+        bad = result["shards"]["s1"]
+        assert bad["metrics"] is None and bad["error"]
+        assert m.counter_value("fleet.scrapes") == 2
+        assert m.counter_value("fleet.scrape_errors") == 1
+
+    def test_latest_caches_until_rescraped(self):
+        net = _FakeShardNet()
+        fed = FleetFederation(
+            lambda: {"s0": "http://h/s0"},
+            metrics=Metrics(), interval_s=60.0, fetch=net.fetch,
+        )
+        first = fed.latest()  # no cache yet: pull-through scrape
+        n_calls = len(net.calls)
+        assert fed.latest() is first  # cached, no new fetches
+        assert len(net.calls) == n_calls
+        net.requests = 9
+        fed.scrape()
+        assert (
+            fed.latest()["shards"]["s0"]["metrics"]["counters"]["serve.requests"]
+            == 9
+        )
+
+    def test_scrape_thread_lifecycle(self):
+        net = _FakeShardNet()
+        fed = FleetFederation(
+            lambda: {"s0": "http://h/s0"},
+            metrics=Metrics(), interval_s=0.01, fetch=net.fetch,
+        )
+        fed.start()
+        fed.start()  # idempotent
+        deadline = time.time() + 5.0
+        while not net.calls and time.time() < deadline:
+            time.sleep(0.01)
+        fed.stop()
+        assert net.calls, "scrape loop never ran"
+        assert fed._thread is None
+
+
+# --------------------------------------------------------------------------
+# router fleet surfaces over real LocalShards + HTTP
+# --------------------------------------------------------------------------
+
+
+class TestRouterFleetSurfaces:
+    @pytest.fixture(scope="class")
+    def fleet(self, world):
+        store, pairs, _ = world
+        shards = [
+            LocalShard(f"s{i}", store, pairs, _spec()).start()
+            for i in range(2)
+        ]
+        router = ClusterRouter(
+            {s.name: s.url for s in shards}, pairs,
+            scrape_interval_s=60.0, scrape_timeout_s=5.0,
+        )
+        server = RouterHTTPServer(router).start()
+        yield server.address, router, shards
+        server.shutdown(timeout=10)
+        for s in shards:
+            try:
+                s.stop(timeout=10)
+            except Exception:
+                pass
+
+    def test_tenant_accounting_front_door_and_forwarded(self, fleet):
+        base, router, shards = fleet
+        st, obj, _ = _http(
+            base + "/v1/generate", {"pair_index": 0, "tenant": "acme corp!"}
+        )
+        assert st == 200, obj
+        st, obj, _ = _http(
+            base + "/v1/generate", {"pair_index": 1},
+            headers={"X-IPC-Tenant": "beta"},
+        )
+        assert st == 200, obj
+        st, obj, _ = _http(base + "/v1/generate", {"pair_index": 2})
+        assert st == 200, obj
+        # front door: sanitized body tenant, header fallback, anonymous
+        assert router.metrics.counter_value("tenant.requests.acme_corp_") == 1
+        assert router.metrics.counter_value("tenant.requests.beta") == 1
+        assert router.metrics.counter_value("tenant.requests.anonymous") >= 1
+        assert router.metrics.counter_value("tenant.bytes.acme_corp_") > 0
+        # forwarded: the owning shard accounted the SAME sanitized slot
+        shard_counters = merge_counters(
+            _http(s.url + "/metrics.json")[1].get("counters", {})
+            for s in shards
+        )
+        assert shard_counters.get("tenant.requests.acme_corp_", 0) == 1
+        assert shard_counters.get("tenant.requests.beta", 0) == 1
+
+    def test_metrics_json_surface(self, fleet):
+        base, _router, _shards = fleet
+        st, snap, _ = _http(base + "/metrics.json")
+        assert st == 200
+        assert snap["counters"]["cluster.requests"] >= 1
+        # the legacy route stays aliased
+        st, snap2, _ = _http(base + "/metrics")
+        assert st == 200 and "counters" in snap2
+
+    def test_metrics_prom_surface(self, fleet):
+        base, _router, _shards = fleet
+        st, text, ctype = _http(base + "/metrics.prom")
+        assert st == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        _check_prom_text(text)
+        for label in ('shard="s0"', 'shard="s1"', 'shard="router"',
+                      'shard="fleet"'):
+            assert label in text, f"missing {label}"
+        assert 'ipc_serve_accepted_generate_total{shard="fleet"}' in text
+
+    def test_cluster_status_surface(self, fleet):
+        base, _router, _shards = fleet
+        st, obj, _ = _http(base + "/v1/cluster/status")
+        assert st == 200
+        assert set(obj["ring"]) == {"s0", "s1"}
+        assert all(e["alive"] for e in obj["ring"].values())
+        assert set(obj["shards"]) == {"s0", "s1"}
+        for entry in obj["shards"].values():
+            assert entry["status"] == "ok"
+            assert entry["scrape_error"] is None
+        assert obj["router"]["requests"] >= 1
+        assert isinstance(obj["delivery_backlog"], int)
+        assert isinstance(obj["store_disk_bytes"], int)
+        assert "last_finalized_epoch" in obj
+
+    def test_debug_flight_surface(self, fleet):
+        base, _router, _shards = fleet
+        st, obj, _ = _http(base + "/debug/flight")
+        assert st == 200
+        assert obj["shards"] == ["s0", "s1"]
+        assert obj["failed"] == []
+        assert obj["spans"], "fleet flight view has no spans"
+        assert all("shard" in sp for sp in obj["spans"])
+        walls = [sp.get("wall_ts", 0.0) for sp in obj["spans"]]
+        assert walls == sorted(walls, reverse=True)  # newest-first
+
+    def test_fleet_keeps_serving_when_a_shard_dies(self, fleet):
+        # LAST in the class: kills s1 for everyone after it.
+        base, router, shards = fleet
+        shards[1].kill()
+        result = router.federation.scrape()
+        assert result["shards"]["s1"]["error"]
+        assert result["shards"]["s1"]["metrics"] is None
+        assert router.metrics.counter_value("fleet.scrape_errors") >= 1
+        st, text, _ = _http(base + "/metrics.prom")
+        assert st == 200
+        _check_prom_text(text)
+        assert 'shard="s0"' in text  # degraded, still a fleet view
+        st, obj, _ = _http(base + "/v1/cluster/status")
+        assert st == 200
+        assert obj["shards"]["s1"]["status"] == "unreachable"
+        assert obj["shards"]["s1"]["scrape_error"]
+        assert obj["shards"]["s0"]["status"] == "ok"
+
+
+# --------------------------------------------------------------------------
+# cross-process span grafting
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _clean_flight_ring():
+    get_flight_recorder().clear()
+    yield
+    get_flight_recorder().clear()
+
+
+class TestGraftSpans:
+    def test_remap_rebase_and_graft_point(self, _clean_flight_ring):
+        m = Metrics()
+        collector = enable_tracing(metrics=m)
+        try:
+            shipped = [
+                {"name": "http.generate", "trace_id": "t9", "span_id": "1",
+                 "parent_id": "77",  # router-side id: NOT in the set
+                 "ts_us": 5, "dur_us": 10, "wall_ts": 1000.0,
+                 "thread": "srv", "attrs": {"pair": 3}},
+                {"name": "serve.generate", "trace_id": "t9", "span_id": "2",
+                 "parent_id": "1", "ts_us": 6, "dur_us": 5,
+                 "wall_ts": 1000.1, "thread": "wkr"},
+                "not-a-dict",
+                {"trace_id": "t9", "span_id": "9"},  # no name: skipped
+            ]
+            assert graft_spans(shipped, "s0", metrics=m) == 2
+            spans = {s.span_id: s for s in collector.snapshot()}
+            assert set(spans) == {"s0:1", "s0:2"}
+            # the out-of-set parent is the graft point, kept verbatim;
+            # the in-set parent follows its child into the namespace
+            assert spans["s0:1"].parent_id == "77"
+            assert spans["s0:2"].parent_id == "s0:1"
+            assert spans["s0:1"].attrs == {"pair": 3, "shard": "s0"}
+            assert spans["s0:1"].thread_name == "s0/srv"
+            assert spans["s0:1"].dur_us == 10
+            assert all(s.sampled for s in spans.values())
+            assert m.counter_value("fleet.spans_grafted") == 2
+        finally:
+            disable_tracing()
+
+    def test_router_skips_same_pid_subtrees(self, world, _clean_flight_ring):
+        """A LocalShard lives in the router's process: its spans are
+        already on the spine, so grafting its shipped subtree would
+        double-record every span."""
+        _, pairs, _ = world
+        router = ClusterRouter({"s0": "http://127.0.0.1:9"}, pairs)
+        collector = enable_tracing(metrics=Metrics())
+        try:
+            ship = {"name": "http.generate", "trace_id": "t1", "span_id": "4",
+                    "parent_id": "", "ts_us": 0, "dur_us": 1, "wall_ts": 1.0,
+                    "thread": "srv"}
+            same = {"ok": 1, "spans": [dict(ship)], "spans_pid": os.getpid()}
+            router._graft_shard_spans("s0", same)
+            assert "spans" not in same and "spans_pid" not in same  # stripped
+            assert collector.snapshot() == []
+            other = {"ok": 1, "spans": [dict(ship)],
+                     "spans_pid": os.getpid() + 1}
+            router._graft_shard_spans("s0", other)
+            assert [s.span_id for s in collector.snapshot()] == ["s0:4"]
+        finally:
+            disable_tracing()
+            router.close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end stitch: subprocess shards → one rooted tree
+# --------------------------------------------------------------------------
+
+
+class TestEndToEndStitch:
+    def test_sampled_scatter_collapses_into_one_rooted_tree(self):
+        """The distributed-tracing law: a sampled ``generate_range``
+        through REAL serve children ships each shard's span subtree back
+        in-band, and the router grafts every one under its scatter spans
+        — the collector holds exactly ONE rooted tree, no orphans."""
+        n_pairs, receipts, match_rate = 4, 4, 0.5
+        _store, pairs, _ = build_range_world(
+            n_pairs, receipts_per_pair=receipts, match_rate=match_rate,
+            signature=SIG, topic1=SUBNET,
+        )
+        m = Metrics()
+        collector = enable_tracing(metrics=m)
+        shards = []
+        try:
+            shards = [
+                spawn_serve_shard(
+                    f"s{k}", n_pairs, SIG, SUBNET,
+                    extra_args=[
+                        "--demo-receipts", str(receipts),
+                        "--demo-match-rate", str(match_rate),
+                        "--trace-out", os.devnull,
+                        "--trace-sample", "1.0",
+                    ],
+                )
+                for k in range(2)
+            ]
+            router = ClusterRouter(
+                {s.name: s.url for s in shards}, pairs, metrics=m
+            )
+            try:
+                status, obj = router.generate_range(
+                    list(range(n_pairs)), chunk_size=2
+                )
+                assert status == 200, obj
+                trace_id = obj["trace_id"]
+                spans = [
+                    s for s in collector.snapshot()
+                    if s.trace_id == trace_id
+                ]
+                ids = {s.span_id for s in spans}
+                roots = [
+                    s for s in spans
+                    if not s.parent_id or s.parent_id not in ids
+                ]
+                assert len(roots) == 1, sorted(
+                    (s.name, s.span_id, s.parent_id) for s in roots
+                )
+                assert roots[0].name == "cluster.generate_range"
+                grafted = [s for s in spans if ":" in s.span_id]
+                assert grafted, "no shard subtrees were grafted"
+                assert {s.span_id.split(":", 1)[0] for s in grafted} <= {
+                    "s0", "s1"
+                }
+                assert {s.attrs.get("shard") for s in grafted} <= {"s0", "s1"}
+                assert any(s.name == "http.generate_range" for s in grafted)
+                assert m.counter_value("fleet.spans_grafted") >= len(grafted)
+            finally:
+                router.close()
+        finally:
+            disable_tracing()
+            for s in shards:
+                try:
+                    s.stop(timeout_s=20.0)
+                except Exception:
+                    s.kill()

@@ -333,6 +333,10 @@ class TestPrometheus:
         assert 'ipc_stage_calls_total{stage="verify"} 1' in text
         assert types["ipc_latency_ms"] == "summary"
         assert 'quantile="0.99"' in text
+        # summary aggregation contract: _sum reconstructs from mean×count,
+        # _count is the observation count — pinned so dashboards can rate()
+        assert "ipc_latency_ms_sum 14.5" in text
+        assert "ipc_latency_ms_count 2" in text
 
     def test_label_escaping(self):
         m = Metrics()
@@ -643,6 +647,71 @@ class TestTraceview:
         assert summary["stages"]["stage_b"]["total_us"] == max(
             1, spans["stage_b"]
         )
+
+    def test_stitch_merges_captures_into_one_tree(self, tmp_path, capsys):
+        """Golden: router + two shard captures of one scatter — span ids
+        collide across processes (both counters start at 1), yet the
+        stitched result is ONE rooted tree with zero orphans."""
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            from traceview import load_events, main, stitch, summarize
+        finally:
+            sys.path.pop(0)
+
+        def ev(name, ts, dur, sid, parent, tid="t1"):
+            return {
+                "ph": "X", "name": name, "cat": "span", "ts": ts, "dur": dur,
+                "args": {"trace_id": tid, "span_id": sid, "parent_id": parent},
+            }
+
+        router = [
+            ev("cluster.generate_range", 0, 1000, "1", None),
+            ev("cluster.dispatch", 10, 400, "2", "1"),
+            ev("cluster.dispatch", 10, 500, "3", "1"),
+        ]
+        # each shard's http span adopted the router's root id "1" as its
+        # wire parent — which collides with the shard's OWN first span id
+        shard0 = [
+            ev("http.generate_range", 20, 300, "1", "1"),
+            ev("serve.generate_range", 30, 250, "2", "1"),
+        ]
+        shard1 = [
+            ev("http.generate_range", 20, 380, "1", "1"),
+            ev("serve.generate_range", 30, 320, "2", "1"),
+        ]
+        paths = []
+        for i, events in enumerate((router, shard0, shard1)):
+            p = tmp_path / f"cap{i}.json"
+            p.write_text(json.dumps({"traceEvents": events}))
+            paths.append(str(p))
+
+        merged = stitch([load_events(p) for p in paths])
+        assert len(merged) == 7
+        ids = {e["args"]["span_id"] for e in merged}
+        orphans = [
+            e for e in merged
+            if e["args"]["parent_id"] is not None
+            and e["args"]["parent_id"] not in ids
+        ]
+        assert not orphans
+        roots = [e for e in merged if e["args"]["parent_id"] is None]
+        assert [e["name"] for e in roots] == ["cluster.generate_range"]
+        # the adopted spans grafted onto the ROUTER's root, not themselves
+        for e in merged:
+            if e["name"] == "http.generate_range":
+                assert e["args"]["parent_id"] == "f0:1"
+            assert e["args"]["span_id"] != e["args"]["parent_id"]
+
+        # the CLI round-trips: --stitch --out writes a loadable merged file
+        out = tmp_path / "fleet.json"
+        assert main(["--stitch", *paths, "--out", str(out), "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["n_events"] == 7 and summary["n_traces"] == 1
+        assert summary["traces"][0]["root"] == "cluster.generate_range"
+        restitched = summarize(load_events(str(out)))
+        assert restitched["traces"][0]["spans"] == 7
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,358 @@
+"""SLO burn-rate watchdog: deterministic burn grids under an injected
+clock — no sleeps, no threads (except the lifecycle test), no network.
+
+The grid tests drive `SloWatchdog.sample()` by hand: tick counters on a
+private `Metrics`, advance the fake clock, and assert the ok → warn →
+burning ladder, the hysteretic recovery, the zero-tolerance integrity
+target, and the anomaly signatures — exactly the transitions the serving
+daemon's `/healthz` ``slo`` block surfaces.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from ipc_proofs_tpu.obs.flight import get_flight_recorder
+from ipc_proofs_tpu.obs.slo import SloTarget, SloWatchdog, default_targets
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _watchdog(metrics, clock, **kw):
+    kw.setdefault("fast_window_s", 300.0)
+    kw.setdefault("slow_window_s", 3600.0)
+    return SloWatchdog(
+        metrics=metrics, clock=clock, recovery_samples=3, **kw
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_ring():
+    get_flight_recorder().clear()
+    yield
+    get_flight_recorder().clear()
+
+
+# --------------------------------------------------------------------------
+# ratio target: the ok → warn → burning grid
+# --------------------------------------------------------------------------
+
+
+class TestRatioBurnGrid:
+    def _availability(self):
+        return SloTarget(
+            name="availability",
+            kind="ratio",
+            objective=0.999,  # 0.1 % error budget
+            bad=("serve.rejected_full.*",),
+            total=("serve.accepted.*", "serve.rejected_full.*"),
+        )
+
+    def test_all_good_stays_ok(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[self._availability()])
+        for _ in range(5):
+            m.count("serve.accepted.verify", 100)
+            status = dog.sample(clock.advance(10))
+        assert status["status"] == "ok"
+        assert status["targets"]["availability"]["fast_burn"] == 0.0
+        assert m.counter_value("slo.evaluations") == 5
+
+    def test_moderate_errors_warn(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[self._availability()])
+        dog.sample(clock.t)  # baseline
+        # 0.5 % bad over a 0.1 % budget → burn 5× in both windows:
+        # fast ≥ warn(2) but < page(10) → warn
+        m.count("serve.accepted.verify", 995)
+        m.count("serve.rejected_full.verify", 5)
+        status = dog.sample(clock.advance(10))
+        target = status["targets"]["availability"]
+        assert target["state"] == "warn"
+        assert target["fast_burn"] == pytest.approx(5.0, rel=1e-3)
+        assert status["status"] == "warn"
+        assert m.counter_value("slo.warn_transitions") == 1
+
+    def test_sharp_sustained_errors_burn(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[self._availability()])
+        dog.sample(clock.t)
+        # 5 % bad → burn 50×: fast ≥ page AND slow ≥ warn → burning
+        m.count("serve.accepted.verify", 950)
+        m.count("serve.rejected_full.verify", 50)
+        status = dog.sample(clock.advance(10))
+        assert status["targets"]["availability"]["state"] == "burning"
+        assert m.counter_value("slo.burn_transitions") == 1
+        # escalation leaves a WARNING in the flight ring
+        logs = get_flight_recorder().snapshot()["logs"]
+        assert any(
+            "availability -> burning" in e["msg"] and e["level"] == "WARNING"
+            for e in logs
+        )
+
+    def test_single_sample_window_burns_zero(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[self._availability()])
+        m.count("serve.rejected_full.verify", 1000)  # before ANY baseline
+        status = dog.sample(clock.t)
+        # one sample = no delta = no verdict; never fires off the bat
+        assert status["status"] == "ok"
+
+    def test_recovery_is_hysteretic(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[self._availability()],
+                        fast_window_s=30.0, slow_window_s=60.0)
+        dog.sample(clock.t)
+        m.count("serve.accepted.verify", 950)
+        m.count("serve.rejected_full.verify", 50)
+        assert (
+            dog.sample(clock.advance(10))["targets"]["availability"]["state"]
+            == "burning"
+        )
+        # quiet evals AFTER the bad delta ages out of both windows:
+        # two are not enough (recovery_samples=3)…
+        for _ in range(2):
+            m.count("serve.accepted.verify", 100)
+            status = dog.sample(clock.advance(40))
+            assert status["targets"]["availability"]["state"] == "burning"
+        # …the third closes the loop, straight back to ok
+        m.count("serve.accepted.verify", 100)
+        status = dog.sample(clock.advance(40))
+        assert status["targets"]["availability"]["state"] == "ok"
+        assert m.counter_value("slo.recoveries") == 1
+
+    def test_flap_resets_recovery_streak(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[self._availability()],
+                        fast_window_s=30.0, slow_window_s=60.0)
+        dog.sample(clock.t)
+        m.count("serve.accepted.verify", 950)
+        m.count("serve.rejected_full.verify", 50)
+        dog.sample(clock.advance(10))
+        # two quiet evals…
+        for _ in range(2):
+            m.count("serve.accepted.verify", 100)
+            dog.sample(clock.advance(40))
+        # …then the signal flaps back: the streak must reset
+        m.count("serve.accepted.verify", 950)
+        m.count("serve.rejected_full.verify", 50)
+        assert (
+            dog.sample(clock.advance(10))["targets"]["availability"]["state"]
+            == "burning"
+        )
+        for _ in range(2):
+            m.count("serve.accepted.verify", 100)
+            status = dog.sample(clock.advance(40))
+            assert status["targets"]["availability"]["state"] == "burning"
+
+
+# --------------------------------------------------------------------------
+# quantile + zero-tolerance targets
+# --------------------------------------------------------------------------
+
+
+class TestQuantileAndZeroTargets:
+    def test_p99_breach_warns(self):
+        m, clock = Metrics(), FakeClock()
+        target = SloTarget(
+            name="generate_p99", kind="quantile", objective=0.99,
+            hist="serve.latency_ms.generate", quantile="p99", limit_ms=100.0,
+        )
+        dog = _watchdog(m, clock, targets=[target])
+        dog.sample(clock.t)
+        # bulk fast, tail slow: p99 over the limit, p50/p90 under →
+        # conservative 2 % bad over a 1 % budget = burn 2.0 → warn
+        for _ in range(100):
+            m.observe("serve.latency_ms.generate", 10.0)
+        for _ in range(2):
+            m.observe("serve.latency_ms.generate", 500.0)
+        status = dog.sample(clock.advance(10))
+        tgt = status["targets"]["generate_p99"]
+        assert tgt["state"] == "warn"
+        assert tgt["fast_burn"] == pytest.approx(2.0)
+
+    def test_median_breach_burns(self):
+        m, clock = Metrics(), FakeClock()
+        target = SloTarget(
+            name="generate_p99", kind="quantile", objective=0.99,
+            hist="serve.latency_ms.generate", quantile="p99", limit_ms=100.0,
+        )
+        dog = _watchdog(m, clock, targets=[target])
+        dog.sample(clock.t)
+        for _ in range(50):
+            m.observe("serve.latency_ms.generate", 500.0)
+        status = dog.sample(clock.advance(10))
+        # p50 over the limit → ≥ 50 % bad → burn 50× → page
+        assert status["targets"]["generate_p99"]["state"] == "burning"
+
+    def test_quantile_needs_new_observations(self):
+        m, clock = Metrics(), FakeClock()
+        target = SloTarget(
+            name="generate_p99", kind="quantile", objective=0.99,
+            hist="serve.latency_ms.generate", quantile="p99", limit_ms=100.0,
+        )
+        dog = _watchdog(m, clock, targets=[target])
+        for _ in range(50):
+            m.observe("serve.latency_ms.generate", 500.0)
+        dog.sample(clock.t)
+        # the breach predates the window's oldest sample; with NO new
+        # observations between samples the count delta is zero → no burn
+        status = dog.sample(clock.advance(10))
+        assert status["targets"]["generate_p99"]["state"] == "ok"
+
+    def test_integrity_zero_tolerance_first_tick_burns(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=list(default_targets()))
+        dog.sample(clock.t)
+        assert dog.status()["targets"]["integrity"]["state"] == "ok"
+        m.count("rpc.integrity_failures")  # ONE tick
+        status = dog.sample(clock.advance(5))
+        assert status["targets"]["integrity"]["state"] == "burning"
+        assert status["status"] == "burning"
+        assert m.counter_value("slo.burn_transitions") == 1
+
+    def test_integrity_recovers_after_window_drains(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=list(default_targets()),
+                        fast_window_s=30.0, slow_window_s=60.0)
+        dog.sample(clock.t)
+        m.count("storex.integrity_evictions")
+        assert (
+            dog.sample(clock.advance(5))["targets"]["integrity"]["state"]
+            == "burning"
+        )
+        for _ in range(2):
+            assert (
+                dog.sample(clock.advance(40))["targets"]["integrity"]["state"]
+                == "burning"
+            )
+        assert (
+            dog.sample(clock.advance(40))["targets"]["integrity"]["state"]
+            == "ok"
+        )
+
+
+# --------------------------------------------------------------------------
+# anomaly signatures
+# --------------------------------------------------------------------------
+
+
+class TestAnomalies:
+    def test_breaker_flap_storm_fires_once_per_onset(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[])
+        dog.sample(clock.t)
+        m.count("failover.breaker_open", 5)
+        status = dog.sample(clock.advance(10))
+        assert status["anomalies"] == ["breaker_flap_storm"]
+        assert m.counter_value("slo.anomalies") == 1
+        # still active next eval, but the onset counted only once
+        status = dog.sample(clock.advance(10))
+        assert status["anomalies"] == ["breaker_flap_storm"]
+        assert m.counter_value("slo.anomalies") == 1
+        logs = get_flight_recorder().snapshot()["logs"]
+        assert sum("breaker_flap_storm" in e["msg"] for e in logs) == 1
+
+    def test_anomaly_clears_when_window_drains(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[], fast_window_s=30.0)
+        dog.sample(clock.t)
+        m.count("storex.evictions", 150)
+        assert dog.sample(clock.advance(10))["anomalies"] == ["eviction_storm"]
+        assert dog.sample(clock.advance(60))["anomalies"] == []
+
+    def test_speculation_waste_needs_volume(self):
+        m, clock = Metrics(), FakeClock()
+        dog = _watchdog(m, clock, targets=[])
+        dog.sample(clock.t)
+        # 100 % waste but below the minimum want volume: not a spike
+        m.count("fetch.speculative_wants", 5)
+        m.count("fetch.speculative_wasted", 5)
+        assert dog.sample(clock.advance(10))["anomalies"] == []
+        m.count("fetch.speculative_wants", 40)
+        m.count("fetch.speculative_wasted", 38)
+        assert dog.sample(clock.advance(10))["anomalies"] == [
+            "speculation_waste_spike"
+        ]
+
+
+# --------------------------------------------------------------------------
+# lifecycle + healthz surface
+# --------------------------------------------------------------------------
+
+
+class TestLifecycleAndHealthz:
+    def test_daemon_thread_samples_and_stops(self):
+        m = Metrics()
+        dog = SloWatchdog(metrics=m, targets=list(default_targets()),
+                          interval_s=0.02)
+        dog.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while (
+                m.counter_value("slo.evaluations") < 2
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert m.counter_value("slo.evaluations") >= 2
+        finally:
+            dog.stop()
+        assert dog._thread is None  # joined; the leak sentinel agrees
+
+    def test_healthz_carries_slo_block(self):
+        from ipc_proofs_tpu.fixtures import build_range_world
+        from ipc_proofs_tpu.proofs.generator import EventProofSpec
+        from ipc_proofs_tpu.proofs.trust import TrustPolicy
+        from ipc_proofs_tpu.serve import (
+            ProofHTTPServer,
+            ProofService,
+            ServiceConfig,
+        )
+
+        sig, topic1 = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1"
+        store, pairs, _ = build_range_world(2, signature=sig, topic1=topic1)
+        metrics = Metrics()
+        svc = ProofService(
+            store=store,
+            spec=EventProofSpec(event_signature=sig, topic_1=topic1),
+            trust_policy=TrustPolicy.accept_all(),
+            config=ServiceConfig(max_batch=4, workers=1),
+            metrics=metrics,
+        )
+        clock = FakeClock()
+        dog = SloWatchdog(metrics=metrics, targets=list(default_targets()),
+                          clock=clock)
+        dog.sample(clock.t)
+        m2 = metrics
+        m2.count("rpc.integrity_failures")
+        dog.sample(clock.advance(5))
+        httpd = ProofHTTPServer(svc, port=0, pairs=pairs, slo=dog).start()
+        try:
+            with urllib.request.urlopen(
+                f"{httpd.address}/healthz", timeout=10
+            ) as resp:
+                health = json.load(resp)
+            assert health["slo"]["status"] == "burning"
+            assert health["slo"]["targets"]["integrity"]["state"] == "burning"
+            assert set(health["slo"]["targets"]) == {
+                "availability", "generate_p99", "delivery_lag_p99", "integrity",
+            }
+        finally:
+            httpd.shutdown(timeout=10)
+        # ProofHTTPServer.shutdown stops an attached watchdog
+        assert dog._thread is None

@@ -46,12 +46,14 @@ LOCKDEP_TEST_FILES = (
     "tests/test_cluster.py",
     "tests/test_crash_recovery.py",
     "tests/test_fetchplane.py",
+    "tests/test_fleet.py",
     "tests/test_jobs.py",
     "tests/test_lockdep.py",
     "tests/test_parallel.py",
     "tests/test_range_pipeline.py",
     "tests/test_serve.py",
     "tests/test_serve_durable.py",
+    "tests/test_slo.py",
     "tests/test_store.py",
     "tests/test_storex.py",
     "tests/test_subs.py",

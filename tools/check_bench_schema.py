@@ -18,7 +18,11 @@ flags. Two strictness levels:
   (single-device hosts skip with a printed reason — see
   `onchip_gate_skip_reason`), plus the host-shape-independent standing
   amortization gate ``standing_generations_per_tipset <=
-  standing_distinct_filters`` (see `standing_gate_skip_reason`).
+  standing_distinct_filters`` (see `standing_gate_skip_reason`) and the
+  fleet-observability overhead gate ``fleetobs_overhead_pct <= 3``
+  whenever ``host_cores > 2`` (the scrape/watchdog threads time-slice
+  the request loop otherwise — see `fleetobs_gate_skip_reason`; the
+  companion span-stitching check IS host-shape independent).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -147,6 +151,13 @@ _KNOWN_TYPES = {
     "standing_tipsets": int,
     "standing_distinct_filters": int,
     "standing_generations_per_tipset": _NUM,
+    "fleetobs_overhead_pct": _NUM,
+    "fleetobs_rps_plain": _NUM,
+    "fleetobs_rps_observed": _NUM,
+    "fleetobs_stitched_spans": int,
+    "fleetobs_scrapes": int,
+    "fleetobs_pairs": int,
+    "fleetobs_requests": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -184,6 +195,8 @@ _CURRENT_REQUIRED = (
     "standing_delivery_lag_p50_ms", "standing_delivery_lag_p99_ms",
     "standing_subscriptions", "standing_tipsets",
     "standing_distinct_filters", "standing_generations_per_tipset",
+    "fleetobs_overhead_pct", "fleetobs_rps_plain", "fleetobs_rps_observed",
+    "fleetobs_stitched_spans",
     "legs", "watchdog_fallback",
 )
 
@@ -391,6 +404,42 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     ">= 1.0 — a consecutive-epoch delta must be strictly "
                     "smaller than re-shipping the full bundle"
                 )
+        # the fleet-observability gate: the whole observability plane
+        # (federated scraping, SLO watchdog, tenant accounting, sampled
+        # trace shipping) must cost ≤ 3% of router throughput. The ratio
+        # needs spare cores — on ≤2-core hosts the scrape and watchdog
+        # threads time-slice the request loop, so the measurement is core
+        # contention, not the plane's cost.
+        if fleetobs_gate_skip_reason(obj) is None:
+            ovh = obj.get("fleetobs_overhead_pct")
+            if not isinstance(ovh, _NUM) or isinstance(ovh, bool):
+                problems.append(
+                    f"fleetobs gate: fleetobs_overhead_pct is {ovh!r} "
+                    "(fleetobs leg did not run?)"
+                )
+            elif ovh > 3.0:
+                problems.append(
+                    f"fleetobs gate: fleetobs_overhead_pct={ovh} > 3.0 — "
+                    "the fleet observability plane must cost at most 3% "
+                    "of router throughput"
+                )
+        # span stitching is correctness, not perf (measured outside the
+        # timed window at sample=1.0): enforced on every artifact carrying
+        # the fleetobs keys regardless of host shape.
+        if (
+            "fleetobs_overhead_pct" in obj
+            or "fleetobs_stitched_spans" in obj
+        ):
+            stitched = obj.get("fleetobs_stitched_spans")
+            if (
+                isinstance(stitched, _NUM) and not isinstance(stitched, bool)
+                and stitched < 1
+            ):
+                problems.append(
+                    f"fleetobs gate: fleetobs_stitched_spans={stitched} "
+                    "< 1 — a fully-sampled scatter must graft shard span "
+                    "subtrees into the router's trace"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -497,6 +546,30 @@ def witnessdiet_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def fleetobs_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the ≤3% fleet-observability overhead gate does NOT apply (None
+    when it does). Measuring the ratio needs spare cores: on ≤2-core
+    hosts the federation scrape and SLO watchdog threads time-slice the
+    request loop's only cores, so the observed/plain delta reflects core
+    contention, not the plane's cost. The companion span-stitching check
+    is host-shape independent and is NOT skipped with the ratio."""
+    if (
+        "fleetobs_overhead_pct" not in obj
+        and "fleetobs_stitched_spans" not in obj
+    ):
+        return "artifact predates the fleetobs leg"
+    cores = obj.get("host_cores")
+    if not isinstance(cores, int):
+        return f"host_cores={cores!r} (unknown host shape)"
+    if cores <= 2:
+        return (
+            f"host_cores={cores} ≤ 2 — the federation scrape and SLO "
+            "watchdog threads time-slice the request loop's cores, so "
+            "measured overhead is core contention, not the plane's cost"
+        )
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -532,6 +605,9 @@ def main(argv=None) -> int:
             reason = witnessdiet_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: witness-diet gate SKIPPED ({reason})")
+            reason = fleetobs_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: fleetobs gate SKIPPED ({reason})")
             reason = standing_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: standing gate SKIPPED ({reason})")

@@ -15,11 +15,20 @@ Usage::
     python tools/traceview.py trace.json            # human summary
     python tools/traceview.py trace.json --json     # machine-readable
     python tools/traceview.py trace.json --trace ID # one trace only
+    python tools/traceview.py --stitch router.json shard0.json shard1.json \
+        --out fleet.json                            # merge captures by trace_id
 
 The input is the Chrome trace-event JSON written by
 ``ipc_proofs_tpu.obs.export.write_chrome_trace`` (``--trace-out`` on
 ``generate`` / ``range`` / ``serve``); any trace-event file whose ``X``
 events carry ``args.trace_id`` / ``args.span_id`` works.
+
+``--stitch`` merges captures from DIFFERENT processes of one distributed
+request (router + shards) into a single coherent file: span ids are
+process-local counters, so each file's ids get a ``f<k>:`` namespace
+prefix — except references to span ids that exist in another capture
+(the cross-process graft points), which are remapped to THAT capture's
+namespace so the subtrees join up under one root per trace.
 """
 
 from __future__ import annotations
@@ -28,7 +37,7 @@ import argparse
 import json
 import sys
 
-__all__ = ["load_events", "summarize", "main"]
+__all__ = ["load_events", "stitch", "summarize", "main"]
 
 TOP_WIDEST = 5
 
@@ -42,6 +51,57 @@ def load_events(path: str) -> "list[dict]":
     if not isinstance(events, list):
         raise ValueError(f"{path}: not a Chrome trace-event file")
     return [e for e in events if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+def stitch(event_lists: "list[list[dict]]") -> "list[dict]":
+    """Merge per-process captures of one distributed request.
+
+    ``event_lists[k]`` is one file's ``X`` events. Span ids are
+    process-local counters, so ids from file ``k`` are namespaced
+    ``f"f{k}:<id>"``. A ``parent_id`` resolves within the SAME trace_id
+    (trace ids are globally unique; span ids are not): same-file first —
+    excluding a self-reference, which can only be an adopted span whose
+    wire parent happens to collide with its own local id — then the
+    first OTHER file holding that span id in the trace (the
+    cross-process graft point: a shard's request span parents to the
+    router span id it adopted from the wire carrier). Pass the router's
+    capture first so ambiguous graft points resolve toward it. Parents
+    found nowhere stay verbatim (those spans remain roots).
+    """
+    ids_by_file: "list[dict]" = []
+    for evs in event_lists:
+        per_trace: "dict[str, set]" = {}
+        for e in evs:
+            a = e.get("args", {})
+            per_trace.setdefault(a.get("trace_id"), set()).add(a.get("span_id"))
+        ids_by_file.append(per_trace)
+
+    def resolve(parent, tid, own, k: int):
+        if parent is None:
+            return None
+        if parent != own and parent in ids_by_file[k].get(tid, ()):
+            return f"f{k}:{parent}"
+        for j, per in enumerate(ids_by_file):
+            if j != k and parent in per.get(tid, ()):
+                return f"f{j}:{parent}"
+        return parent
+
+    merged: "list[dict]" = []
+    for k, evs in enumerate(event_lists):
+        for e in evs:
+            out = dict(e)
+            args = dict(e.get("args", {}))
+            sid = args.get("span_id")
+            args["parent_id"] = resolve(
+                args.get("parent_id"), args.get("trace_id"), sid, k
+            )
+            if sid is not None:
+                args["span_id"] = f"f{k}:{sid}"
+            args["capture"] = f"f{k}"
+            out["args"] = args
+            merged.append(out)
+    merged.sort(key=lambda e: e.get("ts", 0))
+    return merged
 
 
 def _critical_path(root: dict, children: "dict[str, list[dict]]") -> "list[dict]":
@@ -155,12 +215,35 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="traceview", description=__doc__.splitlines()[0]
     )
-    parser.add_argument("trace", help="Chrome trace JSON (--trace-out output)")
+    parser.add_argument(
+        "trace", nargs="+",
+        help="Chrome trace JSON (--trace-out output); several with --stitch",
+    )
     parser.add_argument("--trace-id", default=None, help="summarize one trace only")
     parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--stitch", action="store_true",
+        help="merge multiple per-process captures (router first, then "
+        "shards) into one coherent trace before summarizing",
+    )
+    parser.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="with --stitch: also write the merged trace-event JSON here",
+    )
     args = parser.parse_args(argv)
 
-    summary = summarize(load_events(args.trace), trace_id=args.trace_id)
+    if args.stitch:
+        events = stitch([load_events(p) for p in args.trace])
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump({"traceEvents": events}, fh)
+    elif len(args.trace) > 1:
+        parser.error("multiple trace files need --stitch")
+        return 2
+    else:
+        events = load_events(args.trace[0])
+
+    summary = summarize(events, trace_id=args.trace_id)
     if args.json:
         print(json.dumps(summary))
     else:
