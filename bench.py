@@ -57,7 +57,7 @@ ACTOR = 1001
 LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
-    "asyncfetch", "cluster", "standing", "fleetobs", "onchip",
+    "asyncfetch", "cluster", "standing", "fleetobs", "onchip", "backfill",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -79,6 +79,7 @@ _LEG_TIMEOUTS = {
     "standing": (420.0, 240.0),
     "fleetobs": (420.0, 240.0),
     "onchip": (480.0, 240.0),
+    "backfill": (420.0, 240.0),
 }
 
 
@@ -1607,6 +1608,148 @@ def _leg_cluster(args) -> dict:
     }
 
 
+def _leg_backfill(args) -> dict:
+    """Bulk backfill (host-only, REAL shard processes): deep-history
+    throughput through the router's backfill engine at 1 vs 4 shard
+    child processes over one shared demo world.
+
+    Asserted on every run, never gated:
+    - the streamed chunk sequence, folded client-side exactly as a
+      consumer would, is byte-identical to the single-process chunked
+      driver over the same pairs — at BOTH shard counts;
+    - every window arrives exactly once through the cursor protocol.
+
+    Measured numbers:
+    - ``backfill_epochs_per_sec`` — epochs proven per second through the
+      4-shard scatter (1-shard recorded alongside); gated > 0 by
+      ``tools/check_bench_schema.py``;
+    - ``backfill_ttfc_ms`` vs ``backfill_total_ms`` — time to FIRST
+      streamed chunk vs job completion; the schema gate demands
+      ttfc < total (incremental delivery is the point of the stream);
+    - ``backfill_occupancy_pct`` — proving seconds per shard-lane
+      second from the engine's busy/wall accounting (the device-side
+      utilization a backfill achieves without an interactive load).
+    """
+    import shutil
+    import tempfile
+
+    from ipc_proofs_tpu.cluster import ClusterRouter, spawn_serve_shard
+    from ipc_proofs_tpu.cluster.gather import BundleFold
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    n_pairs = 24 if args.quick else 64
+    receipts, match_rate = 8, 0.25
+    window_size = 4 if args.quick else 8
+    n_windows = -(-n_pairs // window_size)
+
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, match_rate=match_rate,
+        signature=SIG, topic1=TOPIC1,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1)
+    direct = generate_event_proofs_for_range_chunked(
+        store, list(pairs), spec, chunk_size=window_size
+    )
+    direct_json = json.dumps(direct.to_json_obj(), sort_keys=True)
+    extra = [
+        "--demo-receipts", str(receipts), "--demo-match-rate", str(match_rate),
+    ]
+
+    def measure(n_shards: int, workdir: str) -> dict:
+        shards = [
+            spawn_serve_shard(
+                f"s{k}", n_pairs, SIG, TOPIC1,
+                store_dir=os.path.join(workdir, "store"), extra_args=extra,
+            )
+            for k in range(n_shards)
+        ]
+        m = Metrics()
+        router = ClusterRouter(
+            {sh.name: sh.url for sh in shards}, pairs,
+            steal_threshold=2, metrics=m, spec=spec,
+            backfill_jobs_dir=os.path.join(workdir, "jobs"),
+            backfill_window_size=window_size,
+        )
+        try:
+            # warm every shard (extension load, first-request jit paths)
+            for k in range(2 * n_shards):
+                status, _obj = router.generate(k % len(pairs))
+                assert status == 200
+            status, submitted = router.backfill_submit(
+                {"pair_start": 0, "pair_end": n_pairs}
+            )
+            assert status == 200, submitted
+            job_id = submitted["job_id"]
+            # consume the stream through the real cursor protocol: each
+            # poll acks what we already hold and long-polls for more
+            cursor, chunks = 0, []
+            while True:
+                status, resp = router.backfill_chunks(
+                    job_id, cursor, wait_s=10.0
+                )
+                assert status == 200, resp
+                for ch in resp["chunks"]:
+                    chunks.append(ch)
+                    cursor = ch["cursor"]
+                if resp["state"] != "running" and not resp["chunks"]:
+                    break
+            assert resp["state"] == "complete", resp
+            assert len(chunks) == n_windows, (
+                f"{len(chunks)} chunks streamed for {n_windows} windows"
+            )
+            # fold the stream exactly as a consumer would: must equal the
+            # single-process chunked driver byte for byte
+            fold = BundleFold(pairs, list(range(n_pairs)))
+            for ch in chunks:
+                fold.fold(UnifiedProofBundle.from_json_obj(ch["bundle"]))
+            got = json.dumps(fold.seal().to_json_obj(), sort_keys=True)
+            assert got == direct_json, (
+                f"{n_shards}-shard backfill stream diverged from the "
+                "single-process driver"
+            )
+            status, st = router.backfill_status(job_id)
+            assert status == 200, st
+            return st
+        finally:
+            router.close()
+            for sh in shards:
+                sh.stop()
+
+    workdir = tempfile.mkdtemp(prefix="bench_backfill_")
+    try:
+        st1 = measure(1, os.path.join(workdir, "b1"))
+        st4 = measure(4, os.path.join(workdir, "b4"))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    epochs1 = n_pairs / st1["wall_s"]
+    epochs4 = n_pairs / st4["wall_s"]
+    ttfc_ms = (st4["first_chunk_s"] or 0.0) * 1000.0
+    total_ms = st4["wall_s"] * 1000.0
+    occupancy = 100.0 * st4["busy_s"] / (4 * st4["wall_s"])
+    _log(
+        f"bench: backfill ({n_pairs} epochs, {n_windows} windows of "
+        f"{window_size}): {epochs1:,.1f} epochs/s @1 shard vs "
+        f"{epochs4:,.1f} epochs/s @4 shards; first chunk {ttfc_ms:,.0f}ms "
+        f"vs total {total_ms:,.0f}ms; lane occupancy {occupancy:.0f}%; "
+        "streamed fold byte-identical at both shard counts ✓"
+    )
+    return {
+        "backfill_epochs_per_sec": round(epochs4, 2),
+        "backfill_epochs_per_sec_1shard": round(epochs1, 2),
+        "backfill_ttfc_ms": round(ttfc_ms, 1),
+        "backfill_total_ms": round(total_ms, 1),
+        "backfill_occupancy_pct": round(occupancy, 1),
+        "backfill_windows": n_windows,
+        "backfill_epochs": n_pairs,
+        "backfill_shards": 4,
+    }
+
+
 def _leg_fleetobs(args) -> dict:
     """Fleet observability overhead (host-only, REAL processes): the same
     closed-loop generate load through a 2-shard router with the fleet
@@ -1780,7 +1923,12 @@ def _leg_onchip(args) -> dict:
       honestly shows the pjit-path overhead against the plain-jit path);
     - ``batch_verify_speedup`` — scalar hashlib loop wall / batched device
       plane wall over the same blocks (recorded honestly: on a CPU-only
-      host the XLA u32-lane emulation loses to hashlib and this is < 1).
+      host the XLA u32-lane emulation loses to hashlib and this is < 1);
+    - ``verify_tuned_speedup`` — scalar wall / CHOSEN-lane wall after the
+      per-host crossover autotune (`ops.verify_jax.autotune_crossover`).
+      Asserted ≥ 0.8 every run: whatever lane the tuner picks must never
+      be slower than scalar beyond noise — on CPU-only hosts that means
+      ``verify_autotune_scalar_only`` is true and the ratio sits at ~1.
     """
     jax_platform = _setup_platform(args)
     import jax
@@ -1886,6 +2034,41 @@ def _leg_onchip(args) -> dict:
     speedup = t_scalar / t_batch
     assert blake2b_256(blocks[1]) == cids[1].digest  # sanity on the fixture
 
+    # --- autotuned crossover: the lane the tuner PICKS must never lose ------
+    # `batch_verify_speedup` above forces the device lane and records the
+    # ratio honestly (< 1 on CPU-only hosts). The autotuner exists so
+    # production never runs that losing lane: measure the per-host
+    # crossover, persist it, and verify the CHOSEN lane is at least as
+    # fast as scalar (beyond timing noise) on the same blocks.
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from ipc_proofs_tpu.ops import verify_jax as _vj
+
+    tune_dir = _tempfile.mkdtemp(prefix="bench_autotune_")
+    try:
+        # drop the force-device override from the section above so the
+        # tuned crossover (not env) governs lane choice
+        os.environ.pop("IPC_VERIFY_MIN_BYTES", None)
+        record = _vj.autotune_crossover(tune_dir, quick=args.quick, force=True)
+        t_tuned = best_of(lambda: verify_blocks_batch(cids, blocks))
+    finally:
+        _shutil.rmtree(tune_dir, ignore_errors=True)
+    tuned_speedup = t_scalar / t_tuned
+    scalar_only = bool(record["scalar_only"])
+    assert tuned_speedup >= 0.8, (
+        f"autotuned verify lane ran {1 / tuned_speedup:.2f}× slower than "
+        f"scalar (record: {record}) — the tuner must never pick a losing "
+        "lane beyond noise"
+    )
+
+    _log(
+        f"bench: onchip autotune: crossover "
+        f"{'scalar-only' if scalar_only else record['min_bytes']}, chosen "
+        f"lane {t_tuned * 1e3:.1f} ms vs scalar {t_scalar * 1e3:.1f} ms "
+        f"(speedup {tuned_speedup:.2f}) over {len(record['samples'])} "
+        "measured sizes"
+    )
     _log(
         f"bench: onchip ({n_dev} device(s)): match {rate_1:,.0f} ev/s @1 vs "
         f"{rate_n:,.0f} ev/s @{n_dev} (linearity {linearity:.2f}); "
@@ -1900,6 +2083,9 @@ def _leg_onchip(args) -> dict:
         "onchip_match_events": n_events,
         "onchip_verify_blocks": n_blocks,
         "onchip_device_calls": int(device_calls),
+        "verify_tuned_speedup": round(tuned_speedup, 3),
+        "verify_autotune_scalar_only": scalar_only,
+        "verify_autotuned_min_bytes": int(record["min_bytes"]),
         "_platform": jax_platform,
     }
 
@@ -2051,6 +2237,7 @@ _LEG_FNS = {
     "standing": _leg_standing,
     "fleetobs": _leg_fleetobs,
     "onchip": _leg_onchip,
+    "backfill": _leg_backfill,
 }
 
 
@@ -2357,6 +2544,8 @@ def _orchestrate(args) -> None:
     legs_status["standing"] = status
     fleetobs, status = _run_leg("fleetobs", args, "cpu")
     legs_status["fleetobs"] = status
+    backfill, status = _run_leg("backfill", args, "cpu")
+    legs_status["backfill"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -2456,9 +2645,18 @@ def _orchestrate(args) -> None:
     _ONCHIP_KEYS = (
         "device_linearity_Nchip", "batch_verify_speedup", "onchip_devices",
         "onchip_match_events", "onchip_verify_blocks", "onchip_device_calls",
+        "verify_tuned_speedup", "verify_autotune_scalar_only",
+        "verify_autotuned_min_bytes",
     )
     for k in _ONCHIP_KEYS:
         out[k] = (onchip or {}).get(k)
+    _BACKFILL_KEYS = (
+        "backfill_epochs_per_sec", "backfill_epochs_per_sec_1shard",
+        "backfill_ttfc_ms", "backfill_total_ms", "backfill_occupancy_pct",
+        "backfill_windows", "backfill_epochs", "backfill_shards",
+    )
+    for k in _BACKFILL_KEYS:
+        out[k] = (backfill or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

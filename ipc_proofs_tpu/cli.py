@@ -397,6 +397,182 @@ def _cmd_range(args) -> int:
     return 0
 
 
+def _cmd_backfill(args) -> int:
+    """Prove deep history as a durable batch job (`ipc_proofs_tpu.backfill`).
+
+    Two store modes mirroring ``range``/``serve``:
+    - ``--demo-world N``: hermetic synthetic range world (tests, CI);
+    - ``--endpoint`` + ``--from-height/--to-height``: live chain.
+
+    The range splits into ``--window-size`` epoch windows; each committed
+    window journals under ``--jobs-dir`` (re-running the identical
+    command resumes instead of re-proving) and streams as one log line
+    the moment it lands — long before the job completes. The sealed
+    bundle is byte-identical to the ``range`` command over the same pairs.
+    """
+    from ipc_proofs_tpu.backend import get_backend
+    from ipc_proofs_tpu.backfill import BackfillEngine, local_window_runner
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import TipsetPair
+    from ipc_proofs_tpu.utils.metrics import get_metrics
+
+    metrics = get_metrics()
+    tracing = _start_tracing(args)
+
+    plane = None
+    disk = None
+    if args.demo_world:
+        from ipc_proofs_tpu.fixtures import build_range_world
+
+        sig = args.event_sig or "NewTopDownMessage(bytes32,uint256)"
+        topic1 = args.topic1 or "calib-subnet-1"
+        store, pairs, n_matching = build_range_world(
+            args.demo_world,
+            receipts_per_pair=args.demo_receipts,
+            match_rate=args.demo_match_rate,
+            signature=sig,
+            topic1=topic1,
+        )
+        spec = EventProofSpec(event_signature=sig, topic_1=topic1)
+        log.info(
+            "demo world: %d pairs, %d matching events", len(pairs), n_matching
+        )
+    else:
+        from ipc_proofs_tpu.proofs.address import resolve_eth_address_to_actor_id
+        from ipc_proofs_tpu.proofs.chain import Tipset
+        from ipc_proofs_tpu.store.rpc import RpcBlockstore
+
+        if not args.endpoint:
+            log.error("backfill needs --demo-world or --endpoint")
+            return 2
+        if args.from_height is None or args.to_height is None:
+            log.error("--endpoint requires --from-height and --to-height")
+            return 2
+        if not (args.event_sig and args.topic1):
+            log.error("--endpoint requires --event-sig and --topic1")
+            return 2
+        client = _make_rpc_client(args, metrics=metrics)
+        actor_id = None
+        if args.contract:
+            actor_id = resolve_eth_address_to_actor_id(client, args.contract)
+            log.info("actor id: %d", actor_id)
+        with metrics.stage("fetch_tipsets"):
+            tipsets = [
+                Tipset.fetch(client, h)
+                for h in range(args.from_height, args.to_height + 2)
+            ]
+        pairs = [
+            TipsetPair(parent=tipsets[i], child=tipsets[i + 1])
+            for i in range(len(tipsets) - 1)
+        ]
+        spec = EventProofSpec(
+            event_signature=args.event_sig,
+            topic_1=args.topic1,
+            actor_id_filter=actor_id,
+        )
+        if args.batch_rpc:
+            from ipc_proofs_tpu.store.fetchplane import FetchPlane, PlaneBlockstore
+
+            plane = FetchPlane(
+                client,
+                speculate_depth=args.speculate_depth,
+                metrics=metrics,
+                batch_verify=args.batch_verify,
+            )
+            store = PlaneBlockstore(plane)
+        else:
+            store = RpcBlockstore(client)
+        if args.store_dir:
+            from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
+
+            disk = SegmentStore(
+                args.store_dir,
+                cap_bytes=args.store_cap_bytes,
+                metrics=metrics,
+                batch_verify=args.batch_verify,
+            )
+            store = TieredBlockstore(store, disk, metrics=metrics)
+            if plane is not None:
+                plane.set_local(store)
+
+    start = args.pair_start
+    end = args.pair_end if args.pair_end is not None else len(pairs)
+    if not (0 <= start < end <= len(pairs)):
+        log.error(
+            "pair range [%d, %d) out of bounds for %d pairs",
+            start, end, len(pairs),
+        )
+        return 2
+
+    backend = (
+        get_backend(args.backend, mesh_devices=args.mesh_devices)
+        if args.backend != "none"
+        else None
+    )
+    engine = BackfillEngine(
+        pairs,
+        spec,
+        local_window_runner(
+            store, spec, chunk_size=args.chunk_size,
+            match_backend=backend, metrics=metrics,
+        ),
+        jobs_dir=args.jobs_dir,
+        window_size=args.window_size,
+        work_ahead=args.work_ahead,
+        window_parallelism=args.window_parallelism,
+        plane=plane,
+        metrics=metrics,
+    )
+    rc = 0
+    try:
+        job = engine.submit(start, end)
+        log.info(
+            "backfill %s: %d epochs in %d windows of %d (jobs dir: %s)",
+            job.job_id, end - start, len(job.windows), job.window_size,
+            args.jobs_dir or "none — not resumable",
+        )
+        cursor = 0
+        while True:
+            resp = job.chunks_after(cursor, wait_s=5.0)
+            for chunk in resp["chunks"]:
+                w = chunk["window"]
+                log.info(
+                    "chunk %d/%d: window %d pairs [%d, %d) — %d proofs (%s)",
+                    chunk["cursor"], len(job.windows), w["index"],
+                    w["lo"], w["hi"], chunk["n_event_proofs"], chunk["digest"],
+                )
+                cursor = chunk["cursor"]
+            if resp["state"] != "running" and not resp["chunks"]:
+                break
+        bundle = job.result(timeout=0)
+        output = args.output or "backfill_bundle.json"
+        with open(output, "w") as fh:
+            fh.write(bundle.to_json())
+        status = job.status()
+        log.info(
+            "backfill %s complete: %d event proofs, %d witness blocks → %s "
+            "(%d/%d windows replayed from journal, first chunk %.2fs, "
+            "total %.2fs)",
+            job.job_id, len(bundle.event_proofs), len(bundle.blocks), output,
+            status["windows_replayed"], status["windows_total"],
+            status["first_chunk_s"] or 0.0, status["wall_s"],
+        )
+    except Exception as exc:  # fail-soft: CLI exit path — report + rc 1
+        log.error("backfill failed: %s", exc)
+        rc = 1
+    finally:
+        engine.close()
+        if plane is not None:
+            plane.close()
+        if disk is not None:
+            disk.close()
+    if args.metrics:
+        print(metrics.to_json(), file=sys.stderr)
+    if tracing:
+        _finish_tracing(args)
+    return rc
+
+
 def _cmd_vectors(args) -> int:
     """Capture live-chain byte-compat vectors (headers, TxMeta,
     receipts-AMT root) into a fixtures JSON the test suite consumes —
@@ -756,12 +932,43 @@ def _cmd_serve(args) -> int:
     if args.slo == "on":
         slo = _build_slo_watchdog(args, metrics)
         slo.start()
+    backfill = None
+    if args.backfill_jobs_dir:
+        if spec is None or store is None or not pairs:
+            log.error(
+                "--backfill-jobs-dir needs a generate-capable service "
+                "(--demo-world or --endpoint)"
+            )
+            service.drain()
+            return 2
+        from ipc_proofs_tpu.backfill import BackfillEngine
+
+        def _run_backfill_window(window, wpairs):
+            # LOW lane: a backfill window only dispatches when the
+            # interactive verify/generate queue is empty
+            return service.submit_range_window(wpairs).result()
+
+        backfill = BackfillEngine(
+            pairs,
+            spec,
+            _run_backfill_window,
+            jobs_dir=args.backfill_jobs_dir,
+            window_size=args.backfill_window_size,
+            plane=service.fetch_plane,
+            metrics=metrics,
+            delivery=(subs.log if subs is not None else None),
+        )
+        log.info(
+            "backfill: /v1/backfill mounted (jobs dir %s, window %d)",
+            args.backfill_jobs_dir, args.backfill_window_size,
+        )
     from ipc_proofs_tpu.obs.fleet import TenantLedger
 
     httpd = ProofHTTPServer(
         service, host=args.host, port=args.port, pairs=pairs, durable=durable,
         subs=subs, slo=slo,
         tenants=TenantLedger(metrics=metrics, top_k=args.tenant_top_k),
+        backfill=backfill,
     )
     if args.port_file:
         # atomic write: a polling parent never reads a half-written port
@@ -1287,6 +1494,81 @@ def main(argv=None) -> int:
     add_trace_export_flags(rng)
     rng.set_defaults(fn=_cmd_range)
 
+    bf = sub.add_parser(
+        "backfill",
+        help="prove deep history as a durable batch job: windowed, "
+        "journal-resumable, streamed chunk by chunk",
+    )
+    bf.add_argument("--endpoint", default=None)
+    bf.add_argument("--token", default=None)
+    bf.add_argument("--timeout", type=float, default=250.0)
+    add_failover_flags(bf)
+    bf.add_argument("--from-height", type=int, default=None)
+    bf.add_argument("--to-height", type=int, default=None)
+    bf.add_argument("--contract", default=None)
+    bf.add_argument("--event-sig", default=None)
+    bf.add_argument("--topic1", default=None)
+    bf.add_argument(
+        "--demo-world", type=int, default=0, metavar="N_PAIRS",
+        help="hermetic synthetic range world with N tipset pairs instead "
+        "of a live endpoint (the batch analogue of `serve --demo-world`)",
+    )
+    bf.add_argument(
+        "--demo-receipts", type=int, default=16, metavar="N",
+        help="receipts per pair in the --demo-world (default 16)",
+    )
+    bf.add_argument(
+        "--demo-match-rate", type=float, default=0.01,
+        help="fraction of demo-world events matching the spec (default 0.01)",
+    )
+    bf.add_argument(
+        "--pair-start", type=int, default=0, metavar="I",
+        help="first pair-table index to prove (default 0)",
+    )
+    bf.add_argument(
+        "--pair-end", type=int, default=None, metavar="J",
+        help="one past the last pair-table index (default: whole table)",
+    )
+    bf.add_argument(
+        "--window-size", type=int, default=8, metavar="N",
+        help="epochs per schedulable window — the journal's commit and "
+        "the stream's chunk granularity (default 8)",
+    )
+    bf.add_argument(
+        "--work-ahead", type=int, default=2, metavar="N",
+        help="future windows whose tipset headers prime the fetch "
+        "plane's speculative lanes when a window starts (default 2)",
+    )
+    bf.add_argument(
+        "--window-parallelism", type=int, default=1, metavar="N",
+        help="windows proving concurrently (default 1 — the whole job "
+        "occupies a single lane)",
+    )
+    bf.add_argument(
+        "--jobs-dir", default=None, metavar="DIR",
+        help="durable job root: each job journals committed windows "
+        "under DIR/<job-id>/ (IPJ1, fsync'd). Re-running the identical "
+        "command resumes from the journal — a SIGKILL loses at most the "
+        "in-flight windows. Without it the job is not resumable",
+    )
+    bf.add_argument(
+        "--chunk-size", type=int, default=None, metavar="N",
+        help="chunk size within one window's driver run (default: the "
+        "whole window as one chunk)",
+    )
+    bf.add_argument("--backend", default="cpu", choices=["cpu", "tpu", "none"])
+    add_onchip_flags(bf)
+    add_store_flags(bf)
+    add_fetch_plane_flags(bf)
+    bf.add_argument("-o", "--output", default=None)
+    bf.add_argument("--metrics", action="store_true")
+    bf.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="export all stage/RPC spans as Chrome trace-event JSON",
+    )
+    add_trace_export_flags(bf)
+    bf.set_defaults(fn=_cmd_backfill)
+
     vec = sub.add_parser(
         "vectors", help="capture live-chain byte-compat vectors to a fixtures JSON"
     )
@@ -1435,6 +1717,23 @@ def main(argv=None) -> int:
     srv.add_argument(
         "--follow-poll-s", type=float, default=15.0,
         help="chain-follower poll interval in seconds (default 15)",
+    )
+    srv.add_argument(
+        "--backfill-jobs-dir", default=None, metavar="DIR",
+        help="mount /v1/backfill: durable deep-history batch jobs, "
+        "journaled under DIR (IPJ1, one subdirectory per deterministic "
+        "job id — SIGKILL-resumable, identical re-submits dedup). "
+        "Windows execute on the generate micro-batcher's LOW-priority "
+        "lane, so a saturating backfill never starves interactive "
+        "/v1/verify or /v1/generate; chunks stream incrementally via the "
+        "long-poll cursor protocol (GET /v1/backfill/<id>/chunks"
+        "?cursor=N). Needs a generate-capable service (--demo-world or "
+        "--endpoint)",
+    )
+    srv.add_argument(
+        "--backfill-window-size", type=int, default=8, metavar="N",
+        help="epochs (tipset pairs) per backfill window — the journal "
+        "commit and streaming granularity (default 8)",
     )
     srv.add_argument(
         "--queue-dir", default=None, metavar="DIR",

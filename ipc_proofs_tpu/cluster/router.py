@@ -178,6 +178,10 @@ class ClusterRouter:
         scrape_timeout_s: float = 2.0,
         slo=None,
         tenant_top_k: int = 8,
+        spec=None,
+        backfill_jobs_dir: Optional[str] = None,
+        backfill_window_size: int = 8,
+        backfill_window_parallelism: Optional[int] = None,
     ):
         if not shards:
             raise NoShardsError("a cluster needs at least one shard")
@@ -214,6 +218,17 @@ class ClusterRouter:
         )
         self.tenants = TenantLedger(metrics=self.metrics, top_k=tenant_top_k)
         self.slo = slo
+        # bulk backfill over the whole cluster: windows fan out to their
+        # arc shards through the steal-aware dispatch below. The engine
+        # is built lazily on first submit (it snapshots alive shards for
+        # planning); `spec` binds journal manifests to the deployment's
+        # filter when the caller has it (optional — one cluster serves
+        # one spec, so an opaque manifest is still unambiguous)
+        self._backfill_spec = spec
+        self._backfill_jobs_dir = backfill_jobs_dir
+        self._backfill_window_size = int(backfill_window_size)
+        self._backfill_parallelism = backfill_window_parallelism
+        self._backfill = None  # guarded-by: _lock
         self._gauge_alive_locked()
 
     # --- placement (all under _lock) --------------------------------------
@@ -671,6 +686,114 @@ class ClusterRouter:
                 ).claims_json()
             return 200, out
 
+    # --- bulk backfill ------------------------------------------------------
+
+    def _backfill_engine(self):
+        """The router's `BackfillEngine`, built lazily on first use:
+        windows are planned onto the arcs of the shards alive NOW and
+        executed at shard-count parallelism through the same steal-aware,
+        at-least-once dispatch every interactive request uses."""
+        from ipc_proofs_tpu.backfill import BackfillEngine
+
+        with self._lock:
+            if self._backfill is None:
+                nodes = [
+                    name
+                    for name, s in self._shards.items()
+                    if s.alive
+                ] or sorted(self._shards)
+                self._backfill = BackfillEngine(
+                    self.pairs,
+                    self._backfill_spec,
+                    self._run_backfill_window,
+                    jobs_dir=self._backfill_jobs_dir,
+                    window_size=self._backfill_window_size,
+                    window_parallelism=(
+                        self._backfill_parallelism or max(1, len(nodes))
+                    ),
+                    nodes=nodes,
+                    metrics=self.metrics,
+                )
+            return self._backfill
+
+    def _run_backfill_window(self, window, pairs):
+        """Window runner: one `/v1/generate_range` sub-request to the
+        window's arc shard (work stealing and failover come free from
+        `_dispatch`; the stable idempotency key lets a durable shard
+        dedup a failover replay)."""
+        del pairs  # shards hold the pair table; the wire speaks indexes
+        self.metrics.count("cluster.sub_requests")
+        body = {
+            "pair_indexes": list(range(window.lo, window.hi)),
+            "idempotency_key": f"backfill-{window.lo}-{window.hi}",
+        }
+        status, obj = self._dispatch(
+            self._keys[window.lo], "/v1/generate_range", body
+        )
+        if status != 200:
+            raise ShardUnavailable(
+                f"backfill window {window.index} failed with {status}: "
+                f"{obj.get('error', obj)}"
+            )
+        payload = obj.get("result", obj) if obj.get("ok", True) else obj
+        if "bundle" not in payload:
+            raise ShardUnavailable(
+                f"backfill window {window.index}: shard returned no bundle"
+            )
+        return UnifiedProofBundle.from_json_obj(payload["bundle"])
+
+    def backfill_submit(self, body: dict) -> "tuple[int, dict]":
+        """``POST /v1/backfill`` (router door): same contract as the
+        single-daemon handler — rows ``[pair_start, pair_end)`` of the
+        shared pair table, idempotent by journal manifest."""
+        n = len(self.pairs)
+        start, end = body.get("pair_start"), body.get("pair_end")
+
+        def _row(v) -> bool:
+            return isinstance(v, int) and not isinstance(v, bool)
+
+        if not (_row(start) and _row(end) and 0 <= start < end <= n):
+            return 400, {
+                "error": "pair_start/pair_end must be ints with "
+                f"0 <= start < end <= {n} (cluster pair table)"
+            }
+        wsize = body.get("window_size")
+        if wsize is not None and (not _row(wsize) or wsize < 1):
+            return 400, {"error": "window_size must be a positive int"}
+        sub_id = body.get("sub_id")
+        if sub_id is not None and not isinstance(sub_id, str):
+            return 400, {"error": "sub_id must be a string"}
+        try:
+            job = self._backfill_engine().submit(
+                start, end, window_size=wsize, sub_id=sub_id
+            )
+        except (ValueError, RuntimeError) as exc:
+            return 400, {"error": str(exc)}
+        return 200, job.status()
+
+    def backfill_status(self, job_id: str) -> "tuple[int, dict]":
+        with self._lock:
+            engine = self._backfill
+        job = engine.job(job_id) if engine is not None else None
+        if job is None:
+            return 404, {"error": f"no such backfill job: {job_id}"}
+        return 200, job.status()
+
+    def backfill_chunks(
+        self, job_id: str, cursor: int, wait_s: float = 0.0
+    ) -> "tuple[int, dict]":
+        with self._lock:
+            engine = self._backfill
+        job = engine.job(job_id) if engine is not None else None
+        if job is None:
+            return 404, {"error": f"no such backfill job: {job_id}"}
+        return 200, job.chunks_after(cursor, wait_s=wait_s)
+
+    def backfill_jobs(self) -> "tuple[int, dict]":
+        with self._lock:
+            engine = self._backfill
+        return 200, {"jobs": engine.jobs() if engine is not None else []}
+
     # --- cluster health / metrics -----------------------------------------
 
     def healthz(self) -> "tuple[int, dict]":
@@ -819,6 +942,12 @@ class ClusterRouter:
         self.federation.stop()
         if self.slo is not None:
             self.slo.stop()
+        with self._lock:
+            backfill = self._backfill
+        if backfill is not None:
+            # first: running windows hold shard dispatches in flight and
+            # must wind down while shard clients are still usable
+            backfill.close()
         self._executor.shutdown(wait=True)
 
 
@@ -862,6 +991,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send_json(200, self.router.flight())
         elif parts.path == "/v1/subscriptions":
             status, obj = self.router.subscriptions()
+            self._send_json(status, obj)
+        elif parts.path == "/v1/backfill":
+            status, obj = self.router.backfill_jobs()
+            self._send_json(status, obj)
+        elif parts.path.startswith("/v1/backfill/"):
+            rest = parts.path[len("/v1/backfill/") :]
+            job_id, _, tail = rest.partition("/")
+            if tail == "":
+                status, obj = self.router.backfill_status(job_id)
+            elif tail == "chunks":
+                try:
+                    qs = parse_qs(parts.query)
+                    cursor = int((qs.get("cursor") or ["0"])[0])
+                    wait_s = min(30.0, float((qs.get("wait_s") or ["0"])[0]))
+                except ValueError as exc:
+                    self._send_json(400, {"error": f"bad query: {exc}"})
+                    return
+                status, obj = self.router.backfill_chunks(
+                    job_id, cursor=cursor, wait_s=wait_s
+                )
+            else:
+                status, obj = 404, {"error": f"no such path: {self.path}"}
             self._send_json(status, obj)
         elif parts.path == "/v1/deliveries":
             try:
@@ -924,6 +1075,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 status, obj = self.router.subscribe(body)
             elif self.path == "/v1/unsubscribe":
                 status, obj = self.router.unsubscribe(body)
+            elif self.path == "/v1/backfill":
+                status, obj = self.router.backfill_submit(body)
             else:
                 status, obj = 404, {"error": f"no such path: {self.path}"}
         except NoShardsError as exc:

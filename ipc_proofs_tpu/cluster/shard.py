@@ -67,6 +67,8 @@ class LocalShard:
         event_filter=None,
         store_wrapper=None,
         subs=None,
+        backfill_jobs_dir=None,
+        backfill_window_size: int = 8,
     ):
         self.name = name
         self.pairs = list(pairs)
@@ -89,9 +91,29 @@ class LocalShard:
             else None
         )
         self.subs = subs  # StandingQueries, when the shard serves streams
+        self.backfill = None
+        if backfill_jobs_dir:
+            # mirrors the serve daemon: windows enter the generate
+            # batcher's LOW lane, so backfill yields to interactive work
+            from ipc_proofs_tpu.backfill import BackfillEngine
+
+            service = self.service
+
+            def _run_window(window, wpairs):
+                return service.submit_range_window(wpairs).result()
+
+            self.backfill = BackfillEngine(
+                self.pairs,
+                spec,
+                _run_window,
+                jobs_dir=backfill_jobs_dir,
+                window_size=backfill_window_size,
+                metrics=self.service.metrics,
+                delivery=(subs.log if subs is not None else None),
+            )
         self.httpd = ProofHTTPServer(
             self.service, port=0, pairs=self.pairs, durable=self.durable,
-            subs=subs,
+            subs=subs, backfill=self.backfill,
         )
 
     def start(self) -> "LocalShard":

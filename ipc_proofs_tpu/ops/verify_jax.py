@@ -25,7 +25,10 @@ repeated waves compile O(log² n) kernel shapes, not one per batch.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
+import tempfile
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -33,12 +36,32 @@ import numpy as np
 from ipc_proofs_tpu.core.cid import BLAKE2B_256, CID, IDENTITY, KECCAK_256, SHA2_256
 from ipc_proofs_tpu.core.hashes import blake2b_256, keccak256
 
-__all__ = ["verify_blocks_batch", "batch_min_bytes"]
+__all__ = [
+    "verify_blocks_batch",
+    "batch_min_bytes",
+    "autotune_crossover",
+    "load_autotune",
+    "SCALAR_ONLY_MIN_BYTES",
+]
 
 # Below this many payload bytes in one batch, XLA dispatch + packing costs
 # more than hashlib's C loop — the scalar lane runs instead (verdicts are
 # identical; this is the same crossover discipline as backend.tpu).
 _DEFAULT_MIN_BYTES = 256 * 1024
+
+# Autotuned crossover persisted per host under --store-dir. Resolution
+# order in `batch_min_bytes`: env IPC_VERIFY_MIN_BYTES (always wins, so
+# an operator override survives autotuning) > loaded autotune record >
+# `_DEFAULT_MIN_BYTES`.
+_AUTOTUNE_FILE = "verify_autotune.json"
+_AUTOTUNE_VERSION = 1
+
+#: Sentinel crossover meaning "the device lane never beat hashlib on this
+#: host — stay scalar at every batch size". Large enough that no real
+#: batch reaches it.
+SCALAR_ONLY_MIN_BYTES = 1 << 62
+
+_tuned_min_bytes: "int | None" = None
 
 # one device call hashes at most this many messages (bounds the padded
 # [N, B, words] tensor one size-class chunk packs)
@@ -49,11 +72,20 @@ _jax_ok: "bool | None" = None
 
 
 def batch_min_bytes() -> int:
-    """Device-lane crossover in payload bytes (env IPC_VERIFY_MIN_BYTES)."""
-    try:
-        return int(os.environ.get("IPC_VERIFY_MIN_BYTES", _DEFAULT_MIN_BYTES))
-    except ValueError:
-        return _DEFAULT_MIN_BYTES
+    """Device-lane crossover in payload bytes.
+
+    env IPC_VERIFY_MIN_BYTES > autotuned value (`autotune_crossover` /
+    `load_autotune`) > built-in default.
+    """
+    env = os.environ.get("IPC_VERIFY_MIN_BYTES")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if _tuned_min_bytes is not None:
+        return _tuned_min_bytes
+    return _DEFAULT_MIN_BYTES
 
 
 def _device_ready() -> bool:
@@ -189,3 +221,158 @@ def verify_blocks_batch(
     if metrics is not None and scalar_idx:
         metrics.count("verify.scalar_blocks", len(scalar_idx))
     return verdicts
+
+
+# --- per-host crossover autotuning ------------------------------------------
+#
+# `_DEFAULT_MIN_BYTES` is a guess; the real crossover between hashlib's C
+# loop and the XLA lane varies by host (on a CPU-only host the u32-lane
+# device emulation can lose at EVERY size — BENCH_r18 measured the forced
+# device lane at 0.039× scalar). `autotune_crossover` measures both lanes
+# once per host, persists the winner's crossover under --store-dir, and
+# every later daemon on the host loads the record instead of re-measuring.
+
+
+def load_autotune(store_dir: str) -> "int | None":
+    """Load a persisted autotune record, activating its crossover.
+
+    Returns the tuned min-bytes (possibly `SCALAR_ONLY_MIN_BYTES`) or
+    None when no valid record exists. Never raises: an unreadable or
+    wrong-version record is treated as absent.
+    """
+    global _tuned_min_bytes
+    path = os.path.join(store_dir, _AUTOTUNE_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            record = json.load(fh)
+        if record.get("version") != _AUTOTUNE_VERSION:
+            return None
+        min_bytes = int(record["min_bytes"])
+    except (OSError, ValueError, TypeError, KeyError):  # fail-soft: a bad tuning record must never block serving — the default crossover applies
+        return None
+    _tuned_min_bytes = min_bytes
+    return min_bytes
+
+
+def _autotune_fixture(payload_bytes: int, block_bytes: int = 1024):
+    """Deterministic (cids, blocks) covering `payload_bytes` of blake2b
+    blocks — the multihash family every witness block in this repo uses."""
+    n = max(2, payload_bytes // block_bytes)
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 256, size=(n, block_bytes), dtype=np.uint8)
+    blocks = [payload[i].tobytes() for i in range(n)]
+    from ipc_proofs_tpu.core.cid import DAG_CBOR
+
+    cids = [CID.hash_of(b, codec=DAG_CBOR, mh_code=BLAKE2B_256) for b in blocks]
+    return cids, blocks
+
+
+def _best_of(fn, reps: int = 3) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _device_lane_wall(cids, blocks) -> float:
+    """Best-of-3 wall of the device lane over (cids, blocks), compile
+    warmed outside the timing. Digests are checked against the cids so a
+    lane that silently mis-hashes can never win the tuning."""
+    from ipc_proofs_tpu.ops.blake2b_jax import BLOCK_BYTES
+
+    need = [max(1, -(-len(b) // BLOCK_BYTES)) for b in blocks]
+    idxs = list(range(len(blocks)))
+
+    def run():
+        for cls, chunk in _size_class_chunks(idxs, need):
+            digests = _device_digests(BLAKE2B_256, [blocks[i] for i in chunk], cls)
+            for i, digest in zip(chunk, digests):
+                if digest != cids[i].digest:
+                    raise RuntimeError("autotune fixture digest mismatch")
+
+    run()  # warm (compile) outside the timing
+    return _best_of(run)
+
+
+def autotune_crossover(
+    store_dir: Optional[str] = None, quick: bool = True, force: bool = False
+) -> dict:
+    """One-shot per-host crossover measurement.
+
+    Times the scalar hashlib loop against the fused device lane over the
+    same blake2b blocks at increasing batch payloads; the tuned crossover
+    is the smallest payload where the device lane wins (or
+    `SCALAR_ONLY_MIN_BYTES` when it never does — the honest outcome on
+    CPU-only hosts). With `store_dir` the record persists as
+    ``verify_autotune.json`` and later calls load it instead of
+    re-measuring (`force=True` re-measures). The active crossover updates
+    either way; env IPC_VERIFY_MIN_BYTES still overrides everything.
+    """
+    global _tuned_min_bytes
+    if store_dir and not force:
+        loaded = load_autotune(store_dir)
+        if loaded is not None:
+            path = os.path.join(store_dir, _AUTOTUNE_FILE)
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+
+    sizes = [64 * 1024, 256 * 1024, 1024 * 1024]
+    if not quick:
+        sizes.append(4 * 1024 * 1024)
+    samples: "list[dict]" = []
+    min_bytes = SCALAR_ONLY_MIN_BYTES
+    scalar_only = True
+    reason = None
+    if not _device_ready():
+        reason = "no-device"
+    else:
+        try:
+            for payload in sizes:
+                cids, blocks = _autotune_fixture(payload)
+                t_scalar = _best_of(
+                    lambda: [_verify_one(c, b) for c, b in zip(cids, blocks)]
+                )
+                t_device = _device_lane_wall(cids, blocks)
+                samples.append(
+                    {
+                        "payload_bytes": payload,
+                        "scalar_s": round(t_scalar, 6),
+                        "device_s": round(t_device, 6),
+                    }
+                )
+                if scalar_only and t_device <= t_scalar:
+                    min_bytes = payload
+                    scalar_only = False
+                    # keep sampling: the record shows the full curve
+        except Exception:  # fail-soft: a device fault during tuning means the device lane cannot be trusted to win — scalar-only is the safe record
+            min_bytes = SCALAR_ONLY_MIN_BYTES
+            scalar_only = True
+            reason = "device-error"
+
+    record = {
+        "version": _AUTOTUNE_VERSION,
+        "min_bytes": min_bytes,
+        "scalar_only": scalar_only,
+        "samples": samples,
+    }
+    if reason is not None:
+        record["reason"] = reason
+    if store_dir:
+        os.makedirs(store_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=store_dir, prefix=_AUTOTUNE_FILE, suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(record, fh, indent=2, sort_keys=True)
+            os.replace(tmp, os.path.join(store_dir, _AUTOTUNE_FILE))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:  # fail-soft: best-effort temp cleanup on a failed persist
+                pass
+            raise
+    _tuned_min_bytes = min_bytes
+    return record

@@ -23,6 +23,12 @@ them:
 - **drain** (`close(drain=True)`) stops admission, flushes everything
   already accepted, and joins the batcher thread — an accepted request is
   never dropped by shutdown.
+- **priority** is two lanes: ``submit(..., low_priority=True)`` enters a
+  second bounded queue that is only drained when the interactive queue
+  is EMPTY, and low batches are assembled greedily (no wait window) so
+  the assembly thread returns to interactive work immediately. Backfill
+  windows ride this lane — a 100k-epoch job queues forever behind live
+  ``/v1/verify`` traffic, never in front of it.
 
 The batcher owns one assembly thread; the flush callback may optionally be
 dispatched to a shared executor so batch *assembly* overlaps batch
@@ -156,6 +162,9 @@ class MicroBatcher:
         self._executor = executor
         self._cond = named_condition("MicroBatcher._cond")
         self._queue: deque[PendingResult] = deque()  # guarded-by: _cond
+        # low-priority lane (backfill windows): drained only when _queue
+        # is empty, bounded by the same capacity
+        self._low: deque[PendingResult] = deque()  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
         # EWMA of recent flush wall times, seeding the retry-after hint for
         # rejected requests: "queue depth / batch size" flushes still ahead
@@ -173,11 +182,14 @@ class MicroBatcher:
         payload,
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        low_priority: bool = False,
     ) -> PendingResult:
         """Admit one request; never blocks.
 
         Raises `ServiceClosedError` after `close()`, `QueueFullError` when
-        the bounded queue is at capacity.
+        the bounded queue is at capacity. ``low_priority=True`` enters the
+        low lane: same admission contract, but the request waits behind
+        ALL interactive work (see class docstring).
         """
         now = time.monotonic()
         deadline = (now + timeout_s) if timeout_s is not None else None
@@ -185,20 +197,27 @@ class MicroBatcher:
             if self._closed:
                 self._metrics.count(f"serve.rejected_closed.{self._name}")
                 raise ServiceClosedError(f"{self._name} batcher is draining")
-            if len(self._queue) >= self._capacity:
+            lane = self._low if low_priority else self._queue
+            if len(lane) >= self._capacity:
                 self._metrics.count(f"serve.rejected_full.{self._name}")
-                batches_ahead = max(1, len(self._queue) // self._max_batch)
+                batches_ahead = max(1, len(lane) // self._max_batch)
                 raise QueueFullError(
                     retry_after_s=max(0.001, batches_ahead * self._avg_flush_s)
                 )
             pending = PendingResult(payload, deadline, now)
             pending.trace_ctx = current_context()
             pending.tenant = tenant
-            self._queue.append(pending)
-            self._metrics.set_gauge(
-                f"serve.queue_depth.{self._name}", len(self._queue)
-            )
-            self._metrics.count(f"serve.accepted.{self._name}")
+            lane.append(pending)
+            if low_priority:
+                self._metrics.set_gauge(
+                    f"serve.queue_depth_low.{self._name}", len(self._low)
+                )
+                self._metrics.count(f"serve.accepted_low.{self._name}")
+            else:
+                self._metrics.set_gauge(
+                    f"serve.queue_depth.{self._name}", len(self._queue)
+                )
+                self._metrics.count(f"serve.accepted.{self._name}")
             self._cond.notify_all()
         return pending
 
@@ -206,35 +225,59 @@ class MicroBatcher:
         with self._cond:
             return len(self._queue)
 
+    def low_depth(self) -> int:
+        with self._cond:
+            return len(self._low)
+
     # --- batch assembly ----------------------------------------------------
 
     def _run(self) -> None:
         while True:
+            low_batch = False
             with self._cond:
-                while not self._queue and not self._closed:
+                while not self._queue and not self._low and not self._closed:
                     self._cond.wait()
-                if not self._queue and self._closed:
+                if not self._queue and not self._low and self._closed:
                     return
-                batch = [self._queue.popleft()]
-                # the window opens at the OLDEST member's arrival, so a
-                # request's queueing latency is bounded by max_wait even
-                # when stragglers keep trickling in behind it
-                window_end = batch[0].enqueued_at + self._max_wait_s
-                while len(batch) < self._max_batch:
-                    if self._queue:
-                        batch.append(self._queue.popleft())
-                        continue
-                    remaining = window_end - time.monotonic()
-                    if remaining <= 0 or self._closed:
-                        break
-                    self._cond.wait(remaining)
-                    if not self._queue and (
-                        self._closed or time.monotonic() >= window_end
+                if self._queue:
+                    batch = [self._queue.popleft()]
+                    # the window opens at the OLDEST member's arrival, so a
+                    # request's queueing latency is bounded by max_wait even
+                    # when stragglers keep trickling in behind it
+                    window_end = batch[0].enqueued_at + self._max_wait_s
+                    while len(batch) < self._max_batch:
+                        if self._queue:
+                            batch.append(self._queue.popleft())
+                            continue
+                        remaining = window_end - time.monotonic()
+                        if remaining <= 0 or self._closed:
+                            break
+                        self._cond.wait(remaining)
+                        if not self._queue and (
+                            self._closed or time.monotonic() >= window_end
+                        ):
+                            break
+                else:
+                    # low lane: only reached with the interactive queue
+                    # EMPTY, assembled greedily (no wait window — waiting
+                    # would delay any interactive arrival), and abandoned
+                    # mid-fill the moment interactive work appears
+                    low_batch = True
+                    batch = [self._low.popleft()]
+                    while (
+                        self._low
+                        and len(batch) < self._max_batch
+                        and not self._queue
                     ):
-                        break
-                self._metrics.set_gauge(
-                    f"serve.queue_depth.{self._name}", len(self._queue)
-                )
+                        batch.append(self._low.popleft())
+                if low_batch:
+                    self._metrics.set_gauge(
+                        f"serve.queue_depth_low.{self._name}", len(self._low)
+                    )
+                else:
+                    self._metrics.set_gauge(
+                        f"serve.queue_depth.{self._name}", len(self._queue)
+                    )
             self._dispatch(batch)
 
     def _dispatch(self, batch: list[PendingResult]) -> None:
@@ -292,6 +335,10 @@ class MicroBatcher:
             if not drain:
                 while self._queue:
                     self._queue.popleft().fail(
+                        ServiceClosedError(f"{self._name} batcher stopped")
+                    )
+                while self._low:
+                    self._low.popleft().fail(
                         ServiceClosedError(f"{self._name} batcher stopped")
                     )
             self._cond.notify_all()

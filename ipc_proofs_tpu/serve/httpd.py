@@ -226,6 +226,43 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, self.subs.subscriptions())
         elif path == "/v1/deliveries":
             self._handle_deliveries()
+        elif path == "/v1/backfill":
+            if self.backfill is None:
+                self._send_json(404, {"error": "backfill disabled"})
+            else:
+                self._send_json(200, {"jobs": self.backfill.jobs()})
+        elif path.startswith("/v1/backfill/"):
+            self._handle_backfill_get(path)
+        else:
+            self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _handle_backfill_get(self, path: str):
+        """``GET /v1/backfill/<id>`` — job status/cursor;
+        ``GET /v1/backfill/<id>/chunks?cursor=<n>[&wait_s=<s>]`` — the
+        long-poll chunk fetch, `subs/delivery.py` cursor semantics: a
+        poll from cursor N acks everything ≤ N (streamed payloads drop
+        from memory; the journal keeps the bytes) and blocks up to
+        ``wait_s`` for the first chunk above it."""
+        if self.backfill is None:
+            self._send_json(404, {"error": "backfill disabled"})
+            return
+        rest = path[len("/v1/backfill/") :]
+        job_id, _, tail = rest.partition("/")
+        job = self.backfill.job(job_id)
+        if job is None:
+            self._send_json(404, {"error": f"no such backfill job: {job_id}"})
+            return
+        if tail == "":
+            self._send_json(200, job.status())
+        elif tail == "chunks":
+            q = parse_qs(urlsplit(self.path).query)
+            try:
+                cursor = int((q.get("cursor") or ["0"])[0])
+                wait_s = min(30.0, max(0.0, float((q.get("wait_s") or ["0"])[0])))
+            except ValueError:
+                self._send_json(400, {"error": "cursor/wait_s must be numeric"})
+                return
+            self._send_json(200, job.chunks_after(cursor, wait_s=wait_s))
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
 
@@ -296,8 +333,52 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_subscribe(body)
         elif self.path == "/v1/unsubscribe":
             self._handle_unsubscribe(body)
+        elif self.path == "/v1/backfill":
+            self._handle_backfill_submit(body)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _handle_backfill_submit(self, body: dict):
+        """``POST /v1/backfill`` — submit one durable backfill job over
+        rows ``[pair_start, pair_end)`` of the server pair table (the
+        service's event filter is the job's filter; the pair table IS the
+        epoch range). Idempotent: an identical range re-submit returns
+        the running job, or resumes its journal after a crash."""
+        if self.backfill is None:
+            self._send_json(404, {"error": "backfill disabled"})
+            return
+        n = len(self.pairs)
+        start = body.get("pair_start")
+        end = body.get("pair_end")
+
+        def _row(v) -> bool:
+            return isinstance(v, int) and not isinstance(v, bool)
+
+        if not (_row(start) and _row(end) and 0 <= start < end <= n):
+            self._send_json(
+                400,
+                {
+                    "error": "pair_start/pair_end must be ints with "
+                    f"0 <= start < end <= {n} (server pair table)"
+                },
+            )
+            return
+        wsize = body.get("window_size")
+        if wsize is not None and (not _row(wsize) or wsize < 1):
+            self._send_json(400, {"error": "window_size must be a positive int"})
+            return
+        sub_id = body.get("sub_id")
+        if sub_id is not None and not isinstance(sub_id, str):
+            self._send_json(400, {"error": "sub_id must be a string"})
+            return
+        try:
+            job = self.backfill.submit(
+                start, end, window_size=wsize, sub_id=sub_id
+            )
+        except (ValueError, RuntimeError) as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        self._send_json(200, job.status())
 
     def _handle_subscribe(self, body: dict):
         if self.subs is None:
@@ -645,11 +726,13 @@ class ProofHTTPServer:
         subs=None,
         slo=None,
         tenants=None,
+        backfill=None,
     ):
         self.service = service
         self.durable = durable
         self.subs = subs
         self.slo = slo
+        self.backfill = backfill  # backfill.BackfillEngine (or None)
         # tenant accounting is always on (bounded top-K, so it's safe);
         # pass an explicit ledger to share one across servers or set top_k
         self.tenants = (
@@ -667,6 +750,7 @@ class ProofHTTPServer:
                 "subs": subs,
                 "slo": slo,
                 "tenants": self.tenants,
+                "backfill": backfill,
             },
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -709,6 +793,11 @@ class ProofHTTPServer:
             self._thread.join(timeout)
         if self.slo is not None:
             self.slo.stop()
+        # backfill aborts at its next window boundary BEFORE the service
+        # drains — its window runner submits into the service's batcher,
+        # which must still be accepting while running jobs wind down
+        if self.backfill is not None:
+            self.backfill.close(timeout=timeout)
         if self.subs is not None:
             self.subs.drain()
         self.service.drain(timeout=timeout)

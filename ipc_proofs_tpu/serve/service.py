@@ -194,6 +194,18 @@ class _GenerateRequest:
         self.key = _pair_key(self.pair)
 
 
+@dataclass
+class _RangeWindowRequest:
+    """One backfill epoch window riding the generate batcher's LOW lane.
+
+    The payload is a whole pair list (not one pair): the window executes
+    as a single chunked-driver call, so its bundle is the canonical
+    bytes for exactly those pairs and folds bit-identically."""
+
+    pairs: list
+    chunk_size: Optional[int] = None
+
+
 class ProofService:
     """Micro-batching proof server (in-process API).
 
@@ -241,6 +253,16 @@ class ProofService:
                 batch_verify=self.config.batch_verify,
             )
             store = PlaneBlockstore(self.fetch_plane)
+        if self.config.batch_verify and self.config.store_dir:
+            # per-host verify-lane crossover: first daemon on a host
+            # measures and persists verify_autotune.json under the store
+            # dir, later ones load it (env IPC_VERIFY_MIN_BYTES overrides)
+            from ipc_proofs_tpu.ops.verify_jax import autotune_crossover
+
+            try:
+                autotune_crossover(self.config.store_dir)
+            except Exception:  # fail-soft: serving must come up on the default crossover if tuning fails
+                pass
         self._disk_store = None
         if store is not None and self.config.store_dir:
             from ipc_proofs_tpu.storex import SegmentStore, TieredBlockstore
@@ -360,6 +382,31 @@ class ProofService:
         return self.submit_generate(
             pair, timeout_s=timeout_s, tenant=tenant
         ).result()
+
+    def submit_range_window(
+        self,
+        pairs: Sequence[TipsetPair],
+        chunk_size: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+    ) -> PendingResult:
+        """Admit one backfill window on the generate batcher's LOW lane.
+
+        The window waits behind ALL interactive verify/generate traffic
+        (`MicroBatcher` priority semantics) and executes as one canonical
+        chunked-driver call; ``.result()`` is the window's
+        `UnifiedProofBundle`. This is the `BackfillEngine` runner for a
+        single daemon — a saturating backfill job can never starve
+        ``/v1/verify``, because its windows only dispatch when the
+        interactive queue is empty and occupy at most one worker."""
+        if self._generate_batcher is None:
+            raise RuntimeError(
+                "generate path disabled: service was built without store/spec"
+            )
+        return self._generate_batcher.submit(
+            _RangeWindowRequest(list(pairs), chunk_size),
+            timeout_s=timeout_s,
+            low_priority=True,
+        )
 
     def generate_range(
         self, pairs: Sequence[TipsetPair], chunk_size: Optional[int] = None
@@ -607,6 +654,11 @@ class ProofService:
 
     def _flush_generate(self, batch: list[PendingResult]) -> None:
         """Deduplicate pairs → one range-driver call → split proofs by pair."""
+        if isinstance(batch[0].payload, _RangeWindowRequest):
+            # low-lane batches assemble exclusively from the low lane, so
+            # a batch is either all interactive pairs or all windows
+            self._flush_range_windows(batch)
+            return
         exec_start = monotonic()
         unique: dict[tuple, TipsetPair] = {}
         for pending in batch:
@@ -698,6 +750,29 @@ class ProofService:
                 slow.append((pending, total_ms, timing))
         for pending, total_ms, timing in slow:
             self._maybe_log_slow(pending, "generate", total_ms, timing)
+
+    def _flush_range_windows(self, batch: list[PendingResult]) -> None:
+        """Execute backfill windows: one canonical chunked-driver call per
+        window (byte-identical to the same pairs served interactively).
+        Windows fail individually — one bad window never poisons its
+        batch neighbors' jobs."""
+        for pending in batch:
+            req: _RangeWindowRequest = pending.payload
+            try:
+                with use_context(pending.trace_ctx):
+                    with self.metrics.stage("serve.backfill_window"):
+                        bundle = generate_event_proofs_for_range_chunked(
+                            self._store,
+                            req.pairs,
+                            self._spec,
+                            chunk_size=req.chunk_size or len(req.pairs),
+                            metrics=self.metrics,
+                            match_backend=self._match_backend,
+                        )
+            except BaseException as exc:  # fail-soft: the window's job sees the error; other windows proceed
+                pending.fail(exc)
+                continue
+            pending.complete(bundle)
 
 
 def sequential_verify_baseline(

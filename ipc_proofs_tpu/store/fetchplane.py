@@ -223,6 +223,40 @@ class FetchPlane:
         if dropped:
             self._metrics.count("fetch.speculative_dropped", dropped)
 
+    def prime(self, cids: "Iterable[CID]") -> None:
+        """Schedule-driven speculation: like `speculate`, but EXEMPT from
+        the ``speculate_depth`` gate. The backfill work-ahead feeder calls
+        this with the tipset headers of windows it KNOWS will execute —
+        adaptive backoff (which watches the waste ratio of link-chasing
+        guesses) must not drop certain-future demand. Primed wants still
+        ride the speculative queue (bounded, droppable, counted), so a
+        runaway schedule degrades into drops, never unbounded memory."""
+        fresh = [c for c in cids if not self._local_has(c)]
+        if not fresh:
+            return
+        added = dropped = 0
+        with self._cond:
+            if self._closed:
+                return
+            for cid in fresh:
+                if cid in self._wants:
+                    continue
+                if len(self._spec_q) >= self.spec_queue_cap:
+                    dropped += 1
+                    continue
+                self._wants[cid] = _Want(cid, speculative=True, depth=1)
+                self._spec_q.append(cid)
+                added += 1
+            if added:
+                self._ensure_dispatchers_locked()
+                self._cond.notify(added)
+        if added:
+            self._metrics.count("fetch.wants", added)
+            self._metrics.count("fetch.speculative_wants", added)
+            self._metrics.count("fetch.schedule_primed", added)
+        if dropped:
+            self._metrics.count("fetch.speculative_dropped", dropped)
+
     def fetch_into(self, cids: "Iterable[CID]", into: dict) -> "dict[CID, Exception]":
         """Prefetch-wave entry point (`RpcBlockstore.prefetch` reroutes
         here): register every miss as a demand want, then collect — the

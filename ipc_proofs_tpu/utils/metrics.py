@@ -34,6 +34,8 @@ __all__ = [
     "SUBS_COUNTERS",
     "VERIFY_COUNTERS",
     "WITNESS_COUNTERS",
+    "BACKFILL_COUNTERS",
+    "BACKFILL_GAUGES",
     "FLEET_COUNTERS",
     "SLO_COUNTERS",
     "TENANT_COUNTERS",
@@ -113,6 +115,11 @@ RESILIENCE_COUNTERS = (
 #                             whose counted waste ratio crossed the
 #                             threshold and lowered speculate_depth by one
 #                             (--speculate-depth auto)
+#   fetch.schedule_primed   — CIDs entered through `FetchPlane.prime`:
+#                             schedule-driven speculation from the backfill
+#                             work-ahead feeder, exempt from the adaptive
+#                             depth gate (the scheduler KNOWS these blocks
+#                             will be demanded)
 ASYNCFETCH_COUNTERS = (
     "rpc.batch_calls",
     "rpc.batched_reads",
@@ -129,6 +136,7 @@ ASYNCFETCH_COUNTERS = (
     "fetch.speculative_dropped",
     "fetch.speculative_integrity_drops",
     "fetch.speculate_depth_downshifts",
+    "fetch.schedule_primed",
 )
 
 # Counter vocabulary of the durability layer (jobs/journal.py, jobs/job.py,
@@ -240,6 +248,7 @@ VERIFY_COUNTERS = (
 # (`generate`/`verify`) into the counter, e.g. `serve.accepted.generate`.
 SERVE_COUNTERS = (
     "serve.accepted.*",
+    "serve.accepted_low.*",  # low-priority lane admissions (backfill windows)
     "serve.rejected_closed.*",
     "serve.rejected_full.*",
     "serve.deadline_exceeded.*",
@@ -426,11 +435,13 @@ PIPELINE_STAGES = (
     "range_storage",
     "serve.generate_batch",
     "serve.verify_batch",
+    "serve.backfill_window",
 )
 
 # Gauge vocabulary: instantaneous state, overwritten not accumulated.
 SERVE_GAUGES = (
     "serve.queue_depth.*",  # per-batcher queue depth (generate/verify)
+    "serve.queue_depth_low.*",  # per-batcher LOW-priority lane depth
     "serve.result_cache_bytes",  # hot bytes in the spilled result cache
 )
 DURABILITY_GAUGES = (
@@ -460,6 +471,38 @@ SERVE_HISTOGRAMS = (
 
 SUBS_HISTOGRAMS = (
     "subs.delivery_lag_ms",  # append→ack latency of webhook/long-poll acks
+)
+
+# Counter vocabulary of the bulk backfill engine (ipc_proofs_tpu/backfill/):
+#   backfill.jobs            — jobs submitted (fresh threads launched; an
+#                              idempotent resubmit of a RUNNING job does
+#                              not count)
+#   backfill.jobs_resumed    — submits that replayed ≥1 committed window
+#                              from the job's IPJ1 journal
+#   backfill.windows         — windows proved fresh and committed
+#   backfill.windows_replayed— windows satisfied from the journal on resume
+#   backfill.epochs          — epochs covered by emitted windows (fresh +
+#                              replayed; the epochs/s numerator)
+#   backfill.chunks_streamed — chunks entered into jobs' cursor logs
+#   backfill.catchup_deliveries — windows landed on a standing-query
+#                              delivery log (sub_id catch-up; dedup
+#                              absorbs resume replays without a count)
+#   backfill.window_failures — jobs failed by a window-runner error or
+#                              engine shutdown (journal keeps committed
+#                              windows for resume)
+BACKFILL_COUNTERS = (
+    "backfill.jobs",
+    "backfill.jobs_resumed",
+    "backfill.windows",
+    "backfill.windows_replayed",
+    "backfill.epochs",
+    "backfill.chunks_streamed",
+    "backfill.catchup_deliveries",
+    "backfill.window_failures",
+)
+
+BACKFILL_GAUGES = (
+    "backfill.active_jobs",  # jobs currently in the running state
 )
 
 # Fleet observability plane (obs/fleet.py): the router's federation loop

@@ -222,6 +222,20 @@ _ONCHIP_OK = {
     "onchip_match_events": 1 << 20,
     "onchip_verify_blocks": 1024,
     "onchip_device_calls": 2,
+    "verify_tuned_speedup": 4.0,
+    "verify_autotune_scalar_only": False,
+    "verify_autotuned_min_bytes": 262144,
+}
+
+_BACKFILL_OK = {
+    "backfill_epochs_per_sec": 95.0,
+    "backfill_epochs_per_sec_1shard": 30.0,
+    "backfill_ttfc_ms": 140.0,
+    "backfill_total_ms": 670.0,
+    "backfill_occupancy_pct": 61.0,
+    "backfill_windows": 8,
+    "backfill_epochs": 64,
+    "backfill_shards": 4,
 }
 
 _E2E_OK = {
@@ -259,6 +273,7 @@ class TestOrchestrate:
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
+            "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -298,6 +313,11 @@ class TestOrchestrate:
         assert out["legs"]["fleetobs"] == "ok:cpu"
         assert out["fleetobs_overhead_pct"] == 1.4
         assert out["fleetobs_stitched_spans"] == 16
+        assert out["legs"]["backfill"] == "ok:cpu"
+        assert out["backfill_epochs_per_sec"] == 95.0
+        assert out["backfill_ttfc_ms"] == 140.0
+        assert out["verify_tuned_speedup"] == 4.0
+        assert out["verify_autotune_scalar_only"] is False
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -318,6 +338,7 @@ class TestOrchestrate:
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
+            "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -332,7 +353,7 @@ class TestOrchestrate:
             ("resilience", "cpu"), ("durability", "cpu"),
             ("observability", "cpu"), ("storage", "cpu"),
             ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
-            ("fleetobs", "cpu"),
+            ("fleetobs", "cpu"), ("backfill", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -353,6 +374,7 @@ class TestOrchestrate:
             "cluster": [(dict(_CLUSTER_OK), "ok:cpu")],
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
+            "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -404,6 +426,7 @@ class TestOrchestrate:
             "cluster": [(None, "error:cpu")],
             "standing": [(None, "error:cpu")],
             "fleetobs": [(None, "error:cpu")],
+            "backfill": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -427,6 +450,10 @@ class TestOrchestrate:
             "standing_generations_per_tipset",
             "fleetobs_overhead_pct", "fleetobs_rps_plain",
             "fleetobs_rps_observed", "fleetobs_stitched_spans",
+            "verify_tuned_speedup", "verify_autotune_scalar_only",
+            "verify_autotuned_min_bytes", "backfill_epochs_per_sec",
+            "backfill_ttfc_ms", "backfill_total_ms",
+            "backfill_occupancy_pct",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

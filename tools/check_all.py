@@ -43,6 +43,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 # Pure-math and codec suites add wall-clock but no lock edges, so the
 # lockdep sweep stays a sub-minute gate instead of a full tier-1 re-run.
 LOCKDEP_TEST_FILES = (
+    "tests/test_backfill.py",
     "tests/test_cluster.py",
     "tests/test_crash_recovery.py",
     "tests/test_fetchplane.py",

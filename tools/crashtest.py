@@ -65,6 +65,43 @@ def _build_world(n_pairs: int, receipts: int, events: int, match_rate: float):
     return store, pairs, spec
 
 
+def backfill_child_main(args) -> int:
+    """Forked backfill driver: deterministic world → journaled
+    `BackfillEngine` job at ``--chunk-size`` epochs per window.
+
+    The engine journals under ``--job-dir/<job-id>`` through the same
+    IPJ1 writer as the range driver, so the ``IPC_JOURNAL_CRASH_AT`` /
+    ``IPC_JOURNAL_CRASH_TORN`` hooks SIGKILL it at exactly the same
+    commit points — window boundary or torn mid-record."""
+    from ipc_proofs_tpu.backfill import BackfillEngine, local_window_runner
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    store, pairs, spec = _build_world(
+        args.pairs, args.receipts, args.events, args.match_rate
+    )
+    metrics = Metrics()
+    engine = BackfillEngine(
+        pairs,
+        spec,
+        local_window_runner(store, spec, metrics=metrics),
+        jobs_dir=args.job_dir,
+        window_size=args.chunk_size,
+        metrics=metrics,
+    )
+    try:
+        bundle = engine.submit(0, len(pairs)).result(timeout=600.0)
+    finally:
+        engine.close()
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(bundle.to_json())
+    os.replace(tmp, args.out)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as fh:
+            json.dump({"counters": metrics.snapshot()["counters"]}, fh)
+    return 0
+
+
 def child_main(args) -> int:
     """Forked driver: deterministic world → journaled pipelined range run.
 
@@ -109,6 +146,7 @@ def _spawn_child(
     metrics_out: "str | None" = None,
     timeout_s: float = 300.0,
     extra_env: "dict | None" = None,
+    backfill: bool = False,
 ) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
@@ -118,6 +156,8 @@ def _spawn_child(
         "--match-rate", str(shape["match_rate"]),
         "--record-workers", str(shape.get("record_workers") or 1),
     ]
+    if backfill:
+        cmd.append("--backfill")
     if metrics_out:
         cmd += ["--metrics-out", metrics_out]
     env = dict(os.environ)
@@ -202,6 +242,159 @@ def crash_run(
     if res["outcome"] == "identical" and res["chunks_replayed"] != n_records:
         res["outcome"] = "replay_miscount"  # resumed run must reuse every commit
     return res
+
+
+def _find_backfill_journal(jobs_dir: str) -> "str | None":
+    """The backfill engine journals under ``jobs_dir/<bf-...>/`` — one
+    subdirectory per deterministic job id. Locate the journal post-mortem."""
+    from ipc_proofs_tpu.jobs import JOBS_JOURNAL_NAME
+
+    if not os.path.isdir(jobs_dir):
+        return None
+    for name in sorted(os.listdir(jobs_dir)):
+        jpath = os.path.join(jobs_dir, name, JOBS_JOURNAL_NAME)
+        if os.path.exists(jpath):
+            return jpath
+    return None
+
+
+def backfill_crash_run(
+    reference: str,
+    shape: dict,
+    crash_at: int,
+    torn: "int | None",
+    workdir: str,
+    tag: "str | int" = 0,
+) -> dict:
+    """One backfill kill point: SIGKILL the `BackfillEngine` child at the
+    ``crash_at``-th window commit (optionally torn at byte ``torn``),
+    resume it from the same jobs dir, and demand the final bundle be
+    byte-identical to the reference. The resumed run must replay every
+    committed window from the journal (``jobs.chunks_replayed`` at the
+    journal layer, ``backfill.windows_replayed`` at the engine)."""
+    jobs_dir = os.path.join(workdir, f"bfjob_{tag}_at{crash_at}_torn{torn}")
+    out = os.path.join(workdir, f"bfout_{tag}_at{crash_at}_torn{torn}.json")
+    metrics_out = out + ".metrics"
+    res = {"crash_at": crash_at, "torn": torn}
+
+    crashed = _spawn_child(
+        jobs_dir, out, shape, crash_at=crash_at, torn=torn, backfill=True
+    )
+    if crashed.returncode != -signal.SIGKILL:
+        res["outcome"] = "no_crash"
+        res["rc"] = crashed.returncode
+        res["stderr"] = crashed.stderr[-2000:]
+        return res
+
+    from ipc_proofs_tpu.jobs import read_journal
+
+    jpath = _find_backfill_journal(jobs_dir)
+    n_records, torn_tail = 0, False
+    if jpath is not None:
+        records, _, torn_tail = read_journal(jpath)
+        n_records = len(records)
+    res["records_after_crash"] = n_records
+    res["torn_tail"] = torn_tail
+    expect = crash_at if torn is not None else crash_at + 1
+    if n_records != expect:
+        res["outcome"] = "journal_mismatch"
+        res["expected_records"] = expect
+        return res
+
+    resumed = _spawn_child(
+        jobs_dir, out, shape, metrics_out=metrics_out, backfill=True
+    )
+    if resumed.returncode != 0:
+        res["outcome"] = "resume_failed"
+        res["rc"] = resumed.returncode
+        res["stderr"] = resumed.stderr[-2000:]
+        return res
+    with open(out) as fh:
+        final = fh.read()
+    with open(metrics_out) as fh:
+        counters = json.load(fh)["counters"]
+    res["chunks_replayed"] = counters.get("jobs.chunks_replayed", 0)
+    res["windows_replayed"] = counters.get("backfill.windows_replayed", 0)
+    res["outcome"] = "identical" if final == reference else "divergent"
+    if res["outcome"] == "identical" and (
+        res["chunks_replayed"] != n_records
+        or res["windows_replayed"] != n_records
+    ):
+        res["outcome"] = "replay_miscount"  # resumed run must reuse every commit
+    return res
+
+
+def run_backfill_grid(
+    base_seed: int,
+    points: int = 6,
+    n_pairs: int = 12,
+    window_size: int = 2,
+    receipts: int = 4,
+    events: int = 2,
+    match_rate: float = 0.2,
+    log=lambda msg: None,
+) -> dict:
+    """Seeded kill-point grid over the backfill engine: half
+    window-boundary kills, half torn mid-record writes. The reference is
+    the CHUNKED RANGE DRIVER over the same world at the same chunking —
+    so the grid also re-asserts the backfill/driver byte-identity law
+    under crash-resume, not just on the happy path."""
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+
+    shape = {
+        "pairs": n_pairs, "chunk_size": window_size,
+        "receipts": receipts, "events": events, "match_rate": match_rate,
+        "record_workers": 1,
+    }
+    n_windows = (n_pairs + window_size - 1) // window_size
+    store, pairs, spec = _build_world(n_pairs, receipts, events, match_rate)
+    reference = generate_event_proofs_for_range_chunked(
+        store, pairs, spec, chunk_size=window_size
+    ).to_json()
+
+    rng = random.Random(base_seed)
+    kill_points = []
+    for i in range(points):
+        crash_at = rng.randrange(n_windows - 1) if n_windows > 1 else 0
+        if i % 2 == 0:
+            kill_points.append((crash_at, None))  # window-boundary kill
+        else:
+            kill_points.append((crash_at, rng.choice([1, 5, 11, 13, 64, 4096])))
+
+    counts: dict[str, int] = {}
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="crashtest_backfill_") as workdir:
+        for i, (crash_at, torn) in enumerate(kill_points):
+            res = backfill_crash_run(
+                reference, shape, crash_at, torn, workdir, tag=i
+            )
+            counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+            if res["outcome"] != "identical":
+                violations.append(res)
+            log(
+                f"backfill kill at window {crash_at}"
+                + (f" torn@{torn}B" if torn is not None else " (boundary)")
+                + f": {res['outcome']}"
+                + (
+                    f" ({res.get('records_after_crash')} committed, "
+                    f"{res.get('windows_replayed')} replayed)"
+                    if "records_after_crash" in res else ""
+                )
+            )
+    boundary = sum(1 for _, t in kill_points if t is None)
+    ok = (
+        not violations
+        and boundary > 0
+        and boundary < len(kill_points)  # both flavors exercised
+    )
+    return {
+        "ok": ok,
+        "points": len(kill_points),
+        "kill_points": kill_points,
+        "n_windows": n_windows,
+        "counts": counts,
+        "violations": violations,
+    }
 
 
 def compaction_crash_run(
@@ -433,6 +626,12 @@ def main(argv=None) -> int:
         help="also run the kill-during-compaction grid (torn snapshot "
         "sidecar + post-swap kills via IPC_COMPACT_CRASH_*)",
     )
+    ap.add_argument(
+        "--backfill", action="store_true",
+        help="run the kill grid against the backfill engine instead of "
+        "the range driver (reference = chunked driver; in --child mode, "
+        "selects the backfill child)",
+    )
     # --child: the forked driver entrypoint (internal)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--job-dir", help=argparse.SUPPRESS)
@@ -443,12 +642,25 @@ def main(argv=None) -> int:
     if args.child:
         if not args.job_dir or not args.out:
             ap.error("--child needs --job-dir and --out")
-        return child_main(args)
+        return backfill_child_main(args) if args.backfill else child_main(args)
     if args.seed is None:
         ap.error("seed is required")
 
     points = 4 if args.quick and args.points == 8 else args.points
     t0 = time.time()
+    if args.backfill:
+        summary = run_backfill_grid(
+            args.seed, points=points, n_pairs=args.pairs,
+            window_size=args.chunk_size, receipts=args.receipts,
+            events=args.events, match_rate=args.match_rate,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+        print(json.dumps(summary, indent=2))
+        if not summary["ok"]:
+            print("CRASH-RECOVERY INVARIANT VIOLATED", file=sys.stderr)
+            return 1
+        print("CRASH RECOVERY CLEAN")
+        return 0
     summary = run_grid(
         args.seed, points=points, n_pairs=args.pairs,
         chunk_size=args.chunk_size, receipts=args.receipts,
