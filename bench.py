@@ -58,6 +58,7 @@ LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
     "asyncfetch", "cluster", "standing", "fleetobs", "onchip", "backfill",
+    "zerocopy",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -80,6 +81,7 @@ _LEG_TIMEOUTS = {
     "fleetobs": (420.0, 240.0),
     "onchip": (480.0, 240.0),
     "backfill": (420.0, 240.0),
+    "zerocopy": (420.0, 240.0),
 }
 
 
@@ -2220,6 +2222,169 @@ def _leg_standing(args) -> dict:
     }
 
 
+def _leg_zerocopy(args) -> dict:
+    """Zero-copy streaming wire + per-tenant QoS (host-only, hermetic).
+
+    Phase 1 — streaming: a disk-tier-warm service answers ``/v1/generate``
+    over the chunked binary stream wire. Block payloads must leave as
+    mmap-backed `memoryview` slices of segment frames, so the tentpole
+    meter ``warm_block_bytes_copied_per_resp`` (copied block-payload bytes
+    per streamed response) must be EXACTLY 0 on every host — gated
+    host-shape-independently by ``tools/check_bench_schema.py``. Also
+    reports ``stream_ttfb_ms`` (p50 time-to-first-byte: request written →
+    first response byte readable — the chunk-as-produced win the buffered
+    path structurally cannot have).
+
+    Phase 2 — QoS fairness: one heavy tenant saturates the generate
+    batcher from ``qos_heavy_concurrency`` closed-loop threads while a
+    light tenant sends occasional single requests. The batcher's
+    deficit-round-robin tenant queues must bound the light tenant's
+    ``qos_light_tenant_p99_ms`` near one batch's service time instead of
+    the heavy backlog's drain time (``qos_heavy_backlog_drain_ms``);
+    the schema gate checks the ratio and skips (with a printed reason)
+    on hosts with ≤ 2 cores, where there is no parallelism for fairness
+    to arbitrate.
+    """
+    import os as _os
+    import shutil
+    import tempfile
+    import threading
+
+    from http.client import HTTPConnection
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.serve import ProofService, ServiceConfig
+    from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+    from ipc_proofs_tpu.witness.stream import decode_bundle_stream
+
+    n_pairs = 2 if args.quick else 4
+    receipts = 8 if args.quick else 16
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, events_per_receipt=2,
+        match_rate=0.5, signature=SIG, topic1=TOPIC1, actor_id=ACTOR,
+    )
+    spec = EventProofSpec(
+        event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR
+    )
+    root = tempfile.mkdtemp(prefix="bench-zerocopy-")
+    try:
+        service = ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(
+                max_batch=8, max_wait_ms=2.0, workers=2, store_dir=root,
+            ),
+        )
+        httpd = ProofHTTPServer(service, pairs=pairs).start()
+
+        def post(obj):
+            conn = HTTPConnection("127.0.0.1", httpd.port, timeout=120)
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/v1/generate", json.dumps(obj),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            first = resp.read(1)
+            ttfb_ms = (time.perf_counter() - t0) * 1e3
+            data = first + resp.read()
+            conn.close()
+            return resp.status, data, ttfb_ms
+
+        # warm pass: the buffered responses spill every block into the
+        # disk tier's segment files — the frames the stream then slices
+        for i in range(n_pairs):
+            st, data, _ = post({"pair_index": i})
+            assert st == 200, data[:200]
+
+        reps = 16 if args.quick else 48
+        c0 = service.metrics_snapshot()["counters"]
+        ttfbs = []
+        for r in range(reps):
+            st, data, ttfb_ms = post({"pair_index": r % n_pairs, "stream": True})
+            assert st == 200, data[:200]
+            decode_bundle_stream(data)  # reassembly must verify, every time
+            ttfbs.append(ttfb_ms)
+        c1 = service.metrics_snapshot()["counters"]
+        responses = c1.get("serve.stream.responses", 0) - c0.get(
+            "serve.stream.responses", 0
+        )
+        copied = c1.get("serve.stream.copied_bytes", 0) - c0.get(
+            "serve.stream.copied_bytes", 0
+        )
+        zero_copy = c1.get("serve.stream.zero_copy_bytes", 0) - c0.get(
+            "serve.stream.zero_copy_bytes", 0
+        )
+        assert responses == reps, (responses, reps)
+        ttfbs.sort()
+        ttfb_p50 = ttfbs[len(ttfbs) // 2]
+        httpd.shutdown(timeout=30)
+        service.drain()
+
+        # ---- phase 2: light tenant under a heavy tenant's flood ----------
+        service = ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(max_batch=4, max_wait_ms=2.0, workers=2),
+        )
+        heavy_threads = 6
+        light_reps = 10 if args.quick else 25
+        stop = threading.Event()
+        heavy_done = []
+
+        def heavy():
+            n = 0
+            while not stop.is_set():
+                service.generate(pairs[n % n_pairs], tenant="bulk-heavy")
+                n += 1
+            heavy_done.append(n)
+
+        threads = [
+            threading.Thread(target=heavy) for _ in range(heavy_threads)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # let the heavy backlog establish
+        light_lat = []
+        for i in range(light_reps):
+            t0 = time.perf_counter()
+            service.generate(pairs[i % n_pairs], tenant="interactive-light")
+            light_lat.append((time.perf_counter() - t0) * 1e3)
+        t_drain0 = time.perf_counter()
+        stop.set()
+        for t in threads:
+            t.join()
+        drain_ms = (time.perf_counter() - t_drain0) * 1e3
+        service.drain()
+        light_lat.sort()
+        light_p50 = light_lat[len(light_lat) // 2]
+        light_p99 = light_lat[max(0, int(len(light_lat) * 0.99) - 1)]
+        heavy_requests = sum(heavy_done)
+        _log(
+            f"bench: zerocopy: {responses} streamed responses, "
+            f"{copied / max(1, responses):.1f} copied B/resp "
+            f"({zero_copy / max(1, responses):,.0f} zero-copy B/resp), "
+            f"ttfb p50 {ttfb_p50:.1f}ms; light tenant p50 {light_p50:.1f}ms "
+            f"p99 {light_p99:.1f}ms beside {heavy_requests} heavy requests "
+            f"from {heavy_threads} threads"
+        )
+        return {
+            "warm_block_bytes_copied_per_resp": round(
+                copied / max(1, responses), 2
+            ),
+            "stream_ttfb_ms": round(ttfb_p50, 2),
+            "qos_light_tenant_p99_ms": round(light_p99, 2),
+            "qos_light_tenant_p50_ms": round(light_p50, 2),
+            "qos_heavy_backlog_drain_ms": round(drain_ms, 2),
+            "zerocopy_bytes_per_resp": round(zero_copy / max(1, responses)),
+            "zerocopy_responses": responses,
+            "qos_heavy_concurrency": heavy_threads,
+            "qos_heavy_requests": heavy_requests,
+            "zerocopy_host_cpus": _os.cpu_count(),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -2238,6 +2403,7 @@ _LEG_FNS = {
     "fleetobs": _leg_fleetobs,
     "onchip": _leg_onchip,
     "backfill": _leg_backfill,
+    "zerocopy": _leg_zerocopy,
 }
 
 
@@ -2546,6 +2712,8 @@ def _orchestrate(args) -> None:
     legs_status["fleetobs"] = status
     backfill, status = _run_leg("backfill", args, "cpu")
     legs_status["backfill"] = status
+    zerocopy, status = _run_leg("zerocopy", args, "cpu")
+    legs_status["zerocopy"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -2657,6 +2825,15 @@ def _orchestrate(args) -> None:
     )
     for k in _BACKFILL_KEYS:
         out[k] = (backfill or {}).get(k)
+    _ZEROCOPY_KEYS = (
+        "warm_block_bytes_copied_per_resp", "stream_ttfb_ms",
+        "qos_light_tenant_p99_ms", "qos_light_tenant_p50_ms",
+        "qos_heavy_backlog_drain_ms", "zerocopy_bytes_per_resp",
+        "zerocopy_responses", "qos_heavy_concurrency", "qos_heavy_requests",
+        "zerocopy_host_cpus",
+    )
+    for k in _ZEROCOPY_KEYS:
+        out[k] = (zerocopy or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

@@ -843,6 +843,8 @@ def _cmd_serve(args) -> int:
             witness_compress=(args.witness_compress == "on"),
             witness_agg_max=args.witness_agg_max,
             witness_base_cache=args.witness_base_cache,
+            tenant_rate=args.tenant_rate,
+            tenant_burst=args.tenant_burst,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -917,6 +919,10 @@ def _cmd_serve(args) -> int:
             retry_base_s=args.delivery_retry_base_s,
             retry_max_s=args.delivery_retry_max_s,
             delta=(args.witness_delta == "on"),
+            # generate-capable service → standing-query generations ride
+            # the batcher's PUSH lane (one priority order with
+            # interactive requests and backfill windows)
+            service=(service if spec is not None and store is not None else None),
         )
         if subs.registry.replayed:
             log.info(
@@ -1121,6 +1127,10 @@ def _cmd_cluster(args) -> int:
         scrape_timeout_s=args.scrape_timeout_s,
         slo=slo,
         tenant_top_k=args.tenant_top_k,
+        # QoS lives at the front door only: a router-admitted request
+        # must never 429 mid-scatter, so shards run unthrottled
+        tenant_rate=args.tenant_rate,
+        tenant_burst=args.tenant_burst,
     )
     httpd = RouterHTTPServer(router, host=args.host, port=args.port)
     httpd.start()
@@ -1372,6 +1382,19 @@ def main(argv=None) -> int:
             help="track per-tenant request/byte counters for the first K "
             "distinct tenants; later tenants aggregate into the 'other' "
             "bucket (bounds metric cardinality; default 8)",
+        )
+        p.add_argument(
+            "--tenant-rate", type=float, default=None, metavar="R",
+            help="per-tenant QoS: admit at most R proof requests/second "
+            "per tenant (token bucket; sustained excess gets a typed 429 "
+            "with Retry-After). Also arms the batcher's weighted-fair "
+            "tenant ordering. Default off (no throttling)",
+        )
+        p.add_argument(
+            "--tenant-burst", type=float, default=None, metavar="B",
+            help="token-bucket burst depth per tenant (default 2×R): "
+            "short spikes up to B requests admit immediately, then the "
+            "bucket refills at --tenant-rate",
         )
 
     gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")

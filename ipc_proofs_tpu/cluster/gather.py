@@ -93,11 +93,17 @@ class BundleFold:
         self._by_cid: "Dict[bytes, ProofBlock]" = {}
         self._sealed = False
 
-    def fold(self, bundle: UnifiedProofBundle) -> None:
+    def fold(self, bundle: UnifiedProofBundle) -> "List[ProofBlock]":
         """Fold one sub-bundle: bucket its proofs by pair, union its
         witness blocks into the single CID map (conflict-checked, never
         sorted here — sorting N times over an ever-growing map is the
-        quadratic arrival cost `seal()` exists to avoid)."""
+        quadratic arrival cost `seal()` exists to avoid).
+
+        Returns the blocks this fold saw for the FIRST time, in the
+        sub-bundle's order — the streamed door sends exactly these as
+        ``B`` chunks, so a block shared by several shards' sub-bundles
+        crosses the client wire once even though each shard shipped it.
+        """
         if self._sealed:
             raise RuntimeError("BundleFold already sealed")
         for proof in bundle.event_proofs:
@@ -116,16 +122,19 @@ class BundleFold:
                     f"{proof.child_block_cid} (not in this request)"
                 )
             self._storage_buckets[idx].append(proof)
+        fresh: "List[ProofBlock]" = []
         for block in bundle.blocks:
             raw = block.cid.to_bytes()
             prior = self._by_cid.get(raw)
             if prior is None:
                 self._by_cid[raw] = block
+                fresh.append(block)
             elif prior.data != block.data:
                 raise MergeConflictError(
                     f"witness block {block.cid} has conflicting bytes "
                     "across shards"
                 )
+        return fresh
 
     def seal(self) -> UnifiedProofBundle:
         """One canonical sort over the folded CID union → the exact

@@ -77,7 +77,17 @@ from ipc_proofs_tpu.obs.trace import (
     use_context,
 )
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.serve.qos import TenantQoS, TenantThrottledError
+from ipc_proofs_tpu.witness.errors import WitnessEncodingError
 from ipc_proofs_tpu.subs.registry import normalize_filter, subscription_ring_key
+from ipc_proofs_tpu.witness.stream import (
+    CHUNKED_TERMINATOR,
+    STREAM_CONTENT_TYPE,
+    BundleStreamWriter,
+    negotiate_stream,
+    send_buffers,
+    stream_backfill_chunks,
+)
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.threads import locked
 from ipc_proofs_tpu.utils.metrics import Metrics
@@ -178,6 +188,8 @@ class ClusterRouter:
         scrape_timeout_s: float = 2.0,
         slo=None,
         tenant_top_k: int = 8,
+        tenant_rate: Optional[float] = None,
+        tenant_burst: Optional[float] = None,
         spec=None,
         backfill_jobs_dir: Optional[str] = None,
         backfill_window_size: int = 8,
@@ -217,6 +229,20 @@ class ClusterRouter:
             timeout_s=scrape_timeout_s,
         )
         self.tenants = TenantLedger(metrics=self.metrics, top_k=tenant_top_k)
+        # per-tenant QoS at the cluster door (--tenant-rate/--tenant-burst):
+        # the ONE front door throttles, so shard-side buckets aren't also
+        # needed — a router-admitted request must not 429 halfway through
+        # its scatter
+        self.qos = (
+            TenantQoS(
+                tenant_rate,
+                burst=tenant_burst,
+                metrics=self.metrics,
+                ledger=self.tenants,
+            )
+            if tenant_rate
+            else None
+        )
         self.slo = slo
         # bulk backfill over the whole cluster: windows fan out to their
         # arc shards through the steal-aware dispatch below. The engine
@@ -594,7 +620,8 @@ class ClusterRouter:
         timeout_s: Optional[float] = None,
         aggregate: bool = False,
         tenant: Optional[str] = None,
-    ) -> "tuple[int, dict]":
+        writer_factory=None,
+    ) -> "Optional[tuple[int, dict]]":
         """Scatter a multi-pair range across shards, gather one canonical
         bundle (byte-identical to a single-daemon run over the same list).
 
@@ -604,6 +631,16 @@ class ClusterRouter:
         With ``aggregate=True`` the index list may repeat (K co-tipset
         claims); the scatter covers the distinct pairs once and the
         response carries the witness-plane ``claims`` span table.
+
+        With ``writer_factory`` (the streamed door) the fold never
+        buffers a sealed response: the factory is called once, after
+        validation and partition — the HTTP handler commits its 200 +
+        chunked headers there and hands back a `BundleStreamWriter` —
+        then every shard sub-bundle's blocks go out as ``B`` chunks the
+        moment that shard answers; the trailer carries the merged proof
+        sections and the sealed digest. Returns None once streaming has
+        begun (errors after that point travel as in-band ``E`` chunks);
+        pre-stream failures still return ``(status, obj)``.
         """
         n = len(self.pairs)
         idxs = list(pair_indexes)
@@ -651,22 +688,96 @@ class ClusterRouter:
                 self._executor.submit(one, group): name
                 for name, group in groups.items()
             }
-            fold = BundleFold(self.pairs, idxs, metrics=self.metrics)
-            for fut in as_completed(futures):
-                name = futures[fut]
-                status, obj = fut.result()  # NoShardsError propagates
-                if status != 200:
-                    # a shard's error verdict is the scatter's verdict —
-                    # partial bundles are never silently merged
-                    return status, obj
-                payload = obj.get("result", obj) if obj.get("ok", True) else obj
-                if "bundle" not in payload:
-                    return 502, {
-                        "error": f"shard group {name} returned no bundle",
-                        "shard_response": obj,
+            writer = None
+            if writer_factory is not None:
+                # commit the streamed response now: validation and
+                # placement are done, so everything past this point is
+                # in-band (a shard failure becomes an E chunk)
+                writer = writer_factory()
+                writer.begin(
+                    {
+                        "witness_encoding": "identity",
+                        "n_pairs": len(idxs),
+                        "n_groups": len(groups),
+                        "trace_id": sp.trace_id,
                     }
-                fold.fold(UnifiedProofBundle.from_json_obj(payload["bundle"]))
+                )
+            fold = BundleFold(self.pairs, idxs, metrics=self.metrics)
+            try:
+                for fut in as_completed(futures):
+                    name = futures[fut]
+                    status, obj = fut.result()  # NoShardsError propagates
+                    if status != 200:
+                        # a shard's error verdict is the scatter's verdict
+                        # — partial bundles are never silently merged
+                        if writer is None:
+                            return status, obj
+                        writer.error(
+                            str(obj.get("error", f"shard group {name} failed")),
+                            str(obj.get("error_type", "shard_error")),
+                        )
+                        return None
+                    payload = (
+                        obj.get("result", obj) if obj.get("ok", True) else obj
+                    )
+                    if "bundle" not in payload:
+                        if writer is None:
+                            return 502, {
+                                "error": f"shard group {name} returned no bundle",
+                                "shard_response": obj,
+                            }
+                        writer.error(
+                            f"shard group {name} returned no bundle",
+                            "shard_error",
+                        )
+                        return None
+                    sub = UnifiedProofBundle.from_json_obj(payload["bundle"])
+                    fresh = fold.fold(sub)
+                    if writer is not None:
+                        # blocks leave NOW, in arrival order, and only on
+                        # first sight — a block several shards shipped
+                        # crosses the client wire once; the decoder
+                        # restores canonical order (the merge law), so no
+                        # sealed bundle is ever buffered
+                        for b in fresh:
+                            writer.block(b.cid.to_bytes(), b.data)
+                        if len(fresh) != len(sub.blocks):
+                            self.metrics.count(
+                                "cluster.stream_blocks_deduped",
+                                len(sub.blocks) - len(fresh),
+                            )
+            except Exception as exc:
+                if writer is None:
+                    raise
+                writer.error(str(exc), "internal")
+                return None
             merged = fold.seal()
+            claims = None
+            if aggregate:
+                from ipc_proofs_tpu.witness import aggregate_range_bundle
+
+                claims = aggregate_range_bundle(
+                    merged,
+                    self.pairs,
+                    idxs,
+                    claim_indexes=claim_idxs,
+                    metrics=self.metrics,
+                ).claims_json()
+            if writer is not None:
+                tail = {
+                    "storage_proofs": [
+                        p.to_json_obj() for p in merged.storage_proofs
+                    ],
+                    "event_proofs": [
+                        p.to_json_obj() for p in merged.event_proofs
+                    ],
+                    "digest": merged.digest(),
+                    "n_event_proofs": len(merged.event_proofs),
+                }
+                if claims is not None:
+                    tail["claims"] = claims
+                writer.end(tail)
+                return None
             out = {
                 "bundle": merged.to_json_obj(),
                 "n_event_proofs": len(merged.event_proofs),
@@ -674,16 +785,8 @@ class ClusterRouter:
                 "n_groups": len(groups),
                 "trace_id": sp.trace_id,
             }
-            if aggregate:
-                from ipc_proofs_tpu.witness import aggregate_range_bundle
-
-                out["claims"] = aggregate_range_bundle(
-                    merged,
-                    self.pairs,
-                    idxs,
-                    claim_indexes=claim_idxs,
-                    metrics=self.metrics,
-                ).claims_json()
+            if claims is not None:
+                out["claims"] = claims
             return 200, out
 
     # --- bulk backfill ------------------------------------------------------
@@ -959,13 +1062,79 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence per-request stderr noise
         pass
 
-    def _send_json(self, status: int, obj: dict):
+    def _send_json(self, status: int, obj: dict, headers=None):
         body = json.dumps(obj).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+        # response bytes charge the tenant at send time, mirroring the
+        # single-daemon door — tenant.bytes.* is what crossed the wire
+        if getattr(self, "_account_response", False):
+            self.router.tenants.account_bytes(self._tenant, len(body))
+
+    # --- streamed responses (application/x-ipc-bundle-stream) -------------
+
+    def _start_stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", STREAM_CONTENT_TYPE)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Witness-Encoding", "identity")
+        self.end_headers()
+        self.wfile.flush()
+
+    def _finish_stream(self, writer) -> None:
+        try:
+            self.connection.sendall(CHUNKED_TERMINATOR)
+        except OSError:
+            pass
+        self.router.metrics.count("serve.stream.responses")
+        if getattr(self, "_account_response", False):
+            self.router.tenants.account_bytes(self._tenant, writer.bytes_sent)
+        # one stream per connection: don't risk framing drift poisoning a
+        # keep-alive successor request
+        self.close_connection = True
+
+    def _stream_generate_range(self, body: dict) -> None:
+        """Streamed scatter-gather: the router commits its 200 the moment
+        placement succeeds (the writer factory below), then re-emits each
+        shard's blocks as that shard answers — no sealed bundle is ever
+        buffered router-side. Pre-stream failures (validation, all shards
+        dead) still map to plain JSON statuses."""
+        made: dict = {}
+
+        def factory():
+            self._start_stream()
+            made["w"] = BundleStreamWriter(
+                self._send_buffers, metrics=self.router.metrics
+            )
+            return made["w"]
+
+        try:
+            out = self.router.generate_range(
+                body.get("pair_indexes") or [],
+                chunk_size=body.get("chunk_size"),
+                timeout_s=body.get("timeout_s"),
+                aggregate=body.get("aggregate", False) is True,
+                tenant=body.get("tenant"),
+                writer_factory=factory,
+            )
+        except NoShardsError as exc:
+            if "w" not in made:
+                self._send_json(503, {"error": str(exc)})
+                return
+            out = None
+        if out is not None:
+            status, obj = out
+            self._send_json(status, obj)
+            return
+        self._finish_stream(made["w"])
+
+    def _send_buffers(self, buffers) -> None:
+        send_buffers(self.connection, buffers)
 
     def _send_text(self, status: int, text: str, content_type: str):
         body = text.encode("utf-8")
@@ -1011,6 +1180,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 status, obj = self.router.backfill_chunks(
                     job_id, cursor=cursor, wait_s=wait_s
                 )
+                if status == 200 and negotiate_stream({}, headers=self.headers):
+                    # multi-document IPBS stream; no segment tier at the
+                    # router, so block payloads re-emit as copied bytes
+                    self._start_stream()
+                    writer = BundleStreamWriter(
+                        self._send_buffers, metrics=self.router.metrics
+                    )
+                    try:
+                        stream_backfill_chunks(writer, obj)
+                    except Exception as exc:  # fail-soft: headers are already on the wire — the only sound exit is an in-band typed abort chunk, never a half-document
+                        writer.error(str(exc), "internal")
+                    self._finish_stream(writer)
+                    return
             else:
                 status, obj = 404, {"error": f"no such path: {self.path}"}
             self._send_json(status, obj)
@@ -1046,13 +1228,48 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as exc:
             self._send_json(400, {"error": f"bad request body: {exc}"})
             return
+        self._account_response = False
         if self.path in ("/v1/generate", "/v1/verify", "/v1/generate_range"):
             # Per-tenant accounting at the front door, and the (sanitized)
             # tenant rides the forwarded body so shards account it too.
             tenant = extract_tenant(body, self.headers)
+            self._tenant = tenant
+            self._account_response = True
             self.router.tenants.account(tenant, nbytes=length)
             if tenant is not None:
                 body["tenant"] = tenant
+            # QoS throttles at the cluster door, before any scatter work
+            if self.router.qos is not None:
+                try:
+                    self.router.qos.admit(tenant)
+                except TenantThrottledError as exc:
+                    self._send_json(
+                        429,
+                        {
+                            "error": str(exc),
+                            "error_type": "tenant_throttled",
+                            "retry_after_s": exc.retry_after_s,
+                        },
+                        headers={
+                            "Retry-After": f"{max(1, round(exc.retry_after_s))}"
+                        },
+                    )
+                    return
+        if self.path == "/v1/generate_range":
+            try:
+                stream = negotiate_stream(body, headers=self.headers)
+            except WitnessEncodingError as exc:
+                self._send_json(
+                    400,
+                    {"error": str(exc), "error_type": "witness_encoding"},
+                )
+                return
+            if stream:
+                try:
+                    self._stream_generate_range(body)
+                except NoShardsError as exc:
+                    self._send_json(503, {"error": str(exc)})
+                return
         try:
             if self.path == "/v1/generate":
                 status, obj = self.router.generate(

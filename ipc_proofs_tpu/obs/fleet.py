@@ -110,6 +110,29 @@ class TenantLedger:
             self._metrics.count(f"tenant.bytes.{slot}", int(nbytes))
         return slot
 
+    def slot_for(self, tenant: Optional[str]) -> str:
+        """The counter slot a tenant would be charged to, WITHOUT counting
+        anything — the label half of `account`, for callers attributing
+        send-time bytes or throttles to an already-admitted request."""
+        if not tenant:
+            tenant = "anonymous"
+        with self._lock:
+            if tenant in self._known:
+                return tenant
+            if len(self._known) < self.top_k:
+                self._known.add(tenant)
+                return tenant
+        return "other"
+
+    def account_bytes(self, tenant: Optional[str], nbytes: int) -> str:
+        """Attribute wire bytes (request body or response, measured at
+        SEND time so streamed chunks count what actually moved) to a
+        tenant slot without incrementing its request counter."""
+        slot = self.slot_for(tenant)
+        if nbytes > 0:
+            self._metrics.count(f"tenant.bytes.{slot}", int(nbytes))
+        return slot
+
     def known(self) -> List[str]:
         with self._lock:
             return sorted(self._known)

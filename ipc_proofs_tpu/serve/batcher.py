@@ -23,12 +23,20 @@ them:
 - **drain** (`close(drain=True)`) stops admission, flushes everything
   already accepted, and joins the batcher thread — an accepted request is
   never dropped by shutdown.
-- **priority** is two lanes: ``submit(..., low_priority=True)`` enters a
-  second bounded queue that is only drained when the interactive queue
-  is EMPTY, and low batches are assembled greedily (no wait window) so
-  the assembly thread returns to interactive work immediately. Backfill
-  windows ride this lane — a 100k-epoch job queues forever behind live
-  ``/v1/verify`` traffic, never in front of it.
+- **priority** is three lanes, drained strictly in order: ``push`` >
+  ``interactive`` > ``low``. The PUSH lane carries standing-query
+  fan-out work (`subs/matcher.py` riding `submit_range_window`'s push
+  lane) and is assembled greedily — a subscriber notification never
+  waits a batching window behind interactive traffic. The LOW lane
+  (backfill windows) is only drained when both others are empty and is
+  abandoned mid-fill the moment higher work appears — a 100k-epoch job
+  queues forever behind live ``/v1/verify`` traffic, never in front of
+  it. ``submit(..., low_priority=True)`` remains the low-lane spelling.
+- **fairness** inside the interactive lane is deficit round-robin across
+  per-tenant sub-queues (`serve/qos.py::FairQueue`): one hot client's
+  backlog no longer monopolizes batch assembly — tenants take turns,
+  FIFO within each tenant, exact FIFO overall when only one tenant is
+  talking.
 
 The batcher owns one assembly thread; the flush callback may optionally be
 dispatched to a shared executor so batch *assembly* overlaps batch
@@ -43,6 +51,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from ipc_proofs_tpu.obs.trace import current_context
+from ipc_proofs_tpu.serve.qos import FairQueue
 from ipc_proofs_tpu.utils.metrics import Metrics
 from ipc_proofs_tpu.utils.lockdep import named_condition
 
@@ -161,9 +170,12 @@ class MicroBatcher:
         self._metrics = metrics if metrics is not None else Metrics()
         self._executor = executor
         self._cond = named_condition("MicroBatcher._cond")
-        self._queue: deque[PendingResult] = deque()  # guarded-by: _cond
-        # low-priority lane (backfill windows): drained only when _queue
-        # is empty, bounded by the same capacity
+        # interactive lane: deficit-round-robin across tenant sub-queues
+        self._queue: FairQueue = FairQueue()  # guarded-by: _cond
+        # push lane (standing-query fan-out): drained FIRST, greedily
+        self._push: deque[PendingResult] = deque()  # guarded-by: _cond
+        # low-priority lane (backfill windows): drained only when both
+        # other lanes are empty, bounded by the same capacity
         self._low: deque[PendingResult] = deque()  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
         # EWMA of recent flush wall times, seeding the retry-after hint for
@@ -183,39 +195,54 @@ class MicroBatcher:
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
         low_priority: bool = False,
+        lane: Optional[str] = None,
     ) -> PendingResult:
         """Admit one request; never blocks.
 
         Raises `ServiceClosedError` after `close()`, `QueueFullError` when
-        the bounded queue is at capacity. ``low_priority=True`` enters the
-        low lane: same admission contract, but the request waits behind
-        ALL interactive work (see class docstring).
+        the bounded lane is at capacity. ``lane`` is ``"push"`` |
+        ``"interactive"`` (default) | ``"low"``; ``low_priority=True``
+        remains the low-lane spelling. ``tenant`` keys the interactive
+        lane's deficit-round-robin sub-queue (untenanted requests share
+        one round-robin slot).
         """
+        if lane is None:
+            lane = "low" if low_priority else "interactive"
+        if lane not in ("push", "interactive", "low"):
+            raise ValueError(f"unknown batcher lane {lane!r}")
         now = time.monotonic()
         deadline = (now + timeout_s) if timeout_s is not None else None
         with self._cond:
             if self._closed:
                 self._metrics.count(f"serve.rejected_closed.{self._name}")
                 raise ServiceClosedError(f"{self._name} batcher is draining")
-            lane = self._low if low_priority else self._queue
-            if len(lane) >= self._capacity:
+            q = {"push": self._push, "interactive": self._queue, "low": self._low}[lane]
+            if len(q) >= self._capacity:
                 self._metrics.count(f"serve.rejected_full.{self._name}")
-                batches_ahead = max(1, len(lane) // self._max_batch)
+                batches_ahead = max(1, len(q) // self._max_batch)
                 raise QueueFullError(
                     retry_after_s=max(0.001, batches_ahead * self._avg_flush_s)
                 )
             pending = PendingResult(payload, deadline, now)
             pending.trace_ctx = current_context()
             pending.tenant = tenant
-            lane.append(pending)
-            if low_priority:
+            q.append(pending)
+            if lane == "low":
                 self._metrics.set_gauge(
                     f"serve.queue_depth_low.{self._name}", len(self._low)
                 )
                 self._metrics.count(f"serve.accepted_low.{self._name}")
+            elif lane == "push":
+                self._metrics.set_gauge(
+                    f"serve.queue_depth_push.{self._name}", len(self._push)
+                )
+                self._metrics.count(f"serve.accepted_push.{self._name}")
             else:
                 self._metrics.set_gauge(
                     f"serve.queue_depth.{self._name}", len(self._queue)
+                )
+                self._metrics.set_gauge(
+                    "qos.tenant_queues", self._queue.tenants()
                 )
                 self._metrics.count(f"serve.accepted.{self._name}")
             self._cond.notify_all()
@@ -233,17 +260,38 @@ class MicroBatcher:
 
     def _run(self) -> None:
         while True:
-            low_batch = False
             with self._cond:
-                while not self._queue and not self._low and not self._closed:
+                while (
+                    not self._push
+                    and not self._queue
+                    and not self._low
+                    and not self._closed
+                ):
                     self._cond.wait()
-                if not self._queue and not self._low and self._closed:
+                if (
+                    not self._push
+                    and not self._queue
+                    and not self._low
+                    and self._closed
+                ):
                     return
-                if self._queue:
+                if self._push:
+                    # push lane first, assembled greedily: a standing-query
+                    # fan-out never waits a batching window
+                    batch = [self._push.popleft()]
+                    while self._push and len(batch) < self._max_batch:
+                        batch.append(self._push.popleft())
+                    self._metrics.set_gauge(
+                        f"serve.queue_depth_push.{self._name}", len(self._push)
+                    )
+                elif self._queue:
+                    # interactive lane: members pop in deficit-round-robin
+                    # order, so the window opens at the FIRST POPPED
+                    # member's arrival — a request's queueing latency is
+                    # bounded by max_wait plus however many fair-share
+                    # turns its own tenant's backlog costs it (that wait
+                    # is the fairness, not a regression)
                     batch = [self._queue.popleft()]
-                    # the window opens at the OLDEST member's arrival, so a
-                    # request's queueing latency is bounded by max_wait even
-                    # when stragglers keep trickling in behind it
                     window_end = batch[0].enqueued_at + self._max_wait_s
                     while len(batch) < self._max_batch:
                         if self._queue:
@@ -257,26 +305,27 @@ class MicroBatcher:
                             self._closed or time.monotonic() >= window_end
                         ):
                             break
+                    self._metrics.set_gauge(
+                        f"serve.queue_depth.{self._name}", len(self._queue)
+                    )
+                    self._metrics.set_gauge(
+                        "qos.tenant_queues", self._queue.tenants()
+                    )
                 else:
-                    # low lane: only reached with the interactive queue
-                    # EMPTY, assembled greedily (no wait window — waiting
-                    # would delay any interactive arrival), and abandoned
-                    # mid-fill the moment interactive work appears
-                    low_batch = True
+                    # low lane: only reached with both other lanes EMPTY,
+                    # assembled greedily (no wait window — waiting would
+                    # delay any interactive arrival), and abandoned
+                    # mid-fill the moment higher-priority work appears
                     batch = [self._low.popleft()]
                     while (
                         self._low
                         and len(batch) < self._max_batch
                         and not self._queue
+                        and not self._push
                     ):
                         batch.append(self._low.popleft())
-                if low_batch:
                     self._metrics.set_gauge(
                         f"serve.queue_depth_low.{self._name}", len(self._low)
-                    )
-                else:
-                    self._metrics.set_gauge(
-                        f"serve.queue_depth.{self._name}", len(self._queue)
                     )
             self._dispatch(batch)
 
@@ -335,6 +384,10 @@ class MicroBatcher:
             if not drain:
                 while self._queue:
                     self._queue.popleft().fail(
+                        ServiceClosedError(f"{self._name} batcher stopped")
+                    )
+                while self._push:
+                    self._push.popleft().fail(
                         ServiceClosedError(f"{self._name} batcher stopped")
                     )
                 while self._low:

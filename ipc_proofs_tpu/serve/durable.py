@@ -30,6 +30,14 @@ done-with-error record so a poison request can't crash-loop the replay.
 Journal I/O is fail-soft end-to-end (`JournalWriter` degrades to
 in-memory on ENOSPC/EROFS with ``jobs.journal_failures``): the service
 keeps answering, it just stops being able to resume.
+
+Streaming contract: the journal always stores the PLAIN canonical
+result object — never a stream framing and never a compressed/delta
+witness encoding. A streamed response (``"stream": true``) re-encodes
+from the journaled plain bundle at send time (`_stream_durable` in the
+HTTP layer), so an idempotent retry may freely switch between buffered
+and streamed transports, or between witness encodings, and always
+reassembles byte-identical canonical fields from the same done record.
 """
 
 from __future__ import annotations

@@ -28,6 +28,18 @@ an unknown delta base falls back to a full bundle
 (``witness.delta_fallbacks``). ``POST /v1/verify`` accepts plain or
 ``blocks_frame``-compressed bundles plus an optional ``claims`` table for
 per-claim verdicts out of one shared replay.
+
+Streaming wire (README "Streaming wire & tenant QoS"): generate bodies
+may carry ``"stream": true`` (or ``Accept:
+application/x-ipc-bundle-stream``) to receive the chunked binary IPBS
+stream (`witness/stream.py`) instead of a buffered JSON body — on a
+disk-warm daemon the block section is ``memoryview`` slices straight out
+of `SegmentStore` segments, handed to ``socket.sendmsg`` without copying
+through Python. ``GET /v1/backfill/<id>/chunks`` under the same Accept
+header streams one document per result chunk. Per-tenant QoS
+(``--tenant-rate`` / ``--tenant-burst``) throttles at admission with a
+typed 429 + ``Retry-After`` (`serve/qos.py`); response bytes charge the
+tenant ledger at send time, streamed chunks included.
 - ``GET /metrics``  → `utils/metrics.py` snapshot (stage timers, queue
   depths, batch sizes, p50/p90/p99 latency, rejection counters) as JSON.
 - ``GET /metrics.prom`` → the same snapshot in Prometheus text exposition
@@ -89,6 +101,7 @@ from ipc_proofs_tpu.serve.batcher import (
     QueueFullError,
     ServiceClosedError,
 )
+from ipc_proofs_tpu.serve.qos import TenantQoS, TenantThrottledError
 from ipc_proofs_tpu.serve.service import ProofService
 from ipc_proofs_tpu.witness import (
     AggregatedBundle,
@@ -98,6 +111,15 @@ from ipc_proofs_tpu.witness import (
     encode_bundle_fields,
     negotiate_witness,
     parse_bundle_obj,
+)
+from ipc_proofs_tpu.witness.stream import (
+    CHUNKED_TERMINATOR,
+    STREAM_CONTENT_TYPE,
+    BundleStreamWriter,
+    negotiate_stream,
+    send_buffers,
+    stream_backfill_chunks,
+    stream_bundle_doc,
 )
 
 __all__ = ["ProofHTTPServer"]
@@ -113,6 +135,7 @@ class _Handler(BaseHTTPRequestHandler):
     subs = None  # Optional[subs.StandingQueries]
     slo = None  # Optional[obs.slo.SloWatchdog]
     tenants = None  # Optional[obs.fleet.TenantLedger]
+    qos = None  # Optional[serve.qos.TenantQoS]
 
     protocol_version = "HTTP/1.1"
 
@@ -130,6 +153,61 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+        # response bytes charge the tenant AT SEND TIME (the streamed path
+        # does the same with the writer's byte count), so ``tenant.bytes.*``
+        # reflects what actually crossed the wire, not just request bodies
+        if getattr(self, "_account_response", False) and self.tenants is not None:
+            self.tenants.account_bytes(self._tenant, len(body))
+
+    # --- streamed responses (application/x-ipc-bundle-stream) -------------
+
+    def _start_stream(self, encoding: str) -> None:
+        """200 + chunked transfer for an IPBS body. No Content-Length (the
+        length is unknown until the last shard/window lands) and no
+        Server-Timing header — the timing breakdown rides the trailer
+        chunk instead, where ``stream_ms`` can be measured."""
+        self.send_response(200)
+        self.send_header("Content-Type", STREAM_CONTENT_TYPE)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Witness-Encoding", encoding)
+        self.end_headers()
+        self.wfile.flush()
+
+    def _send_buffers(self, buffers) -> None:
+        """One HTTP chunk, scatter-gather, straight to the socket —
+        `witness.stream.send_buffers` (memoryview payloads go mmap →
+        kernel with no Python-side copy)."""
+        send_buffers(self.connection, buffers)
+
+    def _stream_ok(self, stream_fn, encoding: str) -> None:
+        """Send one streamed 200. ``stream_fn(writer)`` emits the
+        document(s); an exception after the status line is gone becomes a
+        typed in-band ``E`` chunk (`StreamAbortError` client-side) — the
+        byte-identical-or-typed-error invariant past the point where HTTP
+        status codes can carry it."""
+        self._start_stream(encoding)
+        writer = BundleStreamWriter(
+            self._send_buffers, metrics=self.service.metrics
+        )
+        try:
+            stream_fn(writer)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+            return
+        except WitnessError as exc:
+            writer.error(str(exc), exc.error_type)
+        except Exception as exc:  # fail-soft: headers are already on the wire — the only sound exit is an in-band typed abort chunk, never a half-document
+            writer.error(str(exc), "internal")
+        try:
+            self.connection.sendall(CHUNKED_TERMINATOR)
+        except OSError:
+            pass
+        self.service.metrics.count("serve.stream.responses")
+        if getattr(self, "_account_response", False) and self.tenants is not None:
+            self.tenants.account_bytes(self._tenant, writer.bytes_sent)
+        # one stream per connection: don't risk framing drift poisoning a
+        # keep-alive successor request
+        self.close_connection = True
 
     def _send_text(self, status: int, text: str, content_type: str):
         body = text.encode("utf-8")
@@ -163,6 +241,17 @@ class _Handler(BaseHTTPRequestHandler):
                 allow_compress=cfg.witness_compress,
                 allow_delta=cfg.witness_delta,
             )
+        except WitnessEncodingError as exc:
+            self.service.metrics.count("witness.encoding_rejects")
+            self._send_json(400, {"error": str(exc), "error_type": exc.error_type})
+            return None
+
+    def _negotiate_stream(self, body: dict) -> Optional[bool]:
+        """Resolve whether this response goes out as an IPBS chunk stream
+        (body ``"stream"`` wins, else the ``Accept`` header). Returns
+        None after sending the typed 400 for a malformed field."""
+        try:
+            return negotiate_stream(body, headers=self.headers)
         except WitnessEncodingError as exc:
             self.service.metrics.count("witness.encoding_rejects")
             self._send_json(400, {"error": str(exc), "error_type": exc.error_type})
@@ -262,9 +351,27 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._send_json(400, {"error": "cursor/wait_s must be numeric"})
                 return
-            self._send_json(200, job.chunks_after(cursor, wait_s=wait_s))
+            out = job.chunks_after(cursor, wait_s=wait_s)
+            if negotiate_stream({}, headers=self.headers):
+                self._stream_backfill_chunks(out)
+            else:
+                self._send_json(200, out)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _stream_backfill_chunks(self, out: dict) -> None:
+        """``GET /v1/backfill/<id>/chunks`` with
+        ``Accept: application/x-ipc-bundle-stream`` — the multi-document
+        stream form: one IPBS document per result chunk (block payloads
+        sliced zero-copy out of the segment tier when warm), closed by a
+        metadata-only envelope document carrying the poll fields
+        (``job_id`` / ``state`` / ``cursor`` / ``acked``)."""
+        self._stream_ok(
+            lambda w: stream_backfill_chunks(
+                w, out, slicer=self.service.read_block_slice
+            ),
+            "identity",
+        )
 
     def _handle_deliveries(self):
         """``GET /v1/deliveries?sub=<id>&cursor=<n>[&wait_s=<s>]`` — the
@@ -306,12 +413,30 @@ class _Handler(BaseHTTPRequestHandler):
         # request through batcher/durable-queue; bytes charge the body size
         self._tenant = extract_tenant(body, self.headers)
         self._active_span = None  # set for remote-carried requests (stitching)
-        if self.tenants is not None and self.path in (
-            "/v1/verify",
-            "/v1/generate",
-            "/v1/generate_range",
-        ):
-            self.tenants.account(self._tenant, getattr(self, "_body_bytes", 0))
+        self._account_response = False
+        if self.path in ("/v1/verify", "/v1/generate", "/v1/generate_range"):
+            if self.tenants is not None:
+                self.tenants.account(self._tenant, getattr(self, "_body_bytes", 0))
+                self._account_response = True
+            # QoS admission sits at the very front door — an exhausted
+            # bucket never touches the batcher, so a heavy tenant's burst
+            # costs one bucket check, not a queue slot
+            if self.qos is not None:
+                try:
+                    self.qos.admit(self._tenant)
+                except TenantThrottledError as exc:
+                    self._send_json(
+                        429,
+                        {
+                            "error": str(exc),
+                            "error_type": "tenant_throttled",
+                            "retry_after_s": exc.retry_after_s,
+                        },
+                        headers={
+                            "Retry-After": f"{max(1, round(exc.retry_after_s))}"
+                        },
+                    )
+                    return
         if self.path == "/v1/verify":
             with adopted_span("http.verify", carrier, {"path": self.path}) as sp:
                 if carrier is not None:
@@ -479,10 +604,32 @@ class _Handler(BaseHTTPRequestHandler):
         opts = self._negotiate_witness(body)
         if opts is None:
             return
+        stream = self._negotiate_stream(body)
+        if stream is None:
+            return
         timeout_s = body.get("timeout_s")
         if self.durable is not None:
-            self._submit_durable("generate", idx, body, witness=opts)
+            self._submit_durable(
+                "generate", idx, body, witness=opts, stream=stream
+            )
             return
+
+        def stream_doc(resp, writer):
+            stream_bundle_doc(
+                writer,
+                resp.bundle,
+                opts,
+                bases=self.service.witness_bases,
+                metrics=self.service.metrics,
+                head_extra={
+                    "n_event_proofs": resp.n_event_proofs,
+                    "batch_size": resp.batch_size,
+                    "trace_id": resp.trace_id,
+                },
+                tail_extra={"server_timing": dict(resp.server_timing)},
+                slicer=self.service.read_block_slice,
+            )
+
         self._submit(
             lambda: self.service.generate(
                 self.pairs[idx], timeout_s=timeout_s, tenant=self._tenant
@@ -494,6 +641,8 @@ class _Handler(BaseHTTPRequestHandler):
                 trace_id=resp.trace_id,
                 server_timing=resp.server_timing,
             ),
+            stream_fn=stream_doc if stream else None,
+            encoding=opts.encoding,
         )
 
     def _handle_generate_range(self, body: dict):
@@ -535,6 +684,9 @@ class _Handler(BaseHTTPRequestHandler):
         opts = self._negotiate_witness(body)
         if opts is None:
             return
+        stream = self._negotiate_stream(body)
+        if stream is None:
+            return
         if aggregate and len(idxs) > self.service.config.witness_agg_max:
             self._send_json(
                 400,
@@ -558,23 +710,41 @@ class _Handler(BaseHTTPRequestHandler):
                 witness=opts,
                 claim_indexes=list(idxs) if aggregate else None,
                 gen_indexes=gen_idxs,
+                stream=stream,
             )
             return
 
+        def _claims(bundle):
+            if not aggregate:
+                return None
+            return aggregate_range_bundle(
+                bundle,
+                self.pairs,
+                gen_idxs,
+                claim_indexes=idxs,
+                metrics=self.service.metrics,
+            ).claims_json()
+
         def render(bundle):
-            claims = None
-            if aggregate:
-                claims = aggregate_range_bundle(
-                    bundle,
-                    self.pairs,
-                    gen_idxs,
-                    claim_indexes=idxs,
-                    metrics=self.service.metrics,
-                ).claims_json()
             return dict(
-                self._witness_fields(bundle, opts, claims=claims),
+                self._witness_fields(bundle, opts, claims=_claims(bundle)),
                 n_event_proofs=len(bundle.event_proofs),
                 n_pairs=len(gen_idxs),
+            )
+
+        def stream_doc(bundle, writer):
+            stream_bundle_doc(
+                writer,
+                bundle,
+                opts,
+                bases=self.service.witness_bases,
+                metrics=self.service.metrics,
+                claims=_claims(bundle),
+                head_extra={
+                    "n_event_proofs": len(bundle.event_proofs),
+                    "n_pairs": len(gen_idxs),
+                },
+                slicer=self.service.read_block_slice,
             )
 
         self._submit(
@@ -582,9 +752,11 @@ class _Handler(BaseHTTPRequestHandler):
                 [self.pairs[i] for i in gen_idxs], chunk_size=chunk
             ),
             render,
+            stream_fn=stream_doc if stream else None,
+            encoding=opts.encoding,
         )
 
-    def _submit(self, call, render):
+    def _submit(self, call, render, stream_fn=None, encoding=None):
         try:
             resp = call()
         except QueueFullError as exc:
@@ -600,6 +772,11 @@ class _Handler(BaseHTTPRequestHandler):
         except RuntimeError as exc:
             self._send_json(400, {"error": str(exc)})
         else:
+            if stream_fn is not None:
+                # admission/execution errors above still travel as typed
+                # JSON statuses — only a successful response streams
+                self._stream_ok(lambda w: stream_fn(resp, w), encoding)
+                return
             obj = render(resp)
             self._attach_spans(obj)
             headers = {}
@@ -665,6 +842,7 @@ class _Handler(BaseHTTPRequestHandler):
         claims=None,
         claim_indexes=None,
         gen_indexes=None,
+        stream=False,
     ):
         """Route one request through the durable admission queue.
 
@@ -695,6 +873,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(504, {"error": str(exc)})
         else:
             headers = None
+            if (
+                stream
+                and witness is not None
+                and done.get("ok")
+                and isinstance(done.get("result"), dict)
+                and "bundle" in done["result"]
+            ):
+                self._stream_durable(
+                    done["result"], key, cached, witness, claim_indexes,
+                    gen_indexes,
+                )
+                return
             if done.get("ok") and isinstance(done.get("result"), dict):
                 result = self._rewitness_result(
                     done["result"], witness, claims, claim_indexes, gen_indexes
@@ -705,6 +895,55 @@ class _Handler(BaseHTTPRequestHandler):
             out = dict(done, idempotency_key=key, cached=cached)
             self._attach_spans(out)
             self._send_json(200, out, headers=headers)
+
+    def _stream_durable(
+        self, result: dict, key, cached, witness, claim_indexes, gen_indexes
+    ) -> None:
+        """Streamed form of a durable done payload: the journal's PLAIN
+        canonical result re-encoded through the IPBS wire under this
+        request's witness options.
+
+        Unlike the buffered durable response there is no ``result``
+        envelope — the document IS the result, with ``ok`` /
+        ``idempotency_key`` / ``cached`` riding the header chunk. Block
+        payloads come from the journal JSON, so they stream as copied
+        bytes unless the segment tier still holds them warm (the slicer
+        is consulted per block either way)."""
+        bundle = UnifiedProofBundle.from_json_obj(result["bundle"])
+        claims_json = None
+        if claim_indexes is not None:
+            claims_json = aggregate_range_bundle(
+                bundle,
+                self.pairs,
+                gen_indexes,
+                claim_indexes=claim_indexes,
+                metrics=self.service.metrics,
+            ).claims_json()
+        head = {
+            k: v
+            for k, v in result.items()
+            if k not in ("bundle", "server_timing")
+        }
+        head.update(ok=True, idempotency_key=key, cached=cached)
+        timing = result.get("server_timing")
+        tail = (
+            {"server_timing": dict(timing)} if isinstance(timing, dict) else None
+        )
+
+        def doc(writer):
+            stream_bundle_doc(
+                writer,
+                bundle,
+                witness,
+                bases=self.service.witness_bases,
+                metrics=self.service.metrics,
+                claims=claims_json,
+                head_extra=head,
+                tail_extra=tail,
+                slicer=self.service.read_block_slice,
+            )
+
+        self._stream_ok(doc, witness.encoding)
 
 
 class ProofHTTPServer:
@@ -727,6 +966,7 @@ class ProofHTTPServer:
         slo=None,
         tenants=None,
         backfill=None,
+        qos=None,
     ):
         self.service = service
         self.durable = durable
@@ -740,6 +980,16 @@ class ProofHTTPServer:
             if tenants is not None
             else TenantLedger(metrics=service.metrics)
         )
+        # QoS enforcement is opt-in (--tenant-rate); built here so the
+        # buckets share the ledger's slot labels for tenant.throttled.*
+        self.qos = qos
+        if self.qos is None and getattr(service.config, "tenant_rate", None):
+            self.qos = TenantQoS(
+                service.config.tenant_rate,
+                burst=service.config.tenant_burst,
+                metrics=service.metrics,
+                ledger=self.tenants,
+            )
         handler = type(
             "_BoundHandler",
             (_Handler,),
@@ -751,6 +1001,7 @@ class ProofHTTPServer:
                 "slo": slo,
                 "tenants": self.tenants,
                 "backfill": backfill,
+                "qos": self.qos,
             },
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)

@@ -1,0 +1,178 @@
+"""Per-tenant QoS enforcement: token buckets at admission + fair queuing.
+
+PR 15's `TenantLedger` built the accounting half (who is using what);
+this module is the enforcement half ROADMAP item 2 names:
+
+- `TenantQoS` — per-tenant token buckets checked at HTTP admission,
+  BEFORE a request touches the micro-batcher. An exhausted bucket is a
+  typed 429 (`TenantThrottledError` → ``error_type: tenant_throttled``
+  with a ``Retry-After`` hint computed from the refill rate), counted as
+  ``qos.throttled`` + ``tenant.throttled.<slot>``. Buckets are bounded:
+  at most ``max_tenants`` live buckets, coldest evicted first — a
+  million distinct tenant strings cannot balloon server memory, and an
+  evicted bucket resurrects full (brief over-admission, never
+  over-rejection of a tenant that was within its rate).
+
+- `FairQueue` — deficit round-robin across per-tenant sub-queues, the
+  `MicroBatcher`'s interactive lane ordering. Every request costs one
+  unit and every tenant's quantum is one unit per turn, so DRR reduces
+  to strict round-robin across tenants while staying FIFO within each
+  tenant — one hot client can no longer monopolize a flush: with T
+  active tenants a light tenant's request sits behind at most ~queue/T
+  of the heavy tenant's backlog instead of all of it. Single-tenant
+  traffic degenerates to the exact FIFO order the batcher always had.
+
+Admission throttling and queue fairness compose: the bucket bounds a
+tenant's admitted RATE, the fair queue bounds the LATENCY a burst that
+did get admitted can impose on everyone else.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+from ipc_proofs_tpu.utils.lockdep import named_lock
+from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
+
+__all__ = ["FairQueue", "TenantQoS", "TenantThrottledError", "TokenBucket"]
+
+
+class TenantThrottledError(RuntimeError):
+    """A tenant's token bucket is exhausted; mapped to a typed 429 with
+    ``Retry-After: retry_after_s`` at the HTTP front door."""
+
+    def __init__(self, tenant: Optional[str], retry_after_s: float):
+        super().__init__(
+            f"tenant {tenant or 'anonymous'!s} exceeded its admission rate"
+        )
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class TokenBucket:
+    """One tenant's admission budget: ``rate`` tokens/s, ``burst`` cap.
+
+    Lazy refill on take (no timer thread); not thread-safe on its own —
+    `TenantQoS` serializes access under its lock."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.stamp = now
+
+    def take(self, now: float) -> "tuple[bool, float]":
+        """(admitted, retry_after_s). Refills from elapsed wall, spends
+        one token when available; otherwise says how long until one
+        token exists."""
+        elapsed = max(0.0, now - self.stamp)
+        self.stamp = now
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True, 0.0
+        needed = (1.0 - self.tokens) / self.rate if self.rate > 0 else float("inf")
+        return False, needed
+
+
+class TenantQoS:
+    """Per-tenant token-bucket admission control (``--tenant-rate`` /
+    ``--tenant-burst``). One bucket per tenant label (anonymous traffic
+    shares one bucket), LRU-bounded at ``max_tenants``."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        metrics: Optional[Metrics] = None,
+        ledger=None,
+        max_tenants: int = 1024,
+        clock=time.monotonic,
+    ):
+        if rate <= 0:
+            raise ValueError("tenant rate must be positive (omit to disable QoS)")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(1.0, 2.0 * self.rate)
+        if self.burst < 1.0:
+            raise ValueError("tenant burst must admit at least one request")
+        self._metrics = metrics if metrics is not None else get_metrics()
+        self._ledger = ledger
+        self._max_tenants = max(1, int(max_tenants))
+        self._clock = clock
+        self._lock = named_lock("TenantQoS._lock")
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()  # guarded-by: _lock
+
+    def admit(self, tenant: Optional[str]) -> None:
+        """Spend one token for ``tenant`` or raise `TenantThrottledError`."""
+        key = tenant or "anonymous"
+        now = self._clock()
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = TokenBucket(self.rate, self.burst, now)
+                self._buckets[key] = bucket
+                while len(self._buckets) > self._max_tenants:
+                    self._buckets.popitem(last=False)  # coldest bucket out
+            else:
+                self._buckets.move_to_end(key)
+            ok, retry_after = bucket.take(now)
+        if ok:
+            return
+        self._metrics.count("qos.throttled")
+        slot = self._ledger.slot_for(tenant) if self._ledger is not None else key
+        self._metrics.count(f"tenant.throttled.{slot}")
+        raise TenantThrottledError(tenant, retry_after)
+
+
+class FairQueue:
+    """Deficit round-robin across per-tenant FIFO sub-queues.
+
+    Unit cost per request, unit quantum per turn: the scheduler visits
+    tenants in arrival-of-first-request order, takes one request, and
+    rotates — strict round-robin across tenants, FIFO within a tenant.
+    NOT thread-safe: the `MicroBatcher` owns it under its condition
+    lock, exactly like the deque it replaces."""
+
+    __slots__ = ("_queues", "_len")
+
+    def __init__(self):
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def append(self, pending) -> None:
+        key = getattr(pending, "tenant", None) or ""
+        q = self._queues.get(key)
+        if q is None:
+            q = deque()
+            self._queues[key] = q
+        q.append(pending)
+        self._len += 1
+
+    def popleft(self):
+        """Next request under DRR order; the chosen tenant rotates to the
+        back of the round so its remaining backlog waits its turn."""
+        if self._len == 0:
+            raise IndexError("pop from empty FairQueue")
+        while True:
+            key, q = next(iter(self._queues.items()))
+            if not q:
+                del self._queues[key]  # drained tenant leaves the round
+                continue
+            out = q.popleft()
+            self._len -= 1
+            if q:
+                self._queues.move_to_end(key)
+            else:
+                del self._queues[key]
+            return out
+
+    def tenants(self) -> int:
+        """Live sub-queues (the ``qos.tenant_queues`` gauge)."""
+        return sum(1 for q in self._queues.values() if q)

@@ -145,6 +145,13 @@ class ServiceConfig:
     witness_compress: bool = True
     witness_agg_max: int = 1024
     witness_base_cache: int = 64
+    # per-tenant QoS enforcement (serve/qos.py): token-bucket admission at
+    # tenant_rate requests/s with tenant_burst headroom (default 2×rate).
+    # None disables throttling — accounting (TenantLedger) still runs.
+    # The micro-batcher's fair interactive lane is always on; the bucket
+    # only adds the typed-429 rate limit.
+    tenant_rate: Optional[float] = None
+    tenant_burst: Optional[float] = None
 
 
 @dataclass
@@ -196,14 +203,18 @@ class _GenerateRequest:
 
 @dataclass
 class _RangeWindowRequest:
-    """One backfill epoch window riding the generate batcher's LOW lane.
+    """One range window riding the generate batcher's LOW or PUSH lane.
 
     The payload is a whole pair list (not one pair): the window executes
     as a single chunked-driver call, so its bundle is the canonical
-    bytes for exactly those pairs and folds bit-identically."""
+    bytes for exactly those pairs and folds bit-identically. ``spec`` /
+    ``storage_specs`` override the service-level spec for standing-query
+    pushes (one distinct filter per window); None keeps the service's."""
 
     pairs: list
     chunk_size: Optional[int] = None
+    spec: Optional[object] = None
+    storage_specs: Optional[list] = None
 
 
 class ProofService:
@@ -388,24 +399,33 @@ class ProofService:
         pairs: Sequence[TipsetPair],
         chunk_size: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        lane: str = "low",
+        spec=None,
+        storage_specs=None,
+        tenant: Optional[str] = None,
     ) -> PendingResult:
-        """Admit one backfill window on the generate batcher's LOW lane.
+        """Admit one range window on the generate batcher's LOW (default)
+        or PUSH lane.
 
-        The window waits behind ALL interactive verify/generate traffic
-        (`MicroBatcher` priority semantics) and executes as one canonical
-        chunked-driver call; ``.result()`` is the window's
-        `UnifiedProofBundle`. This is the `BackfillEngine` runner for a
-        single daemon — a saturating backfill job can never starve
-        ``/v1/verify``, because its windows only dispatch when the
-        interactive queue is empty and occupy at most one worker."""
+        LOW is the `BackfillEngine` runner: the window waits behind ALL
+        interactive verify/generate traffic and executes as one canonical
+        chunked-driver call — a saturating backfill job can never starve
+        ``/v1/verify``. PUSH is the standing-query matcher's lane: the
+        window jumps AHEAD of interactive batches (a subscriber
+        notification is already late by one finality delay) while still
+        riding the same admission bounds and the same canonical driver,
+        so pushed bundles stay byte-identical to request/response ones.
+        ``spec``/``storage_specs`` override the service spec per window
+        (the matcher generates one distinct filter per push)."""
         if self._generate_batcher is None:
             raise RuntimeError(
                 "generate path disabled: service was built without store/spec"
             )
         return self._generate_batcher.submit(
-            _RangeWindowRequest(list(pairs), chunk_size),
+            _RangeWindowRequest(list(pairs), chunk_size, spec, storage_specs),
             timeout_s=timeout_s,
-            low_priority=True,
+            tenant=tenant,
+            lane=lane if lane == "push" else "low",
         )
 
     def generate_range(
@@ -467,6 +487,16 @@ class ProofService:
         the `ChainFollower` prefetches into exactly this object so demand
         traffic and the follower share one warm tier."""
         return self._store
+
+    def read_block_slice(self, cid):
+        """Zero-copy block read for the streaming wire: a CRC-verified
+        ``memoryview`` straight out of the disk tier's segment frame, or
+        None (no disk tier, cold block, or a frame that vanished under a
+        concurrent eviction — the streamer falls back to the in-memory
+        copy it already holds and counts the copied bytes honestly)."""
+        if self._disk_store is None:
+            return None
+        return self._disk_store.read_frame_slice(cid)
 
     @property
     def match_backend(self):
@@ -655,8 +685,8 @@ class ProofService:
     def _flush_generate(self, batch: list[PendingResult]) -> None:
         """Deduplicate pairs → one range-driver call → split proofs by pair."""
         if isinstance(batch[0].payload, _RangeWindowRequest):
-            # low-lane batches assemble exclusively from the low lane, so
-            # a batch is either all interactive pairs or all windows
+            # lanes assemble exclusively from themselves, so a batch is
+            # either all interactive pairs or all windows (low OR push)
             self._flush_range_windows(batch)
             return
         exec_start = monotonic()
@@ -752,10 +782,10 @@ class ProofService:
             self._maybe_log_slow(pending, "generate", total_ms, timing)
 
     def _flush_range_windows(self, batch: list[PendingResult]) -> None:
-        """Execute backfill windows: one canonical chunked-driver call per
-        window (byte-identical to the same pairs served interactively).
-        Windows fail individually — one bad window never poisons its
-        batch neighbors' jobs."""
+        """Execute backfill/push windows: one canonical chunked-driver
+        call per window (byte-identical to the same pairs served
+        interactively). Windows fail individually — one bad window never
+        poisons its batch neighbors' jobs."""
         for pending in batch:
             req: _RangeWindowRequest = pending.payload
             try:
@@ -764,10 +794,11 @@ class ProofService:
                         bundle = generate_event_proofs_for_range_chunked(
                             self._store,
                             req.pairs,
-                            self._spec,
+                            req.spec if req.spec is not None else self._spec,
                             chunk_size=req.chunk_size or len(req.pairs),
                             metrics=self.metrics,
                             match_backend=self._match_backend,
+                            storage_specs=req.storage_specs,
                         )
             except BaseException as exc:  # fail-soft: the window's job sees the error; other windows proceed
                 pending.fail(exc)

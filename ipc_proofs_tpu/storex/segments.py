@@ -53,6 +53,7 @@ counted as ``storex.write_failures``.
 
 from __future__ import annotations
 
+import mmap
 import os
 import struct
 import threading
@@ -443,6 +444,14 @@ class SegmentStore:
                 frame = fh.read(frame_len)
         except OSError:
             return None  # segment evicted/unreadable under us: plain miss
+        return self._frame_payload(cid_raw, frame, frame_len)
+
+    @staticmethod
+    def _frame_payload(cid_raw: bytes, frame, frame_len: int):
+        """Validate one framed block (magic, length, CRC, cid match) and
+        return its payload slice, or None. Works on bytes AND memoryview —
+        a memoryview in yields a zero-copy memoryview out, which is what
+        `read_frame_slice` serves to the streaming wire."""
         if len(frame) != frame_len or frame_len < FRAME_HEADER.size + _CID_LEN.size:
             return None
         magic, length, crc = FRAME_HEADER.unpack_from(frame, 0)
@@ -457,6 +466,57 @@ class SegmentStore:
         if payload[_CID_LEN.size : _CID_LEN.size + cid_len] != cid_raw:
             return None
         return payload[_CID_LEN.size + cid_len :]
+
+    def read_frame_slice(self, cid: CID) -> "Optional[memoryview]":
+        """Zero-copy read: a CRC-verified ``memoryview`` over the block's
+        bytes inside an mmap of its segment file, or None (the caller
+        falls back to the copying ``get`` path — availability, never
+        correctness).
+
+        Eviction-safe without holding any lock across the read: the
+        mapping is established while the segment file still exists (an
+        open/mmap racing a foreign shared-mode eviction fails and reports
+        a miss), and once mapped the pages stay valid even after the file
+        is unlinked — POSIX keeps the backing alive until the last
+        mapping goes, and the returned memoryview pins the mmap object
+        through the buffer protocol. The frame CRC is verified against
+        the mapped bytes BEFORE the slice is returned, so a reader can
+        never observe torn bytes: the whole committed frame or a miss.
+        The multihash half of the verify-twice discipline is not re-run
+        here — every ingest path already validated the bytes against the
+        CID, and re-hashing would force the very copy this API avoids.
+        """
+        cid_raw = cid.to_bytes()
+        entry, path = self._lookup_entry(cid_raw)
+        metrics = self._metrics
+        if entry is None:
+            if metrics is not None:
+                metrics.count("storex.slice_misses")
+            return None
+        _key, off, frame_len = entry
+        try:
+            with open(path, "rb") as fh:
+                mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        except (OSError, ValueError):
+            # vanished (foreign eviction) or empty under us: plain miss
+            if metrics is not None:
+                metrics.count("storex.slice_misses")
+            return None
+        view = memoryview(mm)
+        payload = None
+        if off + frame_len <= len(mm):
+            payload = self._frame_payload(cid_raw, view[off : off + frame_len], frame_len)
+        if payload is None:
+            view.release()
+            mm.close()
+            self._drop_entry(cid_raw, entry)
+            if metrics is not None:
+                metrics.count("storex.integrity_evictions")
+                metrics.count("storex.slice_misses")
+            return None
+        if metrics is not None:
+            metrics.count("storex.slice_hits")
+        return payload
 
     def _read_verified(
         self, cid: CID, cid_raw: bytes, path: str, off: int, frame_len: int

@@ -128,6 +128,15 @@ class TieredBlockstore:
             self._cache_put(cid, data)
         return data
 
+    def read_frame_slice(self, cid: CID) -> "Optional[memoryview]":
+        """Zero-copy disk-tier read for the streaming wire: a verified
+        ``memoryview`` straight out of the segment frame, or None. Goes
+        DIRECTLY to tier 2 — deliberately skipping the tier-1 promotion a
+        normal `get` would do, because promoting would materialize the
+        copy this path exists to avoid (and the bytes are already warm
+        where the streamer wants them: on disk, mmap-able)."""
+        return self._disk.read_frame_slice(cid)
+
     def has_local(self, cid: CID) -> bool:
         """Membership in the LOCAL tiers only — no inner-store (RPC)
         traffic, so the follower can dedup without defeating its point."""

@@ -80,6 +80,7 @@ class StandingQueries:
         push_timeout_s: float = 10.0,
         gen_workers: int = 2,
         delta: bool = True,
+        service=None,
         opener=None,
         sleep=time.sleep,
         rng: Optional[random.Random] = None,
@@ -111,6 +112,7 @@ class StandingQueries:
             match_backend=match_backend,
             gen_workers=gen_workers,
             delta=delta,
+            service=service,
         )
         # Restart convergence: deliveries that were unacked at the last
         # shutdown/crash re-push as soon as the daemon is back.

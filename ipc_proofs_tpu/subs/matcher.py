@@ -91,11 +91,18 @@ class StandingQueryMatcher:
         match_backend=None,
         gen_workers: int = 2,
         delta: bool = True,
+        service=None,
     ):
         self._registry = registry
         self._log = log
         self._push = push
         self._store = store
+        # with a ProofService attached, generations ride its batcher's
+        # PUSH lane (`submit_range_window(lane="push")`) instead of this
+        # matcher's private executor — one priority order across
+        # interactive requests, standing-query pushes and backfill windows
+        # instead of two planes competing blindly for the same workers
+        self._service = service
         self._metrics = metrics if metrics is not None else get_metrics()
         self.chunk_size = max(1, int(chunk_size))
         self.delta = bool(delta)
@@ -232,15 +239,27 @@ class StandingQueryMatcher:
                     actor_id=filt["actor_id"], slot=bytes.fromhex(filt["slot"])
                 )
             ]
-        bundle = generate_event_proofs_for_range_chunked(
-            self._store,
-            [pair],
-            spec,
-            chunk_size=self.chunk_size,
-            match_backend=self._backend,
-            metrics=self._metrics,
-            storage_specs=storage_specs,
-        )
+        if self._service is not None:
+            # unified priority lane: the service's batcher orders this
+            # push ahead of interactive batches, and the canonical
+            # chunked driver keeps the bytes identical to the direct call
+            bundle = self._service.submit_range_window(
+                [pair],
+                chunk_size=self.chunk_size,
+                lane="push",
+                spec=spec,
+                storage_specs=storage_specs,
+            ).result()
+        else:
+            bundle = generate_event_proofs_for_range_chunked(
+                self._store,
+                [pair],
+                spec,
+                chunk_size=self.chunk_size,
+                match_backend=self._backend,
+                metrics=self._metrics,
+                storage_specs=storage_specs,
+            )
         self._metrics.count("subs.generations")
         if not bundle.event_proofs and not bundle.storage_proofs:
             return None

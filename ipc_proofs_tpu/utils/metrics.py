@@ -249,6 +249,7 @@ VERIFY_COUNTERS = (
 SERVE_COUNTERS = (
     "serve.accepted.*",
     "serve.accepted_low.*",  # low-priority lane admissions (backfill windows)
+    "serve.accepted_push.*",  # push-lane admissions (standing-query windows)
     "serve.rejected_closed.*",
     "serve.rejected_full.*",
     "serve.deadline_exceeded.*",
@@ -256,6 +257,18 @@ SERVE_COUNTERS = (
     "serve.batches.verify",
     "serve.idempotent_hits",
     "serve.result_cache_evictions",
+    # Streaming wire (witness/stream.py + the serve/router front ends):
+    #   responses       — streamed responses completed (terminator sent)
+    #   zero_copy_bytes — block payload bytes sent as memoryview slices of
+    #                     disk-tier segment frames (never copied through
+    #                     Python) — the tentpole meter
+    #   copied_bytes    — block payload bytes that DID copy (cache-warm
+    #                     blocks, eviction fallback, compressed frames)
+    #   aborts          — streams ended by an in-band typed error chunk
+    "serve.stream.responses",
+    "serve.stream.zero_copy_bytes",
+    "serve.stream.copied_bytes",
+    "serve.stream.aborts",
 )
 
 # Counter vocabulary of the tiered block store + chain follower
@@ -287,6 +300,9 @@ SERVE_COUNTERS = (
 STOREX_COUNTERS = (
     "storex.disk_hits",
     "storex.disk_misses",
+    "storex.slice_hits",  # zero-copy frame slices handed out (mmap-backed)
+    "storex.slice_misses",  # slice lookups that fell back to a copied read
+
     "storex.evictions",
     "storex.integrity_evictions",
     "storex.shared_evictions",
@@ -405,6 +421,10 @@ WITNESS_COUNTERS = (
 #   cluster.subs_rearced     — subscriptions re-registered on a surviving
 #                              shard after their home shard died (original
 #                              sub ids; registry dedup absorbs replays)
+#   cluster.stream_blocks_deduped — witness blocks a streamed scatter did
+#                              NOT re-send because an earlier shard's
+#                              sub-bundle already carried them (the fold's
+#                              first-sight filter saves the wire bytes)
 CLUSTER_COUNTERS = (
     "cluster.requests",
     "cluster.scatter_requests",
@@ -414,6 +434,7 @@ CLUSTER_COUNTERS = (
     "cluster.shard_failovers",
     "cluster.subscribe_requests",
     "cluster.subs_rearced",
+    "cluster.stream_blocks_deduped",
 )
 
 # Stage-timer vocabulary (`Metrics.stage(...)`): every `with
@@ -442,7 +463,9 @@ PIPELINE_STAGES = (
 SERVE_GAUGES = (
     "serve.queue_depth.*",  # per-batcher queue depth (generate/verify)
     "serve.queue_depth_low.*",  # per-batcher LOW-priority lane depth
+    "serve.queue_depth_push.*",  # per-batcher PUSH-priority lane depth
     "serve.result_cache_bytes",  # hot bytes in the spilled result cache
+    "qos.tenant_queues",  # live per-tenant sub-queues in the fair queue
 )
 DURABILITY_GAUGES = (
     "jobs.journal_bytes",  # bytes in the active job's write-ahead journal
@@ -536,14 +559,23 @@ SLO_COUNTERS = (
     "slo.anomalies",
 )
 
-# Per-tenant accounting substrate (ROADMAP item 6's QoS meters against
-# these). Bounded cardinality: the first `top_k` tenants seen get their own
-# label; everyone else accumulates into the `other` overflow bucket.
+# Per-tenant accounting substrate and the QoS meters on top of it
+# (serve/qos.py). Bounded cardinality: the first `top_k` tenants seen get
+# their own label; everyone else accumulates into the `other` overflow
+# bucket.
 #   tenant.requests.<slot>  — admitted requests attributed to the slot
-#   tenant.bytes.<slot>     — request body bytes attributed to the slot
+#   tenant.bytes.<slot>     — request + response bytes attributed to the
+#                             slot (response bytes account at SEND time,
+#                             streamed chunks included)
+#   tenant.throttled.<slot> — admissions refused by the slot's token
+#                             bucket (typed 429 + Retry-After)
+#   qos.throttled           — all token-bucket refusals (slot-independent
+#                             aggregate the SLO watchdog can page on)
 TENANT_COUNTERS = (
     "tenant.requests.*",
     "tenant.bytes.*",
+    "tenant.throttled.*",
+    "qos.throttled",
 )
 
 # Lazily-bound obs.trace.span factory: `Metrics.stage()` opens a span per

@@ -14,6 +14,7 @@ from __future__ import annotations
 __all__ = [
     "DeltaBaseMismatchError",
     "DeltaBaseMissingError",
+    "StreamAbortError",
     "WitnessEncodingError",
     "WitnessError",
     "WitnessIntegrityError",
@@ -56,3 +57,17 @@ class DeltaBaseMismatchError(WitnessError):
     a silently different bundle."""
 
     error_type = "witness_delta_base"
+
+
+class StreamAbortError(WitnessError):
+    """The server aborted a streamed response in-band (an ``E`` chunk):
+    by the time a mid-stream failure happens the 200 status line is
+    already on the wire, so the typed error travels as a chunk instead
+    of a status code. ``remote_error_type`` carries the server's
+    original ``error_type`` (e.g. ``merge_conflict``)."""
+
+    error_type = "stream_abort"
+
+    def __init__(self, message: str, remote_error_type: str = "internal"):
+        super().__init__(message)
+        self.remote_error_type = remote_error_type

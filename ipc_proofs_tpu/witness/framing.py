@@ -40,7 +40,9 @@ __all__ = [
     "compress_blocks",
     "decompress_blocks",
     "pack_blocks",
+    "read_uvarint",
     "supported_encodings",
+    "uvarint",
 ]
 
 IDENTITY = "identity"
@@ -63,7 +65,10 @@ def supported_encodings() -> "tuple[str, ...]":
     return out
 
 
-def _uvarint(n: int) -> bytes:
+def uvarint(n: int) -> bytes:
+    """LEB128 unsigned varint — the length prefix of this frame AND of
+    the streaming wire's chunks (`witness/stream.py` reuses this codec so
+    a stream decoder needs exactly one varint implementation)."""
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -75,7 +80,10 @@ def _uvarint(n: int) -> bytes:
             return bytes(out)
 
 
-def _read_uvarint(buf: bytes, pos: int) -> "tuple[int, int]":
+def read_uvarint(buf: bytes, pos: int) -> "tuple[int, int]":
+    """Decode one `uvarint` at ``pos``; returns ``(value, next_pos)``.
+    Truncated or >64-bit varints raise `WitnessIntegrityError` — frame
+    and stream decoders share the same typed failure."""
     shift = 0
     value = 0
     while True:
@@ -89,6 +97,11 @@ def _read_uvarint(buf: bytes, pos: int) -> "tuple[int, int]":
         shift += 7
         if shift > 63:
             raise WitnessIntegrityError("oversized varint in witness frame")
+
+
+# historical private names (internal callers predate the public export)
+_uvarint = uvarint
+_read_uvarint = read_uvarint
 
 
 def pack_blocks(blocks: Sequence[ProofBlock]) -> bytes:

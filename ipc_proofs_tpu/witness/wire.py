@@ -23,6 +23,14 @@ client's NEXT ``base_digest``), and a delta response names its base in
 and (for deltas) the base bundle the client holds, it reproduces the
 plain canonical bundle byte-identically or raises a typed error — the
 differential grid in the tests pins every combination.
+
+Transport is orthogonal to encoding: the same negotiated fields ride
+either one buffered JSON body or the chunked binary stream wire
+(`ipc_proofs_tpu.witness.stream`, opted into with ``"stream": true`` or
+``Accept: application/x-ipc-bundle-stream``). A streamed document
+reassembles to exactly the fields this module would have emitted
+buffered, so `expand_response_fields` is the single client-side expander
+for both transports.
 """
 
 from __future__ import annotations

@@ -227,6 +227,19 @@ _ONCHIP_OK = {
     "verify_autotuned_min_bytes": 262144,
 }
 
+_ZEROCOPY_OK = {
+    "warm_block_bytes_copied_per_resp": 0.0,
+    "stream_ttfb_ms": 4.4,
+    "qos_light_tenant_p99_ms": 9.0,
+    "qos_light_tenant_p50_ms": 3.0,
+    "qos_heavy_backlog_drain_ms": 120.0,
+    "zerocopy_bytes_per_resp": 2323,
+    "zerocopy_responses": 16,
+    "qos_heavy_concurrency": 6,
+    "qos_heavy_requests": 800,
+    "zerocopy_host_cpus": 4,
+}
+
 _BACKFILL_OK = {
     "backfill_epochs_per_sec": 95.0,
     "backfill_epochs_per_sec_1shard": 30.0,
@@ -274,6 +287,7 @@ class TestOrchestrate:
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
+            "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -318,6 +332,10 @@ class TestOrchestrate:
         assert out["backfill_ttfc_ms"] == 140.0
         assert out["verify_tuned_speedup"] == 4.0
         assert out["verify_autotune_scalar_only"] is False
+        assert out["legs"]["zerocopy"] == "ok:cpu"
+        assert out["warm_block_bytes_copied_per_resp"] == 0.0
+        assert out["stream_ttfb_ms"] == 4.4
+        assert out["qos_light_tenant_p99_ms"] == 9.0
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -339,6 +357,7 @@ class TestOrchestrate:
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
+            "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -353,7 +372,7 @@ class TestOrchestrate:
             ("resilience", "cpu"), ("durability", "cpu"),
             ("observability", "cpu"), ("storage", "cpu"),
             ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
-            ("fleetobs", "cpu"), ("backfill", "cpu"),
+            ("fleetobs", "cpu"), ("backfill", "cpu"), ("zerocopy", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -375,6 +394,7 @@ class TestOrchestrate:
             "standing": [(dict(_STANDING_OK), "ok:cpu")],
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
+            "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -427,6 +447,7 @@ class TestOrchestrate:
             "standing": [(None, "error:cpu")],
             "fleetobs": [(None, "error:cpu")],
             "backfill": [(None, "error:cpu")],
+            "zerocopy": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -453,7 +474,9 @@ class TestOrchestrate:
             "verify_tuned_speedup", "verify_autotune_scalar_only",
             "verify_autotuned_min_bytes", "backfill_epochs_per_sec",
             "backfill_ttfc_ms", "backfill_total_ms",
-            "backfill_occupancy_pct",
+            "backfill_occupancy_pct", "warm_block_bytes_copied_per_resp",
+            "stream_ttfb_ms", "qos_light_tenant_p99_ms",
+            "zerocopy_bytes_per_resp",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

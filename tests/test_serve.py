@@ -570,6 +570,34 @@ class TestHTTP:
             == [p.to_json_obj() for p in full_bundle.event_proofs]
         )
 
+    def test_streamed_timing_gains_stream_ms_and_still_sums_to_wall(
+        self, server, full_bundle
+    ):
+        from ipc_proofs_tpu.witness.stream import decode_bundle_stream
+
+        t0 = time.monotonic()
+        conn = HTTPConnection("127.0.0.1", server.port, timeout=30)
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({"pair_index": 0, "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        wall_ms = (time.monotonic() - t0) * 1000.0
+        conn.close()
+        assert resp.status == 200
+        out = decode_bundle_stream(raw)
+        timing = out["server_timing"]
+        # the streamed transport adds its own accounted stage…
+        assert set(timing) >= {"queue_ms", "batch_wait_ms",
+                               "generate_ms", "stream_ms"}
+        assert all(v >= 0 for v in timing.values())
+        # …and the stages still cover admission→completion, which the
+        # client-observed wall strictly contains (same pin as test_obs)
+        assert sum(timing.values()) <= wall_ms
+        assert out["n_event_proofs"] == len(full_bundle.event_proofs)
+
     def test_metrics_and_healthz(self, server, full_bundle):
         req = UnifiedProofBundle(
             storage_proofs=[], event_proofs=[full_bundle.event_proofs[0]],

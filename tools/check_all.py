@@ -56,6 +56,7 @@ LOCKDEP_TEST_FILES = (
     "tests/test_serve_durable.py",
     "tests/test_slo.py",
     "tests/test_store.py",
+    "tests/test_stream_qos.py",
     "tests/test_storex.py",
     "tests/test_subs.py",
     "tests/test_threads.py",

@@ -30,7 +30,14 @@ flags. Two strictness levels:
   `verify_autotune_gate_skip_reason`) — and the backfill gates
   ``backfill_epochs_per_sec > 0`` and ``backfill_ttfc_ms <
   backfill_total_ms`` (streaming must beat completion — see
-  `backfill_gate_skip_reason`).
+  `backfill_gate_skip_reason`), plus the zero-copy gate
+  ``warm_block_bytes_copied_per_resp == 0`` (pure accounting over the
+  stream writer's own counters — host-shape independent, exactly zero,
+  see `zerocopy_gate_skip_reason`) and the QoS fairness gate
+  ``qos_light_tenant_p99_ms <= max(10 x p50, 250ms)`` whenever
+  ``host_cores > 2`` (on smaller hosts the heavy flood time-slices the
+  light tenant's only cores, so the tail measures core contention, not
+  queue ordering — see `qos_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -177,6 +184,16 @@ _KNOWN_TYPES = {
     "fleetobs_scrapes": int,
     "fleetobs_pairs": int,
     "fleetobs_requests": int,
+    "warm_block_bytes_copied_per_resp": _NUM,
+    "stream_ttfb_ms": _NUM,
+    "qos_light_tenant_p99_ms": _NUM,
+    "qos_light_tenant_p50_ms": _NUM,
+    "qos_heavy_backlog_drain_ms": _NUM,
+    "zerocopy_bytes_per_resp": _NUM,
+    "zerocopy_responses": int,
+    "qos_heavy_concurrency": int,
+    "qos_heavy_requests": int,
+    "zerocopy_host_cpus": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -218,6 +235,8 @@ _CURRENT_REQUIRED = (
     "standing_distinct_filters", "standing_generations_per_tipset",
     "fleetobs_overhead_pct", "fleetobs_rps_plain", "fleetobs_rps_observed",
     "fleetobs_stitched_spans",
+    "warm_block_bytes_copied_per_resp", "stream_ttfb_ms",
+    "qos_light_tenant_p99_ms",
     "legs", "watchdog_fallback",
 )
 
@@ -533,6 +552,57 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     "must stream the first chunk strictly before the job "
                     "completes"
                 )
+        # the zero-copy gate: block payload bytes copied through Python
+        # per disk-warm streamed response must be EXACTLY zero — the
+        # stream writer accounts every payload it sends as zero-copy
+        # (memoryview of a segment frame) or copied, so any non-zero
+        # value means a fallback path ran on a warm store. Pure
+        # accounting; host-shape independent.
+        if zerocopy_gate_skip_reason(obj) is None:
+            copied = obj.get("warm_block_bytes_copied_per_resp")
+            ttfb = obj.get("stream_ttfb_ms")
+            if not isinstance(copied, _NUM) or isinstance(copied, bool):
+                problems.append(
+                    "zerocopy gate: warm_block_bytes_copied_per_resp is "
+                    f"{copied!r} (zerocopy leg did not run?)"
+                )
+            elif copied != 0:
+                problems.append(
+                    f"zerocopy gate: warm_block_bytes_copied_per_resp="
+                    f"{copied} != 0 — disk-warm streamed responses must "
+                    "send block payloads as segment-frame slices, never "
+                    "copies"
+                )
+            if (
+                isinstance(ttfb, _NUM)
+                and not isinstance(ttfb, bool)
+                and ttfb <= 0
+            ):
+                problems.append(
+                    f"zerocopy gate: stream_ttfb_ms={ttfb} <= 0 — "
+                    "time-to-first-byte must be a positive measurement"
+                )
+        # the QoS fairness gate: under a saturating heavy tenant, the
+        # light tenant's tail must stay near its median — fair tenant
+        # queues bound every light request's wait to a constant number
+        # of rounds, while FIFO starvation balloons p99 relative to p50.
+        if qos_gate_skip_reason(obj) is None:
+            p99 = obj.get("qos_light_tenant_p99_ms")
+            p50 = obj.get("qos_light_tenant_p50_ms")
+            if not isinstance(p99, _NUM) or isinstance(p99, bool):
+                problems.append(
+                    f"qos gate: qos_light_tenant_p99_ms is {p99!r} "
+                    "(zerocopy leg did not run?)"
+                )
+            elif isinstance(p50, _NUM) and not isinstance(p50, bool):
+                bound = max(10 * p50, 250.0)
+                if p99 > bound:
+                    problems.append(
+                        f"qos gate: qos_light_tenant_p99_ms={p99} > "
+                        f"{bound} (max(10 x p50={p50}, 250)) — the fair "
+                        "queue must bound the light tenant's tail under "
+                        "a heavy tenant's flood"
+                    )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -711,6 +781,42 @@ def backfill_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def zerocopy_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the copied-bytes==0 zero-copy gate does NOT apply (None when
+    it does). The gate is pure accounting over the stream writer's own
+    counters — host-shape independent — so the only skip is an artifact
+    predating the zerocopy leg."""
+    if (
+        "warm_block_bytes_copied_per_resp" not in obj
+        and "stream_ttfb_ms" not in obj
+    ):
+        return "artifact predates the zerocopy leg"
+    return None
+
+
+def qos_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the light-tenant-tail fairness gate does NOT apply (None when
+    it does). Bounding the tail needs spare cores: on ≤2-core hosts the
+    heavy tenant's closed-loop threads time-slice the light tenant's
+    only cores, so the measured p99 reflects core contention, not queue
+    ordering. Callers print the reason so a skipped gate is visible,
+    never silent."""
+    if "qos_light_tenant_p99_ms" not in obj:
+        return "artifact predates the zerocopy leg"
+    cores = obj.get("host_cores")
+    if not isinstance(cores, int):
+        cores = obj.get("zerocopy_host_cpus")
+    if not isinstance(cores, int):
+        return f"host_cores={obj.get('host_cores')!r} (unknown host shape)"
+    if cores <= 2:
+        return (
+            f"host_cores={cores} ≤ 2 — the heavy tenant's closed-loop "
+            "threads time-slice the light tenant's only cores, so the "
+            "measured tail is core contention, not queue ordering"
+        )
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -761,6 +867,12 @@ def main(argv=None) -> int:
             reason = backfill_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: backfill gate SKIPPED ({reason})")
+            reason = zerocopy_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: zerocopy gate SKIPPED ({reason})")
+            reason = qos_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: qos gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")
