@@ -58,7 +58,7 @@ LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
     "asyncfetch", "cluster", "standing", "fleetobs", "onchip", "backfill",
-    "zerocopy",
+    "zerocopy", "hostkill",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -82,6 +82,7 @@ _LEG_TIMEOUTS = {
     "onchip": (480.0, 240.0),
     "backfill": (420.0, 240.0),
     "zerocopy": (420.0, 240.0),
+    "hostkill": (420.0, 240.0),
 }
 
 
@@ -2385,6 +2386,191 @@ def _leg_zerocopy(args) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _leg_hostkill(args) -> dict:
+    """Multi-host kill/recovery (host-only, in-process shards with REAL
+    replicated disk tiers): a 2-shard replication_factor=2 cluster.
+
+    - ``replica_repair_hit_rate`` — every rolled frame on one shard's
+      disk corrupted in place; fraction of the resulting integrity
+      evictions absorbed by the replica plane (peer refetch) instead of
+      falling through to the Lotus stand-in. Accounting over the shard's
+      own ``storex.*`` counters;
+    - ``aggregate_proofs_per_sec_2host`` — event proofs/s through the
+      2-shard replicated router under a closed-loop client load;
+    - ``kill_recovery_ms`` — one shard killed mid-load; ms from the kill
+      until a FULL scatter over every pair completes byte-identical to
+      the single-process driver (failover re-dispatch on the survivor).
+      Byte-identity of every answer is ASSERTED here on every run; the
+      numeric gates live in ``tools/check_bench_schema.py`` and skip
+      with a printed reason on small hosts.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from ipc_proofs_tpu.cluster import ClusterRouter, LocalShard
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+    from ipc_proofs_tpu.serve.service import ServiceConfig
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    n_pairs = 8 if args.quick else args.cluster_pairs
+    n_requests = 32 if args.quick else args.cluster_requests
+    receipts, match_rate = 8, 0.25
+    concurrency = 4
+
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, match_rate=match_rate,
+        signature=SIG, topic1=TOPIC1,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=TOPIC1)
+    direct_json = json.dumps(
+        generate_event_proofs_for_range_chunked(
+            store, list(pairs), spec, chunk_size=8
+        ).to_json_obj(),
+        sort_keys=True,
+    )
+    idxs = list(range(len(pairs)))
+
+    workdir = tempfile.mkdtemp(prefix="bench_hostkill_")
+    shard_metrics = [Metrics() for _ in range(2)]
+    shards = [
+        LocalShard(
+            f"s{k}", store, pairs, spec,
+            config=ServiceConfig(
+                max_batch=8, max_wait_ms=5.0, workers=1,
+                store_dir=os.path.join(workdir, f"s{k}"),
+                store_owner=f"s{k}",
+                store_segment_max_bytes=1,  # every spill rolls → replicable
+                cache_max_bytes=1,  # force disk reads so corruption is seen
+            ),
+            metrics=shard_metrics[k],
+        ).start()
+        for k in range(2)
+    ]
+    m = Metrics()
+    router = ClusterRouter(
+        {sh.name: sh.url for sh in shards}, pairs,
+        replication_factor=2, metrics=m, scrape_interval_s=60.0,
+    )
+    try:
+        # warm the tier (spill every witness block), then mirror it
+        status, obj = router.generate_range(idxs, chunk_size=8)
+        assert status == 200, obj
+        assert json.dumps(obj["bundle"], sort_keys=True) == direct_json
+        summary = router.replicate_now()
+        assert not summary["errors"], summary
+
+        # read-repair: corrupt EVERY rolled frame on s0's disk in place
+        s0_dir = os.path.join(workdir, "s0")
+        flipped = 0
+        for name in sorted(os.listdir(s0_dir)):
+            if name.endswith(".blk"):
+                path = os.path.join(s0_dir, name)
+                size = os.path.getsize(path)
+                with open(path, "r+b") as fh:
+                    fh.seek(size - 1)
+                    b = fh.read(1)
+                    fh.seek(size - 1)
+                    fh.write(bytes([b[0] ^ 0x40]))
+                flipped += 1
+        status, obj = router.generate_range(idxs, chunk_size=8)
+        assert status == 200, obj
+        assert json.dumps(obj["bundle"], sort_keys=True) == direct_json, (
+            "post-corruption scatter diverged"
+        )
+        c0 = shard_metrics[0].snapshot()["counters"]
+        repairs = c0.get("storex.replica_repairs", 0)
+        misses = c0.get("storex.replica_repair_misses", 0)
+        hit_rate = repairs / (repairs + misses) if (repairs + misses) else None
+
+        # closed-loop load through the replicated pair → aggregate rate
+        def load(n: int, failures: list, proofs: list):
+            it = iter(range(n))
+            it_lock = threading.Lock()
+
+            def client():
+                while True:
+                    with it_lock:
+                        i = next(it, None)
+                    if i is None:
+                        return
+                    status, obj = router.generate(i % len(pairs))
+                    if status != 200:
+                        failures.append((i, obj))
+                        return
+                    with it_lock:
+                        proofs[0] += obj["n_event_proofs"]
+
+            threads = [
+                threading.Thread(target=client) for _ in range(concurrency)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+        failures: list = []
+        proofs = [0]
+        wall = load(n_requests, failures, proofs)
+        assert not failures, f"hostkill leg: {len(failures)} load failures"
+        agg_2host = proofs[0] / wall
+
+        # kill one host mid-load; time until a full scatter is whole again
+        killer = threading.Timer(wall * 0.25, shards[1].kill)
+        failures2: list = []
+        killer.start()
+        load_thread = threading.Thread(
+            target=lambda: load(n_requests, failures2, [0])
+        )
+        load_thread.start()
+        killer.join()
+        t_kill = time.perf_counter()
+        recovery_ms = None
+        deadline = t_kill + 60.0
+        while time.perf_counter() < deadline:
+            status, obj = router.generate_range(idxs, chunk_size=8)
+            if status == 200 and json.dumps(
+                obj["bundle"], sort_keys=True
+            ) == direct_json:
+                recovery_ms = (time.perf_counter() - t_kill) * 1000.0
+                break
+        load_thread.join()
+        assert recovery_ms is not None, "no identical scatter within 60s of kill"
+        assert not failures2, (
+            f"hostkill leg: {len(failures2)} wrong answers after kill"
+        )
+        failovers = m.snapshot()["counters"].get("cluster.shard_failovers", 0)
+    finally:
+        router.close()
+        for sh in shards:
+            try:
+                sh.stop(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    _log(
+        f"bench: hostkill ({n_pairs} pairs, {n_requests} reqs): "
+        f"{agg_2host:,.0f} proofs/s @2 replicated shards; {flipped} frames "
+        f"corrupted → repair hit rate {hit_rate}; kill→whole in "
+        f"{recovery_ms:,.0f} ms ({failovers} failovers); byte-identical ✓"
+    )
+    return {
+        "aggregate_proofs_per_sec_2host": round(agg_2host, 1),
+        "replica_repair_hit_rate": (
+            round(hit_rate, 4) if hit_rate is not None else None
+        ),
+        "kill_recovery_ms": round(recovery_ms, 1),
+        "hostkill_pairs": n_pairs,
+        "hostkill_requests": n_requests,
+        "hostkill_failovers": int(failovers),
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -2404,6 +2590,7 @@ _LEG_FNS = {
     "onchip": _leg_onchip,
     "backfill": _leg_backfill,
     "zerocopy": _leg_zerocopy,
+    "hostkill": _leg_hostkill,
 }
 
 
@@ -2714,6 +2901,8 @@ def _orchestrate(args) -> None:
     legs_status["backfill"] = status
     zerocopy, status = _run_leg("zerocopy", args, "cpu")
     legs_status["zerocopy"] = status
+    hostkill, status = _run_leg("hostkill", args, "cpu")
+    legs_status["hostkill"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -2834,6 +3023,13 @@ def _orchestrate(args) -> None:
     )
     for k in _ZEROCOPY_KEYS:
         out[k] = (zerocopy or {}).get(k)
+    _HOSTKILL_KEYS = (
+        "aggregate_proofs_per_sec_2host", "replica_repair_hit_rate",
+        "kill_recovery_ms", "hostkill_pairs", "hostkill_requests",
+        "hostkill_failovers",
+    )
+    for k in _HOSTKILL_KEYS:
+        out[k] = (hostkill or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

@@ -355,6 +355,7 @@ def _cmd_range(args) -> int:
         disk = SegmentStore(
             args.store_dir,
             cap_bytes=args.store_cap_bytes,
+            segment_max_bytes=args.store_segment_max_bytes,
             metrics=metrics,
             batch_verify=args.batch_verify,
         )
@@ -488,6 +489,7 @@ def _cmd_backfill(args) -> int:
             disk = SegmentStore(
                 args.store_dir,
                 cap_bytes=args.store_cap_bytes,
+                segment_max_bytes=args.store_segment_max_bytes,
                 metrics=metrics,
                 batch_verify=args.batch_verify,
             )
@@ -729,6 +731,27 @@ def _cmd_demo(args) -> int:
     return 0 if result.all_valid() else 1
 
 
+def _parse_tenant_weights(specs) -> "dict | None":
+    """Parse repeated ``--tenant-weight name=N`` into ``{name: N}``.
+    Returns None when no weights were given (the FairQueue default —
+    every tenant weighs 1). Bad specs abort with exit code 2."""
+    if not specs:
+        return None
+    out: "dict[str, int]" = {}
+    for spec in specs:
+        name, sep, num = str(spec).partition("=")
+        try:
+            weight = int(num)
+        except ValueError:
+            weight = 0
+        if not sep or not name or weight < 1:
+            raise SystemExit(
+                f"--tenant-weight must be name=N with N >= 1 (got {spec!r})"
+            )
+        out[name] = weight
+    return out
+
+
 def _cmd_serve(args) -> int:
     """Long-running proof service: micro-batched verify/generate over HTTP.
 
@@ -833,6 +856,7 @@ def _cmd_serve(args) -> int:
             slow_request_ms=args.slow_ms,
             store_dir=args.store_dir,
             store_cap_bytes=args.store_cap_bytes,
+            store_segment_max_bytes=args.store_segment_max_bytes,
             store_owner=args.store_owner,
             batch_rpc=args.batch_rpc,
             speculate_depth=args.speculate_depth,
@@ -845,6 +869,7 @@ def _cmd_serve(args) -> int:
             witness_base_cache=args.witness_base_cache,
             tenant_rate=args.tenant_rate,
             tenant_burst=args.tenant_burst,
+            tenant_weights=_parse_tenant_weights(args.tenant_weight),
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -1023,18 +1048,24 @@ def _cmd_cluster(args) -> int:
 
     from ipc_proofs_tpu.cluster import (
         ClusterRouter,
+        RemoteShard,
         RouterHTTPServer,
         spawn_serve_shard,
     )
     from ipc_proofs_tpu.fixtures import build_range_world
     from ipc_proofs_tpu.utils.metrics import Metrics
 
-    if args.shards < 1:
-        log.error("--shards must be >= 1")
+    shard_urls = list(args.shard_url or ())
+    if args.shards < 1 and not shard_urls:
+        log.error("--shards must be >= 1 (or give at least one --shard-url)")
+        return 2
+    if args.shards < 0:
+        log.error("--shards must be >= 0")
         return 2
     if not args.demo_world:
         log.error("cluster currently requires --demo-world (hermetic mode)")
         return 2
+    tenant_weights = _parse_tenant_weights(args.tenant_weight)
 
     metrics = Metrics()
     tracing = _start_tracing(args)
@@ -1064,6 +1095,8 @@ def _cmd_cluster(args) -> int:
         ]
     if args.store_cap_bytes is not None:
         extra += ["--store-cap-bytes", str(args.store_cap_bytes)]
+    if args.store_segment_max_bytes is not None:
+        extra += ["--store-segment-max-bytes", str(args.store_segment_max_bytes)]
     # witness diet knobs are cluster-wide: every shard must negotiate the
     # same encodings or the router's scatter-gather sees mixed wire shapes
     extra += [
@@ -1072,6 +1105,11 @@ def _cmd_cluster(args) -> int:
         "--witness-agg-max", str(args.witness_agg_max),
         "--witness-base-cache", str(args.witness_base_cache),
     ]
+    if tenant_weights:
+        # fair-lane weights apply where the queues live: in each shard's
+        # batcher (the router door throttles, shards order)
+        for name, weight in sorted(tenant_weights.items()):
+            extra += ["--tenant-weight", f"{name}={weight}"]
     if args.subs_dir:
         # push/retry knobs are cluster-wide; the registry itself shards
         # per process (DIR/s<k>) and the router places subscriptions on
@@ -1115,6 +1153,23 @@ def _cmd_cluster(args) -> int:
             sh.kill()
         return 1
 
+    # multi-host members: daemons someone else runs, probed before
+    # admission so a typo'd URL fails loudly at boot instead of as a
+    # string of failovers under traffic
+    for url in shard_urls:
+        member = RemoteShard(url)
+        health = member.probe()
+        if health is None:
+            log.error("remote shard %s is unreachable — not admitted", url)
+            for sh in shards:
+                sh.kill()
+            return 1
+        log.info(
+            "remote shard %s up at %s (status=%s)",
+            member.name, member.url, health.get("status"),
+        )
+        shards.append(member)
+
     slo = None
     if args.slo == "on":
         slo = _build_slo_watchdog(args, metrics)
@@ -1122,6 +1177,9 @@ def _cmd_cluster(args) -> int:
         {sh.name: sh.url for sh in shards},
         pairs,
         steal_threshold=args.steal_threshold,
+        steal_latency_unit_s=args.steal_latency_unit_s,
+        replication_factor=args.replication_factor,
+        cut_through=(args.cut_through == "on"),
         metrics=metrics,
         scrape_interval_s=args.scrape_interval_s,
         scrape_timeout_s=args.scrape_timeout_s,
@@ -1143,6 +1201,17 @@ def _cmd_cluster(args) -> int:
         "cluster router on %s (%d shards, steal_threshold=%d, pairs=%d)",
         httpd.address, len(shards), args.steal_threshold, len(pairs),
     )
+    if args.replication_factor > 1:
+        # seed the replica tier now — every owner's segments mirror onto
+        # its ring successors before the first corrupt frame needs them
+        summary = router.replicate_now()
+        log.info(
+            "replication pass: R=%d, %d under-replicated arc(s), "
+            "lag=%d segment(s)",
+            args.replication_factor,
+            len(summary.get("under_replicated") or ()),
+            summary.get("lag_segments", 0),
+        )
 
     def _sigterm(_signum, _frame):
         raise KeyboardInterrupt
@@ -1215,6 +1284,12 @@ def main(argv=None) -> int:
             "--store-cap-bytes", type=int, default=1 << 30,
             help="byte cap on the disk tier (whole cold segments are "
             "evicted; default 1 GiB)",
+        )
+        p.add_argument(
+            "--store-segment-max-bytes", type=int, default=64 << 20,
+            help="roll the active segment at this size (default 64 MiB). "
+            "Replication pulls skip the active tail, so replicated "
+            "clusters want this small enough that hot data rolls promptly",
         )
 
     def add_fetch_plane_flags(p):
@@ -1395,6 +1470,14 @@ def main(argv=None) -> int:
             help="token-bucket burst depth per tenant (default 2×R): "
             "short spikes up to B requests admit immediately, then the "
             "bucket refills at --tenant-rate",
+        )
+        p.add_argument(
+            "--tenant-weight", action="append", default=None,
+            metavar="NAME=N",
+            help="deficit weight for one tenant in the batcher's fair "
+            "interactive lane (repeatable): a weight-N tenant drains up "
+            "to N queued requests per round-robin turn; unlisted tenants "
+            "weigh 1. In cluster mode the weights forward to every shard",
         )
 
     gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")
@@ -1805,9 +1888,36 @@ def main(argv=None) -> int:
     clu.add_argument(
         "--steal-threshold", type=int, default=4, metavar="D",
         help="steal a request from its affine shard when that shard's "
-        "in-flight depth exceeds the least-loaded shard's by D "
-        "(affinity is a cache hint, never a correctness constraint; "
-        "default 4)",
+        "EFFECTIVE load (in-flight depth + latency penalty) exceeds the "
+        "least-loaded shard's by D (affinity is a cache hint, never a "
+        "correctness constraint; default 4)",
+    )
+    clu.add_argument(
+        "--steal-latency-unit-s", type=float, default=0.25, metavar="S",
+        help="latency-penalty unit for placement: a shard's observed "
+        "dispatch EWMA counts as ewma/S phantom queue slots, so slow "
+        "(cross-host) members lose steals they'd win on raw queue depth "
+        "(default 0.25)",
+    )
+    clu.add_argument(
+        "--shard-url", action="append", default=None, metavar="URL",
+        help="admit an ALREADY-RUNNING serve daemon on another host as a "
+        "cluster member (repeatable). The member must serve the same "
+        "--demo-world pair table; it is health-probed before admission "
+        "and failed over like a spawned shard if it stops answering",
+    )
+    clu.add_argument(
+        "--replication-factor", type=int, default=1, metavar="R",
+        help="replicate each shard's hot segment files onto the next R-1 "
+        "distinct ring successors (R=1 disables). Arms peer-first "
+        "read-repair of corrupt frames and re-replication after a host "
+        "death. Shards need --store-dir to hold replicas (default 1)",
+    )
+    clu.add_argument(
+        "--cut-through", default="on", choices=["on", "off"],
+        help="relay shard stream chunks through the router as they "
+        "arrive on streamed range responses, instead of buffering each "
+        "shard's JSON sub-response (default on)",
     )
     clu.add_argument(
         "--demo-world", type=int, default=0, metavar="N_PAIRS",

@@ -37,6 +37,7 @@ from ipc_proofs_tpu.cluster.router import (
 )
 from ipc_proofs_tpu.cluster.shard import (
     LocalShard,
+    RemoteShard,
     SubprocessShard,
     spawn_serve_shard,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "LocalShard",
     "MergeConflictError",
     "NoShardsError",
+    "RemoteShard",
     "RouterHTTPServer",
     "ShardClient",
     "ShardUnavailable",

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
 from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
 
@@ -135,6 +136,28 @@ class BundleFold:
                     "across shards"
                 )
         return fresh
+
+    def fold_block(self, cid_raw: bytes, data: bytes) -> bool:
+        """Fold ONE raw witness block — the cut-through relay's door: a
+        shard's ``B`` chunk folds the moment it arrives, without ever
+        materializing that shard's sub-bundle. Returns True on first
+        sight (exactly the blocks the relay forwards downstream, so the
+        dedup guarantee of `fold` holds chunk-by-chunk); conflicting
+        bytes for a seen CID raise `MergeConflictError`, same law as
+        whole-bundle folding."""
+        if self._sealed:
+            raise RuntimeError("BundleFold already sealed")
+        raw = bytes(cid_raw)
+        prior = self._by_cid.get(raw)
+        if prior is None:
+            self._by_cid[raw] = ProofBlock(cid=CID.from_bytes(raw), data=data)
+            return True
+        if prior.data != data:
+            raise MergeConflictError(
+                f"witness block {CID.from_bytes(raw)} has conflicting bytes "
+                "across shards"
+            )
+        return False
 
     def seal(self) -> UnifiedProofBundle:
         """One canonical sort over the folded CID union → the exact

@@ -91,6 +91,27 @@ class HashRing:
             idx = 0  # wrap around the ring
         return self._points[idx][1]
 
+    def nodes_for(self, key: str, n: int) -> "list[str]":
+        """The first ``n`` DISTINCT nodes clockwise from ``key``'s point:
+        ``[primary, replica 1, replica 2, ...]`` — the replica-placement
+        walk (R-way replication puts a key's bytes on its arc owner plus
+        the next ``n - 1`` distinct successors). Returns fewer than ``n``
+        when the ring holds fewer nodes."""
+        if not self._points:
+            raise ValueError("hash ring is empty (no shards)")
+        point = _point(key)
+        idx = bisect.bisect_right(self._points, (point, "￿"))
+        out: "list[str]" = []
+        seen: "set[str]" = set()
+        for step in range(len(self._points)):
+            _, node = self._points[(idx + step) % len(self._points)]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+                if len(out) >= n:
+                    break
+        return out
+
     def nodes(self) -> Sequence[str]:
         return sorted(self._nodes)
 

@@ -46,9 +46,11 @@ journal on recovery.
 from __future__ import annotations
 
 import hashlib
+import http.client
 import json
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 import uuid
@@ -78,13 +80,22 @@ from ipc_proofs_tpu.obs.trace import (
 )
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.serve.qos import TenantQoS, TenantThrottledError
-from ipc_proofs_tpu.witness.errors import WitnessEncodingError
+from ipc_proofs_tpu.witness.errors import (
+    StreamAbortError,
+    WitnessEncodingError,
+    WitnessIntegrityError,
+)
 from ipc_proofs_tpu.subs.registry import normalize_filter, subscription_ring_key
 from ipc_proofs_tpu.witness.stream import (
+    CHUNK_BLOCK,
+    CHUNK_ERROR,
+    CHUNK_TRAILER,
     CHUNKED_TERMINATOR,
     STREAM_CONTENT_TYPE,
     BundleStreamWriter,
+    iter_stream_chunks,
     negotiate_stream,
+    parse_block_chunk,
     send_buffers,
     stream_backfill_chunks,
 )
@@ -142,6 +153,49 @@ class ShardClient:
         req = urllib.request.Request(self.base_url + path, method="GET")
         return self._roundtrip(req)
 
+    def post_stream(self, path: str, body: dict):
+        """POST asking for the IPBS streamed form (``Accept``). Returns
+        ``("stream", resp)`` with the LIVE response object when the shard
+        streamed — the caller reads chunks incrementally and must close
+        it — or ``("json", (status, obj))`` when the shard answered
+        buffered JSON (error statuses, or doors that don't stream).
+        Transport failure raises `ShardUnavailable`, exactly like `post`.
+        """
+        data = json.dumps(body).encode("utf-8")
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers={
+                "Content-Type": "application/json",
+                "Accept": STREAM_CONTENT_TYPE,
+            },
+            method="POST",
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as exc:
+            try:
+                obj = json.loads(exc.read())
+            except (ValueError, OSError):
+                obj = {"error": f"shard returned {exc.code}"}
+            return "json", (exc.code, obj)
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as exc:
+            raise ShardUnavailable(f"shard {self.name}: {exc}") from exc
+        ctype = resp.headers.get("Content-Type", "")
+        if STREAM_CONTENT_TYPE not in ctype:
+            try:
+                with resp:
+                    return "json", (resp.status, json.loads(resp.read()))
+            except (
+                ValueError,
+                ConnectionError,
+                TimeoutError,
+                OSError,
+                http.client.HTTPException,
+            ) as exc:
+                raise ShardUnavailable(f"shard {self.name}: {exc}") from exc
+        return "stream", resp
+
     def _roundtrip(self, req) -> "tuple[int, dict]":
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
@@ -158,12 +212,16 @@ class ShardClient:
 
 
 class _ShardState:
-    __slots__ = ("client", "alive", "inflight")
+    __slots__ = ("client", "alive", "inflight", "latency_ewma_s")
 
     def __init__(self, client: ShardClient):
         self.client = client
         self.alive = True
         self.inflight = 0
+        # EWMA of observed dispatch latency (s). Starts at 0 so a shard
+        # is judged purely on queue depth until it has been measured —
+        # remote members earn their latency penalty from real traffic.
+        self.latency_ewma_s = 0.0
 
 
 class ClusterRouter:
@@ -180,6 +238,9 @@ class ClusterRouter:
         shards: "Dict[str, str] | Dict[str, ShardClient]",
         pairs: Sequence,
         steal_threshold: int = 4,
+        steal_latency_unit_s: float = 0.25,
+        replication_factor: int = 1,
+        cut_through: bool = True,
         vnodes: int = 64,
         metrics: Optional[Metrics] = None,
         request_timeout_s: float = 120.0,
@@ -199,6 +260,19 @@ class ClusterRouter:
             raise NoShardsError("a cluster needs at least one shard")
         self.pairs = list(pairs)
         self.steal_threshold = max(1, int(steal_threshold))
+        # latency-penalty term for placement: a shard's observed dispatch
+        # EWMA counts as `ewma / unit` phantom queue entries, so a slow
+        # (remote, cross-host) shard loses steals it would win on queue
+        # depth alone. The unit is "one queue slot's worth of latency".
+        self.steal_latency_unit_s = max(1e-6, float(steal_latency_unit_s))
+        # R-way replication of the segment tier (1 = off): every owner's
+        # segment files are mirrored onto the next R-1 distinct ring
+        # successors so a corrupt frame repairs peer-first and a dead
+        # host's arcs survive elsewhere. Supervised by `replicate_now`.
+        self.replication_factor = max(1, int(replication_factor))
+        # streamed scatters relay shard B chunks as they arrive instead
+        # of buffering each shard's JSON sub-response (`post_stream`)
+        self.cut_through = bool(cut_through)
         self.metrics = metrics if metrics is not None else Metrics()
         self._lock = named_lock("ClusterRouter._lock")
         self._shards: "Dict[str, _ShardState]" = {}  # guarded-by: _lock
@@ -215,6 +289,8 @@ class ClusterRouter:
         # sub_id → (ring_key, register body): the failover mirror that lets
         # _mark_dead re-home a dead shard's subscription arc.
         self._standing: "Dict[str, Tuple[str, dict]]" = {}  # guarded-by: _lock
+        # last replication supervision pass (see replicate_now)
+        self._replication_last: Optional[dict] = None  # guarded-by: _lock
         self._executor = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="cluster-scatter"
         )
@@ -270,19 +346,28 @@ class ClusterRouter:
     def _affinity_locked(self, key: str) -> str:
         return self._ring.node_for(key)
 
+    def _effective_load_locked(self, state: _ShardState) -> float:
+        """Queue depth plus the latency penalty: the shard's dispatch
+        EWMA expressed in queue-slot units (`steal_latency_unit_s`). A
+        cross-host member with a slow link looks busier than its raw
+        inflight count, so stealing doesn't flood the slowest shard."""
+        return state.inflight + state.latency_ewma_s / self.steal_latency_unit_s
+
     @locked
     def _place_locked(self, key: str) -> str:
         """Affinity shard unless stealing wins (see module docstring)."""
         if not len(self._ring):
             raise NoShardsError("all shards are dead")
         affine = self._affinity_locked(key)
-        least = min(
+        least_state = min(
             (s for s in self._shards.values() if s.alive),
-            key=lambda s: (s.inflight, s.client.name),
-        ).client.name
+            key=lambda s: (self._effective_load_locked(s), s.client.name),
+        )
+        least = least_state.client.name
         if (
             least != affine
-            and self._shards[affine].inflight - self._shards[least].inflight
+            and self._effective_load_locked(self._shards[affine])
+            - self._effective_load_locked(least_state)
             >= self.steal_threshold
         ):
             self.metrics.count("cluster.steals")
@@ -304,6 +389,16 @@ class ClusterRouter:
                 state.inflight -= 1
                 self.metrics.set_gauge(
                     f"cluster.inflight.{name}", state.inflight
+                )
+
+    def _note_latency(self, name: str, elapsed_s: float) -> None:
+        """Fold one observed dispatch latency into the shard's EWMA
+        (alpha 0.2 — a few requests to converge, one slow blip decays)."""
+        with self._lock:
+            state = self._shards.get(name)
+            if state is not None:
+                state.latency_ewma_s = (
+                    0.8 * state.latency_ewma_s + 0.2 * elapsed_s
                 )
 
     def _alive_shard_urls(self) -> "Dict[str, str]":
@@ -335,6 +430,14 @@ class ClusterRouter:
             "cluster: shard %s unreachable — ring arc redistributed", name
         )
         self._rearc_subscriptions(name, rearc)
+        if self.replication_factor > 1:
+            # a death drops some arcs below R — re-replicate onto the
+            # survivors in the background (tests call replicate_now()
+            # synchronously instead; the pass is idempotent)
+            try:
+                self._executor.submit(self._replicate_after_death)
+            except RuntimeError:
+                pass  # executor already shut down (router closing)
 
     def _rearc_subscriptions(
         self, dead: str, rearc: "List[Tuple[str, str, dict]]"
@@ -378,6 +481,122 @@ class ClusterRouter:
         with self._lock:
             return sorted(n for n, s in self._shards.items() if s.alive)
 
+    # --- replicated segment tier (storex.replica) ---------------------------
+
+    @locked
+    def _replication_plan_locked(self) -> "Dict[str, List[str]]":
+        """Owner token → replica shard names. A LIVE owner's segments
+        mirror onto the next R-1 distinct ring successors; a DEAD
+        owner's token still walks the (survivor) ring but needs R full
+        copies — its own copy died with it. Deterministic in membership,
+        so every supervision pass converges to the same placement."""
+        plan: "Dict[str, List[str]]" = {}
+        want = self.replication_factor
+        if not len(self._ring):
+            return plan
+        for owner, state in self._shards.items():
+            nodes = [
+                n
+                for n in self._ring.nodes_for(owner, want + 1)
+                if n != owner
+            ]
+            plan[owner] = nodes[: want - 1] if state.alive else nodes[:want]
+        return plan
+
+    def _replicate_after_death(self) -> None:
+        try:
+            self.replicate_now()
+        except Exception:  # fail-soft: a failed supervision pass must not poison the failover path; the next periodic pass retries and under_replicated_arcs stays raised
+            logger.exception("cluster: replication pass after death failed")
+
+    def replicate_now(self) -> dict:
+        """One replication supervision pass (idempotent, safe to repeat):
+
+        1. compute the owner → replicas plan from the ring;
+        2. install every live shard's read-repair peer set
+           (``POST /v1/replica_peers`` — all OTHER live shards: segments
+           are content-addressed, so over-asking is merely wasted probes);
+        3. tell each replica shard to pull its assigned owners' segment
+           files (``POST /v1/replicate``).
+
+        Runs at boot, after any `_mark_dead`, and on demand. Gauges:
+        ``cluster.under_replicated_arcs`` (owners whose plan didn't fully
+        sync this pass) and ``cluster.replication_lag_segments`` (segment
+        pulls still pending under the per-pass byte budget)."""
+        summary: dict = {
+            "factor": self.replication_factor,
+            "plan": {},
+            "shards": {},
+            "errors": [],
+        }
+        if self.replication_factor <= 1:
+            with self._lock:
+                self._replication_last = summary
+            return summary
+        self.metrics.count("cluster.replications_triggered")
+        with self._lock:
+            plan = self._replication_plan_locked()
+            live = {
+                n: s.client for n, s in self._shards.items() if s.alive
+            }
+        summary["plan"] = {o: list(r) for o, r in plan.items()}
+        pull: "Dict[str, List[str]]" = {n: [] for n in live}
+        for owner, replicas in plan.items():
+            for name in replicas:
+                if name in pull:
+                    pull[name].append(owner)
+        lag = 0
+        failed_owners: "set[str]" = set()
+        for name in sorted(live):
+            client = live[name]
+            peers = [
+                {"name": n, "url": c.base_url}
+                for n, c in sorted(live.items())
+                if n != name
+            ]
+            owners = sorted(pull[name])
+            try:
+                status, _obj = client.post(
+                    "/v1/replica_peers", {"peers": peers}
+                )
+                if status != 200:
+                    # shard without a disk tier: can't hold replicas
+                    if owners:
+                        failed_owners.update(owners)
+                    continue
+                if not owners:
+                    continue
+                status, obj = client.post(
+                    "/v1/replicate", {"sources": peers, "owners": owners}
+                )
+            except ShardUnavailable:
+                self._mark_dead(name)
+                failed_owners.update(owners)
+                summary["errors"].append(f"{name}: unreachable")
+                continue
+            if status != 200 or not isinstance(obj, dict):
+                failed_owners.update(owners)
+                summary["errors"].append(f"{name}: http {status}")
+                continue
+            if obj.get("errors"):
+                failed_owners.update(owners)
+                summary["errors"].extend(
+                    f"{name}: {e}" for e in obj["errors"]
+                )
+            lag += int(obj.get("pending") or 0)
+            summary["shards"][name] = {
+                k: obj.get(k) for k in ("pulled", "bytes", "blocks", "pending")
+            }
+        summary["under_replicated"] = sorted(failed_owners)
+        summary["lag_segments"] = lag
+        self.metrics.set_gauge(
+            "cluster.under_replicated_arcs", len(failed_owners)
+        )
+        self.metrics.set_gauge("cluster.replication_lag_segments", lag)
+        with self._lock:
+            self._replication_last = summary
+        return summary
+
     # --- dispatch with failover -------------------------------------------
 
     def _dispatch(self, key: str, path: str, body: dict) -> "tuple[int, dict]":
@@ -403,10 +622,12 @@ class ClusterRouter:
             attempted.add(name)
             self.metrics.count("cluster.sub_requests")
             try:
+                t0 = time.monotonic()
                 with span(
                     "cluster.dispatch", {"shard": name, "path": path}
                 ):
                     status, obj = client.post(path, body)
+                self._note_latency(name, time.monotonic() - t0)
                 if isinstance(obj, dict):
                     self._graft_shard_spans(name, obj)
                 return status, obj
@@ -668,6 +889,22 @@ class ClusterRouter:
             groups = partition_indexes(idxs, assign)
             sp.set_attr("n_groups", len(groups))
             ctx = current_context()  # scatter threads parent under this span
+            if writer_factory is not None and self.cut_through:
+                # cut-through relay: shard B chunks forward the moment
+                # they arrive — the router never holds a shard's whole
+                # JSON sub-response in memory
+                return self._scatter_cut_through(
+                    groups,
+                    idxs,
+                    claim_idxs,
+                    aggregate,
+                    chunk_size,
+                    timeout_s,
+                    tenant,
+                    writer_factory,
+                    sp,
+                    ctx,
+                )
 
             def one(group: "List[int]") -> "tuple[int, dict]":
                 body: dict = {"pair_indexes": group}
@@ -788,6 +1025,249 @@ class ClusterRouter:
             if claims is not None:
                 out["claims"] = claims
             return 200, out
+
+    # --- cut-through streamed scatter ---------------------------------------
+
+    def _relay_stream(self, resp, fold, writer, relay_lock, aborted) -> None:
+        """Relay ONE shard's IPBS stream chunk-by-chunk: each ``B`` chunk
+        folds (first-sight dedup) and forwards under ``relay_lock`` the
+        moment it arrives; the ``T`` chunk folds the shard's proof
+        sections and ENDS the relay without waiting for stream EOF (so a
+        connection death after the trailer can never re-fold proofs on a
+        failover retry). Transport faults and truncation surface as
+        `ShardUnavailable` (→ failover, same idempotency key — the fold's
+        dedup absorbs re-sent blocks); an in-band ``E`` chunk is the
+        authoritative answer of a LIVE shard and raises `StreamAbortError`
+        (→ typed abort, never failover)."""
+        try:
+            for kind, payload in iter_stream_chunks(resp):
+                if aborted.is_set():
+                    return
+                if kind == CHUNK_BLOCK:
+                    cid_raw, data = parse_block_chunk(payload)
+                    with relay_lock:
+                        if aborted.is_set():
+                            return
+                        if fold.fold_block(cid_raw, data):
+                            writer.block(bytes(cid_raw), data)
+                        else:
+                            self.metrics.count("cluster.stream_blocks_deduped")
+                elif kind == CHUNK_TRAILER:
+                    tail = json.loads(payload)
+                    sub = UnifiedProofBundle.from_json_obj(
+                        {
+                            "storage_proofs": tail.get("storage_proofs") or [],
+                            "event_proofs": tail.get("event_proofs") or [],
+                            "blocks": [],
+                        }
+                    )
+                    with relay_lock:
+                        if not aborted.is_set():
+                            fold.fold(sub)
+                    return
+                elif kind == CHUNK_ERROR:
+                    try:
+                        err = json.loads(payload)
+                    except ValueError:
+                        err = {}
+                    raise StreamAbortError(
+                        str(err.get("error", "shard aborted its stream")),
+                        str(err.get("error_type", "internal")),
+                    )
+            raise ShardUnavailable("shard stream ended without a trailer")
+        except (ConnectionError, TimeoutError, OSError) as exc:
+            raise ShardUnavailable(f"shard stream failed mid-relay: {exc}") from exc
+        except http.client.HTTPException as exc:
+            # chunked-transfer truncation (IncompleteRead): the shard died
+            # with chunks in flight
+            raise ShardUnavailable(f"shard stream failed mid-relay: {exc}") from exc
+        except WitnessIntegrityError as exc:
+            raise ShardUnavailable(f"shard stream truncated: {exc}") from exc
+
+    def _scatter_cut_through(
+        self,
+        groups: "Dict[str, List[int]]",
+        idxs: "List[int]",
+        claim_idxs: "List[int]",
+        aggregate: bool,
+        chunk_size: Optional[int],
+        timeout_s: Optional[float],
+        tenant: Optional[str],
+        writer_factory,
+        sp,
+        ctx,
+    ) -> None:
+        """The streamed scatter, cut-through flavor: sub-requests ask the
+        shards for THEIR streamed form (`ShardClient.post_stream`) and
+        relay blocks as they arrive instead of buffering per-shard JSON
+        sub-responses. Peak router memory per scatter drops from
+        O(largest sub-response) to O(one chunk) per shard; byte identity
+        is unchanged because the same `BundleFold` merge law runs, one
+        block at a time. Always returns None — the writer is committed
+        before any sub-request, so failures travel as in-band E chunks."""
+        writer = writer_factory()
+        writer.begin(
+            {
+                "witness_encoding": "identity",
+                "n_pairs": len(idxs),
+                "n_groups": len(groups),
+                "trace_id": sp.trace_id,
+            }
+        )
+        fold = BundleFold(self.pairs, idxs, metrics=self.metrics)
+        # serializes fold mutation + writer chunk emission across the
+        # scatter's relay threads (the writer's socket is one wire)
+        relay_lock = named_lock("ClusterRouter._relay_lock")
+        aborted = threading.Event()
+
+        def one_stream(group: "List[int]") -> "tuple[int, Optional[dict]]":
+            body: dict = {"pair_indexes": group}
+            if chunk_size is not None:
+                body["chunk_size"] = chunk_size
+            if timeout_s is not None:
+                body["timeout_s"] = timeout_s
+            if tenant is not None:
+                body["tenant"] = tenant
+            # failover retries reuse this key (at-least-once + dedup)
+            body["idempotency_key"] = uuid.uuid4().hex
+            key = self._keys[group[0]]
+            attempted: "set[str]" = set()
+            with use_context(ctx):
+                carrier = carrier_from_context()
+                if carrier is not None:
+                    body["trace"] = carrier
+                while True:
+                    name, client = self._acquire(key)
+                    if name in attempted:
+                        self._release(name)
+                        raise NoShardsError(
+                            "no shard answered /v1/generate_range "
+                            f"(tried {sorted(attempted)})"
+                        )
+                    attempted.add(name)
+                    self.metrics.count("cluster.sub_requests")
+                    t0 = time.monotonic()
+                    try:
+                        with span(
+                            "cluster.dispatch",
+                            {"shard": name, "path": "/v1/generate_range"},
+                        ):
+                            kind, payload = client.post_stream(
+                                "/v1/generate_range", dict(body)
+                            )
+                            if kind == "json":
+                                # buffered fallback: a shard that didn't
+                                # stream still folds + forwards (its error
+                                # verdict stays authoritative)
+                                status, obj = payload
+                                if isinstance(obj, dict):
+                                    self._graft_shard_spans(name, obj)
+                                if status != 200:
+                                    return status, obj
+                                pl = (
+                                    obj.get("result", obj)
+                                    if obj.get("ok", True)
+                                    else obj
+                                )
+                                if "bundle" not in pl:
+                                    return 502, {
+                                        "error": (
+                                            f"shard group {name} "
+                                            "returned no bundle"
+                                        ),
+                                        "shard_response": obj,
+                                    }
+                                sub = UnifiedProofBundle.from_json_obj(
+                                    pl["bundle"]
+                                )
+                                with relay_lock:
+                                    if not aborted.is_set():
+                                        fresh = fold.fold(sub)
+                                        for b in fresh:
+                                            writer.block(
+                                                b.cid.to_bytes(), b.data
+                                            )
+                                        if len(fresh) != len(sub.blocks):
+                                            self.metrics.count(
+                                                "cluster.stream_blocks_deduped",
+                                                len(sub.blocks) - len(fresh),
+                                            )
+                            else:
+                                resp = payload
+                                try:
+                                    self._relay_stream(
+                                        resp, fold, writer,
+                                        relay_lock, aborted,
+                                    )
+                                finally:
+                                    try:
+                                        resp.close()
+                                    except OSError:
+                                        pass
+                                self.metrics.count("cluster.stream_cut_through")
+                        self._note_latency(name, time.monotonic() - t0)
+                        return 200, None
+                    except ShardUnavailable:
+                        self._mark_dead(name)
+                        self.metrics.count("cluster.shard_failovers")
+                    finally:
+                        self._release(name)
+
+        futures = {
+            self._executor.submit(one_stream, group): name
+            for name, group in groups.items()
+        }
+        failure = None
+        # drain EVERY future before touching the writer from this thread:
+        # lagging relays write chunks until they observe the abort flag,
+        # and the terminator must be the last thing on the wire
+        for fut in as_completed(futures):
+            name = futures[fut]
+            try:
+                status, obj = fut.result()
+            except Exception as exc:  # fail-soft: first failure becomes the typed in-band E chunk below; later ones lose the race but every relay still drains
+                if failure is None:
+                    failure = exc
+                    aborted.set()
+                continue
+            if status != 200 and failure is None:
+                failure = (status, obj, name)
+                aborted.set()
+        if failure is not None:
+            with relay_lock:
+                if isinstance(failure, StreamAbortError):
+                    writer.error(str(failure), failure.remote_error_type)
+                elif isinstance(failure, tuple):
+                    _status, obj, name = failure
+                    writer.error(
+                        str(obj.get("error", f"shard group {name} failed")),
+                        str(obj.get("error_type", "shard_error")),
+                    )
+                else:
+                    writer.error(str(failure), "internal")
+            return None
+        merged = fold.seal()
+        claims = None
+        if aggregate:
+            from ipc_proofs_tpu.witness import aggregate_range_bundle
+
+            claims = aggregate_range_bundle(
+                merged,
+                self.pairs,
+                idxs,
+                claim_indexes=claim_idxs,
+                metrics=self.metrics,
+            ).claims_json()
+        tail = {
+            "storage_proofs": [p.to_json_obj() for p in merged.storage_proofs],
+            "event_proofs": [p.to_json_obj() for p in merged.event_proofs],
+            "digest": merged.digest(),
+            "n_event_proofs": len(merged.event_proofs),
+        }
+        if claims is not None:
+            tail["claims"] = claims
+        writer.end(tail)
+        return None
 
     # --- bulk backfill ------------------------------------------------------
 
@@ -1020,6 +1500,12 @@ class ClusterRouter:
             "last_finalized_epoch": max_epoch,
             "delivery_backlog": backlog,
             "store_disk_bytes": disk_bytes,
+        }
+        with self._lock:
+            replication_last = self._replication_last
+        out["replication"] = {
+            "factor": self.replication_factor,
+            "last_pass": replication_last,
         }
         if self.slo is not None:
             out["slo"] = self.slo.status()

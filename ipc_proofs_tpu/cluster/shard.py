@@ -29,11 +29,14 @@ modes this repo serves.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
+import urllib.request
 from typing import Optional, Sequence
 
 from ipc_proofs_tpu.serve.durable import DurableAdmission
@@ -41,7 +44,7 @@ from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
 from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
 from ipc_proofs_tpu.utils.log import get_logger
 
-__all__ = ["LocalShard", "SubprocessShard", "spawn_serve_shard"]
+__all__ = ["LocalShard", "RemoteShard", "SubprocessShard", "spawn_serve_shard"]
 
 logger = get_logger(__name__)
 
@@ -138,6 +141,56 @@ class LocalShard:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class RemoteShard:
+    """Handle to a serve daemon SOMEONE ELSE runs (``--shard-url``).
+
+    The multi-host member: the router did not spawn it and must not kill
+    it, so lifecycle is reduced to health probing — ``stop()``/``kill()``
+    only mark the handle dead locally (the same drain contract shape as
+    the owned flavors, minus the process control). ``probe()`` is the
+    liveness check the cluster CLI runs before admitting the member and
+    the router's health loop repeats; a member that stops answering is
+    failed over exactly like a dead subprocess (the router only ever sees
+    the URL go connection-refused either way).
+    """
+
+    def __init__(self, url: str, name: Optional[str] = None, timeout_s: float = 5.0):
+        self.url = url.rstrip("/")
+        # default name = host:port — stable across router restarts, so
+        # ring arcs (and seg-<owner> tokens keyed on the name) survive
+        self.name = name or self.url.split("//", 1)[-1].replace("/", "_")
+        self.timeout_s = timeout_s
+        self._stopped = False
+
+    def probe(self) -> "Optional[dict]":
+        """One ``GET /healthz``: the parsed body (status 200 or 503 —
+        draining still answers), or None when the host is unreachable."""
+        try:
+            with urllib.request.urlopen(
+                self.url + "/healthz", timeout=self.timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            try:
+                return json.loads(exc.read().decode("utf-8"))
+            except ValueError:
+                return {"status": f"http {exc.code}"}
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError, ValueError):
+            return None
+
+    @property
+    def alive(self) -> bool:
+        return not self._stopped
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Drop the handle — never the remote daemon (its own operator
+        drains it). Matches the owned shards' drain contract shape."""
+        self._stopped = True
+
+    def kill(self) -> None:
+        self._stopped = True
 
 
 class SubprocessShard:

@@ -157,6 +157,7 @@ class MicroBatcher:
         name: str = "batch",
         metrics: Optional[Metrics] = None,
         executor=None,
+        tenant_weights: Optional[dict] = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -171,7 +172,8 @@ class MicroBatcher:
         self._executor = executor
         self._cond = named_condition("MicroBatcher._cond")
         # interactive lane: deficit-round-robin across tenant sub-queues
-        self._queue: FairQueue = FairQueue()  # guarded-by: _cond
+        # (per-tenant quanta from --tenant-weight; unlisted tenants = 1)
+        self._queue: FairQueue = FairQueue(weights=tenant_weights)  # guarded-by: _cond
         # push lane (standing-query fan-out): drained FIRST, greedily
         self._push: deque[PendingResult] = deque()  # guarded-by: _cond
         # low-priority lane (backfill windows): drained only when both

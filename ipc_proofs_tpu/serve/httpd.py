@@ -83,7 +83,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
-from urllib.parse import parse_qs, urlsplit
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from ipc_proofs_tpu.obs.fleet import (
     TenantLedger,
@@ -103,6 +103,7 @@ from ipc_proofs_tpu.serve.batcher import (
 )
 from ipc_proofs_tpu.serve.qos import TenantQoS, TenantThrottledError
 from ipc_proofs_tpu.serve.service import ProofService
+from ipc_proofs_tpu.storex import SegmentStoreError
 from ipc_proofs_tpu.witness import (
     AggregatedBundle,
     WitnessEncodingError,
@@ -322,8 +323,148 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {"jobs": self.backfill.jobs()})
         elif path.startswith("/v1/backfill/"):
             self._handle_backfill_get(path)
+        elif path == "/v1/segments":
+            self._handle_segments_list()
+        elif path.startswith("/v1/segments/"):
+            self._handle_segment_get(path)
+        elif path.startswith("/v1/blocks/"):
+            self._handle_block_get(path)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    # --- replication plane (storex.replica peers call these) ----------------
+
+    def _send_bytes(self, status: int, data: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _handle_segments_list(self):
+        """``GET /v1/segments`` — the replication inventory: every segment
+        file this shard holds (owner token + active flag), so a replica
+        can diff against its own set and pull only what's missing."""
+        disk = self.service.disk_store
+        if disk is None:
+            self._send_json(404, {"error": "no disk tier (serve without --store-dir)"})
+            return
+        self._send_json(
+            200, {"segments": disk.segment_files(), "owner": disk.owner}
+        )
+
+    def _handle_segment_get(self, path: str):
+        """``GET /v1/segments/<name>`` — one whole segment file, raw.
+        Append-only CRC framing makes the transfer trivially safe: the
+        puller re-scans every frame before believing a byte."""
+        disk = self.service.disk_store
+        if disk is None:
+            self._send_json(404, {"error": "no disk tier (serve without --store-dir)"})
+            return
+        name = unquote(path[len("/v1/segments/") :])
+        seg_path = disk.segment_path(name)
+        if seg_path is None:
+            self._send_json(404, {"error": f"no such segment: {name}"})
+            return
+        try:
+            with open(seg_path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            # evicted between the lookup and the read — a miss, not a fault
+            self._send_json(404, {"error": f"no such segment: {name}"})
+            return
+        self._send_bytes(200, data)
+
+    def _handle_block_get(self, path: str):
+        """``GET /v1/blocks/<cid>`` — one block from the LOCAL tiers only
+        (read-repair). 404 means this shard doesn't hold it; the route
+        never touches the upstream, so a neighbour's repair can't launder
+        a Lotus fetch through us."""
+        data = self.service.read_block_local(unquote(path[len("/v1/blocks/") :]))
+        if data is None:
+            self._send_json(404, {"error": "block not in local tiers"})
+        else:
+            self._send_bytes(200, data)
+
+    def _handle_segment_put(self, path: str):
+        """``POST /v1/segments/<name>`` — ingest one pushed segment file
+        (rebalance handoff / re-replication push). Idempotent: a name
+        already registered is a no-op; every frame is CRC-scanned before
+        registration; own-owner names are a typed 400 (a shard must never
+        shadow its own active segments)."""
+        disk = self.service.disk_store
+        if disk is None:
+            self._send_json(404, {"error": "no disk tier (serve without --store-dir)"})
+            return
+        name = unquote(path[len("/v1/segments/") :])
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > _MAX_BODY_BYTES:
+            self._send_json(
+                400,
+                {"error": f"Content-Length required, 0 < n <= {_MAX_BODY_BYTES}"},
+            )
+            return
+        raw = self.rfile.read(length)
+        try:
+            blocks = disk.ingest_segment_file(name, raw)
+        except SegmentStoreError as exc:
+            self._send_json(400, {"error": str(exc), "error_type": "segment_ingest"})
+            return
+        self._send_json(200, {"segment": name, "blocks": blocks})
+
+    def _handle_replica_peers(self, body: dict):
+        """``POST /v1/replica_peers`` — install this shard's read-repair
+        peer set (the router computes it from ring arcs)."""
+        peers = body.get("peers")
+        if not isinstance(peers, list) or not all(
+            isinstance(p, dict)
+            and isinstance(p.get("name"), str)
+            and isinstance(p.get("url"), str)
+            for p in peers
+        ):
+            self._send_json(
+                400, {"error": "peers must be a list of {name, url} objects"}
+            )
+            return
+        try:
+            self.service.set_replica_peers(peers)
+        except RuntimeError as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        self._send_json(200, {"peers": len(peers)})
+
+    def _handle_replicate(self, body: dict):
+        """``POST /v1/replicate`` — run one pull-sync pass against the
+        named source shards (optionally owner-filtered to the ring arcs
+        this shard replicates). Synchronous: the response carries the
+        pass's pulled/pending counts for the router's lag gauges."""
+        sources = body.get("sources")
+        if not isinstance(sources, list) or not all(
+            isinstance(s, dict)
+            and isinstance(s.get("name"), str)
+            and isinstance(s.get("url"), str)
+            for s in sources
+        ):
+            self._send_json(
+                400, {"error": "sources must be a list of {name, url} objects"}
+            )
+            return
+        owners = body.get("owners")
+        if owners is not None and (
+            not isinstance(owners, list)
+            or not all(isinstance(o, str) for o in owners)
+        ):
+            self._send_json(400, {"error": "owners must be a list of strings"})
+            return
+        try:
+            out = self.service.replicate_from(sources, owners=owners)
+        except RuntimeError as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        self._send_json(200, out)
 
     def _handle_backfill_get(self, path: str):
         """``GET /v1/backfill/<id>`` — job status/cursor;
@@ -398,6 +539,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, out)
 
     def do_POST(self):
+        # segment ingest carries a RAW octet-stream body (a whole segment
+        # file) — route it before the JSON body parse below
+        if self.path.startswith("/v1/segments/"):
+            self._handle_segment_put(urlsplit(self.path).path)
+            return
         try:
             body = self._read_json_body()
         except (ValueError, json.JSONDecodeError) as exc:
@@ -460,6 +606,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_unsubscribe(body)
         elif self.path == "/v1/backfill":
             self._handle_backfill_submit(body)
+        elif self.path == "/v1/replica_peers":
+            self._handle_replica_peers(body)
+        elif self.path == "/v1/replicate":
+            self._handle_replicate(body)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
 

@@ -131,17 +131,25 @@ class TenantQoS:
 class FairQueue:
     """Deficit round-robin across per-tenant FIFO sub-queues.
 
-    Unit cost per request, unit quantum per turn: the scheduler visits
-    tenants in arrival-of-first-request order, takes one request, and
-    rotates — strict round-robin across tenants, FIFO within a tenant.
-    NOT thread-safe: the `MicroBatcher` owns it under its condition
-    lock, exactly like the deque it replaces."""
+    Unit cost per request, quantum = the tenant's WEIGHT per turn
+    (``--tenant-weight name=N``; unlisted tenants weigh 1): the scheduler
+    visits tenants in arrival-of-first-request order, takes up to
+    ``weight`` requests, and rotates — weighted round-robin across
+    tenants, FIFO within a tenant. All-default weights reduce to strict
+    round-robin; single-tenant traffic degenerates to the exact FIFO
+    order the batcher always had. NOT thread-safe: the `MicroBatcher`
+    owns it under its condition lock, exactly like the deque it
+    replaces."""
 
-    __slots__ = ("_queues", "_len")
+    __slots__ = ("_queues", "_len", "_weights", "_credit")
 
-    def __init__(self):
+    def __init__(self, weights: "Optional[dict[str, int]]" = None):
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         self._len = 0
+        self._weights = dict(weights or {})
+        # the head tenant's remaining quantum this turn; 0 forces a
+        # refill from its weight on the next pop
+        self._credit = 0
 
     def __len__(self) -> int:
         return self._len
@@ -156,21 +164,27 @@ class FairQueue:
         self._len += 1
 
     def popleft(self):
-        """Next request under DRR order; the chosen tenant rotates to the
-        back of the round so its remaining backlog waits its turn."""
+        """Next request under weighted-DRR order; a tenant rotates to the
+        back of the round once its quantum (= weight) is spent, so its
+        remaining backlog waits its turn."""
         if self._len == 0:
             raise IndexError("pop from empty FairQueue")
         while True:
             key, q = next(iter(self._queues.items()))
             if not q:
                 del self._queues[key]  # drained tenant leaves the round
+                self._credit = 0
                 continue
+            if self._credit <= 0:
+                self._credit = max(1, int(self._weights.get(key, 1)))
             out = q.popleft()
             self._len -= 1
-            if q:
-                self._queues.move_to_end(key)
-            else:
+            self._credit -= 1
+            if not q:
                 del self._queues[key]
+                self._credit = 0
+            elif self._credit <= 0:
+                self._queues.move_to_end(key)
             return out
 
     def tenants(self) -> int:

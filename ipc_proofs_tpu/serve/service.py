@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from time import monotonic
 from typing import Optional, Sequence
 
+from ipc_proofs_tpu.core.cid import CID
 from ipc_proofs_tpu.proofs.bundle import ProofBlock, UnifiedProofBundle
 from ipc_proofs_tpu.proofs.range import (
     TipsetPair,
@@ -111,6 +112,12 @@ class ServiceConfig:
     # store_cap_bytes; None keeps the memory-only CachedBlockstore
     store_dir: Optional[str] = None
     store_cap_bytes: int = 1 * 1024 * 1024 * 1024
+    # roll the active segment once it reaches this size. Replication pulls
+    # skip the active tail (another process may still be appending), so a
+    # replicated tier wants this small enough that hot data rolls into
+    # immutable segments promptly; the 64 MB default matches the
+    # single-host behavior where rolling cadence is irrelevant
+    store_segment_max_bytes: int = 64 * 1024 * 1024
     # owner token for a store_dir SHARED between shard daemons: each
     # process appends only to its own seg-<owner>.* segments and eviction
     # coordinates through the directory flock (see storex/segments.py).
@@ -152,6 +159,10 @@ class ServiceConfig:
     # only adds the typed-429 rate limit.
     tenant_rate: Optional[float] = None
     tenant_burst: Optional[float] = None
+    # per-tenant deficit weights for the batcher's fair interactive lane
+    # (--tenant-weight name=N): a weight-N tenant drains up to N queued
+    # requests per round-robin turn; unlisted tenants weigh 1
+    tenant_weights: Optional[dict] = None
 
 
 @dataclass
@@ -281,6 +292,7 @@ class ProofService:
             self._disk_store = SegmentStore(
                 self.config.store_dir,
                 cap_bytes=self.config.store_cap_bytes,
+                segment_max_bytes=self.config.store_segment_max_bytes,
                 metrics=self.metrics,
                 owner=self.config.store_owner,
                 batch_verify=self.config.batch_verify,
@@ -327,6 +339,7 @@ class ProofService:
             name="verify",
             metrics=self.metrics,
             executor=self._executor,
+            tenant_weights=self.config.tenant_weights,
         )
         self._generate_batcher = (
             MicroBatcher(
@@ -337,6 +350,7 @@ class ProofService:
                 name="generate",
                 metrics=self.metrics,
                 executor=self._executor,
+                tenant_weights=self.config.tenant_weights,
             )
             if self._store is not None and self._spec is not None
             else None
@@ -497,6 +511,70 @@ class ProofService:
         if self._disk_store is None:
             return None
         return self._disk_store.read_frame_slice(cid)
+
+    # --- replication plane (storex.replica) --------------------------------
+
+    @property
+    def disk_store(self):
+        """The tier-2 `SegmentStore` (None without ``store_dir``) — the
+        replication plane's unit of transfer is its segment files."""
+        return self._disk_store
+
+    def set_replica_peers(self, peers: "Sequence[dict]") -> None:
+        """Install/replace the read-repair peer set (the router's
+        ``POST /v1/replica_peers`` body: ``[{"name", "url"}, ...]``).
+        From then on a local frame that fails CRC/multihash repairs from
+        a peer before the inner store is ever consulted."""
+        from ipc_proofs_tpu.storex import ReplicaClient, ReplicaSet
+
+        if self._disk_store is None:
+            raise RuntimeError("replication needs a disk tier (--store-dir)")
+        clients = [ReplicaClient(p["name"], p["url"]) for p in peers]
+        # self._store is a TieredBlockstore whenever a disk tier exists
+        self._store.set_replicas(ReplicaSet(clients, metrics=self.metrics))
+
+    def replicate_from(
+        self, sources: "Sequence[dict]", owners=None
+    ) -> dict:
+        """Pull-sync segment files from peer shards (the router's
+        ``POST /v1/replicate``). ``sources`` is ``[{"name", "url"}, ...]``;
+        ``owners`` optionally restricts the pull to those owner tokens
+        (the ring arcs this shard is replica for). Per-source failure is
+        fail-soft — the error string lands in ``errors`` and the other
+        sources still sync."""
+        from ipc_proofs_tpu.storex import ReplicaClient, ReplicaError, Replicator
+
+        if self._disk_store is None:
+            raise RuntimeError("replication needs a disk tier (--store-dir)")
+        rep = Replicator(self._disk_store, metrics=self.metrics)
+        out = {"pulled": 0, "bytes": 0, "blocks": 0, "pending": 0, "errors": []}
+        for src in sources:
+            try:
+                r = rep.sync_from(
+                    ReplicaClient(src["name"], src["url"]), owners=owners
+                )
+            except (ReplicaError, KeyError, TypeError) as exc:
+                out["errors"].append(str(exc))
+                continue
+            for k in ("pulled", "bytes", "blocks", "pending"):
+                out[k] += r[k]
+        return out
+
+    def read_block_local(self, cid_str: str) -> "Optional[bytes]":
+        """One block from the LOCAL tiers only (``GET /v1/blocks/<cid>``):
+        never consults the inner store, so a peer's read-repair can never
+        launder an upstream (Lotus) fetch through this shard. None = 404
+        (unparseable CID included — an address we can't hold bytes for)."""
+        if self._store is None:
+            return None
+        try:
+            cid = CID.parse(cid_str)
+        except (ValueError, KeyError, TypeError):
+            return None
+        get_local = getattr(self._store, "get_local", None)
+        if get_local is None:
+            return None
+        return get_local(cid)
 
     @property
     def match_backend(self):

@@ -23,6 +23,13 @@ event — evict + refetch from the inner store — never a correctness one.
 from ipc_proofs_tpu.storex.segments import SEGMENT_MAGIC, SegmentStore, SegmentStoreError
 from ipc_proofs_tpu.storex.tiered import TieredBlockstore
 from ipc_proofs_tpu.storex.follower import ChainFollower, FollowLeaderLock
+from ipc_proofs_tpu.storex.replica import (
+    RebalanceJob,
+    ReplicaClient,
+    ReplicaError,
+    ReplicaSet,
+    Replicator,
+)
 
 __all__ = [
     "SEGMENT_MAGIC",
@@ -31,4 +38,9 @@ __all__ = [
     "TieredBlockstore",
     "ChainFollower",
     "FollowLeaderLock",
+    "RebalanceJob",
+    "ReplicaClient",
+    "ReplicaError",
+    "ReplicaSet",
+    "Replicator",
 ]

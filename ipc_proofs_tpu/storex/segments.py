@@ -411,13 +411,23 @@ class SegmentStore:
 
     def get(self, cid: CID) -> Optional[bytes]:
         """Verified read: frame CRC + multihash, or a counted miss."""
+        return self.get2(cid)[0]
+
+    def get2(self, cid: CID) -> "tuple[Optional[bytes], str]":
+        """`get` plus the miss *reason*: ``(data, "hit")``, ``(None,
+        "miss")`` (never indexed / evicted under us), or ``(None,
+        "corrupt")`` — the frame was here but failed CRC/multihash and
+        was just integrity-evicted. The distinction is what lets the
+        tiered store try a replica repair before burning a Lotus fetch:
+        a plain miss has no reason to exist on any peer, a corrupt frame
+        almost certainly does."""
         cid_raw = cid.to_bytes()
         entry, path = self._lookup_entry(cid_raw)
         metrics = self._metrics
         if entry is None:
             if metrics is not None:
                 metrics.count("storex.disk_misses")
-            return None
+            return None, "miss"
         _key, off, frame_len = entry
         data = self._read_verified(cid, cid_raw, path, off, frame_len)
         if data is None:
@@ -427,10 +437,10 @@ class SegmentStore:
             if metrics is not None:
                 metrics.count("storex.integrity_evictions")
                 metrics.count("storex.disk_misses")
-            return None
+            return None, "corrupt"
         if metrics is not None:
             metrics.count("storex.disk_hits")
-        return data
+        return data, "hit"
 
     def _read_frame(
         self, cid_raw: bytes, path: str, off: int, frame_len: int
@@ -700,6 +710,124 @@ class SegmentStore:
     def contains(self, cid: CID) -> bool:
         with self._lock:
             return cid.to_bytes() in self._index
+
+    @property
+    def owner(self) -> str:
+        """This writer's owner token (``""`` for a single-writer store)."""
+        return self._owner
+
+    # -- replication surface ---------------------------------------------
+    #
+    # Segments are append-only CRC-framed files, so replicating one is a
+    # whole-file copy plus an index scan — no re-serialization. These are
+    # the primitives `storex.replica` and the shard HTTP pull route build
+    # on: list what exists, hand out raw file bytes, ingest a peer's file.
+
+    def segment_files(self) -> "list[dict]":
+        """The current segment inventory: ``{name, owner, size, active}``
+        per segment, sorted by name. ``active`` marks a tail some process
+        may still be appending to — replication pulls skip those (their
+        bytes move once they roll)."""
+        with self._lock:
+            active_key = self._active.key if self._active is not None else None
+            out = []
+            for key, seg in self._segments.items():
+                out.append({
+                    "name": key,
+                    "owner": seg.owner or None,
+                    "size": seg.size,
+                    "active": key == active_key,
+                })
+        out.sort(key=lambda d: d["name"])
+        return out
+
+    def segment_path(self, name: str) -> Optional[str]:
+        """Absolute path of a segment this store currently indexes, or
+        None. Validates the name shape so a traversal-y request string
+        can never address outside the root."""
+        if _parse_segment_name(name) is None:
+            return None
+        with self._lock:
+            seg = self._segments.get(name)
+            if seg is None:
+                return None
+            if (
+                self._active is not None
+                and name == self._active.key
+                and self._active_fh is not None
+            ):
+                self._active_fh.flush()  # serve committed tail bytes
+            return seg.path
+
+    def ingest_segment_file(self, name: str, data: bytes) -> int:
+        """Adopt a peer's whole segment file: atomic tmp-write +
+        ``os.replace`` into the root, then index its frames. Returns the
+        number of blocks newly indexed (frames whose CID we already hold
+        index nowhere — content-addressed, the bytes are identical).
+
+        The file keeps its origin name, so the owner token stays truthful
+        (``seg-s0.*`` on s1's disk is visibly a replica of s0's data) and
+        a re-ingest of the same name is a no-op. Ingesting under our OWN
+        owner token is refused — it would collide with our append id
+        space."""
+        parsed = _parse_segment_name(name)
+        if parsed is None:
+            raise SegmentStoreError(f"{name!r} is not a segment file name")
+        if parsed[0] == self._owner:
+            raise SegmentStoreError(
+                f"refusing to ingest {name!r} under our own owner token"
+            )
+        path = os.path.join(self.root, name)
+        tmp = path + ".ingest.tmp"
+        with self._lock:
+            if name in self._segments:
+                return 0  # already replicated (or raced another pull)
+            if self.degraded:
+                return 0
+            try:
+                with open(tmp, "wb") as fh:
+                    fh.write(data)
+                    fh.flush()
+                entries, good_size, _dirty = _scan_segment(tmp)
+                os.replace(tmp, path)
+            except OSError as exc:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                logger.warning("segment ingest of %s failed: %s", name, exc)
+                return 0
+            seg = _Segment(name, parsed[0], parsed[1], path, good_size)
+            fresh = 0
+            for cid_raw, off, frame_len in entries:
+                if cid_raw in self._index:
+                    continue
+                self._index[cid_raw] = (name, off, frame_len)
+                seg.raws.append(cid_raw)
+                fresh += 1
+            self._segments[name] = seg
+            self._total_bytes += seg.size
+            self._evict_locked()
+            self._gauge_locked()
+        return fresh
+
+    def drop_segment(self, name: str) -> bool:
+        """Forget + delete one non-active segment (the post-handoff half
+        of a rebalance: once the new owner holds the bytes, the old
+        owner's copy is just cap pressure). Never drops the active tail."""
+        with self._lock:
+            if self._active is not None and name == self._active.key:
+                return False
+            seg = self._segments.get(name)
+            if seg is None:
+                return False
+            self._forget_segment_locked(name)
+            try:
+                os.remove(seg.path)
+            except OSError:
+                pass  # fail-soft: the index entry is gone either way
+            self._gauge_locked()
+        return True
 
     def __len__(self) -> int:
         with self._lock:

@@ -45,6 +45,7 @@ class TieredBlockstore:
         disk: SegmentStore,
         cache: "Optional[dict[CID, bytes] | BlockCache]" = None,
         metrics=None,
+        replicas=None,
     ):
         self._inner = inner
         self._disk = disk
@@ -52,8 +53,32 @@ class TieredBlockstore:
         self._evicting = isinstance(self._cache, BlockCache)
         self._lock = named_lock("TieredBlockstore._lock")
         self._metrics = metrics
+        # read-repair peers (storex.replica.ReplicaSet): consulted ONLY
+        # when the disk tier reports a frame as corrupt — a plain miss
+        # has no reason to exist on a peer, but a corrupt frame's bytes
+        # almost certainly do, and repairing there keeps the upstream
+        # (Lotus) out of the loop entirely
+        self._replicas = replicas
         self.hits = 0  # tier-1 hits, same meaning as CachedBlockstore.hits
         self.misses = 0
+
+    def set_replicas(self, replicas) -> None:
+        """Install/replace the read-repair `ReplicaSet` (peers are only
+        known after the whole cluster is up, so this arrives late)."""
+        self._replicas = replicas
+
+    def _disk_get_repaired(self, cid: CID) -> Optional[bytes]:
+        """Tier-2 read with read-repair: a corrupt frame (integrity
+        eviction) refetches from a replica peer BEFORE the caller ever
+        considers the inner store; repaired bytes re-spill to disk."""
+        data, status = self._disk.get2(cid)
+        if data is not None:
+            return data
+        if status == "corrupt" and self._replicas is not None and len(self._replicas):
+            data = self._replicas.repair(cid)  # verified inside
+            if data is not None:
+                self._disk.put(cid, data)
+        return data
 
     # -- tier-1 plumbing (CachedBlockstore-compatible) --------------------
 
@@ -92,7 +117,7 @@ class TieredBlockstore:
             self.hits += 1
             return cached
         self.misses += 1
-        data = self._disk.get(cid)  # verified; corruption reads as a miss
+        data = self._disk_get_repaired(cid)  # verified; corrupt frames try replicas
         if data is not None:
             self._cache_put(cid, data)
             return data
@@ -123,7 +148,7 @@ class TieredBlockstore:
         if cached is not None:
             self.hits += 1
             return cached
-        data = self._disk.get(cid)  # verified; corruption reads as a miss
+        data = self._disk_get_repaired(cid)  # verified; corrupt frames try replicas
         if data is not None:
             self._cache_put(cid, data)
         return data

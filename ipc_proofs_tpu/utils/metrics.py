@@ -297,6 +297,20 @@ SERVE_COUNTERS = (
 #                                shared --store-dir, not one per shard)
 #   follow.polls               — head polls attempted (jittered cadence;
 #                                polls × poll_s sanity-checks herd spread)
+#   storex.replica_repairs     — corrupt local frames whose bytes were
+#                                refetched + re-verified from a replica
+#                                peer shard (read-repair hits — each one
+#                                is a Lotus fetch that never happened)
+#   storex.replica_repair_misses — corrupt frames NO replica could supply
+#                                verified bytes for (falls through to the
+#                                inner store like a plain miss)
+#   storex.replica_segments_pulled — whole segment files ingested from a
+#                                peer by a replication sync pass
+#   storex.replica_bytes_pulled — bytes of those pulled segment files
+#   storex.rebalance_segments_pushed — segment files handed off to a new
+#                                arc owner under the rebalance journal
+#   storex.rebalance_resumes   — rebalance runs that replayed a partial
+#                                journal (crash/SIGKILL mid-handoff)
 STOREX_COUNTERS = (
     "storex.disk_hits",
     "storex.disk_misses",
@@ -307,6 +321,12 @@ STOREX_COUNTERS = (
     "storex.integrity_evictions",
     "storex.shared_evictions",
     "storex.write_failures",
+    "storex.replica_repairs",
+    "storex.replica_repair_misses",
+    "storex.replica_segments_pulled",
+    "storex.replica_bytes_pulled",
+    "storex.rebalance_segments_pushed",
+    "storex.rebalance_resumes",
     "follow.tipsets",
     "follow.blocks_prefetched",
     "follow.errors",
@@ -425,6 +445,13 @@ WITNESS_COUNTERS = (
 #                              NOT re-send because an earlier shard's
 #                              sub-bundle already carried them (the fold's
 #                              first-sight filter saves the wire bytes)
+#   cluster.stream_cut_through — shard sub-responses relayed chunk-by-chunk
+#                              on the streaming wire (Block chunks forwarded
+#                              as they arrive) instead of store-and-forward
+#                              of the whole shard response
+#   cluster.replications_triggered — replication sync passes the router
+#                              kicked off (cluster start, membership change,
+#                              shard death re-replication to restore R)
 CLUSTER_COUNTERS = (
     "cluster.requests",
     "cluster.scatter_requests",
@@ -435,6 +462,8 @@ CLUSTER_COUNTERS = (
     "cluster.subscribe_requests",
     "cluster.subs_rearced",
     "cluster.stream_blocks_deduped",
+    "cluster.stream_cut_through",
+    "cluster.replications_triggered",
 )
 
 # Stage-timer vocabulary (`Metrics.stage(...)`): every `with
@@ -472,6 +501,7 @@ DURABILITY_GAUGES = (
 )
 STOREX_GAUGES = (
     "storex.disk_bytes",  # bytes across all disk-tier segment files
+    "storex.replica_pending_segments",  # peer segments a sync pass still owes
     "follow.last_finalized_epoch",  # last height the follower warmed (healthz)
 )
 SUBS_GAUGES = (
@@ -483,6 +513,8 @@ SUBS_GAUGES = (
 CLUSTER_GAUGES = (
     "cluster.shards_alive",  # shards currently routable (ring members)
     "cluster.inflight.*",  # per-shard outstanding requests (steal signal)
+    "cluster.under_replicated_arcs",  # ring arcs whose replica set is not yet synced to R
+    "cluster.replication_lag_segments",  # segment files replicas still owe (fleet sum)
 )
 
 # Histogram vocabulary: bounded-reservoir distributions (p50/p90/p99).

@@ -88,7 +88,9 @@ __all__ = [
     "BundleStreamWriter",
     "decode_bundle_stream",
     "decode_bundle_stream_docs",
+    "iter_stream_chunks",
     "negotiate_stream",
+    "parse_block_chunk",
     "send_buffers",
     "stream_backfill_chunks",
     "stream_bundle_doc",
@@ -372,6 +374,60 @@ def _iter_chunks(raw: bytes, pos: int):
         pos += length
 
 
+def _read_exact(fp, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        got = fp.read(n - len(out))
+        if not got:
+            raise WitnessIntegrityError("bundle stream truncated mid-chunk")
+        out += got
+    return out
+
+
+def iter_stream_chunks(fp):
+    """Incremental chunk iterator over a FILE-LIKE stream — the relay
+    half of the cut-through router: chunks are parsed (and can be
+    forwarded) the moment they arrive, never buffering more than one
+    chunk's payload. Yields ``(kind, payload)``; a clean EOF between
+    chunks ends the iteration, EOF inside a chunk (the producer died
+    mid-write) raises `WitnessIntegrityError`."""
+    magic = _read_exact(fp, len(STREAM_MAGIC))
+    if magic != STREAM_MAGIC:
+        raise WitnessIntegrityError("not a bundle stream (bad magic)")
+    while True:
+        head = fp.read(1)
+        if not head:
+            return
+        # uvarint, byte-at-a-time (can't over-read a live socket)
+        length = 0
+        shift = 0
+        while True:
+            b = _read_exact(fp, 1)[0]
+            length |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+            if shift > 63:
+                raise WitnessIntegrityError(
+                    "uvarint overflow in bundle stream"
+                )
+        yield head[0], _read_exact(fp, length)
+
+
+def parse_block_chunk(payload: bytes) -> "tuple[bytes, bytes]":
+    """Split one ``B`` chunk payload into ``(cid_raw, data)`` (the
+    ``uvarint(cid_len) cid_raw uvarint(data_len) data`` layout)."""
+    clen, pos = read_uvarint(payload, 0)
+    if pos + clen > len(payload):
+        raise WitnessIntegrityError("truncated CID in bundle stream block")
+    cid_raw = payload[pos : pos + clen]
+    pos += clen
+    dlen, pos = read_uvarint(payload, pos)
+    if pos + dlen != len(payload):
+        raise WitnessIntegrityError("truncated data in bundle stream block")
+    return cid_raw, payload[pos:]
+
+
 class _DocState:
     """One in-flight document between its H and T chunks."""
 
@@ -384,15 +440,7 @@ class _DocState:
 
     def add_block(self, payload: bytes) -> None:
         self.saw_blocks = True
-        clen, pos = read_uvarint(payload, 0)
-        if pos + clen > len(payload):
-            raise WitnessIntegrityError("truncated CID in bundle stream block")
-        cid_raw = payload[pos : pos + clen]
-        pos += clen
-        dlen, pos = read_uvarint(payload, pos)
-        if pos + dlen != len(payload):
-            raise WitnessIntegrityError("truncated data in bundle stream block")
-        data = payload[pos:]
+        cid_raw, data = parse_block_chunk(payload)
         prev = self.blocks.get(cid_raw)
         if prev is not None and prev != data:
             # the one duplicate the merge law forbids: same CID, different

@@ -240,6 +240,15 @@ _ZEROCOPY_OK = {
     "zerocopy_host_cpus": 4,
 }
 
+_HOSTKILL_OK = {
+    "aggregate_proofs_per_sec_2host": 514.6,
+    "replica_repair_hit_rate": 1.0,
+    "kill_recovery_ms": 99.3,
+    "hostkill_pairs": 8,
+    "hostkill_requests": 64,
+    "hostkill_failovers": 2,
+}
+
 _BACKFILL_OK = {
     "backfill_epochs_per_sec": 95.0,
     "backfill_epochs_per_sec_1shard": 30.0,
@@ -288,6 +297,7 @@ class TestOrchestrate:
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
+            "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -336,6 +346,10 @@ class TestOrchestrate:
         assert out["warm_block_bytes_copied_per_resp"] == 0.0
         assert out["stream_ttfb_ms"] == 4.4
         assert out["qos_light_tenant_p99_ms"] == 9.0
+        assert out["legs"]["hostkill"] == "ok:cpu"
+        assert out["aggregate_proofs_per_sec_2host"] == 514.6
+        assert out["replica_repair_hit_rate"] == 1.0
+        assert out["kill_recovery_ms"] == 99.3
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -358,6 +372,7 @@ class TestOrchestrate:
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
+            "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -373,6 +388,7 @@ class TestOrchestrate:
             ("observability", "cpu"), ("storage", "cpu"),
             ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
             ("fleetobs", "cpu"), ("backfill", "cpu"), ("zerocopy", "cpu"),
+            ("hostkill", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -395,6 +411,7 @@ class TestOrchestrate:
             "fleetobs": [(dict(_FLEETOBS_OK), "ok:cpu")],
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
+            "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -448,6 +465,7 @@ class TestOrchestrate:
             "fleetobs": [(None, "error:cpu")],
             "backfill": [(None, "error:cpu")],
             "zerocopy": [(None, "error:cpu")],
+            "hostkill": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -477,6 +495,8 @@ class TestOrchestrate:
             "backfill_occupancy_pct", "warm_block_bytes_copied_per_resp",
             "stream_ttfb_ms", "qos_light_tenant_p99_ms",
             "zerocopy_bytes_per_resp",
+            "aggregate_proofs_per_sec_2host", "replica_repair_hit_rate",
+            "kill_recovery_ms",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

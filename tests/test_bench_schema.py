@@ -19,6 +19,7 @@ from check_bench_schema import (  # noqa: E402
     check_artifact,
     cluster_gate_skip_reason,
     fleetobs_gate_skip_reason,
+    hostkill_gate_skip_reason,
     main,
     onchip_gate_skip_reason,
     speedup_gate_skip_reason,
@@ -446,3 +447,106 @@ class TestFleetObsGate:
         main(["--require-current", str(path)])
         out = capsys.readouterr().out
         assert "fleetobs gate SKIPPED" in out
+
+
+class TestHostkillGate:
+    """kill_recovery_ms ≤ 10 s, replica_repair_hit_rate ≥ 0.99, and
+    aggregate_proofs_per_sec_2host > 0 are enforced (require_current) on
+    hosts with spare cores, and skipped WITH A REASON on 1–2 core hosts
+    where the shards, load clients, and recovery probe time-slice the
+    same core."""
+
+    def _current(self):
+        with open(NEWEST) as fh:
+            obj = json.load(fh)
+        # a multicore shape that keeps the OTHER core-gated gates green
+        obj["host_cores"] = 8
+        obj.setdefault("pipeline_speedup_vs_serial", 1.2)
+        if not isinstance(obj.get("pipeline_speedup_vs_serial"), (int, float)):
+            obj["pipeline_speedup_vs_serial"] = 1.2
+        for key, good in (
+            ("cluster_linearity_4shard", 0.9),
+            ("fleetobs_overhead_pct", 1.0),
+            ("trace_overhead_pct", 1.0),
+            ("qos_light_tenant_p99_ms", 10.0),
+            ("kill_recovery_ms", 120.0),
+            ("replica_repair_hit_rate", 1.0),
+            ("aggregate_proofs_per_sec_2host", 500.0),
+        ):
+            val = obj.get(key)
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                obj[key] = good
+        return obj
+
+    def test_slow_recovery_fails_on_multicore_host(self):
+        obj = self._current()
+        obj["kill_recovery_ms"] = 60_000.0
+        problems = check_artifact(obj, require_current=True)
+        assert any("hostkill gate" in p and "kill_recovery_ms" in p
+                   for p in problems), problems
+
+    def test_repair_misses_fail_on_multicore_host(self):
+        obj = self._current()
+        obj["replica_repair_hit_rate"] = 0.5  # half the evictions hit Lotus
+        problems = check_artifact(obj, require_current=True)
+        assert any("replica_repair_hit_rate" in p for p in problems), problems
+
+    def test_idle_replicated_pair_fails(self):
+        obj = self._current()
+        obj["aggregate_proofs_per_sec_2host"] = 0
+        problems = check_artifact(obj, require_current=True)
+        assert any("aggregate_proofs_per_sec_2host" in p
+                   for p in problems), problems
+
+    def test_good_values_pass(self):
+        obj = self._current()
+        assert not any(
+            "hostkill gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_missing_keys_fail_on_multicore_host(self):
+        obj = self._current()
+        obj["kill_recovery_ms"] = None
+        problems = check_artifact(obj, require_current=True)
+        assert any("hostkill gate" in p and "kill_recovery_ms" in p
+                   for p in problems), problems
+
+    @pytest.mark.parametrize("cores", [1, 2, None])
+    def test_gate_skipped_with_reason_on_small_hosts(self, cores):
+        obj = self._current()
+        obj["host_cores"] = cores
+        obj["kill_recovery_ms"] = 60_000.0
+        obj["replica_repair_hit_rate"] = 0.1
+        reason = hostkill_gate_skip_reason(obj)
+        assert reason is not None and str(cores) in reason
+        assert not any(
+            "hostkill gate" in p
+            for p in check_artifact(obj, require_current=True)
+        )
+
+    def test_gate_applies_above_two_cores(self):
+        obj = self._current()
+        obj["host_cores"] = 3
+        assert hostkill_gate_skip_reason(obj) is None
+
+    def test_gate_skipped_for_prehostkill_vintages(self):
+        obj = self._current()
+        for key in (
+            "kill_recovery_ms", "replica_repair_hit_rate",
+            "aggregate_proofs_per_sec_2host", "hostkill_pairs",
+            "hostkill_requests", "hostkill_failovers",
+        ):
+            obj.pop(key, None)
+        reason = hostkill_gate_skip_reason(obj)
+        assert reason is not None and "predates" in reason
+        assert not any("hostkill gate" in p for p in check_artifact(obj))
+
+    def test_cli_prints_skip_reason(self, tmp_path, capsys):
+        obj = self._current()
+        obj["host_cores"] = 1
+        path = tmp_path / "BENCH_small_hostkill_host.json"
+        path.write_text(json.dumps(obj))
+        main(["--require-current", str(path)])
+        out = capsys.readouterr().out
+        assert "hostkill gate SKIPPED" in out and "host_cores=1" in out

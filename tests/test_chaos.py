@@ -113,3 +113,29 @@ class TestChaosDifferential:
         assert summary["runs"] == 6
         assert summary["violations"] == []
         assert summary["total_faults_injected"] > 0
+
+
+class TestShardTransportChaos:
+    def test_shard_fault_plan_is_seeded(self):
+        a = chaos.ShardFaultPlan(7, fault_rate=0.5)
+        b = chaos.ShardFaultPlan(7, fault_rate=0.5)
+        assert [a.draw() for _ in range(200)] == [b.draw() for _ in range(200)]
+        snap = a.snapshot()
+        assert sum(snap["by_kind"].values()) == snap["faults_injected"]
+        assert set(snap["by_kind"]) <= set(chaos.ShardFaultPlan.KINDS)
+
+    def test_identical_or_typed_over_shard_transport_grid(self):
+        """The cluster-door invariant: seeded drop/delay/truncate on the
+        shard HTTP transport — BOTH the buffered and the cut-through
+        streamed door — yields byte-identical bundles (failover
+        absorbed) or a typed error, never divergence or an untyped
+        escape. The pinned-seed committed form of
+        ``python tools/chaos.py SEED --shards``."""
+        summary = chaos.run_shard_grid(
+            20260807, runs=3, fault_rates=(0.1, 0.4, 0.7), n_pairs=6
+        )
+        assert summary["ok"] is True, summary["violations"]
+        assert summary["counts"]["divergent"] == 0
+        assert summary["counts"]["untyped_error"] == 0
+        assert summary["counts"]["identical"] > 0
+        assert summary["total_faults_injected"] > 0

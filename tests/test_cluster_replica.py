@@ -1,0 +1,571 @@
+"""Multi-host cluster tests: replicated segment tier through the router,
+kill-a-host recovery, cut-through streamed relay, and remote members.
+
+The system invariants under test:
+
+- **Kill-a-host**: with ``replication_factor=2``, killing ANY one shard
+  mid-load yields complete, byte-identical ``/v1/generate_range``
+  bundles (failover re-dispatch), and one supervision pass restores R
+  full copies of the dead owner's segment files on the survivors.
+- **Read-repair beats Lotus**: a corrupt local frame on one shard
+  repairs from its replica peer — the scatter stays byte-identical with
+  ZERO new RPC block fetches (``rpc.calls`` delta pinned 0,
+  ``storex.replica_repairs`` == integrity evictions).
+- **Cut-through relay**: the streamed router door forwards shard Block
+  chunks as they arrive — byte-identical to the buffered scatter, at a
+  measurably lower router peak memory (tracemalloc).
+- **Mid-stream shard death**: a shard dying after its first Block chunk
+  ends in a deduped failover retry (byte-identical) or a typed in-band
+  Error chunk — never torn buffered-vs-streamed divergence.
+- **Remote members**: a `RemoteShard` admitted by URL probes healthy and
+  serves ring arcs exactly like a spawned shard.
+
+All hermetic (in-process shards on ephemeral localhost ports) and
+tier-1.
+"""
+
+import io
+import json
+import os
+import tracemalloc
+from http.client import HTTPConnection
+
+import pytest
+
+from ipc_proofs_tpu.cluster import (
+    ClusterRouter,
+    LocalShard,
+    RemoteShard,
+    RouterHTTPServer,
+    ShardClient,
+)
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+from ipc_proofs_tpu.serve.service import ServiceConfig
+from ipc_proofs_tpu.store.faults import LocalLotusSession
+from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.witness.errors import StreamAbortError
+from ipc_proofs_tpu.witness.stream import (
+    CHUNK_BLOCK,
+    STREAM_CONTENT_TYPE,
+    BundleStreamWriter,
+    decode_bundle_stream,
+    iter_stream_chunks,
+)
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        6, 6, 3, 0.3, signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+        base_height=51_000,
+    )
+
+
+def _spec():
+    return EventProofSpec(
+        event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR
+    )
+
+
+def _canonical_obj(obj) -> str:
+    return json.dumps(obj, sort_keys=True)
+
+
+@pytest.fixture(scope="module")
+def direct_bundle(world):
+    store, pairs, _ = world
+    return generate_event_proofs_for_range_chunked(
+        store, list(pairs), _spec(), chunk_size=3
+    )
+
+
+def _disk_shards_up(world, root, n):
+    """N shards, each with its OWN disk tier (1-byte roll threshold so
+    every spilled block is a pullable rolled segment immediately), a
+    tiny tier-1 cache (so repeat reads actually hit disk), and its own
+    RPC-counted inner store — the Lotus stand-in whose ``rpc.calls``
+    the repair tests pin."""
+    bs, pairs, _ = world
+    shards, metrics = [], []
+    for i in range(n):
+        m = Metrics()
+        inner = RpcBlockstore(
+            LotusClient(
+                "http://test-cluster-replica",
+                session=LocalLotusSession(bs),
+                metrics=m,
+            )
+        )
+        shards.append(
+            LocalShard(
+                f"s{i}",
+                inner,
+                pairs,
+                _spec(),
+                config=ServiceConfig(
+                    max_batch=8, max_wait_ms=5.0, workers=1,
+                    store_dir=os.path.join(str(root), f"s{i}"),
+                    store_owner=f"s{i}",
+                    store_segment_max_bytes=1,
+                    cache_max_bytes=1,
+                    batch_rpc=False,
+                ),
+                metrics=m,
+            ).start()
+        )
+        metrics.append(m)
+    return shards, metrics
+
+
+def _teardown(router, shards):
+    router.close()
+    for s in shards:
+        try:
+            s.stop(timeout=10)
+        except Exception:
+            pass
+
+
+def _rpc_calls(m: Metrics) -> int:
+    return m.snapshot()["counters"].get("rpc.calls", 0)
+
+
+def _owned_segments(shard, owner: str) -> "set[str]":
+    return {
+        d["name"]
+        for d in shard.service.disk_store.segment_files()
+        if d["owner"] == owner and not d["active"]
+    }
+
+
+class TestKillAHostGrid:
+    @pytest.mark.parametrize("victim_idx", [0, 1, 2])
+    def test_kill_any_host_yields_identical_bytes_and_restores_r(
+        self, world, direct_bundle, tmp_path, victim_idx
+    ):
+        """R=2, three hosts: warm the tier, replicate, kill ONE host —
+        the next scatter must fail over to byte-identical bundles, and a
+        supervision pass must re-replicate the dead owner's arcs onto
+        BOTH survivors (a dead owner needs R full copies: its own copy
+        died with it)."""
+        _, pairs, _ = world
+        shards, _metrics = _disk_shards_up(world, tmp_path, 3)
+        m = Metrics()
+        router = ClusterRouter(
+            {s.name: s.url for s in shards}, pairs,
+            replication_factor=2, metrics=m, scrape_interval_s=60.0,
+        )
+        try:
+            status, obj = router.generate_range(
+                list(range(len(pairs))), chunk_size=3
+            )
+            assert status == 200, obj
+            summary = router.replicate_now()
+            assert not summary["errors"], summary
+            victim = shards[victim_idx]
+            victim_segs = _owned_segments(victim, victim.name)
+            assert victim_segs  # the warm scatter spilled segments
+            victim.kill()
+
+            status, obj = router.generate_range(
+                list(range(len(pairs))), chunk_size=3
+            )
+            assert status == 200, obj
+            got = UnifiedProofBundle.from_json_obj(obj["bundle"])
+            assert _canonical_obj(got.to_json_obj()) == _canonical_obj(
+                direct_bundle.to_json_obj()
+            )
+            assert m.counter_value("cluster.shard_failovers") > 0
+            assert router.alive_shards() == sorted(
+                s.name for s in shards if s is not victim
+            )
+
+            # R restored: every survivor now holds the dead owner's FULL
+            # rolled segment set (pulled peer-to-peer, never from Lotus)
+            summary = router.replicate_now()
+            assert not summary["errors"], summary
+            for survivor in shards:
+                if survivor is victim:
+                    continue
+                assert victim_segs <= _owned_segments(survivor, victim.name)
+        finally:
+            _teardown(router, shards)
+
+
+def _flip_last_byte(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size - 1)
+        b = fh.read(1)
+        fh.seek(size - 1)
+        fh.write(bytes([b[0] ^ 0x40]))
+
+
+class TestClusterReadRepair:
+    def test_corrupt_frames_repair_from_replica_with_zero_rpc(
+        self, world, direct_bundle, tmp_path
+    ):
+        """Corrupt EVERY rolled frame on one shard's disk: the next
+        scatter must stay byte-identical, every integrity eviction must
+        repair from the replica peer, and the RPC (Lotus) call count
+        must not move on either shard."""
+        _, pairs, _ = world
+        shards, metrics = _disk_shards_up(world, tmp_path, 2)
+        m = Metrics()
+        router = ClusterRouter(
+            {s.name: s.url for s in shards}, pairs,
+            replication_factor=2, metrics=m, scrape_interval_s=60.0,
+        )
+        try:
+            status, _obj = router.generate_range(
+                list(range(len(pairs))), chunk_size=3
+            )
+            assert status == 200
+            summary = router.replicate_now()
+            assert not summary["errors"], summary
+            assert summary["under_replicated"] == []
+            # each owner's plan names the other shard — 2 hosts, R=2
+            assert summary["plan"] == {"s0": ["s1"], "s1": ["s0"]}
+            rpc_before = [_rpc_calls(mm) for mm in metrics]
+
+            s0_dir = os.path.join(str(tmp_path), "s0")
+            flipped = 0
+            for name in sorted(os.listdir(s0_dir)):
+                if name.endswith(".blk"):
+                    _flip_last_byte(os.path.join(s0_dir, name))
+                    flipped += 1
+            assert flipped > 0
+
+            status, obj = router.generate_range(
+                list(range(len(pairs))), chunk_size=3
+            )
+            assert status == 200, obj
+            got = UnifiedProofBundle.from_json_obj(obj["bundle"])
+            assert _canonical_obj(got.to_json_obj()) == _canonical_obj(
+                direct_bundle.to_json_obj()
+            )
+            # Lotus was never consulted — the repair plane absorbed every
+            # corrupt frame, and repairs account for ALL evictions
+            assert [_rpc_calls(mm) for mm in metrics] == rpc_before
+            c0 = metrics[0].snapshot()["counters"]
+            assert c0.get("storex.integrity_evictions", 0) > 0
+            assert c0.get("storex.replica_repairs", 0) == c0.get(
+                "storex.integrity_evictions", 0
+            )
+            assert "storex.replica_repair_misses" not in c0
+            # the supervision pass is visible in cluster_status
+            status, cs = router.cluster_status()
+            assert status == 200
+            assert cs["replication"]["factor"] == 2
+            assert cs["replication"]["last_pass"]["plan"] == summary["plan"]
+        finally:
+            _teardown(router, shards)
+
+
+class TestReplicationPlan:
+    def test_plan_deterministic_and_dead_owner_needs_full_r(self, world):
+        _, pairs, _ = world
+        router = ClusterRouter(
+            {f"s{i}": f"http://127.0.0.1:{9000 + i}" for i in range(3)},
+            pairs, replication_factor=2, scrape_interval_s=60.0,
+        )
+        try:
+            with router._lock:
+                plan1 = router._replication_plan_locked()
+                plan2 = router._replication_plan_locked()
+            assert plan1 == plan2  # pure function of membership
+            for owner, replicas in plan1.items():
+                assert len(replicas) == 1  # live owner: R-1 mirrors
+                assert owner not in replicas
+            # a dead owner's token needs R FULL copies elsewhere
+            router._shards["s0"].alive = False
+            with router._lock:
+                plan3 = router._replication_plan_locked()
+            assert len(plan3["s0"]) == 2
+            assert "s0" not in plan3["s0"]
+        finally:
+            router.close()
+
+    def test_factor_one_is_off(self, world):
+        _, pairs, _ = world
+        router = ClusterRouter(
+            {"s0": "http://127.0.0.1:9000"}, pairs, scrape_interval_s=60.0
+        )
+        try:
+            summary = router.replicate_now()
+            assert summary["factor"] == 1
+            assert summary["plan"] == {}
+        finally:
+            router.close()
+
+
+def _post_http(port, path, obj, headers=None, raw=False):
+    conn = HTTPConnection("127.0.0.1", port, timeout=120)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", path, json.dumps(obj), hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    headers_out = dict(resp.getheaders())
+    conn.close()
+    return resp.status, headers_out, (data if raw else json.loads(data))
+
+
+class TestCutThroughRelay:
+    @pytest.fixture()
+    def cluster(self, world):
+        store, pairs, _ = world
+        shards = [
+            LocalShard(f"s{i}", store, pairs, _spec()).start()
+            for i in range(2)
+        ]
+        m = Metrics()
+        router = ClusterRouter(
+            {s.name: s.url for s in shards}, pairs,
+            metrics=m, scrape_interval_s=60.0,
+        )
+        server = RouterHTTPServer(router).start()
+        yield server, router, shards, m
+        server.shutdown(timeout=10)
+        _teardown(router, shards)
+
+    def test_streamed_scatter_is_byte_identical_and_cut_through(
+        self, cluster, world, direct_bundle
+    ):
+        _, pairs, _ = world
+        server, _router, _shards, m = cluster
+        idxs = list(range(len(pairs)))
+        st, _, buffered = _post_http(
+            server.port, "/v1/generate_range", {"pair_indexes": idxs}
+        )
+        assert st == 200, buffered
+        st, hdrs, raw = _post_http(
+            server.port, "/v1/generate_range", {"pair_indexes": idxs},
+            headers={"Accept": STREAM_CONTENT_TYPE}, raw=True,
+        )
+        assert st == 200
+        assert hdrs.get("Content-Type") == STREAM_CONTENT_TYPE
+        fields = decode_bundle_stream(raw)  # digest-checked reassembly
+        assert _canonical_obj(fields["bundle"]) == _canonical_obj(
+            buffered["bundle"]
+        )
+        assert _canonical_obj(fields["bundle"]) == _canonical_obj(
+            direct_bundle.to_json_obj()
+        )
+        # every shard group streamed — none fell back to buffered JSON
+        assert m.counter_value("cluster.stream_cut_through") == fields[
+            "n_groups"
+        ]
+
+    def test_cut_through_drops_router_peak_memory(self):
+        """The satellite pin: the same streamed scatter, relayed
+        cut-through, peaks measurably below the store-and-forward
+        router (which buffers each shard's whole sub-response). A
+        larger-than-module world so the payload dominates the peak
+        rather than fixed per-request overheads."""
+        store, pairs, _ = build_range_world(
+            8, 12, 6, 0.6, signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+            base_height=51_000,
+        )
+        shards = [
+            LocalShard(f"s{i}", store, pairs, _spec()).start()
+            for i in range(2)
+        ]
+        routers = {
+            on: ClusterRouter(
+                {s.name: s.url for s in shards}, pairs,
+                cut_through=on, scrape_interval_s=60.0,
+            )
+            for on in (False, True)
+        }
+        idxs = list(range(len(pairs)))
+
+        def run(router):
+            out = router.generate_range(
+                idxs,
+                chunk_size=3,
+                writer_factory=lambda: BundleStreamWriter(
+                    lambda buffers: None, metrics=Metrics()
+                ),
+            )
+            assert out is None  # streamed to completion
+
+        def peak(router):
+            run(router)  # warm (imports, caches) outside the window
+            tracemalloc.start()
+            run(router)
+            _cur, peak_bytes = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            return peak_bytes
+
+        try:
+            peak_buffered = peak(routers[False])
+            peak_cut = peak(routers[True])
+            assert peak_cut < peak_buffered, (peak_cut, peak_buffered)
+            # The in-process LocalShards share this heap, so the
+            # shard-side bundle build is a fixed floor under BOTH
+            # numbers; the measurable delta is exactly the router's
+            # store-and-forward copy (full sub-response text + parsed
+            # JSON). Pin at least a 10% total-process drop (measured
+            # ~20% on this world).
+            assert peak_cut < peak_buffered * 0.9, (
+                peak_cut, peak_buffered,
+            )
+        finally:
+            for router in routers.values():
+                router.close()
+            for s in shards:
+                try:
+                    s.stop(timeout=10)
+                except Exception:
+                    pass
+
+
+class _Tee:
+    """File-like wrapper that records every byte read through it."""
+
+    def __init__(self, fp):
+        self.fp = fp
+        self.buf = bytearray()
+
+    def read(self, n=-1):
+        got = self.fp.read(n)
+        if got:
+            self.buf.extend(got)
+        return got
+
+
+class _DiesAfterFirstBlock(ShardClient):
+    """A shard whose stream cleanly dies right after its first Block
+    chunk — the wire shape of a host killed mid-stream (the router sees
+    EOF with no trailer, a transport-level truncation)."""
+
+    def post_stream(self, path, body):
+        kind, payload = super().post_stream(path, body)
+        if kind != "stream":
+            return kind, payload
+        tee = _Tee(payload)
+        for chunk_kind, _chunk in iter_stream_chunks(tee):
+            if chunk_kind == CHUNK_BLOCK:
+                break
+        try:
+            payload.close()
+        except OSError:
+            pass
+        return "stream", io.BytesIO(bytes(tee.buf))
+
+
+class TestShardDeathMidStream:
+    def test_death_after_first_block_fails_over_deduped(
+        self, world, direct_bundle
+    ):
+        """The shard dies with one Block chunk already relayed to the
+        client. The failover retry (same idempotency key, surviving
+        shard) re-sends that block; the fold's first-sight dedup absorbs
+        it, and the reassembled stream is byte-identical — never torn."""
+        store, pairs, _ = world
+        shards = [
+            LocalShard(f"s{i}", store, pairs, _spec()).start()
+            for i in range(2)
+        ]
+        m = Metrics()
+        router = ClusterRouter(
+            {
+                "s0": _DiesAfterFirstBlock("s0", shards[0].url),
+                "s1": ShardClient("s1", shards[1].url),
+            },
+            pairs, metrics=m, scrape_interval_s=60.0,
+        )
+        server = RouterHTTPServer(router).start()
+        try:
+            st, hdrs, raw = _post_http(
+                server.port, "/v1/generate_range",
+                {"pair_indexes": list(range(len(pairs)))},
+                headers={"Accept": STREAM_CONTENT_TYPE}, raw=True,
+            )
+            assert st == 200
+            assert hdrs.get("Content-Type") == STREAM_CONTENT_TYPE
+            fields = decode_bundle_stream(raw)
+            assert _canonical_obj(fields["bundle"]) == _canonical_obj(
+                direct_bundle.to_json_obj()
+            )
+            assert m.counter_value("cluster.shard_failovers") >= 1
+            # the already-forwarded block came again on the retry and was
+            # absorbed, not duplicated on the client wire
+            assert m.counter_value("cluster.stream_blocks_deduped") >= 1
+        finally:
+            server.shutdown(timeout=10)
+            _teardown(router, shards)
+
+    def test_death_with_no_survivor_is_a_typed_error_chunk(self, world):
+        """No failover target: the stream must end in a typed in-band
+        Error chunk the client decoder raises on — never a torn
+        partial document."""
+        store, pairs, _ = world
+        shards = [LocalShard("s0", store, pairs, _spec()).start()]
+        router = ClusterRouter(
+            {"s0": _DiesAfterFirstBlock("s0", shards[0].url)},
+            pairs, scrape_interval_s=60.0,
+        )
+        server = RouterHTTPServer(router).start()
+        try:
+            st, hdrs, raw = _post_http(
+                server.port, "/v1/generate_range",
+                {"pair_indexes": list(range(len(pairs)))},
+                headers={"Accept": STREAM_CONTENT_TYPE}, raw=True,
+            )
+            assert st == 200  # committed before the death — error is in-band
+            assert hdrs.get("Content-Type") == STREAM_CONTENT_TYPE
+            with pytest.raises(StreamAbortError):
+                decode_bundle_stream(raw)
+        finally:
+            server.shutdown(timeout=10)
+            _teardown(router, shards)
+
+
+class TestRemoteShardMembers:
+    def test_remote_member_probes_and_serves(self, world, direct_bundle):
+        """A shard admitted by URL (`RemoteShard`) — the multi-host door:
+        health-probed at admission, then a full ring member."""
+        store, pairs, _ = world
+        backing = [
+            LocalShard(f"b{i}", store, pairs, _spec()).start()
+            for i in range(2)
+        ]
+        try:
+            remote = RemoteShard(backing[0].url)
+            health = remote.probe()
+            assert isinstance(health, dict)
+            assert remote.alive
+            router = ClusterRouter(
+                {remote.name: remote.url, "s1": backing[1].url},
+                pairs, scrape_interval_s=60.0,
+            )
+            try:
+                status, obj = router.generate_range(
+                    list(range(len(pairs))), chunk_size=3
+                )
+                assert status == 200, obj
+                got = UnifiedProofBundle.from_json_obj(obj["bundle"])
+                assert _canonical_obj(got.to_json_obj()) == _canonical_obj(
+                    direct_bundle.to_json_obj()
+                )
+            finally:
+                router.close()
+        finally:
+            for s in backing:
+                try:
+                    s.stop(timeout=10)
+                except Exception:
+                    pass
+
+    def test_dead_remote_probe_is_none(self):
+        assert RemoteShard("http://127.0.0.1:1", timeout_s=0.5).probe() is None

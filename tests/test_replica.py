@@ -1,0 +1,443 @@
+"""Replicated segment tier tests (storex.replica).
+
+The invariants under test:
+
+- **Replication transport**: `ReplicaClient` round-trips whole segment
+  files and single blocks over the shard HTTP replication routes, with
+  typed `ReplicaError` on any transport or HTTP failure.
+- **Read-repair before Lotus**: a local frame that fails CRC/multihash
+  (integrity eviction) repairs from a replica peer BEFORE the inner
+  store is ever consulted (``storex.replica_repairs`` pinned exact,
+  inner-store gets pinned zero), re-spills to disk, and a lying replica
+  is indistinguishable from a miss.
+- **Pull sync**: `Replicator.sync_from` pulls exactly the rolled foreign
+  segments it is missing — never active tails, never its own owner's
+  segments, never outside an owner filter — and is idempotent.
+- **Rebalance journal discipline**: a `RebalanceJob` SIGKILLed at ANY
+  append boundary (plan, each push, commit) or torn mid-record resumes
+  to the same final segment placement, byte for byte
+  (tools/crashtest.py ``--rebalance`` grid).
+
+Everything is hermetic (ephemeral localhost ports, no egress) and
+tier-1.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from ipc_proofs_tpu.core.cid import CID
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.jobs.journal import read_journal
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
+from ipc_proofs_tpu.storex import (
+    RebalanceJob,
+    ReplicaClient,
+    ReplicaError,
+    ReplicaSet,
+    Replicator,
+    SegmentStore,
+    TieredBlockstore,
+)
+from ipc_proofs_tpu.utils.metrics import Metrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+import crashtest  # noqa: E402
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+
+
+def _block(i: int) -> "tuple[CID, bytes]":
+    data = (b"replica-%04d-" % i) * (i + 2)
+    return CID.hash_of(data), data
+
+
+def _flip_last_byte(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size - 1)
+        b = fh.read(1)
+        fh.seek(size - 1)
+        fh.write(bytes([b[0] ^ 0x40]))
+
+
+class _CountingInner:
+    """Minimal inner Blockstore that counts every get — the stand-in for
+    Lotus. A read-repair that touches it is the bug under test."""
+
+    def __init__(self, mapping=None):
+        self.mapping = dict(mapping or {})
+        self.gets = 0
+
+    def get(self, cid):
+        self.gets += 1
+        return self.mapping.get(cid)
+
+    def put_keyed(self, cid, data):
+        self.mapping[cid] = bytes(data)
+
+    def has(self, cid):
+        return cid in self.mapping
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        2,
+        receipts_per_pair=2,
+        events_per_receipt=2,
+        match_rate=0.5,
+        signature=SIG,
+        topic1=SUBNET,
+        base_height=51_000,
+    )
+
+
+def _shard(world, store_dir, owner, seg_max=1):
+    """One serve daemon exposing the replication routes over a private
+    disk tier (1-byte roll threshold: every put becomes a rolled,
+    pullable segment immediately)."""
+    store, pairs, _ = world
+    svc = ProofService(
+        store=store,
+        spec=EventProofSpec(event_signature=SIG, topic_1=SUBNET),
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=5.0, workers=1,
+            store_dir=str(store_dir),
+            store_owner=owner,
+            store_segment_max_bytes=seg_max,
+        ),
+    )
+    httpd = ProofHTTPServer(svc, pairs=pairs).start()
+    return httpd, svc
+
+
+class TestReplicaClient:
+    def test_segment_and_block_round_trip(self, world, tmp_path):
+        httpd_a, svc_a = _shard(world, tmp_path / "a", "a")
+        httpd_b, svc_b = _shard(world, tmp_path / "b", "b")
+        try:
+            blocks = [_block(i) for i in range(3)]
+            for cid, data in blocks:
+                svc_a.disk_store.put(cid, data)
+            client_a = ReplicaClient("a", f"http://127.0.0.1:{httpd_a.port}")
+            segs = client_a.list_segments()
+            rolled = [s for s in segs if not s["active"]]
+            assert len(rolled) == 3
+            assert all(s["owner"] == "a" for s in rolled)
+            # whole-file fetch is byte-exact against the on-disk segment
+            name = rolled[0]["name"]
+            raw = client_a.fetch_segment(name)
+            with open(svc_a.disk_store.segment_path(name), "rb") as fh:
+                assert raw == fh.read()
+            # push into the other shard: ingest is atomic and idempotent
+            client_b = ReplicaClient("b", f"http://127.0.0.1:{httpd_b.port}")
+            client_b.push_segment(name, raw)
+            client_b.push_segment(name, raw)  # idempotent re-push
+            cid0, data0 = blocks[0]
+            assert svc_b.disk_store.get(cid0) == data0
+            # single-block route: present locally vs a clean 404 miss
+            assert client_a.fetch_block(cid0) == data0
+            missing, _ = _block(999)
+            assert client_a.fetch_block(missing) is None
+        finally:
+            httpd_a.shutdown(timeout=30)
+            httpd_b.shutdown(timeout=30)
+
+    def test_active_tail_is_listed_but_never_pulled(self, world, tmp_path):
+        """The tail another process may still be appending to is marked
+        ``active`` in the inventory and the Replicator filter skips it —
+        its bytes move once they roll. (A direct `fetch_segment` still
+        works: the server flushes and serves the committed tail bytes.)"""
+        httpd, svc = _shard(world, tmp_path / "a", "a", seg_max=1 << 20)
+        try:
+            cid, data = _block(1)
+            svc.disk_store.put(cid, data)  # stays in the active tail
+            client = ReplicaClient("a", f"http://127.0.0.1:{httpd.port}")
+            segs = client.list_segments()
+            assert [s["active"] for s in segs] == [True]
+            assert len(client.fetch_segment(segs[0]["name"])) > 0
+            local = SegmentStore(str(tmp_path / "b"), owner="b")
+            assert Replicator(local).sync_from(client)["pulled"] == 0
+            assert local.get(cid) is None
+            local.close()
+        finally:
+            httpd.shutdown(timeout=30)
+
+    def test_unreachable_peer_is_typed(self):
+        client = ReplicaClient("ghost", "http://127.0.0.1:1", timeout_s=0.5)
+        with pytest.raises(ReplicaError):
+            client.list_segments()
+
+
+class TestReadRepair:
+    def _corrupt_local(self, tmp_path, m, cid, data):
+        local = SegmentStore(str(tmp_path / "local"), metrics=m)
+        local.put(cid, data)
+        seg = [d["name"] for d in local.segment_files()][0]
+        # flipping the payload tail fails the frame CRC on the next read
+        _flip_last_byte(os.path.join(str(tmp_path / "local"), seg))
+        return local
+
+    def test_corrupt_frame_repairs_from_replica_not_inner(
+        self, world, tmp_path
+    ):
+        """The tentpole pin: integrity eviction → replica refetch, with
+        the inner (Lotus stand-in) store untouched and the repaired
+        bytes re-spilled for the next reader."""
+        httpd, svc = _shard(world, tmp_path / "peer", "peer")
+        try:
+            cid, data = _block(7)
+            svc.disk_store.put(cid, data)
+            m = Metrics()
+            local = self._corrupt_local(tmp_path, m, cid, data)
+            inner = _CountingInner()
+            tiered = TieredBlockstore(
+                inner, local, metrics=m,
+                replicas=ReplicaSet(
+                    [ReplicaClient("peer", f"http://127.0.0.1:{httpd.port}")],
+                    metrics=m,
+                ),
+            )
+            assert tiered.get(cid) == data
+            assert inner.gets == 0
+            counters = m.snapshot()["counters"]
+            assert counters["storex.integrity_evictions"] == 1
+            assert counters["storex.replica_repairs"] == 1
+            assert "storex.replica_repair_misses" not in counters
+            # re-spilled: a fresh tiered view with NO replicas and an empty
+            # cache serves the repaired frame straight from local disk
+            inner2 = _CountingInner()
+            tiered2 = TieredBlockstore(inner2, local, metrics=m)
+            assert tiered2.get(cid) == data
+            assert inner2.gets == 0
+            assert m.snapshot()["counters"]["storex.replica_repairs"] == 1
+            local.close()
+        finally:
+            httpd.shutdown(timeout=30)
+
+    def test_repair_miss_falls_back_to_inner(self, world, tmp_path):
+        """A peer that lacks the block is a counted miss — the inner
+        store remains the fallback of record."""
+        httpd, _svc = _shard(world, tmp_path / "peer", "peer")
+        try:
+            cid, data = _block(9)  # never pushed to the peer
+            m = Metrics()
+            local = self._corrupt_local(tmp_path, m, cid, data)
+            inner = _CountingInner({cid: data})
+            tiered = TieredBlockstore(
+                inner, local, metrics=m,
+                replicas=ReplicaSet(
+                    [ReplicaClient("peer", f"http://127.0.0.1:{httpd.port}")],
+                    metrics=m,
+                ),
+            )
+            assert tiered.get(cid) == data
+            assert inner.gets == 1
+            counters = m.snapshot()["counters"]
+            assert counters["storex.replica_repair_misses"] == 1
+            assert "storex.replica_repairs" not in counters
+            local.close()
+        finally:
+            httpd.shutdown(timeout=30)
+
+    def test_lying_replica_is_a_miss(self):
+        """Replica bytes re-verify against the CID: garbage from a peer
+        is never served and never counted as a repair."""
+
+        class _Liar(ReplicaClient):
+            def fetch_block(self, cid):
+                return b"not the bytes you wanted"
+
+        m = Metrics()
+        cid, _data = _block(3)
+        rs = ReplicaSet([_Liar("liar", "http://127.0.0.1:1")], metrics=m)
+        assert rs.repair(cid) is None
+        counters = m.snapshot()["counters"]
+        assert counters["storex.replica_repair_misses"] == 1
+        assert "storex.replica_repairs" not in counters
+
+    def test_plain_miss_never_consults_replicas(self, tmp_path):
+        """Only CORRUPT frames repair — a block that was never here has
+        no reason to exist on a peer, so the peer is never dialed."""
+
+        calls = []
+
+        class _Recorder(ReplicaClient):
+            def fetch_block(self, cid):
+                calls.append(cid)
+                return None
+
+        m = Metrics()
+        local = SegmentStore(str(tmp_path / "local"), metrics=m)
+        cid, data = _block(5)
+        inner = _CountingInner({cid: data})
+        tiered = TieredBlockstore(
+            inner, local, metrics=m,
+            replicas=ReplicaSet(
+                [_Recorder("peer", "http://127.0.0.1:1")], metrics=m
+            ),
+        )
+        assert tiered.get(cid) == data
+        assert inner.gets == 1
+        assert calls == []
+        local.close()
+
+
+class TestReplicatorSync:
+    def test_pull_sync_rolled_foreign_segments(self, world, tmp_path):
+        httpd, svc = _shard(world, tmp_path / "a", "a")
+        try:
+            blocks = [_block(i) for i in range(4)]
+            for cid, data in blocks:
+                svc.disk_store.put(cid, data)
+            peer = ReplicaClient("a", f"http://127.0.0.1:{httpd.port}")
+            m = Metrics()
+            local = SegmentStore(str(tmp_path / "b"), owner="b", metrics=m)
+            r = Replicator(local, metrics=m).sync_from(peer)
+            assert r == {"pulled": 4, "bytes": r["bytes"], "blocks": 4,
+                         "pending": 0}
+            assert r["bytes"] > 0
+            for cid, data in blocks:
+                assert local.get(cid) == data
+            # idempotent: a second pass pulls nothing
+            r2 = Replicator(local, metrics=m).sync_from(peer)
+            assert r2["pulled"] == 0
+            counters = m.snapshot()["counters"]
+            assert counters["storex.replica_segments_pulled"] == 4
+            local.close()
+        finally:
+            httpd.shutdown(timeout=30)
+
+    def test_owner_filter_and_own_segments_skipped(self, world, tmp_path):
+        httpd, svc = _shard(world, tmp_path / "a", "a")
+        try:
+            cid, data = _block(1)
+            svc.disk_store.put(cid, data)
+            peer = ReplicaClient("a", f"http://127.0.0.1:{httpd.port}")
+            # an owner filter that names nobody pulls nothing
+            other = SegmentStore(str(tmp_path / "c"), owner="c")
+            assert Replicator(other).sync_from(peer, owners=["zzz"])[
+                "pulled"] == 0
+            other.close()
+            # a store that IS owner "a" never re-pulls its own segments
+            mine = SegmentStore(str(tmp_path / "a2"), owner="a")
+            assert Replicator(mine).sync_from(peer)["pulled"] == 0
+            mine.close()
+        finally:
+            httpd.shutdown(timeout=30)
+
+
+class TestRebalanceJob:
+    def _src(self, tmp_path, m=None, n=3):
+        src = SegmentStore(
+            str(tmp_path / "src"), owner="a", segment_max_bytes=1, metrics=m
+        )
+        blocks = [_block(i) for i in range(n)]
+        for cid, data in blocks:
+            src.put(cid, data)
+        return src, blocks
+
+    def test_handoff_commits_and_source_drops_after(self, tmp_path):
+        m = Metrics()
+        src, blocks = self._src(tmp_path, m)
+        dest = SegmentStore(str(tmp_path / "dest"), owner="b", metrics=m)
+        segments = [d["name"] for d in src.segment_files() if not d["active"]]
+        assert len(segments) == 3
+
+        def read_segment(name):
+            path = src.segment_path(name)
+            with open(path, "rb") as fh:
+                return fh.read()
+
+        journal = str(tmp_path / "rebalance.journal")
+        job = RebalanceJob(
+            journal, "dest", segments,
+            dest.ingest_segment_file, read_segment, metrics=m,
+        )
+        assert job.run() is True
+        assert job.committed
+        records, _off, torn = read_journal(journal)
+        assert not torn
+        assert [r["kind"] for r in records] == (
+            ["plan"] + ["pushed"] * 3 + ["commit"]
+        )
+        counters = m.snapshot()["counters"]
+        assert counters["storex.rebalance_segments_pushed"] == 3
+        assert "storex.rebalance_resumes" not in counters
+        # the OLD owner served until the commit landed; only now drop
+        for name in segments:
+            src.drop_segment(name)
+        for cid, data in blocks:
+            assert src.get(cid) is None
+            assert dest.get(cid) == data
+        src.close()
+        dest.close()
+
+    def test_resume_skips_pushed_prefix(self, tmp_path):
+        """Die after the first push (exception, not SIGKILL — the kill
+        grid below covers real process death), resume, and demand every
+        committed push be skipped and counted as a resume."""
+        src, blocks = self._src(tmp_path)
+        segments = [d["name"] for d in src.segment_files() if not d["active"]]
+        pushed = {}
+
+        def read_segment(name):
+            with open(src.segment_path(name), "rb") as fh:
+                return fh.read()
+
+        def flaky_push(name, data):
+            if pushed:
+                raise ReplicaError("dest went away")
+            pushed[name] = data
+
+        journal = str(tmp_path / "rebalance.journal")
+        with pytest.raises(ReplicaError):
+            RebalanceJob(
+                journal, "dest", segments, flaky_push, read_segment
+            ).run()
+        assert len(pushed) == 1
+        m2 = Metrics()
+        job = RebalanceJob(
+            journal, "dest", segments, pushed.__setitem__, read_segment,
+            metrics=m2,
+        )
+        assert job.run() is True
+        assert sorted(pushed) == segments
+        counters = m2.snapshot()["counters"]
+        assert counters["storex.rebalance_resumes"] == 1
+        assert counters["storex.rebalance_segments_pushed"] == 2
+        src.close()
+
+    def test_journal_refuses_a_different_plan(self, tmp_path):
+        src, _blocks = self._src(tmp_path)
+        segments = [d["name"] for d in src.segment_files() if not d["active"]]
+
+        def read_segment(name):
+            with open(src.segment_path(name), "rb") as fh:
+                return fh.read()
+
+        journal = str(tmp_path / "rebalance.journal")
+        RebalanceJob(
+            journal, "dest", segments, lambda n, d: None, read_segment
+        ).run()
+        with pytest.raises(ReplicaError):
+            RebalanceJob(
+                journal, "other-dest", segments, lambda n, d: None,
+                read_segment,
+            ).run()
+        src.close()
+
+    def test_sigkill_grid_resumes_to_same_placement(self):
+        """The crashtest grid: SIGKILL at EVERY append boundary (plan,
+        each push, commit) plus torn mid-record writes — every point
+        must resume to the byte-identical final placement."""
+        summary = crashtest.run_rebalance_grid(20260807)
+        assert summary["ok"], summary["violations"]
+        assert summary["counts"] == {"identical": summary["points"]}

@@ -346,6 +346,43 @@ class TestFairQueue:
         assert [q.popleft().tag, q.popleft().tag] == ["n1", "n2"]
         assert len(q) == 0
 
+    def test_weighted_round_order_is_pinned(self):
+        """`--tenant-weight a=2`: tenant a takes TWO requests per round
+        turn, b (default weight 1) takes one — the exact deficit
+        round-robin order, pinned."""
+        q = FairQueue(weights={"a": 2, "b": 1})
+        for tenant, tag in (
+            ("a", "a1"), ("a", "a2"), ("a", "a3"), ("b", "b1"), ("b", "b2"),
+        ):
+            q.append(self._pending(tenant, tag))
+        order = [q.popleft().tag for _ in range(len(q))]
+        assert order == ["a1", "a2", "b1", "a3", "b2"]
+
+    def test_weight_spent_mid_round_does_not_carry_over(self):
+        """A tenant that drains mid-quantum re-enters later rounds with
+        a FRESH quantum, not banked credit."""
+        q = FairQueue(weights={"a": 3})
+        q.append(self._pending("a", "a1"))  # drains with 2 credits unspent
+        q.append(self._pending("b", "b1"))
+        assert q.popleft().tag == "a1"
+        q.append(self._pending("a", "a2"))
+        q.append(self._pending("a", "a3"))
+        q.append(self._pending("a", "a4"))
+        q.append(self._pending("a", "a5"))
+        # b is at the head of the round now; then a gets a fresh 3
+        order = [q.popleft().tag for _ in range(len(q))]
+        assert order == ["b1", "a2", "a3", "a4", "a5"]
+
+    def test_tenant_weight_flag_parses_and_rejects(self):
+        from ipc_proofs_tpu.cli import _parse_tenant_weights
+
+        assert _parse_tenant_weights(None) is None
+        assert _parse_tenant_weights([]) is None
+        assert _parse_tenant_weights(["a=2", "b=1"]) == {"a": 2, "b": 1}
+        for bad in ("a", "a=", "=2", "a=0", "a=x"):
+            with pytest.raises(SystemExit):
+                _parse_tenant_weights([bad])
+
 
 class TestQoSHTTPDoor:
     @pytest.fixture()

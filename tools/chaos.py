@@ -26,6 +26,7 @@ tests/test_chaos.py drives `chaos_run`/`run_grid` over a pinned seed grid.
 from __future__ import annotations
 
 import argparse
+import io
 import json
 import os
 import random
@@ -36,16 +37,25 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from ipc_proofs_tpu.cluster import (
+    ClusterRouter,
+    LocalShard,
+    ShardClient,
+    ShardUnavailable,
+)
 from ipc_proofs_tpu.fixtures import build_range_world
 from ipc_proofs_tpu.proofs.generator import EventProofSpec
 from ipc_proofs_tpu.proofs.range import (
     generate_event_proofs_for_range,
+    generate_event_proofs_for_range_chunked,
     generate_event_proofs_for_range_pipelined,
 )
 from ipc_proofs_tpu.store.failover import EndpointPool
 from ipc_proofs_tpu.store.faults import FaultPlan, FaultySession, LocalLotusSession
 from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcBlockstore, RpcError
 from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.witness.errors import StreamAbortError
+from ipc_proofs_tpu.witness.stream import BundleStreamWriter, decode_bundle_stream
 
 SIG, SUBNET, ACTOR = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1", 1001
 
@@ -192,6 +202,233 @@ def run_grid(
     }
 
 
+# ---------------------------------------------------------------------------
+# Remote shard transport chaos: the same identical-or-typed invariant,
+# pushed through the CLUSTER stack — ClusterRouter scatter/gather (both
+# the buffered and the cut-through streamed door) over shard HTTP with a
+# seeded schedule of drops, delays, and mid-chunk-stream truncations.
+# ---------------------------------------------------------------------------
+
+
+class ShardFaultPlan:
+    """Seeded fault schedule for one shard's HTTP transport.
+
+    Draw kinds: ``drop`` (connection never completes), ``delay`` (slow
+    but correct answer), ``truncate`` (the response dies mid-flight —
+    for a chunk stream, cut at a seeded byte offset so the router sees a
+    torn frame or a missing trailer)."""
+
+    KINDS = ("drop", "delay", "truncate")
+
+    def __init__(self, seed: int, fault_rate: float = 0.2):
+        self._rng = random.Random(seed)
+        self.fault_rate = fault_rate
+        self.faults_injected = 0
+        self.by_kind: "dict[str, int]" = {}
+
+    def draw(self) -> "str | None":
+        if self._rng.random() >= self.fault_rate:
+            return None
+        kind = self._rng.choice(self.KINDS)
+        self.faults_injected += 1
+        self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+        return kind
+
+    def cut_point(self, n: int) -> int:
+        # land INSIDE the stream (never 0 = before the magic, never n =
+        # clean EOF at the trailer) so the relay must detect the tear
+        return self._rng.randrange(1, n) if n > 1 else 0
+
+    def snapshot(self) -> dict:
+        return {
+            "faults_injected": self.faults_injected,
+            "by_kind": dict(self.by_kind),
+        }
+
+
+class ChaosShardClient(ShardClient):
+    """`ShardClient` with a seeded fault plan on every round-trip.
+
+    Faults surface exactly the way the real transport surfaces them:
+    drops and buffered-body truncations raise `ShardUnavailable` (what
+    `ShardClient` maps refused/reset/short-read sockets to); a streamed
+    truncation hands the router a prefix of the real chunk stream, which
+    the relay must classify as torn (integrity error or missing
+    trailer), never forward as a complete document."""
+
+    def __init__(self, name, base_url, plan: ShardFaultPlan, **kw):
+        super().__init__(name, base_url, **kw)
+        self.plan = plan
+
+    def _pre(self, path: str) -> None:
+        kind = self.plan.draw()
+        if kind == "drop":
+            raise ShardUnavailable(f"shard {self.name}: chaos drop {path}")
+        if kind == "delay":
+            time.sleep(0.002)
+        self._pending_truncate = kind == "truncate"
+
+    def post(self, path, body):
+        self._pre(path)
+        if self._pending_truncate:
+            raise ShardUnavailable(
+                f"shard {self.name}: chaos truncated response body {path}"
+            )
+        return super().post(path, body)
+
+    def post_stream(self, path, body):
+        self._pre(path)
+        kind, payload = super().post_stream(path, body)
+        if kind != "stream" or not self._pending_truncate:
+            return kind, payload
+        raw = payload.read()
+        try:
+            payload.close()
+        except OSError:
+            pass
+        return "stream", io.BytesIO(raw[: self.plan.cut_point(len(raw))])
+
+
+def build_shard_world(n_pairs: int = 6, n_shards: int = 2):
+    """Hermetic cluster world: live in-process shards + the fault-free
+    chunked reference (canonical JSON)."""
+    store, pairs, _ = build_range_world(
+        n_pairs, 4, 2, 0.3, signature=SIG, topic1=SUBNET, actor_id=ACTOR,
+    )
+    spec = EventProofSpec(
+        event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR
+    )
+    shards = [
+        LocalShard(f"s{i}", store, pairs, spec).start() for i in range(n_shards)
+    ]
+    reference = json.dumps(
+        generate_event_proofs_for_range_chunked(
+            store, list(pairs), spec, chunk_size=3
+        ).to_json_obj(),
+        sort_keys=True,
+    )
+    return shards, pairs, reference
+
+
+def chaos_shard_run(
+    shards, pairs, reference: str, seed: int,
+    fault_rate: float = 0.2, door: str = "buffered",
+) -> dict:
+    """One seeded run through a fresh router over the live shards.
+
+    ``door`` is "buffered" (JSON scatter/gather) or "streamed" (the
+    cut-through relay door, reassembled with the digest-checking client
+    decoder)."""
+    metrics = Metrics()
+    plans = {
+        s.name: ShardFaultPlan(seed * 211 + i, fault_rate=fault_rate)
+        for i, s in enumerate(shards)
+    }
+    router = ClusterRouter(
+        {s.name: ChaosShardClient(s.name, s.url, plans[s.name]) for s in shards},
+        pairs, metrics=metrics, scrape_interval_s=60.0,
+    )
+    faults = [p.snapshot for p in plans.values()]  # bound methods: late snap
+    idxs = list(range(len(pairs)))
+    try:
+        if door == "buffered":
+            status, obj = router.generate_range(idxs, chunk_size=3)
+            if status != 200:
+                # the router typed the failure on the wire (503 + error)
+                return {
+                    "outcome": "typed_error",
+                    "error": f"http {status}: {obj.get('error', '?')}",
+                    "faults": [f() for f in faults],
+                }
+            got = json.dumps(obj["bundle"], sort_keys=True)
+        else:
+            chunks: "list[bytes]" = []
+            out = router.generate_range(
+                idxs, chunk_size=3,
+                writer_factory=lambda: BundleStreamWriter(
+                    lambda bufs: chunks.extend(bytes(b) for b in bufs),
+                    metrics=metrics,
+                ),
+            )
+            assert out is None
+            fields = decode_bundle_stream(b"".join(chunks))
+            got = json.dumps(fields["bundle"], sort_keys=True)
+    except (StreamAbortError,) + TYPED_ERRORS as exc:
+        return {
+            "outcome": "typed_error",
+            "error": type(exc).__name__,
+            "faults": [f() for f in faults],
+        }
+    except Exception as exc:  # fail-soft: an untyped escape IS the harness finding — reported as outcome=untyped_error
+        return {
+            "outcome": "untyped_error",
+            "error": f"{type(exc).__name__}: {exc}",
+            "faults": [f() for f in faults],
+        }
+    finally:
+        router.close()
+    outcome = "identical" if got == reference else "divergent"
+    return {
+        "outcome": outcome,
+        "faults": [f() for f in faults],
+        "counters": metrics.snapshot()["counters"],
+    }
+
+
+def run_shard_grid(
+    base_seed: int,
+    runs: int = 5,
+    fault_rates=(0.1, 0.3, 0.6),
+    n_pairs: int = 6,
+    log=lambda msg: None,
+) -> dict:
+    """Seed × fault-rate × door grid over the cluster transport."""
+    shards, pairs, reference = build_shard_world(n_pairs=n_pairs)
+    counts = {"identical": 0, "typed_error": 0, "divergent": 0,
+              "untyped_error": 0}
+    violations = []
+    total_faults = 0
+    try:
+        for rate in fault_rates:
+            for k in range(runs):
+                for door in ("buffered", "streamed"):
+                    seed = base_seed + k
+                    res = chaos_shard_run(
+                        shards, pairs, reference, seed,
+                        fault_rate=rate, door=door,
+                    )
+                    counts[res["outcome"]] += 1
+                    n = sum(f["faults_injected"] for f in res["faults"])
+                    total_faults += n
+                    if res["outcome"] in ("divergent", "untyped_error"):
+                        violations.append(
+                            {"seed": seed, "fault_rate": rate, "door": door,
+                             **res}
+                        )
+                    log(
+                        f"shard-chaos seed={seed} rate={rate} door={door}: "
+                        f"{res['outcome']} ({n} faults)"
+                    )
+    finally:
+        for s in shards:
+            try:
+                s.stop(timeout=10)
+            except Exception:  # fail-soft: best-effort teardown; a shard that won't stop must not mask the grid verdict
+                pass
+    ok = (
+        not violations
+        and counts["identical"] > 0  # failover absorbed faults at least once
+        and total_faults > 0  # the schedule actually injected something
+    )
+    return {
+        "ok": ok,
+        "runs": runs * len(fault_rates) * 2,
+        "counts": counts,
+        "total_faults_injected": total_faults,
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("seed", type=int, help="base seed for the fault grid")
@@ -202,6 +439,11 @@ def main(argv=None) -> int:
         help="fault rates to sweep (repeatable; default 0.05 0.3 0.6)",
     )
     ap.add_argument("--quick", action="store_true", help="small world, fewer runs")
+    ap.add_argument(
+        "--shards", action="store_true",
+        help="chaos the CLUSTER shard transport (drop/delay/truncate over "
+        "shard HTTP, buffered and streamed doors) instead of the RPC stack",
+    )
     args = ap.parse_args(argv)
 
     runs = 5 if args.quick and args.runs == 20 else args.runs
@@ -209,10 +451,16 @@ def main(argv=None) -> int:
     rates = tuple(args.fault_rate) if args.fault_rate else (0.05, 0.3, 0.6)
 
     t0 = time.time()
-    summary = run_grid(
-        args.seed, runs=runs, fault_rates=rates, n_pairs=n_pairs,
-        log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
-    )
+    if args.shards:
+        summary = run_shard_grid(
+            args.seed, runs=min(runs, 5), fault_rates=rates, n_pairs=6,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+    else:
+        summary = run_grid(
+            args.seed, runs=runs, fault_rates=rates, n_pairs=n_pairs,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
     print(json.dumps(summary, indent=2))
     if not summary["ok"]:
         print("CHAOS INVARIANT VIOLATED", file=sys.stderr)

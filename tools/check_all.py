@@ -45,6 +45,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 LOCKDEP_TEST_FILES = (
     "tests/test_backfill.py",
     "tests/test_cluster.py",
+    "tests/test_cluster_replica.py",
     "tests/test_crash_recovery.py",
     "tests/test_fetchplane.py",
     "tests/test_fleet.py",
@@ -52,6 +53,7 @@ LOCKDEP_TEST_FILES = (
     "tests/test_lockdep.py",
     "tests/test_parallel.py",
     "tests/test_range_pipeline.py",
+    "tests/test_replica.py",
     "tests/test_serve.py",
     "tests/test_serve_durable.py",
     "tests/test_slo.py",

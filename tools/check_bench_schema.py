@@ -37,7 +37,12 @@ flags. Two strictness levels:
   ``qos_light_tenant_p99_ms <= max(10 x p50, 250ms)`` whenever
   ``host_cores > 2`` (on smaller hosts the heavy flood time-slices the
   light tenant's only cores, so the tail measures core contention, not
-  queue ordering — see `qos_gate_skip_reason`).
+  queue ordering — see `qos_gate_skip_reason`), and the multi-host
+  gates ``kill_recovery_ms <= 10000``, ``replica_repair_hit_rate >=
+  0.99``, and ``aggregate_proofs_per_sec_2host > 0`` whenever
+  ``host_cores > 2`` (on smaller hosts the shards, load clients, and
+  recovery probe time-slice the same core — see
+  `hostkill_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -194,6 +199,12 @@ _KNOWN_TYPES = {
     "qos_heavy_concurrency": int,
     "qos_heavy_requests": int,
     "zerocopy_host_cpus": int,
+    "aggregate_proofs_per_sec_2host": _NUM,
+    "replica_repair_hit_rate": _NUM,
+    "kill_recovery_ms": _NUM,
+    "hostkill_pairs": int,
+    "hostkill_requests": int,
+    "hostkill_failovers": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -237,6 +248,8 @@ _CURRENT_REQUIRED = (
     "fleetobs_stitched_spans",
     "warm_block_bytes_copied_per_resp", "stream_ttfb_ms",
     "qos_light_tenant_p99_ms",
+    "aggregate_proofs_per_sec_2host", "replica_repair_hit_rate",
+    "kill_recovery_ms",
     "legs", "watchdog_fallback",
 )
 
@@ -603,6 +616,55 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                         "queue must bound the light tenant's tail under "
                         "a heavy tenant's flood"
                     )
+        # the hostkill gate: under replication_factor=2, killing one host
+        # mid-load must leave the cluster whole again quickly, the
+        # replica plane must absorb corrupt-frame evictions without
+        # touching Lotus, and the replicated pair must still do real
+        # work. All three measurements need spare cores — on ≤2-core
+        # hosts the shards, the load clients, and the recovery probe
+        # time-slice the same core, so the clock measures contention,
+        # not the failover plane (the artifact still records the
+        # honestly-measured numbers).
+        if hostkill_gate_skip_reason(obj) is None:
+            recovery = obj.get("kill_recovery_ms")
+            hit_rate = obj.get("replica_repair_hit_rate")
+            agg = obj.get("aggregate_proofs_per_sec_2host")
+            for name, val in (
+                ("kill_recovery_ms", recovery),
+                ("replica_repair_hit_rate", hit_rate),
+                ("aggregate_proofs_per_sec_2host", agg),
+            ):
+                if not isinstance(val, _NUM) or isinstance(val, bool):
+                    problems.append(
+                        f"hostkill gate: {name} is {val!r} "
+                        "(hostkill leg did not run?)"
+                    )
+            if (
+                isinstance(recovery, _NUM) and not isinstance(recovery, bool)
+                and recovery > 10_000
+            ):
+                problems.append(
+                    f"hostkill gate: kill_recovery_ms={recovery} > 10000 — "
+                    "a byte-identical scatter must complete within 10 s of "
+                    "a host death"
+                )
+            if (
+                isinstance(hit_rate, _NUM) and not isinstance(hit_rate, bool)
+                and hit_rate < 0.99
+            ):
+                problems.append(
+                    f"hostkill gate: replica_repair_hit_rate={hit_rate} "
+                    "< 0.99 — with a live replica every corrupt-frame "
+                    "eviction must repair peer-to-peer, not from Lotus"
+                )
+            if (
+                isinstance(agg, _NUM) and not isinstance(agg, bool)
+                and agg <= 0
+            ):
+                problems.append(
+                    f"hostkill gate: aggregate_proofs_per_sec_2host={agg} "
+                    "<= 0 — the replicated pair did no work"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -817,6 +879,32 @@ def qos_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def hostkill_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the kill-recovery / replica-repair / 2-host throughput gates do
+    NOT apply (None when they do). The measurements need spare cores: on
+    ≤2-core hosts the two shards, the closed-loop load clients, and the
+    recovery probe all time-slice the same core, so kill_recovery_ms and
+    the aggregate rate measure scheduler contention, not the failover
+    plane. Callers print the reason so a skipped gate is visible, never
+    silent."""
+    if (
+        "kill_recovery_ms" not in obj
+        and "replica_repair_hit_rate" not in obj
+        and "aggregate_proofs_per_sec_2host" not in obj
+    ):
+        return "artifact predates the hostkill leg"
+    cores = obj.get("host_cores")
+    if not isinstance(cores, int):
+        return f"host_cores={cores!r} (unknown host shape)"
+    if cores <= 2:
+        return (
+            f"host_cores={cores} ≤ 2 — the shards, load clients, and "
+            "recovery probe time-slice the same core, so the clock "
+            "measures contention, not the failover plane"
+        )
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -873,6 +961,9 @@ def main(argv=None) -> int:
             reason = qos_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: qos gate SKIPPED ({reason})")
+            reason = hostkill_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: hostkill gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")

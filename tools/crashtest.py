@@ -102,6 +102,72 @@ def backfill_child_main(args) -> int:
     return 0
 
 
+def rebalance_child_main(args) -> int:
+    """Forked rebalance handoff: deterministic source segments → journaled
+    `RebalanceJob` (storex.replica) pushing whole segment files into a
+    destination directory. The journal lives under ``--job-dir`` through
+    the same IPJ1 writer as the range driver, so the
+    ``IPC_JOURNAL_CRASH_AT`` / ``IPC_JOURNAL_CRASH_TORN`` hooks SIGKILL
+    it at every plan/pushed/commit append boundary. ``--pairs`` is reused
+    as the segment count (one block per segment via a 1-byte roll
+    threshold); the final placement manifest (name → sha256 of the pushed
+    file) is what the parent compares across kill points."""
+    import hashlib
+
+    from ipc_proofs_tpu.core.cid import CID
+    from ipc_proofs_tpu.storex import RebalanceJob, SegmentStore
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    src_dir = os.path.join(args.job_dir, "src")
+    dest_dir = os.path.join(args.job_dir, "dest")
+    os.makedirs(dest_dir, exist_ok=True)
+    metrics = Metrics()
+    store = SegmentStore(src_dir, owner="a", segment_max_bytes=1, metrics=metrics)
+    if len(store) == 0:
+        for i in range(args.pairs):
+            data = (b"rebalance-%04d-" % i) * (i + 2)
+            store.put(CID.hash_of(data), data)
+    segments = [d["name"] for d in store.segment_files() if not d["active"]]
+
+    def push(name: str, data: bytes) -> None:
+        tmp = os.path.join(dest_dir, name + ".tmp")
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, os.path.join(dest_dir, name))
+
+    def read_segment(name: str):
+        path = store.segment_path(name)
+        if path is None:
+            return None
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    job = RebalanceJob(
+        os.path.join(args.job_dir, "rebalance.journal"),
+        "dest", segments, push, read_segment, metrics=metrics,
+    )
+    committed = job.run()
+    store.close()
+    placement = {}
+    for name in sorted(os.listdir(dest_dir)):
+        if name.endswith(".tmp"):
+            continue
+        with open(os.path.join(dest_dir, name), "rb") as fh:
+            placement[name] = hashlib.sha256(fh.read()).hexdigest()
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(
+            {"committed": committed, "placement": placement}, fh, sort_keys=True
+        )
+    os.replace(tmp, args.out)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as fh:
+            json.dump({"counters": metrics.snapshot()["counters"]}, fh)
+    return 0
+
+
 def child_main(args) -> int:
     """Forked driver: deterministic world → journaled pipelined range run.
 
@@ -147,6 +213,7 @@ def _spawn_child(
     timeout_s: float = 300.0,
     extra_env: "dict | None" = None,
     backfill: bool = False,
+    rebalance: bool = False,
 ) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
@@ -158,6 +225,8 @@ def _spawn_child(
     ]
     if backfill:
         cmd.append("--backfill")
+    if rebalance:
+        cmd.append("--rebalance")
     if metrics_out:
         cmd += ["--metrics-out", metrics_out]
     env = dict(os.environ)
@@ -322,6 +391,141 @@ def backfill_crash_run(
     ):
         res["outcome"] = "replay_miscount"  # resumed run must reuse every commit
     return res
+
+
+def rebalance_crash_run(
+    reference: dict,
+    n_segments: int,
+    crash_at: int,
+    torn: "int | None",
+    workdir: str,
+    tag: "str | int" = 0,
+) -> dict:
+    """One rebalance kill point: SIGKILL the `RebalanceJob` child at the
+    ``crash_at``-th journal append (plan / pushed / commit boundary,
+    optionally torn at byte ``torn``), resume it, and demand the final
+    destination placement — file names AND bytes — match the
+    uninterrupted reference, with the resume actually detected
+    (``storex.rebalance_resumes``) whenever the crash left records."""
+    from ipc_proofs_tpu.jobs import read_journal
+
+    job_dir = os.path.join(workdir, f"rbjob_{tag}_at{crash_at}_torn{torn}")
+    out = os.path.join(workdir, f"rbout_{tag}_at{crash_at}_torn{torn}.json")
+    metrics_out = out + ".metrics"
+    shape = {
+        "pairs": n_segments, "chunk_size": 1,
+        "receipts": 1, "events": 1, "match_rate": 0.0,
+    }
+    res = {"crash_at": crash_at, "torn": torn}
+
+    crashed = _spawn_child(
+        job_dir, out, shape, crash_at=crash_at, torn=torn, rebalance=True
+    )
+    if crashed.returncode != -signal.SIGKILL:
+        res["outcome"] = "no_crash"
+        res["rc"] = crashed.returncode
+        res["stderr"] = crashed.stderr[-2000:]
+        return res
+
+    jpath = os.path.join(job_dir, "rebalance.journal")
+    n_records = 0
+    already_committed = False
+    if os.path.exists(jpath):
+        records, _, _torn_tail = read_journal(jpath)
+        n_records = len(records)
+        already_committed = any(
+            isinstance(r, dict) and r.get("kind") == "commit" for r in records
+        )
+    res["records_after_crash"] = n_records
+    expect = crash_at if torn is not None else crash_at + 1
+    if n_records != expect:
+        res["outcome"] = "journal_mismatch"
+        res["expected_records"] = expect
+        return res
+
+    resumed = _spawn_child(
+        job_dir, out, shape, metrics_out=metrics_out, rebalance=True
+    )
+    if resumed.returncode != 0:
+        res["outcome"] = "resume_failed"
+        res["rc"] = resumed.returncode
+        res["stderr"] = resumed.stderr[-2000:]
+        return res
+    with open(out) as fh:
+        final = json.load(fh)
+    with open(metrics_out) as fh:
+        counters = json.load(fh)["counters"]
+    res["resumes"] = counters.get("storex.rebalance_resumes", 0)
+    ok = final["committed"] and final["placement"] == reference["placement"]
+    res["outcome"] = "identical" if ok else "divergent"
+    # a crash that left records but no commit must be DETECTED as a resume;
+    # a post-commit kill replays to a no-op and counts nothing
+    expect_resumes = 1 if (n_records and not already_committed) else 0
+    if res["outcome"] == "identical" and res["resumes"] != expect_resumes:
+        res["outcome"] = "resume_miscount"  # committed prefix must be detected
+    return res
+
+
+def run_rebalance_grid(
+    base_seed: int, n_segments: int = 3, log=lambda msg: None
+) -> dict:
+    """Exhaustive rebalance kill grid: every append boundary (plan, each
+    pushed record, commit — ``n_segments + 2`` points) plus two seeded
+    torn mid-record writes. ``ok`` iff every point crashed, resumed, and
+    converged on the byte-identical reference placement."""
+    with tempfile.TemporaryDirectory(prefix="crashtest_rebalance_") as workdir:
+        ref_dir = os.path.join(workdir, "reference")
+        ref_out = os.path.join(workdir, "reference.json")
+        shape = {
+            "pairs": n_segments, "chunk_size": 1,
+            "receipts": 1, "events": 1, "match_rate": 0.0,
+        }
+        ref = _spawn_child(ref_dir, ref_out, shape, rebalance=True)
+        if ref.returncode != 0:
+            return {
+                "ok": False, "points": 0,
+                "violations": [{"outcome": "reference_failed",
+                                "stderr": ref.stderr[-2000:]}],
+                "counts": {},
+            }
+        with open(ref_out) as fh:
+            reference = json.load(fh)
+        if len(reference["placement"]) != n_segments:
+            return {
+                "ok": False, "points": 0,
+                "violations": [{"outcome": "reference_incomplete",
+                                "placement": reference["placement"]}],
+                "counts": {},
+            }
+
+        rng = random.Random(base_seed)
+        n_appends = n_segments + 2  # plan + one per segment + commit
+        kill_points = [(at, None) for at in range(n_appends)]
+        kill_points += [
+            (rng.randrange(n_appends), rng.choice([1, 5, 11, 13, 64]))
+            for _ in range(2)
+        ]
+        counts: "dict[str, int]" = {}
+        violations = []
+        for i, (crash_at, torn) in enumerate(kill_points):
+            res = rebalance_crash_run(
+                reference, n_segments, crash_at, torn, workdir, tag=i
+            )
+            counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+            if res["outcome"] != "identical":
+                violations.append(res)
+            log(
+                f"rebalance kill at append {crash_at}"
+                + (f" torn@{torn}B" if torn is not None else " (boundary)")
+                + f": {res['outcome']}"
+            )
+    return {
+        "ok": not violations,
+        "points": len(kill_points),
+        "kill_points": kill_points,
+        "counts": counts,
+        "violations": violations,
+    }
 
 
 def run_backfill_grid(
@@ -632,6 +836,12 @@ def main(argv=None) -> int:
         "the range driver (reference = chunked driver; in --child mode, "
         "selects the backfill child)",
     )
+    ap.add_argument(
+        "--rebalance", action="store_true",
+        help="run the kill grid against the journaled segment-rebalance "
+        "handoff (storex.RebalanceJob) instead of the range driver (in "
+        "--child mode, selects the rebalance child)",
+    )
     # --child: the forked driver entrypoint (internal)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--job-dir", help=argparse.SUPPRESS)
@@ -642,12 +852,25 @@ def main(argv=None) -> int:
     if args.child:
         if not args.job_dir or not args.out:
             ap.error("--child needs --job-dir and --out")
+        if args.rebalance:
+            return rebalance_child_main(args)
         return backfill_child_main(args) if args.backfill else child_main(args)
     if args.seed is None:
         ap.error("seed is required")
 
     points = 4 if args.quick and args.points == 8 else args.points
     t0 = time.time()
+    if args.rebalance:
+        summary = run_rebalance_grid(
+            args.seed, n_segments=max(1, args.pairs if args.pairs != 12 else 3),
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+        print(json.dumps(summary, indent=2))
+        if not summary["ok"]:
+            print("CRASH-RECOVERY INVARIANT VIOLATED", file=sys.stderr)
+            return 1
+        print("CRASH RECOVERY CLEAN")
+        return 0
     if args.backfill:
         summary = run_backfill_grid(
             args.seed, points=points, n_pairs=args.pairs,

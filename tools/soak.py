@@ -12,7 +12,8 @@ Usage:
     python tools/soak.py BASE_SEED [phase ...] [--quick]
 
 Phases (default: all): event storage shapes codec rleplus cert dagcbor
-header trees range json chaos crash. Every phase derives its seeds from
+header trees range json chaos crash hostkill. Every phase derives its
+seeds from
 BASE_SEED, so a NOTES entry of (base seed, phase) reproduces a run
 exactly.
 """
@@ -389,6 +390,119 @@ def phase_crash(rng, quick):
     )
 
 
+def phase_hostkill(rng, quick):
+    # multi-host recovery differential: kill a live shard mid-load in an
+    # R=2 replicated cluster at fresh seeded victims/timings — every
+    # answer that completes must be byte-identical to the single-process
+    # driver (zero wrong bytes), and the cluster must serve a whole
+    # scatter again within a bounded recovery window
+    import json as _json
+    import tempfile
+    import threading
+
+    from ipc_proofs_tpu.cluster import ClusterRouter, LocalShard
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+    from ipc_proofs_tpu.serve.service import ServiceConfig
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    SIG, SUBNET = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1"
+    store, pairs, _ = build_range_world(
+        6 if quick else 10, 4, 2, 0.3, signature=SIG, topic1=SUBNET,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET)
+    reference = _json.dumps(
+        generate_event_proofs_for_range_chunked(
+            store, list(pairs), spec, chunk_size=3
+        ).to_json_obj(),
+        sort_keys=True,
+    )
+    idxs = list(range(len(pairs)))
+    rounds = 2 if quick else 6
+    n_shards = 3
+    for rnd in range(rounds):
+        with tempfile.TemporaryDirectory(prefix="soak_hostkill_") as workdir:
+            shards = [
+                LocalShard(
+                    f"s{k}", store, pairs, spec,
+                    config=ServiceConfig(
+                        max_batch=8, max_wait_ms=5.0, workers=1,
+                        store_dir=os.path.join(workdir, f"s{k}"),
+                        store_owner=f"s{k}",
+                        store_segment_max_bytes=1,
+                    ),
+                    metrics=Metrics(),
+                ).start()
+                for k in range(n_shards)
+            ]
+            m = Metrics()
+            router = ClusterRouter(
+                {s.name: s.url for s in shards}, pairs,
+                replication_factor=2, metrics=m, scrape_interval_s=60.0,
+            )
+            try:
+                status, obj = router.generate_range(idxs, chunk_size=3)
+                assert status == 200, obj
+                summary = router.replicate_now()
+                assert not summary["errors"], summary
+
+                wrong: list = []
+                stop = threading.Event()
+
+                def load():
+                    while not stop.is_set():
+                        try:
+                            st, o = router.generate_range(idxs, chunk_size=3)
+                        except Exception as exc:  # fail-soft: an untyped escape IS the phase finding — recorded in `wrong` and failed below
+                            wrong.append(f"untyped {type(exc).__name__}: {exc}")
+                            return
+                        if st != 200:
+                            # a typed refusal must still be typed JSON
+                            if not isinstance(o, dict) or "error" not in o:
+                                wrong.append(f"untyped non-200: {st} {o!r}")
+                                return
+                            continue
+                        got = _json.dumps(o["bundle"], sort_keys=True)
+                        if got != reference:
+                            wrong.append("DIVERGENT BYTES")
+                            return
+
+                t = threading.Thread(target=load)
+                t.start()
+                time.sleep(0.02 + rng.random() * 0.1)  # kill mid-load
+                victim = shards[rng.randrange(n_shards)]
+                t_kill = time.monotonic()
+                victim.kill()
+                # recovery: the next whole byte-identical scatter
+                recovered = None
+                while time.monotonic() - t_kill < 30.0:
+                    st, o = router.generate_range(idxs, chunk_size=3)
+                    if st == 200 and _json.dumps(
+                        o["bundle"], sort_keys=True
+                    ) == reference:
+                        recovered = (time.monotonic() - t_kill) * 1000.0
+                        break
+                stop.set()
+                t.join()
+                assert not wrong, f"round {rnd}: {wrong}"
+                assert recovered is not None, (
+                    f"round {rnd}: no whole scatter within 30s of killing "
+                    f"{victim.name}"
+                )
+                log(
+                    f"hostkill round {rnd}: killed {victim.name}, whole again "
+                    f"in {recovered:,.0f} ms, zero wrong bytes"
+                )
+            finally:
+                router.close()
+                for s in shards:
+                    try:
+                        s.stop(timeout=10)
+                    except Exception:  # fail-soft: best-effort teardown; a shard that won't stop must not mask the round verdict
+                        pass
+
+
 PHASES = {
     "event": phase_event,
     "storage": phase_storage,
@@ -403,6 +517,7 @@ PHASES = {
     "json": phase_json,
     "chaos": phase_chaos,
     "crash": phase_crash,
+    "hostkill": phase_hostkill,
 }
 
 
