@@ -58,7 +58,7 @@ LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
     "asyncfetch", "cluster", "standing", "fleetobs", "onchip", "backfill",
-    "zerocopy", "hostkill",
+    "zerocopy", "hostkill", "overload",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -83,6 +83,7 @@ _LEG_TIMEOUTS = {
     "backfill": (420.0, 240.0),
     "zerocopy": (420.0, 240.0),
     "hostkill": (420.0, 240.0),
+    "overload": (300.0, 150.0),
 }
 
 
@@ -2571,6 +2572,214 @@ def _leg_hostkill(args) -> dict:
     }
 
 
+def _leg_overload(args) -> dict:
+    """Overload survival (host-only, hermetic): a closed loop at ~2× the
+    measured capacity against an ``--admit-gradient`` HTTP front end.
+
+    Phase 1 measures capacity: C client threads, think-time 0. Phase 2
+    doubles the thread count and adds (a) a light named tenant sending
+    occasional requests and (b) a doomed stream of tight-deadline
+    requests that must be refused/cancelled BEFORE burning a worker.
+
+    The meters the schema gates ride on:
+
+    - ``goodput_ratio_at_2x``: successful-response rate under 2× offered
+      load / capacity rate. A serve plane that degrades gracefully sheds
+      the excess and keeps doing its capacity's worth of real work
+      (gated ≥ 0.8; skipped with a printed reason on ≤ 2-core hosts);
+    - ``shed_rate``: fraction of overload-phase requests answered 429
+      (tenant bucket or AIMD admission) — honest shedding, not queuing;
+    - ``light_tenant_p99_ms_overload``: the named tenant's p99 while the
+      anonymous pool floods — grace headroom + shed-other-first;
+    - ``cancel_reclaim_pct``: of the doomed tight-deadline requests, the
+      percentage whose work was reclaimed (refused at the door or
+      dropped at dispatch) instead of generated-then-thrown-away.
+
+    Shed 429 responses make a closed loop spin faster than real clients
+    would; overload clients honor the response's Retry-After estimate up
+    to 50 ms so the offered load stays ~2× rather than unbounded."""
+    import os as _os
+    import threading
+
+    from http.client import HTTPConnection
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.serve import ProofService, ServiceConfig
+    from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+
+    n_pairs = 2 if args.quick else 4
+    receipts = 8 if args.quick else 12
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, events_per_receipt=2,
+        match_rate=0.5, signature=SIG, topic1=TOPIC1, actor_id=ACTOR,
+    )
+    spec = EventProofSpec(
+        event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR
+    )
+    service = ProofService(
+        store=store, spec=spec,
+        config=ServiceConfig(
+            max_batch=8, max_wait_ms=2.0, workers=2,
+            admit_gradient=True, admit_initial=8,
+            admit_delay_budget_ms=75.0,
+            tenant_weights={"interactive": 4},
+        ),
+    )
+    httpd = ProofHTTPServer(service, pairs=pairs).start()
+
+    def post(obj, headers=None):
+        conn = HTTPConnection("127.0.0.1", httpd.port, timeout=120)
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        try:
+            conn.request("POST", "/v1/generate", json.dumps(obj), hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+        finally:
+            conn.close()
+
+    for i in range(n_pairs):  # warm every pair through the batcher once
+        st, data = post({"pair_index": i})
+        assert st == 200, data[:200]
+
+    # ---- phase 1: capacity at C threads ------------------------------------
+    cap_threads = 4
+    cap_requests = 48 if args.quick else 128
+    it = iter(range(cap_requests))
+    it_lock = threading.Lock()
+
+    def cap_client():
+        while True:
+            with it_lock:
+                i = next(it, None)
+            if i is None:
+                return
+            st, data = post({"pair_index": i % n_pairs})
+            assert st == 200, data[:200]
+
+    threads = [threading.Thread(target=cap_client) for _ in range(cap_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    capacity_rps = cap_requests / (time.perf_counter() - t0)
+
+    # ---- phase 2: 2× closed loop + light tenant + doomed deadlines ---------
+    c0 = service.metrics_snapshot()["counters"]
+    duration_s = 2.0 if args.quick else 4.0
+    stop = threading.Event()
+    ok_count = [0]
+    shed_count = [0]
+    other_count = [0]
+    count_lock = threading.Lock()
+
+    def heavy_client():
+        while not stop.is_set():
+            st, data = post({"pair_index": 0})
+            with count_lock:
+                if st == 200:
+                    ok_count[0] += 1
+                elif st == 429:
+                    shed_count[0] += 1
+                else:
+                    other_count[0] += 1
+            if st == 429:
+                try:
+                    retry = float(json.loads(data).get("retry_after_s", 0.05))
+                except (ValueError, AttributeError):
+                    retry = 0.05
+                stop.wait(min(retry, 0.05))
+
+    light_lat: "list[float]" = []
+
+    def light_client():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            st, _ = post(
+                {"pair_index": 1 % n_pairs},
+                headers={"X-IPC-Tenant": "interactive"},
+            )
+            if st == 200:
+                light_lat.append((time.perf_counter() - t0) * 1e3)
+            stop.wait(0.02)
+
+    doomed = [0]
+
+    def doomed_client():
+        # alternate below-floor (refused at the door, 5 ms default floor)
+        # and mid-expiry budgets (admitted, then dropped at dispatch once
+        # the overload queue delay eats the remainder)
+        n = 0
+        while not stop.is_set():
+            ms = 1 if n % 2 == 0 else 15
+            post({"pair_index": 0, "deadline_ms": ms})
+            doomed[0] += 1
+            n += 1
+            stop.wait(0.03)
+
+    workers = [
+        threading.Thread(target=heavy_client) for _ in range(2 * cap_threads)
+    ] + [threading.Thread(target=light_client), threading.Thread(target=doomed_client)]
+    t0 = time.perf_counter()
+    for t in workers:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in workers:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    snap = service.metrics_snapshot()
+    c1 = snap["counters"]
+    admit_limit = snap.get("gauges", {}).get("admit.limit")
+    httpd.shutdown(timeout=30)
+    service.drain()
+
+    goodput_rps = ok_count[0] / elapsed
+    goodput_ratio = goodput_rps / capacity_rps if capacity_rps else None
+    answered = ok_count[0] + shed_count[0] + other_count[0]
+    shed_rate = shed_count[0] / answered if answered else None
+    light_lat.sort()
+    light_p99 = (
+        light_lat[max(0, int(len(light_lat) * 0.99) - 1)] if light_lat else None
+    )
+    reclaimed = (
+        c1.get("serve.deadline_rejects", 0) - c0.get("serve.deadline_rejects", 0)
+        + c1.get("serve.cancelled_inflight", 0)
+        - c0.get("serve.cancelled_inflight", 0)
+    )
+    cancel_reclaim_pct = (
+        round(100.0 * min(1.0, reclaimed / doomed[0]), 1) if doomed[0] else None
+    )
+    _log(
+        f"bench: overload: capacity {capacity_rps:,.0f} req/s, goodput at 2x "
+        f"{goodput_rps:,.0f} req/s (ratio "
+        f"{goodput_ratio if goodput_ratio is None else round(goodput_ratio, 2)}), "
+        f"shed {shed_count[0]}/{answered}, light p99 "
+        f"{light_p99 if light_p99 is None else round(light_p99, 1)}ms, "
+        f"{reclaimed}/{doomed[0]} doomed reclaimed"
+    )
+    return {
+        "goodput_ratio_at_2x": (
+            round(goodput_ratio, 3) if goodput_ratio is not None else None
+        ),
+        "shed_rate": round(shed_rate, 3) if shed_rate is not None else None,
+        "light_tenant_p99_ms_overload": (
+            round(light_p99, 2) if light_p99 is not None else None
+        ),
+        "cancel_reclaim_pct": cancel_reclaim_pct,
+        "overload_capacity_rps": round(capacity_rps, 1),
+        "overload_goodput_rps": round(goodput_rps, 1),
+        "overload_requests": answered,
+        "overload_doomed_requests": doomed[0],
+        "overload_admit_limit_final": admit_limit,
+        "overload_host_cpus": _os.cpu_count(),
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -2591,6 +2800,7 @@ _LEG_FNS = {
     "backfill": _leg_backfill,
     "zerocopy": _leg_zerocopy,
     "hostkill": _leg_hostkill,
+    "overload": _leg_overload,
 }
 
 
@@ -2903,6 +3113,8 @@ def _orchestrate(args) -> None:
     legs_status["zerocopy"] = status
     hostkill, status = _run_leg("hostkill", args, "cpu")
     legs_status["hostkill"] = status
+    overload, status = _run_leg("overload", args, "cpu")
+    legs_status["overload"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -3030,6 +3242,14 @@ def _orchestrate(args) -> None:
     )
     for k in _HOSTKILL_KEYS:
         out[k] = (hostkill or {}).get(k)
+    _OVERLOAD_KEYS = (
+        "goodput_ratio_at_2x", "shed_rate", "light_tenant_p99_ms_overload",
+        "cancel_reclaim_pct", "overload_capacity_rps", "overload_goodput_rps",
+        "overload_requests", "overload_doomed_requests",
+        "overload_admit_limit_final", "overload_host_cpus",
+    )
+    for k in _OVERLOAD_KEYS:
+        out[k] = (overload or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

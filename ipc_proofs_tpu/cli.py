@@ -60,6 +60,8 @@ def _make_rpc_client(args, metrics=None):
         clients,
         breaker_threshold=args.breaker_threshold,
         hedge_ms=args.hedge_ms,
+        # serve/cluster only: generate/range runs have no --retry-budget
+        retry_budget_per_s=getattr(args, "retry_budget", None),
         metrics=metrics,
     )
 
@@ -870,6 +872,10 @@ def _cmd_serve(args) -> int:
             tenant_rate=args.tenant_rate,
             tenant_burst=args.tenant_burst,
             tenant_weights=_parse_tenant_weights(args.tenant_weight),
+            admit_gradient=args.admit_gradient,
+            admit_delay_budget_ms=args.admit_delay_budget_ms,
+            deadline_floor_ms=args.deadline_floor_ms,
+            retry_budget=args.retry_budget,
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -1178,6 +1184,7 @@ def _cmd_cluster(args) -> int:
         pairs,
         steal_threshold=args.steal_threshold,
         steal_latency_unit_s=args.steal_latency_unit_s,
+        deadline_floor_ms=args.deadline_floor_ms,
         replication_factor=args.replication_factor,
         cut_through=(args.cut_through == "on"),
         metrics=metrics,
@@ -1478,6 +1485,38 @@ def main(argv=None) -> int:
             "interactive lane (repeatable): a weight-N tenant drains up "
             "to N queued requests per round-robin turn; unlisted tenants "
             "weigh 1. In cluster mode the weights forward to every shard",
+        )
+        p.add_argument(
+            "--admit-gradient", action="store_true",
+            help="adaptive admission: replace the static queue bound as "
+            "the effective concurrency gate with an AIMD limit driven by "
+            "observed queue delay (grows +1 while p99 delay is well under "
+            "budget, shrinks ×0.8 past it). Overload sheds with a typed "
+            "429 + honest Retry-After from the drain estimate; unnamed "
+            "('other') tenants shed before --tenant-weight tenants. "
+            "Default off (static --queue-capacity only)",
+        )
+        p.add_argument(
+            "--admit-delay-budget-ms", type=float, default=250.0,
+            metavar="MS",
+            help="queue-delay p99 budget steering --admit-gradient "
+            "(default 250)",
+        )
+        p.add_argument(
+            "--deadline-floor-ms", type=float, default=5.0, metavar="MS",
+            help="deadline propagation floor: a request whose remaining "
+            "budget (X-IPC-Deadline-Ms header / deadline_ms body field) "
+            "is at/below this refuses typed (504, error_type=deadline) at "
+            "each hop instead of burning a worker on an answer nobody "
+            "can use (default 5)",
+        )
+        p.add_argument(
+            "--retry-budget", type=float, default=None, metavar="R",
+            help="pool-wide client retry budget in retries/second across "
+            "ALL endpoints (token bucket, burst 2×R): during a broad "
+            "outage retries stop amplifying load once the budget is dry "
+            "(rpc.retry_budget_exhausted) and requests surface their "
+            "error instead. Default off (per-request backoff only)",
         )
 
     gen = sub.add_parser("generate", help="generate a proof bundle from a live chain")

@@ -99,6 +99,14 @@ from ipc_proofs_tpu.witness.stream import (
     send_buffers,
     stream_backfill_chunks,
 )
+from ipc_proofs_tpu.utils.deadline import (
+    CancelScope,
+    Deadline,
+    DeadlineError,
+    current_scope,
+    remaining_budget_s,
+    use_scope,
+)
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.threads import locked
 from ipc_proofs_tpu.utils.metrics import Metrics
@@ -239,6 +247,7 @@ class ClusterRouter:
         pairs: Sequence,
         steal_threshold: int = 4,
         steal_latency_unit_s: float = 0.25,
+        deadline_floor_ms: float = 5.0,
         replication_factor: int = 1,
         cut_through: bool = True,
         vnodes: int = 64,
@@ -265,6 +274,10 @@ class ClusterRouter:
         # (remote, cross-host) shard loses steals it would win on queue
         # depth alone. The unit is "one queue slot's worth of latency".
         self.steal_latency_unit_s = max(1e-6, float(steal_latency_unit_s))
+        # hop floor for deadline propagation: a forwarded request whose
+        # remaining budget is at/below this is refused typed rather than
+        # dispatched to a shard that can only fail it late
+        self.deadline_floor_ms = max(0.0, float(deadline_floor_ms))
         # R-way replication of the segment tier (1 = off): every owner's
         # segment files are mirrored onto the next R-1 distinct ring
         # successors so a corrupt frame repairs peer-first and a dead
@@ -371,6 +384,16 @@ class ClusterRouter:
             >= self.steal_threshold
         ):
             self.metrics.count("cluster.steals")
+            affine_state = self._shards[affine]
+            if (
+                affine_state.inflight - least_state.inflight
+                < self.steal_threshold
+            ):
+                # raw queue depth alone would NOT have stolen — the
+                # latency-EWMA penalty drove placement off the affine
+                # shard. That's the slow-not-dead quarantine: a shard
+                # answering slowly sheds traffic without being marked dead
+                self.metrics.count("cluster.slow_quarantines")
             return least
         return affine
 
@@ -599,6 +622,28 @@ class ClusterRouter:
 
     # --- dispatch with failover -------------------------------------------
 
+    def _stamp_deadline(self, body: dict, path: str) -> None:
+        """Re-emit the ambient deadline budget on a forwarded body.
+
+        The ambient `Deadline` is absolute-monotonic, so reading it here
+        yields the budget ALREADY decremented by router time (parse,
+        placement, earlier failover attempts). A budget at/below the
+        router floor refuses the hop typed (``deadline.rejects.router``)
+        instead of dispatching work a shard can only fail late."""
+        rem_s = remaining_budget_s()
+        if rem_s is None:
+            return
+        rem_ms = rem_s * 1000.0
+        if rem_ms <= self.deadline_floor_ms:
+            self.metrics.count("serve.deadline_rejects")
+            self.metrics.count("deadline.rejects.router")
+            raise DeadlineError(
+                f"remaining budget {rem_ms:.0f}ms at/below router floor "
+                f"({self.deadline_floor_ms:.0f}ms) forwarding {path}",
+                stage="router.dispatch",
+            )
+        body["deadline_ms"] = rem_ms
+
     def _dispatch(self, key: str, path: str, body: dict) -> "tuple[int, dict]":
         """Send one request, failing over (same idempotency key) until a
         live shard answers or none remain. At-least-once by construction:
@@ -611,6 +656,8 @@ class ClusterRouter:
             body["trace"] = carrier
         attempted: "set[str]" = set()
         while True:
+            # re-read the budget each attempt: failover retries burn it
+            self._stamp_deadline(body, path)
             name, client = self._acquire(key)
             if name in attempted:
                 # the ring only has shards we already failed against —
@@ -889,6 +936,7 @@ class ClusterRouter:
             groups = partition_indexes(idxs, assign)
             sp.set_attr("n_groups", len(groups))
             ctx = current_context()  # scatter threads parent under this span
+            scope = current_scope()  # deadline/cancel hops with the scatter
             if writer_factory is not None and self.cut_through:
                 # cut-through relay: shard B chunks forward the moment
                 # they arrive — the router never holds a shard's whole
@@ -916,7 +964,7 @@ class ClusterRouter:
                     body["tenant"] = tenant
                 # group affinity = first member's key: the whole group was
                 # binned by that shard's arc, and failover re-keys anyway
-                with use_context(ctx):
+                with use_context(ctx), use_scope(scope):
                     return self._dispatch(
                         self._keys[group[0]], "/v1/generate_range", body
                     )
@@ -1119,6 +1167,7 @@ class ClusterRouter:
         # scatter's relay threads (the writer's socket is one wire)
         relay_lock = named_lock("ClusterRouter._relay_lock")
         aborted = threading.Event()
+        scope = current_scope()  # deadline/cancel hops with the relay threads
 
         def one_stream(group: "List[int]") -> "tuple[int, Optional[dict]]":
             body: dict = {"pair_indexes": group}
@@ -1132,11 +1181,13 @@ class ClusterRouter:
             body["idempotency_key"] = uuid.uuid4().hex
             key = self._keys[group[0]]
             attempted: "set[str]" = set()
-            with use_context(ctx):
+            with use_context(ctx), use_scope(scope):
                 carrier = carrier_from_context()
                 if carrier is not None:
                     body["trace"] = carrier
                 while True:
+                    # re-read the budget each attempt: failovers burn it
+                    self._stamp_deadline(body, "/v1/generate_range")
                     name, client = self._acquire(key)
                     if name in attempted:
                         self._release(name)
@@ -1417,6 +1468,15 @@ class ClusterRouter:
             "shards": shard_health,
             "shards_alive": serving,
         }
+        # degraded serve mode is worth naming explicitly: these shards have
+        # EVERY upstream breaker open and serve warm-tier traffic only
+        lotus_down = sorted(
+            name
+            for name, h in shard_health.items()
+            if h.get("mode") == "lotus_down"
+        )
+        if lotus_down:
+            out["lotus_down"] = lotus_down
         if self.slo is not None:
             out["slo"] = self.slo.status()
         return 200, out
@@ -1474,6 +1534,9 @@ class ClusterRouter:
             shards[name] = {
                 "status": health.get("status")
                 or ("unreachable" if entry.get("error") else "unknown"),
+                # "lotus_down" when the shard serves degraded (all its
+                # upstream breakers open, warm-tier-only); None otherwise
+                "mode": health.get("mode"),
                 "scrape_error": entry.get("error"),
                 "queue_depth": depths,
                 "pending_deliveries": pending,
@@ -1494,6 +1557,8 @@ class ClusterRouter:
             "router": {
                 "requests": counters.get("cluster.requests", 0),
                 "steals": counters.get("cluster.steals", 0),
+                "slow_quarantines": counters.get("cluster.slow_quarantines", 0),
+                "deadline_rejects": counters.get("deadline.rejects.router", 0),
                 "shard_failovers": counters.get("cluster.shard_failovers", 0),
                 "scrape_errors": counters.get("fleet.scrape_errors", 0),
             },
@@ -1715,6 +1780,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request body: {exc}"})
             return
         self._account_response = False
+        self._scope = None  # CancelScope carrying this request's deadline
         if self.path in ("/v1/generate", "/v1/verify", "/v1/generate_range"):
             # Per-tenant accounting at the front door, and the (sanitized)
             # tenant rides the forwarded body so shards account it too.
@@ -1741,6 +1807,36 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         },
                     )
                     return
+            # deadline propagation at the cluster door: same contract as
+            # the single-daemon door (body deadline_ms wins over the
+            # X-IPC-Deadline-Ms header; both mean budget REMAINING)
+            raw = body.get("deadline_ms", None)
+            if raw is None:
+                raw = self.headers.get("X-IPC-Deadline-Ms")
+            if raw is not None:
+                try:
+                    ms = float(raw)
+                except (TypeError, ValueError):
+                    self._send_json(
+                        400,
+                        {"error": "deadline_ms must be a number of milliseconds"},
+                    )
+                    return
+                deadline = Deadline.from_ms(max(0.0, ms))
+                if deadline.remaining_ms() <= self.router.deadline_floor_ms:
+                    self.router.metrics.count("serve.deadline_rejects")
+                    self.router.metrics.count("deadline.rejects.router")
+                    self._send_json(
+                        504,
+                        {
+                            "error": f"deadline budget {ms:.0f}ms at/below "
+                            f"the router floor "
+                            f"({self.router.deadline_floor_ms:.0f}ms)",
+                            "error_type": "deadline",
+                        },
+                    )
+                    return
+                self._scope = CancelScope(deadline)
         if self.path == "/v1/generate_range":
             try:
                 stream = negotiate_stream(body, headers=self.headers)
@@ -1752,38 +1848,47 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 return
             if stream:
                 try:
-                    self._stream_generate_range(body)
+                    with use_scope(self._scope):
+                        self._stream_generate_range(body)
                 except NoShardsError as exc:
                     self._send_json(503, {"error": str(exc)})
+                except DeadlineError as exc:
+                    self._send_json(
+                        504, {"error": str(exc), "error_type": exc.error_type}
+                    )
                 return
         try:
-            if self.path == "/v1/generate":
-                status, obj = self.router.generate(
-                    body.get("pair_index"),
-                    timeout_s=body.get("timeout_s"),
-                    idempotency_key=body.get("idempotency_key"),
-                    tenant=body.get("tenant"),
-                )
-            elif self.path == "/v1/verify":
-                status, obj = self.router.verify(body)
-            elif self.path == "/v1/generate_range":
-                status, obj = self.router.generate_range(
-                    body.get("pair_indexes") or [],
-                    chunk_size=body.get("chunk_size"),
-                    timeout_s=body.get("timeout_s"),
-                    aggregate=body.get("aggregate", False) is True,
-                    tenant=body.get("tenant"),
-                )
-            elif self.path == "/v1/subscribe":
-                status, obj = self.router.subscribe(body)
-            elif self.path == "/v1/unsubscribe":
-                status, obj = self.router.unsubscribe(body)
-            elif self.path == "/v1/backfill":
-                status, obj = self.router.backfill_submit(body)
-            else:
-                status, obj = 404, {"error": f"no such path: {self.path}"}
+            with use_scope(self._scope):
+                if self.path == "/v1/generate":
+                    status, obj = self.router.generate(
+                        body.get("pair_index"),
+                        timeout_s=body.get("timeout_s"),
+                        idempotency_key=body.get("idempotency_key"),
+                        tenant=body.get("tenant"),
+                    )
+                elif self.path == "/v1/verify":
+                    status, obj = self.router.verify(body)
+                elif self.path == "/v1/generate_range":
+                    status, obj = self.router.generate_range(
+                        body.get("pair_indexes") or [],
+                        chunk_size=body.get("chunk_size"),
+                        timeout_s=body.get("timeout_s"),
+                        aggregate=body.get("aggregate", False) is True,
+                        tenant=body.get("tenant"),
+                    )
+                elif self.path == "/v1/subscribe":
+                    status, obj = self.router.subscribe(body)
+                elif self.path == "/v1/unsubscribe":
+                    status, obj = self.router.unsubscribe(body)
+                elif self.path == "/v1/backfill":
+                    status, obj = self.router.backfill_submit(body)
+                else:
+                    status, obj = 404, {"error": f"no such path: {self.path}"}
         except NoShardsError as exc:
             status, obj = 503, {"error": str(exc)}
+        except DeadlineError as exc:
+            # a budget that ran out mid-scatter: typed, never partial
+            status, obj = 504, {"error": str(exc), "error_type": exc.error_type}
         self._send_json(status, obj)
 
 

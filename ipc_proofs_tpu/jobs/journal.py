@@ -198,7 +198,12 @@ class JournalWriter:
             return 0
 
     def _crash(self, frame: bytes) -> None:
-        """Fault hook: die by real SIGKILL mid-append (see module doc)."""
+        """Fault hook: die by real signal mid-append (see module doc).
+
+        ``IPC_JOURNAL_CRASH_SIGNAL=TERM`` swaps the SIGKILL for SIGTERM —
+        the orchestrator-preemption flavor (k8s eviction, spot reclaim):
+        still abrupt when nothing catches it, but deliverable to a process
+        with a drain handler installed. The crashtest grid runs both."""
         if self._crash_torn is not None:
             # tear the frame: persist only the first K bytes (clamped so at
             # least one byte is missing — a full frame wouldn't be torn)
@@ -208,7 +213,12 @@ class JournalWriter:
             self._fh.write(frame)  # boundary kill: record fully committed
         self._fh.flush()
         os.fsync(self._fh.fileno())
-        os.kill(os.getpid(), signal.SIGKILL)
+        sig = (
+            signal.SIGTERM
+            if os.environ.get("IPC_JOURNAL_CRASH_SIGNAL", "").upper() == "TERM"
+            else signal.SIGKILL
+        )
+        os.kill(os.getpid(), sig)
 
     def append(self, obj: Any) -> bool:
         """Durably append one record; True iff it reached disk."""

@@ -332,6 +332,7 @@ class SloWatchdog:
             "storex.shared_evictions",
             "fetch.speculative_wasted",
             "fetch.speculative_wants",
+            "degraded.entered",
         )
         point = (t, {k: counters.get(k, 0) for k in keys})
         self._anomaly_samples.append(point)
@@ -372,6 +373,14 @@ class SloWatchdog:
         ):
             active["speculation_waste_spike"] = (
                 f"{wasted:.0f}/{wants:.0f} speculative fetches wasted"
+            )
+        entered = delta("degraded.entered")
+        if entered >= 1:
+            # a single entry is always page-worthy: the daemon lost its
+            # LAST upstream endpoint and now serves warm-tier traffic only
+            active["degraded_lotus_down"] = (
+                f"entered degraded serve mode {entered:.0f}x in the fast "
+                "window (all upstream breakers open)"
             )
         for name, detail in active.items():
             if name not in self._active_anomalies:

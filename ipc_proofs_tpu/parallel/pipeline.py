@@ -422,17 +422,25 @@ class _OrderedEmitter:
 
 
 def _stage_worker(
-    stage: PipelineStage, in_q, emit, cancel: _Cancel, metrics, trace_ctx=None
+    stage: PipelineStage, in_q, emit, cancel: _Cancel, metrics, trace_ctx=None,
+    cancel_scope=None,
 ) -> None:
     from ipc_proofs_tpu.obs.trace import use_context
+    from ipc_proofs_tpu.utils.deadline import use_scope
 
-    with use_context(trace_ctx):
+    with use_context(trace_ctx), use_scope(cancel_scope):
         while True:
             task = _get(in_q, cancel)
             if task is _STOP:
                 return
             seq, item = task
             try:
+                # stage boundary = cancellation boundary: an abandoned or
+                # expired request stops consuming workers before the next
+                # stage fn runs (checkpoints inside fns fire too — the
+                # ambient scope is installed on this worker thread)
+                if cancel_scope is not None:
+                    cancel_scope.check(stage=f"pipeline.{stage.name}")
                 if metrics is not None and stage.metrics_stage:
                     with metrics.stage(stage.metrics_stage):
                         result = stage.fn(item)
@@ -481,8 +489,13 @@ def run_pipeline(
     # every stage worker thread re-installs it so spans opened inside
     # stage fns (e.g. via metrics.stage) parent into the caller's trace
     from ipc_proofs_tpu.obs.trace import current_context
+    from ipc_proofs_tpu.utils.deadline import current_scope
 
     trace_ctx = current_context()
+    # the caller's CancelScope hops too: every stage worker re-installs
+    # it and checks it at each stage boundary, so a cancelled/expired
+    # request tears the whole pipeline down typed
+    cancel_scope = current_scope()
 
     threads: list[threading.Thread] = []
     for i, stage in enumerate(stages):
@@ -494,7 +507,10 @@ def run_pipeline(
         for w in range(workers):
             t = threading.Thread(
                 target=_stage_worker,
-                args=(stage, queues[i], emitter.emit, cancel, metrics, trace_ctx),
+                args=(
+                    stage, queues[i], emitter.emit, cancel, metrics,
+                    trace_ctx, cancel_scope,
+                ),
                 name=f"pipeline-{stage.name}-{w}",
                 daemon=True,
             )

@@ -25,6 +25,7 @@ the reference's unified bundle (`src/proofs/generator.rs:25-95`).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -41,6 +42,10 @@ from ipc_proofs_tpu.proofs.generator import EventProofSpec
 from ipc_proofs_tpu.proofs.witness import WitnessCollector
 from ipc_proofs_tpu.state.events import StampedEvent
 from ipc_proofs_tpu.store.blockstore import Blockstore, CachedBlockstore
+from ipc_proofs_tpu.utils.deadline import (
+    checkpoint as _dl_checkpoint,
+    remaining_budget_s as _remaining_budget_s,
+)
 from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
 from ipc_proofs_tpu.utils.lockdep import named_lock
 
@@ -254,11 +259,18 @@ def generate_event_proofs_for_range_chunked(
             job_dir, job_manifest(spec_repr, pairs, chunk_size), metrics=metrics
         )
 
+    from ipc_proofs_tpu.utils.deadline import checkpoint
+
     storage_proofs = []
     event_proofs = []
     all_blocks: set[ProofBlock] = set()
     try:
         for chunk_index, start in enumerate(range(0, len(pairs), chunk_size)):
+            # chunk boundary = cancellation/deadline boundary: a cancelled
+            # or expired request stops here typed instead of generating
+            # the remaining chunks for nobody (committed chunks stay in
+            # the checkpoint/journal for a budgeted re-run to resume)
+            checkpoint("range.chunk")
             chunk = pairs[start : start + chunk_size]
             digest = (
                 _chunk_checkpoint_digest(spec_repr, chunk)
@@ -1001,6 +1013,18 @@ def generate_event_proofs_for_range_pipelined(
                     "scan of chunk %d failed (%s) — retry %d/%d",
                     index, exc, attempt, scan_retries,
                 )
+                # back off before rescanning: under the pool's lotus_down
+                # posture an immediate retry is refused without touching
+                # an endpoint (fail fast), so the wait has to span the
+                # breaker window for the next attempt to win the probe
+                # slot. Deadline-aware: a budget that cannot cover the
+                # wait re-raises now instead of sleeping past it.
+                delay = min(0.05 * (2.0 ** (attempt - 1)), 0.5)  # ipclint: disable=det-float (retry backoff is wall-clock, not a proof value)
+                rem = _remaining_budget_s()
+                if rem is not None and rem <= delay:
+                    raise
+                _dl_checkpoint("range.scan_retry")
+                time.sleep(delay)
 
     def _record(scanned):
         # chunk-local: every branch returns a tagged tuple for the merge
@@ -1117,9 +1141,14 @@ def generate_event_proofs_for_range_pipelined(
     try:
         if items:
             if serial_fallback:
+                from ipc_proofs_tpu.utils.deadline import checkpoint
+
                 metrics.count("range_pipeline_serial_fallback")
                 results = []
                 for item in items:
+                    # same cancellation boundary the threaded pipeline has
+                    # at each stage hand-off
+                    checkpoint("range.chunk")
                     out = item
                     for fn in stage_fns:
                         out = fn(out)

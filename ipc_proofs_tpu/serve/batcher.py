@@ -52,6 +52,7 @@ from typing import Callable, Optional
 
 from ipc_proofs_tpu.obs.trace import current_context
 from ipc_proofs_tpu.serve.qos import FairQueue
+from ipc_proofs_tpu.utils.deadline import CancelledError, DeadlineError
 from ipc_proofs_tpu.utils.metrics import Metrics
 from ipc_proofs_tpu.utils.lockdep import named_condition
 
@@ -78,8 +79,12 @@ class ServiceClosedError(RuntimeError):
     """The service is draining or stopped; no new requests are admitted."""
 
 
-class DeadlineExceededError(RuntimeError):
-    """The request's deadline passed before it could be processed."""
+class DeadlineExceededError(DeadlineError):
+    """The request's deadline passed before it could be processed.
+
+    Subclasses `utils.deadline.DeadlineError`, so it carries
+    ``error_type == "deadline"`` and every typed-deadline door (504
+    mapping, IPBS in-band abort, scatter merge) renders it uniformly."""
 
 
 class PendingResult:
@@ -103,6 +108,7 @@ class PendingResult:
         "dispatched_at",
         "trace_ctx",
         "tenant",
+        "cancel_scope",
         "_done",
         "_result",
         "_error",
@@ -115,6 +121,10 @@ class PendingResult:
         self.dispatched_at: Optional[float] = None
         self.trace_ctx = None  # obs.trace.TraceContext captured at submit
         self.tenant: Optional[str] = None  # sanitized accounting label
+        # utils.deadline.CancelScope carried across the queue hop: the
+        # batcher drops cancelled members at dispatch time and batch
+        # execution installs it so chunk/stage checkpoints fire
+        self.cancel_scope = None
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -198,6 +208,7 @@ class MicroBatcher:
         tenant: Optional[str] = None,
         low_priority: bool = False,
         lane: Optional[str] = None,
+        cancel_scope=None,
     ) -> PendingResult:
         """Admit one request; never blocks.
 
@@ -206,7 +217,9 @@ class MicroBatcher:
         ``"interactive"`` (default) | ``"low"``; ``low_priority=True``
         remains the low-lane spelling. ``tenant`` keys the interactive
         lane's deficit-round-robin sub-queue (untenanted requests share
-        one round-robin slot).
+        one round-robin slot). ``cancel_scope`` rides the queue hop: a
+        member whose scope is cancelled by dispatch time is dropped
+        (typed) without spending batch capacity.
         """
         if lane is None:
             lane = "low" if low_priority else "interactive"
@@ -228,6 +241,7 @@ class MicroBatcher:
             pending = PendingResult(payload, deadline, now)
             pending.trace_ctx = current_context()
             pending.tenant = tenant
+            pending.cancel_scope = cancel_scope
             q.append(pending)
             if lane == "low":
                 self._metrics.set_gauge(
@@ -333,15 +347,48 @@ class MicroBatcher:
 
     def _dispatch(self, batch: list[PendingResult]) -> None:
         now = time.monotonic()
+        with self._cond:
+            est_flush_s = self._avg_flush_s
         live: list[PendingResult] = []
         for pending in batch:
             pending.dispatched_at = now
-            if pending.deadline is not None and now > pending.deadline:
+            scope = pending.cancel_scope
+            if scope is not None and scope.cancelled:
+                # abandoned while queued: drop it HERE, before it costs a
+                # worker anything — the whole flush estimate is reclaimed
+                self._metrics.count("serve.cancelled_inflight")
+                self._metrics.count(
+                    "deadline.reclaimed_ms", max(1, int(est_flush_s * 1000.0))
+                )
+                pending.fail(
+                    CancelledError(
+                        scope.reason or "request cancelled while queued"
+                    )
+                )
+            elif pending.deadline is not None and now > pending.deadline:
                 self._metrics.count(f"serve.deadline_exceeded.{self._name}")
+                self._metrics.count("serve.deadline_rejects")
+                self._metrics.count("deadline.rejects.batcher")
                 pending.fail(
                     DeadlineExceededError(
                         f"deadline exceeded after "
                         f"{now - pending.enqueued_at:.3f}s in queue"
+                    )
+                )
+            elif (
+                pending.deadline is not None
+                and pending.deadline - now < est_flush_s * 0.5
+            ):
+                # remaining budget cannot plausibly cover even half a
+                # typical flush: refuse typed rather than produce an
+                # answer after the client stopped waiting
+                self._metrics.count(f"serve.deadline_exceeded.{self._name}")
+                self._metrics.count("serve.deadline_rejects")
+                self._metrics.count("deadline.rejects.batcher")
+                pending.fail(
+                    DeadlineExceededError(
+                        "remaining budget %.0fms below batch execution floor"
+                        % ((pending.deadline - now) * 1000.0)
                     )
                 )
             else:

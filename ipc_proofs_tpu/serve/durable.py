@@ -59,10 +59,10 @@ from ipc_proofs_tpu.jobs.journal import (
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.utils.threads import locked
 from ipc_proofs_tpu.serve.batcher import (
-    DeadlineExceededError,
     QueueFullError,
     ServiceClosedError,
 )
+from ipc_proofs_tpu.utils.deadline import DeadlineError, current_scope
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.lockdep import named_lock
 
@@ -73,8 +73,12 @@ QUEUE_JOURNAL_NAME = "queue.bin"
 logger = get_logger(__name__)
 
 # admission-layer failures: the request never (finishably) executed, so
-# its admit record stays pending and the next restart re-executes it
-_ADMISSION_ERRORS = (QueueFullError, ServiceClosedError, DeadlineExceededError)
+# its admit record stays pending and the next restart re-executes it.
+# DeadlineError covers the batcher's DeadlineExceededError plus every
+# propagated deadline/cancel hop (rpc retry, range chunk, pipeline
+# stage) — a budget that ran out must NOT journal as a durable error, or
+# an idempotent retry with fresh budget would be served the stale failure
+_ADMISSION_ERRORS = (QueueFullError, ServiceClosedError, DeadlineError)
 
 
 class _Inflight:
@@ -283,9 +287,16 @@ class DurableAdmission:
         timeout_s: "float | None",
         tenant: "str | None" = None,
     ) -> dict:
+        # the HTTP layer installs the request's CancelScope as ambient
+        # before calling submit(); forwarding it into the batcher keeps
+        # cooperative cancellation working through the durable hop (replay
+        # runs scope-less: current_scope() is None on the restart thread)
+        scope = current_scope()
         if kind == "verify":
             bundle = UnifiedProofBundle.from_json_obj(payload)
-            resp = self.service.verify(bundle, timeout_s=timeout_s, tenant=tenant)
+            resp = self.service.verify(
+                bundle, timeout_s=timeout_s, tenant=tenant, cancel_scope=scope
+            )
             return {
                 "storage_results": resp.storage_results,
                 "event_results": resp.event_results,
@@ -300,7 +311,10 @@ class DurableAdmission:
                     f"pair_index {payload!r} outside [0, {len(self.pairs)})"
                 )
             resp = self.service.generate(
-                self.pairs[payload], timeout_s=timeout_s, tenant=tenant
+                self.pairs[payload],
+                timeout_s=timeout_s,
+                tenant=tenant,
+                cancel_scope=scope,
             )
             return {
                 "bundle": resp.bundle.to_json_obj(),

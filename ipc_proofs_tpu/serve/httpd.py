@@ -80,6 +80,8 @@ from __future__ import annotations
 
 import json
 import os
+import select
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
@@ -97,13 +99,25 @@ from ipc_proofs_tpu.obs.trace import adopted_span, tracing_enabled
 from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
 from ipc_proofs_tpu.proofs.range import TipsetPair
 from ipc_proofs_tpu.serve.batcher import (
-    DeadlineExceededError,
     QueueFullError,
     ServiceClosedError,
 )
-from ipc_proofs_tpu.serve.qos import TenantQoS, TenantThrottledError
+from ipc_proofs_tpu.serve.qos import (
+    AdmitRejectedError,
+    GradientLimiter,
+    TenantQoS,
+    TenantThrottledError,
+)
 from ipc_proofs_tpu.serve.service import ProofService
+from ipc_proofs_tpu.store.failover import DegradedError
 from ipc_proofs_tpu.storex import SegmentStoreError
+from ipc_proofs_tpu.utils.deadline import (
+    CancelledError,
+    CancelScope,
+    Deadline,
+    DeadlineError,
+    use_scope,
+)
 from ipc_proofs_tpu.witness import (
     AggregatedBundle,
     WitnessEncodingError,
@@ -126,6 +140,10 @@ from ipc_proofs_tpu.witness.stream import (
 __all__ = ["ProofHTTPServer"]
 
 _MAX_BODY_BYTES = 64 * 1024 * 1024  # one bundle; far above any sane request
+# how often a handler thread blocked on a pending result checks whether the
+# client hung up (EOF on the socket) — the window between a disconnect and
+# the in-flight work being cancelled
+_DISCONNECT_POLL_S = 0.1
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -137,6 +155,7 @@ class _Handler(BaseHTTPRequestHandler):
     slo = None  # Optional[obs.slo.SloWatchdog]
     tenants = None  # Optional[obs.fleet.TenantLedger]
     qos = None  # Optional[serve.qos.TenantQoS]
+    admit = None  # Optional[serve.qos.GradientLimiter] (--admit-gradient)
 
     protocol_version = "HTTP/1.1"
 
@@ -560,6 +579,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._tenant = extract_tenant(body, self.headers)
         self._active_span = None  # set for remote-carried requests (stitching)
         self._account_response = False
+        self._cancel_scope = None  # set for proof paths below
+        self._admit_slot = None
+        self._queue_delay_ms = 0.0  # AIMD signal, filled from server_timing
         if self.path in ("/v1/verify", "/v1/generate", "/v1/generate_range"):
             if self.tenants is not None:
                 self.tenants.account(self._tenant, getattr(self, "_body_bytes", 0))
@@ -576,6 +598,9 @@ class _Handler(BaseHTTPRequestHandler):
                         {
                             "error": str(exc),
                             "error_type": "tenant_throttled",
+                            # the HONEST refill estimate (seconds until the
+                            # bucket actually holds one token), not a fixed
+                            # constant — the header rounds it up to >= 1s
                             "retry_after_s": exc.retry_after_s,
                         },
                         headers={
@@ -583,6 +608,40 @@ class _Handler(BaseHTTPRequestHandler):
                         },
                     )
                     return
+            # deadline propagation: X-IPC-Deadline-Ms header / deadline_ms
+            # body field is the caller's REMAINING budget. A budget already
+            # below the admission floor is refused typed here — admitting it
+            # would burn a worker on a response nobody can use
+            if not self._parse_deadline(body):
+                return
+            # adaptive admission (--admit-gradient): the AIMD concurrency
+            # gate sits after the per-tenant bucket (cheap, per-tenant
+            # fairness first) and before any queue slot is taken
+            if self.admit is not None:
+                try:
+                    self._admit_slot = self.admit.acquire(self._tenant)
+                except AdmitRejectedError as exc:
+                    self._send_json(
+                        429,
+                        {
+                            "error": str(exc),
+                            "error_type": "admit_rejected",
+                            "retry_after_s": exc.retry_after_s,
+                        },
+                        headers={
+                            "Retry-After": f"{max(1, round(exc.retry_after_s))}"
+                        },
+                    )
+                    return
+        try:
+            self._route_post(body, carrier)
+        finally:
+            if self._admit_slot is not None:
+                self.admit.release(
+                    self._admit_slot, queue_delay_ms=self._queue_delay_ms
+                )
+
+    def _route_post(self, body: dict, carrier) -> None:
         if self.path == "/v1/verify":
             with adopted_span("http.verify", carrier, {"path": self.path}) as sp:
                 if carrier is not None:
@@ -710,7 +769,7 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as exc:
             self._send_json(400, {"error": f"malformed bundle: {exc}"})
             return
-        timeout_s = body.get("timeout_s")
+        timeout_s = self._effective_timeout(body)
         if self.durable is not None:
             # journal the PLAIN bundle obj (compressed frames expand before
             # admission, so journal replay never needs the codec)
@@ -734,10 +793,14 @@ class _Handler(BaseHTTPRequestHandler):
             return out
 
         self._submit(
-            lambda: self.service.verify(
-                bundle, timeout_s=timeout_s, tenant=self._tenant
+            lambda: self.service.submit_verify(
+                bundle,
+                timeout_s=timeout_s,
+                tenant=self._tenant,
+                cancel_scope=self._cancel_scope,
             ),
             render,
+            pending=True,
         )
 
     def _handle_generate(self, body: dict):
@@ -757,7 +820,7 @@ class _Handler(BaseHTTPRequestHandler):
         stream = self._negotiate_stream(body)
         if stream is None:
             return
-        timeout_s = body.get("timeout_s")
+        timeout_s = self._effective_timeout(body)
         if self.durable is not None:
             self._submit_durable(
                 "generate", idx, body, witness=opts, stream=stream
@@ -781,8 +844,11 @@ class _Handler(BaseHTTPRequestHandler):
             )
 
         self._submit(
-            lambda: self.service.generate(
-                self.pairs[idx], timeout_s=timeout_s, tenant=self._tenant
+            lambda: self.service.submit_generate(
+                self.pairs[idx],
+                timeout_s=timeout_s,
+                tenant=self._tenant,
+                cancel_scope=self._cancel_scope,
             ),
             lambda resp: dict(
                 self._witness_fields(resp.bundle, opts),
@@ -793,6 +859,7 @@ class _Handler(BaseHTTPRequestHandler):
             ),
             stream_fn=stream_doc if stream else None,
             encoding=opts.encoding,
+            pending=True,
         )
 
     def _handle_generate_range(self, body: dict):
@@ -898,17 +965,123 @@ class _Handler(BaseHTTPRequestHandler):
             )
 
         self._submit(
-            lambda: self.service.generate_range(
-                [self.pairs[i] for i in gen_idxs], chunk_size=chunk
+            # direct synchronous driver call on this handler thread — the
+            # scope installs so chunk checkpoints see the deadline (no
+            # concurrent disconnect watcher on this path)
+            lambda: self._call_scoped(
+                lambda: self.service.generate_range(
+                    [self.pairs[i] for i in gen_idxs], chunk_size=chunk
+                )
             ),
             render,
             stream_fn=stream_doc if stream else None,
             encoding=opts.encoding,
         )
 
-    def _submit(self, call, render, stream_fn=None, encoding=None):
+    # --- deadline / cancellation plumbing ----------------------------------
+
+    def _parse_deadline(self, body: dict) -> bool:
+        """Install this request's `CancelScope` from its deadline budget.
+
+        ``deadline_ms`` in the body wins over the ``X-IPC-Deadline-Ms``
+        header; both mean "milliseconds of budget REMAINING as the request
+        reaches me" — each hop re-emits the decremented value, never the
+        original. A budget at/below ``--deadline-floor-ms`` is refused
+        typed 504 right here (``deadline.rejects.httpd``): admitting work
+        that cannot finish inside its budget only burns a worker slot that
+        a live request could have used. Returns False after sending an
+        error response; a request with no deadline still gets a scope so
+        client-disconnect cancellation works."""
+        raw = body.get("deadline_ms", None)
+        if raw is None:
+            raw = self.headers.get("X-IPC-Deadline-Ms")
+        deadline = None
+        if raw is not None:
+            try:
+                ms = float(raw)
+            except (TypeError, ValueError):
+                self._send_json(
+                    400, {"error": "deadline_ms must be a number of milliseconds"}
+                )
+                return False
+            deadline = Deadline.from_ms(max(0.0, ms))
+            floor_ms = float(
+                getattr(self.service.config, "deadline_floor_ms", 0.0)
+            )
+            if deadline.remaining_ms() <= floor_ms:
+                m = self.service.metrics
+                m.count("serve.deadline_rejects")
+                m.count("deadline.rejects.httpd")
+                self._send_json(
+                    504,
+                    {
+                        "error": f"deadline budget {ms:.0f}ms at/below the "
+                        f"admission floor ({floor_ms:.0f}ms)",
+                        "error_type": "deadline",
+                    },
+                )
+                return False
+        self._cancel_scope = CancelScope(deadline)
+        return True
+
+    def _effective_timeout(self, body: dict):
+        """The batcher timeout for this request: the explicit ``timeout_s``
+        clamped to the deadline budget (whichever expires first wins)."""
+        timeout_s = body.get("timeout_s")
+        scope = getattr(self, "_cancel_scope", None)
+        if scope is not None and scope.deadline is not None:
+            rem = max(0.0, scope.deadline.remaining_s())
+            timeout_s = rem if timeout_s is None else min(float(timeout_s), rem)
+        return timeout_s
+
+    def _client_disconnected(self) -> bool:
+        """True when the client hung up: the socket is readable AND a
+        MSG_PEEK read returns EOF (a pipelined next request makes the
+        socket readable too — peeking distinguishes the two without
+        consuming bytes)."""
         try:
-            resp = call()
+            readable, _, _ = select.select([self.connection], [], [], 0)
+            if not readable:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _await_pending(self, pending):
+        """Block on a `PendingResult` while watching the client socket.
+
+        A disconnect cancels the request's scope: the batcher drops it at
+        dispatch (or the range driver aborts at its next chunk boundary)
+        and the worker time goes to a request somebody still wants. We keep
+        waiting after cancelling — the batcher acknowledges with a typed
+        `CancelledError` (or completes the batch that already started)."""
+        scope = self._cancel_scope
+        while True:
+            try:
+                return pending.result(timeout=_DISCONNECT_POLL_S)
+            except TimeoutError:
+                if (
+                    scope is not None
+                    and not scope.cancelled
+                    and self._client_disconnected()
+                ):
+                    scope.cancel("client disconnected")
+
+    def _call_scoped(self, fn):
+        """Run a synchronous service call under this request's scope so
+        driver checkpoints (`utils.deadline.checkpoint`) see its deadline.
+        The call runs on THIS handler thread, so there is no concurrent
+        disconnect watcher — expiry aborts at the next chunk/stage/retry
+        boundary."""
+        scope = getattr(self, "_cancel_scope", None)
+        if scope is None:
+            return fn()
+        with use_scope(scope):
+            return fn()
+
+    def _submit(self, call, render, stream_fn=None, encoding=None, pending=False):
+        try:
+            resp = self._await_pending(call()) if pending else call()
         except QueueFullError as exc:
             self._send_json(
                 503,
@@ -917,11 +1090,30 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except ServiceClosedError:
             self._send_json(503, {"error": "service draining"})
-        except DeadlineExceededError as exc:
-            self._send_json(504, {"error": str(exc)})
+        except CancelledError:
+            # only a client disconnect cancels the scope — there is nobody
+            # left to answer; close without wasting bytes on the dead socket
+            self.close_connection = True
+        except DeadlineError as exc:
+            # covers batcher DeadlineExceededError + every propagated hop
+            # (rpc retry, range chunk, pipeline stage); always typed
+            self._send_json(
+                504, {"error": str(exc), "error_type": exc.error_type}
+            )
+        except DegradedError as exc:
+            # all breakers open and the request needed the upstream: fail
+            # fast typed — warm-tier requests never reach this branch
+            self._send_json(
+                503, {"error": str(exc), "error_type": exc.error_type}
+            )
         except RuntimeError as exc:
             self._send_json(400, {"error": str(exc)})
         else:
+            t = getattr(resp, "server_timing", None)
+            if isinstance(t, dict) and "queue_ms" in t:
+                # the gradient limiter's AIMD signal: pure queue wait, not
+                # execution time (a big batch is throughput, not overload)
+                self._queue_delay_ms = float(t["queue_ms"])
             if stream_fn is not None:
                 # admission/execution errors above still travel as typed
                 # JSON statuses — only a successful response streams
@@ -1007,9 +1199,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": "idempotency_key must be a string"})
             return
         try:
-            key, done, cached = self.durable.submit(
-                kind, payload, idempotency_key=key,
-                timeout_s=body.get("timeout_s"), tenant=self._tenant,
+            # scoped so the durable layer (and any direct driver call it
+            # makes) sees this request's deadline through the ambient scope
+            key, done, cached = self._call_scoped(
+                lambda: self.durable.submit(
+                    kind, payload, idempotency_key=key,
+                    timeout_s=self._effective_timeout(body),
+                    tenant=self._tenant,
+                )
             )
         except QueueFullError as exc:
             self._send_json(
@@ -1019,8 +1216,14 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except ServiceClosedError:
             self._send_json(503, {"error": "service draining"})
-        except DeadlineExceededError as exc:
-            self._send_json(504, {"error": str(exc)})
+        except DeadlineError as exc:
+            self._send_json(
+                504, {"error": str(exc), "error_type": exc.error_type}
+            )
+        except DegradedError as exc:
+            self._send_json(
+                503, {"error": str(exc), "error_type": exc.error_type}
+            )
         else:
             headers = None
             if (
@@ -1140,6 +1343,21 @@ class ProofHTTPServer:
                 metrics=service.metrics,
                 ledger=self.tenants,
             )
+        # adaptive admission (--admit-gradient): one AIMD gate shared by
+        # every handler thread; replaces the static queue_capacity as the
+        # effective concurrency bound (the batcher capacity stays as a
+        # hard backstop behind it)
+        self.admit = None
+        cfg = service.config
+        if getattr(cfg, "admit_gradient", False):
+            self.admit = GradientLimiter(
+                initial=cfg.admit_initial,
+                min_limit=cfg.admit_min,
+                max_limit=cfg.admit_max,
+                delay_budget_ms=cfg.admit_delay_budget_ms,
+                tenant_weights=getattr(cfg, "tenant_weights", None),
+                metrics=service.metrics,
+            )
         handler = type(
             "_BoundHandler",
             (_Handler,),
@@ -1152,6 +1370,7 @@ class ProofHTTPServer:
                 "tenants": self.tenants,
                 "backfill": backfill,
                 "qos": self.qos,
+                "admit": self.admit,
             },
         )
         self._httpd = ThreadingHTTPServer((host, port), handler)

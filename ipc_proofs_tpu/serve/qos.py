@@ -35,8 +35,16 @@ from typing import Optional
 
 from ipc_proofs_tpu.utils.lockdep import named_lock
 from ipc_proofs_tpu.utils.metrics import Metrics, get_metrics
+from ipc_proofs_tpu.utils.threads import locked
 
-__all__ = ["FairQueue", "TenantQoS", "TenantThrottledError", "TokenBucket"]
+__all__ = [
+    "AdmitRejectedError",
+    "FairQueue",
+    "GradientLimiter",
+    "TenantQoS",
+    "TenantThrottledError",
+    "TokenBucket",
+]
 
 
 class TenantThrottledError(RuntimeError):
@@ -190,3 +198,178 @@ class FairQueue:
     def tenants(self) -> int:
         """Live sub-queues (the ``qos.tenant_queues`` gauge)."""
         return sum(1 for q in self._queues.values() if q)
+
+
+class AdmitRejectedError(RuntimeError):
+    """The adaptive admission limiter shed this request; mapped to a
+    typed 429 whose ``Retry-After`` is the limiter's drain estimate —
+    honest backpressure, not a constant the client learns to ignore."""
+
+    error_type = "admit_rejected"
+
+    def __init__(self, retry_after_s: float, tenant: Optional[str] = None):
+        super().__init__(
+            "admission limit reached; retry in %.2fs" % retry_after_s
+        )
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+
+
+class _AdmitSlot:
+    """One held admission: returned by `GradientLimiter.acquire`, handed
+    back to `release`. Carries the acquire stamp so the limiter can
+    measure true service time without a side table."""
+
+    __slots__ = ("tenant", "started", "released")
+
+    def __init__(self, tenant: Optional[str], started: float):
+        self.tenant = tenant
+        self.started = started
+        self.released = False
+
+
+class GradientLimiter:
+    """AIMD concurrency limiter driven by observed queue delay.
+
+    Replaces the static ``queue_capacity`` as the serve plane's first
+    gate (the batcher capacity stays as a hard backstop). The limit
+    GROWS additively (+1) while recent queue delay sits comfortably
+    under the SLO-derived budget, and SHRINKS multiplicatively
+    (× ``shrink``) the moment the window's p99 queue delay crosses it —
+    the classic gradient/AIMD response that keeps a fast host admitting
+    near its true capacity and walks a melting host back down instead of
+    letting a fixed bound choose wrong in both directions.
+
+    Shedding is tenant-aware: tenants named in ``tenant_weights`` (the
+    top-K by deficit weight, the same vocabulary the fair queue uses)
+    ride a grace headroom of ``grace`` × limit before they shed, so
+    under overload the anonymous/`other` pool sheds FIRST and paying
+    tenants keep their latency (counted ``admit.shed_other``).
+
+    429s carry an honest ``Retry-After``: the drain estimate
+    ``excess_requests × avg_service_time / limit`` from the limiter's
+    own EWMA of acquire→release service time.
+    """
+
+    WINDOW = 32  # completions per AIMD evaluation window
+    GROW_FRACTION = 0.5  # grow while p99 delay < this fraction of budget
+
+    def __init__(
+        self,
+        initial: int = 8,
+        min_limit: int = 2,
+        max_limit: int = 1024,
+        delay_budget_ms: float = 250.0,
+        shrink: float = 0.8,
+        grace: float = 1.25,
+        tenant_weights: "Optional[dict[str, int]]" = None,
+        metrics: Optional[Metrics] = None,
+        clock=time.monotonic,
+    ):
+        self.min_limit = max(1, int(min_limit))
+        self.max_limit = max(self.min_limit, int(max_limit))
+        self.delay_budget_ms = float(delay_budget_ms)
+        self.shrink = float(shrink)
+        self.grace = max(1.0, float(grace))
+        self._named = frozenset(tenant_weights or ())
+        self._metrics = metrics if metrics is not None else get_metrics()
+        self._clock = clock
+        self._lock = named_lock("GradientLimiter._lock")
+        self._limit = float(min(self.max_limit, max(self.min_limit, initial)))  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
+        self._delays: "deque[float]" = deque(maxlen=self.WINDOW)  # guarded-by: _lock
+        self._avg_service_s = 0.05  # EWMA acquire→release; guarded-by: _lock
+        self._completions = 0  # completions since last AIMD step; guarded-by: _lock
+
+    @property
+    def limit(self) -> int:
+        with self._lock:
+            return int(self._limit)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def acquire(self, tenant: Optional[str] = None) -> _AdmitSlot:
+        """Take one concurrency slot or raise `AdmitRejectedError`.
+
+        Named (top-K weighted) tenants shed only past ``grace`` × limit;
+        everyone else sheds at the limit — the `other` pool first.
+        """
+        named = tenant is not None and tenant in self._named
+        now = self._clock()
+        with self._lock:
+            ceiling = self._limit * self.grace if named else self._limit
+            if self._inflight >= ceiling:
+                retry_after = self._drain_estimate_locked()
+                shed_other = not named
+            else:
+                self._inflight += 1
+                slot = _AdmitSlot(tenant, now)
+                inflight = self._inflight
+                retry_after = None
+        if retry_after is not None:
+            self._metrics.count("admit.rejects")
+            if shed_other:
+                self._metrics.count("admit.shed_other")
+            raise AdmitRejectedError(retry_after, tenant)
+        self._metrics.count("admit.accepted")
+        self._metrics.set_gauge("admit.inflight", inflight)
+        return slot
+
+    def release(self, slot: _AdmitSlot, queue_delay_ms: float = 0.0) -> None:
+        """Return a slot, feeding the AIMD window with this request's
+        observed queue delay. Idempotent per slot (error paths may race
+        a finally block)."""
+        if slot.released:
+            return
+        slot.released = True
+        now = self._clock()
+        grew = shrank = False
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            inflight = self._inflight
+            service_s = max(0.0, now - slot.started)
+            self._avg_service_s = 0.8 * self._avg_service_s + 0.2 * service_s
+            self._delays.append(max(0.0, float(queue_delay_ms)))
+            self._completions += 1
+            if self._completions >= min(self.WINDOW, max(4, int(self._limit))):
+                p99 = self._p99_locked()
+                if p99 > self.delay_budget_ms:
+                    new = max(self.min_limit, int(self._limit * self.shrink))
+                    shrank = new < int(self._limit)
+                    self._limit = float(new)
+                elif p99 < self.delay_budget_ms * self.GROW_FRACTION:
+                    new = min(self.max_limit, int(self._limit) + 1)
+                    grew = new > int(self._limit)
+                    self._limit = float(new)
+                self._completions = 0
+                self._delays.clear()
+            limit = int(self._limit)
+        if grew:
+            self._metrics.count("admit.grows")
+        if shrank:
+            self._metrics.count("admit.shrinks")
+        self._metrics.set_gauge("admit.limit", limit)
+        self._metrics.set_gauge("admit.inflight", inflight)
+
+    def retry_after_s(self) -> float:
+        """Current drain estimate (what a shed request should wait)."""
+        with self._lock:
+            return self._drain_estimate_locked()
+
+    @locked
+    def _p99_locked(self) -> float:
+        if not self._delays:
+            return 0.0
+        ordered = sorted(self._delays)
+        return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+    @locked
+    def _drain_estimate_locked(self) -> float:
+        # How long until a slot frees: the excess queue over the limit
+        # drains at limit/avg_service_time requests per second.
+        excess = max(1.0, self._inflight - self._limit + 1.0)
+        rate = max(1e-6, self._limit / max(1e-3, self._avg_service_s))
+        return max(0.05, excess / rate)

@@ -63,6 +63,7 @@ from ipc_proofs_tpu.serve.batcher import (
     ServiceClosedError,
 )
 from ipc_proofs_tpu.store.blockstore import BlockCache, CachedBlockstore
+from ipc_proofs_tpu.utils.deadline import use_scope
 from ipc_proofs_tpu.utils.log import get_logger
 from ipc_proofs_tpu.utils.metrics import Metrics
 from ipc_proofs_tpu.utils.lockdep import named_lock
@@ -163,6 +164,22 @@ class ServiceConfig:
     # (--tenant-weight name=N): a weight-N tenant drains up to N queued
     # requests per round-robin turn; unlisted tenants weigh 1
     tenant_weights: Optional[dict] = None
+    # adaptive admission (serve/qos.py GradientLimiter, --admit-gradient):
+    # AIMD concurrency limit on queue delay replaces queue_capacity as the
+    # FIRST gate at the HTTP front door (the batcher capacity stays as a
+    # hard backstop). delay budget is the p99 queue-delay SLO in ms.
+    admit_gradient: bool = False
+    admit_initial: int = 8
+    admit_min: int = 2
+    admit_max: int = 1024
+    admit_delay_budget_ms: float = 250.0
+    # deadline propagation (--deadline-floor-ms): requests whose remaining
+    # budget (X-IPC-Deadline-Ms header / deadline_ms body field) is below
+    # this floor are refused typed at admission instead of admitted to die
+    deadline_floor_ms: float = 5.0
+    # pool-wide client retry budget in tokens/s (--retry-budget; None =
+    # unbudgeted). Wired into EndpointPool at daemon build time.
+    retry_budget: Optional[float] = None
 
 
 @dataclass
@@ -363,14 +380,16 @@ class ProofService:
         bundle: UnifiedProofBundle,
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        cancel_scope=None,
     ) -> PendingResult:
         """Admit one verify request; returns immediately with a pending slot.
 
         Raises `QueueFullError` / `ServiceClosedError` at admission time;
         ``.result()`` raises `DeadlineExceededError` if ``timeout_s`` passes
-        before the batch containing it is processed."""
+        before the batch containing it is processed. ``cancel_scope`` rides
+        the queue: a cancelled member is dropped typed at dispatch."""
         return self._verify_batcher.submit(
-            bundle, timeout_s=timeout_s, tenant=tenant
+            bundle, timeout_s=timeout_s, tenant=tenant, cancel_scope=cancel_scope
         )
 
     def verify(
@@ -378,10 +397,11 @@ class ProofService:
         bundle: UnifiedProofBundle,
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        cancel_scope=None,
     ) -> VerifyResponse:
         """Blocking verify: submit and wait for the micro-batched verdict."""
         return self.submit_verify(
-            bundle, timeout_s=timeout_s, tenant=tenant
+            bundle, timeout_s=timeout_s, tenant=tenant, cancel_scope=cancel_scope
         ).result()
 
     def submit_generate(
@@ -389,13 +409,17 @@ class ProofService:
         pair: TipsetPair,
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        cancel_scope=None,
     ) -> PendingResult:
         if self._generate_batcher is None:
             raise RuntimeError(
                 "generate path disabled: service was built without store/spec"
             )
         return self._generate_batcher.submit(
-            _GenerateRequest(pair), timeout_s=timeout_s, tenant=tenant
+            _GenerateRequest(pair),
+            timeout_s=timeout_s,
+            tenant=tenant,
+            cancel_scope=cancel_scope,
         )
 
     def generate(
@@ -403,9 +427,10 @@ class ProofService:
         pair: TipsetPair,
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        cancel_scope=None,
     ) -> GenerateResponse:
         return self.submit_generate(
-            pair, timeout_s=timeout_s, tenant=tenant
+            pair, timeout_s=timeout_s, tenant=tenant, cancel_scope=cancel_scope
         ).result()
 
     def submit_range_window(
@@ -417,6 +442,7 @@ class ProofService:
         spec=None,
         storage_specs=None,
         tenant: Optional[str] = None,
+        cancel_scope=None,
     ) -> PendingResult:
         """Admit one range window on the generate batcher's LOW (default)
         or PUSH lane.
@@ -440,6 +466,7 @@ class ProofService:
             timeout_s=timeout_s,
             tenant=tenant,
             lane=lane if lane == "push" else "low",
+            cancel_scope=cancel_scope,
         )
 
     def generate_range(
@@ -494,6 +521,15 @@ class ProofService:
         if self._endpoint_pool is not None:
             return self._endpoint_pool.health()
         return {"status": "ok"}
+
+    @property
+    def lotus_down(self) -> bool:
+        """True while every pool endpoint's breaker is open (degraded
+        serve mode: warm-tier requests still produce bit-identical
+        bundles; cold requests fail fast typed ``degraded``)."""
+        return self._endpoint_pool is not None and bool(
+            getattr(self._endpoint_pool, "lotus_down", False)
+        )
 
     @property
     def blockstore(self):
@@ -776,7 +812,11 @@ class ProofService:
 
         job_dir = self._batch_job_dir(unique)
         journal_us0 = self.metrics.counter_value("jobs.chunk_journal_us")
-        with use_context(batch[0].trace_ctx):
+        # a coalesced batch shares one driver call, so cooperative abort is
+        # only safe when the whole batch is one request's work — a shared
+        # batch must finish for the members that did NOT cancel
+        batch_scope = batch[0].cancel_scope if len(batch) == 1 else None
+        with use_context(batch[0].trace_ctx), use_scope(batch_scope):
             with self.metrics.stage("serve.generate_batch"):
                 if len(pairs) > 1:
                     # multi-pair batch: stage-overlapped engine (bit-identical
@@ -814,6 +854,10 @@ class ProofService:
                         match_backend=self._match_backend,
                     )
         self.metrics.count("serve.batches.generate")
+        if self.lotus_down:
+            # the whole batch was satisfied from warm local tiers while
+            # every upstream breaker is open — degraded mode's success path
+            self.metrics.count("degraded.warm_served", len(batch))
         # Wall-clock microseconds the range driver spent journalling chunk
         # commits while this batch executed (one flush thread drives the
         # generate queue, so the counter delta is this batch's journalling)
@@ -867,7 +911,9 @@ class ProofService:
         for pending in batch:
             req: _RangeWindowRequest = pending.payload
             try:
-                with use_context(pending.trace_ctx):
+                with use_context(pending.trace_ctx), use_scope(
+                    pending.cancel_scope
+                ):
                     with self.metrics.stage("serve.backfill_window"):
                         bundle = generate_event_proofs_for_range_chunked(
                             self._store,
@@ -881,6 +927,8 @@ class ProofService:
             except BaseException as exc:  # fail-soft: the window's job sees the error; other windows proceed
                 pending.fail(exc)
                 continue
+            if self.lotus_down:
+                self.metrics.count("degraded.warm_served")
             pending.complete(bundle)
 
 

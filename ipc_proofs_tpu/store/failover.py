@@ -26,6 +26,7 @@ testable without sleeping; all fault-injection lives in `store.faults`.
 
 from __future__ import annotations
 
+import random
 import threading
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -39,7 +40,23 @@ from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcError, veri
 from ipc_proofs_tpu.utils.metrics import Histogram
 from ipc_proofs_tpu.utils.lockdep import named_lock
 
-__all__ = ["EndpointPool", "EndpointState", "IntegrityError"]
+__all__ = ["DegradedError", "EndpointPool", "EndpointState", "IntegrityError"]
+
+
+class DegradedError(RuntimeError):
+    """Every endpoint's breaker is open (``lotus_down``): the pool fails
+    RPC-needing work fast and typed instead of stacking retry timeouts.
+
+    Warm-tier reads never see this — the tiered store answers before the
+    pool is consulted; only genuinely cold requests surface it."""
+
+    error_type = "degraded"
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            "all Lotus endpoints unavailable (degraded=lotus_down)"
+            + (f": {detail}" if detail else "")
+        )
 
 # Breaker states
 _CLOSED = "closed"
@@ -108,6 +125,7 @@ class EndpointPool:
         hedge_ms: Optional[float] = None,
         metrics=None,
         clock=time.monotonic,
+        retry_budget_per_s: Optional[float] = None,
     ):
         """``breaker_threshold`` consecutive failures open an endpoint's
         breaker; after ``breaker_reset_s`` one half-open probe is admitted.
@@ -115,7 +133,12 @@ class EndpointPool:
         milliseconds (the effective delay is the larger of the floor and
         the observed p99 fetch latency); ``None`` disables hedging.
         ``clock`` injects a monotonic time source for deterministic breaker
-        tests."""
+        tests. ``retry_budget_per_s`` caps the POOL-WIDE rate of
+        `LotusClient` retry attempts (token bucket shared across every
+        endpoint, burst 2×): during a brownout the clients stop amplifying
+        load instead of multiplying it by max_retries × endpoints
+        (``rpc.retry_budget_exhausted``). ``None`` leaves retries
+        unbudgeted."""
         if not clients:
             raise ValueError("EndpointPool needs at least one client")
         self._endpoints = [EndpointState(c, i) for i, c in enumerate(clients)]
@@ -127,11 +150,26 @@ class EndpointPool:
         # pool-wide block-fetch seconds
         self._latency = Histogram(maxlen=512)  # guarded-by: _lock
         self._executor: Optional[ThreadPoolExecutor] = None  # guarded-by: _lock
+        # --- degraded (lotus_down) posture + synchronized probing ---
+        self._degraded = False  # all breakers open right now; guarded-by: _lock
+        self._probe_holder: Optional[int] = None  # endpoint index holding the pool probe slot; guarded-by: _lock
+        self._probe_not_before = 0.0  # full-jitter gate for the next pool probe; guarded-by: _lock
+        self._probe_wave = 0  # consecutive failed pool probes; guarded-by: _lock
+        self._probe_rng = random.Random(0x19C0)  # guarded-by: _lock
+        # --- pool-wide client retry budget ---
+        self._retry_rate = float(retry_budget_per_s) if retry_budget_per_s else 0.0
+        self._retry_tokens = 2.0 * self._retry_rate  # guarded-by: _lock
+        self._retry_stamp = clock()  # guarded-by: _lock
         if metrics is None:
             from ipc_proofs_tpu.utils.metrics import get_metrics
 
             metrics = get_metrics()
         self._metrics = metrics
+        if self._retry_rate > 0:
+            for ep in self._endpoints:
+                # the clients consult the shared budget before each retry
+                # sleep; a client without the hook retries as before
+                ep.client.retry_gate = self.allow_retry
 
     # ------------------------------------------------------------------
     # client facade
@@ -150,11 +188,16 @@ class EndpointPool:
         Transport failures (and exhausted-retry `RuntimeError`s from the
         underlying client) rotate to the next endpoint; a semantic
         `RpcError` is the *node answering* — it propagates immediately,
-        because every replica would say the same thing."""
+        because every replica would say the same thing. In the
+        ``lotus_down`` posture (every breaker open) a request that did not
+        win the pool's probe slot raises `DegradedError` without touching
+        any endpoint — fail fast, never a stacked retry timeout."""
         last: Optional[Exception] = None
+        attempted = 0
         for ep in self._candidates():
             if not self._begin_attempt(ep):
                 continue
+            attempted += 1
             t0 = self._clock()
             try:
                 result = ep.client.request(method, params, timeout_s=timeout_s)
@@ -169,6 +212,10 @@ class EndpointPool:
                 continue
             self._record_success(ep, self._clock() - t0, observe_latency=False)
             return result
+        if self.lotus_down:
+            if attempted == 0:
+                self._metrics.count("degraded.fail_fast")
+            raise DegradedError(method) from last
         raise RuntimeError(
             f"all {len(self._endpoints)} endpoints failed for {method}"
         ) from last
@@ -190,9 +237,11 @@ class EndpointPool:
                 sp.set_attr("hedged", True)
                 return self._hedged_read(cid, candidates)
             last: Optional[Exception] = None
+            attempted = 0
             for ep in candidates:
                 if not self._begin_attempt(ep):
                     continue
+                attempted += 1
                 try:
                     return self._read_one(ep, cid)
                 except Exception as exc:  # fail-soft: failover — _read_one already recorded the failure (and demoted on corruption); re-raised below after the last endpoint
@@ -200,6 +249,10 @@ class EndpointPool:
                     continue
             if isinstance(last, IntegrityError):
                 raise last  # every endpoint returned corrupt bytes — say so
+            if self.lotus_down:
+                if attempted == 0:
+                    self._metrics.count("degraded.fail_fast")
+                raise DegradedError(str(cid)) from last
             raise RuntimeError(
                 f"all {len(self._endpoints)} endpoints failed reading {cid}"
             ) from last
@@ -357,15 +410,49 @@ class EndpointPool:
 
     def health(self) -> dict:
         """Status summary for `/healthz`: ``"ok"`` when every breaker is
-        closed, ``"degraded"`` when any endpoint is open/half-open."""
+        closed, ``"degraded"`` when any endpoint is open/half-open; the
+        all-breakers-open posture additionally reports
+        ``"mode": "lotus_down"`` so operators (and the router) can tell
+        partial endpoint loss from a full Lotus outage."""
         with self._lock:
             eps = [ep.snapshot() for ep in self._endpoints]
+            lotus_down = self._degraded
         degraded = any(e["breaker"] != _CLOSED for e in eps)
-        return {"status": "degraded" if degraded else "ok", "endpoints": eps}
+        out = {"status": "degraded" if degraded else "ok", "endpoints": eps}
+        if lotus_down:
+            out["mode"] = "lotus_down"
+        return out
 
     @property
     def degraded(self) -> bool:
         return self.health()["status"] == "degraded"
+
+    @property
+    def lotus_down(self) -> bool:
+        """True while EVERY endpoint's breaker is open (degraded mode)."""
+        with self._lock:
+            return self._degraded
+
+    def allow_retry(self) -> bool:
+        """Spend one token from the pool-wide client retry budget.
+
+        `LotusClient._backoff` consults this before every retry sleep; a
+        dry bucket means the retry ladder stops HERE for all endpoints at
+        once — the anti-storm governor. Unbudgeted pools always allow."""
+        if self._retry_rate <= 0:
+            return True
+        now = self._clock()
+        with self._lock:
+            elapsed = max(0.0, now - self._retry_stamp)
+            self._retry_stamp = now
+            self._retry_tokens = min(
+                2.0 * self._retry_rate, self._retry_tokens + elapsed * self._retry_rate
+            )
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                return True
+        self._metrics.count("rpc.retry_budget_exhausted")
+        return False
 
     # ------------------------------------------------------------------
     # internals
@@ -382,7 +469,9 @@ class EndpointPool:
         it could serve (the others just failed too) is never refused
         outright. Excluding it entirely let one bad block on the sole
         remaining endpoint fail a whole read while a recovered-but-tripped
-        replica sat idle."""
+        replica sat idle. (In the ``lotus_down`` posture that last-resort
+        attempt additionally contends for the pool-wide probe slot — see
+        `_begin_attempt` — so one caller probes and the rest fail fast.)"""
         now = self._clock()
         eligible: list[EndpointState] = []
         tripped: list[EndpointState] = []
@@ -403,40 +492,103 @@ class EndpointPool:
     def _begin_attempt(self, ep: EndpointState) -> bool:
         """Admission check right before an actual attempt: a half-open
         breaker admits exactly one in-flight probe (cleared by the
-        attempt's `_record_success`/`_record_failure`)."""
+        attempt's `_record_success`/`_record_failure`).
+
+        In the ``lotus_down`` posture the rules tighten: EVERY attempt —
+        open-in-window last resorts and half-open probes alike — funnels
+        through ONE pool-wide probe slot + full-jitter backoff. The first
+        request after entry becomes the pool's probe (the gate starts
+        open, so the `_candidates` last-resort contract survives: work
+        that only a tripped endpoint could serve is still tried); the
+        rest fail fast typed instead of stacking timeouts on known-dead
+        nodes. N endpoints recovering together must not greet the
+        gateway with N simultaneous probes (``rpc.probe_suppressed``)."""
+        suppressed = False
         with self._lock:
-            if ep.breaker == _HALF_OPEN:
-                if ep.probe_in_flight:
-                    return False
-                ep.probe_in_flight = True
-            return True
+            if self._degraded:
+                now = self._clock()
+                if (
+                    now < self._probe_not_before
+                    or (
+                        self._probe_holder is not None
+                        and self._probe_holder != ep.index
+                    )
+                    or ep.probe_in_flight
+                ):
+                    suppressed = True
+                else:
+                    self._probe_holder = ep.index
+                    ep.probe_in_flight = True
+                    return True
+            if not suppressed:
+                if ep.breaker == _HALF_OPEN:
+                    if ep.probe_in_flight:
+                        return False
+                    ep.probe_in_flight = True
+                return True
+        self._metrics.count("rpc.probe_suppressed")
+        return False
 
     def _record_success(self, ep: EndpointState, latency_s: float, observe_latency: bool = True) -> None:
+        recovered = False
         with self._lock:
             ep.successes += 1
             ep.consecutive_failures = 0
             ep.probe_in_flight = False
+            if self._probe_holder == ep.index:
+                self._probe_holder = None
             if ep.breaker != _CLOSED:
                 ep.breaker = _CLOSED
             ep.score = (1.0 - _SCORE_ALPHA) * ep.score + _SCORE_ALPHA
             if observe_latency:
                 self._latency.observe(latency_s)
+            if self._degraded:
+                # one endpoint answering ends lotus_down — no restart,
+                # no operator action, just the probe succeeding
+                self._degraded = False
+                self._probe_wave = 0
+                self._probe_not_before = 0.0
+                recovered = True
+        if recovered:
+            self._metrics.count("degraded.exited")
 
     def _record_failure(self, ep: EndpointState, demote: bool = False) -> None:
+        entered = False
         with self._lock:
+            now = self._clock()
+            was_probe = self._degraded and self._probe_holder == ep.index
             ep.failures += 1
             ep.consecutive_failures += 1
             ep.probe_in_flight = False
+            if self._probe_holder == ep.index:
+                self._probe_holder = None
             ep.score = (1.0 - _SCORE_ALPHA) * ep.score
             tripped = demote or ep.breaker == _HALF_OPEN or (
                 ep.consecutive_failures >= self.breaker_threshold
             )
             if tripped and ep.breaker != _OPEN:
                 ep.breaker = _OPEN
-                ep.opened_at = self._clock()
+                ep.opened_at = now
                 self._metrics.count("failover.breaker_open")
             elif tripped:
-                ep.opened_at = self._clock()
+                ep.opened_at = now
+            if was_probe:
+                # failed pool probe: back the next wave off with full
+                # jitter, capped at the breaker window (never slower to
+                # recover than the per-endpoint reset already is)
+                self._probe_wave += 1
+                cap = min(
+                    max(0.0, self.breaker_reset_s),
+                    0.25 * (2.0 ** min(self._probe_wave, 8)),
+                )
+                self._probe_not_before = now + self._probe_rng.uniform(0.0, cap)
+            if not self._degraded and all(
+                e.breaker == _OPEN for e in self._endpoints
+            ):
+                self._degraded = True
+                entered = True
+        if entered:
+            self._metrics.count("degraded.entered")
 
     def _read_one(self, ep: EndpointState, cid: CID) -> Optional[bytes]:
         """Fetch + verify one block from one endpoint, recording outcome."""
@@ -490,6 +642,9 @@ class EndpointPool:
                 primary, rest = ep, candidates[i + 1:]
                 break
         if primary is None:
+            if self.lotus_down:
+                self._metrics.count("degraded.fail_fast")
+                raise DegradedError(str(cid))
             raise RuntimeError(f"no endpoint admits a read for {cid}")
         pool = self._get_executor()
         # racer threads inherit the caller's trace context so their RPC

@@ -402,11 +402,17 @@ class FetchPlane:
             return want
 
     def _await(self, want: _Want) -> Optional[bytes]:
+        from ipc_proofs_tpu.utils.deadline import checkpoint
+
         with self._cond:
             while not want.done:
                 # bounded waits so a silently-dead dispatcher surfaces as
                 # an error instead of a hang (the client's own timeouts
-                # bound how long a live dispatcher can stall)
+                # bound how long a live dispatcher can stall); the
+                # checkpoint turns a cancelled/expired request's demand
+                # wait into a typed abort instead of a worker parked on
+                # a want nobody needs anymore
+                checkpoint("fetch.demand_wait")
                 self._cond.wait(1.0)
                 if not want.done and not self._dispatchers_alive_locked():
                     raise RuntimeError("fetch plane dispatcher died")

@@ -146,6 +146,9 @@ class LotusClient:
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self.retryable_rpc_codes = retryable_rpc_codes
+        # optional pool-wide retry governor (EndpointPool.allow_retry):
+        # consulted before every retry sleep; None = retries unbudgeted
+        self.retry_gate = None
         self._rng = rng if rng is not None else random.Random()
         self._headers = {"Content-Type": "application/json"}
         if bearer_token:
@@ -211,12 +214,16 @@ class LotusClient:
                         sp.set_attr("error", str(exc))
                         raise  # semantic protocol errors are not retryable
                     last_err = exc
-                    if attempt + 1 < self.max_retries:
-                        self._backoff(method, attempt, exc)
+                    if attempt + 1 >= self.max_retries or not self._backoff(
+                        method, attempt, exc
+                    ):
+                        break
                 except Exception as exc:  # fail-soft: transport errors retry with backoff; exhausted retries re-raise below `from last_err`
                     last_err = exc
-                    if attempt + 1 < self.max_retries:
-                        self._backoff(method, attempt, exc)
+                    if attempt + 1 >= self.max_retries or not self._backoff(
+                        method, attempt, exc
+                    ):
+                        break
             self._metrics.count("rpc.failures")
             sp.set_attr("retries", self.max_retries - 1)
             sp.set_attr("error", str(last_err))
@@ -228,16 +235,46 @@ class LotusClient:
         message = (exc.message or "").lower()
         return any(marker in message for marker in _TRANSIENT_RPC_MARKERS)
 
-    def _backoff(self, method: str, attempt: int, exc: Exception) -> None:
+    def _backoff(self, method: str, attempt: int, exc: Exception) -> bool:
+        """Sleep with full jitter before the next retry attempt.
+
+        Returns False (retry ladder stops, the original error surfaces)
+        when the pool-wide retry budget is dry. Raises a typed
+        `DeadlineError` when the ambient request budget cannot cover the
+        sleep — retrying past the client's deadline just burns a node
+        that is already struggling."""
+        from ipc_proofs_tpu.utils.deadline import (
+            DeadlineError,
+            checkpoint,
+            remaining_budget_s,
+        )
         from ipc_proofs_tpu.utils.log import get_logger
 
+        # the request may have been cancelled while the failed attempt ran
+        checkpoint("rpc.retry")
+        gate = self.retry_gate
+        if gate is not None and not gate():
+            get_logger(__name__).warning(
+                "RPC %s retry stopped: pool retry budget exhausted", method
+            )
+            return False
+        bound = min(self.backoff_max_s, self.backoff_base_s * 2.0**attempt)
+        sleep_s = self._rng.uniform(0.0, bound)
+        remaining = remaining_budget_s()
+        if remaining is not None and remaining <= sleep_s:
+            self._metrics.count("deadline.rejects.rpc")
+            raise DeadlineError(
+                "RPC %s retry abandoned: %.0fms budget cannot cover "
+                "%.0fms backoff" % (method, remaining * 1000.0, sleep_s * 1000.0),
+                stage="rpc.retry",
+            ) from exc
         get_logger(__name__).warning(
             "RPC %s attempt %d/%d failed (%s) — retrying",
             method, attempt + 1, self.max_retries, exc,
         )
         self._metrics.count("rpc.retries")
-        bound = min(self.backoff_max_s, self.backoff_base_s * 2.0**attempt)
-        time.sleep(self._rng.uniform(0.0, bound))
+        time.sleep(sleep_s)
+        return True
 
     def chain_read_obj(self, cid: CID) -> Optional[bytes]:
         """Fetch one raw IPLD block (`Filecoin.ChainReadObj`) under the

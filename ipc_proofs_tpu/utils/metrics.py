@@ -39,8 +39,12 @@ __all__ = [
     "FLEET_COUNTERS",
     "SLO_COUNTERS",
     "TENANT_COUNTERS",
+    "DEADLINE_COUNTERS",
+    "ADMIT_COUNTERS",
+    "DEGRADED_COUNTERS",
     "PIPELINE_STAGES",
     "SERVE_GAUGES",
+    "ADMIT_GAUGES",
     "DURABILITY_GAUGES",
     "STOREX_GAUGES",
     "CLUSTER_GAUGES",
@@ -66,6 +70,14 @@ __all__ = [
 #                             retries): the denominator every cache/prefetch
 #                             claim is audited against — a disk-warm request
 #                             must show a delta of ZERO
+#   rpc.probe_suppressed    — half-open probes deferred because ALL breakers
+#                             are open and another endpoint already holds the
+#                             pool-wide probe slot (no probe stampede on a
+#                             recovering gateway)
+#   rpc.retry_budget_exhausted — retries skipped because the pool-wide
+#                             client retry budget (token bucket across all
+#                             endpoints) was dry — the anti-retry-storm
+#                             governor
 RESILIENCE_COUNTERS = (
     "rpc.calls",
     "rpc.retries",
@@ -74,6 +86,8 @@ RESILIENCE_COUNTERS = (
     "rpc.prefetch_failures",
     "rpc.hedges",
     "rpc.hedge_wins",
+    "rpc.probe_suppressed",
+    "rpc.retry_budget_exhausted",
     "failover.breaker_open",
     "range_scan_retries",
     "range_pipeline_serial_fallback",
@@ -452,6 +466,11 @@ WITNESS_COUNTERS = (
 #   cluster.replications_triggered — replication sync passes the router
 #                              kicked off (cluster start, membership change,
 #                              shard death re-replication to restore R)
+#   cluster.slow_quarantines — placements routed away from their affine
+#                              shard because its latency EWMA (not queue
+#                              depth) dominated the effective-load gap: the
+#                              gray-failure quarantine of a slow-not-dead
+#                              shard
 CLUSTER_COUNTERS = (
     "cluster.requests",
     "cluster.scatter_requests",
@@ -464,6 +483,7 @@ CLUSTER_COUNTERS = (
     "cluster.stream_blocks_deduped",
     "cluster.stream_cut_through",
     "cluster.replications_triggered",
+    "cluster.slow_quarantines",
 )
 
 # Stage-timer vocabulary (`Metrics.stage(...)`): every `with
@@ -495,6 +515,10 @@ SERVE_GAUGES = (
     "serve.queue_depth_push.*",  # per-batcher PUSH-priority lane depth
     "serve.result_cache_bytes",  # hot bytes in the spilled result cache
     "qos.tenant_queues",  # live per-tenant sub-queues in the fair queue
+)
+ADMIT_GAUGES = (
+    "admit.limit",  # current AIMD concurrency limit
+    "admit.inflight",  # requests holding an admission slot right now
 )
 DURABILITY_GAUGES = (
     "jobs.journal_bytes",  # bytes in the active job's write-ahead journal
@@ -608,6 +632,71 @@ TENANT_COUNTERS = (
     "tenant.bytes.*",
     "tenant.throttled.*",
     "qos.throttled",
+)
+
+# Deadline propagation + cooperative cancellation (utils/deadline.py,
+# threaded through serve/, cluster/, store/, parallel/, proofs/):
+#   serve.deadline_rejects   — requests refused because the remaining budget
+#                              could not cover the admitting hop's floor
+#                              (typed `deadline` error, never a partial
+#                              bundle)
+#   serve.cancelled_inflight — in-flight work units aborted by cooperative
+#                              cancellation (client disconnect or mid-work
+#                              expiry observed at a chunk/stage boundary)
+#   deadline.rejects.<hop>   — per-hop budget refusals (`httpd`/`batcher`/
+#                              `router`/`rpc`), so dashboards see WHERE
+#                              budget dies
+#   deadline.reclaimed_ms    — worker milliseconds freed by cancellation:
+#                              the remaining batch-execution estimate at
+#                              abort time. The overload leg's
+#                              cancel_reclaim_pct numerator.
+DEADLINE_COUNTERS = (
+    "serve.deadline_rejects",
+    "serve.cancelled_inflight",
+    "deadline.rejects.httpd",
+    "deadline.rejects.batcher",
+    "deadline.rejects.router",
+    "deadline.rejects.rpc",
+    "deadline.reclaimed_ms",
+)
+
+# Adaptive admission (serve/qos.py GradientLimiter): AIMD concurrency
+# limit driven by queue delay, replacing the static queue_capacity as the
+# serve plane's first gate.
+#   admit.accepted   — requests admitted under the current limit
+#   admit.rejects    — requests shed at the limit (typed 429, honest
+#                      Retry-After from the drain estimate)
+#   admit.shed_other — rejects absorbed by the `other` tenant pool while
+#                      named top-K tenants still fit their share (the
+#                      tenant-aware shed order)
+#   admit.grows      — additive limit increases (queue delay under budget)
+#   admit.shrinks    — multiplicative limit decreases (p99 queue delay
+#                      crossed the SLO-derived budget)
+ADMIT_COUNTERS = (
+    "admit.accepted",
+    "admit.rejects",
+    "admit.shed_other",
+    "admit.grows",
+    "admit.shrinks",
+)
+
+# Degraded serve modes (store/failover.py + serve/service.py): the
+# all-Lotus-endpoints-down posture where warm-tier-answerable requests
+# still serve bit-identical and cold requests fail fast typed.
+#   degraded.entered    — transitions into `lotus_down` (SLO anomaly
+#                         signature fires on this delta)
+#   degraded.exited     — recoveries out of the mode (a probe succeeded;
+#                         no restart required)
+#   degraded.warm_served— requests answered entirely from the tiered disk
+#                         store / replica peers while degraded (audited
+#                         with rpc.calls delta == 0)
+#   degraded.fail_fast  — cold requests refused typed `degraded` instead
+#                         of timing out through the retry ladder
+DEGRADED_COUNTERS = (
+    "degraded.entered",
+    "degraded.exited",
+    "degraded.warm_served",
+    "degraded.fail_fast",
 )
 
 # Lazily-bound obs.trace.span factory: `Metrics.stage()` opens a span per

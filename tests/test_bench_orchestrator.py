@@ -249,6 +249,19 @@ _HOSTKILL_OK = {
     "hostkill_failovers": 2,
 }
 
+_OVERLOAD_OK = {
+    "goodput_ratio_at_2x": 0.97,
+    "shed_rate": 0.41,
+    "light_tenant_p99_ms_overload": 18.5,
+    "cancel_reclaim_pct": 62.0,
+    "overload_capacity_rps": 540.0,
+    "overload_goodput_rps": 1048.0,
+    "overload_requests": 1270,
+    "overload_doomed_requests": 54,
+    "overload_admit_limit_final": 61,
+    "overload_host_cpus": 4,
+}
+
 _BACKFILL_OK = {
     "backfill_epochs_per_sec": 95.0,
     "backfill_epochs_per_sec_1shard": 30.0,
@@ -298,6 +311,7 @@ class TestOrchestrate:
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
             "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
+            "overload": [(dict(_OVERLOAD_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -373,6 +387,7 @@ class TestOrchestrate:
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
             "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
+            "overload": [(dict(_OVERLOAD_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -388,7 +403,7 @@ class TestOrchestrate:
             ("observability", "cpu"), ("storage", "cpu"),
             ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
             ("fleetobs", "cpu"), ("backfill", "cpu"), ("zerocopy", "cpu"),
-            ("hostkill", "cpu"),
+            ("hostkill", "cpu"), ("overload", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -412,6 +427,7 @@ class TestOrchestrate:
             "backfill": [(dict(_BACKFILL_OK), "ok:cpu")],
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
             "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
+            "overload": [(dict(_OVERLOAD_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -466,6 +482,7 @@ class TestOrchestrate:
             "backfill": [(None, "error:cpu")],
             "zerocopy": [(None, "error:cpu")],
             "hostkill": [(None, "error:cpu")],
+            "overload": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -497,6 +514,9 @@ class TestOrchestrate:
             "zerocopy_bytes_per_resp",
             "aggregate_proofs_per_sec_2host", "replica_repair_hit_rate",
             "kill_recovery_ms",
+            "goodput_ratio_at_2x", "shed_rate",
+            "light_tenant_p99_ms_overload", "cancel_reclaim_pct",
+            "overload_capacity_rps", "overload_goodput_rps",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

@@ -62,7 +62,7 @@ class TestChaosDifferential:
         import random
 
         from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_pipelined
-        from ipc_proofs_tpu.store.failover import EndpointPool
+        from ipc_proofs_tpu.store.failover import DegradedError, EndpointPool
         from ipc_proofs_tpu.store.faults import FaultySession, LocalLotusSession
         from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcBlockstore
         from ipc_proofs_tpu.utils.metrics import Metrics
@@ -92,8 +92,11 @@ class TestChaosDifferential:
                     scan_threads=1, scan_retries=2, force_pipeline=True,
                     metrics=m,
                 )
-            except IntegrityError:
-                continue  # typed refusal is always acceptable
+            except (IntegrityError, DegradedError):
+                # typed refusal is always acceptable — IntegrityError when
+                # every endpoint served corrupt bytes, DegradedError when
+                # the flips tripped every breaker (lotus_down fail-fast)
+                continue
             finally:
                 pool.close()
             completed += 1

@@ -120,3 +120,20 @@ def test_single_compaction_kill_point_detail(tmp_path):
         reference, shape, "torn_tmp", str(tmp_path), tag="t", torn_bytes=7
     )
     assert res["outcome"] == "identical", res
+
+
+@pytest.mark.parametrize("seed", [20260807])
+def test_sigterm_grid_backfill_and_stream(seed):
+    """SIGTERM — the orchestrator-preemption signal — at both surfaces:
+
+    - at an in-flight backfill window commit (later windows un-run), the
+      resumed engine must replay every committed window and produce the
+      byte-identical bundle, exactly as after a SIGKILL;
+    - mid-IPBS-stream, the committed prefix left on the wire must decode
+      to a typed `WitnessError` (torn frame / open document), never parse
+      as a complete document."""
+    summary = crashtest.run_sigterm_grid(seed)
+    assert summary["ok"], summary["violations"]
+    assert summary["counts"].get("identical", 0) == len(summary["backfill_points"])
+    assert summary["counts"].get("typed_tear", 0) == len(summary["stream_points"])
+    assert "silent_partial" not in summary["counts"]

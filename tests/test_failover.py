@@ -368,3 +368,110 @@ class TestServiceHealth:
             assert health["endpoints"][0]["breaker"] == "open"
         finally:
             service.drain()
+
+
+class TestDegradedMode:
+    """The ``lotus_down`` posture: every breaker open ⇒ typed fail-fast
+    (`DegradedError`), ONE synchronized half-open probe behind a jittered
+    backoff gate, and in-place recovery the moment a probe lands."""
+
+    def _down_pool(self, store):
+        from ipc_proofs_tpu.utils.metrics import Metrics
+
+        s0, s1 = _Switchable(store, "dead"), _Switchable(store, "dead")
+        m = Metrics()
+        pool, clock = _pool([s0, s1], breaker_threshold=1, metrics=m)
+        return pool, clock, (s0, s1), m
+
+    def test_entry_is_typed_and_counted(self):
+        from ipc_proofs_tpu.store.failover import DegradedError
+
+        store, cid, _ = _world()
+        pool, _, _, m = self._down_pool(store)
+        with pytest.raises(DegradedError) as exc:
+            pool.chain_read_obj(cid)
+        assert exc.value.error_type == "degraded"
+        assert pool.lotus_down
+        assert m.snapshot()["counters"]["degraded.entered"] == 1
+        assert pool.health()["mode"] == "lotus_down"
+
+    def test_single_probe_rest_suppressed_fail_fast(self):
+        from ipc_proofs_tpu.store.failover import DegradedError
+
+        store, cid, _ = _world()
+        pool, _, (s0, s1), m = self._down_pool(store)
+        with pytest.raises(DegradedError):
+            pool.chain_read_obj(cid)  # enters lotus_down
+        # the gate starts open: exactly ONE endpoint attempt (the pool
+        # probe) goes out, the other is suppressed — and it fails, arming
+        # the jittered backoff window
+        calls0 = s0.calls + s1.calls
+        with pytest.raises(DegradedError):
+            pool.chain_read_obj(cid)
+        assert (s0.calls + s1.calls) == calls0 + 1
+        c = m.snapshot()["counters"]
+        assert c["rpc.probe_suppressed"] >= 1
+        # inside the backoff window NOTHING reaches an endpoint: pure
+        # typed fail-fast (this is what keeps a dead upstream cheap)
+        calls1 = s0.calls + s1.calls
+        with pytest.raises(DegradedError):
+            pool.chain_read_obj(cid)
+        assert (s0.calls + s1.calls) == calls1
+        assert m.snapshot()["counters"]["degraded.fail_fast"] >= 1
+
+    def test_probe_success_recovers_without_restart(self):
+        from ipc_proofs_tpu.store.failover import DegradedError
+
+        store, cid, raw = _world()
+        pool, clock, (s0, s1), m = self._down_pool(store)
+        with pytest.raises(DegradedError):
+            pool.chain_read_obj(cid)
+        with pytest.raises(DegradedError):
+            pool.chain_read_obj(cid)  # failed probe → backoff armed
+        s0.mode = s1.mode = "ok"
+        clock.advance(31.0)  # past breaker reset AND any probe jitter
+        assert pool.chain_read_obj(cid) == raw
+        assert not pool.lotus_down
+        c = m.snapshot()["counters"]
+        assert c["degraded.exited"] == 1
+        assert pool.health()["status"] in ("ok", "degraded")
+        assert pool.health().get("mode") != "lotus_down"
+
+
+class TestRetryBudget:
+    def test_pool_budget_stops_the_retry_ladder(self):
+        """A pool-wide retries/second budget: once dry, every client's
+        backoff ladder stops immediately (anti-retry-storm governor)."""
+        from ipc_proofs_tpu.utils.metrics import Metrics
+
+        store, cid, _ = _world()
+        dead = _Switchable(store, "dead")
+        clock = _Clock()
+        m = Metrics()
+        client = LotusClient(
+            "http://ep", session=dead, max_retries=4,
+            backoff_base_s=0.0, backoff_max_s=0.0,
+        )
+        pool = EndpointPool(
+            [client], clock=clock, breaker_threshold=10,
+            retry_budget_per_s=1.0, metrics=m,
+        )
+        # budget = 2·rate tokens with a frozen clock: the first two retry
+        # sleeps spend them, the third is refused — 3 attempts total, not
+        # max_retries' 4
+        with pytest.raises(RuntimeError):
+            pool.chain_read_obj(cid)
+        assert dead.calls == 3
+        assert m.snapshot()["counters"]["rpc.retry_budget_exhausted"] >= 1
+
+    def test_unbudgeted_pool_retries_in_full(self):
+        store, cid, _ = _world()
+        dead = _Switchable(store, "dead")
+        client = LotusClient(
+            "http://ep", session=dead, max_retries=4,
+            backoff_base_s=0.0, backoff_max_s=0.0,
+        )
+        pool = EndpointPool([client], clock=_Clock(), breaker_threshold=10)
+        with pytest.raises(RuntimeError):
+            pool.chain_read_obj(cid)
+        assert dead.calls == 4

@@ -22,7 +22,7 @@ from ipc_proofs_tpu.proofs.range import (
     generate_event_proofs_for_range_pipelined,
 )
 from ipc_proofs_tpu.store.blockstore import MemoryBlockstore
-from ipc_proofs_tpu.store.failover import EndpointPool
+from ipc_proofs_tpu.store.failover import DegradedError, EndpointPool
 from ipc_proofs_tpu.store.faults import FaultPlan, FaultySession, LocalLotusSession
 from ipc_proofs_tpu.store.fetchplane import FetchPlane, PlaneBlockstore, _child_links
 from ipc_proofs_tpu.store.rpc import (
@@ -931,8 +931,11 @@ class TestChaosBatched:
                     metrics=m, scan_threads=1, scan_retries=2,
                     force_pipeline=True,
                 )
-            except IntegrityError:
-                continue  # typed refusal is always acceptable
+            except (IntegrityError, DegradedError):
+                # typed refusal is always acceptable — IntegrityError when
+                # every endpoint served corrupt bytes, DegradedError when
+                # the flips tripped every breaker (lotus_down fail-fast)
+                continue
             finally:
                 plane.close()
                 pool.close()

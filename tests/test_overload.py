@@ -1,0 +1,441 @@
+"""Overload-survival acceptance tests: the deadline differential grid
+(deadline shape × path × door → byte-identical bundle or typed
+``deadline`` error, never a silently partial one), cooperative
+cancellation reclaiming queued work on client disconnect, and degraded
+serve mode (every upstream breaker open → warm-tier requests still
+bit-identical with ZERO rpc calls, cold requests fail fast typed
+``degraded``, recovery without a restart). All hermetic and tier-1."""
+
+import base64
+import json
+import socket
+import time
+
+import pytest
+
+from http.client import HTTPConnection
+
+from ipc_proofs_tpu.cluster import ClusterRouter, LocalShard
+from ipc_proofs_tpu.cluster.router import RouterHTTPServer
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.serve import ProofService, ServiceConfig
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.store.blockstore import (
+    CachedBlockstore,
+    MemoryBlockstore,
+    RecordingBlockstore,
+)
+from ipc_proofs_tpu.store.failover import DegradedError, EndpointPool
+from ipc_proofs_tpu.store.faults import LocalLotusSession
+from ipc_proofs_tpu.store.rpc import LotusClient, RpcBlockstore
+from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.witness.stream import (
+    STREAM_CONTENT_TYPE,
+    StreamAbortError,
+    decode_bundle_stream,
+)
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+
+# per-request envelope fields — not part of the proof payload, legitimately
+# vary run to run (batch coalescing, timing, trace ids)
+_ENVELOPE = ("trace_id", "server_timing", "batch_size")
+
+# every refusal the serve plane may answer with under deadline pressure;
+# anything else (or a divergent 200) is a grid violation
+_TYPED_DEADLINE = {"deadline", "cancelled"}
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        4, receipts_per_pair=6, events_per_receipt=3, match_rate=0.5,
+        signature=SIG, topic1=SUBNET, actor_id=ACTOR, base_height=61_000,
+    )
+
+
+def _spec():
+    return EventProofSpec(
+        event_signature=SIG, topic_1=SUBNET, actor_id_filter=ACTOR
+    )
+
+
+def _canon(doc: dict) -> str:
+    payload = {k: v for k, v in doc.items() if k not in _ENVELOPE}
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+
+def _post(port, path, obj, headers=None, timeout=60):
+    conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", path, json.dumps(obj), hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    ctype = resp.headers.get("Content-Type", "")
+    conn.close()
+    return resp.status, ctype, data
+
+
+def _get(port, path):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data)
+
+
+# --------------------------------------------------------------------------
+# the deadline differential grid
+# --------------------------------------------------------------------------
+
+# deadline shapes: ample must succeed; tight (below the 5 ms admission
+# floor) must refuse at the door; mid may land either way depending on the
+# host's speed — the grid's law is the DICHOTOMY, not the outcome
+AMPLE_MS = 60_000.0
+TIGHT_MS = 1.0
+MID_MS = 25.0
+
+
+def _classify(status, ctype, data, reference):
+    """Map one grid response to its verdict: ``identical`` (200, payload
+    byte-equal to the fault-free reference), ``typed`` (a deadline-family
+    refusal, buffered 504 or in-stream abort), or a violation string."""
+    if STREAM_CONTENT_TYPE in ctype:
+        try:
+            doc = decode_bundle_stream(data)
+        except StreamAbortError as exc:
+            if exc.remote_error_type in _TYPED_DEADLINE:
+                return "typed"
+            return f"stream abort with wrong type: {exc.remote_error_type}"
+        if status != 200:
+            return f"streamed non-200: {status}"
+        if _canon(doc) != reference:
+            return "divergent streamed bundle"
+        return "identical"
+    if status == 200:
+        if _canon(json.loads(data)) != reference:
+            return "divergent buffered bundle"
+        return "identical"
+    obj = json.loads(data)
+    if status == 504 and obj.get("error_type") in _TYPED_DEADLINE:
+        return "typed"
+    return f"untyped refusal: {status} {obj}"
+
+
+class TestDeadlineGridSingleDaemon:
+    @pytest.fixture(scope="class")
+    def server(self, world):
+        store, pairs, _ = world
+        service = ProofService(
+            store=store, spec=_spec(),
+            config=ServiceConfig(max_batch=8, max_wait_ms=2.0, workers=2),
+        )
+        httpd = ProofHTTPServer(service, pairs=pairs).start()
+        yield httpd, service
+        httpd.shutdown(timeout=30)
+
+    @pytest.fixture(scope="class")
+    def references(self, server):
+        """Fault-free per-(pair, door) canonical payloads."""
+        httpd, _ = server
+        refs = {}
+        for i in range(2):
+            st, _, data = _post(httpd.port, "/v1/generate", {"pair_index": i})
+            assert st == 200, data[:200]
+            refs[(i, "buffered")] = _canon(json.loads(data))
+            st, ctype, data = _post(
+                httpd.port, "/v1/generate", {"pair_index": i, "stream": True}
+            )
+            assert st == 200 and STREAM_CONTENT_TYPE in ctype
+            refs[(i, "stream")] = _canon(decode_bundle_stream(data))
+        return refs
+
+    @pytest.mark.parametrize("door", ["buffered", "stream"])
+    @pytest.mark.parametrize(
+        "deadline_ms,expect",
+        [(AMPLE_MS, {"identical"}), (TIGHT_MS, {"typed"}),
+         (MID_MS, {"identical", "typed"})],
+        ids=["ample", "tight", "mid-expiry"],
+    )
+    def test_grid_identical_or_typed(
+        self, server, references, door, deadline_ms, expect
+    ):
+        httpd, _ = server
+        for i in range(2):
+            body = {"pair_index": i, "deadline_ms": deadline_ms}
+            if door == "stream":
+                body["stream"] = True
+            st, ctype, data = _post(httpd.port, "/v1/generate", body)
+            verdict = _classify(st, ctype, data, references[(i, door)])
+            assert verdict in expect, (door, deadline_ms, i, verdict)
+
+    def test_header_carries_the_budget_too(self, server, references):
+        """``X-IPC-Deadline-Ms`` is the same contract as the body field:
+        tight refuses typed at the door, ample succeeds identically."""
+        httpd, service = server
+        rejects0 = service.metrics_snapshot()["counters"].get(
+            "serve.deadline_rejects", 0
+        )
+        st, _, data = _post(
+            httpd.port, "/v1/generate", {"pair_index": 0},
+            headers={"X-IPC-Deadline-Ms": "1"},
+        )
+        assert st == 504
+        assert json.loads(data)["error_type"] == "deadline"
+        st, _, data = _post(
+            httpd.port, "/v1/generate", {"pair_index": 0},
+            headers={"X-IPC-Deadline-Ms": "60000"},
+        )
+        assert st == 200
+        assert _canon(json.loads(data)) == references[(0, "buffered")]
+        c = service.metrics_snapshot()["counters"]
+        assert c.get("serve.deadline_rejects", 0) > rejects0
+        assert c.get("deadline.rejects.httpd", 0) >= 1
+
+
+class TestDeadlineGridRouter:
+    @pytest.fixture(scope="class")
+    def cluster(self, world):
+        store, pairs, _ = world
+        shards = [
+            LocalShard(f"s{i}", store, pairs, _spec()).start()
+            for i in range(2)
+        ]
+        router = ClusterRouter({s.name: s.url for s in shards}, pairs)
+        server = RouterHTTPServer(router).start()
+        yield server, router
+        server.shutdown(timeout=30)
+        for s in shards:
+            try:
+                s.stop(timeout=10)
+            except Exception:
+                pass
+
+    @pytest.fixture(scope="class")
+    def references(self, cluster):
+        server, _ = cluster
+        body = {"pair_indexes": [0, 1, 2, 3], "chunk_size": 2}
+        st, _, data = _post(server.port, "/v1/generate_range", body)
+        assert st == 200, data[:200]
+        refs = {"buffered": _canon(json.loads(data)["bundle"])}
+        st, ctype, data = _post(
+            server.port, "/v1/generate_range", dict(body, stream=True)
+        )
+        assert st == 200 and STREAM_CONTENT_TYPE in ctype
+        doc = decode_bundle_stream(data)
+        refs["stream"] = _canon(doc)
+        return refs
+
+    def _classify_range(self, st, ctype, data, reference):
+        if STREAM_CONTENT_TYPE in ctype:
+            try:
+                doc = decode_bundle_stream(data)
+            except StreamAbortError as exc:
+                if exc.remote_error_type in _TYPED_DEADLINE:
+                    return "typed"
+                return f"stream abort with wrong type: {exc.remote_error_type}"
+            if _canon(doc) != reference:
+                return "divergent streamed bundle"
+            return "identical"
+        obj = json.loads(data)
+        if st == 200:
+            if _canon(obj["bundle"]) != reference:
+                return "divergent buffered bundle"
+            return "identical"
+        if st == 504 and obj.get("error_type") in _TYPED_DEADLINE:
+            return "typed"
+        return f"untyped refusal: {st} {obj}"
+
+    @pytest.mark.parametrize("door", ["buffered", "stream"])
+    @pytest.mark.parametrize(
+        "deadline_ms,expect",
+        [(AMPLE_MS, {"identical"}), (TIGHT_MS, {"typed"}),
+         (MID_MS, {"identical", "typed"})],
+        ids=["ample", "tight", "mid-expiry"],
+    )
+    def test_grid_identical_or_typed(
+        self, cluster, references, door, deadline_ms, expect
+    ):
+        server, _ = cluster
+        body = {
+            "pair_indexes": [0, 1, 2, 3], "chunk_size": 2,
+            "deadline_ms": deadline_ms,
+        }
+        if door == "stream":
+            body["stream"] = True
+        st, ctype, data = _post(server.port, "/v1/generate_range", body)
+        verdict = self._classify_range(st, ctype, data, references[door])
+        assert verdict in expect, (door, deadline_ms, verdict)
+
+    def test_router_floor_reject_is_counted(self, cluster, references):
+        server, router = cluster
+        st, _, data = _post(
+            server.port, "/v1/generate_range",
+            {"pair_indexes": [0], "deadline_ms": 1},
+        )
+        assert st == 504
+        assert json.loads(data)["error_type"] == "deadline"
+        c = router.metrics.snapshot()["counters"]
+        assert c.get("serve.deadline_rejects", 0) >= 1
+        assert c.get("deadline.rejects.router", 0) >= 1
+
+
+# --------------------------------------------------------------------------
+# cooperative cancellation: a dead client's queued work is reclaimed
+# --------------------------------------------------------------------------
+
+class TestDisconnectCancellation:
+    def test_disconnect_while_queued_reclaims_the_slot(self, world):
+        """Send a generate request, hang up before the batch window
+        closes: the disconnect watcher cancels the scope and the batcher
+        drops the request at dispatch (``serve.cancelled_inflight``)
+        instead of generating into a dead socket."""
+        store, pairs, _ = world
+        service = ProofService(
+            store=store, spec=_spec(),
+            config=ServiceConfig(max_batch=8, max_wait_ms=400.0, workers=1),
+        )
+        httpd = ProofHTTPServer(service, pairs=pairs).start()
+        try:
+            body = json.dumps({"pair_index": 0}).encode()
+            sock = socket.create_connection(("127.0.0.1", httpd.port), timeout=10)
+            sock.sendall(
+                b"POST /v1/generate HTTP/1.1\r\n"
+                b"Host: localhost\r\nContent-Type: application/json\r\n"
+                b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+            )
+            time.sleep(0.05)  # let the handler enqueue it
+            sock.close()  # ...then vanish while it's still queued
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                c = service.metrics_snapshot()["counters"]
+                if c.get("serve.cancelled_inflight", 0) >= 1:
+                    break
+                time.sleep(0.02)
+            c = service.metrics_snapshot()["counters"]
+            assert c.get("serve.cancelled_inflight", 0) >= 1
+            assert c.get("deadline.reclaimed_ms", 0) >= 1
+        finally:
+            httpd.shutdown(timeout=30)
+
+
+# --------------------------------------------------------------------------
+# degraded serve mode: lotus_down end to end
+# --------------------------------------------------------------------------
+
+class _FlippableSession:
+    """A LocalLotusSession that can be killed and revived mid-test."""
+
+    def __init__(self, store, dead=True):
+        self._inner = LocalLotusSession(store)
+        self.dead = dead
+        self.calls = 0
+
+    def post(self, url, data=None, headers=None, timeout=None):
+        self.calls += 1
+        if self.dead:
+            raise ConnectionError("endpoint down")
+        return self._inner.post(url, data=data, headers=headers, timeout=timeout)
+
+
+class TestDegradedServe:
+    def _build(self, world):
+        """A serve plane whose store is warm for pair 0 only, with every
+        upstream endpoint initially dead."""
+        full_store, pairs, _ = world
+        # record exactly the blocks pair 0's generation touches — that set
+        # IS the warm tier
+        recording = RecordingBlockstore(full_store)
+        probe = ProofService(store=recording, spec=_spec())
+        try:
+            reference = probe.submit_generate(pairs[0]).result(timeout=60)
+        finally:
+            probe.drain()
+        warm = {
+            cid: full_store.get(cid) for cid in recording.peek_seen()
+        }
+        sessions = [
+            _FlippableSession(full_store), _FlippableSession(full_store)
+        ]
+        metrics = Metrics()
+        pool = EndpointPool(
+            [
+                LotusClient("http://ep", session=s, max_retries=1)
+                for s in sessions
+            ],
+            breaker_threshold=1, breaker_reset_s=0.05, metrics=metrics,
+        )
+        serve_store = CachedBlockstore(
+            RpcBlockstore(pool, metrics=metrics), shared_cache=dict(warm)
+        )
+        service = ProofService(
+            store=serve_store, spec=_spec(), metrics=metrics,
+            endpoint_pool=pool,
+            config=ServiceConfig(max_batch=2, max_wait_ms=1.0, workers=1),
+        )
+        return service, pool, sessions, reference, warm
+
+    def test_warm_identical_cold_typed_then_recovery(self, world):
+        _, pairs, _ = world
+        service, pool, sessions, reference, warm = self._build(world)
+        httpd = ProofHTTPServer(service, pairs=pairs).start()
+        try:
+            # enter lotus_down: one pool read trips both dead endpoints
+            some_cid = next(iter(warm))
+            with pytest.raises((DegradedError, RuntimeError)):
+                pool.chain_read_obj(some_cid)
+            assert pool.lotus_down
+            st, health = _get(httpd.port, "/healthz")
+            assert health["status"] == "degraded"
+            assert health.get("mode") == "lotus_down"
+
+            # warm request: bit-identical, zero upstream calls
+            calls0 = sum(s.calls for s in sessions)
+            st, _, data = _post(httpd.port, "/v1/generate", {"pair_index": 0})
+            assert st == 200
+            got = json.loads(data)
+            assert (
+                [p["child_block_cid"] for p in got["bundle"]["event_proofs"]]
+                == [p.child_block_cid for p in reference.bundle.event_proofs]
+            )
+            assert sum(s.calls for s in sessions) == calls0  # rpc.calls == 0
+            c = service.metrics_snapshot()["counters"]
+            assert c.get("degraded.warm_served", 0) >= 1
+
+            # cold request: typed `degraded`, fast — never a stacked
+            # retry-timeout wait
+            t0 = time.monotonic()
+            st, _, data = _post(httpd.port, "/v1/generate", {"pair_index": 1})
+            elapsed = time.monotonic() - t0
+            assert st == 503
+            assert json.loads(data)["error_type"] == "degraded"
+            assert elapsed < 1.0
+
+            # recovery: endpoints come back; the next probe that the
+            # backoff gate admits closes the loop — no restart
+            for s in sessions:
+                s.dead = False
+            deadline = time.monotonic() + 10
+            st = None
+            while time.monotonic() < deadline:
+                st, _, data = _post(
+                    httpd.port, "/v1/generate", {"pair_index": 1}
+                )
+                if st == 200:
+                    break
+                time.sleep(0.05)
+            assert st == 200, data[:200]
+            assert not pool.lotus_down
+            c = service.metrics_snapshot()["counters"]
+            assert c.get("degraded.entered", 0) >= 1
+            assert c.get("degraded.exited", 0) >= 1
+            st, health = _get(httpd.port, "/healthz")
+            assert health["status"] in ("ok", "degraded")
+            assert health.get("mode") != "lotus_down"
+        finally:
+            httpd.shutdown(timeout=30)

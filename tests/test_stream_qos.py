@@ -551,3 +551,59 @@ class TestNegotiation:
 
         with pytest.raises(WitnessEncodingError):
             negotiate_stream({"stream": "yes"})
+
+
+class TestHonestRetryAfter:
+    """The 429's ``Retry-After`` is a real estimate, not a constant: the
+    bucket's exact refill time, and waiting it out actually admits."""
+
+    def test_bucket_retry_after_is_the_refill_time(self):
+        b = TokenBucket(rate=4.0, burst=1.0, now=50.0)
+        ok, _ = b.take(50.0)
+        assert ok
+        ok2, retry = b.take(50.0)
+        assert not ok2
+        # one token at 4/s from an empty bucket: exactly 0.25 s
+        assert retry == pytest.approx(0.25, rel=1e-9)
+        # honesty cuts both ways: just before the estimate still refuses,
+        # at the estimate admits
+        early_ok, early_retry = b.take(50.0 + retry * 0.5)
+        assert not early_ok and early_retry > 0
+        ok3, _ = b.take(50.0 + retry)
+        assert ok3
+
+    def test_http_door_retry_after_admits_when_honored(self, world):
+        store, pairs, _ = world
+        svc = ProofService(
+            store=store,
+            spec=EventProofSpec(event_signature=SIG, topic_1=SUBNET),
+            config=ServiceConfig(
+                max_batch=8, max_wait_ms=5.0, workers=2,
+                tenant_rate=5.0, tenant_burst=1.0,
+            ),
+        )
+        httpd = ProofHTTPServer(svc, pairs=pairs).start()
+        try:
+            st, _, _ = _post(
+                httpd.port, "/v1/generate",
+                {"pair_index": 0, "tenant": "honest"},
+            )
+            assert st == 200
+            st, hdrs, out = _post(
+                httpd.port, "/v1/generate",
+                {"pair_index": 0, "tenant": "honest"},
+            )
+            assert st == 429 and out["error_type"] == "tenant_throttled"
+            # the estimate is the refill time (≤ 1/rate from empty), not
+            # some pessimistic constant — and the header rounds it UP so
+            # a naive client never retries early
+            assert 0.0 < out["retry_after_s"] <= 1.0 / 5.0 + 0.05
+            assert int(hdrs["Retry-After"]) >= 1
+            time.sleep(out["retry_after_s"] + 0.02)
+            st, _, _ = _post(
+                httpd.port, "/v1/generate",
+                {"pair_index": 0, "tenant": "honest"},
+            )
+            assert st == 200  # honoring the hint admits on the first try
+        finally:
+            httpd.shutdown(timeout=30)

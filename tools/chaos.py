@@ -429,6 +429,124 @@ def run_shard_grid(
     }
 
 
+# ---------------------------------------------------------------------------
+# Slow-not-dead shard: a member that answers CORRECTLY but slowly must be
+# quarantined by the router's latency-EWMA placement penalty (traffic
+# steered away, `cluster.slow_quarantines` counted) without ever being
+# marked dead — and every response must stay byte-identical (no faults
+# are injected, only delay).
+# ---------------------------------------------------------------------------
+
+
+class SlowShardClient(ShardClient):
+    """`ShardClient` that answers correctly after a fixed delay —
+    slow-not-dead. The router must learn this through its dispatch-latency
+    EWMA, not through failures (there are none)."""
+
+    def __init__(self, name, base_url, delay_s: float, **kw):
+        super().__init__(name, base_url, **kw)
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def post(self, path, body):
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return super().post(path, body)
+
+    def post_stream(self, path, body):
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return super().post_stream(path, body)
+
+
+def run_slow_shard_grid(
+    base_seed: int,
+    rounds: int = 10,
+    n_pairs: int = 6,
+    delay_s: float = 0.02,
+    log=lambda msg: None,
+) -> dict:
+    """Repeated buffered range requests against a 2-shard cluster where one
+    shard is slow-not-dead. Verdict requires all three:
+
+    - every response byte-identical to the fault-free reference (delay is
+      not a fault — nothing may diverge or error),
+    - ``cluster.slow_quarantines`` > 0 (the latency-EWMA term, not raw
+      queue depth, drove placement off the slow shard at least once),
+    - the slow shard is still alive at the end (quarantine ≠ death)."""
+    shards, pairs, reference = build_shard_world(n_pairs=n_pairs, n_shards=2)
+    metrics = Metrics()
+    slow_name = shards[0].name
+    clients = {
+        s.name: (
+            SlowShardClient(s.name, s.url, delay_s)
+            if s.name == slow_name
+            else ShardClient(s.name, s.url)
+        )
+        for s in shards
+    }
+    router = ClusterRouter(
+        clients,
+        pairs,
+        metrics=metrics,
+        scrape_interval_s=60.0,
+        # one queue slot ≈ 2ms of latency: a 20ms-slow shard looks ~10
+        # slots deep, comfortably past the steal threshold, while its raw
+        # inflight stays 0 in this sequential driver — exactly the
+        # EWMA-driven quarantine signature
+        steal_threshold=3,
+        steal_latency_unit_s=delay_s / 10.0,
+    )
+    idxs = list(range(len(pairs)))
+    divergent = 0
+    errors = []
+    try:
+        for r in range(rounds):
+            try:
+                status, obj = router.generate_range(idxs, chunk_size=2)
+            except TYPED_ERRORS as exc:
+                errors.append(f"{type(exc).__name__}: {exc}")
+                continue
+            if status != 200:
+                errors.append(f"http {status}: {obj.get('error', '?')}")
+                continue
+            if json.dumps(obj["bundle"], sort_keys=True) != reference:
+                divergent += 1
+            snap = metrics.snapshot()["counters"]
+            log(
+                f"slow-shard round={r}: quarantines="
+                f"{snap.get('cluster.slow_quarantines', 0)} "
+                f"steals={snap.get('cluster.steals', 0)}"
+            )
+        _, health = router.cluster_status()
+        slow_alive = bool(health["ring"].get(slow_name, {}).get("alive"))
+    finally:
+        router.close()
+        for s in shards:
+            try:
+                s.stop(timeout=10)
+            except Exception:  # fail-soft: best-effort teardown must not mask the verdict
+                pass
+    counters = metrics.snapshot()["counters"]
+    quarantines = counters.get("cluster.slow_quarantines", 0)
+    ok = (
+        divergent == 0
+        and not errors
+        and quarantines > 0
+        and slow_alive
+    )
+    return {
+        "ok": ok,
+        "rounds": rounds,
+        "divergent": divergent,
+        "errors": errors,
+        "slow_quarantines": quarantines,
+        "steals": counters.get("cluster.steals", 0),
+        "slow_shard_alive": slow_alive,
+        "slow_shard_calls": clients[slow_name].calls,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("seed", type=int, help="base seed for the fault grid")
@@ -444,6 +562,12 @@ def main(argv=None) -> int:
         help="chaos the CLUSTER shard transport (drop/delay/truncate over "
         "shard HTTP, buffered and streamed doors) instead of the RPC stack",
     )
+    ap.add_argument(
+        "--slow-shard", action="store_true",
+        help="slow-not-dead shard: verify the router's latency-EWMA "
+        "quarantine steers traffic away (cluster.slow_quarantines) while "
+        "every response stays byte-identical and the shard stays alive",
+    )
     args = ap.parse_args(argv)
 
     runs = 5 if args.quick and args.runs == 20 else args.runs
@@ -451,7 +575,12 @@ def main(argv=None) -> int:
     rates = tuple(args.fault_rate) if args.fault_rate else (0.05, 0.3, 0.6)
 
     t0 = time.time()
-    if args.shards:
+    if args.slow_shard:
+        summary = run_slow_shard_grid(
+            args.seed, rounds=max(4, min(runs, 10)), n_pairs=6,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+    elif args.shards:
         summary = run_shard_grid(
             args.seed, runs=min(runs, 5), fault_rates=rates, n_pairs=6,
             log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),

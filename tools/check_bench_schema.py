@@ -42,7 +42,10 @@ flags. Two strictness levels:
   0.99``, and ``aggregate_proofs_per_sec_2host > 0`` whenever
   ``host_cores > 2`` (on smaller hosts the shards, load clients, and
   recovery probe time-slice the same core — see
-  `hostkill_gate_skip_reason`).
+  `hostkill_gate_skip_reason`), and the overload gates
+  ``goodput_ratio_at_2x >= 0.8`` and ``cancel_reclaim_pct > 0`` whenever
+  ``host_cores > 2`` (on smaller hosts the 2× closed-loop clients
+  time-slice the server's only cores — see `overload_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -205,6 +208,16 @@ _KNOWN_TYPES = {
     "hostkill_pairs": int,
     "hostkill_requests": int,
     "hostkill_failovers": int,
+    "goodput_ratio_at_2x": _NUM,
+    "shed_rate": _NUM,
+    "light_tenant_p99_ms_overload": _NUM,
+    "cancel_reclaim_pct": _NUM,
+    "overload_capacity_rps": _NUM,
+    "overload_goodput_rps": _NUM,
+    "overload_requests": int,
+    "overload_doomed_requests": int,
+    "overload_admit_limit_final": _NUM,
+    "overload_host_cpus": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -250,6 +263,8 @@ _CURRENT_REQUIRED = (
     "qos_light_tenant_p99_ms",
     "aggregate_proofs_per_sec_2host", "replica_repair_hit_rate",
     "kill_recovery_ms",
+    "goodput_ratio_at_2x", "shed_rate", "light_tenant_p99_ms_overload",
+    "cancel_reclaim_pct",
     "legs", "watchdog_fallback",
 )
 
@@ -665,6 +680,37 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     f"hostkill gate: aggregate_proofs_per_sec_2host={agg} "
                     "<= 0 — the replicated pair did no work"
                 )
+        # the overload gate: a serve plane at 2× offered load must keep
+        # doing ≈ its capacity's worth of real work — shedding the excess
+        # with honest 429s instead of letting queue collapse drag goodput
+        # down. Needs spare cores: on ≤2-core hosts the overload clients
+        # time-slice the server's only cores and the ratio measures
+        # scheduler contention, not admission control.
+        if overload_gate_skip_reason(obj) is None:
+            ratio = obj.get("goodput_ratio_at_2x")
+            reclaim = obj.get("cancel_reclaim_pct")
+            if not isinstance(ratio, _NUM) or isinstance(ratio, bool):
+                problems.append(
+                    f"overload gate: goodput_ratio_at_2x is {ratio!r} "
+                    "(overload leg did not run?)"
+                )
+            elif ratio < 0.8:
+                problems.append(
+                    f"overload gate: goodput_ratio_at_2x={ratio} < 0.8 — "
+                    "under 2x offered load the admission gate must shed "
+                    "the excess and keep goodput near capacity, not let "
+                    "queueing collapse it"
+                )
+            if (
+                isinstance(reclaim, _NUM)
+                and not isinstance(reclaim, bool)
+                and reclaim <= 0
+            ):
+                problems.append(
+                    f"overload gate: cancel_reclaim_pct={reclaim} <= 0 — "
+                    "tight-deadline requests must be refused or dropped "
+                    "before burning a worker, at least sometimes"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -905,6 +951,28 @@ def hostkill_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def overload_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the goodput-at-2× gate does NOT apply (None when it does).
+    The ratio needs spare cores: on ≤2-core hosts the 2× closed-loop
+    clients time-slice the server's only cores, so the measured goodput
+    collapse is scheduler contention, not admission control. Callers
+    print the reason so a skipped gate is visible, never silent."""
+    if "goodput_ratio_at_2x" not in obj and "shed_rate" not in obj:
+        return "artifact predates the overload leg"
+    cores = obj.get("host_cores")
+    if not isinstance(cores, int):
+        cores = obj.get("overload_host_cpus")
+    if not isinstance(cores, int):
+        return f"host_cores={obj.get('host_cores')!r} (unknown host shape)"
+    if cores <= 2:
+        return (
+            f"host_cores={cores} ≤ 2 — the 2× closed-loop clients "
+            "time-slice the server's only cores, so the goodput ratio "
+            "measures scheduler contention, not admission control"
+        )
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("artifacts", nargs="+", help="BENCH_*.json files")
@@ -964,6 +1032,9 @@ def main(argv=None) -> int:
             reason = hostkill_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: hostkill gate SKIPPED ({reason})")
+            reason = overload_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: overload gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")

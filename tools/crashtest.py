@@ -168,6 +168,46 @@ def rebalance_child_main(args) -> int:
     return 0
 
 
+def stream_child_main(args) -> int:
+    """Forked IPBS streamer: deterministic world → one bundle streamed
+    through `BundleStreamWriter` into ``--out``, fsync'd per send.
+
+    ``IPC_STREAM_TERM_AT_CHUNK=N`` raises SIGTERM against the process
+    right after the N-th send callback lands on disk — a mid-stream
+    death with a committed prefix of the IPBS frame sequence, exactly
+    what a preempted serve process leaves on a client's socket. The
+    parent then demands the truncated stream be DETECTABLY torn (typed
+    `WitnessError` from the decoder), never a silently-short document."""
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+    from ipc_proofs_tpu.witness.stream import BundleStreamWriter
+    from ipc_proofs_tpu.witness.wire import WitnessOptions
+    from ipc_proofs_tpu.witness.stream import stream_bundle_doc
+
+    store, pairs, spec = _build_world(
+        args.pairs, args.receipts, args.events, args.match_rate
+    )
+    bundle = generate_event_proofs_for_range_chunked(
+        store, pairs, spec, chunk_size=args.chunk_size
+    )
+    term_at = int(os.environ.get("IPC_STREAM_TERM_AT_CHUNK", "0") or 0)
+    sends = 0
+    fh = open(args.out, "wb")
+
+    def sink(bufs):
+        nonlocal sends
+        for b in bufs:
+            fh.write(bytes(b))
+        fh.flush()
+        os.fsync(fh.fileno())
+        sends += 1
+        if term_at and sends >= term_at:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    stream_bundle_doc(BundleStreamWriter(sink), bundle, WitnessOptions())
+    fh.close()
+    return 0
+
+
 def child_main(args) -> int:
     """Forked driver: deterministic world → journaled pipelined range run.
 
@@ -214,6 +254,7 @@ def _spawn_child(
     extra_env: "dict | None" = None,
     backfill: bool = False,
     rebalance: bool = False,
+    stream: bool = False,
 ) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
@@ -227,6 +268,8 @@ def _spawn_child(
         cmd.append("--backfill")
     if rebalance:
         cmd.append("--rebalance")
+    if stream:
+        cmd.append("--stream")
     if metrics_out:
         cmd += ["--metrics-out", metrics_out]
     env = dict(os.environ)
@@ -235,9 +278,11 @@ def _spawn_child(
     for key in (
         "IPC_JOURNAL_CRASH_AT",
         "IPC_JOURNAL_CRASH_TORN",
+        "IPC_JOURNAL_CRASH_SIGNAL",
         "IPC_JOURNAL_COMPACT_BYTES",
         "IPC_COMPACT_CRASH_BYTES",
         "IPC_COMPACT_CRASH_POST",
+        "IPC_STREAM_TERM_AT_CHUNK",
     ):
         env.pop(key, None)
     if crash_at is not None:
@@ -334,22 +379,28 @@ def backfill_crash_run(
     torn: "int | None",
     workdir: str,
     tag: "str | int" = 0,
+    term: bool = False,
 ) -> dict:
-    """One backfill kill point: SIGKILL the `BackfillEngine` child at the
+    """One backfill kill point: kill the `BackfillEngine` child at the
     ``crash_at``-th window commit (optionally torn at byte ``torn``),
     resume it from the same jobs dir, and demand the final bundle be
     byte-identical to the reference. The resumed run must replay every
     committed window from the journal (``jobs.chunks_replayed`` at the
-    journal layer, ``backfill.windows_replayed`` at the engine)."""
+    journal layer, ``backfill.windows_replayed`` at the engine).
+
+    ``term=True`` delivers SIGTERM instead of SIGKILL — the
+    orchestrator-preemption flavor, landing while later windows are
+    still in flight. Recovery must be indistinguishable from a SIGKILL."""
     jobs_dir = os.path.join(workdir, f"bfjob_{tag}_at{crash_at}_torn{torn}")
     out = os.path.join(workdir, f"bfout_{tag}_at{crash_at}_torn{torn}.json")
     metrics_out = out + ".metrics"
-    res = {"crash_at": crash_at, "torn": torn}
+    res = {"crash_at": crash_at, "torn": torn, "signal": "TERM" if term else "KILL"}
 
     crashed = _spawn_child(
-        jobs_dir, out, shape, crash_at=crash_at, torn=torn, backfill=True
+        jobs_dir, out, shape, crash_at=crash_at, torn=torn, backfill=True,
+        extra_env={"IPC_JOURNAL_CRASH_SIGNAL": "TERM"} if term else None,
     )
-    if crashed.returncode != -signal.SIGKILL:
+    if crashed.returncode != -(signal.SIGTERM if term else signal.SIGKILL):
         res["outcome"] = "no_crash"
         res["rc"] = crashed.returncode
         res["stderr"] = crashed.stderr[-2000:]
@@ -601,6 +652,161 @@ def run_backfill_grid(
     }
 
 
+def sigterm_stream_run(
+    reference: bytes,
+    shape: dict,
+    term_at: int,
+    workdir: str,
+    tag: "str | int" = 0,
+) -> dict:
+    """One mid-IPBS-stream SIGTERM: the stream child dies right after its
+    ``term_at``-th send callback hits disk, leaving a committed prefix of
+    the frame sequence — what a preempted serve process leaves on a
+    client socket. The invariant is DETECTABILITY: the truncated bytes
+    must raise a typed `WitnessError` from the client decoder (torn
+    frame / open document / missing trailer), never parse as a complete
+    document ("silent_partial" = violation)."""
+    from ipc_proofs_tpu.witness.errors import WitnessError
+    from ipc_proofs_tpu.witness.stream import decode_bundle_stream
+
+    job_dir = os.path.join(workdir, f"stjob_{tag}_term{term_at}")
+    out = os.path.join(workdir, f"stout_{tag}_term{term_at}.ipbs")
+    res: dict = {"term_at": term_at}
+
+    crashed = _spawn_child(
+        job_dir, out, shape, stream=True,
+        extra_env={"IPC_STREAM_TERM_AT_CHUNK": str(term_at)},
+    )
+    if crashed.returncode != -signal.SIGTERM:
+        res["outcome"] = "no_crash"
+        res["rc"] = crashed.returncode
+        res["stderr"] = crashed.stderr[-2000:]
+        return res
+    partial = b""
+    if os.path.exists(out):
+        with open(out, "rb") as fh:
+            partial = fh.read()
+    res["partial_bytes"] = len(partial)
+    res["reference_bytes"] = len(reference)
+    if not partial:
+        res["outcome"] = "empty_prefix"  # term_at ≥ 1 ⇒ one send committed
+        return res
+    if partial == reference:
+        # the kill landed on the very last send: nothing was torn
+        res["outcome"] = "complete_before_term"
+        return res
+    if not reference.startswith(partial):
+        res["outcome"] = "divergent"  # the prefix itself must be honest bytes
+        return res
+    try:
+        decode_bundle_stream(partial)
+    except WitnessError as exc:
+        res["outcome"] = "typed_tear"
+        res["error"] = f"{type(exc).__name__}: {exc}"
+        return res
+    res["outcome"] = "silent_partial"  # decoder accepted a torn stream
+    return res
+
+
+def run_sigterm_grid(
+    base_seed: int,
+    n_pairs: int = 8,
+    window_size: int = 2,
+    receipts: int = 3,
+    events: int = 2,
+    match_rate: float = 0.25,
+    log=lambda msg: None,
+) -> dict:
+    """SIGTERM (orchestrator-preemption) grid, two surfaces:
+
+    - **in-flight backfill window**: TERM at a window-commit append while
+      later windows are still un-run — resume must be byte-identical to
+      the chunked-driver reference, replaying every committed window;
+    - **mid-IPBS-stream**: TERM between stream sends — the committed
+      prefix must decode to a typed `WitnessError`, never a document.
+
+    ``ok`` iff every backfill point resumed identical AND every stream
+    point tore typed, with at least one point per surface."""
+    from ipc_proofs_tpu.proofs.range import generate_event_proofs_for_range_chunked
+
+    shape = {
+        "pairs": n_pairs, "chunk_size": window_size,
+        "receipts": receipts, "events": events, "match_rate": match_rate,
+        "record_workers": 1,
+    }
+    n_windows = (n_pairs + window_size - 1) // window_size
+    store, pairs, spec = _build_world(n_pairs, receipts, events, match_rate)
+    reference = generate_event_proofs_for_range_chunked(
+        store, pairs, spec, chunk_size=window_size
+    ).to_json()
+
+    rng = random.Random(base_seed)
+    backfill_points = sorted(
+        rng.sample(range(max(1, n_windows - 1)), k=min(2, max(1, n_windows - 1)))
+    )
+    counts: "dict[str, int]" = {}
+    violations = []
+    stream_points = []
+    with tempfile.TemporaryDirectory(prefix="crashtest_sigterm_") as workdir:
+        for i, crash_at in enumerate(backfill_points):
+            res = backfill_crash_run(
+                reference, shape, crash_at, None, workdir, tag=f"term{i}",
+                term=True,
+            )
+            counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+            if res["outcome"] != "identical":
+                violations.append(res)
+            log(
+                f"SIGTERM backfill at window {crash_at}: {res['outcome']}"
+                + (
+                    f" ({res.get('records_after_crash')} committed, "
+                    f"{res.get('windows_replayed')} replayed)"
+                    if "records_after_crash" in res else ""
+                )
+            )
+
+        # fault-free stream reference (also proves the stream child works)
+        ref_dir = os.path.join(workdir, "stream_ref")
+        ref_out = os.path.join(workdir, "stream_ref.ipbs")
+        ref = _spawn_child(ref_dir, ref_out, shape, stream=True)
+        if ref.returncode != 0:
+            violations.append(
+                {"outcome": "stream_reference_failed",
+                 "stderr": ref.stderr[-2000:]}
+            )
+        else:
+            with open(ref_out, "rb") as fh:
+                stream_reference = fh.read()
+            stream_points = [1, 3, 5]
+            for i, term_at in enumerate(stream_points):
+                res = sigterm_stream_run(
+                    stream_reference, shape, term_at, workdir, tag=i
+                )
+                counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+                if res["outcome"] != "typed_tear":
+                    violations.append(res)
+                log(
+                    f"SIGTERM stream at send {term_at}: {res['outcome']}"
+                    + (
+                        f" ({res.get('partial_bytes')}/"
+                        f"{res.get('reference_bytes')} bytes)"
+                        if "partial_bytes" in res else ""
+                    )
+                )
+    ok = (
+        not violations
+        and counts.get("identical", 0) >= 1
+        and counts.get("typed_tear", 0) >= 1
+    )
+    return {
+        "ok": ok,
+        "backfill_points": backfill_points,
+        "stream_points": stream_points,
+        "counts": counts,
+        "violations": violations,
+    }
+
+
 def compaction_crash_run(
     reference: str,
     shape: dict,
@@ -842,6 +1048,16 @@ def main(argv=None) -> int:
         "handoff (storex.RebalanceJob) instead of the range driver (in "
         "--child mode, selects the rebalance child)",
     )
+    ap.add_argument(
+        "--sigterm", action="store_true",
+        help="run the SIGTERM (preemption) grid: TERM at an in-flight "
+        "backfill window commit (resume must be byte-identical) and TERM "
+        "mid-IPBS-stream (the torn prefix must decode to a typed error)",
+    )
+    ap.add_argument(
+        "--stream", action="store_true",
+        help=argparse.SUPPRESS,  # internal: selects the IPBS stream child
+    )
     # --child: the forked driver entrypoint (internal)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--job-dir", help=argparse.SUPPRESS)
@@ -854,12 +1070,25 @@ def main(argv=None) -> int:
             ap.error("--child needs --job-dir and --out")
         if args.rebalance:
             return rebalance_child_main(args)
+        if args.stream:
+            return stream_child_main(args)
         return backfill_child_main(args) if args.backfill else child_main(args)
     if args.seed is None:
         ap.error("seed is required")
 
     points = 4 if args.quick and args.points == 8 else args.points
     t0 = time.time()
+    if args.sigterm:
+        summary = run_sigterm_grid(
+            args.seed,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+        print(json.dumps(summary, indent=2))
+        if not summary["ok"]:
+            print("CRASH-RECOVERY INVARIANT VIOLATED", file=sys.stderr)
+            return 1
+        print("CRASH RECOVERY CLEAN")
+        return 0
     if args.rebalance:
         summary = run_rebalance_grid(
             args.seed, n_segments=max(1, args.pairs if args.pairs != 12 else 3),

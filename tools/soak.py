@@ -12,8 +12,8 @@ Usage:
     python tools/soak.py BASE_SEED [phase ...] [--quick]
 
 Phases (default: all): event storage shapes codec rleplus cert dagcbor
-header trees range json chaos crash hostkill. Every phase derives its
-seeds from
+header trees range json chaos crash hostkill overload. Every phase
+derives its seeds from
 BASE_SEED, so a NOTES entry of (base seed, phase) reproduces a run
 exactly.
 """
@@ -390,6 +390,131 @@ def phase_crash(rng, quick):
     )
 
 
+def phase_overload(rng, quick):
+    # overload-survival differential: a deadline storm (seeded ample /
+    # tight / mid-expiry budgets) against the admission-gated HTTP front
+    # end — every answer must be byte-identical to the fault-free
+    # reference for its pair or a TYPED refusal (deadline / admit /
+    # throttle), never an untyped 500 and never a divergent bundle; plus
+    # fresh-seed reruns of the SIGTERM grid and the slow-shard
+    # quarantine grid (tools/crashtest.py / tools/chaos.py harnesses)
+    import json as _json
+    import threading
+
+    from http.client import HTTPConnection
+
+    import chaos
+    import crashtest
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.serve import ProofService, ServiceConfig
+    from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+
+    SIG, SUBNET = "NewTopDownMessage(bytes32,uint256)", "calib-subnet-1"
+    n_pairs = 3 if quick else 6
+    store, pairs, _ = build_range_world(
+        n_pairs, 4, 2, 0.4, signature=SIG, topic1=SUBNET,
+    )
+    spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET)
+    service = ProofService(
+        store=store, spec=spec,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=2.0, workers=2,
+            admit_gradient=True, admit_initial=4,
+            admit_delay_budget_ms=50.0,
+        ),
+    )
+    httpd = ProofHTTPServer(service, pairs=pairs).start()
+    typed_refusals = {"deadline", "cancelled", "admit_rejected",
+                      "tenant_throttled", "degraded"}
+    try:
+        def post(obj):
+            conn = HTTPConnection("127.0.0.1", httpd.port, timeout=60)
+            try:
+                conn.request(
+                    "POST", "/v1/generate", _json.dumps(obj),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+
+        def canonical(data):
+            # strip the per-request envelope (trace id, timing) — the
+            # differential verdict is about the PROOF payload bytes
+            obj = _json.loads(data)
+            obj.pop("trace_id", None)
+            obj.pop("server_timing", None)
+            return _json.dumps(obj, sort_keys=True)
+
+        references = {}
+        for i in range(n_pairs):
+            st, data = post({"pair_index": i})
+            assert st == 200, data[:200]
+            references[i] = canonical(data)
+
+        n = 60 if quick else 400
+        outcomes = {"identical": 0, "typed": 0}
+        lock = threading.Lock()
+
+        def storm(seed):
+            import random as _random
+
+            r = _random.Random(seed)
+            for _ in range(n // 4):
+                i = r.randrange(n_pairs)
+                body = {"pair_index": i}
+                draw = r.random()
+                if draw < 0.3:
+                    body["deadline_ms"] = r.choice([1, 3, 8, 15])  # tight
+                elif draw < 0.5:
+                    body["deadline_ms"] = r.randrange(2_000, 10_000)  # ample
+                st, data = post(body)
+                if st == 200:
+                    assert canonical(data) == references[i], (
+                        f"divergent bundle for pair {i} under deadline storm"
+                    )
+                    with lock:
+                        outcomes["identical"] += 1
+                else:
+                    obj = _json.loads(data)
+                    assert obj.get("error_type") in typed_refusals, (
+                        st, obj,
+                    )
+                    with lock:
+                        outcomes["typed"] += 1
+
+        seeds = [rng.randrange(1 << 30) for _ in range(4)]
+        threads = [threading.Thread(target=storm, args=(s,)) for s in seeds]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outcomes["identical"] > 0, outcomes  # storms must do real work
+        log(
+            f"overload deadline storm: {sum(outcomes.values())} requests "
+            f"({outcomes['identical']} identical, {outcomes['typed']} typed "
+            "refusals), zero divergent/untyped"
+        )
+    finally:
+        httpd.shutdown(timeout=30)
+        service.drain()
+
+    summary = crashtest.run_sigterm_grid(rng.randrange(1 << 30), log=log)
+    assert summary["ok"], summary
+    log("overload SIGTERM grid clean")
+    summary = chaos.run_slow_shard_grid(
+        rng.randrange(1 << 30), rounds=4 if quick else 10, log=log
+    )
+    assert summary["ok"], summary
+    log(
+        f"overload slow-shard grid clean "
+        f"({summary['slow_quarantines']} quarantines)"
+    )
+
+
 def phase_hostkill(rng, quick):
     # multi-host recovery differential: kill a live shard mid-load in an
     # R=2 replicated cluster at fresh seeded victims/timings — every
@@ -518,6 +643,7 @@ PHASES = {
     "chaos": phase_chaos,
     "crash": phase_crash,
     "hostkill": phase_hostkill,
+    "overload": phase_overload,
 }
 
 
