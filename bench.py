@@ -58,7 +58,7 @@ LEGS = (
     "e2e", "kernel", "cid", "baseline", "native_baseline", "serve",
     "witness", "resilience", "durability", "observability", "storage",
     "asyncfetch", "cluster", "standing", "fleetobs", "onchip", "backfill",
-    "zerocopy", "hostkill", "overload",
+    "zerocopy", "hostkill", "overload", "registry",
 )
 
 # per-leg watchdog timeouts in seconds: (full, quick). Device legs budget
@@ -84,6 +84,7 @@ _LEG_TIMEOUTS = {
     "zerocopy": (420.0, 240.0),
     "hostkill": (420.0, 240.0),
     "overload": (300.0, 150.0),
+    "registry": (300.0, 150.0),
 }
 
 
@@ -2780,6 +2781,186 @@ def _leg_overload(args) -> dict:
     }
 
 
+def _leg_registry(args) -> dict:
+    """Proof provenance plane (host-only, hermetic): what the audit
+    registry costs and what the fleet base directory buys.
+
+    Three meters:
+
+    - ``registry_append_overhead_pct``: one sealed IPR1 frame per served
+      bundle, as a percentage of the request it rides on. Measured as a
+      ratio of two costs on the SAME host — the direct per-append wall
+      cost (a realistic serve record with a CID set, buffered write, no
+      fsync) over the mean buffered ``/v1/generate`` request with the
+      registry enabled — so the gate (< 1%) is host-shape independent:
+      both numerator and denominator scale with the same machine.
+    - ``registry_inclusion_proof_ms``: mean wall time to generate AND
+      verify an O(log n) inclusion proof against the live root over a
+      multi-thousand-record chain — the audit path's cost.
+    - ``fleet_delta_hit_rate`` vs ``fleet_delta_baseline_hit_rate``: a
+      4-shard scatter appends serve records + base acks to one shared
+      registry dir, then every base lookup lands on a RANDOM shard (the
+      failover case). The baseline is each shard's private
+      `WitnessBaseCache` (hits only when the lookup happens to land on
+      the serving shard, ~1/shards); the fleet directory answers from
+      ANY shard's records (gated strictly above the baseline).
+    """
+    import hashlib as _hashlib
+    import random as _random
+    import tempfile
+
+    from http.client import HTTPConnection
+
+    from ipc_proofs_tpu.fixtures import build_range_world
+    from ipc_proofs_tpu.proofs.generator import EventProofSpec
+    from ipc_proofs_tpu.registry import ProvenanceRegistry
+    from ipc_proofs_tpu.registry.mmr import verify_inclusion
+    from ipc_proofs_tpu.serve import ProofService, ServiceConfig
+    from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+    from ipc_proofs_tpu.witness.bases import WitnessBaseCache
+
+    rng = _random.Random(20260807)
+
+    def _digest(tag):
+        return _hashlib.sha256(tag.encode()).hexdigest()
+
+    def _cids(tag, k=3):
+        return frozenset(
+            _hashlib.sha256(f"{tag}-cid-{j}".encode()).digest() for j in range(k)
+        )
+
+    # ---- phase 1: append overhead as a fraction of a served request --------
+    n_pairs = 2 if args.quick else 4
+    receipts = 8 if args.quick else 12
+    store, pairs, _ = build_range_world(
+        n_pairs, receipts_per_pair=receipts, events_per_receipt=2,
+        match_rate=0.5, signature=SIG, topic1=TOPIC1, actor_id=ACTOR,
+    )
+    spec = EventProofSpec(
+        event_signature=SIG, topic_1=TOPIC1, actor_id_filter=ACTOR
+    )
+    serve_requests = 32 if args.quick else 96
+    with tempfile.TemporaryDirectory(prefix="bench-registry-") as reg_dir:
+        service = ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(
+                max_batch=8, max_wait_ms=2.0, workers=2,
+                registry_dir=reg_dir, registry_owner="bench",
+            ),
+        )
+        httpd = ProofHTTPServer(service, pairs=pairs).start()
+
+        def post(obj):
+            conn = HTTPConnection("127.0.0.1", httpd.port, timeout=120)
+            try:
+                conn.request(
+                    "POST", "/v1/generate", json.dumps(obj),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, data
+            finally:
+                conn.close()
+
+        for i in range(n_pairs):  # warm every pair through the batcher once
+            st, data = post({"pair_index": i})
+            assert st == 200, data[:200]
+        t0 = time.perf_counter()
+        for i in range(serve_requests):
+            st, data = post({"pair_index": i % n_pairs})
+            assert st == 200, data[:200]
+        serve_mean_s = (time.perf_counter() - t0) / serve_requests
+        head = service.registry.head()
+        assert head["size"] >= serve_requests, head  # every response sealed
+        httpd.shutdown(timeout=30)
+        service.drain()
+
+    # the numerator: the same append the serve path pays, microbenched
+    # directly (buffered write + chain link + tree append, no fsync)
+    append_n = 512 if args.quick else 2048
+    with tempfile.TemporaryDirectory(prefix="bench-registry-") as reg_dir:
+        reg = ProvenanceRegistry(reg_dir, owner="bench")
+        t0 = time.perf_counter()
+        for i in range(append_n):
+            reg.append_served(
+                _digest(f"append-{i}"), trace=f"trace-{i}", tenant="bench",
+                key=f"pair:{i % 8}", verdict="served", cids=_cids(f"append-{i}"),
+            )
+        append_mean_s = (time.perf_counter() - t0) / append_n
+        append_overhead_pct = 100.0 * append_mean_s / serve_mean_s
+
+        # ---- phase 2: inclusion-proof latency over the same chain ----------
+        proof_n = 64 if args.quick else 200
+        seqs = [rng.randrange(append_n) for _ in range(proof_n)]
+        t0 = time.perf_counter()
+        for seq in seqs:
+            proof = reg.inclusion_proof(seq)
+            assert verify_inclusion(
+                bytes.fromhex(proof["leaf"]), proof["seq"], proof["size"],
+                [bytes.fromhex(h) for h in proof["path"]],
+                bytes.fromhex(proof["root"]),
+            ), proof["seq"]
+        inclusion_ms = 1000.0 * (time.perf_counter() - t0) / proof_n
+        reg.close()
+
+    # ---- phase 3: fleet base directory vs per-shard caches -----------------
+    shards = 4
+    filters = 16 if args.quick else 32
+    epochs = 4
+    with tempfile.TemporaryDirectory(prefix="bench-registry-") as fleet_dir:
+        regs = [
+            ProvenanceRegistry(fleet_dir, owner=f"shard-{s}")
+            for s in range(shards)
+        ]
+        caches = [WitnessBaseCache(cap=filters * epochs) for _ in range(shards)]
+        last = {}
+        for e in range(epochs):
+            for f in range(filters):
+                s = rng.randrange(shards)
+                digest = _digest(f"fleet-f{f}-e{e}")
+                cids = _cids(f"fleet-f{f}-e{e}")
+                regs[s].append_served(
+                    digest, key=f"filter:{f}", verdict="pushed", cids=cids
+                )
+                regs[s].append_base_ack(
+                    "bench", f"filter:{f}", f"sub-{f}", digest, e
+                )
+                caches[s].register(digest, cids)
+                last[f] = digest
+        fleet_hits = baseline_hits = 0
+        for f in range(filters):
+            lk = rng.randrange(shards)  # the shard failover lands on
+            if caches[lk].lookup(last[f]) is not None:
+                baseline_hits += 1
+            d = regs[lk].fleet_acked_base("bench", f"filter:{f}", f"sub-{f}")
+            if d == last[f] and regs[lk].lookup_base(d) is not None:
+                fleet_hits += 1
+        for reg in regs:
+            reg.close()
+    fleet_rate = fleet_hits / filters
+    baseline_rate = baseline_hits / filters
+
+    _log(
+        f"bench: registry: append {append_mean_s * 1e6:,.1f}us over "
+        f"{serve_mean_s * 1e3:,.1f}ms/request = "
+        f"{append_overhead_pct:.3f}% overhead, inclusion proof "
+        f"{inclusion_ms:.2f}ms @ {append_n} records, fleet base hit rate "
+        f"{fleet_rate:.2f} vs per-shard {baseline_rate:.2f}"
+    )
+    return {
+        "registry_append_overhead_pct": round(append_overhead_pct, 4),
+        "registry_append_us": round(append_mean_s * 1e6, 2),
+        "registry_inclusion_proof_ms": round(inclusion_ms, 3),
+        "fleet_delta_hit_rate": round(fleet_rate, 3),
+        "fleet_delta_baseline_hit_rate": round(baseline_rate, 3),
+        "registry_chain_records": append_n,
+        "registry_serve_requests": serve_requests,
+        "registry_shards": shards,
+        "registry_lookups": filters,
+    }
+
+
 _LEG_FNS = {
     "e2e": _leg_e2e,
     "kernel": _leg_kernel,
@@ -2801,6 +2982,7 @@ _LEG_FNS = {
     "zerocopy": _leg_zerocopy,
     "hostkill": _leg_hostkill,
     "overload": _leg_overload,
+    "registry": _leg_registry,
 }
 
 
@@ -3115,6 +3297,8 @@ def _orchestrate(args) -> None:
     legs_status["hostkill"] = status
     overload, status = _run_leg("overload", args, "cpu")
     legs_status["overload"] = status
+    registry, status = _run_leg("registry", args, "cpu")
+    legs_status["registry"] = status
 
     scalar_rate = (baseline or {}).get("scalar_baseline_proofs_per_sec")
     native_rate = (native or {}).get("native_baseline_proofs_per_sec")
@@ -3250,6 +3434,14 @@ def _orchestrate(args) -> None:
     )
     for k in _OVERLOAD_KEYS:
         out[k] = (overload or {}).get(k)
+    _REGISTRY_KEYS = (
+        "registry_append_overhead_pct", "registry_append_us",
+        "registry_inclusion_proof_ms", "fleet_delta_hit_rate",
+        "fleet_delta_baseline_hit_rate", "registry_chain_records",
+        "registry_serve_requests", "registry_shards", "registry_lookups",
+    )
+    for k in _REGISTRY_KEYS:
+        out[k] = (registry or {}).get(k)
     out["legs"] = legs_status
     out["watchdog_fallback"] = watchdog_fallback
     print(json.dumps(out))

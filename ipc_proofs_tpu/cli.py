@@ -876,6 +876,9 @@ def _cmd_serve(args) -> int:
             admit_delay_budget_ms=args.admit_delay_budget_ms,
             deadline_floor_ms=args.deadline_floor_ms,
             retry_budget=args.retry_budget,
+            registry_dir=args.registry_dir,
+            registry_owner=args.registry_owner,
+            registry_fsync=(args.registry_fsync == "on"),
         ),
         endpoint_pool=endpoint_pool,
         metrics=metrics,
@@ -954,6 +957,10 @@ def _cmd_serve(args) -> int:
             # the batcher's PUSH lane (one priority order with
             # interactive requests and backfill windows)
             service=(service if spec is not None and store is not None else None),
+            # fleet base directory: pushed bundles + acked bases seal into
+            # the provenance chain so deltas survive failover fleet-wide
+            provenance=service.registry,
+            fleet=args.subs_fleet,
         )
         if subs.registry.replayed:
             log.info(
@@ -1136,6 +1143,16 @@ def _cmd_cluster(args) -> int:
             if args.subs_dir:
                 shard_extra += [
                     "--subs-dir", os.path.join(args.subs_dir, name)
+                ]
+            if args.registry_dir:
+                # ONE shared provenance/base directory, one single-writer
+                # log per shard (reg-s<k>.log) — this sharing is what lets
+                # any shard answer for a base another shard served
+                shard_extra += [
+                    "--registry-dir", args.registry_dir,
+                    "--registry-owner", name,
+                    "--registry-fsync", args.registry_fsync,
+                    "--subs-fleet", args.subs_fleet,
                 ]
             shards.append(
                 spawn_serve_shard(
@@ -1381,6 +1398,35 @@ def main(argv=None) -> int:
             "--witness-base-cache", type=int, default=64, metavar="N",
             help="server-side LRU of witness base digests → CID sets used "
             "to answer delta requests (default 64 bases)",
+        )
+
+    def add_registry_flags(p):
+        p.add_argument(
+            "--registry-dir", default=None, metavar="DIR",
+            help="proof provenance registry: seal every served bundle "
+            "into a hash-linked IPR1 audit log (reg-<owner>.log) under "
+            "DIR, mount GET /v1/registry/{head,entry,proof,consistency}, "
+            "and use DIR as the fleet-wide delta base directory (shards "
+            "sharing DIR see each other's serve records). Appends are "
+            "fail-soft: registry trouble degrades /healthz, never serving",
+        )
+        p.add_argument(
+            "--registry-owner", default="main", metavar="TOKEN",
+            help="writer token naming this process's registry log file "
+            "(each process sharing --registry-dir needs its own; default "
+            "main)",
+        )
+        p.add_argument(
+            "--registry-fsync", choices=["on", "off"], default="off",
+            help="fsync each registry frame (durable audit contract) "
+            "instead of riding the page cache; 'off' keeps append "
+            "overhead under the 1%% serve-wall budget (default off)",
+        )
+        p.add_argument(
+            "--subs-fleet", default="default", metavar="NAME",
+            help="subscriber-fleet label for registry base records: acked "
+            "delta bases are keyed (fleet, filter key) so any shard can "
+            "find the newest base the whole fleet acked (default default)",
         )
 
     def add_onchip_flags(p):
@@ -1837,6 +1883,7 @@ def main(argv=None) -> int:
     add_fetch_plane_flags(srv)
     add_subs_flags(srv)
     add_witness_flags(srv)
+    add_registry_flags(srv)
     srv.add_argument(
         "--backend", default="none", choices=["cpu", "tpu", "none"],
         help="batch backend for generate-range event matching (default "
@@ -1976,6 +2023,7 @@ def main(argv=None) -> int:
     add_store_flags(clu)
     add_subs_flags(clu)
     add_witness_flags(clu)
+    add_registry_flags(clu)
     clu.add_argument(
         "--queue-dir", default=None, metavar="DIR",
         help="durable admission root: each shard journals under DIR/s<k> "

@@ -57,7 +57,7 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
-from urllib.parse import parse_qs, urlsplit
+from urllib.parse import parse_qs, urlencode, urlsplit
 
 from ipc_proofs_tpu.cluster.gather import BundleFold, partition_indexes
 from ipc_proofs_tpu.cluster.hashring import HashRing, pair_ring_key
@@ -1519,6 +1519,8 @@ class ClusterRouter:
         max_epoch: Optional[int] = None
         backlog = 0
         disk_bytes = 0
+        registry_heads: "Dict[str, dict]" = {}
+        registry_degraded = 0
         for name, entry in latest.get("shards", {}).items():
             health = entry.get("healthz") or {}
             snap = entry.get("metrics") or {}
@@ -1542,7 +1544,13 @@ class ClusterRouter:
                 "pending_deliveries": pending,
                 "last_finalized_epoch": epoch,
                 "disk_bytes": shard_disk,
+                "registry": health.get("registry"),
             }
+            head = health.get("registry_head")
+            if isinstance(head, dict):
+                registry_heads[name] = head
+                if health.get("registry") == "degraded":
+                    registry_degraded += 1
             if isinstance(epoch, int):
                 max_epoch = epoch if max_epoch is None else max(max_epoch, epoch)
             if isinstance(pending, (int, float)):
@@ -1572,9 +1580,80 @@ class ClusterRouter:
             "factor": self.replication_factor,
             "last_pass": replication_last,
         }
+        if registry_heads:
+            # per-shard provenance checkpoints: each shard's chain is
+            # independent, so the fleet head is the set of (size, root)
+            # checkpoints — an auditor pins each and asks any shard for
+            # consistency proofs against its own pin
+            out["registry"] = {
+                "heads": registry_heads,
+                "total_records": sum(
+                    int(h.get("size") or 0) for h in registry_heads.values()
+                ),
+                "degraded_shards": registry_degraded,
+            }
         if self.slo is not None:
             out["slo"] = self.slo.status()
         return 200, out
+
+    def registry_query(self, sub_path: str, qs: dict) -> "tuple[int, dict]":
+        """Fleet audit surface over the per-shard provenance chains.
+
+        ``head`` with no ``?shard=`` aggregates every live shard's
+        checkpoint (each chain is independent — the fleet head is the set
+        of per-shard (size, root) pins). ``entry`` / ``proof`` /
+        ``consistency`` (and ``head?shard=``) proxy to the named shard:
+        proofs only verify against the chain that sealed the record.
+        ``base`` (the fleet delta-base directory) needs no shard — the
+        registry dir is shared, so any live shard answers for the fleet."""
+        shard = (qs.get("shard") or [""])[0]
+        with self._lock:
+            clients = {
+                name: st.client for name, st in self._shards.items() if st.alive
+            }
+        if sub_path == "head" and not shard:
+            heads: dict = {}
+            errors: dict = {}
+            for name, client in sorted(clients.items()):
+                try:
+                    status, obj = client.get("/v1/registry/head")
+                except ShardUnavailable as exc:
+                    errors[name] = str(exc)
+                    continue
+                if status == 200:
+                    heads[name] = obj
+                else:
+                    errors[name] = obj.get("error", f"status {status}")
+            return 200, {
+                "heads": heads,
+                "errors": errors,
+                "total_records": sum(
+                    int(h.get("size") or 0) for h in heads.values()
+                ),
+                "degraded_shards": sum(
+                    1 for h in heads.values() if h.get("degraded")
+                ),
+            }
+        if sub_path not in ("head", "entry", "proof", "consistency", "base"):
+            return 404, {"error": f"no such registry path: {sub_path}"}
+        if not shard and sub_path == "base" and clients:
+            shard = sorted(clients)[0]  # shared dir: any live shard answers
+        if not shard:
+            return 400, {"error": f"registry/{sub_path} requires ?shard=<name>"}
+        client = clients.get(shard)
+        if client is None:
+            return 404, {"error": f"unknown or dead shard: {shard}"}
+        pairs = [
+            (k, v)
+            for k, vals in qs.items()
+            if k != "shard"
+            for v in vals
+        ]
+        tail = ("?" + urlencode(pairs)) if pairs else ""
+        try:
+            return client.get(f"/v1/registry/{sub_path}{tail}")
+        except ShardUnavailable as exc:
+            return 503, {"error": f"shard {shard} unreachable: {exc}"}
 
     def flight(self) -> dict:
         """Aggregate the fleet's flight rings (shards' ``/debug/flight``
@@ -1764,6 +1843,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 )
             except NoShardsError as exc:
                 status, obj = 503, {"error": str(exc)}
+            self._send_json(status, obj)
+        elif parts.path.startswith("/v1/registry/"):
+            sub_path = parts.path[len("/v1/registry/") :]
+            status, obj = self.router.registry_query(
+                sub_path, parse_qs(parts.query)
+            )
             self._send_json(status, obj)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})

@@ -333,6 +333,7 @@ class SloWatchdog:
             "fetch.speculative_wasted",
             "fetch.speculative_wants",
             "degraded.entered",
+            "registry.append_failures",
         )
         point = (t, {k: counters.get(k, 0) for k in keys})
         self._anomaly_samples.append(point)
@@ -381,6 +382,16 @@ class SloWatchdog:
             active["degraded_lotus_down"] = (
                 f"entered degraded serve mode {entered:.0f}x in the fast "
                 "window (all upstream breakers open)"
+            )
+        dropped = delta("registry.append_failures")
+        if dropped >= 1:
+            # any dropped provenance record means the audit chain and the
+            # served-response history have DIVERGED — serving is fine
+            # (fail-soft contract) but the registry can no longer attest
+            # to every response, which is page-worthy on its own
+            active["registry_divergence"] = (
+                f"{dropped:.0f} provenance appends dropped in the fast "
+                "window (audit chain diverging from served responses)"
             )
         for name, detail in active.items():
             if name not in self._active_anomalies:

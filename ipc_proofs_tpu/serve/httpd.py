@@ -348,8 +348,115 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_segment_get(path)
         elif path.startswith("/v1/blocks/"):
             self._handle_block_get(path)
+        elif path.startswith("/v1/registry/"):
+            self._handle_registry_get(path)
         else:
             self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    # --- provenance registry -------------------------------------------------
+
+    def _handle_registry_get(self, path: str) -> None:
+        """`/v1/registry/{head,entry,proof,consistency,base}`: the audit
+        surface. ``head`` publishes the checkpoint (size + tree root +
+        chain tip); ``entry?seq=N`` returns one sealed record;
+        ``proof?seq=N`` (or ``?digest=<bundle digest>``) an inclusion
+        proof against the current root; ``consistency?old_size=N`` the
+        proof that the current root extends the size-N checkpoint;
+        ``base?fleet=F&key=K`` the fleet directory's newest common acked
+        base for a filter key (digest + CID set)."""
+        reg = self.service.registry
+        if reg is None:
+            self._send_json(404, {"error": "registry disabled"})
+            return
+        q = parse_qs(urlsplit(self.path).query)
+
+        def _int_param(name):
+            try:
+                return int(q[name][0])
+            except (KeyError, IndexError, ValueError):
+                return None
+
+        if path == "/v1/registry/head":
+            self._send_json(200, reg.head())
+        elif path == "/v1/registry/entry":
+            seq = _int_param("seq")
+            entry = reg.entry(seq) if seq is not None else None
+            if entry is None:
+                self._send_json(404, {"error": f"no registry entry seq={seq}"})
+            else:
+                self._send_json(200, entry)
+        elif path == "/v1/registry/proof":
+            seq = _int_param("seq")
+            if seq is None and "digest" in q:
+                seq = reg.seq_of(q["digest"][0])
+            proof = reg.inclusion_proof(seq) if seq is not None else None
+            if proof is None:
+                self._send_json(404, {"error": "no such registry record"})
+            else:
+                self._send_json(200, proof)
+        elif path == "/v1/registry/consistency":
+            old = _int_param("old_size")
+            proof = reg.consistency(old) if old is not None else None
+            if proof is None:
+                self._send_json(
+                    404, {"error": "old_size required, 0 <= old_size <= size"}
+                )
+            else:
+                self._send_json(200, proof)
+        elif path == "/v1/registry/base":
+            # fleet base directory query: the newest base every member of
+            # (fleet, key) acked — what a post-failover delta builds on
+            fleet = (q.get("fleet") or [""])[0]
+            key = (q.get("key") or [""])[0]
+            if not key:
+                self._send_json(404, {"error": "key required"})
+                return
+            digest = reg.newest_common_base(fleet or "default", key)
+            cids = reg.lookup_base(digest) if digest else None
+            self._send_json(
+                200,
+                {
+                    "fleet": fleet or "default",
+                    "key": key,
+                    "digest": digest,
+                    "cids": sorted(c.hex() for c in cids) if cids else None,
+                },
+            )
+        else:
+            self._send_json(404, {"error": f"no such path: {self.path}"})
+
+    def _registry_append(
+        self, digest: str, *, verdict: str = "", key: str = "", trace: str = "",
+        cids=None,
+    ) -> None:
+        """Seal one served bundle into the provenance chain — fail-soft:
+        any trouble counts `registry.append_failures` inside the writer
+        and the response goes out bit-identical either way.
+
+        ``digest`` and ``cids`` accept zero-arg callables (bound methods)
+        resolved only past the ``reg is None`` gate: with the registry
+        disabled the serve path must not pay for digesting or CID-set
+        materialization it will never use."""
+        reg = self.service.registry
+        if reg is None:
+            return
+        if callable(digest):
+            digest = digest()
+        if not digest:
+            return
+        try:
+            if callable(cids):
+                cids = cids()
+            reg.append_served(
+                digest,
+                trace=trace,
+                tenant=getattr(self, "_tenant", None) or "",
+                key=key,
+                verdict=verdict,
+                cids=cids,
+            )
+        except Exception:  # fail-soft: a registry write failure must never block serving
+            self.service.metrics.count("registry.append_failures")
 
     # --- replication plane (storex.replica peers call these) ----------------
 
@@ -774,10 +881,29 @@ class _Handler(BaseHTTPRequestHandler):
             # journal the PLAIN bundle obj (compressed frames expand before
             # admission, so journal replay never needs the codec)
             plain = obj if "blocks_frame" not in obj else bundle.to_json_obj()
-            self._submit_durable("verify", plain, body, claims=claims)
+            self._submit_durable(
+                "verify", plain, body, claims=claims,
+                seal=lambda done: self._registry_append(
+                    bundle.digest,
+                    verdict=(
+                        "valid"
+                        if (done.get("result") or {}).get("all_valid")
+                        else "invalid"
+                    ),
+                    key="verify",
+                )
+                if done.get("ok")
+                else None,
+            )
             return
 
         def render(resp):
+            self._registry_append(
+                bundle.digest,
+                verdict="valid" if resp.all_valid() else "invalid",
+                key="verify",
+                trace=resp.trace_id,
+            )
             out = {
                 "storage_results": resp.storage_results,
                 "event_results": resp.event_results,
@@ -828,7 +954,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
         def stream_doc(resp, writer):
-            stream_bundle_doc(
+            digest = stream_bundle_doc(
                 writer,
                 resp.bundle,
                 opts,
@@ -842,6 +968,24 @@ class _Handler(BaseHTTPRequestHandler):
                 tail_extra={"server_timing": dict(resp.server_timing)},
                 slicer=self.service.read_block_slice,
             )
+            self._registry_append(
+                digest, verdict="served", key=f"pair:{idx}",
+                trace=resp.trace_id, cids=resp.bundle.cid_set,
+            )
+
+        def render(resp):
+            fields = self._witness_fields(resp.bundle, opts)
+            self._registry_append(
+                fields.get("digest", ""), verdict="served", key=f"pair:{idx}",
+                trace=resp.trace_id, cids=resp.bundle.cid_set,
+            )
+            return dict(
+                fields,
+                n_event_proofs=resp.n_event_proofs,
+                batch_size=resp.batch_size,
+                trace_id=resp.trace_id,
+                server_timing=resp.server_timing,
+            )
 
         self._submit(
             lambda: self.service.submit_generate(
@@ -850,13 +994,7 @@ class _Handler(BaseHTTPRequestHandler):
                 tenant=self._tenant,
                 cancel_scope=self._cancel_scope,
             ),
-            lambda resp: dict(
-                self._witness_fields(resp.bundle, opts),
-                n_event_proofs=resp.n_event_proofs,
-                batch_size=resp.batch_size,
-                trace_id=resp.trace_id,
-                server_timing=resp.server_timing,
-            ),
+            render,
             stream_fn=stream_doc if stream else None,
             encoding=opts.encoding,
             pending=True,
@@ -942,15 +1080,22 @@ class _Handler(BaseHTTPRequestHandler):
                 metrics=self.service.metrics,
             ).claims_json()
 
+        range_key = "pairs:" + ",".join(str(i) for i in gen_idxs[:32])
+
         def render(bundle):
+            fields = self._witness_fields(bundle, opts, claims=_claims(bundle))
+            self._registry_append(
+                fields.get("digest", ""), verdict="served", key=range_key,
+                cids=bundle.cid_set,
+            )
             return dict(
-                self._witness_fields(bundle, opts, claims=_claims(bundle)),
+                fields,
                 n_event_proofs=len(bundle.event_proofs),
                 n_pairs=len(gen_idxs),
             )
 
         def stream_doc(bundle, writer):
-            stream_bundle_doc(
+            digest = stream_bundle_doc(
                 writer,
                 bundle,
                 opts,
@@ -962,6 +1107,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "n_pairs": len(gen_idxs),
                 },
                 slicer=self.service.read_block_slice,
+            )
+            self._registry_append(
+                digest, verdict="served", key=range_key, cids=bundle.cid_set
             )
 
         self._submit(
@@ -1166,6 +1314,13 @@ class _Handler(BaseHTTPRequestHandler):
                 ).claims_json()
             result = {k: v for k, v in result.items() if k != "bundle"}
             result.update(self._witness_fields(bundle, witness, claims=claims_json))
+            # durable replays are served responses too: the provenance
+            # chain records every bundle that leaves the process, cached
+            # or fresh
+            self._registry_append(
+                result.get("digest", ""), verdict="served", key="replay",
+                cids=bundle.cid_set,
+            )
         if claims is not None and "storage_results" in result:
             result = dict(
                 result,
@@ -1185,6 +1340,7 @@ class _Handler(BaseHTTPRequestHandler):
         claim_indexes=None,
         gen_indexes=None,
         stream=False,
+        seal=None,
     ):
         """Route one request through the durable admission queue.
 
@@ -1225,6 +1381,13 @@ class _Handler(BaseHTTPRequestHandler):
                 503, {"error": str(exc), "error_type": exc.error_type}
             )
         else:
+            if seal is not None:
+                # provenance seal for journaled kinds that carry no bundle
+                # (verify): fail-soft like every registry append
+                try:
+                    seal(done)
+                except Exception:  # fail-soft: a registry write failure must never block serving
+                    self.service.metrics.count("registry.append_failures")
             headers = None
             if (
                 stream
@@ -1284,7 +1447,7 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
         def doc(writer):
-            stream_bundle_doc(
+            digest = stream_bundle_doc(
                 writer,
                 bundle,
                 witness,
@@ -1294,6 +1457,9 @@ class _Handler(BaseHTTPRequestHandler):
                 head_extra=head,
                 tail_extra=tail,
                 slicer=self.service.read_block_slice,
+            )
+            self._registry_append(
+                digest, verdict="served", key="replay", cids=bundle.cid_set
             )
 
         self._stream_ok(doc, witness.encoding)

@@ -180,6 +180,17 @@ class ServiceConfig:
     # pool-wide client retry budget in tokens/s (--retry-budget; None =
     # unbudgeted). Wired into EndpointPool at daemon build time.
     retry_budget: Optional[float] = None
+    # proof provenance registry (ipc_proofs_tpu/registry/): when
+    # registry_dir is set every served bundle seals one hash-linked IPR1
+    # frame into reg-<registry_owner>.log under that directory, the
+    # /v1/registry/* proof endpoints come up, and witness_bases is
+    # front-ended by the fleet-wide base directory (siblings sharing the
+    # dir see each other's serve records). registry_fsync=False rides
+    # the page cache (the <1% serve-overhead budget); True restores the
+    # per-record durable contract.
+    registry_dir: Optional[str] = None
+    registry_owner: str = "main"
+    registry_fsync: bool = False
 
 
 @dataclass
@@ -343,6 +354,24 @@ class ProofService:
         # witness plane: every served bundle registers here under its
         # canonical digest so later requests can name it as a delta base
         self.witness_bases = WitnessBaseCache(cap=self.config.witness_base_cache)
+        # provenance registry: seals every served bundle into the
+        # hash-linked audit chain, and (as the fleet base directory)
+        # front-ends the local base cache so a digest served by ANY
+        # sibling shard still resolves here after a failover
+        self.registry = None
+        if self.config.registry_dir:
+            from ipc_proofs_tpu.registry import ProvenanceRegistry
+            from ipc_proofs_tpu.witness.bases import FleetBaseCache
+
+            self.registry = ProvenanceRegistry(
+                self.config.registry_dir,
+                owner=self.config.registry_owner,
+                metrics=self.metrics,
+                fsync=self.config.registry_fsync,
+            )
+            self.witness_bases = FleetBaseCache(
+                self.witness_bases, self.registry, metrics=self.metrics
+            )
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.workers, thread_name_prefix="proof-serve"
         )
@@ -518,9 +547,18 @@ class ProofService:
         pool is attached."""
         if self.draining:
             return {"status": "draining"}
-        if self._endpoint_pool is not None:
-            return self._endpoint_pool.health()
-        return {"status": "ok"}
+        out = (
+            self._endpoint_pool.health()
+            if self._endpoint_pool is not None
+            else {"status": "ok"}
+        )
+        if self.registry is not None:
+            out = dict(
+                out,
+                registry="degraded" if self.registry.degraded else "ok",
+                registry_head=self.registry.head(),
+            )
+        return out
 
     @property
     def lotus_down(self) -> bool:
@@ -644,6 +682,8 @@ class ProofService:
             self.fetch_plane.close()
         if self._disk_store is not None:
             self._disk_store.close()
+        if self.registry is not None:
+            self.registry.close()
 
     close = drain
 

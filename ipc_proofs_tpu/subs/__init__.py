@@ -81,6 +81,8 @@ class StandingQueries:
         gen_workers: int = 2,
         delta: bool = True,
         service=None,
+        provenance=None,
+        fleet: str = "default",
         opener=None,
         sleep=time.sleep,
         rng: Optional[random.Random] = None,
@@ -113,11 +115,38 @@ class StandingQueries:
             gen_workers=gen_workers,
             delta=delta,
             service=service,
+            provenance=provenance,
+            fleet=fleet,
         )
+        # fleet base directory (ROADMAP item 5): acked-base advances flow
+        # into the provenance registry keyed (fleet, filter key, sub), so
+        # a base survives failover AND compaction fleet-wide — any shard
+        # can cut a delta against the newest base this fleet acked
+        self.provenance = provenance
+        self.fleet = fleet
+        if provenance is not None:
+            self.log.set_base_reporter(self._report_base)
+            # restart sweep: re-seed the directory from replayed acked
+            # state (the registry dedups (sub, cursor, digest) replays)
+            for sub_id, (digest, cursor) in self.log.bases().items():
+                self._report_base(sub_id, digest, cursor)
         # Restart convergence: deliveries that were unacked at the last
         # shutdown/crash re-push as soon as the daemon is back.
         if self.log.pending_total():
             self.push.repush_pending(self.registry)
+
+    def _report_base(self, sub_id: str, digest: str, cursor: int) -> None:
+        """DeliveryLog base-advance hook → registry base record. Fail-soft:
+        directory trouble never blocks the ack path."""
+        sub = self.registry.get(sub_id)
+        if sub is None:
+            return
+        try:
+            self.provenance.append_base_ack(
+                self.fleet, filter_key(sub.filter), sub_id, digest, cursor
+            )
+        except Exception:  # fail-soft: losing one base ack only costs a future delta, never the push
+            self._metrics.count("registry.append_failures")
 
     # ---------------------------------------------------------- follower hook
 

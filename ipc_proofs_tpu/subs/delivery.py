@@ -41,7 +41,7 @@ import time
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 from urllib.error import HTTPError
 
 from ipc_proofs_tpu.jobs.journal import (
@@ -158,6 +158,11 @@ class DeliveryLog:
         # histogram; replayed deliveries have no entry (lag across a
         # restart would be measuring downtime, not delivery)
         self._append_ts: Dict[str, float] = {}  # guarded-by: _cond
+        # fleet base-directory feed (set_base_reporter): called AFTER the
+        # lock is released with (sub_id, base_digest, base_cursor) whenever
+        # an ack advances a sub's delta base — the callback may take its
+        # own locks (provenance registry) so it must never run under _cond
+        self._base_reporter = None
         self.replayed = 0
         if os.path.exists(self.path):
             entries, good_offset, torn = read_journal_entries(self.path)
@@ -348,6 +353,25 @@ class DeliveryLog:
             self._cond.notify_all()
         return d
 
+    def set_base_reporter(self, reporter) -> None:
+        """Install the fleet base-directory feed: ``reporter(sub_id,
+        base_digest, base_cursor)`` fires outside the log lock whenever an
+        ack advances a sub's delta base."""
+        self._base_reporter = reporter
+
+    def _report_base(self, sub_id: str, before, sl: _SubLog) -> None:
+        """Fire the reporter if the (digest, cursor) base moved past
+        ``before``. Called WITHOUT _cond held; fail-soft."""
+        if self._base_reporter is None:
+            return
+        after = (sl.base_digest, sl.base_cursor)
+        if after == before or after[0] is None:
+            return
+        try:
+            self._base_reporter(sub_id, after[0], after[1])
+        except Exception as exc:  # fail-soft: the reporter is observability; the ack itself already committed
+            logger.warning("delta-base reporter failed for %s: %s", sub_id, exc)
+
     def ack(self, sub_id: str, cursor: int) -> bool:
         """Ack one delivery; ``False`` if unknown or already acked — the
         duplicate-ack guard the push retry loop relies on."""
@@ -356,11 +380,13 @@ class DeliveryLog:
             if sl is None or cursor not in sl.entries:
                 self._metrics.count("subs.duplicate_acks")
                 return False
+            base_before = (sl.base_digest, sl.base_cursor)
             self._ack_entry(sl, cursor)
             self._append_rec({"op": "ack", "sub": sub_id, "cursor": cursor})
             self._metrics.count("subs.acks")
             self._maybe_compact_locked()
             self._publish_gauges_locked()
+        self._report_base(sub_id, base_before, sl)
         return True
 
     def ack_through(self, sub_id: str, cursor: int) -> int:
@@ -371,6 +397,7 @@ class DeliveryLog:
             sl = self._subs.get(sub_id)
             if sl is None:
                 return 0
+            base_before = (sl.base_digest, sl.base_cursor)
             for c in sorted(sl.entries):
                 if c > cursor:
                     break
@@ -381,7 +408,20 @@ class DeliveryLog:
             if acked:
                 self._maybe_compact_locked()
                 self._publish_gauges_locked()
+        if acked:
+            self._report_base(sub_id, base_before, sl)
         return acked
+
+    def bases(self) -> "Dict[str, Tuple[str, int]]":
+        """Every sub's current acked base ``{sub_id: (digest, cursor)}`` —
+        the restart sweep that re-seeds the fleet base directory from
+        replayed sstate/ack frames (the registry dedups replays)."""
+        with self._cond:
+            return {
+                sub_id: (sl.base_digest, sl.base_cursor)
+                for sub_id, sl in self._subs.items()
+                if sl.base_digest is not None
+            }
 
     # ------------------------------------------------------------------- reads
 

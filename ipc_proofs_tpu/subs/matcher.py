@@ -92,11 +92,20 @@ class StandingQueryMatcher:
         gen_workers: int = 2,
         delta: bool = True,
         service=None,
+        provenance=None,
+        fleet: str = "default",
     ):
         self._registry = registry
         self._log = log
         self._push = push
         self._store = store
+        # provenance registry (ipc_proofs_tpu/registry/): every pushed
+        # bundle seals a serve record (whose CID set feeds the fleet base
+        # directory), and the delta fallback consults that directory so a
+        # base acked against ANOTHER shard — or against this one before a
+        # restart — still cuts a delta instead of re-shipping full bytes
+        self._provenance = provenance
+        self.fleet = fleet
         # with a ProofService attached, generations ride its batcher's
         # PUSH lane (`submit_range_window(lane="push")`) instead of this
         # matcher's private executor — one priority order across
@@ -166,6 +175,14 @@ class StandingQueryMatcher:
                 self._metrics.count("subs.empty_matches")
                 continue
             bundle, payload, digest = result
+            if self._provenance is not None:
+                try:
+                    self._provenance.append_served(
+                        digest, key=fkey, verdict="pushed",
+                        cids=bundle.cid_set(),
+                    )
+                except Exception:  # fail-soft: a registry write failure must never block the push
+                    self._metrics.count("registry.append_failures")
             with self._lock:
                 prev = self._filter_bases.get(fkey)
             # one delta per (filter, base) serves every subscriber parked
@@ -206,16 +223,45 @@ class StandingQueryMatcher:
         ``witness.delta_fallbacks``: degradation, never a wrong delta.
         """
         base = self._log.acked_base(sub.sub_id)
+        if base is None and self._provenance is not None:
+            # fresh delivery log (failover takeover): the fleet directory
+            # still knows the base THIS subscriber last acked — recorded
+            # by whichever shard served it — so the delta survives the
+            # shard that held the local acked state
+            try:
+                base = self._provenance.fleet_acked_base(
+                    self.fleet, filter_key(sub.filter), sub.sub_id
+                )
+            except Exception:  # fail-soft: directory trouble degrades to a full bundle, never an error
+                base = None
         if base is None or base == digest:
             return payload, digest  # nothing held yet / replay of same bundle
-        if prev is None or base != prev[0]:
-            self._metrics.count("witness.delta_fallbacks")
-            return payload, digest
+        if prev is not None and base == prev[0]:
+            base_cids = prev[1]
+        else:
+            # local miss (matcher restarted, base compacted, or the sub
+            # last acked against another shard): the fleet base directory
+            # may still know the base's CID set via ANY shard's serve
+            # record — a hit keeps the delta alive across failover
+            base_cids = None
+            if self._provenance is not None:
+                try:
+                    base_cids = self._provenance.lookup_base(base)
+                except Exception:  # fail-soft: directory trouble degrades to a full bundle, never an error
+                    base_cids = None
+                self._metrics.count(
+                    "witness.fleet_base_hits"
+                    if base_cids is not None
+                    else "witness.fleet_base_misses"
+                )
+            if base_cids is None:
+                self._metrics.count("witness.delta_fallbacks")
+                return payload, digest
         if base not in deltas:
             from ipc_proofs_tpu.witness.delta import encode_delta
 
             dobj = encode_delta(
-                bundle, prev[1], base, digest=digest, metrics=self._metrics
+                bundle, base_cids, base, digest=digest, metrics=self._metrics
             )
             deltas[base] = ({"bundle_delta": dobj}, f"delta:{base}:{digest}")
         self._metrics.count("witness.delta_hits")

@@ -34,6 +34,7 @@ __all__ = [
     "SUBS_COUNTERS",
     "VERIFY_COUNTERS",
     "WITNESS_COUNTERS",
+    "REGISTRY_COUNTERS",
     "BACKFILL_COUNTERS",
     "BACKFILL_GAUGES",
     "FLEET_COUNTERS",
@@ -423,6 +424,13 @@ SUBS_COUNTERS = (
 #   witness.compressed_frames — compressed witness frames emitted
 #   witness.encoding_rejects — requests naming an unknown/disabled
 #                             encoding, rejected with a typed 4xx
+#   witness.fleet_base_hits  — base digests unknown to the local
+#                             WitnessBaseCache but recovered from the
+#                             fleet-wide registry directory (another
+#                             shard's serve record) — the post-failover
+#                             delta save
+#   witness.fleet_base_misses — local miss AND directory miss → the
+#                             delta falls back to full (sound)
 WITNESS_COUNTERS = (
     "witness.aggregated_requests",
     "witness.aggregated_claims",
@@ -432,6 +440,33 @@ WITNESS_COUNTERS = (
     "witness.delta_blocks_dropped",
     "witness.compressed_frames",
     "witness.encoding_rejects",
+    "witness.fleet_base_hits",
+    "witness.fleet_base_misses",
+)
+
+# Counter vocabulary of the provenance registry (ipc_proofs_tpu/registry/):
+# the hash-linked audit log every served bundle seals a frame into, which
+# doubles as the fleet-wide delta base directory.
+#   registry.appends         — records committed to this process's chain
+#                             (serve seals + fleet base acks)
+#   registry.append_failures — appends that failed (write error or an
+#                             already-degraded writer): serving continued
+#                             bit-identical, the record was dropped — the
+#                             fail-soft contract, and the SLO watchdog's
+#                             registry_divergence anomaly signal
+#   registry.torn_tails      — torn tails truncated on open (crash
+#                             residue, recovered exactly like the jobs
+#                             journal — never an error)
+#   registry.proofs          — inclusion/consistency proofs generated
+#   registry.fleet_refresh_errors — sibling-shard log scans that failed
+#                             (missing/corrupt/torn sibling): fail-soft,
+#                             the directory just misses
+REGISTRY_COUNTERS = (
+    "registry.appends",
+    "registry.append_failures",
+    "registry.torn_tails",
+    "registry.proofs",
+    "registry.fleet_refresh_errors",
 )
 
 # Counter vocabulary of the cluster plane (cluster/router.py,

@@ -1,0 +1,129 @@
+"""Offline registry auditor (tools/auditview.py): full-chain verify,
+inclusion proof for a served digest, checkpoint diff — all from nothing
+but the log file, no daemon."""
+
+import hashlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+import auditview  # noqa: E402
+
+from ipc_proofs_tpu.registry import ProvenanceRegistry  # noqa: E402
+
+
+def _digest(i):
+    return hashlib.sha256(f"bundle-{i}".encode()).hexdigest()
+
+
+@pytest.fixture()
+def reg_log(tmp_path):
+    reg = ProvenanceRegistry(str(tmp_path), owner="a")
+    for i in range(5):
+        reg.append_served(
+            _digest(i), trace=f"t{i}", key=f"pair:{i}", verdict="valid",
+            cids=frozenset({hashlib.sha256(f"c{i}".encode()).digest()}),
+        )
+    reg.append_base_ack("pool", "k", "s1", _digest(2), 3)
+    head = reg.head()
+    reg.close()
+    return reg.path, head
+
+
+class TestVerify:
+    def test_clean_log_verifies(self, reg_log):
+        path, head = reg_log
+        out = auditview.verify_log(path)
+        assert out["ok"], out
+        assert out["records"] == 6
+        assert out["kinds"] == {"serve": 5, "base": 1}
+        # the offline root/tip equal what the daemon published
+        assert out["root"] == head["root"]
+        assert out["tip"] == head["tip"]
+        assert not out["torn_tail"]
+
+    def test_torn_tail_reported_but_passes(self, reg_log):
+        path, _head = reg_log
+        with open(path, "ab") as fh:
+            fh.write(b"IPR1\xff")
+        out = auditview.verify_log(path)
+        assert out["ok"] and out["torn_tail"]
+        assert out["records"] == 6
+
+    def test_flipped_bit_fails_typed(self, reg_log):
+        path, _head = reg_log
+        with open(path, "r+b") as fh:
+            fh.seek(30)
+            b = fh.read(1)
+            fh.seek(30)
+            fh.write(bytes([b[0] ^ 0x10]))
+        out = auditview.verify_log(path)
+        assert not out["ok"]
+        assert "error" in out
+
+
+class TestProve:
+    def test_inclusion_for_served_digest(self, reg_log):
+        path, head = reg_log
+        out = auditview.prove_digest(path, _digest(3))
+        assert out["ok"], out
+        assert out["seq"] == 3 and out["size"] == 6
+        assert out["root"] == head["root"]
+
+    def test_pinned_root_binds_log_to_checkpoint(self, reg_log):
+        path, head = reg_log
+        assert auditview.prove_digest(path, _digest(0), root_hex=head["root"])["ok"]
+        # against someone else's root the proof must NOT verify
+        bad = hashlib.sha256(b"forged").hexdigest()
+        assert not auditview.prove_digest(path, _digest(0), root_hex=bad)["ok"]
+
+    def test_unknown_digest(self, reg_log):
+        path, _head = reg_log
+        out = auditview.prove_digest(path, "ff" * 32)
+        assert not out["ok"] and "no serve record" in out["error"]
+
+
+class TestDiff:
+    def test_head_extends_checkpoint(self, reg_log):
+        path, _head = reg_log
+        for old in range(0, 7):
+            out = auditview.diff_checkpoints(path, old)
+            assert out["ok"], (old, out)
+            assert len(out["appended"]) == 6 - old
+        out = auditview.diff_checkpoints(path, 2)
+        assert [r["seq"] for r in out["appended"]] == [2, 3, 4, 5]
+
+    def test_forked_old_root_fails(self, reg_log):
+        path, _head = reg_log
+        forged = hashlib.sha256(b"other-history").hexdigest()
+        out = auditview.diff_checkpoints(path, 3, old_root_hex=forged)
+        assert not out["ok"]
+        assert "NOT an append-only extension" in out["error"]
+
+    def test_out_of_range(self, reg_log):
+        path, _head = reg_log
+        assert not auditview.diff_checkpoints(path, 99)["ok"]
+
+
+class TestCLI:
+    def test_verify_exit_codes(self, reg_log, capsys):
+        path, head = reg_log
+        assert auditview.main(["verify", path]) == 0
+        assert "OK:" in capsys.readouterr().out
+        assert auditview.main(
+            ["prove", path, "--digest", _digest(1), "--root", head["root"]]
+        ) == 0
+        assert auditview.main(["diff", path, "--old-size", "2", "--json"]) == 0
+        out = capsys.readouterr().out
+        assert '"ok": true' in out
+        # a tampered log exits 1 from every subcommand
+        with open(path, "r+b") as fh:
+            fh.seek(40)
+            b = fh.read(1)
+            fh.seek(40)
+            fh.write(bytes([b[0] ^ 0x01]))
+        assert auditview.main(["verify", path]) == 1
+        assert "FAIL" in capsys.readouterr().out

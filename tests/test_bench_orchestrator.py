@@ -262,6 +262,18 @@ _OVERLOAD_OK = {
     "overload_host_cpus": 4,
 }
 
+_REGISTRY_OK = {
+    "registry_append_overhead_pct": 0.6,
+    "registry_append_us": 26.7,
+    "registry_inclusion_proof_ms": 2.3,
+    "fleet_delta_hit_rate": 1.0,
+    "fleet_delta_baseline_hit_rate": 0.19,
+    "registry_chain_records": 2048,
+    "registry_serve_requests": 96,
+    "registry_shards": 4,
+    "registry_lookups": 32,
+}
+
 _BACKFILL_OK = {
     "backfill_epochs_per_sec": 95.0,
     "backfill_epochs_per_sec_1shard": 30.0,
@@ -312,6 +324,7 @@ class TestOrchestrate:
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
             "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
             "overload": [(dict(_OVERLOAD_OK), "ok:cpu")],
+            "registry": [(dict(_REGISTRY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0
         assert out["vs_baseline"] == 40.0
@@ -364,6 +377,11 @@ class TestOrchestrate:
         assert out["aggregate_proofs_per_sec_2host"] == 514.6
         assert out["replica_repair_hit_rate"] == 1.0
         assert out["kill_recovery_ms"] == 99.3
+        assert out["legs"]["registry"] == "ok:cpu"
+        assert out["registry_append_overhead_pct"] == 0.6
+        assert out["registry_inclusion_proof_ms"] == 2.3
+        assert out["fleet_delta_hit_rate"] == 1.0
+        assert out["fleet_delta_baseline_hit_rate"] == 0.19
 
     def test_stalled_e2e_downgrades_and_retries_on_cpu(self, monkeypatch, capsys):
         requested = []
@@ -388,6 +406,7 @@ class TestOrchestrate:
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
             "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
             "overload": [(dict(_OVERLOAD_OK), "ok:cpu")],
+            "registry": [(dict(_REGISTRY_OK), "ok:cpu")],
         }, requested=requested)
         assert out["watchdog_fallback"] is True
         assert out["legs"]["e2e"] == "timeout:default → ok:cpu"
@@ -403,7 +422,7 @@ class TestOrchestrate:
             ("observability", "cpu"), ("storage", "cpu"),
             ("asyncfetch", "cpu"), ("cluster", "cpu"), ("standing", "cpu"),
             ("fleetobs", "cpu"), ("backfill", "cpu"), ("zerocopy", "cpu"),
-            ("hostkill", "cpu"), ("overload", "cpu"),
+            ("hostkill", "cpu"), ("overload", "cpu"), ("registry", "cpu"),
         ]
 
     def test_stalled_secondary_leg_costs_only_itself(self, monkeypatch, capsys):
@@ -428,6 +447,7 @@ class TestOrchestrate:
             "zerocopy": [(dict(_ZEROCOPY_OK), "ok:cpu")],
             "hostkill": [(dict(_HOSTKILL_OK), "ok:cpu")],
             "overload": [(dict(_OVERLOAD_OK), "ok:cpu")],
+            "registry": [(dict(_REGISTRY_OK), "ok:cpu")],
         })
         assert out["value"] == 5000.0  # headline survives
         assert out["device_mask_kernel_events_per_sec"] is None
@@ -483,6 +503,7 @@ class TestOrchestrate:
             "zerocopy": [(None, "error:cpu")],
             "hostkill": [(None, "error:cpu")],
             "overload": [(None, "error:cpu")],
+            "registry": [(None, "error:cpu")],
         })
         # the artifact still prints, with every headline key present + null
         for key in (
@@ -517,6 +538,8 @@ class TestOrchestrate:
             "goodput_ratio_at_2x", "shed_rate",
             "light_tenant_p99_ms_overload", "cancel_reclaim_pct",
             "overload_capacity_rps", "overload_goodput_rps",
+            "registry_append_overhead_pct", "registry_inclusion_proof_ms",
+            "fleet_delta_hit_rate", "fleet_delta_baseline_hit_rate",
         ):
             assert key in out and out[key] is None, key
         assert out["legs"]["e2e"] == "timeout:default → timeout:cpu"

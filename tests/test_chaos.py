@@ -67,6 +67,18 @@ class TestChaosDifferential:
         from ipc_proofs_tpu.store.rpc import IntegrityError, LotusClient, RpcBlockstore
         from ipc_proofs_tpu.utils.metrics import Metrics
 
+        class _TickClock:
+            # breaker reset / probe-wave decisions count pool operations
+            # instead of wall time: on a loaded host real elapsed time can
+            # keep every breaker open long enough that all 12 seeds degrade
+            # and the non-vacuity assertion below goes hollow
+            def __init__(self, step_s=0.002):
+                self._t, self._step = 0.0, step_s
+
+            def __call__(self):
+                self._t += self._step
+                return self._t
+
         flips_seen = completed = 0
         for seed in range(12):
             m = Metrics()
@@ -85,7 +97,8 @@ class TestChaosDifferential:
                 for i in range(2)
             ]
             pool = EndpointPool(clients, breaker_threshold=3,
-                                breaker_reset_s=0.01, metrics=m)
+                                breaker_reset_s=0.01, metrics=m,
+                                clock=_TickClock())
             try:
                 bundle = generate_event_proofs_for_range_pipelined(
                     RpcBlockstore(pool, metrics=m), pairs, spec, chunk_size=3,

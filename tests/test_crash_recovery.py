@@ -123,6 +123,38 @@ def test_single_compaction_kill_point_detail(tmp_path):
 
 
 @pytest.mark.parametrize("seed", [20260807])
+def test_registry_crash_grid_extends_same_head(seed):
+    """SIGKILL the provenance-registry writer at frame boundaries and torn
+    mid-record: reopen must truncate the residue, the hash chain must
+    re-verify end to end, the committed prefix must be EXACT (crash_at+1
+    records for a boundary kill, crash_at for a torn one), and post-crash
+    appends must extend the same head — the pre-resume root is a proven
+    consistency prefix of the post-resume root."""
+    summary = crashtest.run_registry_grid(seed, points=8, n_records=12)
+    assert summary["ok"], summary["violations"]
+    assert summary["counts"] == {"identical": summary["points"]}
+    torn = [t for _, t in summary["kill_points"] if t is not None]
+    assert torn and len(torn) < summary["points"]
+
+
+def test_single_registry_kill_point_detail(tmp_path):
+    """One torn registry kill with internals exposed: residue visible as a
+    torn tail post-mortem, exactly crash_at committed records, and the
+    resume doubles the chain on the same head."""
+    shape = {
+        "pairs": 6, "chunk_size": 2, "receipts": 1, "events": 1,
+        "match_rate": 0.0, "record_workers": 1,
+    }
+    res = crashtest.registry_crash_run(
+        shape, crash_at=3, torn=13, workdir=str(tmp_path), tag="t"
+    )
+    assert res["outcome"] == "identical", res
+    assert res["records_after_crash"] == 3  # the torn 4th frame is residue
+    assert res["torn_tail"]
+    assert res["records_after_resume"] == 3 + 6
+
+
+@pytest.mark.parametrize("seed", [20260807])
 def test_sigterm_grid_backfill_and_stream(seed):
     """SIGTERM — the orchestrator-preemption signal — at both surfaces:
 

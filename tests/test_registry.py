@@ -1,0 +1,725 @@
+"""Proof provenance plane: hash-linked registry, Merkle proofs, fleet
+base directory (ipc_proofs_tpu/registry/).
+
+Four layers under test, bottom-up:
+
+- the RFC 6962 tree (`registry.mmr`) against a from-scratch recursive
+  reference — every inclusion and consistency proof for every (size,
+  index) in a grid, plus negative cases;
+- the IPR1 frame log (`registry.log`): torn tails truncate, and EVERY
+  single-bit flip anywhere in the file is caught typed or surfaces as a
+  strictly-shorter log (checkpoint-detectable) — never a silent
+  same-length parse of different bytes;
+- `ProvenanceRegistry`: append/proof/reopen, idempotent base acks,
+  sibling scans, fail-soft degrade with the in-memory head frozen;
+- the serving stack: a differential grid (buffered × streamed ×
+  aggregated HTTP, delta pushes) where every served bundle gets a
+  verifying inclusion + consistency proof, registry write failure leaves
+  responses bit-identical, and a killed shard's subscriber still gets a
+  valid delta from the fleet base directory.
+"""
+
+import hashlib
+import json
+import random
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from ipc_proofs_tpu.fixtures import build_range_world
+from ipc_proofs_tpu.proofs.bundle import UnifiedProofBundle
+from ipc_proofs_tpu.proofs.generator import EventProofSpec
+from ipc_proofs_tpu.proofs.range import TipsetPair, generate_event_proofs_for_range_chunked
+from ipc_proofs_tpu.registry import (
+    MerkleLog,
+    ProvenanceRegistry,
+    RegistryError,
+    frame_registry_record,
+    leaf_hash,
+    node_hash,
+    read_registry_frames,
+    record_digest,
+    verify_chain,
+    verify_consistency,
+    verify_inclusion,
+)
+from ipc_proofs_tpu.serve.httpd import ProofHTTPServer
+from ipc_proofs_tpu.serve.service import ProofService, ServiceConfig
+from ipc_proofs_tpu.subs import StandingQueries, filter_key, normalize_filter
+from ipc_proofs_tpu.utils.metrics import Metrics
+from ipc_proofs_tpu.witness import apply_delta
+from ipc_proofs_tpu.witness.bases import FleetBaseCache, WitnessBaseCache
+
+SIG = "NewTopDownMessage(bytes32,uint256)"
+SUBNET = "calib-subnet-1"
+ACTOR = 1001
+FILTER_A = {"signature": SIG, "topic1": SUBNET}
+
+_NOSLEEP = lambda s: None  # noqa: E731
+
+
+def _counters(m):
+    return m.snapshot()["counters"]
+
+
+def _wait_until(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# --------------------------------------------------------------------------
+# Merkle tree vs a from-scratch recursive reference
+# --------------------------------------------------------------------------
+
+
+def _ref_mth(leaves):
+    """RFC 6962 MTH, recursively — the independent oracle."""
+    n = len(leaves)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return leaves[0]
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return node_hash(_ref_mth(leaves[:k]), _ref_mth(leaves[k:]))
+
+
+def _leaves(n):
+    return [leaf_hash(f"leaf-{i}".encode()) for i in range(n)]
+
+
+class TestMerkle:
+    def test_roots_match_recursive_reference(self):
+        for n in range(0, 17):
+            assert MerkleLog(_leaves(n)).root() == _ref_mth(_leaves(n)), n
+
+    def test_incremental_append_equals_batch(self):
+        tree = MerkleLog()
+        for i in range(16):
+            assert tree.append(leaf_hash(f"leaf-{i}".encode())) == i
+            assert tree.root() == _ref_mth(_leaves(i + 1))
+            assert tree.size == i + 1
+
+    def test_every_inclusion_proof_verifies(self):
+        for n in range(1, 17):
+            tree = MerkleLog(_leaves(n))
+            root = tree.root()
+            for i in range(n):
+                path = tree.inclusion_path(i)
+                assert verify_inclusion(tree.leaves[i], i, n, path, root), (n, i)
+                # wrong leaf, wrong index, wrong root: all must fail
+                bad = leaf_hash(b"not-this-leaf")
+                assert not verify_inclusion(bad, i, n, path, root)
+                if n > 1:
+                    j = (i + 1) % n
+                    assert not verify_inclusion(tree.leaves[i], j, n, path, root)
+                assert not verify_inclusion(
+                    tree.leaves[i], i, n, path, hashlib.sha256(b"x").digest()
+                )
+
+    def test_every_consistency_proof_verifies(self):
+        for n in range(1, 17):
+            tree = MerkleLog(_leaves(n))
+            for m in range(0, n + 1):
+                old_root = tree.root_at(m)
+                assert old_root == _ref_mth(_leaves(m)), (m, n)
+                proof = tree.consistency_path(m) if 0 < m < n else []
+                assert verify_consistency(m, n, old_root, tree.root(), proof), (m, n)
+                # a forked history (different old root) must not verify
+                if m > 0:
+                    forked = _ref_mth(
+                        [leaf_hash(f"fork-{i}".encode()) for i in range(m)]
+                    )
+                    assert not verify_consistency(
+                        m, n, forked, tree.root(), proof
+                    ), (m, n)
+
+
+# --------------------------------------------------------------------------
+# IPR1 frame log + the single-bit tamper grid
+# --------------------------------------------------------------------------
+
+
+def _write_frames(path, objs):
+    payloads = []
+    prev = ""
+    with open(path, "wb") as fh:
+        for obj in objs:
+            rec = dict(obj, prev=prev)
+            frame = frame_registry_record(rec)
+            payloads.append(frame[12:])
+            prev = record_digest(frame[12:])
+            fh.write(frame)
+    return payloads
+
+
+def _sample_objs(n):
+    out = []
+    for i in range(n):
+        if i % 3 == 2:
+            out.append(
+                {"kind": "base", "fleet": "f", "key": "k", "sub": f"s{i}",
+                 "digest": f"d{i}", "cursor": i, "t": float(i)}
+            )
+        else:
+            out.append(
+                {"kind": "serve", "digest": f"d{i}", "trace": f"t{i}",
+                 "tenant": "", "key": f"pair:{i}", "verdict": "valid",
+                 "t": float(i), "cids": [f"{i:02x}aa", f"{i:02x}bb"]}
+            )
+    return out
+
+
+class TestRegistryLog:
+    def test_roundtrip_and_chain(self, tmp_path):
+        path = str(tmp_path / "reg-a.log")
+        payloads = _write_frames(path, _sample_objs(5))
+        entries, good, torn = read_registry_frames(path)
+        assert [p for _r, p, _o in entries] == payloads
+        assert not torn
+        assert verify_chain(entries) == record_digest(payloads[-1])
+
+    def test_missing_file_reads_empty(self, tmp_path):
+        entries, good, torn = read_registry_frames(str(tmp_path / "nope.log"))
+        assert (entries, torn) == ([], False)
+
+    def test_torn_tail_at_every_cut(self, tmp_path):
+        """Truncating the file anywhere inside the LAST frame is crash
+        residue: the complete prefix reads back, torn=True, no error."""
+        path = str(tmp_path / "reg-a.log")
+        _write_frames(path, _sample_objs(3))
+        full = open(path, "rb").read()
+        entries_all, _good, _ = read_registry_frames(path)
+        last_off = entries_all[-1][2]
+        for cut in range(last_off + 1, len(full)):
+            with open(path, "wb") as fh:
+                fh.write(full[:cut])
+            entries, good, torn = read_registry_frames(path)
+            assert torn and len(entries) == 2, cut
+            assert good == last_off
+
+    def test_broken_prev_link_typed(self, tmp_path):
+        path = str(tmp_path / "reg-a.log")
+        objs = _sample_objs(3)
+        with open(path, "wb") as fh:
+            prev = ""
+            for i, obj in enumerate(objs):
+                rec = dict(obj, prev=("bogus" if i == 2 else prev))
+                frame = frame_registry_record(rec)
+                prev = record_digest(frame[12:])
+                fh.write(frame)
+        entries, _good, _torn = read_registry_frames(path)
+        with pytest.raises(RegistryError, match="chain broken"):
+            verify_chain(entries)
+        with pytest.raises(RegistryError, match="chain broken"):
+            ProvenanceRegistry(str(tmp_path), owner="a")
+
+    def test_every_single_bit_flip_is_detected(self, tmp_path):
+        """The acceptance tamper grid: flip ONE bit at EVERY byte of the
+        log — magic, length, CRC, payload, prev-link chars, all of it.
+        Every flip must either raise the typed `RegistryError` (on read
+        or on chain verification) or strictly shorten the readable log
+        (which a pinned checkpoint catches: old_size > new size). No flip
+        may ever yield a clean same-length parse of different bytes."""
+        path = str(tmp_path / "reg-a.log")
+        payloads = _write_frames(path, _sample_objs(5))
+        clean = open(path, "rb").read()
+        n_clean = len(payloads)
+        outcomes = {"typed": 0, "shorter": 0}
+        for off in range(len(clean)):
+            for bit in (0, 7):
+                tampered = bytearray(clean)
+                tampered[off] ^= 1 << bit
+                with open(path, "wb") as fh:
+                    fh.write(bytes(tampered))
+                try:
+                    entries, _good, torn = read_registry_frames(path)
+                    verify_chain(entries)
+                except RegistryError:
+                    outcomes["typed"] += 1
+                    continue
+                # no typed error: the only acceptable story is a shorter
+                # log (a length-field flip making the tail look torn)
+                assert torn and len(entries) < n_clean, (off, bit)
+                assert [p for _r, p, _o in entries] == payloads[: len(entries)]
+                outcomes["shorter"] += 1
+        assert outcomes["typed"] > 0 and outcomes["shorter"] > 0
+        # typed detection must dominate: only tail-length flips truncate
+        assert outcomes["typed"] > outcomes["shorter"] * 10
+
+
+# --------------------------------------------------------------------------
+# ProvenanceRegistry
+# --------------------------------------------------------------------------
+
+
+def _digest(i):
+    return hashlib.sha256(f"bundle-{i}".encode()).hexdigest()
+
+
+def _cids(i, k=3):
+    return frozenset(
+        hashlib.sha256(f"cid-{i}-{j}".encode()).digest() for j in range(k)
+    )
+
+
+class TestProvenanceRegistry:
+    def test_append_proof_reopen_roundtrip(self, tmp_path):
+        m = Metrics()
+        reg = ProvenanceRegistry(str(tmp_path), owner="a", metrics=m)
+        for i in range(7):
+            assert reg.append_served(
+                _digest(i), trace=f"t{i}", key=f"pair:{i}", verdict="valid",
+                cids=_cids(i),
+            ) == i
+        head = reg.head()
+        assert (head["owner"], head["size"], head["degraded"]) == ("a", 7, False)
+
+        # every record: inclusion proof verifies against the head root
+        for i in range(7):
+            assert reg.seq_of(_digest(i)) == i
+            proof = reg.inclusion_proof(i)
+            assert verify_inclusion(
+                bytes.fromhex(proof["leaf"]), i, proof["size"],
+                [bytes.fromhex(h) for h in proof["path"]],
+                bytes.fromhex(head["root"]),
+            ), i
+            assert proof["record"]["digest"] == _digest(i)
+        # every checkpoint: consistency proof verifies against the head
+        for old in range(0, 8):
+            c = reg.consistency(old)
+            assert verify_consistency(
+                old, c["size"], bytes.fromhex(c["old_root"]),
+                bytes.fromhex(c["root"]),
+                [bytes.fromhex(h) for h in c["path"]],
+            ), old
+        assert _counters(m)["registry.appends"] == 7
+        reg.close()
+
+        # reopen: same head, chain continues (no re-append, no divergence)
+        reg2 = ProvenanceRegistry(str(tmp_path), owner="a", metrics=m)
+        assert reg2.head() == dict(head, log_bytes=reg2.head()["log_bytes"])
+        assert reg2.append_served(_digest(7), cids=_cids(7)) == 7
+        c = reg2.consistency(7)
+        assert c["old_root"] == head["root"]
+        reg2.close()
+
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        m = Metrics()
+        reg = ProvenanceRegistry(str(tmp_path), owner="a")
+        for i in range(3):
+            reg.append_served(_digest(i))
+        reg.close()
+        with open(reg.path, "ab") as fh:
+            fh.write(b"IPR1\x99\x00")  # torn header: crash residue
+        reg2 = ProvenanceRegistry(str(tmp_path), owner="a", metrics=m)
+        assert len(reg2) == 3
+        assert _counters(m)["registry.torn_tails"] == 1
+        # the residue is gone: the next append lands on a clean tail
+        reg2.append_served(_digest(3))
+        reg2.close()
+        entries, _g, torn = read_registry_frames(reg2.path)
+        assert len(entries) == 4 and not torn
+        verify_chain(entries)
+
+    def test_base_acks_idempotent_and_common_base(self, tmp_path):
+        reg = ProvenanceRegistry(str(tmp_path), owner="a")
+        reg.append_served(_digest(0), cids=_cids(0))
+        reg.append_served(_digest(1), cids=_cids(1))
+        assert reg.append_base_ack("f", "k", "s1", _digest(0), 1) is not None
+        # replaying the same latest ack (restart sweep) grows nothing
+        n = len(reg)
+        assert reg.append_base_ack("f", "k", "s1", _digest(0), 1) is None
+        assert len(reg) == n
+        # one member → its base IS the common base
+        assert reg.newest_common_base("f", "k") == _digest(0)
+        assert reg.fleet_acked_base("f", "k", "s1") == _digest(0)
+        # second member appears, still on the old base
+        reg.append_base_ack("f", "k", "s2", _digest(0), 1)
+        # s1 advances alone: common stays at the old digest…
+        reg.append_base_ack("f", "k", "s1", _digest(1), 2)
+        assert reg.newest_common_base("f", "k") == _digest(0)
+        # …until s2 follows
+        reg.append_base_ack("f", "k", "s2", _digest(1), 2)
+        assert reg.newest_common_base("f", "k") == _digest(1)
+        assert reg.lookup_base(_digest(1)) == _cids(1)
+        reg.close()
+
+    def test_sibling_scan_and_corrupt_sibling_fail_soft(self, tmp_path):
+        m = Metrics()
+        a = ProvenanceRegistry(str(tmp_path), owner="a")
+        a.append_served(_digest(0), cids=_cids(0))
+        a.append_base_ack("f", "k", "s1", _digest(0), 1)
+        a.close()
+        b = ProvenanceRegistry(str(tmp_path), owner="b", metrics=m)
+        # b's directory sees a's serve record AND a's fleet acks
+        assert b.lookup_base(_digest(0)) == _cids(0)
+        assert b.fleet_acked_base("f", "k", "s1") == _digest(0)
+        assert b.newest_common_base("f", "k") == _digest(0)
+        # a sibling going corrupt is counted, never fatal
+        with open(a.path, "r+b") as fh:
+            fh.seek(20)
+            byte = fh.read(1)
+            fh.seek(20)
+            fh.write(bytes([byte[0] ^ 0x01]))
+        c = ProvenanceRegistry(str(tmp_path), owner="c", metrics=m)
+        assert c.lookup_base(_digest(0)) is None  # miss, not a crash
+        assert _counters(m)["registry.fleet_refresh_errors"] >= 1
+        b.close()
+        c.close()
+
+    def test_write_failure_degrades_head_frozen(self, tmp_path):
+        m = Metrics()
+        reg = ProvenanceRegistry(str(tmp_path), owner="a", metrics=m)
+        reg.append_served(_digest(0))
+        head = reg.head()
+        # swap the log handle for a read-only one: the next write raises
+        # OSError exactly like ENOSPC/EROFS would
+        reg._writer._fh.close()
+        reg._writer._fh = open(reg.path, "rb")
+        assert reg.append_served(_digest(1)) is None
+        assert reg.degraded and reg.head()["degraded"]
+        # the in-memory head NEVER advanced on the failed write
+        assert reg.head()["size"] == head["size"]
+        assert reg.head()["root"] == head["root"]
+        assert reg.append_served(_digest(2)) is None  # permanently degraded
+        assert _counters(m)["registry.append_failures"] == 2
+        # the on-disk chain is still the clean prefix
+        entries, _g, _t = read_registry_frames(reg.path)
+        assert len(entries) == 1
+        verify_chain(entries)
+
+
+class TestFleetBaseCache:
+    def test_local_hit_fleet_hit_and_miss(self, tmp_path):
+        m = Metrics()
+        a = ProvenanceRegistry(str(tmp_path), owner="a")
+        a.append_served(_digest(0), cids=_cids(0))
+        a.close()
+        b = ProvenanceRegistry(str(tmp_path), owner="b")
+        local = WitnessBaseCache(cap=4)
+        cache = FleetBaseCache(local, b, metrics=m)
+        # local miss → fleet hit (a's serve record), then local is seeded
+        assert cache.lookup(_digest(0)) == _cids(0)
+        assert _counters(m)["witness.fleet_base_hits"] == 1
+        assert local.lookup(_digest(0)) == _cids(0)
+        assert cache.lookup(_digest(0)) == _cids(0)  # local now, no recount
+        assert _counters(m)["witness.fleet_base_hits"] == 1
+        assert cache.lookup("ffff") is None
+        assert _counters(m)["witness.fleet_base_misses"] == 1
+        assert len(cache) == len(local)
+        b.close()
+
+
+# --------------------------------------------------------------------------
+# serving stack: differential grid + fail-soft + failover delta
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def world():
+    return build_range_world(
+        4, receipts_per_pair=6, events_per_receipt=3, match_rate=0.5,
+        signature=SIG, topic1=SUBNET, actor_id=ACTOR, base_height=41_000,
+    )
+
+
+def _get(port, path):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path, None, {})
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read())
+
+
+def _post(port, path, obj):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, json.dumps(obj),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, resp.read()
+
+
+def _check_served(port, digest):
+    """The acceptance predicate: the served digest has an inclusion proof
+    verifying against the live head, and the head extends checkpoint 1."""
+    status, head = _get(port, "/v1/registry/head")
+    assert status == 200
+    status, proof = _get(port, f"/v1/registry/proof?digest={digest}")
+    assert status == 200, proof
+    assert verify_inclusion(
+        bytes.fromhex(proof["leaf"]), proof["seq"], proof["size"],
+        [bytes.fromhex(h) for h in proof["path"]],
+        bytes.fromhex(head["root"]),
+    ), digest
+    assert proof["record"]["digest"] == digest
+    status, c = _get(port, "/v1/registry/consistency?old_size=1")
+    assert status == 200
+    assert verify_consistency(
+        1, c["size"], bytes.fromhex(c["old_root"]), bytes.fromhex(c["root"]),
+        [bytes.fromhex(h) for h in c["path"]],
+    )
+
+
+class TestServeDifferentialGrid:
+    def test_every_served_bundle_proves_inclusion(self, world, tmp_path):
+        """Buffered × streamed × aggregated: each response seals exactly
+        one serve record whose inclusion proof verifies against the head
+        the daemon publishes right after."""
+        from ipc_proofs_tpu.witness.stream import decode_bundle_stream
+
+        store, pairs, _ = world
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET,
+                              actor_id_filter=ACTOR)
+        svc = ProofService(
+            store=store, spec=spec,
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=2,
+                                 registry_dir=str(tmp_path), registry_owner="t"),
+        )
+        httpd = ProofHTTPServer(svc, pairs=pairs).start()
+        try:
+            served = []
+            # buffered generate
+            status, raw = _post(httpd.port, "/v1/generate", {"pair_index": 0})
+            assert status == 200
+            out = json.loads(raw)
+            served.append(out["digest"])
+            # streamed generate
+            status, raw = _post(
+                httpd.port, "/v1/generate", {"pair_index": 1, "stream": True}
+            )
+            assert status == 200
+            sout = decode_bundle_stream(raw)
+            served.append(sout["digest"])
+            # aggregated range (buffered)
+            status, raw = _post(httpd.port, "/v1/generate_range",
+                                {"pair_indexes": [0, 1]})
+            assert status == 200
+            served.append(json.loads(raw)["digest"])
+            # aggregated range (streamed)
+            status, raw = _post(
+                httpd.port, "/v1/generate_range",
+                {"pair_indexes": [2, 3], "stream": True},
+            )
+            assert status == 200
+            served.append(decode_bundle_stream(raw)["digest"])
+
+            status, head = _get(httpd.port, "/v1/registry/head")
+            assert (status, head["size"]) == (200, 4)
+            for digest in served:
+                assert digest
+                _check_served(httpd.port, digest)
+            # the sealed kinds/keys tell the story
+            status, e0 = _get(httpd.port, "/v1/registry/entry?seq=0")
+            assert (e0["kind"], e0["key"]) == ("serve", "pair:0")
+            # health carries the registry head
+            status, health = _get(httpd.port, "/healthz")
+            assert health["registry"] == "ok"
+            assert health["registry_head"]["size"] == 4
+        finally:
+            httpd.shutdown(timeout=30)
+
+    def test_registry_failure_is_fail_soft(self, world, tmp_path):
+        """Force the writer into OSError-degrade mid-flight: responses
+        stay bit-identical to a registry-less service, the counter and
+        /healthz tell the operator, serving never blocks."""
+        store, pairs, _ = world
+        spec = EventProofSpec(event_signature=SIG, topic_1=SUBNET,
+                              actor_id_filter=ACTOR)
+        m_plain = Metrics()
+        svc_plain = ProofService(
+            store=store, spec=spec, metrics=m_plain,
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=2),
+        )
+        httpd_plain = ProofHTTPServer(svc_plain, pairs=pairs).start()
+        m = Metrics()
+        svc = ProofService(
+            store=store, spec=spec, metrics=m,
+            config=ServiceConfig(max_batch=8, max_wait_ms=5.0, workers=2,
+                                 registry_dir=str(tmp_path), registry_owner="t"),
+        )
+        httpd = ProofHTTPServer(svc, pairs=pairs).start()
+        try:
+            from ipc_proofs_tpu.witness.stream import decode_bundle_stream
+
+            # break the log handle: every append from here raises OSError
+            svc.registry._writer._fh.close()
+            svc.registry._writer._fh = open(svc.registry.path, "rb")
+            for req in ({"pair_index": 0}, {"pair_index": 1, "stream": True}):
+                status, raw = _post(httpd.port, "/v1/generate", dict(req))
+                status_p, raw_p = _post(httpd_plain.port, "/v1/generate", dict(req))
+                assert status == status_p == 200
+                dec = decode_bundle_stream if req.get("stream") else json.loads
+                out, out_p = dec(raw), dec(raw_p)
+                # the proof payload is bit-identical; only wall-clock
+                # timing fields may differ between the two instances
+                assert out["digest"] == out_p["digest"]
+                assert out["bundle"] == out_p["bundle"]
+            assert _counters(m)["registry.append_failures"] >= 2
+            status, health = _get(httpd.port, "/healthz")
+            assert (status, health["registry"]) == (200, "degraded")
+            assert health["status"] == "ok"  # serving itself is fine
+        finally:
+            httpd.shutdown(timeout=30)
+            httpd_plain.shutdown(timeout=30)
+
+
+class _RecordingOpener:
+    def __init__(self):
+        self.sent = []
+
+    def __call__(self, url, body, timeout_s):
+        env = json.loads(body)
+        self.sent.append((url, env))
+        return 200
+
+    def envelopes(self):
+        return [env for _u, env in self.sent]
+
+
+def _expected(store, pair, filt):
+    spec = EventProofSpec(
+        event_signature=filt["signature"], topic_1=filt["topic1"],
+        actor_id_filter=filt.get("actor_id"),
+    )
+    bundle = generate_event_proofs_for_range_chunked(store, [pair], spec,
+                                                     chunk_size=8)
+    obj = bundle.to_json_obj()
+    from ipc_proofs_tpu.subs.matcher import _bundle_digest
+
+    return obj, _bundle_digest(obj)
+
+
+class TestFleetFailoverDelta:
+    def test_replacement_shard_serves_delta_from_fleet_directory(
+        self, world, tmp_path
+    ):
+        """Kill-a-shard: shard A pushes pair 0 to a webhook subscriber
+        (who acks), then dies taking its delivery log with it. Shard B —
+        fresh subs root, same shared registry dir — pushes pair 1. The
+        fleet directory supplies both the base the subscriber acked AND
+        its CID set, so B ships a DELTA that expands byte-identical; the
+        per-shard-cache baseline (no registry) degrades to full."""
+        store, pairs, _ = world
+        regroot = str(tmp_path / "reg")
+        fkey = filter_key(normalize_filter(FILTER_A))
+
+        # shard A: serve pair 0, subscriber acks (webhook 200 auto-acks)
+        m_a = Metrics()
+        opener_a = _RecordingOpener()
+        reg_a = ProvenanceRegistry(regroot, owner="shard-a", metrics=m_a)
+        sq_a = StandingQueries(
+            str(tmp_path / "subs-a"), store=store, metrics=m_a, fsync=False,
+            opener=opener_a, sleep=_NOSLEEP, rng=random.Random(0),
+            provenance=reg_a, fleet="pool",
+        )
+        sq_a.subscribe({"filter": FILTER_A, "target": {"url": "http://h/w1"},
+                        "sub_id": "w1"})
+        assert sq_a.matcher.match_pair(pairs[0]) == 1
+        assert _wait_until(lambda: sq_a.log.pending_total() == 0)
+        obj0, digest0 = _expected(store, pairs[0], normalize_filter(FILTER_A))
+        assert opener_a.envelopes()[0]["digest"] == digest0
+        # the ack reporter sealed the base record for the fleet
+        assert reg_a.fleet_acked_base("pool", fkey, "w1") == digest0
+        sq_a.drain()
+        reg_a.close()  # shard A is dead; only its log file remains
+
+        # shard B: fresh subs root — local acked state is EMPTY
+        m_b = Metrics()
+        opener_b = _RecordingOpener()
+        reg_b = ProvenanceRegistry(regroot, owner="shard-b", metrics=m_b)
+        sq_b = StandingQueries(
+            str(tmp_path / "subs-b"), store=store, metrics=m_b, fsync=False,
+            opener=opener_b, sleep=_NOSLEEP, rng=random.Random(1),
+            provenance=reg_b, fleet="pool",
+        )
+        sq_b.subscribe({"filter": FILTER_A, "target": {"url": "http://h/w1"},
+                        "sub_id": "w1"})
+        try:
+            assert sq_b.matcher.match_pair(pairs[1]) == 1
+            assert _wait_until(lambda: sq_b.log.pending_total() == 0)
+            obj1, digest1 = _expected(store, pairs[1],
+                                      normalize_filter(FILTER_A))
+            env = opener_b.envelopes()[0]
+            assert env["digest"] == digest1
+            # the point: a DELTA against the base the dead shard recorded
+            assert "bundle_delta" in env, env.keys()
+            assert env["bundle_delta"]["base_digest"] == digest0
+            base = UnifiedProofBundle.from_json_obj(obj0)
+            assert apply_delta(env["bundle_delta"], base).to_json_obj() == obj1
+            c = _counters(m_b)
+            assert c["witness.fleet_base_hits"] >= 1
+            assert c.get("witness.delta_fallbacks", 0) == 0
+        finally:
+            sq_b.drain()
+            reg_b.close()
+
+    def test_baseline_without_directory_degrades_to_full(self, world, tmp_path):
+        """Same failover, no registry: the replacement shard can only
+        ship the full bundle — the measured gap the bench leg gates."""
+        store, pairs, _ = world
+        m_a = Metrics()
+        opener_a = _RecordingOpener()
+        sq_a = StandingQueries(
+            str(tmp_path / "subs-a"), store=store, metrics=m_a, fsync=False,
+            opener=opener_a, sleep=_NOSLEEP, rng=random.Random(0),
+        )
+        sq_a.subscribe({"filter": FILTER_A, "target": {"url": "http://h/w1"},
+                        "sub_id": "w1"})
+        assert sq_a.matcher.match_pair(pairs[0]) == 1
+        assert _wait_until(lambda: sq_a.log.pending_total() == 0)
+        sq_a.drain()
+
+        m_b = Metrics()
+        opener_b = _RecordingOpener()
+        sq_b = StandingQueries(
+            str(tmp_path / "subs-b"), store=store, metrics=m_b, fsync=False,
+            opener=opener_b, sleep=_NOSLEEP, rng=random.Random(1),
+        )
+        sq_b.subscribe({"filter": FILTER_A, "target": {"url": "http://h/w1"},
+                        "sub_id": "w1"})
+        try:
+            assert sq_b.matcher.match_pair(pairs[1]) == 1
+            assert _wait_until(lambda: sq_b.log.pending_total() == 0)
+            env = opener_b.envelopes()[0]
+            assert "bundle" in env and "bundle_delta" not in env
+        finally:
+            sq_b.drain()
+
+    def test_unknown_subscriber_never_gets_unsound_delta(self, world, tmp_path):
+        """Soundness guard: a subscriber the fleet directory has NEVER
+        seen ack anything must get the full bundle — a delta against a
+        base it doesn't hold would be wrong, not slow."""
+        store, pairs, _ = world
+        regroot = str(tmp_path / "reg")
+        # someone else's acks are on the chain under the same filter
+        reg_seed = ProvenanceRegistry(regroot, owner="seed")
+        fkey = filter_key(normalize_filter(FILTER_A))
+        obj0, digest0 = _expected(store, pairs[0], normalize_filter(FILTER_A))
+        reg_seed.append_served(digest0, key=fkey, cids=_cids(0))
+        reg_seed.append_base_ack("pool", fkey, "other-sub", digest0, 1)
+        reg_seed.close()
+
+        m = Metrics()
+        opener = _RecordingOpener()
+        reg = ProvenanceRegistry(regroot, owner="shard-b", metrics=m)
+        sq = StandingQueries(
+            str(tmp_path / "subs-b"), store=store, metrics=m, fsync=False,
+            opener=opener, sleep=_NOSLEEP, rng=random.Random(1),
+            provenance=reg, fleet="pool",
+        )
+        sq.subscribe({"filter": FILTER_A, "target": {"url": "http://h/new"},
+                      "sub_id": "never-acked"})
+        try:
+            assert sq.matcher.match_pair(pairs[1]) == 1
+            assert _wait_until(lambda: sq.log.pending_total() == 0)
+            env = opener.envelopes()[0]
+            assert "bundle" in env and "bundle_delta" not in env
+        finally:
+            sq.drain()
+            reg.close()

@@ -43,6 +43,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 # Pure-math and codec suites add wall-clock but no lock edges, so the
 # lockdep sweep stays a sub-minute gate instead of a full tier-1 re-run.
 LOCKDEP_TEST_FILES = (
+    "tests/test_auditview.py",
     "tests/test_backfill.py",
     "tests/test_cluster.py",
     "tests/test_cluster_replica.py",
@@ -53,6 +54,7 @@ LOCKDEP_TEST_FILES = (
     "tests/test_lockdep.py",
     "tests/test_parallel.py",
     "tests/test_range_pipeline.py",
+    "tests/test_registry.py",
     "tests/test_replica.py",
     "tests/test_serve.py",
     "tests/test_serve_durable.py",

@@ -45,7 +45,11 @@ flags. Two strictness levels:
   `hostkill_gate_skip_reason`), and the overload gates
   ``goodput_ratio_at_2x >= 0.8`` and ``cancel_reclaim_pct > 0`` whenever
   ``host_cores > 2`` (on smaller hosts the 2× closed-loop clients
-  time-slice the server's only cores — see `overload_gate_skip_reason`).
+  time-slice the server's only cores — see `overload_gate_skip_reason`),
+  and the provenance-registry gates ``registry_append_overhead_pct < 1``
+  and ``fleet_delta_hit_rate > fleet_delta_baseline_hit_rate`` (both are
+  same-host ratios — host-shape independent, see
+  `registry_gate_skip_reason`).
 
 Importable (``check_artifact(obj) -> list[str]`` of problems) and a CLI::
 
@@ -218,6 +222,15 @@ _KNOWN_TYPES = {
     "overload_doomed_requests": int,
     "overload_admit_limit_final": _NUM,
     "overload_host_cpus": int,
+    "registry_append_overhead_pct": _NUM,
+    "registry_append_us": _NUM,
+    "registry_inclusion_proof_ms": _NUM,
+    "fleet_delta_hit_rate": _NUM,
+    "fleet_delta_baseline_hit_rate": _NUM,
+    "registry_chain_records": int,
+    "registry_serve_requests": int,
+    "registry_shards": int,
+    "registry_lookups": int,
     "legs": dict,
     "watchdog_fallback": bool,
 }
@@ -265,6 +278,8 @@ _CURRENT_REQUIRED = (
     "kill_recovery_ms",
     "goodput_ratio_at_2x", "shed_rate", "light_tenant_p99_ms_overload",
     "cancel_reclaim_pct",
+    "registry_append_overhead_pct", "registry_inclusion_proof_ms",
+    "fleet_delta_hit_rate", "fleet_delta_baseline_hit_rate",
     "legs", "watchdog_fallback",
 )
 
@@ -711,6 +726,58 @@ def check_artifact(obj: dict, require_current: bool = False) -> list[str]:
                     "tight-deadline requests must be refused or dropped "
                     "before burning a worker, at least sometimes"
                 )
+        # the registry gate: sealing one provenance frame per served
+        # bundle must cost < 1% of the request it rides on, and the
+        # fleet base directory must beat per-shard base caches when a
+        # lookup lands on a shard that didn't serve the base. Both are
+        # ratios of measurements taken on the SAME host — the append/
+        # request costs scale together, and the hit rates are counting —
+        # so the gates are host-shape independent; only artifacts
+        # predating the registry leg skip.
+        if registry_gate_skip_reason(obj) is None:
+            ovh = obj.get("registry_append_overhead_pct")
+            proof_ms = obj.get("registry_inclusion_proof_ms")
+            fleet = obj.get("fleet_delta_hit_rate")
+            base = obj.get("fleet_delta_baseline_hit_rate")
+            for name, val in (
+                ("registry_append_overhead_pct", ovh),
+                ("registry_inclusion_proof_ms", proof_ms),
+                ("fleet_delta_hit_rate", fleet),
+                ("fleet_delta_baseline_hit_rate", base),
+            ):
+                if not isinstance(val, _NUM) or isinstance(val, bool):
+                    problems.append(
+                        f"registry gate: {name} is {val!r} "
+                        "(registry leg did not run?)"
+                    )
+            if (
+                isinstance(ovh, _NUM) and not isinstance(ovh, bool)
+                and ovh >= 1.0
+            ):
+                problems.append(
+                    f"registry gate: registry_append_overhead_pct={ovh} "
+                    ">= 1.0 — sealing a provenance frame must cost under "
+                    "1% of the request it audits"
+                )
+            if (
+                isinstance(proof_ms, _NUM) and not isinstance(proof_ms, bool)
+                and proof_ms <= 0
+            ):
+                problems.append(
+                    f"registry gate: registry_inclusion_proof_ms={proof_ms} "
+                    "<= 0 — inclusion proving must be a positive measurement"
+                )
+            if (
+                isinstance(fleet, _NUM) and not isinstance(fleet, bool)
+                and isinstance(base, _NUM) and not isinstance(base, bool)
+                and fleet <= base
+            ):
+                problems.append(
+                    f"registry gate: fleet_delta_hit_rate={fleet} <= "
+                    f"fleet_delta_baseline_hit_rate={base} — the fleet base "
+                    "directory must strictly beat per-shard base caches on "
+                    "scattered lookups"
+                )
         if cluster_gate_skip_reason(obj) is None:
             linearity = obj.get("cluster_linearity_4shard")
             if not isinstance(linearity, _NUM) or isinstance(linearity, bool):
@@ -951,6 +1018,19 @@ def hostkill_gate_skip_reason(obj: dict) -> "str | None":
     return None
 
 
+def registry_gate_skip_reason(obj: dict) -> "str | None":
+    """Why the append-overhead / fleet-directory gates do NOT apply (None
+    when they do). Both are same-host ratios (append cost over request
+    cost; hit counting over scattered lookups) — host-shape independent —
+    so the only skip is an artifact predating the registry leg."""
+    if (
+        "registry_append_overhead_pct" not in obj
+        and "fleet_delta_hit_rate" not in obj
+    ):
+        return "artifact predates the registry leg"
+    return None
+
+
 def overload_gate_skip_reason(obj: dict) -> "str | None":
     """Why the goodput-at-2× gate does NOT apply (None when it does).
     The ratio needs spare cores: on ≤2-core hosts the 2× closed-loop
@@ -1035,6 +1115,9 @@ def main(argv=None) -> int:
             reason = overload_gate_skip_reason(obj)
             if reason is not None:
                 print(f"{path}: overload gate SKIPPED ({reason})")
+            reason = registry_gate_skip_reason(obj)
+            if reason is not None:
+                print(f"{path}: registry gate SKIPPED ({reason})")
         if problems:
             rc = 1
             print(f"{path}: {len(problems)} problem(s)")

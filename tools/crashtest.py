@@ -243,6 +243,53 @@ def child_main(args) -> int:
     return 0
 
 
+def registry_child_main(args) -> int:
+    """Forked provenance appender: a `ProvenanceRegistry` at ``--job-dir``
+    receives ``--pairs`` deterministic serve records (digest, trace, CID
+    set all pure functions of the index), then writes its published head
+    to ``--out``.
+
+    ``IPC_REGISTRY_CRASH_AT=N`` SIGKILLs at the N-th append after the
+    frame is fully on disk (boundary kill → N+1 committed records);
+    ``+ IPC_REGISTRY_CRASH_TORN=K`` persists only the first K bytes of
+    that frame (torn kill → N committed records plus residue the reopen
+    must truncate). A clean (resume) run reopens the crashed log —
+    truncating residue, re-verifying the chain — and appends the same
+    ``--pairs`` records again, so the parent knows the exact expected
+    record count at every step."""
+    import hashlib
+
+    from ipc_proofs_tpu.registry import ProvenanceRegistry
+    from ipc_proofs_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    reg = ProvenanceRegistry(args.job_dir, owner="crash", metrics=metrics)
+    for i in range(args.pairs):
+        digest = hashlib.sha256(f"bundle-{i}".encode()).hexdigest()
+        reg.append_served(
+            digest,
+            trace=f"trace-{i}",
+            tenant="crashtest",
+            key=f"pair:{i}",
+            verdict="valid",
+            cids=frozenset(
+                hashlib.sha256(f"cid-{i}-{j}".encode()).digest()
+                for j in range(2)
+            ),
+            t=float(i),
+        )
+    head = reg.head()
+    reg.close()
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"head": head}, fh, sort_keys=True)
+    os.replace(tmp, args.out)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as fh:
+            json.dump({"counters": metrics.snapshot()["counters"]}, fh)
+    return 0
+
+
 def _spawn_child(
     job_dir: str,
     out: str,
@@ -255,6 +302,7 @@ def _spawn_child(
     backfill: bool = False,
     rebalance: bool = False,
     stream: bool = False,
+    registry: bool = False,
 ) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
@@ -270,6 +318,8 @@ def _spawn_child(
         cmd.append("--rebalance")
     if stream:
         cmd.append("--stream")
+    if registry:
+        cmd.append("--registry")
     if metrics_out:
         cmd += ["--metrics-out", metrics_out]
     env = dict(os.environ)
@@ -283,12 +333,15 @@ def _spawn_child(
         "IPC_COMPACT_CRASH_BYTES",
         "IPC_COMPACT_CRASH_POST",
         "IPC_STREAM_TERM_AT_CHUNK",
+        "IPC_REGISTRY_CRASH_AT",
+        "IPC_REGISTRY_CRASH_TORN",
     ):
         env.pop(key, None)
     if crash_at is not None:
-        env["IPC_JOURNAL_CRASH_AT"] = str(crash_at)
+        prefix = "IPC_REGISTRY" if registry else "IPC_JOURNAL"
+        env[f"{prefix}_CRASH_AT"] = str(crash_at)
         if torn is not None:
-            env["IPC_JOURNAL_CRASH_TORN"] = str(torn)
+            env[f"{prefix}_CRASH_TORN"] = str(torn)
     if extra_env:
         env.update(extra_env)
     return subprocess.run(
@@ -938,6 +991,165 @@ def run_compaction_grid(
     }
 
 
+def registry_crash_run(
+    shape: dict,
+    crash_at: int,
+    torn: "int | None",
+    workdir: str,
+    tag: "str | int" = 0,
+) -> dict:
+    """One provenance-registry kill point. The invariant is NOT
+    byte-identity (the registry is append-only, not replayed) but the
+    audit chain's crash contract:
+
+    - the committed prefix is exact — ``crash_at + 1`` records for a
+      boundary kill, ``crash_at`` for a torn one (residue truncatable);
+    - the survivor log re-verifies: every CRC, every prev-link;
+    - the resumed process reopens the SAME log and its appends extend the
+      same head — the old root is a proven consistency prefix of the new.
+    """
+    from ipc_proofs_tpu.registry.log import read_registry_frames, verify_chain
+    from ipc_proofs_tpu.registry.mmr import (
+        MerkleLog,
+        leaf_hash,
+        verify_consistency,
+    )
+
+    job_dir = os.path.join(workdir, f"reg_{tag}")
+    out = os.path.join(workdir, f"reg_out_{tag}.json")
+    log_path = os.path.join(job_dir, "reg-crash.log")
+    res: dict = {"crash_at": crash_at, "torn": torn}
+
+    crashed = _spawn_child(
+        job_dir, out, shape, crash_at=crash_at, torn=torn, registry=True
+    )
+    if crashed.returncode != -signal.SIGKILL:
+        res["outcome"] = "no_crash"
+        res["rc"] = crashed.returncode
+        res["stderr"] = crashed.stderr[-2000:]
+        return res
+
+    # post-mortem: survivor log must hold the exact committed prefix,
+    # chain-verified, with torn residue iff the kill was torn
+    try:
+        entries, _good, torn_tail = read_registry_frames(log_path)
+        verify_chain(entries)
+    except Exception as exc:  # fail-soft: any reopen failure IS the grid outcome under test
+        res["outcome"] = "chain_corrupt"
+        res["error"] = f"{type(exc).__name__}: {exc}"
+        return res
+    expect = crash_at if torn is not None else crash_at + 1
+    res["records_after_crash"] = len(entries)
+    res["torn_tail"] = torn_tail
+    if len(entries) != expect:
+        res["outcome"] = "commit_count_wrong"
+        res["expected"] = expect
+        return res
+    if torn_tail != (torn is not None):
+        res["outcome"] = "torn_flag_wrong"
+        return res
+    old_tree = MerkleLog([leaf_hash(p) for _rec, p, _off in entries])
+    old_size, old_root = old_tree.size, old_tree.root()
+
+    # resume: reopen (truncates residue, replays chain), append more
+    resumed = _spawn_child(job_dir, out, shape, registry=True)
+    if resumed.returncode != 0:
+        res["outcome"] = "resume_failed"
+        res["rc"] = resumed.returncode
+        res["stderr"] = resumed.stderr[-2000:]
+        return res
+    try:
+        entries2, _good2, torn2 = read_registry_frames(log_path)
+        verify_chain(entries2)
+    except Exception as exc:  # fail-soft: any reopen failure IS the grid outcome under test
+        res["outcome"] = "post_resume_corrupt"
+        res["error"] = f"{type(exc).__name__}: {exc}"
+        return res
+    res["records_after_resume"] = len(entries2)
+    if torn2 or len(entries2) != old_size + shape["pairs"]:
+        res["outcome"] = "resume_count_wrong"
+        res["expected"] = old_size + shape["pairs"]
+        return res
+    new_tree = MerkleLog([leaf_hash(p) for _rec, p, _off in entries2])
+    proof = (
+        new_tree.consistency_path(old_size)
+        if 0 < old_size < new_tree.size
+        else []
+    )
+    if not verify_consistency(
+        old_size, new_tree.size, old_root, new_tree.root(), proof
+    ):
+        res["outcome"] = "head_diverged"
+        return res
+    # the child's published head must match the auditor's recomputation
+    with open(out) as fh:
+        head = json.load(fh)["head"]
+    if head["root"] != new_tree.root().hex() or head["size"] != new_tree.size:
+        res["outcome"] = "head_mismatch"
+        res["head"] = head
+        return res
+    res["outcome"] = "identical"
+    return res
+
+
+def run_registry_grid(
+    base_seed: int,
+    points: int = 8,
+    n_records: int = 12,
+    log=lambda msg: None,
+) -> dict:
+    """Seeded kill grid over the provenance registry writer: half
+    boundary kills (frame fully fsync'd), half torn mid-record writes,
+    kill indices drawn over the whole append range. ``ok`` iff every
+    point crashed, reopened with the exact committed prefix, re-verified
+    the chain, and extended the same head — and both flavors occurred."""
+    shape = {
+        "pairs": n_records, "chunk_size": 2, "receipts": 1, "events": 1,
+        "match_rate": 0.0, "record_workers": 1,
+    }
+    rng = random.Random(base_seed)
+    kill_points = []
+    for i in range(points):
+        crash_at = rng.randrange(n_records)
+        if i % 2 == 0:
+            kill_points.append((crash_at, None))  # boundary kill
+        else:
+            # torn write: tear inside the 12-byte header or the payload
+            kill_points.append((crash_at, rng.choice([1, 5, 11, 13, 64, 4096])))
+
+    counts: dict[str, int] = {}
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="crashtest_registry_") as workdir:
+        for i, (crash_at, torn) in enumerate(kill_points):
+            res = registry_crash_run(shape, crash_at, torn, workdir, tag=i)
+            counts[res["outcome"]] = counts.get(res["outcome"], 0) + 1
+            if res["outcome"] != "identical":
+                violations.append(res)
+            log(
+                f"registry kill at append {crash_at}"
+                + (f" torn@{torn}B" if torn is not None else " (boundary)")
+                + f": {res['outcome']}"
+                + (
+                    f" ({res.get('records_after_crash')} committed, "
+                    f"{res.get('records_after_resume')} after resume)"
+                    if "records_after_crash" in res else ""
+                )
+            )
+    boundary = sum(1 for _, t in kill_points if t is None)
+    ok = (
+        not violations
+        and boundary > 0
+        and boundary < len(kill_points)  # both flavors exercised
+    )
+    return {
+        "ok": ok,
+        "points": len(kill_points),
+        "kill_points": kill_points,
+        "counts": counts,
+        "violations": violations,
+    }
+
+
 def run_grid(
     base_seed: int,
     points: int = 8,
@@ -1055,6 +1267,13 @@ def main(argv=None) -> int:
         "mid-IPBS-stream (the torn prefix must decode to a typed error)",
     )
     ap.add_argument(
+        "--registry", action="store_true",
+        help="run the kill grid against the provenance registry writer "
+        "(IPC_REGISTRY_CRASH_AT/TORN): reopen must truncate residue, "
+        "re-verify the hash chain, and extend the same head (in --child "
+        "mode, selects the registry child)",
+    )
+    ap.add_argument(
         "--stream", action="store_true",
         help=argparse.SUPPRESS,  # internal: selects the IPBS stream child
     )
@@ -1072,12 +1291,25 @@ def main(argv=None) -> int:
             return rebalance_child_main(args)
         if args.stream:
             return stream_child_main(args)
+        if args.registry:
+            return registry_child_main(args)
         return backfill_child_main(args) if args.backfill else child_main(args)
     if args.seed is None:
         ap.error("seed is required")
 
     points = 4 if args.quick and args.points == 8 else args.points
     t0 = time.time()
+    if args.registry:
+        summary = run_registry_grid(
+            args.seed, points=points, n_records=args.pairs,
+            log=lambda m: print(f"[{time.time()-t0:6.1f}s] {m}", flush=True),
+        )
+        print(json.dumps(summary, indent=2))
+        if not summary["ok"]:
+            print("CRASH-RECOVERY INVARIANT VIOLATED", file=sys.stderr)
+            return 1
+        print("CRASH RECOVERY CLEAN")
+        return 0
     if args.sigterm:
         summary = run_sigterm_grid(
             args.seed,
